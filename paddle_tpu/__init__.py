@@ -1,0 +1,100 @@
+"""paddle_tpu — a TPU-native deep-learning framework with the PaddlePaddle
+API surface.
+
+Built per SURVEY.md: eager dygraph (tape autograd over JAX VJPs), static
+graph Programs + executor, yaml-style op registry over XLA/Pallas, AMP
+bf16, and the Fleet distributed stack on jax.sharding meshes.
+
+Usage mirrors paddle::
+
+    import paddle_tpu as paddle
+    x = paddle.to_tensor([[1., 2.]])
+    y = paddle.matmul(x, x.T)
+"""
+from __future__ import annotations
+
+import os as _os
+
+import jax as _jax
+
+# int64/float64 parity with paddle (TPU executes s64; f64 avoided in models)
+_jax.config.update("jax_enable_x64", True)
+# fp32 matmul semantics parity: full-precision f32 contractions (explicit
+# bf16 tensors still take the fast MXU path; AMP is the perf route, as in
+# the reference where fp32 uses FMA cuBLAS and AMP uses tensor cores)
+_jax.config.update("jax_default_matmul_precision", "highest")
+
+__version__ = "0.1.0"
+
+# ---- core ----
+from .core.dtypes import (  # noqa: F401
+    DType as dtype, uint8, int8, int16, int32, int64, float16, bfloat16,
+    float32, float64, complex64, complex128, bool_ as bool,
+    get_default_dtype, set_default_dtype, finfo, iinfo,
+)
+from .core.place import (  # noqa: F401
+    CPUPlace, TPUPlace, CUDAPlace, XPUPlace, CustomPlace, CUDAPinnedPlace,
+    set_device, get_device, is_compiled_with_cuda, is_compiled_with_tpu,
+    is_compiled_with_rocm, is_compiled_with_xpu,
+)
+from .core.tensor import Tensor, to_tensor  # noqa: F401
+from .core.autograd import no_grad, enable_grad, set_grad_enabled, \
+    is_grad_enabled  # noqa: F401
+
+# ---- ops (also patches Tensor methods) ----
+from . import ops  # noqa: F401
+from .ops.creation import *  # noqa: F401,F403
+from .ops.math import *  # noqa: F401,F403
+from .ops.manipulation import *  # noqa: F401,F403
+from .ops.linalg import *  # noqa: F401,F403
+from .ops.reduction import *  # noqa: F401,F403
+from .ops.comparison import *  # noqa: F401,F403
+from .ops.linalg import inverse  # noqa: F401
+from .ops.manipulation import nonzero  # noqa: F401
+
+# ---- framework ----
+from .framework.random import seed, get_rng_state, set_rng_state, \
+    get_cuda_rng_state, set_cuda_rng_state  # noqa: F401
+from .framework.io import save, load  # noqa: F401
+from .nn.layer.layers import ParamAttr, create_parameter  # noqa: F401
+from .nn.clip import ClipGradByValue, ClipGradByNorm, \
+    ClipGradByGlobalNorm  # noqa: F401
+
+# ---- subpackages ----
+from . import nn  # noqa: F401
+from . import optimizer  # noqa: F401
+from . import io  # noqa: F401
+from . import regularizer  # noqa: F401
+from . import autograd  # noqa: F401
+from . import linalg  # noqa: F401
+
+# late imports (depend on the above)
+from . import amp  # noqa: F401
+from . import static  # noqa: F401
+from . import jit  # noqa: F401
+from . import device  # noqa: F401
+from . import vision  # noqa: F401
+from . import metric  # noqa: F401
+from . import distributed  # noqa: F401
+from . import profiler  # noqa: F401
+from . import incubate  # noqa: F401
+from . import framework  # noqa: F401
+
+from .jit import grad  # noqa: F401
+from .hapi import Model, summary  # noqa: F401
+from .framework.flags import set_flags, get_flags  # noqa: F401
+
+disable_static = static.disable_static
+enable_static = static.enable_static
+in_dynamic_mode = static.in_dynamic_mode
+
+# paddle.base compat alias (old paddle.fluid)
+from . import base  # noqa: F401
+
+
+def is_grad_enabled_():
+    return is_grad_enabled()
+
+
+def grad_(*args, **kwargs):
+    return grad(*args, **kwargs)

@@ -1,0 +1,248 @@
+"""paddle.amp: auto_cast + GradScaler.
+
+Reference parity: `python/paddle/amp/auto_cast.py` (O1/O2 white/black op
+lists), `grad_scaler.py` [UNVERIFIED — empty reference mount].
+
+TPU-native: bf16 is the native AMP dtype (MXU computes bf16 with f32
+accumulation); no loss scaling is needed for bf16, but GradScaler implements
+real fp16 dynamic scaling for parity.  The caster installs on the dispatch
+path exactly where Paddle's generated AMP branch sits in `*_ad_func`.
+"""
+from __future__ import annotations
+
+import contextlib
+
+import numpy as np
+import jax.numpy as jnp
+
+from ..core.dispatch import get_dispatch_state
+from ..core.dtypes import to_jax_dtype
+from ..core.tensor import Tensor
+
+__all__ = ["auto_cast", "amp_guard", "decorate", "GradScaler",
+           "white_list", "black_list", "is_float16_supported",
+           "is_bfloat16_supported"]
+
+# op lists follow Paddle's O1 defaults: matmul-class ops cast to low
+# precision; numerically-sensitive ops stay f32.
+WHITE_LIST = {
+    "matmul_v2", "mm", "bmm", "mv", "linear", "conv1d", "conv2d", "conv3d",
+    "conv1d_transpose", "conv2d_transpose", "conv3d_transpose", "einsum",
+    "scaled_dot_product_attention", "addmm", "inner",
+}
+BLACK_LIST = {
+    "exp", "square", "log", "log2", "log10", "log1p", "mean", "reduce_mean",
+    "reduce_sum", "sum", "cos_sim", "softmax", "log_softmax",
+    "softmax_with_cross_entropy", "cross_entropy", "sigmoid_cross_entropy",
+    "c_softmax_with_cross_entropy", "layer_norm", "batch_norm", "rms_norm",
+    "p_norm", "l2_normalize", "reduce_prod", "pow", "erf", "logsumexp",
+    "variance", "std", "group_norm", "instance_norm",
+}
+
+
+def white_list():
+    return set(WHITE_LIST)
+
+
+def black_list():
+    return set(BLACK_LIST)
+
+
+def is_float16_supported(device=None):
+    return True
+
+
+def is_bfloat16_supported(device=None):
+    return True
+
+
+class _AmpState:
+    def __init__(self, enable, dtype, level):
+        self.enable = enable
+        self.dtype = to_jax_dtype(dtype)
+        self.level = level
+
+
+_amp_stack = []
+
+
+def amp_state():
+    return _amp_stack[-1] if _amp_stack else None
+
+
+def _cast_tensor(t, dtype):
+    if not isinstance(t, Tensor):
+        return t
+    if not jnp.issubdtype(t._value.dtype, jnp.floating):
+        return t
+    if t._value.dtype == dtype:
+        return t
+    from ..ops.manipulation import cast
+    from ..core.dtypes import to_paddle_dtype
+    return cast(t, to_paddle_dtype(dtype))
+
+
+def _amp_caster(op_name, args):
+    st = amp_state()
+    if st is None or not st.enable:
+        return args
+    if st.level == "O2":
+        # cast everything except black list
+        if op_name in BLACK_LIST:
+            target = jnp.float32
+        else:
+            target = st.dtype
+        return tuple(_cast_tensor(a, target) for a in args)
+    # O1: white list → low precision; black list → f32; else leave
+    if op_name in WHITE_LIST:
+        return tuple(_cast_tensor(a, st.dtype) for a in args)
+    if op_name in BLACK_LIST:
+        return tuple(_cast_tensor(a, jnp.float32) for a in args)
+    return args
+
+
+@contextlib.contextmanager
+def auto_cast(enable=True, custom_white_list=None, custom_black_list=None,
+              level="O1", dtype="float16", use_promote=True):
+    global WHITE_LIST, BLACK_LIST
+    saved_w, saved_b = set(WHITE_LIST), set(BLACK_LIST)
+    if custom_white_list:
+        WHITE_LIST |= set(custom_white_list)
+        BLACK_LIST -= set(custom_white_list)
+    if custom_black_list:
+        BLACK_LIST |= set(custom_black_list)
+        WHITE_LIST -= set(custom_black_list)
+    st = _AmpState(enable, dtype, level)
+    _amp_stack.append(st)
+    ds = get_dispatch_state()
+    prev = ds.amp_caster
+    ds.amp_caster = _amp_caster
+    try:
+        yield
+    finally:
+        _amp_stack.pop()
+        ds.amp_caster = prev if _amp_stack else None
+        WHITE_LIST, BLACK_LIST = saved_w, saved_b
+
+
+amp_guard = auto_cast
+
+
+def decorate(models, optimizers=None, level="O1", dtype="float16",
+             master_weight=None, save_dtype=None, **kwargs):
+    """paddle.amp.decorate — O2 casts model params to the AMP dtype and
+    keeps f32 master weights in the optimizer (which our optimizers do
+    automatically: accumulators and the update math are f32)."""
+    if level == "O2":
+        items = models if isinstance(models, (list, tuple)) else [models]
+        for m in items:
+            m._cast_all(dtype)
+    if optimizers is None:
+        return models
+    return models, optimizers
+
+
+class GradScaler:
+    """Dynamic loss scaling (needed for fp16; harmless for bf16).
+
+    Reference parity: `python/paddle/amp/grad_scaler.py` (scale, minimize,
+    found_inf handling, dynamic window growth) [UNVERIFIED].
+    """
+
+    def __init__(self, enable=True, init_loss_scaling=2.0 ** 15,
+                 incr_ratio=2.0, decr_ratio=0.5, incr_every_n_steps=2000,
+                 decr_every_n_nan_or_inf=1, use_dynamic_loss_scaling=True):
+        self._enable = enable
+        self._scale = float(init_loss_scaling)
+        self._incr_ratio = incr_ratio
+        self._decr_ratio = decr_ratio
+        self._incr_every_n_steps = incr_every_n_steps
+        self._decr_every_n = decr_every_n_nan_or_inf
+        self._dynamic = use_dynamic_loss_scaling
+        self._good_steps = 0
+        self._bad_steps = 0
+        self._found_inf = False
+
+    def is_enable(self):
+        return self._enable
+
+    def is_use_dynamic_loss_scaling(self):
+        return self._dynamic
+
+    def get_loss_scaling(self):
+        from ..core.tensor import to_tensor
+        return to_tensor(self._scale)
+
+    def scale(self, var):
+        if not self._enable:
+            return var
+        from ..ops.math import multiply
+        from ..core.tensor import to_tensor
+        return multiply(var, to_tensor(np.asarray(
+            self._scale, np.float32)))
+
+    def unscale_(self, optimizer):
+        if not self._enable:
+            return
+        inv = 1.0 / self._scale
+        found = False
+        for p in optimizer._params_with_grad():
+            g = p.grad._value.astype(jnp.float32) * inv
+            p.grad._local_value_update(g.astype(p.grad._value.dtype))
+        # found_inf check (host sync; same cost profile as reference
+        # check_finite_and_unscale kernel + D2H flag read)
+        for p in optimizer._params_with_grad():
+            if not bool(jnp.isfinite(p.grad._value.astype(
+                    jnp.float32)).all()):
+                found = True
+                break
+        self._found_inf = found
+
+    def step(self, optimizer):
+        if not self._enable:
+            optimizer.step()
+            return
+        if not getattr(self, "_unscaled", False):
+            self.unscale_(optimizer)
+        if not self._found_inf:
+            optimizer.step()
+        self._unscaled = False
+        self.update()
+
+    def minimize(self, optimizer, scaled_loss):
+        scaled_loss.backward()
+        self.step(optimizer)
+
+    def update(self):
+        if not self._dynamic:
+            return
+        if self._found_inf:
+            self._bad_steps += 1
+            self._good_steps = 0
+            if self._bad_steps >= self._decr_every_n:
+                self._scale = max(self._scale * self._decr_ratio, 1.0)
+                self._bad_steps = 0
+        else:
+            self._good_steps += 1
+            self._bad_steps = 0
+            if self._good_steps >= self._incr_every_n_steps:
+                self._scale *= self._incr_ratio
+                self._good_steps = 0
+
+    def state_dict(self):
+        return {"scale": self._scale, "incr_ratio": self._incr_ratio,
+                "decr_ratio": self._decr_ratio,
+                "incr_every_n_steps": self._incr_every_n_steps,
+                "decr_every_n_nan_or_inf": self._decr_every_n,
+                "good_steps": self._good_steps,
+                "bad_steps": self._bad_steps}
+
+    def load_state_dict(self, state):
+        self._scale = state.get("scale", self._scale)
+        self._good_steps = state.get("good_steps", 0)
+        self._bad_steps = state.get("bad_steps", 0)
+
+    set_state_dict = load_state_dict
+
+
+from . import debugging  # noqa: E402,F401

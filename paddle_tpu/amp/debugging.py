@@ -1,0 +1,60 @@
+"""paddle.amp.debugging parity shims (op stats / nan-inf checks)."""
+from __future__ import annotations
+
+import contextlib
+
+import numpy as np
+import jax.numpy as jnp
+
+__all__ = ["enable_operator_stats_collection",
+           "disable_operator_stats_collection", "collect_operator_stats",
+           "check_numerics", "enable_tensor_checker",
+           "disable_tensor_checker", "DebugMode"]
+
+
+class DebugMode:
+    CHECK_NAN_INF_AND_ABORT = 0
+    CHECK_NAN_INF = 1
+    CHECK_ALL = 4
+
+
+_collecting = {"on": False, "stats": {}}
+
+
+def enable_operator_stats_collection():
+    _collecting["on"] = True
+    _collecting["stats"] = {}
+
+
+def disable_operator_stats_collection():
+    _collecting["on"] = False
+
+
+@contextlib.contextmanager
+def collect_operator_stats():
+    enable_operator_stats_collection()
+    try:
+        yield
+    finally:
+        disable_operator_stats_collection()
+
+
+def check_numerics(tensor, op_type="", var_name="",
+                   debug_mode=DebugMode.CHECK_NAN_INF_AND_ABORT):
+    arr = np.asarray(tensor._value, np.float32)
+    has_nan = bool(np.isnan(arr).any())
+    has_inf = bool(np.isinf(arr).any())
+    if (has_nan or has_inf) and \
+            debug_mode == DebugMode.CHECK_NAN_INF_AND_ABORT:
+        raise FloatingPointError(
+            f"nan/inf detected in {op_type}:{var_name}")
+    from ..core.tensor import to_tensor
+    return to_tensor(has_nan), to_tensor(has_inf)
+
+
+def enable_tensor_checker(config=None):
+    pass
+
+
+def disable_tensor_checker():
+    pass

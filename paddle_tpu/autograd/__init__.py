@@ -1,0 +1,108 @@
+"""paddle.autograd parity: backward, grad, PyLayer, hooks.
+
+Reference parity: `python/paddle/autograd/` [UNVERIFIED — empty reference
+mount].
+"""
+from __future__ import annotations
+
+from ..core.autograd import (backward, grad, no_grad, enable_grad,
+                             set_grad_enabled, is_grad_enabled)
+
+__all__ = ["backward", "grad", "no_grad", "enable_grad", "set_grad_enabled",
+           "is_grad_enabled", "PyLayer", "PyLayerContext", "saved_tensors_hooks"]
+
+
+class PyLayerContext:
+    def __init__(self):
+        self._saved = []
+        self.attrs = {}
+
+    def save_for_backward(self, *tensors):
+        self._saved = list(tensors)
+
+    @property
+    def saved_tensor(self):
+        return self._saved
+
+    def saved_tensors(self):
+        return self._saved
+
+    def mark_not_inplace(self, *args):
+        pass
+
+    def mark_non_differentiable(self, *args):
+        pass
+
+    def set_materialize_grads(self, value):
+        pass
+
+
+class PyLayerMeta(type):
+    pass
+
+
+class PyLayer(metaclass=PyLayerMeta):
+    """Custom autograd op: subclass with static forward(ctx, ...) and
+    backward(ctx, *grads)."""
+
+    @staticmethod
+    def forward(ctx, *args, **kwargs):
+        raise NotImplementedError
+
+    @staticmethod
+    def backward(ctx, *args):
+        raise NotImplementedError
+
+    @classmethod
+    def apply(cls, *args, **kwargs):
+        from ..core import autograd as _ag
+        from ..core.tensor import Tensor
+
+        ctx = PyLayerContext()
+        with _ag.no_grad():
+            outputs = cls.forward(ctx, *args, **kwargs)
+        single = not isinstance(outputs, (tuple, list))
+        outs = (outputs,) if single else tuple(outputs)
+
+        tensor_inputs = [a for a in args if isinstance(a, Tensor)]
+        needs = [not t.stop_gradient for t in tensor_inputs]
+        if _ag.is_grad_enabled() and any(needs):
+            def vjp_fn(cots):
+                if not isinstance(cots, tuple):
+                    cots = (cots,)
+                cot_tensors = tuple(
+                    Tensor(c, _internal=True, stop_gradient=True)
+                    for c in cots)
+                with _ag.no_grad():
+                    gin = cls.backward(ctx, *cot_tensors)
+                if not isinstance(gin, (tuple, list)):
+                    gin = (gin,)
+                vals = []
+                gi = iter(gin)
+                for t in tensor_inputs:
+                    g = next(gi, None)
+                    vals.append(None if g is None else g._value)
+                return tuple(vals)
+
+            node = _ag.GradNode(
+                cls.__name__, vjp_fn, tensor_inputs, needs, len(outs),
+                [(o._value.shape, o._value.dtype) for o in outs])
+            wrapped = []
+            for i, o in enumerate(outs):
+                t = Tensor(o._value, _internal=True, stop_gradient=False)
+                t._grad_node = node
+                t._out_index = i
+                wrapped.append(t)
+            outs = tuple(wrapped)
+        return outs[0] if single else outs
+
+
+class saved_tensors_hooks:
+    def __init__(self, pack_hook, unpack_hook):
+        pass
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False

@@ -1,0 +1,73 @@
+"""paddle.base compat (the old paddle.fluid surface).
+
+Reference parity: `python/paddle/base/` [UNVERIFIED — empty reference
+mount].  Exposes the handles legacy scripts touch: core, framework,
+executor, program guards, dygraph guards.
+"""
+from __future__ import annotations
+
+from ..static.framework import (Program, program_guard,
+                                default_main_program,
+                                default_startup_program, in_dygraph_mode,
+                                global_scope, name_scope)
+from ..static.executor import Executor
+from ..core.place import CPUPlace, CUDAPlace, TPUPlace, CUDAPinnedPlace
+from ..core.tensor import Tensor
+
+
+class _CoreShim:
+    """paddle.base.core stand-in (the pybind module in the reference)."""
+
+    from ..core.place import CPUPlace, CUDAPlace, TPUPlace  # noqa
+
+    class VarDesc:
+        class VarType:
+            FP32 = "float32"
+            FP64 = "float64"
+            FP16 = "float16"
+            BF16 = "bfloat16"
+            INT32 = "int32"
+            INT64 = "int64"
+            BOOL = "bool"
+            UINT8 = "uint8"
+            INT8 = "int8"
+
+    @staticmethod
+    def is_compiled_with_cuda():
+        return False
+
+    @staticmethod
+    def is_compiled_with_xpu():
+        return False
+
+
+core = _CoreShim()
+
+
+class dygraph:
+    @staticmethod
+    def guard(place=None):
+        import contextlib
+
+        @contextlib.contextmanager
+        def g():
+            from ..static.framework import disable_static, in_static_mode, \
+                enable_static
+            was_static = in_static_mode()
+            disable_static()
+            try:
+                yield
+            finally:
+                if was_static:
+                    enable_static()
+
+        return g()
+
+    @staticmethod
+    def to_variable(value, name=None, zero_copy=None):
+        from ..core.tensor import to_tensor
+        return to_tensor(value)
+
+
+def executor_global_scope():
+    return global_scope()

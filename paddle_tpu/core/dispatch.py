@@ -1,0 +1,144 @@
+"""Op dispatch: the KernelFactory equivalent, TPU-native.
+
+Reference parity: Paddle routes every op through generated ``*_ad_func`` →
+phi KernelFactory (backend, layout, dtype) → kernel (`paddle/phi/core/
+kernel_factory.h`, `paddle/fluid/eager/` [UNVERIFIED — empty reference
+mount]).  Here there is exactly ONE backend — XLA — so "kernel selection"
+collapses: every op has a pure-JAX ``impl(*arrays, **attrs)``; dispatch
+decides only (a) eager vs static-graph capture and (b) whether to record a
+GradNode via ``jax.vjp``.
+
+AMP hook: like the generated AMP branch in Paddle's dygraph functions, the
+amp module installs a caster that rewrites input dtypes per op white/black
+lists before the impl runs.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from . import autograd
+from .dtypes import to_paddle_dtype
+
+__all__ = ["dispatch", "OpDef", "OP_REGISTRY", "register_op"]
+
+
+class OpDef:
+    __slots__ = ("name", "impl", "n_outputs", "differentiable")
+
+    def __init__(self, name, impl, n_outputs=1, differentiable=True):
+        self.name = name
+        self.impl = impl
+        self.n_outputs = n_outputs
+        self.differentiable = differentiable
+
+
+OP_REGISTRY: dict[str, OpDef] = {}
+
+
+def register_op(name, impl, n_outputs=1, differentiable=True):
+    op = OpDef(name, impl, n_outputs, differentiable)
+    OP_REGISTRY[name] = op
+    return op
+
+
+class _DispatchState(threading.local):
+    def __init__(self):
+        # static-graph capture hook: fn(name, impl, args, attrs) -> outputs
+        self.static_hook = None
+        # AMP caster: fn(name, tensor_args) -> tensor_args
+        self.amp_caster = None
+
+
+_state = _DispatchState()
+
+
+def get_dispatch_state():
+    return _state
+
+
+def _is_float(v) -> bool:
+    return jnp.issubdtype(v.dtype, jnp.floating) or jnp.issubdtype(
+        v.dtype, jnp.complexfloating
+    )
+
+
+def dispatch(name: str, impl: Callable, args: Sequence[Any], attrs=None,
+             differentiable: bool = True):
+    """Run op ``name``.
+
+    ``args`` may mix Tensors and raw python values (scalars keep JAX weak-type
+    promotion).  Returns Tensor or tuple of Tensors mirroring impl's output.
+    """
+    from .tensor import Tensor
+
+    attrs = attrs or {}
+
+    if _state.static_hook is not None:
+        return _state.static_hook(name, impl, args, attrs)
+
+    if _state.amp_caster is not None:
+        args = _state.amp_caster(name, args)
+
+    tensor_idx = [i for i, a in enumerate(args) if isinstance(a, Tensor)]
+    tensors = [args[i] for i in tensor_idx]
+    arrays = [t.value() for t in tensors]
+
+    needs = [
+        (not t.stop_gradient) and _is_float(v)
+        for t, v in zip(tensors, arrays)
+    ]
+    record = (
+        differentiable
+        and autograd.is_grad_enabled()
+        and any(needs)
+    )
+
+    if not record:
+        full = list(args)
+        for i, v in zip(tensor_idx, arrays):
+            full[i] = v
+        outs = impl(*full, **attrs)
+        return _wrap(outs, name, node=None)
+
+    def fn(*arrs):
+        full = list(args)
+        for i, v in zip(tensor_idx, arrs):
+            full[i] = v
+        return impl(*full, **attrs)
+
+    outs, vjp_fn = jax.vjp(fn, *arrays)
+    is_multi = isinstance(outs, (tuple, list))
+    outs_t = tuple(outs) if is_multi else (outs,)
+    node = autograd.GradNode(
+        name,
+        vjp_fn,
+        tensors,
+        needs,
+        len(outs_t),
+        [(o.shape, o.dtype) for o in outs_t],
+    )
+    return _wrap(outs, name, node=node)
+
+
+def _wrap(outs, name, node):
+    from .tensor import Tensor
+
+    is_multi = isinstance(outs, (tuple, list))
+    outs_t = tuple(outs) if is_multi else (outs,)
+    wrapped = []
+    for i, o in enumerate(outs_t):
+        if o is None:
+            wrapped.append(None)
+            continue
+        t = Tensor(o, stop_gradient=(node is None), _internal=True)
+        if node is not None:
+            t._grad_node = node
+            t._out_index = i
+        wrapped.append(t)
+    if is_multi:
+        return tuple(wrapped)
+    return wrapped[0]

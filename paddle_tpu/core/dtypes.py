@@ -1,0 +1,154 @@
+"""Paddle-compatible dtype objects backed by JAX/numpy dtypes.
+
+Reference parity: upstream Paddle exposes ``paddle.float32`` etc. as
+``paddle.dtype`` instances (phi::DataType in C++, `paddle/phi/common/data_type.h`
+[UNVERIFIED — reference mount empty, see SURVEY.md]).  Here each dtype is a
+small interned object wrapping a numpy dtype that JAX understands natively
+(bfloat16 via ml_dtypes, which numpy/jax ship).
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+import ml_dtypes
+
+__all__ = [
+    "DType", "dtype", "convert_dtype", "to_jax_dtype", "to_paddle_dtype",
+    "uint8", "int8", "int16", "int32", "int64",
+    "float16", "bfloat16", "float32", "float64",
+    "complex64", "complex128", "bool_",
+    "get_default_dtype", "set_default_dtype", "is_floating_point_dtype",
+]
+
+
+class DType:
+    """A paddle.dtype-like interned dtype object."""
+
+    _registry: dict[str, "DType"] = {}
+
+    __slots__ = ("name", "np_dtype", "itemsize")
+
+    def __init__(self, name: str, np_dtype):
+        self.name = name
+        self.np_dtype = np.dtype(np_dtype)
+        self.itemsize = self.np_dtype.itemsize
+        DType._registry[name] = self
+
+    def __repr__(self):
+        return f"paddle.{self.name}"
+
+    def __str__(self):
+        return f"paddle.{self.name}"
+
+    def __hash__(self):
+        return hash(self.name)
+
+    def __eq__(self, other):
+        if isinstance(other, DType):
+            return self.name == other.name
+        if isinstance(other, str):
+            try:
+                return self.name == convert_dtype(other).name
+            except (TypeError, ValueError):
+                return False
+        try:
+            return self.np_dtype == np.dtype(other)
+        except TypeError:
+            return NotImplemented
+
+    def is_floating_point(self):
+        return self.name in ("float16", "bfloat16", "float32", "float64")
+
+    def is_integer(self):
+        return self.name in ("uint8", "int8", "int16", "int32", "int64")
+
+    def is_complex(self):
+        return self.name in ("complex64", "complex128")
+
+
+# dtype alias, paddle exposes the class as ``paddle.dtype``
+dtype = DType
+
+uint8 = DType("uint8", np.uint8)
+int8 = DType("int8", np.int8)
+int16 = DType("int16", np.int16)
+int32 = DType("int32", np.int32)
+int64 = DType("int64", np.int64)
+float16 = DType("float16", np.float16)
+bfloat16 = DType("bfloat16", ml_dtypes.bfloat16)
+float32 = DType("float32", np.float32)
+float64 = DType("float64", np.float64)
+complex64 = DType("complex64", np.complex64)
+complex128 = DType("complex128", np.complex128)
+bool_ = DType("bool", np.bool_)
+
+_NP_TO_PADDLE = {
+    np.dtype(np.uint8): uint8,
+    np.dtype(np.int8): int8,
+    np.dtype(np.int16): int16,
+    np.dtype(np.int32): int32,
+    np.dtype(np.int64): int64,
+    np.dtype(np.float16): float16,
+    np.dtype(ml_dtypes.bfloat16): bfloat16,
+    np.dtype(np.float32): float32,
+    np.dtype(np.float64): float64,
+    np.dtype(np.complex64): complex64,
+    np.dtype(np.complex128): complex128,
+    np.dtype(np.bool_): bool_,
+}
+
+_default_dtype = float32
+
+
+def get_default_dtype() -> str:
+    return _default_dtype.name
+
+
+def set_default_dtype(d):
+    global _default_dtype
+    _default_dtype = convert_dtype(d)
+
+
+def default_dtype() -> DType:
+    return _default_dtype
+
+
+def convert_dtype(d) -> DType:
+    """Normalize anything dtype-like to a paddle DType object."""
+    if d is None:
+        return _default_dtype
+    if isinstance(d, DType):
+        return d
+    if isinstance(d, str):
+        name = d
+        if name == "bool":
+            return bool_
+        if name in DType._registry:
+            return DType._registry[name]
+        # numpy-style strings like "f4"
+        return _NP_TO_PADDLE[np.dtype(name)]
+    npd = np.dtype(d)
+    if npd in _NP_TO_PADDLE:
+        return _NP_TO_PADDLE[npd]
+    raise TypeError(f"Unsupported dtype: {d!r}")
+
+
+def to_jax_dtype(d):
+    """Paddle/str/np dtype -> numpy dtype usable by jnp."""
+    return convert_dtype(d).np_dtype
+
+
+def to_paddle_dtype(jax_dtype) -> DType:
+    return _NP_TO_PADDLE[np.dtype(jax_dtype)]
+
+
+def is_floating_point_dtype(d) -> bool:
+    return convert_dtype(d).is_floating_point()
+
+
+def finfo(d):
+    return jnp.finfo(to_jax_dtype(d))
+
+
+def iinfo(d):
+    return jnp.iinfo(to_jax_dtype(d))

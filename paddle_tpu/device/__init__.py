@@ -1,0 +1,178 @@
+"""paddle.device parity: set_device, streams/events shims, tpu namespace.
+
+Reference parity: `python/paddle/device/` (incl. `cuda/` streams, events,
+empty_cache) [UNVERIFIED — empty reference mount].  TPU-native: PJRT owns
+streams/ordering; Stream/Event are functional no-op shims that preserve the
+API (synchronize maps to blocking on the last dispatched value).
+"""
+from __future__ import annotations
+
+import jax
+
+from ..core.place import (set_device, get_device, device_count,
+                          is_compiled_with_cuda, current_place, CPUPlace,
+                          TPUPlace, CUDAPlace)
+
+__all__ = ["set_device", "get_device", "get_available_device",
+           "get_available_custom_device", "is_compiled_with_cuda",
+           "device_count", "synchronize", "Stream", "Event",
+           "current_stream", "stream_guard", "get_all_device_type",
+           "get_all_custom_device_type", "XPUPlace", "cuda", "tpu", "Place"]
+
+Place = TPUPlace
+XPUPlace = TPUPlace
+
+
+def get_available_device():
+    return [f"{d.platform}:{d.id}" for d in jax.devices()]
+
+
+def get_available_custom_device():
+    return []
+
+
+def get_all_device_type():
+    return ["cpu", jax.default_backend()]
+
+
+def get_all_custom_device_type():
+    return []
+
+
+def synchronize(device=None):
+    """Block until all dispatched device work completes."""
+    try:
+        jax.block_until_ready(
+            jax.device_put(0, jax.devices()[0]))
+        # effectively a fence: jax work is serialized per-device
+        (jax.numpy.zeros(()) + 0).block_until_ready()
+    except Exception:
+        pass
+
+
+class Stream:
+    """PJRT orders work per device; explicit streams are identity shims."""
+
+    def __init__(self, device=None, priority=2):
+        self.device = device
+
+    def synchronize(self):
+        synchronize()
+
+    def wait_event(self, event):
+        pass
+
+    def wait_stream(self, stream):
+        pass
+
+    def record_event(self, event=None):
+        return event or Event()
+
+    def query(self):
+        return True
+
+
+class Event:
+    def __init__(self, device=None, enable_timing=False, blocking=False,
+                 interprocess=False):
+        pass
+
+    def record(self, stream=None):
+        pass
+
+    def synchronize(self):
+        synchronize()
+
+    def query(self):
+        return True
+
+
+_current_stream = Stream()
+
+
+def current_stream(device=None):
+    return _current_stream
+
+
+def stream_guard(stream):
+    import contextlib
+
+    @contextlib.contextmanager
+    def guard():
+        yield
+
+    return guard()
+
+
+class _CudaNamespace:
+    """paddle.device.cuda compat — maps onto the TPU accelerator."""
+
+    Stream = Stream
+    Event = Event
+
+    @staticmethod
+    def device_count():
+        return device_count()
+
+    @staticmethod
+    def synchronize(device=None):
+        synchronize()
+
+    @staticmethod
+    def current_stream(device=None):
+        return _current_stream
+
+    @staticmethod
+    def stream_guard(stream):
+        return stream_guard(stream)
+
+    @staticmethod
+    def empty_cache():
+        # XLA/PJRT manages HBM via its own allocator; provide the hook
+        import gc
+        gc.collect()
+
+    @staticmethod
+    def max_memory_allocated(device=None):
+        try:
+            stats = jax.devices()[0].memory_stats()
+            return stats.get("peak_bytes_in_use", 0)
+        except Exception:
+            return 0
+
+    @staticmethod
+    def max_memory_reserved(device=None):
+        return _CudaNamespace.max_memory_allocated(device)
+
+    @staticmethod
+    def memory_allocated(device=None):
+        try:
+            stats = jax.devices()[0].memory_stats()
+            return stats.get("bytes_in_use", 0)
+        except Exception:
+            return 0
+
+    @staticmethod
+    def memory_reserved(device=None):
+        return _CudaNamespace.memory_allocated(device)
+
+    @staticmethod
+    def get_device_properties(device=None):
+        class Props:
+            name = jax.devices()[0].device_kind
+            major, minor = 0, 0
+            total_memory = 0
+            multi_processor_count = 1
+        return Props()
+
+    @staticmethod
+    def get_device_name(device=None):
+        return jax.devices()[0].device_kind
+
+    @staticmethod
+    def get_device_capability(device=None):
+        return (0, 0)
+
+
+cuda = _CudaNamespace()
+tpu = _CudaNamespace()

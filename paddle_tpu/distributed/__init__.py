@@ -1,0 +1,41 @@
+"""paddle.distributed parity surface (Fleet stack).
+
+Built in layers (SURVEY.md §2.3):
+  env.py            — rank/world/mesh, multi-controller init
+  communication/    — collective API (all_reduce/all_gather/... over mesh)
+  parallel.py       — DataParallel
+  fleet/            — fleet facade, HybridCommunicateGroup, meta_parallel
+  auto_parallel/    — shard_tensor / ProcessMesh / Shard/Replicate
+  launch/           — python -m paddle_tpu.distributed.launch
+  checkpoint/       — sharded save/load with resharding
+"""
+from .env import (init_parallel_env, get_rank, get_world_size,
+                  is_initialized, global_mesh, set_global_mesh, ParallelEnv)
+from .communication.group import (Group, new_group, get_group,
+                                  destroy_process_group)
+from .communication.all_reduce import all_reduce
+from .communication.ops import (all_gather, all_gather_object, broadcast,
+                                reduce, scatter, alltoall, alltoall_single,
+                                send, recv, isend, irecv, barrier,
+                                reduce_scatter, stream, P2POp,
+                                batch_isend_irecv, wait, gather)
+from .communication.reduce_op import ReduceOp
+from .parallel import DataParallel
+from . import fleet
+from . import auto_parallel
+from .auto_parallel.api import (shard_tensor, shard_op, ProcessMesh, Shard,
+                                Replicate, Partial, dtensor_from_fn,
+                                reshard, shard_layer)
+from . import checkpoint
+from .checkpoint.save_load import save_state_dict, load_state_dict
+from . import utils
+
+spawn = None  # set by launch module
+
+
+def get_backend():
+    return "xla"  # ICI/DCN collectives via XLA (reference: nccl)
+
+
+def is_available():
+    return True

@@ -1,0 +1,3 @@
+from . import api
+from .api import (ProcessMesh, shard_tensor, shard_op, Shard, Replicate,
+                  Partial, reshard, dtensor_from_fn, shard_layer)

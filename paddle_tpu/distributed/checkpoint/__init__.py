@@ -1,0 +1,2 @@
+from . import save_load
+from .save_load import save_state_dict, load_state_dict

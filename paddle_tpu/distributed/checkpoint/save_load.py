@@ -1,0 +1,104 @@
+"""Distributed checkpoint: per-rank shards + metadata, reshard on load.
+
+Reference parity: `python/paddle/distributed/checkpoint/save_state_dict.py`
+/ `load_state_dict.py` (each rank saves owned shards + global metadata;
+load reshards to the new topology) [UNVERIFIED — empty reference mount].
+
+TPU-native: each host saves the addressable shards of its global arrays
+with their index coordinates; load assembles the global value and
+device_puts it under the *current* sharding — resharding across topologies
+falls out (the Orbax-style flow, dependency-free).
+"""
+from __future__ import annotations
+
+import json
+import os
+import pickle
+
+import numpy as np
+import jax
+
+from ...core.tensor import Tensor
+
+__all__ = ["save_state_dict", "load_state_dict"]
+
+
+def _proc_id():
+    try:
+        return jax.process_index()
+    except Exception:
+        return 0
+
+
+def save_state_dict(state_dict, path, process_group=None,
+                    coordinator_rank=0, unique_id=None, async_save=False):
+    os.makedirs(path, exist_ok=True)
+    rank = _proc_id()
+    shards = {}
+    meta = {}
+    for name, t in state_dict.items():
+        if not isinstance(t, Tensor):
+            meta[name] = {"type": "object"}
+            shards[name] = t
+            continue
+        arr = t._value
+        meta[name] = {
+            "type": "tensor",
+            "global_shape": list(arr.shape),
+            "dtype": t.dtype.name,
+        }
+        pieces = []
+        try:
+            for s in arr.addressable_shards:
+                idx = [[sl.start or 0,
+                        sl.stop if sl.stop is not None else dim]
+                       for sl, dim in zip(s.index, arr.shape)]
+                pieces.append({"index": idx,
+                               "data": np.asarray(s.data)})
+        except Exception:
+            pieces.append({"index": [[0, d] for d in arr.shape],
+                           "data": np.asarray(arr)})
+        shards[name] = pieces
+    with open(os.path.join(path, f"shard_{rank}.pkl"), "wb") as f:
+        pickle.dump(shards, f)
+    if rank == coordinator_rank:
+        with open(os.path.join(path, "metadata.json"), "w") as f:
+            json.dump(meta, f)
+
+
+def load_state_dict(state_dict, path, process_group=None,
+                    coordinator_rank=0, unique_id=None,
+                    offload=False):
+    """Fill `state_dict`'s tensors in place, resharding to their current
+    placement."""
+    with open(os.path.join(path, "metadata.json")) as f:
+        meta = json.load(f)
+    all_shards = {}
+    for fname in sorted(os.listdir(path)):
+        if fname.startswith("shard_") and fname.endswith(".pkl"):
+            with open(os.path.join(path, fname), "rb") as f:
+                data = pickle.load(f)
+            for name, pieces in data.items():
+                all_shards.setdefault(name, []).extend(
+                    pieces if isinstance(pieces, list) else [pieces])
+    import jax.numpy as jnp
+
+    for name, t in state_dict.items():
+        if name not in meta:
+            continue
+        m = meta[name]
+        if m["type"] != "tensor" or not isinstance(t, Tensor):
+            continue
+        full = np.zeros(m["global_shape"],
+                        np.float32 if m["dtype"] == "bfloat16"
+                        else np.dtype(m["dtype"]))
+        for piece in all_shards.get(name, []):
+            idx = tuple(slice(a, b) for a, b in piece["index"])
+            full[idx] = piece["data"]
+        val = jnp.asarray(full, t._value.dtype)
+        try:
+            val = jax.device_put(val, t._value.sharding)
+        except Exception:
+            pass
+        t._inplace_update(val)
+    return state_dict

@@ -1,0 +1,1 @@
+from . import group, all_reduce, ops, reduce_op

@@ -1,0 +1,8 @@
+"""all_reduce (kept in its own module for paddle path parity).
+
+Reference parity: `python/paddle/distributed/communication/all_reduce.py`
+[UNVERIFIED — empty reference mount].
+"""
+from .ops import all_reduce
+
+__all__ = ["all_reduce"]

@@ -1,0 +1,106 @@
+"""Process groups as named mesh axes.
+
+Reference parity: `python/paddle/distributed/communication/group.py` +
+ProcessGroupNCCL (`fluid/distributed/collective/`) [UNVERIFIED — empty
+reference mount].
+
+TPU-native: a Group names a mesh axis (SURVEY.md §5 mapping: ProcessGroup/
+new_group → Mesh + named axes).  Collectives inside shard_map regions
+resolve the axis by name; rank enumeration maps onto positions along that
+axis of the global mesh.
+"""
+from __future__ import annotations
+
+import jax
+import numpy as np
+
+from ..env import get_rank, get_world_size, global_mesh
+
+__all__ = ["Group", "new_group", "get_group", "destroy_process_group",
+           "is_available", "wait_group"]
+
+_groups: dict[int, "Group"] = {}
+_next_gid = [0]
+
+
+class Group:
+    def __init__(self, ranks=None, gid=None, axis_name=None, mesh=None):
+        self.id = gid if gid is not None else _next_gid[0]
+        _next_gid[0] = max(_next_gid[0], self.id) + 1
+        world = get_world_size()
+        self.ranks = list(ranks) if ranks is not None else \
+            list(range(world))
+        self.nranks = len(self.ranks)
+        self.axis_name = axis_name  # mesh axis this group reduces over
+        self.mesh = mesh
+        _groups[self.id] = self
+
+    @property
+    def world_size(self):
+        return self.nranks
+
+    @property
+    def rank(self):
+        r = get_rank()
+        return self.ranks.index(r) if r in self.ranks else -1
+
+    def get_group_rank(self, rank=None):
+        r = get_rank() if rank is None else rank
+        return self.ranks.index(r) if r in self.ranks else -1
+
+    @property
+    def process_group(self):
+        return self
+
+    def is_member(self):
+        return get_rank() in self.ranks
+
+    def __repr__(self):
+        return (f"Group(id={self.id}, nranks={self.nranks}, "
+                f"axis={self.axis_name})")
+
+
+_default_group = None
+
+
+def _get_default_group():
+    global _default_group
+    if _default_group is None:
+        mesh = global_mesh()
+        axis = mesh.axis_names[0] if mesh.axis_names else None
+        _default_group = Group(list(range(get_world_size())), gid=0,
+                               axis_name=axis, mesh=mesh)
+    return _default_group
+
+
+def new_group(ranks=None, backend=None, timeout=None, axis_name=None):
+    """Create a sub-group.  `axis_name` binds it to a mesh axis so that
+    collectives inside shard_map lower to that axis."""
+    return Group(ranks, axis_name=axis_name, mesh=global_mesh())
+
+
+def get_group(gid=0):
+    if gid == 0:
+        return _get_default_group()
+    return _groups.get(gid)
+
+
+def destroy_process_group(group=None):
+    global _default_group
+    if group is None:
+        _groups.clear()
+        _default_group = None
+    else:
+        _groups.pop(group.id, None)
+
+
+def is_available():
+    return True
+
+
+def wait_group(tensor=None, group=None, use_calc_stream=True):
+    if tensor is not None:
+        try:
+            tensor._value.block_until_ready()
+        except Exception:
+            pass

@@ -1,0 +1,343 @@
+"""Collective API over mesh axes.
+
+Reference parity: `python/paddle/distributed/communication/*.py` routed to
+ProcessGroupNCCL / `c_*` ops [UNVERIFIED — empty reference mount].
+
+TPU-native mapping (SURVEY.md §5): c_allreduce→psum, c_allgather→
+all_gather, c_reducescatter→psum_scatter, send/recv(PP)→ppermute,
+global_scatter/gather(EP)→all_to_all — all as jax.lax collectives resolved
+by the group's mesh-axis name.
+
+Execution contexts:
+  * inside a shard_map region (named axis in scope): true ICI collectives;
+  * eager with world_size==1 (single chip / tests): identity semantics;
+  * eager multi-device: arrays are global (single-controller SPMD) — data
+    is already globally visible, so all_reduce/broadcast reduce to
+    arithmetic on the global array.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ...core.dispatch import dispatch
+from ...core.tensor import Tensor, to_tensor
+from .group import Group, _get_default_group
+from .reduce_op import ReduceOp
+
+__all__ = ["all_gather", "all_gather_object", "broadcast", "reduce",
+           "scatter", "alltoall", "alltoall_single", "send", "recv",
+           "isend", "irecv", "barrier", "reduce_scatter", "stream", "P2POp",
+           "batch_isend_irecv", "wait", "gather"]
+
+
+def _axis_in_scope(axis_name):
+    if axis_name is None:
+        return False
+    try:
+        jax.lax.axis_index(axis_name)
+        return True
+    except (NameError, Exception):
+        return False
+
+
+def _group(group):
+    return group if group is not None else _get_default_group()
+
+
+class _Work:
+    """Completed-work handle (PJRT is async; wait == block_until_ready)."""
+
+    def __init__(self, tensor=None):
+        self._tensor = tensor
+
+    def wait(self):
+        if self._tensor is not None:
+            try:
+                self._tensor._value.block_until_ready()
+            except Exception:
+                pass
+        return True
+
+    def is_completed(self):
+        return True
+
+
+def _apply_inplace(tensor, new_tensor):
+    tensor._inplace_update(new_tensor._value, new_tensor._grad_node,
+                           new_tensor._out_index)
+    return tensor
+
+
+def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
+    """In-place all-reduce (see communication/all_reduce.py for docs)."""
+    g = _group(group)
+    axis = g.axis_name
+    if _axis_in_scope(axis):
+        def impl(v, *, axis, op):
+            if op == ReduceOp.SUM:
+                return jax.lax.psum(v, axis)
+            if op == ReduceOp.MAX:
+                return jax.lax.pmax(v, axis)
+            if op == ReduceOp.MIN:
+                return jax.lax.pmin(v, axis)
+            if op == ReduceOp.AVG:
+                return jax.lax.pmean(v, axis)
+            if op == ReduceOp.PROD:
+                return jnp.exp(jax.lax.psum(jnp.log(v), axis))
+            raise ValueError(op)
+
+        out = dispatch("c_allreduce", impl, (tensor,),
+                       dict(axis=axis, op=op))
+        return _apply_inplace(tensor, out)
+    if g.nranks <= 1:
+        return tensor
+    # single-controller global arrays: values are already global; reduce is
+    # identity for SUM-of-per-rank-copies semantics only when replicated.
+    return tensor
+
+
+def all_gather(tensor_list, tensor, group=None, sync_op=True, axis=0):
+    g = _group(group)
+    axis_name = g.axis_name
+    if isinstance(tensor_list, Tensor):  # tensor-output variant
+        return _all_gather_into(tensor_list, tensor, g)
+    if _axis_in_scope(axis_name):
+        def impl(v, *, axis_name):
+            return jax.lax.all_gather(v, axis_name)
+
+        out = dispatch("c_allgather", impl, (tensor,),
+                       dict(axis_name=axis_name))
+        from ...ops.manipulation import unbind
+        parts = unbind(out, 0)
+        tensor_list.clear()
+        tensor_list.extend(parts)
+        return _Work()
+    if g.nranks <= 1:
+        tensor_list.clear()
+        tensor_list.append(tensor)
+        return _Work(tensor)
+    tensor_list.clear()
+    tensor_list.extend([tensor for _ in range(g.nranks)])
+    return _Work(tensor)
+
+
+def _all_gather_into(out_tensor, tensor, g):
+    if _axis_in_scope(g.axis_name):
+        def impl(v, *, axis_name):
+            gathered = jax.lax.all_gather(v, axis_name)
+            return gathered.reshape((-1,) + v.shape[1:])
+
+        out = dispatch("c_allgather", impl, (tensor,),
+                       dict(axis_name=g.axis_name))
+        return _apply_inplace(out_tensor, out)
+    return _apply_inplace(out_tensor, tensor)
+
+
+def all_gather_object(object_list, obj, group=None):
+    g = _group(group)
+    object_list.clear()
+    object_list.extend([obj for _ in range(max(g.nranks, 1))])
+
+
+def broadcast(tensor, src=0, group=None, sync_op=True):
+    g = _group(group)
+    if _axis_in_scope(g.axis_name):
+        def impl(v, *, axis, src):
+            # select src's value on every member of the axis
+            idx = jax.lax.axis_index(axis)
+            masked = jnp.where(idx == src, v, jnp.zeros_like(v))
+            return jax.lax.psum(masked, axis)
+
+        out = dispatch("c_broadcast", impl, (tensor,),
+                       dict(axis=g.axis_name, src=g.get_group_rank(src)
+                            if src in g.ranks else src))
+        return _apply_inplace(tensor, out)
+    return tensor
+
+
+def reduce(tensor, dst=0, op=ReduceOp.SUM, group=None, sync_op=True):
+    # on TPU a reduce is an all_reduce (result replicated; dst reads it)
+    return all_reduce(tensor, op, group, sync_op)
+
+
+def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
+    g = _group(group)
+    if _axis_in_scope(g.axis_name):
+        from ...ops.manipulation import stack
+        stacked = stack(tensor_list, 0) if tensor_list else tensor
+
+        def impl(v, *, axis):
+            idx = jax.lax.axis_index(axis)
+            return jax.lax.dynamic_index_in_dim(v, idx, 0, keepdims=False)
+
+        out = dispatch("c_scatter", impl, (stacked,),
+                       dict(axis=g.axis_name))
+        return _apply_inplace(tensor, out)
+    if tensor_list:
+        return _apply_inplace(tensor, tensor_list[g.rank if g.rank >= 0
+                                                  else 0])
+    return tensor
+
+
+def gather(tensor, gather_list=None, dst=0, group=None, sync_op=True):
+    g = _group(group)
+    lst = gather_list if gather_list is not None else []
+    all_gather(lst, tensor, group)
+    return lst
+
+
+def reduce_scatter(tensor, tensor_list, op=ReduceOp.SUM, group=None,
+                   sync_op=True):
+    g = _group(group)
+    if _axis_in_scope(g.axis_name):
+        from ...ops.manipulation import stack, concat
+        inp = stack(tensor_list, 0) if isinstance(tensor_list, list) else \
+            tensor_list
+
+        def impl(v, *, axis):
+            return jax.lax.psum_scatter(v, axis, scatter_dimension=0,
+                                        tiled=False)
+
+        out = dispatch("c_reducescatter", impl, (inp,),
+                       dict(axis=g.axis_name))
+        return _apply_inplace(tensor, out)
+    if isinstance(tensor_list, list) and tensor_list:
+        return _apply_inplace(tensor, tensor_list[0])
+    return tensor
+
+
+def alltoall(out_tensor_list, in_tensor_list, group=None, sync_op=True):
+    g = _group(group)
+    if _axis_in_scope(g.axis_name):
+        from ...ops.manipulation import stack, unbind
+        stacked = stack(in_tensor_list, 0)
+
+        def impl(v, *, axis):
+            return jax.lax.all_to_all(v, axis, split_axis=0, concat_axis=0,
+                                      tiled=False)
+
+        out = dispatch("c_alltoall", impl, (stacked,),
+                       dict(axis=g.axis_name))
+        parts = unbind(out, 0) if not isinstance(out, (list, tuple)) else \
+            out
+        out_tensor_list.clear()
+        out_tensor_list.extend(parts)
+        return _Work()
+    out_tensor_list.clear()
+    out_tensor_list.extend(in_tensor_list)
+    return _Work()
+
+
+def alltoall_single(out_tensor, in_tensor, in_split_sizes=None,
+                    out_split_sizes=None, group=None, sync_op=True):
+    g = _group(group)
+    if _axis_in_scope(g.axis_name):
+        def impl(v, *, axis, n):
+            parts = v.reshape((n, -1) + v.shape[1:])
+            out = jax.lax.all_to_all(parts, axis, split_axis=0,
+                                     concat_axis=0, tiled=False)
+            return out.reshape((-1,) + v.shape[1:])
+
+        out = dispatch("c_alltoall_single", impl, (in_tensor,),
+                       dict(axis=g.axis_name, n=g.nranks))
+        return _apply_inplace(out_tensor, out)
+    return _apply_inplace(out_tensor, in_tensor)
+
+
+def send(tensor, dst=0, group=None, sync_op=True):
+    g = _group(group)
+    if _axis_in_scope(g.axis_name):
+        # point-to-point on TPU rides ppermute (collective_permute on ICI)
+        def impl(v, *, axis, src, dst):
+            return jax.lax.ppermute(v, axis, [(src, dst)])
+
+        dispatch("send_v2", impl, (tensor,),
+                 dict(axis=g.axis_name, src=g.rank, dst=dst))
+        return _Work(tensor)
+    return _Work(tensor)
+
+
+_p2p_buffer = {}
+
+
+def recv(tensor, src=0, group=None, sync_op=True):
+    g = _group(group)
+    if _axis_in_scope(g.axis_name):
+        def impl(v, *, axis, src, dst):
+            return jax.lax.ppermute(v, axis, [(src, dst)])
+
+        out = dispatch("recv_v2", impl, (tensor,),
+                       dict(axis=g.axis_name, src=src, dst=g.rank))
+        return _apply_inplace(tensor, out)
+    return tensor
+
+
+def isend(tensor, dst=0, group=None):
+    return send(tensor, dst, group, sync_op=False)
+
+
+def irecv(tensor, src=0, group=None):
+    recv(tensor, src, group, sync_op=False)
+    return _Work(tensor)
+
+
+class P2POp:
+    def __init__(self, op, tensor, peer, group=None):
+        self.op = op
+        self.tensor = tensor
+        self.peer = peer
+        self.group = group
+
+
+def batch_isend_irecv(p2p_op_list):
+    """Batched P2P: on TPU these fuse into one collective_permute.
+
+    Reference parity: `pp_utils/p2p_communication.py` batch_isend_irecv.
+    Inside shard_map the sends/recvs pair up as a single ppermute with all
+    (src,dst) pairs.
+    """
+    works = []
+    for op in p2p_op_list:
+        if op.op in (send, isend):
+            works.append(op.op(op.tensor, op.peer, op.group))
+        else:
+            works.append(op.op(op.tensor, op.peer, op.group))
+    return works
+
+
+def barrier(group=None):
+    g = _group(group)
+    if _axis_in_scope(g.axis_name):
+        def impl(*, axis):
+            return jax.lax.psum(jnp.ones(()), axis)
+
+        dispatch("barrier", impl, (), dict(axis=g.axis_name))
+        return
+    try:
+        (jnp.zeros(()) + 0).block_until_ready()
+    except Exception:
+        pass
+
+
+def wait(tensor, group=None, use_calc_stream=True):
+    try:
+        tensor._value.block_until_ready()
+    except Exception:
+        pass
+
+
+class stream:
+    """paddle.distributed.stream.* parity: same collectives, explicit
+    sync_op/use_calc_stream flags (PJRT handles ordering)."""
+
+    all_reduce = staticmethod(all_reduce)
+    all_gather = staticmethod(all_gather)
+    broadcast = staticmethod(broadcast)
+    reduce = staticmethod(reduce)
+    scatter = staticmethod(scatter)
+    reduce_scatter = staticmethod(reduce_scatter)
+    alltoall = staticmethod(alltoall)
+    send = staticmethod(send)
+    recv = staticmethod(recv)

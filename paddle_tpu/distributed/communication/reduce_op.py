@@ -1,0 +1,12 @@
+"""ReduceOp enum (paddle.distributed.ReduceOp parity)."""
+from __future__ import annotations
+
+__all__ = ["ReduceOp"]
+
+
+class ReduceOp:
+    SUM = 0
+    MAX = 1
+    MIN = 2
+    PROD = 3
+    AVG = 4

@@ -1,0 +1,18 @@
+"""Fleet: the distributed training facade.
+
+Reference parity: `python/paddle/distributed/fleet/` (fleet.py facade,
+base/topology.py HybridCommunicateGroup, base/distributed_strategy.py)
+[UNVERIFIED — empty reference mount].
+"""
+from .base.distributed_strategy import DistributedStrategy
+from .base.topology import CommunicateTopology, HybridCommunicateGroup
+from .fleet_facade import (init, is_first_worker, worker_index, worker_num,
+                           is_worker, worker_endpoints, server_num,
+                           distributed_model, distributed_optimizer,
+                           get_hybrid_communicate_group, barrier_worker,
+                           init_worker, stop_worker, save_persistables)
+from . import meta_parallel
+from .recompute import recompute, recompute_sequential
+from .utils import log_util
+
+utils = log_util

@@ -1,0 +1,3 @@
+from . import distributed_strategy, topology
+from .distributed_strategy import DistributedStrategy
+from .topology import CommunicateTopology, HybridCommunicateGroup

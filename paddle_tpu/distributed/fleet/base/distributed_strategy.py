@@ -1,0 +1,76 @@
+"""DistributedStrategy.
+
+Reference parity: `python/paddle/distributed/fleet/base/
+distributed_strategy.py` wrapping distributed_strategy.proto [UNVERIFIED —
+empty reference mount].  Plain-python config object with the same nested
+configs (hybrid_configs, sharding_configs, amp_configs, ...).
+"""
+from __future__ import annotations
+
+__all__ = ["DistributedStrategy"]
+
+
+class DistributedStrategy:
+    def __init__(self):
+        # execution modes
+        self.auto = False
+        self.a_sync = False
+        self.a_sync_configs = {}
+        # amp
+        self.amp = False
+        self.amp_configs = {
+            "init_loss_scaling": 32768.0, "custom_white_list": [],
+            "custom_black_list": [], "use_pure_fp16": False,
+            "use_fp16_guard": True, "use_bf16": False,
+        }
+        # recompute
+        self.recompute = False
+        self.recompute_configs = {"checkpoints": [], "enable_offload": False}
+        # gradient merge
+        self.gradient_merge = False
+        self.gradient_merge_configs = {"k_steps": 1, "avg": True}
+        # sharding (ZeRO)
+        self.sharding = False
+        self.sharding_configs = {
+            "sharding_degree": 1, "stage": 1, "offload": False,
+            "comm_overlap": True,
+        }
+        # pipeline
+        self.pipeline = False
+        self.pipeline_configs = {
+            "accumulate_steps": 1, "micro_batch_size": 1,
+            "schedule_mode": "1F1B",
+        }
+        # tensor parallel
+        self.tensor_parallel = False
+        self.tensor_parallel_configs = {"tensor_parallel_degree": 1}
+        # hybrid
+        self.hybrid_configs = {
+            "dp_degree": -1, "mp_degree": 1, "pp_degree": 1,
+            "sharding_degree": 1, "sep_degree": 1,
+            "order": ["dp", "pp", "sharding", "sep", "mp"],
+        }
+        # misc meta-optimizers
+        self.lamb = False
+        self.lamb_configs = {}
+        self.dgc = False
+        self.localsgd = False
+        self.fp16_allreduce = False
+        self.find_unused_parameters = False
+        self.fuse_all_reduce_ops = True
+        self.fuse_grad_size_in_MB = 32
+        self.nccl_comm_num = 1
+        self.sync_nccl_allreduce = False
+        self.gradient_scale_configs = {"scale_strategy": "avg"}
+        self.heter_ccl_mode = False
+        self.is_fl_ps_mode = False
+        self.without_graph_optimization = True
+        self.asp = False
+        self.qat = False
+        self.qat_configs = {}
+
+    def __repr__(self):
+        keys = ["amp", "recompute", "sharding", "pipeline",
+                "tensor_parallel", "hybrid_configs"]
+        return "DistributedStrategy(" + ", ".join(
+            f"{k}={getattr(self, k)}" for k in keys) + ")"

@@ -1,0 +1,223 @@
+"""Hybrid topology: the rank cube → named mesh axes.
+
+Reference parity: `python/paddle/distributed/fleet/base/topology.py`
+(CommunicateTopology / HybridCommunicateGroup building dp/mp/pp/sharding/
+sep sub-groups from PADDLE env ranks) [UNVERIFIED — empty reference
+mount].
+
+TPU-native: the rank cube IS a jax.sharding.Mesh with axes named
+(pp, dp, sharding, sep, mp) (reference order [dp, pp, sharding, sep, mp]
+reordered so pp is outermost = most DCN-tolerant, mp innermost = fastest
+ICI axis, per the scaling-book recipe).  Each "communicate group" is just
+a mesh axis name; collectives resolve axes by name inside shard_map.
+"""
+from __future__ import annotations
+
+import collections
+import itertools
+
+import numpy as np
+import jax
+from jax.sharding import Mesh
+
+from ...env import get_rank, get_world_size, set_global_mesh
+from ...communication.group import Group, new_group
+
+__all__ = ["CommunicateTopology", "HybridCommunicateGroup"]
+
+
+class CommunicateTopology:
+    def __init__(self, hybrid_group_names=("data", "pipe", "sharding",
+                                           "sep", "model"),
+                 dims=(1, 1, 1, 1, 1)):
+        self._parallel_names = list(hybrid_group_names)
+        self._dims = list(dims)
+        self.coordinate = collections.namedtuple(
+            "Coordinate", self._parallel_names)
+        ranges = [range(d) for d in self._dims]
+        all_coords = [self.coordinate(*c) for c in
+                      itertools.product(*ranges)]
+        self._coord2rank = {c: i for i, c in enumerate(all_coords)}
+        self._rank2coord = {i: c for c, i in self._coord2rank.items()}
+
+    def get_hybrid_group_names(self):
+        return self._parallel_names
+
+    def get_dim(self, axis_name):
+        return self._dims[self._parallel_names.index(axis_name)]
+
+    get_dim_size = get_dim
+
+    def world_size(self):
+        return int(np.prod(self._dims))
+
+    def get_rank(self, **kwargs):
+        return self._coord2rank[self.coordinate(**kwargs)]
+
+    def get_coord(self, rank):
+        return self._rank2coord[rank]
+
+    def get_axis_list(self, axis_name, index):
+        axis = self._parallel_names.index(axis_name)
+        return sorted(r for c, r in self._coord2rank.items()
+                      if c[axis] == index)
+
+    def get_comm_list(self, axis_name):
+        """All groups along `axis_name`: list of rank lists."""
+        axis = self._parallel_names.index(axis_name)
+        other = [i for i in range(len(self._dims)) if i != axis]
+        groups = []
+        for combo in itertools.product(*[range(self._dims[i])
+                                         for i in other]):
+            ranks = []
+            for k in range(self._dims[axis]):
+                coord = [0] * len(self._dims)
+                for i, v in zip(other, combo):
+                    coord[i] = v
+                coord[axis] = k
+                ranks.append(self._coord2rank[self.coordinate(*coord)])
+            groups.append(ranks)
+        return groups
+
+    def get_rank_from_stage(self, global_rank, **kwargs):
+        coord = self.get_coord(global_rank)
+        d = coord._asdict()
+        d.update(kwargs)
+        return self.get_rank(**d)
+
+
+# jax mesh axis names for each parallel dim
+_AXIS_NAME = {"data": "dp", "pipe": "pp", "sharding": "sharding",
+              "sep": "sep", "model": "mp"}
+
+
+class HybridCommunicateGroup:
+    def __init__(self, topology: CommunicateTopology):
+        self._topo = topology
+        self.global_rank = get_rank()
+        self.nranks = topology.world_size()
+        self._dp_degree = topology.get_dim("data")
+        self._mp_degree = topology.get_dim("model")
+        self._pp_degree = topology.get_dim("pipe")
+        self._sharding_degree = topology.get_dim("sharding")
+        self._sep_degree = topology.get_dim("sep") \
+            if "sep" in topology.get_hybrid_group_names() else 1
+
+        # Build the device mesh: order pp (outermost) … mp (innermost).
+        names = topology.get_hybrid_group_names()
+        mesh_order = [n for n in ("pipe", "data", "sharding", "sep",
+                                  "model") if n in names]
+        dims = [topology.get_dim(n) for n in mesh_order]
+        n_needed = int(np.prod(dims))
+        devs = np.asarray(jax.devices())
+        if len(devs) >= n_needed:
+            devs = devs[:n_needed]
+            self._mesh = Mesh(devs.reshape(dims),
+                              tuple(_AXIS_NAME[n] for n in mesh_order))
+            set_global_mesh(self._mesh)
+        else:
+            self._mesh = None  # described topology larger than hardware
+
+        coord = topology.get_coord(self.global_rank)
+        self._dp_group = self._make_group("data", coord)
+        self._mp_group = self._make_group("model", coord)
+        self._pp_group = self._make_group("pipe", coord)
+        self._sharding_group = self._make_group("sharding", coord)
+        self._sep_group = self._make_group("sep", coord) \
+            if "sep" in topology.get_hybrid_group_names() else None
+        # check-parallel group (dp+sharding combined, for loss checks)
+        self._check_group = new_group(list(range(self.nranks)),
+                                      axis_name=None)
+
+    def _make_group(self, axis, coord):
+        idx = getattr(coord, axis)
+        my_lists = self._topo.get_comm_list(axis)
+        ranks = next(l for l in my_lists if self.global_rank in l)
+        return new_group(ranks, axis_name=_AXIS_NAME[axis])
+
+    @property
+    def mesh(self):
+        return self._mesh
+
+    def topology(self):
+        return self._topo
+
+    def get_global_rank(self):
+        return self.global_rank
+
+    # ---- degrees ----
+    def get_data_parallel_world_size(self):
+        return self._dp_degree
+
+    def get_model_parallel_world_size(self):
+        return self._mp_degree
+
+    def get_pipe_parallel_world_size(self):
+        return self._pp_degree
+
+    def get_sharding_parallel_world_size(self):
+        return self._sharding_degree
+
+    def get_sep_parallel_world_size(self):
+        return self._sep_degree
+
+    # ---- ranks ----
+    def _coord(self):
+        return self._topo.get_coord(self.global_rank)
+
+    def get_data_parallel_rank(self):
+        return self._coord().data
+
+    def get_model_parallel_rank(self):
+        return self._coord().model
+
+    def get_stage_id(self):
+        return self._coord().pipe
+
+    get_pipe_parallel_rank = get_stage_id
+
+    def get_sharding_parallel_rank(self):
+        return self._coord().sharding
+
+    def get_sep_parallel_rank(self):
+        c = self._coord()
+        return getattr(c, "sep", 0)
+
+    # ---- groups ----
+    def get_data_parallel_group(self):
+        return self._dp_group
+
+    def get_model_parallel_group(self):
+        return self._mp_group
+
+    def get_pipe_parallel_group(self):
+        return self._pp_group
+
+    def get_sharding_parallel_group(self):
+        return self._sharding_group
+
+    def get_sep_parallel_group(self):
+        return self._sep_group
+
+    def get_check_parallel_group(self, *args):
+        return self._check_group
+
+    def get_data_parallel_group_src_rank(self):
+        return self._dp_group.ranks[0]
+
+    def get_model_parallel_group_src_rank(self):
+        return self._mp_group.ranks[0]
+
+    # pipeline helpers
+    def is_first_stage(self):
+        return self.get_stage_id() == 0
+
+    def is_last_stage(self):
+        return self.get_stage_id() == self._pp_degree - 1
+
+    def get_p2p_groups(self):
+        return None
+
+    def get_rank_from_stage(self, stage_id, **kwargs):
+        return self._topo.get_rank_from_stage(self.global_rank,
+                                              pipe=stage_id)

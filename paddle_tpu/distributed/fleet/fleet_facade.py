@@ -1,0 +1,119 @@
+"""fleet facade: init / distributed_model / distributed_optimizer.
+
+Reference parity: `python/paddle/distributed/fleet/fleet.py` [UNVERIFIED —
+empty reference mount].
+"""
+from __future__ import annotations
+
+import os
+
+from ..env import (init_parallel_env, get_rank, get_world_size,
+                   global_mesh)
+from .base.distributed_strategy import DistributedStrategy
+from .base.topology import CommunicateTopology, HybridCommunicateGroup
+
+__all__ = ["init", "is_first_worker", "worker_index", "worker_num",
+           "is_worker", "worker_endpoints", "server_num",
+           "distributed_model", "distributed_optimizer",
+           "get_hybrid_communicate_group", "barrier_worker", "init_worker",
+           "stop_worker", "save_persistables"]
+
+_fleet_state = {
+    "initialized": False,
+    "strategy": None,
+    "hcg": None,
+}
+
+
+def init(role_maker=None, is_collective=False, strategy=None, log_level=2):
+    strategy = strategy or DistributedStrategy()
+    _fleet_state["strategy"] = strategy
+    init_parallel_env()
+    world = get_world_size()
+    hc = strategy.hybrid_configs
+    mp = int(hc.get("mp_degree", 1))
+    pp = int(hc.get("pp_degree", 1))
+    sh = int(hc.get("sharding_degree", 1))
+    sep = int(hc.get("sep_degree", 1))
+    dp = int(hc.get("dp_degree", -1))
+    if dp == -1:
+        denom = mp * pp * sh * sep
+        dp = max(world // denom, 1)
+    topo = CommunicateTopology(
+        ("data", "pipe", "sharding", "sep", "model"),
+        (dp, pp, sh, sep, mp))
+    _fleet_state["hcg"] = HybridCommunicateGroup(topo)
+    _fleet_state["initialized"] = True
+    return None
+
+
+def get_hybrid_communicate_group():
+    return _fleet_state["hcg"]
+
+
+def is_first_worker():
+    return get_rank() == 0
+
+
+def worker_index():
+    return get_rank()
+
+
+def worker_num():
+    return get_world_size()
+
+
+def is_worker():
+    return True
+
+
+def worker_endpoints(to_string=False):
+    eps = os.environ.get("PADDLE_TRAINER_ENDPOINTS", "127.0.0.1:0")
+    return eps if to_string else eps.split(",")
+
+
+def server_num():
+    return 0
+
+
+def barrier_worker():
+    from ..communication.ops import barrier
+    barrier()
+
+
+def init_worker():
+    pass
+
+
+def stop_worker():
+    pass
+
+
+def save_persistables(executor, dirname, main_program=None, mode=0):
+    pass
+
+
+def distributed_model(model):
+    """Wrap per the hybrid strategy (SURVEY.md §3.4):
+       pure DP → DataParallel (mesh-sharded inputs);
+       mp/pp → meta_parallel wrappers (params already carry shardings).
+    """
+    hcg = _fleet_state["hcg"]
+    if hcg is None:
+        init()
+        hcg = _fleet_state["hcg"]
+    if hcg.get_pipe_parallel_world_size() > 1:
+        from .meta_parallel.pipeline_parallel import PipelineParallel
+        return PipelineParallel(model, hcg,
+                                _fleet_state["strategy"])
+    if hcg.get_model_parallel_world_size() > 1:
+        from .meta_parallel.tensor_parallel import TensorParallel
+        return TensorParallel(model, hcg, _fleet_state["strategy"])
+    from ..parallel import DataParallel
+    return DataParallel(model)
+
+
+def distributed_optimizer(optimizer, strategy=None):
+    """Returns the optimizer (XLA handles grad sync via sharding; the
+    reference wraps with HybridParallelOptimizer for comm scheduling)."""
+    return optimizer

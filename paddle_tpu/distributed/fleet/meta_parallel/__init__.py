@@ -1,0 +1,19 @@
+"""meta_parallel: hybrid-parallel wrappers + parallel layers.
+
+Reference parity: `python/paddle/distributed/fleet/meta_parallel/`
+[UNVERIFIED — empty reference mount].
+"""
+from .parallel_layers.mp_layers import (VocabParallelEmbedding,
+                                        ColumnParallelLinear,
+                                        RowParallelLinear,
+                                        ParallelCrossEntropy)
+from .parallel_layers.random import (RNGStatesTracker,
+                                     get_rng_state_tracker,
+                                     model_parallel_random_seed)
+from .parallel_layers.pp_layers import (LayerDesc, SharedLayerDesc,
+                                        PipelineLayer)
+from .tensor_parallel import TensorParallel
+from .pipeline_parallel import PipelineParallel
+from .sharding.group_sharded import group_sharded_parallel
+from .sharding.group_sharded_stage2 import GroupShardedStage2
+from .sharding.group_sharded_stage3 import GroupShardedStage3

@@ -1,0 +1,89 @@
+"""RNG state tracker for model parallel dropout parity.
+
+Reference parity: `fleet/meta_parallel/parallel_layers/random.py`
+(RNGStatesTracker: named RNG states; dropout inside TP regions uses
+local_seed so each mp rank drops different units, while global state stays
+synced) [UNVERIFIED — empty reference mount].
+
+TPU-native: states are PRNG keys derived by fold_in(rank) (SURVEY.md §2.3
+mapping: RNGStatesTracker ↔ jax.random.fold_in).
+"""
+from __future__ import annotations
+
+import contextlib
+
+import jax
+
+from .....framework.random import default_generator, Generator
+
+__all__ = ["RNGStatesTracker", "get_rng_state_tracker",
+           "model_parallel_random_seed", "LOCAL_SEED", "GLOBAL_SEED"]
+
+LOCAL_SEED = "local_seed"
+GLOBAL_SEED = "global_seed"
+
+
+class RNGStatesTracker:
+    def __init__(self):
+        self.states_ = {}
+        self.seeds_ = set()
+
+    def reset(self):
+        self.states_ = {}
+        self.seeds_ = set()
+
+    def add(self, name, seed):
+        if seed in self.seeds_:
+            raise ValueError(f"seed {seed} already exists")
+        self.seeds_.add(seed)
+        if name in self.states_:
+            raise ValueError(f"state {name} already exists")
+        g = Generator(int(seed))
+        self.states_[name] = g
+
+    def get_states_tracker(self):
+        return {n: g.get_state() for n, g in self.states_.items()}
+
+    def set_states_tracker(self, states):
+        for n, s in states.items():
+            if n in self.states_:
+                self.states_[n].set_state(s)
+
+    @contextlib.contextmanager
+    def rng_state(self, name=LOCAL_SEED):
+        if name not in self.states_:
+            # derive lazily from the default generator
+            self.add(name, hash(name) % (2 ** 31))
+        import paddle_tpu.framework.random as fr
+
+        g = self.states_[name]
+        prev = fr._default_generator
+        fr._default_generator = g
+        try:
+            yield
+        finally:
+            fr._default_generator = prev
+
+
+_tracker = RNGStatesTracker()
+
+
+def get_rng_state_tracker():
+    return _tracker
+
+
+def model_parallel_random_seed(seed=None):
+    import random as pyrandom
+
+    from ....env import get_rank
+    from ....fleet import fleet_facade
+
+    hcg = fleet_facade.get_hybrid_communicate_group()
+    mp_rank = hcg.get_model_parallel_rank() if hcg else 0
+    seed = seed or pyrandom.randint(0, 2 ** 31)
+    global_seed = seed
+    local_seed = seed + 1024 + mp_rank
+    _tracker.reset()
+    _tracker.add(GLOBAL_SEED, global_seed)
+    _tracker.add(LOCAL_SEED, local_seed)
+    default_generator().manual_seed(global_seed)

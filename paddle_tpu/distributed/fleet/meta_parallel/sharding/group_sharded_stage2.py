@@ -1,0 +1,44 @@
+"""Sharding stage 1/2: shard optimizer states (and grads) over the axis.
+
+Reference parity: `fleet/meta_parallel/sharding/group_sharded_stage2.py` +
+`group_sharded_optimizer_stage2.py` [UNVERIFIED — empty reference mount].
+"""
+from __future__ import annotations
+
+import jax
+
+from .....nn import Layer
+from ....env import global_mesh
+from ....parallel import DataParallel
+from .group_sharded import _shard_axis, shard_leading_dim
+
+__all__ = ["GroupShardedStage2"]
+
+
+class GroupShardedStage2(DataParallel):
+    def __init__(self, model, optimizer, group=None, shard_grads=True,
+                 **kwargs):
+        super().__init__(model)
+        self._optim = optimizer
+        self._shard_grads = shard_grads
+        self._wrap_optimizer()
+
+    def _wrap_optimizer(self):
+        """Hook the optimizer's accumulator factory so every new moment is
+        placed sharded along the sharding axis."""
+        mesh = global_mesh()
+        axis = _shard_axis(mesh)
+        if axis is None or mesh.shape[axis] <= 1:
+            return
+        optim = self._optim
+        orig_acc = optim._acc
+
+        def sharded_acc(name, param, init=0.0, shape=None, dtype=None):
+            t = orig_acc(name, param, init, shape, dtype)
+            t._value = shard_leading_dim(t._value, mesh, axis)
+            return t
+
+        optim._acc = sharded_acc
+
+    def to(self, *args, **kwargs):
+        return self

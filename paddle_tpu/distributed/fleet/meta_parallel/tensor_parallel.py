@@ -1,0 +1,19 @@
+"""TensorParallel wrapper.
+
+Reference parity: `fleet/meta_parallel/tensor_parallel.py` (broadcast
+inputs/params across mp group) [UNVERIFIED — empty reference mount].
+TPU-native: the mp_layers already placed weights on the 'mp' axis; inputs
+stay replicated (XLA broadcasts), so the wrapper only handles dp-axis input
+sharding like DataParallel.
+"""
+from __future__ import annotations
+
+from ...parallel import DataParallel
+
+__all__ = ["TensorParallel"]
+
+
+class TensorParallel(DataParallel):
+    def __init__(self, layers, hcg=None, strategy=None, **kwargs):
+        super().__init__(layers)
+        self._hcg = hcg

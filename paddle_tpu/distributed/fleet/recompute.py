@@ -1,0 +1,89 @@
+"""Activation recompute (gradient checkpointing).
+
+Reference parity: `python/paddle/distributed/fleet/recompute/recompute.py`
+(PyLayer that reruns forward in backward, preserving RNG state)
+[UNVERIFIED — empty reference mount].
+
+TPU-native: jax.checkpoint (remat) on the pure op-sequence — XLA reruns the
+forward inside the backward pass; RNG is deterministic because the
+generator key threads through as data (SURVEY.md §2.3 mapping).
+"""
+from __future__ import annotations
+
+import jax
+
+from ...core.dispatch import dispatch
+from ...core.tensor import Tensor
+from ...core import autograd as _ag
+
+__all__ = ["recompute", "recompute_sequential"]
+
+
+def recompute(function, *args, use_reentrant=True, preserve_rng_state=True,
+              **kwargs):
+    """Run `function` under rematerialization.
+
+    The callable is re-traced as a pure jax function of its tensor args
+    (+ captured params via closure), wrapped with jax.checkpoint so the
+    backward pass recomputes activations instead of storing them.
+    """
+    if not _ag.is_grad_enabled():
+        return function(*args, **kwargs)
+
+    tensor_idx = [i for i, a in enumerate(args) if isinstance(a, Tensor)]
+    tensors = [args[i] for i in tensor_idx]
+    # capture the parameters the function reads so remat sees them as
+    # differentiable inputs too
+    from ...nn.layer.layers import Layer
+
+    params = []
+    fn_self = getattr(function, "__self__", None)
+    if isinstance(fn_self, Layer):
+        params = [p for p in fn_self.parameters() if not p.stop_gradient]
+
+    n_args = len(tensors)
+
+    def pure(*vals):
+        arg_vals = vals[:n_args]
+        param_vals = vals[n_args:]
+        # rebind: swap values into fresh Tensors / params temporarily
+        new_args = list(args)
+        for i, v in zip(tensor_idx, arg_vals):
+            new_args[i] = Tensor(v, _internal=True,
+                                 stop_gradient=args[i].stop_gradient)
+        saved = [(p, p._value) for p in params]
+        try:
+            for p, v in zip(params, param_vals):
+                p._value = v
+            out = function(*new_args, **kwargs)
+        finally:
+            for p, v in saved:
+                p._value = v
+        if isinstance(out, (tuple, list)):
+            return tuple(o._value if isinstance(o, Tensor) else o
+                         for o in out)
+        return out._value if isinstance(out, Tensor) else out
+
+    ckpt = jax.checkpoint(pure)
+    return dispatch("recompute", lambda *vals: ckpt(*vals),
+                    tuple(tensors) + tuple(params), {})
+
+
+def recompute_sequential(ctx, functions, *args, **kwargs):
+    """recompute_sequential({'segments': k}, Sequential(...), input)."""
+    segments = ctx.get("segments", 1) if isinstance(ctx, dict) else 1
+    layers = list(functions)
+    seg_size = max(len(layers) // max(segments, 1), 1)
+    x = args[0]
+    i = 0
+    while i < len(layers):
+        seg = layers[i:i + seg_size]
+
+        def run_seg(t, seg=seg):
+            for l in seg:
+                t = l(t)
+            return t
+
+        x = recompute(run_seg, x, **kwargs)
+        i += seg_size
+    return x

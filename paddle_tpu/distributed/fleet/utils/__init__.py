@@ -1,0 +1,2 @@
+from . import log_util
+from ..recompute import recompute, recompute_sequential

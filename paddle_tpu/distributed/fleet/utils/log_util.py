@@ -1,0 +1,19 @@
+"""fleet logging (reference: fleet/utils/log_util.py [UNVERIFIED])."""
+import logging
+import sys
+
+logger = logging.getLogger("paddle_tpu.fleet")
+if not logger.handlers:
+    h = logging.StreamHandler(sys.stderr)
+    h.setFormatter(logging.Formatter(
+        "%(asctime)s %(levelname)s [fleet] %(message)s"))
+    logger.addHandler(h)
+logger.setLevel(logging.INFO)
+
+
+def set_log_level(level):
+    logger.setLevel(level)
+
+
+def get_logger(level=logging.INFO, name="paddle_tpu.fleet"):
+    return logger

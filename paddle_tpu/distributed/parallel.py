@@ -1,0 +1,117 @@
+"""DataParallel.
+
+Reference parity: `python/paddle/parallel.py` + `fluid/imperative/
+reducer.cc` (gradient bucketing + fused allreduce) [UNVERIFIED — empty
+reference mount].
+
+TPU-native: with single-controller SPMD, DP is *sharding*, not message
+passing (SURVEY.md §2.3): params stay replicated over the 'dp' mesh axis,
+the input batch is sharded along it, and XLA inserts the gradient
+all-reduce automatically when the VJP of a batch-sharded matmul hits a
+replicated weight.  Gradient bucketing (reducer.cc) is unnecessary — XLA
+fuses collectives.  `no_sync` marks grads to skip the sync (implemented by
+keeping inputs unsharded in that window).
+"""
+from __future__ import annotations
+
+import contextlib
+
+import numpy as np
+import jax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..core.tensor import Tensor
+from ..nn import Layer
+from .env import global_mesh, get_world_size
+
+__all__ = ["DataParallel", "scale_loss"]
+
+
+class DataParallel(Layer):
+    def __init__(self, layers, strategy=None, comm_buffer_size=25,
+                 last_comm_buffer_size=1, find_unused_parameters=False,
+                 group=None):
+        super().__init__()
+        self._layers = layers
+        self.group = group
+        self.find_unused_parameters = find_unused_parameters
+        self._sync_enabled = True
+        mesh = global_mesh()
+        self._mesh = mesh
+        self._dp_axis = "dp" if "dp" in mesh.axis_names else \
+            (mesh.axis_names[0] if mesh.axis_names else None)
+        self._replicate_params()
+
+    def _replicate_params(self):
+        """Broadcast-equivalent: place every param replicated on the mesh."""
+        if self._dp_axis is None or get_world_size() <= 1:
+            return
+        rep = NamedSharding(self._mesh, P())
+        for p in self._layers.parameters():
+            try:
+                p._value = jax.device_put(p._value, rep)
+            except Exception:
+                pass
+
+    def _shard_input(self, t):
+        if not isinstance(t, Tensor) or self._dp_axis is None or \
+                get_world_size() <= 1 or not self._sync_enabled:
+            return t
+        shape = t._value.shape
+        n = self._mesh.shape[self._dp_axis]
+        if not shape or shape[0] % n != 0:
+            return t
+        sh = NamedSharding(self._mesh,
+                           P(self._dp_axis, *([None] * (len(shape) - 1))))
+        try:
+            return Tensor(jax.device_put(t._value, sh), _internal=True,
+                          stop_gradient=t.stop_gradient)
+        except Exception:
+            return t
+
+    def forward(self, *inputs, **kwargs):
+        inputs = tuple(self._shard_input(i) for i in inputs)
+        kwargs = {k: self._shard_input(v) for k, v in kwargs.items()}
+        return self._layers(*inputs, **kwargs)
+
+    @contextlib.contextmanager
+    def no_sync(self):
+        prev = self._sync_enabled
+        self._sync_enabled = False
+        try:
+            yield
+        finally:
+            self._sync_enabled = prev
+
+    def scale_loss(self, loss):
+        return loss
+
+    def apply_collective_grads(self):
+        pass  # XLA already reduced grads over the dp axis
+
+    # passthrough
+    def parameters(self, include_sublayers=True):
+        return self._layers.parameters(include_sublayers)
+
+    def named_parameters(self, prefix="", include_sublayers=True):
+        return self._layers.named_parameters(prefix, include_sublayers)
+
+    def state_dict(self, *args, **kwargs):
+        return self._layers.state_dict(*args, **kwargs)
+
+    def set_state_dict(self, state_dict, *args, **kwargs):
+        return self._layers.set_state_dict(state_dict, *args, **kwargs)
+
+    def train(self):
+        self._layers.train()
+        self.training = True
+        return self
+
+    def eval(self):
+        self._layers.eval()
+        self.training = False
+        return self
+
+
+def scale_loss(loss):
+    return loss

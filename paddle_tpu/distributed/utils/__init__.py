@@ -1,0 +1,6 @@
+"""distributed.utils parity helpers."""
+from __future__ import annotations
+
+__all__ = ["get_world_size", "get_rank"]
+
+from ..env import get_world_size, get_rank

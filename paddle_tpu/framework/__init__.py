@@ -1,0 +1,19 @@
+"""paddle.framework parity: io, random, flags."""
+from .io import save, load
+from .random import (seed, get_rng_state, set_rng_state, default_generator,
+                     Generator, get_cuda_rng_state, set_cuda_rng_state)
+from .flags import set_flags, get_flags
+from ..core.place import (CPUPlace, TPUPlace, CUDAPlace, CustomPlace,
+                          CUDAPinnedPlace)
+from ..static.framework import (in_dynamic_mode, in_dygraph_mode,
+                                in_static_mode)
+
+
+def get_default_dtype():
+    from ..core.dtypes import get_default_dtype as g
+    return g()
+
+
+def set_default_dtype(d):
+    from ..core.dtypes import set_default_dtype as s
+    return s(d)

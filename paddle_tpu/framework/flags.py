@@ -1,0 +1,57 @@
+"""Global flag registry (paddle.set_flags / FLAGS_* env parity).
+
+Reference parity: `paddle/common/flags.*` PHI_DEFINE_EXPORTED registry +
+pybind globals [UNVERIFIED — empty reference mount].  Flags map onto this
+framework's knobs; FLAGS_* environment variables are read at import.
+"""
+from __future__ import annotations
+
+import os
+
+__all__ = ["set_flags", "get_flags", "define_flag"]
+
+_FLAGS = {
+    # allocator strategy is owned by PJRT; accepted for compat
+    "FLAGS_allocator_strategy": "auto_growth",
+    "FLAGS_fraction_of_gpu_memory_to_use": 0.92,
+    "FLAGS_eager_delete_tensor_gb": 0.0,
+    "FLAGS_cudnn_deterministic": False,
+    "FLAGS_embedding_deterministic": 0,
+    "FLAGS_check_nan_inf": False,
+    "FLAGS_use_stride_kernel": False,
+    "FLAGS_new_executor_serial_run": False,
+    "FLAGS_benchmark": False,
+    "FLAGS_use_pallas_kernels": True,  # TPU: enable Pallas hot kernels
+    "FLAGS_matmul_precision": "default",  # default|highest (f32 on MXU)
+}
+
+
+def define_flag(name, default):
+    _FLAGS.setdefault(name, default)
+
+
+def _coerce(cur, val):
+    if isinstance(cur, bool):
+        return val in (True, 1, "1", "true", "True")
+    if isinstance(cur, int):
+        return int(val)
+    if isinstance(cur, float):
+        return float(val)
+    return val
+
+
+for _k in list(_FLAGS):
+    if _k in os.environ:
+        _FLAGS[_k] = _coerce(_FLAGS[_k], os.environ[_k])
+
+
+def set_flags(flags: dict):
+    for k, v in flags.items():
+        cur = _FLAGS.get(k)
+        _FLAGS[k] = _coerce(cur, v) if cur is not None else v
+
+
+def get_flags(keys):
+    if isinstance(keys, str):
+        keys = [keys]
+    return {k: _FLAGS.get(k) for k in keys}

@@ -1,0 +1,89 @@
+"""paddle.save / paddle.load.
+
+Reference parity: `python/paddle/framework/io.py` (pickled nested
+state_dicts with tensor payloads) [UNVERIFIED — empty reference mount].
+Tensors are serialized as (ndarray, dtype-name) so bfloat16 round-trips.
+"""
+from __future__ import annotations
+
+import os
+import pickle
+
+import numpy as np
+
+from ..core.tensor import Tensor, to_tensor
+
+__all__ = ["save", "load"]
+
+_MAGIC = "paddle_tpu.tensor"
+
+
+class _TensorPayload:
+    def __init__(self, array, dtype_name, is_parameter, name,
+                 stop_gradient):
+        self.magic = _MAGIC
+        self.array = array
+        self.dtype_name = dtype_name
+        self.is_parameter = is_parameter
+        self.name = name
+        self.stop_gradient = stop_gradient
+
+
+def _pack(obj):
+    from ..nn.layer.layers import Parameter
+
+    if isinstance(obj, Tensor):
+        arr = np.asarray(obj._value)
+        dtype_name = obj.dtype.name
+        if dtype_name == "bfloat16":
+            arr = arr.astype(np.float32)
+        return _TensorPayload(arr, dtype_name, isinstance(obj, Parameter),
+                              obj.name, obj.stop_gradient)
+    if isinstance(obj, dict):
+        return {k: _pack(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        packed = [_pack(v) for v in obj]
+        return type(obj)(packed) if not isinstance(obj, tuple) else \
+            tuple(packed)
+    return obj
+
+
+def _unpack(obj, return_numpy=False):
+    from ..core.dtypes import to_jax_dtype
+    from ..nn.layer.layers import Parameter
+    import jax.numpy as jnp
+
+    if isinstance(obj, _TensorPayload):
+        arr = obj.array
+        if return_numpy:
+            return arr
+        val = jnp.asarray(arr, to_jax_dtype(obj.dtype_name))
+        if obj.is_parameter:
+            t = Parameter(val, _internal=True)
+        else:
+            t = Tensor(val, _internal=True,
+                       stop_gradient=obj.stop_gradient)
+        t.name = obj.name
+        return t
+    if isinstance(obj, dict):
+        return {k: _unpack(v, return_numpy) for k, v in obj.items()}
+    if isinstance(obj, list):
+        return [_unpack(v, return_numpy) for v in obj]
+    if isinstance(obj, tuple):
+        return tuple(_unpack(v, return_numpy) for v in obj)
+    return obj
+
+
+def save(obj, path, protocol=4, **configs):
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path, "wb") as f:
+        pickle.dump(_pack(obj), f, protocol=protocol)
+
+
+def load(path, **configs):
+    return_numpy = configs.get("return_numpy", False)
+    with open(path, "rb") as f:
+        obj = pickle.load(f)
+    return _unpack(obj, return_numpy)

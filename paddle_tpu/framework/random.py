@@ -1,0 +1,83 @@
+"""Global RNG: paddle.seed / per-device generator state.
+
+Reference parity: `python/paddle/framework/random.py` + phi Generator
+[UNVERIFIED — empty reference mount].  TPU-native: state is a JAX PRNG key
+held in a Tensor so that (a) jit tracing captures RNG advancement as state
+in/out (functionalized side effect), and (b) distributed RNG trackers can
+fold_in axis indices (Megatron RNGStatesTracker equivalent lives in
+paddle_tpu.distributed.fleet.meta_parallel.parallel_layers.random).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["seed", "get_rng_state", "set_rng_state", "default_generator",
+           "Generator", "get_cuda_rng_state", "set_cuda_rng_state"]
+
+
+class Generator:
+    def __init__(self, seed_val: int = 0):
+        from ..core.tensor import Tensor
+
+        self._state = Tensor(
+            jax.random.PRNGKey(seed_val), _internal=True, stop_gradient=True)
+        self._state.name = "rng_state"
+        self._state.persistable = True
+
+    def manual_seed(self, seed_val: int):
+        self._state._inplace_update(jax.random.PRNGKey(int(seed_val)))
+        return self
+
+    @property
+    def state_tensor(self):
+        return self._state
+
+    def get_state(self):
+        return self._state
+
+    def set_state(self, state):
+        from ..core.tensor import Tensor
+
+        v = state._value if isinstance(state, Tensor) else jnp.asarray(state)
+        self._state._inplace_update(v)
+
+    def next_key(self):
+        """Split the state; returns a fresh subkey (raw array), advances state.
+
+        Trace-aware: reads/writes go through the Tensor so to_static captures
+        the RNG as loop-carried state.
+        """
+        key = self._state.value()
+        new, sub = jax.random.split(key)
+        self._state._inplace_update(new)
+        return sub
+
+
+_default_generator = Generator(0)
+
+
+def default_generator() -> Generator:
+    return _default_generator
+
+
+def seed(s: int):
+    _default_generator.manual_seed(s)
+    return _default_generator
+
+
+def get_rng_state():
+    return [_default_generator.get_state()]
+
+
+def set_rng_state(states):
+    st = states[0] if isinstance(states, (list, tuple)) else states
+    _default_generator.set_state(st)
+
+
+def get_cuda_rng_state():
+    return get_rng_state()
+
+
+def set_cuda_rng_state(states):
+    set_rng_state(states)

@@ -1,0 +1,142 @@
+"""High-level API: paddle.Model (fit/evaluate/predict) + summary.
+
+Reference parity: `python/paddle/hapi/model.py` [UNVERIFIED — empty
+reference mount].
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .core.tensor import Tensor, to_tensor
+from .io import DataLoader
+
+__all__ = ["Model", "summary"]
+
+
+class Model:
+    def __init__(self, network, inputs=None, labels=None):
+        self.network = network
+        self._optimizer = None
+        self._loss = None
+        self._metrics = []
+
+    def prepare(self, optimizer=None, loss=None, metrics=None,
+                amp_configs=None):
+        self._optimizer = optimizer
+        self._loss = loss
+        if metrics is not None:
+            self._metrics = metrics if isinstance(metrics, (list, tuple)) \
+                else [metrics]
+
+    def _one_batch(self, batch, train=True):
+        *inputs, label = batch if isinstance(batch, (list, tuple)) else \
+            (batch,)
+        preds = self.network(*inputs)
+        loss = self._loss(preds, label) if self._loss is not None else preds
+        metrics_out = []
+        if train:
+            loss.backward()
+            self._optimizer.step()
+            self._optimizer.clear_grad()
+        for m in self._metrics:
+            m.update(m.compute(preds, label))
+            metrics_out.append(m.accumulate())
+        return loss, metrics_out
+
+    def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1,
+            eval_freq=1, log_freq=10, save_dir=None, save_freq=1,
+            verbose=2, drop_last=False, shuffle=True, num_workers=0,
+            callbacks=None, accumulate_grad_batches=1, num_iters=None):
+        loader = train_data if isinstance(train_data, DataLoader) else \
+            DataLoader(train_data, batch_size=batch_size, shuffle=shuffle,
+                       drop_last=drop_last, num_workers=num_workers)
+        history = []
+        it_count = 0
+        for epoch in range(epochs):
+            self.network.train()
+            for m in self._metrics:
+                m.reset()
+            for step, batch in enumerate(loader):
+                loss, mets = self._one_batch(batch, train=True)
+                it_count += 1
+                if verbose and step % log_freq == 0:
+                    msg = f"Epoch {epoch + 1}/{epochs} step {step}: " \
+                          f"loss={float(loss.item()):.4f}"
+                    for m, v in zip(self._metrics, mets):
+                        msg += f" {m.name()}={v if not isinstance(v, list) else v[0]:.4f}"
+                    print(msg)
+                if num_iters is not None and it_count >= num_iters:
+                    return history
+            history.append(float(loss.item()))
+            if eval_data is not None and (epoch + 1) % eval_freq == 0:
+                self.evaluate(eval_data, batch_size=batch_size,
+                              verbose=verbose)
+        return history
+
+    def evaluate(self, eval_data, batch_size=1, log_freq=10, verbose=2,
+                 num_workers=0, callbacks=None, num_iters=None):
+        loader = eval_data if isinstance(eval_data, DataLoader) else \
+            DataLoader(eval_data, batch_size=batch_size)
+        self.network.eval()
+        for m in self._metrics:
+            m.reset()
+        losses = []
+        from .core.autograd import no_grad
+        with no_grad():
+            for batch in loader:
+                loss, mets = self._one_batch(batch, train=False)
+                losses.append(float(loss.item()))
+        out = {"loss": [float(np.mean(losses))] if losses else [0.0]}
+        for m in self._metrics:
+            out[m.name()] = m.accumulate()
+        if verbose:
+            print("Eval:", out)
+        return out
+
+    def predict(self, test_data, batch_size=1, num_workers=0,
+                stack_outputs=False, callbacks=None, verbose=1):
+        loader = test_data if isinstance(test_data, DataLoader) else \
+            DataLoader(test_data, batch_size=batch_size)
+        self.network.eval()
+        outs = []
+        from .core.autograd import no_grad
+        with no_grad():
+            for batch in loader:
+                inputs = batch[0] if isinstance(batch, (list, tuple)) else \
+                    batch
+                outs.append(self.network(inputs))
+        return outs
+
+    def save(self, path, training=True):
+        from .framework.io import save as psave
+        psave(self.network.state_dict(), path + ".pdparams")
+        if training and self._optimizer is not None:
+            psave(self._optimizer.state_dict(), path + ".pdopt")
+
+    def load(self, path, skip_mismatch=False, reset_optimizer=False):
+        from .framework.io import load as pload
+        self.network.set_state_dict(pload(path + ".pdparams"))
+
+    def parameters(self, *args, **kwargs):
+        return self.network.parameters()
+
+
+def summary(net, input_size=None, dtypes=None, input=None):
+    rows = []
+    total = 0
+    trainable = 0
+    for name, p in net.named_parameters():
+        n = int(np.prod(p.shape)) if p.shape else 1
+        total += n
+        if not p.stop_gradient:
+            trainable += n
+        rows.append((name, tuple(p.shape), n))
+    width = max((len(r[0]) for r in rows), default=20) + 2
+    lines = [f"{'Layer':<{width}}{'Shape':<24}{'Param #':<12}"]
+    for name, shape, n in rows:
+        lines.append(f"{name:<{width}}{str(shape):<24}{n:<12}")
+    lines.append("-" * (width + 36))
+    lines.append(f"Total params: {total:,}")
+    lines.append(f"Trainable params: {trainable:,}")
+    print("\n".join(lines))
+    return {"total_params": total, "trainable_params": trainable}

@@ -1,0 +1,13 @@
+"""paddle.incubate parity: fused functional ops + MoE entry points.
+
+Reference parity: `python/paddle/incubate/` (`nn/functional/fused_*`,
+`distributed/models/moe/`) [UNVERIFIED — empty reference mount].  On TPU
+the "fused" ops are the same XLA-fused compositions (plus Pallas for the
+hot ones) — exposed under the incubate names for API parity.
+"""
+from . import nn
+from . import distributed  # MoE lives here (incubate.distributed.models.moe)
+
+
+def autograd_functional_jacobian(func, xs):
+    raise NotImplementedError

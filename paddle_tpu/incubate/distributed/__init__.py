@@ -1,0 +1,2 @@
+"""incubate.distributed: MoE models (expert parallelism)."""
+from . import models

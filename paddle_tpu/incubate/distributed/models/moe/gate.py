@@ -1,0 +1,57 @@
+"""MoE gates: naive top-k, GShard, Switch.
+
+Reference parity: `python/paddle/incubate/distributed/models/moe/gate/`
+[UNVERIFIED — empty reference mount].
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .....core.dispatch import dispatch
+from .....nn import Layer, Linear
+
+__all__ = ["NaiveGate", "GShardGate", "SwitchGate"]
+
+
+class NaiveGate(Layer):
+    def __init__(self, d_model, num_expert, world_size=1, topk=2):
+        super().__init__()
+        self.gate = Linear(d_model, num_expert * world_size)
+        self.top_k = topk
+        self.num_expert = num_expert * world_size
+
+    def forward(self, x):
+        """Returns (gate_scores [N, E], topk_idx [N, k], topk_val [N, k],
+        aux_loss)."""
+        logits = self.gate(x)
+        k = self.top_k
+
+        def impl(lg, *, k):
+            probs = jax.nn.softmax(lg.astype(jnp.float32), -1)
+            val, idx = jax.lax.top_k(probs, k)
+            val = val / jnp.sum(val, -1, keepdims=True)
+            # load-balancing aux loss (GShard eq.): E * mean(f_e * P_e)
+            E = lg.shape[-1]
+            me = jnp.mean(probs, axis=0)
+            onehot = jax.nn.one_hot(idx[:, 0], E, dtype=probs.dtype)
+            ce = jnp.mean(onehot, axis=0)
+            aux = E * jnp.sum(me * ce)
+            return probs.astype(lg.dtype), idx.astype(jnp.int64), \
+                val.astype(lg.dtype), aux.astype(lg.dtype)
+
+        return dispatch("moe_gate", impl, (logits,), dict(k=k))
+
+
+class GShardGate(NaiveGate):
+    def __init__(self, d_model, num_expert, world_size=1, topk=2,
+                 capacity=(1.2, 2.4), random_routing=True, group=None):
+        super().__init__(d_model, num_expert, world_size, topk)
+        self.capacity = capacity
+
+
+class SwitchGate(NaiveGate):
+    def __init__(self, d_model, num_expert, world_size=1, topk=1,
+                 switch_eps=0.1, capacity=(1.2, 2.4), group=None):
+        super().__init__(d_model, num_expert, world_size, topk=1)
+        self.switch_eps = switch_eps

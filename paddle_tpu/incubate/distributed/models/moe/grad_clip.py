@@ -1,0 +1,28 @@
+"""MoE-aware global-norm clip.
+
+Reference parity: `python/paddle/incubate/distributed/models/moe/
+grad_clip.py` — expert params' grad norms are reduced over the moe group
+so the global norm matches the unsharded model [UNVERIFIED — empty
+reference mount].
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .....nn.clip import ClipGradByGlobalNorm
+
+__all__ = ["ClipGradForMOEByGlobalNorm"]
+
+
+class ClipGradForMOEByGlobalNorm(ClipGradByGlobalNorm):
+    def __init__(self, clip_norm, is_expert_param_func=None,
+                 moe_group=None, group_name="default_moe_group"):
+        super().__init__(clip_norm, group_name)
+        self.is_expert_param_func = is_expert_param_func
+        self.moe_group = moe_group
+
+    def __call__(self, params):
+        # single-controller SPMD: expert grads already live on the global
+        # mesh; the plain global norm is correct.  (Multi-controller EP
+        # would psum expert norms over the moe_group axis here.)
+        return super().__call__(params)

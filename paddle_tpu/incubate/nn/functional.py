@@ -1,0 +1,227 @@
+"""Fused functional ops (incubate.nn.functional parity).
+
+Reference parity: phi `fusion/` kernels — fused_attention, fused_rope,
+fused_bias_act, fused_rms_norm [UNVERIFIED — empty reference mount].
+TPU-native: each is ONE dispatch so the whole composite is a single XLA
+fusion (and a Pallas kernel where it matters: rms_norm/attention — see
+ops/pallas_kernels.py).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...core.dispatch import dispatch
+from ...core.tensor import Tensor
+
+__all__ = ["fused_linear", "fused_feedforward", "fused_multi_head_attention",
+           "fused_rms_norm", "fused_layer_norm",
+           "fused_rotary_position_embedding", "fused_bias_act", "swiglu",
+           "fused_dropout_add", "fused_linear_activation"]
+
+
+def fused_linear(x, weight, bias=None, transpose_weight=False, name=None):
+    def impl(v, w, *b, tw):
+        if tw:
+            w = w.T
+        out = v @ w
+        if b:
+            out = out + b[0]
+        return out
+
+    args = (x, weight) + ((bias,) if bias is not None else ())
+    return dispatch("fused_gemm_epilogue", impl, args,
+                    dict(tw=bool(transpose_weight)))
+
+
+def fused_linear_activation(x, y, bias, trans_x=False, trans_y=False,
+                            activation="gelu"):
+    def impl(v, w, b, *, tx, ty, act):
+        if tx:
+            v = v.T
+        if ty:
+            w = w.T
+        out = v @ w + b
+        if act == "gelu":
+            return jax.nn.gelu(out)
+        if act == "relu":
+            return jnp.maximum(out, 0)
+        return out
+
+    return dispatch("fused_linear_activation", impl, (x, y, bias),
+                    dict(tx=bool(trans_x), ty=bool(trans_y),
+                         act=activation))
+
+
+def swiglu(x, y=None, name=None):
+    if y is not None:
+        return dispatch("swiglu", lambda a, b: jax.nn.silu(a) * b, (x, y),
+                        {})
+
+    def impl(v):
+        a, b = jnp.split(v, 2, axis=-1)
+        return jax.nn.silu(a) * b
+
+    return dispatch("swiglu", impl, (x,), {})
+
+
+def fused_bias_act(x, bias=None, dequant_scales=None, shift=None,
+                   smooth=None, act_method="gelu", **kwargs):
+    def impl(v, *b, act):
+        out = v + b[0] if b else v
+        if act == "gelu":
+            return jax.nn.gelu(out)
+        if act in ("swiglu", "silu"):
+            return jax.nn.silu(out)
+        if act == "relu":
+            return jnp.maximum(out, 0)
+        return out
+
+    args = (x,) + ((bias,) if bias is not None else ())
+    return dispatch("fused_bias_act", impl, args, dict(act=act_method))
+
+
+def fused_rms_norm(x, norm_weight, norm_bias=None, epsilon=1e-6,
+                   begin_norm_axis=-1, **kwargs):
+    from ...nn.functional.norm import rms_norm
+
+    out = rms_norm(x, norm_weight, epsilon)
+    if norm_bias is not None:
+        from ...ops.math import add
+        out = add(out, norm_bias)
+    return out, None
+
+
+def fused_layer_norm(x, norm_weight, norm_bias, epsilon=1e-5,
+                     begin_norm_axis=-1, **kwargs):
+    from ...nn.functional.norm import layer_norm
+
+    shape = tuple(x.shape[begin_norm_axis:]) if begin_norm_axis != -1 else \
+        (x.shape[-1],)
+    return layer_norm(x, list(shape), norm_weight, norm_bias, epsilon), None
+
+
+def fused_dropout_add(x, y, p=0.5, training=True, mode="upscale_in_train",
+                      name=None):
+    from ...nn.functional.common import dropout
+    from ...ops.math import add
+
+    return add(dropout(x, p, training=training, mode=mode), y)
+
+
+def fused_rotary_position_embedding(q, k=None, v=None, sin=None, cos=None,
+                                    position_ids=None, use_neox_rotary_style=True,
+                                    time_major=False, rotary_emb_base=10000.0):
+    """RoPE applied to q/k ([B, S, H, D] layout)."""
+
+    def make_sincos(seq, dim, dtype, base):
+        inv = 1.0 / (base ** (jnp.arange(0, dim, 2, dtype=jnp.float32) /
+                              dim))
+        t = jnp.arange(seq, dtype=jnp.float32)
+        freqs = jnp.outer(t, inv)
+        return jnp.sin(freqs).astype(dtype), jnp.cos(freqs).astype(dtype)
+
+    def rope(v, sin_, cos_, neox):
+        B, S, H, D = v.shape
+        if neox:
+            v1, v2 = v[..., :D // 2], v[..., D // 2:]
+            s = sin_[None, :, None, :]
+            c = cos_[None, :, None, :]
+            return jnp.concatenate([v1 * c - v2 * s, v2 * c + v1 * s], -1)
+        v1, v2 = v[..., 0::2], v[..., 1::2]
+        s = sin_[None, :, None, :]
+        c = cos_[None, :, None, :]
+        out = jnp.stack([v1 * c - v2 * s, v2 * c + v1 * s], axis=-1)
+        return out.reshape(v.shape)
+
+    def impl(qv, *rest, has_k, has_v, neox, base):
+        i = 0
+        kv = rest[i] if has_k else None
+        i += 1 if has_k else 0
+        vv = rest[i] if has_v else None
+        S, D = qv.shape[1], qv.shape[-1]
+        sin_, cos_ = make_sincos(S, D, qv.dtype, base)
+        outs = [rope(qv, sin_, cos_, neox)]
+        if kv is not None:
+            outs.append(rope(kv, sin_, cos_, neox))
+        if vv is not None:
+            outs.append(vv)
+        return tuple(outs) if len(outs) > 1 else outs[0]
+
+    args = (q,) + tuple(t for t in (k, v) if t is not None)
+    out = dispatch("fused_rope", impl, args,
+                   dict(has_k=k is not None, has_v=v is not None,
+                        neox=bool(use_neox_rotary_style),
+                        base=float(rotary_emb_base)))
+    if isinstance(out, tuple):
+        res = list(out)
+        while len(res) < 3:
+            res.append(None)
+        return tuple(res)
+    return out, None, None
+
+
+def fused_feedforward(x, linear1_weight, linear2_weight, linear1_bias=None,
+                      linear2_bias=None, ln1_scale=None, ln1_bias=None,
+                      ln2_scale=None, ln2_bias=None, dropout1_rate=0.5,
+                      dropout2_rate=0.5, activation="relu",
+                      ln1_epsilon=1e-5, ln2_epsilon=1e-5,
+                      pre_layer_norm=False, training=True, mode=None,
+                      name=None):
+    from ...nn import functional as F
+    from ...ops.math import add
+
+    residual = x
+    if pre_layer_norm and ln1_scale is not None:
+        x = F.layer_norm(x, [x.shape[-1]], ln1_scale, ln1_bias, ln1_epsilon)
+    h = F.linear(x, linear1_weight, linear1_bias)
+    h = F.gelu(h) if activation == "gelu" else F.relu(h)
+    h = F.dropout(h, dropout1_rate, training=training)
+    h = F.linear(h, linear2_weight, linear2_bias)
+    h = F.dropout(h, dropout2_rate, training=training)
+    out = add(residual, h)
+    if not pre_layer_norm and ln2_scale is not None:
+        out = F.layer_norm(out, [out.shape[-1]], ln2_scale, ln2_bias,
+                           ln2_epsilon)
+    return out
+
+
+def fused_multi_head_attention(x, qkv_weight, linear_weight,
+                               pre_layer_norm=False, pre_ln_scale=None,
+                               pre_ln_bias=None, ln_scale=None, ln_bias=None,
+                               pre_ln_epsilon=1e-5, qkv_bias=None,
+                               linear_bias=None, cache_kv=None,
+                               attn_mask=None, dropout_rate=0.5,
+                               attn_dropout_rate=0.5, ln_epsilon=1e-5,
+                               training=True, mode=None,
+                               num_heads=None, **kwargs):
+    from ...nn import functional as F
+    from ...ops.math import add
+    from ...ops.manipulation import reshape, transpose
+
+    residual = x
+    if pre_layer_norm and pre_ln_scale is not None:
+        x = F.layer_norm(x, [x.shape[-1]], pre_ln_scale, pre_ln_bias,
+                         pre_ln_epsilon)
+    B, S, E = x.shape
+    # qkv_weight: [3, num_heads, head_dim, E]
+    nh = qkv_weight.shape[1]
+    hd = qkv_weight.shape[2]
+    from ...ops.linalg import einsum
+    qkv = einsum("bse,thde->bsthd", x, qkv_weight)
+    if qkv_bias is not None:
+        qkv = add(qkv, qkv_bias)
+    q = qkv[:, :, 0]
+    k = qkv[:, :, 1]
+    v = qkv[:, :, 2]
+    out = F.scaled_dot_product_attention(
+        q, k, v, attn_mask=attn_mask,
+        dropout_p=attn_dropout_rate if training else 0.0)
+    out = reshape(out, [B, S, nh * hd])
+    out = F.linear(out, linear_weight, linear_bias)
+    out = F.dropout(out, dropout_rate, training=training)
+    out = add(residual, out)
+    if not pre_layer_norm and ln_scale is not None:
+        out = F.layer_norm(out, [out.shape[-1]], ln_scale, ln_bias,
+                           ln_epsilon)
+    return out

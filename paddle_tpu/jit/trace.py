@@ -1,0 +1,306 @@
+"""to_static: compile the imperative training step into one XLA executable.
+
+Reference parity: `python/paddle/jit/` dy2static + SOT [UNVERIFIED — empty
+reference mount].  Paddle captures Python bytecode / AST to build a static
+program.  TPU-native redesign (SURVEY.md §7): because every eager op in this
+framework bottoms out in pure JAX, the imperative step function can be
+*re-traced under jax.jit directly* — state (parameters, optimizer moments,
+RNG key, BN stats) is discovered on a first eager run and threaded as
+inputs/outputs of a pure function.  That single executable includes forward,
+tape backward, and the fused optimizer update — XLA fuses and schedules the
+whole step (the StandaloneExecutor + CINN role).
+
+Mechanics per call signature (cache key = pytree structure + shapes/dtypes):
+  1. discovery run: execute eagerly, recording every external Tensor read
+     (captured state) and every Tensor whose buffer is swapped (mutations).
+  2. compile: jit a pure fn (args, state_in) -> (outs, state_out, grads).
+  3. steady state: one compiled call per step + host-side buffer swaps.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor, get_trace_ctx, set_trace_ctx
+
+
+class _DiscoveryCtx:
+    """Records reads/writes during the eager discovery run."""
+
+    def __init__(self):
+        self.created = set()
+        self.read_order = []
+        self.read_ids = set()
+        self.written = []
+        self.written_ids = set()
+
+    def on_create(self, t):
+        self.created.add(id(t))
+
+    def on_read(self, t):
+        if id(t) not in self.created and id(t) not in self.read_ids:
+            self.read_ids.add(id(t))
+            self.read_order.append(t)
+        return t._value
+
+    def on_write(self, t, old_value=None, old_node=None):
+        if id(t) not in self.written_ids:
+            self.written_ids.add(id(t))
+            self.written.append(t)
+
+
+class _ReplayCtx:
+    """Substitutes tracers for captured state during jit re-trace."""
+
+    def __init__(self, sub):
+        self.sub = sub  # id(tensor) -> traced value
+        self.created = set()
+        self.missing = []
+        # first-write snapshot of external tensors, so an aborted or
+        # completed trace never leaves tracers behind in live objects
+        self.write_snapshot = {}
+
+    def on_create(self, t):
+        self.created.add(id(t))
+
+    def on_read(self, t):
+        v = self.sub.get(id(t))
+        if v is not None:
+            return v
+        if id(t) not in self.created:
+            self.missing.append(t)
+        return t._value
+
+    def on_write(self, t, old_value=None, old_node=None):
+        if id(t) not in self.created and id(t) not in self.write_snapshot:
+            self.write_snapshot[id(t)] = (t, old_value, old_node)
+
+
+class _RetraceNeeded(Exception):
+    def __init__(self, missing):
+        super().__init__(
+            f"{len(missing)} state tensors discovered only during replay")
+        self.missing = missing
+
+
+def _is_tensor_leaf(x):
+    return isinstance(x, Tensor)
+
+
+def _tree_key(tree):
+    leaves, treedef = jax.tree.flatten(tree, is_leaf=_is_tensor_leaf)
+    parts = [str(treedef)]
+    for leaf in leaves:
+        if isinstance(leaf, Tensor):
+            parts.append(f"T{tuple(leaf._value.shape)}:{leaf._value.dtype}")
+        elif isinstance(leaf, jax.Array):
+            parts.append(f"A{tuple(leaf.shape)}:{leaf.dtype}")
+        else:
+            parts.append(f"V{leaf!r}")
+    return "|".join(parts)
+
+
+def _tensor_arg_values(args, kwargs):
+    leaves = jax.tree.flatten((args, kwargs), is_leaf=_is_tensor_leaf)[0]
+    return tuple(l._value for l in leaves if isinstance(l, Tensor))
+
+
+def _bind_args(args, kwargs, tensor_vals):
+    """Rebuild (args, kwargs) with fresh Tensor wrappers around traced
+    values; non-tensor leaves pass through unchanged (static)."""
+    leaves, treedef = jax.tree.flatten((args, kwargs),
+                                       is_leaf=_is_tensor_leaf)
+    it = iter(tensor_vals)
+    new_leaves = []
+    for l in leaves:
+        if isinstance(l, Tensor):
+            new_leaves.append(Tensor(next(it), _internal=True,
+                                     stop_gradient=l.stop_gradient))
+        else:
+            new_leaves.append(l)
+    return jax.tree.unflatten(treedef, new_leaves)
+
+
+class TracedFunction:
+    """The callable returned by paddle.jit.to_static."""
+
+    def __init__(self, fn, input_spec=None, jit_kwargs=None):
+        self._fn = fn
+        self._input_spec = input_spec
+        self._cache = {}
+        self._jit_kwargs = jit_kwargs or {}
+        functools.update_wrapper(self, fn, updated=[])
+
+    @property
+    def forward(self):
+        return self
+
+    def __call__(self, *args, **kwargs):
+        if get_trace_ctx() is not None:
+            return self._fn(*args, **kwargs)  # nested: already tracing
+        key = _tree_key((args, kwargs))
+        comp = self._cache.get(key)
+        if comp is None:
+            first_result, comp = self._discover_and_compile(args, kwargs)
+            self._cache[key] = comp
+            return first_result
+        return self._run_compiled(comp, args, kwargs)
+
+    # ------------------------------------------------------------------
+    def _discover_and_compile(self, args, kwargs):
+        ctx = _DiscoveryCtx()
+        set_trace_ctx(ctx)
+        try:
+            result = self._fn(*args, **kwargs)
+        finally:
+            set_trace_ctx(None)
+
+        arg_leaves = [l for l in jax.tree.flatten(
+            (args, kwargs), is_leaf=_is_tensor_leaf)[0]
+            if isinstance(l, Tensor)]
+        arg_ids = {id(l) for l in arg_leaves}
+        state = [t for t in ctx.read_order if id(t) not in arg_ids]
+        mutated = [t for t in ctx.written
+                   if id(t) not in ctx.created and id(t) not in arg_ids]
+        # params whose .grad was freshly created during the step and kept
+        grad_slots = [t for t in state
+                      if t.grad is not None and id(t.grad) in ctx.created]
+        # Tensors created during discovery but still referenced afterwards
+        # (e.g. optimizer accumulators born on the first step) surface as
+        # "missing" when the replay trace reads them; the compile loop below
+        # promotes them into state/mutated and re-traces (no re-execution).
+        written_ids = set(ctx.written_ids)
+        while True:
+            try:
+                comp = self._compile(args, kwargs, state, mutated,
+                                     grad_slots)
+                break
+            except _RetraceNeeded as e:
+                state_ids = {id(t) for t in state}
+                mutated_ids = {id(t) for t in mutated}
+                progress = False
+                for t in e.missing:
+                    if id(t) not in state_ids:
+                        state.append(t)
+                        state_ids.add(id(t))
+                        progress = True
+                        if id(t) in written_ids and \
+                                id(t) not in mutated_ids:
+                            mutated.append(t)
+                            mutated_ids.add(id(t))
+                if not progress:
+                    raise
+        return result, comp
+
+    def _compile(self, args, kwargs, state, mutated, grad_slots):
+        fn = self._fn
+        touched = {id(t): t for t in state}
+        for t in mutated:
+            touched.setdefault(id(t), t)
+
+        meta = {}
+
+        def pure_fn(tensor_arg_vals, state_vals):
+            saved = [(t, t._value, t._grad_node, t.grad)
+                     for t in touched.values()]
+            sub = {id(t): v for t, v in zip(state, state_vals)}
+            rctx = _ReplayCtx(sub)
+            set_trace_ctx(rctx)
+            try:
+                new_args, new_kwargs = _bind_args(args, kwargs,
+                                                  tensor_arg_vals)
+                for t, v in zip(state, state_vals):
+                    t._value = v
+                for t in grad_slots:
+                    t.grad = None  # reproduce discovery initial conditions
+                result = fn(*new_args, **new_kwargs)
+                if rctx.missing:
+                    raise _RetraceNeeded(rctx.missing)
+                out_leaves, out_treedef = jax.tree.flatten(
+                    result, is_leaf=_is_tensor_leaf)
+                out_vals = tuple(
+                    l._value if isinstance(l, Tensor) else l
+                    for l in out_leaves)
+                mut_vals = tuple(t._value for t in mutated)
+                grad_vals = tuple(
+                    t.grad._value if t.grad is not None
+                    else jnp.zeros_like(t._value) for t in grad_slots)
+                meta["out_treedef"] = out_treedef
+                meta["out_is_tensor"] = [isinstance(l, Tensor)
+                                         for l in out_leaves]
+                meta["has_grad"] = [t.grad is not None for t in grad_slots]
+                return out_vals, mut_vals, grad_vals
+            finally:
+                set_trace_ctx(None)
+                for t, ov, on in rctx.write_snapshot.values():
+                    t._value = ov
+                    t._grad_node = on
+                for t, v, gn, gr in saved:
+                    t._value = v
+                    t._grad_node = gn
+                    t.grad = gr
+
+        jitted = jax.jit(pure_fn, **self._jit_kwargs)
+        arg_vals = _tensor_arg_values(args, kwargs)
+        state_vals = tuple(t._value for t in state)
+        compiled = jitted.lower(arg_vals, state_vals).compile()
+        return {
+            "compiled": compiled,
+            "state": state,
+            "mutated": mutated,
+            "grad_slots": grad_slots,
+            "out_treedef": meta["out_treedef"],
+            "out_is_tensor": meta["out_is_tensor"],
+            "has_grad": meta["has_grad"],
+        }
+
+    def _run_compiled(self, comp, args, kwargs):
+        arg_vals = _tensor_arg_values(args, kwargs)
+        state_vals = tuple(t._value for t in comp["state"])
+        out_vals, mut_vals, grad_vals = comp["compiled"](
+            arg_vals, state_vals)
+        for t, v in zip(comp["mutated"], mut_vals):
+            t._value = v
+            t._grad_node = None
+        for t, v, hg in zip(comp["grad_slots"], grad_vals,
+                            comp["has_grad"]):
+            if hg:
+                if t.grad is None:
+                    t.grad = Tensor(v, _internal=True, stop_gradient=True)
+                else:
+                    t.grad._value = v
+            else:
+                t.grad = None
+        out_leaves = [
+            Tensor(v, _internal=True, stop_gradient=True) if is_t else v
+            for v, is_t in zip(out_vals, comp["out_is_tensor"])]
+        return jax.tree.unflatten(comp["out_treedef"], out_leaves)
+
+
+def to_static(function=None, input_spec=None, build_strategy=None,
+              backend=None, **kwargs):
+    """paddle.jit.to_static — decorator or call form."""
+
+    def decorate(fn):
+        if isinstance(fn, TracedFunction):
+            return fn
+        from ..nn.layer.layers import Layer
+
+        if isinstance(fn, Layer):
+            traced = TracedFunction(fn.forward, input_spec)
+            fn.forward = traced
+            return fn
+        return TracedFunction(fn, input_spec)
+
+    if function is not None:
+        return decorate(function)
+    return decorate
+
+
+def not_to_static(fn):
+    fn._not_to_static = True
+    return fn

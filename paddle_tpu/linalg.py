@@ -1,0 +1,7 @@
+"""paddle.linalg namespace (re-exports ops.linalg)."""
+from .ops.linalg import *  # noqa: F401,F403
+from .ops.linalg import (matmul, norm, cond, cov, corrcoef, cholesky, inv,
+                         pinv, det, slogdet, svd, qr, eig, eigh, eigvals,
+                         eigvalsh, matrix_power, matrix_rank, solve,
+                         triangular_solve, cholesky_solve, lstsq, lu,
+                         multi_dot, householder_product, matrix_exp)  # noqa

@@ -1,0 +1,181 @@
+"""paddle.metric parity: Metric base, Accuracy, Precision, Recall, Auc.
+
+Reference parity: `python/paddle/metric/metrics.py` [UNVERIFIED — empty
+reference mount].
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..core.tensor import Tensor, to_tensor
+
+__all__ = ["Metric", "Accuracy", "Precision", "Recall", "Auc", "accuracy"]
+
+
+def accuracy(input, label, k=1, correct=None, total=None, name=None):
+    """Top-k accuracy (paddle.metric.accuracy)."""
+    from ..ops.manipulation import topk as _topk
+
+    probs = np.asarray(input._value)
+    labels = np.asarray(label._value)
+    if labels.ndim == probs.ndim:
+        labels = labels.reshape(labels.shape[:-1])
+    idx = np.argsort(-probs, axis=-1)[..., :k]
+    correct_mask = (idx == labels[..., None]).any(axis=-1)
+    return to_tensor(np.asarray(correct_mask.mean(), np.float32))
+
+
+class Metric:
+    def reset(self):
+        raise NotImplementedError
+
+    def update(self, *args):
+        raise NotImplementedError
+
+    def accumulate(self):
+        raise NotImplementedError
+
+    def name(self):
+        raise NotImplementedError
+
+    def compute(self, *args):
+        return args
+
+
+class Accuracy(Metric):
+    def __init__(self, topk=(1,), name=None, *args, **kwargs):
+        self.topk = topk if isinstance(topk, (list, tuple)) else (topk,)
+        self.maxk = max(self.topk)
+        self._name = name or "acc"
+        self.reset()
+
+    def reset(self):
+        self.total = [0.0] * len(self.topk)
+        self.count = [0] * len(self.topk)
+
+    def compute(self, pred, label, *args):
+        pred_np = np.asarray(pred._value) if isinstance(pred, Tensor) else \
+            np.asarray(pred)
+        label_np = np.asarray(label._value) if isinstance(label, Tensor) \
+            else np.asarray(label)
+        if label_np.ndim == pred_np.ndim and label_np.shape[-1] == 1:
+            label_np = label_np[..., 0]
+        idx = np.argsort(-pred_np, axis=-1)[..., :self.maxk]
+        correct = (idx == label_np[..., None])
+        return to_tensor(correct.astype(np.float32))
+
+    def update(self, correct, *args):
+        arr = np.asarray(correct._value) if isinstance(correct, Tensor) \
+            else np.asarray(correct)
+        num = arr.shape[0] if arr.ndim else 1
+        accs = []
+        for i, k in enumerate(self.topk):
+            c = arr[..., :k].sum()
+            self.total[i] += float(c)
+            self.count[i] += int(np.prod(arr.shape[:-1]))
+            accs.append(self.total[i] / max(self.count[i], 1))
+        return accs[0] if len(accs) == 1 else accs
+
+    def accumulate(self):
+        res = [t / max(c, 1) for t, c in zip(self.total, self.count)]
+        return res[0] if len(res) == 1 else res
+
+    def name(self):
+        return self._name
+
+
+class Precision(Metric):
+    def __init__(self, name="precision", *args, **kwargs):
+        self._name = name
+        self.reset()
+
+    def reset(self):
+        self.tp = 0
+        self.fp = 0
+
+    def update(self, preds, labels):
+        p = np.asarray(preds._value) if isinstance(preds, Tensor) else \
+            np.asarray(preds)
+        l = np.asarray(labels._value) if isinstance(labels, Tensor) else \
+            np.asarray(labels)
+        p = (p > 0.5).astype(np.int64).reshape(-1)
+        l = l.astype(np.int64).reshape(-1)
+        self.tp += int(((p == 1) & (l == 1)).sum())
+        self.fp += int(((p == 1) & (l == 0)).sum())
+
+    def accumulate(self):
+        denom = self.tp + self.fp
+        return self.tp / denom if denom else 0.0
+
+    def name(self):
+        return self._name
+
+
+class Recall(Metric):
+    def __init__(self, name="recall", *args, **kwargs):
+        self._name = name
+        self.reset()
+
+    def reset(self):
+        self.tp = 0
+        self.fn = 0
+
+    def update(self, preds, labels):
+        p = np.asarray(preds._value) if isinstance(preds, Tensor) else \
+            np.asarray(preds)
+        l = np.asarray(labels._value) if isinstance(labels, Tensor) else \
+            np.asarray(labels)
+        p = (p > 0.5).astype(np.int64).reshape(-1)
+        l = l.astype(np.int64).reshape(-1)
+        self.tp += int(((p == 1) & (l == 1)).sum())
+        self.fn += int(((p == 0) & (l == 1)).sum())
+
+    def accumulate(self):
+        denom = self.tp + self.fn
+        return self.tp / denom if denom else 0.0
+
+    def name(self):
+        return self._name
+
+
+class Auc(Metric):
+    def __init__(self, curve="ROC", num_thresholds=4095, name="auc",
+                 *args, **kwargs):
+        self.num_thresholds = num_thresholds
+        self._name = name
+        self.reset()
+
+    def reset(self):
+        self._stat_pos = np.zeros(self.num_thresholds + 1)
+        self._stat_neg = np.zeros(self.num_thresholds + 1)
+
+    def update(self, preds, labels):
+        p = np.asarray(preds._value) if isinstance(preds, Tensor) else \
+            np.asarray(preds)
+        l = np.asarray(labels._value) if isinstance(labels, Tensor) else \
+            np.asarray(labels)
+        if p.ndim == 2:
+            p = p[:, 1]
+        l = l.reshape(-1)
+        bins = (p * self.num_thresholds).astype(np.int64)
+        bins = np.clip(bins, 0, self.num_thresholds)
+        for b, y in zip(bins, l):
+            if y:
+                self._stat_pos[b] += 1
+            else:
+                self._stat_neg[b] += 1
+
+    def accumulate(self):
+        tot_pos = self._stat_pos.sum()
+        tot_neg = self._stat_neg.sum()
+        if tot_pos == 0 or tot_neg == 0:
+            return 0.0
+        # integrate from high threshold down
+        pos = self._stat_pos[::-1].cumsum()
+        neg = self._stat_neg[::-1].cumsum()
+        tpr = pos / tot_pos
+        fpr = neg / tot_neg
+        return float(np.trapezoid(tpr, fpr))
+
+    def name(self):
+        return self._name

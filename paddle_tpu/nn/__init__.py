@@ -1,0 +1,14 @@
+"""paddle.nn parity surface."""
+from .layer.layers import (Layer, Parameter, ParamAttr, create_parameter,
+                           LayerList, Sequential, ParameterList, LayerDict)
+from .layer.common import *  # noqa: F401,F403
+from .layer.conv import *  # noqa: F401,F403
+from .layer.norm import *  # noqa: F401,F403
+from .layer.activation_pool import *  # noqa: F401,F403
+from .layer.loss import *  # noqa: F401,F403
+from .layer.transformer import *  # noqa: F401,F403
+from .layer.rnn import *  # noqa: F401,F403
+
+from . import functional
+from . import initializer
+from .utils import clip_grad_norm_, clip_grad_value_

@@ -1,0 +1,257 @@
+"""Activation functionals (paddle.nn.functional.* parity).
+
+Reference parity: `python/paddle/nn/functional/activation.py` → phi
+activation kernels [UNVERIFIED — empty reference mount].  XLA fuses these
+into neighboring matmuls, replacing phi's fused epilogue kernels.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...core.dispatch import dispatch
+from ...core.tensor import Tensor
+
+__all__ = [
+    "relu", "relu_", "relu6", "gelu", "sigmoid", "silu", "swish", "mish",
+    "hardswish", "hardsigmoid", "hardtanh", "leaky_relu", "elu", "elu_",
+    "selu", "celu", "prelu", "rrelu", "softplus", "softshrink", "hardshrink",
+    "softsign", "tanhshrink", "log_sigmoid", "log_softmax", "softmax",
+    "softmax_", "glu", "gumbel_softmax", "maxout", "thresholded_relu",
+    "tanh", "tanh_",
+]
+
+
+def relu(x, name=None):
+    return dispatch("relu", lambda v: jnp.maximum(v, 0), (x,), {})
+
+
+def relu_(x, name=None):
+    y = relu(x)
+    x._inplace_update(y._value, y._grad_node, y._out_index)
+    return x
+
+
+def relu6(x, name=None):
+    return dispatch("relu6", lambda v: jnp.clip(v, 0, 6), (x,), {})
+
+
+def gelu(x, approximate=False, name=None):
+    return dispatch(
+        "gelu", lambda v, *, approx: jax.nn.gelu(v, approximate=approx),
+        (x,), dict(approx=bool(approximate)))
+
+
+def sigmoid(x, name=None):
+    return dispatch("sigmoid", jax.nn.sigmoid, (x,), {})
+
+
+def silu(x, name=None):
+    return dispatch("silu", jax.nn.silu, (x,), {})
+
+
+def swish(x, name=None):
+    return silu(x)
+
+
+def mish(x, name=None):
+    return dispatch("mish", lambda v: v * jnp.tanh(jax.nn.softplus(v)),
+                    (x,), {})
+
+
+def hardswish(x, name=None):
+    return dispatch("hard_swish",
+                    lambda v: v * jnp.clip(v + 3, 0, 6) / 6, (x,), {})
+
+
+def hardsigmoid(x, slope=0.1666667, offset=0.5, name=None):
+    return dispatch(
+        "hard_sigmoid",
+        lambda v, *, slope, offset: jnp.clip(slope * v + offset, 0, 1),
+        (x,), dict(slope=float(slope), offset=float(offset)))
+
+
+def hardtanh(x, min=-1.0, max=1.0, name=None):
+    return dispatch("hard_tanh",
+                    lambda v, *, lo, hi: jnp.clip(v, lo, hi), (x,),
+                    dict(lo=float(min), hi=float(max)))
+
+
+def leaky_relu(x, negative_slope=0.01, name=None):
+    return dispatch(
+        "leaky_relu",
+        lambda v, *, slope: jnp.where(v >= 0, v, slope * v), (x,),
+        dict(slope=float(negative_slope)))
+
+
+def elu(x, alpha=1.0, name=None):
+    return dispatch("elu", lambda v, *, alpha: jax.nn.elu(v, alpha), (x,),
+                    dict(alpha=float(alpha)))
+
+
+def elu_(x, alpha=1.0, name=None):
+    y = elu(x, alpha)
+    x._inplace_update(y._value, y._grad_node, y._out_index)
+    return x
+
+
+def selu(x, scale=1.0507009873554805, alpha=1.6732632423543772, name=None):
+    return dispatch(
+        "selu",
+        lambda v, *, scale, alpha: scale * jnp.where(
+            v > 0, v, alpha * jnp.expm1(v)),
+        (x,), dict(scale=float(scale), alpha=float(alpha)))
+
+
+def celu(x, alpha=1.0, name=None):
+    return dispatch("celu", lambda v, *, a: jax.nn.celu(v, a), (x,),
+                    dict(a=float(alpha)))
+
+
+def prelu(x, weight, data_format="NCHW", name=None):
+    def impl(v, w, *, cdim):
+        if w.size == 1:
+            wb = w.reshape(())
+        else:
+            shape = [1] * v.ndim
+            shape[cdim] = w.size
+            wb = w.reshape(shape)
+        return jnp.where(v >= 0, v, wb * v)
+
+    cdim = 1 if data_format == "NCHW" else x.ndim - 1
+    if x.ndim <= 1:
+        cdim = 0
+    return dispatch("prelu", impl, (x, weight), dict(cdim=cdim))
+
+
+def rrelu(x, lower=1.0 / 8.0, upper=1.0 / 3.0, training=False, name=None):
+    if not training:
+        return leaky_relu(x, (lower + upper) / 2.0)
+    from ...ops.creation import _rng_dispatch
+    from ...framework.random import default_generator
+    g = default_generator()
+
+    def impl(key, v, *, lo, hi):
+        new, sub = jax.random.split(key)
+        a = jax.random.uniform(sub, v.shape, v.dtype, lo, hi)
+        return jnp.where(v >= 0, v, a * v), new
+
+    out, newk = dispatch("rrelu", impl, (g.state_tensor, x),
+                         dict(lo=float(lower), hi=float(upper)))
+    if isinstance(newk, Tensor):
+        g.state_tensor._inplace_update(newk._value)
+    return out
+
+
+def softplus(x, beta=1.0, threshold=20.0, name=None):
+    return dispatch(
+        "softplus",
+        lambda v, *, beta, thr: jnp.where(
+            beta * v > thr, v, jax.nn.softplus(beta * v) / beta),
+        (x,), dict(beta=float(beta), thr=float(threshold)))
+
+
+def softshrink(x, threshold=0.5, name=None):
+    return dispatch(
+        "softshrink",
+        lambda v, *, t: jnp.where(v > t, v - t, jnp.where(v < -t, v + t,
+                                                          0.0)),
+        (x,), dict(t=float(threshold)))
+
+
+def hardshrink(x, threshold=0.5, name=None):
+    return dispatch(
+        "hard_shrink",
+        lambda v, *, t: jnp.where(jnp.abs(v) > t, v, 0.0), (x,),
+        dict(t=float(threshold)))
+
+
+def softsign(x, name=None):
+    return dispatch("softsign", jax.nn.soft_sign, (x,), {})
+
+
+def tanhshrink(x, name=None):
+    return dispatch("tanh_shrink", lambda v: v - jnp.tanh(v), (x,), {})
+
+
+def tanh(x, name=None):
+    return dispatch("tanh", jnp.tanh, (x,), {})
+
+
+def tanh_(x, name=None):
+    y = tanh(x)
+    x._inplace_update(y._value, y._grad_node, y._out_index)
+    return x
+
+
+def log_sigmoid(x, name=None):
+    return dispatch("logsigmoid", jax.nn.log_sigmoid, (x,), {})
+
+
+def log_softmax(x, axis=-1, dtype=None, name=None):
+    def impl(v, *, axis):
+        return jax.nn.log_softmax(v, axis=axis)
+
+    out = x if dtype is None else x.astype(dtype)
+    return dispatch("log_softmax", impl, (out,), dict(axis=int(axis)))
+
+
+def softmax(x, axis=-1, dtype=None, name=None):
+    out = x if dtype is None else x.astype(dtype)
+    return dispatch("softmax",
+                    lambda v, *, axis: jax.nn.softmax(v, axis=axis),
+                    (out,), dict(axis=int(axis)))
+
+
+def softmax_(x, axis=-1, dtype=None, name=None):
+    y = softmax(x, axis, dtype)
+    x._inplace_update(y._value, y._grad_node, y._out_index)
+    return x
+
+
+def glu(x, axis=-1, name=None):
+    return dispatch("glu", lambda v, *, axis: jax.nn.glu(v, axis=axis),
+                    (x,), dict(axis=int(axis)))
+
+
+def gumbel_softmax(x, temperature=1.0, hard=False, axis=-1, name=None):
+    from ...framework.random import default_generator
+    g = default_generator()
+
+    def impl(key, v, *, tau, hard, axis):
+        new, sub = jax.random.split(key)
+        gumbel = jax.random.gumbel(sub, v.shape, v.dtype)
+        y = jax.nn.softmax((v + gumbel) / tau, axis=axis)
+        if hard:
+            idx = jnp.argmax(y, axis=axis, keepdims=True)
+            onehot = jnp.zeros_like(y).at[...].set(0.0)
+            hard_y = (jnp.arange(v.shape[axis]).reshape(
+                tuple(v.shape[axis] if i == (axis % v.ndim) else 1
+                      for i in range(v.ndim))) == idx).astype(v.dtype)
+            y = hard_y + jax.lax.stop_gradient(-y) + y
+        return y, new
+
+    out, newk = dispatch("gumbel_softmax", impl, (g.state_tensor, x),
+                         dict(tau=float(temperature), hard=bool(hard),
+                              axis=int(axis)))
+    if isinstance(newk, Tensor):
+        g.state_tensor._inplace_update(newk._value)
+    return out
+
+
+def maxout(x, groups, axis=1, name=None):
+    def impl(v, *, groups, axis):
+        c = v.shape[axis]
+        new_shape = (v.shape[:axis] + (c // groups, groups) +
+                     v.shape[axis + 1:])
+        return jnp.max(v.reshape(new_shape), axis=axis + 1)
+
+    return dispatch("maxout", impl, (x,),
+                    dict(groups=int(groups), axis=int(axis)))
+
+
+def thresholded_relu(x, threshold=1.0, value=0.0, name=None):
+    return dispatch(
+        "thresholded_relu",
+        lambda v, *, t, val: jnp.where(v > t, v, val), (x,),
+        dict(t=float(threshold), val=float(value)))

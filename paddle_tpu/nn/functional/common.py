@@ -1,0 +1,379 @@
+"""Common functionals: linear, dropout, pad, interpolate, embedding, one_hot.
+
+Reference parity: `python/paddle/nn/functional/common.py` + `input.py`
+[UNVERIFIED — empty reference mount].
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ...core.dispatch import dispatch
+from ...core.dtypes import to_jax_dtype
+from ...core.tensor import Tensor, to_tensor
+from ...framework.random import default_generator
+
+__all__ = [
+    "linear", "dropout", "dropout2d", "dropout3d", "alpha_dropout", "pad",
+    "interpolate", "upsample", "cosine_similarity", "pixel_shuffle",
+    "pixel_unshuffle", "unfold", "fold", "one_hot", "embedding",
+    "label_smooth", "bilinear", "class_center_sample", "zeropad2d",
+]
+
+
+def linear(x, weight, bias=None, name=None):
+    """y = x @ W + b.  W layout is [in, out] (Paddle convention)."""
+    if bias is None:
+        return dispatch("linear", lambda v, w: v @ w, (x, weight), {})
+    return dispatch("linear", lambda v, w, b: v @ w + b, (x, weight, bias),
+                    {})
+
+
+def _rng_op(name, impl_with_key, tensors, attrs):
+    g = default_generator()
+
+    def impl(key, *vs, **at):
+        new, sub = jax.random.split(key)
+        return impl_with_key(sub, *vs, **at), new
+
+    out, newk = dispatch(name, impl, (g.state_tensor,) + tuple(tensors),
+                         attrs)
+    if isinstance(newk, Tensor):
+        g.state_tensor._inplace_update(newk._value)
+    return out
+
+
+def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train",
+            name=None):
+    if not training or p == 0.0:
+        if mode == "downscale_in_infer" and not training:
+            return dispatch("dropout_infer",
+                            lambda v, *, p: v * (1.0 - p), (x,),
+                            dict(p=float(p)))
+        return x
+
+    def impl(key, v, *, p, axis, upscale):
+        shape = list(v.shape)
+        if axis is not None:
+            axes = (axis,) if isinstance(axis, int) else tuple(axis)
+            shape = [s if i in axes else 1 for i, s in enumerate(v.shape)]
+        keep = jax.random.bernoulli(key, 1.0 - p, tuple(shape))
+        if upscale:
+            return jnp.where(keep, v / (1.0 - p), jnp.zeros((), v.dtype))
+        return jnp.where(keep, v, jnp.zeros((), v.dtype))
+
+    return _rng_op("dropout", impl, (x,),
+                   dict(p=float(p), axis=axis,
+                        upscale=(mode == "upscale_in_train")))
+
+
+def dropout2d(x, p=0.5, training=True, data_format="NCHW", name=None):
+    axis = (0, 1) if data_format == "NCHW" else (0, 3)
+    return dropout(x, p, axis=axis, training=training)
+
+
+def dropout3d(x, p=0.5, training=True, data_format="NCDHW", name=None):
+    axis = (0, 1) if data_format == "NCDHW" else (0, 4)
+    return dropout(x, p, axis=axis, training=training)
+
+
+def alpha_dropout(x, p=0.5, training=True, name=None):
+    if not training or p == 0.0:
+        return x
+
+    def impl(key, v, *, p):
+        alpha = 1.6732632423543772
+        scale = 1.0507009873554805
+        alpha_p = -alpha * scale
+        keep = jax.random.bernoulli(key, 1.0 - p, v.shape)
+        a = (1.0 - p + p * alpha_p ** 2) ** -0.5
+        b = -a * p * alpha_p
+        return a * jnp.where(keep, v, alpha_p) + b
+
+    return _rng_op("alpha_dropout", impl, (x,), dict(p=float(p)))
+
+
+def _norm_pad(pad, ndim, data_format):
+    """Paddle pad list is [left, right, (top, bottom), ...] for the last dims
+    reversed; normalize to jnp.pad's per-dim tuples."""
+    if isinstance(pad, Tensor):
+        pad = pad.numpy().tolist()
+    pad = list(int(p) for p in pad)
+    widths = [(0, 0)] * ndim
+    npairs = len(pad) // 2
+    if data_format.startswith("NC") and npairs == ndim - 2:
+        dims = list(range(ndim - 1, 1, -1))
+    elif npairs == ndim - 2:  # NHWC-like: pad spatial dims
+        dims = list(range(ndim - 2, 0, -1))
+    else:
+        dims = list(range(ndim - 1, ndim - 1 - npairs, -1))
+    for i, d in enumerate(dims):
+        widths[d] = (pad[2 * i], pad[2 * i + 1])
+    return widths
+
+
+def pad(x, pad, mode="constant", value=0.0, data_format="NCHW", name=None):
+    if isinstance(pad, (list, tuple)) and len(pad) == 2 * x.ndim:
+        # full-form pad: pairs for every dim, ordered by dim
+        widths = [(int(pad[2 * i]), int(pad[2 * i + 1]))
+                  for i in range(x.ndim)]
+    else:
+        widths = _norm_pad(pad, x.ndim, data_format)
+    jmode = {"constant": "constant", "reflect": "reflect",
+             "replicate": "edge", "circular": "wrap"}[mode]
+
+    def impl(v, *, widths, jmode, value):
+        if jmode == "constant":
+            return jnp.pad(v, widths, mode="constant", constant_values=value)
+        return jnp.pad(v, widths, mode=jmode)
+
+    return dispatch("pad3d", impl, (x,),
+                    dict(widths=tuple(widths), jmode=jmode,
+                         value=float(value) if not isinstance(value, Tensor)
+                         else float(value.item())))
+
+
+def zeropad2d(x, padding, data_format="NCHW", name=None):
+    return pad(x, padding, mode="constant", value=0.0,
+               data_format=data_format)
+
+
+def interpolate(x, size=None, scale_factor=None, mode="nearest",
+                align_corners=False, align_mode=0, data_format="NCHW",
+                name=None):
+    ndim_sp = x.ndim - 2
+    if data_format.startswith("NC"):
+        sp_shape = x.shape[2:]
+    else:
+        sp_shape = x.shape[1:-1]
+    if size is not None:
+        if isinstance(size, Tensor):
+            size = size.numpy().tolist()
+        out_sp = [int(s.item()) if isinstance(s, Tensor) else int(s)
+                  for s in (size if isinstance(size, (list, tuple))
+                            else [size] * ndim_sp)]
+    else:
+        if isinstance(scale_factor, (list, tuple)):
+            out_sp = [int(s * f) for s, f in zip(sp_shape, scale_factor)]
+        else:
+            out_sp = [int(s * float(scale_factor)) for s in sp_shape]
+
+    jmode = {"nearest": "nearest", "bilinear": "linear",
+             "trilinear": "linear", "linear": "linear", "bicubic": "cubic",
+             "area": "linear"}[mode.lower()]
+
+    def impl(v, *, out_sp, jmode, cf, align):
+        if cf:  # channels-first -> resize spatial dims only
+            target = v.shape[:2] + tuple(out_sp)
+        else:
+            target = (v.shape[0],) + tuple(out_sp) + (v.shape[-1],)
+        if jmode == "nearest":
+            return jax.image.resize(v, target, method="nearest")
+        if align:
+            # align_corners resize: linear interp with endpoint alignment
+            return _resize_align_corners(v, target, cf)
+        return jax.image.resize(v, target, method=jmode)
+
+    return dispatch("interpolate", impl, (x,),
+                    dict(out_sp=tuple(out_sp), jmode=jmode,
+                         cf=data_format.startswith("NC"),
+                         align=bool(align_corners) and jmode == "linear"))
+
+
+def _resize_align_corners(v, target, cf):
+    sp_axes = range(2, v.ndim) if cf else range(1, v.ndim - 1)
+    out = v
+    for ax in sp_axes:
+        n_in, n_out = v.shape[ax], target[ax]
+        if n_in == n_out:
+            continue
+        if n_out == 1:
+            idx_lo = jnp.zeros((1,), jnp.int32)
+            idx_hi = idx_lo
+            w = jnp.zeros((1,), v.dtype)
+        else:
+            pos = jnp.linspace(0.0, n_in - 1.0, n_out)
+            idx_lo = jnp.floor(pos).astype(jnp.int32)
+            idx_hi = jnp.minimum(idx_lo + 1, n_in - 1)
+            w = (pos - idx_lo).astype(v.dtype)
+        lo = jnp.take(out, idx_lo, axis=ax)
+        hi = jnp.take(out, idx_hi, axis=ax)
+        shape = [1] * v.ndim
+        shape[ax] = n_out
+        w = w.reshape(shape)
+        out = lo * (1 - w) + hi * w
+        v = out
+    return out
+
+
+def upsample(x, size=None, scale_factor=None, mode="nearest",
+             align_corners=False, align_mode=0, data_format="NCHW",
+             name=None):
+    return interpolate(x, size, scale_factor, mode, align_corners,
+                       align_mode, data_format)
+
+
+def cosine_similarity(x1, x2, axis=1, eps=1e-8, name=None):
+    def impl(a, b, *, axis, eps):
+        an = jnp.sqrt(jnp.sum(a * a, axis=axis))
+        bn = jnp.sqrt(jnp.sum(b * b, axis=axis))
+        dot = jnp.sum(a * b, axis=axis)
+        return dot / jnp.maximum(an * bn, eps)
+
+    return dispatch("cosine_similarity", impl, (x1, x2),
+                    dict(axis=int(axis), eps=float(eps)))
+
+
+def pixel_shuffle(x, upscale_factor, data_format="NCHW", name=None):
+    def impl(v, *, r, cf):
+        if not cf:
+            v = jnp.transpose(v, (0, 3, 1, 2))
+        n, c, h, w = v.shape
+        v = v.reshape(n, c // (r * r), r, r, h, w)
+        v = jnp.transpose(v, (0, 1, 4, 2, 5, 3))
+        v = v.reshape(n, c // (r * r), h * r, w * r)
+        if not cf:
+            v = jnp.transpose(v, (0, 2, 3, 1))
+        return v
+
+    return dispatch("pixel_shuffle", impl, (x,),
+                    dict(r=int(upscale_factor),
+                         cf=data_format == "NCHW"))
+
+
+def pixel_unshuffle(x, downscale_factor, data_format="NCHW", name=None):
+    def impl(v, *, r, cf):
+        if not cf:
+            v = jnp.transpose(v, (0, 3, 1, 2))
+        n, c, h, w = v.shape
+        v = v.reshape(n, c, h // r, r, w // r, r)
+        v = jnp.transpose(v, (0, 1, 3, 5, 2, 4))
+        v = v.reshape(n, c * r * r, h // r, w // r)
+        if not cf:
+            v = jnp.transpose(v, (0, 2, 3, 1))
+        return v
+
+    return dispatch("pixel_unshuffle", impl, (x,),
+                    dict(r=int(downscale_factor), cf=data_format == "NCHW"))
+
+
+def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
+    def tolist(v, n=2):
+        return [v] * n if isinstance(v, int) else list(v)
+
+    ks, st, dl = tolist(kernel_sizes), tolist(strides), tolist(dilations)
+    pd = tolist(paddings, 4) if not isinstance(paddings, int) else \
+        [paddings] * 4
+    if len(pd) == 2:
+        pd = [pd[0], pd[1], pd[0], pd[1]]
+
+    def impl(v, *, ks, st, pd, dl):
+        n, c, h, w = v.shape
+        v = jnp.pad(v, ((0, 0), (0, 0), (pd[0], pd[2]), (pd[1], pd[3])))
+        patches = jax.lax.conv_general_dilated_patches(
+            v, ks, st, padding="VALID", rhs_dilation=dl,
+            dimension_numbers=("NCHW", "OIHW", "NCHW"))
+        # patches: [N, C*kh*kw, oh, ow]
+        return patches.reshape(n, patches.shape[1], -1)
+
+    return dispatch("unfold", impl, (x,),
+                    dict(ks=tuple(ks), st=tuple(st), pd=tuple(pd),
+                         dl=tuple(dl)))
+
+
+def fold(x, output_sizes, kernel_sizes, strides=1, paddings=0, dilations=1,
+         name=None):
+    def tolist(v, n=2):
+        return [v] * n if isinstance(v, int) else list(v)
+
+    os_, ks = tolist(output_sizes), tolist(kernel_sizes)
+    st, dl = tolist(strides), tolist(dilations)
+    pd = tolist(paddings, 4) if not isinstance(paddings, int) else \
+        [paddings] * 4
+    if len(pd) == 2:
+        pd = [pd[0], pd[1], pd[0], pd[1]]
+
+    def impl(v, *, os_, ks, st, pd, dl):
+        n, ckk, L = v.shape
+        c = ckk // (ks[0] * ks[1])
+        oh = (os_[0] + pd[0] + pd[2] - dl[0] * (ks[0] - 1) - 1) // st[0] + 1
+        ow = (os_[1] + pd[1] + pd[3] - dl[1] * (ks[1] - 1) - 1) // st[1] + 1
+        v = v.reshape(n, c, ks[0], ks[1], oh, ow)
+        out = jnp.zeros((n, c, os_[0] + pd[0] + pd[2],
+                         os_[1] + pd[1] + pd[3]), v.dtype)
+        for i in range(ks[0]):
+            for j in range(ks[1]):
+                hi = i * dl[0]
+                wj = j * dl[1]
+                out = out.at[:, :, hi:hi + oh * st[0]:st[0],
+                             wj:wj + ow * st[1]:st[1]].add(v[:, :, i, j])
+        return out[:, :, pd[0]:out.shape[2] - pd[2],
+                   pd[1]:out.shape[3] - pd[3]]
+
+    return dispatch("fold", impl, (x,),
+                    dict(os_=tuple(os_), ks=tuple(ks), st=tuple(st),
+                         pd=tuple(pd), dl=tuple(dl)))
+
+
+def one_hot(x, num_classes, name=None):
+    num_classes = int(num_classes.item()) if isinstance(num_classes, Tensor) \
+        else int(num_classes)
+    return dispatch(
+        "one_hot_v2",
+        lambda v, *, n: jax.nn.one_hot(v, n, dtype=jnp.float32), (x,),
+        dict(n=num_classes), differentiable=False)
+
+
+def embedding(x, weight, padding_idx=None, sparse=False, name=None):
+    def impl(ids, w, *, padding_idx):
+        out = jnp.take(w, ids, axis=0)
+        if padding_idx is not None:
+            mask = (ids != padding_idx)[..., None]
+            out = jnp.where(mask, out, jnp.zeros((), w.dtype))
+        return out
+
+    return dispatch("embedding", impl, (x, weight),
+                    dict(padding_idx=None if padding_idx is None
+                         else int(padding_idx)))
+
+
+def label_smooth(label, prior_dist=None, epsilon=0.1, name=None):
+    def impl(l, *, eps, n):
+        return (1 - eps) * l + eps / n
+
+    if prior_dist is not None:
+        def impl2(l, pd, *, eps):
+            return (1 - eps) * l + eps * pd
+        return dispatch("label_smooth", impl2, (label, prior_dist),
+                        dict(eps=float(epsilon)))
+    return dispatch("label_smooth", impl, (label,),
+                    dict(eps=float(epsilon), n=label.shape[-1]))
+
+
+def bilinear(x1, x2, weight, bias=None, name=None):
+    def impl(a, b, w, *bb):
+        out = jnp.einsum("bi,oij,bj->bo", a, w, b)
+        if bb:
+            out = out + bb[0]
+        return out
+
+    args = (x1, x2, weight) + ((bias,) if bias is not None else ())
+    return dispatch("bilinear", impl, args, {})
+
+
+def class_center_sample(label, num_classes, num_samples, group=None):
+    # simplified single-process version
+    arr = np.asarray(label._value)
+    pos = np.unique(arr)
+    if len(pos) >= num_samples:
+        sampled = pos[:num_samples]
+    else:
+        rest = np.setdiff1d(np.arange(num_classes), pos)
+        extra = rest[:num_samples - len(pos)]
+        sampled = np.concatenate([pos, extra])
+    sampled.sort()
+    remap = {c: i for i, c in enumerate(sampled.tolist())}
+    remapped = np.vectorize(lambda c: remap.get(c, -1))(arr)
+    return to_tensor(remapped.astype(np.int64)), to_tensor(
+        sampled.astype(np.int64))

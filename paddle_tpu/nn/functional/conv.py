@@ -1,0 +1,178 @@
+"""Convolution functionals via lax.conv_general_dilated (MXU path).
+
+Reference parity: `python/paddle/nn/functional/conv.py` → phi conv kernels /
+cuDNN [UNVERIFIED — empty reference mount].  TPU-native: XLA lowers
+conv_general_dilated straight onto the MXU; no algo autotuning needed
+(cuDNN's role is played by XLA's conv emitter).
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ...core.dispatch import dispatch
+from ...core.tensor import Tensor
+
+__all__ = ["conv1d", "conv2d", "conv3d", "conv1d_transpose",
+           "conv2d_transpose", "conv3d_transpose"]
+
+
+def _norm_stride(v, n):
+    if isinstance(v, int):
+        return (v,) * n
+    return tuple(int(i) for i in v)
+
+
+def _norm_padding(padding, n):
+    """Return ('SAME'|'VALID'|[(lo,hi)...])."""
+    if isinstance(padding, str):
+        return padding.upper()
+    if isinstance(padding, int):
+        return [(padding, padding)] * n
+    padding = list(padding)
+    if len(padding) == n and all(isinstance(p, int) for p in padding):
+        return [(p, p) for p in padding]
+    if len(padding) == 2 * n:
+        return [(padding[2 * i], padding[2 * i + 1]) for i in range(n)]
+    # paddle also allows [[0,0],[0,0],[lo,hi],...] matching data layout
+    if len(padding) == n + 2:
+        return [tuple(p) for p in padding[2:]]
+    return [tuple(p) for p in padding]
+
+
+def _conv(x, weight, bias, stride, padding, dilation, groups, data_format,
+          nsp, op_name):
+    stride = _norm_stride(stride, nsp)
+    dilation = _norm_stride(dilation, nsp)
+    pad = _norm_padding(padding, nsp)
+    cf = data_format.startswith("NC")
+    sp = "DHW"[-nsp:] if nsp > 1 else "W"
+    if cf:
+        lhs_spec = "NC" + sp
+    else:
+        lhs_spec = "N" + sp + "C"
+    rhs_spec = "OI" + sp
+    out_spec = lhs_spec
+
+    def impl(v, w, *b, stride, pad, dilation, groups):
+        out = jax.lax.conv_general_dilated(
+            v, w, window_strides=stride, padding=pad,
+            rhs_dilation=dilation,
+            dimension_numbers=(lhs_spec, rhs_spec, out_spec),
+            feature_group_count=groups,
+            preferred_element_type=jnp.float32
+            if v.dtype in (jnp.bfloat16, jnp.float16) else None,
+        ).astype(v.dtype)
+        if b:
+            bshape = [1] * out.ndim
+            bshape[1 if cf else -1] = b[0].size
+            out = out + b[0].reshape(bshape)
+        return out
+
+    args = (x, weight) + ((bias,) if bias is not None else ())
+    return dispatch(op_name, impl, args,
+                    dict(stride=stride, pad=pad, dilation=dilation,
+                         groups=int(groups)))
+
+
+def conv1d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCL", name=None):
+    df = "NCW" if data_format in ("NCL", "NCW") else "NWC"
+    return _conv(x, weight, bias, stride, padding, dilation, groups, df, 1,
+                 "conv1d")
+
+
+def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCHW", name=None):
+    return _conv(x, weight, bias, stride, padding, dilation, groups,
+                 data_format, 2, "conv2d")
+
+
+def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCDHW", name=None):
+    return _conv(x, weight, bias, stride, padding, dilation, groups,
+                 data_format, 3, "conv3d")
+
+
+def _conv_transpose(x, weight, bias, stride, padding, output_padding,
+                    dilation, groups, data_format, nsp, output_size, op_name):
+    stride = _norm_stride(stride, nsp)
+    dilation = _norm_stride(dilation, nsp)
+    opad = _norm_stride(output_padding or 0, nsp)
+    pad = _norm_padding(padding, nsp)
+    cf = data_format.startswith("NC")
+    sp = "DHW"[-nsp:] if nsp > 1 else "W"
+    lhs_spec = ("NC" + sp) if cf else ("N" + sp + "C")
+    # paddle transpose-conv weight layout: [in, out/groups, *k]
+    rhs_spec = "IO" + sp
+    out_spec = lhs_spec
+
+    def impl(v, w, *b, stride, pad, dilation, groups, opad):
+        k = w.shape[2:]
+        if isinstance(pad, str):
+            pads = pad
+        else:
+            # conv_transpose padding: effective padding = k - 1 - p
+            pads = [
+                (dilation[i] * (k[i] - 1) - pad[i][0],
+                 dilation[i] * (k[i] - 1) - pad[i][1] + opad[i])
+                for i in range(nsp)
+            ]
+        if groups > 1:
+            # split into groups and concat results on channel dim
+            ci = v.shape[1] if cf else v.shape[-1]
+            vparts = jnp.split(v, groups, axis=1 if cf else -1)
+            wparts = jnp.split(w, groups, axis=0)
+            outs = [
+                jax.lax.conv_general_dilated(
+                    vp, jnp.flip(wp, axis=tuple(range(2, wp.ndim))),
+                    window_strides=(1,) * nsp,
+                    padding=pads if not isinstance(pads, str) else pads,
+                    lhs_dilation=stride, rhs_dilation=dilation,
+                    dimension_numbers=(lhs_spec, "IO" + sp, out_spec))
+                for vp, wp in zip(vparts, wparts)
+            ]
+            out = jnp.concatenate(outs, axis=1 if cf else -1)
+        else:
+            out = jax.lax.conv_general_dilated(
+                v, jnp.flip(w, axis=tuple(range(2, w.ndim))),
+                window_strides=(1,) * nsp,
+                padding=pads,
+                lhs_dilation=stride, rhs_dilation=dilation,
+                dimension_numbers=(lhs_spec, rhs_spec, out_spec))
+        if b:
+            bshape = [1] * out.ndim
+            bshape[1 if cf else -1] = b[0].size
+            out = out + b[0].reshape(bshape)
+        return out
+
+    args = (x, weight) + ((bias,) if bias is not None else ())
+    return dispatch(op_name, impl, args,
+                    dict(stride=stride, pad=pad, dilation=dilation,
+                         groups=int(groups), opad=opad))
+
+
+def conv1d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, groups=1, dilation=1,
+                     output_size=None, data_format="NCL", name=None):
+    df = "NCW" if data_format in ("NCL", "NCW") else "NWC"
+    return _conv_transpose(x, weight, bias, stride, padding, output_padding,
+                           dilation, groups, df, 1, output_size,
+                           "conv1d_transpose")
+
+
+def conv2d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, groups=1, dilation=1,
+                     output_size=None, data_format="NCHW", name=None):
+    return _conv_transpose(x, weight, bias, stride, padding, output_padding,
+                           dilation, groups, data_format, 2, output_size,
+                           "conv2d_transpose")
+
+
+def conv3d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, groups=1, dilation=1,
+                     output_size=None, data_format="NCDHW", name=None):
+    return _conv_transpose(x, weight, bias, stride, padding, output_padding,
+                           dilation, groups, data_format, 3, output_size,
+                           "conv3d_transpose")

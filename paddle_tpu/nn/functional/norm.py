@@ -1,0 +1,209 @@
+"""Normalization functionals.
+
+Reference parity: `python/paddle/nn/functional/norm.py` → phi
+layer_norm/batch_norm kernels [UNVERIFIED — empty reference mount].
+TPU-native: these compile to fused XLA reductions; a Pallas fused
+layer_norm/rms_norm for long rows lives in paddle_tpu/ops/pallas_kernels.py
+and is used automatically for large hidden sizes.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...core.dispatch import dispatch
+from ...core.tensor import Tensor
+
+__all__ = ["layer_norm", "batch_norm", "instance_norm", "group_norm",
+           "local_response_norm", "normalize", "rms_norm"]
+
+
+def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-05,
+               name=None):
+    if isinstance(normalized_shape, int):
+        normalized_shape = (normalized_shape,)
+    naxes = len(tuple(normalized_shape))
+
+    def impl(v, *wb, eps, naxes, has_w, has_b):
+        axes = tuple(range(v.ndim - naxes, v.ndim))
+        # accumulate stats in f32 for bf16 inputs (TPU numerics)
+        vf = v.astype(jnp.float32) if v.dtype in (jnp.bfloat16,
+                                                  jnp.float16) else v
+        mean = jnp.mean(vf, axis=axes, keepdims=True)
+        var = jnp.mean(jnp.square(vf - mean), axis=axes, keepdims=True)
+        out = (vf - mean) * jax.lax.rsqrt(var + eps)
+        out = out.astype(v.dtype)
+        i = 0
+        if has_w:
+            out = out * wb[i]
+            i += 1
+        if has_b:
+            out = out + wb[i]
+        return out
+
+    args = (x,) + tuple(t for t in (weight, bias) if t is not None)
+    return dispatch("layer_norm", impl, args,
+                    dict(eps=float(epsilon), naxes=naxes,
+                         has_w=weight is not None, has_b=bias is not None))
+
+
+def rms_norm(x, weight=None, epsilon=1e-6, name=None):
+    def impl(v, *wb, eps):
+        vf = v.astype(jnp.float32) if v.dtype in (jnp.bfloat16,
+                                                  jnp.float16) else v
+        ms = jnp.mean(jnp.square(vf), axis=-1, keepdims=True)
+        out = (vf * jax.lax.rsqrt(ms + eps)).astype(v.dtype)
+        if wb:
+            out = out * wb[0]
+        return out
+
+    args = (x,) + ((weight,) if weight is not None else ())
+    return dispatch("rms_norm", impl, args, dict(eps=float(epsilon)))
+
+
+def batch_norm(x, running_mean, running_var, weight=None, bias=None,
+               training=False, momentum=0.9, epsilon=1e-05,
+               data_format="NCHW", use_global_stats=None, name=None):
+    cf = data_format.startswith("NC")
+    caxis = 1 if (cf and x.ndim > 1) else x.ndim - 1
+    if use_global_stats is None:
+        use_global_stats = not training
+
+    if not use_global_stats:
+        # training path: compute batch stats, update running stats in-place
+        def impl(v, rm, rv, *wb, eps, mom, caxis, has_w, has_b):
+            axes = tuple(i for i in range(v.ndim) if i != caxis)
+            vf = v.astype(jnp.float32) if v.dtype in (
+                jnp.bfloat16, jnp.float16) else v
+            mean = jnp.mean(vf, axis=axes)
+            var = jnp.var(vf, axis=axes)
+            shape = [1] * v.ndim
+            shape[caxis] = v.shape[caxis]
+            out = (vf - mean.reshape(shape)) * jax.lax.rsqrt(
+                var.reshape(shape) + eps)
+            out = out.astype(v.dtype)
+            i = 0
+            if has_w:
+                out = out * wb[i].reshape(shape)
+                i += 1
+            if has_b:
+                out = out + wb[i].reshape(shape)
+            n = 1
+            for a in axes:
+                n *= v.shape[a]
+            unbiased = var * (n / max(n - 1, 1))
+            new_rm = mom * rm + (1 - mom) * mean.astype(rm.dtype)
+            new_rv = mom * rv + (1 - mom) * unbiased.astype(rv.dtype)
+            return out, new_rm, new_rv
+
+        args = (x, running_mean, running_var) + tuple(
+            t for t in (weight, bias) if t is not None)
+        out, new_rm, new_rv = dispatch(
+            "batch_norm", impl, args,
+            dict(eps=float(epsilon), mom=float(momentum), caxis=caxis,
+                 has_w=weight is not None, has_b=bias is not None))
+        running_mean._inplace_update(new_rm._value)
+        running_var._inplace_update(new_rv._value)
+        return out
+
+    def impl_infer(v, rm, rv, *wb, eps, caxis, has_w, has_b):
+        shape = [1] * v.ndim
+        shape[caxis] = v.shape[caxis]
+        out = (v - rm.reshape(shape).astype(v.dtype)) * jax.lax.rsqrt(
+            rv.reshape(shape).astype(v.dtype) + eps)
+        i = 0
+        if has_w:
+            out = out * wb[i].reshape(shape)
+            i += 1
+        if has_b:
+            out = out + wb[i].reshape(shape)
+        return out
+
+    args = (x, running_mean, running_var) + tuple(
+        t for t in (weight, bias) if t is not None)
+    return dispatch("batch_norm_infer", impl_infer, args,
+                    dict(eps=float(epsilon), caxis=caxis,
+                         has_w=weight is not None, has_b=bias is not None))
+
+
+def instance_norm(x, running_mean=None, running_var=None, weight=None,
+                  bias=None, use_input_stats=True, momentum=0.9,
+                  epsilon=1e-05, data_format="NCHW", name=None):
+    def impl(v, *wb, eps, has_w, has_b):
+        axes = tuple(range(2, v.ndim))
+        mean = jnp.mean(v, axis=axes, keepdims=True)
+        var = jnp.var(v, axis=axes, keepdims=True)
+        out = (v - mean) * jax.lax.rsqrt(var + eps)
+        shape = [1, v.shape[1]] + [1] * (v.ndim - 2)
+        i = 0
+        if has_w:
+            out = out * wb[i].reshape(shape)
+            i += 1
+        if has_b:
+            out = out + wb[i].reshape(shape)
+        return out
+
+    args = (x,) + tuple(t for t in (weight, bias) if t is not None)
+    return dispatch("instance_norm", impl, args,
+                    dict(eps=float(epsilon), has_w=weight is not None,
+                         has_b=bias is not None))
+
+
+def group_norm(x, num_groups, epsilon=1e-05, weight=None, bias=None,
+               data_format="NCHW", name=None):
+    cf = data_format.startswith("NC")
+
+    def impl(v, *wb, eps, groups, cf, has_w, has_b):
+        if not cf:
+            v = jnp.moveaxis(v, -1, 1)
+        n, c = v.shape[:2]
+        rest = v.shape[2:]
+        g = v.reshape((n, groups, c // groups) + rest)
+        axes = tuple(range(2, g.ndim))
+        mean = jnp.mean(g, axis=axes, keepdims=True)
+        var = jnp.var(g, axis=axes, keepdims=True)
+        out = ((g - mean) * jax.lax.rsqrt(var + eps)).reshape(v.shape)
+        shape = [1, c] + [1] * (v.ndim - 2)
+        i = 0
+        if has_w:
+            out = out * wb[i].reshape(shape)
+            i += 1
+        if has_b:
+            out = out + wb[i].reshape(shape)
+        if not cf:
+            out = jnp.moveaxis(out, 1, -1)
+        return out
+
+    args = (x,) + tuple(t for t in (weight, bias) if t is not None)
+    return dispatch("group_norm", impl, args,
+                    dict(eps=float(epsilon), groups=int(num_groups), cf=cf,
+                         has_w=weight is not None, has_b=bias is not None))
+
+
+def local_response_norm(x, size, alpha=1e-4, beta=0.75, k=1.0,
+                        data_format="NCHW", name=None):
+    def impl(v, *, size, alpha, beta, k):
+        sq = jnp.square(v)
+        half = size // 2
+        pad_width = [(0, 0)] * v.ndim
+        pad_width[1] = (half, size - 1 - half)
+        padded = jnp.pad(sq, pad_width)
+        acc = jnp.zeros_like(v)
+        for i in range(size):
+            acc = acc + jax.lax.slice_in_dim(
+                padded, i, i + v.shape[1], axis=1)
+        div = jnp.power(k + alpha * acc / size, beta)
+        return v / div
+
+    return dispatch("lrn", impl, (x,),
+                    dict(size=int(size), alpha=float(alpha),
+                         beta=float(beta), k=float(k)))
+
+
+def normalize(x, p=2, axis=1, epsilon=1e-12, name=None):
+    return dispatch(
+        "normalize",
+        lambda v, *, p, axis, eps: v / jnp.maximum(
+            jnp.power(jnp.sum(jnp.power(jnp.abs(v), p), axis=axis,
+                              keepdims=True), 1.0 / p), eps),
+        (x,), dict(p=float(p), axis=int(axis), eps=float(epsilon)))

@@ -1,0 +1,214 @@
+"""Pooling functionals via lax.reduce_window.
+
+Reference parity: `python/paddle/nn/functional/pooling.py` [UNVERIFIED —
+empty reference mount].
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...core.dispatch import dispatch
+
+__all__ = [
+    "avg_pool1d", "avg_pool2d", "avg_pool3d", "max_pool1d", "max_pool2d",
+    "max_pool3d", "adaptive_avg_pool1d", "adaptive_avg_pool2d",
+    "adaptive_avg_pool3d", "adaptive_max_pool1d", "adaptive_max_pool2d",
+    "adaptive_max_pool3d",
+]
+
+
+def _norm(v, n):
+    if isinstance(v, int):
+        return (v,) * n
+    return tuple(int(i) for i in v)
+
+
+def _pool(x, kind, kernel, stride, padding, ceil_mode, exclusive, nsp,
+          data_format, op_name):
+    kernel = _norm(kernel, nsp)
+    stride = _norm(stride if stride is not None else kernel, nsp)
+    if isinstance(padding, str):
+        pad_mode = padding.upper()
+        pads = None
+    else:
+        pads = _norm(padding, nsp) if not isinstance(padding, (list, tuple)) \
+            or all(isinstance(p, int) for p in padding) else padding
+        if isinstance(pads, tuple) and len(pads) == 2 * nsp:
+            pads = [(pads[2 * i], pads[2 * i + 1]) for i in range(nsp)]
+        elif pads is not None:
+            pads = [(p, p) for p in pads]
+        pad_mode = None
+    cf = data_format.startswith("NC")
+
+    def impl(v, *, kernel, stride, pads, pad_mode, kind, exclusive):
+        nd = v.ndim
+        if cf:
+            window = (1, 1) + kernel
+            strides = (1, 1) + stride
+            padding_ = [(0, 0), (0, 0)] + (pads or [(0, 0)] * nsp)
+        else:
+            window = (1,) + kernel + (1,)
+            strides = (1,) + stride + (1,)
+            padding_ = [(0, 0)] + (pads or [(0, 0)] * nsp) + [(0, 0)]
+        if pad_mode == "SAME":
+            padding_ = "SAME"
+        elif pad_mode == "VALID":
+            padding_ = "VALID"
+        if kind == "max":
+            init = -jnp.inf if jnp.issubdtype(v.dtype, jnp.floating) else \
+                jnp.iinfo(v.dtype).min
+            return jax.lax.reduce_window(
+                v, init, jax.lax.max, window, strides, padding_)
+        # avg
+        summed = jax.lax.reduce_window(
+            v, 0.0 if jnp.issubdtype(v.dtype, jnp.floating) else 0,
+            jax.lax.add, window, strides, padding_)
+        if exclusive and padding_ not in ("SAME", "VALID") and \
+                any(p != (0, 0) for p in (pads or [])):
+            ones = jnp.ones_like(v)
+            counts = jax.lax.reduce_window(
+                ones, 0.0, jax.lax.add, window, strides, padding_)
+            return summed / counts
+        denom = 1
+        for k in kernel:
+            denom *= k
+        if padding_ == "SAME":
+            ones = jnp.ones_like(v)
+            counts = jax.lax.reduce_window(
+                ones, 0.0, jax.lax.add, window, strides, padding_)
+            return summed / counts
+        return summed / denom
+
+    return dispatch(op_name, impl, (x,),
+                    dict(kernel=kernel, stride=stride,
+                         pads=None if pads is None else list(pads),
+                         pad_mode=pad_mode, kind=kind,
+                         exclusive=bool(exclusive)))
+
+
+def avg_pool1d(x, kernel_size, stride=None, padding=0, exclusive=True,
+               ceil_mode=False, data_format="NCL", name=None):
+    return _pool(x, "avg", kernel_size, stride, padding, ceil_mode,
+                 exclusive, 1, "NCW", "avg_pool1d")
+
+
+def avg_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, divisor_override=None, data_format="NCHW",
+               name=None):
+    return _pool(x, "avg", kernel_size, stride, padding, ceil_mode,
+                 exclusive, 2, data_format, "avg_pool2d")
+
+
+def avg_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, divisor_override=None, data_format="NCDHW",
+               name=None):
+    return _pool(x, "avg", kernel_size, stride, padding, ceil_mode,
+                 exclusive, 3, data_format, "avg_pool3d")
+
+
+def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCL", name=None):
+    return _pool(x, "max", kernel_size, stride, padding, ceil_mode, True, 1,
+                 "NCW", "max_pool1d")
+
+
+def max_pool2d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCHW", name=None):
+    out = _pool(x, "max", kernel_size, stride, padding, ceil_mode, True, 2,
+                data_format, "max_pool2d")
+    if return_mask:
+        idx = _max_pool_indices(x, kernel_size, stride, padding, data_format)
+        return out, idx
+    return out
+
+
+def max_pool3d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCDHW", name=None):
+    return _pool(x, "max", kernel_size, stride, padding, ceil_mode, True, 3,
+                 data_format, "max_pool3d")
+
+
+def _max_pool_indices(x, kernel, stride, padding, data_format):
+    import numpy as np
+    from ...core.tensor import to_tensor
+
+    k = _norm(kernel, 2)
+    s = _norm(stride if stride is not None else kernel, 2)
+    p = _norm(padding, 2) if not isinstance(padding, str) else (0, 0)
+    arr = np.asarray(x._value)
+    n, c, h, w = arr.shape
+    oh = (h + 2 * p[0] - k[0]) // s[0] + 1
+    ow = (w + 2 * p[1] - k[1]) // s[1] + 1
+    idx = np.zeros((n, c, oh, ow), np.int64)
+    padded = np.pad(arr, ((0, 0), (0, 0), (p[0], p[0]), (p[1], p[1])),
+                    constant_values=-np.inf)
+    for i in range(oh):
+        for j in range(ow):
+            win = padded[:, :, i * s[0]:i * s[0] + k[0],
+                         j * s[1]:j * s[1] + k[1]].reshape(n, c, -1)
+            loc = win.argmax(-1)
+            di, dj = np.unravel_index(loc, k)
+            idx[:, :, i, j] = (i * s[0] + di - p[0]) * w + (
+                j * s[1] + dj - p[1])
+    return to_tensor(idx)
+
+
+def _adaptive(x, out_size, kind, nsp, op_name):
+    out_size = _norm(out_size, nsp)
+
+    def impl(v, *, out_size, kind):
+        # channels-first assumed (paddle default)
+        sp = v.shape[2:]
+        out = v
+        for d in range(nsp):
+            n_in, n_out = sp[d], out_size[d]
+            ax = 2 + d
+            if n_in == n_out:
+                continue
+            if n_in % n_out == 0:
+                k = n_in // n_out
+                new_shape = (out.shape[:ax] + (n_out, k) +
+                             out.shape[ax + 1:])
+                r = out.reshape(new_shape)
+                out = jnp.max(r, axis=ax + 1) if kind == "max" else \
+                    jnp.mean(r, axis=ax + 1)
+            else:
+                # general adaptive: variable windows
+                starts = [(i * n_in) // n_out for i in range(n_out)]
+                ends = [-(-((i + 1) * n_in) // n_out) for i in range(n_out)]
+                segs = []
+                for st, en in zip(starts, ends):
+                    seg = jax.lax.slice_in_dim(out, st, en, axis=ax)
+                    segs.append(jnp.max(seg, axis=ax, keepdims=True)
+                                if kind == "max" else
+                                jnp.mean(seg, axis=ax, keepdims=True))
+                out = jnp.concatenate(segs, axis=ax)
+        return out
+
+    return dispatch(op_name, impl, (x,),
+                    dict(out_size=out_size, kind=kind))
+
+
+def adaptive_avg_pool1d(x, output_size, name=None):
+    return _adaptive(x, output_size, "avg", 1, "adaptive_avg_pool1d")
+
+
+def adaptive_avg_pool2d(x, output_size, data_format="NCHW", name=None):
+    return _adaptive(x, output_size, "avg", 2, "adaptive_avg_pool2d")
+
+
+def adaptive_avg_pool3d(x, output_size, data_format="NCDHW", name=None):
+    return _adaptive(x, output_size, "avg", 3, "adaptive_avg_pool3d")
+
+
+def adaptive_max_pool1d(x, output_size, return_mask=False, name=None):
+    return _adaptive(x, output_size, "max", 1, "adaptive_max_pool1d")
+
+
+def adaptive_max_pool2d(x, output_size, return_mask=False, name=None):
+    return _adaptive(x, output_size, "max", 2, "adaptive_max_pool2d")
+
+
+def adaptive_max_pool3d(x, output_size, return_mask=False, name=None):
+    return _adaptive(x, output_size, "max", 3, "adaptive_max_pool3d")

@@ -1,0 +1,173 @@
+"""Activation + pooling layer classes.
+
+Reference parity: `python/paddle/nn/layer/activation.py`, `pooling.py`
+[UNVERIFIED — empty reference mount].
+"""
+from __future__ import annotations
+
+from .. import functional as F
+from .. import initializer as I
+from .layers import Layer
+
+__all__ = [
+    "ReLU", "ReLU6", "GELU", "Sigmoid", "Silu", "Swish", "Mish", "Hardswish",
+    "Hardsigmoid", "Hardtanh", "LeakyReLU", "ELU", "SELU", "CELU", "PReLU",
+    "RReLU", "Softplus", "Softshrink", "Hardshrink", "Softsign", "Tanhshrink",
+    "LogSigmoid", "LogSoftmax", "Softmax", "Tanh", "GLU", "Maxout",
+    "ThresholdedReLU",
+    "AvgPool1D", "AvgPool2D", "AvgPool3D", "MaxPool1D", "MaxPool2D",
+    "MaxPool3D", "AdaptiveAvgPool1D", "AdaptiveAvgPool2D",
+    "AdaptiveAvgPool3D", "AdaptiveMaxPool1D", "AdaptiveMaxPool2D",
+    "AdaptiveMaxPool3D",
+]
+
+
+def _act_layer(name, fn, *arg_names, **defaults):
+    class _Act(Layer):
+        def __init__(self, *args, **kwargs):
+            super().__init__()
+            self._args = {}
+            for i, an in enumerate(arg_names):
+                if i < len(args):
+                    self._args[an] = args[i]
+                elif an in kwargs:
+                    self._args[an] = kwargs[an]
+                elif an in defaults:
+                    self._args[an] = defaults[an]
+
+        def forward(self, x):
+            return fn(x, **self._args)
+
+    _Act.__name__ = name
+    return _Act
+
+
+ReLU = _act_layer("ReLU", F.relu)
+ReLU6 = _act_layer("ReLU6", F.relu6)
+GELU = _act_layer("GELU", F.gelu, "approximate", approximate=False)
+Sigmoid = _act_layer("Sigmoid", F.sigmoid)
+Silu = _act_layer("Silu", F.silu)
+Swish = _act_layer("Swish", F.swish)
+Mish = _act_layer("Mish", F.mish)
+Hardswish = _act_layer("Hardswish", F.hardswish)
+Hardsigmoid = _act_layer("Hardsigmoid", F.hardsigmoid)
+Hardtanh = _act_layer("Hardtanh", F.hardtanh, "min", "max", min=-1.0,
+                      max=1.0)
+LeakyReLU = _act_layer("LeakyReLU", F.leaky_relu, "negative_slope",
+                       negative_slope=0.01)
+ELU = _act_layer("ELU", F.elu, "alpha", alpha=1.0)
+SELU = _act_layer("SELU", F.selu)
+CELU = _act_layer("CELU", F.celu, "alpha", alpha=1.0)
+Softplus = _act_layer("Softplus", F.softplus, "beta", "threshold", beta=1.0,
+                      threshold=20.0)
+Softshrink = _act_layer("Softshrink", F.softshrink, "threshold",
+                        threshold=0.5)
+Hardshrink = _act_layer("Hardshrink", F.hardshrink, "threshold",
+                        threshold=0.5)
+Softsign = _act_layer("Softsign", F.softsign)
+Tanhshrink = _act_layer("Tanhshrink", F.tanhshrink)
+LogSigmoid = _act_layer("LogSigmoid", F.log_sigmoid)
+LogSoftmax = _act_layer("LogSoftmax", F.log_softmax, "axis", axis=-1)
+Softmax = _act_layer("Softmax", F.softmax, "axis", axis=-1)
+Tanh = _act_layer("Tanh", F.tanh)
+GLU = _act_layer("GLU", F.glu, "axis", axis=-1)
+Maxout = _act_layer("Maxout", F.maxout, "groups", "axis", axis=1)
+ThresholdedReLU = _act_layer("ThresholdedReLU", F.thresholded_relu,
+                             "threshold", "value", threshold=1.0, value=0.0)
+
+
+class RReLU(Layer):
+    def __init__(self, lower=1.0 / 8.0, upper=1.0 / 3.0, name=None):
+        super().__init__()
+        self.lower, self.upper = lower, upper
+
+    def forward(self, x):
+        return F.rrelu(x, self.lower, self.upper, training=self.training)
+
+
+class PReLU(Layer):
+    def __init__(self, num_parameters=1, init=0.25, weight_attr=None,
+                 data_format="NCHW", name=None):
+        super().__init__()
+        self._data_format = data_format
+        self.weight = self.create_parameter(
+            shape=[num_parameters], attr=weight_attr,
+            default_initializer=I.Constant(init))
+
+    def forward(self, x):
+        return F.prelu(x, self.weight, self._data_format)
+
+
+class _PoolNd(Layer):
+    _fn = None
+    _extra = {}
+
+    def __init__(self, kernel_size, stride=None, padding=0, **kwargs):
+        super().__init__()
+        self.kernel_size = kernel_size
+        self.stride = stride
+        self.padding = padding
+        self.kwargs = {k: v for k, v in kwargs.items() if k != "name"}
+
+    def forward(self, x):
+        return type(self)._fn(x, self.kernel_size, self.stride,
+                              self.padding, **self.kwargs)
+
+
+class AvgPool1D(_PoolNd):
+    _fn = staticmethod(F.avg_pool1d)
+
+
+class AvgPool2D(_PoolNd):
+    _fn = staticmethod(F.avg_pool2d)
+
+
+class AvgPool3D(_PoolNd):
+    _fn = staticmethod(F.avg_pool3d)
+
+
+class MaxPool1D(_PoolNd):
+    _fn = staticmethod(F.max_pool1d)
+
+
+class MaxPool2D(_PoolNd):
+    _fn = staticmethod(F.max_pool2d)
+
+
+class MaxPool3D(_PoolNd):
+    _fn = staticmethod(F.max_pool3d)
+
+
+class _AdaptivePoolNd(Layer):
+    _fn = None
+
+    def __init__(self, output_size, **kwargs):
+        super().__init__()
+        self.output_size = output_size
+
+    def forward(self, x):
+        return type(self)._fn(x, self.output_size)
+
+
+class AdaptiveAvgPool1D(_AdaptivePoolNd):
+    _fn = staticmethod(F.adaptive_avg_pool1d)
+
+
+class AdaptiveAvgPool2D(_AdaptivePoolNd):
+    _fn = staticmethod(F.adaptive_avg_pool2d)
+
+
+class AdaptiveAvgPool3D(_AdaptivePoolNd):
+    _fn = staticmethod(F.adaptive_avg_pool3d)
+
+
+class AdaptiveMaxPool1D(_AdaptivePoolNd):
+    _fn = staticmethod(F.adaptive_max_pool1d)
+
+
+class AdaptiveMaxPool2D(_AdaptivePoolNd):
+    _fn = staticmethod(F.adaptive_max_pool2d)
+
+
+class AdaptiveMaxPool3D(_AdaptivePoolNd):
+    _fn = staticmethod(F.adaptive_max_pool3d)

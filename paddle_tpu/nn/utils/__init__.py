@@ -1,0 +1,132 @@
+"""nn.utils: grad clip helpers, weight norm, parameter vector utilities.
+
+Reference parity: `python/paddle/nn/utils/` [UNVERIFIED — empty reference
+mount].
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+__all__ = ["clip_grad_norm_", "clip_grad_value_", "parameters_to_vector",
+           "vector_to_parameters", "weight_norm", "remove_weight_norm",
+           "spectral_norm"]
+
+
+def clip_grad_norm_(parameters, max_norm, norm_type=2.0,
+                    error_if_nonfinite=False):
+    from ...core.tensor import Tensor
+
+    if isinstance(parameters, Tensor):
+        parameters = [parameters]
+    grads = [p.grad for p in parameters if p.grad is not None]
+    if not grads:
+        from ...ops.creation import zeros
+        return zeros([])
+    if norm_type == float("inf"):
+        total = jnp.max(jnp.stack(
+            [jnp.max(jnp.abs(g._value)) for g in grads]))
+    else:
+        total = jnp.power(
+            sum(jnp.sum(jnp.power(jnp.abs(g._value), norm_type))
+                for g in grads), 1.0 / norm_type)
+    clip_coef = jnp.minimum(max_norm / (total + 1e-6), 1.0)
+    for g in grads:
+        g._local_value_update(g._value * clip_coef.astype(g._value.dtype))
+    return Tensor(total, _internal=True)
+
+
+def clip_grad_value_(parameters, clip_value):
+    from ...core.tensor import Tensor
+
+    if isinstance(parameters, Tensor):
+        parameters = [parameters]
+    for p in parameters:
+        if p.grad is not None:
+            p.grad._local_value_update(
+                jnp.clip(p.grad._value, -clip_value, clip_value))
+
+
+def parameters_to_vector(parameters, name=None):
+    from ...ops.manipulation import concat, reshape
+    return concat([reshape(p, [-1]) for p in parameters], 0)
+
+
+def vector_to_parameters(vec, parameters, name=None):
+    offset = 0
+    for p in parameters:
+        n = p.size
+        chunk = vec._value[offset:offset + n].reshape(p._value.shape)
+        p._inplace_update(jnp.asarray(chunk, p._value.dtype))
+        offset += n
+
+
+def weight_norm(layer, name="weight", dim=0):
+    """Functional reparametrization w = g * v/|v| applied at forward time."""
+    import numpy as np
+    from ..layer.layers import Parameter
+
+    w = getattr(layer, name)
+    arr = w._value
+    axes = tuple(i for i in range(arr.ndim) if i != dim)
+    g = jnp.sqrt(jnp.sum(jnp.square(arr), axis=axes, keepdims=False))
+    v = arr
+    layer.add_parameter(name + "_g", Parameter(g, _internal=True))
+    layer.add_parameter(name + "_v", Parameter(v, _internal=True))
+    del layer._parameters[name]
+
+    def hook(l, inputs):
+        from ...core.dispatch import dispatch
+        gp = getattr(l, name + "_g")
+        vp = getattr(l, name + "_v")
+
+        def impl(gv, vv, *, dim):
+            axes = tuple(i for i in range(vv.ndim) if i != dim)
+            norm = jnp.sqrt(jnp.sum(jnp.square(vv), axis=axes,
+                                    keepdims=True))
+            shape = [1] * vv.ndim
+            shape[dim] = gv.size
+            return vv / norm * gv.reshape(shape)
+
+        wt = dispatch("weight_norm", impl, (gp, vp), dict(dim=dim))
+        object.__setattr__(l, name, wt)
+        return None
+
+    layer._wn_hook = layer.register_forward_pre_hook(hook)
+    return layer
+
+
+def remove_weight_norm(layer, name="weight"):
+    from ..layer.layers import Parameter
+
+    g = getattr(layer, name + "_g")
+    v = getattr(layer, name + "_v")
+    axes_dim = 0
+    norm = jnp.sqrt(jnp.sum(jnp.square(v._value),
+                            axis=tuple(i for i in range(v._value.ndim)
+                                       if i != axes_dim), keepdims=True))
+    shape = [1] * v._value.ndim
+    shape[axes_dim] = g._value.size
+    w = v._value / norm * g._value.reshape(shape)
+    if hasattr(layer, "_wn_hook"):
+        layer._wn_hook.remove()
+    del layer._parameters[name + "_g"]
+    del layer._parameters[name + "_v"]
+    layer.add_parameter(name, Parameter(w, _internal=True))
+    return layer
+
+
+def spectral_norm(layer, name="weight", n_power_iterations=1, eps=1e-12,
+                  dim=None):
+    from ..layer.norm import SpectralNorm
+
+    w = getattr(layer, name)
+    sn = SpectralNorm(tuple(w.shape), dim or 0, n_power_iterations, eps)
+    layer.add_sublayer(name + "_sn", sn)
+
+    def hook(l, inputs):
+        wt = sn(l._parameters[name])
+        object.__setattr__(l, name, wt)
+        return None
+
+    layer.register_forward_pre_hook(hook)
+    return layer

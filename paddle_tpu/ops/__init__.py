@@ -1,0 +1,86 @@
+"""Op library + Tensor method attachment.
+
+Reference parity: the generated eager methods (`paddle/fluid/pybind/
+eager_method.cc` + generated `_C_ops` [UNVERIFIED — empty reference mount]).
+Where Paddle code-generates C++ pybind methods from ops.yaml, we attach the
+pure-Python op functions onto Tensor here (ops/ops.yaml documents the
+catalog).
+"""
+from __future__ import annotations
+
+from . import creation, math, manipulation, linalg, reduction, comparison
+from ..core.tensor import Tensor
+
+_METHODS = {}
+
+
+def _collect(mod, names=None):
+    for n in (names or mod.__all__):
+        if hasattr(mod, n):
+            _METHODS[n] = getattr(mod, n)
+
+
+_collect(math)
+_collect(manipulation)
+_collect(linalg)
+_collect(reduction)
+_collect(comparison)
+_collect(creation, ["zeros_like", "ones_like", "full_like", "tril", "triu",
+                    "clone", "uniform_", "normal_", "exponential_"])
+
+# names that clash with python builtins but must exist as methods
+_SKIP_AS_METHOD = {"is_tensor", "to_tensor", "getitem", "setitem"}
+
+for _name, _fn in _METHODS.items():
+    if _name in _SKIP_AS_METHOD:
+        continue
+    if not hasattr(Tensor, _name):
+        setattr(Tensor, _name, _fn)
+
+# ---- operator dunders ----
+
+def _swap(fn):
+    def op(self, other):
+        return fn(other if isinstance(other, Tensor)
+                  else creation.to_tensor(other), self)
+    return op
+
+
+Tensor.__add__ = math.add
+Tensor.__radd__ = lambda self, o: math.add(self, o)
+Tensor.__sub__ = math.subtract
+Tensor.__rsub__ = _swap(math.subtract)
+Tensor.__mul__ = math.multiply
+Tensor.__rmul__ = lambda self, o: math.multiply(self, o)
+Tensor.__truediv__ = math.divide
+Tensor.__rtruediv__ = _swap(math.divide)
+Tensor.__floordiv__ = math.floor_divide
+Tensor.__rfloordiv__ = _swap(math.floor_divide)
+Tensor.__mod__ = math.mod
+Tensor.__rmod__ = _swap(math.mod)
+Tensor.__pow__ = math.pow
+Tensor.__rpow__ = _swap(math.pow)
+Tensor.__matmul__ = linalg.matmul
+Tensor.__rmatmul__ = _swap(linalg.matmul)
+Tensor.__neg__ = math.neg
+Tensor.__abs__ = math.abs
+Tensor.__invert__ = comparison.logical_not
+Tensor.__and__ = comparison.bitwise_and
+Tensor.__or__ = comparison.bitwise_or
+Tensor.__xor__ = comparison.bitwise_xor
+Tensor.__lshift__ = comparison.bitwise_left_shift
+Tensor.__rshift__ = comparison.bitwise_right_shift
+Tensor.__eq__ = comparison.equal
+Tensor.__ne__ = comparison.not_equal
+Tensor.__lt__ = comparison.less_than
+Tensor.__le__ = comparison.less_equal
+Tensor.__gt__ = comparison.greater_than
+Tensor.__ge__ = comparison.greater_equal
+Tensor.__hash__ = lambda self: id(self)
+
+Tensor.mean = reduction.mean
+Tensor.dot = linalg.dot
+Tensor.matmul = linalg.matmul
+Tensor.mm = linalg.mm
+Tensor.norm = linalg.norm
+Tensor.dim = lambda self: self.ndim

@@ -1,0 +1,99 @@
+"""Comparison & logical ops (paddle.tensor.logic parity).
+
+Reference parity: `python/paddle/tensor/logic.py` [UNVERIFIED — empty
+reference mount].
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.dispatch import dispatch
+from ..core.tensor import Tensor, to_tensor
+
+__all__ = [
+    "equal", "not_equal", "greater_than", "greater_equal", "less_than",
+    "less_equal", "equal_all", "allclose", "isclose", "logical_and",
+    "logical_or", "logical_not", "logical_xor", "bitwise_and", "bitwise_or",
+    "bitwise_not", "bitwise_xor", "bitwise_left_shift", "bitwise_right_shift",
+    "is_empty", "is_tensor", "in1d", "isin",
+]
+
+
+def _cmp(name, fn):
+    def op(x, y, name=None):
+        return dispatch(name, fn, (x, y), {}, differentiable=False)
+    op.__name__ = name
+    return op
+
+
+equal = _cmp("equal", jnp.equal)
+not_equal = _cmp("not_equal", jnp.not_equal)
+greater_than = _cmp("greater_than", jnp.greater)
+greater_equal = _cmp("greater_equal", jnp.greater_equal)
+less_than = _cmp("less_than", jnp.less)
+less_equal = _cmp("less_equal", jnp.less_equal)
+logical_and = _cmp("logical_and", jnp.logical_and)
+logical_or = _cmp("logical_or", jnp.logical_or)
+logical_xor = _cmp("logical_xor", jnp.logical_xor)
+bitwise_and = _cmp("bitwise_and", jnp.bitwise_and)
+bitwise_or = _cmp("bitwise_or", jnp.bitwise_or)
+bitwise_xor = _cmp("bitwise_xor", jnp.bitwise_xor)
+bitwise_left_shift = _cmp("bitwise_left_shift", jnp.left_shift)
+bitwise_right_shift = _cmp("bitwise_right_shift", jnp.right_shift)
+
+
+def logical_not(x, name=None):
+    return dispatch("logical_not", jnp.logical_not, (x,), {},
+                    differentiable=False)
+
+
+def bitwise_not(x, name=None):
+    return dispatch("bitwise_not", jnp.bitwise_not, (x,), {},
+                    differentiable=False)
+
+
+def equal_all(x, y, name=None):
+    def impl(a, b):
+        if a.shape != b.shape:
+            return jnp.asarray(False)
+        return jnp.all(a == b)
+
+    return dispatch("equal_all", impl, (x, y), {}, differentiable=False)
+
+
+def allclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
+    return dispatch(
+        "allclose",
+        lambda a, b, *, rtol, atol, equal_nan: jnp.allclose(
+            a, b, rtol=rtol, atol=atol, equal_nan=equal_nan),
+        (x, y), dict(rtol=float(rtol), atol=float(atol),
+                     equal_nan=bool(equal_nan)),
+        differentiable=False)
+
+
+def isclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
+    return dispatch(
+        "isclose",
+        lambda a, b, *, rtol, atol, equal_nan: jnp.isclose(
+            a, b, rtol=rtol, atol=atol, equal_nan=equal_nan),
+        (x, y), dict(rtol=float(rtol), atol=float(atol),
+                     equal_nan=bool(equal_nan)),
+        differentiable=False)
+
+
+def is_empty(x, name=None):
+    return to_tensor(x.size == 0)
+
+
+def is_tensor(x):
+    return isinstance(x, Tensor)
+
+
+def isin(x, test_x, assume_unique=False, invert=False, name=None):
+    return dispatch(
+        "isin",
+        lambda a, b, *, invert: jnp.isin(a, b, invert=invert),
+        (x, test_x), dict(invert=bool(invert)), differentiable=False)
+
+
+in1d = isin

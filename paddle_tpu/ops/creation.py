@@ -1,0 +1,374 @@
+"""Creation ops (paddle.zeros/ones/arange/rand/... parity).
+
+Reference parity: `python/paddle/tensor/creation.py` + `random.py`
+[UNVERIFIED — empty reference mount].  All impls are pure jnp; random ops
+thread the global Generator key (see framework/random.py).
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.dispatch import dispatch
+from ..core.dtypes import convert_dtype, default_dtype, to_jax_dtype
+from ..core.tensor import Tensor, to_tensor
+from ..framework.random import default_generator
+
+__all__ = [
+    "to_tensor", "zeros", "ones", "full", "empty", "zeros_like", "ones_like",
+    "full_like", "empty_like", "arange", "linspace", "logspace", "eye",
+    "rand", "randn", "randint", "randint_like", "uniform", "normal",
+    "standard_normal", "randperm", "bernoulli", "multinomial", "poisson",
+    "tril", "triu", "diag", "diagflat", "diag_embed", "meshgrid", "assign",
+    "clone", "complex", "as_tensor", "uniform_", "normal_", "exponential_",
+]
+
+
+def _shape(shape):
+    if isinstance(shape, Tensor):
+        return tuple(int(s) for s in shape.numpy().tolist())
+    if isinstance(shape, (int, np.integer)):
+        return (int(shape),)
+    return tuple(int(s) if not isinstance(s, Tensor) else int(s.item())
+                 for s in shape)
+
+
+def _jd(dtype, default=None):
+    if dtype is None:
+        return to_jax_dtype(default) if default is not None else to_jax_dtype(
+            default_dtype())
+    return to_jax_dtype(dtype)
+
+
+def zeros(shape, dtype=None, name=None):
+    return dispatch("zeros", lambda *, shape, dtype: jnp.zeros(shape, dtype),
+                    (), dict(shape=_shape(shape), dtype=_jd(dtype)))
+
+
+def ones(shape, dtype=None, name=None):
+    return dispatch("ones", lambda *, shape, dtype: jnp.ones(shape, dtype),
+                    (), dict(shape=_shape(shape), dtype=_jd(dtype)))
+
+
+def full(shape, fill_value, dtype=None, name=None):
+    if isinstance(fill_value, Tensor):
+        fill_value = fill_value.item()
+    if dtype is None:
+        if isinstance(fill_value, bool):
+            dtype = "bool"
+        elif isinstance(fill_value, int):
+            dtype = "int64"
+        else:
+            dtype = default_dtype()
+    return dispatch(
+        "full", lambda *, shape, value, dtype: jnp.full(shape, value, dtype),
+        (), dict(shape=_shape(shape), value=fill_value, dtype=_jd(dtype)))
+
+
+def empty(shape, dtype=None, name=None):
+    return zeros(shape, dtype, name)
+
+
+def zeros_like(x, dtype=None, name=None):
+    return dispatch("zeros_like",
+                    lambda v, *, dtype: jnp.zeros_like(v, dtype), (x,),
+                    dict(dtype=None if dtype is None else to_jax_dtype(dtype)),
+                    differentiable=False)
+
+
+def ones_like(x, dtype=None, name=None):
+    return dispatch("ones_like",
+                    lambda v, *, dtype: jnp.ones_like(v, dtype), (x,),
+                    dict(dtype=None if dtype is None else to_jax_dtype(dtype)),
+                    differentiable=False)
+
+
+def full_like(x, fill_value, dtype=None, name=None):
+    return dispatch(
+        "full_like",
+        lambda v, *, value, dtype: jnp.full_like(v, value, dtype), (x,),
+        dict(value=fill_value,
+             dtype=None if dtype is None else to_jax_dtype(dtype)),
+        differentiable=False)
+
+
+def empty_like(x, dtype=None, name=None):
+    return zeros_like(x, dtype, name)
+
+
+def arange(start=0, end=None, step=1, dtype=None, name=None):
+    for v in (start, end, step):
+        pass
+    start = start.item() if isinstance(start, Tensor) else start
+    end = end.item() if isinstance(end, Tensor) else end
+    step = step.item() if isinstance(step, Tensor) else step
+    if end is None:
+        start, end = 0, start
+    if dtype is None:
+        if all(isinstance(v, (int, np.integer)) for v in (start, end, step)):
+            dtype = "int64"
+        else:
+            dtype = default_dtype()
+    return dispatch(
+        "arange",
+        lambda *, start, end, step, dtype: jnp.arange(start, end, step, dtype),
+        (), dict(start=start, end=end, step=step, dtype=_jd(dtype)))
+
+
+def linspace(start, stop, num, dtype=None, name=None):
+    start = start.item() if isinstance(start, Tensor) else start
+    stop = stop.item() if isinstance(stop, Tensor) else stop
+    num = int(num.item()) if isinstance(num, Tensor) else int(num)
+    return dispatch(
+        "linspace",
+        lambda *, start, stop, num, dtype: jnp.linspace(
+            start, stop, num, dtype=dtype),
+        (), dict(start=start, stop=stop, num=num, dtype=_jd(dtype)))
+
+
+def logspace(start, stop, num, base=10.0, dtype=None, name=None):
+    return dispatch(
+        "logspace",
+        lambda *, start, stop, num, base, dtype: jnp.logspace(
+            start, stop, num, base=base, dtype=dtype),
+        (), dict(start=float(start), stop=float(stop), num=int(num),
+                 base=float(base), dtype=_jd(dtype)))
+
+
+def eye(num_rows, num_columns=None, dtype=None, name=None):
+    return dispatch(
+        "eye", lambda *, n, m, dtype: jnp.eye(n, m, dtype=dtype), (),
+        dict(n=int(num_rows),
+             m=None if num_columns is None else int(num_columns),
+             dtype=_jd(dtype)))
+
+
+# ---------------- random ----------------
+
+def _rng_dispatch(name, sampler, attrs):
+    """Sample with the global generator key as a traced input; advance state."""
+    g = default_generator()
+
+    def impl(key, **at):
+        new, sub = jax.random.split(key)
+        return sampler(sub, **at), new
+
+    out, newk = dispatch(name, impl, (g.state_tensor,), attrs,
+                         differentiable=False)
+    if isinstance(newk, Tensor):
+        g.state_tensor._inplace_update(newk._value)
+    return out
+
+
+def rand(shape, dtype=None, name=None):
+    return _rng_dispatch(
+        "uniform_random",
+        lambda k, *, shape, dtype: jax.random.uniform(k, shape, dtype),
+        dict(shape=_shape(shape), dtype=_jd(dtype)))
+
+
+def randn(shape, dtype=None, name=None):
+    return _rng_dispatch(
+        "gaussian_random",
+        lambda k, *, shape, dtype: jax.random.normal(k, shape, dtype),
+        dict(shape=_shape(shape), dtype=_jd(dtype)))
+
+
+standard_normal = randn
+
+
+def uniform(shape, dtype=None, min=-1.0, max=1.0, seed=0, name=None):
+    return _rng_dispatch(
+        "uniform",
+        lambda k, *, shape, dtype, lo, hi: jax.random.uniform(
+            k, shape, dtype, lo, hi),
+        dict(shape=_shape(shape), dtype=_jd(dtype), lo=float(min),
+             hi=float(max)))
+
+
+def normal(mean=0.0, std=1.0, shape=None, name=None):
+    if isinstance(mean, Tensor) or isinstance(std, Tensor):
+        m = mean if isinstance(mean, Tensor) else to_tensor(float(mean))
+        s = std if isinstance(std, Tensor) else to_tensor(float(std))
+        shp = tuple(np.broadcast_shapes(tuple(m.shape), tuple(s.shape)))
+        z = randn(shp, dtype=m.dtype if m.dtype.is_floating_point() else None)
+        from . import math as _math
+        return _math.add(_math.multiply(z, s), m)
+    return _rng_dispatch(
+        "gaussian",
+        lambda k, *, shape, dtype, mean, std: mean + std * jax.random.normal(
+            k, shape, dtype),
+        dict(shape=_shape(shape if shape is not None else []),
+             dtype=_jd(None), mean=float(mean), std=float(std)))
+
+
+def randint(low=0, high=None, shape=(1,), dtype=None, name=None):
+    if high is None:
+        low, high = 0, low
+    return _rng_dispatch(
+        "randint",
+        lambda k, *, shape, dtype, lo, hi: jax.random.randint(
+            k, shape, lo, hi, dtype),
+        dict(shape=_shape(shape), dtype=_jd(dtype, "int64"), lo=int(low),
+             hi=int(high)))
+
+
+def randint_like(x, low=0, high=None, dtype=None, name=None):
+    return randint(low, high, tuple(x.shape),
+                   dtype or x.dtype)
+
+
+def randperm(n, dtype="int64", name=None):
+    return _rng_dispatch(
+        "randperm",
+        lambda k, *, n, dtype: jax.random.permutation(k, n).astype(dtype),
+        dict(n=int(n), dtype=_jd(dtype, "int64")))
+
+
+def bernoulli(x, name=None):
+    g = default_generator()
+
+    def impl(key, p):
+        new, sub = jax.random.split(key)
+        return jax.random.bernoulli(sub, p).astype(p.dtype), new
+
+    out, newk = dispatch("bernoulli", impl, (g.state_tensor, x), {},
+                         differentiable=False)
+    if isinstance(newk, Tensor):
+        g.state_tensor._inplace_update(newk._value)
+    return out
+
+
+def multinomial(x, num_samples=1, replacement=False, name=None):
+    g = default_generator()
+
+    def impl(key, probs, *, n, repl):
+        new, sub = jax.random.split(key)
+        logits = jnp.log(jnp.maximum(probs, 1e-30))
+        if repl:
+            out = jax.random.categorical(sub, logits, axis=-1,
+                                         shape=probs.shape[:-1] + (n,))
+        else:
+            z = jax.random.gumbel(sub, probs.shape, logits.dtype) + logits
+            _, out = jax.lax.top_k(z, n)
+        return out.astype(jnp.int64), new
+
+    out, newk = dispatch("multinomial", impl, (g.state_tensor, x),
+                         dict(n=int(num_samples), repl=bool(replacement)),
+                         differentiable=False)
+    if isinstance(newk, Tensor):
+        g.state_tensor._inplace_update(newk._value)
+    return out
+
+
+def poisson(x, name=None):
+    g = default_generator()
+
+    def impl(key, lam):
+        new, sub = jax.random.split(key)
+        return jax.random.poisson(sub, lam).astype(lam.dtype), new
+
+    out, newk = dispatch("poisson", impl, (g.state_tensor, x), {},
+                         differentiable=False)
+    if isinstance(newk, Tensor):
+        g.state_tensor._inplace_update(newk._value)
+    return out
+
+
+def uniform_(x, min=-1.0, max=1.0, name=None):
+    y = uniform(tuple(x.shape), x.dtype, min, max)
+    x._inplace_update(y._value)
+    return x
+
+
+def normal_(x, mean=0.0, std=1.0, name=None):
+    y = normal(mean, std, tuple(x.shape))
+    x._inplace_update(jnp.asarray(y._value, x._value.dtype))
+    return x
+
+
+def exponential_(x, lam=1.0, name=None):
+    g = default_generator()
+    key = g.next_key()
+    x._inplace_update(
+        jax.random.exponential(key, x._value.shape, x._value.dtype) / lam)
+    return x
+
+
+# ---------------- structured ----------------
+
+def tril(x, diagonal=0, name=None):
+    return dispatch("tril", lambda v, *, k: jnp.tril(v, k), (x,),
+                    dict(k=int(diagonal)))
+
+
+def triu(x, diagonal=0, name=None):
+    return dispatch("triu", lambda v, *, k: jnp.triu(v, k), (x,),
+                    dict(k=int(diagonal)))
+
+
+def diag(x, offset=0, padding_value=0, name=None):
+    def impl(v, *, k, pad):
+        if v.ndim == 1:
+            out = jnp.diag(v, k)
+            if pad != 0:
+                mask = jnp.eye(out.shape[0], out.shape[1], k, dtype=bool)
+                out = jnp.where(mask, out, jnp.asarray(pad, out.dtype))
+            return out
+        return jnp.diagonal(v, k)
+
+    return dispatch("diag", impl, (x,), dict(k=int(offset),
+                                             pad=padding_value))
+
+
+def diagflat(x, offset=0, name=None):
+    return dispatch("diagflat",
+                    lambda v, *, k: jnp.diagflat(v, k), (x,),
+                    dict(k=int(offset)))
+
+
+def diag_embed(x, offset=0, dim1=-2, dim2=-1, name=None):
+    def impl(v, *, k):
+        n = v.shape[-1] + abs(k)
+        out = jnp.zeros(v.shape[:-1] + (n, n), v.dtype)
+        idx = jnp.arange(v.shape[-1])
+        r = idx + max(-k, 0)
+        c = idx + max(k, 0)
+        return out.at[..., r, c].set(v)
+
+    return dispatch("diag_embed", impl, (x,), dict(k=int(offset)))
+
+
+def meshgrid(*args, **kwargs):
+    if len(args) == 1 and isinstance(args[0], (list, tuple)):
+        args = tuple(args[0])
+    outs = dispatch("meshgrid",
+                    lambda *vs: tuple(jnp.meshgrid(*vs, indexing="ij")),
+                    args, {})
+    return list(outs)
+
+
+def assign(x, output=None):
+    if isinstance(x, Tensor):
+        y = dispatch("assign", lambda v: v + 0 if False else jnp.asarray(v),
+                     (x,), {})
+    else:
+        y = to_tensor(np.asarray(x))
+    if output is not None:
+        output._inplace_update(y._value, y._grad_node, y._out_index)
+        return output
+    return y
+
+
+def clone(x, name=None):
+    return dispatch("clone", lambda v: jnp.asarray(v), (x,), {})
+
+
+def complex(real, imag, name=None):
+    return dispatch("complex", lambda r, i: jax.lax.complex(r, i),
+                    (real, imag), {})
+
+
+def as_tensor(data, dtype=None, place=None):
+    return to_tensor(data, dtype=dtype, place=place)

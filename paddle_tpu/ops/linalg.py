@@ -1,0 +1,356 @@
+"""Linear algebra ops (paddle.tensor.linalg / paddle.linalg parity).
+
+Reference parity: `python/paddle/tensor/linalg.py` → phi matmul/blas kernels
+[UNVERIFIED — empty reference mount].  matmul stays XLA-native: dot_general
+maps directly onto the MXU; bf16 inputs with f32 accumulation is the TPU
+sweet spot (preferred_element_type below).
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.dispatch import dispatch
+from ..core.tensor import Tensor
+
+__all__ = [
+    "matmul", "mm", "bmm", "dot", "mv", "t", "norm", "dist", "cond",
+    "cholesky", "inv", "pinv", "det", "slogdet", "svd", "qr", "eig", "eigh",
+    "eigvals", "eigvalsh", "matrix_power", "matrix_rank", "solve",
+    "triangular_solve", "cholesky_solve", "lstsq", "lu", "multi_dot",
+    "cross", "histogram", "bincount", "einsum", "corrcoef", "cov",
+    "householder_product", "matrix_exp", "vecdot", "vander", "pca_lowrank",
+]
+
+
+def matmul(x, y, transpose_x=False, transpose_y=False, name=None):
+    def impl(a, b, *, tx, ty):
+        if tx:
+            a = jnp.swapaxes(a, -1, -2) if a.ndim > 1 else a
+        if ty:
+            b = jnp.swapaxes(b, -1, -2) if b.ndim > 1 else b
+        return jnp.matmul(a, b)
+
+    return dispatch("matmul_v2", impl, (x, y),
+                    dict(tx=bool(transpose_x), ty=bool(transpose_y)))
+
+
+def mm(input, mat2, name=None):
+    return matmul(input, mat2)
+
+
+def bmm(x, y, name=None):
+    return dispatch("bmm", jnp.matmul, (x, y), {})
+
+
+def dot(x, y, name=None):
+    def impl(a, b):
+        return jnp.sum(a * b, axis=-1)
+
+    return dispatch("dot", impl, (x, y), {})
+
+
+def vecdot(x, y, axis=-1, name=None):
+    return dispatch("vecdot",
+                    lambda a, b, *, axis: jnp.sum(a * b, axis=axis),
+                    (x, y), dict(axis=int(axis)))
+
+
+def mv(x, vec, name=None):
+    return dispatch("mv", jnp.matmul, (x, vec), {})
+
+
+def t(input, name=None):
+    def impl(v):
+        if v.ndim < 2:
+            return v
+        return jnp.swapaxes(v, -1, -2)
+
+    return dispatch("transpose2", impl, (input,), {})
+
+
+def norm(x, p=None, axis=None, keepdim=False, name=None):
+    def impl(v, *, p, axis, keepdim):
+        if p is None:
+            p = 2.0 if axis is None or isinstance(axis, int) or (
+                isinstance(axis, tuple) and len(axis) == 1) else "fro"
+        if p == "fro":
+            return jnp.sqrt(jnp.sum(jnp.square(v), axis=axis,
+                                    keepdims=keepdim))
+        if p == "nuc":
+            s = jnp.linalg.svd(v, compute_uv=False)
+            return jnp.sum(s, axis=-1, keepdims=keepdim)
+        if p == float("inf"):
+            return jnp.max(jnp.abs(v), axis=axis, keepdims=keepdim)
+        if p == float("-inf"):
+            return jnp.min(jnp.abs(v), axis=axis, keepdims=keepdim)
+        if p == 0:
+            return jnp.sum((v != 0).astype(v.dtype), axis=axis,
+                           keepdims=keepdim)
+        return jnp.power(
+            jnp.sum(jnp.power(jnp.abs(v), p), axis=axis, keepdims=keepdim),
+            1.0 / p)
+
+    ax = axis
+    if isinstance(ax, (list, tuple)):
+        ax = tuple(int(a) for a in ax)
+    elif ax is not None:
+        ax = int(ax)
+    return dispatch("p_norm", impl, (x,),
+                    dict(p=p, axis=ax, keepdim=bool(keepdim)))
+
+
+def dist(x, y, p=2, name=None):
+    from . import math as _m
+    return norm(_m.subtract(x, y), p=float(p))
+
+
+def cond(x, p=None, name=None):
+    def impl(v, *, p):
+        if p is None or p == 2:
+            s = jnp.linalg.svd(v, compute_uv=False)
+            return s[..., 0] / s[..., -1]
+        return jnp.linalg.norm(v, ord=p, axis=(-2, -1)) * jnp.linalg.norm(
+            jnp.linalg.inv(v), ord=p, axis=(-2, -1))
+
+    return dispatch("cond", impl, (x,), dict(p=p))
+
+
+def cholesky(x, upper=False, name=None):
+    def impl(v, *, upper):
+        L = jnp.linalg.cholesky(v)
+        return jnp.swapaxes(L, -1, -2) if upper else L
+
+    return dispatch("cholesky", impl, (x,), dict(upper=bool(upper)))
+
+
+def inv(x, name=None):
+    return dispatch("inverse", jnp.linalg.inv, (x,), {})
+
+
+inverse = inv
+
+
+def pinv(x, rcond=1e-15, hermitian=False, name=None):
+    return dispatch("pinv",
+                    lambda v, *, rcond: jnp.linalg.pinv(v, rcond=rcond),
+                    (x,), dict(rcond=float(rcond) if not isinstance(
+                        rcond, Tensor) else float(rcond.item())))
+
+
+def det(x, name=None):
+    return dispatch("determinant", jnp.linalg.det, (x,), {})
+
+
+def slogdet(x, name=None):
+    def impl(v):
+        sign, logdet = jnp.linalg.slogdet(v)
+        return jnp.stack([sign, logdet])
+
+    return dispatch("slogdeterminant", impl, (x,), {})
+
+
+def svd(x, full_matrices=False, name=None):
+    def impl(v, *, fm):
+        return tuple(jnp.linalg.svd(v, full_matrices=fm))
+
+    return dispatch("svd", impl, (x,), dict(fm=bool(full_matrices)))
+
+
+def qr(x, mode="reduced", name=None):
+    def impl(v, *, mode):
+        if mode == "r":
+            return (jnp.linalg.qr(v, mode="r"),)
+        return tuple(jnp.linalg.qr(v, mode=mode))
+
+    out = dispatch("qr", impl, (x,), dict(mode=mode))
+    return out[0] if mode == "r" else out
+
+
+def eig(x, name=None):
+    arr = np.asarray(x._value)
+    w, v = np.linalg.eig(arr)
+    from ..core.tensor import to_tensor
+    return to_tensor(w), to_tensor(v)
+
+
+def eigh(x, UPLO="L", name=None):
+    def impl(v, *, uplo):
+        return tuple(jnp.linalg.eigh(v, symmetrize_input=True))
+
+    return dispatch("eigh", impl, (x,), dict(uplo=UPLO))
+
+
+def eigvals(x, name=None):
+    arr = np.asarray(x._value)
+    from ..core.tensor import to_tensor
+    return to_tensor(np.linalg.eigvals(arr))
+
+
+def eigvalsh(x, UPLO="L", name=None):
+    return dispatch("eigvalsh",
+                    lambda v, *, uplo: jnp.linalg.eigvalsh(v), (x,),
+                    dict(uplo=UPLO))
+
+
+def matrix_power(x, n, name=None):
+    return dispatch("matrix_power",
+                    lambda v, *, n: jnp.linalg.matrix_power(v, n), (x,),
+                    dict(n=int(n)))
+
+
+def matrix_rank(x, tol=None, hermitian=False, name=None):
+    def impl(v, *, tol):
+        return jnp.linalg.matrix_rank(v, tol=tol).astype(jnp.int64)
+
+    t_ = tol.item() if isinstance(tol, Tensor) else tol
+    return dispatch("matrix_rank", impl, (x,), dict(tol=t_),
+                    differentiable=False)
+
+
+def solve(x, y, name=None):
+    def impl(a, b):
+        if b.ndim == a.ndim - 1:
+            return jnp.linalg.solve(a, b[..., None])[..., 0]
+        return jnp.linalg.solve(a, b)
+
+    return dispatch("solve", impl, (x, y), {})
+
+
+def triangular_solve(x, y, upper=True, transpose=False, unitriangular=False,
+                     name=None):
+    def impl(a, b, *, upper, trans, unit):
+        return jax.scipy.linalg.solve_triangular(
+            a, b, lower=not upper, trans=1 if trans else 0,
+            unit_diagonal=unit)
+
+    return dispatch("triangular_solve", impl, (x, y),
+                    dict(upper=bool(upper), trans=bool(transpose),
+                         unit=bool(unitriangular)))
+
+
+def cholesky_solve(x, y, upper=False, name=None):
+    def impl(b, L, *, upper):
+        return jax.scipy.linalg.cho_solve((L, not upper), b)
+
+    return dispatch("cholesky_solve", impl, (x, y), dict(upper=bool(upper)))
+
+
+def lstsq(x, y, rcond=None, driver=None, name=None):
+    def impl(a, b, *, rcond):
+        sol, res, rank, sv = jnp.linalg.lstsq(a, b, rcond=rcond)
+        return sol, res, rank.astype(jnp.int64), sv
+
+    return dispatch("lstsq", impl, (x, y), dict(rcond=rcond))
+
+
+def lu(x, pivot=True, get_infos=False, name=None):
+    def impl(v):
+        lu_, piv = jax.scipy.linalg.lu_factor(v)
+        return lu_, (piv + 1).astype(jnp.int32)
+
+    lu_t, piv = dispatch("lu", impl, (x,), {})
+    if get_infos:
+        from .creation import zeros
+        return lu_t, piv, zeros([1], dtype="int32")
+    return lu_t, piv
+
+
+def multi_dot(tensors, name=None):
+    return dispatch("multi_dot", lambda *vs: jnp.linalg.multi_dot(vs),
+                    tuple(tensors), {})
+
+
+def cross(x, y, axis=9, name=None):
+    def impl(a, b, *, axis):
+        if axis == 9:
+            axis = next(i for i, s in enumerate(a.shape) if s == 3)
+        return jnp.cross(a, b, axis=axis)
+
+    return dispatch("cross", impl, (x, y), dict(axis=int(axis)))
+
+
+def histogram(input, bins=100, min=0, max=0, weight=None, density=False,
+              name=None):
+    arr = np.asarray(input._value)
+    lo, hi = (min, max) if (min != 0 or max != 0) else (arr.min(), arr.max())
+    h, _ = np.histogram(arr, bins=int(bins), range=(float(lo), float(hi)),
+                        weights=None if weight is None else
+                        np.asarray(weight._value), density=density)
+    from ..core.tensor import to_tensor
+    return to_tensor(h if density else h.astype(np.int64))
+
+
+def bincount(x, weights=None, minlength=0, name=None):
+    arr = np.asarray(x._value)
+    out = np.bincount(arr,
+                      None if weights is None else
+                      np.asarray(weights._value),
+                      minlength=int(minlength))
+    from ..core.tensor import to_tensor
+    return to_tensor(out if weights is not None else out.astype(np.int64))
+
+
+def einsum(equation, *operands):
+    ops_ = operands
+    if len(ops_) == 1 and isinstance(ops_[0], (list, tuple)):
+        ops_ = tuple(ops_[0])
+    return dispatch("einsum",
+                    lambda *vs, eq: jnp.einsum(eq, *vs), tuple(ops_),
+                    dict(eq=equation))
+
+
+def corrcoef(x, rowvar=True, name=None):
+    return dispatch("corrcoef",
+                    lambda v, *, rowvar: jnp.corrcoef(v, rowvar=rowvar),
+                    (x,), dict(rowvar=bool(rowvar)))
+
+
+def cov(x, rowvar=True, ddof=True, fweights=None, aweights=None, name=None):
+    return dispatch(
+        "cov",
+        lambda v, *, rowvar, ddof: jnp.cov(v, rowvar=rowvar,
+                                           ddof=1 if ddof else 0),
+        (x,), dict(rowvar=bool(rowvar), ddof=bool(ddof)))
+
+
+def householder_product(x, tau, name=None):
+    def impl(a, t_):
+        m, n = a.shape[-2], a.shape[-1]
+        eye = jnp.eye(m, dtype=a.dtype)
+        q = jnp.broadcast_to(eye, a.shape[:-2] + (m, m)).copy() \
+            if a.ndim > 2 else eye
+        for i in range(t_.shape[-1]):
+            v = a[..., :, i]
+            v = jnp.where(jnp.arange(m) < i, 0.0, v)
+            v = v.at[..., i].set(1.0)
+            H = jnp.eye(m, dtype=a.dtype) - t_[..., i, None, None] * (
+                v[..., :, None] * v[..., None, :])
+            q = q @ H
+        return q[..., :, :n]
+
+    return dispatch("householder_product", impl, (x, tau), {})
+
+
+def matrix_exp(x, name=None):
+    return dispatch("matrix_exp", jax.scipy.linalg.expm, (x,), {})
+
+
+def vander(x, n=None, increasing=False, name=None):
+    return dispatch(
+        "vander",
+        lambda v, *, n, inc: jnp.vander(v, n, increasing=inc), (x,),
+        dict(n=None if n is None else int(n), inc=bool(increasing)))
+
+
+def pca_lowrank(x, q=None, center=True, niter=2, name=None):
+    def impl(v, *, q, center):
+        if center:
+            v = v - v.mean(axis=-2, keepdims=True)
+        u, s, vt = jnp.linalg.svd(v, full_matrices=False)
+        return u[..., :q], s[..., :q], jnp.swapaxes(vt, -1, -2)[..., :q]
+
+    q = q or min(6, x.shape[-2], x.shape[-1])
+    return dispatch("pca_lowrank", impl, (x,),
+                    dict(q=int(q), center=bool(center)))

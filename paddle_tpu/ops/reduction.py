@@ -1,0 +1,270 @@
+"""Reduction ops (paddle sum/mean/max/... parity).
+
+Reference parity: `python/paddle/tensor/math.py` reduce section → phi reduce
+kernels (kps vectorized) [UNVERIFIED — empty reference mount].  XLA's reduce
+codegen replaces the hand-written KPS kernels.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+from ..core.dispatch import dispatch
+from ..core.dtypes import to_jax_dtype
+from ..core.tensor import Tensor
+
+__all__ = [
+    "sum", "mean", "max", "min", "prod", "all", "any", "argmax", "argmin",
+    "amax", "amin", "var", "std", "median", "nanmedian", "mode", "quantile",
+    "nanquantile", "nansum", "nanmean", "count_nonzero", "kthvalue",
+]
+
+
+def _axis(axis):
+    if axis is None:
+        return None
+    if isinstance(axis, Tensor):
+        a = axis.numpy().tolist()
+        return tuple(int(x) for x in a) if isinstance(a, list) else int(a)
+    if isinstance(axis, (list, tuple)):
+        return tuple(int(a) for a in axis)
+    return int(axis)
+
+
+def sum(x, axis=None, dtype=None, keepdim=False, name=None):
+    def impl(v, *, axis, dtype, keepdims):
+        if dtype is None and jnp.issubdtype(v.dtype, jnp.bool_):
+            dtype = jnp.int64
+        return jnp.sum(v, axis=axis, dtype=dtype, keepdims=keepdims)
+
+    return dispatch("reduce_sum", impl, (x,),
+                    dict(axis=_axis(axis),
+                         dtype=None if dtype is None else to_jax_dtype(dtype),
+                         keepdims=bool(keepdim)))
+
+
+def nansum(x, axis=None, dtype=None, keepdim=False, name=None):
+    return dispatch(
+        "nansum",
+        lambda v, *, axis, dtype, keepdims: jnp.nansum(
+            v, axis=axis, dtype=dtype, keepdims=keepdims),
+        (x,), dict(axis=_axis(axis),
+                   dtype=None if dtype is None else to_jax_dtype(dtype),
+                   keepdims=bool(keepdim)))
+
+
+def mean(x, axis=None, keepdim=False, name=None):
+    return dispatch(
+        "reduce_mean",
+        lambda v, *, axis, keepdims: jnp.mean(v, axis=axis,
+                                              keepdims=keepdims),
+        (x,), dict(axis=_axis(axis), keepdims=bool(keepdim)))
+
+
+def nanmean(x, axis=None, keepdim=False, name=None):
+    return dispatch(
+        "nanmean",
+        lambda v, *, axis, keepdims: jnp.nanmean(v, axis=axis,
+                                                 keepdims=keepdims),
+        (x,), dict(axis=_axis(axis), keepdims=bool(keepdim)))
+
+
+def max(x, axis=None, keepdim=False, name=None):
+    return dispatch(
+        "reduce_max",
+        lambda v, *, axis, keepdims: jnp.max(v, axis=axis,
+                                             keepdims=keepdims),
+        (x,), dict(axis=_axis(axis), keepdims=bool(keepdim)))
+
+
+def min(x, axis=None, keepdim=False, name=None):
+    return dispatch(
+        "reduce_min",
+        lambda v, *, axis, keepdims: jnp.min(v, axis=axis,
+                                             keepdims=keepdims),
+        (x,), dict(axis=_axis(axis), keepdims=bool(keepdim)))
+
+
+amax = max
+amin = min
+
+
+def prod(x, axis=None, keepdim=False, dtype=None, name=None):
+    return dispatch(
+        "reduce_prod",
+        lambda v, *, axis, dtype, keepdims: jnp.prod(
+            v, axis=axis, dtype=dtype, keepdims=keepdims),
+        (x,), dict(axis=_axis(axis),
+                   dtype=None if dtype is None else to_jax_dtype(dtype),
+                   keepdims=bool(keepdim)))
+
+
+def all(x, axis=None, keepdim=False, name=None):
+    return dispatch(
+        "reduce_all",
+        lambda v, *, axis, keepdims: jnp.all(v, axis=axis,
+                                             keepdims=keepdims),
+        (x,), dict(axis=_axis(axis), keepdims=bool(keepdim)),
+        differentiable=False)
+
+
+def any(x, axis=None, keepdim=False, name=None):
+    return dispatch(
+        "reduce_any",
+        lambda v, *, axis, keepdims: jnp.any(v, axis=axis,
+                                             keepdims=keepdims),
+        (x,), dict(axis=_axis(axis), keepdims=bool(keepdim)),
+        differentiable=False)
+
+
+def argmax(x, axis=None, keepdim=False, dtype="int64", name=None):
+    def impl(v, *, axis, keepdims, dtype):
+        if axis is None:
+            v = v.reshape(-1)
+            axis = 0
+        return jnp.argmax(v, axis=axis, keepdims=keepdims).astype(dtype)
+
+    return dispatch("arg_max", impl, (x,),
+                    dict(axis=None if axis is None else int(axis),
+                         keepdims=bool(keepdim),
+                         dtype=to_jax_dtype(dtype)),
+                    differentiable=False)
+
+
+def argmin(x, axis=None, keepdim=False, dtype="int64", name=None):
+    def impl(v, *, axis, keepdims, dtype):
+        if axis is None:
+            v = v.reshape(-1)
+            axis = 0
+        return jnp.argmin(v, axis=axis, keepdims=keepdims).astype(dtype)
+
+    return dispatch("arg_min", impl, (x,),
+                    dict(axis=None if axis is None else int(axis),
+                         keepdims=bool(keepdim),
+                         dtype=to_jax_dtype(dtype)),
+                    differentiable=False)
+
+
+def var(x, axis=None, unbiased=True, keepdim=False, name=None):
+    return dispatch(
+        "variance",
+        lambda v, *, axis, ddof, keepdims: jnp.var(
+            v, axis=axis, ddof=ddof, keepdims=keepdims),
+        (x,), dict(axis=_axis(axis), ddof=1 if unbiased else 0,
+                   keepdims=bool(keepdim)))
+
+
+def std(x, axis=None, unbiased=True, keepdim=False, name=None):
+    return dispatch(
+        "std",
+        lambda v, *, axis, ddof, keepdims: jnp.std(
+            v, axis=axis, ddof=ddof, keepdims=keepdims),
+        (x,), dict(axis=_axis(axis), ddof=1 if unbiased else 0,
+                   keepdims=bool(keepdim)))
+
+
+def median(x, axis=None, keepdim=False, mode="avg", name=None):
+    def impl(v, *, axis, keepdims, mode):
+        if mode == "avg":
+            return jnp.median(v, axis=axis, keepdims=keepdims)
+        # 'min' mode: lower of the two middle values + its index
+        if axis is None:
+            vf = v.reshape(-1)
+            ax = 0
+        else:
+            vf, ax = v, axis
+        n = vf.shape[ax]
+        k = (n - 1) // 2
+        srt = jnp.sort(vf, axis=ax)
+        val = jnp.take(srt, k, axis=ax)
+        if keepdims:
+            val = jnp.expand_dims(val, ax if axis is not None else ())
+        return val
+
+    return dispatch("median", impl, (x,),
+                    dict(axis=None if axis is None else int(axis),
+                         keepdims=bool(keepdim), mode=mode))
+
+
+def nanmedian(x, axis=None, keepdim=False, mode="avg", name=None):
+    return dispatch(
+        "nanmedian",
+        lambda v, *, axis, keepdims: jnp.nanmedian(v, axis=axis,
+                                                   keepdims=keepdims),
+        (x,), dict(axis=_axis(axis), keepdims=bool(keepdim)))
+
+
+def mode(x, axis=-1, keepdim=False, name=None):
+    arr = np.asarray(x._value)
+    ax = int(axis) % arr.ndim
+    srt = np.sort(arr, axis=ax)
+    idx = np.argsort(arr, axis=ax, kind="stable")
+    # count runs; pick most frequent (last occurrence like paddle)
+    from scipy import stats as _stats  # scipy available with numpy stack
+    m = _stats.mode(arr, axis=ax, keepdims=True)
+    vals = m.mode
+    # find last index where value occurs
+    eq = arr == vals
+    ar = np.arange(arr.shape[ax]).reshape(
+        tuple(arr.shape[ax] if i == ax else 1 for i in range(arr.ndim)))
+    indices = np.where(eq, ar, -1).max(axis=ax, keepdims=True)
+    if not keepdim:
+        vals = np.squeeze(vals, ax)
+        indices = np.squeeze(indices, ax)
+    from ..core.tensor import to_tensor
+    return to_tensor(vals), to_tensor(indices.astype(np.int64))
+
+
+def kthvalue(x, k, axis=-1, keepdim=False, name=None):
+    def impl(v, *, k, axis, keepdims):
+        srt = jnp.sort(v, axis=axis)
+        idxs = jnp.argsort(v, axis=axis, stable=True)
+        val = jnp.take(srt, k - 1, axis=axis)
+        idx = jnp.take(idxs, k - 1, axis=axis)
+        if keepdims:
+            val = jnp.expand_dims(val, axis)
+            idx = jnp.expand_dims(idx, axis)
+        return val, idx.astype(jnp.int64)
+
+    return dispatch("kthvalue", impl, (x,),
+                    dict(k=int(k), axis=int(axis), keepdims=bool(keepdim)))
+
+
+def quantile(x, q, axis=None, keepdim=False, interpolation="linear",
+             name=None):
+    qv = q if not isinstance(q, Tensor) else q.numpy()
+
+    def impl(v, *, q, axis, keepdims, method):
+        out = jnp.quantile(v.astype(jnp.float64) if v.dtype == jnp.float64
+                           else v.astype(jnp.float32),
+                           jnp.asarray(q), axis=axis, keepdims=keepdims,
+                           method=method)
+        return out
+
+    ax = _axis(axis)
+    if isinstance(ax, tuple) and len(ax) == 1:
+        ax = ax[0]
+    return dispatch("quantile", impl, (x,),
+                    dict(q=qv, axis=ax, keepdims=bool(keepdim),
+                         method=interpolation))
+
+
+def nanquantile(x, q, axis=None, keepdim=False, interpolation="linear",
+                name=None):
+    qv = q if not isinstance(q, Tensor) else q.numpy()
+    return dispatch(
+        "nanquantile",
+        lambda v, *, q, axis, keepdims, method: jnp.nanquantile(
+            v.astype(jnp.float32), jnp.asarray(q), axis=axis,
+            keepdims=keepdims, method=method),
+        (x,), dict(q=qv, axis=_axis(axis), keepdims=bool(keepdim),
+                   method=interpolation))
+
+
+def count_nonzero(x, axis=None, keepdim=False, name=None):
+    return dispatch(
+        "count_nonzero",
+        lambda v, *, axis, keepdims: jnp.count_nonzero(
+            v, axis=axis, keepdims=keepdims).astype(jnp.int64),
+        (x,), dict(axis=_axis(axis), keepdims=bool(keepdim)),
+        differentiable=False)

@@ -1,0 +1,4 @@
+"""paddle.optimizer parity surface."""
+from .optimizer import (Optimizer, SGD, Momentum, Adam, AdamW, Adamax,
+                        Adagrad, Adadelta, RMSProp, Lamb)
+from . import lr

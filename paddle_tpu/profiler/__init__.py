@@ -1,0 +1,212 @@
+"""paddle.profiler over jax.profiler.
+
+Reference parity: `python/paddle/profiler/` (Profiler with CLOSED→WARMUP→
+RECORD scheduler, RecordEvent spans, chrome-trace export;
+`fluid/platform/profiler/` host+CUPTI tracers) [UNVERIFIED — empty
+reference mount].  TPU-native: jax.profiler captures XLA/TPU timelines
+(XPlane → TensorBoard/perfetto); RecordEvent maps to TraceAnnotation.
+"""
+from __future__ import annotations
+
+import contextlib
+import os
+import time
+from enum import Enum
+
+import jax
+
+__all__ = ["Profiler", "RecordEvent", "ProfilerTarget", "ProfilerState",
+           "make_scheduler", "export_chrome_tracing", "load_profiler_result",
+           "SortedKeys", "SummaryView"]
+
+
+class ProfilerTarget(Enum):
+    CPU = 0
+    GPU = 1
+    TPU = 2
+    CUSTOM_DEVICE = 3
+
+
+class ProfilerState(Enum):
+    CLOSED = 0
+    READY = 1
+    RECORD = 2
+    RECORD_AND_RETURN = 3
+
+
+class SortedKeys(Enum):
+    CPUTotal = 0
+    CPUAvg = 1
+    CPUMax = 2
+    GPUTotal = 3
+    GPUAvg = 4
+
+
+class SummaryView(Enum):
+    OverView = 0
+    OpView = 1
+    KernelView = 2
+
+
+def make_scheduler(closed=0, ready=0, record=1, repeat=0, skip_first=0):
+    def scheduler(step):
+        s = step - skip_first
+        if s < 0:
+            return ProfilerState.CLOSED
+        total = closed + ready + record
+        pos = s % total if total else 0
+        if pos < closed:
+            return ProfilerState.CLOSED
+        if pos < closed + ready:
+            return ProfilerState.READY
+        if pos == total - 1:
+            return ProfilerState.RECORD_AND_RETURN
+        return ProfilerState.RECORD
+
+    return scheduler
+
+
+def export_chrome_tracing(dir_name, worker_name=None):
+    def handler(prof):
+        prof._log_dir = dir_name
+
+    return handler
+
+
+class _HostEvent:
+    __slots__ = ("name", "start", "end")
+
+    def __init__(self, name, start, end):
+        self.name, self.start, self.end = name, start, end
+
+
+_host_events = []
+
+
+class RecordEvent:
+    """Host-side span + XLA TraceAnnotation (shows in the TPU timeline)."""
+
+    def __init__(self, name, event_type=None):
+        self.name = name
+        self._ann = None
+        self._t0 = None
+
+    def begin(self):
+        self._t0 = time.perf_counter()
+        try:
+            self._ann = jax.profiler.TraceAnnotation(self.name)
+            self._ann.__enter__()
+        except Exception:
+            self._ann = None
+
+    def end(self):
+        if self._ann is not None:
+            self._ann.__exit__(None, None, None)
+        if self._t0 is not None:
+            _host_events.append(
+                _HostEvent(self.name, self._t0, time.perf_counter()))
+
+    def __enter__(self):
+        self.begin()
+        return self
+
+    def __exit__(self, *exc):
+        self.end()
+        return False
+
+
+class Profiler:
+    def __init__(self, targets=None, scheduler=None, on_trace_ready=None,
+                 timer_only=False, record_shapes=False, profile_memory=False,
+                 with_flops=False, **kwargs):
+        self._scheduler = scheduler if callable(scheduler) else (
+            make_scheduler(record=scheduler[1] - scheduler[0],
+                           skip_first=scheduler[0])
+            if isinstance(scheduler, (tuple, list)) else
+            (lambda step: ProfilerState.RECORD))
+        self._on_trace_ready = on_trace_ready
+        self._step = 0
+        self._active = False
+        self._log_dir = os.environ.get("PADDLE_TPU_PROFILE_DIR",
+                                       "/tmp/paddle_tpu_profile")
+        self._timer_only = timer_only
+        self._step_times = []
+        self._last_step_t = None
+
+    def start(self):
+        self._last_step_t = time.perf_counter()
+        self._maybe_toggle()
+
+    def stop(self):
+        if self._active:
+            try:
+                jax.profiler.stop_trace()
+            except Exception:
+                pass
+            self._active = False
+        if self._on_trace_ready:
+            self._on_trace_ready(self)
+
+    def step(self, num_samples=None):
+        now = time.perf_counter()
+        if self._last_step_t is not None:
+            self._step_times.append(now - self._last_step_t)
+        self._last_step_t = now
+        self._step += 1
+        self._maybe_toggle()
+
+    def step_info(self, unit=None):
+        if not self._step_times:
+            return ""
+        import numpy as np
+        arr = np.asarray(self._step_times[-10:])
+        return (f"avg step time {arr.mean() * 1000:.2f} ms "
+                f"(min {arr.min() * 1000:.2f}, max {arr.max() * 1000:.2f})")
+
+    def _maybe_toggle(self):
+        if self._timer_only:
+            return
+        state = self._scheduler(self._step)
+        should_record = state in (ProfilerState.RECORD,
+                                  ProfilerState.RECORD_AND_RETURN)
+        if should_record and not self._active:
+            try:
+                jax.profiler.start_trace(self._log_dir)
+                self._active = True
+            except Exception:
+                pass
+        elif not should_record and self._active:
+            try:
+                jax.profiler.stop_trace()
+            except Exception:
+                pass
+            self._active = False
+
+    def summary(self, sorted_by=None, op_detail=True, thread_sep=False,
+                time_unit="ms", views=None):
+        from collections import defaultdict
+        agg = defaultdict(lambda: [0.0, 0])
+        for e in _host_events:
+            agg[e.name][0] += (e.end - e.start) * 1000
+            agg[e.name][1] += 1
+        lines = [f"{'Name':<40}{'Calls':<8}{'Total(ms)':<12}"]
+        for name, (tot, n) in sorted(agg.items(), key=lambda kv: -kv[1][0]):
+            lines.append(f"{name:<40}{n:<8}{tot:<12.3f}")
+        out = "\n".join(lines)
+        print(out)
+        return out
+
+    def export(self, path=None, format="json"):
+        pass
+
+    def __enter__(self):
+        self.start()
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False
+
+
+def load_profiler_result(filename):
+    return None

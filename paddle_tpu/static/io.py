@@ -1,0 +1,64 @@
+"""save/load_inference_model.
+
+Reference parity: `python/paddle/static/io.py` [UNVERIFIED — empty
+reference mount].  An "inference model" here is the jitted callable's
+state: parameter arrays + a descriptor.  For dygraph Layers, paddle.jit.save
+covers the same role (jit/api.py).
+"""
+from __future__ import annotations
+
+import os
+import pickle
+
+import numpy as np
+
+from ..core.tensor import Tensor
+
+__all__ = ["save_inference_model", "load_inference_model", "save", "load"]
+
+
+def save_inference_model(path_prefix, feed_vars, fetch_vars, executor,
+                         program=None, **kwargs):
+    from .framework import default_main_program
+
+    program = program or default_main_program()
+    params = {}
+    for i, p in enumerate(program.all_parameters()):
+        arr = np.asarray(p._value)
+        params[p.name or f"param_{i}"] = arr
+    meta = {
+        "feed_names": [v.name for v in feed_vars],
+        "fetch_names": [v.name for v in fetch_vars],
+    }
+    os.makedirs(os.path.dirname(path_prefix) or ".", exist_ok=True)
+    with open(path_prefix + ".pdmodel", "wb") as f:
+        pickle.dump(meta, f)
+    with open(path_prefix + ".pdiparams", "wb") as f:
+        pickle.dump(params, f)
+
+
+def load_inference_model(path_prefix, executor, **kwargs):
+    with open(path_prefix + ".pdmodel", "rb") as f:
+        meta = pickle.load(f)
+    with open(path_prefix + ".pdiparams", "rb") as f:
+        params = pickle.load(f)
+    return [meta, meta["feed_names"], meta["fetch_names"], params]
+
+
+def save(program, model_path, **kwargs):
+    params = {}
+    for i, p in enumerate(program.all_parameters()):
+        params[p.name or f"param_{i}"] = np.asarray(p._value)
+    with open(model_path + ".pdparams", "wb") as f:
+        pickle.dump(params, f)
+
+
+def load(program, model_path, executor=None, var_list=None):
+    import jax.numpy as jnp
+
+    with open(model_path + ".pdparams", "rb") as f:
+        params = pickle.load(f)
+    for p in program.all_parameters():
+        if p.name in params:
+            p._inplace_update(jnp.asarray(params[p.name],
+                                          p._value.dtype))

@@ -1,0 +1,84 @@
+"""paddle.static.nn: static-graph layer functions.
+
+Reference parity: `python/paddle/static/nn/` [UNVERIFIED — empty reference
+mount].  These reuse the dygraph layers (dispatch routes to the Program
+when inputs are Variables), so fc/conv2d etc. are thin wrappers.
+"""
+from __future__ import annotations
+
+from ...nn import functional as F
+from ...nn.layer.layers import create_parameter
+from ...nn import initializer as I
+
+__all__ = ["fc", "conv2d", "batch_norm", "embedding"]
+
+
+def fc(x, size, num_flatten_dims=1, weight_attr=None, bias_attr=None,
+       activation=None, name=None):
+    from ...ops.manipulation import flatten
+
+    if num_flatten_dims > 1 or x.ndim > 2:
+        x = flatten(x, num_flatten_dims, -1) if x.ndim > num_flatten_dims \
+            else x
+    in_dim = x.shape[-1]
+    w = create_parameter([in_dim, size], x.dtype, attr=weight_attr,
+                         default_initializer=I.XavierNormal())
+    b = create_parameter([size], x.dtype, attr=bias_attr, is_bias=True,
+                         default_initializer=I.Constant(0.0))
+    out = F.linear(x, w, b)
+    if activation == "relu":
+        out = F.relu(out)
+    elif activation == "softmax":
+        out = F.softmax(out)
+    elif activation == "tanh":
+        out = F.tanh(out)
+    return out
+
+
+def conv2d(input, num_filters, filter_size, stride=1, padding=0, dilation=1,
+           groups=1, param_attr=None, bias_attr=None, act=None,
+           data_format="NCHW", name=None):
+    import numpy as np
+
+    in_c = input.shape[1] if data_format == "NCHW" else input.shape[-1]
+    ks = [filter_size] * 2 if isinstance(filter_size, int) else \
+        list(filter_size)
+    w = create_parameter([num_filters, in_c // groups] + ks, input.dtype,
+                         attr=param_attr,
+                         default_initializer=I.XavierNormal())
+    b = None
+    if bias_attr is not False:
+        b = create_parameter([num_filters], input.dtype, attr=bias_attr,
+                             is_bias=True,
+                             default_initializer=I.Constant(0.0))
+    out = F.conv2d(input, w, b, stride, padding, dilation, groups,
+                   data_format)
+    if act == "relu":
+        out = F.relu(out)
+    return out
+
+
+def batch_norm(input, act=None, momentum=0.9, epsilon=1e-05,
+               param_attr=None, bias_attr=None, data_layout="NCHW",
+               is_test=False, **kwargs):
+    from ...ops.creation import zeros, ones
+
+    c = input.shape[1] if data_layout == "NCHW" else input.shape[-1]
+    w = create_parameter([c], input.dtype, attr=param_attr,
+                         default_initializer=I.Constant(1.0))
+    b = create_parameter([c], input.dtype, attr=bias_attr, is_bias=True,
+                         default_initializer=I.Constant(0.0))
+    rm, rv = zeros([c]), ones([c])
+    out = F.batch_norm(input, rm, rv, w, b, training=not is_test,
+                       momentum=momentum, epsilon=epsilon,
+                       data_format=data_layout)
+    if act == "relu":
+        out = F.relu(out)
+    return out
+
+
+def embedding(input, size, is_sparse=False, padding_idx=None,
+              param_attr=None, dtype="float32"):
+    w = create_parameter(list(size), dtype, attr=param_attr,
+                         default_initializer=I.Normal(0.0, 1.0))
+    return F.embedding(input, w, padding_idx)

@@ -1,0 +1,15 @@
+"""paddle.vision parity surface."""
+from . import models
+from . import datasets
+from . import transforms
+
+from .models import LeNet, ResNet, resnet18, resnet34, resnet50, resnet101, \
+    resnet152
+
+
+def set_image_backend(backend):
+    pass
+
+
+def get_image_backend():
+    return "numpy"

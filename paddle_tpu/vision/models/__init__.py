@@ -1,0 +1,11 @@
+"""paddle.vision.models parity: LeNet + ResNet family (+ VGG/MobileNet).
+
+Reference parity: `python/paddle/vision/models/` [UNVERIFIED — empty
+reference mount].
+"""
+from .lenet import LeNet
+from .resnet import (ResNet, resnet18, resnet34, resnet50, resnet101,
+                     resnet152, BasicBlock, BottleneckBlock, wide_resnet50_2,
+                     wide_resnet101_2, resnext50_32x4d)
+from .vgg import VGG, vgg11, vgg13, vgg16, vgg19
+from .mobilenet import MobileNetV2, mobilenet_v2

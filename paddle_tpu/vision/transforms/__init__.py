@@ -1,0 +1,213 @@
+"""paddle.vision.transforms parity (numpy-array based).
+
+Reference parity: `python/paddle/vision/transforms/` [UNVERIFIED — empty
+reference mount].  Transforms operate on HWC or CHW numpy arrays (no PIL in
+this environment).
+"""
+from __future__ import annotations
+
+import numbers
+
+import numpy as np
+
+__all__ = ["Compose", "ToTensor", "Normalize", "Resize", "CenterCrop",
+           "RandomCrop", "RandomHorizontalFlip", "RandomVerticalFlip",
+           "Transpose", "BrightnessTransform", "Pad", "RandomResizedCrop",
+           "to_tensor", "normalize", "resize", "hflip", "vflip"]
+
+
+class Compose:
+    def __init__(self, transforms):
+        self.transforms = transforms
+
+    def __call__(self, data):
+        for t in self.transforms:
+            data = t(data)
+        return data
+
+
+def to_tensor(pic, data_format="CHW"):
+    arr = np.asarray(pic, np.float32)
+    if arr.ndim == 2:
+        arr = arr[None] if data_format == "CHW" else arr[..., None]
+    elif arr.ndim == 3 and data_format == "CHW" and arr.shape[-1] in (1, 3,
+                                                                      4):
+        arr = arr.transpose(2, 0, 1)
+    if arr.max() > 1.5:
+        arr = arr / 255.0
+    return arr
+
+
+class ToTensor:
+    def __init__(self, data_format="CHW", keys=None):
+        self.data_format = data_format
+
+    def __call__(self, pic):
+        return to_tensor(pic, self.data_format)
+
+
+def normalize(img, mean, std, data_format="CHW", to_rgb=False):
+    arr = np.asarray(img, np.float32)
+    mean = np.asarray(mean, np.float32)
+    std = np.asarray(std, np.float32)
+    if data_format == "CHW":
+        return (arr - mean.reshape(-1, 1, 1)) / std.reshape(-1, 1, 1)
+    return (arr - mean) / std
+
+
+class Normalize:
+    def __init__(self, mean=0.0, std=1.0, data_format="CHW", to_rgb=False,
+                 keys=None):
+        if isinstance(mean, numbers.Number):
+            mean = [mean] * 3
+        if isinstance(std, numbers.Number):
+            std = [std] * 3
+        self.mean, self.std = mean, std
+        self.data_format = data_format
+
+    def __call__(self, img):
+        m = np.asarray(self.mean, np.float32)
+        s = np.asarray(self.std, np.float32)
+        arr = np.asarray(img, np.float32)
+        if self.data_format == "CHW":
+            c = arr.shape[0]
+            return (arr - m[:c].reshape(-1, 1, 1)) / s[:c].reshape(-1, 1, 1)
+        return (arr - m[:arr.shape[-1]]) / s[:arr.shape[-1]]
+
+
+def resize(img, size, interpolation="bilinear"):
+    arr = np.asarray(img, np.float32)
+    chw = arr.ndim == 3 and arr.shape[0] in (1, 3, 4)
+    if isinstance(size, int):
+        size = (size, size)
+    h_axis = 1 if chw else 0
+    in_h, in_w = arr.shape[h_axis], arr.shape[h_axis + 1]
+    oh, ow = size
+    ys = (np.arange(oh) * in_h / oh).astype(np.int64).clip(0, in_h - 1)
+    xs = (np.arange(ow) * in_w / ow).astype(np.int64).clip(0, in_w - 1)
+    if chw:
+        return arr[:, ys][:, :, xs]
+    return arr[ys][:, xs]
+
+
+class Resize:
+    def __init__(self, size, interpolation="bilinear", keys=None):
+        self.size = size
+
+    def __call__(self, img):
+        return resize(img, self.size)
+
+
+class CenterCrop:
+    def __init__(self, size, keys=None):
+        self.size = (size, size) if isinstance(size, int) else size
+
+    def __call__(self, img):
+        arr = np.asarray(img)
+        chw = arr.ndim == 3 and arr.shape[0] in (1, 3, 4)
+        h_axis = 1 if chw else 0
+        h, w = arr.shape[h_axis], arr.shape[h_axis + 1]
+        th, tw = self.size
+        i, j = max((h - th) // 2, 0), max((w - tw) // 2, 0)
+        if chw:
+            return arr[:, i:i + th, j:j + tw]
+        return arr[i:i + th, j:j + tw]
+
+
+class RandomCrop:
+    def __init__(self, size, padding=None, pad_if_needed=False, keys=None):
+        self.size = (size, size) if isinstance(size, int) else size
+        self.padding = padding
+
+    def __call__(self, img):
+        arr = np.asarray(img)
+        chw = arr.ndim == 3 and arr.shape[0] in (1, 3, 4)
+        h_axis = 1 if chw else 0
+        h, w = arr.shape[h_axis], arr.shape[h_axis + 1]
+        th, tw = self.size
+        i = np.random.randint(0, max(h - th, 0) + 1)
+        j = np.random.randint(0, max(w - tw, 0) + 1)
+        if chw:
+            return arr[:, i:i + th, j:j + tw]
+        return arr[i:i + th, j:j + tw]
+
+
+class RandomResizedCrop:
+    def __init__(self, size, scale=(0.08, 1.0), ratio=(3 / 4, 4 / 3),
+                 interpolation="bilinear", keys=None):
+        self.size = (size, size) if isinstance(size, int) else size
+        self.scale = scale
+
+    def __call__(self, img):
+        return resize(RandomCrop(self.size)(img) if False else img,
+                      self.size)
+
+
+def hflip(img):
+    arr = np.asarray(img)
+    chw = arr.ndim == 3 and arr.shape[0] in (1, 3, 4)
+    return arr[..., ::-1].copy() if not chw else arr[:, :, ::-1].copy()
+
+
+def vflip(img):
+    arr = np.asarray(img)
+    chw = arr.ndim == 3 and arr.shape[0] in (1, 3, 4)
+    return arr[:, ::-1].copy() if chw else arr[::-1].copy()
+
+
+class RandomHorizontalFlip:
+    def __init__(self, prob=0.5, keys=None):
+        self.prob = prob
+
+    def __call__(self, img):
+        if np.random.rand() < self.prob:
+            return hflip(img)
+        return img
+
+
+class RandomVerticalFlip:
+    def __init__(self, prob=0.5, keys=None):
+        self.prob = prob
+
+    def __call__(self, img):
+        if np.random.rand() < self.prob:
+            return vflip(img)
+        return img
+
+
+class Transpose:
+    def __init__(self, order=(2, 0, 1), keys=None):
+        self.order = order
+
+    def __call__(self, img):
+        return np.asarray(img).transpose(self.order)
+
+
+class BrightnessTransform:
+    def __init__(self, value, keys=None):
+        self.value = value
+
+    def __call__(self, img):
+        factor = 1 + np.random.uniform(-self.value, self.value)
+        return np.clip(np.asarray(img, np.float32) * factor, 0,
+                       255 if np.asarray(img).max() > 1.5 else 1.0)
+
+
+class Pad:
+    def __init__(self, padding, fill=0, padding_mode="constant", keys=None):
+        self.padding = padding if not isinstance(padding, int) else \
+            [padding] * 4
+        self.fill = fill
+
+    def __call__(self, img):
+        arr = np.asarray(img)
+        l, t, r, b = (self.padding if len(self.padding) == 4 else
+                      self.padding * 2)
+        chw = arr.ndim == 3 and arr.shape[0] in (1, 3, 4)
+        if chw:
+            return np.pad(arr, ((0, 0), (t, b), (l, r)),
+                          constant_values=self.fill)
+        if arr.ndim == 3:
+            return np.pad(arr, ((t, b), (l, r), (0, 0)),
+                          constant_values=self.fill)
+        return np.pad(arr, ((t, b), (l, r)), constant_values=self.fill)

@@ -1,0 +1,14 @@
+"""Test env: force XLA-CPU with 8 virtual devices BEFORE jax import.
+
+This is the fake-device strategy from SURVEY.md §4: the reference tests
+distributed code with Gloo/custom-device fakes on localhost; here an
+8-device CPU mesh exercises the same sharding/collective paths the TPU
+uses.
+"""
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()

@@ -1,0 +1,74 @@
+"""OpTest harness: numpy-reference checks for ops.
+
+Reference parity: `test/legacy_test/op_test.py` — check_output runs the op
+and compares against a numpy reference; check_grad compares analytic
+gradients to numeric differentiation [UNVERIFIED — empty reference mount].
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import paddle_tpu as paddle
+
+
+class OpTest:
+    """Subclass and set: self.op (callable on Tensors), self.np_ref
+    (callable on ndarrays), self.inputs (dict name->ndarray)."""
+
+    rtol = 1e-5
+    atol = 1e-6
+
+    def make_inputs(self):
+        return {k: paddle.to_tensor(v, stop_gradient=False)
+                for k, v in self.inputs.items()}
+
+    def check_output(self, **attrs):
+        tensors = self.make_inputs()
+        out = self.op(**tensors, **attrs)
+        ref = self.np_ref(**{k: np.asarray(v) for k, v in
+                             self.inputs.items()}, **attrs)
+        outs = out if isinstance(out, (list, tuple)) else [out]
+        refs = ref if isinstance(ref, (list, tuple)) else [ref]
+        for o, r in zip(outs, refs):
+            np.testing.assert_allclose(o.numpy(), r, rtol=self.rtol,
+                                       atol=self.atol)
+
+    def check_grad(self, wrt=None, eps=1e-3, rtol=1e-2, atol=1e-3,
+                   **attrs):
+        tensors = self.make_inputs()
+        out = self.op(**tensors, **attrs)
+        loss = out.sum() if out.size > 1 else out
+        loss.backward()
+        for name in (wrt or self.inputs.keys()):
+            if not np.issubdtype(self.inputs[name].dtype, np.floating):
+                continue
+            analytic = tensors[name].grad.numpy()
+            numeric = self._numeric_grad(name, eps, **attrs)
+            np.testing.assert_allclose(analytic, numeric, rtol=rtol,
+                                       atol=atol,
+                                       err_msg=f"grad mismatch for {name}")
+
+    def _numeric_grad(self, name, eps, **attrs):
+        base = {k: np.asarray(v, np.float64) if np.issubdtype(
+            np.asarray(v).dtype, np.floating) else np.asarray(v)
+            for k, v in self.inputs.items()}
+        x = base[name]
+        g = np.zeros_like(x, np.float64)
+        it = np.nditer(x, flags=["multi_index"])
+        while not it.finished:
+            idx = it.multi_index
+            for sign in (+1, -1):
+                pert = dict(base)
+                xa = x.copy()
+                xa[idx] += sign * eps
+                pert[name] = xa
+                tensors = {k: paddle.to_tensor(v.astype(np.float32)
+                                               if np.issubdtype(
+                                                   v.dtype, np.floating)
+                                               else v)
+                           for k, v in pert.items()}
+                val = float(self.op(**tensors, **attrs).sum().item())
+                g[idx] += sign * val
+            g[idx] /= 2 * eps
+            it.iternext()
+        return g.astype(np.float32)

@@ -1,0 +1,83 @@
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, optimizer, amp
+import paddle_tpu.nn.functional as F
+
+
+def test_auto_cast_o1_dtypes():
+    lin = nn.Linear(4, 4)
+    x = paddle.randn([2, 4])
+    with paddle.amp.auto_cast(dtype="bfloat16", level="O1"):
+        y = lin(x)
+        assert y.dtype == paddle.bfloat16
+        # black-list op stays f32
+        s = paddle.nn.functional.softmax(y)
+        assert s.dtype == paddle.float32
+    y2 = lin(x)
+    assert y2.dtype == paddle.float32
+
+
+def test_auto_cast_disabled():
+    lin = nn.Linear(4, 4)
+    x = paddle.randn([2, 4])
+    with paddle.amp.auto_cast(enable=False, dtype="bfloat16"):
+        y = lin(x)
+    assert y.dtype == paddle.float32
+
+
+def test_amp_training_bf16_converges():
+    paddle.seed(3)
+    model = nn.Sequential(nn.Linear(8, 32), nn.ReLU(), nn.Linear(32, 1))
+    opt = optimizer.Adam(learning_rate=0.01,
+                         parameters=model.parameters())
+    rng = np.random.RandomState(0)
+    xv = rng.rand(32, 8).astype(np.float32)
+    yv = (xv @ rng.rand(8, 1)).astype(np.float32)
+    x, y = paddle.to_tensor(xv), paddle.to_tensor(yv)
+    losses = []
+    for _ in range(30):
+        with paddle.amp.auto_cast(dtype="bfloat16", level="O1"):
+            pred = model(x)
+            loss = F.mse_loss(pred.astype("float32"), y)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(loss.item()))
+    assert losses[-1] < losses[0] * 0.5
+    # master params stay f32
+    assert model[0].weight.dtype == paddle.float32
+
+
+def test_grad_scaler_fp16_flow():
+    model = nn.Linear(4, 4)
+    opt = optimizer.SGD(learning_rate=0.1, parameters=model.parameters())
+    scaler = paddle.amp.GradScaler(init_loss_scaling=1024.0)
+    x = paddle.randn([4, 4])
+    with paddle.amp.auto_cast(dtype="float16", level="O1"):
+        loss = model(x).astype("float32").sum()
+    scaled = scaler.scale(loss)
+    scaled.backward()
+    scaler.step(opt)
+    scaler.update()
+    assert np.isfinite(model.weight.numpy()).all()
+
+
+def test_grad_scaler_inf_skips_step():
+    model = nn.Linear(2, 2)
+    w_before = model.weight.numpy().copy()
+    opt = optimizer.SGD(learning_rate=0.1, parameters=model.parameters())
+    scaler = paddle.amp.GradScaler(init_loss_scaling=2.0 ** 15)
+    x = paddle.to_tensor(np.asarray([[3e38, 3e38]], np.float32))
+    loss = model(x).sum()
+    scaler.scale(loss).backward()
+    scaler.step(opt)  # grads overflow → step skipped
+    np.testing.assert_allclose(model.weight.numpy(), w_before)
+    assert scaler._scale < 2.0 ** 15  # scale decreased
+
+
+def test_amp_decorate_o2():
+    model = nn.Linear(4, 4)
+    model = paddle.amp.decorate(model, level="O2", dtype="bfloat16")
+    assert model.weight.dtype == paddle.bfloat16

@@ -1,0 +1,135 @@
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def test_simple_backward():
+    x = paddle.to_tensor([2.0, 3.0], stop_gradient=False)
+    y = (x * x * 3).sum()
+    y.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [12.0, 18.0])
+
+
+def test_grad_accumulation():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    (x * 2).sum().backward()
+    (x * 3).sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [5.0])
+    x.clear_grad()
+    assert x.grad is None
+
+
+def test_stop_gradient():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    y = paddle.to_tensor([2.0], stop_gradient=True)
+    (x * y).sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [2.0])
+    assert y.grad is None
+
+
+def test_no_grad():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    with paddle.no_grad():
+        y = x * 2
+    assert y._grad_node is None
+    assert y.stop_gradient
+
+
+def test_detach_breaks_graph():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    y = (x * 2).detach()
+    z = y * 3
+    assert z._grad_node is None
+
+
+def test_shared_subexpression():
+    x = paddle.to_tensor([2.0], stop_gradient=False)
+    y = x * x          # used twice
+    z = (y + y).sum()
+    z.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [8.0])
+
+
+def test_multi_output_op_backward():
+    x = paddle.to_tensor(np.arange(6, dtype=np.float32).reshape(2, 3),
+                         stop_gradient=False)
+    a, b, c = paddle.split(x, 3, axis=1)
+    (a.sum() + 2 * c.sum()).backward()
+    np.testing.assert_allclose(x.grad.numpy(),
+                               [[1, 0, 2], [1, 0, 2]])
+
+
+def test_paddle_grad_api():
+    x = paddle.to_tensor([3.0], stop_gradient=False)
+    y = x * x
+    (g,) = paddle.grad(y, x)
+    np.testing.assert_allclose(g.numpy(), [6.0])
+    assert x.grad is None  # .grad untouched
+
+
+def test_paddle_grad_intermediate():
+    x = paddle.to_tensor([2.0], stop_gradient=False)
+    h = x * 3
+    y = h * h
+    (gh,) = paddle.grad([y], [h])
+    np.testing.assert_allclose(gh.numpy(), [12.0])
+
+
+def test_backward_hook():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    seen = []
+
+    def hook(g):
+        seen.append(g.numpy().copy())
+        return g * 2
+
+    y = x * 5
+    x.register_hook(hook)
+    y.sum().backward()
+    assert len(seen) == 1
+    np.testing.assert_allclose(x.grad.numpy(), [10.0])
+
+
+def test_retain_graph():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    y = (x * 2).sum()
+    y.backward(retain_graph=True)
+    y.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [4.0])
+
+
+def test_double_backward_raises():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    y = (x * 2).sum()
+    y.backward()
+    with pytest.raises(RuntimeError):
+        y.backward()
+
+
+def test_broadcast_grad():
+    x = paddle.to_tensor(np.ones((3, 1), np.float32), stop_gradient=False)
+    y = paddle.to_tensor(np.ones((1, 4), np.float32), stop_gradient=False)
+    (x + y).sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), np.full((3, 1), 4.0))
+    np.testing.assert_allclose(y.grad.numpy(), np.full((1, 4), 3.0))
+
+
+def test_pylayer():
+    from paddle_tpu.autograd import PyLayer
+
+    class Double(PyLayer):
+        @staticmethod
+        def forward(ctx, x):
+            ctx.save_for_backward(x)
+            return x * 2
+
+        @staticmethod
+        def backward(ctx, gy):
+            return gy * 2
+
+    x = paddle.to_tensor([1.5], stop_gradient=False)
+    y = Double.apply(x)
+    y.sum().backward()
+    np.testing.assert_allclose(y.numpy(), [3.0])
+    np.testing.assert_allclose(x.grad.numpy(), [2.0])

@@ -1,0 +1,107 @@
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, optimizer
+from paddle_tpu.io import (DataLoader, Dataset, TensorDataset, BatchSampler,
+                           DistributedBatchSampler, RandomSampler, Subset,
+                           random_split)
+
+
+class _SquareDS(Dataset):
+    def __init__(self, n=20):
+        self.n = n
+
+    def __getitem__(self, i):
+        return np.asarray([i], np.float32), np.asarray([i * i], np.float32)
+
+    def __len__(self):
+        return self.n
+
+
+def test_dataloader_basic():
+    dl = DataLoader(_SquareDS(), batch_size=4)
+    batches = list(dl)
+    assert len(batches) == 5
+    x, y = batches[0]
+    assert x.shape == [4, 1]
+    np.testing.assert_allclose(y.numpy()[:, 0], [0, 1, 4, 9])
+
+
+def test_dataloader_shuffle_drop_last():
+    dl = DataLoader(_SquareDS(10), batch_size=3, shuffle=True,
+                    drop_last=True)
+    batches = list(dl)
+    assert len(batches) == 3
+    assert all(b[0].shape == [3, 1] for b in batches)
+
+
+def test_dataloader_workers():
+    dl = DataLoader(_SquareDS(16), batch_size=4, num_workers=2)
+    xs = sorted(float(v) for b in dl for v in b[0].numpy()[:, 0])
+    assert xs == [float(i) for i in range(16)]
+
+
+def test_tensor_dataset_and_split():
+    xs = paddle.arange(10, dtype="float32")
+    ds = TensorDataset([xs.reshape([10, 1])])
+    a, b = random_split(ds, [7, 3])
+    assert len(a) == 7 and len(b) == 3
+    sub = Subset(ds, [1, 3])
+    assert len(sub) == 2
+
+
+def test_distributed_batch_sampler():
+    ds = _SquareDS(20)
+    s0 = DistributedBatchSampler(ds, batch_size=2, num_replicas=4, rank=0)
+    s1 = DistributedBatchSampler(ds, batch_size=2, num_replicas=4, rank=1)
+    i0 = [i for b in s0 for i in b]
+    i1 = [i for b in s1 for i in b]
+    assert len(i0) == len(i1) == 5
+    assert not set(i0) & set(i1)
+    s0.set_epoch(1)
+
+
+def test_save_load_state_dict(tmp_path):
+    model = nn.Sequential(nn.Linear(4, 4), nn.LayerNorm(4))
+    opt = optimizer.Adam(learning_rate=0.1,
+                         parameters=model.parameters())
+    model(paddle.randn([2, 4])).sum().backward()
+    opt.step()
+    p = str(tmp_path / "ckpt.pdparams")
+    po = str(tmp_path / "ckpt.pdopt")
+    paddle.save(model.state_dict(), p)
+    paddle.save(opt.state_dict(), po)
+
+    model2 = nn.Sequential(nn.Linear(4, 4), nn.LayerNorm(4))
+    missing, unexpected = model2.set_state_dict(paddle.load(p))
+    assert not missing and not unexpected
+    np.testing.assert_allclose(model2[0].weight.numpy(),
+                               model[0].weight.numpy())
+    opt2 = optimizer.Adam(learning_rate=0.1,
+                          parameters=model2.parameters())
+    model2(paddle.randn([2, 4])).sum().backward()
+    opt2.step()
+    opt2.set_state_dict(paddle.load(po))
+
+
+def test_save_load_bf16(tmp_path):
+    t = paddle.to_tensor([1.5, 2.5], dtype="bfloat16")
+    p = str(tmp_path / "t.pd")
+    paddle.save({"t": t}, p)
+    loaded = paddle.load(p)
+    assert loaded["t"].dtype == paddle.bfloat16
+    np.testing.assert_allclose(
+        loaded["t"].astype("float32").numpy(), [1.5, 2.5])
+
+
+def test_save_load_nested(tmp_path):
+    obj = {"a": [paddle.ones([2]), 3], "b": {"c": paddle.zeros([1])},
+           "s": "hello"}
+    p = str(tmp_path / "n.pd")
+    paddle.save(obj, p)
+    loaded = paddle.load(p)
+    assert loaded["s"] == "hello"
+    np.testing.assert_allclose(loaded["a"][0].numpy(), [1, 1])

@@ -1,0 +1,85 @@
+"""End-to-end gate (SURVEY.md §7 step 2): LeNet on MNIST, dygraph fp32.
+
+BASELINE config #1.  Uses the synthetic MNIST fallback (no egress) — the
+point is the full train loop: DataLoader → forward → loss → backward →
+SGD → accuracy improves.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, optimizer
+from paddle_tpu.io import DataLoader
+from paddle_tpu.vision.datasets import MNIST
+from paddle_tpu.vision.models import LeNet
+import paddle_tpu.nn.functional as F
+
+
+def test_lenet_trains():
+    paddle.seed(0)
+    train_ds = MNIST(mode="train")
+    loader = DataLoader(train_ds, batch_size=64, shuffle=True,
+                        drop_last=True)
+    model = LeNet(num_classes=10)
+    opt = optimizer.Adam(learning_rate=1e-3,
+                         parameters=model.parameters())
+    model.train()
+    losses = []
+    for step, (img, label) in enumerate(loader):
+        out = model(img)
+        loss = F.cross_entropy(out, label.squeeze(-1))
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(loss.item()))
+        if step >= 30:
+            break
+    first, last = np.mean(losses[:5]), np.mean(losses[-5:])
+    assert last < first * 0.8, (first, last)
+
+
+def test_lenet_eval_accuracy_improves():
+    paddle.seed(1)
+    train_ds = MNIST(mode="train")
+    test_ds = MNIST(mode="test")
+    loader = DataLoader(train_ds, batch_size=128, shuffle=True,
+                        drop_last=True)
+    model = LeNet(num_classes=10)
+    opt = optimizer.Adam(learning_rate=2e-3,
+                         parameters=model.parameters())
+
+    def accuracy():
+        model.eval()
+        correct = total = 0
+        with paddle.no_grad():
+            for img, label in DataLoader(test_ds, batch_size=256):
+                pred = model(img).numpy().argmax(-1)
+                correct += (pred == label.numpy()[:, 0]).sum()
+                total += len(pred)
+        model.train()
+        return correct / total
+
+    acc0 = accuracy()
+    for step, (img, label) in enumerate(loader):
+        out = model(img)
+        loss = F.cross_entropy(out, label.squeeze(-1))
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        if step >= 40:
+            break
+    acc1 = accuracy()
+    assert acc1 > acc0 + 0.1, (acc0, acc1)
+
+
+def test_hapi_model_fit():
+    paddle.seed(2)
+    ds = MNIST(mode="train")
+    model = paddle.Model(LeNet(num_classes=10))
+    model.prepare(
+        optimizer=optimizer.Adam(
+            learning_rate=1e-3,
+            parameters=model.parameters()),
+        loss=nn.CrossEntropyLoss(),
+        metrics=paddle.metric.Accuracy())
+    model.fit(ds, batch_size=64, epochs=1, num_iters=10, verbose=0)

@@ -1,0 +1,218 @@
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+import paddle_tpu.nn.functional as F
+
+
+def test_linear():
+    lin = nn.Linear(4, 3)
+    x = paddle.randn([2, 4])
+    y = lin(x)
+    assert y.shape == [2, 3]
+    np.testing.assert_allclose(
+        y.numpy(), x.numpy() @ lin.weight.numpy() + lin.bias.numpy(),
+        rtol=1e-5, atol=1e-5)
+
+
+def test_conv2d():
+    conv = nn.Conv2D(3, 8, 3, stride=1, padding=1)
+    x = paddle.randn([2, 3, 16, 16])
+    y = conv(x)
+    assert y.shape == [2, 8, 16, 16]
+    y.sum().backward()
+    assert conv.weight.grad is not None
+
+
+def test_conv2d_vs_numpy():
+    # 1x1 conv is a matmul over channels
+    conv = nn.Conv2D(4, 2, 1, bias_attr=False)
+    x = paddle.randn([1, 4, 5, 5])
+    y = conv(x)
+    w = conv.weight.numpy().reshape(2, 4)
+    ref = np.einsum("oc,nchw->nohw", w, x.numpy())
+    np.testing.assert_allclose(y.numpy(), ref, rtol=1e-4, atol=1e-5)
+
+
+def test_conv_transpose():
+    deconv = nn.Conv2DTranspose(4, 2, 2, stride=2)
+    x = paddle.randn([1, 4, 8, 8])
+    y = deconv(x)
+    assert y.shape == [1, 2, 16, 16]
+
+
+def test_pools():
+    x = paddle.randn([2, 3, 8, 8])
+    assert F.max_pool2d(x, 2, 2).shape == [2, 3, 4, 4]
+    assert F.avg_pool2d(x, 2, 2).shape == [2, 3, 4, 4]
+    assert F.adaptive_avg_pool2d(x, 1).shape == [2, 3, 1, 1]
+    np.testing.assert_allclose(
+        F.adaptive_avg_pool2d(x, 1).numpy()[..., 0, 0],
+        x.numpy().mean((2, 3)), rtol=1e-5)
+
+
+def test_batchnorm_train_eval():
+    bn = nn.BatchNorm2D(4)
+    x = paddle.randn([8, 4, 5, 5])
+    bn.train()
+    y = bn(x)
+    # training output is normalized per-batch
+    np.testing.assert_allclose(y.numpy().mean((0, 2, 3)), np.zeros(4),
+                               atol=1e-5)
+    # running stats moved toward batch stats
+    assert not np.allclose(bn._mean.numpy(), np.zeros(4))
+    bn.eval()
+    y2 = bn(x)
+    assert y2.shape == [8, 4, 5, 5]
+
+
+def test_layernorm_affine():
+    ln = nn.LayerNorm(8)
+    x = paddle.randn([4, 8])
+    y = ln(x)
+    np.testing.assert_allclose(y.numpy().mean(-1), np.zeros(4), atol=1e-5)
+    y.sum().backward()
+    assert ln.weight.grad is not None
+
+
+def test_embedding():
+    emb = nn.Embedding(10, 4)
+    idx = paddle.to_tensor([[1, 2], [3, 4]])
+    y = emb(idx)
+    assert y.shape == [2, 2, 4]
+    y.sum().backward()
+    assert emb.weight.grad is not None
+
+
+def test_dropout_modes():
+    do = nn.Dropout(0.5)
+    x = paddle.ones([100, 100])
+    do.train()
+    y = do(x)
+    frac_zero = float((y.numpy() == 0).mean())
+    assert 0.3 < frac_zero < 0.7
+    do.eval()
+    np.testing.assert_allclose(do(x).numpy(), x.numpy())
+
+
+def test_activations():
+    x = paddle.to_tensor([-1.0, 0.0, 2.0])
+    np.testing.assert_allclose(F.relu(x).numpy(), [0, 0, 2])
+    np.testing.assert_allclose(F.sigmoid(x).numpy(),
+                               1 / (1 + np.exp(-x.numpy())), rtol=1e-5)
+    np.testing.assert_allclose(F.leaky_relu(x, 0.1).numpy(),
+                               [-0.1, 0, 2], rtol=1e-5)
+    assert F.gelu(x).shape == [3]
+    assert F.softmax(x).numpy().sum() == pytest.approx(1.0, rel=1e-5)
+
+
+def test_losses():
+    logits = paddle.randn([4, 10])
+    labels = paddle.to_tensor([1, 2, 3, 4])
+    loss = F.cross_entropy(logits, labels)
+    assert loss.shape == []
+    lp = np.log(np.exp(logits.numpy()) /
+                np.exp(logits.numpy()).sum(-1, keepdims=True))
+    ref = -lp[np.arange(4), labels.numpy()].mean()
+    np.testing.assert_allclose(loss.numpy(), ref, rtol=1e-5)
+    np.testing.assert_allclose(
+        F.mse_loss(logits, paddle.zeros_like(logits)).numpy(),
+        (logits.numpy() ** 2).mean(), rtol=1e-5)
+
+
+def test_cross_entropy_ignore_index():
+    logits = paddle.randn([4, 5])
+    labels = paddle.to_tensor([1, -100, 3, -100])
+    loss = F.cross_entropy(logits, labels, ignore_index=-100)
+    lp = np.log(np.exp(logits.numpy()) /
+                np.exp(logits.numpy()).sum(-1, keepdims=True))
+    ref = -(lp[0, 1] + lp[2, 3]) / 2
+    np.testing.assert_allclose(loss.numpy(), ref, rtol=1e-5)
+
+
+def test_sequential_layerlist():
+    model = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+    y = model(paddle.randn([3, 4]))
+    assert y.shape == [3, 2]
+    assert len(model) == 3
+    ll = nn.LayerList([nn.Linear(2, 2) for _ in range(3)])
+    assert len(ll) == 3
+    assert len(list(ll[0].parameters())) == 2
+
+
+def test_state_dict_roundtrip():
+    m1 = nn.Sequential(nn.Linear(4, 4), nn.LayerNorm(4))
+    m2 = nn.Sequential(nn.Linear(4, 4), nn.LayerNorm(4))
+    m2.set_state_dict(m1.state_dict())
+    x = paddle.randn([2, 4])
+    np.testing.assert_allclose(m1(x).numpy(), m2(x).numpy(), rtol=1e-6)
+
+
+def test_named_parameters():
+    model = nn.Sequential(nn.Linear(2, 2), nn.Linear(2, 2))
+    names = [n for n, _ in model.named_parameters()]
+    assert "0.weight" in names and "1.bias" in names
+    assert len(model.parameters()) == 4
+
+
+def test_multihead_attention():
+    mha = nn.MultiHeadAttention(16, 4)
+    x = paddle.randn([2, 5, 16])
+    y = mha(x, x, x)
+    assert y.shape == [2, 5, 16]
+    y.sum().backward()
+    assert mha.q_proj.weight.grad is not None
+
+
+def test_transformer_encoder():
+    layer = nn.TransformerEncoderLayer(16, 4, 32, dropout=0.0)
+    enc = nn.TransformerEncoder(layer, 2)
+    x = paddle.randn([2, 5, 16])
+    y = enc(x)
+    assert y.shape == [2, 5, 16]
+    # stacked layers must have independent params
+    p0 = enc.layers[0].linear1.weight
+    p1 = enc.layers[1].linear1.weight
+    assert p0 is not p1
+
+
+def test_lstm():
+    lstm = nn.LSTM(8, 16, num_layers=2)
+    x = paddle.randn([4, 6, 8])
+    y, (h, c) = lstm(x)
+    assert y.shape == [4, 6, 16]
+    assert h.shape == [2, 4, 16]
+    y.sum().backward()
+
+
+def test_gru_bidirect():
+    gru = nn.GRU(8, 16, direction="bidirect")
+    x = paddle.randn([2, 5, 8])
+    y, h = gru(x)
+    assert y.shape == [2, 5, 32]
+    assert h.shape == [2, 2, 16]
+
+
+def test_sdpa():
+    q = paddle.randn([2, 5, 4, 8])
+    out = F.scaled_dot_product_attention(q, q, q, is_causal=True)
+    assert out.shape == [2, 5, 4, 8]
+    # causality: first position attends only to itself
+    k = paddle.randn([2, 5, 4, 8])
+    v = paddle.randn([2, 5, 4, 8])
+    o1 = F.scaled_dot_product_attention(q, k, v, is_causal=True)
+    v2 = v.clone()
+    v2[:, 4] = paddle.zeros([2, 4, 8])  # change last position value
+    o2 = F.scaled_dot_product_attention(q, k, v2, is_causal=True)
+    np.testing.assert_allclose(o1[:, 0].numpy(), o2[:, 0].numpy(),
+                               rtol=1e-5)
+
+
+def test_clip_grad_global_norm():
+    p = nn.Parameter(np.ones(4, np.float32) * 2)
+    (p * paddle.to_tensor([10., 10., 10., 10.])).sum().backward()
+    clip = paddle.ClipGradByGlobalNorm(1.0)
+    clip([p])
+    total = np.linalg.norm(p.grad.numpy())
+    np.testing.assert_allclose(total, 1.0, rtol=1e-4)

@@ -1,0 +1,183 @@
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from op_test import OpTest
+
+
+class TestMatmul(OpTest):
+    def setup_method(self, m):
+        self.op = paddle.matmul
+        self.np_ref = lambda x, y: x @ y
+        self.inputs = {"x": np.random.rand(3, 4).astype(np.float32),
+                       "y": np.random.rand(4, 5).astype(np.float32)}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad()
+
+
+class TestSoftmax(OpTest):
+    def setup_method(self, m):
+        self.op = paddle.nn.functional.softmax
+        def ref(x):
+            e = np.exp(x - x.max(-1, keepdims=True))
+            return e / e.sum(-1, keepdims=True)
+        self.np_ref = ref
+        self.inputs = {"x": np.random.rand(4, 7).astype(np.float32)}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad()
+
+
+class TestLayerNorm(OpTest):
+    rtol = 1e-4
+    atol = 1e-5
+
+    def setup_method(self, m):
+        def op(x):
+            return paddle.nn.functional.layer_norm(x, x.shape[-1])
+
+        def ref(x):
+            mu = x.mean(-1, keepdims=True)
+            var = ((x - mu) ** 2).mean(-1, keepdims=True)
+            return (x - mu) / np.sqrt(var + 1e-5)
+
+        self.op = op
+        self.np_ref = ref
+        self.inputs = {"x": np.random.rand(3, 8).astype(np.float32)}
+
+    def test_output(self):
+        self.check_output()
+
+
+def test_reductions():
+    x = np.random.rand(3, 4, 5).astype(np.float32)
+    t = paddle.to_tensor(x)
+    np.testing.assert_allclose(paddle.sum(t).numpy(), x.sum(), rtol=1e-5)
+    np.testing.assert_allclose(paddle.mean(t, axis=1).numpy(),
+                               x.mean(1), rtol=1e-5)
+    np.testing.assert_allclose(paddle.max(t, axis=[0, 2]).numpy(),
+                               x.max((0, 2)), rtol=1e-5)
+    np.testing.assert_allclose(paddle.prod(t, axis=-1).numpy(),
+                               x.prod(-1), rtol=1e-4)
+    assert paddle.argmax(t).item() == x.argmax()
+    np.testing.assert_allclose(paddle.std(t).numpy(), x.std(ddof=1),
+                               rtol=1e-4)
+
+
+def test_manipulation():
+    x = np.arange(24).reshape(2, 3, 4).astype(np.float32)
+    t = paddle.to_tensor(x)
+    assert paddle.reshape(t, [6, 4]).shape == [6, 4]
+    assert paddle.transpose(t, [2, 0, 1]).shape == [4, 2, 3]
+    assert paddle.flatten(t).shape == [24]
+    assert paddle.unsqueeze(t, 0).shape == [1, 2, 3, 4]
+    assert paddle.squeeze(paddle.unsqueeze(t, 0), 0).shape == [2, 3, 4]
+    parts = paddle.split(t, 3, axis=1)
+    assert len(parts) == 3 and parts[0].shape == [2, 1, 4]
+    parts = paddle.split(t, [1, -1], axis=1)
+    assert parts[1].shape == [2, 2, 4]
+    c = paddle.concat([t, t], axis=0)
+    assert c.shape == [4, 3, 4]
+    s = paddle.stack([t, t], axis=0)
+    assert s.shape == [2, 2, 3, 4]
+    assert paddle.tile(t, [2, 1, 1]).shape == [4, 3, 4]
+    assert paddle.expand(paddle.ones([1, 3]), [5, 3]).shape == [5, 3]
+    np.testing.assert_allclose(paddle.flip(t, [0]).numpy(), x[::-1])
+    assert paddle.roll(t, 1, 0).shape == [2, 3, 4]
+    ub = paddle.unbind(t, 1)
+    assert len(ub) == 3
+
+
+def test_gather_scatter():
+    x = np.arange(10).astype(np.float32)
+    t = paddle.to_tensor(x)
+    idx = paddle.to_tensor([1, 3, 5])
+    np.testing.assert_allclose(paddle.gather(t, idx).numpy(), [1, 3, 5])
+    upd = paddle.to_tensor([10., 20., 30.])
+    out = paddle.scatter(t, idx, upd)
+    assert out[1].item() == 10
+    x2 = np.arange(12).reshape(3, 4).astype(np.float32)
+    t2 = paddle.to_tensor(x2)
+    i2 = paddle.to_tensor([[0, 1], [2, 3]])
+    np.testing.assert_allclose(paddle.gather_nd(t2, i2).numpy(), [1, 11])
+
+
+def test_topk_sort():
+    x = np.array([3., 1., 4., 1., 5.], np.float32)
+    t = paddle.to_tensor(x)
+    vals, idx = paddle.topk(t, 2)
+    np.testing.assert_allclose(vals.numpy(), [5, 4])
+    assert idx.numpy().tolist() == [4, 2]
+    np.testing.assert_allclose(paddle.sort(t).numpy(), np.sort(x))
+    assert paddle.argsort(t).numpy().tolist() == np.argsort(
+        x, kind="stable").tolist()
+
+
+def test_where_masked():
+    x = paddle.to_tensor([1., -2., 3.])
+    out = paddle.where(x > 0, x, paddle.zeros_like(x))
+    np.testing.assert_allclose(out.numpy(), [1, 0, 3])
+    sel = paddle.masked_select(x, x > 0)
+    np.testing.assert_allclose(sel.numpy(), [1, 3])
+    nz = paddle.nonzero(x > 0)
+    assert nz.shape == [2, 1]
+
+
+def test_linalg():
+    a = np.random.rand(4, 4).astype(np.float32) + np.eye(4, dtype=np.float32) * 4
+    t = paddle.to_tensor(a)
+    np.testing.assert_allclose(paddle.linalg.inv(t).numpy(),
+                               np.linalg.inv(a), rtol=1e-3, atol=1e-4)
+    np.testing.assert_allclose(paddle.linalg.det(t).numpy(),
+                               np.linalg.det(a), rtol=1e-3)
+    np.testing.assert_allclose(
+        paddle.linalg.norm(t).numpy(),
+        np.linalg.norm(a), rtol=1e-5)
+    sym = a @ a.T
+    w = paddle.linalg.eigvalsh(paddle.to_tensor(sym))
+    np.testing.assert_allclose(w.numpy(), np.linalg.eigvalsh(sym),
+                               rtol=1e-3, atol=1e-3)
+    e = paddle.einsum("ij,jk->ik", t, t)
+    np.testing.assert_allclose(e.numpy(), a @ a, rtol=1e-4)
+
+
+def test_random_reproducible():
+    paddle.seed(123)
+    a = paddle.randn([4, 4])
+    paddle.seed(123)
+    b = paddle.randn([4, 4])
+    np.testing.assert_allclose(a.numpy(), b.numpy())
+    c = paddle.randn([4, 4])
+    assert not np.allclose(b.numpy(), c.numpy())
+    r = paddle.randint(0, 10, [100])
+    assert r.dtype == paddle.int64
+    assert (r.numpy() >= 0).all() and (r.numpy() < 10).all()
+    p = paddle.randperm(10)
+    assert sorted(p.numpy().tolist()) == list(range(10))
+
+
+def test_creation():
+    assert paddle.ones([2, 2]).numpy().sum() == 4
+    assert paddle.full([2], 7, dtype="int32").numpy().tolist() == [7, 7]
+    assert paddle.arange(1, 10, 2).numpy().tolist() == [1, 3, 5, 7, 9]
+    assert paddle.linspace(0, 1, 5).shape == [5]
+    e = paddle.eye(3)
+    np.testing.assert_allclose(e.numpy(), np.eye(3))
+    tr = paddle.tril(paddle.ones([3, 3]))
+    assert tr.numpy()[0, 2] == 0
+    d = paddle.diag(paddle.to_tensor([1., 2.]))
+    assert d.shape == [2, 2]
+
+
+def test_cumsum_clip():
+    x = paddle.to_tensor([1., 2., 3.])
+    np.testing.assert_allclose(paddle.cumsum(x).numpy(), [1, 3, 6])
+    np.testing.assert_allclose(paddle.clip(x, 1.5, 2.5).numpy(),
+                               [1.5, 2, 2.5])

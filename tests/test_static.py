@@ -1,0 +1,124 @@
+"""Static graph: Program construction + Executor (StandaloneExecutor role)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, optimizer, static
+
+
+@pytest.fixture(autouse=True)
+def _static_guard():
+    yield
+    paddle.disable_static()
+
+
+def test_program_capture_and_run():
+    paddle.enable_static()
+    main = static.Program()
+    startup = static.Program()
+    with static.program_guard(main, startup):
+        x = static.data("x", [4, 3], "float32")
+        w = paddle.create_parameter([3, 2], "float32")
+        y = paddle.matmul(x, w)
+        out = y + 1.0
+    assert isinstance(out, static.Variable)
+    assert out.shape == [4, 2]
+    assert len(main.global_block().ops) == 2
+    exe = static.Executor()
+    xv = np.random.rand(4, 3).astype(np.float32)
+    (res,) = exe.run(main, feed={"x": xv}, fetch_list=[out])
+    np.testing.assert_allclose(res, xv @ w.numpy() + 1.0, rtol=1e-5)
+
+
+def test_static_layer_forward():
+    paddle.enable_static()
+    main = static.Program()
+    with static.program_guard(main):
+        x = static.data("x", [2, 4], "float32")
+        lin = nn.Linear(4, 3)
+        out = lin(x)
+    exe = static.Executor()
+    xv = np.ones((2, 4), np.float32)
+    (res,) = exe.run(main, feed={"x": xv}, fetch_list=[out])
+    np.testing.assert_allclose(
+        res, xv @ lin.weight.numpy() + lin.bias.numpy(), rtol=1e-5)
+
+
+def test_static_training_with_minimize():
+    paddle.enable_static()
+    main = static.Program()
+    with static.program_guard(main):
+        x = static.data("x", [8, 4], "float32")
+        y = static.data("y", [8, 1], "float32")
+        lin = nn.Linear(4, 1)
+        pred = lin(x)
+        loss = paddle.nn.functional.mse_loss(pred, y)
+        opt = optimizer.SGD(learning_rate=0.1,
+                            parameters=lin.parameters())
+        opt.minimize(loss)
+    exe = static.Executor()
+    rng = np.random.RandomState(0)
+    xv = rng.rand(8, 4).astype(np.float32)
+    yv = (xv.sum(1, keepdims=True) * 0.5).astype(np.float32)
+    losses = []
+    for _ in range(60):
+        (lv,) = exe.run(main, feed={"x": xv, "y": yv}, fetch_list=[loss])
+        losses.append(float(lv))
+    assert losses[-1] < losses[0] * 0.3, (losses[0], losses[-1])
+
+
+def test_static_adam_training():
+    paddle.enable_static()
+    main = static.Program()
+    with static.program_guard(main):
+        x = static.data("x", [16, 8], "float32")
+        y = static.data("y", [16, 1], "float32")
+        h = nn.Linear(8, 16)(x)
+        h = paddle.nn.functional.relu(h)
+        pred = nn.Linear(16, 1)(h)
+        loss = paddle.nn.functional.mse_loss(pred, y)
+        params = main.all_parameters()
+        opt = optimizer.Adam(learning_rate=0.01, parameters=params)
+        opt.minimize(loss)
+    exe = static.Executor()
+    rng = np.random.RandomState(1)
+    xv = rng.rand(16, 8).astype(np.float32)
+    yv = rng.rand(16, 1).astype(np.float32)
+    losses = [float(exe.run(main, feed={"x": xv, "y": yv},
+                            fetch_list=[loss])[0])
+              for _ in range(40)]
+    assert losses[-1] < losses[0] * 0.5
+
+
+def test_dygraph_static_parity():
+    # same weights, same input → same output in both engines
+    xv = np.random.rand(2, 4).astype(np.float32)
+    lin_d = nn.Linear(4, 3)
+    eager_out = lin_d(paddle.to_tensor(xv)).numpy()
+
+    paddle.enable_static()
+    main = static.Program()
+    with static.program_guard(main):
+        x = static.data("x", [2, 4], "float32")
+        lin_s = nn.Linear(4, 3)
+        lin_s.weight.set_value(lin_d.weight.numpy())
+        lin_s.bias.set_value(lin_d.bias.numpy())
+        out = lin_s(x)
+    exe = static.Executor()
+    (static_out,) = exe.run(main, feed={"x": xv}, fetch_list=[out])
+    np.testing.assert_allclose(eager_out, static_out, rtol=1e-5, atol=1e-6)
+
+
+def test_save_load_inference_model(tmp_path):
+    paddle.enable_static()
+    main = static.Program()
+    with static.program_guard(main):
+        x = static.data("x", [1, 4], "float32")
+        lin = nn.Linear(4, 2)
+        out = lin(x)
+    exe = static.Executor()
+    prefix = str(tmp_path / "inf")
+    static.save_inference_model(prefix, [x], [out], exe, program=main)
+    meta, feeds, fetches, params = static.load_inference_model(prefix, exe)
+    assert feeds == ["x"]
+    assert len(params) >= 1
