@@ -1,0 +1,134 @@
+"""Benchmark driver hook: prints ONE JSON line on stdout.
+
+Headline: BERT-base MLM pretraining step (BASELINE.md config #3 — static
+graph + StandaloneExecutor-equivalent, AMP bf16) on the available
+accelerator.  The whole train step (fwd, bwd, fused AdamW) is captured
+as a Program and compiled once to a single XLA executable; steady-state
+step time is measured.
+
+`vs_baseline`: BASELINE.md's operative target is "match A100"; with no
+published reference numbers (empty mount — see BASELINE.md caveat) the
+hardware-neutral comparison is model-FLOPs-utilization.  vs_baseline =
+measured MFU / 0.40, 0.40 being a strong A100 mixed-precision BERT
+pretraining MFU (A100 runs at 312 bf16 TFLOP/s peak; 40% is the
+well-tuned reference point).  >1.0 beats the reference.
+"""
+import json
+import os
+import sys
+import time
+
+
+def log(msg):
+    print(f"[bench] {msg}", file=sys.stderr, flush=True)
+
+
+PEAK_BF16 = {  # TFLOP/s per chip
+    "v4": 275e12, "v5e": 197e12, "v5litepod": 197e12, "v5p": 459e12,
+    "v6e": 918e12,
+}
+
+
+def device_peak_flops():
+    import jax
+    d = jax.devices()[0]
+    kind = getattr(d, "device_kind", "") or ""
+    for key, peak in PEAK_BF16.items():
+        if key in kind.lower().replace("-", "").replace(" ", ""):
+            return peak, kind
+    if d.platform == "tpu":
+        return 197e12, kind or "tpu"
+    return None, kind or d.platform
+
+
+def main():
+    t0 = time.time()
+    log("initializing backend (first touch may be slow over the tunnel)…")
+    import jax
+    import numpy as np
+    devs = jax.devices()
+    peak, kind = device_peak_flops()
+    on_tpu = devs[0].platform == "tpu"
+    log(f"backend={devs[0].platform} kind={kind} init={time.time()-t0:.0f}s")
+
+    import paddle_tpu as paddle
+    from paddle_tpu import nn, optimizer, static
+    from paddle_tpu.models import BertConfig, BertForMaskedLM
+
+    B, S = (32, 128) if on_tpu else (4, 64)
+    cfg = BertConfig() if on_tpu else BertConfig(
+        hidden_size=128, num_hidden_layers=2, num_attention_heads=2,
+        intermediate_size=256)
+    n_iters = 20 if on_tpu else 3
+
+    paddle.enable_static()
+    main_prog = static.Program()
+    startup = static.Program()
+    t = time.time()
+    with static.program_guard(main_prog, startup):
+        ids = static.data("ids", [B, S], "int64")
+        labels = static.data("labels", [B, S], "int64")
+        model = BertForMaskedLM(cfg)
+        with paddle.amp.auto_cast(dtype="bfloat16", level="O1"):
+            loss, _ = model(ids, labels=labels)
+        opt = optimizer.AdamW(learning_rate=1e-4,
+                              parameters=model.parameters())
+        opt.minimize(loss)
+    log(f"program built: {len(main_prog.global_block().ops)} ops "
+        f"in {time.time()-t:.1f}s")
+
+    n_params = sum(int(np.prod(p.shape)) for p in model.parameters())
+    exe = static.Executor()
+    rng = np.random.default_rng(0)
+
+    def batch():
+        x = rng.integers(0, cfg.vocab_size, (B, S)).astype(np.int64)
+        return {"ids": x, "labels": x}
+
+    t = time.time()
+    (l0,) = exe.run(main_prog, feed=batch(), fetch_list=[loss])
+    log(f"compile+first step: {time.time()-t:.1f}s loss={float(l0):.3f}")
+
+    fd = batch()  # fixed feed: measure device step, not host RNG
+    t = time.time()
+    for _ in range(n_iters):
+        (lv,) = exe.run(main_prog, feed=fd, fetch_list=[loss])
+    try:
+        lv.block_until_ready()
+    except AttributeError:
+        pass
+    dt = (time.time() - t) / n_iters
+    log(f"steady step: {dt*1e3:.1f} ms  loss={float(lv):.3f}")
+
+    tokens_per_sec = B * S / dt
+    # model flops: 6*N per token (fwd+bwd) + attention matmuls
+    L, H = cfg.num_hidden_layers, cfg.hidden_size
+    attn_flops = 12 * L * S * H          # per token: QK^T + PV, fwd+bwd
+    flops_per_token = 6 * n_params + attn_flops
+    achieved = flops_per_token * tokens_per_sec
+    mfu = achieved / peak if peak else 0.0
+    vs = mfu / 0.40 if peak else 0.0
+    log(f"tokens/s={tokens_per_sec:,.0f} achieved={achieved/1e12:.1f} "
+        f"TFLOP/s MFU={mfu:.3f}")
+
+    print(json.dumps({
+        "metric": "bert_base_mlm_static_bf16_tokens_per_sec",
+        "value": round(tokens_per_sec, 1),
+        "unit": "tokens/s",
+        "vs_baseline": round(vs, 3),
+    }), flush=True)
+
+
+if __name__ == "__main__":
+    try:
+        main()
+    except Exception as e:  # always emit the contract line
+        import traceback
+        traceback.print_exc(file=sys.stderr)
+        print(json.dumps({
+            "metric": "bert_base_mlm_static_bf16_tokens_per_sec",
+            "value": 0.0,
+            "unit": "tokens/s",
+            "vs_baseline": 0.0,
+        }), flush=True)
+        sys.exit(0)

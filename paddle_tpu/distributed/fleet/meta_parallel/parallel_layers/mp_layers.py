@@ -135,21 +135,72 @@ def _with_sharding_constraint(t, entry):
     return dispatch("sharding_constraint", impl, (t,), dict(spec=spec))
 
 
+def _axis_in_scope(axis_name):
+    if axis_name is None:
+        return False
+    try:
+        jax.lax.axis_index(axis_name)
+        return True
+    except (NameError, Exception):
+        return False
+
+
 class ParallelCrossEntropy(Layer):
     """Vocab-parallel softmax cross-entropy.
 
     Reference parity: `c_softmax_with_cross_entropy` op — each mp rank
-    holds a vocab shard; max/sum reduce over the mp group.  Here logits
-    arrive sharded on the class dim and XLA's sharded reductions compute
-    exactly those collectives.
+    holds a vocab shard; max/sum reduce over the mp group.
+
+    Two execution contexts:
+      * global sharded arrays (pjit/eager): the class-dim reductions in
+        ordinary cross_entropy span the whole array, so XLA lowers them
+        to exactly the mp-group collectives — no extra code;
+      * inside shard_map (logits are LOCAL vocab shards): the explicit
+        vocab-parallel math — pmax of the local max, psum of the local
+        sum-exp, psum-gather of the target logit from whichever shard
+        owns it.
     """
 
     def __init__(self, mp_group=None, name=None, ignore_index=-100):
         super().__init__()
         self.ignore_index = ignore_index
+        self.mp_group = mp_group
 
     def forward(self, input, label):
+        mesh = global_mesh()
+        axis = (self.mp_group.axis_name if self.mp_group is not None
+                else _mp_axis(mesh))
+        from .....ops.manipulation import unsqueeze
+        if _axis_in_scope(axis):
+            loss = self._vocab_parallel_loss(input, label, axis)
+            return unsqueeze(loss, -1)
         loss = F.cross_entropy(input, label, reduction="none",
                                ignore_index=self.ignore_index)
-        from .....ops.manipulation import unsqueeze
         return unsqueeze(loss, -1)
+
+    def _vocab_parallel_loss(self, input, label, axis):
+        import jax.numpy as jnp
+        from .....core.dispatch import dispatch
+        ignore = self.ignore_index
+
+        def impl(logits, lab, *, axis, ignore):
+            if lab.ndim == logits.ndim and lab.shape[-1] == 1:
+                lab = jnp.squeeze(lab, -1)
+            v_local = logits.shape[-1]
+            offset = jax.lax.axis_index(axis) * v_local
+            x = logits.astype(jnp.float32)
+            m = jax.lax.pmax(jnp.max(x, axis=-1), axis)
+            sumexp = jax.lax.psum(
+                jnp.sum(jnp.exp(x - m[..., None]), axis=-1), axis)
+            local = lab - offset
+            in_shard = jnp.logical_and(local >= 0, local < v_local)
+            safe = jnp.clip(local, 0, v_local - 1)
+            picked_local = jnp.take_along_axis(
+                x, safe[..., None], axis=-1)[..., 0]
+            picked = jax.lax.psum(
+                jnp.where(in_shard, picked_local, 0.0), axis)
+            loss = jnp.log(sumexp) + m - picked
+            return jnp.where(lab == ignore, 0.0, loss)
+
+        return dispatch("c_softmax_with_cross_entropy", impl,
+                        (input, label), dict(axis=axis, ignore=ignore))

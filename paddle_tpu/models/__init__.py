@@ -1,0 +1,18 @@
+"""Model families matching the reference's benchmark configs.
+
+Reference parity: GPT/BERT/LLaMA live in the PaddleNLP ecosystem
+(`paddlenlp/transformers/{gpt,bert,llama}/modeling.py` [UNVERIFIED — the
+reference mount is empty; BASELINE.md configs 3-5 name these models]);
+vision models live in `python/paddle/vision/models` (already in
+paddle_tpu.vision).  These are the flagship LM families the benchmarks
+and the multichip dryrun drive.
+"""
+from .gpt import GPTConfig, GPTModel, GPTForCausalLM, GPTPretrainingCriterion
+from .bert import BertConfig, BertModel, BertForMaskedLM
+from .llama import LlamaConfig, LlamaModel, LlamaForCausalLM
+
+__all__ = [
+    "GPTConfig", "GPTModel", "GPTForCausalLM", "GPTPretrainingCriterion",
+    "BertConfig", "BertModel", "BertForMaskedLM",
+    "LlamaConfig", "LlamaModel", "LlamaForCausalLM",
+]

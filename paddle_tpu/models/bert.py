@@ -1,0 +1,114 @@
+"""BERT encoder + MLM head (BASELINE.md config #3: BERT-base MLM).
+
+Reference parity: `paddlenlp/transformers/bert/modeling.py` [UNVERIFIED —
+empty reference mount].
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import paddle_tpu as paddle
+from .. import nn
+from ..nn import functional as F
+
+
+@dataclass
+class BertConfig:
+    vocab_size: int = 30522
+    hidden_size: int = 768
+    num_hidden_layers: int = 12
+    num_attention_heads: int = 12
+    intermediate_size: int = 3072
+    max_position_embeddings: int = 512
+    type_vocab_size: int = 2
+    layer_norm_eps: float = 1e-12
+
+
+class BertEmbeddings(nn.Layer):
+    def __init__(self, cfg: BertConfig):
+        super().__init__()
+        self.word_embeddings = nn.Embedding(cfg.vocab_size,
+                                            cfg.hidden_size)
+        self.position_embeddings = nn.Embedding(
+            cfg.max_position_embeddings, cfg.hidden_size)
+        self.token_type_embeddings = nn.Embedding(cfg.type_vocab_size,
+                                                  cfg.hidden_size)
+        self.layer_norm = nn.LayerNorm(cfg.hidden_size,
+                                       epsilon=cfg.layer_norm_eps)
+
+    def forward(self, input_ids, token_type_ids=None):
+        b, s = input_ids.shape
+        pos = paddle.arange(s, dtype="int64")
+        x = self.word_embeddings(input_ids) + self.position_embeddings(pos)
+        if token_type_ids is not None:
+            x = x + self.token_type_embeddings(token_type_ids)
+        return self.layer_norm(x)
+
+
+class BertSelfAttention(nn.Layer):
+    def __init__(self, cfg: BertConfig):
+        super().__init__()
+        self.num_heads = cfg.num_attention_heads
+        self.head_dim = cfg.hidden_size // cfg.num_attention_heads
+        self.qkv = nn.Linear(cfg.hidden_size, 3 * cfg.hidden_size)
+        self.out = nn.Linear(cfg.hidden_size, cfg.hidden_size)
+
+    def forward(self, x, attn_mask=None):
+        b, s, h = x.shape
+        qkv = paddle.reshape(self.qkv(x),
+                             [b, s, 3, self.num_heads, self.head_dim])
+        q, k, v = paddle.unbind(qkv, axis=2)
+        out = F.scaled_dot_product_attention(q, k, v, attn_mask=attn_mask)
+        return self.out(paddle.reshape(out, [b, s, h]))
+
+
+class BertLayer(nn.Layer):
+    def __init__(self, cfg: BertConfig):
+        super().__init__()
+        self.attention = BertSelfAttention(cfg)
+        self.ln1 = nn.LayerNorm(cfg.hidden_size, epsilon=cfg.layer_norm_eps)
+        self.fc1 = nn.Linear(cfg.hidden_size, cfg.intermediate_size)
+        self.fc2 = nn.Linear(cfg.intermediate_size, cfg.hidden_size)
+        self.ln2 = nn.LayerNorm(cfg.hidden_size, epsilon=cfg.layer_norm_eps)
+
+    def forward(self, x, attn_mask=None):
+        x = self.ln1(x + self.attention(x, attn_mask))
+        x = self.ln2(x + self.fc2(F.gelu(self.fc1(x), approximate=True)))
+        return x
+
+
+class BertModel(nn.Layer):
+    def __init__(self, cfg: BertConfig):
+        super().__init__()
+        self.config = cfg
+        self.embeddings = BertEmbeddings(cfg)
+        self.encoder = nn.LayerList([BertLayer(cfg)
+                                     for _ in range(cfg.num_hidden_layers)])
+
+    def forward(self, input_ids, token_type_ids=None, attn_mask=None):
+        x = self.embeddings(input_ids, token_type_ids)
+        for layer in self.encoder:
+            x = layer(x, attn_mask)
+        return x
+
+
+class BertForMaskedLM(nn.Layer):
+    def __init__(self, cfg: BertConfig):
+        super().__init__()
+        self.bert = BertModel(cfg)
+        self.transform = nn.Linear(cfg.hidden_size, cfg.hidden_size)
+        self.ln = nn.LayerNorm(cfg.hidden_size, epsilon=cfg.layer_norm_eps)
+
+    def forward(self, input_ids, token_type_ids=None, labels=None):
+        hidden = self.bert(input_ids, token_type_ids)
+        hidden = self.ln(F.gelu(self.transform(hidden), approximate=True))
+        logits = paddle.matmul(hidden,
+                               self.bert.embeddings.word_embeddings.weight,
+                               transpose_y=True)
+        if labels is None:
+            return logits
+        v = logits.shape[-1]
+        loss = F.cross_entropy(paddle.reshape(logits, [-1, v]),
+                               paddle.reshape(labels, [-1]),
+                               ignore_index=-100, reduction="mean")
+        return loss, logits

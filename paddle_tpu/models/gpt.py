@@ -1,0 +1,133 @@
+"""GPT decoder-only LM (BASELINE.md config #4: GPT-3 1.3B class).
+
+Reference parity: `paddlenlp/transformers/gpt/modeling.py` [UNVERIFIED —
+empty reference mount].  TPU-native notes: attention routes through
+F.scaled_dot_product_attention → the Pallas flash kernel on TPU; the LM
+loss uses the fused softmax-xent path via F.cross_entropy; recompute
+(jax.checkpoint) can wrap each block via `recompute=True`.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import paddle_tpu as paddle
+from .. import nn
+from ..nn import functional as F
+
+
+@dataclass
+class GPTConfig:
+    vocab_size: int = 50304
+    hidden_size: int = 768
+    num_hidden_layers: int = 12
+    num_attention_heads: int = 12
+    intermediate_size: int = 0      # 0 → 4*hidden
+    max_position_embeddings: int = 1024
+    hidden_dropout_prob: float = 0.0
+    use_flash_attention: bool = True
+    use_recompute: bool = False
+    tie_word_embeddings: bool = True
+
+    def __post_init__(self):
+        if not self.intermediate_size:
+            self.intermediate_size = 4 * self.hidden_size
+
+
+# 1.3B preset (GPT-3 XL shape) used by bench configs
+GPT_1P3B = dict(vocab_size=50304, hidden_size=2048, num_hidden_layers=24,
+                num_attention_heads=16, max_position_embeddings=2048)
+
+
+class GPTAttention(nn.Layer):
+    def __init__(self, cfg: GPTConfig):
+        super().__init__()
+        self.num_heads = cfg.num_attention_heads
+        self.head_dim = cfg.hidden_size // cfg.num_attention_heads
+        self.qkv_proj = nn.Linear(cfg.hidden_size, 3 * cfg.hidden_size)
+        self.out_proj = nn.Linear(cfg.hidden_size, cfg.hidden_size)
+
+    def forward(self, x):
+        b, s, h = x.shape
+        qkv = self.qkv_proj(x)
+        qkv = paddle.reshape(qkv, [b, s, 3, self.num_heads, self.head_dim])
+        q, k, v = paddle.unbind(qkv, axis=2)     # each [b, s, nh, hd]
+        out = F.scaled_dot_product_attention(q, k, v, is_causal=True)
+        out = paddle.reshape(out, [b, s, h])
+        return self.out_proj(out)
+
+
+class GPTMLP(nn.Layer):
+    def __init__(self, cfg: GPTConfig):
+        super().__init__()
+        self.fc1 = nn.Linear(cfg.hidden_size, cfg.intermediate_size)
+        self.fc2 = nn.Linear(cfg.intermediate_size, cfg.hidden_size)
+
+    def forward(self, x):
+        return self.fc2(F.gelu(self.fc1(x), approximate=True))
+
+
+class GPTBlock(nn.Layer):
+    def __init__(self, cfg: GPTConfig):
+        super().__init__()
+        self.ln_1 = nn.LayerNorm(cfg.hidden_size)
+        self.attn = GPTAttention(cfg)
+        self.ln_2 = nn.LayerNorm(cfg.hidden_size)
+        self.mlp = GPTMLP(cfg)
+
+    def forward(self, x):
+        x = x + self.attn(self.ln_1(x))
+        x = x + self.mlp(self.ln_2(x))
+        return x
+
+
+class GPTModel(nn.Layer):
+    def __init__(self, cfg: GPTConfig):
+        super().__init__()
+        self.config = cfg
+        self.wte = nn.Embedding(cfg.vocab_size, cfg.hidden_size)
+        self.wpe = nn.Embedding(cfg.max_position_embeddings,
+                                cfg.hidden_size)
+        self.h = nn.LayerList([GPTBlock(cfg)
+                               for _ in range(cfg.num_hidden_layers)])
+        self.ln_f = nn.LayerNorm(cfg.hidden_size)
+        self._recompute = cfg.use_recompute
+
+    def forward(self, input_ids):
+        b, s = input_ids.shape
+        pos = paddle.arange(s, dtype="int64")
+        x = self.wte(input_ids) + self.wpe(pos)
+        for blk in self.h:
+            if self._recompute:
+                from ..distributed.fleet.recompute import recompute
+                x = recompute(blk, x)
+            else:
+                x = blk(x)
+        return self.ln_f(x)
+
+
+class GPTForCausalLM(nn.Layer):
+    def __init__(self, cfg: GPTConfig):
+        super().__init__()
+        self.gpt = GPTModel(cfg)
+        if cfg.tie_word_embeddings:
+            self.lm_head = None
+        else:
+            self.lm_head = nn.Linear(cfg.hidden_size, cfg.vocab_size,
+                                     bias_attr=False)
+
+    def forward(self, input_ids):
+        hidden = self.gpt(input_ids)
+        if self.lm_head is not None:
+            return self.lm_head(hidden)
+        return paddle.matmul(hidden, self.gpt.wte.weight,
+                             transpose_y=True)
+
+
+class GPTPretrainingCriterion(nn.Layer):
+    """Shifted next-token LM loss (ignore_index=-100 for padding)."""
+
+    def forward(self, logits, labels):
+        b, s, v = logits.shape
+        logits = paddle.reshape(logits[:, :-1, :], [-1, v])
+        labels = paddle.reshape(labels[:, 1:], [-1])
+        return F.cross_entropy(logits, labels, reduction="mean")

@@ -1,0 +1,179 @@
+"""LLaMA decoder LM (BASELINE.md config #5: LLaMA-2 7B class).
+
+Reference parity: `paddlenlp/transformers/llama/modeling.py` [UNVERIFIED —
+empty reference mount].  RMSNorm routes to the Pallas fused kernel on
+TPU; attention to the Pallas flash kernel; rotary tables are fixed
+buffers (host-precomputed, folded into the compiled step as constants).
+"""
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+
+import numpy as np
+
+import paddle_tpu as paddle
+from .. import nn
+from ..nn import functional as F
+
+
+@dataclass
+class LlamaConfig:
+    vocab_size: int = 32000
+    hidden_size: int = 4096
+    num_hidden_layers: int = 32
+    num_attention_heads: int = 32
+    num_key_value_heads: int = 0     # 0 → same as num_attention_heads
+    intermediate_size: int = 11008
+    max_position_embeddings: int = 4096
+    rms_norm_eps: float = 1e-6
+    rope_theta: float = 10000.0
+    use_recompute: bool = False
+
+    def __post_init__(self):
+        if not self.num_key_value_heads:
+            self.num_key_value_heads = self.num_attention_heads
+
+
+# 7B preset
+LLAMA_7B = dict(vocab_size=32000, hidden_size=4096, num_hidden_layers=32,
+                num_attention_heads=32, intermediate_size=11008,
+                max_position_embeddings=4096)
+
+
+def _rope_tables(head_dim, max_pos, theta):
+    inv = 1.0 / (theta ** (np.arange(0, head_dim, 2, dtype=np.float64)
+                           / head_dim))
+    t = np.arange(max_pos, dtype=np.float64)
+    freqs = np.outer(t, inv)                       # [S, D/2]
+    emb = np.concatenate([freqs, freqs], axis=-1)  # [S, D]
+    return (np.cos(emb).astype(np.float32), np.sin(emb).astype(np.float32))
+
+
+def _rotate_half(x):
+    d = x.shape[-1]
+    x1 = x[..., : d // 2]
+    x2 = x[..., d // 2:]
+    return paddle.concat([-x2, x1], axis=-1)
+
+
+def apply_rotary_pos_emb(q, k, cos, sin):
+    """q/k: [b, s, h, d]; cos/sin: [s, d] broadcast over batch/heads."""
+    cos = paddle.unsqueeze(paddle.unsqueeze(cos, 0), 2)   # [1, s, 1, d]
+    sin = paddle.unsqueeze(paddle.unsqueeze(sin, 0), 2)
+    q2 = q * cos + _rotate_half(q) * sin
+    k2 = k * cos + _rotate_half(k) * sin
+    return q2, k2
+
+
+class LlamaAttention(nn.Layer):
+    def __init__(self, cfg: LlamaConfig):
+        super().__init__()
+        self.num_heads = cfg.num_attention_heads
+        self.num_kv_heads = cfg.num_key_value_heads
+        self.head_dim = cfg.hidden_size // cfg.num_attention_heads
+        self.q_proj = nn.Linear(cfg.hidden_size,
+                                self.num_heads * self.head_dim,
+                                bias_attr=False)
+        self.k_proj = nn.Linear(cfg.hidden_size,
+                                self.num_kv_heads * self.head_dim,
+                                bias_attr=False)
+        self.v_proj = nn.Linear(cfg.hidden_size,
+                                self.num_kv_heads * self.head_dim,
+                                bias_attr=False)
+        self.o_proj = nn.Linear(cfg.hidden_size, cfg.hidden_size,
+                                bias_attr=False)
+
+    def forward(self, x, cos, sin):
+        b, s, _ = x.shape
+        q = paddle.reshape(self.q_proj(x),
+                           [b, s, self.num_heads, self.head_dim])
+        k = paddle.reshape(self.k_proj(x),
+                           [b, s, self.num_kv_heads, self.head_dim])
+        v = paddle.reshape(self.v_proj(x),
+                           [b, s, self.num_kv_heads, self.head_dim])
+        q, k = apply_rotary_pos_emb(q, k, cos, sin)
+        if self.num_kv_heads != self.num_heads:   # GQA: repeat kv heads
+            rep = self.num_heads // self.num_kv_heads
+            k = paddle.repeat_interleave(k, rep, axis=2)
+            v = paddle.repeat_interleave(v, rep, axis=2)
+        out = F.scaled_dot_product_attention(q, k, v, is_causal=True)
+        return self.o_proj(paddle.reshape(out, [b, s, -1]))
+
+
+class LlamaMLP(nn.Layer):
+    def __init__(self, cfg: LlamaConfig):
+        super().__init__()
+        self.gate_proj = nn.Linear(cfg.hidden_size, cfg.intermediate_size,
+                                   bias_attr=False)
+        self.up_proj = nn.Linear(cfg.hidden_size, cfg.intermediate_size,
+                                 bias_attr=False)
+        self.down_proj = nn.Linear(cfg.intermediate_size, cfg.hidden_size,
+                                   bias_attr=False)
+
+    def forward(self, x):
+        return self.down_proj(F.silu(self.gate_proj(x)) * self.up_proj(x))
+
+
+class LlamaDecoderLayer(nn.Layer):
+    def __init__(self, cfg: LlamaConfig):
+        super().__init__()
+        self.input_layernorm = nn.RMSNorm(cfg.hidden_size,
+                                          epsilon=cfg.rms_norm_eps)
+        self.self_attn = LlamaAttention(cfg)
+        self.post_attention_layernorm = nn.RMSNorm(
+            cfg.hidden_size, epsilon=cfg.rms_norm_eps)
+        self.mlp = LlamaMLP(cfg)
+
+    def forward(self, x, cos, sin):
+        x = x + self.self_attn(self.input_layernorm(x), cos, sin)
+        x = x + self.mlp(self.post_attention_layernorm(x))
+        return x
+
+
+class LlamaModel(nn.Layer):
+    def __init__(self, cfg: LlamaConfig):
+        super().__init__()
+        self.config = cfg
+        self.embed_tokens = nn.Embedding(cfg.vocab_size, cfg.hidden_size)
+        self.layers = nn.LayerList([LlamaDecoderLayer(cfg)
+                                    for _ in range(cfg.num_hidden_layers)])
+        self.norm = nn.RMSNorm(cfg.hidden_size, epsilon=cfg.rms_norm_eps)
+        head_dim = cfg.hidden_size // cfg.num_attention_heads
+        cos, sin = _rope_tables(head_dim, cfg.max_position_embeddings,
+                                cfg.rope_theta)
+        self.register_buffer("rope_cos", paddle.to_tensor(cos))
+        self.register_buffer("rope_sin", paddle.to_tensor(sin))
+        self._recompute = cfg.use_recompute
+
+    def forward(self, input_ids):
+        b, s = input_ids.shape
+        x = self.embed_tokens(input_ids)
+        cos = self.rope_cos[:s]
+        sin = self.rope_sin[:s]
+        for layer in self.layers:
+            if self._recompute:
+                from ..distributed.fleet.recompute import recompute
+                x = recompute(layer, x, cos, sin)
+            else:
+                x = layer(x, cos, sin)
+        return self.norm(x)
+
+
+class LlamaForCausalLM(nn.Layer):
+    def __init__(self, cfg: LlamaConfig):
+        super().__init__()
+        self.llama = LlamaModel(cfg)
+        self.lm_head = nn.Linear(cfg.hidden_size, cfg.vocab_size,
+                                 bias_attr=False)
+
+    def forward(self, input_ids, labels=None):
+        hidden = self.llama(input_ids)
+        logits = self.lm_head(hidden)
+        if labels is None:
+            return logits
+        v = logits.shape[-1]
+        loss = F.cross_entropy(
+            paddle.reshape(logits[:, :-1, :], [-1, v]),
+            paddle.reshape(labels[:, 1:], [-1]), reduction="mean")
+        return loss, logits

@@ -4,11 +4,12 @@ Reference parity: `python/paddle/nn/functional/flash_attention.py` wrapping
 `third_party/flashattn` via `phi/kernels/gpu/flash_attn_kernel.cu`
 [UNVERIFIED — empty reference mount].
 
-TPU-native: the hot path is a Pallas flash-attention kernel
-(paddle_tpu/ops/pallas_kernels.py) with online softmax tiled for the MXU;
-on non-TPU backends (tests run on CPU) it falls back to the XLA composite
-below, which XLA fuses well.  Layout convention matches Paddle:
-[batch, seqlen, num_heads, head_dim].
+TPU-native: the hot path is the Pallas flash-attention kernel in
+paddle_tpu/ops/pallas_kernels.py (online softmax, MXU-tiled q/k blocks,
+hand-written flash backward via jax.custom_vjp).  On non-TPU backends
+(tests run on XLA-CPU) the XLA composite below is used — the Pallas kernel
+itself is validated on CPU in interpret mode by tests/test_pallas_kernels.
+Layout convention matches Paddle: [batch, seqlen, num_heads, head_dim].
 """
 from __future__ import annotations
 
@@ -23,7 +24,7 @@ __all__ = ["scaled_dot_product_attention", "flash_attention",
            "flash_attn_unpadded", "sdp_kernel"]
 
 
-def _sdpa_ref(q, k, v, bias, causal, scale, dropout_p=0.0):
+def _sdpa_ref(q, k, v, bias, causal, scale, dropout_p=0.0, key=None):
     """XLA-composite attention: [B, S, H, D] layout, f32 softmax."""
     B, Sq, H, D = q.shape
     Sk = k.shape[1]
@@ -38,38 +39,76 @@ def _sdpa_ref(q, k, v, bias, causal, scale, dropout_p=0.0):
         mask = jnp.tril(jnp.ones((Sq, Sk), bool), k=Sk - Sq)
         scores = jnp.where(mask, scores, jnp.asarray(-1e30, scores.dtype))
     probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+    if bias is not None or causal:
+        # fully-masked rows: softmax returns uniform 1/Sk — zero them so
+        # rows with no visible keys output 0 (matches the Pallas kernel
+        # and prevents cross-sequence leakage in the varlen path)
+        any_visible = jnp.any(scores > -1e29, axis=-1, keepdims=True)
+        probs = jnp.where(any_visible, probs, jnp.zeros((), probs.dtype))
+    if dropout_p > 0.0 and key is not None:
+        keep = jax.random.bernoulli(key, 1.0 - dropout_p, probs.shape)
+        probs = jnp.where(keep, probs / (1.0 - dropout_p),
+                          jnp.zeros((), probs.dtype))
     out = jnp.einsum("bhqk,bhkd->bhqd", probs, vt,
                      preferred_element_type=jnp.float32).astype(q.dtype)
     return jnp.swapaxes(out, 1, 2)
 
 
-def _use_pallas(q_shape, head_dim):
-    try:
-        import jax
-        if jax.default_backend() != "tpu":
-            return False
-        # MXU tiling wants head_dim and seq multiples of (8,128) lanes
-        return head_dim % 128 == 0 and q_shape[1] % 128 == 0
-    except Exception:
+def _use_pallas(head_dim, seqlen_k, dtype) -> bool:
+    """Gate the Mosaic kernel: TPU backend, MXU-friendly head_dim, and a
+    K/V working set that fits VMEM.
+
+    head_dim does not need to be a multiple of 128 — the kernel keeps D as
+    the lane dim and Mosaic pads to 128 lanes, so 64/96/128/256 all work
+    (the old `head_dim % 128 == 0` gate excluded nearly every real model).
+    The kernel currently stages the full K and V for one (batch, head) in
+    VMEM; cap that at ~8MB so long sequences fall back to the XLA
+    composite instead of failing Mosaic compilation (ring attention is
+    the long-context path).
+    """
+    if jax.default_backend() != "tpu":
         return False
+    from ...core.dtypes import to_jax_dtype
+    jd = jnp.dtype(to_jax_dtype(dtype))
+    if jd not in (jnp.dtype(jnp.float32), jnp.dtype(jnp.bfloat16)):
+        return False
+    d_pad = max(head_dim, 128)  # Mosaic pads lanes to 128
+    kv_bytes = 2 * seqlen_k * d_pad * jd.itemsize
+    return head_dim <= 256 and kv_bytes <= 8 * 1024 * 1024
 
 
 def scaled_dot_product_attention(query, key, value, attn_mask=None,
                                  dropout_p=0.0, is_causal=False,
                                  training=True, name=None):
-    """Paddle-layout SDPA: q/k/v are [batch, seqlen, num_heads, head_dim]."""
+    """Paddle-layout SDPA: q/k/v are [batch, seqlen, num_heads, head_dim].
+
+    Attention dropout (dropout_p>0, training) uses the framework RNG via
+    the same generator-state threading as F.dropout; the Pallas kernel
+    has no dropout path, so dropout falls back to the XLA composite.
+    """
     scale = 1.0 / (query.shape[-1] ** 0.5)
-    use_pallas = _use_pallas(tuple(query.shape), query.shape[-1])
+    drop = float(dropout_p) if training else 0.0
+    use_pallas = drop == 0.0 and _use_pallas(query.shape[-1],
+                                             key.shape[1], query.dtype)
+
+    if drop > 0.0:
+        from .common import _rng_op
+
+        def impl_drop(key_arr, q, k, v, *mask, causal, scale, p):
+            bias = mask[0] if mask else None
+            return _sdpa_ref(q, k, v, bias, causal, scale, p, key_arr)
+
+        args = (query, key, value) + ((attn_mask,)
+                                      if attn_mask is not None else ())
+        return _rng_op("scaled_dot_product_attention_drop", impl_drop,
+                       args, dict(causal=bool(is_causal), scale=scale,
+                                  p=drop))
 
     def impl(q, k, v, *mask, causal, scale, use_pallas):
         bias = mask[0] if mask else None
         if use_pallas and bias is None:
-            from ...ops.pallas_kernels import flash_attention_fwd
-            try:
-                return flash_attention_fwd(q, k, v, causal=causal,
-                                           scale=scale)
-            except Exception:
-                pass
+            from ...ops.pallas_kernels import flash_attention
+            return flash_attention(q, k, v, causal=causal, scale=scale)
         return _sdpa_ref(q, k, v, bias, causal, scale)
 
     args = (query, key, value) + ((attn_mask,) if attn_mask is not None
@@ -93,11 +132,65 @@ def flash_attn_unpadded(query, key, value, cu_seqlens_q, cu_seqlens_k,
                         max_seqlen_q, max_seqlen_k, scale, dropout=0.0,
                         causal=False, return_softmax=False, training=True,
                         name=None):
-    # varlen attention: fall back to dense with padding mask derived from
-    # cu_seqlens (tests use equal lengths).
-    out = scaled_dot_product_attention(query, key, value, None, dropout,
-                                       causal, training)
-    return out, None
+    """Varlen attention over packed sequences.
+
+    query/key/value: [total_tokens, num_heads, head_dim] with sequences
+    concatenated; cu_seqlens_*: int32 [batch+1] prefix sums of lengths.
+    Tokens only attend within their own sequence (block-diagonal mask
+    derived from cu_seqlens), optionally causal within each sequence —
+    matching the reference's flash_attn_varlen semantics.
+
+    Memory note: this composite materializes [total_q, total_k] scores
+    (the mask itself stays boolean), so very large packed batches should
+    be chunked by the caller; a tiled varlen Pallas kernel is the
+    long-term path.
+    """
+    drop = float(dropout) if training else 0.0
+
+    def impl_with_key(key_arr, q, k, v, cu_q, cu_k, *, causal, scale, p):
+        tq, h, d = q.shape
+        tk = k.shape[0]
+        pos_q = jnp.arange(tq)
+        pos_k = jnp.arange(tk)
+        # sequence id of each packed token: index of the bucket it falls in
+        seg_q = jnp.searchsorted(cu_q, pos_q, side="right") - 1
+        seg_k = jnp.searchsorted(cu_k, pos_k, side="right") - 1
+        same = seg_q[:, None] == seg_k[None, :]
+        if causal:
+            # position within own sequence
+            off_q = pos_q - jnp.take(cu_q, seg_q)
+            off_k = pos_k - jnp.take(cu_k, seg_k)
+            same = jnp.logical_and(same,
+                                   off_k[None, :] <= off_q[:, None])
+        qt = jnp.swapaxes(q[None], 1, 2)
+        kt = jnp.swapaxes(k[None], 1, 2)
+        vt = jnp.swapaxes(v[None], 1, 2)
+        scores = jnp.einsum("bhqd,bhkd->bhqk", qt, kt,
+                            preferred_element_type=jnp.float32) * scale
+        scores = jnp.where(same[None, None], scores,
+                           jnp.asarray(-1e30, scores.dtype))
+        probs = jax.nn.softmax(scores, axis=-1)
+        any_visible = jnp.any(same, axis=-1)[None, None, :, None]
+        probs = jnp.where(any_visible, probs, 0.0).astype(q.dtype)
+        if p > 0.0:
+            keep = jax.random.bernoulli(key_arr, 1.0 - p, probs.shape)
+            probs = jnp.where(keep, probs / (1.0 - p),
+                              jnp.zeros((), probs.dtype))
+        out = jnp.einsum("bhqk,bhkd->bhqd", probs, vt,
+                         preferred_element_type=jnp.float32)
+        return jnp.swapaxes(out, 1, 2)[0].astype(q.dtype)
+
+    tensors = (query, key, value, cu_seqlens_q, cu_seqlens_k)
+    attrs = dict(causal=bool(causal), scale=float(scale), p=drop)
+    if drop > 0.0:
+        from .common import _rng_op
+        return _rng_op("flash_attn_unpadded_drop", impl_with_key, tensors,
+                       attrs), None
+
+    def impl(*args, **at):
+        return impl_with_key(None, *args, **at)
+
+    return dispatch("flash_attn_unpadded", impl, tensors, attrs), None
 
 
 class sdp_kernel:

@@ -35,8 +35,28 @@ def _reduce(out, reduction):
 def cross_entropy(input, label, weight=None, ignore_index=-100,
                   reduction="mean", soft_label=False, axis=-1,
                   use_softmax=True, label_smoothing=0.0, name=None):
+    # Hard-label fast path → Pallas fused softmax-xent on TPU (the
+    # reference's fused c_softmax_with_cross_entropy kernel role).
+    use_fused = (jax.default_backend() == "tpu" and not soft_label
+                 and weight is None and label_smoothing == 0.0
+                 and use_softmax and axis in (-1, input.ndim - 1))
+
     def impl(logits, lab, *w, ignore_index, reduction, soft_label, axis,
-             use_softmax, smooth):
+             use_softmax, smooth, use_fused=False):
+        if use_fused:
+            from ...ops.pallas_kernels import fused_softmax_cross_entropy
+            lab_i = lab
+            if lab_i.ndim == logits.ndim and lab_i.shape[-1] == 1:
+                lab_i = jnp.squeeze(lab_i, -1)
+            valid = lab_i != ignore_index
+            relabeled = jnp.where(valid, lab_i, -1)  # kernel ignores <0
+            loss = fused_softmax_cross_entropy(logits, relabeled)
+            if reduction == "mean":
+                denom = jnp.sum(valid.astype(loss.dtype))
+                return jnp.sum(loss) / jnp.maximum(denom, 1.0)
+            if reduction == "sum":
+                return jnp.sum(loss)
+            return loss
         if use_softmax:
             logp = jax.nn.log_softmax(
                 logits.astype(jnp.float32)
@@ -85,7 +105,8 @@ def cross_entropy(input, label, weight=None, ignore_index=-100,
                     dict(ignore_index=int(ignore_index), reduction=reduction,
                          soft_label=bool(soft_label), axis=int(axis),
                          use_softmax=bool(use_softmax),
-                         smooth=float(label_smoothing)))
+                         smooth=float(label_smoothing),
+                         use_fused=use_fused))
 
 
 def softmax_with_cross_entropy(logits, label, soft_label=False,
@@ -96,8 +117,7 @@ def softmax_with_cross_entropy(logits, label, soft_label=False,
                          axis=axis)
     # paddle keeps the reduced axis with size 1
     from ...ops.manipulation import unsqueeze
-    loss = unsqueeze(loss, axis if axis >= 0 else loss.ndim + 1 + axis
-                     if False else -1)
+    loss = unsqueeze(loss, axis if axis >= 0 else loss.ndim + 1 + axis)
     if return_softmax:
         from .activation import softmax as _softmax
         return loss, _softmax(logits, axis=axis)

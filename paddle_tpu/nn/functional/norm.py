@@ -23,8 +23,13 @@ def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-05,
     if isinstance(normalized_shape, int):
         normalized_shape = (normalized_shape,)
     naxes = len(tuple(normalized_shape))
+    use_pallas = (naxes == 1 and weight is not None and bias is not None
+                  and jax.default_backend() == "tpu")
 
-    def impl(v, *wb, eps, naxes, has_w, has_b):
+    def impl(v, *wb, eps, naxes, has_w, has_b, use_pallas=False):
+        if use_pallas:
+            from ...ops.pallas_kernels import fused_layer_norm
+            return fused_layer_norm(v, wb[0], wb[1], eps=eps)
         axes = tuple(range(v.ndim - naxes, v.ndim))
         # accumulate stats in f32 for bf16 inputs (TPU numerics)
         vf = v.astype(jnp.float32) if v.dtype in (jnp.bfloat16,
@@ -44,11 +49,17 @@ def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-05,
     args = (x,) + tuple(t for t in (weight, bias) if t is not None)
     return dispatch("layer_norm", impl, args,
                     dict(eps=float(epsilon), naxes=naxes,
-                         has_w=weight is not None, has_b=bias is not None))
+                         has_w=weight is not None, has_b=bias is not None,
+                         use_pallas=use_pallas))
 
 
 def rms_norm(x, weight=None, epsilon=1e-6, name=None):
-    def impl(v, *wb, eps):
+    use_pallas = weight is not None and jax.default_backend() == "tpu"
+
+    def impl(v, *wb, eps, use_pallas=False):
+        if use_pallas:
+            from ...ops.pallas_kernels import fused_rms_norm
+            return fused_rms_norm(v, wb[0], eps=eps)
         vf = v.astype(jnp.float32) if v.dtype in (jnp.bfloat16,
                                                   jnp.float16) else v
         ms = jnp.mean(jnp.square(vf), axis=-1, keepdims=True)
@@ -58,7 +69,8 @@ def rms_norm(x, weight=None, epsilon=1e-6, name=None):
         return out
 
     args = (x,) + ((weight,) if weight is not None else ())
-    return dispatch("rms_norm", impl, args, dict(eps=float(epsilon)))
+    return dispatch("rms_norm", impl, args,
+                    dict(eps=float(epsilon), use_pallas=use_pallas))
 
 
 def batch_norm(x, running_mean, running_var, weight=None, bias=None,

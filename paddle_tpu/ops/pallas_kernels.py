@@ -1,0 +1,669 @@
+"""Pallas TPU kernels for the hot op set.
+
+Reference parity: the reference implements these as hand-written CUDA in
+`paddle/phi/kernels/gpu/` — `flash_attn_kernel.cu` (wrapping
+third_party/flashattn), `layer_norm_kernel.cu`, `rms_norm_kernel.cu`,
+`c_softmax_with_cross_entropy_op.cu` [UNVERIFIED — empty reference mount;
+upstream-layout paths per SURVEY.md §2.1].
+
+TPU-native design: each kernel is a `pl.pallas_call` tiled for the MXU/VPU
+(blocks of 128 lanes, f32 accumulation in VMEM) wrapped in
+`jax.custom_vjp` so both the eager tape (jax.vjp in core/dispatch.py) and
+`to_static` (jax.jit) differentiate through the hand-written backward.
+
+On non-TPU backends (tests run on XLA-CPU) the same kernels execute in
+Pallas interpret mode, so numerics are validated everywhere the suite
+runs; on TPU they compile via Mosaic.
+
+Conventions:
+  * attention layout inside the kernels is [batch*heads, seq, head_dim]
+    (callers convert from Paddle's [B, S, H, D]);
+  * sequence dims are padded to a multiple of the block size here, with
+    padding masked inside the kernels (cols → -inf, padded lse → +inf);
+  * all softmax/variance math runs in float32 regardless of input dtype.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+try:  # pltpu is importable on CPU builds of jax as well
+    from jax.experimental.pallas import tpu as pltpu
+except ImportError:  # pragma: no cover
+    pltpu = None
+
+__all__ = [
+    "flash_attention",
+    "fused_layer_norm",
+    "fused_rms_norm",
+    "fused_softmax_cross_entropy",
+]
+
+_NEG_INF = -1e30
+
+
+def _interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+def _round_up(x: int, m: int) -> int:
+    return ((x + m - 1) // m) * m
+
+
+def _pad_dim(x, dim, target, value=0.0):
+    pad = target - x.shape[dim]
+    if pad == 0:
+        return x
+    widths = [(0, 0)] * x.ndim
+    widths[dim] = (0, pad)
+    return jnp.pad(x, widths, constant_values=value)
+
+
+# =====================================================================
+# Flash attention
+# =====================================================================
+
+def _attn_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *,
+                     scale, causal, block_k, sk_real, offset):
+    """One (batch*head, q-block) program: online-softmax over K blocks."""
+    q = q_ref[0].astype(jnp.float32)                     # (block_q, D)
+    block_q, _ = q.shape
+    sk_pad = k_ref.shape[1]
+    q_start = pl.program_id(1) * block_q
+
+    num_k_blocks = sk_pad // block_k
+    if causal:
+        # highest kv index any row in this q block may attend to
+        hi = q_start + block_q + offset
+        num_k_blocks = jnp.minimum(
+            num_k_blocks, (jnp.maximum(hi, 0) + block_k - 1) // block_k)
+
+    def body(i, carry):
+        m_prev, l_prev, acc = carry
+        k_blk = k_ref[0, pl.ds(i * block_k, block_k), :].astype(jnp.float32)
+        v_blk = v_ref[0, pl.ds(i * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale  # (bq, bk)
+        col = jax.lax.broadcasted_iota(jnp.int32, s.shape, 1) + i * block_k
+        mask = col < sk_real                              # K padding
+        if causal:
+            row = jax.lax.broadcasted_iota(jnp.int32, s.shape, 0) + q_start
+            mask = jnp.logical_and(mask, col <= row + offset)
+        s = jnp.where(mask, s, _NEG_INF)
+        m_cur = jnp.max(s, axis=-1)
+        m_new = jnp.maximum(m_prev, m_cur)
+        # explicit zero on masked cols: for a fully-masked row s == m_new
+        # == _NEG_INF and exp(s - m_new) would be 1, not 0
+        p = jnp.where(mask, jnp.exp(s - m_new[:, None]), 0.0)
+        alpha = jnp.exp(m_prev - m_new)
+        l_new = alpha * l_prev + jnp.sum(p, axis=-1)
+        acc = acc * alpha[:, None] + jax.lax.dot_general(
+            p, v_blk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return m_new, l_new, acc
+
+    m0 = jnp.full((block_q,), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((block_q,), jnp.float32)
+    acc0 = jnp.zeros((block_q, q.shape[-1]), jnp.float32)
+    m, l, acc = jax.lax.fori_loop(0, num_k_blocks, body, (m0, l0, acc0))
+    l_safe = jnp.where(l == 0.0, 1.0, l)
+    o_ref[0] = (acc / l_safe[:, None]).astype(o_ref.dtype)
+    lse_ref[0] = jnp.where(l == 0.0, _NEG_INF, m + jnp.log(l_safe))
+
+
+def _attn_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                        dq_ref, *, scale, causal, block_k, sk_real, offset):
+    q = q_ref[0].astype(jnp.float32)
+    do = do_ref[0].astype(jnp.float32)
+    lse = lse_ref[0]
+    delta = delta_ref[0]
+    block_q = q.shape[0]
+    sk_pad = k_ref.shape[1]
+    q_start = pl.program_id(1) * block_q
+
+    num_k_blocks = sk_pad // block_k
+    if causal:
+        hi = q_start + block_q + offset
+        num_k_blocks = jnp.minimum(
+            num_k_blocks, (jnp.maximum(hi, 0) + block_k - 1) // block_k)
+
+    def body(i, dq):
+        k_blk = k_ref[0, pl.ds(i * block_k, block_k), :].astype(jnp.float32)
+        v_blk = v_ref[0, pl.ds(i * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+        col = jax.lax.broadcasted_iota(jnp.int32, s.shape, 1) + i * block_k
+        mask = col < sk_real
+        if causal:
+            row = jax.lax.broadcasted_iota(jnp.int32, s.shape, 0) + q_start
+            mask = jnp.logical_and(mask, col <= row + offset)
+        s = jnp.where(mask, s, _NEG_INF)
+        p = jnp.exp(s - lse[:, None])                    # (bq, bk)
+        dp = jax.lax.dot_general(
+            do, v_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        ds = p * (dp - delta[:, None]) * scale
+        return dq + jax.lax.dot_general(
+            ds, k_blk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    dq0 = jnp.zeros_like(q)
+    dq = jax.lax.fori_loop(0, num_k_blocks, body, dq0)
+    dq_ref[0] = dq.astype(dq_ref.dtype)
+
+
+def _attn_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                         dk_ref, dv_ref, *, scale, causal, block_q,
+                         sq_real, offset):
+    k = k_ref[0].astype(jnp.float32)                     # (block_k, D)
+    v = v_ref[0].astype(jnp.float32)
+    block_k = k.shape[0]
+    sq_pad = q_ref.shape[1]
+    k_start = pl.program_id(1) * block_k
+
+    lo = 0
+    num_q_blocks = sq_pad // block_q
+    if causal:
+        # first q row that can see this k block: row >= k_start - offset
+        lo = jnp.maximum(k_start - offset, 0) // block_q
+
+    def body(i, carry):
+        dk, dv = carry
+        q_blk = q_ref[0, pl.ds(i * block_q, block_q), :].astype(jnp.float32)
+        do_blk = do_ref[0, pl.ds(i * block_q, block_q), :].astype(
+            jnp.float32)
+        lse_blk = lse_ref[0, pl.ds(i * block_q, block_q)]
+        delta_blk = delta_ref[0, pl.ds(i * block_q, block_q)]
+        s = jax.lax.dot_general(
+            q_blk, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale  # (bq, bk)
+        row = jax.lax.broadcasted_iota(jnp.int32, s.shape, 0) + i * block_q
+        mask = row < sq_real
+        if causal:
+            col = jax.lax.broadcasted_iota(jnp.int32, s.shape, 1) + k_start
+            mask = jnp.logical_and(mask, col <= row + offset)
+        s = jnp.where(mask, s, _NEG_INF)
+        p = jnp.exp(s - lse_blk[:, None])
+        dv = dv + jax.lax.dot_general(
+            p, do_blk, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(
+            do_blk, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        ds = p * (dp - delta_blk[:, None]) * scale
+        dk = dk + jax.lax.dot_general(
+            ds, q_blk, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return dk, dv
+
+    dk0 = jnp.zeros_like(k)
+    dv0 = jnp.zeros_like(v)
+    dk, dv = jax.lax.fori_loop(lo, num_q_blocks, body, (dk0, dv0))
+    dk_ref[0] = dk.astype(dk_ref.dtype)
+    dv_ref[0] = dv.astype(dv_ref.dtype)
+
+
+def _flash_fwd(q, k, v, scale, causal, sq_real, sk_real, block_q, block_k):
+    bh, sq_pad, d = q.shape
+    sk_pad = k.shape[1]
+    offset = sk_real - sq_real  # causal alignment for cross-length attn
+    grid = (bh, sq_pad // block_q)
+    out, lse = pl.pallas_call(
+        functools.partial(_attn_fwd_kernel, scale=scale, causal=causal,
+                          block_k=block_k, sk_real=sk_real, offset=offset),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, sk_pad, d), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, sk_pad, d), lambda b, i: (b, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, block_q), lambda b, i: (b, i)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, sq_pad, d), q.dtype),
+            jax.ShapeDtypeStruct((bh, sq_pad), jnp.float32),
+        ],
+        interpret=_interpret(),
+    )(q, k, v)
+    return out, lse
+
+
+def _flash_bwd(q, k, v, do, out, lse, scale, causal, sq_real, sk_real,
+               block_q, block_k):
+    bh, sq_pad, d = q.shape
+    sk_pad = k.shape[1]
+    offset = sk_real - sq_real
+    delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32),
+                    axis=-1)                              # (BH, Sq_pad)
+    # p = exp(s - lse) must be 0 wherever a row has no visible keys:
+    # padded q rows AND real rows the causal mask empties (Sq > Sk case,
+    # forward stored lse = _NEG_INF there).  Force lse huge so exp → 0.
+    row = jnp.arange(sq_pad)[None, :]
+    empty = jnp.logical_or(row >= sq_real, lse <= _NEG_INF / 2)
+    lse_safe = jnp.where(empty, jnp.float32(1e30), lse)
+    dq = pl.pallas_call(
+        functools.partial(_attn_bwd_dq_kernel, scale=scale, causal=causal,
+                          block_k=block_k, sk_real=sk_real, offset=offset),
+        grid=(bh, sq_pad // block_q),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, sk_pad, d), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, sk_pad, d), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, block_q), lambda b, i: (b, i)),
+            pl.BlockSpec((1, block_q), lambda b, i: (b, i)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((bh, sq_pad, d), q.dtype),
+        interpret=_interpret(),
+    )(q, k, v, do, lse_safe, delta)
+    dk, dv = pl.pallas_call(
+        functools.partial(_attn_bwd_dkv_kernel, scale=scale, causal=causal,
+                          block_q=block_q, sq_real=sq_real, offset=offset),
+        grid=(bh, sk_pad // block_k),
+        in_specs=[
+            pl.BlockSpec((1, sq_pad, d), lambda b, j: (b, 0, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, j: (b, j, 0)),
+            pl.BlockSpec((1, sq_pad, d), lambda b, j: (b, 0, 0)),
+            pl.BlockSpec((1, sq_pad), lambda b, j: (b, 0)),
+            pl.BlockSpec((1, sq_pad), lambda b, j: (b, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_k, d), lambda b, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, j: (b, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, sk_pad, d), k.dtype),
+            jax.ShapeDtypeStruct((bh, sk_pad, d), v.dtype),
+        ],
+        interpret=_interpret(),
+    )(q, k, v, do, lse_safe, delta)
+    return dq, dk, dv
+
+
+def _pick_block(seq: int) -> int:
+    return 128 if seq >= 128 else _round_up(max(seq, 8), 8)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def _flash_attention_bhsd(q, k, v, scale, causal):
+    out, _ = _flash_attention_bhsd_fwd(q, k, v, scale, causal)
+    return out
+
+
+def _flash_attention_bhsd_fwd(q, k, v, scale, causal):
+    bh, sq, d = q.shape
+    sk = k.shape[1]
+    block_q = _pick_block(sq)
+    block_k = _pick_block(sk)
+    qp = _pad_dim(q, 1, _round_up(sq, block_q))
+    kp = _pad_dim(k, 1, _round_up(sk, block_k))
+    vp = _pad_dim(v, 1, _round_up(sk, block_k))
+    out, lse = _flash_fwd(qp, kp, vp, scale, causal, sq, sk,
+                          block_q, block_k)
+    return out[:, :sq], (q, k, v, out, lse)
+
+
+def _flash_attention_bhsd_bwd(scale, causal, res, g):
+    q, k, v, out_pad, lse = res
+    bh, sq, d = q.shape
+    sk = k.shape[1]
+    block_q = _pick_block(sq)
+    block_k = _pick_block(sk)
+    qp = _pad_dim(q, 1, _round_up(sq, block_q))
+    kp = _pad_dim(k, 1, _round_up(sk, block_k))
+    vp = _pad_dim(v, 1, _round_up(sk, block_k))
+    gp = _pad_dim(g, 1, _round_up(sq, block_q))
+    dq, dk, dv = _flash_bwd(qp, kp, vp, gp, out_pad, lse, scale, causal,
+                            sq, sk, block_q, block_k)
+    return dq[:, :sq], dk[:, :sk], dv[:, :sk]
+
+
+_flash_attention_bhsd.defvjp(_flash_attention_bhsd_fwd,
+                             _flash_attention_bhsd_bwd)
+
+
+def flash_attention(q, k, v, *, causal=False, scale=None):
+    """Flash attention over Paddle layout [B, S, H, D]; differentiable.
+
+    Online-softmax tiled for the MXU with a hand-written flash backward
+    (the reference's flash_attn_kernel.cu + flash_attn_grad role).
+    Supports head_dim not a multiple of 128 (Mosaic pads lanes), uneven
+    sequence lengths (padded + masked here), causal cross-attention
+    (Sk != Sq aligned bottom-right, matching flash-attn semantics).
+    """
+    if scale is None:
+        scale = 1.0 / (q.shape[-1] ** 0.5)
+    b, sq, h, d = q.shape
+    sk = k.shape[1]
+    qt = jnp.swapaxes(q, 1, 2).reshape(b * h, sq, d)
+    kt = jnp.swapaxes(k, 1, 2).reshape(b * h, sk, d)
+    vt = jnp.swapaxes(v, 1, 2).reshape(b * h, sk, d)
+    out = _flash_attention_bhsd(qt, kt, vt, float(scale), bool(causal))
+    return jnp.swapaxes(out.reshape(b, h, sq, d), 1, 2)
+
+
+# =====================================================================
+# Fused layer norm / rms norm
+# =====================================================================
+
+def _ln_fwd_kernel(x_ref, g_ref, b_ref, o_ref, mu_ref, rstd_ref, *, eps):
+    x = x_ref[:].astype(jnp.float32)                    # (block_rows, N)
+    mu = jnp.mean(x, axis=-1)
+    xc = x - mu[:, None]
+    var = jnp.mean(xc * xc, axis=-1)
+    rstd = jax.lax.rsqrt(var + eps)
+    xhat = xc * rstd[:, None]
+    o_ref[:] = (xhat * g_ref[:].astype(jnp.float32)
+                + b_ref[:].astype(jnp.float32)).astype(o_ref.dtype)
+    mu_ref[:] = mu
+    rstd_ref[:] = rstd
+
+
+def _ln_bwd_kernel(x_ref, g_ref, mu_ref, rstd_ref, do_ref,
+                   dx_ref, dg_ref, db_ref):
+    x = x_ref[:].astype(jnp.float32)
+    do = do_ref[:].astype(jnp.float32)
+    gamma = g_ref[:].astype(jnp.float32)
+    mu = mu_ref[:]
+    rstd = rstd_ref[:]
+    n = x.shape[-1]
+    xhat = (x - mu[:, None]) * rstd[:, None]
+    dg_ref[:] = jnp.sum(do * xhat, axis=0, keepdims=True)
+    db_ref[:] = jnp.sum(do, axis=0, keepdims=True)
+    dxhat = do * gamma
+    m1 = jnp.mean(dxhat, axis=-1, keepdims=True)
+    m2 = jnp.mean(dxhat * xhat, axis=-1, keepdims=True)
+    dx = (dxhat - m1 - xhat * m2) * rstd[:, None]
+    dx_ref[:] = dx.astype(dx_ref.dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
+def _fused_layer_norm_2d(x, gamma, beta, eps):
+    return _fused_layer_norm_2d_fwd(x, gamma, beta, eps)[0]
+
+
+def _ln_block_rows(rows, n, itemsize=4):
+    # keep a block under ~2MB of f32 VMEM working set
+    budget = max(1, (2 << 20) // max(n * itemsize, 1))
+    return min(rows, max(8, min(512, _round_up(budget, 8))))
+
+
+def _fused_layer_norm_2d_fwd(x, gamma, beta, eps):
+    rows, n = x.shape
+    br = _ln_block_rows(rows, n)
+    rows_pad = _round_up(rows, br)
+    xp = _pad_dim(x, 0, rows_pad)
+    out, mu, rstd = pl.pallas_call(
+        functools.partial(_ln_fwd_kernel, eps=eps),
+        grid=(rows_pad // br,),
+        in_specs=[
+            pl.BlockSpec((br, n), lambda i: (i, 0)),
+            pl.BlockSpec((n,), lambda i: (0,)),
+            pl.BlockSpec((n,), lambda i: (0,)),
+        ],
+        out_specs=[
+            pl.BlockSpec((br, n), lambda i: (i, 0)),
+            pl.BlockSpec((br,), lambda i: (i,)),
+            pl.BlockSpec((br,), lambda i: (i,)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((rows_pad, n), x.dtype),
+            jax.ShapeDtypeStruct((rows_pad,), jnp.float32),
+            jax.ShapeDtypeStruct((rows_pad,), jnp.float32),
+        ],
+        interpret=_interpret(),
+    )(xp, gamma, beta)
+    return out[:rows], (x, gamma, mu, rstd)
+
+
+def _fused_layer_norm_2d_bwd(eps, res, do):
+    x, gamma, mu, rstd = res
+    rows, n = x.shape
+    br = _ln_block_rows(rows, n)
+    rows_pad = _round_up(rows, br)
+    nb = rows_pad // br
+    xp = _pad_dim(x, 0, rows_pad)
+    dop = _pad_dim(do, 0, rows_pad)
+    dx, dg_part, db_part = pl.pallas_call(
+        _ln_bwd_kernel,
+        grid=(nb,),
+        in_specs=[
+            pl.BlockSpec((br, n), lambda i: (i, 0)),
+            pl.BlockSpec((n,), lambda i: (0,)),
+            pl.BlockSpec((br,), lambda i: (i,)),
+            pl.BlockSpec((br,), lambda i: (i,)),
+            pl.BlockSpec((br, n), lambda i: (i, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((br, n), lambda i: (i, 0)),
+            pl.BlockSpec((1, n), lambda i: (i, 0)),
+            pl.BlockSpec((1, n), lambda i: (i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((rows_pad, n), x.dtype),
+            jax.ShapeDtypeStruct((nb, n), jnp.float32),
+            jax.ShapeDtypeStruct((nb, n), jnp.float32),
+        ],
+        interpret=_interpret(),
+    )(xp, gamma, mu, rstd, dop)
+    dgamma = jnp.sum(dg_part, axis=0).astype(gamma.dtype)
+    dbeta = jnp.sum(db_part, axis=0).astype(gamma.dtype)
+    return dx[:rows], dgamma, dbeta
+
+
+_fused_layer_norm_2d.defvjp(_fused_layer_norm_2d_fwd,
+                            _fused_layer_norm_2d_bwd)
+
+
+def fused_layer_norm(x, gamma, beta, eps=1e-5):
+    """LayerNorm over the last dim, any leading shape; differentiable."""
+    shape = x.shape
+    n = shape[-1]
+    out = _fused_layer_norm_2d(x.reshape(-1, n), gamma, beta, float(eps))
+    return out.reshape(shape)
+
+
+def _rms_fwd_kernel(x_ref, g_ref, o_ref, rstd_ref, *, eps):
+    x = x_ref[:].astype(jnp.float32)
+    ms = jnp.mean(x * x, axis=-1)
+    rstd = jax.lax.rsqrt(ms + eps)
+    o_ref[:] = (x * rstd[:, None] * g_ref[:].astype(jnp.float32)).astype(
+        o_ref.dtype)
+    rstd_ref[:] = rstd
+
+
+def _rms_bwd_kernel(x_ref, g_ref, rstd_ref, do_ref, dx_ref, dg_ref):
+    x = x_ref[:].astype(jnp.float32)
+    do = do_ref[:].astype(jnp.float32)
+    gamma = g_ref[:].astype(jnp.float32)
+    rstd = rstd_ref[:]
+    n = x.shape[-1]
+    xhat = x * rstd[:, None]
+    dg_ref[:] = jnp.sum(do * xhat, axis=0, keepdims=True)
+    dxhat = do * gamma
+    m = jnp.mean(dxhat * xhat, axis=-1, keepdims=True)
+    dx = (dxhat - xhat * m) * rstd[:, None]
+    dx_ref[:] = dx.astype(dx_ref.dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2,))
+def _fused_rms_norm_2d(x, gamma, eps):
+    return _fused_rms_norm_2d_fwd(x, gamma, eps)[0]
+
+
+def _fused_rms_norm_2d_fwd(x, gamma, eps):
+    rows, n = x.shape
+    br = _ln_block_rows(rows, n)
+    rows_pad = _round_up(rows, br)
+    xp = _pad_dim(x, 0, rows_pad)
+    out, rstd = pl.pallas_call(
+        functools.partial(_rms_fwd_kernel, eps=eps),
+        grid=(rows_pad // br,),
+        in_specs=[
+            pl.BlockSpec((br, n), lambda i: (i, 0)),
+            pl.BlockSpec((n,), lambda i: (0,)),
+        ],
+        out_specs=[
+            pl.BlockSpec((br, n), lambda i: (i, 0)),
+            pl.BlockSpec((br,), lambda i: (i,)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((rows_pad, n), x.dtype),
+            jax.ShapeDtypeStruct((rows_pad,), jnp.float32),
+        ],
+        interpret=_interpret(),
+    )(xp, gamma)
+    return out[:rows], (x, gamma, rstd)
+
+
+def _fused_rms_norm_2d_bwd(eps, res, do):
+    x, gamma, rstd = res
+    rows, n = x.shape
+    br = _ln_block_rows(rows, n)
+    rows_pad = _round_up(rows, br)
+    nb = rows_pad // br
+    xp = _pad_dim(x, 0, rows_pad)
+    dop = _pad_dim(do, 0, rows_pad)
+    dx, dg_part = pl.pallas_call(
+        _rms_bwd_kernel,
+        grid=(nb,),
+        in_specs=[
+            pl.BlockSpec((br, n), lambda i: (i, 0)),
+            pl.BlockSpec((n,), lambda i: (0,)),
+            pl.BlockSpec((br,), lambda i: (i,)),
+            pl.BlockSpec((br, n), lambda i: (i, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((br, n), lambda i: (i, 0)),
+            pl.BlockSpec((1, n), lambda i: (i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((rows_pad, n), x.dtype),
+            jax.ShapeDtypeStruct((nb, n), jnp.float32),
+        ],
+        interpret=_interpret(),
+    )(xp, gamma, rstd, dop)
+    dgamma = jnp.sum(dg_part, axis=0).astype(gamma.dtype)
+    return dx[:rows], dgamma
+
+
+_fused_rms_norm_2d.defvjp(_fused_rms_norm_2d_fwd, _fused_rms_norm_2d_bwd)
+
+
+def fused_rms_norm(x, gamma, eps=1e-6):
+    """RMSNorm over the last dim, any leading shape; differentiable."""
+    shape = x.shape
+    n = shape[-1]
+    out = _fused_rms_norm_2d(x.reshape(-1, n), gamma, float(eps))
+    return out.reshape(shape)
+
+
+# =====================================================================
+# Fused softmax cross-entropy (from logits + integer labels)
+# =====================================================================
+
+def _xent_fwd_kernel(x_ref, lbl_ref, loss_ref, lse_ref):
+    x = x_ref[:].astype(jnp.float32)                   # (block_rows, V)
+    lbl = lbl_ref[:]                                   # (block_rows,)
+    m = jnp.max(x, axis=-1)
+    lse = m + jnp.log(jnp.sum(jnp.exp(x - m[:, None]), axis=-1))
+    col = jax.lax.broadcasted_iota(jnp.int32, x.shape, 1)
+    picked = jnp.sum(jnp.where(col == lbl[:, None], x, 0.0), axis=-1)
+    # ignore_index rows (lbl < 0) produce 0 loss
+    valid = lbl >= 0
+    loss_ref[:] = jnp.where(valid, lse - picked, 0.0)
+    lse_ref[:] = lse
+
+
+def _xent_bwd_kernel(x_ref, lbl_ref, lse_ref, g_ref, dx_ref):
+    x = x_ref[:].astype(jnp.float32)
+    lbl = lbl_ref[:]
+    lse = lse_ref[:]
+    g = g_ref[:]
+    p = jnp.exp(x - lse[:, None])
+    col = jax.lax.broadcasted_iota(jnp.int32, x.shape, 1)
+    onehot = (col == lbl[:, None]).astype(jnp.float32)
+    valid = (lbl >= 0).astype(jnp.float32)
+    dx = (p - onehot) * (g * valid)[:, None]
+    dx_ref[:] = dx.astype(dx_ref.dtype)
+
+
+@jax.custom_vjp
+def _fused_xent_2d(logits, labels):
+    return _fused_xent_2d_fwd(logits, labels)[0]
+
+
+def _fused_xent_2d_fwd(logits, labels):
+    rows, v = logits.shape
+    br = _ln_block_rows(rows, v)
+    rows_pad = _round_up(rows, br)
+    xp = _pad_dim(logits, 0, rows_pad)
+    lp = _pad_dim(labels.astype(jnp.int32), 0, rows_pad, value=-1)
+    loss, lse = pl.pallas_call(
+        _xent_fwd_kernel,
+        grid=(rows_pad // br,),
+        in_specs=[
+            pl.BlockSpec((br, v), lambda i: (i, 0)),
+            pl.BlockSpec((br,), lambda i: (i,)),
+        ],
+        out_specs=[
+            pl.BlockSpec((br,), lambda i: (i,)),
+            pl.BlockSpec((br,), lambda i: (i,)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((rows_pad,), jnp.float32),
+            jax.ShapeDtypeStruct((rows_pad,), jnp.float32),
+        ],
+        interpret=_interpret(),
+    )(xp, lp)
+    return loss[:rows], (logits, labels, lse[:rows])
+
+
+def _fused_xent_2d_bwd(res, g):
+    logits, labels, lse = res
+    rows, v = logits.shape
+    br = _ln_block_rows(rows, v)
+    rows_pad = _round_up(rows, br)
+    xp = _pad_dim(logits, 0, rows_pad)
+    lp = _pad_dim(labels.astype(jnp.int32), 0, rows_pad, value=-1)
+    lsep = _pad_dim(lse, 0, rows_pad)
+    gp = _pad_dim(g.astype(jnp.float32), 0, rows_pad)
+    dx = pl.pallas_call(
+        _xent_bwd_kernel,
+        grid=(rows_pad // br,),
+        in_specs=[
+            pl.BlockSpec((br, v), lambda i: (i, 0)),
+            pl.BlockSpec((br,), lambda i: (i,)),
+            pl.BlockSpec((br,), lambda i: (i,)),
+            pl.BlockSpec((br,), lambda i: (i,)),
+        ],
+        out_specs=pl.BlockSpec((br, v), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((rows_pad, v), logits.dtype),
+        interpret=_interpret(),
+    )(xp, lp, lsep, gp)
+    return dx[:rows], None
+
+
+_fused_xent_2d.defvjp(_fused_xent_2d_fwd, _fused_xent_2d_bwd)
+
+
+def fused_softmax_cross_entropy(logits, labels):
+    """Per-example softmax cross-entropy from integer labels.
+
+    logits: [..., V]; labels: [...] int. Labels < 0 are ignored (loss 0,
+    zero gradient), matching softmax_with_cross_entropy ignore_index
+    handling after relabeling.
+    """
+    shape = logits.shape
+    v = shape[-1]
+    loss = _fused_xent_2d(logits.reshape(-1, v), labels.reshape(-1))
+    return loss.reshape(shape[:-1])

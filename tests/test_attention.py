@@ -1,0 +1,95 @@
+"""Attention functionals: SDPA masking/dropout semantics + varlen."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.nn import functional as F
+
+
+def _np_attn(q, k, v, causal):
+    s, h, d = q.shape[1], q.shape[2], q.shape[3]
+    out = np.zeros_like(q)
+    for b in range(q.shape[0]):
+        for hh in range(h):
+            sc = q[b, :, hh] @ k[b, :, hh].T / np.sqrt(d)
+            if causal:
+                sk = k.shape[1]
+                mask = np.tril(np.ones((s, sk), bool), k=sk - s)
+                sc = np.where(mask, sc, -1e30)
+            e = np.exp(sc - sc.max(-1, keepdims=True))
+            p = e / e.sum(-1, keepdims=True)
+            out[b, :, hh] = p @ v[b, :, hh]
+    return out
+
+
+def test_sdpa_matches_numpy():
+    rng = np.random.RandomState(0)
+    q = rng.randn(2, 8, 2, 16).astype(np.float32)
+    k = rng.randn(2, 8, 2, 16).astype(np.float32)
+    v = rng.randn(2, 8, 2, 16).astype(np.float32)
+    out = F.scaled_dot_product_attention(
+        paddle.to_tensor(q), paddle.to_tensor(k), paddle.to_tensor(v),
+        is_causal=True)
+    np.testing.assert_allclose(out.numpy(), _np_attn(q, k, v, True),
+                               atol=1e-5)
+
+
+def test_sdpa_dropout_runs_and_differs():
+    rng = np.random.RandomState(1)
+    q = paddle.to_tensor(rng.randn(1, 16, 2, 8).astype(np.float32))
+    out1 = F.scaled_dot_product_attention(q, q, q, dropout_p=0.5,
+                                          training=True)
+    out2 = F.scaled_dot_product_attention(q, q, q, dropout_p=0.5,
+                                          training=True)
+    # stochastic masks differ between calls
+    assert not np.allclose(out1.numpy(), out2.numpy())
+    out3 = F.scaled_dot_product_attention(q, q, q, dropout_p=0.5,
+                                          training=False)
+    ref = F.scaled_dot_product_attention(q, q, q)
+    np.testing.assert_allclose(out3.numpy(), ref.numpy(), atol=1e-6)
+
+
+def test_flash_attn_unpadded_blocks_cross_sequence():
+    """Packed [3+5] tokens: attention must be block-diagonal per sequence
+    (regression: cu_seqlens used to be ignored entirely)."""
+    rng = np.random.RandomState(2)
+    lens = [3, 5]
+    total = sum(lens)
+    q = rng.randn(total, 2, 16).astype(np.float32)
+    k = rng.randn(total, 2, 16).astype(np.float32)
+    v = rng.randn(total, 2, 16).astype(np.float32)
+    cu = np.array([0, 3, 8], np.int32)
+    out, _ = F.flash_attn_unpadded(
+        paddle.to_tensor(q), paddle.to_tensor(k), paddle.to_tensor(v),
+        paddle.to_tensor(cu), paddle.to_tensor(cu),
+        max_seqlen_q=5, max_seqlen_k=5, scale=1.0 / 4.0)
+    # reference: each sequence attends only to itself
+    ref = np.zeros_like(q)
+    for a, b in zip(cu[:-1], cu[1:]):
+        qb = q[None, a:b]
+        ref[a:b] = _np_attn(qb, k[None, a:b], v[None, a:b], False)[0]
+    np.testing.assert_allclose(out.numpy(), ref, atol=1e-5)
+
+
+def test_flash_attn_unpadded_causal():
+    rng = np.random.RandomState(3)
+    cu = np.array([0, 4, 10], np.int32)
+    q = rng.randn(10, 1, 8).astype(np.float32)
+    out, _ = F.flash_attn_unpadded(
+        paddle.to_tensor(q), paddle.to_tensor(q), paddle.to_tensor(q),
+        paddle.to_tensor(cu), paddle.to_tensor(cu),
+        max_seqlen_q=6, max_seqlen_k=6, scale=1.0 / np.sqrt(8),
+        causal=True)
+    ref = np.zeros_like(q)
+    for a, b in zip(cu[:-1], cu[1:]):
+        qb = q[None, a:b]
+        ref[a:b] = _np_attn(qb, qb, qb, True)[0]
+    np.testing.assert_allclose(out.numpy(), ref, atol=1e-5)
+
+
+def test_flash_attention_api():
+    rng = np.random.RandomState(4)
+    q = paddle.to_tensor(rng.randn(2, 8, 2, 16).astype(np.float32))
+    out, _ = F.flash_attention(q, q, q, causal=True)
+    ref = _np_attn(q.numpy(), q.numpy(), q.numpy(), True)
+    np.testing.assert_allclose(out.numpy(), ref, atol=1e-5)

@@ -1,0 +1,200 @@
+"""Fleet parity tests on the 8-device CPU mesh: DP / TP / sharding / MoE
+train with the same losses as a single-device run (SURVEY.md §4's
+loss-parity strategy)."""
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+from jax.experimental.shard_map import shard_map
+
+import paddle_tpu as paddle
+import paddle_tpu.distributed as dist
+from paddle_tpu import nn, optimizer
+from paddle_tpu.core.tensor import Tensor
+from paddle_tpu.distributed.communication import group as group_mod
+
+
+def _reset_mesh(mesh=None):
+    dist.env.set_global_mesh(mesh)
+    group_mod._default_group = None
+
+
+@pytest.fixture(autouse=True)
+def _cleanup():
+    yield
+    _reset_mesh(None)
+
+
+def _mlp(seed):
+    paddle.seed(seed)
+    return nn.Sequential(nn.Linear(16, 32), nn.ReLU(),
+                         nn.Linear(32, 4))
+
+
+def _train(model, steps, make_batch, opt=None, wrap=None):
+    opt = opt or optimizer.SGD(learning_rate=0.1,
+                               parameters=model.parameters())
+    run = wrap(model) if wrap else model
+    losses = []
+    for i in range(steps):
+        x, y = make_batch(i)
+        out = run(paddle.to_tensor(x))
+        loss = paddle.nn.functional.mse_loss(out, paddle.to_tensor(y))
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(loss))
+    return losses
+
+
+def _batches(i):
+    rng = np.random.RandomState(100 + i)
+    return (rng.randn(8, 16).astype(np.float32),
+            rng.randn(8, 4).astype(np.float32))
+
+
+def test_data_parallel_loss_parity():
+    ref = _train(_mlp(0), 10, _batches)
+    _reset_mesh(Mesh(np.array(jax.devices()[:8]), ("dp",)))
+    got = _train(_mlp(0), 10, _batches,
+                 wrap=lambda m: dist.DataParallel(m))
+    np.testing.assert_allclose(got, ref, rtol=2e-4, atol=2e-5)
+
+
+def test_sharding_stage2_loss_parity():
+    from paddle_tpu.distributed.fleet.meta_parallel.sharding import \
+        group_sharded
+    ref_m = _mlp(1)
+    ref_opt = optimizer.Adam(learning_rate=0.01,
+                             parameters=ref_m.parameters())
+    ref = _train(ref_m, 10, _batches, opt=ref_opt)
+
+    _reset_mesh(Mesh(np.array(jax.devices()[:8]), ("dp",)))
+    m = _mlp(1)
+    opt = optimizer.Adam(learning_rate=0.01, parameters=m.parameters())
+    wrapped, opt2, _ = group_sharded.group_sharded_parallel(
+        m, opt, level="os_g")
+    got = _train(wrapped, 10, _batches, opt=opt2)
+    np.testing.assert_allclose(got, ref, rtol=2e-4, atol=2e-5)
+
+
+def test_sharding_stage3_loss_parity():
+    from paddle_tpu.distributed.fleet.meta_parallel.sharding import \
+        group_sharded
+    ref_m = _mlp(2)
+    ref_opt = optimizer.Adam(learning_rate=0.01,
+                             parameters=ref_m.parameters())
+    ref = _train(ref_m, 10, _batches, opt=ref_opt)
+
+    _reset_mesh(Mesh(np.array(jax.devices()[:8]), ("dp",)))
+    m = _mlp(2)
+    opt = optimizer.Adam(learning_rate=0.01, parameters=m.parameters())
+    wrapped, opt2, _ = group_sharded.group_sharded_parallel(
+        m, opt, level="p_g_os")
+    got = _train(wrapped, 10, _batches, opt=opt2)
+    np.testing.assert_allclose(got, ref, rtol=2e-4, atol=2e-5)
+
+
+class _TPBlock(nn.Layer):
+    """Column→Row pair, the Megatron building block."""
+
+    def __init__(self, parallel):
+        super().__init__()
+        if parallel:
+            from paddle_tpu.distributed.fleet.meta_parallel. \
+                parallel_layers.mp_layers import (ColumnParallelLinear,
+                                                  RowParallelLinear)
+            self.fc1 = ColumnParallelLinear(16, 64, has_bias=True,
+                                            gather_output=False)
+            self.fc2 = RowParallelLinear(64, 4, has_bias=True,
+                                         input_is_parallel=True)
+        else:
+            self.fc1 = nn.Linear(16, 64)
+            self.fc2 = nn.Linear(64, 4)
+        self.act = nn.ReLU()
+
+    def forward(self, x):
+        return self.fc2(self.act(self.fc1(x)))
+
+
+def test_tensor_parallel_loss_parity():
+    paddle.seed(3)
+    ref_model = _TPBlock(parallel=False)
+    ref = _train(ref_model, 10, _batches)
+
+    _reset_mesh(Mesh(np.array(jax.devices()[:8]).reshape(2, 4),
+                     ("dp", "mp")))
+    paddle.seed(3)   # same seed → identical init draws as the reference
+    tp_model = _TPBlock(parallel=True)
+    got = _train(tp_model, 10, _batches)
+    np.testing.assert_allclose(got, ref, rtol=2e-4, atol=2e-5)
+
+
+def test_vocab_parallel_embedding():
+    from paddle_tpu.distributed.fleet.meta_parallel.parallel_layers. \
+        mp_layers import VocabParallelEmbedding
+    _reset_mesh(Mesh(np.array(jax.devices()[:8]).reshape(2, 4),
+                     ("dp", "mp")))
+    paddle.seed(4)
+    emb = VocabParallelEmbedding(64, 8)
+    ids = paddle.to_tensor(np.array([[1, 5], [63, 0]], np.int64))
+    out = emb(ids)
+    np.testing.assert_allclose(
+        out.numpy(), emb.weight.numpy()[ids.numpy()], atol=1e-6)
+
+
+def test_parallel_cross_entropy_shard_map():
+    """Vocab-parallel CE inside shard_map matches dense CE."""
+    from paddle_tpu.distributed.fleet.meta_parallel.parallel_layers. \
+        mp_layers import ParallelCrossEntropy
+    devs = np.array(jax.devices()[:8])
+    mesh = Mesh(devs, ("mp",))
+    _reset_mesh(mesh)
+    rng = np.random.RandomState(5)
+    V = 64  # 8 per shard
+    logits = rng.randn(6, V).astype(np.float32)
+    labels = rng.randint(0, V, (6,)).astype(np.int64)
+    labels[2] = -100  # ignore_index
+
+    pce = ParallelCrossEntropy()
+
+    def f(lg, lb):
+        t = Tensor(lg, _internal=True)
+        l = Tensor(lb, _internal=True)
+        out = pce(t, l)
+        return out._value
+
+    got = shard_map(f, mesh=mesh, in_specs=(P(None, "mp"), P(None)),
+                    out_specs=P(None), check_rep=False)(
+        jnp.asarray(logits), jnp.asarray(labels))
+
+    ref = paddle.nn.functional.cross_entropy(
+        paddle.to_tensor(logits), paddle.to_tensor(labels),
+        reduction="none", ignore_index=-100)
+    np.testing.assert_allclose(np.asarray(got)[:, 0], ref.numpy(),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_moe_layer_trains():
+    from paddle_tpu.incubate.distributed.models.moe import MoELayer
+    from paddle_tpu.incubate.distributed.models.moe.gate import GShardGate
+    paddle.seed(6)
+    d_model = 16
+    experts = nn.LayerList([
+        nn.Sequential(nn.Linear(d_model, 32), nn.ReLU(),
+                      nn.Linear(32, d_model)) for _ in range(4)])
+    moe = MoELayer(d_model=d_model, experts=experts,
+                   gate=GShardGate(d_model, 4, topk=2))
+    opt = optimizer.Adam(learning_rate=0.01, parameters=moe.parameters())
+    rng = np.random.RandomState(7)
+    x = rng.randn(2, 8, d_model).astype(np.float32)
+    losses = []
+    for _ in range(5):
+        out = moe(paddle.to_tensor(x))
+        loss = paddle.mean((out - paddle.to_tensor(x)) ** 2)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(loss))
+    assert losses[-1] < losses[0]

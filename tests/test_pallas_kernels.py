@@ -1,0 +1,180 @@
+"""Pallas kernel parity vs XLA reference compositions (interpret mode on
+CPU; same code compiles via Mosaic on TPU)."""
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.ops import pallas_kernels as pk
+
+
+def _sdpa_ref(q, k, v, causal, scale):
+    b, sq, h, d = q.shape
+    sk = k.shape[1]
+    qt = jnp.swapaxes(q, 1, 2).astype(jnp.float32)
+    kt = jnp.swapaxes(k, 1, 2).astype(jnp.float32)
+    vt = jnp.swapaxes(v, 1, 2).astype(jnp.float32)
+    s = jnp.einsum("bhqd,bhkd->bhqk", qt, kt) * scale
+    if causal:
+        mask = jnp.tril(jnp.ones((sq, sk), bool), k=sk - sq)
+        s = jnp.where(mask, s, -1e30)
+    p = jax.nn.softmax(s, axis=-1)
+    o = jnp.einsum("bhqk,bhkd->bhqd", p, vt)
+    return jnp.swapaxes(o, 1, 2).astype(q.dtype)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+@pytest.mark.parametrize("shape", [
+    (2, 64, 2, 32),      # small, uneven vs 128 blocks
+    (1, 100, 1, 64),     # non-multiple seq, head_dim 64
+])
+def test_flash_attention_forward(shape, causal):
+    b, s, h, d = shape
+    key = jax.random.PRNGKey(0)
+    kq, kk, kv = jax.random.split(key, 3)
+    q = jax.random.normal(kq, shape, jnp.float32)
+    k = jax.random.normal(kk, shape, jnp.float32)
+    v = jax.random.normal(kv, shape, jnp.float32)
+    out = pk.flash_attention(q, k, v, causal=causal)
+    ref = _sdpa_ref(q, k, v, causal, 1.0 / d ** 0.5)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+
+
+def test_flash_attention_cross_lengths():
+    key = jax.random.PRNGKey(1)
+    kq, kk, kv = jax.random.split(key, 3)
+    q = jax.random.normal(kq, (1, 24, 2, 32), jnp.float32)
+    k = jax.random.normal(kk, (1, 40, 2, 32), jnp.float32)
+    v = jax.random.normal(kv, (1, 40, 2, 32), jnp.float32)
+    out = pk.flash_attention(q, k, v, causal=True)
+    ref = _sdpa_ref(q, k, v, True, 1.0 / 32 ** 0.5)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+
+
+def test_flash_attention_causal_sq_gt_sk_grad():
+    """Sq > Sk causal: leading rows see no keys; grads must be 0 there,
+    not garbage (regression for the empty-row lse backward bug)."""
+    kq, kk, kv = jax.random.split(jax.random.PRNGKey(11), 3)
+    q = jax.random.normal(kq, (1, 48, 1, 32), jnp.float32)
+    k = jax.random.normal(kk, (1, 16, 1, 32), jnp.float32)
+    v = jax.random.normal(kv, (1, 16, 1, 32), jnp.float32)
+    out = pk.flash_attention(q, k, v, causal=True)
+    # rows 0..31 attend to nothing → output 0 (flash-attn convention)
+    np.testing.assert_allclose(np.asarray(out[:, :32]), 0.0, atol=1e-6)
+
+    def f(q, k, v):
+        o = pk.flash_attention(q, k, v, causal=True)
+        return jnp.sum(o[:, 32:] ** 2)  # only rows with visible keys
+
+    def f_ref(q, k, v):
+        o = _sdpa_ref(q, k, v, True, 1.0 / 32 ** 0.5)
+        return jnp.sum(o[:, 32:] ** 2)
+
+    g = jax.grad(f, argnums=(0, 1, 2))(q, k, v)
+    g_ref = jax.grad(f_ref, argnums=(0, 1, 2))(q, k, v)
+    np.testing.assert_allclose(np.asarray(g[0][:, :32]), 0.0, atol=1e-6)
+    for a, b in zip(g, g_ref):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=1e-4, rtol=1e-4)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_attention_grad(causal):
+    shape = (1, 48, 2, 32)
+    key = jax.random.PRNGKey(2)
+    kq, kk, kv = jax.random.split(key, 3)
+    q = jax.random.normal(kq, shape, jnp.float32)
+    k = jax.random.normal(kk, shape, jnp.float32)
+    v = jax.random.normal(kv, shape, jnp.float32)
+
+    def f_pl(q, k, v):
+        return jnp.sum(pk.flash_attention(q, k, v, causal=causal) ** 2)
+
+    def f_ref(q, k, v):
+        return jnp.sum(_sdpa_ref(q, k, v, causal, 1.0 / 32 ** 0.5) ** 2)
+
+    g_pl = jax.grad(f_pl, argnums=(0, 1, 2))(q, k, v)
+    g_ref = jax.grad(f_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g_pl, g_ref):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=1e-4, rtol=1e-4)
+
+
+def test_fused_layer_norm():
+    key = jax.random.PRNGKey(3)
+    x = jax.random.normal(key, (37, 96), jnp.float32) * 3 + 1
+    gamma = jax.random.normal(jax.random.PRNGKey(4), (96,)) + 1
+    beta = jax.random.normal(jax.random.PRNGKey(5), (96,))
+
+    def ref(x, g, b):
+        mu = jnp.mean(x, -1, keepdims=True)
+        var = jnp.var(x, -1, keepdims=True)
+        return (x - mu) * jax.lax.rsqrt(var + 1e-5) * g + b
+
+    out = pk.fused_layer_norm(x, gamma, beta, eps=1e-5)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref(x, gamma,
+                               beta)), atol=1e-5, rtol=1e-5)
+
+    def loss_pl(x, g, b):
+        return jnp.sum(jnp.sin(pk.fused_layer_norm(x, g, b)))
+
+    def loss_ref(x, g, b):
+        return jnp.sum(jnp.sin(ref(x, g, b)))
+
+    gp = jax.grad(loss_pl, argnums=(0, 1, 2))(x, gamma, beta)
+    gr = jax.grad(loss_ref, argnums=(0, 1, 2))(x, gamma, beta)
+    for a, b in zip(gp, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=1e-4, rtol=1e-4)
+
+
+def test_fused_rms_norm():
+    x = jax.random.normal(jax.random.PRNGKey(6), (20, 64), jnp.float32)
+    gamma = jax.random.normal(jax.random.PRNGKey(7), (64,)) + 1
+
+    def ref(x, g):
+        ms = jnp.mean(x * x, -1, keepdims=True)
+        return x * jax.lax.rsqrt(ms + 1e-6) * g
+
+    out = pk.fused_rms_norm(x, gamma, eps=1e-6)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref(x, gamma)),
+                               atol=1e-5, rtol=1e-5)
+    gp = jax.grad(lambda x, g: jnp.sum(pk.fused_rms_norm(x, g) ** 2),
+                  argnums=(0, 1))(x, gamma)
+    gr = jax.grad(lambda x, g: jnp.sum(ref(x, g) ** 2),
+                  argnums=(0, 1))(x, gamma)
+    for a, b in zip(gp, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=1e-4, rtol=1e-4)
+
+
+def test_fused_softmax_cross_entropy():
+    logits = jax.random.normal(jax.random.PRNGKey(8), (33, 50),
+                               jnp.float32)
+    labels = jax.random.randint(jax.random.PRNGKey(9), (33,), 0, 50)
+
+    def ref(x, y):
+        lse = jax.nn.logsumexp(x, axis=-1)
+        return lse - jnp.take_along_axis(x, y[:, None], 1)[:, 0]
+
+    loss = pk.fused_softmax_cross_entropy(logits, labels)
+    np.testing.assert_allclose(np.asarray(loss),
+                               np.asarray(ref(logits, labels)),
+                               atol=1e-5, rtol=1e-5)
+    gp = jax.grad(lambda x: jnp.mean(
+        pk.fused_softmax_cross_entropy(x, labels)))(logits)
+    gr = jax.grad(lambda x: jnp.mean(ref(x, labels)))(logits)
+    np.testing.assert_allclose(np.asarray(gp), np.asarray(gr),
+                               atol=1e-5, rtol=1e-5)
+
+
+def test_xent_ignore_index():
+    logits = jax.random.normal(jax.random.PRNGKey(10), (8, 10))
+    labels = jnp.array([1, 2, -1, 3, -1, 0, 9, 4])
+    loss = pk.fused_softmax_cross_entropy(logits, labels)
+    assert float(loss[2]) == 0.0 and float(loss[4]) == 0.0
+    g = jax.grad(lambda x: jnp.sum(
+        pk.fused_softmax_cross_entropy(x, labels)))(logits)
+    assert float(jnp.abs(g[2]).sum()) == 0.0
