@@ -55,6 +55,15 @@ def main():
     from paddle_tpu import nn, optimizer, static
     from paddle_tpu.models import BertConfig, BertForMaskedLM
 
+    if on_tpu:
+        # fail LOUDLY if any Pallas kernel cannot compile on this chip
+        # (r2 shipped a 0.0 bench because a broken kernel was silently
+        # wired in; the probe makes that a hard error before measuring)
+        from paddle_tpu.ops.pallas_gate import probe_all
+        t = time.time()
+        log(f"pallas probe: {probe_all(raise_on_failure=True)} "
+            f"({time.time()-t:.0f}s)")
+
     B, S = (32, 128) if on_tpu else (4, 64)
     cfg = BertConfig() if on_tpu else BertConfig(
         hidden_size=128, num_hidden_layers=2, num_attention_heads=2,
@@ -122,7 +131,7 @@ def main():
 if __name__ == "__main__":
     try:
         main()
-    except Exception as e:  # always emit the contract line
+    except Exception as e:  # emit the contract line, but FAIL the run
         import traceback
         traceback.print_exc(file=sys.stderr)
         print(json.dumps({
@@ -130,5 +139,6 @@ if __name__ == "__main__":
             "value": 0.0,
             "unit": "tokens/s",
             "vs_baseline": 0.0,
+            "error": f"{type(e).__name__}: {e}"[:200],
         }), flush=True)
-        sys.exit(0)
+        sys.exit(1)

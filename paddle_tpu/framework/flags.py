@@ -22,6 +22,11 @@ _FLAGS = {
     "FLAGS_new_executor_serial_run": False,
     "FLAGS_benchmark": False,
     "FLAGS_use_pallas_kernels": True,  # TPU: enable Pallas hot kernels
+    # donate param/opt-state buffers into compiled steps (1x HBM).  Turn
+    # off if you hold detach() views of parameters across steps — donation
+    # consumes the old buffer and stale views raise "Array has been
+    # deleted" (paddle.clone() copies and is always safe).
+    "FLAGS_buffer_donation": True,
     "FLAGS_matmul_precision": "default",  # default|highest (f32 on MXU)
 }
 

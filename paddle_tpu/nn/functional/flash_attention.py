@@ -66,15 +66,17 @@ def _use_pallas(head_dim, seqlen_k, dtype) -> bool:
     composite instead of failing Mosaic compilation (ring attention is
     the long-context path).
     """
-    if jax.default_backend() != "tpu":
-        return False
+    # cheap static checks first; the probe compile (pallas_enabled) last
     from ...core.dtypes import to_jax_dtype
     jd = jnp.dtype(to_jax_dtype(dtype))
     if jd not in (jnp.dtype(jnp.float32), jnp.dtype(jnp.bfloat16)):
         return False
     d_pad = max(head_dim, 128)  # Mosaic pads lanes to 128
     kv_bytes = 2 * seqlen_k * d_pad * jd.itemsize
-    return head_dim <= 256 and kv_bytes <= 8 * 1024 * 1024
+    if head_dim > 256 or kv_bytes > 8 * 1024 * 1024:
+        return False
+    from ...ops.pallas_gate import pallas_enabled
+    return pallas_enabled("flash_attention")
 
 
 def scaled_dot_product_attention(query, key, value, attn_mask=None,

@@ -37,9 +37,15 @@ def cross_entropy(input, label, weight=None, ignore_index=-100,
                   use_softmax=True, label_smoothing=0.0, name=None):
     # Hard-label fast path → Pallas fused softmax-xent on TPU (the
     # reference's fused c_softmax_with_cross_entropy kernel role).
-    use_fused = (jax.default_backend() == "tpu" and not soft_label
+    from ...ops.pallas_gate import pallas_enabled
+    # vocab cap keeps the (16, V) f32 row-block within VMEM (the kernel
+    # floors the block at 16 rows; 16 * 128k * 4B = 8MB)
+    use_fused = (not soft_label
                  and weight is None and label_smoothing == 0.0
-                 and use_softmax and axis in (-1, input.ndim - 1))
+                 and use_softmax and axis in (-1, input.ndim - 1)
+                 and input.shape[-1] <= 128 * 1024
+                 and input.dtype in ("float32", "bfloat16", "float16")
+                 and pallas_enabled("softmax_cross_entropy"))
 
     def impl(logits, lab, *w, ignore_index, reduction, soft_label, axis,
              use_softmax, smooth, use_fused=False):

@@ -23,8 +23,9 @@ def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-05,
     if isinstance(normalized_shape, int):
         normalized_shape = (normalized_shape,)
     naxes = len(tuple(normalized_shape))
+    from ...ops.pallas_gate import pallas_enabled
     use_pallas = (naxes == 1 and weight is not None and bias is not None
-                  and jax.default_backend() == "tpu")
+                  and pallas_enabled("layer_norm"))
 
     def impl(v, *wb, eps, naxes, has_w, has_b, use_pallas=False):
         if use_pallas:
@@ -54,7 +55,8 @@ def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-05,
 
 
 def rms_norm(x, weight=None, epsilon=1e-6, name=None):
-    use_pallas = weight is not None and jax.default_backend() == "tpu"
+    from ...ops.pallas_gate import pallas_enabled
+    use_pallas = weight is not None and pallas_enabled("rms_norm")
 
     def impl(v, *wb, eps, use_pallas=False):
         if use_pallas:

@@ -362,7 +362,9 @@ def assign(x, output=None):
 
 
 def clone(x, name=None):
-    return dispatch("clone", lambda v: jnp.asarray(v), (x,), {})
+    # real copy (Paddle clone copies; also keeps snapshots valid when the
+    # compiled-step buffer donation consumes the source buffer)
+    return dispatch("clone", lambda v: jnp.copy(v), (x,), {})
 
 
 def complex(real, imag, name=None):

@@ -591,7 +591,9 @@ def pad(x, pad, mode="constant", value=0.0, data_format="NCHW", name=None):
 
 
 def clone(x, name=None):
-    return dispatch("clone", lambda v: jnp.asarray(v), (x,), {})
+    # real copy (Paddle clone copies; also keeps snapshots valid when the
+    # compiled-step buffer donation consumes the source buffer)
+    return dispatch("clone", lambda v: jnp.copy(v), (x,), {})
 
 
 def numel(x, name=None):

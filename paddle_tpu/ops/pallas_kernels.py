@@ -15,7 +15,23 @@ On non-TPU backends (tests run on XLA-CPU) the same kernels execute in
 Pallas interpret mode, so numerics are validated everywhere the suite
 runs; on TPU they compile via Mosaic.
 
-Conventions:
+Mosaic block-mapping rules honoured here (the round-2 kernels violated
+them and failed to compile on hardware): the last two dims of every
+BlockSpec must each be divisible by (8, 128) or equal to the overall
+array dim.  Consequently:
+  * every array crossing the pallas_call boundary is rank >= 2;
+  * per-row statistics (lse, mean, rstd, loss, delta, incoming
+    cotangents, integer labels) travel as f32/int32 arrays with a
+    trailing `_STAT_LANES == 8` lane dim — written as lane-broadcasts,
+    read back via `[:, :1]` (8 == the array dim satisfies the lane
+    rule; only 8x memory on arrays that are tiny to begin with);
+  * in-kernel reductions keep dims (`keepdims=True`) so all VPU values
+    stay rank-2;
+  * dgamma/dbeta are reduced with the sequential-grid accumulation
+    pattern: one (8, N) output block revisited by every program,
+    zero-initialised under `pl.when(program_id == 0)`.
+
+Layout conventions:
   * attention layout inside the kernels is [batch*heads, seq, head_dim]
     (callers convert from Paddle's [B, S, H, D]);
   * sequence dims are padded to a multiple of the block size here, with
@@ -43,6 +59,40 @@ __all__ = [
 ]
 
 _NEG_INF = -1e30
+_STAT_LANES = 8  # trailing lane dim for per-row stat arrays (see module doc)
+
+try:
+    from jax._src.config import enable_x64 as _enable_x64_ctx
+except ImportError:  # pragma: no cover - fallback for jax API moves
+    import contextlib
+
+    @contextlib.contextmanager
+    def _enable_x64_ctx(value):
+        old = jax.config.jax_enable_x64
+        jax.config.update("jax_enable_x64", value)
+        try:
+            yield
+        finally:
+            jax.config.update("jax_enable_x64", old)
+
+
+def _x32(fn):
+    """Trace the wrapped pallas_call builder under x32 semantics.
+
+    The framework enables jax_enable_x64 globally (paddle_tpu/__init__.py)
+    for Paddle's int64/float64 tensor semantics.  Under x64, Pallas
+    index-map literals and in-kernel weak ints trace as i64, which Mosaic
+    cannot legalize ("failed to legalize func.return (i32, i64)") and
+    whose int64 converts send Mosaic's _convert_helper into infinite
+    recursion — this was the root cause of ALL four round-2 kernel
+    failures on hardware.  Every dtype inside the kernels is explicit
+    (f32/bf16/i32), so tracing them x32 changes nothing numerically.
+    """
+    @functools.wraps(fn)
+    def wrapper(*args, **kwargs):
+        with _enable_x64_ctx(False):
+            return fn(*args, **kwargs)
+    return wrapper
 
 
 def _interpret() -> bool:
@@ -60,6 +110,24 @@ def _pad_dim(x, dim, target, value=0.0):
     widths = [(0, 0)] * x.ndim
     widths[dim] = (0, pad)
     return jnp.pad(x, widths, constant_values=value)
+
+
+def _lanes(x2d):
+    """Broadcast a (rows,) or (rows, 1) stat to the stat-lane layout."""
+    if x2d.ndim == 1:
+        x2d = x2d[:, None]
+    return jnp.broadcast_to(x2d, x2d.shape[:-1] + (_STAT_LANES,))
+
+
+def _demote_f64(*xs):
+    """TPU has no float64: demote f64 inputs to f32 (grad flows back
+    through the cast).  The global x64 mode (paddle_tpu/__init__.py)
+    makes f64 a reachable input dtype on the CPU test path."""
+    return tuple(
+        x.astype(jnp.float32) if x is not None
+        and jnp.issubdtype(x.dtype, jnp.floating)
+        and jnp.dtype(x.dtype).itemsize == 8 else x
+        for x in xs)
 
 
 # =====================================================================
@@ -82,7 +150,7 @@ def _attn_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *,
             num_k_blocks, (jnp.maximum(hi, 0) + block_k - 1) // block_k)
 
     def body(i, carry):
-        m_prev, l_prev, acc = carry
+        m_prev, l_prev, acc = carry                       # (bq,1)x2,(bq,D)
         k_blk = k_ref[0, pl.ds(i * block_k, block_k), :].astype(jnp.float32)
         v_blk = v_ref[0, pl.ds(i * block_k, block_k), :].astype(jnp.float32)
         s = jax.lax.dot_general(
@@ -94,33 +162,34 @@ def _attn_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *,
             row = jax.lax.broadcasted_iota(jnp.int32, s.shape, 0) + q_start
             mask = jnp.logical_and(mask, col <= row + offset)
         s = jnp.where(mask, s, _NEG_INF)
-        m_cur = jnp.max(s, axis=-1)
+        m_cur = jnp.max(s, axis=-1, keepdims=True)
         m_new = jnp.maximum(m_prev, m_cur)
         # explicit zero on masked cols: for a fully-masked row s == m_new
         # == _NEG_INF and exp(s - m_new) would be 1, not 0
-        p = jnp.where(mask, jnp.exp(s - m_new[:, None]), 0.0)
+        p = jnp.where(mask, jnp.exp(s - m_new), 0.0)
         alpha = jnp.exp(m_prev - m_new)
-        l_new = alpha * l_prev + jnp.sum(p, axis=-1)
-        acc = acc * alpha[:, None] + jax.lax.dot_general(
+        l_new = alpha * l_prev + jnp.sum(p, axis=-1, keepdims=True)
+        acc = acc * alpha + jax.lax.dot_general(
             p, v_blk, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         return m_new, l_new, acc
 
-    m0 = jnp.full((block_q,), _NEG_INF, jnp.float32)
-    l0 = jnp.zeros((block_q,), jnp.float32)
+    m0 = jnp.full((block_q, 1), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((block_q, 1), jnp.float32)
     acc0 = jnp.zeros((block_q, q.shape[-1]), jnp.float32)
     m, l, acc = jax.lax.fori_loop(0, num_k_blocks, body, (m0, l0, acc0))
     l_safe = jnp.where(l == 0.0, 1.0, l)
-    o_ref[0] = (acc / l_safe[:, None]).astype(o_ref.dtype)
-    lse_ref[0] = jnp.where(l == 0.0, _NEG_INF, m + jnp.log(l_safe))
+    o_ref[0] = (acc / l_safe).astype(o_ref.dtype)
+    lse = jnp.where(l == 0.0, _NEG_INF, m + jnp.log(l_safe))  # (bq, 1)
+    lse_ref[0] = jnp.broadcast_to(lse, (block_q, _STAT_LANES))
 
 
 def _attn_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                         dq_ref, *, scale, causal, block_k, sk_real, offset):
     q = q_ref[0].astype(jnp.float32)
     do = do_ref[0].astype(jnp.float32)
-    lse = lse_ref[0]
-    delta = delta_ref[0]
+    lse = lse_ref[0][:, :1]                               # (bq, 1)
+    delta = delta_ref[0][:, :1]
     block_q = q.shape[0]
     sk_pad = k_ref.shape[1]
     q_start = pl.program_id(1) * block_q
@@ -143,11 +212,11 @@ def _attn_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             row = jax.lax.broadcasted_iota(jnp.int32, s.shape, 0) + q_start
             mask = jnp.logical_and(mask, col <= row + offset)
         s = jnp.where(mask, s, _NEG_INF)
-        p = jnp.exp(s - lse[:, None])                    # (bq, bk)
+        p = jnp.exp(s - lse)                              # (bq, bk)
         dp = jax.lax.dot_general(
             do, v_blk, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)
-        ds = p * (dp - delta[:, None]) * scale
+        ds = p * (dp - delta) * scale
         return dq + jax.lax.dot_general(
             ds, k_blk, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
@@ -177,8 +246,8 @@ def _attn_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         q_blk = q_ref[0, pl.ds(i * block_q, block_q), :].astype(jnp.float32)
         do_blk = do_ref[0, pl.ds(i * block_q, block_q), :].astype(
             jnp.float32)
-        lse_blk = lse_ref[0, pl.ds(i * block_q, block_q)]
-        delta_blk = delta_ref[0, pl.ds(i * block_q, block_q)]
+        lse_blk = lse_ref[0, pl.ds(i * block_q, block_q), :][:, :1]
+        delta_blk = delta_ref[0, pl.ds(i * block_q, block_q), :][:, :1]
         s = jax.lax.dot_general(
             q_blk, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale  # (bq, bk)
@@ -188,14 +257,14 @@ def _attn_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             col = jax.lax.broadcasted_iota(jnp.int32, s.shape, 1) + k_start
             mask = jnp.logical_and(mask, col <= row + offset)
         s = jnp.where(mask, s, _NEG_INF)
-        p = jnp.exp(s - lse_blk[:, None])
+        p = jnp.exp(s - lse_blk)
         dv = dv + jax.lax.dot_general(
             p, do_blk, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         dp = jax.lax.dot_general(
             do_blk, v, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)
-        ds = p * (dp - delta_blk[:, None]) * scale
+        ds = p * (dp - delta_blk) * scale
         dk = dk + jax.lax.dot_general(
             ds, q_blk, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
@@ -208,6 +277,7 @@ def _attn_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     dv_ref[0] = dv.astype(dv_ref.dtype)
 
 
+@_x32
 def _flash_fwd(q, k, v, scale, causal, sq_real, sk_real, block_q, block_k):
     bh, sq_pad, d = q.shape
     sk_pad = k.shape[1]
@@ -224,28 +294,31 @@ def _flash_fwd(q, k, v, scale, causal, sq_real, sk_real, block_q, block_k):
         ],
         out_specs=[
             pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, block_q), lambda b, i: (b, i)),
+            pl.BlockSpec((1, block_q, _STAT_LANES), lambda b, i: (b, i, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((bh, sq_pad, d), q.dtype),
-            jax.ShapeDtypeStruct((bh, sq_pad), jnp.float32),
+            jax.ShapeDtypeStruct((bh, sq_pad, _STAT_LANES), jnp.float32),
         ],
         interpret=_interpret(),
     )(q, k, v)
     return out, lse
 
 
+@_x32
 def _flash_bwd(q, k, v, do, out, lse, scale, causal, sq_real, sk_real,
                block_q, block_k):
+    """lse arrives in the (BH, Sq_pad, _STAT_LANES) stat-lane layout."""
     bh, sq_pad, d = q.shape
     sk_pad = k.shape[1]
     offset = sk_real - sq_real
     delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32),
-                    axis=-1)                              # (BH, Sq_pad)
+                    axis=-1, keepdims=True)              # (BH, Sq_pad, 1)
+    delta = jnp.broadcast_to(delta, (bh, sq_pad, _STAT_LANES))
     # p = exp(s - lse) must be 0 wherever a row has no visible keys:
     # padded q rows AND real rows the causal mask empties (Sq > Sk case,
     # forward stored lse = _NEG_INF there).  Force lse huge so exp → 0.
-    row = jnp.arange(sq_pad)[None, :]
+    row = jnp.arange(sq_pad)[None, :, None]
     empty = jnp.logical_or(row >= sq_real, lse <= _NEG_INF / 2)
     lse_safe = jnp.where(empty, jnp.float32(1e30), lse)
     dq = pl.pallas_call(
@@ -257,8 +330,8 @@ def _flash_bwd(q, k, v, do, out, lse, scale, causal, sq_real, sk_real,
             pl.BlockSpec((1, sk_pad, d), lambda b, i: (b, 0, 0)),
             pl.BlockSpec((1, sk_pad, d), lambda b, i: (b, 0, 0)),
             pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, block_q), lambda b, i: (b, i)),
-            pl.BlockSpec((1, block_q), lambda b, i: (b, i)),
+            pl.BlockSpec((1, block_q, _STAT_LANES), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, block_q, _STAT_LANES), lambda b, i: (b, i, 0)),
         ],
         out_specs=pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
         out_shape=jax.ShapeDtypeStruct((bh, sq_pad, d), q.dtype),
@@ -273,8 +346,8 @@ def _flash_bwd(q, k, v, do, out, lse, scale, causal, sq_real, sk_real,
             pl.BlockSpec((1, block_k, d), lambda b, j: (b, j, 0)),
             pl.BlockSpec((1, block_k, d), lambda b, j: (b, j, 0)),
             pl.BlockSpec((1, sq_pad, d), lambda b, j: (b, 0, 0)),
-            pl.BlockSpec((1, sq_pad), lambda b, j: (b, 0)),
-            pl.BlockSpec((1, sq_pad), lambda b, j: (b, 0)),
+            pl.BlockSpec((1, sq_pad, _STAT_LANES), lambda b, j: (b, 0, 0)),
+            pl.BlockSpec((1, sq_pad, _STAT_LANES), lambda b, j: (b, 0, 0)),
         ],
         out_specs=[
             pl.BlockSpec((1, block_k, d), lambda b, j: (b, j, 0)),
@@ -290,7 +363,8 @@ def _flash_bwd(q, k, v, do, out, lse, scale, causal, sq_real, sk_real,
 
 
 def _pick_block(seq: int) -> int:
-    return 128 if seq >= 128 else _round_up(max(seq, 8), 8)
+    # 16-row minimum keeps bf16 blocks on whole (16, 128) tiles
+    return 128 if seq >= 128 else _round_up(max(seq, 16), 16)
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
@@ -342,6 +416,7 @@ def flash_attention(q, k, v, *, causal=False, scale=None):
     """
     if scale is None:
         scale = 1.0 / (q.shape[-1] ** 0.5)
+    q, k, v = _demote_f64(q, k, v)
     b, sq, h, d = q.shape
     sk = k.shape[1]
     qt = jnp.swapaxes(q, 1, 2).reshape(b * h, sq, d)
@@ -357,32 +432,42 @@ def flash_attention(q, k, v, *, causal=False, scale=None):
 
 def _ln_fwd_kernel(x_ref, g_ref, b_ref, o_ref, mu_ref, rstd_ref, *, eps):
     x = x_ref[:].astype(jnp.float32)                    # (block_rows, N)
-    mu = jnp.mean(x, axis=-1)
-    xc = x - mu[:, None]
-    var = jnp.mean(xc * xc, axis=-1)
+    br = x.shape[0]
+    mu = jnp.mean(x, axis=-1, keepdims=True)
+    xc = x - mu
+    var = jnp.mean(xc * xc, axis=-1, keepdims=True)
     rstd = jax.lax.rsqrt(var + eps)
-    xhat = xc * rstd[:, None]
+    xhat = xc * rstd
     o_ref[:] = (xhat * g_ref[:].astype(jnp.float32)
                 + b_ref[:].astype(jnp.float32)).astype(o_ref.dtype)
-    mu_ref[:] = mu
-    rstd_ref[:] = rstd
+    mu_ref[:] = jnp.broadcast_to(mu, (br, _STAT_LANES))
+    rstd_ref[:] = jnp.broadcast_to(rstd, (br, _STAT_LANES))
 
 
 def _ln_bwd_kernel(x_ref, g_ref, mu_ref, rstd_ref, do_ref,
                    dx_ref, dg_ref, db_ref):
     x = x_ref[:].astype(jnp.float32)
     do = do_ref[:].astype(jnp.float32)
-    gamma = g_ref[:].astype(jnp.float32)
-    mu = mu_ref[:]
-    rstd = rstd_ref[:]
-    n = x.shape[-1]
-    xhat = (x - mu[:, None]) * rstd[:, None]
-    dg_ref[:] = jnp.sum(do * xhat, axis=0, keepdims=True)
-    db_ref[:] = jnp.sum(do, axis=0, keepdims=True)
+    gamma = g_ref[:].astype(jnp.float32)                # (1, N)
+    mu = mu_ref[:][:, :1]
+    rstd = rstd_ref[:][:, :1]
+    xhat = (x - mu) * rstd
+
+    # dgamma/dbeta: sequential-grid accumulation into one revisited block
+    @pl.when(pl.program_id(0) == 0)
+    def _init():
+        dg_ref[:] = jnp.zeros_like(dg_ref)
+        db_ref[:] = jnp.zeros_like(db_ref)
+
+    dg = jnp.sum(do * xhat, axis=0, keepdims=True)      # (1, N)
+    db = jnp.sum(do, axis=0, keepdims=True)
+    dg_ref[:] = dg_ref[:] + jnp.broadcast_to(dg, dg_ref.shape)
+    db_ref[:] = db_ref[:] + jnp.broadcast_to(db, db_ref.shape)
+
     dxhat = do * gamma
     m1 = jnp.mean(dxhat, axis=-1, keepdims=True)
     m2 = jnp.mean(dxhat * xhat, axis=-1, keepdims=True)
-    dx = (dxhat - m1 - xhat * m2) * rstd[:, None]
+    dx = (dxhat - m1 - xhat * m2) * rstd
     dx_ref[:] = dx.astype(dx_ref.dtype)
 
 
@@ -392,11 +477,13 @@ def _fused_layer_norm_2d(x, gamma, beta, eps):
 
 
 def _ln_block_rows(rows, n, itemsize=4):
-    # keep a block under ~2MB of f32 VMEM working set
+    # keep a block under ~2MB of f32 VMEM working set; 16-row multiples
+    # keep bf16 blocks on whole (16, 128) tiles
     budget = max(1, (2 << 20) // max(n * itemsize, 1))
-    return min(rows, max(8, min(512, _round_up(budget, 8))))
+    return min(_round_up(rows, 16), max(16, min(512, _round_up(budget, 16))))
 
 
+@_x32
 def _fused_layer_norm_2d_fwd(x, gamma, beta, eps):
     rows, n = x.shape
     br = _ln_block_rows(rows, n)
@@ -407,24 +494,25 @@ def _fused_layer_norm_2d_fwd(x, gamma, beta, eps):
         grid=(rows_pad // br,),
         in_specs=[
             pl.BlockSpec((br, n), lambda i: (i, 0)),
-            pl.BlockSpec((n,), lambda i: (0,)),
-            pl.BlockSpec((n,), lambda i: (0,)),
+            pl.BlockSpec((1, n), lambda i: (0, 0)),
+            pl.BlockSpec((1, n), lambda i: (0, 0)),
         ],
         out_specs=[
             pl.BlockSpec((br, n), lambda i: (i, 0)),
-            pl.BlockSpec((br,), lambda i: (i,)),
-            pl.BlockSpec((br,), lambda i: (i,)),
+            pl.BlockSpec((br, _STAT_LANES), lambda i: (i, 0)),
+            pl.BlockSpec((br, _STAT_LANES), lambda i: (i, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((rows_pad, n), x.dtype),
-            jax.ShapeDtypeStruct((rows_pad,), jnp.float32),
-            jax.ShapeDtypeStruct((rows_pad,), jnp.float32),
+            jax.ShapeDtypeStruct((rows_pad, _STAT_LANES), jnp.float32),
+            jax.ShapeDtypeStruct((rows_pad, _STAT_LANES), jnp.float32),
         ],
         interpret=_interpret(),
-    )(xp, gamma, beta)
+    )(xp, gamma.reshape(1, n), beta.reshape(1, n))
     return out[:rows], (x, gamma, mu, rstd)
 
 
+@_x32
 def _fused_layer_norm_2d_bwd(eps, res, do):
     x, gamma, mu, rstd = res
     rows, n = x.shape
@@ -433,30 +521,30 @@ def _fused_layer_norm_2d_bwd(eps, res, do):
     nb = rows_pad // br
     xp = _pad_dim(x, 0, rows_pad)
     dop = _pad_dim(do, 0, rows_pad)
-    dx, dg_part, db_part = pl.pallas_call(
+    dx, dg_acc, db_acc = pl.pallas_call(
         _ln_bwd_kernel,
         grid=(nb,),
         in_specs=[
             pl.BlockSpec((br, n), lambda i: (i, 0)),
-            pl.BlockSpec((n,), lambda i: (0,)),
-            pl.BlockSpec((br,), lambda i: (i,)),
-            pl.BlockSpec((br,), lambda i: (i,)),
+            pl.BlockSpec((1, n), lambda i: (0, 0)),
+            pl.BlockSpec((br, _STAT_LANES), lambda i: (i, 0)),
+            pl.BlockSpec((br, _STAT_LANES), lambda i: (i, 0)),
             pl.BlockSpec((br, n), lambda i: (i, 0)),
         ],
         out_specs=[
             pl.BlockSpec((br, n), lambda i: (i, 0)),
-            pl.BlockSpec((1, n), lambda i: (i, 0)),
-            pl.BlockSpec((1, n), lambda i: (i, 0)),
+            pl.BlockSpec((8, n), lambda i: (0, 0)),
+            pl.BlockSpec((8, n), lambda i: (0, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((rows_pad, n), x.dtype),
-            jax.ShapeDtypeStruct((nb, n), jnp.float32),
-            jax.ShapeDtypeStruct((nb, n), jnp.float32),
+            jax.ShapeDtypeStruct((8, n), jnp.float32),
+            jax.ShapeDtypeStruct((8, n), jnp.float32),
         ],
         interpret=_interpret(),
-    )(xp, gamma, mu, rstd, dop)
-    dgamma = jnp.sum(dg_part, axis=0).astype(gamma.dtype)
-    dbeta = jnp.sum(db_part, axis=0).astype(gamma.dtype)
+    )(xp, gamma.reshape(1, n), mu, rstd, dop)
+    dgamma = dg_acc[0].astype(gamma.dtype)
+    dbeta = db_acc[0].astype(gamma.dtype)
     return dx[:rows], dgamma, dbeta
 
 
@@ -466,6 +554,7 @@ _fused_layer_norm_2d.defvjp(_fused_layer_norm_2d_fwd,
 
 def fused_layer_norm(x, gamma, beta, eps=1e-5):
     """LayerNorm over the last dim, any leading shape; differentiable."""
+    x, gamma, beta = _demote_f64(x, gamma, beta)
     shape = x.shape
     n = shape[-1]
     out = _fused_layer_norm_2d(x.reshape(-1, n), gamma, beta, float(eps))
@@ -474,24 +563,31 @@ def fused_layer_norm(x, gamma, beta, eps=1e-5):
 
 def _rms_fwd_kernel(x_ref, g_ref, o_ref, rstd_ref, *, eps):
     x = x_ref[:].astype(jnp.float32)
-    ms = jnp.mean(x * x, axis=-1)
+    br = x.shape[0]
+    ms = jnp.mean(x * x, axis=-1, keepdims=True)
     rstd = jax.lax.rsqrt(ms + eps)
-    o_ref[:] = (x * rstd[:, None] * g_ref[:].astype(jnp.float32)).astype(
+    o_ref[:] = (x * rstd * g_ref[:].astype(jnp.float32)).astype(
         o_ref.dtype)
-    rstd_ref[:] = rstd
+    rstd_ref[:] = jnp.broadcast_to(rstd, (br, _STAT_LANES))
 
 
 def _rms_bwd_kernel(x_ref, g_ref, rstd_ref, do_ref, dx_ref, dg_ref):
     x = x_ref[:].astype(jnp.float32)
     do = do_ref[:].astype(jnp.float32)
-    gamma = g_ref[:].astype(jnp.float32)
-    rstd = rstd_ref[:]
-    n = x.shape[-1]
-    xhat = x * rstd[:, None]
-    dg_ref[:] = jnp.sum(do * xhat, axis=0, keepdims=True)
+    gamma = g_ref[:].astype(jnp.float32)                # (1, N)
+    rstd = rstd_ref[:][:, :1]
+    xhat = x * rstd
+
+    @pl.when(pl.program_id(0) == 0)
+    def _init():
+        dg_ref[:] = jnp.zeros_like(dg_ref)
+
+    dg = jnp.sum(do * xhat, axis=0, keepdims=True)
+    dg_ref[:] = dg_ref[:] + jnp.broadcast_to(dg, dg_ref.shape)
+
     dxhat = do * gamma
     m = jnp.mean(dxhat * xhat, axis=-1, keepdims=True)
-    dx = (dxhat - xhat * m) * rstd[:, None]
+    dx = (dxhat - xhat * m) * rstd
     dx_ref[:] = dx.astype(dx_ref.dtype)
 
 
@@ -500,6 +596,7 @@ def _fused_rms_norm_2d(x, gamma, eps):
     return _fused_rms_norm_2d_fwd(x, gamma, eps)[0]
 
 
+@_x32
 def _fused_rms_norm_2d_fwd(x, gamma, eps):
     rows, n = x.shape
     br = _ln_block_rows(rows, n)
@@ -510,21 +607,22 @@ def _fused_rms_norm_2d_fwd(x, gamma, eps):
         grid=(rows_pad // br,),
         in_specs=[
             pl.BlockSpec((br, n), lambda i: (i, 0)),
-            pl.BlockSpec((n,), lambda i: (0,)),
+            pl.BlockSpec((1, n), lambda i: (0, 0)),
         ],
         out_specs=[
             pl.BlockSpec((br, n), lambda i: (i, 0)),
-            pl.BlockSpec((br,), lambda i: (i,)),
+            pl.BlockSpec((br, _STAT_LANES), lambda i: (i, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((rows_pad, n), x.dtype),
-            jax.ShapeDtypeStruct((rows_pad,), jnp.float32),
+            jax.ShapeDtypeStruct((rows_pad, _STAT_LANES), jnp.float32),
         ],
         interpret=_interpret(),
-    )(xp, gamma)
+    )(xp, gamma.reshape(1, n))
     return out[:rows], (x, gamma, rstd)
 
 
+@_x32
 def _fused_rms_norm_2d_bwd(eps, res, do):
     x, gamma, rstd = res
     rows, n = x.shape
@@ -533,26 +631,26 @@ def _fused_rms_norm_2d_bwd(eps, res, do):
     nb = rows_pad // br
     xp = _pad_dim(x, 0, rows_pad)
     dop = _pad_dim(do, 0, rows_pad)
-    dx, dg_part = pl.pallas_call(
+    dx, dg_acc = pl.pallas_call(
         _rms_bwd_kernel,
         grid=(nb,),
         in_specs=[
             pl.BlockSpec((br, n), lambda i: (i, 0)),
-            pl.BlockSpec((n,), lambda i: (0,)),
-            pl.BlockSpec((br,), lambda i: (i,)),
+            pl.BlockSpec((1, n), lambda i: (0, 0)),
+            pl.BlockSpec((br, _STAT_LANES), lambda i: (i, 0)),
             pl.BlockSpec((br, n), lambda i: (i, 0)),
         ],
         out_specs=[
             pl.BlockSpec((br, n), lambda i: (i, 0)),
-            pl.BlockSpec((1, n), lambda i: (i, 0)),
+            pl.BlockSpec((8, n), lambda i: (0, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((rows_pad, n), x.dtype),
-            jax.ShapeDtypeStruct((nb, n), jnp.float32),
+            jax.ShapeDtypeStruct((8, n), jnp.float32),
         ],
         interpret=_interpret(),
-    )(xp, gamma, rstd, dop)
-    dgamma = jnp.sum(dg_part, axis=0).astype(gamma.dtype)
+    )(xp, gamma.reshape(1, n), rstd, dop)
+    dgamma = dg_acc[0].astype(gamma.dtype)
     return dx[:rows], dgamma
 
 
@@ -561,6 +659,7 @@ _fused_rms_norm_2d.defvjp(_fused_rms_norm_2d_fwd, _fused_rms_norm_2d_bwd)
 
 def fused_rms_norm(x, gamma, eps=1e-6):
     """RMSNorm over the last dim, any leading shape; differentiable."""
+    x, gamma = _demote_f64(x, gamma)
     shape = x.shape
     n = shape[-1]
     out = _fused_rms_norm_2d(x.reshape(-1, n), gamma, float(eps))
@@ -573,27 +672,29 @@ def fused_rms_norm(x, gamma, eps=1e-6):
 
 def _xent_fwd_kernel(x_ref, lbl_ref, loss_ref, lse_ref):
     x = x_ref[:].astype(jnp.float32)                   # (block_rows, V)
-    lbl = lbl_ref[:]                                   # (block_rows,)
-    m = jnp.max(x, axis=-1)
-    lse = m + jnp.log(jnp.sum(jnp.exp(x - m[:, None]), axis=-1))
+    br = x.shape[0]
+    lbl = lbl_ref[:][:, :1]                            # (block_rows, 1)
+    m = jnp.max(x, axis=-1, keepdims=True)
+    lse = m + jnp.log(jnp.sum(jnp.exp(x - m), axis=-1, keepdims=True))
     col = jax.lax.broadcasted_iota(jnp.int32, x.shape, 1)
-    picked = jnp.sum(jnp.where(col == lbl[:, None], x, 0.0), axis=-1)
+    picked = jnp.sum(jnp.where(col == lbl, x, 0.0), axis=-1, keepdims=True)
     # ignore_index rows (lbl < 0) produce 0 loss
     valid = lbl >= 0
-    loss_ref[:] = jnp.where(valid, lse - picked, 0.0)
-    lse_ref[:] = lse
+    loss = jnp.where(valid, lse - picked, 0.0)
+    loss_ref[:] = jnp.broadcast_to(loss, (br, _STAT_LANES))
+    lse_ref[:] = jnp.broadcast_to(lse, (br, _STAT_LANES))
 
 
 def _xent_bwd_kernel(x_ref, lbl_ref, lse_ref, g_ref, dx_ref):
     x = x_ref[:].astype(jnp.float32)
-    lbl = lbl_ref[:]
-    lse = lse_ref[:]
-    g = g_ref[:]
-    p = jnp.exp(x - lse[:, None])
+    lbl = lbl_ref[:][:, :1]
+    lse = lse_ref[:][:, :1]
+    g = g_ref[:][:, :1]
+    p = jnp.exp(x - lse)
     col = jax.lax.broadcasted_iota(jnp.int32, x.shape, 1)
-    onehot = (col == lbl[:, None]).astype(jnp.float32)
+    onehot = (col == lbl).astype(jnp.float32)
     valid = (lbl >= 0).astype(jnp.float32)
-    dx = (p - onehot) * (g * valid)[:, None]
+    dx = (p - onehot) * (g * valid)
     dx_ref[:] = dx.astype(dx_ref.dtype)
 
 
@@ -602,49 +703,51 @@ def _fused_xent_2d(logits, labels):
     return _fused_xent_2d_fwd(logits, labels)[0]
 
 
+@_x32
 def _fused_xent_2d_fwd(logits, labels):
     rows, v = logits.shape
     br = _ln_block_rows(rows, v)
     rows_pad = _round_up(rows, br)
     xp = _pad_dim(logits, 0, rows_pad)
-    lp = _pad_dim(labels.astype(jnp.int32), 0, rows_pad, value=-1)
+    lp = _lanes(_pad_dim(labels.astype(jnp.int32), 0, rows_pad, value=-1))
     loss, lse = pl.pallas_call(
         _xent_fwd_kernel,
         grid=(rows_pad // br,),
         in_specs=[
             pl.BlockSpec((br, v), lambda i: (i, 0)),
-            pl.BlockSpec((br,), lambda i: (i,)),
+            pl.BlockSpec((br, _STAT_LANES), lambda i: (i, 0)),
         ],
         out_specs=[
-            pl.BlockSpec((br,), lambda i: (i,)),
-            pl.BlockSpec((br,), lambda i: (i,)),
+            pl.BlockSpec((br, _STAT_LANES), lambda i: (i, 0)),
+            pl.BlockSpec((br, _STAT_LANES), lambda i: (i, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((rows_pad,), jnp.float32),
-            jax.ShapeDtypeStruct((rows_pad,), jnp.float32),
+            jax.ShapeDtypeStruct((rows_pad, _STAT_LANES), jnp.float32),
+            jax.ShapeDtypeStruct((rows_pad, _STAT_LANES), jnp.float32),
         ],
         interpret=_interpret(),
     )(xp, lp)
-    return loss[:rows], (logits, labels, lse[:rows])
+    return loss[:rows, 0], (logits, labels, lse[:rows])
 
 
+@_x32
 def _fused_xent_2d_bwd(res, g):
     logits, labels, lse = res
     rows, v = logits.shape
     br = _ln_block_rows(rows, v)
     rows_pad = _round_up(rows, br)
     xp = _pad_dim(logits, 0, rows_pad)
-    lp = _pad_dim(labels.astype(jnp.int32), 0, rows_pad, value=-1)
+    lp = _lanes(_pad_dim(labels.astype(jnp.int32), 0, rows_pad, value=-1))
     lsep = _pad_dim(lse, 0, rows_pad)
-    gp = _pad_dim(g.astype(jnp.float32), 0, rows_pad)
+    gp = _lanes(_pad_dim(g.astype(jnp.float32), 0, rows_pad))
     dx = pl.pallas_call(
         _xent_bwd_kernel,
         grid=(rows_pad // br,),
         in_specs=[
             pl.BlockSpec((br, v), lambda i: (i, 0)),
-            pl.BlockSpec((br,), lambda i: (i,)),
-            pl.BlockSpec((br,), lambda i: (i,)),
-            pl.BlockSpec((br,), lambda i: (i,)),
+            pl.BlockSpec((br, _STAT_LANES), lambda i: (i, 0)),
+            pl.BlockSpec((br, _STAT_LANES), lambda i: (i, 0)),
+            pl.BlockSpec((br, _STAT_LANES), lambda i: (i, 0)),
         ],
         out_specs=pl.BlockSpec((br, v), lambda i: (i, 0)),
         out_shape=jax.ShapeDtypeStruct((rows_pad, v), logits.dtype),
@@ -663,6 +766,7 @@ def fused_softmax_cross_entropy(logits, labels):
     zero gradient), matching softmax_with_cross_entropy ignore_index
     handling after relabeling.
     """
+    logits, = _demote_f64(logits)
     shape = logits.shape
     v = shape[-1]
     loss = _fused_xent_2d(logits.reshape(-1, v), labels.reshape(-1))

@@ -1,8 +1,14 @@
 """Drive the Pallas kernels on the real TPU (Mosaic compile + parity).
 
-Run: PYTHONPATH=/root/repo:/root/.axon_site python -u scripts/verify_tpu_kernels.py
+Run: python -u scripts/verify_tpu_kernels.py   (from any cwd; bootstraps
+sys.path so a fresh checkout works without pip install — VERDICT r2
+missing #8).  Exits non-zero on any failure.
 """
+import os
+import sys
 import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import jax
 import jax.numpy as jnp
@@ -126,3 +132,4 @@ for name, fn in [("pallas", jax.jit(lambda q, k, v: pk.flash_attention(
 
 print(f"total {time.time()-t0:.0f}s  ALL {'OK' if ok else 'FAILED'}",
       flush=True)
+sys.exit(0 if ok else 1)
