@@ -17,3 +17,8 @@ from .pipeline_parallel import PipelineParallel
 from .sharding.group_sharded import group_sharded_parallel
 from .sharding.group_sharded_stage2 import GroupShardedStage2
 from .sharding.group_sharded_stage3 import GroupShardedStage3
+from .pipeline_parallel import PipelineParallelWithInterleave
+from .context_parallel import (ring_attention, ring_attention_local,
+                               ulysses_attention, ulysses_attention_local)
+from .expert_parallel import (ExpertParallelEngine, global_scatter_local,
+                              global_gather_local, moe_ep_forward_local)

@@ -1,27 +1,32 @@
-"""PipelineParallel: microbatched pipeline training.
+"""PipelineParallel: microbatched pipeline training over a `pp` mesh axis.
 
 Reference parity: `fleet/meta_parallel/pipeline_parallel.py`
 (PipelineParallel.train_batch 1F1B; interleaved variant;
 pp_utils/p2p_communication.py send/recv between stage ranks) [UNVERIFIED —
 empty reference mount].
 
-TPU-native (SURVEY.md §2.3 PP row): with a single-controller SPMD runtime
-the per-rank P2P send/recv loop becomes a *schedule over the mesh*:
-- Stage weights are placed on the 'pp' axis coordinate they belong to.
-- train_batch splits the batch into micro-batches and runs
-  forward/backward per micro-batch, accumulating grads (GPipe semantics —
-  identical loss/grad math to 1F1B; 1F1B's benefit is memory, which
-  jax.checkpoint recovers).  Inter-stage activation movement is XLA
-  resharding over ICI (the collective_permute the reference codes by
-  hand).  A shard_map+ppermute 1F1B kernel is the planned upgrade
-  (parallel/pipeline.py).
+TPU-native (SURVEY.md §2.3 PP row, §3.6): the per-rank P2P send/recv loop
+becomes ONE compiled SPMD schedule (pp_utils/spmd_schedule.py):
+stage-stacked parameters sharded over the `pp` mesh axis, a lax.scan over
+GPipe ticks with `ppermute` inter-stage activation transfer, remat around
+each stage body, and the optimizer update fused into the same executable.
+
+When the model violates the SPMD formulation's constraints (heterogeneous
+stages, fp16 GradScaler, tensor/sep parallel mixed in, no mesh), the
+engine build fails and train_batch falls back to microbatch gradient
+accumulation — same loss/grad math, no inter-stage parallelism — and says
+so once in the log.
 """
 from __future__ import annotations
+
+import logging
 
 import numpy as np
 
 from ....core.tensor import Tensor
 from ...parallel import DataParallel
+
+logger = logging.getLogger("paddle_tpu.pipeline")
 
 __all__ = ["PipelineParallel", "PipelineParallelWithInterleave"]
 
@@ -36,13 +41,89 @@ class PipelineParallel(DataParallel):
         self.accumulate_steps = int(cfg.get("accumulate_steps", 1))
         self.micro_batch_size = int(cfg.get("micro_batch_size", 1))
         self._pipeline_layer = layers  # a PipelineLayer
+        self._engine = None       # SpmdPipelineEngine | False (fallback)
 
     def forward(self, *args, **kwargs):
+        self._sync_from_engine()  # see the engine-trained weights
         return self._layers(*args, **kwargs)
 
+    # ------------------------------------------------------------------
+    def _try_build_engine(self, optimizer):
+        if self._engine is not None:
+            return
+        hcg = self._hcg
+        ok = (hcg is not None and getattr(hcg, "mesh", None) is not None
+              and hcg.get_pipe_parallel_world_size() > 1
+              and hcg.get_model_parallel_world_size() == 1
+              and hcg.get_sep_parallel_world_size() == 1
+              and hasattr(self._pipeline_layer, "segment"))
+        if ok:
+            try:
+                from ....optimizer.optimizer import Optimizer as _OptBase
+                if type(optimizer)._pure_update is _OptBase._pure_update:
+                    raise ValueError(
+                        f"{type(optimizer).__name__} has no fused "
+                        f"static update (_pure_update)")
+                from .pp_utils import SpmdPipelineEngine
+                self._engine = SpmdPipelineEngine(
+                    self._pipeline_layer, hcg, optimizer,
+                    n_micro=max(self.accumulate_steps, 1),
+                    remat=True)
+                logger.info(
+                    "pipeline: SPMD GPipe engine over pp=%d mesh axis, "
+                    "%d microbatches",
+                    hcg.get_pipe_parallel_world_size(),
+                    max(self.accumulate_steps, 1))
+                return
+            except Exception as e:
+                logger.warning(
+                    "pipeline: SPMD engine unavailable (%s); falling back "
+                    "to microbatch gradient accumulation (no inter-stage "
+                    "parallelism)", e)
+        else:
+            logger.warning(
+                "pipeline: no usable pp mesh; falling back to microbatch "
+                "gradient accumulation")
+        self._engine = False
+
+    # ------------------------------------------------------------------
     def train_batch(self, data, optimizer, lr_scheduler=None, scaler=None):
-        """Split into micro-batches; forward+backward each; one step."""
+        """Split into micro-batches and run the pipeline schedule."""
+        if scaler is None:
+            self._try_build_engine(optimizer)
+        if self._engine not in (None, False) and scaler is None:
+            return self._train_batch_spmd(data, optimizer, lr_scheduler)
+        return self._train_batch_accum(data, optimizer, lr_scheduler,
+                                       scaler)
+
+    def _train_batch_spmd(self, data, optimizer, lr_scheduler):
+        import jax.numpy as jnp
+
+        inputs, labels = data
+        x = inputs._value if isinstance(inputs, Tensor) else \
+            jnp.asarray(np.asarray(inputs))
+        y = labels._value if isinstance(labels, Tensor) else \
+            jnp.asarray(np.asarray(labels))
+        n_micro = self._engine.n_micro
+        if x.shape[0] % n_micro != 0:
+            raise ValueError(
+                f"batch {x.shape[0]} not divisible by accumulate_steps "
+                f"{n_micro}")
+        xm = x.reshape((n_micro, x.shape[0] // n_micro) + x.shape[1:])
+        ym = y.reshape((n_micro, y.shape[0] // n_micro) + y.shape[1:])
+        lr = optimizer.get_lr() if hasattr(optimizer, "get_lr") else 1e-3
+        loss = self._engine.train_step(xm, ym, lr)
+        if lr_scheduler is not None:
+            lr_scheduler.step()
+        return Tensor(jnp.asarray(loss, jnp.float32), _internal=True,
+                      stop_gradient=True)
+
+    def _train_batch_accum(self, data, optimizer, lr_scheduler=None,
+                           scaler=None):
         from ....ops.manipulation import split
+
+        # if the SPMD engine trained first, its stacked params are newer
+        self._sync_from_engine()
 
         inputs, labels = data
         n_micro = self.accumulate_steps
@@ -74,9 +155,15 @@ class PipelineParallel(DataParallel):
             lr_scheduler.step()
         return total_loss * (1.0 / n_micro)
 
+    # ------------------------------------------------------------------
+    def _sync_from_engine(self):
+        if self._engine not in (None, False):
+            self._engine.sync_params_to_layers()
+
     def eval_batch(self, data, compute_loss=True):
         from ....core.autograd import no_grad
 
+        self._sync_from_engine()
         inputs, labels = data
         with no_grad():
             out = self._layers.forward(inputs) if hasattr(
@@ -86,6 +173,25 @@ class PipelineParallel(DataParallel):
                 return loss_fn(out, labels)
         return out
 
+    def state_dict(self, *args, **kwargs):
+        self._sync_from_engine()
+        return super().state_dict(*args, **kwargs)
+
 
 class PipelineParallelWithInterleave(PipelineParallel):
-    pass
+    """Interleaved (virtual-pipeline) variant.
+
+    The reference's interleaved 1F1B exists to shrink the pipeline bubble
+    by giving each rank several non-contiguous stage chunks.  Under this
+    framework's single-program SPMD schedule the bubble is governed by
+    the compiled GPipe scan + XLA's latency-hiding scheduler, and the
+    virtual chunks of one rank would still execute serially per tick on a
+    TPU core — so the compiled schedule is identical to
+    PipelineParallel's.  The class is kept for API parity; it accepts and
+    records num_virtual_pipeline_stages.
+    """
+
+    def __init__(self, layers, hcg=None, strategy=None,
+                 num_virtual_pipeline_stages=None, **kwargs):
+        super().__init__(layers, hcg=hcg, strategy=strategy, **kwargs)
+        self._num_virtual_stages = num_virtual_pipeline_stages or 1

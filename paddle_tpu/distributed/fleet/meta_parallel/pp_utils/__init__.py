@@ -1,0 +1,3 @@
+from .spmd_schedule import SpmdPipelineEngine
+
+__all__ = ["SpmdPipelineEngine"]

@@ -1,2 +1,3 @@
 from . import log_util
 from ..recompute import recompute, recompute_sequential
+from . import sequence_parallel_utils

@@ -1,0 +1,164 @@
+"""Megatron-style sequence parallelism helpers.
+
+Reference parity: `fleet/utils/sequence_parallel_utils.py`
+(ColumnSequenceParallelLinear, RowSequenceParallelLinear, AllGatherOp,
+ReduceScatterOp, mark_as_sequence_parallel_parameter,
+register_sequence_parallel_allreduce_hooks) [UNVERIFIED — empty
+reference mount; SURVEY.md §2.3 SP row].
+
+TPU-native: the reference hand-codes allgather-before-column-linear and
+reduce-scatter-after-row-linear on the TP group.  Here activations carry
+*sharding constraints* on the sequence dim over the `mp` mesh axis and
+XLA's SPMD partitioner inserts the all_gather / reduce_scatter over ICI
+(SURVEY.md §2.3: "seq-dim sharding in pjit specs; XLA inserts ag/rs").
+The layer classes keep the reference's API; AllGatherOp/ReduceScatterOp
+are the explicit-constraint primitives, differentiable because a
+resharding constraint transposes to the inverse resharding.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ....core.tensor import Tensor
+from ....nn import Layer
+from ...env import global_mesh
+
+__all__ = [
+    "AllGatherOp", "ReduceScatterOp", "ColumnSequenceParallelLinear",
+    "RowSequenceParallelLinear", "mark_as_sequence_parallel_parameter",
+    "register_sequence_parallel_allreduce_hooks",
+    "ScatterOp", "GatherOp",
+]
+
+
+def _mp_axis(mesh):
+    for cand in ("mp", "tp", "model"):
+        if cand in mesh.axis_names:
+            return cand
+    return None
+
+
+def _constrain(x, spec_entries):
+    """Apply a sharding constraint to a Tensor/array; 'MP' entries bind
+    to the mp mesh axis.  No-op without a mesh (single-device tests)."""
+    mesh = global_mesh()
+    if mesh is None:
+        return x
+    axis = _mp_axis(mesh)
+    if axis is None:
+        return x
+    spec = P(*[axis if e == "MP" else None for e in spec_entries])
+    val = x._value if isinstance(x, Tensor) else x
+    try:
+        out = jax.lax.with_sharding_constraint(
+            val, NamedSharding(mesh, spec))
+    except Exception:
+        return x  # outside jit on an unsharded value: placement advisory
+    if isinstance(x, Tensor):
+        t = Tensor(out, _internal=True, stop_gradient=x.stop_gradient)
+        t._grad_node = x._grad_node
+        return t
+    return out
+
+
+def ScatterOp(x, axis=1):
+    """Shard the sequence dim over mp (reference: split to the TP group;
+    here a reshard constraint XLA lowers to a local slice)."""
+    entries = [None] * (x.ndim if hasattr(x, "ndim") else 3)
+    entries[axis] = "MP"
+    return _constrain(x, entries)
+
+
+def GatherOp(x, axis=1):
+    """Gather the sequence dim from the mp shards (all_gather)."""
+    entries = [None] * (x.ndim if hasattr(x, "ndim") else 3)
+    return _constrain(x, entries)
+
+
+AllGatherOp = GatherOp        # reference names both; gather == allgather
+ReduceScatterOp = ScatterOp   # partial-sum in → seq-sharded out
+
+
+class ColumnSequenceParallelLinear(Layer):
+    """Column-parallel linear whose INPUT is sequence-sharded.
+
+    [B, S/mp, in] --(XLA all_gather over mp)--> [B, S, in] @ W[:, out/mp]
+    → [B, S, out/mp].  Weight is placed column-sharded on the mp axis.
+    """
+
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 has_bias=True, gather_output=False, mp_group=None,
+                 name=None):
+        super().__init__()
+        self.weight = self.create_parameter(
+            shape=[in_features, out_features], attr=weight_attr)
+        self.bias = self.create_parameter(
+            shape=[out_features], attr=None, is_bias=True) \
+            if has_bias else None
+        self.gather_output = gather_output
+        from ..meta_parallel.parallel_layers.mp_layers import _place
+        _place(self.weight, (None, "MP"))
+        if self.bias is not None:
+            _place(self.bias, ("MP",))
+
+    def forward(self, x):
+        from ....nn import functional as F
+        x = _constrain(x, (None, "MP", None))   # seq-sharded in
+        y = F.linear(x, self.weight, self.bias)
+        y = _constrain(y, (None, None, None if self.gather_output
+                           else "MP"))
+        return y
+
+
+class RowSequenceParallelLinear(Layer):
+    """Row-parallel linear whose OUTPUT is sequence-sharded.
+
+    [B, S, in/mp] @ W[in/mp, out] → partial sums; the output constraint
+    [B, S/mp, out] makes XLA emit the reduce_scatter the reference codes
+    by hand.
+    """
+
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 has_bias=True, input_is_parallel=True, mp_group=None,
+                 name=None):
+        super().__init__()
+        self.weight = self.create_parameter(
+            shape=[in_features, out_features], attr=weight_attr)
+        self.bias = self.create_parameter(
+            shape=[out_features], attr=None, is_bias=True) \
+            if has_bias else None
+        from ..meta_parallel.parallel_layers.mp_layers import _place
+        _place(self.weight, ("MP", None))
+
+    def forward(self, x):
+        from ....nn import functional as F
+        x = _constrain(x, (None, None, "MP"))
+        y = F.linear(x, self.weight, None)
+        y = _constrain(y, (None, "MP", None))   # seq-sharded out (rs)
+        if self.bias is not None:
+            y = y + self.bias
+        return y
+
+
+def mark_as_sequence_parallel_parameter(param):
+    """Tag a parameter (e.g. LayerNorm weight inside the SP region) so
+    its gradient is summed over the mp group."""
+    param.sequence_parallel = True
+
+
+def register_sequence_parallel_allreduce_hooks(model, accumulation_steps=1,
+                                               fuse=False):
+    """Reference behavior: backward hooks allreduce marked params' grads
+    over the TP group (each rank saw only its sequence shard).
+
+    In this single-controller runtime eager tensors are global values and
+    sharded execution happens under pjit, where XLA already reduces the
+    gradient of a replicated parameter across the mesh — so there is no
+    residual per-rank partial grad to fix up.  The function validates the
+    marks and exists for API parity.
+    """
+    marked = [p for p in model.parameters()
+              if getattr(p, "sequence_parallel", False)]
+    return marked

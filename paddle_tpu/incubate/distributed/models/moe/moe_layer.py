@@ -83,6 +83,36 @@ class MoELayer(Layer):
         self.top_k = top_k
         self.capacity_factor = capacity_factor
         self.aux_loss = None
+        self._ep_engine = None  # ExpertParallelEngine | False
+        self._ep_mesh = None    # mesh the cached decision was made for
+
+    def _maybe_ep_engine(self):
+        """Build the ep-axis SPMD engine lazily when the global mesh has
+        an expert axis (see meta_parallel/expert_parallel.py).  The
+        decision is re-evaluated whenever the global mesh changes, so a
+        warm-up forward before fleet.init() doesn't disable EP forever."""
+        from .....distributed.env import global_mesh
+        mesh = global_mesh()
+        if self._ep_engine is not None and mesh is self._ep_mesh:
+            return self._ep_engine or None
+        self._ep_mesh = mesh
+        axis = None
+        if mesh is not None:
+            for cand in ("ep", "expert"):
+                if cand in mesh.axis_names and mesh.shape[cand] > 1:
+                    axis = cand
+                    break
+        if axis is None:
+            self._ep_engine = False
+        else:
+            try:
+                from .....distributed.fleet.meta_parallel.\
+                    expert_parallel import ExpertParallelEngine
+                self._ep_engine = ExpertParallelEngine(
+                    self, mesh=mesh, axis=axis)
+            except Exception:
+                self._ep_engine = False
+        return self._ep_engine or None
 
     def forward(self, x):
         from .....ops.manipulation import reshape
@@ -93,6 +123,28 @@ class MoELayer(Layer):
             N *= s
         d = orig_shape[-1]
         xf = reshape(x, [N, d])
+
+        engine = self._maybe_ep_engine()
+        if engine is not None:
+            import numpy as _np
+            n_shards = int(_np.prod(
+                [engine.mesh.shape[a] for a in engine.tok_axes]))
+            E = len(self.experts)
+            if N % n_shards == 0:
+                C = max(int(self.capacity_factor * (N // n_shards) *
+                            self.top_k / max(E, 1)), 1)
+                ne = len(engine.expert_tensors)
+
+                def impl(xv, *pv, C):
+                    return engine(xv, pv[:ne], pv[ne:], C)
+
+                y, aux = dispatch(
+                    "moe_ep", impl,
+                    (xf,) + tuple(engine.expert_tensors)
+                    + tuple(engine.gate_tensors), dict(C=C))
+                self.aux_loss = aux
+                return reshape(y, orig_shape)
+
         probs, topk_idx, topk_val, aux = self.gate(xf)
         self.aux_loss = aux
         E = len(self.experts)

