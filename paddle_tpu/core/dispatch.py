@@ -55,6 +55,76 @@ class _DispatchState(threading.local):
 
 _state = _DispatchState()
 
+# ---------------------------------------------------------------------
+# Eager per-op executable cache (SURVEY.md §3.1: per-op dispatch is THE
+# dygraph bottleneck).  Instead of tracing jax.vjp anew and executing
+# the op primitive-by-primitive on every eager call, each (op, impl
+# code, static args, input avals) signature gets ONE jitted
+# forward(+vjp) executable; jax.vjp's returned function is a pytree
+# (residual arrays + static structure), so it crosses the jit boundary
+# and a single shared jitted applier runs the backward.  Ops whose impl
+# closes over free variables, or with unhashable statics, fall back to
+# the plain eager path (the cache must key all behavior).
+# ---------------------------------------------------------------------
+_EAGER_JIT_MAX = 4096
+_eager_fwd_cache: dict = {}
+_eager_vjp_cache: dict = {}
+_bwd_apply = None
+
+
+def _get_bwd_apply():
+    global _bwd_apply
+    if _bwd_apply is None:
+        _bwd_apply = jax.jit(lambda vjp_fn, cts: vjp_fn(cts))
+    return _bwd_apply
+
+
+_HASHABLE = (bool, int, float, str, bytes, type(None), slice,
+             type(Ellipsis))
+
+
+def _static_sig(v):
+    import numpy as _np
+    if isinstance(v, slice):
+        return ("slice", v.start, v.stop, v.step)
+    if isinstance(v, _HASHABLE):
+        # type tag: 2, 2.0 and True hash/compare equal but trace to
+        # different graphs (dtype promotion)
+        return (type(v).__name__, v)
+    if isinstance(v, _np.generic):
+        return (type(v).__name__, v.item())
+    if isinstance(v, (tuple, list)):
+        return tuple(_static_sig(x) for x in v)
+    raise TypeError
+
+
+def _jit_key(name, impl, args, tensor_idx, arrays, attrs):
+    from ..framework.flags import get_flags
+    if not get_flags("FLAGS_eager_op_jit")["FLAGS_eager_op_jit"]:
+        return None
+    code = getattr(impl, "__code__", None)
+    if code is None:
+        # builtins / jnp ufuncs: no closure to worry about; key on the
+        # (hashable) callable itself
+        try:
+            hash(impl)
+        except TypeError:
+            return None
+        code = impl
+    elif code.co_freevars:
+        return None
+    tset = set(tensor_idx)
+    try:
+        statics = tuple(
+            (i, _static_sig(a)) for i, a in enumerate(args)
+            if i not in tset)
+        attr_sig = tuple(sorted(
+            (k, _static_sig(v)) for k, v in attrs.items()))
+    except TypeError:
+        return None
+    aval_sig = tuple((v.shape, str(v.dtype)) for v in arrays)
+    return (name, code, statics, attr_sig, aval_sig)
+
 
 def get_dispatch_state():
     return _state
@@ -97,7 +167,28 @@ def dispatch(name: str, impl: Callable, args: Sequence[Any], attrs=None,
         and any(needs)
     )
 
+    key = _jit_key(name, impl, args, tensor_idx, arrays, attrs)
+
     if not record:
+        if key is not None:
+            cached = _eager_fwd_cache.get(key)
+            if cached is None and len(_eager_fwd_cache) < _EAGER_JIT_MAX:
+                # None at tensor slots: the closure must not pin the
+                # first call's Tensors (and their autograd graphs)
+                template = [None if i in set(tensor_idx) else a
+                            for i, a in enumerate(args)]
+
+                def pure_fwd(*arrs, _t=template, _ti=tuple(tensor_idx),
+                             _impl=impl, _attrs=attrs):
+                    full = list(_t)
+                    for i, v in zip(_ti, arrs):
+                        full[i] = v
+                    return _impl(*full, **_attrs)
+
+                cached = jax.jit(pure_fwd)
+                _eager_fwd_cache[key] = cached
+            if cached is not None:
+                return _wrap(cached(*arrays), name, node=None)
         full = list(args)
         for i, v in zip(tensor_idx, arrays):
             full[i] = v
@@ -109,6 +200,37 @@ def dispatch(name: str, impl: Callable, args: Sequence[Any], attrs=None,
         for i, v in zip(tensor_idx, arrs):
             full[i] = v
         return impl(*full, **attrs)
+
+    if key is not None:
+        cached = _eager_vjp_cache.get(key)
+        if cached is None and len(_eager_vjp_cache) < _EAGER_JIT_MAX:
+            template = [None if i in set(tensor_idx) else a
+                        for i, a in enumerate(args)]
+
+            def pure_pair(*arrs, _t=template, _ti=tuple(tensor_idx),
+                          _impl=impl, _attrs=attrs):
+                def f(*inner):
+                    full = list(_t)
+                    for i, v in zip(_ti, inner):
+                        full[i] = v
+                    return _impl(*full, **_attrs)
+                return jax.vjp(f, *arrs)
+
+            cached = jax.jit(pure_pair)
+            _eager_vjp_cache[key] = cached
+        if cached is not None:
+            outs, raw_vjp = cached(*arrays)
+            apply = _get_bwd_apply()
+
+            def vjp_fn(cts, _raw=raw_vjp, _apply=apply):
+                return _apply(_raw, cts)
+
+            is_multi = isinstance(outs, (tuple, list))
+            outs_t = tuple(outs) if is_multi else (outs,)
+            node = autograd.GradNode(
+                name, vjp_fn, tensors, needs, len(outs_t),
+                [(o.shape, o.dtype) for o in outs_t])
+            return _wrap(outs, name, node=node)
 
     outs, vjp_fn = jax.vjp(fn, *arrays)
     is_multi = isinstance(outs, (tuple, list))

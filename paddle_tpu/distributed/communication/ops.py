@@ -340,11 +340,14 @@ class P2POp:
 
 
 def batch_isend_irecv(p2p_op_list):
-    """Batched P2P: on TPU these fuse into one collective_permute.
+    """Batched P2P (reference: `pp_utils/p2p_communication.py`).
 
-    Reference parity: `pp_utils/p2p_communication.py` batch_isend_irecv.
-    Inside shard_map the sends/recvs pair up as a single ppermute with all
-    (src,dst) pairs.
+    Delegates per-op: each send lowers to its own ppermute.  In COMPILED
+    graphs XLA's CollectivePermuteCombiner merges adjacent permutes with
+    disjoint pairs into one collective, so the fused-transfer behavior
+    the reference hand-codes is recovered at compile time; the pipeline
+    engine (pp_utils/spmd_schedule.py) emits a single ppermute directly
+    and does not go through this compat shim.
     """
     works = []
     for op in p2p_op_list:

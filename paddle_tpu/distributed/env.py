@@ -37,12 +37,15 @@ def get_rank(group=None):
 
 
 def get_world_size(group=None):
+    """Device-level SPMD world size (one rank per chip).
+
+    NB: deliberately NOT PADDLE_TRAINERS_NUM — that env var counts
+    controller PROCESSES (one per host, set by the launch CLI) and only
+    feeds jax.distributed.initialize; the mesh/topology world is the
+    global chip count, which jax.device_count() reports across all
+    processes once the runtime is initialized."""
     if group is not None:
         return group.nranks
-    env = os.environ.get("PADDLE_TRAINERS_NUM")
-    if env is not None:
-        return int(env)
-    # device-level world size (one rank per chip, SPMD view)
     return jax.device_count()
 
 
@@ -61,12 +64,15 @@ def init_parallel_env(strategy=None):
         return ParallelEnv()
     coord = os.environ.get("PADDLE_MASTER") or \
         os.environ.get("MASTER_ADDR")
-    nnodes = int(os.environ.get("PADDLE_NNODES", "1"))
-    if nnodes > 1 and coord and not jax.distributed.is_initialized():
+    # total controller processes: set by the launch CLI
+    # (= nnodes * nproc_per_node); one per host on TPU
+    nprocs = int(os.environ.get(
+        "PADDLE_TRAINERS_NUM", os.environ.get("PADDLE_NNODES", "1")))
+    if nprocs > 1 and coord and not jax.distributed.is_initialized():
         port = os.environ.get("MASTER_PORT", "8476")
         jax.distributed.initialize(
             coordinator_address=f"{coord.split(':')[0]}:{port}",
-            num_processes=nnodes,
+            num_processes=nprocs,
             process_id=int(os.environ.get("PADDLE_TRAINER_ID", "0")))
     _initialized = True
     if _global_mesh is None:

@@ -92,7 +92,24 @@ class PipelineParallel(DataParallel):
         if scaler is None:
             self._try_build_engine(optimizer)
         if self._engine not in (None, False) and scaler is None:
-            return self._train_batch_spmd(data, optimizer, lr_scheduler)
+            inputs = data[0]
+            if inputs.shape[0] % self._engine.n_micro == 0:
+                return self._train_batch_spmd(data, optimizer,
+                                              lr_scheduler)
+            logger.warning(
+                "pipeline: batch %d not divisible by accumulate_steps "
+                "%d; running this batch on the accumulation path",
+                inputs.shape[0], self._engine.n_micro)
+        if self._engine not in (None, False):
+            # the accumulation path is about to train the EAGER params;
+            # the engine's stacked copies would silently diverge, so
+            # sync down and retire the engine (reference behavior: one
+            # schedule per run)
+            logger.warning(
+                "pipeline: leaving the SPMD engine (scaler or ragged "
+                "batch); continuing on the accumulation path")
+            self._engine.sync_params_to_layers()
+            self._engine = False
         return self._train_batch_accum(data, optimizer, lr_scheduler,
                                        scaler)
 

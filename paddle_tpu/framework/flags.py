@@ -27,6 +27,9 @@ _FLAGS = {
     # consumes the old buffer and stale views raise "Array has been
     # deleted" (paddle.clone() copies and is always safe).
     "FLAGS_buffer_donation": True,
+    # eager per-op executable cache (jitted fwd+vjp per op signature);
+    # the dygraph per-op-dispatch mitigation from SURVEY.md §3.1
+    "FLAGS_eager_op_jit": True,
     "FLAGS_matmul_precision": "default",  # default|highest (f32 on MXU)
 }
 

@@ -19,6 +19,10 @@ _MAGIC = "paddle_tpu.tensor"
 
 
 class _TensorPayload:
+    """Legacy payload class: kept ONLY so checkpoints written by older
+    versions still unpickle; new files use a plain-dict payload that is
+    immune to module-path renames."""
+
     def __init__(self, array, dtype_name, is_parameter, name,
                  stop_gradient):
         self.magic = _MAGIC
@@ -37,8 +41,10 @@ def _pack(obj):
         dtype_name = obj.dtype.name
         if dtype_name == "bfloat16":
             arr = arr.astype(np.float32)
-        return _TensorPayload(arr, dtype_name, isinstance(obj, Parameter),
-                              obj.name, obj.stop_gradient)
+        return {"__magic__": _MAGIC, "array": arr,
+                "dtype_name": dtype_name,
+                "is_parameter": isinstance(obj, Parameter),
+                "name": obj.name, "stop_gradient": obj.stop_gradient}
     if isinstance(obj, dict):
         return {k: _pack(v) for k, v in obj.items()}
     if isinstance(obj, (list, tuple)):
@@ -66,6 +72,11 @@ def _unpack(obj, return_numpy=False):
         t.name = obj.name
         return t
     if isinstance(obj, dict):
+        if obj.get("__magic__") == _MAGIC:
+            payload = _TensorPayload(
+                obj["array"], obj["dtype_name"], obj["is_parameter"],
+                obj["name"], obj["stop_gradient"])
+            return _unpack(payload, return_numpy)
         return {k: _unpack(v, return_numpy) for k, v in obj.items()}
     if isinstance(obj, list):
         return [_unpack(v, return_numpy) for v in obj]

@@ -54,25 +54,32 @@ class Generator:
         return sub
 
 
-_default_generator = Generator(0)
+_default_generator = None
 
 
 def default_generator() -> Generator:
+    """Lazy: creating the PRNG key initializes the XLA backend, which
+    must not happen at import time (jax.distributed.initialize in
+    init_parallel_env must run first on multi-host — SURVEY.md §3.4)."""
+    global _default_generator
+    if _default_generator is None:
+        _default_generator = Generator(0)
     return _default_generator
 
 
 def seed(s: int):
-    _default_generator.manual_seed(s)
-    return _default_generator
+    g = default_generator()
+    g.manual_seed(s)
+    return g
 
 
 def get_rng_state():
-    return [_default_generator.get_state()]
+    return [default_generator().get_state()]
 
 
 def set_rng_state(states):
     st = states[0] if isinstance(states, (list, tuple)) else states
-    _default_generator.set_state(st)
+    default_generator().set_state(st)
 
 
 def get_cuda_rng_state():

@@ -10,4 +10,23 @@ from . import distributed  # MoE lives here (incubate.distributed.models.moe)
 
 
 def autograd_functional_jacobian(func, xs):
-    raise NotImplementedError
+    """Dense Jacobian of func at xs (incubate.autograd parity) via
+    reverse-mode jax.jacrev over the framework's pure-op core."""
+    import jax
+    from ..core.tensor import Tensor
+    from ..core.autograd import no_grad
+
+    single = isinstance(xs, Tensor)
+    xs_t = [xs] if single else list(xs)
+    vals = [x._value for x in xs_t]
+
+    def pure(*vs):
+        with no_grad():
+            out = func(*[Tensor(v, _internal=True, stop_gradient=True)
+                         for v in vs])
+        return out._value if isinstance(out, Tensor) else out
+
+    jac = jax.jacrev(pure, argnums=tuple(range(len(vals))))(*vals)
+    wrapped = tuple(Tensor(j, _internal=True, stop_gradient=True)
+                    for j in jac)
+    return wrapped[0] if single else wrapped

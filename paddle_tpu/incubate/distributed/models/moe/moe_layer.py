@@ -110,7 +110,12 @@ class MoELayer(Layer):
                     expert_parallel import ExpertParallelEngine
                 self._ep_engine = ExpertParallelEngine(
                     self, mesh=mesh, axis=axis)
-            except Exception:
+            except Exception as e:
+                import logging
+                logging.getLogger("paddle_tpu.moe").warning(
+                    "MoE: '%s' mesh axis present but expert parallelism "
+                    "unavailable (%s); running the dense replicated "
+                    "path", axis, e)
                 self._ep_engine = False
         return self._ep_engine or None
 

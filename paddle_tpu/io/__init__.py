@@ -3,10 +3,12 @@
 Reference parity: `python/paddle/io/` (`dataloader/dataloader_iter.py`
 multiprocess workers) [UNVERIFIED — empty reference mount].
 
-TPU-native notes: host input pipeline feeds the device via async transfers;
-the DataLoader here supports multiprocess workers (spawn via
-multiprocessing) and a single-process fast path.  DistributedBatchSampler
-shards by process (data-parallel rank).
+TPU-native notes: host input pipeline feeds the device via async transfers.
+num_workers > 0 uses real multiprocessing workers (forked; samples fetched
+and transformed in the workers, collation in the parent so device arrays
+never cross the pipe), falling back to a prefetching thread pool when the
+platform cannot fork.  DistributedBatchSampler shards by process
+(data-parallel rank).
 """
 from __future__ import annotations
 
@@ -293,6 +295,29 @@ def default_collate_fn(batch):
     return batch
 
 
+class _MPUnavailable(RuntimeError):
+    pass
+
+
+_mp_dataset = None
+
+
+def _mp_worker_init(dataset, init_fn):
+    global _mp_dataset
+    _mp_dataset = dataset
+    if init_fn is not None:
+        import multiprocessing as mp
+        wid = 0
+        ident = mp.current_process()._identity
+        if ident:
+            wid = ident[0] - 1
+        init_fn(wid)
+
+
+def _mp_fetch(indices):
+    return [_mp_dataset[i] for i in indices]
+
+
 class DataLoader:
     def __init__(self, dataset, feed_list=None, places=None,
                  return_list=True, batch_sampler=None, batch_size=1,
@@ -331,7 +356,10 @@ class DataLoader:
         elif self.num_workers == 0:
             yield from self._iter_single()
         else:
-            yield from self._iter_threaded()
+            try:
+                yield from self._iter_multiprocess()
+            except _MPUnavailable:
+                yield from self._iter_threaded()
 
     def _iter_iterable(self):
         batch = []
@@ -346,6 +374,65 @@ class DataLoader:
     def _iter_single(self):
         for indices in self.batch_sampler:
             yield self.collate_fn([self.dataset[i] for i in indices])
+
+    def _iter_multiprocess(self):
+        """Real multiprocess workers (the reference's dataloader_iter
+        worker pool): the dataset is shared into forked workers
+        (copy-on-write, nothing pickled per item), workers run
+        __getitem__ — the GIL-bound decode/augment cost — and ship
+        sample lists back; the parent collates so jax device arrays
+        never cross the pipe."""
+        import multiprocessing as mp
+
+        # forking after the XLA runtime started its thread pools can
+        # deadlock children; spawn (dataset pickled once into workers)
+        # is the safe method then
+        method = "fork"
+        try:
+            from jax._src import xla_bridge as _xb
+            if _xb.backends_are_initialized():
+                method = "spawn"
+        except Exception:
+            pass
+        try:
+            ctx = mp.get_context(method)
+        except ValueError as e:  # pragma: no cover - non-POSIX
+            raise _MPUnavailable(str(e))
+
+        dataset = self.dataset
+        init_fn = self.worker_init_fn
+
+        try:
+            pool = ctx.Pool(
+                self.num_workers,
+                initializer=_mp_worker_init,
+                initargs=(dataset, init_fn))
+        except Exception as e:  # unpicklable dataset/init_fn under spawn
+            raise _MPUnavailable(str(e))
+        try:
+            depth = max(2, self.prefetch_factor * self.num_workers)
+            pending = queue.Queue()
+            it = iter(self.batch_sampler)
+
+            def submit_next():
+                try:
+                    indices = next(it)
+                except StopIteration:
+                    return False
+                pending.put(pool.apply_async(_mp_fetch, (list(indices),)))
+                return True
+
+            for _ in range(depth):
+                if not submit_next():
+                    break
+            while not pending.empty():
+                res = pending.get()
+                samples = res.get()
+                submit_next()
+                yield self.collate_fn(samples)
+        finally:
+            pool.terminate()
+            pool.join()
 
     def _iter_threaded(self):
         """Prefetch with a thread pool (host-side pipeline; the heavy work

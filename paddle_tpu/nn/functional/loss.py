@@ -193,10 +193,6 @@ def binary_cross_entropy_with_logits(logit, label, weight=None,
     def impl(z, y, *extra, reduction, has_w, has_pw):
         # numerically stable: max(z,0) - z*y + log(1+exp(-|z|))
         loss = jnp.maximum(z, 0) - z * y + jnp.log1p(jnp.exp(-jnp.abs(z)))
-        i = 0
-        if has_pw:
-            pw = extra[i + (1 if has_w else 0)] if False else None
-        # apply pos_weight properly
         if has_pw:
             pw_arr = extra[1] if has_w else extra[0]
             log_sig = jax.nn.log_sigmoid(z)

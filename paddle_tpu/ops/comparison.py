@@ -19,10 +19,11 @@ __all__ = [
 ]
 
 
-def _cmp(name, fn):
+def _cmp(op_name, fn):
+    # public `name=None` kwarg must not shadow the dispatch op name
     def op(x, y, name=None):
-        return dispatch(name, fn, (x, y), {}, differentiable=False)
-    op.__name__ = name
+        return dispatch(op_name, fn, (x, y), {}, differentiable=False)
+    op.__name__ = op_name
     return op
 
 

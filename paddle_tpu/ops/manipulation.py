@@ -534,11 +534,20 @@ def _canon_index(idx):
 
 def getitem(x, idx):
     cidx = _canon_index(idx)
+    try:  # fully-static index → attr (cacheable in the eager jit cache)
+        from ..core.dispatch import _static_sig
+        _static_sig(cidx)
+
+        def impl_static(v, *, cidx):
+            return v[cidx]
+
+        return dispatch("slice", impl_static, (x,), dict(cidx=cidx))
+    except TypeError:
+        pass  # index contains arrays: keep them in the closure
 
     def impl(v):
         return v[cidx]
 
-    # Tensors used in index are traced separately? keep simple: closure.
     return dispatch("slice", impl, (x,), {})
 
 

@@ -30,17 +30,19 @@ __all__ = [
 ]
 
 
-def _ew(name, fn):
+def _ew(op_name, fn):
+    # NB: the public `name=None` kwarg (Paddle API) must not shadow the
+    # op name fed to dispatch — it keys the eager executable cache
     def op(x, name=None):
-        return dispatch(name, fn, (x,), {})
-    op.__name__ = name
+        return dispatch(op_name, fn, (x,), {})
+    op.__name__ = op_name
     return op
 
 
-def _binop(name, fn):
+def _binop(op_name, fn):
     def op(x, y, name=None):
-        return dispatch(name, fn, (x, y), {})
-    op.__name__ = name
+        return dispatch(op_name, fn, (x, y), {})
+    op.__name__ = op_name
     return op
 
 

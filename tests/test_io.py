@@ -105,3 +105,31 @@ def test_save_load_nested(tmp_path):
     loaded = paddle.load(p)
     assert loaded["s"] == "hello"
     np.testing.assert_allclose(loaded["a"][0].numpy(), [1, 1])
+
+
+def test_dataloader_multiprocess_workers():
+    import numpy as np
+    from paddle_tpu.io import DataLoader, Dataset
+
+    class Sq(Dataset):
+        def __len__(self):
+            return 17
+
+        def __getitem__(self, i):
+            return np.float32(i * i)
+
+    dl = DataLoader(Sq(), batch_size=4, num_workers=2, shuffle=False)
+    got = [np.asarray(b) for b in dl]
+    flat = np.concatenate([g.ravel() for g in got])
+    np.testing.assert_allclose(flat, np.arange(17, dtype=np.float32) ** 2)
+
+
+def test_incubate_jacobian():
+    import numpy as np
+    import paddle_tpu as paddle
+
+    x = paddle.to_tensor(np.array([1.0, 2.0, 3.0], np.float32))
+    j = paddle.incubate.autograd_functional_jacobian(
+        lambda t: t * t, x)
+    np.testing.assert_allclose(np.asarray(j._value),
+                               np.diag([2.0, 4.0, 6.0]), rtol=1e-6)

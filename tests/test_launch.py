@@ -1,0 +1,78 @@
+"""Multi-host runtime simulation: the launch CLI spawns 2 controller
+processes over localhost, init_parallel_env performs
+jax.distributed.initialize, the global mesh forms across processes, and
+a cross-process allreduce matches the expected sum (SURVEY.md §4
+fake-cluster-on-localhost; VERDICT r3 item 7)."""
+import os
+import socket
+import subprocess
+import sys
+import textwrap
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+WORKER = textwrap.dedent("""
+    import os
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+    import paddle_tpu as paddle
+    import paddle_tpu.distributed as dist
+
+    dist.init_parallel_env()
+    rank = dist.get_rank()
+    n = jax.process_count()
+    assert n == 2, f"expected 2 processes, got {n}"
+    assert jax.device_count() == 2 * jax.local_device_count()
+
+    # global mesh across both processes; allreduce via shard_map psum
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+    mesh = Mesh(np.array(jax.devices()), ("dp",))
+    nd = jax.device_count()
+    local = np.full((jax.local_device_count(), 4), float(rank + 1),
+                    np.float32)
+    arr = jax.make_array_from_process_local_data(
+        NamedSharding(mesh, P("dp")), local, (nd, 4))
+
+    def f(x):
+        return jax.lax.psum(x, "dp")
+
+    out = jax.jit(jax.shard_map(f, mesh=mesh, in_specs=P("dp"),
+                                out_specs=P(), check_vma=False))(arr)
+    # sum over all device shards: ranks contribute (rank+1) each
+    expect = sum((r + 1) * jax.local_device_count() for r in range(2))
+    got = float(np.asarray(jax.device_get(out)).ravel()[0])
+    assert got == expect, f"allreduce got {got} want {expect}"
+    print(f"RANK{rank} ALLREDUCE_OK {got}")
+""")
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def test_launch_two_process_allreduce(tmp_path):
+    worker = tmp_path / "worker.py"
+    worker.write_text(WORKER)
+    log_dir = tmp_path / "logs"
+    port = _free_port()
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env.pop("JAX_PLATFORMS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    r = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.distributed.launch",
+         "--master", f"127.0.0.1:{port}", "--nnodes", "1",
+         "--nproc_per_node", "2", "--log_dir", str(log_dir),
+         str(worker)],
+        env=env, cwd=REPO, capture_output=True, text=True, timeout=300)
+    logs = "\n".join(
+        (log_dir / f"workerlog.{i}").read_text() for i in range(2))
+    assert r.returncode == 0, f"launcher rc={r.returncode}\n{logs}"
+    assert "RANK0 ALLREDUCE_OK" in logs and "RANK1 ALLREDUCE_OK" in logs, \
+        logs
