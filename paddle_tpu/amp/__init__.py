@@ -23,21 +23,12 @@ __all__ = ["auto_cast", "amp_guard", "decorate", "GradScaler",
            "white_list", "black_list", "is_float16_supported",
            "is_bfloat16_supported"]
 
-# op lists follow Paddle's O1 defaults: matmul-class ops cast to low
-# precision; numerically-sensitive ops stay f32.
-WHITE_LIST = {
-    "matmul_v2", "mm", "bmm", "mv", "linear", "conv1d", "conv2d", "conv3d",
-    "conv1d_transpose", "conv2d_transpose", "conv3d_transpose", "einsum",
-    "scaled_dot_product_attention", "addmm", "inner",
-}
-BLACK_LIST = {
-    "exp", "square", "log", "log2", "log10", "log1p", "mean", "reduce_mean",
-    "reduce_sum", "sum", "cos_sim", "softmax", "log_softmax",
-    "softmax_with_cross_entropy", "cross_entropy", "sigmoid_cross_entropy",
-    "c_softmax_with_cross_entropy", "layer_norm", "batch_norm", "rms_norm",
-    "p_norm", "l2_normalize", "reduce_prod", "pow", "erf", "logsumexp",
-    "variance", "std", "group_norm", "instance_norm",
-}
+# O1 white/black lists are GENERATED from ops.yaml (the single source
+# of truth for op classification — python -m paddle_tpu.ops.gen);
+# matmul-class ops cast to low precision, numerically-sensitive ops
+# stay f32.
+from ..ops._generated import (AMP_WHITE_LIST as WHITE_LIST,
+                              AMP_BLACK_LIST as BLACK_LIST)
 
 
 def white_list():
@@ -105,13 +96,16 @@ def _amp_caster(op_name, args):
 def auto_cast(enable=True, custom_white_list=None, custom_black_list=None,
               level="O1", dtype="float16", use_promote=True):
     global WHITE_LIST, BLACK_LIST
-    saved_w, saved_b = set(WHITE_LIST), set(BLACK_LIST)
+    saved_w, saved_b = WHITE_LIST, BLACK_LIST
+    # REBIND, never mutate: the sets are shared with ops._generated
+    # (the yaml-codegen source of truth) — in-place |=/-= would corrupt
+    # the generated classification for every other consumer
     if custom_white_list:
-        WHITE_LIST |= set(custom_white_list)
-        BLACK_LIST -= set(custom_white_list)
+        WHITE_LIST = (WHITE_LIST | set(custom_white_list))
+        BLACK_LIST = (BLACK_LIST - set(custom_white_list))
     if custom_black_list:
-        BLACK_LIST |= set(custom_black_list)
-        WHITE_LIST -= set(custom_black_list)
+        BLACK_LIST = (BLACK_LIST | set(custom_black_list))
+        WHITE_LIST = (WHITE_LIST - set(custom_black_list))
     st = _AmpState(enable, dtype, level)
     _amp_stack.append(st)
     ds = get_dispatch_state()

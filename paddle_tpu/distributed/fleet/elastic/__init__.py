@@ -1,0 +1,3 @@
+from .manager import ElasticManager, ElasticStore
+
+__all__ = ["ElasticManager", "ElasticStore"]

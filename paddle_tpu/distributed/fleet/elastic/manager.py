@@ -1,0 +1,122 @@
+"""Elastic training: heartbeats, failure detection, relaunch.
+
+Reference parity: `python/paddle/distributed/fleet/elastic/manager.py`
+(ElasticManager: each rank heartbeats into etcd, the manager watches
+membership and on node loss kills local workers and relaunches with
+renumbered ranks, resuming from user checkpoints) [UNVERIFIED — empty
+reference mount; SURVEY.md §5 "Failure detection / elastic"].
+
+TPU-native: pod slices fail all-or-nothing and there is no etcd — the
+health signals are (a) worker process exit, watched by the launch CLI,
+and (b) heartbeat staleness in a small KV store: the
+jax.distributed coordination service's key-value store when the
+multi-controller runtime is up (the same service that replaced
+TCPStore), else a shared-filesystem directory (single host / tests).
+Recovery is the checkpoint-restore loop: the launcher's
+--max_restarts relaunches the pod and training scripts resume from
+their latest checkpoint (`paddle.distributed.checkpoint` reshards on
+load if the topology changed).
+"""
+from __future__ import annotations
+
+import os
+import threading
+import time
+
+__all__ = ["ElasticStore", "ElasticManager"]
+
+
+class ElasticStore:
+    """Tiny KV for heartbeats: coordination-service-backed when
+    jax.distributed is initialized, directory-backed otherwise."""
+
+    def __init__(self, path=None):
+        self._client = None
+        try:
+            from jax._src import distributed as _dist
+            if _dist.global_state.client is not None:
+                self._client = _dist.global_state.client
+        except Exception:
+            pass
+        self._dir = path or os.environ.get(
+            "PADDLE_ELASTIC_DIR", "/tmp/paddle_tpu_elastic")
+        if self._client is None:
+            os.makedirs(self._dir, exist_ok=True)
+
+    def set(self, key, value: str):
+        if self._client is not None:
+            self._client.key_value_set(f"elastic/{key}", value)
+            return
+        with open(os.path.join(self._dir, key), "w") as f:
+            f.write(value)
+
+    def get(self, key, default=None):
+        if self._client is not None:
+            try:
+                return self._client.blocking_key_value_get(
+                    f"elastic/{key}", 100)
+            except Exception:
+                return default
+        p = os.path.join(self._dir, key)
+        if not os.path.exists(p):
+            return default
+        with open(p) as f:
+            return f.read()
+
+
+class ElasticManager:
+    """Heartbeat writer + staleness watchdog.
+
+    Each rank calls start(); the rank-0 watcher (or the launcher)
+    polls dead_ranks() and triggers the relaunch path when a rank goes
+    silent past the timeout (the reference's etcd-watch equivalent).
+    """
+
+    def __init__(self, rank=None, world_size=None, timeout=30.0,
+                 interval=3.0, store=None):
+        self.rank = rank if rank is not None else int(
+            os.environ.get("PADDLE_TRAINER_ID", "0"))
+        self.world = world_size if world_size is not None else int(
+            os.environ.get("PADDLE_TRAINERS_NUM", "1"))
+        self.timeout = float(
+            os.environ.get("PADDLE_ELASTIC_TIMEOUT", timeout))
+        self.interval = interval
+        self.store = store or ElasticStore()
+        self._stop = threading.Event()
+        self._thread = None
+
+    # ---- heartbeat side ----
+    def start(self):
+        self.beat()
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+        return self
+
+    def beat(self):
+        self.store.set(f"hb_{self.rank}", repr(time.time()))
+
+    def _loop(self):
+        while not self._stop.wait(self.interval):
+            self.beat()
+
+    def stop(self):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=self.interval * 2)
+
+    # ---- watcher side ----
+    def last_beat(self, rank):
+        v = self.store.get(f"hb_{rank}")
+        return float(v) if v else None
+
+    def dead_ranks(self):
+        now = time.time()
+        dead = []
+        for r in range(self.world):
+            t = self.last_beat(r)
+            if t is None or now - t > self.timeout:
+                dead.append(r)
+        return dead
+
+    def healthy(self):
+        return not self.dead_ranks()

@@ -114,6 +114,10 @@ def distributed_model(model):
 
 
 def distributed_optimizer(optimizer, strategy=None):
-    """Returns the optimizer (XLA handles grad sync via sharding; the
-    reference wraps with HybridParallelOptimizer for comm scheduling)."""
-    return optimizer
+    """Wrap the optimizer per the DistributedStrategy meta-optimizer
+    flags (reference: fleet's meta-optimizer chain); grad SYNC itself is
+    XLA's job via sharding, so no HybridParallelOptimizer comm
+    scheduling is needed."""
+    strategy = strategy or _fleet_state.get("strategy")
+    from .meta_optimizers import apply_meta_optimizers
+    return apply_meta_optimizers(optimizer, strategy)

@@ -1,0 +1,126 @@
+"""Meta-optimizers: strategy-driven optimizer wrappers.
+
+Reference parity: `python/paddle/distributed/fleet/meta_optimizers/`
+(gradient_merge_optimizer.py, lamb_optimizer.py, ... — static-graph
+program rewrites keyed off DistributedStrategy flags) [UNVERIFIED —
+empty reference mount; SURVEY.md §2.3 "Static meta-optimizers"].
+
+TPU-native: there is no ProgramDesc to rewrite — both engines bottom
+out in the optimizer's fused `_pure_update`, so a meta-optimizer is an
+optimizer WRAPPER whose `_pure_update` transforms the inner one and
+whose eager `step()` does the same imperative transform.  XLA compiles
+the k-step accumulate + conditional apply into the train step (the
+reference inserts gradient-merge ops into the program).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ....optimizer.optimizer import Optimizer
+
+__all__ = ["GradientMergeOptimizer", "apply_meta_optimizers"]
+
+
+class GradientMergeOptimizer(Optimizer):
+    """Accumulate grads for k steps, then apply the inner optimizer.
+
+    Works on both engines: eager `step()` accumulates into host-side
+    buffers and applies the inner optimizer every k-th call; the static
+    `_pure_update` carries the accumulators in opt state and applies
+    under `lax.cond` — compiled into the single train-step executable.
+    """
+
+    def __init__(self, inner, k_steps=1, avg=True):
+        self.inner = inner
+        self.k_steps = int(k_steps)
+        self.avg = bool(avg)
+        self._accum = {}
+        self._count = 0
+
+    # delegate the Optimizer surface to the inner optimizer
+    def __getattr__(self, name):
+        return getattr(self.inner, name)
+
+    # ---- eager engine ----
+    def step(self):
+        from ....core.tensor import Tensor
+        params = [p for p in self.inner._parameter_list
+                  if p.grad is not None]
+        for p in params:
+            a = self._accum.get(id(p))
+            g = p.grad._value
+            self._accum[id(p)] = g if a is None else a + g
+        self._count += 1
+        if self._count % self.k_steps:
+            return
+        scale = 1.0 / self.k_steps if self.avg else 1.0
+        for p in params:
+            p.grad._value = self._accum.pop(id(p)) * scale
+        self.inner.step()
+
+    def clear_grad(self, set_to_zero=True):
+        self.inner.clear_grad(set_to_zero)
+
+    # ---- static/compiled engines ----
+    def _ensure_static_state(self, params):
+        inner_state = self.inner._ensure_static_state(params)
+        from ....core.tensor import Tensor
+        # microstep counter rides in opt state so it is TRACED: the
+        # executor compiles the step once, and a python-side counter
+        # would bake "(step+1) % k" to a constant
+        counter = Tensor(jnp.zeros((), jnp.int64), _internal=True,
+                         stop_gradient=True)
+        accum = [Tensor(jnp.zeros(p._value.shape, jnp.float32),
+                        _internal=True, stop_gradient=True)
+                 for p in params]
+        return [counter] + accum + list(inner_state)
+
+    def _static_update(self, param_vals, grads, opt_vals, params):
+        lr = self.inner._lr_tensor._value
+        step = self.inner._step_count._value
+        self.inner._step_count._inplace_update(step + 1)
+        return self._pure_update(lr, step, param_vals, grads, opt_vals,
+                                 params)
+
+    def _pure_update(self, lr, step, param_vals, grads, opt_vals, params):
+        del step  # traced microstep counter lives in opt_vals[0]
+        n = len(param_vals)
+        counter = opt_vals[0]
+        accum = opt_vals[1:n + 1]
+        inner_state = tuple(opt_vals[n + 1:])
+        k = self.k_steps
+        new_accum = tuple(a + g.astype(jnp.float32)
+                          for a, g in zip(accum, grads))
+        apply_now = (counter + 1) % k == 0
+        scale = 1.0 / k if self.avg else 1.0
+        # inner step index counts APPLIES, not microsteps
+        inner_step = (counter + 1) // k - 1
+
+        def do_apply(_):
+            merged = tuple((a * scale).astype(g.dtype)
+                           for a, g in zip(new_accum, grads))
+            new_p, new_inner = self.inner._pure_update(
+                lr, inner_step, param_vals, merged, inner_state, params)
+            zeros = tuple(jnp.zeros_like(a) for a in new_accum)
+            return tuple(new_p), zeros + tuple(new_inner)
+
+        def keep(_):
+            return tuple(param_vals), new_accum + inner_state
+
+        new_p, new_opt = jax.lax.cond(apply_now, do_apply, keep,
+                                      operand=None)
+        return new_p, (counter + 1,) + tuple(new_opt)
+
+
+def apply_meta_optimizers(optimizer, strategy):
+    """Wrap `optimizer` per the DistributedStrategy flags (the
+    reference's meta-optimizer selection in fleet.distributed_optimizer)."""
+    if strategy is None:
+        return optimizer
+    if getattr(strategy, "gradient_merge", False):
+        cfg = getattr(strategy, "gradient_merge_configs", {})
+        optimizer = GradientMergeOptimizer(
+            optimizer, k_steps=cfg.get("k_steps", 1),
+            avg=cfg.get("avg", True))
+    return optimizer

@@ -93,13 +93,15 @@ class PipelineParallel(DataParallel):
             self._try_build_engine(optimizer)
         if self._engine not in (None, False) and scaler is None:
             inputs = data[0]
-            if inputs.shape[0] % self._engine.n_micro == 0:
+            n0 = (inputs.shape[0] if hasattr(inputs, "shape")
+                  else len(inputs))
+            if n0 % self._engine.n_micro == 0:
                 return self._train_batch_spmd(data, optimizer,
                                               lr_scheduler)
             logger.warning(
                 "pipeline: batch %d not divisible by accumulate_steps "
                 "%d; running this batch on the accumulation path",
-                inputs.shape[0], self._engine.n_micro)
+                n0, self._engine.n_micro)
         if self._engine not in (None, False):
             # the accumulation path is about to train the EAGER params;
             # the engine's stacked copies would silently diverge, so

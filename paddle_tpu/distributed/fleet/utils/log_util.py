@@ -1,19 +1,61 @@
-"""fleet logging (reference: fleet/utils/log_util.py [UNVERIFIED])."""
+"""fleet logging: VLOG-style levels + per-rank log capture.
+
+Reference parity: `fleet/utils/log_util.py` (python logging) and the
+C++ glog `VLOG(n)` convention gated by the GLOG_v env var, with the
+launch CLI teeing per-rank worker logs [UNVERIFIED — empty reference
+mount; SURVEY.md §5 "Metrics/logging/observability"].
+
+TPU-native notes: every paddle_tpu subsystem logs under the
+"paddle_tpu.*" namespace (fleet, pipeline, moe, pallas); this module
+owns the shared handler.  GLOG_v=N enables vlog(n<=N) verbose traces
+exactly like the reference's C++ side; PADDLE_LOG_DIR (set by the
+launch CLI) adds a per-rank file handler so multi-process runs keep
+separated logs.
+"""
 import logging
+import os
 import sys
 
+_root = logging.getLogger("paddle_tpu")
 logger = logging.getLogger("paddle_tpu.fleet")
-if not logger.handlers:
+
+GLOG_V = int(os.environ.get("GLOG_v", "0"))
+
+if not _root.handlers:
     h = logging.StreamHandler(sys.stderr)
     h.setFormatter(logging.Formatter(
-        "%(asctime)s %(levelname)s [fleet] %(message)s"))
-    logger.addHandler(h)
-logger.setLevel(logging.INFO)
+        "%(asctime)s %(levelname)s [%(name)s] %(message)s"))
+    _root.addHandler(h)
+    _root.setLevel(logging.DEBUG if GLOG_V > 0 else logging.INFO)
+    log_dir = os.environ.get("PADDLE_LOG_DIR")
+    if log_dir:
+        rank = os.environ.get("PADDLE_TRAINER_ID", "0")
+        os.makedirs(log_dir, exist_ok=True)
+        fh = logging.FileHandler(
+            os.path.join(log_dir, f"paddle_tpu.rank{rank}.log"))
+        fh.setFormatter(h.formatter)
+        _root.addHandler(fh)
 
 
 def set_log_level(level):
-    logger.setLevel(level)
+    """Accepts logging levels or a glog-style int verbosity."""
+    if isinstance(level, int) and level < 10:
+        global GLOG_V
+        GLOG_V = level
+        _root.setLevel(logging.DEBUG if level > 0 else logging.INFO)
+        return
+    _root.setLevel(level)
+
+
+def vlog(level, msg, *args, logger_name="paddle_tpu.fleet"):
+    """VLOG(level): emitted only when GLOG_v >= level (reference: glog
+    verbose logging gated by the GLOG_v env var)."""
+    if GLOG_V >= level:
+        logging.getLogger(logger_name).debug("VLOG(%d) " + msg, level,
+                                             *args)
 
 
 def get_logger(level=logging.INFO, name="paddle_tpu.fleet"):
-    return logger
+    lg = logging.getLogger(name)
+    lg.setLevel(level)
+    return lg

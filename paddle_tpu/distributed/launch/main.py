@@ -42,6 +42,12 @@ def _parse_args(argv=None):
                    help="processes per node (1 on TPU; >1 for CPU "
                         "fake-cluster tests)")
     p.add_argument("--log_dir", default="log")
+    p.add_argument("--max_restarts", type=int,
+                   default=int(os.environ.get("PADDLE_ELASTIC_MAX_RESTARTS",
+                                              "0")),
+                   help="elastic: relaunch the pod up to N times on "
+                        "worker failure (training resumes from user "
+                        "checkpoints)")
     p.add_argument("--devices", default=None,
                    help="accepted for reference-CLI compat (device "
                         "visibility is PJRT-managed on TPU)")
@@ -51,6 +57,20 @@ def _parse_args(argv=None):
 
 
 def launch(args):
+    """Elastic outer loop (reference: ElasticManager relaunch): run the
+    pod; on failure relaunch up to --max_restarts times with
+    PADDLE_RESTART_CNT incremented so workers resume from checkpoints."""
+    restarts = 0
+    while True:
+        rc = _launch_once(args, restarts)
+        if rc == 0 or rc == 130 or restarts >= args.max_restarts:
+            return rc  # 130 = user interrupt: never relaunch on Ctrl-C
+        restarts += 1
+        print(f"launch: elastic relaunch {restarts}/{args.max_restarts} "
+              f"(previous rc={rc})", file=sys.stderr, flush=True)
+
+
+def _launch_once(args, restarts=0):
     nproc = args.nproc_per_node
     world = args.nnodes * nproc
     master = args.master or os.environ.get("MASTER_ADDR", "127.0.0.1")
@@ -73,8 +93,10 @@ def launch(args):
             "MASTER_ADDR": addr,
             "MASTER_PORT": str(port),
             "PADDLE_CURRENT_ENDPOINT": f"{addr}:{int(port) + rank + 1}",
+            "PADDLE_RESTART_CNT": str(restarts),
         })
-        log_path = os.path.join(args.log_dir, f"workerlog.{rank}")
+        suffix = f".restart{restarts}" if restarts else ""
+        log_path = os.path.join(args.log_dir, f"workerlog.{rank}{suffix}")
         lf = open(log_path, "w")
         logs.append(lf)
         cmd = [sys.executable, "-u", args.training_script,

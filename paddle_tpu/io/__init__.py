@@ -302,20 +302,25 @@ class _MPUnavailable(RuntimeError):
 _mp_dataset = None
 
 
-def _mp_worker_init(dataset, init_fn):
+def _mp_worker_init(dataset, init_fn, counter):
     global _mp_dataset
     _mp_dataset = dataset
     if init_fn is not None:
-        import multiprocessing as mp
-        wid = 0
-        ident = mp.current_process()._identity
-        if ident:
-            wid = ident[0] - 1
+        # explicit 0..num_workers-1 id from a shared counter; the
+        # process _identity is a parent-global counter that drifts out
+        # of range on the second epoch's fresh pool
+        with counter.get_lock():
+            wid = counter.value
+            counter.value += 1
         init_fn(wid)
 
 
 def _mp_fetch(indices):
     return [_mp_dataset[i] for i in indices]
+
+
+def _mp_probe():
+    return _mp_dataset is not None
 
 
 class DataLoader:
@@ -403,11 +408,20 @@ class DataLoader:
         init_fn = self.worker_init_fn
 
         try:
+            counter = ctx.Value("i", 0)
             pool = ctx.Pool(
                 self.num_workers,
                 initializer=_mp_worker_init,
-                initargs=(dataset, init_fn))
-        except Exception as e:  # unpicklable dataset/init_fn under spawn
+                initargs=(dataset, init_fn, counter))
+            # smoke round: spawn-unpickle failures crash CHILDREN after
+            # Pool() returns, leaving every result pending forever; a
+            # bounded probe turns that hang into the threaded fallback
+            pool.apply_async(_mp_probe).get(timeout=60)
+        except Exception as e:  # unpicklable dataset/init_fn, dead pool
+            try:
+                pool.terminate()
+            except Exception:
+                pass
             raise _MPUnavailable(str(e))
         try:
             depth = max(2, self.prefetch_factor * self.num_workers)

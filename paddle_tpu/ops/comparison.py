@@ -27,18 +27,13 @@ def _cmp(op_name, fn):
     return op
 
 
-equal = _cmp("equal", jnp.equal)
-not_equal = _cmp("not_equal", jnp.not_equal)
-greater_than = _cmp("greater_than", jnp.greater)
-greater_equal = _cmp("greater_equal", jnp.greater_equal)
-less_than = _cmp("less_than", jnp.less)
-less_equal = _cmp("less_equal", jnp.less_equal)
-logical_and = _cmp("logical_and", jnp.logical_and)
-logical_or = _cmp("logical_or", jnp.logical_or)
-logical_xor = _cmp("logical_xor", jnp.logical_xor)
-bitwise_and = _cmp("bitwise_and", jnp.bitwise_and)
-bitwise_or = _cmp("bitwise_or", jnp.bitwise_or)
-bitwise_xor = _cmp("bitwise_xor", jnp.bitwise_xor)
+# comparison/logical bindings are GENERATED from ops.yaml
+# (python -m paddle_tpu.ops.gen); bespoke-signature ops stay below
+from ._generated import (  # noqa: F401
+    equal, not_equal, greater_than, greater_equal, less_than, less_equal,
+    logical_and, logical_or, logical_xor, bitwise_and, bitwise_or,
+    bitwise_xor)
+
 bitwise_left_shift = _cmp("bitwise_left_shift", jnp.left_shift)
 bitwise_right_shift = _cmp("bitwise_right_shift", jnp.right_shift)
 

@@ -46,81 +46,26 @@ def _binop(op_name, fn):
     return op
 
 
-add = _binop("elementwise_add", jnp.add)
-subtract = _binop("elementwise_sub", jnp.subtract)
-multiply = _binop("elementwise_mul", jnp.multiply)
-divide = _binop("elementwise_div", jnp.divide)
-floor_divide = _binop("elementwise_floordiv", jnp.floor_divide)
-mod = _binop("elementwise_mod", jnp.mod)
+# Binary/unary elementwise bindings are GENERATED from ops.yaml
+# (python -m paddle_tpu.ops.gen) — the reference's yaml->api.cc codegen
+# role.  Only ops with bespoke signatures stay hand-written below.
+from ._generated import (  # noqa: F401
+    add, subtract, multiply, divide, floor_divide, mod, maximum, minimum,
+    fmax, fmin, atan2, heaviside, hypot, logaddexp, ldexp, gcd, lcm, pow)
+
 remainder = mod
-maximum = _binop("elementwise_max", jnp.maximum)
-minimum = _binop("elementwise_min", jnp.minimum)
-fmax = _binop("elementwise_fmax", jnp.fmax)
-fmin = _binop("elementwise_fmin", jnp.fmin)
-atan2 = _binop("atan2", jnp.arctan2)
-heaviside = _binop("elementwise_heaviside", jnp.heaviside)
-hypot = _binop("hypot", jnp.hypot)
-logaddexp = _binop("logaddexp", jnp.logaddexp)
-ldexp = _binop("ldexp", jnp.ldexp)
-gcd = _binop("gcd", jnp.gcd)
-lcm = _binop("lcm", jnp.lcm)
-
-
-def pow(x, y, name=None):
-    return dispatch("elementwise_pow", jnp.power, (x, y), {})
 
 
 float_power = pow
 
-sqrt = _ew("sqrt", jnp.sqrt)
-rsqrt = _ew("rsqrt", jax.lax.rsqrt)
-square = _ew("square", jnp.square)
-exp = _ew("exp", jnp.exp)
-expm1 = _ew("expm1", jnp.expm1)
-log = _ew("log", jnp.log)
-log2 = _ew("log2", jnp.log2)
-log10 = _ew("log10", jnp.log10)
-log1p = _ew("log1p", jnp.log1p)
-abs = _ew("abs", jnp.abs)
-neg = _ew("neg", jnp.negative)
-sin = _ew("sin", jnp.sin)
-cos = _ew("cos", jnp.cos)
-tan = _ew("tan", jnp.tan)
-asin = _ew("asin", jnp.arcsin)
-acos = _ew("acos", jnp.arccos)
-atan = _ew("atan", jnp.arctan)
-sinh = _ew("sinh", jnp.sinh)
-cosh = _ew("cosh", jnp.cosh)
-tanh = _ew("tanh", jnp.tanh)
-asinh = _ew("asinh", jnp.arcsinh)
-acosh = _ew("acosh", jnp.arccosh)
-atanh = _ew("atanh", jnp.arctanh)
-floor = _ew("floor", jnp.floor)
-ceil = _ew("ceil", jnp.ceil)
-round = _ew("round", jnp.round)
-trunc = _ew("trunc", jnp.trunc)
-reciprocal = _ew("reciprocal", jnp.reciprocal)
-erf = _ew("erf", jax.scipy.special.erf)
-erfinv = _ew("erfinv", jax.scipy.special.erfinv)
-lgamma = _ew("lgamma", jax.scipy.special.gammaln)
-digamma = _ew("digamma", jax.scipy.special.digamma)
-rad2deg = _ew("rad2deg", jnp.rad2deg)
-deg2rad = _ew("deg2rad", jnp.deg2rad)
-angle = _ew("angle", jnp.angle)
-conj = _ew("conj", jnp.conjugate)
-real = _ew("real", jnp.real)
-imag = _ew("imag", jnp.imag)
-
-
-def sign(x, name=None):
-    return dispatch("sign", jnp.sign, (x,), {}, differentiable=False)
+from ._generated import (  # noqa: F401
+    sqrt, rsqrt, square, exp, expm1, log, log2, log10, log1p, abs, neg,
+    sin, cos, tan, asin, acos, atan, sinh, cosh, tanh, asinh, acosh,
+    atanh, floor, ceil, round, trunc, reciprocal, erf, erfinv, lgamma,
+    digamma, rad2deg, deg2rad, angle, conj, real, imag, frac, sign)
 
 
 sgn = sign
-
-
-def frac(x, name=None):
-    return dispatch("frac", lambda v: v - jnp.trunc(v), (x,), {})
 
 
 def clip(x, min=None, max=None, name=None):

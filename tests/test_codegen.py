@@ -1,0 +1,35 @@
+"""ops.yaml codegen (SURVEY.md §2.4): the checked-in _generated.py must
+match a fresh regeneration, and the schema must classify the hot ops."""
+import os
+
+
+def test_generated_in_sync():
+    from paddle_tpu.ops import gen, _generated
+    fresh = gen.generate()
+    path = os.path.join(os.path.dirname(_generated.__file__),
+                        "_generated.py")
+    assert open(path).read() == fresh, \
+        "paddle_tpu/ops/_generated.py is stale: run python -m paddle_tpu.ops.gen"
+
+
+def test_op_table_metadata():
+    from paddle_tpu.ops._generated import (OP_TABLE, AMP_WHITE_LIST,
+                                           AMP_BLACK_LIST,
+                                           CUSTOM_VJP_OPS)
+    assert "matmul_v2" in AMP_WHITE_LIST
+    assert "layer_norm" in AMP_BLACK_LIST and "softmax" in AMP_BLACK_LIST
+    assert "layer_norm" in CUSTOM_VJP_OPS  # pallas hand-written backward
+    assert OP_TABLE["elementwise_add"]["kind"] == "binary"
+    assert OP_TABLE["gcd"]["differentiable"] is False
+
+
+def test_generated_bindings_execute():
+    import numpy as np
+    import paddle_tpu as paddle
+    x = paddle.to_tensor(np.array([1.0, -2.0], np.float32))
+    y = paddle.to_tensor(np.array([3.0, 4.0], np.float32))
+    assert float(paddle.add(x, y).sum()) == 6.0
+    assert bool(paddle.less_than(x, y)._value.all())
+    x.stop_gradient = False
+    paddle.tanh(x).sum().backward()
+    assert x.grad is not None
