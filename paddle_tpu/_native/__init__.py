@@ -1,0 +1,117 @@
+"""Native host-runtime kernels (C, ctypes-loaded).
+
+Reference parity: the reference's host runtime (DataLoader readers,
+buffer bookkeeping) is native C++ (SURVEY.md §2.1/§2.2) [UNVERIFIED —
+empty reference mount].  Here the device runtime is PJRT/XLA; the
+host-side batch assembly is the piece that benefits from native code,
+implemented in collate.c and compiled on first use with the system cc
+(`cc -O3 -shared -fPIC`), cached under ~/.cache/paddle_tpu.  Everything
+degrades to numpy when no compiler is available — `available()` tells
+you which path is live.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+import numpy as np
+
+__all__ = ["available", "fast_stack", "gather_rows"]
+
+_lib = None
+_tried = False
+_lock = threading.Lock()
+
+
+def _build_and_load():
+    src = os.path.join(os.path.dirname(__file__), "collate.c")
+    cache = os.path.join(
+        os.path.expanduser(os.environ.get("PADDLE_TPU_CACHE",
+                                          "~/.cache/paddle_tpu")),
+        "native")
+    os.makedirs(cache, exist_ok=True)
+    so = os.path.join(cache, "libptnative.so")
+    if not os.path.exists(so) or (os.path.getmtime(so)
+                                  < os.path.getmtime(src)):
+        tmp = f"{so}.{os.getpid()}.tmp"  # per-pid: N ranks may race here
+        for cc in ("cc", "gcc", "clang"):
+            try:
+                subprocess.run(
+                    [cc, "-O3", "-shared", "-fPIC", "-o", tmp, src],
+                    check=True, capture_output=True, timeout=120)
+                os.replace(tmp, so)
+                break
+            except (OSError, subprocess.SubprocessError):
+                continue
+        else:
+            return None
+    lib = ctypes.CDLL(so)
+    lib.pt_stack_copy.argtypes = [
+        ctypes.POINTER(ctypes.c_char_p), ctypes.c_int64,
+        ctypes.c_int64, ctypes.c_char_p]
+    lib.pt_gather_rows.argtypes = [
+        ctypes.c_char_p, ctypes.POINTER(ctypes.c_int64),
+        ctypes.c_int64, ctypes.c_int64, ctypes.c_char_p]
+    lib.pt_i64_to_i32.argtypes = [
+        ctypes.POINTER(ctypes.c_int64), ctypes.c_int64,
+        ctypes.POINTER(ctypes.c_int32)]
+    return lib
+
+
+def _get():
+    global _lib, _tried
+    if not _tried:
+        with _lock:
+            if not _tried:
+                try:
+                    _lib = _build_and_load()
+                except Exception:
+                    _lib = None
+                _tried = True
+    return _lib
+
+
+def available() -> bool:
+    return _get() is not None
+
+
+def fast_stack(arrays):
+    """np.stack for a list of same-shape contiguous arrays, with the
+    copy loop in C (GIL released — worker threads overlap)."""
+    lib = _get()
+    first = np.asarray(arrays[0])
+    if (lib is None or first.dtype == object
+            or any(not isinstance(a, np.ndarray)
+                   or a.shape != first.shape or a.dtype != first.dtype
+                   for a in arrays)):
+        return np.stack([np.asarray(a) for a in arrays])
+    arrs = [np.ascontiguousarray(a) for a in arrays]
+    n = len(arrs)
+    nbytes = first.nbytes
+    out = np.empty((n,) + first.shape, first.dtype)
+    ptrs = (ctypes.c_char_p * n)(*[
+        ctypes.cast(a.ctypes.data, ctypes.c_char_p) for a in arrs])
+    lib.pt_stack_copy(ptrs, n, nbytes,
+                      out.ctypes.data_as(ctypes.c_char_p))
+    return out
+
+
+def gather_rows(src, indices):
+    """out[i] = src[indices[i]] over dim 0 (C memcpy per row)."""
+    lib = _get()
+    src = np.ascontiguousarray(src)
+    idx = np.ascontiguousarray(np.asarray(indices, np.int64))
+    if (lib is None or idx.size == 0 or idx.min() < 0
+            or idx.max() >= src.shape[0]):
+        # numpy path also owns negative/out-of-range semantics — the C
+        # memcpy must never see an unchecked index
+        return src[idx]
+    row = int(np.prod(src.shape[1:])) * src.dtype.itemsize
+    out = np.empty((len(idx),) + src.shape[1:], src.dtype)
+    lib.pt_gather_rows(
+        src.ctypes.data_as(ctypes.c_char_p),
+        idx.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        len(idx), row, out.ctypes.data_as(ctypes.c_char_p))
+    return out

@@ -1,0 +1,42 @@
+/* Native batch-assembly kernels for the host input pipeline.
+ *
+ * Reference parity: the reference's DataLoader core is native C++
+ * (`paddle/fluid/operators/reader/`, dataloader shared-memory workers —
+ * SURVEY.md §2.2 Data row [UNVERIFIED: empty reference mount]).
+ *
+ * TPU-native: the device side is XLA's job; what remains hot on the
+ * host is assembling sample arrays into one contiguous batch that the
+ * runtime can hand to the device DMA in a single transfer.  These
+ * kernels run GIL-free (ctypes releases the GIL for the duration of
+ * the call), so DataLoader worker threads overlap collation with
+ * Python-side sample fetch.
+ *
+ * Built by paddle_tpu._native at first use:  cc -O3 -shared -fPIC.
+ */
+#include <string.h>
+#include <stdint.h>
+
+/* stack n same-sized contiguous buffers into out (batch dim 0) */
+void pt_stack_copy(const char **srcs, int64_t n, int64_t nbytes,
+                   char *out) {
+    for (int64_t i = 0; i < n; ++i) {
+        memcpy(out + i * nbytes, srcs[i], nbytes);
+    }
+}
+
+/* gather rows: out[i] = src[idx[i]] for row size nbytes (host-side
+ * shuffle/batch-index materialization) */
+void pt_gather_rows(const char *src, const int64_t *idx, int64_t n,
+                    int64_t nbytes, char *out) {
+    for (int64_t i = 0; i < n; ++i) {
+        memcpy(out + i * nbytes, src + idx[i] * nbytes, nbytes);
+    }
+}
+
+/* int64 -> int32 narrowing copy (label tensors: Paddle defaults int64,
+ * TPU kernels want int32) */
+void pt_i64_to_i32(const int64_t *src, int64_t n, int32_t *out) {
+    for (int64_t i = 0; i < n; ++i) {
+        out[i] = (int32_t)src[i];
+    }
+}

@@ -47,8 +47,12 @@ class ElasticStore:
         if self._client is not None:
             self._client.key_value_set(f"elastic/{key}", value)
             return
-        with open(os.path.join(self._dir, key), "w") as f:
+        # atomic replace: a watcher must never read a truncated beat
+        p = os.path.join(self._dir, key)
+        tmp = f"{p}.{os.getpid()}.tmp"
+        with open(tmp, "w") as f:
             f.write(value)
+        os.replace(tmp, p)
 
     def get(self, key, default=None):
         if self._client is not None:

@@ -100,6 +100,9 @@ class GradientMergeOptimizer(Optimizer):
         def do_apply(_):
             merged = tuple((a * scale).astype(g.dtype)
                            for a, g in zip(new_accum, grads))
+            # the inner optimizer's grad_clip applies to the MERGED grad
+            # (parity with the eager path, which clips in inner.step())
+            merged = self.inner._clip_static_grads(merged)
             new_p, new_inner = self.inner._pure_update(
                 lr, inner_step, param_vals, merged, inner_state, params)
             zeros = tuple(jnp.zeros_like(a) for a in new_accum)

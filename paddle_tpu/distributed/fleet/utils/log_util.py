@@ -57,5 +57,6 @@ def vlog(level, msg, *args, logger_name="paddle_tpu.fleet"):
 
 def get_logger(level=logging.INFO, name="paddle_tpu.fleet"):
     lg = logging.getLogger(name)
-    lg.setLevel(level)
+    if GLOG_V == 0:  # verbose mode: never clamp children below root
+        lg.setLevel(level)
     return lg

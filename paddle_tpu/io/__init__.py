@@ -276,12 +276,13 @@ def get_worker_info():
 
 
 def default_collate_fn(batch):
+    from .._native import fast_stack  # C memcpy, GIL-free (native host path)
     sample = batch[0]
     if isinstance(sample, (Tensor,)):
-        vals = np.stack([np.asarray(b._value) for b in batch])
+        vals = fast_stack([np.asarray(b._value) for b in batch])
         return to_tensor(vals)
     if isinstance(sample, np.ndarray):
-        return to_tensor(np.stack(batch))
+        return to_tensor(fast_stack(batch))
     if isinstance(sample, (int, np.integer)):
         return to_tensor(np.asarray(batch, np.int64))
     if isinstance(sample, (float, np.floating)):

@@ -1,7 +1,17 @@
 """paddle.jit: to_static, save/load.
 
-Reference parity: `python/paddle/jit/api.py` [UNVERIFIED — empty reference
+Reference parity: `python/paddle/jit/api.py` — paddle.jit.save persists
+a program + params that AnalysisPredictor / paddle.jit.load can run
+WITHOUT the originating python class [UNVERIFIED — empty reference
 mount].
+
+TPU-native: the "program" is a `jax.export` StableHLO artifact — the
+layer's forward is traced to a pure function of (state, inputs), lowered
+for BOTH cpu and tpu, and serialized next to the weights.  `load`
+returns a TranslatedLayer that executes the deserialized executable
+directly, so inference needs no model code (the reference's
+save_inference_model contract).  Dynamic batch dims in the input_spec
+(None) export as symbolic dimensions.
 """
 from __future__ import annotations
 
@@ -28,39 +38,143 @@ def ignore_module(modules):
     pass
 
 
+def _export_forward(layer, state_tensors, input_spec):
+    """Trace layer.forward into pure(state, *inputs) and jax.export it
+    (cpu+tpu lowerings; None dims become symbolic)."""
+    import jax
+    from jax import export as jexport
+    from ..core.tensor import Tensor
+    from ..core.autograd import no_grad
+    from ..core.dtypes import to_jax_dtype
+
+    names = sorted(state_tensors)
+    tensors = [state_tensors[k] for k in names]
+    fwd = layer.forward
+    if isinstance(fwd, TracedFunction):  # unwrap to_static wrapper
+        fwd = fwd._fn
+
+    def pure(state_vals, *xs):
+        saved = [(t, t._value) for t in tensors]
+        try:
+            for t, v in zip(tensors, state_vals):
+                t._value = v
+            with no_grad():
+                out = fwd(*[Tensor(x, _internal=True,
+                                   stop_gradient=True) for x in xs])
+            if isinstance(out, (tuple, list)):
+                return tuple(o._value if isinstance(o, Tensor) else o
+                             for o in out)
+            return out._value if isinstance(out, Tensor) else out
+        finally:
+            for t, v in saved:
+                t._value = v
+
+    state_avals = tuple(
+        jax.ShapeDtypeStruct(tuple(t._value.shape), t._value.dtype)
+        for t in tensors)
+    in_avals = []
+    scope = jexport.SymbolicScope()
+    for i, spec in enumerate(input_spec):
+        shape = tuple(spec.shape)
+        if any(d is None or (isinstance(d, int) and d < 0)
+               for d in shape):
+            dims = ",".join(
+                f"d{i}_{j}" if (d is None or d < 0) else str(d)
+                for j, d in enumerate(shape))
+            shape = jexport.symbolic_shape(dims, scope=scope)
+        dt = to_jax_dtype(getattr(spec, "dtype", "float32"))
+        in_avals.append(jax.ShapeDtypeStruct(shape, dt))
+    exp = jexport.export(jax.jit(pure), platforms=("cpu", "tpu"))(
+        state_avals, *in_avals)
+    return exp.serialize(), names
+
+
 def save(layer, path, input_spec=None, **configs):
-    """paddle.jit.save: persist a Layer's structure-name→array state plus a
-    descriptor; load() restores into a TranslatedLayer-like callable."""
+    """paddle.jit.save: weights + descriptor + (when an input_spec is
+    known) a serialized StableHLO executable of the forward."""
     from ..nn.layer.layers import Layer
 
     os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    state_tensors = {}
     if isinstance(layer, Layer):
-        state = {k: np.asarray(v._value)
-                 for k, v in layer.state_dict().items()}
-        dtypes = {k: v.dtype.name for k, v in layer.state_dict().items()}
-    else:
-        state, dtypes = {}, {}
+        state_tensors = layer.state_dict()
+    state = {k: np.asarray(v._value) for k, v in state_tensors.items()}
+    dtypes = {k: v.dtype.name for k, v in state_tensors.items()}
     meta = {"class": type(layer).__name__, "dtypes": dtypes,
-            "input_spec": None}
+            "input_spec": None, "state_names": None}
+
+    if input_spec is None:
+        input_spec = getattr(layer, "_input_spec", None)
+    blob = None
+    if input_spec and isinstance(layer, Layer):
+        try:
+            blob, names = _export_forward(layer, state_tensors,
+                                          input_spec)
+            meta["state_names"] = names
+            meta["input_spec"] = [
+                (list(s.shape), str(getattr(s, "dtype", "float32")))
+                for s in input_spec]
+        except Exception as e:  # pragma: no cover - exotic forwards
+            import logging
+            logging.getLogger("paddle_tpu.jit").warning(
+                "jit.save: could not export a compiled forward (%s); "
+                "saving weights only", e)
     with open(path + ".pdmodel", "wb") as f:
         pickle.dump(meta, f)
     with open(path + ".pdiparams", "wb") as f:
         pickle.dump(state, f)
+    if blob is not None:
+        with open(path + ".pdexec", "wb") as f:
+            f.write(blob)
+    elif os.path.exists(path + ".pdexec"):
+        os.remove(path + ".pdexec")  # never pair stale exec w/ new weights
 
 
 class TranslatedLayer:
-    """Loaded inference artifact; callable if the originating class is
-    reconstructable, else exposes state_dict."""
+    """Loaded inference artifact.
 
-    def __init__(self, state, meta):
+    When the archive carries a serialized executable (.pdexec), __call__
+    runs it directly — no originating python class needed (the
+    reference's AnalysisPredictor contract).  Otherwise only state_dict
+    access is available.
+    """
+
+    def __init__(self, state, meta, exec_blob=None):
         self._state = state
         self._meta = meta
+        self._blob = exec_blob
+        self._exported = None
         self.training = False
 
     def state_dict(self):
         from ..core.tensor import to_tensor
 
         return {k: to_tensor(v) for k, v in self._state.items()}
+
+    def __call__(self, *inputs):
+        if self._blob is None:
+            raise RuntimeError(
+                "this artifact was saved without an input_spec; only "
+                "state_dict() is available (re-save with "
+                "paddle.jit.save(layer, path, input_spec=[...]))")
+        import jax.numpy as jnp
+        from jax import export as jexport
+        from ..core.tensor import Tensor, to_tensor
+        if self._exported is None:
+            self._exported = jexport.deserialize(self._blob)
+            names = self._meta["state_names"]
+            self._state_vals = tuple(
+                jnp.asarray(self._state[k]) for k in names)
+        xs = [x._value if isinstance(x, Tensor) else jnp.asarray(x)
+              for x in inputs]
+        out = self._exported.call(self._state_vals, *xs)
+        if isinstance(out, (tuple, list)) and len(out) > 1:
+            return tuple(to_tensor(o) for o in out)
+        if isinstance(out, (tuple, list)):
+            out = out[0]
+        return to_tensor(out)
+
+    forward = __call__
 
     def eval(self):
         self.training = False
@@ -76,4 +190,8 @@ def load(path, **configs):
         meta = pickle.load(f)
     with open(path + ".pdiparams", "rb") as f:
         state = pickle.load(f)
-    return TranslatedLayer(state, meta)
+    blob = None
+    if os.path.exists(path + ".pdexec"):
+        with open(path + ".pdexec", "rb") as f:
+            blob = f.read()
+    return TranslatedLayer(state, meta, exec_blob=blob)

@@ -30,22 +30,6 @@ __all__ = [
 ]
 
 
-def _ew(op_name, fn):
-    # NB: the public `name=None` kwarg (Paddle API) must not shadow the
-    # op name fed to dispatch — it keys the eager executable cache
-    def op(x, name=None):
-        return dispatch(op_name, fn, (x,), {})
-    op.__name__ = op_name
-    return op
-
-
-def _binop(op_name, fn):
-    def op(x, y, name=None):
-        return dispatch(op_name, fn, (x, y), {})
-    op.__name__ = op_name
-    return op
-
-
 # Binary/unary elementwise bindings are GENERATED from ops.yaml
 # (python -m paddle_tpu.ops.gen) — the reference's yaml->api.cc codegen
 # role.  Only ops with bespoke signatures stay hand-written below.

@@ -145,24 +145,31 @@ class Optimizer:
     def _static_state(self, params):
         return []
 
+    def _clip_static_grads(self, grads):
+        """Apply this optimizer's grad_clip in traced code (shared by
+        the direct static path and meta-optimizer wrappers)."""
+        if self._grad_clip is None:
+            return grads
+        from ..nn.clip import ClipGradByGlobalNorm, ClipGradByNorm, \
+            ClipGradByValue
+        if isinstance(self._grad_clip, ClipGradByGlobalNorm):
+            total = jnp.sqrt(sum(
+                jnp.sum(jnp.square(g.astype(jnp.float32)))
+                for g in grads))
+            cn = self._grad_clip.clip_norm
+            scale = cn / jnp.maximum(total, cn)
+            return tuple((g.astype(jnp.float32) * scale).astype(g.dtype)
+                         for g in grads)
+        if isinstance(self._grad_clip, ClipGradByValue):
+            return tuple(jnp.clip(g, self._grad_clip.min,
+                                  self._grad_clip.max) for g in grads)
+        return grads
+
     def _static_update(self, param_vals, grads, opt_vals, params):
         lr = self._lr_tensor._value
         step = self._step_count._value
         self._step_count._inplace_update(step + 1)
-        if self._grad_clip is not None:
-            from ..nn.clip import ClipGradByGlobalNorm, ClipGradByNorm, \
-                ClipGradByValue
-            if isinstance(self._grad_clip, ClipGradByGlobalNorm):
-                total = jnp.sqrt(sum(
-                    jnp.sum(jnp.square(g.astype(jnp.float32)))
-                    for g in grads))
-                cn = self._grad_clip.clip_norm
-                scale = cn / jnp.maximum(total, cn)
-                grads = tuple((g.astype(jnp.float32) * scale).astype(g.dtype)
-                              for g in grads)
-            elif isinstance(self._grad_clip, ClipGradByValue):
-                grads = tuple(jnp.clip(g, self._grad_clip.min,
-                                       self._grad_clip.max) for g in grads)
+        grads = self._clip_static_grads(grads)
         return self._pure_update(lr, step, param_vals, grads, opt_vals,
                                  params)
 

@@ -133,3 +133,22 @@ def test_incubate_jacobian():
         lambda t: t * t, x)
     np.testing.assert_allclose(np.asarray(j._value),
                                np.diag([2.0, 4.0, 6.0]), rtol=1e-6)
+
+
+def test_native_collate_kernels():
+    """The C host-runtime kernels (paddle_tpu._native) match numpy and
+    back default_collate_fn."""
+    import numpy as np
+    from paddle_tpu import _native
+    from paddle_tpu.io import default_collate_fn
+
+    arrs = [np.random.RandomState(i).randn(3, 5).astype(np.float32)
+            for i in range(4)]
+    np.testing.assert_array_equal(_native.fast_stack(arrs),
+                                  np.stack(arrs))
+    src = np.stack(arrs)
+    np.testing.assert_array_equal(_native.gather_rows(src, [3, 1, 1]),
+                                  src[[3, 1, 1]])
+    # ragged/mixed input falls back to np.stack semantics
+    out = default_collate_fn(arrs)
+    np.testing.assert_array_equal(np.asarray(out._value), src)
