@@ -54,9 +54,6 @@ def _build_and_load():
     lib.pt_gather_rows.argtypes = [
         ctypes.c_char_p, ctypes.POINTER(ctypes.c_int64),
         ctypes.c_int64, ctypes.c_int64, ctypes.c_char_p]
-    lib.pt_i64_to_i32.argtypes = [
-        ctypes.POINTER(ctypes.c_int64), ctypes.c_int64,
-        ctypes.POINTER(ctypes.c_int32)]
     return lib
 
 

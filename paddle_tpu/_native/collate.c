@@ -32,11 +32,3 @@ void pt_gather_rows(const char *src, const int64_t *idx, int64_t n,
         memcpy(out + i * nbytes, src + idx[i] * nbytes, nbytes);
     }
 }
-
-/* int64 -> int32 narrowing copy (label tensors: Paddle defaults int64,
- * TPU kernels want int32) */
-void pt_i64_to_i32(const int64_t *src, int64_t n, int32_t *out) {
-    for (int64_t i = 0; i < n; ++i) {
-        out[i] = (int32_t)src[i];
-    }
-}

@@ -104,7 +104,11 @@ def save(layer, path, input_spec=None, **configs):
             "input_spec": None, "state_names": None}
 
     if input_spec is None:
-        input_spec = getattr(layer, "_input_spec", None)
+        # a to_static-wrapped forward carries the spec declared at
+        # decoration time (TracedFunction._input_spec)
+        fwd = getattr(layer, "forward", None)
+        input_spec = getattr(fwd, "_input_spec", None) or \
+            getattr(layer, "_input_spec", None)
     blob = None
     if input_spec and isinstance(layer, Layer):
         try:
@@ -161,10 +165,12 @@ class TranslatedLayer:
         from jax import export as jexport
         from ..core.tensor import Tensor, to_tensor
         if self._exported is None:
-            self._exported = jexport.deserialize(self._blob)
+            # order matters for thread-safety: publish _exported LAST so
+            # a concurrent caller never sees it without _state_vals
             names = self._meta["state_names"]
             self._state_vals = tuple(
                 jnp.asarray(self._state[k]) for k in names)
+            self._exported = jexport.deserialize(self._blob)
         xs = [x._value if isinstance(x, Tensor) else jnp.asarray(x)
               for x in inputs]
         out = self._exported.call(self._state_vals, *xs)

@@ -160,6 +160,14 @@ class Optimizer:
             scale = cn / jnp.maximum(total, cn)
             return tuple((g.astype(jnp.float32) * scale).astype(g.dtype)
                          for g in grads)
+        if isinstance(self._grad_clip, ClipGradByNorm):
+            cn = self._grad_clip.clip_norm
+            out = []
+            for g in grads:
+                n = jnp.sqrt(jnp.sum(jnp.square(g.astype(jnp.float32))))
+                s = cn / jnp.maximum(n, cn)
+                out.append((g.astype(jnp.float32) * s).astype(g.dtype))
+            return tuple(out)
         if isinstance(self._grad_clip, ClipGradByValue):
             return tuple(jnp.clip(g, self._grad_clip.min,
                                   self._grad_clip.max) for g in grads)
