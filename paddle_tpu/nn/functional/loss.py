@@ -38,8 +38,8 @@ def cross_entropy(input, label, weight=None, ignore_index=-100,
     # Hard-label fast path → Pallas fused softmax-xent on TPU (the
     # reference's fused c_softmax_with_cross_entropy kernel role).
     from ...ops.pallas_gate import pallas_enabled
-    # vocab cap keeps the (16, V) f32 row-block within VMEM (the kernel
-    # floors the block at 16 rows; 16 * 128k * 4B = 8MB)
+    # the kernel is vocab-tiled (bounded VMEM at any V); the cap only
+    # avoids pathological pad blow-up for absurd vocab sizes
     use_fused = (not soft_label
                  and weight is None and label_smoothing == 0.0
                  and use_softmax and axis in (-1, input.ndim - 1)

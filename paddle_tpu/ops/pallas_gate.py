@@ -115,8 +115,10 @@ def pallas_enabled(kernel: str) -> bool:
 
 def probe_all(raise_on_failure: bool = False) -> dict:
     """Probe every kernel now; returns {name: ok}.  bench.py calls this
-    with raise_on_failure=True so a broken kernel is a loud failure, not
-    a silent 0.0 (VERDICT r2 weak #10)."""
+    (raise_on_failure=False) and reports the result as
+    ``pallas_kernels_ok`` in its JSON line: a broken kernel falls back
+    to the XLA composite so the bench still produces a number, but the
+    regression is visible in the artifact (VERDICT r2 weak #10)."""
     results = {name: pallas_enabled(name) for name in _PROBES}
     if raise_on_failure and jax.default_backend() == "tpu" and _flag_on():
         bad = [k for k, v in results.items() if not v]
