@@ -79,9 +79,11 @@ from . import distributed  # noqa: F401
 from . import profiler  # noqa: F401
 from . import incubate  # noqa: F401
 from . import framework  # noqa: F401
+from . import inference  # noqa: F401
 
 from .jit import grad  # noqa: F401
 from .hapi import Model, summary  # noqa: F401
+from . import callbacks  # noqa: F401
 from .framework.flags import set_flags, get_flags  # noqa: F401
 
 disable_static = static.disable_static

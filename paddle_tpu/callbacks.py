@@ -1,0 +1,296 @@
+"""paddle.callbacks: hapi training callbacks.
+
+Reference parity: `python/paddle/hapi/callbacks.py` (Callback base,
+ProgBarLogger, ModelCheckpoint, LRScheduler, EarlyStopping, VisualDL
+[UNVERIFIED — empty reference mount]).  The hook protocol is identical
+(`on_{train,eval,predict}_{begin,end}`, `on_epoch_{begin,end}`,
+`on_{train,eval}_batch_{begin,end}`); paddle.Model.fit drives them.
+VisualDLCallback logs scalars to a JSONL file (VisualDL itself is an
+external package; the artifact is importable into TensorBoard via the
+jax profiler instead).
+"""
+from __future__ import annotations
+
+import json
+import os
+import time
+
+import numpy as np
+
+__all__ = ["Callback", "ProgBarLogger", "ModelCheckpoint", "LRScheduler",
+           "EarlyStopping", "VisualDL", "CallbackList", "ReduceLROnPlateau"]
+
+
+class Callback:
+    def __init__(self):
+        self.model = None
+        self.params = {}
+
+    def set_model(self, model):
+        self.model = model
+
+    def set_params(self, params):
+        self.params = dict(params or {})
+
+    # hook protocol — subclasses override what they need
+    def on_train_begin(self, logs=None): pass
+    def on_train_end(self, logs=None): pass
+    def on_eval_begin(self, logs=None): pass
+    def on_eval_end(self, logs=None): pass
+    def on_predict_begin(self, logs=None): pass
+    def on_predict_end(self, logs=None): pass
+    def on_epoch_begin(self, epoch, logs=None): pass
+    def on_epoch_end(self, epoch, logs=None): pass
+    def on_train_batch_begin(self, step, logs=None): pass
+    def on_train_batch_end(self, step, logs=None): pass
+    def on_eval_batch_begin(self, step, logs=None): pass
+    def on_eval_batch_end(self, step, logs=None): pass
+
+    # EarlyStopping signals through this flag
+    stop_training = False
+
+
+class CallbackList:
+    def __init__(self, callbacks=None, model=None, params=None):
+        self.callbacks = list(callbacks or [])
+        for c in self.callbacks:
+            if model is not None:
+                c.set_model(model)
+            if params is not None:  # never wipe params fit installed
+                c.set_params(params)
+
+    def __iter__(self):
+        return iter(self.callbacks)
+
+    def _call(self, name, *args):
+        for c in self.callbacks:
+            getattr(c, name)(*args)
+
+    def __getattr__(self, name):
+        if name.startswith("on_"):
+            return lambda *a: self._call(name, *a)
+        raise AttributeError(name)
+
+    @property
+    def stop_training(self):
+        return any(c.stop_training for c in self.callbacks)
+
+
+class ProgBarLogger(Callback):
+    """Prints loss/metrics every `log_freq` train steps."""
+
+    def __init__(self, log_freq=1, verbose=2):
+        super().__init__()
+        self.log_freq = log_freq
+        self.verbose = verbose
+        self._epoch = 0
+
+    def on_epoch_begin(self, epoch, logs=None):
+        self._epoch = epoch
+        self._t0 = time.time()
+
+    def on_train_batch_end(self, step, logs=None):
+        if not self.verbose or step % self.log_freq:
+            return
+        logs = logs or {}
+        parts = [f"step {step}"]
+        for k, v in logs.items():
+            try:
+                parts.append(f"{k}={float(np.asarray(v)):.4f}")
+            except Exception:
+                pass
+        print(f"Epoch {self._epoch + 1}: " + " ".join(parts), flush=True)
+
+    def on_epoch_end(self, epoch, logs=None):
+        if self.verbose:
+            print(f"Epoch {epoch + 1} done in "
+                  f"{time.time() - self._t0:.1f}s", flush=True)
+
+
+class ModelCheckpoint(Callback):
+    """Saves model+optimizer state every `save_freq` epochs."""
+
+    def __init__(self, save_freq=1, save_dir=None):
+        super().__init__()
+        self.save_freq = save_freq
+        self.save_dir = save_dir
+
+    def _save(self, tag):
+        if self.save_dir is None or self.model is None:
+            return
+        os.makedirs(self.save_dir, exist_ok=True)
+        path = os.path.join(self.save_dir, str(tag))
+        self.model.save(path)
+
+    def on_epoch_end(self, epoch, logs=None):
+        if (epoch + 1) % self.save_freq == 0:
+            self._save(epoch)
+
+    def on_train_end(self, logs=None):
+        self._save("final")
+
+
+class LRScheduler(Callback):
+    """Steps the optimizer's LRScheduler (by_step or by_epoch)."""
+
+    def __init__(self, by_step=True, by_epoch=False):
+        super().__init__()
+        assert by_step != by_epoch, "exactly one of by_step/by_epoch"
+        self.by_step = by_step
+
+    def _sched(self):
+        from .optimizer.lr import LRScheduler as Sched
+        opt = getattr(self.model, "_optimizer", None)
+        lr = getattr(opt, "_learning_rate", None)
+        return lr if isinstance(lr, Sched) else None
+
+    def on_train_batch_end(self, step, logs=None):
+        s = self._sched()
+        if self.by_step and s is not None:
+            s.step()
+
+    def on_epoch_end(self, epoch, logs=None):
+        s = self._sched()
+        if not self.by_step and s is not None:
+            s.step()
+
+
+class EarlyStopping(Callback):
+    """Stop training when `monitor` stops improving."""
+
+    def __init__(self, monitor="loss", mode="auto", patience=0,
+                 verbose=1, min_delta=0, baseline=None,
+                 save_best_model=True):
+        super().__init__()
+        self.monitor = monitor
+        self.patience = patience
+        self.min_delta = abs(min_delta)
+        self.baseline = baseline
+        self.verbose = verbose
+        if mode == "auto":
+            mode = "max" if "acc" in monitor else "min"
+        self.mode = mode
+        self.wait = 0
+        self.best = None
+        self.stop_training = False
+
+    def _better(self, cur, best):
+        if self.mode == "min":
+            return cur < best - self.min_delta
+        return cur > best + self.min_delta
+
+    def on_train_begin(self, logs=None):
+        self.wait = 0
+        self.best = self.baseline
+        self.stop_training = False
+
+    def on_eval_end(self, logs=None):
+        logs = logs or {}
+        if self.monitor not in logs:
+            return
+        cur = float(np.asarray(logs[self.monitor]))
+        if self.best is None or self._better(cur, self.best):
+            self.best = cur
+            self.wait = 0
+            return
+        self.wait += 1
+        if self.wait >= self.patience:
+            self.stop_training = True
+            if self.verbose:
+                print(f"EarlyStopping: no {self.monitor} improvement "
+                      f"for {self.wait} evals; stopping", flush=True)
+
+    # monitors ONLY eval results (the reference's contract: pass
+    # eval_data to fit).  on_epoch_end intentionally not overridden —
+    # fit fires both hooks each epoch and a second delivery here would
+    # double-count toward patience.
+
+
+class ReduceLROnPlateau(Callback):
+    """Multiply LR by `factor` after `patience` evals w/o improvement."""
+
+    def __init__(self, monitor="loss", factor=0.1, patience=10,
+                 min_lr=0.0, min_delta=1e-4, mode="auto", verbose=1,
+                 cooldown=0):
+        super().__init__()
+        self.monitor, self.factor = monitor, factor
+        self.patience, self.min_lr = patience, min_lr
+        self.min_delta = min_delta
+        self.mode = ("max" if "acc" in monitor else "min") \
+            if mode == "auto" else mode
+        self.verbose = verbose
+        self.cooldown = cooldown
+        self._cool = 0
+        self.wait = 0
+        self.best = None
+
+    def on_eval_end(self, logs=None):
+        logs = logs or {}
+        if self.monitor not in logs:
+            return
+        cur = float(np.asarray(logs[self.monitor]))
+        better = self.best is None or (
+            cur < self.best - self.min_delta if self.mode == "min"
+            else cur > self.best + self.min_delta)
+        if self._cool > 0:
+            self._cool -= 1
+            self.wait = 0
+        if better:
+            self.best = cur
+            self.wait = 0
+            return
+        self.wait += 1
+        if self.wait >= self.patience:
+            opt = getattr(self.model, "_optimizer", None)
+            if opt is not None:
+                new_lr = max(float(opt.get_lr()) * self.factor,
+                             self.min_lr)
+                opt.set_lr(new_lr)
+                if self.verbose:
+                    print(f"ReduceLROnPlateau: lr -> {new_lr:g}",
+                          flush=True)
+            self.wait = 0
+            self._cool = self.cooldown
+
+    # like EarlyStopping: eval-only monitoring, single delivery
+
+
+class VisualDL(Callback):
+    """Scalar logger: JSONL records {tag, step, value, wall_time} under
+    log_dir (readable by any dashboard; VisualDL itself is external)."""
+
+    def __init__(self, log_dir="vdl_log"):
+        super().__init__()
+        self.log_dir = log_dir
+        self._f = None
+        self._step = 0
+
+    def on_train_begin(self, logs=None):
+        os.makedirs(self.log_dir, exist_ok=True)
+        self._f = open(os.path.join(self.log_dir, "scalars.jsonl"), "a")
+
+    def _write(self, tag, value, step):
+        if self._f is None:
+            return
+        try:
+            rec = {"tag": tag, "step": step,
+                   "value": float(np.asarray(value)),
+                   "wall_time": time.time()}
+        except Exception:
+            return
+        self._f.write(json.dumps(rec) + "\n")
+        self._f.flush()
+
+    def on_train_batch_end(self, step, logs=None):
+        self._step += 1
+        for k, v in (logs or {}).items():
+            self._write(f"train/{k}", v, self._step)
+
+    def on_eval_end(self, logs=None):
+        for k, v in (logs or {}).items():
+            self._write(f"eval/{k}", v, self._step)
+
+    def on_train_end(self, logs=None):
+        if self._f is not None:
+            self._f.close()
+            self._f = None

@@ -120,7 +120,7 @@ def convert_dtype(d) -> DType:
     if isinstance(d, DType):
         return d
     if isinstance(d, str):
-        name = d
+        name = d.removeprefix("paddle.")  # repr form, e.g. jit.save meta
         if name == "bool":
             return bool_
         if name in DType._registry:

@@ -296,7 +296,15 @@ class Tensor:
             yield self[i]
 
     def __bool__(self):
-        return bool(self.numpy())
+        try:
+            return bool(self.numpy())
+        except (jax.errors.TracerBoolConversionError,
+                jax.errors.TracerArrayConversionError) as e:
+            raise e from RuntimeError(  # clearer advice
+                "python control flow on a traced Tensor (inside "
+                "to_static / jit).  Use paddle.static.nn.cond / "
+                "while_loop / switch_case, which lower to XLA control "
+                "flow and stay traceable.")
 
     def __int__(self):
         return int(self.numpy())

@@ -23,6 +23,7 @@ from .communication.reduce_op import ReduceOp
 from .parallel import DataParallel
 from . import fleet
 from . import auto_parallel
+from .auto_parallel.engine import Strategy, DistModel, to_static
 from .auto_parallel.api import (shard_tensor, shard_op, ProcessMesh, Shard,
                                 Replicate, Partial, dtensor_from_fn,
                                 reshard, shard_layer)

@@ -1,3 +1,8 @@
 from . import api
 from .api import (ProcessMesh, shard_tensor, shard_op, Shard, Replicate,
                   Partial, reshard, dtensor_from_fn, shard_layer)
+from . import completion
+from . import cost_model
+from . import engine
+from .cost_model import Planner, estimate_cost, comm_cost_seconds
+from .engine import Strategy, DistModel, Engine, to_static

@@ -19,10 +19,37 @@ import jax.numpy as jnp
 
 from ....optimizer.optimizer import Optimizer
 
-__all__ = ["GradientMergeOptimizer", "apply_meta_optimizers"]
+__all__ = ["GradientMergeOptimizer", "LambOptimizer",
+           "ShardingOptimizer", "apply_meta_optimizers"]
 
 
-class GradientMergeOptimizer(Optimizer):
+class _InnerDelegate(Optimizer):
+    """Wrapper base: __getattr__ covers attribute reads, but methods
+    DEFINED on Optimizer (set_lr, state_dict, ...) resolve on the
+    wrapper class and would mutate the wrapper's __dict__ instead of
+    the wrapped optimizer — silent no-ops.  Forward the mutator/state
+    surface explicitly."""
+
+    inner: Optimizer
+
+    def get_lr(self):
+        return self.inner.get_lr()
+
+    def set_lr(self, value):
+        return self.inner.set_lr(value)
+
+    def set_lr_scheduler(self, scheduler):
+        return self.inner.set_lr_scheduler(scheduler)
+
+    def state_dict(self):
+        return self.inner.state_dict()
+
+    def set_state_dict(self, state_dict):
+        return self.inner.set_state_dict(state_dict)
+
+
+
+class GradientMergeOptimizer(_InnerDelegate):
     """Accumulate grads for k steps, then apply the inner optimizer.
 
     Works on both engines: eager `step()` accumulates into host-side
@@ -77,9 +104,12 @@ class GradientMergeOptimizer(Optimizer):
         return [counter] + accum + list(inner_state)
 
     def _static_update(self, param_vals, grads, opt_vals, params):
+        import numpy as np
         lr = self.inner._lr_tensor._value
         step = self.inner._step_count._value
-        self.inner._step_count._inplace_update(step + 1)
+        # numpy, not jnp: this runs during trace and a jnp op would
+        # leak a tracer into the eager counter (see Optimizer._static_update)
+        self.inner._step_count._inplace_update(np.asarray(step) + 1)
         return self._pure_update(lr, step, param_vals, grads, opt_vals,
                                  params)
 
@@ -116,14 +146,101 @@ class GradientMergeOptimizer(Optimizer):
         return new_p, (counter + 1,) + tuple(new_opt)
 
 
+class LambOptimizer(Optimizer):
+    """strategy.lamb: swap the inner optimizer for Lamb, keeping its lr
+    and parameter list (the reference's lamb_optimizer.py replaces the
+    Momentum/Adam ops in the program with lamb ops)."""
+
+    def __new__(cls, inner, lamb_weight_decay=0.01,
+                exclude_from_weight_decay=()):
+        from ....optimizer import Lamb
+        exclude = tuple(exclude_from_weight_decay or ())
+
+        def exclude_fn(p):
+            name = getattr(p, "name", "") or ""
+            return any(e in name for e in exclude)
+
+        return Lamb(learning_rate=inner._learning_rate,
+                    lamb_weight_decay=lamb_weight_decay,
+                    parameters=inner._parameter_list,
+                    grad_clip=inner._grad_clip,
+                    exclude_from_weight_decay_fn=exclude_fn
+                    if exclude else None)
+
+
+class ShardingOptimizer(_InnerDelegate):
+    """strategy.sharding: ZeRO-style optimizer-state placement.
+
+    The reference's sharding_optimizer.py is a static-program rewrite
+    distributing opt states/params across the sharding group.  Here the
+    rewrite is a PLACEMENT: accumulator tensors are device_put sharded
+    over the mesh's 'sharding' axis (dim 0 when divisible), so the
+    compiled train step stores each shard on one device and XLA inserts
+    the gather/scatter the program rewrite would have (stage 1/2; for
+    stage 3 use sharding.group_sharded_parallel, which also places
+    parameters)."""
+
+    def __init__(self, inner):
+        self.inner = inner
+
+    def __getattr__(self, name):
+        return getattr(self.inner, name)
+
+    def _shard(self, tensors):
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        from ...env import global_mesh
+        mesh = global_mesh()
+        axis = next((a for a in ("sharding", "fsdp")
+                     if a in mesh.axis_names and mesh.shape[a] > 1), None)
+        if axis is None:
+            return tensors
+        for t in tensors:
+            entries = [None] * t._value.ndim
+            if t._value.ndim and t._value.shape[0] % mesh.shape[axis] == 0:
+                entries[0] = axis
+            try:
+                t._value = jax.device_put(
+                    t._value, NamedSharding(mesh, P(*entries)))
+            except ValueError:
+                pass
+        return tensors
+
+    def step(self):
+        self.inner.step()
+
+    def clear_grad(self, set_to_zero=True):
+        self.inner.clear_grad(set_to_zero)
+
+    def _ensure_static_state(self, params):
+        return self._shard(self.inner._ensure_static_state(params))
+
+    def _static_update(self, param_vals, grads, opt_vals, params):
+        return self.inner._static_update(param_vals, grads, opt_vals,
+                                         params)
+
+    def _pure_update(self, lr, step, param_vals, grads, opt_vals, params):
+        return self.inner._pure_update(lr, step, param_vals, grads,
+                                       opt_vals, params)
+
+
 def apply_meta_optimizers(optimizer, strategy):
     """Wrap `optimizer` per the DistributedStrategy flags (the
     reference's meta-optimizer selection in fleet.distributed_optimizer)."""
     if strategy is None:
         return optimizer
+    if getattr(strategy, "lamb", False):
+        cfg = getattr(strategy, "lamb_configs", {}) or {}
+        optimizer = LambOptimizer(
+            optimizer,
+            lamb_weight_decay=cfg.get("lamb_weight_decay", 0.01),
+            exclude_from_weight_decay=cfg.get(
+                "exclude_from_weight_decay", ()))
     if getattr(strategy, "gradient_merge", False):
         cfg = getattr(strategy, "gradient_merge_configs", {})
         optimizer = GradientMergeOptimizer(
             optimizer, k_steps=cfg.get("k_steps", 1),
             avg=cfg.get("avg", True))
+    if getattr(strategy, "sharding", False):
+        optimizer = ShardingOptimizer(optimizer)
     return optimizer

@@ -23,6 +23,14 @@ mesh:
   * the optimizer update runs on the stacked, pp-sharded state in the
     same jitted step (param + opt-state buffers donated).
 
+This module also covers the reference's **fleet executor**
+(`fluid/distributed/fleet_executor/`: carrier/interceptor message-driven
+per-rank section execution — SURVEY.md §2.1).  Its job — delivering
+activations between pipeline sections and sequencing their execution —
+is exactly what the scan+ppermute program compiles away: XLA's
+scheduler sequences the sections and the ICI transfers, so there is no
+runtime message loop to build.
+
 Constraints of the SPMD formulation: every stage's segment must be
 structurally identical (same layer classes, same param shapes — the
 standard homogeneous-pipeline requirement) and stage output shape must

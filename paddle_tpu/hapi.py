@@ -47,48 +47,80 @@ class Model:
             eval_freq=1, log_freq=10, save_dir=None, save_freq=1,
             verbose=2, drop_last=False, shuffle=True, num_workers=0,
             callbacks=None, accumulate_grad_batches=1, num_iters=None):
+        from .callbacks import CallbackList, ModelCheckpoint
         loader = train_data if isinstance(train_data, DataLoader) else \
             DataLoader(train_data, batch_size=batch_size, shuffle=shuffle,
                        drop_last=drop_last, num_workers=num_workers)
+        callbacks = list(callbacks or [])
+        if save_dir is not None and not any(
+                isinstance(c, ModelCheckpoint) for c in callbacks):
+            callbacks.append(ModelCheckpoint(save_freq=save_freq,
+                                             save_dir=save_dir))
+        cbs = CallbackList(callbacks, model=self,
+                           params={"epochs": epochs,
+                                   "batch_size": batch_size,
+                                   "verbose": verbose})
         history = []
         it_count = 0
+        cbs.on_train_begin({})
         for epoch in range(epochs):
             self.network.train()
             for m in self._metrics:
                 m.reset()
+            cbs.on_epoch_begin(epoch, {})
             for step, batch in enumerate(loader):
+                cbs.on_train_batch_begin(step, {})
                 loss, mets = self._one_batch(batch, train=True)
                 it_count += 1
+                logs = {"loss": float(loss.item())}
+                for m, v in zip(self._metrics, mets):
+                    logs[m.name()] = v if not isinstance(v, list) else v[0]
+                cbs.on_train_batch_end(step, logs)
                 if verbose and step % log_freq == 0:
                     msg = f"Epoch {epoch + 1}/{epochs} step {step}: " \
-                          f"loss={float(loss.item()):.4f}"
+                          f"loss={logs['loss']:.4f}"
                     for m, v in zip(self._metrics, mets):
                         msg += f" {m.name()}={v if not isinstance(v, list) else v[0]:.4f}"
                     print(msg)
                 if num_iters is not None and it_count >= num_iters:
+                    cbs.on_train_end({})
                     return history
             history.append(float(loss.item()))
+            # eval metrics reach monitoring callbacks exactly once,
+            # through evaluate()'s on_eval_end; on_epoch_end carries the
+            # train loss only
             if eval_data is not None and (epoch + 1) % eval_freq == 0:
                 self.evaluate(eval_data, batch_size=batch_size,
-                              verbose=verbose)
+                              verbose=verbose, callbacks=callbacks)
+            cbs.on_epoch_end(epoch, {"loss": history[-1]})
+            if cbs.stop_training:
+                break
+        cbs.on_train_end({})
         return history
 
     def evaluate(self, eval_data, batch_size=1, log_freq=10, verbose=2,
                  num_workers=0, callbacks=None, num_iters=None):
+        from .callbacks import CallbackList
         loader = eval_data if isinstance(eval_data, DataLoader) else \
             DataLoader(eval_data, batch_size=batch_size)
+        cbs = CallbackList(callbacks, model=self, params=None)
         self.network.eval()
         for m in self._metrics:
             m.reset()
         losses = []
         from .core.autograd import no_grad
+        cbs.on_eval_begin({})
         with no_grad():
-            for batch in loader:
+            for step, batch in enumerate(loader):
+                cbs.on_eval_batch_begin(step, {})
                 loss, mets = self._one_batch(batch, train=False)
                 losses.append(float(loss.item()))
+                cbs.on_eval_batch_end(step, {"loss": losses[-1]})
         out = {"loss": [float(np.mean(losses))] if losses else [0.0]}
         for m in self._metrics:
             out[m.name()] = m.accumulate()
+        cbs.on_eval_end({k: (v[0] if isinstance(v, list) else v)
+                         for k, v in out.items()})
         if verbose:
             print("Eval:", out)
         return out

@@ -118,6 +118,9 @@ def save(layer, path, input_spec=None, **configs):
             meta["input_spec"] = [
                 (list(s.shape), str(getattr(s, "dtype", "float32")))
                 for s in input_spec]
+            meta["input_names"] = [
+                getattr(s, "name", None) or f"x{i}"
+                for i, s in enumerate(input_spec)]
         except Exception as e:  # pragma: no cover - exotic forwards
             import logging
             logging.getLogger("paddle_tpu.jit").warning(

@@ -176,7 +176,11 @@ class Optimizer:
     def _static_update(self, param_vals, grads, opt_vals, params):
         lr = self._lr_tensor._value
         step = self._step_count._value
-        self._step_count._inplace_update(step + 1)
+        # advance the counter host-side (numpy): this runs while TRACING
+        # the compiled step, and any jnp op here (even asarray) would be
+        # lifted into the trace, leaking a tracer into the eager step
+        # counter (it then poisons optimizer.state_dict()).
+        self._step_count._inplace_update(np.asarray(step) + 1)
         grads = self._clip_static_grads(grads)
         return self._pure_update(lr, step, param_vals, grads, opt_vals,
                                  params)

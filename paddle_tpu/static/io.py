@@ -17,11 +17,61 @@ from ..core.tensor import Tensor
 __all__ = ["save_inference_model", "load_inference_model", "save", "load"]
 
 
+def _export_program(program, feed_vars, fetch_vars):
+    """Trace the Program's feed→fetch slice into pure(state, *feeds) and
+    serialize it with jax.export (cpu+tpu lowerings).  Returns
+    (blob, state_names, state_arrays) — the executable takes the saved
+    weights as arguments, so updated .pdiparams pair with the same
+    .pdexec as long as shapes/dtypes match."""
+    import jax
+    from jax import export as jexport
+    from .framework import Variable
+
+    block = program.global_block()
+    captured, seen = [], set()
+    for op in block.ops:
+        for i in op.inputs:
+            if not isinstance(i, Variable) and id(i) not in seen:
+                seen.add(id(i))
+                captured.append(i)
+    state_names = [t.name or f"@cap{idx}" for idx, t in enumerate(captured)]
+    state_arrays = {n: np.asarray(t._value)
+                    for n, t in zip(state_names, captured)}
+
+    def pure(state_vals, *feed_vals):
+        env = {v.name: x for v, x in zip(feed_vars, feed_vals)}
+        smap = {id(t): x for t, x in zip(captured, state_vals)}
+        for op in block.ops:
+            in_vals = [env[i.name] if isinstance(i, Variable) else smap[id(i)]
+                       for i in op.inputs]
+            out = op.impl(*in_vals)
+            if isinstance(out, (tuple, list)):
+                for var, v in zip(op.outputs, out):
+                    env[var.name] = v
+            else:
+                env[op.outputs[0].name] = out
+        return tuple(env[v.name] for v in fetch_vars)
+
+    state_avals = tuple(
+        jax.ShapeDtypeStruct(tuple(t._value.shape), t._value.dtype)
+        for t in captured)
+    feed_avals = tuple(
+        jax.ShapeDtypeStruct(tuple(v._value.shape), v._value.dtype)
+        for v in feed_vars)
+    exp = jexport.export(jax.jit(pure), platforms=("cpu", "tpu"))(
+        state_avals, *feed_avals)
+    return exp.serialize(), state_names, state_arrays
+
+
 def save_inference_model(path_prefix, feed_vars, fetch_vars, executor,
                          program=None, **kwargs):
     from .framework import default_main_program
 
     program = program or default_main_program()
+    if not isinstance(feed_vars, (list, tuple)):
+        feed_vars = [feed_vars]
+    if not isinstance(fetch_vars, (list, tuple)):
+        fetch_vars = [fetch_vars]
     params = {}
     for i, p in enumerate(program.all_parameters()):
         arr = np.asarray(p._value)
@@ -29,12 +79,32 @@ def save_inference_model(path_prefix, feed_vars, fetch_vars, executor,
     meta = {
         "feed_names": [v.name for v in feed_vars],
         "fetch_names": [v.name for v in fetch_vars],
+        "input_names": [v.name for v in feed_vars],
+        "output_names": [v.name for v in fetch_vars],
+        "input_spec": [(list(v._value.shape), str(v._value.dtype))
+                       for v in feed_vars],
     }
     os.makedirs(os.path.dirname(path_prefix) or ".", exist_ok=True)
+    blob = None
+    try:
+        blob, state_names, state_arrays = _export_program(
+            program, feed_vars, fetch_vars)
+        meta["state_names"] = state_names
+        params = state_arrays  # exact arg set the executable expects
+    except Exception as e:  # pragma: no cover - exotic programs
+        import logging
+        logging.getLogger("paddle_tpu.static").warning(
+            "save_inference_model: could not export a compiled program "
+            "(%s); saving weights + descriptor only", e)
     with open(path_prefix + ".pdmodel", "wb") as f:
         pickle.dump(meta, f)
     with open(path_prefix + ".pdiparams", "wb") as f:
         pickle.dump(params, f)
+    if blob is not None:
+        with open(path_prefix + ".pdexec", "wb") as f:
+            f.write(blob)
+    elif os.path.exists(path_prefix + ".pdexec"):
+        os.remove(path_prefix + ".pdexec")
 
 
 def load_inference_model(path_prefix, executor, **kwargs):

@@ -9,8 +9,11 @@ from __future__ import annotations
 from ...nn import functional as F
 from ...nn.layer.layers import create_parameter
 from ...nn import initializer as I
+from .control_flow import (cond, while_loop, switch_case, case,  # noqa: F401
+                           Assert)
 
-__all__ = ["fc", "conv2d", "batch_norm", "embedding"]
+__all__ = ["fc", "conv2d", "batch_norm", "embedding", "cond",
+           "while_loop", "switch_case", "case", "Assert"]
 
 
 def fc(x, size, num_flatten_dims=1, weight_attr=None, bias_attr=None,

@@ -1,0 +1,278 @@
+"""Control-flow ops: cond / while_loop / switch_case / case.
+
+Reference parity: `python/paddle/static/nn/control_flow.py`
+(ConditionalBlock / While ops built into the Program; the dy2static AST
+pass rewrites python `if`/`while` on tensors into these [UNVERIFIED —
+empty reference mount]).
+
+TPU-native redesign: there is no ConditionalBlock op to build — XLA has
+native control flow (`lax.cond` / `lax.while_loop` / `lax.switch`), and
+everything here lowers to those, which means the SAME call works in
+eager mode, inside `to_static`'s jit re-trace, and in the static
+Program (dispatch routes by mode, like every other op).
+
+Mechanics: the branch callables close over eager Tensors.  A discovery
+dry-run of each branch under a capture context records every external
+Tensor it reads; those become explicit operands of one dispatched op,
+so the autograd tape sees a single differentiable "cond" whose VJP
+(via jax.vjp of lax.cond) routes gradients to both branches' captures.
+This replaces the reference's grad-op construction for
+ConditionalBlock.
+
+Functional contract (same as jax, stricter than the reference): branch
+callables must RETURN their results — in-place mutation of enclosing
+tensors inside a branch is not captured.  `while_loop` is forward-only
+(XLA cannot reverse-differentiate a dynamic-trip-count loop; the
+reference's While grad has the same restriction in practice — use
+`lax.scan` via paddle ops on a static trip count when you need grads).
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from ...core.dispatch import dispatch
+from ...core.tensor import Tensor, get_trace_ctx, set_trace_ctx
+
+__all__ = ["cond", "while_loop", "switch_case", "case", "Assert"]
+
+
+class _CaptureCtx:
+    """Records external Tensor reads during a branch dry-run; chains to
+    any enclosing trace context so outer discovery still sees them."""
+
+    def __init__(self, outer):
+        self.outer = outer
+        self.created = set()
+        self.read_order = []
+        self._read_ids = set()
+
+    def on_create(self, t):
+        self.created.add(id(t))
+        if self.outer is not None:
+            self.outer.on_create(t)
+
+    def on_read(self, t):
+        if id(t) not in self.created and id(t) not in self._read_ids:
+            self._read_ids.add(id(t))
+            self.read_order.append(t)
+        if self.outer is not None:
+            return self.outer.on_read(t)
+        return t._value
+
+    def on_write(self, t, old_value=None, old_node=None):
+        if self.outer is not None:
+            self.outer.on_write(t, old_value, old_node)
+
+
+def _dry_run(fn, args=()):
+    """Run fn eagerly, returning (out_struct, flat_out_tensors, captures)."""
+    outer = get_trace_ctx()
+    ctx = _CaptureCtx(outer)
+    set_trace_ctx(ctx)
+    try:
+        out = fn(*args)
+    finally:
+        set_trace_ctx(outer)
+    flat, tree = jax.tree.flatten(
+        out, is_leaf=lambda x: isinstance(x, Tensor))
+    return tree, flat, ctx.read_order
+
+
+def _leaf_val(x):
+    return x._value if isinstance(x, Tensor) else jnp.asarray(x)
+
+
+def _rebind(tensors, vals, fn, args):
+    """Call fn with `tensors` temporarily bound to traced `vals`."""
+    saved = [(t, t._value) for t in tensors]
+    try:
+        for t, v in zip(tensors, vals):
+            t._value = v
+        out = fn(*args)
+    finally:
+        for t, v in saved:
+            t._value = v
+    flat, _ = jax.tree.flatten(out, is_leaf=lambda x: isinstance(x, Tensor))
+    return tuple(_leaf_val(x) for x in flat)
+
+
+def _wrap_out(tree, flat_vals):
+    outs = [Tensor(v, _internal=True) if not isinstance(v, Tensor) else v
+            for v in (flat_vals if isinstance(flat_vals, (tuple, list))
+                      else [flat_vals])]
+    return jax.tree.unflatten(tree, outs)
+
+
+def cond(pred, true_fn, false_fn=None, name=None, return_names=None):
+    """Run true_fn() if pred else false_fn(); one differentiable op.
+
+    pred may be a python bool (resolved immediately) or a 0-d bool
+    Tensor (lowered to lax.cond, traceable under to_static/jit)."""
+    if not isinstance(pred, Tensor):
+        if pred:
+            return true_fn()
+        return false_fn() if false_fn is not None else None
+    if false_fn is None:
+        false_fn = lambda: None  # noqa: E731
+
+    tree_t, flat_t, caps_t = _dry_run(true_fn)
+    tree_f, flat_f, caps_f = _dry_run(false_fn)
+    if tree_t != tree_f:
+        raise ValueError(
+            f"cond: true_fn and false_fn must return the same structure, "
+            f"got {tree_t} vs {tree_f}")
+    captures, seen = [], set()
+    for t in caps_t + caps_f:
+        if id(t) not in seen:
+            seen.add(id(t))
+            captures.append(t)
+
+    def impl(p, *cap_vals):
+        p = jnp.asarray(p)
+        if p.ndim:
+            p = jnp.reshape(p, ())
+        res = jax.lax.cond(
+            p.astype(bool),
+            lambda cv: _rebind(captures, cv, true_fn, ()),
+            lambda cv: _rebind(captures, cv, false_fn, ()),
+            tuple(cap_vals))
+        return res[0] if len(flat_t) == 1 else res
+
+    out = dispatch("cond", impl, (pred, *captures))
+    flat = out if isinstance(out, tuple) else (out,)
+    return _wrap_out(tree_t, flat)
+
+
+def while_loop(cond_fn, body_fn, loop_vars, is_test=False, name=None):
+    """paddle.static.nn.while_loop over lax.while_loop (forward-only)."""
+    loop_vars = list(loop_vars)
+    flat_lv, lv_tree = jax.tree.flatten(
+        loop_vars, is_leaf=lambda x: isinstance(x, Tensor))
+    lv_tensors = [x if isinstance(x, Tensor)
+                  else Tensor(jnp.asarray(x), _internal=True,
+                              stop_gradient=True)
+                  for x in flat_lv]
+
+    # discovery: captures of both callables (runs one iteration eagerly)
+    _, _, caps_c = _dry_run(cond_fn, loop_vars)
+    out_tree, flat_out, caps_b = _dry_run(body_fn, loop_vars)
+    if len(flat_out) != len(flat_lv):
+        raise ValueError(
+            "while_loop: body must return the same number of loop vars "
+            f"({len(flat_lv)}), got {len(flat_out)}")
+    lv_ids = {id(t) for t in lv_tensors}
+    captures, seen = [], set(lv_ids)
+    for t in caps_c + caps_b:
+        if id(t) not in seen:
+            seen.add(id(t))
+            captures.append(t)
+
+    def impl(*vals):
+        n = len(lv_tensors)
+        lv_vals, cap_vals = vals[:n], vals[n:]
+
+        def call(fn, carry):
+            lv = jax.tree.unflatten(
+                lv_tree, [Tensor(v, _internal=True, stop_gradient=True)
+                          for v in carry])
+            return _rebind(captures, cap_vals, fn, lv)
+
+        def c(carry):
+            (p,) = call(cond_fn, carry)
+            if p.ndim:
+                p = jnp.reshape(p, ())
+            return p.astype(bool)
+
+        res = jax.lax.while_loop(c, lambda carry: call(body_fn, carry),
+                                 tuple(v for v in lv_vals))
+        return res[0] if n == 1 else res
+
+    out = dispatch("while_loop", impl, (*lv_tensors, *captures),
+                   differentiable=False)
+    flat = out if isinstance(out, tuple) else (out,)
+    return jax.tree.unflatten(lv_tree, list(flat))
+
+
+def switch_case(branch_index, branch_fns, default=None, name=None):
+    """lax.switch over an integer index Tensor."""
+    if isinstance(branch_fns, dict):
+        items = sorted(branch_fns.items())
+    else:
+        items = list(enumerate(branch_fns)) \
+            if callable(branch_fns[0]) else list(branch_fns)
+    keys = [k for k, _ in items]
+    fns = [f for _, f in items]
+    if default is None:
+        default = fns[-1]
+    if not isinstance(branch_index, Tensor):
+        idx = int(branch_index)
+        return dict(items).get(idx, default)()
+
+    # dense branch table covering [min_key, max_key]; others → default
+    lo, hi = min(keys), max(keys)
+    table = [dict(items).get(k, default) for k in range(lo, hi + 1)]
+    table.append(default)  # out-of-range slot
+
+    trees, captures, seen = [], [], set()
+    for f in table:
+        tree, _, caps = _dry_run(f)
+        trees.append(tree)
+        for t in caps:
+            if id(t) not in seen:
+                seen.add(id(t))
+                captures.append(t)
+    if any(t != trees[0] for t in trees):
+        raise ValueError("switch_case: all branches must return the same "
+                         "structure")
+
+    def impl(idx, *cap_vals):
+        idx = jnp.reshape(jnp.asarray(idx), ()).astype(jnp.int32)
+        in_range = (idx >= lo) & (idx <= hi)
+        sel = jnp.where(in_range, idx - lo, len(table) - 1)
+        res = jax.lax.switch(
+            sel, [lambda cv, f=f: _rebind(captures, cv, f, ())
+                  for f in table], tuple(cap_vals))
+        return res[0] if len(res) == 1 else res
+
+    out = dispatch("switch_case", impl, (branch_index, *captures))
+    flat = out if isinstance(out, tuple) else (out,)
+    return _wrap_out(trees[0], flat)
+
+
+def case(pred_fn_pairs, default=None, name=None):
+    """First pair whose pred is true wins (nested cond chain)."""
+    if not pred_fn_pairs:
+        raise ValueError("case: pred_fn_pairs must be non-empty")
+
+    def build(pairs):
+        (pred, fn) = pairs[0]
+        rest = pairs[1:]
+        if not rest:
+            if default is None:
+                return cond(pred, fn, fn)
+            return cond(pred, fn, default)
+        return cond(pred, fn, lambda: build(rest))
+
+    return build(list(pred_fn_pairs))
+
+
+def Assert(cond_t, data=None, summarize=20, name=None):
+    """Debug assert: checks eagerly when concrete; inside jit it uses
+    jax's checkify-free best effort (no-op on traced values, matching
+    the reference's behavior of stripping Assert in inference)."""
+    import numpy as np
+    v = cond_t._value if isinstance(cond_t, Tensor) else cond_t
+    try:
+        ok = bool(np.asarray(v))
+    except Exception:
+        return  # traced: cannot check at runtime without checkify
+    if not ok:
+        parts = []
+        for d in (data or []):
+            arr = np.asarray(d._value if isinstance(d, Tensor) else d)
+            parts.append(np.array2string(arr.ravel()[:summarize]))
+        raise AssertionError("Assert failed" +
+                             (": " + "; ".join(parts) if parts else ""))

@@ -1,0 +1,129 @@
+"""Semi-auto parallel: cost model planner, completion, DistModel/Engine.
+
+Parity strategy (SURVEY.md §4): the sharded DistModel must produce the
+same losses as a plain single-device training loop.
+"""
+import numpy as np
+import pytest
+import jax
+from jax.sharding import Mesh, PartitionSpec as P
+
+import paddle_tpu as paddle
+import paddle_tpu.distributed as dist
+from paddle_tpu import nn, optimizer
+from paddle_tpu.distributed.auto_parallel import (
+    Planner, estimate_cost, comm_cost_seconds, Strategy, Engine,
+    completion)
+
+
+def _mesh(shape, names):
+    devs = np.asarray(jax.devices()[: int(np.prod(shape))])
+    return Mesh(devs.reshape(shape), names)
+
+
+class _MLP(nn.Layer):
+    def __init__(self):
+        super().__init__()
+        self.fc1 = nn.Linear(16, 64)
+        self.fc2 = nn.Linear(64, 4)
+
+    def forward(self, x):
+        return self.fc2(paddle.nn.functional.relu(self.fc1(x)))
+
+
+def _data(n=32):
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(n, 16)).astype(np.float32)
+    y = rng.integers(0, 4, size=(n,)).astype(np.int64)
+    return x, y
+
+
+def _loss_fn(out, label):
+    return paddle.nn.functional.cross_entropy(out, label)
+
+
+def _train_plain(steps=4):
+    paddle.seed(7)
+    m = _MLP()
+    opt = optimizer.SGD(learning_rate=0.1, parameters=m.parameters())
+    x, y = _data()
+    losses = []
+    for _ in range(steps):
+        loss = _loss_fn(m(paddle.to_tensor(x)), paddle.to_tensor(y))
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(loss.numpy()))
+    return losses
+
+
+def test_cost_model_estimates():
+    est = estimate_cost(lambda a, b: a @ b,
+                        jax.ShapeDtypeStruct((128, 256), np.float32),
+                        jax.ShapeDtypeStruct((256, 64), np.float32))
+    assert est.flops == 2 * 128 * 256 * 64
+    assert est.bytes_accessed >= 128 * 64 * 4
+    assert comm_cost_seconds(1 << 20, 4, "all_reduce") > \
+        comm_cost_seconds(1 << 20, 4, "all_gather") > 0
+    assert comm_cost_seconds(1 << 20, 1, "all_reduce") == 0.0
+
+
+def test_planner_places_params():
+    mesh = _mesh((2, 4), ("dp", "mp"))
+    plan = Planner(mesh, fsdp_threshold=1024).plan(
+        {"w": (512, 512), "b": (4,)})
+    assert plan["w"].count("mp") == 1   # big weight tensor-sharded
+    assert plan["b"] == [None]          # small bias replicated
+    fsdp_mesh = _mesh((2, 4), ("dp", "sharding"))
+    plan = Planner(fsdp_mesh, fsdp_threshold=1024).plan({"w": (512, 512)})
+    assert plan["w"][0] == "sharding"   # ZeRO-style dim-0 shard
+
+
+def test_completion_propagates_sharding():
+    mesh = _mesh((8,), ("dp",))
+    out_specs, compiled = completion.complete(
+        lambda x, w: x @ w, mesh, [("dp", None), None],
+        jax.ShapeDtypeStruct((32, 16), np.float32),
+        jax.ShapeDtypeStruct((16, 8), np.float32))
+    # batch sharding propagates through the matmul to the output
+    assert out_specs[0] and out_specs[0][0] == "dp"
+
+
+@pytest.mark.parametrize("shape,names", [((8,), ("dp",)),
+                                         ((2, 4), ("dp", "mp"))])
+def test_dist_model_loss_parity(shape, names):
+    want = _train_plain()
+    mesh = _mesh(shape, names)
+    dist.auto_parallel.api.set_mesh(None)
+    paddle.seed(7)
+    m = _MLP()
+    opt = optimizer.SGD(learning_rate=0.1, parameters=m.parameters())
+    dm = dist.to_static(m, loss=_loss_fn, optimizer=opt,
+                        strategy=Strategy(), )
+    dm._mesh = mesh  # explicit mesh for the test
+    dm._place_state()
+    dm._place_opt_state()
+    x, y = _data()
+    got = [float(np.asarray(dm(x, y).numpy())) for _ in range(4)]
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+def test_engine_fit_evaluate_predict(tmp_path):
+    mesh = _mesh((8,), ("dp",))
+    paddle.seed(11)
+    m = _MLP()
+    opt = optimizer.SGD(learning_rate=0.1, parameters=m.parameters())
+    eng = Engine(m, loss=_loss_fn, optimizer=opt)
+    eng._ensure()._mesh = mesh
+    eng._ensure()._place_state()
+    eng._ensure()._place_opt_state()
+    x, y = _data()
+    hist = eng.fit([paddle.to_tensor(x), paddle.to_tensor(y)], epochs=3)
+    assert len(hist) == 3
+    assert hist[-1]["loss"] < hist[0]["loss"]
+    ev = eng.evaluate([paddle.to_tensor(x), paddle.to_tensor(y)])
+    assert ev["loss"] == pytest.approx(hist[-1]["loss"], rel=0.5)
+    preds = eng.predict([paddle.to_tensor(x)])
+    assert tuple(preds[0].shape) == (32, 4)
+    eng.save(str(tmp_path / "ckpt"))
+    eng.load(str(tmp_path / "ckpt"))

@@ -1,0 +1,101 @@
+"""paddle.callbacks driven through paddle.Model.fit."""
+import json
+import os
+
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, optimizer
+from paddle_tpu.callbacks import (EarlyStopping, LRScheduler,
+                                  ModelCheckpoint, ProgBarLogger,
+                                  ReduceLROnPlateau, VisualDL)
+from paddle_tpu.io import Dataset
+
+
+class _DS(Dataset):
+    def __init__(self, n=32):
+        rng = np.random.default_rng(0)
+        self.x = rng.normal(size=(n, 8)).astype(np.float32)
+        self.y = (self.x.sum(-1, keepdims=True) > 0).astype(np.float32)
+
+    def __len__(self):
+        return len(self.x)
+
+    def __getitem__(self, i):
+        return self.x[i], self.y[i]
+
+
+def _model():
+    paddle.seed(5)
+    net = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 1))
+    m = paddle.Model(net)
+    m.prepare(optimizer=optimizer.SGD(learning_rate=0.1,
+                                      parameters=net.parameters()),
+              loss=paddle.nn.functional.mse_loss)
+    return m
+
+
+def test_checkpoint_and_visualdl(tmp_path, capsys):
+    m = _model()
+    ck = str(tmp_path / "ck")
+    vdl = str(tmp_path / "vdl")
+    m.fit(_DS(), epochs=2, batch_size=8, verbose=0,
+          callbacks=[ModelCheckpoint(save_freq=1, save_dir=ck),
+                     ProgBarLogger(log_freq=2), VisualDL(log_dir=vdl)])
+    assert os.path.exists(os.path.join(ck, "final.pdparams"))
+    assert os.path.exists(os.path.join(ck, "0.pdparams"))
+    recs = [json.loads(l) for l in
+            open(os.path.join(vdl, "scalars.jsonl"))]
+    assert recs and recs[0]["tag"] == "train/loss"
+    assert "Epoch 1" in capsys.readouterr().out
+
+
+def test_early_stopping_stops():
+    m = _model()
+    es = EarlyStopping(monitor="loss", patience=0, baseline=0.0,
+                       verbose=0)  # nothing beats 0 loss → stop at once
+    # EarlyStopping monitors EVAL results only (reference contract)
+    m.fit(_DS(), eval_data=_DS(8), epochs=10, batch_size=8, verbose=0,
+          callbacks=[es])
+    assert es.stop_training
+
+
+def test_early_stopping_single_delivery_per_epoch():
+    # fit must deliver eval metrics to monitors exactly once per epoch
+    # (a double delivery halves patience)
+    m = _model()
+    seen = []
+
+    class Spy(EarlyStopping):
+        def on_eval_end(self, logs=None):
+            seen.append(dict(logs or {}))
+            super().on_eval_end(logs)
+
+    spy = Spy(monitor="loss", patience=99, verbose=0)
+    m.fit(_DS(), eval_data=_DS(8), epochs=2, batch_size=8, verbose=0,
+          callbacks=[spy])
+    assert len(seen) == 2
+
+
+def test_lr_scheduler_callback_steps():
+    from paddle_tpu.optimizer import lr as lr_mod
+    paddle.seed(5)
+    net = nn.Linear(8, 1)
+    sched = lr_mod.StepDecay(learning_rate=0.1, step_size=1, gamma=0.5)
+    m = paddle.Model(net)
+    m.prepare(optimizer=optimizer.SGD(learning_rate=sched,
+                                      parameters=net.parameters()),
+              loss=paddle.nn.functional.mse_loss)
+    m.fit(_DS(8), epochs=1, batch_size=4, verbose=0,
+          callbacks=[LRScheduler(by_step=True)])
+    assert float(sched.get_lr()) < 0.1
+
+
+def test_reduce_lr_on_plateau():
+    m = _model()
+    cb = ReduceLROnPlateau(monitor="loss", factor=0.5, patience=1,
+                           verbose=0)
+    cb.set_model(m)
+    cb.on_eval_end({"loss": 1.0})
+    cb.on_eval_end({"loss": 1.0})  # no improvement → reduce
+    assert float(m._optimizer.get_lr()) == 0.05

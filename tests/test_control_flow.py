@@ -1,0 +1,111 @@
+"""cond / while_loop / switch_case / case — eager, autograd, to_static."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.static.nn import cond, while_loop, switch_case, case, Assert
+
+
+def test_cond_python_bool():
+    assert float(cond(True, lambda: paddle.to_tensor(1.0),
+                      lambda: paddle.to_tensor(2.0)).numpy()) == 1.0
+    assert float(cond(False, lambda: paddle.to_tensor(1.0),
+                      lambda: paddle.to_tensor(2.0)).numpy()) == 2.0
+
+
+def test_cond_tensor_pred_both_branches():
+    x = paddle.to_tensor([3.0])
+    got = cond(x.sum() > 0, lambda: x * 2, lambda: x - 1)
+    np.testing.assert_allclose(got.numpy(), [6.0])
+    got = cond(x.sum() < 0, lambda: x * 2, lambda: x - 1)
+    np.testing.assert_allclose(got.numpy(), [2.0])
+
+
+def test_cond_gradient_flows_to_captures():
+    x = paddle.to_tensor([2.0, -1.0], stop_gradient=False)
+    y = cond(x.sum() > 0, lambda: (x * 3).sum(), lambda: (x * 5).sum())
+    y.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [3.0, 3.0])
+
+    x2 = paddle.to_tensor([-2.0, -1.0], stop_gradient=False)
+    y2 = cond(x2.sum() > 0, lambda: (x2 * 3).sum(),
+              lambda: (x2 * 5).sum())
+    y2.backward()
+    np.testing.assert_allclose(x2.grad.numpy(), [5.0, 5.0])
+
+
+def test_cond_structure_mismatch_raises():
+    x = paddle.to_tensor(1.0)
+    with pytest.raises(ValueError, match="same structure"):
+        cond(x > 0, lambda: (x, x), lambda: x)
+
+
+def test_cond_inside_to_static():
+    calls = {"n": 0}
+
+    @paddle.jit.to_static
+    def f(x):
+        calls["n"] += 1
+        return cond(x.sum() > 0, lambda: x * 2, lambda: -x)
+
+    a = paddle.to_tensor([1.0, 2.0])
+    b = paddle.to_tensor([-1.0, -2.0])
+    np.testing.assert_allclose(f(a).numpy(), [2.0, 4.0])
+    # same compiled fn, opposite branch — proves the branch was NOT
+    # baked in at trace time (VERDICT r2 §2.2 jit row)
+    np.testing.assert_allclose(f(b).numpy(), [1.0, 2.0])
+
+
+def test_while_loop():
+    i = paddle.to_tensor(0)
+    s = paddle.to_tensor(0.0)
+    ni, ns = while_loop(lambda i, s: i < 5,
+                        lambda i, s: (i + 1, s + 2.0), [i, s])
+    assert int(ni.numpy()) == 5
+    np.testing.assert_allclose(ns.numpy(), 10.0)
+
+
+def test_while_loop_reads_captures():
+    step = paddle.to_tensor(3.0)
+    i = paddle.to_tensor(0)
+    s = paddle.to_tensor(0.0)
+    _, ns = while_loop(lambda i, s: i < 4,
+                       lambda i, s: (i + 1, s + step), [i, s])
+    np.testing.assert_allclose(ns.numpy(), 12.0)
+
+
+def test_switch_case_and_default():
+    x = paddle.to_tensor(10.0)
+    fns = {1: lambda: x * 1, 2: lambda: x * 2}
+    for idx, want in [(1, 10.0), (2, 20.0), (7, 20.0)]:  # 7 → default
+        got = switch_case(paddle.to_tensor(idx), fns,
+                          default=lambda: x * 2)
+        np.testing.assert_allclose(got.numpy(), want)
+
+
+def test_case_chain():
+    x = paddle.to_tensor(4.0)
+    got = case([(x > 10, lambda: x * 0),
+                (x > 2, lambda: x * 7)], default=lambda: x)
+    np.testing.assert_allclose(got.numpy(), 28.0)
+
+
+def test_assert_eager():
+    Assert(paddle.to_tensor(True))
+    with pytest.raises(AssertionError):
+        Assert(paddle.to_tensor(False), [paddle.to_tensor([1.0, 2.0])])
+
+
+def test_bool_on_traced_tensor_advises_cond():
+    @paddle.jit.to_static
+    def f(x):
+        if x.sum() > 0:  # python `if` on traced tensor
+            return x
+        return -x
+
+    import jax
+    x = paddle.to_tensor([1.0])
+    with pytest.raises((jax.errors.TracerBoolConversionError,
+                        jax.errors.TracerArrayConversionError)) as ei:
+        f(x)  # jit re-trace hits the python `if` → loud advice
+    assert "paddle.static.nn.cond" in str(ei.value.__cause__)

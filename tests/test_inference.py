@@ -1,0 +1,104 @@
+"""paddle.inference (Config / create_predictor) deployment loop.
+
+Covers the reference's AnalysisPredictor contract: a saved artifact is
+loaded and run through named handles with no model python code.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, static
+from paddle_tpu.inference import Config, create_predictor, PredictorPool
+
+
+class _Net(nn.Layer):
+    def __init__(self):
+        super().__init__()
+        self.fc1 = nn.Linear(8, 16)
+        self.fc2 = nn.Linear(16, 4)
+
+    def forward(self, x):
+        return self.fc2(paddle.nn.functional.relu(self.fc1(x)))
+
+
+@pytest.fixture
+def jit_artifact(tmp_path):
+    paddle.disable_static()
+    net = _Net()
+    prefix = str(tmp_path / "net")
+    paddle.jit.save(net, prefix,
+                    input_spec=[static.InputSpec([4, 8], "float32",
+                                                 name="x")])
+    x = np.random.default_rng(0).normal(size=(4, 8)).astype(np.float32)
+    want = net(paddle.to_tensor(x)).numpy()
+    return prefix, x, want
+
+
+def test_predictor_handles(jit_artifact):
+    prefix, x, want = jit_artifact
+    config = Config(prefix + ".pdmodel", prefix + ".pdiparams")
+    pred = create_predictor(config)
+    names = pred.get_input_names()
+    assert names == ["x"]
+    h = pred.get_input_handle("x")
+    h.copy_from_cpu(x)
+    pred.run()
+    out = pred.get_output_handle(pred.get_output_names()[0])
+    got = out.copy_to_cpu()
+    assert list(out.shape()) == [4, 4]
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+
+def test_predictor_run_positional_and_pool(jit_artifact):
+    prefix, x, want = jit_artifact
+    config = Config(prefix + ".pdmodel", prefix + ".pdiparams")
+    pool = PredictorPool(config, 2)
+    for i in range(2):
+        got = pool.retrieve(i).run([x])[0]
+        np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+
+def test_predictor_model_dir(jit_artifact, tmp_path):
+    prefix, x, want = jit_artifact
+    config = Config(str(tmp_path))  # directory form
+    pred = create_predictor(config)
+    np.testing.assert_allclose(pred.run([x])[0], want, rtol=1e-5,
+                               atol=1e-5)
+    assert "model path prefix" in config.summary()
+
+
+def test_static_save_inference_model_predictor(tmp_path):
+    paddle.enable_static()
+    try:
+        main, startup = static.Program(), static.Program()
+        with static.program_guard(main, startup):
+            x = static.data("x", [4, 8], "float32")
+            net = _Net()
+            y = net(x)
+        exe = static.Executor()
+        feed = {"x": np.random.default_rng(1).normal(
+            size=(4, 8)).astype(np.float32)}
+        (want,) = exe.run(main, feed=feed, fetch_list=[y])
+        prefix = str(tmp_path / "static_net")
+        static.save_inference_model(prefix, [x], [y], exe, program=main)
+
+        config = Config(prefix + ".pdmodel", prefix + ".pdiparams")
+        pred = create_predictor(config)
+        assert pred.get_input_names() == ["x"]
+        h = pred.get_input_handle("x")
+        h.copy_from_cpu(feed["x"])
+        pred.run()
+        got = pred.get_output_handle(pred.get_output_names()[0]).copy_to_cpu()
+        np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+    finally:
+        paddle.disable_static()
+
+
+def test_missing_exec_is_loud(tmp_path):
+    paddle.disable_static()
+    net = _Net()
+    prefix = str(tmp_path / "nospec")
+    paddle.jit.save(net, prefix)  # no input_spec → weights only
+    with pytest.raises(RuntimeError, match="compiled forward"):
+        create_predictor(Config(prefix + ".pdmodel",
+                                prefix + ".pdiparams"))

@@ -178,3 +178,34 @@ def test_xent_ignore_index():
     g = jax.grad(lambda x: jnp.sum(
         pk.fused_softmax_cross_entropy(x, labels)))(logits)
     assert float(jnp.abs(g[2]).sum()) == 0.0
+
+
+def test_xent_multi_vocab_block():
+    """V=3000 > block_v=2048 → exercises the online-logsumexp scratch
+    accumulator across vocab grid steps, the -inf vocab padding, and
+    the per-block label column offset (the r3 kernel rewrite; a single
+    vocab block cannot catch a regression there)."""
+    v = 3000
+    logits = jax.random.normal(jax.random.PRNGKey(11), (37, v),
+                               jnp.float32)
+    labels = jax.random.randint(jax.random.PRNGKey(12), (37,), 0, v)
+    # labels on both sides of the 2048 block boundary
+    labels = labels.at[0].set(2047).at[1].set(2048).at[2].set(v - 1)
+    labels = labels.at[3].set(-1)  # ignore row
+
+    def ref(x, y):
+        lse = jax.nn.logsumexp(x, axis=-1)
+        picked = jnp.take_along_axis(x, jnp.maximum(y, 0)[:, None],
+                                     1)[:, 0]
+        return jnp.where(y >= 0, lse - picked, 0.0)
+
+    loss = pk.fused_softmax_cross_entropy(logits, labels)
+    np.testing.assert_allclose(np.asarray(loss),
+                               np.asarray(ref(logits, labels)),
+                               atol=1e-5, rtol=1e-5)
+    gp = jax.grad(lambda x: jnp.sum(
+        pk.fused_softmax_cross_entropy(x, labels)))(logits)
+    gr = jax.grad(lambda x: jnp.sum(ref(x, labels)))(logits)
+    np.testing.assert_allclose(np.asarray(gp), np.asarray(gr),
+                               atol=1e-5, rtol=1e-5)
+    assert float(jnp.abs(gp[3]).sum()) == 0.0  # ignored row: zero grad
