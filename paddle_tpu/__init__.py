@@ -80,6 +80,12 @@ from . import profiler  # noqa: F401
 from . import incubate  # noqa: F401
 from . import framework  # noqa: F401
 from . import inference  # noqa: F401
+from . import sparse  # noqa: F401
+from . import fft  # noqa: F401
+from . import distribution  # noqa: F401
+from . import utils  # noqa: F401
+from . import version  # noqa: F401
+from . import sysconfig  # noqa: F401
 
 from .jit import grad  # noqa: F401
 from .hapi import Model, summary  # noqa: F401

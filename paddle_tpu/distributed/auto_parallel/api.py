@@ -240,6 +240,7 @@ def shard_layer(layer, process_mesh, shard_fn=None, input_fn=None,
 
 def to_static(layer, loader=None, loss_fn=None, optimizer=None,
               strategy=None):
-    """auto_parallel dist-model compile entry; returns the layer (already
-    SPMD via sharded tensors + pjit in this design)."""
-    return layer
+    """auto_parallel dist-model compile entry — delegates to
+    engine.DistModel (one SPMD executable with planner-placed state)."""
+    from .engine import to_static as _to_static
+    return _to_static(layer, loader, loss_fn, optimizer, strategy)

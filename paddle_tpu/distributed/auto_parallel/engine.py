@@ -88,12 +88,18 @@ class DistModel:
     def _plan_entries(self, p, name):
         user = getattr(p, "placements", None)
         if user is not None:
-            from .api import _placements_to_spec, Shard
+            from .api import Shard
+            # resolve axis names against the mesh the tensor was placed
+            # on when it differs from the engine mesh (shard_tensor
+            # stores it as .process_mesh)
+            pmesh = getattr(p, "process_mesh", None)
+            names = (pmesh.dim_names if pmesh is not None
+                     else self._mesh.axis_names)
             entries = [None] * p.ndim
             for axis_i, pl in enumerate(user):
-                if isinstance(pl, Shard) and \
-                        axis_i < len(self._mesh.axis_names):
-                    entries[pl.dim] = self._mesh.axis_names[axis_i]
+                if isinstance(pl, Shard) and axis_i < len(names) and \
+                        names[axis_i] in self._mesh.axis_names:
+                    entries[pl.dim] = names[axis_i]
             return entries
         return self._auto_plan.get(name, [None] * p.ndim)
 
@@ -172,7 +178,8 @@ class DistModel:
             return x._value if isinstance(x, Tensor) else x
 
         if mode == "predict":
-            def step(pvals, opt_vals, *data):
+            def step(pvals, opt_vals, lr, step_i, *data):
+                del lr, step_i
                 out = self._bind_forward(pvals, data)
                 if isinstance(out, (tuple, list)):
                     return tuple(tval(o) for o in out), pvals, opt_vals
@@ -191,15 +198,17 @@ class DistModel:
                 return tval(loss).astype(jnp.float32)
 
             if mode == "eval":
-                def step(pvals, opt_vals, *data):
+                def step(pvals, opt_vals, lr, step_i, *data):
+                    del lr, step_i
                     return loss_of(pvals, data), pvals, opt_vals
                 donate = ()
             else:
-                def step(pvals, opt_vals, *data):
+                def step(pvals, opt_vals, lr, step_i, *data):
                     loss, grads = jax.value_and_grad(loss_of)(
                         tuple(pvals), data)
                     new_p, new_o = self._optimizer._static_update(
-                        pvals, grads, opt_vals, self._trainable)
+                        pvals, grads, opt_vals, self._trainable, lr=lr,
+                        step=step_i)
                     return loss, tuple(new_p), tuple(new_o)
                 donate = (0, 1)
 
@@ -235,7 +244,18 @@ class DistModel:
             self._steps[key] = fn
         pvals = tuple(p._value for p in self._trainable)
         ovals = tuple(t._value for t in self._opt_state)
-        out, new_p, new_o = fn(pvals, ovals, *arrs)
+        lr = jnp.asarray(0.0, jnp.float32)
+        step_i = jnp.asarray(0, jnp.int32)
+        if self._optimizer is not None:
+            opt = self._optimizer
+            opt._sync_lr()
+            lr = jnp.asarray(opt._lr_tensor._value, jnp.float32)
+            step_i = jnp.asarray(np.asarray(opt._step_count._value),
+                                 jnp.int32)
+            if self._mode == "train":
+                opt._step_count._inplace_update(
+                    np.asarray(opt._step_count._value) + 1)
+        out, new_p, new_o = fn(pvals, ovals, lr, step_i, *arrs)
         for p, v in zip(self._trainable, new_p):
             p._value = v
         for t, v in zip(self._opt_state, new_o):

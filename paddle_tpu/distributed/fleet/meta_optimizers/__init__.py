@@ -103,13 +103,19 @@ class GradientMergeOptimizer(_InnerDelegate):
                  for p in params]
         return [counter] + accum + list(inner_state)
 
-    def _static_update(self, param_vals, grads, opt_vals, params):
+    def _static_update(self, param_vals, grads, opt_vals, params,
+                       lr=None, step=None):
         import numpy as np
-        lr = self.inner._lr_tensor._value
-        step = self.inner._step_count._value
-        # numpy, not jnp: this runs during trace and a jnp op would
-        # leak a tracer into the eager counter (see Optimizer._static_update)
-        self.inner._step_count._inplace_update(np.asarray(step) + 1)
+        if lr is None:
+            lr = self.inner._lr_tensor._value
+        if step is None:
+            step = self.inner._step_count._value
+            # numpy, not jnp: this runs during trace and a jnp op would
+            # leak a tracer into the eager counter (see
+            # Optimizer._static_update)
+            self.inner._step_count._inplace_update(np.asarray(step) + 1)
+        # `step` itself is unused by _pure_update (the traced microstep
+        # counter lives in opt state), but forward it for parity
         return self._pure_update(lr, step, param_vals, grads, opt_vals,
                                  params)
 
@@ -215,9 +221,10 @@ class ShardingOptimizer(_InnerDelegate):
     def _ensure_static_state(self, params):
         return self._shard(self.inner._ensure_static_state(params))
 
-    def _static_update(self, param_vals, grads, opt_vals, params):
+    def _static_update(self, param_vals, grads, opt_vals, params,
+                       lr=None, step=None):
         return self.inner._static_update(param_vals, grads, opt_vals,
-                                         params)
+                                         params, lr=lr, step=step)
 
     def _pure_update(self, lr, step, param_vals, grads, opt_vals, params):
         return self.inner._pure_update(lr, step, param_vals, grads,

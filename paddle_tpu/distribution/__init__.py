@@ -1,0 +1,454 @@
+"""paddle.distribution: probability distributions.
+
+Reference parity: `python/paddle/distribution/` (Distribution base,
+Normal/Uniform/Categorical/Bernoulli/Beta/Dirichlet/..., kl_divergence,
+register_kl [UNVERIFIED — empty reference mount]).
+
+TPU-native: sampling uses the framework's seeded generator
+(paddle.seed → jax.random key folding), densities are jnp expressions
+routed through dispatch so log_prob/entropy are differentiable on the
+tape and traceable under to_static.
+"""
+from __future__ import annotations
+
+import math
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.dispatch import dispatch
+from ..core.tensor import Tensor, to_tensor
+
+__all__ = ["Distribution", "Normal", "Uniform", "Categorical",
+           "Bernoulli", "Beta", "Dirichlet", "Exponential", "Gamma",
+           "Geometric", "Gumbel", "Laplace", "LogNormal", "Multinomial",
+           "Poisson", "kl_divergence", "register_kl"]
+
+
+def _val(x):
+    if isinstance(x, Tensor):
+        return x._value
+    return jnp.asarray(x, jnp.float32) if isinstance(
+        x, (int, float, list, tuple)) else jnp.asarray(x)
+
+
+
+def _keep(x):
+    """Preserve the caller's Tensor (so log_prob gradients reach it);
+    wrap raw values."""
+    if isinstance(x, Tensor):
+        return x
+    return _wrap(jnp.asarray(x, jnp.float32) if isinstance(
+        x, (int, float, list, tuple)) else jnp.asarray(x))
+
+def _next_key():
+    from ..framework import random as prandom
+    return prandom.default_generator().next_key()
+
+
+def _wrap(v):
+    return Tensor(v, _internal=True, stop_gradient=True)
+
+
+class Distribution:
+    def __init__(self, batch_shape=(), event_shape=()):
+        self._batch_shape = tuple(batch_shape)
+        self._event_shape = tuple(event_shape)
+
+    @property
+    def batch_shape(self):
+        return self._batch_shape
+
+    @property
+    def event_shape(self):
+        return self._event_shape
+
+    def sample(self, shape=()):
+        raise NotImplementedError
+
+    def rsample(self, shape=()):
+        raise NotImplementedError
+
+    def log_prob(self, value):
+        raise NotImplementedError
+
+    def prob(self, value):
+        from ..ops.math import exp
+        return exp(self.log_prob(value))
+
+    def entropy(self):
+        raise NotImplementedError
+
+    def kl_divergence(self, other):
+        return kl_divergence(self, other)
+
+
+class Normal(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc_t, self.scale_t = _keep(loc), _keep(scale)
+        self.loc = self.loc_t._value
+        self.scale = self.scale_t._value
+        super().__init__(jnp.broadcast_shapes(self.loc.shape,
+                                              self.scale.shape))
+
+    @property
+    def mean(self):
+        return _wrap(jnp.broadcast_to(
+            self.loc, self.batch_shape))
+
+    @property
+    def variance(self):
+        return _wrap(jnp.broadcast_to(
+            jnp.square(self.scale), self.batch_shape))
+
+    def sample(self, shape=()):
+        shape = tuple(shape) + self.batch_shape
+        z = jax.random.normal(_next_key(), shape, jnp.float32)
+        return _wrap(self.loc + self.scale * z)
+
+    rsample = sample
+
+    def log_prob(self, value):
+        def impl(v, loc, scale):
+            var = jnp.square(scale)
+            return (-jnp.square(v - loc) / (2 * var)
+                    - jnp.log(scale) - 0.5 * math.log(2 * math.pi))
+        return dispatch("normal_log_prob", impl,
+                        (value, self.loc_t, self.scale_t))
+
+    def entropy(self):
+        return _wrap(jnp.broadcast_to(
+            0.5 + 0.5 * math.log(2 * math.pi) + jnp.log(self.scale),
+            self.batch_shape))
+
+    def cdf(self, value):
+        return dispatch(
+            "normal_cdf",
+            lambda v, loc, scale: 0.5 * (1 + jax.lax.erf(
+                (v - loc) / (scale * math.sqrt(2)))),
+            (value, self.loc_t, self.scale_t))
+
+
+class LogNormal(Normal):
+    def sample(self, shape=()):
+        return _wrap(jnp.exp(super().sample(shape)._value))
+
+    rsample = sample
+
+    def log_prob(self, value):
+        def impl(v, loc, scale):
+            lv = jnp.log(v)
+            var = jnp.square(scale)
+            return (-jnp.square(lv - loc) / (2 * var) - lv
+                    - jnp.log(scale) - 0.5 * math.log(2 * math.pi))
+        return dispatch("lognormal_log_prob", impl,
+                        (value, self.loc_t, self.scale_t))
+
+
+class Uniform(Distribution):
+    def __init__(self, low, high, name=None):
+        self.low = _val(low)
+        self.high = _val(high)
+        super().__init__(jnp.broadcast_shapes(self.low.shape,
+                                              self.high.shape))
+
+    def sample(self, shape=()):
+        shape = tuple(shape) + self.batch_shape
+        u = jax.random.uniform(_next_key(), shape, jnp.float32)
+        return _wrap(self.low + (self.high - self.low) * u)
+
+    rsample = sample
+
+    def log_prob(self, value):
+        def impl(v, lo, hi):
+            inside = (v >= lo) & (v < hi)
+            return jnp.where(inside, -jnp.log(hi - lo), -jnp.inf)
+        return dispatch("uniform_log_prob", impl,
+                        (value, _wrap(self.low), _wrap(self.high)),
+                        differentiable=False)
+
+    def entropy(self):
+        return _wrap(jnp.log(self.high - self.low))
+
+
+class Categorical(Distribution):
+    def __init__(self, logits=None, probs=None, name=None):
+        if logits is None and probs is None:
+            raise ValueError("one of logits/probs is required")
+        if logits is not None and probs is None:
+            self.logits = _val(logits)
+        else:
+            self.logits = jnp.log(jnp.clip(_val(probs), 1e-38))
+        super().__init__(self.logits.shape[:-1])
+
+    @property
+    def probs(self):
+        return _wrap(jax.nn.softmax(self.logits, -1))
+
+    def sample(self, shape=()):
+        shape = tuple(shape) + self.batch_shape
+        out = jax.random.categorical(_next_key(), self.logits,
+                                     shape=shape)
+        return _wrap(out)
+
+    def log_prob(self, value):
+        def impl(v, logits):
+            logp = jax.nn.log_softmax(logits, -1)
+            return jnp.take_along_axis(
+                logp, v.astype(jnp.int32)[..., None], -1)[..., 0]
+        return dispatch("categorical_log_prob", impl,
+                        (value, _wrap(self.logits)))
+
+    def entropy(self):
+        logp = jax.nn.log_softmax(self.logits, -1)
+        return _wrap(-jnp.sum(jnp.exp(logp) * logp, -1))
+
+
+class Bernoulli(Distribution):
+    def __init__(self, probs, name=None):
+        self.probs_ = jnp.clip(_val(probs), 1e-7, 1 - 1e-7)
+        super().__init__(self.probs_.shape)
+
+    def sample(self, shape=()):
+        shape = tuple(shape) + self.batch_shape
+        u = jax.random.uniform(_next_key(), shape)
+        return _wrap((u < self.probs_).astype(jnp.float32))
+
+    def log_prob(self, value):
+        def impl(v, p):
+            return v * jnp.log(p) + (1 - v) * jnp.log1p(-p)
+        return dispatch("bernoulli_log_prob", impl,
+                        (value, _wrap(self.probs_)))
+
+    def entropy(self):
+        p = self.probs_
+        return _wrap(-(p * jnp.log(p) + (1 - p) * jnp.log1p(-p)))
+
+
+class Beta(Distribution):
+    def __init__(self, alpha, beta, name=None):
+        self.alpha = _val(alpha)
+        self.beta = _val(beta)
+        super().__init__(jnp.broadcast_shapes(self.alpha.shape,
+                                              self.beta.shape))
+
+    def sample(self, shape=()):
+        shape = tuple(shape) + self.batch_shape
+        return _wrap(jax.random.beta(_next_key(), self.alpha, self.beta,
+                                     shape))
+
+    def log_prob(self, value):
+        def impl(v, a, b):
+            lbeta = (jax.scipy.special.gammaln(a)
+                     + jax.scipy.special.gammaln(b)
+                     - jax.scipy.special.gammaln(a + b))
+            return (a - 1) * jnp.log(v) + (b - 1) * jnp.log1p(-v) - lbeta
+        return dispatch("beta_log_prob", impl,
+                        (value, _wrap(self.alpha), _wrap(self.beta)))
+
+
+class Dirichlet(Distribution):
+    def __init__(self, concentration, name=None):
+        self.concentration = _val(concentration)
+        super().__init__(self.concentration.shape[:-1],
+                         self.concentration.shape[-1:])
+
+    def sample(self, shape=()):
+        shape = tuple(shape) + self.batch_shape
+        return _wrap(jax.random.dirichlet(_next_key(),
+                                          self.concentration, shape))
+
+    def log_prob(self, value):
+        def impl(v, c):
+            lognorm = (jnp.sum(jax.scipy.special.gammaln(c), -1)
+                       - jax.scipy.special.gammaln(jnp.sum(c, -1)))
+            return jnp.sum((c - 1) * jnp.log(v), -1) - lognorm
+        return dispatch("dirichlet_log_prob", impl,
+                        (value, _wrap(self.concentration)))
+
+
+class Exponential(Distribution):
+    def __init__(self, rate, name=None):
+        self.rate = _val(rate)
+        super().__init__(self.rate.shape)
+
+    def sample(self, shape=()):
+        shape = tuple(shape) + self.batch_shape
+        return _wrap(jax.random.exponential(_next_key(), shape)
+                     / self.rate)
+
+    def log_prob(self, value):
+        return dispatch(
+            "exponential_log_prob",
+            lambda v, r: jnp.log(r) - r * v, (value, _wrap(self.rate)))
+
+    def entropy(self):
+        return _wrap(1.0 - jnp.log(self.rate))
+
+
+class Gamma(Distribution):
+    def __init__(self, concentration, rate, name=None):
+        self.concentration = _val(concentration)
+        self.rate = _val(rate)
+        super().__init__(jnp.broadcast_shapes(self.concentration.shape,
+                                              self.rate.shape))
+
+    def sample(self, shape=()):
+        shape = tuple(shape) + self.batch_shape
+        return _wrap(jax.random.gamma(_next_key(), self.concentration,
+                                      shape) / self.rate)
+
+    def log_prob(self, value):
+        def impl(v, a, r):
+            return (a * jnp.log(r) + (a - 1) * jnp.log(v) - r * v
+                    - jax.scipy.special.gammaln(a))
+        return dispatch("gamma_log_prob", impl,
+                        (value, _wrap(self.concentration),
+                         _wrap(self.rate)))
+
+
+class Geometric(Distribution):
+    def __init__(self, probs, name=None):
+        self.probs_ = jnp.clip(_val(probs), 1e-7, 1 - 1e-7)
+        super().__init__(self.probs_.shape)
+
+    def sample(self, shape=()):
+        shape = tuple(shape) + self.batch_shape
+        u = jax.random.uniform(_next_key(), shape)
+        return _wrap(jnp.floor(jnp.log1p(-u) / jnp.log1p(-self.probs_)))
+
+    def log_prob(self, value):
+        return dispatch(
+            "geometric_log_prob",
+            lambda v, p: v * jnp.log1p(-p) + jnp.log(p),
+            (value, _wrap(self.probs_)))
+
+
+class Gumbel(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc_t, self.scale_t = _keep(loc), _keep(scale)
+        self.loc = self.loc_t._value
+        self.scale = self.scale_t._value
+        super().__init__(jnp.broadcast_shapes(self.loc.shape,
+                                              self.scale.shape))
+
+    def sample(self, shape=()):
+        shape = tuple(shape) + self.batch_shape
+        g = jax.random.gumbel(_next_key(), shape)
+        return _wrap(self.loc + self.scale * g)
+
+    rsample = sample
+
+    def log_prob(self, value):
+        def impl(v, loc, scale):
+            z = (v - loc) / scale
+            return -(z + jnp.exp(-z)) - jnp.log(scale)
+        return dispatch("gumbel_log_prob", impl,
+                        (value, self.loc_t, self.scale_t))
+
+
+class Laplace(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc_t, self.scale_t = _keep(loc), _keep(scale)
+        self.loc = self.loc_t._value
+        self.scale = self.scale_t._value
+        super().__init__(jnp.broadcast_shapes(self.loc.shape,
+                                              self.scale.shape))
+
+    def sample(self, shape=()):
+        shape = tuple(shape) + self.batch_shape
+        return _wrap(self.loc + self.scale
+                     * jax.random.laplace(_next_key(), shape))
+
+    rsample = sample
+
+    def log_prob(self, value):
+        def impl(v, loc, scale):
+            return -jnp.abs(v - loc) / scale - jnp.log(2 * scale)
+        return dispatch("laplace_log_prob", impl,
+                        (value, self.loc_t, self.scale_t))
+
+
+class Multinomial(Distribution):
+    def __init__(self, total_count, probs, name=None):
+        self.total_count = int(total_count)
+        self.probs_ = _val(probs)
+        super().__init__(self.probs_.shape[:-1], self.probs_.shape[-1:])
+
+    def sample(self, shape=()):
+        logits = jnp.log(jnp.clip(self.probs_, 1e-38))
+        draws = jax.random.categorical(
+            _next_key(), logits,
+            shape=tuple(shape) + (self.total_count,) + self.batch_shape)
+        k = self.probs_.shape[-1]
+        onehot = jax.nn.one_hot(draws, k)
+        return _wrap(jnp.sum(onehot, axis=len(tuple(shape))))
+
+    def log_prob(self, value):
+        def impl(v, p):
+            logc = (jax.scipy.special.gammaln(
+                jnp.sum(v, -1) + 1)
+                - jnp.sum(jax.scipy.special.gammaln(v + 1), -1))
+            return logc + jnp.sum(v * jnp.log(jnp.clip(p, 1e-38)), -1)
+        return dispatch("multinomial_log_prob", impl,
+                        (value, _wrap(self.probs_)))
+
+
+class Poisson(Distribution):
+    def __init__(self, rate, name=None):
+        self.rate = _val(rate)
+        super().__init__(self.rate.shape)
+
+    def sample(self, shape=()):
+        shape = tuple(shape) + self.batch_shape
+        return _wrap(jax.random.poisson(_next_key(), self.rate,
+                                        shape).astype(jnp.float32))
+
+    def log_prob(self, value):
+        def impl(v, r):
+            return (v * jnp.log(r) - r
+                    - jax.scipy.special.gammaln(v + 1))
+        return dispatch("poisson_log_prob", impl,
+                        (value, _wrap(self.rate)))
+
+
+# ---- KL registry ---------------------------------------------------------
+
+_KL_REGISTRY = {}
+
+
+def register_kl(p_cls, q_cls):
+    def deco(fn):
+        _KL_REGISTRY[(p_cls, q_cls)] = fn
+        return fn
+    return deco
+
+
+def kl_divergence(p, q):
+    for (pc, qc), fn in _KL_REGISTRY.items():
+        if isinstance(p, pc) and isinstance(q, qc):
+            return fn(p, q)
+    raise NotImplementedError(
+        f"no KL registered for ({type(p).__name__}, {type(q).__name__})")
+
+
+@register_kl(Normal, Normal)
+def _kl_normal_normal(p, q):
+    var_ratio = jnp.square(p.scale / q.scale)
+    t1 = jnp.square((p.loc - q.loc) / q.scale)
+    return _wrap(0.5 * (var_ratio + t1 - 1 - jnp.log(var_ratio)))
+
+
+@register_kl(Categorical, Categorical)
+def _kl_cat_cat(p, q):
+    logp = jax.nn.log_softmax(p.logits, -1)
+    logq = jax.nn.log_softmax(q.logits, -1)
+    return _wrap(jnp.sum(jnp.exp(logp) * (logp - logq), -1))
+
+
+@register_kl(Uniform, Uniform)
+def _kl_uniform_uniform(p, q):
+    inside = (q.low <= p.low) & (p.high <= q.high)
+    kl = jnp.log((q.high - q.low) / (p.high - p.low))
+    return _wrap(jnp.where(inside, kl, jnp.inf))

@@ -7,6 +7,7 @@ hot ones) — exposed under the incubate names for API parity.
 """
 from . import nn
 from . import distributed  # MoE lives here (incubate.distributed.models.moe)
+from . import autograd  # vjp/jvp/Jacobian/Hessian transforms
 
 
 def autograd_functional_jacobian(func, xs):

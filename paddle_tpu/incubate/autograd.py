@@ -1,0 +1,135 @@
+"""paddle.incubate.autograd: functional transforms (vjp/jvp/Jacobian/
+Hessian/forward_grad).
+
+Reference parity: `python/paddle/incubate/autograd/` [UNVERIFIED —
+empty reference mount].  TPU-native: these are direct exposures of
+jax's transform set over the framework's pure-op core — the reference
+builds them from double-grad op rules; here jax.jacrev/jacfwd/jvp/vjp
+compose for free because every op bottoms out in traceable JAX.
+"""
+from __future__ import annotations
+
+from ..core.tensor import Tensor
+
+__all__ = ["vjp", "jvp", "Jacobian", "Hessian", "jacobian", "hessian",
+           "forward_grad"]
+
+
+def _pure(func, n_in):
+    import jax
+    from ..core.autograd import no_grad
+
+    def fn(*vals):
+        with no_grad():
+            out = func(*[Tensor(v, _internal=True, stop_gradient=True)
+                         for v in vals])
+        if isinstance(out, (tuple, list)):
+            return tuple(o._value if isinstance(o, Tensor) else o
+                         for o in out)
+        return out._value if isinstance(out, Tensor) else out
+    return fn
+
+
+def _vals(xs):
+    single = isinstance(xs, Tensor)
+    lst = [xs] if single else list(xs)
+    return single, [x._value for x in lst]
+
+
+def _wrap(v):
+    if isinstance(v, (tuple, list)):
+        return tuple(_wrap(x) for x in v)
+    return Tensor(v, _internal=True, stop_gradient=True)
+
+
+def vjp(func, xs, v=None):
+    """(outputs, vjp_result): reverse-mode products (cotangent v)."""
+    import jax
+    single, vals = _vals(xs)
+    out, pullback = jax.vjp(_pure(func, len(vals)), *vals)
+    if v is None:
+        import jax.numpy as jnp
+        cot = jax.tree.map(jnp.ones_like, out)
+    else:
+        cot = v._value if isinstance(v, Tensor) else tuple(
+            x._value for x in v)
+    grads = pullback(cot)
+    g = grads[0] if single else grads
+    return _wrap(out), _wrap(g)
+
+
+def jvp(func, xs, v=None):
+    """(outputs, jvp_result): forward-mode products (tangent v)."""
+    import jax
+    import jax.numpy as jnp
+    single, vals = _vals(xs)
+    if v is None:
+        tangents = tuple(jnp.ones_like(x) for x in vals)
+    else:
+        vs = [v] if isinstance(v, Tensor) else list(v)
+        tangents = tuple(x._value for x in vs)
+    out, tangent_out = jax.jvp(_pure(func, len(vals)), tuple(vals),
+                               tangents)
+    return _wrap(out), _wrap(tangent_out)
+
+
+class Jacobian:
+    """Lazy dense Jacobian: J[:] materializes, J[i, j] slices."""
+
+    def __init__(self, func, xs, is_batched=False):
+        import jax
+        single, vals = _vals(xs)
+        jac = jax.jacrev(_pure(func, len(vals)),
+                         argnums=tuple(range(len(vals))))(*vals)
+        self._jac = jac[0] if single else jac
+        self.is_batched = is_batched
+
+    def __getitem__(self, idx):
+        import numpy as np
+        arr = self._jac
+        if isinstance(arr, tuple):
+            arr = arr[0]
+        # flatten (out_shape, in_shape) → 2-D like the reference
+        out = np.asarray(arr)
+        flat = out.reshape(-1) if out.ndim <= 1 else out.reshape(
+            int(np.prod(out.shape[: out.ndim // 2])) or 1, -1)
+        return _wrap(flat[idx])
+
+    def numpy(self):
+        import numpy as np
+        arr = self._jac[0] if isinstance(self._jac, tuple) else self._jac
+        return np.asarray(arr)
+
+
+class Hessian:
+    def __init__(self, func, xs, is_batched=False):
+        import jax
+        single, vals = _vals(xs)
+        hess = jax.hessian(_pure(func, len(vals)))(*vals)
+        self._h = hess
+        self.is_batched = is_batched
+
+    def __getitem__(self, idx):
+        import numpy as np
+        h = np.asarray(self._h)
+        n = int(np.sqrt(h.size)) if h.ndim != 2 else h.shape[0]
+        return _wrap(h.reshape(n, -1)[idx])
+
+    def numpy(self):
+        import numpy as np
+        return np.asarray(self._h)
+
+
+def jacobian(func, xs, create_graph=False, allow_unused=False):
+    j = Jacobian(func, xs)
+    return _wrap(j.numpy())
+
+
+def hessian(func, xs, create_graph=False, allow_unused=False):
+    h = Hessian(func, xs)
+    return _wrap(h.numpy())
+
+
+def forward_grad(func, xs, v=None):
+    """Forward-mode gradient (the reference's primal-transpose path)."""
+    return jvp(func, xs, v)[1]

@@ -339,11 +339,22 @@ def create_predictor(config: Config) -> Predictor:
 
 
 class PredictorPool:
-    """A fixed-size pool of predictors sharing one artifact (the
-    reference uses this for multi-threaded serving)."""
+    """A fixed-size pool of predictors sharing ONE deserialized
+    executable + weight buffers (the reference uses this for
+    multi-threaded serving).  Each member only has its own input/output
+    handles and lock."""
 
     def __init__(self, config: Config, size: int = 1):
-        self._preds = [Predictor(config) for _ in range(max(1, size))]
+        first = Predictor(config)
+        self._preds = [first]
+        for _ in range(max(1, size) - 1):
+            clone = Predictor.__new__(Predictor)
+            clone.__dict__.update(first.__dict__)
+            clone._lock = threading.Lock()
+            clone._inputs = {n: Tensor(n, h._shape, h._dtype)
+                             for n, h in first._inputs.items()}
+            clone._outputs = {n: Tensor(n) for n in first._outputs}
+            self._preds.append(clone)
 
     def retrieve(self, idx: int) -> Predictor:
         return self._preds[idx % len(self._preds)]

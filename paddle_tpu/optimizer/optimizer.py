@@ -173,14 +173,24 @@ class Optimizer:
                                   self._grad_clip.max) for g in grads)
         return grads
 
-    def _static_update(self, param_vals, grads, opt_vals, params):
-        lr = self._lr_tensor._value
-        step = self._step_count._value
-        # advance the counter host-side (numpy): this runs while TRACING
-        # the compiled step, and any jnp op here (even asarray) would be
-        # lifted into the trace, leaking a tracer into the eager step
-        # counter (it then poisons optimizer.state_dict()).
-        self._step_count._inplace_update(np.asarray(step) + 1)
+    def _static_update(self, param_vals, grads, opt_vals, params,
+                       lr=None, step=None):
+        # `lr` and `step` are traced per-step values when the caller
+        # threads them as executable arguments (Executor/DistModel/the
+        # pipeline engine do).  Baking them at trace time would freeze
+        # an LRScheduler's changes AND Adam/AdamW's bias correction
+        # (`1 - beta**step`) at the first step's values for the whole
+        # cached-executable lifetime.
+        if lr is None:
+            lr = self._lr_tensor._value
+        if step is None:
+            step = self._step_count._value
+            # advance the counter host-side (numpy): this runs while
+            # TRACING the compiled step, and any jnp op here (even
+            # asarray) would be lifted into the trace, leaking a tracer
+            # into the eager step counter (it then poisons
+            # optimizer.state_dict()).
+            self._step_count._inplace_update(np.asarray(step) + 1)
         grads = self._clip_static_grads(grads)
         return self._pure_update(lr, step, param_vals, grads, opt_vals,
                                  params)

@@ -1,0 +1,280 @@
+"""paddle.sparse: COO/CSR sparse tensors over jax BCOO/BCSR.
+
+Reference parity: `phi/core/` SelectedRows + SparseCooTensor/
+SparseCsrTensor and `python/paddle/sparse/` (sparse_coo_tensor,
+to_dense, unary/binary ops, sparse.nn activations [UNVERIFIED — empty
+reference mount; SURVEY.md §2.1 Tensor core row]).
+
+TPU-native: the carrier is `jax.experimental.sparse` (BCOO/BCSR), whose
+ops lower to XLA gather/scatter/segment-sum — there is no cuSPARSE to
+wrap.  On TPU, sparse pays off for EMBEDDING-class access patterns
+(SelectedRows' role: sparse gradients for large tables) rather than
+irregular spMM, so the surface here focuses on construction,
+conversion, elementwise math, and matmul; dense is one `.to_dense()`
+away and XLA fuses the rest.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.experimental import sparse as jsparse
+
+from ..core.tensor import Tensor, to_tensor
+
+__all__ = [
+    "sparse_coo_tensor", "sparse_csr_tensor", "SparseCooTensor",
+    "is_same_shape", "matmul", "masked_matmul", "add", "subtract",
+    "multiply", "divide", "relu", "sin", "tanh", "sqrt", "abs", "pow",
+    "neg", "cast", "transpose", "sum",
+]
+
+
+class SparseCooTensor(Tensor):
+    """A Tensor whose value is a BCOO array.  Inherits the Tensor
+    surface; dense-only ops should call `.to_dense()` first (the
+    reference raises the same way for unsupported sparse kernels)."""
+
+    def __init__(self, bcoo, stop_gradient=True):
+        super().__init__(jnp.zeros((), jnp.float32), _internal=True,
+                         stop_gradient=stop_gradient)
+        self._value = bcoo
+
+    # ---- introspection ----
+    def is_sparse(self):
+        return True
+
+    def is_sparse_coo(self):
+        return isinstance(self._value, jsparse.BCOO)
+
+    def is_sparse_csr(self):
+        return isinstance(self._value, jsparse.BCSR)
+
+    def nnz(self):
+        return int(self._value.nse)
+
+    def indices(self):
+        if isinstance(self._value, jsparse.BCSR):
+            return to_tensor(np.asarray(self._value.indices))
+        return to_tensor(np.asarray(self._value.indices).T)
+
+    def values(self):
+        return to_tensor(self._value.data)
+
+    def crows(self):
+        return to_tensor(np.asarray(self._value.indptr))
+
+    def cols(self):
+        return to_tensor(np.asarray(self._value.indices))
+
+    # ---- conversion ----
+    def to_dense(self):
+        return Tensor(self._value.todense(), _internal=True,
+                      stop_gradient=self.stop_gradient)
+
+    def to_sparse_coo(self, sparse_dim=None):
+        if isinstance(self._value, jsparse.BCSR):
+            return SparseCooTensor(self._value.to_bcoo(),
+                                   self.stop_gradient)
+        return self
+
+    def to_sparse_csr(self):
+        if isinstance(self._value, jsparse.BCOO):
+            return SparseCooTensor(jsparse.BCSR.from_bcoo(self._value),
+                                   self.stop_gradient)
+        return self
+
+    @property
+    def shape(self):
+        return list(self._value.shape)
+
+    def numpy(self):
+        return np.asarray(self._value.todense())
+
+    def __repr__(self):
+        kind = "csr" if self.is_sparse_csr() else "coo"
+        return (f"SparseTensor({kind}, shape={self.shape}, "
+                f"nnz={self.nnz()}, dtype={self._value.dtype})")
+
+
+def sparse_coo_tensor(indices, values, shape=None, dtype=None,
+                      place=None, stop_gradient=True):
+    """Build a COO tensor from [sparse_dim, nnz] indices + values."""
+    idx = np.asarray(indices.numpy() if isinstance(indices, Tensor)
+                     else indices)
+    val = values._value if isinstance(values, Tensor) else jnp.asarray(
+        values)
+    if dtype is not None:
+        from ..core.dtypes import to_jax_dtype
+        val = val.astype(to_jax_dtype(dtype))
+    if idx.ndim != 2:
+        raise ValueError("indices must be [sparse_dim, nnz]")
+    if shape is None:
+        shape = tuple(int(idx[d].max()) + 1 for d in range(idx.shape[0]))
+    bcoo = jsparse.BCOO((val, jnp.asarray(idx.T)), shape=tuple(shape))
+    return SparseCooTensor(bcoo, stop_gradient=stop_gradient)
+
+
+def sparse_csr_tensor(crows, cols, values, shape, dtype=None,
+                      place=None, stop_gradient=True):
+    crows = np.asarray(crows.numpy() if isinstance(crows, Tensor)
+                       else crows)
+    cols = np.asarray(cols.numpy() if isinstance(cols, Tensor) else cols)
+    val = values._value if isinstance(values, Tensor) else jnp.asarray(
+        values)
+    if dtype is not None:
+        from ..core.dtypes import to_jax_dtype
+        val = val.astype(to_jax_dtype(dtype))
+    bcsr = jsparse.BCSR((val, jnp.asarray(cols), jnp.asarray(crows)),
+                        shape=tuple(shape))
+    return SparseCooTensor(bcsr, stop_gradient=stop_gradient)
+
+
+def _coo(x):
+    if isinstance(x, SparseCooTensor):
+        v = x._value
+        return v.to_bcoo() if isinstance(v, jsparse.BCSR) else v
+    raise TypeError(f"expected a sparse tensor, got {type(x).__name__}")
+
+
+def is_same_shape(x, y):
+    return list(x.shape) == list(y.shape)
+
+
+# ---- math ----------------------------------------------------------------
+
+def matmul(x, y, name=None):
+    """sparse @ dense (or sparse @ sparse → dense result)."""
+    a = _coo(x) if isinstance(x, SparseCooTensor) else x._value
+    b = _coo(y) if isinstance(y, SparseCooTensor) else y._value
+    out = a @ b
+    if isinstance(out, (jsparse.BCOO, jsparse.BCSR)):
+        return SparseCooTensor(out)
+    return Tensor(out, _internal=True)
+
+
+def masked_matmul(x, y, mask, name=None):
+    """Dense x @ y evaluated only at mask's nonzero positions."""
+    m = _coo(mask)
+    xv = x._value if isinstance(x, Tensor) else jnp.asarray(x)
+    yv = y._value if isinstance(y, Tensor) else jnp.asarray(y)
+    rows = m.indices[:, 0]
+    cols = m.indices[:, 1]
+    vals = jnp.einsum("nk,nk->n", xv[rows], yv[:, cols].T)
+    return SparseCooTensor(jsparse.BCOO((vals, m.indices),
+                                        shape=m.shape))
+
+
+def _ew(x, y, op):
+    a, b = _coo(x), _coo(y)
+    return SparseCooTensor(jsparse.bcoo_sum_duplicates(op(a, b)))
+
+
+def add(x, y, name=None):
+    if not isinstance(y, SparseCooTensor):
+        return Tensor(_coo(x).todense() + y._value, _internal=True)
+    a, b = _coo(x), _coo(y)
+    out = jsparse.bcoo_sum_duplicates(jsparse.BCOO(
+        (jnp.concatenate([a.data, b.data]),
+         jnp.concatenate([a.indices, b.indices])), shape=a.shape))
+    return SparseCooTensor(out)
+
+
+def subtract(x, y, name=None):
+    neg_y = SparseCooTensor(jsparse.BCOO((-_coo(y).data, _coo(y).indices),
+                                         shape=_coo(y).shape))
+    return add(x, neg_y)
+
+
+def multiply(x, y, name=None):
+    if isinstance(y, (int, float)):
+        a = _coo(x)
+        return SparseCooTensor(jsparse.BCOO((a.data * y, a.indices),
+                                            shape=a.shape))
+    # elementwise with dense: gather dense at sparse positions
+    a = _coo(x)
+    yv = y._value if isinstance(y, Tensor) else jnp.asarray(y)
+    gathered = yv[tuple(a.indices[:, d] for d in range(a.indices.shape[1]))]
+    return SparseCooTensor(jsparse.BCOO((a.data * gathered, a.indices),
+                                        shape=a.shape))
+
+
+def divide(x, y, name=None):
+    if isinstance(y, (int, float)):
+        return multiply(x, 1.0 / y)
+    a = _coo(x)
+    yv = y._value if isinstance(y, Tensor) else jnp.asarray(y)
+    gathered = yv[tuple(a.indices[:, d] for d in range(a.indices.shape[1]))]
+    return SparseCooTensor(jsparse.BCOO((a.data / gathered, a.indices),
+                                        shape=a.shape))
+
+
+def _unary(fn):
+    def op(x, name=None):
+        a = _coo(x)
+        return SparseCooTensor(jsparse.BCOO((fn(a.data), a.indices),
+                                            shape=a.shape))
+    return op
+
+
+relu = _unary(lambda v: jnp.maximum(v, 0))
+sin = _unary(jnp.sin)
+tanh = _unary(jnp.tanh)
+sqrt = _unary(jnp.sqrt)
+abs = _unary(jnp.abs)
+neg = _unary(jnp.negative)
+
+
+def pow(x, factor, name=None):
+    return _unary(lambda v: jnp.power(v, factor))(x)
+
+
+def cast(x, index_dtype=None, value_dtype=None, name=None):
+    from ..core.dtypes import to_jax_dtype
+    a = _coo(x)
+    data = a.data if value_dtype is None else a.data.astype(
+        to_jax_dtype(value_dtype))
+    idx = a.indices if index_dtype is None else a.indices.astype(
+        to_jax_dtype(index_dtype))
+    return SparseCooTensor(jsparse.BCOO((data, idx), shape=a.shape))
+
+
+def transpose(x, perm, name=None):
+    a = _coo(x)
+    return SparseCooTensor(jsparse.bcoo_transpose(
+        a, permutation=tuple(perm)))
+
+
+def sum(x, axis=None, dtype=None, keepdim=False, name=None):
+    a = _coo(x)
+    dense = a.todense().sum(axis=axis, keepdims=keepdim)
+    if dtype is not None:
+        from ..core.dtypes import to_jax_dtype
+        dense = dense.astype(to_jax_dtype(dtype))
+    return Tensor(dense, _internal=True)
+
+
+class _NN:
+    """sparse.nn: activation layers over sparse values."""
+
+    class ReLU:
+        def __call__(self, x):
+            return relu(x)
+
+    class Softmax:
+        def __init__(self, axis=-1):
+            self.axis = axis
+
+        def __call__(self, x):
+            # softmax over the last dense axis of each row's nonzeros:
+            # densify (XLA-friendly), mask empty slots to -inf
+            a = _coo(x)
+            d = a.todense()
+            mask = a.todense() != 0
+            z = jnp.where(mask, d, -jnp.inf)
+            s = jax.nn.softmax(z, axis=self.axis)
+            s = jnp.where(mask, s, 0)
+            return SparseCooTensor(jsparse.bcoo_fromdense(s))
+
+
+nn = _NN()

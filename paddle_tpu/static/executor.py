@@ -43,15 +43,16 @@ class CompiledProgram:
         self._build_strategy = build_strategy
 
 
-def _interpret(program: Program, env: dict):
-    """Run the op list over an environment of concrete/traced arrays."""
-    for op in program.global_block().ops:
-        in_vals = []
-        for i in op.inputs:
-            if isinstance(i, Variable):
-                in_vals.append(env[i.name])
-            else:  # captured eager Tensor (parameter / constant)
-                in_vals.append(env.setdefault(f"@cap{id(i)}", i._value))
+def run_program_ops(ops, env, capture_value):
+    """THE Program walker: evaluate the op list over `env`
+    (Variable name → array).  Non-Variable inputs are captured eager
+    Tensors (parameters/constants) resolved through `capture_value`.
+    Shared by Executor compilation and static/io._export_program so the
+    execution semantics of a Program cannot diverge between run and
+    save_inference_model."""
+    for op in ops:
+        in_vals = [env[i.name] if isinstance(i, Variable)
+                   else capture_value(i) for i in op.inputs]
         out = op.impl(*in_vals)
         if isinstance(out, (tuple, list)):
             for var, v in zip(op.outputs, out):
@@ -92,8 +93,18 @@ class Executor:
             for i, name in enumerate(entry["feed_names"]))
         param_vals = tuple(p._value for p in entry["params"])
         opt_state_vals = tuple(t._value for t in entry["opt_state"])
+        lr_val = jnp.asarray(0.0, jnp.float32)
+        step_val = jnp.asarray(0, jnp.int32)
+        if program._optimize_info is not None:
+            optimizer = program._optimize_info[0]
+            optimizer._sync_lr()  # pick up LRScheduler.step() changes
+            lr_val = jnp.asarray(optimizer._lr_tensor._value, jnp.float32)
+            step_val = jnp.asarray(
+                np.asarray(optimizer._step_count._value), jnp.int32)
+            optimizer._step_count._inplace_update(
+                np.asarray(optimizer._step_count._value) + 1)
         outs, new_params, new_opt_state = entry["compiled"](
-            feed_vals, param_vals, opt_state_vals)
+            feed_vals, param_vals, opt_state_vals, lr_val, step_val)
         for p, v in zip(entry["params"], new_params):
             p._value = v
         for t, v in zip(entry["opt_state"], new_opt_state):
@@ -138,44 +149,33 @@ class Executor:
             opt_state = optimizer._ensure_static_state(trainable)
 
         def run_ops(feed_vals, param_vals):
-            env = {}
-            for n, v in zip(feed_names, feed_vals):
-                env[n] = v
+            env = dict(zip(feed_names, feed_vals))
             pmap = {id(p): v for p, v in zip(trainable, param_vals)}
-            for op in block.ops:
-                in_vals = []
-                for i in op.inputs:
-                    if isinstance(i, Variable):
-                        in_vals.append(env[i.name])
-                    elif id(i) in pmap:
-                        in_vals.append(pmap[id(i)])
-                    else:
-                        in_vals.append(i._value)
-                out = op.impl(*in_vals)
-                if isinstance(out, (tuple, list)):
-                    for var, v in zip(op.outputs, out):
-                        env[var.name] = v
-                else:
-                    env[op.outputs[0].name] = out
-            return env
+            return run_program_ops(
+                block.ops, env, lambda i: pmap.get(id(i), i._value))
 
         if opt is None:
-            def pure(feed_vals, param_vals, opt_vals):
+            def pure(feed_vals, param_vals, opt_vals, lr, step):
+                del lr, step
                 env = run_ops(feed_vals, param_vals)
                 return tuple(env[v.name] for v in fetch_vars), param_vals, \
                     opt_vals
         else:
             optimizer, loss_var = opt
 
-            def pure(feed_vals, param_vals, opt_vals):
+            def pure(feed_vals, param_vals, opt_vals, lr, step):
                 def loss_fn(pvals):
                     env = run_ops(feed_vals, pvals)
                     return env[loss_var.name].astype(jnp.float32), env
 
                 (loss, env), grads = jax.value_and_grad(
                     loss_fn, has_aux=True)(param_vals)
+                # lr + step ride as arguments so LRScheduler.step()
+                # and Adam bias correction (1 - beta**step) evolve
+                # across calls of the cached executable
                 new_params, new_opt = optimizer._static_update(
-                    param_vals, grads, opt_vals, trainable)
+                    param_vals, grads, opt_vals, trainable, lr=lr,
+                    step=step)
                 return tuple(env[v.name] for v in fetch_vars), \
                     tuple(new_params), tuple(new_opt)
 
@@ -197,8 +197,10 @@ class Executor:
         opt_avals = tuple(
             jax.ShapeDtypeStruct(tuple(t._value.shape), t._value.dtype)
             for t in opt_state)
+        lr_aval = jax.ShapeDtypeStruct((), jnp.float32)
+        step_aval = jax.ShapeDtypeStruct((), jnp.int32)
         compiled = jitted.lower(feed_avals, param_avals,
-                                opt_avals).compile()
+                                opt_avals, lr_aval, step_aval).compile()
         return {
             "compiled": compiled,
             "feed_names": feed_names,

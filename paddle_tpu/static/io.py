@@ -39,17 +39,10 @@ def _export_program(program, feed_vars, fetch_vars):
                     for n, t in zip(state_names, captured)}
 
     def pure(state_vals, *feed_vals):
+        from .executor import run_program_ops
         env = {v.name: x for v, x in zip(feed_vars, feed_vals)}
         smap = {id(t): x for t, x in zip(captured, state_vals)}
-        for op in block.ops:
-            in_vals = [env[i.name] if isinstance(i, Variable) else smap[id(i)]
-                       for i in op.inputs]
-            out = op.impl(*in_vals)
-            if isinstance(out, (tuple, list)):
-                for var, v in zip(op.outputs, out):
-                    env[var.name] = v
-            else:
-                env[op.outputs[0].name] = out
+        run_program_ops(block.ops, env, lambda i: smap[id(i)])
         return tuple(env[v.name] for v in fetch_vars)
 
     state_avals = tuple(

@@ -211,10 +211,12 @@ def switch_case(branch_index, branch_fns, default=None, name=None):
         idx = int(branch_index)
         return dict(items).get(idx, default)()
 
-    # dense branch table covering [min_key, max_key]; others → default
-    lo, hi = min(keys), max(keys)
-    table = [dict(items).get(k, default) for k in range(lo, hi + 1)]
-    table.append(default)  # out-of-range slot
+    # compact branch list: one slot per DISTINCT key + a default slot.
+    # (A dense [min,max] table would dry-run and trace one branch per
+    # integer in the range — sparse keys like {0, 100000} must not
+    # blow up compile time.)
+    table = fns + [default]
+    key_arr = jnp.asarray(keys, jnp.int32)
 
     trees, captures, seen = [], [], set()
     for f in table:
@@ -230,8 +232,10 @@ def switch_case(branch_index, branch_fns, default=None, name=None):
 
     def impl(idx, *cap_vals):
         idx = jnp.reshape(jnp.asarray(idx), ()).astype(jnp.int32)
-        in_range = (idx >= lo) & (idx <= hi)
-        sel = jnp.where(in_range, idx - lo, len(table) - 1)
+        # position of idx among the branch keys, else the default slot
+        matches = key_arr == idx
+        sel = jnp.where(jnp.any(matches),
+                        jnp.argmax(matches), len(table) - 1)
         res = jax.lax.switch(
             sel, [lambda cv, f=f: _rebind(captures, cv, f, ())
                   for f in table], tuple(cap_vals))

@@ -1,0 +1,21 @@
+"""paddle.sysconfig: include/lib paths for native extensions.
+
+Reference parity: `python/paddle/sysconfig.py` [UNVERIFIED].  Native
+extensions against this framework compile against the CPython headers
+only (see paddle_tpu/_native); there is no libpaddle to link.
+"""
+from __future__ import annotations
+
+import os
+import sysconfig as _pysysconfig
+
+__all__ = ["get_include", "get_lib"]
+
+
+def get_include():
+    return _pysysconfig.get_paths()["include"]
+
+
+def get_lib():
+    return os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "_native")

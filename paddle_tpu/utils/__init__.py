@@ -1,0 +1,65 @@
+"""paddle.utils: misc framework utilities.
+
+Reference parity: `python/paddle/utils/` (unique_name, deprecated,
+try_import, run_check, cpp_extension, download [UNVERIFIED — empty
+reference mount]).  cpp_extension maps to plain setuptools/ctypes here
+(see paddle_tpu/_native for the in-tree example); download is local-path
+only (no egress in the target environment).
+"""
+from __future__ import annotations
+
+import functools
+import importlib
+import warnings
+
+from . import unique_name  # noqa: F401
+
+__all__ = ["unique_name", "deprecated", "try_import", "run_check",
+           "require_version"]
+
+
+def deprecated(update_to="", since="", reason="", level=0):
+    """Mark an API deprecated; warns once per call site."""
+    def deco(fn):
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            msg = f"API {fn.__qualname__} is deprecated"
+            if since:
+                msg += f" since {since}"
+            if update_to:
+                msg += f"; use {update_to} instead"
+            if reason:
+                msg += f" ({reason})"
+            warnings.warn(msg, DeprecationWarning, stacklevel=2)
+            return fn(*args, **kwargs)
+        return wrapper
+    return deco
+
+
+def try_import(module_name, err_msg=None):
+    try:
+        return importlib.import_module(module_name)
+    except ImportError as e:
+        raise ImportError(
+            err_msg or f"{module_name} is required but not installed "
+            f"({e})") from e
+
+
+def require_version(min_version, max_version=None):
+    from .. import __version__
+    return __version__
+
+
+def run_check():
+    """Smoke-check the install: one compiled matmul + backward on the
+    default backend (the reference checks GPU/NCCL health here)."""
+    import jax
+    import numpy as np
+    from .. import to_tensor
+    x = to_tensor(np.ones((4, 4), np.float32), stop_gradient=False)
+    y = (x @ x).sum()
+    y.backward()
+    dev = jax.devices()[0]
+    print(f"paddle_tpu is installed successfully! "
+          f"backend={dev.platform} device={dev}", flush=True)
+    return True

@@ -1,0 +1,47 @@
+"""paddle.utils.unique_name: process-wide unique name generator.
+
+Reference parity: `python/paddle/utils/unique_name.py` (generate/
+switch/guard over a UniqueNameGenerator [UNVERIFIED]).
+"""
+from __future__ import annotations
+
+import contextlib
+import threading
+
+__all__ = ["generate", "switch", "guard"]
+
+
+class _Generator:
+    def __init__(self):
+        self._ids = {}
+        self._lock = threading.Lock()
+
+    def __call__(self, key):
+        with self._lock:
+            i = self._ids.get(key, 0)
+            self._ids[key] = i + 1
+        return f"{key}_{i}"
+
+
+_generator = _Generator()
+
+
+def generate(key):
+    return _generator(key)
+
+
+def switch(new_generator=None):
+    global _generator
+    old = _generator
+    _generator = new_generator or _Generator()
+    return old
+
+
+@contextlib.contextmanager
+def guard(new_generator=None):
+    old = switch(new_generator)
+    try:
+        yield
+    finally:
+        global _generator
+        _generator = old

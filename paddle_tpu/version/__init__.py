@@ -1,0 +1,41 @@
+"""paddle.version: build metadata.
+
+Reference parity: generated `python/paddle/version/__init__.py`
+(full_version, cuda()/cudnn()/nccl() build strings [UNVERIFIED]).
+CUDA-stack queries return None by design — the accelerator stack here
+is PJRT/XLA; `xla()` reports the jaxlib version instead.
+"""
+from __future__ import annotations
+
+full_version = "0.1.0"
+major, minor, patch = (int(x) for x in full_version.split("."))
+rc = 0
+commit = "unknown"
+with_gpu = False
+
+
+def show():
+    print(f"paddle_tpu {full_version} (commit {commit})")
+    print(f"jax/jaxlib: {xla()}")
+
+
+def cuda():
+    return None
+
+
+def cudnn():
+    return None
+
+
+def nccl():
+    return None
+
+
+def xpu():
+    return None
+
+
+def xla():
+    import jax
+    import jaxlib
+    return f"jax {jax.__version__} / jaxlib {jaxlib.__version__}"

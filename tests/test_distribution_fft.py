@@ -1,0 +1,104 @@
+"""paddle.distribution + paddle.fft parity checks."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import distribution as D
+from paddle_tpu import fft
+
+
+def setup_function(_):
+    paddle.seed(1234)
+
+
+def test_normal_logprob_entropy_kl():
+    n = D.Normal(0.0, 1.0)
+    x = paddle.to_tensor([0.0, 1.0, -2.0])
+    want = -0.5 * np.array([0.0, 1.0, 4.0]) - 0.5 * np.log(2 * np.pi)
+    np.testing.assert_allclose(n.log_prob(x).numpy(), want, rtol=1e-5)
+    np.testing.assert_allclose(
+        float(np.asarray(n.entropy().numpy())),
+        0.5 + 0.5 * np.log(2 * np.pi), rtol=1e-6)
+    m = D.Normal(1.0, 2.0)
+    kl = D.kl_divergence(n, m)
+    want_kl = 0.5 * (0.25 + 0.25 - 1 - np.log(0.25))
+    np.testing.assert_allclose(float(np.asarray(kl.numpy())), want_kl,
+                               rtol=1e-5)
+
+
+def test_normal_sample_moments():
+    n = D.Normal(3.0, 0.5)
+    s = n.sample((20000,)).numpy()
+    assert abs(s.mean() - 3.0) < 0.05
+    assert abs(s.std() - 0.5) < 0.05
+
+
+def test_logprob_is_differentiable():
+    loc = paddle.to_tensor(0.5, stop_gradient=False)
+    n = D.Normal(loc, 1.0)
+    lp = n.log_prob(paddle.to_tensor(1.5))
+    lp.backward()
+    np.testing.assert_allclose(loc.grad.numpy(), 1.0, rtol=1e-5)
+
+
+def test_categorical_and_bernoulli():
+    c = D.Categorical(probs=[0.2, 0.3, 0.5])
+    lp = c.log_prob(paddle.to_tensor(2))
+    np.testing.assert_allclose(float(np.asarray(lp.numpy())),
+                               np.log(0.5), rtol=1e-5)
+    samples = c.sample((5000,)).numpy()
+    assert abs((samples == 2).mean() - 0.5) < 0.05
+    ent = c.entropy()
+    want = -(0.2 * np.log(0.2) + 0.3 * np.log(0.3) + 0.5 * np.log(0.5))
+    np.testing.assert_allclose(float(np.asarray(ent.numpy())), want,
+                               rtol=1e-5)
+
+    b = D.Bernoulli(0.7)
+    np.testing.assert_allclose(
+        float(np.asarray(b.log_prob(paddle.to_tensor(1.0)).numpy())),
+        np.log(0.7), rtol=1e-4)
+
+
+@pytest.mark.parametrize("dist,args", [
+    (D.Uniform, (0.0, 2.0)), (D.Beta, (2.0, 3.0)),
+    (D.Exponential, (1.5,)), (D.Gamma, (2.0, 1.0)),
+    (D.Gumbel, (0.0, 1.0)), (D.Laplace, (0.0, 1.0)),
+    (D.Poisson, (3.0,)), (D.Geometric, (0.3,)),
+    (D.LogNormal, (0.0, 0.5)),
+])
+def test_sample_and_logprob_shapes(dist, args):
+    d = dist(*args)
+    s = d.sample((7,))
+    assert s.shape[0] == 7
+    lp = d.log_prob(paddle.to_tensor(np.abs(s.numpy()) + 0.1))
+    assert np.isfinite(np.asarray(lp.numpy())).all()
+
+
+def test_dirichlet_multinomial():
+    d = D.Dirichlet([1.0, 2.0, 3.0])
+    s = d.sample((11,))
+    np.testing.assert_allclose(s.numpy().sum(-1), np.ones(11), rtol=1e-5)
+    lp = d.log_prob(paddle.to_tensor([0.2, 0.3, 0.5]))
+    assert np.isfinite(float(np.asarray(lp.numpy())))
+
+    m = D.Multinomial(10, [0.5, 0.5])
+    s = m.sample((6,))
+    np.testing.assert_allclose(s.numpy().sum(-1), 10 * np.ones(6))
+
+
+def test_fft_roundtrip_and_grad():
+    x = paddle.to_tensor(
+        np.random.default_rng(0).normal(size=(8, 16)).astype(np.float32))
+    X = fft.fft(x)
+    back = fft.ifft(X)
+    np.testing.assert_allclose(np.real(back.numpy()), x.numpy(),
+                               atol=1e-5)
+    np.testing.assert_allclose(
+        fft.rfft(x).numpy(), np.fft.rfft(x.numpy()), atol=1e-4)
+    np.testing.assert_allclose(
+        fft.fftshift(fft.fftfreq(16)).numpy(),
+        np.fft.fftshift(np.fft.fftfreq(16)), atol=1e-6)
+    # 2d
+    np.testing.assert_allclose(fft.fft2(x).numpy(),
+                               np.fft.fft2(x.numpy()), rtol=2e-4,
+                               atol=1e-3)

@@ -1,0 +1,25 @@
+"""incubate.autograd functional transforms."""
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu.incubate import autograd as A
+
+
+def test_vjp_jvp():
+    x = paddle.to_tensor([1.0, 2.0, 3.0])
+    out, g = A.vjp(lambda x: (x * x).sum(), x)
+    np.testing.assert_allclose(np.asarray(out.numpy()), 14.0)
+    np.testing.assert_allclose(g.numpy(), [2.0, 4.0, 6.0])
+    out, t = A.jvp(lambda x: (x * x).sum(), x,
+                   paddle.to_tensor([1.0, 0.0, 0.0]))
+    np.testing.assert_allclose(np.asarray(t.numpy()), 2.0)
+
+
+def test_jacobian_hessian():
+    x = paddle.to_tensor([1.0, 2.0])
+    J = A.Jacobian(lambda x: x * x, x)
+    np.testing.assert_allclose(J.numpy(), np.diag([2.0, 4.0]))
+    H = A.Hessian(lambda x: (x ** 3).sum(), x)
+    np.testing.assert_allclose(H.numpy(), np.diag([6.0, 12.0]))
+    np.testing.assert_allclose(
+        A.forward_grad(lambda x: x * 2, x).numpy(), [2.0, 2.0])

@@ -1,0 +1,86 @@
+"""paddle.sparse COO/CSR over jax.experimental.sparse."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import sparse
+
+
+def _coo():
+    indices = [[0, 0, 1, 2], [0, 2, 1, 0]]
+    values = [1.0, 2.0, 3.0, 4.0]
+    return sparse.sparse_coo_tensor(indices, values, shape=[3, 3])
+
+
+def test_construct_and_dense_roundtrip():
+    s = _coo()
+    assert s.is_sparse() and s.is_sparse_coo()
+    assert s.nnz() == 4
+    want = np.array([[1, 0, 2], [0, 3, 0], [4, 0, 0]], np.float32)
+    np.testing.assert_allclose(s.to_dense().numpy(), want)
+    np.testing.assert_allclose(s.numpy(), want)
+    assert s.shape == [3, 3]
+    assert "coo" in repr(s)
+
+
+def test_csr_roundtrip():
+    s = sparse.sparse_csr_tensor([0, 2, 3, 4], [0, 2, 1, 0],
+                                 [1.0, 2.0, 3.0, 4.0], [3, 3])
+    assert s.is_sparse_csr()
+    want = np.array([[1, 0, 2], [0, 3, 0], [4, 0, 0]], np.float32)
+    np.testing.assert_allclose(s.to_dense().numpy(), want)
+    coo = s.to_sparse_coo()
+    assert coo.is_sparse_coo()
+    np.testing.assert_allclose(coo.to_dense().numpy(), want)
+    back = coo.to_sparse_csr()
+    assert back.is_sparse_csr()
+
+
+def test_matmul_sparse_dense():
+    s = _coo()
+    d = paddle.to_tensor(np.eye(3, dtype=np.float32) * 2)
+    out = sparse.matmul(s, d)
+    np.testing.assert_allclose(out.numpy(), s.numpy() @ (np.eye(3) * 2))
+
+
+def test_elementwise_and_unary():
+    s = _coo()
+    two = sparse.multiply(s, 2.0)
+    np.testing.assert_allclose(two.to_dense().numpy(), s.numpy() * 2)
+    ss = sparse.add(s, s)
+    np.testing.assert_allclose(ss.to_dense().numpy(), s.numpy() * 2)
+    z = sparse.subtract(s, s)
+    np.testing.assert_allclose(z.to_dense().numpy(), np.zeros((3, 3)))
+    r = sparse.relu(sparse.neg(s))
+    np.testing.assert_allclose(r.to_dense().numpy(), np.zeros((3, 3)))
+    np.testing.assert_allclose(
+        sparse.pow(s, 2).to_dense().numpy(), s.numpy() ** 2)
+
+
+def test_transpose_sum_cast():
+    s = _coo()
+    t = sparse.transpose(s, [1, 0])
+    np.testing.assert_allclose(t.to_dense().numpy(), s.numpy().T)
+    np.testing.assert_allclose(np.asarray(sparse.sum(s).numpy()), 10.0)
+    c = sparse.cast(s, value_dtype="float64")
+    assert "float64" in str(c.values()._value.dtype)
+
+
+def test_masked_matmul():
+    rng = np.random.default_rng(0)
+    x = paddle.to_tensor(rng.normal(size=(3, 4)).astype(np.float32))
+    y = paddle.to_tensor(rng.normal(size=(4, 3)).astype(np.float32))
+    mask = _coo()
+    out = sparse.masked_matmul(x, y, mask)
+    dense = x.numpy() @ y.numpy()
+    got = out.to_dense().numpy()
+    for r, c in zip(*np.nonzero(mask.numpy())):
+        np.testing.assert_allclose(got[r, c], dense[r, c], rtol=1e-5)
+    assert got[0, 1] == 0.0  # masked-out position stays empty
+
+
+def test_sparse_nn_relu():
+    s = sparse.neg(_coo())
+    out = sparse.nn.ReLU()(s)
+    assert out.nnz() == 4  # structure kept, values clamped
+    np.testing.assert_allclose(out.to_dense().numpy(), np.zeros((3, 3)))

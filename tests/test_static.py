@@ -122,3 +122,81 @@ def test_save_load_inference_model(tmp_path):
     meta, feeds, fetches, params = static.load_inference_model(prefix, exe)
     assert feeds == ["x"]
     assert len(params) >= 1
+
+
+def test_lr_scheduler_takes_effect_in_compiled_step():
+    """LRScheduler.step() between exe.run calls must change the update
+    (lr rides as an executable argument, not a baked constant)."""
+    from paddle_tpu.optimizer import lr as lr_mod
+    paddle.enable_static()
+    try:
+        main, startup = static.Program(), static.Program()
+        with static.program_guard(main, startup):
+            x = static.data("x", [4, 8], "float32")
+            y = static.data("y", [4, 1], "float32")
+            net = nn.Linear(8, 1)
+            loss = paddle.nn.functional.mse_loss(net(x), y)
+            sched = lr_mod.StepDecay(learning_rate=1.0, step_size=1,
+                                     gamma=0.0)  # 1.0 then 0.0
+            opt = optimizer.SGD(learning_rate=sched,
+                                parameters=net.parameters())
+            opt.minimize(loss)
+        exe = static.Executor()
+        rng = np.random.default_rng(0)
+        feed = {"x": rng.normal(size=(4, 8)).astype(np.float32),
+                "y": rng.normal(size=(4, 1)).astype(np.float32)}
+        w0 = net.weight.numpy().copy()
+        exe.run(main, feed=feed, fetch_list=[loss])
+        w1 = net.weight.numpy().copy()
+        assert not np.allclose(w0, w1)
+        sched.step()  # lr -> 0.0: the compiled step must see it
+        exe.run(main, feed=feed, fetch_list=[loss])
+        w2 = net.weight.numpy().copy()
+        np.testing.assert_allclose(w1, w2)
+    finally:
+        paddle.disable_static()
+
+
+def test_adam_bias_correction_evolves_in_compiled_step():
+    """The Adam step index must be a traced executable argument: static
+    training matches an eager AdamW run step-for-step (a baked step
+    would freeze bias correction at 1-beta and amplify every update)."""
+    def build(seed):
+        paddle.seed(seed)
+        return nn.Linear(6, 3)
+
+    rng = np.random.default_rng(3)
+    xs = rng.normal(size=(5, 4, 6)).astype(np.float32)
+    ys = rng.normal(size=(5, 4, 3)).astype(np.float32)
+
+    # eager reference
+    m_e = build(11)
+    opt_e = optimizer.AdamW(learning_rate=0.01,
+                            parameters=m_e.parameters())
+    for i in range(5):
+        loss = paddle.nn.functional.mse_loss(
+            m_e(paddle.to_tensor(xs[i])), paddle.to_tensor(ys[i]))
+        loss.backward()
+        opt_e.step()
+        opt_e.clear_grad()
+
+    # static engine
+    paddle.enable_static()
+    try:
+        main, startup = static.Program(), static.Program()
+        with static.program_guard(main, startup):
+            x = static.data("x", [4, 6], "float32")
+            y = static.data("y", [4, 3], "float32")
+            m_s = build(11)
+            loss = paddle.nn.functional.mse_loss(m_s(x), y)
+            opt_s = optimizer.AdamW(learning_rate=0.01,
+                                    parameters=m_s.parameters())
+            opt_s.minimize(loss)
+        exe = static.Executor()
+        for i in range(5):
+            exe.run(main, feed={"x": xs[i], "y": ys[i]},
+                    fetch_list=[loss])
+    finally:
+        paddle.disable_static()
+    np.testing.assert_allclose(m_s.weight.numpy(), m_e.weight.numpy(),
+                               rtol=1e-4, atol=1e-5)

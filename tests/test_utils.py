@@ -1,0 +1,42 @@
+"""paddle.utils / version / sysconfig."""
+import warnings
+
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import utils, version, sysconfig
+from paddle_tpu.utils import unique_name
+
+
+def test_unique_name_generate_and_guard():
+    a, b = unique_name.generate("fc"), unique_name.generate("fc")
+    assert a != b and a.startswith("fc_")
+    with unique_name.guard():
+        c = unique_name.generate("fc")
+        assert c == "fc_0"
+    d = unique_name.generate("fc")
+    assert d not in (a, b, c)
+
+
+def test_deprecated_warns_and_try_import():
+    @utils.deprecated(update_to="paddle.new_api", since="2.0")
+    def old():
+        return 42
+
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        assert old() == 42
+    assert any("deprecated" in str(x.message) for x in w)
+    import math
+    assert utils.try_import("math") is math
+    with pytest.raises(ImportError):
+        utils.try_import("definitely_not_a_module_xyz")
+
+
+def test_run_check_and_version(capsys):
+    assert utils.run_check()
+    assert "successfully" in capsys.readouterr().out
+    assert version.cuda() is None
+    assert "jax" in version.xla()
+    assert sysconfig.get_include()
+    assert sysconfig.get_lib().endswith("_native")
