@@ -44,14 +44,27 @@ _NEG_INF = -1e30
 
 
 def ring_attention_local(q, k, v, *, axis="sep", axis_size, causal=False,
-                         scale=None):
+                         scale=None, use_pallas=None):
     """Exact blockwise attention; call inside shard_map.
 
     q/k/v: local shards [B, S_local, H, D] (Paddle layout).  Returns the
     local output shard [B, S_local, H, D].
+
+    On TPU (Pallas gate open) each resident KV block runs through the
+    Mosaic flash kernels with an exact ring backward
+    (ops/ring_flash_attention.py); this jnp blockwise path is the
+    fallback and the numerics oracle.
     """
     if scale is None:
         scale = 1.0 / (q.shape[-1] ** 0.5)
+    if use_pallas is None:
+        from ....ops.pallas_gate import pallas_enabled
+        use_pallas = pallas_enabled("flash_attention")
+    if use_pallas:
+        from ....ops.ring_flash_attention import ring_flash_attention_local
+        return ring_flash_attention_local(
+            q, k, v, axis=axis, axis_size=axis_size, causal=causal,
+            scale=scale)
     me = jax.lax.axis_index(axis)
     B, S_loc, H, D = q.shape
     qs = jnp.swapaxes(q, 1, 2).astype(jnp.float32)      # B H Sq D

@@ -136,6 +136,32 @@ class LogNormal(Normal):
 
     rsample = sample
 
+    # the inherited Normal statistics describe ln X, not X — override
+    @property
+    def mean(self):
+        return _wrap(jnp.broadcast_to(
+            jnp.exp(self.loc + jnp.square(self.scale) / 2),
+            self.batch_shape))
+
+    @property
+    def variance(self):
+        s2 = jnp.square(self.scale)
+        return _wrap(jnp.broadcast_to(
+            (jnp.exp(s2) - 1) * jnp.exp(2 * self.loc + s2),
+            self.batch_shape))
+
+    def entropy(self):
+        return _wrap(jnp.broadcast_to(
+            self.loc + 0.5 + 0.5 * math.log(2 * math.pi)
+            + jnp.log(self.scale), self.batch_shape))
+
+    def cdf(self, value):
+        return dispatch(
+            "lognormal_cdf",
+            lambda v, loc, scale: 0.5 * (1 + jax.lax.erf(
+                (jnp.log(v) - loc) / (scale * math.sqrt(2)))),
+            (value, self.loc_t, self.scale_t))
+
     def log_prob(self, value):
         def impl(v, loc, scale):
             lv = jnp.log(v)
@@ -426,9 +452,17 @@ def register_kl(p_cls, q_cls):
 
 
 def kl_divergence(p, q):
+    # most-specific registration wins (a subclass pair beats its base
+    # pair regardless of registration order), like the reference
+    best, best_depth = None, -1
     for (pc, qc), fn in _KL_REGISTRY.items():
         if isinstance(p, pc) and isinstance(q, qc):
-            return fn(p, q)
+            depth = (len(type(p).__mro__) - type(p).__mro__.index(pc)) \
+                + (len(type(q).__mro__) - type(q).__mro__.index(qc))
+            if depth > best_depth:
+                best, best_depth = fn, depth
+    if best is not None:
+        return best(p, q)
     raise NotImplementedError(
         f"no KL registered for ({type(p).__name__}, {type(q).__name__})")
 

@@ -18,16 +18,16 @@ __all__ = ["fft", "ifft", "fft2", "ifft2", "fftn", "ifftn",
            "ifftshift"]
 
 
-def _mk(name, fn, has_n=True):
+def _mk(op_name, fn, has_n=True):
     if has_n:
-        def op(x, n=None, axis=-1, norm="backward", name_=None):
-            return dispatch(f"fft_{name}", fn, (x,),
+        def op(x, n=None, axis=-1, norm="backward", name=None):
+            return dispatch(f"fft_{op_name}", fn, (x,),
                             dict(n=n, axis=axis, norm=norm))
     else:
-        def op(x, s=None, axes=None, norm="backward", name_=None):
-            return dispatch(f"fft_{name}", fn, (x,),
+        def op(x, s=None, axes=None, norm="backward", name=None):
+            return dispatch(f"fft_{op_name}", fn, (x,),
                             dict(s=s, axes=axes, norm=norm))
-    op.__name__ = name
+    op.__name__ = op_name
     return op
 
 

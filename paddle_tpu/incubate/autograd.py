@@ -74,31 +74,40 @@ def jvp(func, xs, v=None):
 
 
 class Jacobian:
-    """Lazy dense Jacobian: J[:] materializes, J[i, j] slices."""
+    """Lazy dense Jacobian: J[:] materializes, J[i, j] slices.
+
+    Multi-input functions concatenate the per-input Jacobian blocks
+    along the flattened input axis (reference semantics: one matrix of
+    shape [num_outputs, total_num_inputs])."""
 
     def __init__(self, func, xs, is_batched=False):
         import jax
         single, vals = _vals(xs)
         jac = jax.jacrev(_pure(func, len(vals)),
                          argnums=tuple(range(len(vals))))(*vals)
+        self._single = single
         self._jac = jac[0] if single else jac
         self.is_batched = is_batched
 
-    def __getitem__(self, idx):
+    def _matrix(self):
         import numpy as np
-        arr = self._jac
-        if isinstance(arr, tuple):
-            arr = arr[0]
-        # flatten (out_shape, in_shape) → 2-D like the reference
-        out = np.asarray(arr)
-        flat = out.reshape(-1) if out.ndim <= 1 else out.reshape(
-            int(np.prod(out.shape[: out.ndim // 2])) or 1, -1)
-        return _wrap(flat[idx])
+        blocks = [self._jac] if self._single else list(self._jac)
+        if len(blocks) == 1:
+            return np.asarray(blocks[0])
+        # flatten each jacrev block (out_shape + in_shape_i) to
+        # [n_out, n_in_i] and concatenate along the input axis
+        mats = []
+        for a in blocks:
+            a = np.asarray(a)
+            n_out = a.shape[0] if a.ndim > 1 else 1
+            mats.append(a.reshape(n_out, -1))
+        return np.concatenate(mats, axis=-1)
+
+    def __getitem__(self, idx):
+        return _wrap(self._matrix()[idx])
 
     def numpy(self):
-        import numpy as np
-        arr = self._jac[0] if isinstance(self._jac, tuple) else self._jac
-        return np.asarray(arr)
+        return self._matrix()
 
 
 class Hessian:

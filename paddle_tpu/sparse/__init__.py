@@ -181,8 +181,9 @@ def add(x, y, name=None):
 
 
 def subtract(x, y, name=None):
-    neg_y = SparseCooTensor(jsparse.BCOO((-_coo(y).data, _coo(y).indices),
-                                         shape=_coo(y).shape))
+    b = _coo(y)
+    neg_y = SparseCooTensor(jsparse.BCOO((-b.data, b.indices),
+                                         shape=b.shape))
     return add(x, neg_y)
 
 
@@ -266,14 +267,16 @@ class _NN:
             self.axis = axis
 
         def __call__(self, x):
-            # softmax over the last dense axis of each row's nonzeros:
-            # densify (XLA-friendly), mask empty slots to -inf
+            # structure-based softmax: stored positions (including
+            # explicit zeros) participate, empty slots are -inf; ONE
+            # densification of an indicator carries the structure
             a = _coo(x)
             d = a.todense()
-            mask = a.todense() != 0
+            ind = jsparse.BCOO((jnp.ones_like(a.data), a.indices),
+                               shape=a.shape)
+            mask = ind.todense() > 0
             z = jnp.where(mask, d, -jnp.inf)
-            s = jax.nn.softmax(z, axis=self.axis)
-            s = jnp.where(mask, s, 0)
+            s = jnp.where(mask, jax.nn.softmax(z, axis=self.axis), 0)
             return SparseCooTensor(jsparse.bcoo_fromdense(s))
 
 

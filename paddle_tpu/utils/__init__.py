@@ -47,6 +47,17 @@ def try_import(module_name, err_msg=None):
 
 def require_version(min_version, max_version=None):
     from .. import __version__
+
+    def key(v):
+        return tuple(int(p) for p in str(v).split(".")[:3])
+
+    have = key(__version__)
+    if key(min_version) > have:
+        raise Exception(
+            f"paddle_tpu>={min_version} required, found {__version__}")
+    if max_version is not None and key(max_version) < have:
+        raise Exception(
+            f"paddle_tpu<={max_version} required, found {__version__}")
     return __version__
 
 

@@ -87,5 +87,47 @@ for tag, (rows, v) in [("bert", (768, 30522)), ("llama", (512, 32000))]:
             x, jnp.zeros((rows,), i32)).sum()),
         ((rows, v), f32))
 
+
+# ring flash attention: Mosaic kernels inside shard_map over the 2x2
+# topology's ring (the sep-axis long-context path)
+def _ring_check():
+    import functools
+    import numpy as np
+    from jax.sharding import Mesh, PartitionSpec as P
+    from paddle_tpu.ops.ring_flash_attention import (
+        ring_flash_attention_local)
+
+    mesh = Mesh(np.array(topo.devices).reshape(4), ("sep",))
+    spec = P(None, "sep", None, None)
+    fn = jax.shard_map(
+        functools.partial(ring_flash_attention_local, axis="sep",
+                          axis_size=4, causal=True, scale=0.125),
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        check_vma=False)
+    qa = jax.ShapeDtypeStruct(
+        (2, 512, 4, 64), bf16,
+        sharding=jax.sharding.NamedSharding(mesh, spec))
+
+    def compile_(name, f, n_args):
+        t = time.time()
+        try:
+            jax.jit(f).lower(*([qa] * n_args)).compile()
+        except Exception as e:
+            print(f"{name}: FAIL ({type(e).__name__}: {str(e)[:300]})",
+                  flush=True)
+            return False
+        print(f"{name}: OK ({time.time()-t:.1f}s)", flush=True)
+        return True
+
+    r = compile_("ring_flash fwd", fn, 3)
+    r &= compile_(
+        "ring_flash bwd",
+        jax.grad(lambda q, k, v: fn(q, k, v).astype(f32).sum(),
+                 argnums=(0, 1, 2)), 3)
+    return r
+
+
+ok &= _ring_check()
+
 print("ALL", "OK" if ok else "FAILED", flush=True)
 sys.exit(0 if ok else 1)

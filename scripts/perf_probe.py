@@ -1,0 +1,125 @@
+"""One-window TPU perf probe: run when the tunnel is healthy.
+
+Measures, in order (each independently sync'd, results printed as they
+arrive so a mid-run wedge still yields data):
+  1. raw bf16 matmul TF/s (MXU sanity),
+  2. BERT-base fwd-only / fwd+bwd+AdamW step time via the static
+     Executor at the bench config,
+  3. the same with Pallas kernels disabled (XLA composite path),
+  4. per-op-class timing from 3 repeated steps under jax.profiler
+     (trace written to /tmp/paddle_tpu_profile for offline reading).
+
+Usage: PYTHONPATH=/root/repo:/root/.axon_site python -u \
+           scripts/perf_probe.py > /tmp/perf_probe.log 2>&1
+"""
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def log(msg):
+    print(f"[probe] {msg}", flush=True)
+
+
+def sync(x):
+    import numpy as np
+    return np.asarray(x)
+
+
+def raw_matmul():
+    import jax
+    import jax.numpy as jnp
+    n = 4096
+
+    @jax.jit
+    def chain(a, b):
+        for _ in range(8):
+            a = (a @ b).astype(jnp.bfloat16)
+        return a.astype(jnp.float32).sum()
+
+    key = jax.random.PRNGKey(0)
+    a = (jax.random.normal(key, (n, n)) * 0.05).astype(jnp.bfloat16)
+    b = (jax.random.normal(key, (n, n)) * 0.05).astype(jnp.bfloat16)
+    sync(chain(a, b))  # compile
+    t = time.time()
+    iters = 5
+    for _ in range(iters):
+        s = chain(a, b)
+    sync(s)
+    dt = (time.time() - t) / iters
+    fl = 2 * n ** 3 * 8
+    log(f"raw bf16 matmul: {dt * 1e3:.2f} ms  {fl / dt / 1e12:.0f} TF/s "
+        f"(peak 197)")
+
+
+def bert_step(use_pallas=True, fwd_only=False, profile=False):
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu import optimizer, static
+    from paddle_tpu.models import BertConfig, BertForMaskedLM
+
+    paddle.set_flags({"FLAGS_use_pallas_kernels": use_pallas})
+    from paddle_tpu.ops.pallas_gate import reset_probe_cache
+    reset_probe_cache()
+
+    B, S = 32, 128
+    paddle.enable_static()
+    main = static.Program()
+    startup = static.Program()
+    with static.program_guard(main, startup):
+        ids = static.data("ids", [B, S], "int64")
+        labels = static.data("labels", [B, S], "int64")
+        model = BertForMaskedLM(BertConfig())
+        with paddle.amp.auto_cast(dtype="bfloat16", level="O1"):
+            loss, _ = model(ids, labels=labels)
+        if not fwd_only:
+            opt = optimizer.AdamW(learning_rate=1e-4,
+                                  parameters=model.parameters())
+            opt.minimize(loss)
+    exe = static.Executor()
+    rng = np.random.default_rng(0)
+    x = rng.integers(0, 30522, (B, S)).astype(np.int64)
+    feed = {"ids": x, "labels": x}
+    t = time.time()
+    exe.run(main, feed=feed, fetch_list=[loss])
+    log(f"  compile+first: {time.time() - t:.1f}s")
+    iters = 10
+    if profile:
+        import jax
+        jax.profiler.start_trace("/tmp/paddle_tpu_profile")
+    t = time.time()
+    for _ in range(iters):
+        (lv,) = exe.run(main, feed=feed, fetch_list=[loss])
+    dt = (time.time() - t) / iters
+    if profile:
+        import jax
+        jax.profiler.stop_trace()
+    toks = B * S / dt
+    kind = "fwd" if fwd_only else "train"
+    log(f"  bert {kind} (pallas={use_pallas}): {dt * 1e3:.1f} ms/step "
+        f"{toks:,.0f} tok/s")
+    paddle.disable_static()
+    return dt
+
+
+def main():
+    import jax
+    log(f"devices: {jax.devices()}")
+    raw_matmul()
+    log("bert fwd-only:")
+    bert_step(fwd_only=True)
+    log("bert train pallas=True:")
+    t_p = bert_step(use_pallas=True)
+    log("bert train pallas=False:")
+    t_x = bert_step(use_pallas=False)
+    log(f"pallas speedup: {t_x / t_p:.2f}x")
+    log("profiled 3 steps -> /tmp/paddle_tpu_profile")
+    bert_step(use_pallas=True, profile=True)
+    log("DONE")
+
+
+if __name__ == "__main__":
+    main()

@@ -102,3 +102,44 @@ def test_fft_roundtrip_and_grad():
     np.testing.assert_allclose(fft.fft2(x).numpy(),
                                np.fft.fft2(x.numpy()), rtol=2e-4,
                                atol=1e-3)
+
+
+def test_lognormal_statistics():
+    d = D.LogNormal(0.0, 0.5)
+    want_mean = np.exp(0.125)
+    np.testing.assert_allclose(float(np.asarray(d.mean.numpy())),
+                               want_mean, rtol=1e-5)
+    want_var = (np.exp(0.25) - 1) * np.exp(0.25)
+    np.testing.assert_allclose(float(np.asarray(d.variance.numpy())),
+                               want_var, rtol=1e-5)
+    s = d.sample((40000,)).numpy()
+    assert abs(s.mean() - want_mean) < 0.05
+    # cdf at the median exp(mu) = 0.5
+    np.testing.assert_allclose(
+        float(np.asarray(d.cdf(paddle.to_tensor(1.0)).numpy())), 0.5,
+        atol=1e-5)
+
+
+def test_kl_registry_most_specific_wins():
+    class MyNormal(D.Normal):
+        pass
+
+    @D.register_kl(MyNormal, MyNormal)
+    def _custom(p, q):
+        return "custom"
+
+    try:
+        assert D.kl_divergence(MyNormal(0.0, 1.0),
+                               MyNormal(0.0, 1.0)) == "custom"
+        # base pair still uses the generic formula
+        out = D.kl_divergence(D.Normal(0.0, 1.0), D.Normal(0.0, 1.0))
+        np.testing.assert_allclose(float(np.asarray(out.numpy())), 0.0,
+                                   atol=1e-6)
+    finally:
+        D._KL_REGISTRY.pop((MyNormal, MyNormal), None)
+
+
+def test_fft_name_kwarg():
+    x = paddle.to_tensor(np.ones(8, np.float32))
+    fft.fft(x, name="n")  # reference signature accepts name=
+    fft.fftn(x, name="n")

@@ -23,3 +23,21 @@ def test_jacobian_hessian():
     np.testing.assert_allclose(H.numpy(), np.diag([6.0, 12.0]))
     np.testing.assert_allclose(
         A.forward_grad(lambda x: x * 2, x).numpy(), [2.0, 2.0])
+
+
+def test_jacobian_multi_input_blocks():
+    x = paddle.to_tensor([1.0, 2.0])
+    y = paddle.to_tensor([3.0])
+    J = A.Jacobian(lambda x, y: x * y, [x, y])
+    m = J.numpy()
+    assert m.shape == (2, 3)  # d/dx block (2x2) + d/dy block (2x1)
+    np.testing.assert_allclose(m[:, :2], np.diag([3.0, 3.0]))
+    np.testing.assert_allclose(m[:, 2], [1.0, 2.0])
+
+
+def test_require_version():
+    import pytest
+    from paddle_tpu import utils
+    utils.require_version("0.0.1")
+    with pytest.raises(Exception, match="required"):
+        utils.require_version("99.0.0")
