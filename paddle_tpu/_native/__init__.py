@@ -18,11 +18,15 @@ import threading
 
 import numpy as np
 
-__all__ = ["available", "fast_stack", "gather_rows"]
+__all__ = ["available", "fast_stack", "gather_rows",
+           "tcp_store_available", "start_tcp_store_server",
+           "stop_tcp_store_server"]
 
 _lib = None
 _tried = False
 _lock = threading.Lock()
+_store_lib = None
+_store_tried = False
 
 
 def _build_and_load():
@@ -93,6 +97,74 @@ def fast_stack(arrays):
     lib.pt_stack_copy(ptrs, n, nbytes,
                       out.ctypes.data_as(ctypes.c_char_p))
     return out
+
+
+def _build_store():
+    """Build + load the C++ TCPStore server (tcp_store.cc)."""
+    src = os.path.join(os.path.dirname(__file__), "tcp_store.cc")
+    cache = os.path.join(
+        os.path.expanduser(os.environ.get("PADDLE_TPU_CACHE",
+                                          "~/.cache/paddle_tpu")),
+        "native")
+    os.makedirs(cache, exist_ok=True)
+    so = os.path.join(cache, "libpttcpstore.so")
+    if not os.path.exists(so) or (os.path.getmtime(so)
+                                  < os.path.getmtime(src)):
+        tmp = f"{so}.{os.getpid()}.tmp"
+        for cxx in ("c++", "g++", "clang++"):
+            try:
+                subprocess.run(
+                    [cxx, "-O2", "-std=c++17", "-shared", "-fPIC",
+                     "-pthread", "-o", tmp, src],
+                    check=True, capture_output=True, timeout=180)
+                os.replace(tmp, so)
+                break
+            except (OSError, subprocess.SubprocessError):
+                continue
+        else:
+            return None
+    lib = ctypes.CDLL(so)
+    lib.pt_store_server_start.restype = ctypes.c_void_p
+    lib.pt_store_server_start.argtypes = [
+        ctypes.c_int, ctypes.POINTER(ctypes.c_int)]
+    lib.pt_store_server_stop.argtypes = [ctypes.c_void_p]
+    return lib
+
+
+def _get_store_lib():
+    global _store_lib, _store_tried
+    if not _store_tried:
+        with _lock:
+            if not _store_tried:
+                try:
+                    _store_lib = _build_store()
+                except Exception:
+                    _store_lib = None
+                _store_tried = True
+    return _store_lib
+
+
+def tcp_store_available() -> bool:
+    return _get_store_lib() is not None
+
+
+def start_tcp_store_server(port=0):
+    """Start the native TCPStore server; returns (handle, port)."""
+    lib = _get_store_lib()
+    if lib is None:
+        raise RuntimeError("native TCPStore unavailable (no C++ "
+                           "compiler); use the python fallback store")
+    out_port = ctypes.c_int(0)
+    h = lib.pt_store_server_start(int(port), ctypes.byref(out_port))
+    if not h:
+        raise RuntimeError(f"TCPStore: could not bind port {port}")
+    return h, int(out_port.value)
+
+
+def stop_tcp_store_server(handle):
+    lib = _get_store_lib()
+    if lib is not None and handle:
+        lib.pt_store_server_stop(ctypes.c_void_p(handle))
 
 
 def gather_rows(src, indices):

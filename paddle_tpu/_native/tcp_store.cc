@@ -1,0 +1,255 @@
+// TCPStore: rendezvous key-value store (C++17, POSIX sockets).
+//
+// Role of the reference's fluid/distributed/store/tcp_store.cc
+// (SURVEY.md §2.1 "Comm runtime": TCPStore KV barrier used by
+// init_parallel_env rendezvous) [UNVERIFIED - empty reference mount].
+//
+// Design: thread-per-connection server over a mutex-protected map with
+// a condition variable for blocking GET/WAIT (the reference parks
+// waiting ranks the same way).  Wire format: 1-byte command,
+// 4-byte LE key length + key, 8-byte LE value length + value.
+// Commands: S=set, G=get(blocking), Q=query(non-blocking), A=add
+// (atomic int64 counter, returns new value), W=wait(blocking until key
+// exists), D=delete, N=num_keys, X=shutdown.
+//
+// Exposed as a C ABI (pt_store_*) loaded via ctypes by
+// paddle_tpu/distributed/store.py; the server can also run in-process
+// for the master rank (pt_store_server_start).
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <chrono>
+#include <condition_variable>
+#include <cstdint>
+#include <cstring>
+#include <map>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace {
+
+struct Store {
+  std::mutex mu;
+  std::condition_variable cv;
+  std::map<std::string, std::string> data;
+  std::atomic<bool> stop{false};
+  int listen_fd = -1;
+  std::thread accept_thread;
+  std::vector<std::thread> workers;
+  // live connection fds: stop() must shutdown() each so workers parked
+  // in recv() unblock and join
+  std::mutex conn_mu;
+  std::vector<int> conn_fds;
+};
+
+bool read_n(int fd, void* buf, size_t n) {
+  char* p = static_cast<char*>(buf);
+  while (n > 0) {
+    ssize_t r = ::recv(fd, p, n, 0);
+    if (r <= 0) return false;
+    p += r;
+    n -= static_cast<size_t>(r);
+  }
+  return true;
+}
+
+bool write_n(int fd, const void* buf, size_t n) {
+  const char* p = static_cast<const char*>(buf);
+  while (n > 0) {
+    ssize_t r = ::send(fd, p, n, MSG_NOSIGNAL);
+    if (r <= 0) return false;
+    p += r;
+    n -= static_cast<size_t>(r);
+  }
+  return true;
+}
+
+bool read_key(int fd, std::string* key) {
+  uint32_t klen;
+  if (!read_n(fd, &klen, 4) || klen > (1u << 20)) return false;
+  key->resize(klen);
+  return klen == 0 || read_n(fd, key->data(), klen);
+}
+
+bool write_value(int fd, const std::string& v) {
+  uint64_t vlen = v.size();
+  if (!write_n(fd, &vlen, 8)) return false;
+  return v.empty() || write_n(fd, v.data(), v.size());
+}
+
+void serve_conn(Store* st, int fd) {
+  int one = 1;
+  ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+  {
+    std::lock_guard<std::mutex> lk(st->conn_mu);
+    st->conn_fds.push_back(fd);
+  }
+  for (;;) {
+    char cmd;
+    if (!read_n(fd, &cmd, 1)) break;
+    if (cmd == 'X') {
+      st->stop.store(true);
+      st->cv.notify_all();
+      // wake the accept loop by connecting once? close listen fd below.
+      ::shutdown(st->listen_fd, SHUT_RDWR);
+      break;
+    }
+    std::string key;
+    if (cmd != 'N' && !read_key(fd, &key)) break;
+    if (cmd == 'S') {
+      uint64_t vlen;
+      if (!read_n(fd, &vlen, 8) || vlen > (1ull << 32)) break;
+      std::string val(vlen, '\0');
+      if (vlen && !read_n(fd, val.data(), vlen)) break;
+      {
+        std::lock_guard<std::mutex> lk(st->mu);
+        st->data[key] = std::move(val);
+      }
+      st->cv.notify_all();
+      char ok = 1;
+      if (!write_n(fd, &ok, 1)) break;
+    } else if (cmd == 'G' || cmd == 'W') {
+      std::unique_lock<std::mutex> lk(st->mu);
+      st->cv.wait(lk, [&] {
+        return st->stop.load() || st->data.count(key) != 0;
+      });
+      if (st->stop.load()) break;
+      std::string v = (cmd == 'G') ? st->data[key] : std::string();
+      lk.unlock();
+      if (cmd == 'W') {
+        char ok = 1;
+        if (!write_n(fd, &ok, 1)) break;
+      } else if (!write_value(fd, v)) {
+        break;
+      }
+    } else if (cmd == 'Q') {
+      std::unique_lock<std::mutex> lk(st->mu);
+      bool has = st->data.count(key) != 0;
+      std::string v = has ? st->data[key] : std::string();
+      lk.unlock();
+      char flag = has ? 1 : 0;
+      if (!write_n(fd, &flag, 1)) break;
+      if (has && !write_value(fd, v)) break;
+    } else if (cmd == 'A') {
+      int64_t amount;
+      if (!read_n(fd, &amount, 8)) break;
+      int64_t now;
+      {
+        std::lock_guard<std::mutex> lk(st->mu);
+        int64_t cur = 0;
+        auto it = st->data.find(key);
+        if (it != st->data.end() && it->second.size() == 8) {
+          std::memcpy(&cur, it->second.data(), 8);
+        }
+        now = cur + amount;
+        std::string v(8, '\0');
+        std::memcpy(v.data(), &now, 8);
+        st->data[key] = std::move(v);
+      }
+      st->cv.notify_all();
+      if (!write_n(fd, &now, 8)) break;
+    } else if (cmd == 'D') {
+      {
+        std::lock_guard<std::mutex> lk(st->mu);
+        st->data.erase(key);
+      }
+      char ok = 1;
+      if (!write_n(fd, &ok, 1)) break;
+    } else if (cmd == 'N') {
+      int64_t n;
+      {
+        std::lock_guard<std::mutex> lk(st->mu);
+        n = static_cast<int64_t>(st->data.size());
+      }
+      if (!write_n(fd, &n, 8)) break;
+    } else {
+      break;  // unknown command
+    }
+  }
+  {
+    std::lock_guard<std::mutex> lk(st->conn_mu);
+    for (auto it = st->conn_fds.begin(); it != st->conn_fds.end(); ++it) {
+      if (*it == fd) {
+        st->conn_fds.erase(it);
+        break;
+      }
+    }
+  }
+  ::close(fd);
+}
+
+void accept_loop(Store* st) {
+  for (;;) {
+    int fd = ::accept(st->listen_fd, nullptr, nullptr);
+    if (fd < 0) {
+      if (st->stop.load()) break;
+      continue;
+    }
+    if (st->stop.load()) {
+      ::close(fd);
+      break;
+    }
+    st->workers.emplace_back(serve_conn, st, fd);
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+// Returns an opaque handle, or null on failure.  port==0 picks a free
+// port; *out_port receives the bound port.
+void* pt_store_server_start(int port, int* out_port) {
+  auto* st = new Store();
+  st->listen_fd = ::socket(AF_INET, SOCK_STREAM, 0);
+  if (st->listen_fd < 0) {
+    delete st;
+    return nullptr;
+  }
+  int one = 1;
+  ::setsockopt(st->listen_fd, SOL_SOCKET, SO_REUSEADDR, &one,
+               sizeof(one));
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_addr.s_addr = htonl(INADDR_ANY);
+  addr.sin_port = htons(static_cast<uint16_t>(port));
+  if (::bind(st->listen_fd, reinterpret_cast<sockaddr*>(&addr),
+             sizeof(addr)) != 0 ||
+      ::listen(st->listen_fd, 128) != 0) {
+    ::close(st->listen_fd);
+    delete st;
+    return nullptr;
+  }
+  socklen_t len = sizeof(addr);
+  ::getsockname(st->listen_fd, reinterpret_cast<sockaddr*>(&addr), &len);
+  if (out_port) *out_port = ntohs(addr.sin_port);
+  st->accept_thread = std::thread(accept_loop, st);
+  return st;
+}
+
+void pt_store_server_stop(void* handle) {
+  auto* st = static_cast<Store*>(handle);
+  if (!st) return;
+  st->stop.store(true);
+  st->cv.notify_all();
+  ::shutdown(st->listen_fd, SHUT_RDWR);
+  ::close(st->listen_fd);
+  {
+    // unblock workers parked in recv() on live client connections
+    std::lock_guard<std::mutex> lk(st->conn_mu);
+    for (int fd : st->conn_fds) ::shutdown(fd, SHUT_RDWR);
+  }
+  if (st->accept_thread.joinable()) st->accept_thread.join();
+  for (auto& t : st->workers) {
+    if (t.joinable()) t.join();
+  }
+  delete st;
+}
+
+}  // extern "C"

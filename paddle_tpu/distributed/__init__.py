@@ -29,6 +29,7 @@ from .auto_parallel.api import (shard_tensor, shard_op, ProcessMesh, Shard,
                                 reshard, shard_layer)
 from . import checkpoint
 from .checkpoint.save_load import save_state_dict, load_state_dict
+from .store import TCPStore
 from . import utils
 
 spawn = None  # set by launch module

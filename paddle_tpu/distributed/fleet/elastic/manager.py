@@ -27,23 +27,41 @@ __all__ = ["ElasticStore", "ElasticManager"]
 
 
 class ElasticStore:
-    """Tiny KV for heartbeats: coordination-service-backed when
-    jax.distributed is initialized, directory-backed otherwise."""
+    """Tiny KV for heartbeats, by preference order:
+    1. an explicit TCPStore (`PADDLE_ELASTIC_STORE=host:port` → the
+       native C++ rendezvous server, distributed/store.py) — the
+       closest analog of the reference's etcd registry;
+    2. the jax.distributed coordination service when initialized;
+    3. a shared directory (single-host fallback)."""
 
     def __init__(self, path=None):
         self._client = None
-        try:
-            from jax._src import distributed as _dist
-            if _dist.global_state.client is not None:
-                self._client = _dist.global_state.client
-        except Exception:
-            pass
+        self._tcp = None
+        ep = os.environ.get("PADDLE_ELASTIC_STORE")
+        if ep and ":" in ep:
+            try:
+                from ...store import TCPStore
+                host, port = ep.rsplit(":", 1)
+                self._tcp = TCPStore(host, int(port), is_master=False,
+                                     timeout=10)
+            except Exception:
+                self._tcp = None
+        if self._tcp is None:
+            try:
+                from jax._src import distributed as _dist
+                if _dist.global_state.client is not None:
+                    self._client = _dist.global_state.client
+            except Exception:
+                pass
         self._dir = path or os.environ.get(
             "PADDLE_ELASTIC_DIR", "/tmp/paddle_tpu_elastic")
-        if self._client is None:
+        if self._client is None and self._tcp is None:
             os.makedirs(self._dir, exist_ok=True)
 
     def set(self, key, value: str):
+        if self._tcp is not None:
+            self._tcp.set(f"elastic/{key}", value.encode())
+            return
         if self._client is not None:
             self._client.key_value_set(f"elastic/{key}", value)
             return
@@ -55,6 +73,9 @@ class ElasticStore:
         os.replace(tmp, p)
 
     def get(self, key, default=None):
+        if self._tcp is not None:
+            v = self._tcp.query(f"elastic/{key}")
+            return default if v is None else v.decode()
         if self._client is not None:
             try:
                 return self._client.blocking_key_value_get(

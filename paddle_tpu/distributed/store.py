@@ -1,0 +1,275 @@
+"""TCPStore: the rendezvous key-value store.
+
+Reference parity: `fluid/distributed/store/tcp_store.cc` +
+`paddle.distributed.TCPStore` (master rank hosts the store; every rank
+connects for set/get/add/wait/barrier during init_parallel_env
+[UNVERIFIED — empty reference mount; SURVEY.md §2.1 "Comm runtime"]).
+
+TPU-native split: ICI/DCN collectives never touch this store (XLA owns
+them); what remains is host-side rendezvous — and that part is the
+reference's design unchanged.  The SERVER is native C++
+(`_native/tcp_store.cc`: thread-per-connection over a cv-guarded map,
+blocking GET/WAIT park the caller server-side), built on first use; a
+pure-python server is the fallback when no C++ toolchain exists.  The
+client speaks the length-prefixed wire protocol over one socket.
+"""
+from __future__ import annotations
+
+import os
+import socket
+import struct
+import threading
+import time
+
+__all__ = ["TCPStore"]
+
+
+class _PyStoreServer:
+    """Python fallback server implementing the same wire protocol."""
+
+    def __init__(self, port=0):
+        self._data = {}
+        self._cv = threading.Condition()
+        self._stop = False
+        self._srv = socket.create_server(("0.0.0.0", port))
+        self.port = self._srv.getsockname()[1]
+        self._threads = []
+        t = threading.Thread(target=self._accept, daemon=True)
+        t.start()
+        self._threads.append(t)
+
+    def _accept(self):
+        while not self._stop:
+            try:
+                conn, _ = self._srv.accept()
+            except OSError:
+                break
+            t = threading.Thread(target=self._serve, args=(conn,),
+                                 daemon=True)
+            t.start()
+            self._threads.append(t)
+
+    def _read_n(self, conn, n):
+        buf = b""
+        while len(buf) < n:
+            chunk = conn.recv(n - len(buf))
+            if not chunk:
+                raise ConnectionError
+            buf += chunk
+        return buf
+
+    def _serve(self, conn):
+        conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        try:
+            while True:
+                cmd = self._read_n(conn, 1)
+                if cmd == b"X":
+                    self.stop()
+                    return
+                if cmd == b"N":
+                    with self._cv:
+                        n = len(self._data)
+                    conn.sendall(struct.pack("<q", n))
+                    continue
+                (klen,) = struct.unpack("<I", self._read_n(conn, 4))
+                key = self._read_n(conn, klen).decode()
+                if cmd == b"S":
+                    (vlen,) = struct.unpack("<Q", self._read_n(conn, 8))
+                    val = self._read_n(conn, vlen) if vlen else b""
+                    with self._cv:
+                        self._data[key] = val
+                        self._cv.notify_all()
+                    conn.sendall(b"\x01")
+                elif cmd in (b"G", b"W"):
+                    with self._cv:
+                        while key not in self._data and not self._stop:
+                            self._cv.wait(0.1)
+                        val = self._data.get(key, b"")
+                    if cmd == b"W":
+                        conn.sendall(b"\x01")
+                    else:
+                        conn.sendall(struct.pack("<Q", len(val)) + val)
+                elif cmd == b"Q":
+                    with self._cv:
+                        has = key in self._data
+                        val = self._data.get(key, b"")
+                    conn.sendall(b"\x01" if has else b"\x00")
+                    if has:
+                        conn.sendall(struct.pack("<Q", len(val)) + val)
+                elif cmd == b"A":
+                    (amt,) = struct.unpack("<q", self._read_n(conn, 8))
+                    with self._cv:
+                        cur = struct.unpack(
+                            "<q", self._data.get(
+                                key, b"\0" * 8))[0] if len(
+                            self._data.get(key, b"\0" * 8)) == 8 else 0
+                        now = cur + amt
+                        self._data[key] = struct.pack("<q", now)
+                        self._cv.notify_all()
+                    conn.sendall(struct.pack("<q", now))
+                elif cmd == b"D":
+                    with self._cv:
+                        self._data.pop(key, None)
+                    conn.sendall(b"\x01")
+                else:
+                    return
+        except (ConnectionError, OSError):
+            pass
+        finally:
+            conn.close()
+
+    def stop(self):
+        self._stop = True
+        try:
+            self._srv.close()
+        except OSError:
+            pass
+        with self._cv:
+            self._cv.notify_all()
+
+
+class TCPStore:
+    """paddle.distributed.TCPStore-compatible client (+ server on the
+    master rank).
+
+    TCPStore(host, port, is_master=False, world_size=1, timeout=...)
+    with set/get/add/wait/delete_key/num_keys/barrier.
+    """
+
+    def __init__(self, host="127.0.0.1", port=0, is_master=False,
+                 world_size=1, timeout=300, **kwargs):
+        self._host = host
+        self._world_size = world_size
+        self._timeout = timeout
+        self._server = None
+        self._native_handle = None
+        if is_master:
+            from .._native import (tcp_store_available,
+                                   start_tcp_store_server)
+            if tcp_store_available():
+                self._native_handle, port = \
+                    start_tcp_store_server(port)
+            else:
+                self._server = _PyStoreServer(port)
+                port = self._server.port
+        self.port = port
+        self._sock = None
+        self._lock = threading.Lock()
+        self._connect()
+
+    # -- wire ------------------------------------------------------------
+    def _connect(self):
+        deadline = time.time() + self._timeout
+        last = None
+        while time.time() < deadline:
+            try:
+                self._sock = socket.create_connection(
+                    (self._host, self.port), timeout=self._timeout)
+                self._sock.setsockopt(socket.IPPROTO_TCP,
+                                      socket.TCP_NODELAY, 1)
+                return
+            except OSError as e:
+                last = e
+                time.sleep(0.05)
+        raise TimeoutError(
+            f"TCPStore: cannot reach {self._host}:{self.port} ({last})")
+
+    def _read_n(self, n):
+        buf = b""
+        while len(buf) < n:
+            chunk = self._sock.recv(n - len(buf))
+            if not chunk:
+                raise ConnectionError("TCPStore server closed")
+            buf += chunk
+        return buf
+
+    def _req(self, cmd, key=None, payload=b""):
+        msg = cmd
+        if key is not None:
+            kb = key.encode()
+            msg += struct.pack("<I", len(kb)) + kb
+        msg += payload
+        self._sock.sendall(msg)
+
+    # -- API -------------------------------------------------------------
+    def set(self, key, value):
+        if isinstance(value, str):
+            value = value.encode()
+        with self._lock:
+            self._req(b"S", key,
+                      struct.pack("<Q", len(value)) + bytes(value))
+            self._read_n(1)
+
+    def get(self, key):
+        """Blocking get (waits until the key exists)."""
+        with self._lock:
+            self._req(b"G", key)
+            (vlen,) = struct.unpack("<Q", self._read_n(8))
+            return self._read_n(vlen) if vlen else b""
+
+    def query(self, key):
+        """Non-blocking get: returns None when absent."""
+        with self._lock:
+            self._req(b"Q", key)
+            has = self._read_n(1) == b"\x01"
+            if not has:
+                return None
+            (vlen,) = struct.unpack("<Q", self._read_n(8))
+            return self._read_n(vlen) if vlen else b""
+
+    def add(self, key, amount=1):
+        with self._lock:
+            self._req(b"A", key, struct.pack("<q", int(amount)))
+            (now,) = struct.unpack("<q", self._read_n(8))
+            return now
+
+    def wait(self, keys):
+        if isinstance(keys, str):
+            keys = [keys]
+        for k in keys:
+            with self._lock:
+                self._req(b"W", k)
+                self._read_n(1)
+
+    def delete_key(self, key):
+        with self._lock:
+            self._req(b"D", key)
+            self._read_n(1)
+        return True
+
+    def num_keys(self):
+        with self._lock:
+            self._req(b"N")
+            (n,) = struct.unpack("<q", self._read_n(8))
+            return n
+
+    def barrier(self, tag="barrier"):
+        """All world_size ranks block until everyone arrived."""
+        n = self.add(f"__{tag}__", 1)
+        round_ = (n - 1) // self._world_size
+        target = (round_ + 1) * self._world_size
+        deadline = time.time() + self._timeout
+        while self.add(f"__{tag}__", 0) < target:
+            if time.time() > deadline:
+                raise TimeoutError(f"TCPStore barrier {tag!r} timed out")
+            time.sleep(0.002)
+
+    def close(self):
+        try:
+            if self._sock is not None:
+                self._sock.close()
+        except OSError:
+            pass
+        if self._native_handle is not None:
+            from .._native import stop_tcp_store_server
+            stop_tcp_store_server(self._native_handle)
+            self._native_handle = None
+        if self._server is not None:
+            self._server.stop()
+            self._server = None
+
+    def __del__(self):  # pragma: no cover - best effort
+        try:
+            self.close()
+        except Exception:
+            pass

@@ -82,26 +82,30 @@ class Jacobian:
 
     def __init__(self, func, xs, is_batched=False):
         import jax
+        import numpy as np
         single, vals = _vals(xs)
         jac = jax.jacrev(_pure(func, len(vals)),
                          argnums=tuple(range(len(vals))))(*vals)
         self._single = single
         self._jac = jac[0] if single else jac
+        self._in_sizes = [int(max(1, np.prod(v.shape))) for v in vals]
         self.is_batched = is_batched
 
     def _matrix(self):
         import numpy as np
         blocks = [self._jac] if self._single else list(self._jac)
-        if len(blocks) == 1:
-            return np.asarray(blocks[0])
-        # flatten each jacrev block (out_shape + in_shape_i) to
-        # [n_out, n_in_i] and concatenate along the input axis
+        # each jacrev block has shape out_shape + in_shape_i; the input
+        # element count is known, so n_out = size // n_in regardless of
+        # the output rank → flatten to [n_out, n_in_i] and concatenate
+        # the input axis ([num_outputs, total_num_inputs], reference
+        # shape)
         mats = []
-        for a in blocks:
+        for a, n_in in zip(blocks, self._in_sizes):
             a = np.asarray(a)
-            n_out = a.shape[0] if a.ndim > 1 else 1
-            mats.append(a.reshape(n_out, -1))
-        return np.concatenate(mats, axis=-1)
+            n_out = max(1, a.size // n_in)
+            mats.append(a.reshape(n_out, n_in))
+        return mats[0] if len(mats) == 1 else np.concatenate(mats,
+                                                             axis=-1)
 
     def __getitem__(self, idx):
         return _wrap(self._matrix()[idx])

@@ -49,7 +49,17 @@ def require_version(min_version, max_version=None):
     from .. import __version__
 
     def key(v):
-        return tuple(int(p) for p in str(v).split(".")[:3])
+        parts = []
+        for p in str(v).split(".")[:3]:
+            digits = ""
+            for ch in p:
+                if not ch.isdigit():
+                    break  # "0rc1" → 0 (pre-release tags compare as base)
+                digits += ch
+            parts.append(int(digits or 0))
+        while len(parts) < 3:
+            parts.append(0)  # "0.1" == "0.1.0"
+        return tuple(parts)
 
     have = key(__version__)
     if key(min_version) > have:

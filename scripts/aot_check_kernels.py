@@ -97,11 +97,12 @@ def _ring_check():
     from paddle_tpu.ops.ring_flash_attention import (
         ring_flash_attention_local)
 
-    mesh = Mesh(np.array(topo.devices).reshape(4), ("sep",))
+    n_dev = len(topo.devices)
+    mesh = Mesh(np.array(topo.devices).reshape(n_dev), ("sep",))
     spec = P(None, "sep", None, None)
     fn = jax.shard_map(
         functools.partial(ring_flash_attention_local, axis="sep",
-                          axis_size=4, causal=True, scale=0.125),
+                          axis_size=n_dev, causal=True, scale=0.125),
         mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
         check_vma=False)
     qa = jax.ShapeDtypeStruct(

@@ -41,3 +41,16 @@ def test_require_version():
     utils.require_version("0.0.1")
     with pytest.raises(Exception, match="required"):
         utils.require_version("99.0.0")
+
+
+def test_jacobian_multidim_output():
+    x = paddle.to_tensor(np.arange(4.0, dtype=np.float32).reshape(2, 2))
+    J = A.Jacobian(lambda x: x * 2, x)
+    m = J.numpy()
+    assert m.shape == (4, 4)  # flattened [n_out, n_in]
+    np.testing.assert_allclose(m, 2 * np.eye(4))
+    # version key edge cases
+    from paddle_tpu import utils
+    utils.require_version("0.1")          # short form == 0.1.0
+    utils.require_version("0.0.1", max_version="0.1")
+    utils.require_version("0.1.0rc1")     # tag ignored in comparison

@@ -1,0 +1,127 @@
+"""TCPStore rendezvous: native C++ server + python client, multiprocess."""
+import multiprocessing as mp
+import os
+import sys
+import time
+
+import pytest
+
+import paddle_tpu  # noqa: F401  (path setup)
+from paddle_tpu._native import tcp_store_available
+from paddle_tpu.distributed.store import TCPStore, _PyStoreServer
+
+
+def _roundtrip(store):
+    store.set("alpha", b"hello")
+    assert store.get("alpha") == b"hello"
+    assert store.query("missing") is None
+    assert store.add("ctr", 5) == 5
+    assert store.add("ctr", 2) == 7
+    assert store.num_keys() >= 2
+    store.wait(["alpha"])
+    assert store.delete_key("alpha")
+    assert store.query("alpha") is None
+
+
+def test_native_server_roundtrip():
+    if not tcp_store_available():
+        pytest.skip("no C++ toolchain")
+    master = TCPStore("127.0.0.1", 0, is_master=True, world_size=1)
+    assert master._native_handle is not None  # really the C++ server
+    try:
+        _roundtrip(master)
+        # a second client against the same server
+        client = TCPStore("127.0.0.1", master.port, is_master=False,
+                          world_size=1)
+        client.set("beta", b"b")
+        assert master.get("beta") == b"b"
+        client.close()
+    finally:
+        master.close()
+
+
+def test_python_fallback_server_roundtrip():
+    srv = _PyStoreServer(0)
+    try:
+        store = TCPStore("127.0.0.1", srv.port, is_master=False,
+                         world_size=1)
+        _roundtrip(store)
+        store.close()
+    finally:
+        srv.stop()
+
+
+def test_blocking_get_unblocks_on_set():
+    if not tcp_store_available():
+        pytest.skip("no C++ toolchain")
+    master = TCPStore("127.0.0.1", 0, is_master=True, world_size=1)
+    try:
+        import threading
+        got = {}
+
+        def waiter():
+            c = TCPStore("127.0.0.1", master.port)
+            got["v"] = c.get("late")  # parks server-side
+            c.close()
+
+        t = threading.Thread(target=waiter)
+        t.start()
+        time.sleep(0.2)
+        assert "v" not in got  # still blocked
+        master.set("late", b"now")
+        t.join(timeout=10)
+        assert got.get("v") == b"now"
+    finally:
+        master.close()
+
+
+def _worker(port, rank, world, q):
+    store = TCPStore("127.0.0.1", port, is_master=False,
+                     world_size=world, timeout=30)
+    store.set(f"rank{rank}", str(rank).encode())
+    store.barrier("sync")
+    # after the barrier every rank's key must be visible
+    vals = sorted(int(store.get(f"rank{r}")) for r in range(world))
+    q.put((rank, vals))
+    store.close()
+
+
+def test_multiprocess_barrier_rendezvous():
+    if not tcp_store_available():
+        pytest.skip("no C++ toolchain")
+    world = 4
+    master = TCPStore("127.0.0.1", 0, is_master=True, world_size=world)
+    try:
+        ctx = mp.get_context("spawn")
+        q = ctx.Queue()
+        procs = [ctx.Process(target=_worker,
+                             args=(master.port, r, world, q))
+                 for r in range(world)]
+        for p in procs:
+            p.start()
+        results = [q.get(timeout=60) for _ in range(world)]
+        for p in procs:
+            p.join(timeout=30)
+        for _, vals in results:
+            assert vals == [0, 1, 2, 3]
+    finally:
+        master.close()
+
+
+def test_elastic_store_over_tcp_store(monkeypatch):
+    """PADDLE_ELASTIC_STORE=host:port routes elastic heartbeats through
+    the native rendezvous server (the reference's etcd registry role)."""
+    if not tcp_store_available():
+        pytest.skip("no C++ toolchain")
+    from paddle_tpu.distributed.fleet.elastic.manager import ElasticStore
+    master = TCPStore("127.0.0.1", 0, is_master=True, world_size=1)
+    try:
+        monkeypatch.setenv("PADDLE_ELASTIC_STORE",
+                           f"127.0.0.1:{master.port}")
+        es = ElasticStore()
+        assert es._tcp is not None
+        es.set("beat_0", "123.5")
+        assert es.get("beat_0") == "123.5"
+        assert es.get("absent", "dflt") == "dflt"
+    finally:
+        master.close()
