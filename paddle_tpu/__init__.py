@@ -86,6 +86,7 @@ from . import distribution  # noqa: F401
 from . import utils  # noqa: F401
 from . import version  # noqa: F401
 from . import sysconfig  # noqa: F401
+from . import quantization  # noqa: F401
 
 from .jit import grad  # noqa: F401
 from .hapi import Model, summary  # noqa: F401

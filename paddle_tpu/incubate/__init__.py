@@ -8,6 +8,7 @@ hot ones) — exposed under the incubate names for API parity.
 from . import nn
 from . import distributed  # MoE lives here (incubate.distributed.models.moe)
 from . import autograd  # vjp/jvp/Jacobian/Hessian transforms
+from . import optimizer  # LookAhead / ModelAverage
 
 
 def autograd_functional_jacobian(func, xs):

@@ -1,0 +1,261 @@
+"""paddle.quantization: QAT / PTQ over fake-quant ops.
+
+Reference parity: `python/paddle/quantization/` (QuantConfig, QAT, PTQ,
+quanters/observers; static `paddle/static/quantization` passes
+[UNVERIFIED — empty reference mount]).
+
+TPU-native: the "quant program pass" is unnecessary — fake-quant is a
+dispatched op (quantize→dequantize with a straight-through-estimator
+custom gradient) inserted by wrapping layers, and XLA folds it into the
+surrounding computation in both engines.  INT8 *execution* is not the
+TPU deployment path (the MXU's low-precision format is bf16/int8 via
+XLA's native quantized dots when available); the artifact of PTQ/QAT
+here is the scale metadata + a quantize-aware float graph, which is the
+same contract the reference's ONNX/Lite exporters consume.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.dispatch import dispatch
+from ..core.tensor import Tensor
+from ..nn.layer.layers import Layer
+
+__all__ = ["QuantConfig", "QAT", "PTQ", "FakeQuanterWithAbsMax",
+           "AbsmaxObserver", "quant_dequant"]
+
+
+@jax.custom_vjp
+def _fake_quant(x, scale, qmax):
+    q = jnp.clip(jnp.round(x / scale * qmax), -qmax, qmax)
+    return q * scale / qmax
+
+
+def _fq_fwd(x, scale, qmax):
+    return _fake_quant(x, scale, qmax), None
+
+
+def _fq_bwd(res, g):
+    # straight-through estimator: d(fake_quant)/dx ≈ 1
+    return g, None, None
+
+
+_fake_quant.defvjp(_fq_fwd, _fq_bwd)
+
+
+def quant_dequant(x, scale, bits=8):
+    """Quantize→dequantize with STE gradient (the fake_quantize op)."""
+    qmax = float(2 ** (bits - 1) - 1)
+
+    def impl(v, s, qmax):
+        return _fake_quant(v.astype(jnp.float32), s, qmax).astype(v.dtype)
+
+    return dispatch("fake_quantize_dequantize", impl, (x, scale),
+                    dict(qmax=qmax))
+
+
+class AbsmaxObserver:
+    """Tracks running abs-max of a tensor (PTQ calibration)."""
+
+    def __init__(self, quant_bits=8):
+        self.bits = quant_bits
+        self._absmax = 0.0
+
+    def observe(self, x):
+        v = float(jnp.max(jnp.abs(
+            x._value if isinstance(x, Tensor) else jnp.asarray(x))))
+        self._absmax = max(self._absmax, v)
+
+    def scale(self):
+        return max(self._absmax, 1e-8)
+
+
+class FakeQuanterWithAbsMax:
+    """QAT quanter: per-call abs-max scale + STE fake quant."""
+
+    def __init__(self, quant_bits=8, moving_rate=0.9):
+        self.bits = quant_bits
+        self.moving_rate = moving_rate
+        self._scale = None
+
+    def __call__(self, x):
+        cur = jnp.max(jnp.abs(
+            x._value if isinstance(x, Tensor) else jnp.asarray(x)))
+        try:
+            # concrete (eager): update the EMA, held as a python float
+            # so a jit re-trace can never leak a tracer into state
+            curf = float(cur)
+            if self._scale is None:
+                self._scale = curf
+            else:  # EMA of scales (reference moving-average absmax)
+                self._scale = (self.moving_rate * self._scale
+                               + (1 - self.moving_rate) * curf)
+            scale = max(float(self._scale), 1e-8)
+            # as a Tensor ARGUMENT, not a python static: the per-step
+            # EMA value changes every call and a float would key a
+            # fresh jit compile each step in the eager op cache
+            scale = Tensor(jnp.asarray(scale, jnp.float32),
+                           _internal=True, stop_gradient=True)
+        except (jax.errors.TracerArrayConversionError, TypeError):
+            # traced (to_static): use the frozen calibrated scale, or
+            # the live per-batch max when never calibrated
+            if self._scale is not None:
+                scale = Tensor(jnp.asarray(max(float(self._scale), 1e-8),
+                                           jnp.float32),
+                               _internal=True, stop_gradient=True)
+            else:
+                scale = Tensor(
+                    jnp.maximum(jax.lax.stop_gradient(cur), 1e-8),
+                    _internal=True, stop_gradient=True)
+        return quant_dequant(x, scale, self.bits)
+
+
+class QuantConfig:
+    """Which quanter to use for activations/weights, per layer type."""
+
+    def __init__(self, activation=None, weight=None):
+        self.activation = activation
+        self.weight = weight
+        self._type_configs = {}
+
+    def add_type_config(self, layer_types, activation=None, weight=None):
+        if not isinstance(layer_types, (list, tuple)):
+            layer_types = [layer_types]
+        for t in layer_types:
+            self._type_configs[t] = (activation, weight)
+
+    def config_for(self, layer):
+        for t, cfg in self._type_configs.items():
+            if isinstance(layer, t):
+                return cfg
+        return (self.activation, self.weight)
+
+
+def _make(quanter):
+    if quanter is None:
+        return None
+    if callable(quanter) and not isinstance(
+            quanter, (FakeQuanterWithAbsMax, AbsmaxObserver)):
+        return quanter()  # a factory/class
+    return quanter
+
+
+class _QuantedWrapper(Layer):
+    """Wraps a leaf layer: fake-quant its input and weight."""
+
+    def __init__(self, inner, act_q, weight_q):
+        super().__init__()
+        self.inner = inner
+        self._act_q = act_q
+        self._weight_q = weight_q
+
+    def forward(self, x, *args, **kwargs):
+        if self._act_q is not None:
+            x = self._act_q(x)
+        w = getattr(self.inner, "weight", None)
+        if self._weight_q is not None and w is not None:
+            saved = w._value
+            try:
+                w._value = self._weight_q(
+                    Tensor(saved, _internal=True))._value
+                return self.inner(x, *args, **kwargs)
+            finally:
+                w._value = saved
+        return self.inner(x, *args, **kwargs)
+
+
+_DEFAULT_QUANTABLE = None
+
+
+def _quantable_types():
+    global _DEFAULT_QUANTABLE
+    if _DEFAULT_QUANTABLE is None:
+        from .. import nn
+        _DEFAULT_QUANTABLE = (nn.Linear, nn.Conv2D)
+    return _DEFAULT_QUANTABLE
+
+
+def _wrap_model(model, config, act_factory):
+    for name, child in list(getattr(model, "_sub_layers", {}).items()):
+        if isinstance(child, _QuantedWrapper):
+            continue
+        if isinstance(child, _quantable_types()):
+            act_q, w_q = config.config_for(child)
+            wrapper = _QuantedWrapper(child, _make(act_q or act_factory),
+                                      _make(w_q or act_factory))
+            model._sub_layers[name] = wrapper
+            setattr(model, name, wrapper)
+        else:
+            _wrap_model(child, config, act_factory)
+    return model
+
+
+class QAT:
+    """Quantization-aware training: insert STE fake-quant wrappers."""
+
+    def __init__(self, config: QuantConfig):
+        self.config = config
+
+    def quantize(self, model, inplace=True):
+        return _wrap_model(model, self.config,
+                           FakeQuanterWithAbsMax)
+
+    def convert(self, model, inplace=True):
+        """Strip the wrappers, leaving scale metadata on the layers."""
+        for name, child in list(getattr(model, "_sub_layers",
+                                        {}).items()):
+            if isinstance(child, _QuantedWrapper):
+                inner = child.inner
+                scale = getattr(child._weight_q, "_scale", None)
+                if scale is not None:
+                    inner.weight_scale = float(np.asarray(scale))
+                model._sub_layers[name] = inner
+                setattr(model, name, inner)
+            else:
+                self.convert(child)
+        return model
+
+
+class PTQ:
+    """Post-training quantization: observe activations on calibration
+    batches, then bake scales."""
+
+    def __init__(self, config: QuantConfig = None):
+        self.config = config or QuantConfig()
+        self._observers = []
+
+    def quantize(self, model, inplace=True):
+        ptq = self
+
+        class _Observing(FakeQuanterWithAbsMax):
+            def __init__(self):
+                super().__init__()
+                self.observer = AbsmaxObserver()
+                ptq._observers.append(self.observer)
+
+            def __call__(self, x):
+                self.observer.observe(x)
+                return x  # observation only during calibration
+
+        return _wrap_model(model, self.config, _Observing)
+
+    def convert(self, model, inplace=True):
+        """After calibration: replace observers with fixed-scale
+        fake-quant (so the exported graph carries the PTQ scales)."""
+        for name, child in list(getattr(model, "_sub_layers",
+                                        {}).items()):
+            if isinstance(child, _QuantedWrapper):
+                for attr in ("_act_q", "_weight_q"):
+                    q = getattr(child, attr)
+                    obs = getattr(q, "observer", None)
+                    if obs is not None:
+                        scale = obs.scale()
+                        fixed = FakeQuanterWithAbsMax()
+                        fixed._scale = jnp.asarray(scale, jnp.float32)
+                        fixed.moving_rate = 1.0  # frozen
+                        setattr(child, attr, fixed)
+            else:
+                self.convert(child)
+        return model

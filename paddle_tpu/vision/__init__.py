@@ -7,6 +7,9 @@ from .models import LeNet, ResNet, resnet18, resnet34, resnet50, resnet101, \
     resnet152
 
 
+from . import ops  # noqa: F401  (nms/roi_align/yolo_box/deform_conv2d)
+
+
 def set_image_backend(backend):
     pass
 

@@ -1,0 +1,445 @@
+"""paddle.vision.ops: detection operators.
+
+Reference parity: `python/paddle/vision/ops.py` (nms, roi_align,
+roi_pool, box_coder, yolo_box, deform_conv2d + layer wrappers
+[UNVERIFIED — empty reference mount]).
+
+TPU-native notes:
+  * roi_align / roi_pool / box_coder / yolo_box / deform_conv2d are
+    pure-jnp gather/arithmetic compositions routed through dispatch —
+    differentiable and traceable, XLA fuses the sampling math;
+  * nms has a data-dependent output size, which XLA cannot express as
+    one static program — like the reference (a CPU/GPU kernel with
+    dynamic output), it executes eagerly on host (numpy) and returns
+    the kept indices; trace it outside jit (standard detection
+    postprocessing position).
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.dispatch import dispatch
+from ..core.tensor import Tensor, to_tensor
+
+__all__ = ["nms", "roi_align", "roi_pool", "box_coder", "yolo_box",
+           "deform_conv2d", "RoIAlign", "RoIPool", "DeformConv2D",
+           "box_iou"]
+
+
+def _np(x):
+    return np.asarray(x.numpy() if isinstance(x, Tensor) else x)
+
+
+def box_iou(boxes1, boxes2):
+    """Pairwise IoU [N, M] for xyxy boxes; differentiable."""
+    def impl(a, b):
+        area1 = (a[:, 2] - a[:, 0]) * (a[:, 3] - a[:, 1])
+        area2 = (b[:, 2] - b[:, 0]) * (b[:, 3] - b[:, 1])
+        lt = jnp.maximum(a[:, None, :2], b[None, :, :2])
+        rb = jnp.minimum(a[:, None, 2:], b[None, :, 2:])
+        wh = jnp.clip(rb - lt, 0)
+        inter = wh[..., 0] * wh[..., 1]
+        return inter / jnp.clip(area1[:, None] + area2[None] - inter,
+                                1e-10)
+    return dispatch("box_iou", impl, (boxes1, boxes2))
+
+
+def nms(boxes, iou_threshold=0.3, scores=None, category_idxs=None,
+        categories=None, top_k=None):
+    """Greedy NMS; returns kept indices (host op, dynamic output)."""
+    b = _np(boxes).astype(np.float64)
+    n = len(b)
+    if n == 0:
+        return to_tensor(np.zeros((0,), np.int64))
+    s = _np(scores).astype(np.float64) if scores is not None else None
+    cats = _np(category_idxs) if category_idxs is not None else None
+
+    def greedy(idxs):
+        keep = []
+        x1, y1, x2, y2 = (b[idxs, i] for i in range(4))
+        areas = (x2 - x1) * (y2 - y1)
+        order = np.argsort(
+            -s[idxs]) if s is not None else np.arange(len(idxs))
+        alive = np.ones(len(idxs), bool)
+        for oi in range(len(order)):
+            i = order[oi]
+            if not alive[i]:
+                continue
+            keep.append(idxs[i])
+            xx1 = np.maximum(x1[i], x1[order[oi + 1:]])
+            yy1 = np.maximum(y1[i], y1[order[oi + 1:]])
+            xx2 = np.minimum(x2[i], x2[order[oi + 1:]])
+            yy2 = np.minimum(y2[i], y2[order[oi + 1:]])
+            inter = (np.clip(xx2 - xx1, 0, None)
+                     * np.clip(yy2 - yy1, 0, None))
+            iou = inter / np.clip(
+                areas[i] + areas[order[oi + 1:]] - inter, 1e-10, None)
+            dead = order[oi + 1:][iou > iou_threshold]
+            alive[dead] = False
+        return keep
+
+    if cats is None:
+        keep = greedy(np.arange(n))
+    else:
+        keep = []
+        for c in (categories if categories is not None
+                  else np.unique(cats)):
+            idxs = np.nonzero(cats == c)[0]
+            if len(idxs):
+                keep.extend(greedy(idxs))
+        if s is not None:
+            keep = sorted(keep, key=lambda i: -s[i])
+    if top_k is not None:
+        keep = keep[: int(top_k)]
+    return to_tensor(np.asarray(keep, np.int64))
+
+
+def _bilinear(feat, y, x):
+    """feat [C, H, W]; y/x broadcastable index grids → gathered values."""
+    H, W = feat.shape[-2:]
+    y0 = jnp.floor(y)
+    x0 = jnp.floor(x)
+    y1, x1 = y0 + 1, x0 + 1
+    wy1 = y - y0
+    wx1 = x - x0
+    wy0, wx0 = 1 - wy1, 1 - wx1
+
+    def at(yy, xx):
+        yi = jnp.clip(yy, 0, H - 1).astype(jnp.int32)
+        xi = jnp.clip(xx, 0, W - 1).astype(jnp.int32)
+        return feat[:, yi, xi]
+
+    # zero outside the feature map (reference roi_align semantics)
+    valid = ((y > -1) & (y < H) & (x > -1) & (x < W)).astype(feat.dtype)
+    val = (at(y0, x0) * (wy0 * wx0) + at(y0, x1) * (wy0 * wx1)
+           + at(y1, x0) * (wy1 * wx0) + at(y1, x1) * (wy1 * wx1))
+    return val * valid
+
+
+def roi_align(x, boxes, boxes_num, output_size, spatial_scale=1.0,
+              sampling_ratio=-1, aligned=True, name=None):
+    """RoIAlign over NCHW features; boxes [R, 4] xyxy, boxes_num [N]."""
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    ph, pw = output_size
+    ratio = 2 if sampling_ratio <= 0 else int(sampling_ratio)
+
+    def impl(feats, rois, rois_num, ph, pw, ratio, scale, aligned):
+        n = feats.shape[0]
+        # map each roi to its batch image
+        counts = rois_num.astype(jnp.int32)
+        img_idx = jnp.repeat(jnp.arange(n), counts,
+                             total_repeat_length=rois.shape[0])
+
+        off = 0.5 if aligned else 0.0
+        x1 = rois[:, 0] * scale - off
+        y1 = rois[:, 1] * scale - off
+        x2 = rois[:, 2] * scale - off
+        y2 = rois[:, 3] * scale - off
+        rw = x2 - x1
+        rh = y2 - y1
+        if not aligned:
+            rw = jnp.maximum(rw, 1.0)
+            rh = jnp.maximum(rh, 1.0)
+        bin_h = rh / ph
+        bin_w = rw / pw
+
+        iy = (jnp.arange(ratio) + 0.5) / ratio   # intra-bin offsets
+        gy = (jnp.arange(ph)[:, None] + iy[None, :]).reshape(-1)  # ph*r
+        gx = (jnp.arange(pw)[:, None] + iy[None, :]).reshape(-1)
+
+        def one(roi_i):
+            feat = feats[img_idx[roi_i]]
+            ys = y1[roi_i] + gy * bin_h[roi_i]       # (ph*r,)
+            xs = x1[roi_i] + gx * bin_w[roi_i]       # (pw*r,)
+            vals = _bilinear(feat, ys[:, None], xs[None, :])
+            c = vals.shape[0]
+            vals = vals.reshape(c, ph, ratio, pw, ratio)
+            return vals.mean(axis=(2, 4))            # (C, ph, pw)
+
+        return jax.vmap(one)(jnp.arange(rois.shape[0]))
+
+    return dispatch("roi_align", impl, (x, boxes, boxes_num),
+                    dict(ph=ph, pw=pw, ratio=ratio,
+                         scale=float(spatial_scale),
+                         aligned=bool(aligned)))
+
+
+def roi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0,
+             name=None):
+    """RoIPool (max within each bin, quantized bounds)."""
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    ph, pw = output_size
+    # max-pool ≈ roi_align with dense sampling + max; use quantized
+    # reference semantics via a fine sampling grid and max reduction
+    ratio = 4
+
+    def impl(feats, rois, rois_num, ph, pw, scale):
+        n = feats.shape[0]
+        counts = rois_num.astype(jnp.int32)
+        img_idx = jnp.repeat(jnp.arange(n), counts,
+                             total_repeat_length=rois.shape[0])
+        x1 = jnp.round(rois[:, 0] * scale)
+        y1 = jnp.round(rois[:, 1] * scale)
+        x2 = jnp.round(rois[:, 2] * scale)
+        y2 = jnp.round(rois[:, 3] * scale)
+        rw = jnp.maximum(x2 - x1 + 1, 1.0)
+        rh = jnp.maximum(y2 - y1 + 1, 1.0)
+        bin_h = rh / ph
+        bin_w = rw / pw
+        iy = (jnp.arange(ratio) + 0.5) / ratio
+        gy = (jnp.arange(ph)[:, None] + iy[None, :]).reshape(-1)
+        gx = (jnp.arange(pw)[:, None] + iy[None, :]).reshape(-1)
+
+        def one(roi_i):
+            feat = feats[img_idx[roi_i]]
+            ys = y1[roi_i] + gy * bin_h[roi_i]
+            xs = x1[roi_i] + gx * bin_w[roi_i]
+            vals = _bilinear(feat, ys[:, None], xs[None, :])
+            c = vals.shape[0]
+            vals = vals.reshape(c, ph, ratio, pw, ratio)
+            return vals.max(axis=(2, 4))
+
+        return jax.vmap(one)(jnp.arange(rois.shape[0]))
+
+    return dispatch("roi_pool", impl, (x, boxes, boxes_num),
+                    dict(ph=ph, pw=pw, scale=float(spatial_scale)))
+
+
+def box_coder(prior_box, prior_box_var, target_box,
+              code_type="encode_center_size", box_normalized=True,
+              axis=0, name=None):
+    """Encode/decode boxes against priors (SSD-style)."""
+    def impl(prior, tbox, var, code_type, box_normalized, axis):
+        norm = 0.0 if box_normalized else 1.0
+        pw = prior[:, 2] - prior[:, 0] + norm
+        phh = prior[:, 3] - prior[:, 1] + norm
+        pcx = prior[:, 0] + pw * 0.5
+        pcy = prior[:, 1] + phh * 0.5
+        if var is None:
+            var = jnp.ones((4,), jnp.float32)
+        if var.ndim == 1:
+            var = jnp.broadcast_to(var, prior.shape)
+        if code_type == "encode_center_size":
+            tw = tbox[:, 2] - tbox[:, 0] + norm
+            th = tbox[:, 3] - tbox[:, 1] + norm
+            tcx = tbox[:, 0] + tw * 0.5
+            tcy = tbox[:, 1] + th * 0.5
+            # [T, P, 4]: every target against every prior
+            out = jnp.stack([
+                (tcx[:, None] - pcx[None]) / pw[None] / var[None, :, 0],
+                (tcy[:, None] - pcy[None]) / phh[None] / var[None, :, 1],
+                jnp.log(tw[:, None] / pw[None]) / var[None, :, 2],
+                jnp.log(th[:, None] / phh[None]) / var[None, :, 3],
+            ], axis=-1)
+            return out
+        # decode: tbox [N, M, 4] deltas; priors align with `axis`
+        d = tbox
+        if d.shape[axis] != prior.shape[0]:
+            raise ValueError(
+                f"box_coder decode: target_box dim {axis} "
+                f"({d.shape[axis]}) must equal the prior count "
+                f"({prior.shape[0]}); use axis=1 when priors vary "
+                "along the second dim")
+        if axis == 1:
+            pcx, pcy = pcx[None, :], pcy[None, :]
+            pw_, ph_ = pw[None, :], phh[None, :]
+            v = var[None]
+        else:
+            pcx, pcy = pcx[:, None], pcy[:, None]
+            pw_, ph_ = pw[:, None], phh[:, None]
+            v = var[:, None]
+        cx = v[..., 0] * d[..., 0] * pw_ + pcx
+        cy = v[..., 1] * d[..., 1] * ph_ + pcy
+        w = jnp.exp(v[..., 2] * d[..., 2]) * pw_
+        h = jnp.exp(v[..., 3] * d[..., 3]) * ph_
+        return jnp.stack([cx - w / 2, cy - h / 2,
+                          cx + w / 2 - norm, cy + h / 2 - norm], -1)
+
+    var_arg = prior_box_var if isinstance(prior_box_var, Tensor) else (
+        None if prior_box_var is None
+        else jnp.asarray(prior_box_var, jnp.float32))
+    return dispatch("box_coder", impl, (prior_box, target_box),
+                    dict(var=var_arg if not isinstance(var_arg, Tensor)
+                         else var_arg._value,
+                         code_type=code_type,
+                         box_normalized=bool(box_normalized),
+                         axis=int(axis)))
+
+
+def yolo_box(x, img_size, anchors, class_num, conf_thresh,
+             downsample_ratio, clip_bbox=True, name=None,
+             scale_x_y=1.0, iou_aware=False, iou_aware_factor=0.5):
+    """Decode YOLOv3 head output [N, AN*(5+C), H, W] into boxes+scores."""
+    if iou_aware:
+        raise NotImplementedError(
+            "yolo_box: iou_aware head layout ([N, AN*(6+C), H, W]) is "
+            "not supported yet")
+    an = len(anchors) // 2
+
+    def impl(x, img_size, anchors, an, class_num, conf_thresh,
+             ds, clip_bbox, sxy):
+        n, _, h, w = x.shape
+        a = x.reshape(n, an, 5 + class_num, h, w)
+        gx = jnp.arange(w, dtype=jnp.float32)
+        gy = jnp.arange(h, dtype=jnp.float32)
+        anc = jnp.asarray(anchors, jnp.float32).reshape(an, 2)
+        bias = 0.5 * (sxy - 1)
+        cx = (jax.nn.sigmoid(a[:, :, 0]) * sxy - bias
+              + gx[None, None, None, :]) / w
+        cy = (jax.nn.sigmoid(a[:, :, 1]) * sxy - bias
+              + gy[None, None, :, None]) / h
+        bw = jnp.exp(a[:, :, 2]) * anc[None, :, 0, None, None] / (w * ds)
+        bh = jnp.exp(a[:, :, 3]) * anc[None, :, 1, None, None] / (h * ds)
+        conf = jax.nn.sigmoid(a[:, :, 4])
+        probs = jax.nn.sigmoid(a[:, :, 5:]) * conf[:, :, None]
+        ih = img_size[:, 0].astype(jnp.float32)
+        iw = img_size[:, 1].astype(jnp.float32)
+        x1 = (cx - bw / 2) * iw[:, None, None, None]
+        y1 = (cy - bh / 2) * ih[:, None, None, None]
+        x2 = (cx + bw / 2) * iw[:, None, None, None]
+        y2 = (cy + bh / 2) * ih[:, None, None, None]
+        if clip_bbox:
+            x1 = jnp.clip(x1, 0)
+            y1 = jnp.clip(y1, 0)
+            x2 = jnp.minimum(x2, iw[:, None, None, None] - 1)
+            y2 = jnp.minimum(y2, ih[:, None, None, None] - 1)
+        boxes = jnp.stack([x1, y1, x2, y2], -1).reshape(n, -1, 4)
+        scores = jnp.moveaxis(probs, 2, -1).reshape(n, -1, class_num)
+        # zero out boxes under the confidence threshold (the reference
+        # sets them to 0 rather than dropping — static shape)
+        keep = (conf.reshape(n, -1, 1) >= conf_thresh)
+        return boxes * keep, scores * keep
+
+    return dispatch("yolo_box", impl, (x, img_size),
+                    dict(anchors=tuple(anchors), an=an,
+                         class_num=int(class_num),
+                         conf_thresh=float(conf_thresh),
+                         ds=float(downsample_ratio),
+                         clip_bbox=bool(clip_bbox),
+                         sxy=float(scale_x_y)))
+
+
+def deform_conv2d(x, offset, weight, bias=None, stride=1, padding=0,
+                  dilation=1, deformable_groups=1, groups=1, mask=None,
+                  name=None):
+    """Deformable conv v1/v2 (mask=None → v1): bilinear-sample the
+    input at offset positions, then a dense matmul per output pixel."""
+    s = (stride, stride) if isinstance(stride, int) else tuple(stride)
+    p = (padding, padding) if isinstance(padding, int) else tuple(padding)
+    d = (dilation, dilation) if isinstance(dilation, int) else \
+        tuple(dilation)
+    if deformable_groups != 1 or groups != 1:
+        raise NotImplementedError(
+            "deform_conv2d: deformable_groups/groups > 1 not supported")
+
+    def impl(x, offset, weight, *maybe, s, p, d, has_bias, has_mask):
+        bias = maybe[0] if has_bias else None
+        mask = maybe[-1] if has_mask else None
+        n, cin, H, W = x.shape
+        cout, _, kh, kw = weight.shape
+        oh = (H + 2 * p[0] - d[0] * (kh - 1) - 1) // s[0] + 1
+        ow = (W + 2 * p[1] - d[1] * (kw - 1) - 1) // s[1] + 1
+        # base sampling grid per output pixel and kernel tap
+        oy = jnp.arange(oh) * s[0] - p[0]
+        ox = jnp.arange(ow) * s[1] - p[1]
+        ky = jnp.arange(kh) * d[0]
+        kx = jnp.arange(kw) * d[1]
+        base_y = oy[:, None, None, None] + ky[None, None, :, None]
+        base_x = ox[None, :, None, None] + kx[None, None, None, :]
+        off = offset.reshape(n, kh * kw, 2, oh, ow)
+        dy = jnp.moveaxis(off[:, :, 0], 1, -1).reshape(n, oh, ow, kh, kw)
+        dx = jnp.moveaxis(off[:, :, 1], 1, -1).reshape(n, oh, ow, kh, kw)
+        ys = base_y[None] + dy
+        xs = base_x[None] + dx
+
+        if mask is not None:
+            m = jnp.moveaxis(mask.reshape(n, kh * kw, oh, ow),
+                             1, -1).reshape(n, oh, ow, kh, kw)
+        else:  # v1: all taps fully weighted (XLA folds the constant)
+            m = jnp.ones((n, oh, ow, kh, kw), x.dtype)
+
+        def one(img, ys, xs, m):
+            vals = _bilinear(img, ys.reshape(-1), xs.reshape(-1))
+            vals = vals.reshape(cin, oh, ow, kh, kw) * m[None]
+            cols = jnp.moveaxis(vals, 0, -3).reshape(
+                oh, ow, cin * kh * kw)
+            return jnp.einsum(
+                "hwf,of->ohw", cols, weight.reshape(cout, -1),
+                preferred_element_type=jnp.float32).astype(x.dtype)
+
+        out = jax.vmap(one)(x, ys, xs, m)
+        if bias is not None:
+            out = out + bias[None, :, None, None]
+        return out
+
+    args = [x, offset, weight]
+    if bias is not None:
+        args.append(bias)
+    if mask is not None:
+        args.append(mask)
+    return dispatch("deform_conv2d", impl, tuple(args),
+                    dict(s=s, p=p, d=d, has_bias=bias is not None,
+                         has_mask=mask is not None))
+
+
+from ..nn.layer.layers import Layer as _Layer
+
+
+class RoIAlign(_Layer):
+    def __init__(self, output_size, spatial_scale=1.0):
+        super().__init__()
+        self.output_size = output_size
+        self.spatial_scale = spatial_scale
+
+    def forward(self, x, boxes, boxes_num):
+        return roi_align(x, boxes, boxes_num, self.output_size,
+                         self.spatial_scale)
+
+
+class RoIPool(_Layer):
+    def __init__(self, output_size, spatial_scale=1.0):
+        super().__init__()
+        self.output_size = output_size
+        self.spatial_scale = spatial_scale
+
+    def forward(self, x, boxes, boxes_num):
+        return roi_pool(x, boxes, boxes_num, self.output_size,
+                        self.spatial_scale)
+
+
+class DeformConv2D(_Layer):
+    """Layer form — weight/bias register as Parameters so parent
+    models see them in parameters()/state_dict()."""
+
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, deformable_groups=1, groups=1,
+                 weight_attr=None, bias_attr=None):
+        super().__init__()
+        from ..nn.layer.layers import create_parameter
+        from ..nn import initializer as I
+        kh = kernel_size if isinstance(kernel_size, int) else \
+            kernel_size[0]
+        kw = kernel_size if isinstance(kernel_size, int) else \
+            kernel_size[1]
+        self.weight = create_parameter(
+            [out_channels, in_channels // groups, kh, kw], "float32",
+            attr=weight_attr, default_initializer=I.XavierNormal())
+        self.bias = None
+        if bias_attr is not False:
+            self.bias = create_parameter(
+                [out_channels], "float32", attr=bias_attr, is_bias=True,
+                default_initializer=I.Constant(0.0))
+        self.stride = stride
+        self.padding = padding
+        self.dilation = dilation
+        self.deformable_groups = deformable_groups
+        self.groups = groups
+
+    def forward(self, x, offset, mask=None):
+        return deform_conv2d(x, offset, self.weight, self.bias,
+                             self.stride, self.padding, self.dilation,
+                             self.deformable_groups, self.groups, mask)

@@ -1,0 +1,69 @@
+"""incubate.optimizer: LookAhead + ModelAverage."""
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, optimizer
+from paddle_tpu.incubate.optimizer import LookAhead, ModelAverage
+
+
+def _step(m, opt, seed):
+    rng = np.random.RandomState(seed)
+    x = paddle.to_tensor(rng.randn(4, 8).astype(np.float32))
+    y = paddle.to_tensor(rng.randn(4, 2).astype(np.float32))
+    loss = paddle.nn.functional.mse_loss(m(x), y)
+    loss.backward()
+    opt.step()
+    opt.clear_grad()
+
+
+def test_lookahead_interpolates():
+    paddle.seed(0)
+    m = nn.Linear(8, 2)
+    inner = optimizer.SGD(learning_rate=0.1, parameters=m.parameters())
+    la = LookAhead(inner, alpha=0.5, k=2)
+    w0 = m.weight.numpy().copy()
+    _step(m, la, 0)
+    w_fast = m.weight.numpy().copy()
+    assert not np.allclose(w0, w_fast)
+    _step(m, la, 1)  # k-th step → slow update: w = w0 + 0.5*(fast2-w0)
+    w_slow = m.weight.numpy()
+    # slow weights lie strictly between start and the fast trajectory
+    assert not np.allclose(w_slow, w_fast)
+    assert "lookahead_step" in la.state_dict()
+
+
+def test_model_average_apply_restore():
+    paddle.seed(1)
+    m = nn.Linear(8, 2)
+    opt = optimizer.SGD(learning_rate=0.05, parameters=m.parameters())
+    ma = ModelAverage(0.15, parameters=m.parameters(),
+                      max_average_window=100)
+    snapshots = []
+    for i in range(4):
+        _step(m, opt, i)
+        ma.step()
+        snapshots.append(m.weight.numpy().copy())
+    cur = m.weight.numpy().copy()
+    ma.apply()
+    avg = m.weight.numpy()
+    np.testing.assert_allclose(avg, np.mean(snapshots, axis=0),
+                               atol=1e-6)
+    ma.restore()
+    np.testing.assert_allclose(m.weight.numpy(), cur)
+
+
+def test_lookahead_state_roundtrip():
+    paddle.seed(2)
+    m = nn.Linear(8, 2)
+    inner = optimizer.SGD(learning_rate=0.1, parameters=m.parameters())
+    la = LookAhead(inner, alpha=0.5, k=3)
+    _step(m, la, 0)
+    sd = la.state_dict()
+    assert sd["lookahead_step"] == 1
+    assert any(k.startswith("lookahead_slow_") for k in sd)
+    # fresh wrapper resumes mid-trajectory
+    la2 = LookAhead(optimizer.SGD(learning_rate=0.1,
+                                  parameters=m.parameters()),
+                    alpha=0.5, k=3)
+    la2.set_state_dict(sd)
+    assert la2._step_num == 1 and la2._slow

@@ -1,0 +1,76 @@
+"""paddle.quantization: QAT fake-quant with STE + PTQ calibration."""
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, optimizer
+from paddle_tpu.quantization import (AbsmaxObserver,
+                                     FakeQuanterWithAbsMax, PTQ, QAT,
+                                     QuantConfig, quant_dequant)
+
+
+def _model():
+    paddle.seed(3)
+    return nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 2))
+
+
+def test_quant_dequant_ste():
+    x = paddle.to_tensor(np.linspace(-1, 1, 9).astype(np.float32),
+                         stop_gradient=False)
+    y = quant_dequant(x, 1.0, bits=8)
+    # values land on the int8 grid
+    grid = np.round(y.numpy() * 127)
+    np.testing.assert_allclose(grid, y.numpy() * 127, atol=1e-4)
+    # straight-through gradient == 1
+    y.sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), np.ones(9), atol=1e-6)
+
+
+def test_qat_quantize_train_convert():
+    m = _model()
+    q = QAT(QuantConfig())
+    qm = q.quantize(m)
+    # wrapped leaves
+    from paddle_tpu.quantization import _QuantedWrapper
+    assert isinstance(qm._sub_layers["0"], _QuantedWrapper)
+    opt = optimizer.SGD(learning_rate=0.05, parameters=m.parameters())
+    rng = np.random.default_rng(0)
+    x = paddle.to_tensor(rng.normal(size=(16, 8)).astype(np.float32))
+    y = paddle.to_tensor(rng.normal(size=(16, 2)).astype(np.float32))
+    first = None
+    for _ in range(8):
+        loss = paddle.nn.functional.mse_loss(qm(x), y)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        first = first if first is not None else float(loss.numpy())
+    assert float(loss.numpy()) < first  # QAT trains through fake-quant
+    back = q.convert(qm)
+    assert not isinstance(back._sub_layers["0"], _QuantedWrapper)
+    assert hasattr(back._sub_layers["0"], "weight_scale")
+
+
+def test_ptq_calibrates_scales():
+    m = _model()
+    ptq = PTQ(QuantConfig())
+    qm = ptq.quantize(m)
+    rng = np.random.default_rng(1)
+    for _ in range(3):
+        x = paddle.to_tensor(rng.normal(size=(8, 8)).astype(np.float32))
+        qm(x)  # calibration passes
+    assert all(o._absmax > 0 for o in ptq._observers)
+    ptq.convert(qm)
+    # converted: fixed-scale fake quant; output close to float model
+    x = paddle.to_tensor(rng.normal(size=(4, 8)).astype(np.float32))
+    out_q = qm(x).numpy()
+    assert np.isfinite(out_q).all()
+
+
+def test_observer_and_quanter():
+    o = AbsmaxObserver()
+    o.observe(paddle.to_tensor(np.array([-3.0, 2.0], np.float32)))
+    o.observe(paddle.to_tensor(np.array([1.0], np.float32)))
+    assert o.scale() == 3.0
+    fq = FakeQuanterWithAbsMax(moving_rate=0.0)
+    y = fq(paddle.to_tensor(np.array([0.5, -2.0], np.float32)))
+    assert abs(float(fq._scale) - 2.0) < 1e-6
+    assert np.abs(y.numpy()).max() <= 2.0 + 1e-5

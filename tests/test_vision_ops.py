@@ -1,0 +1,132 @@
+"""paddle.vision.ops detection operators."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.vision import ops as V
+
+
+def test_box_iou_and_nms():
+    boxes = paddle.to_tensor(np.array(
+        [[0, 0, 10, 10], [1, 1, 11, 11], [20, 20, 30, 30]],
+        np.float32))
+    iou = V.box_iou(boxes, boxes).numpy()
+    np.testing.assert_allclose(np.diag(iou), 1.0, rtol=1e-6)
+    assert iou[0, 2] == 0.0
+    assert 0.5 < iou[0, 1] < 0.8
+
+    scores = paddle.to_tensor(np.array([0.9, 0.8, 0.7], np.float32))
+    keep = V.nms(boxes, iou_threshold=0.5, scores=scores).numpy()
+    assert list(keep) == [0, 2]  # box 1 suppressed by box 0
+
+    # per-category: same boxes, different categories → nothing suppressed
+    cats = paddle.to_tensor(np.array([0, 1, 0], np.int64))
+    keep = V.nms(boxes, iou_threshold=0.5, scores=scores,
+                 category_idxs=cats, categories=[0, 1]).numpy()
+    assert sorted(keep) == [0, 1, 2]
+
+
+def test_roi_align_identity_box():
+    # a box covering exactly one 2x2 region, output_size 2, ratio 1:
+    # values equal the pixel centers
+    x = np.zeros((1, 1, 4, 4), np.float32)
+    x[0, 0] = np.arange(16).reshape(4, 4)
+    boxes = np.array([[0.0, 0.0, 2.0, 2.0]], np.float32)
+    out = V.roi_align(paddle.to_tensor(x), paddle.to_tensor(boxes),
+                      paddle.to_tensor(np.array([1], np.int32)),
+                      output_size=2, sampling_ratio=1,
+                      aligned=True).numpy()
+    assert out.shape == (1, 1, 2, 2)
+    # sampling points at (0, 0), (0, 1), (1, 0), (1, 1) minus the 0.5
+    # aligned offset → interpolated values around the top-left corner
+    assert np.isfinite(out).all()
+    # monotone along both axes like the source grid
+    assert out[0, 0, 1, 1] > out[0, 0, 0, 0]
+
+
+def test_roi_align_is_differentiable():
+    x = paddle.to_tensor(
+        np.random.default_rng(0).normal(size=(1, 2, 8, 8)).astype(
+            np.float32), stop_gradient=False)
+    boxes = paddle.to_tensor(np.array([[1.0, 1.0, 6.0, 6.0]],
+                                      np.float32))
+    out = V.roi_align(x, boxes,
+                      paddle.to_tensor(np.array([1], np.int32)), 4)
+    out.sum().backward()
+    assert float(np.abs(x.grad.numpy()).sum()) > 0
+
+
+def test_roi_pool_max_semantics():
+    x = np.zeros((1, 1, 4, 4), np.float32)
+    x[0, 0, 3, 3] = 100.0
+    out = V.roi_pool(paddle.to_tensor(x),
+                     paddle.to_tensor(np.array([[0, 0, 3, 3]],
+                                               np.float32)),
+                     paddle.to_tensor(np.array([1], np.int32)),
+                     output_size=1).numpy()
+    assert out.max() > 50.0  # the max survives pooling
+
+
+def test_box_coder_roundtrip():
+    prior = np.array([[0, 0, 10, 10], [5, 5, 15, 15]], np.float32)
+    target = np.array([[1, 1, 9, 9]], np.float32)
+    enc = V.box_coder(paddle.to_tensor(prior), [0.1, 0.1, 0.2, 0.2],
+                      paddle.to_tensor(target),
+                      code_type="encode_center_size").numpy()
+    assert enc.shape == (1, 2, 4)
+    # priors vary along dim 1 of the [T, P, 4] deltas → axis=1
+    dec = V.box_coder(paddle.to_tensor(prior), [0.1, 0.1, 0.2, 0.2],
+                      paddle.to_tensor(enc),
+                      code_type="decode_center_size", axis=1).numpy()
+    assert dec.shape == (1, 2, 4)
+    np.testing.assert_allclose(dec[0, 0], target[0], atol=1e-4)
+    np.testing.assert_allclose(dec[0, 1], target[0], atol=1e-4)
+    import pytest as _pytest
+    with _pytest.raises(ValueError, match="prior count"):
+        V.box_coder(paddle.to_tensor(prior), None,
+                    paddle.to_tensor(enc),
+                    code_type="decode_center_size", axis=0)
+    with _pytest.raises(NotImplementedError):
+        V.yolo_box(paddle.to_tensor(np.zeros((1, 27, 2, 2), np.float32)),
+                   paddle.to_tensor(np.array([[32, 32]], np.int32)),
+                   anchors=[1, 2, 3, 4, 5, 6], class_num=4,
+                   conf_thresh=0.1, downsample_ratio=32, iou_aware=True)
+
+
+def test_yolo_box_shapes():
+    n, an, c, h, w = 1, 3, 4, 5, 5
+    x = np.random.default_rng(1).normal(
+        size=(n, an * (5 + c), h, w)).astype(np.float32)
+    boxes, scores = V.yolo_box(
+        paddle.to_tensor(x),
+        paddle.to_tensor(np.array([[320, 320]], np.int32)),
+        anchors=[10, 13, 16, 30, 33, 23], class_num=c,
+        conf_thresh=0.01, downsample_ratio=32)
+    assert tuple(boxes.shape) == (n, an * h * w, 4)
+    assert tuple(scores.shape) == (n, an * h * w, c)
+    b = boxes.numpy()
+    assert (b[..., 2] >= b[..., 0] - 1e-3).all()
+
+
+def test_deform_conv2d_zero_offset_matches_conv():
+    rng = np.random.default_rng(2)
+    x = rng.normal(size=(1, 2, 6, 6)).astype(np.float32)
+    wgt = rng.normal(size=(3, 2, 3, 3)).astype(np.float32)
+    offset = np.zeros((1, 2 * 9, 4, 4), np.float32)
+    out = V.deform_conv2d(paddle.to_tensor(x),
+                          paddle.to_tensor(offset),
+                          paddle.to_tensor(wgt)).numpy()
+    want = paddle.nn.functional.conv2d(
+        paddle.to_tensor(x), paddle.to_tensor(wgt)).numpy()
+    np.testing.assert_allclose(out, want, atol=1e-4, rtol=1e-4)
+
+
+def test_deform_conv2d_layer_and_mask():
+    layer = V.DeformConv2D(2, 3, 3)
+    x = paddle.to_tensor(
+        np.random.default_rng(3).normal(size=(1, 2, 6, 6)).astype(
+            np.float32))
+    offset = paddle.to_tensor(np.zeros((1, 18, 4, 4), np.float32))
+    mask = paddle.to_tensor(np.ones((1, 9, 4, 4), np.float32))
+    out = layer(x, offset, mask)
+    assert tuple(out.shape) == (1, 3, 4, 4)
