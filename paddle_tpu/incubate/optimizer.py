@@ -24,7 +24,14 @@ class LookAhead:
         self._slow = {}
 
     def __getattr__(self, name):
-        return getattr(self.inner_optimizer, name)
+        # object.__getattribute__ avoids infinite recursion when the
+        # instance __dict__ is not yet populated (deepcopy/unpickle
+        # probe attributes before __init__ runs)
+        try:
+            inner = object.__getattribute__(self, "inner_optimizer")
+        except AttributeError:
+            raise AttributeError(name) from None
+        return getattr(inner, name)
 
     def step(self):
         params = [p for p in self.inner_optimizer._parameter_list
@@ -90,25 +97,42 @@ class ModelAverage:
         self._sum = {id(p): jnp.zeros_like(p._value)
                      for p in self._params}
         self._n = 0
+        self._updates = 0
         self._backup = None
+
+    def _window(self):
+        """Effective window: everything seen so far, capped by
+        max_average_window and by rate*num_updates (floored at
+        min_average_window) — the reference uses the rate to decide
+        when accumulated history is dropped; the streaming equivalent
+        is this cap."""
+        desired = max(self.min_w, int(self.rate * self._updates))
+        return max(1, min(self._updates, self.max_w, desired))
 
     def step(self):
         """Accumulate the current weights (call after optimizer.step())."""
-        self._n = min(self._n + 1, self.max_w)
+        self._updates += 1
+        win = self._window()
+        # decay only once the window is SATURATED (n already == win
+        # before this sample); while it grows, plain accumulation
+        saturated = self._n >= win
+        if not saturated:
+            self._n += 1
         for p in self._params:
-            # windowed running average: old avg decays once the window
-            # is saturated (the reference restarts sums; a decaying sum
-            # is the streaming equivalent)
             s = self._sum[id(p)]
-            if self._n >= self.max_w:
-                s = s * (1.0 - 1.0 / self.max_w)
+            if saturated:
+                # the reference restarts sums at the window boundary; a
+                # decaying sum is the streaming equivalent
+                s = s * (1.0 - 1.0 / win)
             self._sum[id(p)] = s + p._value
 
     def apply(self, executor=None, need_restore=True):
         if self._n == 0:
             return
-        denom = min(self._n, self.max_w)
-        if need_restore:
+        denom = min(self._n, self._window())
+        if need_restore and self._backup is None:
+            # never overwrite an existing backup: a second apply()
+            # before restore() must not lose the training weights
             self._backup = {id(p): p._value for p in self._params}
         for p in self._params:
             p._value = (self._sum[id(p)] / denom).astype(p._value.dtype)

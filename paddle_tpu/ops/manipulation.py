@@ -29,6 +29,7 @@ __all__ = [
     "swapaxes", "as_strided", "view", "view_as", "tensordot", "atleast_1d",
     "atleast_2d", "atleast_3d", "tolist", "flatten_", "unfold",
     "shard_index", "tensor_split", "hsplit", "vsplit", "dsplit",
+    "diagonal", "searchsorted", "bucketize", "index_fill", "masked_scatter", "select_scatter", "slice_scatter", "column_stack", "row_stack",
 ]
 
 
@@ -704,3 +705,111 @@ def shard_index(input, index_num, nshards, shard_id, ignore_value=-1):
                          shard_id=int(shard_id),
                          ignore_value=int(ignore_value)),
                     differentiable=False)
+
+
+def diagonal(x, offset=0, axis1=0, axis2=1, name=None):
+    return dispatch(
+        "diagonal",
+        lambda v, offset, axis1, axis2: jnp.diagonal(
+            v, offset=offset, axis1=axis1, axis2=axis2),
+        (x,), dict(offset=int(offset), axis1=int(axis1),
+                   axis2=int(axis2)))
+
+
+def searchsorted(sorted_sequence, values, out_int32=False, right=False,
+                 name=None):
+    def impl(seq, vals, right, out_int32):
+        side = "right" if right else "left"
+        if seq.ndim == 1:
+            out = jnp.searchsorted(seq, vals, side=side)
+        else:
+            # batched rows: vmap over all leading dims
+            flat_seq = seq.reshape(-1, seq.shape[-1])
+            flat_vals = vals.reshape(-1, vals.shape[-1])
+            out = jax.vmap(
+                lambda s, v: jnp.searchsorted(s, v, side=side))(
+                flat_seq, flat_vals).reshape(vals.shape)
+        return out.astype(jnp.int32 if out_int32 else jnp.int64)
+
+    return dispatch("searchsorted", impl, (sorted_sequence, values),
+                    dict(right=bool(right), out_int32=bool(out_int32)),
+                    differentiable=False)
+
+
+def bucketize(x, sorted_sequence, out_int32=False, right=False,
+              name=None):
+    return searchsorted(sorted_sequence, x, out_int32=out_int32,
+                        right=right)
+
+
+def index_fill(x, index, axis, value, name=None):
+    def impl(v, idx, axis, value):
+        moved = jnp.moveaxis(v, axis, 0)
+        moved = moved.at[idx].set(value)
+        return jnp.moveaxis(moved, 0, axis)
+
+    return dispatch("index_fill", impl, (x, index),
+                    dict(axis=int(axis),
+                         value=float(value) if not isinstance(
+                             value, (list, tuple)) else value))
+
+
+def masked_scatter(x, mask, value, name=None):
+    """Fill True positions of mask (in order) with value's elements."""
+    try:  # eager: enough source elements? (traced masks skip the check)
+        needed = int(np.asarray(
+            mask._value if hasattr(mask, "_value") else mask).sum())
+        have = int(np.prod(np.asarray(
+            value._value if hasattr(value, "_value") else value).shape))
+        if have < needed:
+            raise ValueError(
+                f"masked_scatter: value has {have} elements but mask "
+                f"selects {needed}")
+    except (TypeError, jax.errors.ConcretizationTypeError):
+        pass
+
+    def impl(v, m, val):
+        m = jnp.broadcast_to(m, v.shape)
+        flat_v = v.reshape(-1)
+        flat_m = m.reshape(-1)
+        # k-th True position takes value.flatten()[k]
+        order = jnp.cumsum(flat_m.astype(jnp.int32)) - 1
+        src = val.reshape(-1)
+        take = jnp.clip(order, 0, src.shape[0] - 1)
+        return jnp.where(flat_m, src[take], flat_v).reshape(v.shape)
+
+    return dispatch("masked_scatter", impl, (x, mask, value), {})
+
+
+def select_scatter(x, values, axis, index, name=None):
+    def impl(v, src, axis, index):
+        idx = [builtins.slice(None)] * v.ndim  # `slice` op shadows builtin
+        idx[axis] = index
+        return v.at[tuple(idx)].set(src)
+
+    return dispatch("select_scatter", impl, (x, values),
+                    dict(axis=int(axis), index=int(index)))
+
+
+def slice_scatter(x, value, axes, starts, ends, strides, name=None):
+    def impl(v, src, axes, starts, ends, strides):
+        idx = [builtins.slice(None)] * v.ndim  # `slice` op shadows builtin
+        for a, s, e, st in zip(axes, starts, ends, strides):
+            idx[a] = builtins.slice(s, e, st)
+        return v.at[tuple(idx)].set(src)
+
+    return dispatch("slice_scatter", impl, (x, value),
+                    dict(axes=tuple(axes), starts=tuple(starts),
+                         ends=tuple(ends), strides=tuple(strides)))
+
+
+def column_stack(x, name=None):
+    def impl(*vs):
+        return jnp.column_stack(vs)
+    return dispatch("column_stack", impl, tuple(x), {})
+
+
+def row_stack(x, name=None):
+    def impl(*vs):
+        return jnp.vstack(vs)
+    return dispatch("row_stack", impl, tuple(x), {})

@@ -27,6 +27,7 @@ __all__ = [
     "hypot", "ldexp", "logaddexp", "inner", "outer", "kron", "trace",
     "deg2rad", "diff", "angle", "conj", "real", "imag", "gcd", "lcm",
     "cumsum", "cumprod", "cummax", "cummin", "sgn", "take", "increment",
+    "copysign", "trapezoid", "cumulative_trapezoid", "logcumsumexp", "renorm", "gammaln", "polygamma", "i0", "i1", "sinc", "signbit", "isposinf", "isneginf", "isreal",
 ]
 
 
@@ -289,3 +290,103 @@ def multiply_(x, y, name=None):
     out = multiply(x, y)
     x._inplace_update(out._value, out._grad_node, out._out_index)
     return x
+
+
+def copysign(x, y, name=None):
+    return dispatch("copysign", lambda a, b: jnp.copysign(a, b), (x, y),
+                    {})
+
+
+def trapezoid(y, x=None, dx=None, axis=-1, name=None):
+    def impl(yv, *maybe_x, dx, axis):
+        xv = maybe_x[0] if maybe_x else None
+        return jnp.trapezoid(yv, x=xv, dx=1.0 if dx is None else dx,
+                             axis=axis)
+    args = (y, x) if x is not None else (y,)
+    return dispatch("trapezoid", impl, args, dict(dx=dx, axis=axis))
+
+
+def cumulative_trapezoid(y, x=None, dx=None, axis=-1, name=None):
+    def impl(yv, *maybe_x, dx, axis):
+        import jax.scipy.integrate as _ji  # noqa: F401  (availability)
+        xv = maybe_x[0] if maybe_x else None
+        n = yv.shape[axis]
+        y1 = jax.lax.slice_in_dim(yv, 1, n, axis=axis)
+        y0 = jax.lax.slice_in_dim(yv, 0, n - 1, axis=axis)
+        if xv is not None:
+            x1 = jax.lax.slice_in_dim(xv, 1, n, axis=axis)
+            x0 = jax.lax.slice_in_dim(xv, 0, n - 1, axis=axis)
+            seg = (x1 - x0) * (y0 + y1) / 2.0
+        else:
+            seg = (1.0 if dx is None else dx) * (y0 + y1) / 2.0
+        return jnp.cumsum(seg, axis=axis)
+    args = (y, x) if x is not None else (y,)
+    return dispatch("cumulative_trapezoid", impl, args,
+                    dict(dx=dx, axis=axis))
+
+
+def logcumsumexp(x, axis=None, dtype=None, name=None):
+    def impl(v, axis):
+        if axis is None:
+            v, axis = v.reshape(-1), 0
+        # global-max stabilization: exact in log domain, one pass
+        mx = jnp.max(v, axis=axis, keepdims=True)
+        return jnp.log(jnp.cumsum(jnp.exp(v - mx), axis=axis)) + mx
+
+    return dispatch("logcumsumexp", impl, (x,), dict(axis=axis))
+
+
+def renorm(x, p, axis, max_norm, name=None):
+    def impl(v, p, axis, max_norm):
+        dims = [d for d in range(v.ndim) if d != axis]
+        norms = jnp.sum(jnp.abs(v) ** p, axis=dims,
+                        keepdims=True) ** (1.0 / p)
+        factor = jnp.where(norms > max_norm,
+                           max_norm / jnp.maximum(norms, 1e-12), 1.0)
+        return v * factor
+    return dispatch("renorm", impl, (x,),
+                    dict(p=float(p), axis=int(axis),
+                         max_norm=float(max_norm)))
+
+
+def gammaln(x, name=None):
+    return dispatch("gammaln",
+                    lambda v: jax.scipy.special.gammaln(v), (x,), {})
+
+
+def polygamma(x, n, name=None):
+    return dispatch("polygamma",
+                    lambda v, n: jax.scipy.special.polygamma(n, v),
+                    (x,), dict(n=int(n)))
+
+
+def i0(x, name=None):
+    return dispatch("i0", lambda v: jax.scipy.special.i0(v), (x,), {})
+
+
+def i1(x, name=None):
+    return dispatch("i1", lambda v: jax.scipy.special.i1(v), (x,), {})
+
+
+def sinc(x, name=None):
+    return dispatch("sinc", lambda v: jnp.sinc(v), (x,), {})
+
+
+def signbit(x, name=None):
+    return dispatch("signbit", lambda v: jnp.signbit(v), (x,), {},
+                    differentiable=False)
+
+
+def isposinf(x, name=None):
+    return dispatch("isposinf", lambda v: jnp.isposinf(v), (x,), {},
+                    differentiable=False)
+
+
+def isneginf(x, name=None):
+    return dispatch("isneginf", lambda v: jnp.isneginf(v), (x,), {},
+                    differentiable=False)
+
+
+def isreal(x, name=None):
+    return dispatch("isreal", lambda v: jnp.isreal(v), (x,), {},
+                    differentiable=False)

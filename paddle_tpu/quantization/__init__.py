@@ -98,7 +98,9 @@ class FakeQuanterWithAbsMax:
             # fresh jit compile each step in the eager op cache
             scale = Tensor(jnp.asarray(scale, jnp.float32),
                            _internal=True, stop_gradient=True)
-        except (jax.errors.TracerArrayConversionError, TypeError):
+        except (jax.errors.ConcretizationTypeError, TypeError):
+            # ConcretizationTypeError is what float(tracer) raises (it
+            # is the PARENT of TracerArrayConversionError)
             # traced (to_static): use the frozen calibrated scale, or
             # the live per-batch max when never calibrated
             if self._scale is not None:
@@ -199,6 +201,9 @@ class QAT:
         self.config = config
 
     def quantize(self, model, inplace=True):
+        if not inplace:
+            import copy
+            model = copy.deepcopy(model)
         return _wrap_model(model, self.config,
                            FakeQuanterWithAbsMax)
 
@@ -227,6 +232,9 @@ class PTQ:
         self._observers = []
 
     def quantize(self, model, inplace=True):
+        if not inplace:
+            import copy
+            model = copy.deepcopy(model)
         ptq = self
 
         class _Observing(FakeQuanterWithAbsMax):

@@ -214,13 +214,14 @@ def box_coder(prior_box, prior_box_var, target_box,
               axis=0, name=None):
     """Encode/decode boxes against priors (SSD-style)."""
     def impl(prior, tbox, var, code_type, box_normalized, axis):
+        # var arrives as an ARRAY OPERAND (3rd positional), never an
+        # attr: a Tensor variance must not be baked as a compile-time
+        # constant, and arrays in attrs would defeat the eager op cache
         norm = 0.0 if box_normalized else 1.0
         pw = prior[:, 2] - prior[:, 0] + norm
         phh = prior[:, 3] - prior[:, 1] + norm
         pcx = prior[:, 0] + pw * 0.5
         pcy = prior[:, 1] + phh * 0.5
-        if var is None:
-            var = jnp.ones((4,), jnp.float32)
         if var.ndim == 1:
             var = jnp.broadcast_to(var, prior.shape)
         if code_type == "encode_center_size":
@@ -259,13 +260,15 @@ def box_coder(prior_box, prior_box_var, target_box,
         return jnp.stack([cx - w / 2, cy - h / 2,
                           cx + w / 2 - norm, cy + h / 2 - norm], -1)
 
-    var_arg = prior_box_var if isinstance(prior_box_var, Tensor) else (
-        None if prior_box_var is None
-        else jnp.asarray(prior_box_var, jnp.float32))
-    return dispatch("box_coder", impl, (prior_box, target_box),
-                    dict(var=var_arg if not isinstance(var_arg, Tensor)
-                         else var_arg._value,
-                         code_type=code_type,
+    if prior_box_var is None:
+        var_arg = to_tensor(np.ones(4, np.float32))
+    elif isinstance(prior_box_var, Tensor):
+        var_arg = prior_box_var
+    else:
+        var_arg = to_tensor(np.asarray(prior_box_var, np.float32))
+    return dispatch("box_coder", impl,
+                    (prior_box, target_box, var_arg),
+                    dict(code_type=code_type,
                          box_normalized=bool(box_normalized),
                          axis=int(axis)))
 

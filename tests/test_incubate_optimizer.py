@@ -67,3 +67,18 @@ def test_lookahead_state_roundtrip():
                     alpha=0.5, k=3)
     la2.set_state_dict(sd)
     assert la2._step_num == 1 and la2._slow
+
+
+def test_model_average_double_apply_keeps_backup():
+    paddle.seed(4)
+    m = nn.Linear(8, 2)
+    opt = optimizer.SGD(learning_rate=0.05, parameters=m.parameters())
+    ma = ModelAverage(0.15, parameters=m.parameters(),
+                      max_average_window=100)
+    _step(m, opt, 0)
+    ma.step()
+    real = m.weight.numpy().copy()
+    ma.apply()
+    ma.apply()  # second apply must NOT overwrite the backup
+    ma.restore()
+    np.testing.assert_allclose(m.weight.numpy(), real)

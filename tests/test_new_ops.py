@@ -1,0 +1,93 @@
+"""Round-3 tensor-op additions (math + manipulation) vs numpy."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def _t(a):
+    return paddle.to_tensor(np.asarray(a))
+
+
+def test_math_additions():
+    x = np.linspace(-2, 2, 7).astype(np.float32)
+    np.testing.assert_allclose(
+        paddle.copysign(_t(x), _t(-np.ones_like(x))).numpy(),
+        np.copysign(x, -1), rtol=1e-6)
+    np.testing.assert_allclose(paddle.sinc(_t(x)).numpy(), np.sinc(x),
+                               rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(paddle.signbit(_t(x)).numpy(),
+                               np.signbit(x))
+    y = np.abs(x) + 0.1
+    np.testing.assert_allclose(paddle.trapezoid(_t(y)).numpy(),
+                               np.trapz(y), rtol=1e-5)
+    np.testing.assert_allclose(
+        paddle.cumulative_trapezoid(_t(y)).numpy(),
+        np.cumsum((y[1:] + y[:-1]) / 2), rtol=1e-5)
+    np.testing.assert_allclose(
+        paddle.logcumsumexp(_t(x)).numpy(),
+        np.log(np.cumsum(np.exp(x))), rtol=1e-5)
+    np.testing.assert_allclose(
+        paddle.gammaln(_t(y)).numpy(),
+        np.array([np.math.lgamma(v) for v in y], np.float32)
+        if hasattr(np, "math") else
+        __import__("scipy.special", fromlist=["gammaln"]).gammaln(y),
+        rtol=1e-5)
+    np.testing.assert_allclose(paddle.i0(_t(y)).numpy(),
+                               np.i0(y), rtol=1e-5)
+    inf = np.array([np.inf, -np.inf, 1.0], np.float32)
+    np.testing.assert_allclose(paddle.isposinf(_t(inf)).numpy(),
+                               [True, False, False])
+    np.testing.assert_allclose(paddle.isneginf(_t(inf)).numpy(),
+                               [False, True, False])
+    assert paddle.isreal(_t(x)).numpy().all()
+
+
+def test_renorm():
+    x = np.array([[3.0, 4.0], [0.3, 0.4]], np.float32)
+    out = paddle.renorm(_t(x), p=2, axis=0, max_norm=1.0).numpy()
+    np.testing.assert_allclose(np.linalg.norm(out[0]), 1.0, rtol=1e-5)
+    np.testing.assert_allclose(out[1], x[1], rtol=1e-6)  # under the cap
+
+
+def test_manipulation_additions():
+    m = np.arange(9, dtype=np.float32).reshape(3, 3)
+    np.testing.assert_allclose(paddle.diagonal(_t(m)).numpy(),
+                               np.diagonal(m))
+    np.testing.assert_allclose(
+        paddle.diagonal(_t(m), offset=1).numpy(),
+        np.diagonal(m, offset=1))
+
+    seq = np.array([1.0, 3.0, 5.0, 7.0], np.float32)
+    vals = np.array([0.0, 3.0, 8.0], np.float32)
+    np.testing.assert_allclose(
+        paddle.searchsorted(_t(seq), _t(vals)).numpy(),
+        np.searchsorted(seq, vals))
+    np.testing.assert_allclose(
+        paddle.bucketize(_t(vals), _t(seq), right=True).numpy(),
+        np.searchsorted(seq, vals, side="right"))
+
+    out = paddle.index_fill(_t(m), _t(np.array([0, 2])), 0, -1.0).numpy()
+    assert (out[[0, 2]] == -1).all() and (out[1] == m[1]).all()
+
+    mask = np.array([[True, False, True]] * 3)
+    filled = paddle.masked_scatter(
+        _t(m), _t(mask), _t(np.arange(100, 106, dtype=np.float32)))
+    got = filled.numpy()
+    assert got[0, 0] == 100 and got[0, 2] == 101 and got[1, 1] == m[1, 1]
+
+    ss = paddle.select_scatter(_t(m), _t(np.zeros(3, np.float32)), 0,
+                               1).numpy()
+    assert (ss[1] == 0).all() and (ss[0] == m[0]).all()
+
+    sl = paddle.slice_scatter(
+        _t(m), _t(np.full((3, 1), 9.0, np.float32)), [1], [0], [1],
+        [1]).numpy()
+    assert (sl[:, 0] == 9).all()
+
+    a, b = np.arange(3.0, dtype=np.float32), np.arange(3.0, 6.0,
+                                                      dtype=np.float32)
+    np.testing.assert_allclose(paddle.column_stack([_t(a), _t(b)]).numpy(),
+                               np.column_stack([a, b]))
+    np.testing.assert_allclose(paddle.row_stack([_t(a), _t(b)]).numpy(),
+                               np.vstack([a, b]))
