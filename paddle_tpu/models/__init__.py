@@ -10,9 +10,14 @@ and the multichip dryrun drive.
 from .gpt import GPTConfig, GPTModel, GPTForCausalLM, GPTPretrainingCriterion
 from .bert import BertConfig, BertModel, BertForMaskedLM
 from .llama import LlamaConfig, LlamaModel, LlamaForCausalLM
+from .ernie import (ErnieConfig, ErnieModel, ErnieForMaskedLM,
+                    ErnieForSequenceClassification)
+from .generation import GenerationMixin, generate
 
 __all__ = [
     "GPTConfig", "GPTModel", "GPTForCausalLM", "GPTPretrainingCriterion",
     "BertConfig", "BertModel", "BertForMaskedLM",
     "LlamaConfig", "LlamaModel", "LlamaForCausalLM",
+    "ErnieConfig", "ErnieModel", "ErnieForMaskedLM",
+    "ErnieForSequenceClassification", "GenerationMixin", "generate",
 ]

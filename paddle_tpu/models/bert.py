@@ -92,18 +92,20 @@ class BertModel(nn.Layer):
         return x
 
 
-class BertForMaskedLM(nn.Layer):
+class TiedMLMHead(nn.Layer):
+    """transform → gelu → LN → logits tied to the word embedding; the
+    shared masked-LM head for BERT-family encoders (ERNIE reuses it)."""
+
     def __init__(self, cfg: BertConfig):
         super().__init__()
-        self.bert = BertModel(cfg)
         self.transform = nn.Linear(cfg.hidden_size, cfg.hidden_size)
-        self.ln = nn.LayerNorm(cfg.hidden_size, epsilon=cfg.layer_norm_eps)
+        self.ln = nn.LayerNorm(cfg.hidden_size,
+                               epsilon=cfg.layer_norm_eps)
 
-    def forward(self, input_ids, token_type_ids=None, labels=None):
-        hidden = self.bert(input_ids, token_type_ids)
-        hidden = self.ln(F.gelu(self.transform(hidden), approximate=True))
-        logits = paddle.matmul(hidden,
-                               self.bert.embeddings.word_embeddings.weight,
+    def forward(self, hidden, word_embedding_weight, labels=None):
+        hidden = self.ln(F.gelu(self.transform(hidden),
+                                approximate=True))
+        logits = paddle.matmul(hidden, word_embedding_weight,
                                transpose_y=True)
         if labels is None:
             return logits
@@ -112,3 +114,16 @@ class BertForMaskedLM(nn.Layer):
                                paddle.reshape(labels, [-1]),
                                ignore_index=-100, reduction="mean")
         return loss, logits
+
+
+class BertForMaskedLM(nn.Layer):
+    def __init__(self, cfg: BertConfig):
+        super().__init__()
+        self.bert = BertModel(cfg)
+        self.cls = TiedMLMHead(cfg)
+
+    def forward(self, input_ids, token_type_ids=None, labels=None):
+        hidden = self.bert(input_ids, token_type_ids)
+        return self.cls(hidden,
+                        self.bert.embeddings.word_embeddings.weight,
+                        labels)

@@ -1,0 +1,102 @@
+"""ERNIE: Paddle's flagship pretrained-LM family.
+
+Reference parity: `paddlenlp/transformers/ernie/modeling.py`
+(ErnieModel = BERT-style encoder + task-type embeddings + pooler;
+ErnieForSequenceClassification / ErnieForMaskedLM heads [UNVERIFIED —
+empty reference mount]).  Reuses this package's Bert blocks — the
+architectures differ only in the task-type embedding term and the
+pooled [CLS] head, so the TPU-native encoder (Pallas attention via the
+functional layer, XLA-fused residual blocks) is shared.
+"""
+from __future__ import annotations
+
+import paddle_tpu as paddle
+from .. import nn
+from ..nn import functional as F
+from .bert import BertConfig, BertEmbeddings, BertLayer, TiedMLMHead
+
+__all__ = ["ErnieConfig", "ErnieModel", "ErnieForMaskedLM",
+           "ErnieForSequenceClassification"]
+
+
+class ErnieConfig(BertConfig):
+    def __init__(self, task_type_vocab_size=3, use_task_id=True,
+                 num_labels=2, **kw):
+        super().__init__(**kw)
+        self.task_type_vocab_size = task_type_vocab_size
+        self.use_task_id = use_task_id
+        self.num_labels = num_labels
+
+
+class ErnieEmbeddings(BertEmbeddings):
+    """Bert embeddings + the ERNIE task-type embedding term, summed
+    BEFORE the shared LayerNorm (reference order: LN(word + pos +
+    token_type + task_type))."""
+
+    def __init__(self, cfg: ErnieConfig):
+        super().__init__(cfg)
+        self.task_type_embeddings = None
+        if cfg.use_task_id:
+            self.task_type_embeddings = nn.Embedding(
+                cfg.task_type_vocab_size, cfg.hidden_size)
+
+    def forward(self, input_ids, token_type_ids=None, task_type_ids=None):
+        b, s = input_ids.shape
+        pos = paddle.arange(s, dtype="int64")
+        x = (self.word_embeddings(input_ids)
+             + self.position_embeddings(pos))
+        if token_type_ids is not None:
+            x = x + self.token_type_embeddings(token_type_ids)
+        if self.task_type_embeddings is not None:
+            if task_type_ids is None:
+                task_type_ids = paddle.zeros_like(input_ids)
+            x = x + self.task_type_embeddings(task_type_ids)
+        return self.layer_norm(x)
+
+
+class ErnieModel(nn.Layer):
+    def __init__(self, cfg: ErnieConfig):
+        super().__init__()
+        self.config = cfg
+        self.embeddings = ErnieEmbeddings(cfg)
+        self.encoder = nn.LayerList(
+            [BertLayer(cfg) for _ in range(cfg.num_hidden_layers)])
+        self.pooler = nn.Linear(cfg.hidden_size, cfg.hidden_size)
+
+    def forward(self, input_ids, token_type_ids=None, task_type_ids=None,
+                attn_mask=None):
+        x = self.embeddings(input_ids, token_type_ids, task_type_ids)
+        for layer in self.encoder:
+            x = layer(x, attn_mask)
+        pooled = F.tanh(self.pooler(x[:, 0]))
+        return x, pooled
+
+
+class ErnieForMaskedLM(nn.Layer):
+    def __init__(self, cfg: ErnieConfig):
+        super().__init__()
+        self.ernie = ErnieModel(cfg)
+        self.cls = TiedMLMHead(cfg)
+
+    def forward(self, input_ids, token_type_ids=None, labels=None):
+        hidden, _ = self.ernie(input_ids, token_type_ids)
+        return self.cls(hidden,
+                        self.ernie.embeddings.word_embeddings.weight,
+                        labels)
+
+
+class ErnieForSequenceClassification(nn.Layer):
+    def __init__(self, cfg: ErnieConfig, dropout_prob=0.1):
+        super().__init__()
+        self.ernie = ErnieModel(cfg)
+        self.dropout = nn.Dropout(dropout_prob)
+        self.classifier = nn.Linear(cfg.hidden_size, cfg.num_labels)
+
+    def forward(self, input_ids, token_type_ids=None, labels=None):
+        _, pooled = self.ernie(input_ids, token_type_ids)
+        logits = self.classifier(self.dropout(pooled))
+        if labels is None:
+            return logits
+        loss = F.cross_entropy(logits, paddle.reshape(labels, [-1]),
+                               reduction="mean")
+        return loss, logits

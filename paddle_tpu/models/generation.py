@@ -1,0 +1,104 @@
+"""Autoregressive generation for the causal-LM families.
+
+Reference parity: PaddleNLP's `generation_utils.py` (greedy / sampling
+decode loops [UNVERIFIED — empty reference mount]).
+
+TPU note: this is the straightforward host-loop decode (full-sequence
+recompute per step — O(n²) but correct for every model here, and each
+step is one compiled forward).  The compile-friendly fixed-shape
+`lax.scan` + KV-cache variant is the planned upgrade; on one chip at
+the toy sizes the dryruns use, recompute decode is compile-cache
+friendly because the sequence grows by one each call only up to
+max_length (bounded trace count).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["GenerationMixin", "generate"]
+
+
+def _sample_logits(logits_row, do_sample, top_k, top_p, temperature,
+                   rng):
+    z = np.asarray(logits_row, np.float64)
+    if not do_sample:
+        return int(z.argmax())
+    if temperature and temperature != 1.0:
+        z = z / float(temperature)
+    z = z - z.max()
+    p = np.exp(z)
+    p /= p.sum()
+    if top_k:
+        k = min(int(top_k), len(p))  # clamp to vocab (HF semantics)
+        kth = np.sort(p)[-k]
+        p = np.where(p >= kth, p, 0.0)
+        p /= p.sum()  # renormalize BEFORE nucleus filtering
+    if top_p and top_p < 1.0:
+        order = np.argsort(-p)
+        cum = np.cumsum(p[order])
+        # nucleus: smallest set whose cumulative mass REACHES top_p —
+        # the boundary token is included (cum before it < top_p)
+        cut = (cum - p[order]) < top_p
+        mask = np.zeros_like(p, bool)
+        mask[order[cut]] = True
+        p = np.where(mask, p, 0.0)
+    p /= p.sum()
+    return int(rng.choice(len(p), p=p))
+
+
+def _model_max_positions(model):
+    """Find max_position_embeddings on the model's config, if any."""
+    for attr in ("config",):
+        for obj in (model, getattr(model, "gpt", None),
+                    getattr(model, "llama", None),
+                    getattr(model, "model", None)):
+            cfg = getattr(obj, attr, None) if obj is not None else None
+            mp = getattr(cfg, "max_position_embeddings", None)
+            if mp is not None:
+                return int(mp)
+    return None
+
+
+def generate(model, input_ids, max_new_tokens=20, max_length=None,
+             do_sample=False, top_k=0, top_p=1.0, temperature=1.0,
+             eos_token_id=None, pad_token_id=None, seed=None):
+    """Decode continuation tokens; returns the full [B, S+T] ids."""
+    import paddle_tpu as paddle
+    from ..core.autograd import no_grad
+
+    ids = np.asarray(input_ids.numpy()
+                     if hasattr(input_ids, "numpy") else input_ids)
+    if ids.ndim == 1:
+        ids = ids[None, :]
+    rng = np.random.default_rng(seed)
+    if max_length is not None:
+        max_new_tokens = max(0, int(max_length) - ids.shape[1])
+    # never decode past the model's position table (silent clamping on
+    # accelerators, a hard error on CPU's embedding bounds check)
+    mp = _model_max_positions(model)
+    if mp is not None:
+        max_new_tokens = max(0, min(int(max_new_tokens),
+                                    mp - ids.shape[1]))
+    done = np.zeros(ids.shape[0], bool)
+    for _ in range(int(max_new_tokens)):
+        with no_grad():
+            logits = model(paddle.to_tensor(ids.astype(np.int64)))
+        if isinstance(logits, (tuple, list)):
+            logits = logits[-1]
+        last = np.asarray(logits.numpy())[:, -1, :]
+        nxt = np.array([_sample_logits(last[b], do_sample, top_k, top_p,
+                                       temperature, rng)
+                        for b in range(ids.shape[0])], ids.dtype)
+        if eos_token_id is not None:
+            fill = eos_token_id if pad_token_id is None else pad_token_id
+            nxt = np.where(done, fill, nxt)
+            done |= nxt == eos_token_id
+        ids = np.concatenate([ids, nxt[:, None]], axis=1)
+        if eos_token_id is not None and done.all():
+            break
+    return paddle.to_tensor(ids)
+
+
+class GenerationMixin:
+    def generate(self, input_ids, **kwargs):
+        return generate(self, input_ids, **kwargs)

@@ -13,6 +13,7 @@ from dataclasses import dataclass
 import paddle_tpu as paddle
 from .. import nn
 from ..nn import functional as F
+from .generation import GenerationMixin
 
 
 @dataclass
@@ -105,7 +106,7 @@ class GPTModel(nn.Layer):
         return self.ln_f(x)
 
 
-class GPTForCausalLM(nn.Layer):
+class GPTForCausalLM(nn.Layer, GenerationMixin):
     def __init__(self, cfg: GPTConfig):
         super().__init__()
         self.gpt = GPTModel(cfg)

@@ -15,6 +15,7 @@ import numpy as np
 import paddle_tpu as paddle
 from .. import nn
 from ..nn import functional as F
+from .generation import GenerationMixin
 
 
 @dataclass
@@ -160,7 +161,7 @@ class LlamaModel(nn.Layer):
         return self.norm(x)
 
 
-class LlamaForCausalLM(nn.Layer):
+class LlamaForCausalLM(nn.Layer, GenerationMixin):
     def __init__(self, cfg: LlamaConfig):
         super().__init__()
         self.llama = LlamaModel(cfg)

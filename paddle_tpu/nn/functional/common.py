@@ -326,6 +326,29 @@ def one_hot(x, num_classes, name=None):
 
 
 def embedding(x, weight, padding_idx=None, sparse=False, name=None):
+    # eager bounds check: jnp.take clamps out-of-range ids SILENTLY
+    # (garbage lookups, NaN losses downstream); the reference raises.
+    # Concrete HOST-side ids only — traced ids follow XLA clamp
+    # semantics, and device-resident ids on an accelerator skip the
+    # check rather than forcing a blocking device→host sync per call.
+    try:
+        import numpy as _np
+        val = x._value if hasattr(x, "_value") else x
+        if not (isinstance(val, _np.ndarray)
+                or jax.default_backend() == "cpu"):
+            raise TypeError  # skip: device array on an accelerator
+        ids_v = _np.asarray(val)
+        n = (weight._value if hasattr(weight, "_value")
+             else weight).shape[0]
+        if ids_v.size and (int(ids_v.min()) < 0
+                           or int(ids_v.max()) >= n):
+            raise ValueError(
+                f"embedding: ids must be in [0, {n}), got range "
+                f"[{int(ids_v.min())}, {int(ids_v.max())}]")
+    except (TypeError, jax.errors.ConcretizationTypeError,
+            jax.errors.TracerArrayConversionError):
+        pass
+
     def impl(ids, w, *, padding_idx):
         out = jnp.take(w, ids, axis=0)
         if padding_idx is not None:

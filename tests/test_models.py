@@ -109,3 +109,75 @@ def test_resnet50_forward():
         np.random.RandomState(0).randn(2, 3, 32, 32).astype(np.float32))
     out = m(x)
     assert tuple(out.shape) == (2, 10)
+
+
+def test_ernie_trains_and_classifies():
+    from paddle_tpu.models import (ErnieConfig, ErnieForMaskedLM,
+                                   ErnieForSequenceClassification)
+    cfg = ErnieConfig(vocab_size=128, hidden_size=32,
+                      intermediate_size=64, num_hidden_layers=2,
+                      num_attention_heads=2,
+                      max_position_embeddings=32, num_labels=3)
+    paddle.seed(5)
+    mlm = ErnieForMaskedLM(cfg)
+    ids = paddle.to_tensor(np.random.RandomState(1).randint(
+        0, cfg.vocab_size, (2, 16)).astype(np.int64))
+    losses = []
+    opt = optimizer.AdamW(learning_rate=1e-3,
+                          parameters=mlm.parameters())
+    for i in range(3):
+        loss, _ = mlm(ids, labels=ids)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(loss))
+    assert losses[-1] < losses[0]
+
+    cls = ErnieForSequenceClassification(cfg)
+    cls.eval()
+    logits = cls(ids)
+    assert tuple(logits.shape) == (2, 3)
+    labels = paddle.to_tensor(np.array([0, 2], np.int64))
+    loss, logits = cls(ids, labels=labels)
+    assert np.isfinite(float(loss))
+
+
+def test_generate_greedy_and_sampling():
+    from paddle_tpu.models import GPTConfig, GPTForCausalLM
+    cfg = GPTConfig(vocab_size=64, hidden_size=32, num_hidden_layers=2,
+                    num_attention_heads=2, max_position_embeddings=64)
+    paddle.seed(6)
+    m = GPTForCausalLM(cfg)
+    m.eval()
+    ids = paddle.to_tensor(np.random.RandomState(2).randint(
+        0, cfg.vocab_size, (2, 4)).astype(np.int64))
+    out = m.generate(ids, max_new_tokens=5)
+    assert tuple(out.shape) == (2, 9)
+    np.testing.assert_array_equal(out.numpy()[:, :4], ids.numpy())
+    # greedy is deterministic
+    out2 = m.generate(ids, max_new_tokens=5)
+    np.testing.assert_array_equal(out.numpy(), out2.numpy())
+    # sampling with a seed is reproducible and respects max_length
+    s1 = m.generate(ids, max_length=8, do_sample=True, top_k=10,
+                    temperature=0.8, seed=0)
+    s2 = m.generate(ids, max_length=8, do_sample=True, top_k=10,
+                    temperature=0.8, seed=0)
+    assert tuple(s1.shape) == (2, 8)
+    np.testing.assert_array_equal(s1.numpy(), s2.numpy())
+
+
+def test_generate_respects_position_table():
+    from paddle_tpu.models import GPTConfig, GPTForCausalLM
+    cfg = GPTConfig(vocab_size=32, hidden_size=16, num_hidden_layers=1,
+                    num_attention_heads=2, max_position_embeddings=8)
+    paddle.seed(7)
+    m = GPTForCausalLM(cfg)
+    m.eval()
+    ids = paddle.to_tensor(np.random.RandomState(3).randint(
+        0, 32, (1, 6)).astype(np.int64))
+    out = m.generate(ids, max_new_tokens=50)  # capped at 8 positions
+    assert out.shape[1] == 8
+    # huge top_k is clamped, not an IndexError
+    out = m.generate(ids, max_new_tokens=1, do_sample=True, top_k=1000,
+                     seed=0)
+    assert out.shape[1] == 7

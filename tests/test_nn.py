@@ -216,3 +216,12 @@ def test_clip_grad_global_norm():
     clip([p])
     total = np.linalg.norm(p.grad.numpy())
     np.testing.assert_allclose(total, 1.0, rtol=1e-4)
+
+
+def test_embedding_out_of_range_raises():
+    emb = nn.Embedding(10, 4)
+    with pytest.raises(ValueError, match="ids must be in"):
+        emb(paddle.to_tensor(np.array([3, 10], np.int64)))
+    with pytest.raises(ValueError, match="ids must be in"):
+        emb(paddle.to_tensor(np.array([-1, 2], np.int64)))
+    emb(paddle.to_tensor(np.array([0, 9], np.int64)))  # bounds OK
