@@ -78,8 +78,10 @@ class ErnieForMaskedLM(nn.Layer):
         self.ernie = ErnieModel(cfg)
         self.cls = TiedMLMHead(cfg)
 
-    def forward(self, input_ids, token_type_ids=None, labels=None):
-        hidden, _ = self.ernie(input_ids, token_type_ids)
+    def forward(self, input_ids, token_type_ids=None, task_type_ids=None,
+                attn_mask=None, labels=None):
+        hidden, _ = self.ernie(input_ids, token_type_ids,
+                               task_type_ids, attn_mask)
         return self.cls(hidden,
                         self.ernie.embeddings.word_embeddings.weight,
                         labels)
@@ -92,8 +94,10 @@ class ErnieForSequenceClassification(nn.Layer):
         self.dropout = nn.Dropout(dropout_prob)
         self.classifier = nn.Linear(cfg.hidden_size, cfg.num_labels)
 
-    def forward(self, input_ids, token_type_ids=None, labels=None):
-        _, pooled = self.ernie(input_ids, token_type_ids)
+    def forward(self, input_ids, token_type_ids=None, task_type_ids=None,
+                attn_mask=None, labels=None):
+        _, pooled = self.ernie(input_ids, token_type_ids,
+                               task_type_ids, attn_mask)
         logits = self.classifier(self.dropout(pooled))
         if labels is None:
             return logits

@@ -19,6 +19,7 @@ __all__ = [
     "softsign", "tanhshrink", "log_sigmoid", "log_softmax", "softmax",
     "softmax_", "glu", "gumbel_softmax", "maxout", "thresholded_relu",
     "tanh", "tanh_",
+    "softmin",
 ]
 
 
@@ -255,3 +256,9 @@ def thresholded_relu(x, threshold=1.0, value=0.0, name=None):
         "thresholded_relu",
         lambda v, *, t, val: jnp.where(v > t, v, val), (x,),
         dict(t=float(threshold), val=float(value)))
+
+
+def softmin(x, axis=-1, name=None):
+    return dispatch("softmin",
+                    lambda v, *, axis: jax.nn.softmax(-v, axis=axis),
+                    (x,), dict(axis=int(axis)))

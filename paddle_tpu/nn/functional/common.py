@@ -19,6 +19,9 @@ __all__ = [
     "interpolate", "upsample", "cosine_similarity", "pixel_shuffle",
     "pixel_unshuffle", "unfold", "fold", "one_hot", "embedding",
     "label_smooth", "bilinear", "class_center_sample", "zeropad2d",
+    "channel_shuffle", "pairwise_distance", "affine_grid",
+    "grid_sample", "temporal_shift",
+    "feature_alpha_dropout",
 ]
 
 
@@ -87,7 +90,8 @@ def alpha_dropout(x, p=0.5, training=True, name=None):
         scale = 1.0507009873554805
         alpha_p = -alpha * scale
         keep = jax.random.bernoulli(key, 1.0 - p, v.shape)
-        a = (1.0 - p + p * alpha_p ** 2) ** -0.5
+        # variance-preserving affine (SNN paper): a = (q(1+p*a'^2))^-1/2
+        a = ((1.0 - p) * (1.0 + p * alpha_p ** 2)) ** -0.5
         b = -a * p * alpha_p
         return a * jnp.where(keep, v, alpha_p) + b
 
@@ -400,3 +404,154 @@ def class_center_sample(label, num_classes, num_samples, group=None):
     remapped = np.vectorize(lambda c: remap.get(c, -1))(arr)
     return to_tensor(remapped.astype(np.int64)), to_tensor(
         sampled.astype(np.int64))
+
+
+def channel_shuffle(x, groups, data_format="NCHW", name=None):
+    def impl(v, *, g, nchw):
+        if nchw:
+            n, c, h, w = v.shape
+            return v.reshape(n, g, c // g, h, w).swapaxes(1, 2).reshape(
+                n, c, h, w)
+        n, h, w, c = v.shape
+        return v.reshape(n, h, w, g, c // g).swapaxes(3, 4).reshape(
+            n, h, w, c)
+    return dispatch("channel_shuffle", impl, (x,),
+                    dict(g=int(groups), nchw=data_format == "NCHW"))
+
+
+def pairwise_distance(x, y, p=2.0, epsilon=1e-6, keepdim=False,
+                      name=None):
+    def impl(a, b, *, p, eps, keepdim):
+        d = a - b + eps
+        return jnp.sum(jnp.abs(d) ** p, axis=-1,
+                       keepdims=keepdim) ** (1.0 / p)
+    return dispatch("pairwise_distance", impl, (x, y),
+                    dict(p=float(p), eps=float(epsilon),
+                         keepdim=bool(keepdim)))
+
+
+def affine_grid(theta, out_shape, align_corners=True, name=None):
+    """[N, 2, 3] affine matrices → [N, H, W, 2] sampling grid."""
+    def impl(th, *, H, W, align):
+        if align:
+            ys = jnp.linspace(-1.0, 1.0, H)
+            xs = jnp.linspace(-1.0, 1.0, W)
+        else:
+            ys = (jnp.arange(H) * 2 + 1) / H - 1
+            xs = (jnp.arange(W) * 2 + 1) / W - 1
+        gx, gy = jnp.meshgrid(xs, ys)
+        ones = jnp.ones_like(gx)
+        base = jnp.stack([gx, gy, ones], axis=-1)          # H W 3
+        return jnp.einsum("hwk,nik->nhwi", base, th)       # N H W 2
+    H, W = int(out_shape[-2]), int(out_shape[-1])
+    return dispatch("affine_grid", impl, (theta,),
+                    dict(H=H, W=W, align=bool(align_corners)))
+
+
+def grid_sample(x, grid, mode="bilinear", padding_mode="zeros",
+                align_corners=True, name=None):
+    """Sample NCHW features at [N, Hg, Wg, 2] normalized (x, y) coords."""
+    def impl(v, g, *, mode, pad_mode, align):
+        n, c, H, W = v.shape
+        gx = g[..., 0]
+        gy = g[..., 1]
+        if align:
+            fx = (gx + 1) * (W - 1) / 2
+            fy = (gy + 1) * (H - 1) / 2
+        else:
+            fx = ((gx + 1) * W - 1) / 2
+            fy = ((gy + 1) * H - 1) / 2
+        if pad_mode == "border":
+            fx = jnp.clip(fx, 0, W - 1)
+            fy = jnp.clip(fy, 0, H - 1)
+        elif pad_mode == "reflection":
+            span_x = 2 * (W - 1) if align else 2 * W
+            fx = jnp.abs(jnp.mod(fx + (0 if align else 0.5), span_x)
+                         - (span_x / 2)) * -1 + span_x / 2 \
+                - (0 if align else 0.5)
+            span_y = 2 * (H - 1) if align else 2 * H
+            fy = jnp.abs(jnp.mod(fy + (0 if align else 0.5), span_y)
+                         - (span_y / 2)) * -1 + span_y / 2 \
+                - (0 if align else 0.5)
+            fx = jnp.clip(fx, 0, W - 1)
+            fy = jnp.clip(fy, 0, H - 1)
+
+        def sample(img, fy, fx):                            # C H W
+            if mode == "nearest":
+                yi = jnp.clip(jnp.round(fy), 0, H - 1).astype(jnp.int32)
+                xi = jnp.clip(jnp.round(fx), 0, W - 1).astype(jnp.int32)
+                val = img[:, yi, xi]
+                if pad_mode == "zeros":
+                    ok = ((fy > -0.5) & (fy < H - 0.5)
+                          & (fx > -0.5) & (fx < W - 0.5))
+                    val = val * ok.astype(img.dtype)
+                return val
+            y0 = jnp.floor(fy)
+            x0 = jnp.floor(fx)
+            wy1 = fy - y0
+            wx1 = fx - x0
+
+            def at(yy, xx):
+                yi = jnp.clip(yy, 0, H - 1).astype(jnp.int32)
+                xi = jnp.clip(xx, 0, W - 1).astype(jnp.int32)
+                v_ = img[:, yi, xi]
+                if pad_mode == "zeros":
+                    ok = ((yy >= 0) & (yy <= H - 1)
+                          & (xx >= 0) & (xx <= W - 1))
+                    v_ = v_ * ok.astype(img.dtype)
+                return v_
+
+            return (at(y0, x0) * (1 - wy1) * (1 - wx1)
+                    + at(y0, x0 + 1) * (1 - wy1) * wx1
+                    + at(y0 + 1, x0) * wy1 * (1 - wx1)
+                    + at(y0 + 1, x0 + 1) * wy1 * wx1)
+
+        return jax.vmap(sample)(v, fy, fx)
+
+    return dispatch("grid_sample", impl, (x, grid),
+                    dict(mode=mode, pad_mode=padding_mode,
+                         align=bool(align_corners)))
+
+
+def temporal_shift(x, seg_num, shift_ratio=0.25, data_format="NCHW",
+                   name=None):
+    """TSM: shift a channel slice one step along the segment dim."""
+    def impl(v, *, seg, ratio, nchw):
+        if not nchw:  # NHWC → NCHW, shift, back
+            v = jnp.moveaxis(v, -1, 1)
+        nt, c, h, w = v.shape
+        n = nt // seg
+        v = v.reshape(n, seg, c, h, w)
+        fold = int(c * ratio)
+        left = jnp.concatenate(
+            [v[:, 1:, :fold], jnp.zeros_like(v[:, :1, :fold])], axis=1)
+        right = jnp.concatenate(
+            [jnp.zeros_like(v[:, :1, fold:2 * fold]),
+             v[:, :-1, fold:2 * fold]], axis=1)
+        keep = v[:, :, 2 * fold:]
+        out = jnp.concatenate([left, right, keep], axis=2)
+        out = out.reshape(nt, c, h, w)
+        return out if nchw else jnp.moveaxis(out, 1, -1)
+    return dispatch("temporal_shift", impl, (x,),
+                    dict(seg=int(seg_num), ratio=float(shift_ratio),
+                         nchw=data_format == "NCHW"))
+
+
+def feature_alpha_dropout(x, p=0.5, training=True, name=None):
+    """Alpha dropout masking whole feature maps (channel dim 1)."""
+    if not training or p == 0.0:
+        return x
+
+    def impl(key, v, *, p):
+        alpha = 1.6732632423543772
+        scale = 1.0507009873554805
+        alpha_p = -alpha * scale
+        shape = (v.shape[0], v.shape[1]) + (1,) * (v.ndim - 2)
+        keep = jax.random.bernoulli(key, 1.0 - p, shape)
+        # variance-preserving affine (SNN paper): a = (q(1+p*a'^2))^-1/2
+        a = ((1.0 - p) * (1.0 + p * alpha_p ** 2)) ** -0.5
+        b = -a * p * alpha_p
+        return a * jnp.where(keep, v, alpha_p) + b
+
+    return _rng_op("feature_alpha_dropout", impl, (x,),
+                   dict(p=float(p)))

@@ -21,6 +21,7 @@ __all__ = [
     "cosine_embedding_loss", "triplet_margin_loss", "log_loss", "square_error_cost",
     "sigmoid_focal_loss", "dice_loss", "npair_loss", "poisson_nll_loss",
     "multi_label_soft_margin_loss", "soft_margin_loss", "ctc_loss",
+    "huber_loss", "gaussian_nll_loss",
 ]
 
 
@@ -443,3 +444,29 @@ def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
     return dispatch("ctc_loss", impl,
                     (log_probs, labels, input_lengths, label_lengths),
                     dict(blank=int(blank), reduction=reduction))
+
+
+def huber_loss(input, label, delta=1.0, reduction="mean", name=None):
+    def impl(x, y, *, delta, reduction):
+        d = x - y
+        ad = jnp.abs(d)
+        out = jnp.where(ad <= delta, 0.5 * d * d,
+                        delta * (ad - 0.5 * delta))
+        return _reduce(out, reduction)
+    return dispatch("huber_loss", impl, (input, label),
+                    dict(delta=float(delta), reduction=reduction))
+
+
+def gaussian_nll_loss(input, label, variance, full=False, epsilon=1e-6,
+                      reduction="mean", name=None):
+    def impl(mu, y, var, *, full, eps, reduction):
+        var = jnp.clip(var, eps)
+        out = 0.5 * (jnp.log(var) + jnp.square(y - mu) / var)
+        if full:
+            out = out + 0.5 * jnp.log(
+                jnp.asarray(2 * jnp.pi, var.dtype))
+        return _reduce(out, reduction)
+    return dispatch("gaussian_nll_loss", impl,
+                    (input, label, variance),
+                    dict(full=bool(full), eps=float(epsilon),
+                         reduction=reduction))

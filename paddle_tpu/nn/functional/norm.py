@@ -15,7 +15,8 @@ from ...core.dispatch import dispatch
 from ...core.tensor import Tensor
 
 __all__ = ["layer_norm", "batch_norm", "instance_norm", "group_norm",
-           "local_response_norm", "normalize", "rms_norm"]
+           "local_response_norm", "normalize", "rms_norm",
+           "spectral_norm"]
 
 
 def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-05,
@@ -221,3 +222,24 @@ def normalize(x, p=2, axis=1, epsilon=1e-12, name=None):
             jnp.power(jnp.sum(jnp.power(jnp.abs(v), p), axis=axis,
                               keepdims=True), 1.0 / p), eps),
         (x,), dict(p=float(p), axis=int(axis), eps=float(epsilon)))
+
+
+def spectral_norm(x, weight_u, weight_v, dim=0, power_iters=1,
+                  epsilon=1e-12, name=None):
+    """Normalize weight x by its largest singular value (power
+    iteration with the given u/v state); functional form of the
+    SpectralNorm layer."""
+    def impl(w, u, v, *, dim, iters, eps):
+        perm = (dim,) + tuple(i for i in range(w.ndim) if i != dim)
+        mat = jnp.transpose(w, perm).reshape(w.shape[dim], -1)
+        for _ in range(iters):
+            v = mat.T @ u
+            v = v / (jnp.linalg.norm(v) + eps)
+            u = mat @ v
+            u = u / (jnp.linalg.norm(u) + eps)
+        sigma = u @ mat @ v
+        return w / sigma
+
+    return dispatch("spectral_norm", impl, (x, weight_u, weight_v),
+                    dict(dim=int(dim), iters=int(power_iters),
+                         eps=float(epsilon)))

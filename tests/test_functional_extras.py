@@ -1,0 +1,148 @@
+"""Round-3 nn.functional additions."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+F = paddle.nn.functional
+
+
+def _t(a):
+    return paddle.to_tensor(np.asarray(a, np.float32))
+
+
+def test_softmin_and_losses():
+    x = _t([[1.0, 2.0, 3.0]])
+    np.testing.assert_allclose(
+        F.softmin(x).numpy(),
+        np.exp(-x.numpy()) / np.exp(-x.numpy()).sum(), rtol=1e-5)
+
+    a, b = _t([0.0, 3.0]), _t([0.5, 0.0])
+    hl = F.huber_loss(a, b, delta=1.0, reduction="none").numpy()
+    np.testing.assert_allclose(hl, [0.125, 2.5], rtol=1e-6)
+
+    mu, y, var = _t([0.0]), _t([1.0]), _t([4.0])
+    g = float(np.asarray(F.gaussian_nll_loss(mu, y, var,
+                                             reduction="sum").numpy()))
+    np.testing.assert_allclose(g, 0.5 * (np.log(4.0) + 0.25), rtol=1e-5)
+
+
+def test_pairwise_distance_channel_shuffle():
+    a = _t([[3.0, 4.0]])
+    b = _t([[0.0, 0.0]])
+    np.testing.assert_allclose(F.pairwise_distance(a, b).numpy(), [5.0],
+                               rtol=1e-4)
+    x = np.arange(8, dtype=np.float32).reshape(1, 4, 1, 2)
+    out = F.channel_shuffle(_t(x), 2).numpy()
+    want = x.reshape(1, 2, 2, 1, 2).transpose(0, 2, 1, 3, 4).reshape(
+        1, 4, 1, 2)
+    np.testing.assert_allclose(out, want)
+
+
+def test_affine_grid_grid_sample_identity():
+    # identity affine → grid_sample reproduces the input
+    x = np.random.default_rng(0).normal(size=(1, 2, 5, 7)).astype(
+        np.float32)
+    theta = _t(np.array([[[1, 0, 0], [0, 1, 0]]], np.float32))
+    grid = F.affine_grid(theta, [1, 2, 5, 7], align_corners=True)
+    out = F.grid_sample(_t(x), grid, align_corners=True)
+    np.testing.assert_allclose(out.numpy(), x, atol=1e-5)
+    # nearest mode also identity on exact grid points
+    out = F.grid_sample(_t(x), grid, mode="nearest",
+                        align_corners=True)
+    np.testing.assert_allclose(out.numpy(), x, atol=1e-5)
+
+
+def test_grid_sample_zeros_padding():
+    x = np.ones((1, 1, 2, 2), np.float32)
+    # grid entirely outside → zeros
+    grid = F.affine_grid(
+        _t(np.array([[[1, 0, 5.0], [0, 1, 5.0]]], np.float32)),
+        [1, 1, 2, 2], align_corners=True)
+    out = F.grid_sample(_t(x), grid, padding_mode="zeros",
+                        align_corners=True)
+    np.testing.assert_allclose(out.numpy(), np.zeros_like(x))
+    # border padding clamps instead
+    out = F.grid_sample(_t(x), grid, padding_mode="border",
+                        align_corners=True)
+    np.testing.assert_allclose(out.numpy(), x)
+
+
+def test_temporal_shift_moves_channels():
+    nt, c, h, w = 4, 4, 1, 1
+    x = np.arange(nt * c, dtype=np.float32).reshape(nt, c, h, w)
+    out = F.temporal_shift(_t(x), seg_num=2, shift_ratio=0.25).numpy()
+    # fold=1: channel 0 shifted left within each segment group
+    assert out[0, 0, 0, 0] == x[1, 0, 0, 0]
+    assert out[1, 0, 0, 0] == 0.0  # boundary zero-filled
+    np.testing.assert_allclose(out[:, 2:], x[:, 2:])  # kept channels
+
+
+def test_feature_alpha_dropout_and_spectral_norm():
+    paddle.seed(0)
+    x = _t(np.ones((2, 3, 4, 4)))
+    out = F.feature_alpha_dropout(x, p=0.5, training=True).numpy()
+    # whole channels share the same value (feature-wise masking)
+    for n in range(2):
+        for ch in range(3):
+            assert np.unique(out[n, ch]).size == 1
+    assert np.allclose(
+        F.feature_alpha_dropout(x, training=False).numpy(), 1.0)
+
+    w = _t(np.random.default_rng(1).normal(size=(4, 6)))
+    u = _t(np.random.default_rng(2).normal(size=(4,)))
+    v = _t(np.random.default_rng(3).normal(size=(6,)))
+    wn = F.spectral_norm(w, u, v, power_iters=20).numpy()
+    s = np.linalg.svd(wn, compute_uv=False)
+    np.testing.assert_allclose(s[0], 1.0, rtol=1e-3)
+
+
+def test_alpha_dropout_preserves_variance():
+    paddle.seed(3)
+    x = _t(np.random.default_rng(9).normal(size=(200000,)))
+    out = F.alpha_dropout(x, p=0.5, training=True).numpy()
+    assert abs(out.var() - 1.0) < 0.05  # SNN variance preservation
+    assert abs(out.mean()) < 0.02
+
+
+def test_temporal_shift_nhwc():
+    nt, c = 4, 4
+    x = np.arange(nt * c, dtype=np.float32).reshape(nt, c, 1, 1)
+    ref = F.temporal_shift(_t(x), seg_num=2).numpy()
+    nhwc = F.temporal_shift(_t(x.transpose(0, 2, 3, 1)), seg_num=2,
+                            data_format="NHWC").numpy()
+    np.testing.assert_allclose(nhwc.transpose(0, 3, 1, 2), ref)
+
+
+def test_generate_temperature_zero_is_greedy():
+    from paddle_tpu.models import GPTConfig, GPTForCausalLM
+    cfg = GPTConfig(vocab_size=32, hidden_size=16, num_hidden_layers=1,
+                    num_attention_heads=2, max_position_embeddings=16)
+    paddle.seed(8)
+    m = GPTForCausalLM(cfg)
+    m.eval()
+    ids = paddle.to_tensor(np.random.RandomState(4).randint(
+        0, 32, (1, 4)).astype(np.int64))
+    greedy = m.generate(ids, max_new_tokens=4).numpy()
+    t0 = m.generate(ids, max_new_tokens=4, do_sample=True,
+                    temperature=0.0, seed=1).numpy()
+    np.testing.assert_array_equal(greedy, t0)
+
+
+def test_ernie_heads_accept_task_type_ids():
+    from paddle_tpu.models import (ErnieConfig,
+                                   ErnieForSequenceClassification)
+    cfg = ErnieConfig(vocab_size=64, hidden_size=16,
+                      intermediate_size=32, num_hidden_layers=1,
+                      num_attention_heads=2,
+                      max_position_embeddings=16, num_labels=2)
+    paddle.seed(9)
+    m = ErnieForSequenceClassification(cfg)
+    m.eval()
+    ids = paddle.to_tensor(np.random.RandomState(5).randint(
+        0, 64, (2, 8)).astype(np.int64))
+    task = paddle.to_tensor(np.ones((2, 8), np.int64))
+    out0 = m(ids).numpy()
+    out1 = m(ids, task_type_ids=task).numpy()
+    assert out0.shape == out1.shape == (2, 2)
+    assert not np.allclose(out0, out1)  # task embedding participates
