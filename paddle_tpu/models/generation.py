@@ -21,11 +21,12 @@ __all__ = ["GenerationMixin", "generate"]
 def _sample_logits(logits_row, do_sample, top_k, top_p, temperature,
                    rng):
     z = np.asarray(logits_row, np.float64)
-    if not do_sample or (do_sample and temperature == 0.0):
+    if not do_sample or temperature == 0.0:
         # temperature 0 means greedy (the conventional request), not
         # "skip scaling and sample at temperature 1"
         return int(z.argmax())
-    if temperature != 1.0:
+    if temperature is not None and temperature != 1.0:
+        # None (HF-style "default") samples unscaled
         z = z / float(temperature)
     z = z - z.max()
     p = np.exp(z)

@@ -81,19 +81,24 @@ def dropout3d(x, p=0.5, training=True, data_format="NCDHW", name=None):
     return dropout(x, p, axis=axis, training=training)
 
 
+def _alpha_dropout_body(key, v, p, mask_shape):
+    """Shared SNN alpha-dropout: drop to alpha', then the
+    variance-preserving affine a = (q(1+p*a'^2))^-1/2, b = -a*p*a'."""
+    alpha = 1.6732632423543772
+    scale = 1.0507009873554805
+    alpha_p = -alpha * scale
+    keep = jax.random.bernoulli(key, 1.0 - p, mask_shape)
+    a = ((1.0 - p) * (1.0 + p * alpha_p ** 2)) ** -0.5
+    b = -a * p * alpha_p
+    return a * jnp.where(keep, v, alpha_p) + b
+
+
 def alpha_dropout(x, p=0.5, training=True, name=None):
     if not training or p == 0.0:
         return x
 
     def impl(key, v, *, p):
-        alpha = 1.6732632423543772
-        scale = 1.0507009873554805
-        alpha_p = -alpha * scale
-        keep = jax.random.bernoulli(key, 1.0 - p, v.shape)
-        # variance-preserving affine (SNN paper): a = (q(1+p*a'^2))^-1/2
-        a = ((1.0 - p) * (1.0 + p * alpha_p ** 2)) ** -0.5
-        b = -a * p * alpha_p
-        return a * jnp.where(keep, v, alpha_p) + b
+        return _alpha_dropout_body(key, v, p, v.shape)
 
     return _rng_op("alpha_dropout", impl, (x,), dict(p=float(p)))
 
@@ -543,15 +548,8 @@ def feature_alpha_dropout(x, p=0.5, training=True, name=None):
         return x
 
     def impl(key, v, *, p):
-        alpha = 1.6732632423543772
-        scale = 1.0507009873554805
-        alpha_p = -alpha * scale
         shape = (v.shape[0], v.shape[1]) + (1,) * (v.ndim - 2)
-        keep = jax.random.bernoulli(key, 1.0 - p, shape)
-        # variance-preserving affine (SNN paper): a = (q(1+p*a'^2))^-1/2
-        a = ((1.0 - p) * (1.0 + p * alpha_p ** 2)) ** -0.5
-        b = -a * p * alpha_p
-        return a * jnp.where(keep, v, alpha_p) + b
+        return _alpha_dropout_body(key, v, p, shape)
 
     return _rng_op("feature_alpha_dropout", impl, (x,),
                    dict(p=float(p)))

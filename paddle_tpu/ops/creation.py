@@ -22,6 +22,7 @@ __all__ = [
     "standard_normal", "randperm", "bernoulli", "multinomial", "poisson",
     "tril", "triu", "diag", "diagflat", "diag_embed", "meshgrid", "assign",
     "clone", "complex", "as_tensor", "uniform_", "normal_", "exponential_",
+    "tril_indices", "triu_indices",
 ]
 
 
@@ -374,3 +375,19 @@ def complex(real, imag, name=None):
 
 def as_tensor(data, dtype=None, place=None):
     return to_tensor(data, dtype=dtype, place=place)
+
+
+def tril_indices(row, col=None, offset=0, dtype="int64"):
+    import numpy as _np
+    from ..core.tensor import to_tensor
+    col = row if col is None else col
+    r, c = _np.tril_indices(int(row), k=int(offset), m=int(col))
+    return to_tensor(_np.stack([r, c]).astype(_np.int64), dtype=dtype)
+
+
+def triu_indices(row, col=None, offset=0, dtype="int64"):
+    import numpy as _np
+    from ..core.tensor import to_tensor
+    col = row if col is None else col
+    r, c = _np.triu_indices(int(row), k=int(offset), m=int(col))
+    return to_tensor(_np.stack([r, c]).astype(_np.int64), dtype=dtype)

@@ -21,6 +21,7 @@ __all__ = [
     "triangular_solve", "cholesky_solve", "lstsq", "lu", "multi_dot",
     "cross", "histogram", "bincount", "einsum", "corrcoef", "cov",
     "householder_product", "matrix_exp", "vecdot", "vander", "pca_lowrank",
+    "lu_unpack",
 ]
 
 
@@ -255,6 +256,39 @@ def lu(x, pivot=True, get_infos=False, name=None):
         from .creation import zeros
         return lu_t, piv, zeros([1], dtype="int32")
     return lu_t, piv
+
+
+def lu_unpack(x, y, unpack_ludata=True, unpack_pivots=True, name=None):
+    """Unpack paddle.linalg.lu results into (P, L, U); batched inputs
+    vmap over leading dims (lu_factor batches, so must this)."""
+    def one(lu_v, piv):
+        n, m = lu_v.shape
+        k = min(n, m)
+        L = jnp.tril(lu_v[:, :k], -1) + jnp.eye(n, k, dtype=lu_v.dtype)
+        U = jnp.triu(lu_v[:k, :])
+        # pivots (1-based row swaps) -> permutation matrix
+        perm = jnp.arange(n)
+
+        def apply_swap(i, perm):
+            j = piv[i] - 1
+            pi, pj = perm[i], perm[j]
+            perm = perm.at[i].set(pj)
+            return perm.at[j].set(pi)
+
+        perm = jax.lax.fori_loop(0, piv.shape[0], apply_swap, perm)
+        P = jnp.eye(n, dtype=lu_v.dtype)[perm].T
+        return P, L, U
+
+    def impl(lu_v, piv):
+        if lu_v.ndim == 2:
+            return one(lu_v, piv)
+        batch = lu_v.shape[:-2]
+        f = one
+        for _ in batch:
+            f = jax.vmap(f)
+        return f(lu_v, piv)
+
+    return dispatch("lu_unpack", impl, (x, y), {})
 
 
 def multi_dot(tensors, name=None):

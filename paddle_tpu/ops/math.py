@@ -28,6 +28,7 @@ __all__ = [
     "deg2rad", "diff", "angle", "conj", "real", "imag", "gcd", "lcm",
     "cumsum", "cumprod", "cummax", "cummin", "sgn", "take", "increment",
     "copysign", "trapezoid", "cumulative_trapezoid", "logcumsumexp", "renorm", "gammaln", "polygamma", "i0", "i1", "sinc", "signbit", "isposinf", "isneginf", "isreal",
+    "is_complex", "is_floating_point", "broadcast_shape", "histogramdd",
 ]
 
 
@@ -390,3 +391,31 @@ def isneginf(x, name=None):
 def isreal(x, name=None):
     return dispatch("isreal", lambda v: jnp.isreal(v), (x,), {},
                     differentiable=False)
+
+
+def is_complex(x):
+    return jnp.issubdtype(
+        (x._value if isinstance(x, Tensor) else jnp.asarray(x)).dtype,
+        jnp.complexfloating)
+
+
+def is_floating_point(x):
+    return jnp.issubdtype(
+        (x._value if isinstance(x, Tensor) else jnp.asarray(x)).dtype,
+        jnp.floating)
+
+
+def broadcast_shape(x_shape, y_shape):
+    return list(jnp.broadcast_shapes(tuple(x_shape), tuple(y_shape)))
+
+
+def histogramdd(x, bins=10, ranges=None, density=False, weights=None,
+                name=None):
+    import numpy as _np
+    from ..core.tensor import to_tensor
+    sample = _np.asarray(x.numpy() if isinstance(x, Tensor) else x)
+    w = None if weights is None else _np.asarray(
+        weights.numpy() if isinstance(weights, Tensor) else weights)
+    hist, edges = _np.histogramdd(sample, bins=bins, range=ranges,
+                                  density=density, weights=w)
+    return to_tensor(hist), [to_tensor(e) for e in edges]

@@ -91,3 +91,39 @@ def test_manipulation_additions():
                                np.column_stack([a, b]))
     np.testing.assert_allclose(paddle.row_stack([_t(a), _t(b)]).numpy(),
                                np.vstack([a, b]))
+
+
+def test_round3_top_level_fills():
+    assert paddle.is_floating_point(_t(np.zeros(2, np.float32)))
+    assert not paddle.is_floating_point(_t(np.zeros(2, np.int64)))
+    assert not paddle.is_complex(_t(np.zeros(2, np.float32)))
+    assert paddle.broadcast_shape([2, 1, 3], [4, 3]) == [2, 4, 3]
+
+    ti = paddle.tril_indices(3).numpy()
+    np.testing.assert_array_equal(ti, np.stack(np.tril_indices(3)))
+    tu = paddle.triu_indices(3, offset=1).numpy()
+    np.testing.assert_array_equal(tu, np.stack(np.triu_indices(3, k=1)))
+
+    hist, edges = paddle.histogramdd(
+        _t(np.random.default_rng(0).normal(size=(100, 2))), bins=4)
+    assert tuple(hist.shape) == (4, 4) and len(edges) == 2
+    assert float(np.asarray(hist.numpy()).sum()) == 100
+
+
+def test_lu_unpack_reconstructs():
+    from paddle_tpu import linalg
+    a = np.random.default_rng(1).normal(size=(4, 4)).astype(np.float32)
+    lu, piv = linalg.lu(_t(a))
+    P, L, U = linalg.lu_unpack(lu, piv)
+    rec = P.numpy() @ L.numpy() @ U.numpy()
+    np.testing.assert_allclose(rec, a, atol=1e-5)
+
+
+def test_lu_unpack_batched():
+    from paddle_tpu import linalg
+    a = np.random.default_rng(2).normal(size=(3, 4, 4)).astype(
+        np.float32)
+    lu, piv = linalg.lu(_t(a))
+    P, L, U = linalg.lu_unpack(lu, piv)
+    rec = np.einsum("bij,bjk,bkl->bil", P.numpy(), L.numpy(), U.numpy())
+    np.testing.assert_allclose(rec, a, atol=1e-4)
