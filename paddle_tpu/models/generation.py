@@ -3,15 +3,17 @@
 Reference parity: PaddleNLP's `generation_utils.py` (greedy / sampling
 decode loops [UNVERIFIED — empty reference mount]).
 
-TPU note: this is the straightforward host-loop decode (full-sequence
-recompute per step — O(n²) but correct for every model here, and each
-step is one compiled forward).  The compile-friendly fixed-shape
-`lax.scan` + KV-cache variant is the planned upgrade; on one chip at
-the toy sizes the dryruns use, recompute decode is compile-cache
-friendly because the sequence grows by one each call only up to
-max_length (bounded trace count).
+TPU note: models exposing `use_cache` (GPT/LLaMA) decode with a KV
+cache — prefill once, then one-token steps reusing cached K/V, O(n)
+per step.  The cache GROWS each step, so each length compiles its own
+executable (bounded by max_length); the fixed-shape `lax.scan` decode
+with a preallocated cache is the remaining upgrade for long
+generations.  Models without `use_cache` fall back to full-sequence
+recompute per step.
 """
 from __future__ import annotations
+
+import inspect
 
 import numpy as np
 
@@ -83,9 +85,20 @@ def generate(model, input_ids, max_new_tokens=20, max_length=None,
         max_new_tokens = max(0, min(int(max_new_tokens),
                                     mp - ids.shape[1]))
     done = np.zeros(ids.shape[0], bool)
-    for _ in range(int(max_new_tokens)):
-        with no_grad():
-            logits = model(paddle.to_tensor(ids.astype(np.int64)))
+    cache = None
+    use_cache = "use_cache" in inspect.signature(
+        model.forward).parameters
+    for step in range(int(max_new_tokens)):
+        if use_cache:
+            # KV-cache decode: feed only the new token after the prompt
+            feed = ids if step == 0 else ids[:, -1:]
+            with no_grad():
+                out = model(paddle.to_tensor(feed.astype(np.int64)),
+                            cache=cache, use_cache=True)
+            logits, cache = out
+        else:
+            with no_grad():
+                logits = model(paddle.to_tensor(ids.astype(np.int64)))
         if isinstance(logits, (tuple, list)):
             logits = logits[-1]
         last = np.asarray(logits.numpy())[:, -1, :]

@@ -47,14 +47,22 @@ class GPTAttention(nn.Layer):
         self.qkv_proj = nn.Linear(cfg.hidden_size, 3 * cfg.hidden_size)
         self.out_proj = nn.Linear(cfg.hidden_size, cfg.hidden_size)
 
-    def forward(self, x):
+    def forward(self, x, cache=None, use_cache=False):
         b, s, h = x.shape
         qkv = self.qkv_proj(x)
         qkv = paddle.reshape(qkv, [b, s, 3, self.num_heads, self.head_dim])
         q, k, v = paddle.unbind(qkv, axis=2)     # each [b, s, nh, hd]
+        if cache is not None:
+            # decode: extend K/V with the cached prefix; the SDPA causal
+            # mask is bottom-right aligned, so new rows see everything
+            k = paddle.concat([cache[0], k], axis=1)
+            v = paddle.concat([cache[1], v], axis=1)
         out = F.scaled_dot_product_attention(q, k, v, is_causal=True)
         out = paddle.reshape(out, [b, s, h])
-        return self.out_proj(out)
+        out = self.out_proj(out)
+        if use_cache:
+            return out, (k, v)
+        return out
 
 
 class GPTMLP(nn.Layer):
@@ -75,8 +83,13 @@ class GPTBlock(nn.Layer):
         self.ln_2 = nn.LayerNorm(cfg.hidden_size)
         self.mlp = GPTMLP(cfg)
 
-    def forward(self, x):
-        x = x + self.attn(self.ln_1(x))
+    def forward(self, x, cache=None, use_cache=False):
+        if use_cache:
+            a, new_cache = self.attn(self.ln_1(x), cache, True)
+            x = x + a
+            x = x + self.mlp(self.ln_2(x))
+            return x, new_cache
+        x = x + self.attn(self.ln_1(x), cache)
         x = x + self.mlp(self.ln_2(x))
         return x
 
@@ -93,17 +106,28 @@ class GPTModel(nn.Layer):
         self.ln_f = nn.LayerNorm(cfg.hidden_size)
         self._recompute = cfg.use_recompute
 
-    def forward(self, input_ids):
+    def forward(self, input_ids, cache=None, use_cache=False):
         b, s = input_ids.shape
-        pos = paddle.arange(s, dtype="int64")
+        past = 0 if cache is None else cache[0][0].shape[1]
+        pos = paddle.arange(past, past + s, dtype="int64")
         x = self.wte(input_ids) + self.wpe(pos)
-        for blk in self.h:
-            if self._recompute:
+        new_caches = []
+        for i, blk in enumerate(self.h):
+            layer_cache = None if cache is None else cache[i]
+            if use_cache:
+                x, c = blk(x, layer_cache, True)
+                new_caches.append(c)
+            elif self._recompute and layer_cache is None:
                 from ..distributed.fleet.recompute import recompute
                 x = recompute(blk, x)
             else:
-                x = blk(x)
-        return self.ln_f(x)
+                # a supplied cache participates even when the caller
+                # doesn't want an updated one back
+                x = blk(x, layer_cache)
+        x = self.ln_f(x)
+        if use_cache:
+            return x, new_caches
+        return x
 
 
 class GPTForCausalLM(nn.Layer, GenerationMixin):
@@ -116,12 +140,20 @@ class GPTForCausalLM(nn.Layer, GenerationMixin):
             self.lm_head = nn.Linear(cfg.hidden_size, cfg.vocab_size,
                                      bias_attr=False)
 
-    def forward(self, input_ids):
-        hidden = self.gpt(input_ids)
+    def forward(self, input_ids, cache=None, use_cache=False):
+        if use_cache:
+            hidden, new_cache = self.gpt(input_ids, cache, True)
+        else:
+            hidden = self.gpt(input_ids, cache)
+            new_cache = None
         if self.lm_head is not None:
-            return self.lm_head(hidden)
-        return paddle.matmul(hidden, self.gpt.wte.weight,
-                             transpose_y=True)
+            logits = self.lm_head(hidden)
+        else:
+            logits = paddle.matmul(hidden, self.gpt.wte.weight,
+                                   transpose_y=True)
+        if use_cache:
+            return logits, new_cache
+        return logits
 
 
 class GPTPretrainingCriterion(nn.Layer):

@@ -85,7 +85,7 @@ class LlamaAttention(nn.Layer):
         self.o_proj = nn.Linear(cfg.hidden_size, cfg.hidden_size,
                                 bias_attr=False)
 
-    def forward(self, x, cos, sin):
+    def forward(self, x, cos, sin, cache=None, use_cache=False):
         b, s, _ = x.shape
         q = paddle.reshape(self.q_proj(x),
                            [b, s, self.num_heads, self.head_dim])
@@ -94,12 +94,20 @@ class LlamaAttention(nn.Layer):
         v = paddle.reshape(self.v_proj(x),
                            [b, s, self.num_kv_heads, self.head_dim])
         q, k = apply_rotary_pos_emb(q, k, cos, sin)
+        if cache is not None:
+            # cache holds PRE-GQA (kv-head) keys/values, already rotated
+            k = paddle.concat([cache[0], k], axis=1)
+            v = paddle.concat([cache[1], v], axis=1)
+        new_cache = (k, v) if use_cache else None
         if self.num_kv_heads != self.num_heads:   # GQA: repeat kv heads
             rep = self.num_heads // self.num_kv_heads
             k = paddle.repeat_interleave(k, rep, axis=2)
             v = paddle.repeat_interleave(v, rep, axis=2)
         out = F.scaled_dot_product_attention(q, k, v, is_causal=True)
-        return self.o_proj(paddle.reshape(out, [b, s, -1]))
+        out = self.o_proj(paddle.reshape(out, [b, s, -1]))
+        if use_cache:
+            return out, new_cache
+        return out
 
 
 class LlamaMLP(nn.Layer):
@@ -126,8 +134,14 @@ class LlamaDecoderLayer(nn.Layer):
             cfg.hidden_size, epsilon=cfg.rms_norm_eps)
         self.mlp = LlamaMLP(cfg)
 
-    def forward(self, x, cos, sin):
-        x = x + self.self_attn(self.input_layernorm(x), cos, sin)
+    def forward(self, x, cos, sin, cache=None, use_cache=False):
+        if use_cache:
+            a, new_cache = self.self_attn(self.input_layernorm(x), cos,
+                                          sin, cache, True)
+            x = x + a
+            x = x + self.mlp(self.post_attention_layernorm(x))
+            return x, new_cache
+        x = x + self.self_attn(self.input_layernorm(x), cos, sin, cache)
         x = x + self.mlp(self.post_attention_layernorm(x))
         return x
 
@@ -147,18 +161,29 @@ class LlamaModel(nn.Layer):
         self.register_buffer("rope_sin", paddle.to_tensor(sin))
         self._recompute = cfg.use_recompute
 
-    def forward(self, input_ids):
+    def forward(self, input_ids, cache=None, use_cache=False):
         b, s = input_ids.shape
+        past = 0 if cache is None else cache[0][0].shape[1]
         x = self.embed_tokens(input_ids)
-        cos = self.rope_cos[:s]
-        sin = self.rope_sin[:s]
-        for layer in self.layers:
-            if self._recompute:
+        cos = self.rope_cos[past:past + s]
+        sin = self.rope_sin[past:past + s]
+        new_caches = []
+        for i, layer in enumerate(self.layers):
+            layer_cache = None if cache is None else cache[i]
+            if use_cache:
+                x, c = layer(x, cos, sin, layer_cache, True)
+                new_caches.append(c)
+            elif self._recompute and layer_cache is None:
                 from ..distributed.fleet.recompute import recompute
                 x = recompute(layer, x, cos, sin)
             else:
-                x = layer(x, cos, sin)
-        return self.norm(x)
+                # a supplied cache participates even when the caller
+                # doesn't want an updated one back
+                x = layer(x, cos, sin, layer_cache)
+        x = self.norm(x)
+        if use_cache:
+            return x, new_caches
+        return x
 
 
 class LlamaForCausalLM(nn.Layer, GenerationMixin):
@@ -168,8 +193,12 @@ class LlamaForCausalLM(nn.Layer, GenerationMixin):
         self.lm_head = nn.Linear(cfg.hidden_size, cfg.vocab_size,
                                  bias_attr=False)
 
-    def forward(self, input_ids, labels=None):
-        hidden = self.llama(input_ids)
+    def forward(self, input_ids, labels=None, cache=None,
+                use_cache=False):
+        if use_cache:
+            hidden, new_cache = self.llama(input_ids, cache, True)
+            return self.lm_head(hidden), new_cache
+        hidden = self.llama(input_ids, cache)
         logits = self.lm_head(hidden)
         if labels is None:
             return logits

@@ -181,3 +181,57 @@ def test_generate_respects_position_table():
     out = m.generate(ids, max_new_tokens=1, do_sample=True, top_k=1000,
                      seed=0)
     assert out.shape[1] == 7
+
+
+def test_kv_cache_decode_matches_full_recompute():
+    """Cache decode (feed one token, reuse K/V) must produce the same
+    tokens as full-sequence recompute — GPT and LLaMA."""
+    from paddle_tpu.models import (GPTConfig, GPTForCausalLM,
+                                   LlamaConfig, LlamaForCausalLM)
+    from paddle_tpu.models import generation as gen
+
+    for build in [
+        lambda: GPTForCausalLM(GPTConfig(
+            vocab_size=48, hidden_size=32, num_hidden_layers=2,
+            num_attention_heads=2, max_position_embeddings=32)),
+        lambda: LlamaForCausalLM(LlamaConfig(
+            vocab_size=48, hidden_size=32, intermediate_size=64,
+            num_hidden_layers=2, num_attention_heads=4,
+            num_key_value_heads=2, max_position_embeddings=32)),
+    ]:
+        paddle.seed(11)
+        m = build()
+        m.eval()
+        ids = paddle.to_tensor(np.random.RandomState(6).randint(
+            0, 48, (2, 5)).astype(np.int64))
+        with_cache = m.generate(ids, max_new_tokens=6).numpy()
+
+        # force the no-cache path through the same sampler
+        class NoCache:
+            def __init__(self, m):
+                self._m = m
+
+            def __call__(self, x):
+                return self._m(x)
+
+            forward = __call__  # no use_cache parameter
+
+        without = gen.generate(NoCache(m), ids, max_new_tokens=6).numpy()
+        np.testing.assert_array_equal(with_cache, without)
+
+
+def test_cache_participates_without_use_cache():
+    """Feeding a cache while use_cache=False must still attend over the
+    cached prefix (not silently drop it)."""
+    from paddle_tpu.models import GPTConfig, GPTForCausalLM
+    paddle.seed(12)
+    m = GPTForCausalLM(GPTConfig(
+        vocab_size=48, hidden_size=32, num_hidden_layers=2,
+        num_attention_heads=2, max_position_embeddings=32))
+    m.eval()
+    ids = paddle.to_tensor(np.random.RandomState(7).randint(
+        0, 48, (1, 6)).astype(np.int64))
+    full = m(ids).numpy()[:, -1]
+    _, cache = m(paddle.to_tensor(ids.numpy()[:, :5]), use_cache=True)
+    last = m(paddle.to_tensor(ids.numpy()[:, 5:]), cache=cache).numpy()
+    np.testing.assert_allclose(last[:, -1], full, atol=2e-5, rtol=2e-5)
