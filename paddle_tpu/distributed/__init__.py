@@ -18,7 +18,9 @@ from .communication.ops import (all_gather, all_gather_object, broadcast,
                                 reduce, scatter, alltoall, alltoall_single,
                                 send, recv, isend, irecv, barrier,
                                 reduce_scatter, stream, P2POp,
-                                batch_isend_irecv, wait, gather)
+                                batch_isend_irecv, wait, gather,
+                                broadcast_object_list,
+                                scatter_object_list, monitored_barrier)
 from .communication.reduce_op import ReduceOp
 from .parallel import DataParallel
 from . import fleet
@@ -30,6 +32,7 @@ from .auto_parallel.api import (shard_tensor, shard_op, ProcessMesh, Shard,
 from . import checkpoint
 from .checkpoint.save_load import save_state_dict, load_state_dict
 from .store import TCPStore
+from .split_api import split
 from . import utils
 
 spawn = None  # set by launch module

@@ -29,7 +29,9 @@ from .reduce_op import ReduceOp
 __all__ = ["all_gather", "all_gather_object", "broadcast", "reduce",
            "scatter", "alltoall", "alltoall_single", "send", "recv",
            "isend", "irecv", "barrier", "reduce_scatter", "stream", "P2POp",
-           "batch_isend_irecv", "wait", "gather"]
+           "batch_isend_irecv", "wait", "gather"    "broadcast_object_list", "scatter_object_list",
+    "monitored_barrier",
+]
 
 
 def _axis_in_scope(axis_name):
@@ -392,3 +394,27 @@ class stream:
     alltoall = staticmethod(alltoall)
     send = staticmethod(send)
     recv = staticmethod(recv)
+
+
+def broadcast_object_list(object_list, src=0, group=None):
+    """Single-controller SPMD: every rank lives in this process and the
+    list is already identical on all of them (same shim contract as
+    all_gather_object above).  Cross-PROCESS object exchange is the
+    TCPStore's job (distributed/store.py)."""
+    return object_list
+
+
+def scatter_object_list(out_object_list, in_object_list=None, src=0,
+                        group=None):
+    g = _group(group)
+    rank = g.rank if g.rank >= 0 else 0  # same convention as scatter()
+    out_object_list.clear()
+    if in_object_list:
+        out_object_list.append(in_object_list[rank % len(in_object_list)])
+    return out_object_list
+
+
+def monitored_barrier(group=None, timeout=None, wait_all_ranks=False):
+    """Barrier with a watchdog timeout; in one SPMD process the barrier
+    is the device-collective barrier and the timeout is advisory."""
+    return barrier(group)

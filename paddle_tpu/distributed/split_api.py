@@ -1,0 +1,59 @@
+"""paddle.distributed.split: functional Megatron-split helper.
+
+Reference parity: `python/paddle/distributed/collective.py::split`
+(builds a vocab/column/row-parallel layer over the mp group and applies
+it; weights are created on first call and cached by name [UNVERIFIED —
+empty reference mount]).  Delegates to the placement-based mp layers in
+fleet.meta_parallel.
+"""
+from __future__ import annotations
+
+__all__ = ["split", "reset_split_cache"]
+
+_SPLIT_LAYERS: dict = {}
+
+
+def reset_split_cache():
+    """Release all layers (and sharded weights) split() has created."""
+    _SPLIT_LAYERS.clear()
+
+
+def split(x, size, operation="linear", axis=0, num_partitions=None,
+          gather_out=True, weight_attr=None, bias_attr=None, name=None):
+    """Apply a model-parallel layer of the given kind to x.
+
+    operation="embedding" → VocabParallelEmbedding(size);
+    operation="linear", axis=1 → ColumnParallelLinear (weight columns
+    split over mp); axis=0 → RowParallelLinear.  The layer (and its
+    sharded weights) is created once per `name` (or per signature) and
+    reused across calls, matching the reference's parameter caching.
+    """
+    # the full signature keys the cache even when a name is given: the
+    # same name with a different operation/shape must NOT silently
+    # reuse the first layer
+    key = (name, operation, tuple(size), axis, gather_out,
+           bias_attr is not False)
+    layer = _SPLIT_LAYERS.get(key)
+    if layer is None:
+        from .fleet.meta_parallel import (ColumnParallelLinear,
+                                          RowParallelLinear,
+                                          VocabParallelEmbedding)
+        if operation == "embedding":
+            layer = VocabParallelEmbedding(size[0], size[1],
+                                           weight_attr=weight_attr,
+                                           name=name)
+        elif operation == "linear" and axis == 1:
+            layer = ColumnParallelLinear(
+                size[0], size[1], weight_attr=weight_attr,
+                has_bias=bias_attr is not False,
+                gather_output=gather_out, name=name)
+        elif operation == "linear" and axis == 0:
+            layer = RowParallelLinear(
+                size[0], size[1], weight_attr=weight_attr,
+                has_bias=bias_attr is not False,
+                input_is_parallel=not gather_out, name=name)
+        else:
+            raise ValueError(
+                f"split: unsupported operation={operation!r} axis={axis}")
+        _SPLIT_LAYERS[key] = layer
+    return layer(x)

@@ -197,3 +197,32 @@ def test_new_group_infers_axis_from_ranks():
     finally:
         dist.env.set_global_mesh(None)
         group_mod._default_group = None
+
+
+def test_object_collectives_and_monitored_barrier():
+    objs = []
+    dist.all_gather_object(objs, {"a": 1})
+    assert objs and objs[0] == {"a": 1}
+    lst = [{"x": 2}]
+    assert dist.broadcast_object_list(lst) == [{"x": 2}]
+    out = []
+    dist.scatter_object_list(out, [{"r": 0}, {"r": 1}])
+    assert out and "r" in out[0]
+    dist.monitored_barrier(timeout=5)
+
+
+def test_dist_split_linear_and_embedding():
+    import numpy as np
+    from paddle_tpu.distributed import split_api
+    split_api.reset_split_cache()
+    x = paddle.to_tensor(
+        np.random.default_rng(0).normal(size=(2, 8)).astype(np.float32))
+    y1 = dist.split(x, (8, 12), operation="linear", axis=1,
+                    name="col_t")
+    assert tuple(y1.shape) == (2, 12)
+    y2 = dist.split(x, (8, 12), operation="linear", axis=1,
+                    name="col_t")
+    np.testing.assert_allclose(y1.numpy(), y2.numpy())  # cached weights
+    ids = paddle.to_tensor(np.array([[0, 3], [5, 1]], np.int64))
+    e = dist.split(ids, (16, 6), operation="embedding", name="emb_t")
+    assert tuple(e.shape) == (2, 2, 6)
