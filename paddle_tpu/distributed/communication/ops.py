@@ -29,7 +29,8 @@ from .reduce_op import ReduceOp
 __all__ = ["all_gather", "all_gather_object", "broadcast", "reduce",
            "scatter", "alltoall", "alltoall_single", "send", "recv",
            "isend", "irecv", "barrier", "reduce_scatter", "stream", "P2POp",
-           "batch_isend_irecv", "wait", "gather"    "broadcast_object_list", "scatter_object_list",
+           "batch_isend_irecv", "wait", "gather",
+           "broadcast_object_list", "scatter_object_list",
     "monitored_barrier",
 ]
 

@@ -28,12 +28,23 @@ def split(x, size, operation="linear", axis=0, num_partitions=None,
     sharded weights) is created once per `name` (or per signature) and
     reused across calls, matching the reference's parameter caching.
     """
-    # the full signature keys the cache even when a name is given: the
-    # same name with a different operation/shape must NOT silently
-    # reuse the first layer
-    key = (name, operation, tuple(size), axis, gather_out,
-           bias_attr is not False)
-    layer = _SPLIT_LAYERS.get(key)
+    # Reference semantics: split() is a BUILD-time API — each call site
+    # creates its own parameters.  Unnamed calls therefore always build
+    # a fresh layer (two anonymous projections must not share weights);
+    # pass `name` to reuse one layer across steps in an eager loop.
+    # A named hit is validated against the full signature including the
+    # attr objects so a changed initializer cannot be silently ignored.
+    key = None
+    layer = None
+    if name is not None:
+        key = (name, operation, tuple(size), axis, gather_out)
+        entry = _SPLIT_LAYERS.get(key)
+        if entry is not None:
+            layer, prev_w, prev_b = entry
+            if prev_w is not weight_attr or prev_b is not bias_attr:
+                raise ValueError(
+                    f"split(name={name!r}): weight_attr/bias_attr "
+                    "differ from the cached layer's; use a new name")
     if layer is None:
         from .fleet.meta_parallel import (ColumnParallelLinear,
                                           RowParallelLinear,
@@ -55,5 +66,6 @@ def split(x, size, operation="linear", axis=0, num_partitions=None,
         else:
             raise ValueError(
                 f"split: unsupported operation={operation!r} axis={axis}")
-        _SPLIT_LAYERS[key] = layer
+        if key is not None:
+            _SPLIT_LAYERS[key] = (layer, weight_attr, bias_attr)
     return layer(x)

@@ -12,3 +12,5 @@ from .layer.rnn import *  # noqa: F401,F403
 from . import functional
 from . import initializer
 from .utils import clip_grad_norm_, clip_grad_value_
+from .clip import (ClipGradByValue, ClipGradByNorm,  # noqa: F401
+                   ClipGradByGlobalNorm)

@@ -15,6 +15,7 @@ __all__ = [
     "max_pool3d", "adaptive_avg_pool1d", "adaptive_avg_pool2d",
     "adaptive_avg_pool3d", "adaptive_max_pool1d", "adaptive_max_pool2d",
     "adaptive_max_pool3d",
+    "max_unpool2d",
 ]
 
 
@@ -212,3 +213,31 @@ def adaptive_max_pool2d(x, output_size, return_mask=False, name=None):
 
 def adaptive_max_pool3d(x, output_size, return_mask=False, name=None):
     return _adaptive(x, output_size, "max", 3, "adaptive_max_pool3d")
+
+
+def max_unpool2d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCHW", output_size=None, name=None):
+    """Scatter pooled values back to the positions `indices` recorded
+    (the flat H*W input offsets max_pool2d(return_mask=True) emits)."""
+    from ...core.dispatch import dispatch
+    k = _norm(kernel_size, 2)
+    s = _norm(stride if stride is not None else kernel_size, 2)
+    p = _norm(padding, 2) if not isinstance(padding, str) else (0, 0)
+    n, c, oh, ow = x.shape
+    if output_size is not None:
+        H, W = int(output_size[-2]), int(output_size[-1])
+    else:
+        H = (oh - 1) * s[0] - 2 * p[0] + k[0]
+        W = (ow - 1) * s[1] - 2 * p[1] + k[1]
+
+    def impl(v, idx, *, H, W):
+        n, c, oh, ow = v.shape
+        flat = jnp.zeros((n, c, H * W), v.dtype)
+        flat = flat.at[
+            jnp.arange(n)[:, None, None],
+            jnp.arange(c)[None, :, None],
+            idx.reshape(n, c, -1)].set(v.reshape(n, c, -1))
+        return flat.reshape(n, c, H, W)
+
+    return dispatch("max_unpool2d", impl, (x, indices),
+                    dict(H=H, W=W))

@@ -19,6 +19,7 @@ __all__ = [
     "MaxPool3D", "AdaptiveAvgPool1D", "AdaptiveAvgPool2D",
     "AdaptiveAvgPool3D", "AdaptiveMaxPool1D", "AdaptiveMaxPool2D",
     "AdaptiveMaxPool3D",
+    "MaxUnPool2D",
 ]
 
 
@@ -171,3 +172,19 @@ class AdaptiveMaxPool2D(_AdaptivePoolNd):
 
 class AdaptiveMaxPool3D(_AdaptivePoolNd):
     _fn = staticmethod(F.adaptive_max_pool3d)
+
+
+class MaxUnPool2D(Layer):
+    def __init__(self, kernel_size, stride=None, padding=0,
+                 data_format="NCHW", output_size=None, name=None):
+        super().__init__()
+        self.kernel_size = kernel_size
+        self.stride = stride
+        self.padding = padding
+        self.data_format = data_format
+        self.output_size = output_size
+
+    def forward(self, x, indices):
+        return F.max_unpool2d(x, indices, self.kernel_size, self.stride,
+                              self.padding, self.data_format,
+                              self.output_size)

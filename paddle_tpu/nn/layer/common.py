@@ -16,7 +16,8 @@ __all__ = ["Linear", "Embedding", "Dropout", "Dropout2D", "Dropout3D",
            "AlphaDropout", "Flatten", "Upsample", "UpsamplingNearest2D",
            "UpsamplingBilinear2D", "Pad1D", "Pad2D", "Pad3D", "ZeroPad2D",
            "CosineSimilarity", "Bilinear", "Identity", "Unfold", "Fold",
-           "PixelShuffle", "PixelUnshuffle"]
+           "PixelShuffle", "PixelUnshuffle", "Unflatten",
+           "PairwiseDistance", "ChannelShuffle"]
 
 
 class Identity(Layer):
@@ -257,3 +258,37 @@ class PixelUnshuffle(Layer):
 
     def forward(self, x):
         return F.pixel_unshuffle(x, self.downscale_factor, self.data_format)
+
+
+class Unflatten(Layer):
+    def __init__(self, axis, shape, name=None):
+        super().__init__()
+        self.axis = axis
+        self.shape = list(shape)
+
+    def forward(self, x):
+        from ...ops.manipulation import reshape
+        ax = self.axis if self.axis >= 0 else x.ndim + self.axis
+        new = list(x.shape)
+        new[ax:ax + 1] = self.shape
+        return reshape(x, new)
+
+
+class PairwiseDistance(Layer):
+    def __init__(self, p=2.0, epsilon=1e-6, keepdim=False, name=None):
+        super().__init__()
+        self.p, self.epsilon, self.keepdim = p, epsilon, keepdim
+
+    def forward(self, x, y):
+        return F.pairwise_distance(x, y, self.p, self.epsilon,
+                                   self.keepdim)
+
+
+class ChannelShuffle(Layer):
+    def __init__(self, groups, data_format="NCHW", name=None):
+        super().__init__()
+        self.groups = groups
+        self.data_format = data_format
+
+    def forward(self, x):
+        return F.channel_shuffle(x, self.groups, self.data_format)

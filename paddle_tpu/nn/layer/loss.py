@@ -12,7 +12,9 @@ __all__ = ["CrossEntropyLoss", "MSELoss", "L1Loss", "NLLLoss", "BCELoss",
            "BCEWithLogitsLoss", "SmoothL1Loss", "KLDivLoss",
            "MarginRankingLoss", "CTCLoss", "HingeEmbeddingLoss",
            "CosineEmbeddingLoss", "TripletMarginLoss", "PoissonNLLLoss",
-           "MultiLabelSoftMarginLoss", "SoftMarginLoss"]
+           "MultiLabelSoftMarginLoss", "SoftMarginLoss",
+    "HuberLoss", "GaussianNLLLoss",
+]
 
 
 class CrossEntropyLoss(Layer):
@@ -173,3 +175,24 @@ class MultiLabelSoftMarginLoss(Layer):
     def forward(self, input, label):
         return F.multi_label_soft_margin_loss(input, label, self.weight,
                                               self.reduction)
+
+
+class HuberLoss(Layer):
+    def __init__(self, reduction="mean", delta=1.0, name=None):
+        super().__init__()
+        self.reduction, self.delta = reduction, delta
+
+    def forward(self, input, label):
+        return F.huber_loss(input, label, self.delta, self.reduction)
+
+
+class GaussianNLLLoss(Layer):
+    def __init__(self, full=False, epsilon=1e-6, reduction="mean",
+                 name=None):
+        super().__init__()
+        self.full, self.epsilon = full, epsilon
+        self.reduction = reduction
+
+    def forward(self, input, label, variance):
+        return F.gaussian_nll_loss(input, label, variance, self.full,
+                                   self.epsilon, self.reduction)

@@ -226,3 +226,27 @@ def test_dist_split_linear_and_embedding():
     ids = paddle.to_tensor(np.array([[0, 3], [5, 1]], np.int64))
     e = dist.split(ids, (16, 6), operation="embedding", name="emb_t")
     assert tuple(e.shape) == (2, 2, 6)
+
+
+def test_dist_split_anonymous_calls_get_fresh_weights():
+    import numpy as np
+    from paddle_tpu.distributed import split_api
+    split_api.reset_split_cache()
+    x = paddle.to_tensor(
+        np.random.default_rng(1).normal(size=(2, 8)).astype(np.float32))
+    a = dist.split(x, (8, 12), operation="linear", axis=1)
+    b = dist.split(x, (8, 12), operation="linear", axis=1)
+    assert not np.allclose(a.numpy(), b.numpy())  # independent params
+    import pytest as _pytest
+    dist.split(x, (8, 12), operation="linear", axis=1, name="w")
+    with _pytest.raises(ValueError, match="weight_attr"):
+        from paddle_tpu.nn import initializer as I
+        dist.split(x, (8, 12), operation="linear", axis=1, name="w",
+                   weight_attr=I.Constant(0.5))
+
+
+def test_unflatten_negative_axis():
+    import numpy as np
+    u = paddle.nn.Unflatten(-1, [2, 3])
+    out = u(paddle.to_tensor(np.zeros((4, 6), np.float32)))
+    assert tuple(out.shape) == (4, 2, 3)

@@ -225,3 +225,42 @@ def test_embedding_out_of_range_raises():
     with pytest.raises(ValueError, match="ids must be in"):
         emb(paddle.to_tensor(np.array([-1, 2], np.int64)))
     emb(paddle.to_tensor(np.array([0, 9], np.int64)))  # bounds OK
+
+
+def test_round3_layer_fills():
+    # Unflatten / PairwiseDistance / ChannelShuffle / losses / clip names
+    u = nn.Unflatten(1, [2, 3])
+    assert tuple(u(paddle.to_tensor(
+        np.zeros((4, 6), np.float32))).shape) == (4, 2, 3)
+    d = nn.PairwiseDistance()(
+        paddle.to_tensor(np.array([[3.0, 4.0]], np.float32)),
+        paddle.to_tensor(np.array([[0.0, 0.0]], np.float32)))
+    np.testing.assert_allclose(d.numpy(), [5.0], rtol=1e-4)
+    cs = nn.ChannelShuffle(2)
+    assert tuple(cs(paddle.to_tensor(
+        np.zeros((1, 4, 2, 2), np.float32))).shape) == (1, 4, 2, 2)
+    h = nn.HuberLoss(delta=1.0)(
+        paddle.to_tensor(np.array([0.0], np.float32)),
+        paddle.to_tensor(np.array([3.0], np.float32)))
+    np.testing.assert_allclose(float(np.asarray(h.numpy())), 2.5,
+                               rtol=1e-6)
+    g = nn.GaussianNLLLoss()(
+        paddle.to_tensor(np.array([0.0], np.float32)),
+        paddle.to_tensor(np.array([1.0], np.float32)),
+        paddle.to_tensor(np.array([1.0], np.float32)))
+    assert np.isfinite(float(np.asarray(g.numpy())))
+    assert nn.ClipGradByGlobalNorm is paddle.ClipGradByGlobalNorm
+
+
+def test_max_unpool2d_roundtrip():
+    x = np.zeros((1, 1, 4, 4), np.float32)
+    x[0, 0, 1, 1] = 5.0
+    x[0, 0, 2, 3] = 7.0
+    t = paddle.to_tensor(x)
+    pooled, idx = paddle.nn.functional.max_pool2d(t, 2, return_mask=True)
+    unpooled = paddle.nn.functional.max_unpool2d(pooled, idx, 2).numpy()
+    assert unpooled[0, 0, 1, 1] == 5.0
+    assert unpooled[0, 0, 2, 3] == 7.0
+    assert unpooled.sum() >= 12.0  # maxima land back at their positions
+    layer = nn.MaxUnPool2D(2)
+    np.testing.assert_allclose(layer(pooled, idx).numpy(), unpooled)
