@@ -24,9 +24,10 @@ def split(x, size, operation="linear", axis=0, num_partitions=None,
 
     operation="embedding" → VocabParallelEmbedding(size);
     operation="linear", axis=1 → ColumnParallelLinear (weight columns
-    split over mp); axis=0 → RowParallelLinear.  The layer (and its
-    sharded weights) is created once per `name` (or per signature) and
-    reused across calls, matching the reference's parameter caching.
+    split over mp); axis=0 → RowParallelLinear.  An UNNAMED call always
+    builds a fresh layer (the reference's build-time contract: every
+    call site owns its parameters); pass `name` to reuse one layer —
+    and its weights — across repeated calls in an eager loop.
     """
     # Reference semantics: split() is a BUILD-time API — each call site
     # creates its own parameters.  Unnamed calls therefore always build
@@ -34,6 +35,15 @@ def split(x, size, operation="linear", axis=0, num_partitions=None,
     # pass `name` to reuse one layer across steps in an eager loop.
     # A named hit is validated against the full signature including the
     # attr objects so a changed initializer cannot be silently ignored.
+    def _attr_sig(attr):
+        # compare attrs by CONFIG, not identity: a fresh-but-identical
+        # initializer each step must hit the cache
+        if attr is None or attr is False:
+            return attr
+        return (type(attr).__name__,
+                tuple(sorted((k, repr(v))
+                             for k, v in vars(attr).items())))
+
     key = None
     layer = None
     if name is not None:
@@ -41,7 +51,8 @@ def split(x, size, operation="linear", axis=0, num_partitions=None,
         entry = _SPLIT_LAYERS.get(key)
         if entry is not None:
             layer, prev_w, prev_b = entry
-            if prev_w is not weight_attr or prev_b is not bias_attr:
+            if prev_w != _attr_sig(weight_attr) or \
+                    prev_b != _attr_sig(bias_attr):
                 raise ValueError(
                     f"split(name={name!r}): weight_attr/bias_attr "
                     "differ from the cached layer's; use a new name")
@@ -67,5 +78,6 @@ def split(x, size, operation="linear", axis=0, num_partitions=None,
             raise ValueError(
                 f"split: unsupported operation={operation!r} axis={axis}")
         if key is not None:
-            _SPLIT_LAYERS[key] = (layer, weight_attr, bias_attr)
+            _SPLIT_LAYERS[key] = (layer, _attr_sig(weight_attr),
+                                  _attr_sig(bias_attr))
     return layer(x)

@@ -5,6 +5,7 @@ empty reference mount].
 """
 from __future__ import annotations
 
+import numpy as np
 import jax
 import jax.numpy as jnp
 
@@ -218,8 +219,18 @@ def adaptive_max_pool3d(x, output_size, return_mask=False, name=None):
 def max_unpool2d(x, indices, kernel_size, stride=None, padding=0,
                  data_format="NCHW", output_size=None, name=None):
     """Scatter pooled values back to the positions `indices` recorded
-    (the flat H*W input offsets max_pool2d(return_mask=True) emits)."""
-    from ...core.dispatch import dispatch
+    (the flat row*W_in+col input offsets max_pool2d(return_mask=True)
+    emits).  When pooling did not tile the input exactly (e.g. 5x5
+    with k=s=2), the inferred output shape LOSES the tail — pass
+    `output_size` with the original spatial shape, as the reference
+    requires; indices past the inferred extent raise."""
+    if data_format == "NHWC":
+        from ...ops.manipulation import transpose
+        out = max_unpool2d(transpose(x, [0, 3, 1, 2]),
+                           transpose(indices, [0, 3, 1, 2]),
+                           kernel_size, stride, padding, "NCHW",
+                           output_size)
+        return transpose(out, [0, 2, 3, 1])
     k = _norm(kernel_size, 2)
     s = _norm(stride if stride is not None else kernel_size, 2)
     p = _norm(padding, 2) if not isinstance(padding, str) else (0, 0)
@@ -229,6 +240,17 @@ def max_unpool2d(x, indices, kernel_size, stride=None, padding=0,
     else:
         H = (oh - 1) * s[0] - 2 * p[0] + k[0]
         W = (ow - 1) * s[1] - 2 * p[1] + k[1]
+    try:  # eager guard: an index beyond H*W means the inferred shape
+        # is too small — the caller must supply output_size
+        mx = int(np.asarray(
+            indices._value if hasattr(indices, "_value")
+            else indices).max())
+        if mx >= H * W:
+            raise ValueError(
+                f"max_unpool2d: index {mx} outside the inferred "
+                f"{H}x{W} output; pass output_size=[H_in, W_in]")
+    except (TypeError, jax.errors.ConcretizationTypeError):
+        pass
 
     def impl(v, idx, *, H, W):
         n, c, oh, ow = v.shape

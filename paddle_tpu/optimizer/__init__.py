@@ -1,4 +1,5 @@
 """paddle.optimizer parity surface."""
 from .optimizer import (Optimizer, SGD, Momentum, Adam, AdamW, Adamax,
                         Adagrad, Adadelta, RMSProp, Lamb)
+from .extra import Rprop, ASGD, NAdam, RAdam, LBFGS
 from . import lr

@@ -1,0 +1,301 @@
+"""Additional optimizers: Rprop, ASGD, NAdam, RAdam, LBFGS.
+
+Reference parity: `python/paddle/optimizer/{rprop,asgd,nadam,radam,
+lbfgs}.py` [UNVERIFIED — empty reference mount].  Each implements the
+framework Optimizer contract: `_pure_update` (one fused traced update —
+used by the static Executor/DistModel and by the eager path below) and
+`_static_state`.  LBFGS is closure-driven and eager-only, like the
+reference (its inner line search re-evaluates the loss).
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core import autograd
+from ..core.tensor import Tensor
+from .optimizer import Optimizer
+
+__all__ = ["Rprop", "ASGD", "NAdam", "RAdam", "LBFGS"]
+
+
+class _PureApplied(Optimizer):
+    """Eager `_apply` driven by `_pure_update` (one implementation of
+    the math).  The update closes over python state, so it takes the
+    plain eager path rather than the per-op jit cache — fine for these
+    optimizers; the compiled engines fuse `_pure_update` directly."""
+
+    def _apply(self, params):
+        state = self._static_state(params)
+        lr = self._lr_tensor._value
+        step = self._step_count._value
+        pvals = tuple(p._value for p in params)
+        gvals = tuple(p.grad._value for p in params)
+        ovals = tuple(t._value for t in state)
+        new_p, new_o = self._pure_update(lr, step, pvals, gvals, ovals,
+                                         params)
+        for p, v in zip(params, new_p):
+            p._inplace_update(v)
+        for t, v in zip(state, new_o):
+            t._inplace_update(v)
+
+
+class Rprop(_PureApplied):
+    """Resilient backprop: sign-based per-element step sizes."""
+
+    def __init__(self, learning_rate=0.001, learning_rate_range=(1e-5, 50),
+                 parameters=None, etas=(0.5, 1.2), grad_clip=None,
+                 multi_precision=False, name=None, **kw):
+        super().__init__(learning_rate, parameters, None, grad_clip, name)
+        self._lr_range = (float(learning_rate_range[0]),
+                          float(learning_rate_range[1]))
+        self._etas = (float(etas[0]), float(etas[1]))
+
+    def _static_state(self, params):
+        out = []
+        for p in params:
+            out.append(self._acc("prev_grad", p))
+            out.append(self._acc("step_size", p,
+                                 init=float(self._current_lr())))
+        return out
+
+    def _pure_update(self, lr, step, param_vals, grads, opt_vals, params):
+        lo, hi = self._lr_range
+        eta_m, eta_p = self._etas
+        new_p, new_o = [], []
+        for i, (p, g) in enumerate(zip(param_vals, grads)):
+            prev = opt_vals[2 * i]
+            size = opt_vals[2 * i + 1]
+            gf = g.astype(jnp.float32)
+            sign = jnp.sign(gf * prev)
+            size2 = jnp.clip(
+                jnp.where(sign > 0, size * eta_p,
+                          jnp.where(sign < 0, size * eta_m, size)),
+                lo, hi)
+            # on sign change the step is skipped and the grad zeroed
+            g_eff = jnp.where(sign < 0, 0.0, gf)
+            new_p.append((p.astype(jnp.float32)
+                          - size2 * jnp.sign(g_eff)).astype(p.dtype))
+            new_o.extend([g_eff, size2])
+        return tuple(new_p), tuple(new_o)
+
+
+class ASGD(_PureApplied):
+    """Averaged SGD: plain SGD steps plus a running average of the
+    iterates; the average is what `ax` accumulators hold (swap in for
+    evaluation via state_dict, the reference contract)."""
+
+    def __init__(self, learning_rate=0.001, batch_num=1, parameters=None,
+                 weight_decay=None, grad_clip=None,
+                 multi_precision=False, name=None, **kw):
+        super().__init__(learning_rate, parameters, weight_decay,
+                         grad_clip, name)
+        self._batch_num = int(batch_num)
+
+    def _static_state(self, params):
+        return [self._acc("ax", p) for p in params]
+
+    def _pure_update(self, lr, step, param_vals, grads, opt_vals, params):
+        wd = self._decay_coeff()
+        t = step.astype(jnp.float32) + 1.0
+        new_p, new_ax = [], []
+        for p, g, ax in zip(param_vals, grads, opt_vals):
+            gf = g.astype(jnp.float32) + wd * p.astype(jnp.float32)
+            p2 = p.astype(jnp.float32) - lr * gf
+            new_p.append(p2.astype(p.dtype))
+            new_ax.append(ax + (p2 - ax) / t)   # running iterate average
+        return tuple(new_p), tuple(new_ax)
+
+
+class NAdam(_PureApplied):
+    """Adam with Nesterov momentum (Dozat 2016)."""
+
+    def __init__(self, learning_rate=0.002, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, momentum_decay=0.004, parameters=None,
+                 weight_decay=None, grad_clip=None, name=None, **kw):
+        super().__init__(learning_rate, parameters, weight_decay,
+                         grad_clip, name)
+        self._b1, self._b2 = float(beta1), float(beta2)
+        self._eps = float(epsilon)
+        self._psi = float(momentum_decay)
+
+    def _static_state(self, params):
+        out = []
+        for p in params:
+            out.append(self._acc("moment1", p))
+            out.append(self._acc("moment2", p))
+        # the cumulative momentum product is real STATE (Dozat's
+        # schedule), carried as one scalar accumulator at the end
+        out.append(self._acc("mu_product", params[0], init=1.0,
+                             shape=(), dtype=jnp.float32))
+        return out
+
+    def _pure_update(self, lr, step, param_vals, grads, opt_vals, params):
+        wd = self._decay_coeff()
+        b1, b2, eps, psi = self._b1, self._b2, self._eps, self._psi
+        t = step.astype(jnp.float32) + 1.0
+        mu_t = b1 * (1.0 - 0.5 * 0.96 ** (t * psi))
+        mu_t1 = b1 * (1.0 - 0.5 * 0.96 ** ((t + 1.0) * psi))
+        mprod_t = opt_vals[-1] * mu_t
+        mprod_t1 = mprod_t * mu_t1
+        new_p, new_o = [], []
+        for i, (p, g) in enumerate(zip(param_vals, grads)):
+            m = opt_vals[2 * i]
+            v = opt_vals[2 * i + 1]
+            gf = g.astype(jnp.float32) + wd * p.astype(jnp.float32)
+            m2 = b1 * m + (1 - b1) * gf
+            v2 = b2 * v + (1 - b2) * gf * gf
+            m_hat = (mu_t1 * m2 / (1 - mprod_t1)
+                     + (1 - mu_t) * gf / (1 - mprod_t))
+            v_hat = v2 / (1 - b2 ** t)
+            new_p.append((p.astype(jnp.float32)
+                          - lr * m_hat / (jnp.sqrt(v_hat) + eps)
+                          ).astype(p.dtype))
+            new_o.extend([m2, v2])
+        new_o.append(mprod_t)
+        return tuple(new_p), tuple(new_o)
+
+
+class RAdam(_PureApplied):
+    """Rectified Adam (Liu et al. 2019): variance-rectified warmup."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None, **kw):
+        super().__init__(learning_rate, parameters, weight_decay,
+                         grad_clip, name)
+        self._b1, self._b2 = float(beta1), float(beta2)
+        self._eps = float(epsilon)
+
+    def _static_state(self, params):
+        out = []
+        for p in params:
+            out.append(self._acc("moment1", p))
+            out.append(self._acc("moment2", p))
+        return out
+
+    def _pure_update(self, lr, step, param_vals, grads, opt_vals, params):
+        wd = self._decay_coeff()
+        b1, b2, eps = self._b1, self._b2, self._eps
+        t = step.astype(jnp.float32) + 1.0
+        rho_inf = 2.0 / (1.0 - b2) - 1.0
+        b2t = b2 ** t
+        rho_t = rho_inf - 2.0 * t * b2t / (1.0 - b2t)
+        rect = jnp.sqrt(
+            ((rho_t - 4) * (rho_t - 2) * rho_inf)
+            / jnp.maximum((rho_inf - 4) * (rho_inf - 2) * rho_t, 1e-8))
+        use_rect = rho_t > 5.0
+        new_p, new_o = [], []
+        for i, (p, g) in enumerate(zip(param_vals, grads)):
+            m = opt_vals[2 * i]
+            v = opt_vals[2 * i + 1]
+            gf = g.astype(jnp.float32) + wd * p.astype(jnp.float32)
+            m2 = b1 * m + (1 - b1) * gf
+            v2 = b2 * v + (1 - b2) * gf * gf
+            m_hat = m2 / (1 - b1 ** t)
+            v_hat = jnp.sqrt(v2 / (1.0 - b2t))
+            upd = jnp.where(use_rect,
+                            rect * m_hat / (v_hat + eps),
+                            m_hat)
+            new_p.append((p.astype(jnp.float32) - lr * upd
+                          ).astype(p.dtype))
+            new_o.extend([m2, v2])
+        return tuple(new_p), tuple(new_o)
+
+
+class LBFGS(Optimizer):
+    """Limited-memory BFGS with closure-driven steps (eager only).
+
+    step(closure) re-evaluates the loss as the reference does; the
+    two-loop recursion runs on device arrays, history on the host."""
+
+    def __init__(self, learning_rate=1.0, max_iter=20, max_eval=None,
+                 tolerance_grad=1e-7, tolerance_change=1e-9,
+                 history_size=100, line_search_fn=None, parameters=None,
+                 weight_decay=None, grad_clip=None, name=None, **kw):
+        super().__init__(learning_rate, parameters, weight_decay,
+                         grad_clip, name)
+        self.max_iter = int(max_iter)
+        self.tol_grad = float(tolerance_grad)
+        self.tol_change = float(tolerance_change)
+        self.history_size = int(history_size)
+        self.line_search_fn = line_search_fn
+        self._s, self._y = [], []
+
+    def _flat(self, params, attr):
+        vs = [(p._value if attr == "p" else p.grad._value).astype(
+            jnp.float32).reshape(-1) for p in params]
+        return jnp.concatenate(vs)
+
+    def _unflatten_to(self, params, flat):
+        off = 0
+        for p in params:
+            n = int(np.prod(p._value.shape))
+            p._inplace_update(
+                flat[off:off + n].reshape(p._value.shape).astype(
+                    p._value.dtype))
+            off += n
+
+    @autograd.no_grad()
+    def step(self, closure=None):
+        if closure is None:
+            raise ValueError("LBFGS.step requires a closure that "
+                             "re-evaluates the loss")
+        params = [p for p in (self._parameter_list or [])
+                  if not p.stop_gradient]
+
+        def eval_closure():
+            with autograd.enable_grad():
+                loss = closure()
+            return loss
+
+        loss = eval_closure()
+        for _ in range(self.max_iter):
+            g = self._flat(params, "g")
+            if float(jnp.max(jnp.abs(g))) <= self.tol_grad:
+                break
+            # two-loop recursion over (s, y) history
+            q = g
+            alphas = []
+            for s, y in reversed(list(zip(self._s, self._y))):
+                rho = 1.0 / jnp.maximum(jnp.vdot(y, s), 1e-10)
+                a = rho * jnp.vdot(s, q)
+                alphas.append((a, rho, s, y))
+                q = q - a * y
+            if self._y:
+                y_last, s_last = self._y[-1], self._s[-1]
+                gamma = jnp.vdot(s_last, y_last) / jnp.maximum(
+                    jnp.vdot(y_last, y_last), 1e-10)
+                q = q * gamma
+            for a, rho, s, y in reversed(alphas):
+                b = rho * jnp.vdot(y, q)
+                q = q + (a - b) * s
+            d = -q
+            x0 = self._flat(params, "p")
+            lr = float(self._current_lr())
+            # backtracking line search (sufficient decrease)
+            f0 = float(loss)
+            t = lr
+            gtd = float(jnp.vdot(g, d))
+            for _ls in range(10):
+                self._unflatten_to(params, x0 + t * d)
+                self.clear_grad()
+                loss = eval_closure()
+                if float(loss) <= f0 + 1e-4 * t * gtd:
+                    break
+                t *= 0.5
+            g_new = self._flat(params, "g")
+            s_vec = t * d
+            y_vec = g_new - g
+            if float(jnp.vdot(s_vec, y_vec)) > 1e-10:
+                self._s.append(s_vec)
+                self._y.append(y_vec)
+                if len(self._s) > self.history_size:
+                    self._s.pop(0)
+                    self._y.pop(0)
+            if float(jnp.max(jnp.abs(s_vec))) <= self.tol_change:
+                break
+        self._step_count._inplace_update(
+            np.asarray(self._step_count._value) + 1)
+        return loss

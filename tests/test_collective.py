@@ -250,3 +250,16 @@ def test_unflatten_negative_axis():
     u = paddle.nn.Unflatten(-1, [2, 3])
     out = u(paddle.to_tensor(np.zeros((4, 6), np.float32)))
     assert tuple(out.shape) == (4, 2, 3)
+
+
+def test_dist_split_named_reuse_with_equal_attr_config():
+    import numpy as np
+    from paddle_tpu.distributed import split_api
+    from paddle_tpu.nn import initializer as I
+    split_api.reset_split_cache()
+    x = paddle.to_tensor(np.ones((2, 8), np.float32))
+    a = dist.split(x, (8, 4), operation="linear", axis=1, name="eqw",
+                   weight_attr=I.Constant(0.5))
+    b = dist.split(x, (8, 4), operation="linear", axis=1, name="eqw",
+                   weight_attr=I.Constant(0.5))  # fresh-but-equal attr
+    np.testing.assert_allclose(a.numpy(), b.numpy())

@@ -264,3 +264,15 @@ def test_max_unpool2d_roundtrip():
     assert unpooled.sum() >= 12.0  # maxima land back at their positions
     layer = nn.MaxUnPool2D(2)
     np.testing.assert_allclose(layer(pooled, idx).numpy(), unpooled)
+
+
+def test_max_unpool2d_requires_output_size_when_lossy():
+    x = np.zeros((1, 1, 5, 5), np.float32)
+    x[0, 0, 2, 3] = 9.0
+    t = paddle.to_tensor(x)
+    pooled, idx = paddle.nn.functional.max_pool2d(t, 2, return_mask=True)
+    # 5x5 pooled by 2 is lossy: with the true output_size the max lands
+    # back exactly where it came from
+    out = paddle.nn.functional.max_unpool2d(
+        pooled, idx, 2, output_size=[5, 5]).numpy()
+    assert out[0, 0, 2, 3] == 9.0
