@@ -35,13 +35,17 @@ def split(x, size, operation="linear", axis=0, num_partitions=None,
     # pass `name` to reuse one layer across steps in an eager loop.
     # A named hit is validated against the full signature including the
     # attr objects so a changed initializer cannot be silently ignored.
-    def _attr_sig(attr):
+    def _attr_sig(attr, _depth=0):
         # compare attrs by CONFIG, not identity: a fresh-but-identical
-        # initializer each step must hit the cache
-        if attr is None or attr is False:
+        # initializer each step must hit the cache.  Recurse into
+        # nested config objects (ParamAttr.initializer etc.) — their
+        # default repr embeds the memory address and would never match.
+        if attr is None or isinstance(attr, (bool, int, float, str)):
             return attr
+        if _depth > 4 or not hasattr(attr, "__dict__"):
+            return (type(attr).__name__,)
         return (type(attr).__name__,
-                tuple(sorted((k, repr(v))
+                tuple(sorted((k, _attr_sig(v, _depth + 1))
                              for k, v in vars(attr).items())))
 
     key = None

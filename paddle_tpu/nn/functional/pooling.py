@@ -241,10 +241,10 @@ def max_unpool2d(x, indices, kernel_size, stride=None, padding=0,
         H = (oh - 1) * s[0] - 2 * p[0] + k[0]
         W = (ow - 1) * s[1] - 2 * p[1] + k[1]
     try:  # eager guard: an index beyond H*W means the inferred shape
-        # is too small — the caller must supply output_size
-        mx = int(np.asarray(
-            indices._value if hasattr(indices, "_value")
-            else indices).max())
+        # is too small — the caller must supply output_size.  The max
+        # reduces ON DEVICE; only the scalar crosses to host.
+        mx = int((indices._value if hasattr(indices, "_value")
+                  else indices).max())
         if mx >= H * W:
             raise ValueError(
                 f"max_unpool2d: index {mx} outside the inferred "

@@ -84,3 +84,58 @@ Tensor.matmul = linalg.matmul
 Tensor.mm = linalg.mm
 Tensor.norm = linalg.norm
 Tensor.dim = lambda self: self.ndim
+
+
+# ---- method-only fills (reference eager_method.cc surface) ----
+
+def _fill_(self, value):
+    """In-place fill with a scalar."""
+    import jax.numpy as jnp
+    self._inplace_update(jnp.full_like(self._value, value))
+    return self
+
+
+def _zero_(self):
+    import jax.numpy as jnp
+    self._inplace_update(jnp.zeros_like(self._value))
+    return self
+
+
+def _clip_(self, min=None, max=None):
+    out = math.clip(self, min, max)
+    self._inplace_update(out._value, out._grad_node, out._out_index)
+    return self
+
+
+def _scale_(self, scale=1.0, bias=0.0, bias_after_scale=True):
+    out = math.scale(self, scale, bias, bias_after_scale)
+    self._inplace_update(out._value, out._grad_node, out._out_index)
+    return self
+
+
+def _lerp_(self, y, weight):
+    out = math.lerp(self, y, weight)
+    self._inplace_update(out._value, out._grad_node, out._out_index)
+    return self
+
+
+def _sigmoid(self, name=None):
+    from ..nn.functional.activation import sigmoid as _f
+    return _f(self)
+
+
+def _softmax(self, axis=-1, name=None):
+    from ..nn.functional.activation import softmax as _f
+    return _f(self, axis=axis)
+
+
+Tensor.fill_ = _fill_
+Tensor.zero_ = _zero_
+Tensor.clip_ = _clip_
+Tensor.scale_ = _scale_
+Tensor.lerp_ = _lerp_
+Tensor.sigmoid = _sigmoid
+Tensor.softmax = _softmax
+Tensor.ndimension = lambda self: self.ndim
+if not hasattr(Tensor, "nonzero"):
+    Tensor.nonzero = manipulation.nonzero

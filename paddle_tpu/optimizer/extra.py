@@ -82,30 +82,39 @@ class Rprop(_PureApplied):
 
 
 class ASGD(_PureApplied):
-    """Averaged SGD: plain SGD steps plus a running average of the
-    iterates; the average is what `ax` accumulators hold (swap in for
-    evaluation via state_dict, the reference contract)."""
+    """Averaged SGD: steps on the running mean of the last ~batch_num
+    gradients (the reference's gradient-averaging window, kept as a
+    streaming mean `d`), plus a running average of the iterates in `ax`
+    (swap in for evaluation via state_dict, the reference contract)."""
 
     def __init__(self, learning_rate=0.001, batch_num=1, parameters=None,
                  weight_decay=None, grad_clip=None,
                  multi_precision=False, name=None, **kw):
         super().__init__(learning_rate, parameters, weight_decay,
                          grad_clip, name)
-        self._batch_num = int(batch_num)
+        self._batch_num = max(1, int(batch_num))
 
     def _static_state(self, params):
-        return [self._acc("ax", p) for p in params]
+        out = []
+        for p in params:
+            out.append(self._acc("grad_avg", p))
+            out.append(self._acc("ax", p))
+        return out
 
     def _pure_update(self, lr, step, param_vals, grads, opt_vals, params):
         wd = self._decay_coeff()
         t = step.astype(jnp.float32) + 1.0
-        new_p, new_ax = [], []
-        for p, g, ax in zip(param_vals, grads, opt_vals):
+        win = jnp.minimum(t, float(self._batch_num))
+        new_p, new_o = [], []
+        for i, (p, g) in enumerate(zip(param_vals, grads)):
+            d = opt_vals[2 * i]
+            ax = opt_vals[2 * i + 1]
             gf = g.astype(jnp.float32) + wd * p.astype(jnp.float32)
-            p2 = p.astype(jnp.float32) - lr * gf
+            d2 = d + (gf - d) / win          # windowed gradient mean
+            p2 = p.astype(jnp.float32) - lr * d2
             new_p.append(p2.astype(p.dtype))
-            new_ax.append(ax + (p2 - ax) / t)   # running iterate average
-        return tuple(new_p), tuple(new_ax)
+            new_o.extend([d2, ax + (p2 - ax) / t])
+        return tuple(new_p), tuple(new_o)
 
 
 class NAdam(_PureApplied):
@@ -126,9 +135,15 @@ class NAdam(_PureApplied):
             out.append(self._acc("moment1", p))
             out.append(self._acc("moment2", p))
         # the cumulative momentum product is real STATE (Dozat's
-        # schedule), carried as one scalar accumulator at the end
-        out.append(self._acc("mu_product", params[0], init=1.0,
-                             shape=(), dtype=jnp.float32))
+        # schedule); owned by the OPTIMIZER, not keyed to any param —
+        # a changing first-param (frozen layers) must not reset it
+        if not hasattr(self, "_mu_product_t"):
+            self._mu_product_t = Tensor(jnp.asarray(1.0, jnp.float32),
+                                        _internal=True,
+                                        stop_gradient=True)
+            self._mu_product_t.name = "nadam_mu_product"
+            self._mu_product_t.persistable = True
+        out.append(self._mu_product_t)
         return out
 
     def _pure_update(self, lr, step, param_vals, grads, opt_vals, params):
@@ -217,15 +232,29 @@ class LBFGS(Optimizer):
         super().__init__(learning_rate, parameters, weight_decay,
                          grad_clip, name)
         self.max_iter = int(max_iter)
+        self.max_eval = (int(max_eval) if max_eval is not None
+                         else self.max_iter * 5 // 4)
         self.tol_grad = float(tolerance_grad)
         self.tol_change = float(tolerance_change)
         self.history_size = int(history_size)
+        if line_search_fn not in (None, "strong_wolfe"):
+            raise ValueError(
+                f"line_search_fn must be None or 'strong_wolfe', got "
+                f"{line_search_fn!r}")
         self.line_search_fn = line_search_fn
         self._s, self._y = [], []
 
     def _flat(self, params, attr):
-        vs = [(p._value if attr == "p" else p.grad._value).astype(
-            jnp.float32).reshape(-1) for p in params]
+        wd = self._decay_coeff()
+        vs = []
+        for p in params:
+            if attr == "p":
+                v = p._value.astype(jnp.float32)
+            else:
+                v = p.grad._value.astype(jnp.float32)
+                if wd:
+                    v = v + wd * p._value.astype(jnp.float32)
+            vs.append(v.reshape(-1))
         return jnp.concatenate(vs)
 
     def _unflatten_to(self, params, flat):
@@ -242,15 +271,22 @@ class LBFGS(Optimizer):
         if closure is None:
             raise ValueError("LBFGS.step requires a closure that "
                              "re-evaluates the loss")
-        params = [p for p in (self._parameter_list or [])
-                  if not p.stop_gradient]
+        n_evals = [0]
 
         def eval_closure():
             with autograd.enable_grad():
                 loss = closure()
+            n_evals[0] += 1
             return loss
 
         loss = eval_closure()
+        # only parameters the closure actually gradded participate
+        # (frozen/unused submodules must not crash the flatten)
+        params = self._params_with_grad()
+        if not params:
+            return loss
+        if self._grad_clip is not None:
+            self._grad_clip(params)
         for _ in range(self.max_iter):
             g = self._flat(params, "g")
             if float(jnp.max(jnp.abs(g))) <= self.tol_grad:
@@ -274,17 +310,22 @@ class LBFGS(Optimizer):
             d = -q
             x0 = self._flat(params, "p")
             lr = float(self._current_lr())
-            # backtracking line search (sufficient decrease)
             f0 = float(loss)
             t = lr
             gtd = float(jnp.vdot(g, d))
-            for _ls in range(10):
+            if self.line_search_fn is None:
+                # reference contract: no line search → one fixed-lr step
                 self._unflatten_to(params, x0 + t * d)
                 self.clear_grad()
                 loss = eval_closure()
-                if float(loss) <= f0 + 1e-4 * t * gtd:
-                    break
-                t *= 0.5
+            else:  # 'strong_wolfe' ~ backtracking sufficient decrease
+                for _ls in range(10):
+                    self._unflatten_to(params, x0 + t * d)
+                    self.clear_grad()
+                    loss = eval_closure()
+                    if float(loss) <= f0 + 1e-4 * t * gtd:
+                        break
+                    t *= 0.5
             g_new = self._flat(params, "g")
             s_vec = t * d
             y_vec = g_new - g
@@ -295,6 +336,8 @@ class LBFGS(Optimizer):
                     self._s.pop(0)
                     self._y.pop(0)
             if float(jnp.max(jnp.abs(s_vec))) <= self.tol_change:
+                break
+            if n_evals[0] >= self.max_eval:
                 break
         self._step_count._inplace_update(
             np.asarray(self._step_count._value) + 1)

@@ -98,3 +98,38 @@ def test_lbfgs_quadratic():
     assert float(loss.numpy()) < l0 * 0.01  # near-exact on a quadratic
     with pytest.raises(ValueError, match="closure"):
         opt.step()
+
+
+def test_lbfgs_strong_wolfe_and_unused_params():
+    m, x, y = _problem(19)
+    extra = nn.Linear(3, 3)  # never used by the loss → grad stays None
+    opt = optimizer.LBFGS(learning_rate=1.0, max_iter=8,
+                          line_search_fn="strong_wolfe",
+                          parameters=list(m.parameters())
+                          + list(extra.parameters()))
+
+    def closure():
+        opt.clear_grad()
+        loss = _loss(m, x, y)
+        loss.backward()
+        return loss
+
+    l0 = float(_loss(m, x, y).numpy())
+    loss = opt.step(closure)  # must not crash on the ungradded params
+    assert float(loss.numpy()) < l0
+
+
+def test_asgd_batch_num_changes_trajectory():
+    m1, x, y = _problem(23)
+    m2, _, _ = _problem(23)
+    o1 = optimizer.ASGD(learning_rate=0.05, batch_num=1,
+                        parameters=m1.parameters())
+    o2 = optimizer.ASGD(learning_rate=0.05, batch_num=8,
+                        parameters=m2.parameters())
+    for _ in range(5):
+        for m, o in [(m1, o1), (m2, o2)]:
+            loss = _loss(m, x, y)
+            loss.backward()
+            o.step()
+            o.clear_grad()
+    assert not np.allclose(m1.weight.numpy(), m2.weight.numpy())

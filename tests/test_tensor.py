@@ -102,3 +102,27 @@ def test_iteration():
     rows = list(t)
     assert len(rows) == 3
     assert rows[0].shape == [2]
+
+
+def test_round3_method_fills():
+    t = paddle.to_tensor(np.array([-2.0, 0.5, 3.0], np.float32))
+    assert t.ndimension() == 1
+    s = t.sigmoid().numpy()
+    np.testing.assert_allclose(s, 1 / (1 + np.exp(-t.numpy())),
+                               rtol=1e-5)
+    sm = t.softmax().numpy()
+    np.testing.assert_allclose(sm.sum(), 1.0, rtol=1e-6)
+    t.clip_(min=0.0)
+    assert t.numpy().min() >= 0.0
+    t.fill_(7.0)
+    np.testing.assert_allclose(t.numpy(), 7.0)
+    t.zero_()
+    np.testing.assert_allclose(t.numpy(), 0.0)
+    t.fill_(2.0)
+    t.scale_(3.0, bias=1.0)
+    np.testing.assert_allclose(t.numpy(), 7.0)
+    a = paddle.to_tensor(np.zeros(3, np.float32))
+    a.lerp_(paddle.to_tensor(np.ones(3, np.float32)), 0.25)
+    np.testing.assert_allclose(a.numpy(), 0.25)
+    nz = paddle.to_tensor(np.array([0.0, 1.0, 0.0, 2.0])).nonzero()
+    np.testing.assert_array_equal(np.asarray(nz.numpy()).ravel(), [1, 3])
