@@ -208,18 +208,49 @@ class Optimizer:
         if isinstance(self._learning_rate, LRScheduler):
             out["LR_Scheduler"] = self._learning_rate.state_dict()
         out["global_step"] = self._step_count
+        # auto-generated parameter names are session-counter dependent;
+        # recording the save-time order lets set_state_dict map state
+        # POSITIONALLY onto a freshly-built optimizer whose names differ
+        out["__param_names__"] = [
+            p.name for p in (self._parameter_list or [])
+            if not p.stop_gradient]
         return out
 
     def set_state_dict(self, state_dict):
-        for acc_name, d in self._accumulators.items():
-            for pname in d:
-                key = f"{pname}_{acc_name}"
-                if key in state_dict:
-                    src = state_dict[key]
-                    v = src._value if isinstance(src, Tensor) else \
-                        jnp.asarray(np.asarray(src))
-                    d[pname]._inplace_update(
-                        jnp.asarray(v, d[pname]._value.dtype))
+        def _val(src):
+            return src._value if isinstance(src, Tensor) else \
+                jnp.asarray(np.asarray(src))
+
+        saved_names = state_dict.get("__param_names__")
+        if saved_names is not None:
+            # positional mapping: saved param i ↔ current param i; the
+            # accumulator is MATERIALIZED via _acc so a fresh optimizer
+            # (empty _accumulators) restores correctly
+            cur = [p for p in (self._parameter_list or [])
+                   if not p.stop_gradient]
+            by_len = sorted(saved_names, key=len, reverse=True)
+            pos = {n: i for i, n in enumerate(saved_names)}
+            for key, src in state_dict.items():
+                if key in ("LR_Scheduler", "global_step",
+                           "__param_names__"):
+                    continue
+                for n in by_len:  # longest prefix wins (names nest)
+                    if key.startswith(n + "_"):
+                        i = pos[n]
+                        if i < len(cur):
+                            acc_name = key[len(n) + 1:]
+                            t = self._acc(acc_name, cur[i])
+                            t._inplace_update(jnp.asarray(
+                                _val(src), t._value.dtype))
+                        break
+        else:  # legacy dicts: name-matched into existing accumulators
+            for acc_name, d in self._accumulators.items():
+                for pname in d:
+                    key = f"{pname}_{acc_name}"
+                    if key in state_dict:
+                        d[pname]._inplace_update(jnp.asarray(
+                            _val(state_dict[key]),
+                            d[pname]._value.dtype))
         if "LR_Scheduler" in state_dict and isinstance(
                 self._learning_rate, LRScheduler):
             self._learning_rate.set_state_dict(state_dict["LR_Scheduler"])

@@ -1,0 +1,96 @@
+"""End-to-end user journey: the workflow a reference user follows.
+
+train (eager + AMP) → jit.save → paddle.inference predictor → PTQ
+quantize → LLM generate — one integration pass over the seams between
+subsystems that unit tests cover individually.
+"""
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, optimizer, static
+from paddle_tpu.inference import Config, create_predictor
+from paddle_tpu.quantization import PTQ, QuantConfig
+
+
+def test_train_save_deploy_quantize(tmp_path):
+    paddle.seed(77)
+    net = nn.Sequential(nn.Linear(8, 32), nn.ReLU(), nn.Linear(32, 4))
+    opt = optimizer.AdamW(learning_rate=1e-2,
+                          parameters=net.parameters())
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(64, 8)).astype(np.float32)
+    w = rng.normal(size=(8, 4)).astype(np.float32)
+    y = x @ w
+
+    # 1. train with AMP autocast
+    first = None
+    for i in range(15):
+        with paddle.amp.auto_cast(dtype="bfloat16", level="O1"):
+            loss = paddle.nn.functional.mse_loss(
+                net(paddle.to_tensor(x)), paddle.to_tensor(y))
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        first = first if first is not None else float(loss.numpy())
+    assert float(loss.numpy()) < first * 0.5
+
+    # 2. save a deployable artifact
+    net.eval()
+    prefix = str(tmp_path / "deploy")
+    paddle.jit.save(net, prefix,
+                    input_spec=[static.InputSpec([4, 8], "float32",
+                                                 name="feat")])
+
+    # 3. serve it through the inference predictor (no model code)
+    pred = create_predictor(Config(prefix + ".pdmodel",
+                                   prefix + ".pdiparams"))
+    h = pred.get_input_handle("feat")
+    h.copy_from_cpu(x[:4])
+    pred.run()
+    served = pred.get_output_handle(pred.get_output_names()[0])
+    want = net(paddle.to_tensor(x[:4])).numpy()
+    np.testing.assert_allclose(served.copy_to_cpu(), want, rtol=1e-2,
+                               atol=1e-2)
+
+    # 4. PTQ-calibrate the trained model; outputs stay close to float
+    ptq = PTQ(QuantConfig())
+    qnet = ptq.quantize(net)
+    for i in range(3):
+        qnet(paddle.to_tensor(x[i * 8:(i + 1) * 8]))
+    ptq.convert(qnet)
+    q_out = qnet(paddle.to_tensor(x[:4])).numpy()
+    rel = np.abs(q_out - want).mean() / (np.abs(want).mean() + 1e-6)
+    assert rel < 0.1  # int8 fake-quant stays within ~10% of float
+
+
+def test_checkpoint_resume_continues_training(tmp_path):
+    paddle.seed(31)
+    net = nn.Linear(6, 2)
+    opt = optimizer.AdamW(learning_rate=1e-2,
+                          parameters=net.parameters())
+    rng = np.random.default_rng(1)
+    x = rng.normal(size=(16, 6)).astype(np.float32)
+    y = rng.normal(size=(16, 2)).astype(np.float32)
+
+    def step(n, o):
+        loss = paddle.nn.functional.mse_loss(
+            n(paddle.to_tensor(x)), paddle.to_tensor(y))
+        loss.backward()
+        o.step()
+        o.clear_grad()
+        return float(loss.numpy())
+
+    for _ in range(3):
+        step(net, opt)
+    paddle.save(net.state_dict(), str(tmp_path / "m.pdparams"))
+    paddle.save(opt.state_dict(), str(tmp_path / "m.pdopt"))
+    ref = [step(net, opt) for _ in range(2)]
+
+    paddle.seed(31)
+    net2 = nn.Linear(6, 2)
+    opt2 = optimizer.AdamW(learning_rate=1e-2,
+                           parameters=net2.parameters())
+    net2.set_state_dict(paddle.load(str(tmp_path / "m.pdparams")))
+    opt2.set_state_dict(paddle.load(str(tmp_path / "m.pdopt")))
+    got = [step(net2, opt2) for _ in range(2)]
+    np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-6)
