@@ -1,10 +1,10 @@
 """Benchmark driver hook: prints ONE JSON line on stdout.
 
 Headline: BERT-base MLM pretraining step (BASELINE.md config #3 — static
-graph + StandaloneExecutor-equivalent, AMP bf16) on the available
-accelerator.  The whole train step (fwd, bwd, fused AdamW) is captured
-as a Program and compiled once to a single XLA executable; steady-state
-step time is measured.
+graph + StandaloneExecutor-equivalent, AMP bf16).  Additional BASELINE.md
+configs ride in ``extra_metrics``: LeNet dygraph fp32 (#1), ResNet50
+dygraph AMP bf16 (#2), GPT flash+recompute bf16 (#4, sized to one chip),
+LLaMA sharding-stage2+TP dryrun on the 8-device CPU mesh (#5).
 
 `vs_baseline`: BASELINE.md's operative target is "match A100"; with no
 published reference numbers (empty mount — see BASELINE.md caveat) the
@@ -12,11 +12,32 @@ hardware-neutral comparison is model-FLOPs-utilization.  vs_baseline =
 measured MFU / 0.40, 0.40 being a strong A100 mixed-precision BERT
 pretraining MFU (A100 runs at 312 bf16 TFLOP/s peak; 40% is the
 well-tuned reference point).  >1.0 beats the reference.
+
+Tunnel resilience (VERDICT r3 "next" #1 — three rounds of recorded 0.0):
+  * device liveness is probed in a SUBPROCESS with retry/backoff; a
+    wedged axon tunnel hangs ``jax.devices()`` for hours and must never
+    hang (or crash) the bench process itself.  A hung probe is
+    abandoned, not killed — SIGTERM on a jax process mid-claim is what
+    wedges the tunnel server side in the first place.
+  * every completed config immediately updates ``.bench_cache/
+    latest.json``, so a wedge mid-run keeps earlier results.
+  * if the TPU is unreachable at driver time but a measurement was
+    captured earlier (the in-round watcher `scripts/bench_watch.py`
+    runs this bench in the first healthy window), the cached JSON is
+    emitted with ``"cached": true`` instead of a 0.0.
+  * nothing exits rc=1 for a dead tunnel; that state is the loud
+    ``"tpu_unreachable": true`` field instead.
 """
 import json
 import os
+import subprocess
 import sys
 import time
+from pathlib import Path
+
+ROOT = Path(__file__).resolve().parent
+CACHE_PATH = ROOT / ".bench_cache" / "latest.json"
+HEADLINE = "bert_base_mlm_static_bf16_tokens_per_sec"
 
 
 def log(msg):
@@ -41,28 +62,384 @@ def device_peak_flops():
     return None, kind or d.platform
 
 
-def main():
-    t0 = time.time()
-    log("initializing backend (first touch may be slow over the tunnel)…")
-    import jax
+# ---------------------------------------------------------------------
+# Tunnel probe
+# ---------------------------------------------------------------------
+_PROBE_CODE = r"""
+import json
+import jax
+d = jax.devices()[0]
+import jax.numpy as jnp
+x = jnp.ones((128, 128))
+(x @ x).sum().block_until_ready()
+print("PROBE_OK " + json.dumps(
+    {"platform": d.platform, "kind": getattr(d, "device_kind", "")}))
+"""
+
+
+def probe_device(wait_s=240, attempts=2, backoff_s=20):
+    """Return {"platform", "kind"} from a subprocess probe, or None."""
+    for a in range(attempts):
+        t0 = time.time()
+        p = subprocess.Popen(
+            [sys.executable, "-c", _PROBE_CODE],
+            stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True)
+        while time.time() - t0 < wait_s and p.poll() is None:
+            time.sleep(2)
+        rc = p.poll()
+        if rc == 0:
+            for line in (p.stdout.read() or "").splitlines():
+                if line.startswith("PROBE_OK "):
+                    info = json.loads(line[len("PROBE_OK "):])
+                    log(f"probe ok in {time.time()-t0:.0f}s: {info}")
+                    return info
+            log("probe exited 0 without marker")
+        elif rc is None:
+            # abandoned on purpose — do NOT p.kill() (see module docstring)
+            log(f"probe attempt {a+1}/{attempts}: hung >{wait_s}s; "
+                "abandoning the process")
+        else:
+            log(f"probe attempt {a+1}/{attempts}: rc={rc}")
+        if a + 1 < attempts:
+            time.sleep(backoff_s)
+    return None
+
+
+def _git_rev():
+    try:
+        return subprocess.run(
+            ["git", "-C", str(ROOT), "rev-parse", "--short", "HEAD"],
+            capture_output=True, text=True, timeout=10).stdout.strip()
+    except Exception:
+        return ""
+
+
+def save_cache(payload):
+    try:
+        CACHE_PATH.parent.mkdir(exist_ok=True)
+        CACHE_PATH.write_text(json.dumps(payload, indent=1))
+    except Exception as e:
+        log(f"cache write failed: {e}")
+
+
+CACHE_MAX_AGE_S = 16 * 3600  # one build round
+
+
+def load_cache():
+    """Only an in-round capture counts: a cache older than one round
+    (or missing its timestamp) must not masquerade as current."""
+    try:
+        data = json.loads(CACHE_PATH.read_text())
+        age = time.time() - data.get("captured_unix", 0)
+        if data.get("value", 0) > 0 and 0 <= age < CACHE_MAX_AGE_S:
+            return data
+    except Exception:
+        pass
+    return None
+
+
+def _hbm_peak_gb():
+    try:
+        import jax
+        stats = jax.devices()[0].memory_stats() or {}
+        peak = stats.get("peak_bytes_in_use")
+        if peak:
+            return round(peak / 2**30, 2)
+    except Exception:
+        pass
+    return None
+
+
+# ---------------------------------------------------------------------
+# Config #3 (headline): BERT-base MLM, static graph, AMP bf16
+# ---------------------------------------------------------------------
+def bench_bert(on_tpu, peak):
     import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu import optimizer, static
+    from paddle_tpu.models import BertConfig, BertForMaskedLM
+
+    B, S = (32, 128) if on_tpu else (4, 64)
+    cfg = BertConfig() if on_tpu else BertConfig(
+        hidden_size=128, num_hidden_layers=2, num_attention_heads=2,
+        intermediate_size=256)
+    n_iters = 20 if on_tpu else 3
+
+    paddle.enable_static()
+    try:
+        main_prog = static.Program()
+        startup = static.Program()
+        t = time.time()
+        with static.program_guard(main_prog, startup):
+            ids = static.data("ids", [B, S], "int64")
+            labels = static.data("labels", [B, S], "int64")
+            model = BertForMaskedLM(cfg)
+            with paddle.amp.auto_cast(dtype="bfloat16", level="O1"):
+                loss, _ = model(ids, labels=labels)
+            opt = optimizer.AdamW(learning_rate=1e-4,
+                                  parameters=model.parameters())
+            opt.minimize(loss)
+        log(f"bert: program built "
+            f"({len(main_prog.global_block().ops)} ops, "
+            f"{time.time()-t:.1f}s)")
+
+        n_params = sum(int(np.prod(p.shape)) for p in model.parameters())
+        exe = static.Executor()
+        rng = np.random.default_rng(0)
+        x = rng.integers(0, cfg.vocab_size, (B, S)).astype(np.int64)
+        fd = {"ids": x, "labels": x}
+
+        t = time.time()
+        (l0,) = exe.run(main_prog, feed=fd, fetch_list=[loss])
+        log(f"bert: compile+first step {time.time()-t:.1f}s "
+            f"loss={float(l0):.3f}")
+
+        t = time.time()
+        for _ in range(n_iters):
+            (lv,) = exe.run(main_prog, feed=fd, fetch_list=[loss])
+        dt = (time.time() - t) / n_iters
+        log(f"bert: steady step {dt*1e3:.1f} ms loss={float(lv):.3f}")
+
+        tokens_per_sec = B * S / dt
+        L, H = cfg.num_hidden_layers, cfg.hidden_size
+        attn_flops = 12 * L * S * H      # per token: QK^T + PV, fwd+bwd
+        flops_per_token = 6 * n_params + attn_flops
+        achieved = flops_per_token * tokens_per_sec
+        mfu = achieved / peak if peak else 0.0
+        log(f"bert: tokens/s={tokens_per_sec:,.0f} "
+            f"achieved={achieved/1e12:.1f} TF/s MFU={mfu:.3f}")
+        return {"tokens_per_sec": round(tokens_per_sec, 1),
+                "step_ms": round(dt * 1e3, 2), "mfu": round(mfu, 4),
+                "hbm_peak_gb": _hbm_peak_gb()}
+    finally:
+        paddle.disable_static()
+
+
+# ---------------------------------------------------------------------
+# Config #1: LeNet dygraph fp32
+# ---------------------------------------------------------------------
+def bench_lenet(on_tpu):
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu import optimizer
+    from paddle_tpu.vision.models import LeNet
+    import paddle_tpu.nn.functional as F
+
+    B = 64
+    n_iters = 10 if on_tpu else 3
+    paddle.seed(0)
+    model = LeNet(num_classes=10)
+    opt = optimizer.Adam(learning_rate=1e-3,
+                         parameters=model.parameters())
+    rng = np.random.default_rng(0)
+    img = paddle.to_tensor(
+        rng.standard_normal((B, 1, 28, 28)).astype(np.float32))
+    label = paddle.to_tensor(
+        rng.integers(0, 10, (B,)).astype(np.int64))
+
+    def step():
+        loss = F.cross_entropy(model(img), label)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        return loss
+
+    t = time.time()
+    step()
+    log(f"lenet: first step {time.time()-t:.1f}s")
+    t = time.time()
+    for _ in range(n_iters):
+        loss = step()
+    loss.numpy()  # sync
+    dt = (time.time() - t) / n_iters
+    log(f"lenet: dygraph step {dt*1e3:.1f} ms "
+        f"({B/dt:,.0f} imgs/s)")
+    return {"imgs_per_sec": round(B / dt, 1),
+            "step_ms": round(dt * 1e3, 2)}
+
+
+# ---------------------------------------------------------------------
+# Config #2: ResNet50 dygraph AMP bf16
+# ---------------------------------------------------------------------
+def bench_resnet50(on_tpu):
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu import optimizer
+    from paddle_tpu.vision.models import resnet50
+    import paddle_tpu.nn.functional as F
+
+    B, HW = (32, 224) if on_tpu else (2, 64)
+    n_iters = 5 if on_tpu else 2
+    paddle.seed(0)
+    model = resnet50(num_classes=1000)
+    opt = optimizer.Momentum(learning_rate=0.1, momentum=0.9,
+                             parameters=model.parameters())
+    rng = np.random.default_rng(0)
+    img = paddle.to_tensor(
+        rng.standard_normal((B, 3, HW, HW)).astype(np.float32))
+    label = paddle.to_tensor(
+        rng.integers(0, 1000, (B,)).astype(np.int64))
+
+    def step():
+        with paddle.amp.auto_cast(dtype="bfloat16", level="O1"):
+            loss = F.cross_entropy(model(img), label)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        return loss
+
+    t = time.time()
+    step()
+    log(f"resnet50: first step {time.time()-t:.1f}s")
+    t = time.time()
+    for _ in range(n_iters):
+        loss = step()
+    loss.numpy()
+    dt = (time.time() - t) / n_iters
+    log(f"resnet50: dygraph AMP step {dt*1e3:.1f} ms "
+        f"({B/dt:,.0f} imgs/s)")
+    return {"imgs_per_sec": round(B / dt, 1),
+            "step_ms": round(dt * 1e3, 2),
+            "hbm_peak_gb": _hbm_peak_gb()}
+
+
+# ---------------------------------------------------------------------
+# Config #4: GPT with flash attention + recompute, bf16 (sized to fit
+# one chip: 0.35B params — BASELINE's 1.3B + AdamW fp32 state does not
+# fit a single v5e's 16 GB HBM; parallel scaling is dryrun-validated)
+# ---------------------------------------------------------------------
+def bench_gpt(on_tpu, peak):
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu import optimizer, static
+    from paddle_tpu.models import (GPTConfig, GPTForCausalLM,
+                                   GPTPretrainingCriterion)
+
+    if on_tpu:
+        cfg = GPTConfig(hidden_size=1024, num_hidden_layers=24,
+                        num_attention_heads=16, use_flash_attention=True,
+                        use_recompute=True)
+        B, S, n_iters = 8, 1024, 10
+    else:
+        cfg = GPTConfig(hidden_size=128, num_hidden_layers=2,
+                        num_attention_heads=2, use_flash_attention=False,
+                        use_recompute=True, max_position_embeddings=128)
+        B, S, n_iters = 2, 64, 2
+
+    paddle.enable_static()
+    try:
+        main_prog = static.Program()
+        startup = static.Program()
+        with static.program_guard(main_prog, startup):
+            ids = static.data("ids", [B, S], "int64")
+            labels = static.data("labels", [B, S], "int64")
+            model = GPTForCausalLM(cfg)
+            criterion = GPTPretrainingCriterion()
+            with paddle.amp.auto_cast(dtype="bfloat16", level="O1"):
+                loss = criterion(model(ids), labels)
+            opt = optimizer.AdamW(learning_rate=1e-4,
+                                  parameters=model.parameters())
+            opt.minimize(loss)
+        n_params = sum(int(np.prod(p.shape)) for p in model.parameters())
+        log(f"gpt: {n_params/1e6:.0f}M params, B={B} S={S}")
+        exe = static.Executor()
+        rng = np.random.default_rng(0)
+        x = rng.integers(0, cfg.vocab_size, (B, S)).astype(np.int64)
+        fd = {"ids": x, "labels": x}
+        t = time.time()
+        (l0,) = exe.run(main_prog, feed=fd, fetch_list=[loss])
+        log(f"gpt: compile+first step {time.time()-t:.1f}s "
+            f"loss={float(l0):.3f}")
+        t = time.time()
+        for _ in range(n_iters):
+            (lv,) = exe.run(main_prog, feed=fd, fetch_list=[loss])
+        dt = (time.time() - t) / n_iters
+        tokens_per_sec = B * S / dt
+        L, H = cfg.num_hidden_layers, cfg.hidden_size
+        flops_per_token = 6 * n_params + 12 * L * S * H
+        mfu = flops_per_token * tokens_per_sec / peak if peak else 0.0
+        log(f"gpt: step {dt*1e3:.1f} ms {tokens_per_sec:,.0f} tok/s "
+            f"MFU={mfu:.3f}")
+        return {"tokens_per_sec": round(tokens_per_sec, 1),
+                "step_ms": round(dt * 1e3, 2), "mfu": round(mfu, 4),
+                "n_params_m": round(n_params / 1e6),
+                "hbm_peak_gb": _hbm_peak_gb()}
+    finally:
+        paddle.disable_static()
+
+
+# ---------------------------------------------------------------------
+# Config #5: LLaMA sharding stage2 + TP — correctness dryrun on the
+# 8-device CPU mesh in a subprocess (multi-chip hardware is not
+# available; the sharded program must still build + execute)
+# ---------------------------------------------------------------------
+_LLAMA_DRYRUN = r"""
+import os
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "") +
+                           " --xla_force_host_platform_device_count=8")
+import jax
+jax.config.update("jax_platforms", "cpu")
+import __graft_entry__ as g
+g.dryrun_multichip(8)
+print("LLAMA_DRYRUN_OK")
+"""
+
+
+def bench_llama_dryrun():
+    t = time.time()
+    p = subprocess.run(
+        [sys.executable, "-c", _LLAMA_DRYRUN], cwd=str(ROOT),
+        capture_output=True, text=True, timeout=1800)
+    ok = "LLAMA_DRYRUN_OK" in p.stdout
+    log(f"llama/hybrid dryrun: ok={ok} ({time.time()-t:.0f}s)")
+    if not ok:
+        log("llama dryrun tail: " + (p.stderr or "")[-500:])
+    return {"ok": ok, "seconds": round(time.time() - t, 1)}
+
+
+# ---------------------------------------------------------------------
+def main():
+    force_cpu = os.environ.get("PADDLE_TPU_BENCH_FORCE_CPU") == "1"
+    configs = os.environ.get(
+        "PADDLE_TPU_BENCH_CONFIGS",
+        "bert,lenet,resnet50,gpt,llama_dryrun").split(",")
+
+    info = None
+    if not force_cpu:
+        info = probe_device()
+    if info is None and not force_cpu:
+        cached = load_cache()
+        if cached is not None:
+            cached["cached"] = True
+            cached["tpu_unreachable_now"] = True
+            log("tunnel unreachable; emitting cached in-round result "
+                f"captured at {cached.get('captured_at')}")
+            print(json.dumps(cached), flush=True)
+            return
+        log("tunnel unreachable and no cached result; emitting "
+            "tpu_unreachable marker")
+        print(json.dumps({
+            "metric": HEADLINE, "value": 0.0, "unit": "tokens/s",
+            "vs_baseline": 0.0, "tpu_unreachable": True,
+        }), flush=True)
+        return
+
+    if force_cpu:
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+
+    t0 = time.time()
+    import jax
     devs = jax.devices()
     peak, kind = device_peak_flops()
     on_tpu = devs[0].platform == "tpu"
-    log(f"backend={devs[0].platform} kind={kind} init={time.time()-t0:.0f}s")
+    log(f"backend={devs[0].platform} kind={kind} "
+        f"init={time.time()-t0:.0f}s")
 
     import paddle_tpu as paddle
-    from paddle_tpu import nn, optimizer, static
-    from paddle_tpu.models import BertConfig, BertForMaskedLM
 
     pallas_ok = None
     if on_tpu:
-        # probe every Pallas kernel on this chip BEFORE measuring (r2
-        # shipped a silent 0.0 because a broken kernel was wired in
-        # unconditionally).  A failed probe is loud — it goes to stderr
-        # and into the JSON — but the bench still completes on the XLA
-        # fallback path the gate provides, so one bad kernel can never
-        # zero the benchmark again.
         from paddle_tpu.framework.flags import get_flags
         from paddle_tpu.ops.pallas_gate import probe_all
         if get_flags("FLAGS_use_pallas_kernels")[
@@ -72,89 +449,104 @@ def main():
             pallas_ok = all(results.values())
             log(f"pallas probe: {results} ({time.time()-t:.0f}s)")
             if not pallas_ok:
-                log("WARNING: some Pallas kernels failed probe compile; "
+                log("WARNING: some Pallas kernels failed probe; "
                     "measuring on the XLA composite fallback")
         else:
             log("pallas kernels disabled by flag; measuring XLA path")
 
-    B, S = (32, 128) if on_tpu else (4, 64)
-    cfg = BertConfig() if on_tpu else BertConfig(
-        hidden_size=128, num_hidden_layers=2, num_attention_heads=2,
-        intermediate_size=256)
-    n_iters = 20 if on_tpu else 3
-
-    paddle.enable_static()
-    main_prog = static.Program()
-    startup = static.Program()
-    t = time.time()
-    with static.program_guard(main_prog, startup):
-        ids = static.data("ids", [B, S], "int64")
-        labels = static.data("labels", [B, S], "int64")
-        model = BertForMaskedLM(cfg)
-        with paddle.amp.auto_cast(dtype="bfloat16", level="O1"):
-            loss, _ = model(ids, labels=labels)
-        opt = optimizer.AdamW(learning_rate=1e-4,
-                              parameters=model.parameters())
-        opt.minimize(loss)
-    log(f"program built: {len(main_prog.global_block().ops)} ops "
-        f"in {time.time()-t:.1f}s")
-
-    n_params = sum(int(np.prod(p.shape)) for p in model.parameters())
-    exe = static.Executor()
-    rng = np.random.default_rng(0)
-
-    def batch():
-        x = rng.integers(0, cfg.vocab_size, (B, S)).astype(np.int64)
-        return {"ids": x, "labels": x}
-
-    t = time.time()
-    (l0,) = exe.run(main_prog, feed=batch(), fetch_list=[loss])
-    log(f"compile+first step: {time.time()-t:.1f}s loss={float(l0):.3f}")
-
-    fd = batch()  # fixed feed: measure device step, not host RNG
-    t = time.time()
-    for _ in range(n_iters):
-        (lv,) = exe.run(main_prog, feed=fd, fetch_list=[loss])
-    try:
-        lv.block_until_ready()
-    except AttributeError:
-        pass
-    dt = (time.time() - t) / n_iters
-    log(f"steady step: {dt*1e3:.1f} ms  loss={float(lv):.3f}")
-
-    tokens_per_sec = B * S / dt
-    # model flops: 6*N per token (fwd+bwd) + attention matmuls
-    L, H = cfg.num_hidden_layers, cfg.hidden_size
-    attn_flops = 12 * L * S * H          # per token: QK^T + PV, fwd+bwd
-    flops_per_token = 6 * n_params + attn_flops
-    achieved = flops_per_token * tokens_per_sec
-    mfu = achieved / peak if peak else 0.0
-    vs = mfu / 0.40 if peak else 0.0
-    log(f"tokens/s={tokens_per_sec:,.0f} achieved={achieved/1e12:.1f} "
-        f"TFLOP/s MFU={mfu:.3f}")
-
     payload = {
-        "metric": "bert_base_mlm_static_bf16_tokens_per_sec",
-        "value": round(tokens_per_sec, 1),
-        "unit": "tokens/s",
-        "vs_baseline": round(vs, 3),
+        "metric": HEADLINE, "value": 0.0, "unit": "tokens/s",
+        "vs_baseline": 0.0,
+        "platform": devs[0].platform, "device_kind": kind,
+        "captured_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        "captured_unix": int(time.time()),
+        "git_rev": _git_rev(),
+        "extra_metrics": {},
     }
     if pallas_ok is not None:
         payload["pallas_kernels_ok"] = pallas_ok
+
+    runners = {
+        "bert": lambda: bench_bert(on_tpu, peak),
+        "lenet": lambda: bench_lenet(on_tpu),
+        "resnet50": lambda: bench_resnet50(on_tpu),
+        "gpt": lambda: bench_gpt(on_tpu, peak),
+        "llama_dryrun": bench_llama_dryrun,
+    }
+    errors = {}
+    for name in configs:
+        name = name.strip()
+        fn = runners.get(name)
+        if fn is None:
+            log(f"unknown bench config {name!r} "
+                f"(known: {sorted(runners)})")
+            errors[name] = "unknown config name"
+            continue
+        try:
+            res = fn()
+        except Exception as e:
+            import traceback
+            traceback.print_exc(file=sys.stderr)
+            errors[name] = f"{type(e).__name__}: {e}"[:200]
+            continue
+        if name == "bert":
+            payload["value"] = res["tokens_per_sec"]
+            payload["vs_baseline"] = round(res["mfu"] / 0.40, 3) \
+                if on_tpu else 0.0
+            payload["extra_metrics"]["bert_step_ms"] = res["step_ms"]
+            if res.get("hbm_peak_gb"):
+                payload["extra_metrics"]["bert_hbm_peak_gb"] = \
+                    res["hbm_peak_gb"]
+        elif name == "lenet":
+            payload["extra_metrics"][
+                "lenet_dygraph_fp32_imgs_per_sec"] = res["imgs_per_sec"]
+        elif name == "resnet50":
+            payload["extra_metrics"][
+                "resnet50_dygraph_amp_bf16_imgs_per_sec"] = \
+                res["imgs_per_sec"]
+        elif name == "gpt":
+            payload["extra_metrics"][
+                "gpt_0p35b_flash_recompute_bf16_tokens_per_sec"] = \
+                res["tokens_per_sec"]
+            payload["extra_metrics"]["gpt_mfu"] = res["mfu"]
+        elif name == "llama_dryrun":
+            payload["extra_metrics"][
+                "llama_sharding2_tp_dryrun_ok"] = res["ok"]
+        if errors:
+            payload["errors"] = errors
+        if on_tpu:
+            save_cache(payload)   # survive a mid-run wedge
+
+    if errors:
+        payload["errors"] = errors
     print(json.dumps(payload), flush=True)
+
+
+def _looks_like_tunnel_error(e):
+    text = f"{type(e).__name__}: {e}".lower()
+    return any(s in text for s in (
+        "unavailable", "tpu backend", "axon", "deadline", "connection",
+        "initialize backend", "plugin"))
 
 
 if __name__ == "__main__":
     try:
         main()
-    except Exception as e:  # emit the contract line, but FAIL the run
+    except Exception as e:
         import traceback
         traceback.print_exc(file=sys.stderr)
-        print(json.dumps({
-            "metric": "bert_base_mlm_static_bf16_tokens_per_sec",
-            "value": 0.0,
-            "unit": "tokens/s",
-            "vs_baseline": 0.0,
-            "error": f"{type(e).__name__}: {e}"[:200],
-        }), flush=True)
-        sys.exit(1)
+        cached = load_cache()
+        if cached is not None and _looks_like_tunnel_error(e):
+            # infra (tunnel) death after an in-round capture: the cached
+            # measurement is the round's result
+            cached["cached"] = True
+            cached["late_error"] = f"{type(e).__name__}: {e}"[:200]
+            print(json.dumps(cached), flush=True)
+        else:
+            # genuine code failure must stay LOUD — rc=1, no masking
+            print(json.dumps({
+                "metric": HEADLINE, "value": 0.0, "unit": "tokens/s",
+                "vs_baseline": 0.0,
+                "error": f"{type(e).__name__}: {e}"[:200],
+            }), flush=True)
+            sys.exit(1)

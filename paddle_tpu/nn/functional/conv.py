@@ -56,14 +56,27 @@ def _conv(x, weight, bias, stride, padding, dilation, groups, data_format,
     out_spec = lhs_spec
 
     def impl(v, w, *b, stride, pad, dilation, groups):
+        # operand dtypes must agree, and preferred_element_type is not
+        # used: its transpose rule mixes an f32 cotangent with the
+        # low-precision weight and raises inside lax.conv_general_dilated
+        # on the backward.  bf16 needs no f32 accumulator hint (the TPU
+        # MXU accumulates bf16 convs in f32 natively); fp16 keeps its
+        # f32 accumulation by computing the conv in f32 and casting back.
+        odt = None
+        if v.dtype == jnp.float16 or w.dtype == jnp.float16:
+            odt = jnp.promote_types(v.dtype, w.dtype)
+            v, w = v.astype(jnp.float32), w.astype(jnp.float32)
+        elif v.dtype != w.dtype:
+            ct = jnp.promote_types(v.dtype, w.dtype)
+            v, w = v.astype(ct), w.astype(ct)
         out = jax.lax.conv_general_dilated(
             v, w, window_strides=stride, padding=pad,
             rhs_dilation=dilation,
             dimension_numbers=(lhs_spec, rhs_spec, out_spec),
             feature_group_count=groups,
-            preferred_element_type=jnp.float32
-            if v.dtype in (jnp.bfloat16, jnp.float16) else None,
-        ).astype(v.dtype)
+        )
+        if odt is not None:
+            out = out.astype(odt)
         if b:
             bshape = [1] * out.ndim
             bshape[1 if cf else -1] = b[0].size
@@ -109,6 +122,13 @@ def _conv_transpose(x, weight, bias, stride, padding, output_padding,
     out_spec = lhs_spec
 
     def impl(v, w, *b, stride, pad, dilation, groups, opad):
+        odt = None
+        if v.dtype == jnp.float16 or w.dtype == jnp.float16:
+            odt = jnp.promote_types(v.dtype, w.dtype)
+            v, w = v.astype(jnp.float32), w.astype(jnp.float32)
+        elif v.dtype != w.dtype:
+            ct = jnp.promote_types(v.dtype, w.dtype)
+            v, w = v.astype(ct), w.astype(ct)
         k = w.shape[2:]
         if isinstance(pad, str):
             pads = pad
@@ -141,6 +161,8 @@ def _conv_transpose(x, weight, bias, stride, padding, output_padding,
                 padding=pads,
                 lhs_dilation=stride, rhs_dilation=dilation,
                 dimension_numbers=(lhs_spec, rhs_spec, out_spec))
+        if odt is not None:
+            out = out.astype(odt)
         if b:
             bshape = [1] * out.ndim
             bshape[1 if cf else -1] = b[0].size

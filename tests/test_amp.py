@@ -81,3 +81,34 @@ def test_amp_decorate_o2():
     model = nn.Linear(4, 4)
     model = paddle.amp.decorate(model, level="O2", dtype="bfloat16")
     assert model.weight.dtype == paddle.bfloat16
+
+
+def test_amp_conv_backward_bf16():
+    """Regression: conv under autocast used preferred_element_type=f32 +
+    astype, whose transpose rule mixes an f32 cotangent with the bf16
+    weight and raises inside lax.conv_general_dilated (r4)."""
+    paddle.seed(0)
+    conv = nn.Conv2D(3, 8, 3)
+    x = paddle.to_tensor(
+        np.random.default_rng(0).standard_normal((2, 3, 16, 16))
+        .astype(np.float32))
+    with paddle.amp.auto_cast(dtype="bfloat16", level="O1"):
+        h = conv(x)
+    assert h.dtype == paddle.bfloat16
+    loss = h.sum()
+    loss.backward()
+    assert conv.weight.grad is not None
+    assert np.isfinite(conv.weight.grad.numpy().astype(np.float32)).all()
+
+
+def test_amp_conv_transpose_backward_bf16():
+    paddle.seed(0)
+    conv = nn.Conv2DTranspose(3, 8, 3)
+    x = paddle.to_tensor(
+        np.random.default_rng(0).standard_normal((2, 3, 8, 8))
+        .astype(np.float32))
+    with paddle.amp.auto_cast(dtype="bfloat16", level="O1"):
+        h = conv(x)
+    loss = h.sum()
+    loss.backward()
+    assert conv.weight.grad is not None
