@@ -17,8 +17,14 @@ import os as _os
 
 import jax as _jax
 
-# int64/float64 parity with paddle (TPU executes s64; f64 avoided in models)
-_jax.config.update("jax_enable_x64", True)
+# int64/float64 parity with paddle (TPU executes s64; f64 avoided in
+# models).  PADDLE_TPU_X32=1 opts the whole process out: 64-bit dtype
+# requests are canonicalized to 32-bit at the device boundary (a perf
+# mode for TPU, where s64 indices/iota cost real cycles; Tensor.dtype
+# then honestly reports the 32-bit type).
+_X32_MODE = _os.environ.get("PADDLE_TPU_X32") == "1"
+if not _X32_MODE:
+    _jax.config.update("jax_enable_x64", True)
 # fp32 matmul semantics parity: full-precision f32 contractions (explicit
 # bf16 tensors still take the fast MXU path; AMP is the perf route, as in
 # the reference where fp32 uses FMA cuBLAS and AMP uses tensor cores)

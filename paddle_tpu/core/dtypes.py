@@ -133,9 +133,27 @@ def convert_dtype(d) -> DType:
     raise TypeError(f"Unsupported dtype: {d!r}")
 
 
+import os as _os
+
+_X32_MODE = _os.environ.get("PADDLE_TPU_X32") == "1"
+_X32_MAP = {
+    np.dtype(np.int64): np.dtype(np.int32),
+    np.dtype(np.uint64): np.dtype(np.uint32),
+    np.dtype(np.float64): np.dtype(np.float32),
+    np.dtype(np.complex128): np.dtype(np.complex64),
+}
+
+
 def to_jax_dtype(d):
-    """Paddle/str/np dtype -> numpy dtype usable by jnp."""
-    return convert_dtype(d).np_dtype
+    """Paddle/str/np dtype -> numpy dtype usable by jnp.
+
+    Under PADDLE_TPU_X32=1 (jax_enable_x64 left off) 64-bit requests
+    canonicalize to 32-bit here, so explicit dtype= arguments neither
+    warn nor re-upcast what jnp would silently downcast anyway."""
+    npd = convert_dtype(d).np_dtype
+    if _X32_MODE:
+        return _X32_MAP.get(np.dtype(npd), npd)
+    return npd
 
 
 def to_paddle_dtype(jax_dtype) -> DType:

@@ -350,9 +350,14 @@ def _to_array(data, dtype=None, place=None):
             return jnp.asarray(data, to_jax_dtype(dtype))
         return data
     if isinstance(data, np.ndarray):
+        from .dtypes import _X32_MAP, _X32_MODE
         jd = to_jax_dtype(dtype) if dtype is not None else data.dtype
-        if dtype is None and data.dtype == np.float64:
+        if dtype is None and data.dtype == np.float64 and not _X32_MODE:
             jd = np.float64  # paddle keeps float64 numpy arrays as float64
+        if _X32_MODE:
+            # canonicalize 64-bit inputs here so jnp neither warns nor
+            # truncates per call under PADDLE_TPU_X32
+            jd = _X32_MAP.get(np.dtype(jd), jd)
         return jnp.asarray(data, jd)
     # python scalars / nested lists
     if dtype is not None:

@@ -85,9 +85,20 @@ def new_group(ranks=None, backend=None, timeout=None, axis_name=None):
     if ranks is not None and axis_name is None:
         world = get_world_size()
         rs = sorted(ranks)
-        if rs == list(range(world)):
-            mesh = global_mesh()
-            axis_name = mesh.axis_names[0] if mesh.axis_names else None
+        mesh = global_mesh()
+        mesh_n = int(mesh.devices.size) if mesh is not None else 0
+        if rs == list(range(world)) or (mesh_n and
+                                        rs == list(range(mesh_n))):
+            # the whole world / whole mesh: an all-axes group (a
+            # topology smaller than the hardware still counts).  Tuple
+            # axis names so in-scope collectives reduce over EVERY mesh
+            # axis, not just the first (jax.lax.psum accepts tuples).
+            if mesh is not None and mesh.axis_names:
+                axis_name = (mesh.axis_names[0]
+                             if len(mesh.axis_names) == 1
+                             else tuple(mesh.axis_names))
+            else:
+                axis_name = None
         else:
             axis_name = _infer_axis_for_ranks(rs)
             if axis_name is None and len(rs) > 1:

@@ -38,8 +38,10 @@ __all__ = ["all_gather", "all_gather_object", "broadcast", "reduce",
 def _axis_in_scope(axis_name):
     if axis_name is None:
         return False
+    names = axis_name if isinstance(axis_name, tuple) else (axis_name,)
     try:
-        jax.lax.axis_index(axis_name)
+        for n in names:  # whole-mesh groups carry a tuple of axes
+            jax.lax.axis_index(n)
         return True
     except (NameError, Exception):
         return False

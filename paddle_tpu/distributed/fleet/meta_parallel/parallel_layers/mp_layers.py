@@ -117,6 +117,11 @@ class RowParallelLinear(Layer):
 
 def _with_sharding_constraint(t, entry):
     """Constrain a tensor's sharding (replicated when entry is None)."""
+    from ..pp_utils.global_schedule import constraints_suspended
+    if constraints_suspended():
+        # inside the pipeline engine's stage-vmap the activation carries
+        # a pp-sharded stage dim these specs don't know about
+        return t
     mesh = global_mesh()
     axis = _mp_axis(mesh)
     if axis is None:

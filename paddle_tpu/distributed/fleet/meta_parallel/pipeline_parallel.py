@@ -54,16 +54,39 @@ class PipelineParallel(DataParallel):
         hcg = self._hcg
         ok = (hcg is not None and getattr(hcg, "mesh", None) is not None
               and hcg.get_pipe_parallel_world_size() > 1
-              and hcg.get_model_parallel_world_size() == 1
-              and hcg.get_sep_parallel_world_size() == 1
               and hasattr(self._pipeline_layer, "segment"))
         if ok:
+            from ....optimizer.optimizer import Optimizer as _OptBase
+            if type(optimizer)._pure_update is _OptBase._pure_update:
+                logger.warning(
+                    "pipeline: %s has no fused static update; falling "
+                    "back to gradient accumulation",
+                    type(optimizer).__name__)
+                self._engine = False
+                return
+            # primary: global-array engine (heterogeneous stages, pp×mp,
+            # GradScaler); secondary: shard_map GPipe (homogeneous, mp=1)
             try:
-                from ....optimizer.optimizer import Optimizer as _OptBase
-                if type(optimizer)._pure_update is _OptBase._pure_update:
-                    raise ValueError(
-                        f"{type(optimizer).__name__} has no fused "
-                        f"static update (_pure_update)")
+                from .pp_utils import GlobalPipelineEngine
+                self._engine = GlobalPipelineEngine(
+                    self._pipeline_layer, hcg, optimizer,
+                    n_micro=max(self.accumulate_steps, 1),
+                    remat=True)
+                logger.info(
+                    "pipeline: global-array GPipe engine over pp=%d, "
+                    "%d microbatches",
+                    hcg.get_pipe_parallel_world_size(),
+                    max(self.accumulate_steps, 1))
+                return
+            except Exception as e:
+                logger.warning(
+                    "pipeline: global engine unavailable (%s); trying "
+                    "the shard_map engine", e)
+            try:
+                if (hcg.get_model_parallel_world_size() != 1
+                        or hcg.get_sep_parallel_world_size() != 1):
+                    raise ValueError("shard_map engine requires mp=1 "
+                                     "and sep=1")
                 from .pp_utils import SpmdPipelineEngine
                 self._engine = SpmdPipelineEngine(
                     self._pipeline_layer, hcg, optimizer,
@@ -89,33 +112,50 @@ class PipelineParallel(DataParallel):
     # ------------------------------------------------------------------
     def train_batch(self, data, optimizer, lr_scheduler=None, scaler=None):
         """Split into micro-batches and run the pipeline schedule."""
-        if scaler is None:
+        use_scaler = scaler is not None and scaler.is_enable()
+        # a scaler can only ride the global engine; once an attempt
+        # showed this model builds a non-global engine, stop rebuilding
+        # per scaler batch
+        if not (use_scaler and self._engine is None
+                and getattr(self, "_scaler_incompat", False)):
             self._try_build_engine(optimizer)
-        if self._engine not in (None, False) and scaler is None:
+        engine = self._engine if self._engine not in (None, False) \
+            else None
+        if engine is not None and use_scaler and \
+                not hasattr(engine, "outer"):
+            self._scaler_incompat = True
+            if engine._dirty:
+                engine.sync_params_to_layers()
+            # never retire permanently: a later scaler-free batch can
+            # rebuild from the (current) eager params
+            logger.warning(
+                "pipeline: %s cannot serve a GradScaler; this batch "
+                "runs on the accumulation path",
+                type(engine).__name__)
+            self._engine = None
+            engine = None
+        if engine is not None:
             inputs = data[0]
             n0 = (inputs.shape[0] if hasattr(inputs, "shape")
                   else len(inputs))
-            if n0 % self._engine.n_micro == 0:
+            if n0 % engine.n_micro == 0:
                 return self._train_batch_spmd(data, optimizer,
-                                              lr_scheduler)
+                                              lr_scheduler, scaler)
+            # ragged batch: the accumulation path trains the EAGER
+            # params, so the engine's stacked copies must sync down and
+            # the engine rebuilds later from the updated weights
             logger.warning(
                 "pipeline: batch %d not divisible by accumulate_steps "
                 "%d; running this batch on the accumulation path",
-                n0, self._engine.n_micro)
-        if self._engine not in (None, False):
-            # the accumulation path is about to train the EAGER params;
-            # the engine's stacked copies would silently diverge, so
-            # sync down and retire the engine (reference behavior: one
-            # schedule per run)
-            logger.warning(
-                "pipeline: leaving the SPMD engine (scaler or ragged "
-                "batch); continuing on the accumulation path")
-            self._engine.sync_params_to_layers()
-            self._engine = False
+                n0, engine.n_micro)
+            if engine._dirty:
+                engine.sync_params_to_layers()
+            self._engine = None
         return self._train_batch_accum(data, optimizer, lr_scheduler,
                                        scaler)
 
-    def _train_batch_spmd(self, data, optimizer, lr_scheduler):
+    def _train_batch_spmd(self, data, optimizer, lr_scheduler,
+                          scaler=None):
         import jax.numpy as jnp
 
         inputs, labels = data
@@ -131,7 +171,18 @@ class PipelineParallel(DataParallel):
         xm = x.reshape((n_micro, x.shape[0] // n_micro) + x.shape[1:])
         ym = y.reshape((n_micro, y.shape[0] // n_micro) + y.shape[1:])
         lr = optimizer.get_lr() if hasattr(optimizer, "get_lr") else 1e-3
-        loss = self._engine.train_step(xm, ym, lr)
+        use_scaler = scaler is not None and scaler.is_enable()
+        if use_scaler:
+            loss, found_inf = self._engine.train_step(
+                xm, ym, lr, scale=scaler._scale)
+            # in-graph check_finite_and_unscale already gated the fused
+            # update; the host just evolves the dynamic scale
+            scaler._found_inf = found_inf
+            scaler.update()
+        else:
+            loss = self._engine.train_step(xm, ym, lr)
+            if isinstance(loss, tuple):
+                loss = loss[0]
         if lr_scheduler is not None:
             lr_scheduler.step()
         return Tensor(jnp.asarray(loss, jnp.float32), _internal=True,

@@ -1,3 +1,4 @@
 from .spmd_schedule import SpmdPipelineEngine
+from .global_schedule import GlobalPipelineEngine
 
-__all__ = ["SpmdPipelineEngine"]
+__all__ = ["SpmdPipelineEngine", "GlobalPipelineEngine"]

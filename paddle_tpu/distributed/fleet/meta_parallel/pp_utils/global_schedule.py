@@ -1,0 +1,498 @@
+"""Global-array SPMD pipeline engine: heterogeneous stages + pp×mp×dp.
+
+Reference parity: `fleet/meta_parallel/pp_layers.py` (PipelineLayer
+segments arbitrary LayerDesc lists — embedding first stage, lm-head last,
+SharedLayerDesc tying them) + `pipeline_parallel.py` (1F1B composing with
+mp/dp inside the hybrid cube) [UNVERIFIED — empty reference mount;
+SURVEY.md §2.3 PP row, §3.6; VERDICT r3 missing #3].
+
+TPU-native redesign, second formulation (the first — shard_map + explicit
+ppermute, spmd_schedule.py — remains for the homogeneous mp=1 case):
+everything is GLOBAL sharded arrays under one jit, and XLA inserts every
+collective:
+
+  * the homogeneous trunk ("body") is detected as the longest periodic
+    run of structurally identical layer groups; the leading remainder
+    ("pre": embeddings, …) and trailing remainder ("post": final norm,
+    lm head, loss inputs) run OUTSIDE the pipeline scan, sharded over
+    dp/mp only — this lifts the identical-stages constraint: a GPT-style
+    [embed, block×N, ln, tied-head] PipelineLayer pipelines its trunk
+    while pre/post stay dense;
+  * trunk stage parameters are stacked on a leading dim sharded over the
+    `pp` mesh axis; the stage compute is a `jax.vmap` over that dim — an
+    elementwise map XLA executes shard-local, with each stage's weights
+    resident on its own pp slice;
+  * the GPipe tick rotates a (n_stages, micro, ...) activation buffer
+    with `jnp.roll` on the pp-sharded dim — XLA lowers exactly this to a
+    CollectivePermute over ICI (the reference's send_v2/recv_v2);
+  * tensor-parallel layers inside any section keep their NamedSharding
+    placements (mp_layers.py), so pp×mp×dp composes by construction —
+    the same sharding-propagation mechanism that runs them standalone;
+  * fp16 GradScaler support is native: the loss is scaled in-graph,
+    grads unscaled, a found_inf reduction guards the fused update, and
+    the host updates the scaler's scale from the returned flag (the
+    reference's update_loss_scaling op).
+"""
+from __future__ import annotations
+
+import logging
+import threading
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from .....core.tensor import Tensor
+
+logger = logging.getLogger("paddle_tpu.pipeline")
+
+__all__ = ["GlobalPipelineEngine"]
+
+
+def _config_fingerprint(fn, _depth=0):
+    """Scalar config attrs (dropout p, epsilon, activation flags, ...) of
+    a layer and its sublayers: stages that differ only in parameterless
+    config must NOT be treated as identical (all stages execute the
+    template stage's code)."""
+    if not hasattr(fn, "__dict__") or _depth > 4:
+        return ()
+    out = []
+    for k, v in sorted(vars(fn).items()):
+        if k.startswith("_") and k not in ("_epsilon", "_p"):
+            continue
+        if isinstance(v, (bool, int, float, str, type(None))):
+            out.append((k, v))
+        elif isinstance(v, (tuple, list)) and all(
+                isinstance(e, (bool, int, float, str)) for e in v):
+            out.append((k, tuple(v)))
+    for name, sub in (fn.named_children()
+                      if hasattr(fn, "named_children") else ()):
+        out.append((name, _config_fingerprint(sub, _depth + 1)))
+    return tuple(out)
+
+
+def _entry_signature(entry):
+    fn, fwd = entry
+    name = type(fn).__name__ if not callable(fn) or hasattr(
+        fn, "parameters") else getattr(fn, "__name__", "fn")
+    params = fn.parameters() if hasattr(fn, "parameters") else []
+    return (name, getattr(fwd, "__name__", None), tuple(
+        (tuple(p.shape), str(p.dtype)) for p in params),
+        _config_fingerprint(fn))
+
+
+def _find_trunk(sigs, n_stages, max_edge=8):
+    """Split layer signatures into (pre_len, body_len, post_len) where the
+    body is periodic with some period p and repeats m ≡ 0 (mod n_stages).
+    Prefers the longest body, then the smallest edge sections."""
+    n = len(sigs)
+    best = None
+    for pre in range(0, min(max_edge, n) + 1):
+        for post in range(0, min(max_edge, n - pre) + 1):
+            body = n - pre - post
+            if body <= 0:
+                continue
+            seg = sigs[pre:pre + body]
+            for period in range(1, body + 1):
+                if body % period:
+                    continue
+                reps = body // period
+                if reps % n_stages:
+                    continue
+                if all(seg[i] == seg[i % period]
+                       for i in range(body)):
+                    cand = (body, -(pre + post), pre, post, period)
+                    if best is None or cand > best:
+                        best = cand
+                    break
+    if best is None:
+        return None
+    body, _, pre, post, period = best
+    return pre, body, post
+
+
+class _PureSection:
+    """Run an ordered list of (layer, forward_func) entries as a pure
+    function of its unique parameter leaves (the tensor._value swap trick
+    jit/trace.py and spmd_schedule.py use)."""
+
+    def __init__(self, entries):
+        self.entries = entries
+        self.params = []
+        self.buffers = []
+        seen = set()
+        for fn, _ in entries:
+            if hasattr(fn, "parameters"):
+                for p in fn.parameters():
+                    if id(p) not in seen:
+                        seen.add(id(p))
+                        self.params.append(p)
+            if hasattr(fn, "named_buffers"):
+                for _, b in fn.named_buffers():
+                    if id(b) not in seen:
+                        seen.add(id(b))
+                        self.buffers.append(b)
+
+    def __call__(self, param_vals, x_val):
+        from .....core.autograd import no_grad
+        saved = [(t, t._value, t._grad_node) for t in self.params]
+        saved_buf = [(b, b._value) for b in self.buffers]
+        try:
+            for t, v in zip(self.params, param_vals):
+                t._value = v
+            with no_grad():
+                x = Tensor(x_val, _internal=True, stop_gradient=True)
+                for fn, fwd in self.entries:
+                    x = fwd(fn, x) if fwd is not None else fn(x)
+            return x._value
+        finally:
+            for t, v, gn in saved:
+                t._value = v
+                t._grad_node = gn
+            for b, v in saved_buf:
+                b._value = v
+
+
+# Layer-level sharding constraints (RowParallelLinear's "replicate the
+# output" etc.) assume unbatched global activations; under the trunk's
+# stage-vmap they would fight the pp sharding of the stage dim.  The
+# engine suspends them for the vmapped region only.
+_suspend = threading.local()
+
+
+def constraints_suspended():
+    return getattr(_suspend, "on", False)
+
+
+class _SuspendConstraints:
+    def __enter__(self):
+        self._prev = getattr(_suspend, "on", False)
+        _suspend.on = True
+
+    def __exit__(self, *exc):
+        _suspend.on = self._prev
+
+
+def _param_spec(t, extra_leading=None):
+    """PartitionSpec for a parameter: its mp placement if any."""
+    sh = getattr(t, "dist_spec", None)
+    if isinstance(sh, NamedSharding):
+        entries = tuple(sh.spec)
+        entries += (None,) * (t._value.ndim - len(entries))
+    else:
+        entries = (None,) * t._value.ndim
+    if extra_leading is not None:
+        entries = (extra_leading,) + entries
+    return P(*entries)
+
+
+class GlobalPipelineEngine:
+    """Compiled GPipe over global sharded arrays; heterogeneous pre/post
+    sections; composes with mp (tensor parallel) and dp/sharding axes."""
+
+    def __init__(self, pipeline_layer, hcg, optimizer, n_micro,
+                 remat=True):
+        self.pl = pipeline_layer
+        self.hcg = hcg
+        self.mesh = hcg.mesh
+        if self.mesh is None or "pp" not in self.mesh.axis_names:
+            raise ValueError("no pp axis in mesh")
+        if hcg.get_sep_parallel_world_size() > 1:
+            raise ValueError("sep axis inside the pipeline engine is "
+                             "not supported")
+        self.optimizer = optimizer
+        self.n_micro = int(n_micro)
+        self.n_stages = int(self.mesh.shape["pp"])
+        self.remat = remat
+        self._compiled = {}
+        self._step_host = 0
+        self._dirty = False
+
+        entries = list(pipeline_layer.run_function)
+        sigs = [_entry_signature(e) for e in entries]
+        split = _find_trunk(sigs, self.n_stages)
+        if split is None:
+            raise ValueError(
+                "no periodic trunk divisible into "
+                f"{self.n_stages} stages in {len(entries)} layers")
+        pre_n, body_n, post_n = split
+        per_stage_n = body_n // self.n_stages
+        self.pre = _PureSection(entries[:pre_n])
+        self.post = _PureSection(entries[pre_n + body_n:])
+        stage_entries = [
+            entries[pre_n + s * per_stage_n:
+                    pre_n + (s + 1) * per_stage_n]
+            for s in range(self.n_stages)]
+        self.stage_sections = [_PureSection(e) for e in stage_entries]
+        self.body_template = self.stage_sections[0]
+        if any(s.buffers for s in self.stage_sections):
+            raise ValueError("trunk stages with buffers (e.g. BN "
+                             "running stats) are not supported")
+        n_bp = len(self.body_template.params)
+        if any(len(s.params) != n_bp for s in self.stage_sections):
+            raise ValueError("stage param counts differ")
+        logger.info(
+            "pipeline(global): pre=%d trunk=%d (%d/stage x %d stages) "
+            "post=%d layers", pre_n, body_n, per_stage_n, self.n_stages,
+            post_n)
+
+        # outer params: pre+post unique tensors (tied weights dedup here)
+        outer, seen = [], set()
+        for t in self.pre.params + self.post.params:
+            if id(t) not in seen:
+                seen.add(id(t))
+                outer.append(t)
+        body_ids = {id(p) for s in self.stage_sections for p in s.params}
+        if body_ids & {id(t) for t in outer}:
+            raise ValueError("a weight shared between trunk and "
+                             "pre/post sections is not supported")
+        self.outer = outer
+
+        # trunk params stacked on a pp-sharded leading dim
+        self.stacked = []
+        for i in range(n_bp):
+            arr = jnp.stack([self.stage_sections[s].params[i]._value
+                             for s in range(self.n_stages)])
+            spec = _param_spec(self.stage_sections[0].params[i],
+                               extra_leading="pp")
+            arr = jax.device_put(arr, NamedSharding(self.mesh, spec))
+            t = Tensor(arr, _internal=True)
+            t.stop_gradient = self.stage_sections[0].params[
+                i].stop_gradient
+            t.name = self.stage_sections[0].params[i].name + "@pp_stacked"
+            t.dist_spec = NamedSharding(self.mesh, spec)
+            self.stacked.append(t)
+
+        self.all_params = list(self.outer) + list(self.stacked)
+        self.trainable = [t for t in self.all_params
+                          if not t.stop_gradient]
+        self.opt_state = optimizer._ensure_static_state(self.trainable)
+        # accumulators shard like their params.  Optimizer state layouts
+        # differ (Adam/Momentum: one block per accumulator kind;
+        # Rprop/NAdam/...: interleaved per param, possibly with trailing
+        # scalars like NAdam's mu_product), so associate by EXACT shape
+        # under the candidate layouts and leave anything ambiguous
+        # unsharded (correct, just resharded by XLA on first use).
+        n_tr = len(self.trainable)
+        n_acc = len(self.opt_state)
+        k = n_acc // n_tr if n_tr and n_acc % n_tr == 0 else 0
+        for i, acc in enumerate(self.opt_state):
+            ash = tuple(acc._value.shape)
+            cands = ([self.trainable[i % n_tr],
+                      self.trainable[i // k]] if k else [])
+            pt = next((c for c in cands
+                       if tuple(c._value.shape) == ash), None)
+            if pt is None:
+                same = [t for t in self.trainable
+                        if tuple(t._value.shape) == ash]
+                specs = {str(getattr(t, "dist_spec", None))
+                         for t in same}
+                pt = same[0] if same and len(specs) == 1 else None
+            if pt is None:
+                continue
+            sh = getattr(pt, "dist_spec", None)
+            spec = (tuple(sh.spec) if isinstance(sh, NamedSharding)
+                    else ())
+            spec = P(*(spec + (None,) * (acc._value.ndim - len(spec))))
+            acc._value = jax.device_put(
+                acc._value, NamedSharding(self.mesh, spec))
+
+        self.batch_axes = tuple(
+            a for a in ("dp", "sharding") if a in self.mesh.axis_names
+            and self.mesh.shape[a] > 1) or None
+
+    # ------------------------------------------------------------------
+    def _build(self, x_aval, y_aval, with_scaler):
+        n_micro, n_stages = self.n_micro, self.n_stages
+        mesh = self.mesh
+        pre, post = self.pre, self.post
+        stage_tpl = self.body_template
+        loss_fn = getattr(self.pl, "_loss_fn", None)
+        optimizer = self.optimizer
+        trainable = self.trainable
+        n_outer = len(self.outer)
+        outer_train = [i for i, t in enumerate(self.outer)
+                       if not t.stop_gradient]
+        stacked_train = [i for i, t in enumerate(self.stacked)
+                         if not t.stop_gradient]
+        batch_axes = self.batch_axes
+        remat = self.remat
+        # Tensor.__eq__ is elementwise — index by id, never list.index
+        outer_pos = {id(t): i for i, t in enumerate(self.outer)}
+        pre_idx = [outer_pos[id(t)] for t in pre.params]
+        post_idx = [outer_pos[id(t)] for t in post.params]
+
+        def body_one(stage_leaves, x):
+            with _SuspendConstraints():
+                return stage_tpl(stage_leaves, x)
+
+        if remat:
+            body_one = jax.checkpoint(body_one)
+        body_v = jax.vmap(body_one, in_axes=(0, 0))
+
+        def state_constraint(v, leading):
+            spec = P(leading, batch_axes,
+                     *([None] * (v.ndim - 2)))
+            return jax.lax.with_sharding_constraint(
+                v, NamedSharding(mesh, spec))
+
+        def run_loss(out_val, y_val):
+            from .....core.autograd import no_grad
+            with no_grad():
+                o = Tensor(out_val, _internal=True, stop_gradient=True)
+                l = Tensor(y_val, _internal=True, stop_gradient=True)
+                r = loss_fn(o, l) if loss_fn is not None else o
+            v = r._value if isinstance(r, Tensor) else r
+            return jnp.mean(v.astype(jnp.float32))
+
+        def step_fn(outer_vals, stacked_vals, opt_vals, lr, step, scale,
+                    x, y):
+            mb = x.shape[1]
+
+            def loss_of(train_leaves):
+                o_vals = list(outer_vals)
+                s_vals = list(stacked_vals)
+                k = 0
+                for i in outer_train:
+                    o_vals[i] = train_leaves[k]
+                    k += 1
+                for i in stacked_train:
+                    s_vals[i] = train_leaves[k]
+                    k += 1
+                pre_vals = [o_vals[i] for i in pre_idx]
+                post_vals = [o_vals[i] for i in post_idx]
+
+                xf = x.reshape((n_micro * mb,) + x.shape[2:])
+                h = pre(pre_vals, xf) if pre.entries else xf
+                h = h.reshape((n_micro, mb) + h.shape[1:])
+
+                def tick(carry, t):
+                    state, outbuf = carry
+                    x_t = jnp.where(
+                        t < n_micro,
+                        jax.lax.dynamic_index_in_dim(
+                            h, jnp.clip(t, 0, n_micro - 1), 0,
+                            keepdims=False),
+                        jnp.zeros_like(h[0]))
+                    state = jnp.roll(state, 1, axis=0)
+                    state = jax.lax.dynamic_update_index_in_dim(
+                        state, x_t, 0, 0)
+                    state = state_constraint(state, "pp")
+                    state = body_v(tuple(s_vals), state)
+                    state = state_constraint(state, "pp")
+                    mi = t - (n_stages - 1)
+                    idx = jnp.clip(mi, 0, n_micro - 1)
+                    cur = jax.lax.dynamic_index_in_dim(
+                        outbuf, idx, 0, keepdims=False)
+                    new = jnp.where(mi >= 0, state[n_stages - 1], cur)
+                    outbuf = jax.lax.dynamic_update_index_in_dim(
+                        outbuf, new, idx, 0)
+                    return (state, outbuf), None
+
+                state0 = jnp.zeros((n_stages,) + h.shape[1:], h.dtype)
+                state0 = state_constraint(state0, "pp")
+                outbuf0 = jnp.zeros_like(h)
+                (_, outbuf), _ = jax.lax.scan(
+                    tick, (state0, outbuf0),
+                    jnp.arange(n_micro + n_stages - 1))
+
+                of = outbuf.reshape((n_micro * mb,) + outbuf.shape[2:])
+                out = post(post_vals, of) if post.entries else of
+                loss = run_loss(out, y.reshape((n_micro * mb,)
+                                               + y.shape[2:]))
+                return loss * scale
+
+            train_leaves = tuple(
+                [outer_vals[i] for i in outer_train]
+                + [stacked_vals[i] for i in stacked_train])
+            scaled_loss, grads = jax.value_and_grad(loss_of)(train_leaves)
+            loss = scaled_loss / scale
+            inv = 1.0 / scale
+            grads = tuple(
+                (g.astype(jnp.float32) * inv).astype(g.dtype)
+                for g in grads)
+            if with_scaler:
+                found_inf = jnp.any(jnp.stack([
+                    jnp.logical_not(jnp.all(jnp.isfinite(
+                        g.astype(jnp.float32)))) for g in grads]))
+            else:
+                found_inf = jnp.bool_(False)
+
+            p_in = train_leaves
+            new_p, new_opt = optimizer._pure_update(
+                lr, step, p_in, grads, opt_vals, trainable)
+            if with_scaler:
+                new_p = tuple(
+                    jnp.where(found_inf, o, n)
+                    for o, n in zip(p_in, new_p))
+                new_opt = tuple(
+                    jnp.where(found_inf, o, n)
+                    for o, n in zip(opt_vals, new_opt))
+            # scatter updated trainables back into the full lists
+            o_out = list(outer_vals)
+            s_out = list(stacked_vals)
+            k = 0
+            for i in outer_train:
+                o_out[i] = new_p[k]
+                k += 1
+            for i in stacked_train:
+                s_out[i] = new_p[k]
+                k += 1
+            return (loss, found_inf, tuple(o_out), tuple(s_out),
+                    tuple(new_opt))
+
+        from .....framework.flags import get_flags
+        donate = get_flags("FLAGS_buffer_donation")[
+            "FLAGS_buffer_donation"]
+        return jax.jit(step_fn,
+                       donate_argnums=(0, 1, 2) if donate else ())
+
+    # ------------------------------------------------------------------
+    def train_step(self, x, y, lr, scale=None):
+        """One pipelined step; x/y are (n_micro, mb, ...) arrays.
+        Returns (loss, found_inf)."""
+        x = jnp.asarray(x)
+        y = jnp.asarray(y)
+        if self.batch_axes:
+            x = jax.device_put(x, NamedSharding(
+                self.mesh, P(None, self.batch_axes,
+                             *([None] * (x.ndim - 2)))))
+            y = jax.device_put(y, NamedSharding(
+                self.mesh, P(None, self.batch_axes,
+                             *([None] * (y.ndim - 2)))))
+        with_scaler = scale is not None
+        key = (x.shape, str(x.dtype), y.shape, str(y.dtype), with_scaler)
+        fn = self._compiled.get(key)
+        if fn is None:
+            fn = self._build(x, y, with_scaler)
+            self._compiled[key] = fn
+        loss, found_inf, new_outer, new_stacked, new_opt = fn(
+            tuple(t._value for t in self.outer),
+            tuple(t._value for t in self.stacked),
+            tuple(t._value for t in self.opt_state),
+            jnp.asarray(lr, jnp.float32),
+            jnp.asarray(self._step_host, jnp.int32),
+            jnp.asarray(1.0 if scale is None else scale, jnp.float32),
+            x, y)
+        for t, v in zip(self.outer, new_outer):
+            t._value = v
+        for t, v in zip(self.stacked, new_stacked):
+            t._value = v
+        for t, v in zip(self.opt_state, new_opt):
+            t._value = v
+        self._step_host += 1
+        self._dirty = True
+        return float(loss), bool(found_inf)
+
+    def sync_params_to_layers(self):
+        """Scatter trained trunk params back into the per-stage eager
+        layers (outer params are trained in place already)."""
+        if not self._dirty:
+            return
+        for i, st in enumerate(self.stacked):
+            host = np.asarray(st._value)
+            for s in range(self.n_stages):
+                self.stage_sections[s].params[i]._value = \
+                    jnp.asarray(host[s])
+        self._dirty = False

@@ -359,6 +359,10 @@ def embedding(x, weight, padding_idx=None, sparse=False, name=None):
         pass
 
     def impl(ids, w, *, padding_idx):
+        # s64 gather indices are a pure TPU tax (the global x64 mode
+        # keeps paddle's int64 ids); any real vocab fits int32
+        if ids.dtype in (jnp.int64, jnp.uint64):
+            ids = ids.astype(jnp.int32)
         out = jnp.take(w, ids, axis=0)
         if padding_idx is not None:
             mask = (ids != padding_idx)[..., None]

@@ -50,6 +50,10 @@ def cross_entropy(input, label, weight=None, ignore_index=-100,
 
     def impl(logits, lab, *w, ignore_index, reduction, soft_label, axis,
              use_softmax, smooth, use_fused=False):
+        # s64 class indices are a pure TPU tax (global x64 mode keeps
+        # paddle's int64 labels); any real class count fits int32
+        if not soft_label and lab.dtype in (jnp.int64, jnp.uint64):
+            lab = lab.astype(jnp.int32)
         if use_fused:
             from ...ops.pallas_kernels import fused_softmax_cross_entropy
             lab_i = lab

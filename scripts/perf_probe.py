@@ -116,10 +116,34 @@ def main():
     log("bert train pallas=False:")
     t_x = bert_step(use_pallas=False)
     log(f"pallas speedup: {t_x / t_p:.2f}x")
+    log("bert train under PADDLE_TPU_X32=1 (s64-free device program):")
+    t_32 = bert_x32_subprocess()
+    if t_32:
+        log(f"x32 speedup vs x64: {t_p / t_32:.2f}x")
     log("profiled 3 steps -> /tmp/paddle_tpu_profile")
     bert_step(use_pallas=True, profile=True)
     log("DONE")
 
 
+def bert_x32_subprocess():
+    """x32 mode is a process-level switch (set before import), so the
+    comparison point runs in a child; returns its steady step time."""
+    import re
+    import subprocess
+    env = dict(os.environ, PADDLE_TPU_X32="1")
+    p = subprocess.run(
+        [sys.executable, "-u", os.path.abspath(__file__), "--bert-only"],
+        env=env, capture_output=True, text=True)
+    sys.stderr.write(p.stdout + (p.stderr or ""))
+    m = re.search(r"bert train .*?: ([0-9.]+) ms/step", p.stdout)
+    return float(m.group(1)) / 1e3 if m else None
+
+
 if __name__ == "__main__":
-    main()
+    if "--bert-only" in sys.argv:
+        import jax
+        log(f"devices: {jax.devices()} "
+            f"x32={os.environ.get('PADDLE_TPU_X32')}")
+        bert_step(use_pallas=True)
+    else:
+        main()
