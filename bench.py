@@ -219,12 +219,18 @@ def bench_bert(on_tpu, peak):
 # Config #1: LeNet dygraph fp32
 # ---------------------------------------------------------------------
 def bench_lenet(on_tpu):
+    import contextlib
     import numpy as np
     import paddle_tpu as paddle
     from paddle_tpu import optimizer
     from paddle_tpu.vision.models import LeNet
     import paddle_tpu.nn.functional as F
 
+    # dygraph on TPU runs in lazy eager mode (SURVEY §7): ops keep
+    # imperative semantics but flush as compiled segments — the role the
+    # reference's async CUDA launches play for its dygraph
+    lazy_cm = (paddle.incubate.lazy_eager() if on_tpu
+               else contextlib.nullcontext())
     B = 64
     n_iters = 10 if on_tpu else 3
     paddle.seed(0)
@@ -244,13 +250,14 @@ def bench_lenet(on_tpu):
         opt.clear_grad()
         return loss
 
-    t = time.time()
-    step()
-    log(f"lenet: first step {time.time()-t:.1f}s")
-    t = time.time()
-    for _ in range(n_iters):
-        loss = step()
-    loss.numpy()  # sync
+    with lazy_cm:
+        t = time.time()
+        step()
+        log(f"lenet: first step {time.time()-t:.1f}s")
+        t = time.time()
+        for _ in range(n_iters):
+            loss = step()
+        loss.numpy()  # sync
     dt = (time.time() - t) / n_iters
     log(f"lenet: dygraph step {dt*1e3:.1f} ms "
         f"({B/dt:,.0f} imgs/s)")
@@ -262,12 +269,15 @@ def bench_lenet(on_tpu):
 # Config #2: ResNet50 dygraph AMP bf16
 # ---------------------------------------------------------------------
 def bench_resnet50(on_tpu):
+    import contextlib
     import numpy as np
     import paddle_tpu as paddle
     from paddle_tpu import optimizer
     from paddle_tpu.vision.models import resnet50
     import paddle_tpu.nn.functional as F
 
+    lazy_cm = (paddle.incubate.lazy_eager() if on_tpu
+               else contextlib.nullcontext())
     B, HW = (32, 224) if on_tpu else (2, 64)
     n_iters = 5 if on_tpu else 2
     paddle.seed(0)
@@ -288,13 +298,14 @@ def bench_resnet50(on_tpu):
         opt.clear_grad()
         return loss
 
-    t = time.time()
-    step()
-    log(f"resnet50: first step {time.time()-t:.1f}s")
-    t = time.time()
-    for _ in range(n_iters):
-        loss = step()
-    loss.numpy()
+    with lazy_cm:
+        t = time.time()
+        step()
+        log(f"resnet50: first step {time.time()-t:.1f}s")
+        t = time.time()
+        for _ in range(n_iters):
+            loss = step()
+        loss.numpy()
     dt = (time.time() - t) / n_iters
     log(f"resnet50: dygraph AMP step {dt*1e3:.1f} ms "
         f"({B/dt:,.0f} imgs/s)")

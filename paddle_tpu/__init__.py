@@ -95,6 +95,12 @@ from . import sysconfig  # noqa: F401
 from . import quantization  # noqa: F401
 
 from .jit import grad  # noqa: F401
+
+# lazy eager opt-in at import (see core/lazy.py; also
+# paddle.incubate.lazy_eager / enable_lazy at runtime)
+if _os.environ.get("PADDLE_TPU_LAZY") == "1":
+    from .core.lazy import enable_lazy as _enable_lazy
+    _enable_lazy(True)
 from .hapi import Model, summary  # noqa: F401
 from . import callbacks  # noqa: F401
 from .framework.flags import set_flags, get_flags  # noqa: F401

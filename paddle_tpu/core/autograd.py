@@ -20,6 +20,8 @@ from typing import Any, Callable, List, Optional, Sequence
 
 import jax.numpy as jnp
 
+from .lazy import concrete as _lazy_concrete, lazy_add
+
 __all__ = [
     "GradNode", "backward", "grad", "no_grad", "enable_grad",
     "set_grad_enabled", "is_grad_enabled",
@@ -174,7 +176,7 @@ class GradNode:
 
 def _sink_accumulate(sink, t, g):
     k = id(t)
-    sink[k] = g if k not in sink else sink[k] + g
+    sink[k] = g if k not in sink else lazy_add(sink[k], g)
 
 
 def _accumulate(t, g):
@@ -189,7 +191,7 @@ def _accumulate(t, g):
         t.grad = Tensor(g, stop_gradient=True, _internal=True)
         t.grad.name = (t.name or "tensor") + "@GRAD"
     else:
-        t.grad._inplace_update(t.grad.value() + g)
+        t.grad._inplace_update(lazy_add(t.grad.value(), g))
 
 
 def backward(tensors, grad_tensors=None, retain_graph: bool = False,
@@ -214,7 +216,7 @@ def backward(tensors, grad_tensors=None, retain_graph: bool = False,
 
     def seed(node, idx, cot):
         lst = pending.setdefault(node.id, [None] * node.n_outputs)
-        lst[idx] = cot if lst[idx] is None else lst[idx] + cot
+        lst[idx] = cot if lst[idx] is None else lazy_add(lst[idx], cot)
         nodes[node.id] = node
 
     for t, g in zip(tensors, grad_tensors):
@@ -226,7 +228,8 @@ def backward(tensors, grad_tensors=None, retain_graph: bool = False,
                     "grad can be implicitly created only for scalar outputs; "
                     f"got shape {tuple(t._value.shape)}"
                 )
-            gv = jnp.ones_like(t._value)
+            v = t._value
+            gv = jnp.ones(getattr(v, "shape", ()), v.dtype)
         else:
             gv = g._value if isinstance(g, Tensor) else jnp.asarray(g)
         node = t._grad_node
@@ -261,6 +264,9 @@ def backward(tensors, grad_tensors=None, retain_graph: bool = False,
             c if c is not None else jnp.zeros(s, d)
             for c, (s, d) in zip(cots, node.out_shapes_dtypes)
         )
+        if not getattr(node.vjp_fn, "_lazy_ok", False):
+            # jitted/plain vjp closures reject LazyValue arguments
+            full = tuple(_lazy_concrete(c) for c in full)
         if node.n_outputs == 1:
             in_cots = node.vjp_fn(full[0])
         else:
@@ -286,7 +292,7 @@ def backward(tensors, grad_tensors=None, retain_graph: bool = False,
             else:
                 lst = pending.setdefault(child.id, [None] * child.n_outputs)
                 i = t._out_index
-                lst[i] = ic if lst[i] is None else lst[i] + ic
+                lst[i] = ic if lst[i] is None else lazy_add(lst[i], ic)
                 if child.id not in nodes:
                     nodes[child.id] = child
                 if child.id not in inheap and child.id in pending:

@@ -21,6 +21,7 @@ import jax
 import jax.numpy as jnp
 
 from . import autograd
+from . import lazy as _lazy
 from .dtypes import to_paddle_dtype
 
 __all__ = ["dispatch", "OpDef", "OP_REGISTRY", "register_op"]
@@ -98,6 +99,18 @@ def _static_sig(v):
     raise TypeError
 
 
+def _cell_sig(v, _depth=0):
+    """Hashable signature for one closure cell; TypeError when the cell
+    holds anything whose behavior the key could not capture."""
+    if callable(v) and hasattr(v, "__code__"):
+        cells = v.__closure__ or ()
+        if _depth > 3:
+            raise TypeError
+        return ("fn", v.__code__, tuple(
+            _cell_sig(c.cell_contents, _depth + 1) for c in cells))
+    return _static_sig(v)
+
+
 def _jit_key(name, impl, args, tensor_idx, arrays, attrs):
     from ..framework.flags import get_flags
     if not get_flags("FLAGS_eager_op_jit")["FLAGS_eager_op_jit"]:
@@ -112,7 +125,17 @@ def _jit_key(name, impl, args, tensor_idx, arrays, attrs):
             return None
         code = impl
     elif code.co_freevars:
-        return None
+        # closures over hashable config (conv dimension specs etc.) are
+        # cacheable: the cell values ride in the key.  Function-valued
+        # cells (the _rng_op wrapper around dropout impls) key by their
+        # code objects.  Anything else (tensors, mutable state) keeps
+        # the op out of the caches.  Empty cells raise ValueError.
+        try:
+            free = tuple(_cell_sig(c.cell_contents)
+                         for c in impl.__closure__)
+        except (TypeError, ValueError):
+            return None
+        code = (code, free)
     tset = set(tensor_idx)
     try:
         statics = tuple(
@@ -168,6 +191,18 @@ def dispatch(name: str, impl: Callable, args: Sequence[Any], attrs=None,
     )
 
     key = _jit_key(name, impl, args, tensor_idx, arrays, attrs)
+
+    # ---- lazy eager (SURVEY §7): record instead of dispatching ----
+    if _lazy._EVER_ENABLED:  # keep the default hot path untouched
+        if (key is not None and _lazy.lazy_enabled()
+                and not any(isinstance(a, jax.core.Tracer)
+                            for a in arrays)):
+            out = _lazy_dispatch(name, impl, args, attrs, tensor_idx,
+                                 tensors, arrays, needs, record, key)
+            if out is not _LAZY_UNSUPPORTED:
+                return out
+        # fallback paths need concrete arrays (jax.vjp rejects LazyValue)
+        arrays = [_lazy.concrete(a) for a in arrays]
 
     if not record:
         if key is not None:
@@ -244,6 +279,53 @@ def dispatch(name: str, impl: Callable, args: Sequence[Any], attrs=None,
         [(o.shape, o.dtype) for o in outs_t],
     )
     return _wrap(outs, name, node=node)
+
+
+_LAZY_UNSUPPORTED = object()
+
+
+def _lazy_dispatch(name, impl, args, attrs, tensor_idx, tensors, arrays,
+                   needs, record, key):
+    """Record the op into the lazy segment buffer; no device dispatch.
+    Returns _LAZY_UNSUPPORTED when the op cannot be abstractly
+    evaluated (host-value-dependent impls) — caller falls through to
+    the immediate path."""
+    from . import lazy as _lazy
+
+    tset = set(tensor_idx)
+    template = [None if i in tset else a for i, a in enumerate(args)]
+    in_avals = [_lazy._aval_of(a) for a in arrays]
+    try:
+        meta = _lazy.abs_eval(key, record, template, tensor_idx, attrs,
+                              impl, in_avals)
+    except Exception:
+        return _LAZY_UNSUPPORTED
+    if record and any(meta["none_mask"]):
+        return _LAZY_UNSUPPORTED
+
+    run = _lazy.make_fwd_run(template, tensor_idx, attrs, impl, record)
+    avals = list(meta["out_avals"]) + list(meta.get("res_avals", ()))
+    lazy_outs = _lazy.record_node(run, arrays, avals,
+                                  ("fwd", key, record))
+    n_out = len(meta["out_avals"])
+    outs = lazy_outs[:n_out]
+
+    if not record:
+        if meta["is_multi"]:
+            full, it = [], iter(outs)
+            for isnone in meta["none_mask"]:
+                full.append(None if isnone else next(it))
+            return _wrap(full, name, node=None)
+        return _wrap(outs[0], name, node=None)
+
+    res_vals = lazy_outs[n_out:]
+    vjp_fn = _lazy.make_lazy_vjp(key, res_vals, meta["treedef"],
+                                 meta["out_struct"])
+    node = autograd.GradNode(
+        name, vjp_fn, tensors, needs, n_out,
+        [(o.shape, o.dtype) for o in outs])
+    return _wrap(tuple(outs) if meta["is_multi"] else outs[0], name,
+                 node=node)
 
 
 def _wrap(outs, name, node):

@@ -76,6 +76,11 @@ class Tensor:
     def value(self):
         ctx = _trace_state.ctx
         if ctx is not None:
+            from .lazy import LazyValue
+            if isinstance(self._value, LazyValue):
+                # a to_static trace must capture the concrete buffer,
+                # not a half-built lazy segment
+                self._value = self._value.force()
             return ctx.on_read(self)
         return self._value
 

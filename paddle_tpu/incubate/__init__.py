@@ -32,3 +32,7 @@ def autograd_functional_jacobian(func, xs):
     wrapped = tuple(Tensor(j, _internal=True, stop_gradient=True)
                     for j in jac)
     return wrapped[0] if single else wrapped
+
+# lazy eager mode (SURVEY.md §7 "dygraph without per-op sync")
+from ..core.lazy import (lazy_guard as lazy_eager,  # noqa: F401
+                         enable_lazy, flush as lazy_flush)

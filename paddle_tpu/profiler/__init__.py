@@ -192,6 +192,15 @@ class Profiler:
         lines = [f"{'Name':<40}{'Calls':<8}{'Total(ms)':<12}"]
         for name, (tot, n) in sorted(agg.items(), key=lambda kv: -kv[1][0]):
             lines.append(f"{name:<40}{n:<8}{tot:<12.3f}")
+        # device memory footprint (SURVEY.md:101 allocator stats)
+        from ..device import memory_stats
+        s = memory_stats()
+        if s:
+            gb = 2.0 ** 30
+            lines.append(
+                f"{'HBM in_use / peak (GiB)':<40}"
+                f"{s.get('bytes_in_use', 0)/gb:<8.3f}"
+                f"{s.get('peak_bytes_in_use', 0)/gb:<12.3f}")
         out = "\n".join(lines)
         print(out)
         return out

@@ -103,8 +103,10 @@ class Executor:
                 np.asarray(optimizer._step_count._value), jnp.int32)
             optimizer._step_count._inplace_update(
                 np.asarray(optimizer._step_count._value) + 1)
-        outs, new_params, new_opt_state = entry["compiled"](
-            feed_vals, param_vals, opt_state_vals, lr_val, step_val)
+        from ..device import hbm_oom_context
+        with hbm_oom_context():
+            outs, new_params, new_opt_state = entry["compiled"](
+                feed_vals, param_vals, opt_state_vals, lr_val, step_val)
         for p, v in zip(entry["params"], new_params):
             p._value = v
         for t, v in zip(entry["opt_state"], new_opt_state):

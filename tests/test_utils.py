@@ -40,3 +40,27 @@ def test_run_check_and_version(capsys):
     assert "jax" in version.xla()
     assert sysconfig.get_include()
     assert sysconfig.get_lib().endswith("_native")
+
+
+def test_device_memory_api():
+    """HBM observability surface (SURVEY.md:101): stats dict, counters,
+    summary text, and the OOM re-raise context."""
+    import paddle_tpu as paddle
+    from paddle_tpu import device
+
+    s = device.memory_stats()
+    assert isinstance(s, dict)
+    assert device.memory_allocated() >= 0
+    assert device.max_memory_allocated() >= device.memory_allocated() \
+        or device.max_memory_allocated() == 0
+    assert isinstance(device.memory_summary(), str)
+    device.empty_cache()
+
+    with pytest.raises(RuntimeError, match="memory"):
+        with device.hbm_oom_context():
+            raise RuntimeError("RESOURCE_EXHAUSTED: Out of memory "
+                               "allocating 1TB")
+    # non-OOM errors pass through untouched
+    with pytest.raises(ValueError):
+        with device.hbm_oom_context():
+            raise ValueError("unrelated")
