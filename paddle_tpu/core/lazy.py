@@ -344,7 +344,9 @@ def _flush_nodes(pending):
         fn = jax.jit(replay)
         if len(_segment_cache) < _SEGMENT_CACHE_MAX:
             _segment_cache[seg_key] = fn
-    out = fn(leaves)
+    from ..device import hbm_oom_context
+    with hbm_oom_context():  # dygraph OOMs surface here
+        out = fn(leaves)
     for n, vals in zip(pending, out):
         for lv, v in zip(n.outs, vals):
             lv._concrete = v

@@ -134,27 +134,19 @@ class _CudaNamespace:
 
     @staticmethod
     def max_memory_allocated(device=None):
-        try:
-            stats = jax.devices()[0].memory_stats()
-            return stats.get("peak_bytes_in_use", 0)
-        except Exception:
-            return 0
+        return max_memory_allocated(device)
 
     @staticmethod
     def max_memory_reserved(device=None):
-        return _CudaNamespace.max_memory_allocated(device)
+        return max_memory_reserved(device)
 
     @staticmethod
     def memory_allocated(device=None):
-        try:
-            stats = jax.devices()[0].memory_stats()
-            return stats.get("bytes_in_use", 0)
-        except Exception:
-            return 0
+        return memory_allocated(device)
 
     @staticmethod
     def memory_reserved(device=None):
-        return _CudaNamespace.memory_allocated(device)
+        return memory_allocated(device)
 
     @staticmethod
     def get_device_properties(device=None):

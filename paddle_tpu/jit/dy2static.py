@@ -1,0 +1,423 @@
+"""dy2static: AST conversion of Python control flow for to_static.
+
+Reference parity: `python/paddle/jit/dy2static/` — the ProgramTranslator
+rewrites `if`/`while`/`for` over Tensors into `cond`/`while_loop` layers
+with runtime converters (`convert_ifelse`, `convert_while_loop`,
+`convert_logical_*`) that fall back to plain Python when the predicate
+is not a Tensor [UNVERIFIED — empty reference mount; SURVEY.md:134].
+(The SOT/bytecode path is future work; this is the AST generation.)
+
+TPU-native: the converters dispatch on whether the predicate is a
+*traced* value.  A concrete Tensor predicate runs ordinary Python
+control flow (eager semantics, including under the lazy-eager mode —
+forcing the predicate is a sync point); a traced predicate lowers to
+`static.nn.cond` / `while_loop`, i.e. `lax.cond` / `lax.while_loop`,
+inside the one compiled program.
+
+Conversion is best-effort with LOUD fallback: any construct outside the
+supported subset (`break`/`continue`/`return` inside a converted block,
+closures over free variables, unavailable source) leaves the function
+untransformed and logs why — trace semantics then apply (a Python `if`
+on a traced tensor raises with advice, as before).
+"""
+from __future__ import annotations
+
+import ast
+import functools
+import inspect
+import logging
+import textwrap
+import types
+
+logger = logging.getLogger("paddle_tpu.dy2static")
+
+__all__ = ["convert_function", "convert_ifelse", "convert_while_loop",
+           "convert_logical_and", "convert_logical_or",
+           "convert_logical_not", "UNDEF"]
+
+
+class _Undefined:
+    """Placeholder for names assigned in only one branch of a converted
+    block (Paddle's UndefinedVar role)."""
+
+    def __repr__(self):
+        return "<undefined>"
+
+
+UNDEF = _Undefined()
+
+
+def _is_traced(x):
+    from ..core.tensor import Tensor
+    if not isinstance(x, Tensor):
+        return False
+    import jax
+    return isinstance(x._value, jax.core.Tracer)
+
+
+def _to_bool(x):
+    from ..core.tensor import Tensor
+    if isinstance(x, Tensor):
+        return bool(x._value)       # sync point under lazy mode
+    return bool(x)
+
+
+# ---------------------------------------------------------------------
+# runtime converters (referenced by generated code as _jst.*)
+# ---------------------------------------------------------------------
+def convert_ifelse(pred, true_fn, false_fn, init_vars):
+    """init_vars: tuple of current values of every name either branch
+    assigns; each *_fn takes and returns that full tuple."""
+    if _is_traced(pred):
+        from ..static.nn.control_flow import cond
+        out = cond(pred, lambda: true_fn(*init_vars),
+                   lambda: false_fn(*init_vars))
+        _check_no_undef(out, "if")
+        return out
+    if _to_bool(pred):
+        return true_fn(*init_vars)
+    return false_fn(*init_vars)
+
+
+def convert_while_loop(cond_fn, body_fn, init_vars):
+    first = cond_fn(*init_vars)
+    if _is_traced(first):
+        from ..static.nn.control_flow import while_loop
+        _check_no_undef(init_vars, "while")
+        return tuple(while_loop(lambda *vs: cond_fn(*vs),
+                                lambda *vs: tuple(body_fn(*vs)),
+                                list(init_vars)))
+    vars_ = tuple(init_vars)
+    while _to_bool(cond_fn(*vars_)):
+        vars_ = tuple(body_fn(*vars_))
+    return vars_
+
+
+def _check_no_undef(vals, kind):
+    if any(isinstance(v, _Undefined) for v in
+           (vals if isinstance(vals, (tuple, list)) else (vals,))):
+        raise ValueError(
+            f"dy2static: a variable assigned in only one branch of a "
+            f"traced `{kind}` is used afterwards; assign it before the "
+            f"{kind} so both paths define it")
+
+
+def convert_logical_and(lhs_fn, rhs_fn):
+    lhs = lhs_fn()
+    if _is_traced(lhs):
+        from ..ops._generated import logical_and
+        return logical_and(lhs, rhs_fn())
+    return lhs and rhs_fn()
+
+
+def convert_logical_or(lhs_fn, rhs_fn):
+    lhs = lhs_fn()
+    if _is_traced(lhs):
+        from ..ops._generated import logical_or
+        return logical_or(lhs, rhs_fn())
+    return lhs or rhs_fn()
+
+
+def convert_logical_not(x):
+    if _is_traced(x):
+        from ..ops.manipulation import logical_not
+        return logical_not(x)
+    return not x
+
+
+# ---------------------------------------------------------------------
+# AST transformation
+# ---------------------------------------------------------------------
+class _Unsupported(Exception):
+    pass
+
+
+def _assigned_names(nodes):
+    """Names bound by a statement list (shallow: no nested defs)."""
+    out = []
+
+    class V(ast.NodeVisitor):
+        def visit_Name(self, n):
+            if isinstance(n.ctx, (ast.Store, ast.Del)):
+                if n.id not in out:
+                    out.append(n.id)
+
+        def visit_FunctionDef(self, n):
+            if n.name not in out:
+                out.append(n.name)
+
+        def visit_AsyncFunctionDef(self, n):
+            pass
+
+        def visit_Lambda(self, n):
+            pass
+
+    v = V()
+    for s in nodes:
+        v.visit(s)
+    return out
+
+
+def _loaded_names(node):
+    out = set()
+
+    class V(ast.NodeVisitor):
+        def visit_Name(self, n):
+            if isinstance(n.ctx, ast.Load):
+                out.add(n.id)
+
+    V().visit(node)
+    return out
+
+
+class _BreakFinder(ast.NodeVisitor):
+    """break/continue/return inside a block (not inside a nested loop
+    or def) make it unconvertible."""
+
+    def __init__(self):
+        self.found = False
+
+    def generic_visit(self, node):
+        if isinstance(node, (ast.Break, ast.Continue, ast.Return)):
+            self.found = True
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            return
+        if isinstance(node, (ast.For, ast.While)):
+            # a break inside a NESTED loop belongs to that loop; only
+            # its own test/body order matters — still scan for return
+            for sub in ast.walk(node):
+                if isinstance(sub, ast.Return):
+                    self.found = True
+            return
+        super().generic_visit(node)
+
+
+def _block_has_escape(nodes):
+    f = _BreakFinder()
+    for n in nodes:
+        f.visit(n)
+    return f.found
+
+
+class _Transformer(ast.NodeTransformer):
+    def __init__(self):
+        self.counter = 0
+        self.changed = False
+        self.seen_names: set = set()      # names assigned so far
+
+    # --- helpers ---
+    def _freshen(self, base):
+        self.counter += 1
+        return f"__jst_{base}_{self.counter}"
+
+    def _tuple_expr(self, names, ctx):
+        return ast.Tuple(
+            elts=[ast.Name(id=n, ctx=ctx()) for n in names], ctx=ctx())
+
+    def _make_branch_fn(self, fname, var_names, body, extra_ret=None):
+        """def fname(v1, v2, ...):  body;  return (v1, ... | extra)"""
+        ret = ast.Return(value=extra_ret if extra_ret is not None
+                         else self._tuple_expr(var_names, ast.Load))
+        args = ast.arguments(
+            posonlyargs=[], args=[ast.arg(arg=n) for n in var_names],
+            vararg=None, kwonlyargs=[], kw_defaults=[], kwarg=None,
+            defaults=[])
+        return ast.FunctionDef(
+            name=fname, args=args, body=list(body) + [ret],
+            decorator_list=[], returns=None)
+
+    def _jst(self, attr):
+        return ast.Attribute(
+            value=ast.Name(id="_jst", ctx=ast.Load()), attr=attr,
+            ctx=ast.Load())
+
+    def _undef_inits(self, names, seen_before):
+        """`v = _jst.UNDEF` for names never assigned before the block
+        (seen_before: the snapshot from before the block's own bodies
+        were visited — branch-local stores must not count)."""
+        out = []
+        for n in names:
+            if n not in seen_before:
+                out.append(ast.Assign(
+                    targets=[ast.Name(id=n, ctx=ast.Store())],
+                    value=self._jst("UNDEF")))
+        return out
+
+    # --- statements ---
+    def visit_FunctionDef(self, node):
+        for a in node.args.args + node.args.posonlyargs + \
+                node.args.kwonlyargs:
+            self.seen_names.add(a.arg)
+        if node.args.vararg:
+            self.seen_names.add(node.args.vararg.arg)
+        if node.args.kwarg:
+            self.seen_names.add(node.args.kwarg.arg)
+        node.body = self._visit_block(node.body)
+        return node
+
+    def _visit_block(self, stmts):
+        out = []
+        for s in stmts:
+            r = self.visit(s)
+            if isinstance(r, list):
+                out.extend(r)
+            elif r is not None:
+                out.append(r)
+            for n in _assigned_names([s]):
+                self.seen_names.add(n)
+        return out
+
+    def visit_If(self, node):
+        seen_before = set(self.seen_names)
+        node.test = self.visit(node.test)
+        node.body = self._visit_block(node.body)
+        node.orelse = self._visit_block(node.orelse)
+        if _block_has_escape(node.body) or _block_has_escape(node.orelse):
+            return node  # unsupported: leave trace semantics
+        mod = _assigned_names(node.body + node.orelse)
+        if not mod:
+            return node  # side-effect-only branches: leave as-is
+        self.changed = True
+        tname = self._freshen("true")
+        fname = self._freshen("false")
+        true_def = self._make_branch_fn(tname, mod, node.body)
+        false_def = self._make_branch_fn(fname, mod, node.orelse or
+                                         [ast.Pass()])
+        call = ast.Assign(
+            targets=[self._tuple_expr(mod, ast.Store)],
+            value=ast.Call(
+                func=self._jst("convert_ifelse"),
+                args=[node.test,
+                      ast.Name(id=tname, ctx=ast.Load()),
+                      ast.Name(id=fname, ctx=ast.Load()),
+                      self._tuple_expr(mod, ast.Load)],
+                keywords=[]))
+        return self._undef_inits(mod, seen_before) + \
+            [true_def, false_def, call]
+
+    def visit_While(self, node):
+        seen_before = set(self.seen_names)
+        node.test = self.visit(node.test)
+        node.body = self._visit_block(node.body)
+        if node.orelse or _block_has_escape(node.body):
+            return node
+        mod = _assigned_names(node.body)
+        test_reads = [u for u in sorted(_loaded_names(node.test))
+                      if u in self.seen_names and u not in mod]
+        loop_vars = list(dict.fromkeys(list(mod) + test_reads))
+        if not loop_vars:
+            return node
+        self.changed = True
+        cname = self._freshen("cond")
+        bname = self._freshen("body")
+        cond_def = self._make_branch_fn(cname, loop_vars, [],
+                                        extra_ret=node.test)
+        body_def = self._make_branch_fn(bname, loop_vars, node.body)
+        call = ast.Assign(
+            targets=[self._tuple_expr(loop_vars, ast.Store)],
+            value=ast.Call(
+                func=self._jst("convert_while_loop"),
+                args=[ast.Name(id=cname, ctx=ast.Load()),
+                      ast.Name(id=bname, ctx=ast.Load()),
+                      self._tuple_expr(loop_vars, ast.Load)],
+                keywords=[]))
+        return self._undef_inits(loop_vars, seen_before) + \
+            [cond_def, body_def, call]
+
+    # --- expressions ---
+    def visit_BoolOp(self, node):
+        self.generic_visit(node)
+        conv = ("convert_logical_and" if isinstance(node.op, ast.And)
+                else "convert_logical_or")
+        expr = node.values[-1]
+        for v in reversed(node.values[:-1]):
+            expr = ast.Call(
+                func=self._jst(conv),
+                args=[ast.Lambda(
+                          args=ast.arguments(
+                              posonlyargs=[], args=[], vararg=None,
+                              kwonlyargs=[], kw_defaults=[], kwarg=None,
+                              defaults=[]),
+                          body=v),
+                      ast.Lambda(
+                          args=ast.arguments(
+                              posonlyargs=[], args=[], vararg=None,
+                              kwonlyargs=[], kw_defaults=[], kwarg=None,
+                              defaults=[]),
+                          body=expr)],
+                keywords=[])
+            self.changed = True
+        return expr
+
+    def visit_UnaryOp(self, node):
+        self.generic_visit(node)
+        if isinstance(node.op, ast.Not):
+            self.changed = True
+            return ast.Call(func=self._jst("convert_logical_not"),
+                            args=[node.operand], keywords=[])
+        return node
+
+
+def convert_function(fn):
+    """Return a control-flow-converted version of `fn`, or `fn` itself
+    (with a logged reason) when conversion is not possible."""
+    raw = fn.__func__ if isinstance(fn, types.MethodType) else fn
+    if getattr(raw, "_jst_converted", False) or \
+            getattr(raw, "_not_to_static", False):
+        return fn
+    if raw.__closure__:
+        logger.info(
+            "dy2static: %s closes over free variables; keeping trace "
+            "semantics", raw.__qualname__)
+        return fn
+    try:
+        src = textwrap.dedent(inspect.getsource(raw))
+    except (OSError, TypeError) as e:
+        logger.info("dy2static: no source for %s (%s); keeping trace "
+                    "semantics", getattr(raw, "__qualname__", raw), e)
+        return fn
+    try:
+        tree = ast.parse(src)
+    except SyntaxError as e:
+        logger.info("dy2static: cannot parse %s (%s)", raw.__qualname__,
+                    e)
+        return fn
+    fdef = tree.body[0]
+    if not isinstance(fdef, (ast.FunctionDef,)):
+        return fn
+    fdef.decorator_list = []    # @to_static etc. must not re-apply
+
+    tr = _Transformer()
+    try:
+        tree = tr.visit(tree)
+    except _Unsupported as e:
+        logger.warning("dy2static: %s not converted (%s); python "
+                       "control flow over traced tensors will raise",
+                       raw.__qualname__, e)
+        return fn
+    if not tr.changed:
+        return fn
+    ast.fix_missing_locations(tree)
+
+    glob = dict(raw.__globals__)
+    from . import dy2static as _jst_mod
+    glob["_jst"] = _jst_mod
+    try:
+        code = compile(tree, filename=f"<dy2static {raw.__qualname__}>",
+                       mode="exec")
+        exec(code, glob)
+        new_raw = glob[fdef.name]
+    except Exception as e:
+        logger.warning("dy2static: compiling converted %s failed (%s); "
+                       "keeping trace semantics", raw.__qualname__, e)
+        return fn
+    functools.update_wrapper(new_raw, raw)
+    new_raw._jst_converted = True
+    new_raw.__defaults__ = raw.__defaults__
+    new_raw.__kwdefaults__ = raw.__kwdefaults__
+    logger.info("dy2static: converted control flow in %s",
+                raw.__qualname__)
+    if isinstance(fn, types.MethodType):
+        return types.MethodType(new_raw, fn.__self__)
+    return new_raw

@@ -129,7 +129,11 @@ class TracedFunction:
     """The callable returned by paddle.jit.to_static."""
 
     def __init__(self, fn, input_spec=None, jit_kwargs=None):
-        self._fn = fn
+        from .dy2static import convert_function
+        # AST pass first (SURVEY.md:134): python if/while over traced
+        # tensors become static.nn.cond/while_loop; unconvertible
+        # functions keep trace semantics with a logged reason
+        self._fn = convert_function(fn)
         self._input_spec = input_spec
         self._cache = {}
         self._jit_kwargs = jit_kwargs or {}

@@ -1,0 +1,172 @@
+"""Memory-validate the GPipe schedule (VERDICT r3 item 10).
+
+Compares XLA's compile-time memory analysis (temp allocation = live
+activations + workspace) for the global-array pipeline engine's scan
+schedule — remat on and off — against plain microbatch gradient
+accumulation at equal global batch, on the 8-device CPU mesh.  No
+hardware needed: `compiled.memory_analysis()` is the planner's own
+accounting, the same quantity HBM residency is made of.
+
+Writes PP_MEMORY.md at the repo root with the table.
+
+Usage: XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+           python scripts/pp_memory_probe.py
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "") +
+                           " --xla_force_host_platform_device_count=8")
+import jax  # noqa: E402
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+
+def log(msg):
+    print(f"[ppmem] {msg}", flush=True)
+
+
+def build_engine(n_micro, remat, hidden=256, layers=8, seq=128):
+    import paddle_tpu as paddle
+    from paddle_tpu import nn, optimizer
+    from paddle_tpu.distributed import fleet
+    from paddle_tpu.distributed.fleet.meta_parallel import PipelineLayer
+    from paddle_tpu.distributed.fleet.meta_parallel.pp_utils import \
+        GlobalPipelineEngine
+
+    import paddle_tpu.distributed as dist
+    from paddle_tpu.distributed.communication import group as group_mod
+    from paddle_tpu.distributed.fleet import fleet_facade as _ff
+    dist.env.set_global_mesh(None)
+    group_mod._default_group = None
+    _ff._fleet_state["initialized"] = False
+    _ff._fleet_state["hcg"] = None
+
+    strategy = fleet.DistributedStrategy()
+    strategy.hybrid_configs = {"dp_degree": 2, "pp_degree": 4}
+    strategy.pipeline_configs = {"accumulate_steps": n_micro,
+                                 "micro_batch_size": 1}
+    fleet.init(is_collective=True, strategy=strategy)
+
+    paddle.seed(0)
+    blocks = []
+    for _ in range(8):
+        blocks += [nn.Linear(hidden, hidden), nn.Tanh()]
+    mse = lambda o, l: paddle.nn.functional.mse_loss(o, l)  # noqa: E731
+    pl = PipelineLayer(layers=blocks, num_stages=4, loss_fn=mse)
+    opt = paddle.optimizer.SGD(learning_rate=0.1,
+                               parameters=pl.parameters())
+    return GlobalPipelineEngine(pl, _ff._fleet_state["hcg"], opt,
+                                n_micro=n_micro, remat=remat)
+
+
+def engine_memory(n_micro, remat, mb=2, hidden=256, seq=128):
+    eng = build_engine(n_micro, remat, hidden=hidden)
+    x = jnp.zeros((n_micro, mb, seq, hidden), jnp.float32)
+    y = jnp.zeros((n_micro, mb, seq, hidden), jnp.float32)
+    fn = eng._build(x, y, False)
+    lowered = fn.lower(
+        tuple(t._value for t in eng.outer),
+        tuple(t._value for t in eng.stacked),
+        tuple(t._value for t in eng.opt_state),
+        jnp.float32(0.1), jnp.int32(0), jnp.float32(1.0), x, y)
+    mem = lowered.compile().memory_analysis()
+    return mem
+
+
+def accum_memory(n_micro, mb=2, hidden=256, seq=128):
+    """Single-program microbatch gradient accumulation at equal global
+    batch (what the fallback path compiles to, idealized as one jit)."""
+    import paddle_tpu as paddle
+    from paddle_tpu import nn
+
+    paddle.seed(0)
+    blocks = []
+    for _ in range(8):
+        blocks += [nn.Linear(hidden, hidden), nn.Tanh()]
+    model = nn.Sequential(*blocks)
+    params = [p for p in model.parameters()]
+    named = list(enumerate(params))
+
+    def loss_fn(pvals, xb, yb):
+        saved = [(p, p._value) for p in params]
+        try:
+            for (i, p), v in zip(named, pvals):
+                p._value = v
+            from paddle_tpu.core.tensor import Tensor
+            from paddle_tpu.core.autograd import no_grad
+            with no_grad():
+                o = model(Tensor(xb, _internal=True, stop_gradient=True))
+                l = ((o - Tensor(yb, _internal=True,
+                                 stop_gradient=True)) ** 2)
+                return jnp.mean(l._value.astype(jnp.float32))
+        finally:
+            for p, v in saved:
+                p._value = v
+
+    def step(pvals, x, y):
+        def micro(carry, xy):
+            acc = carry
+            xb, yb = xy
+            l, g = jax.value_and_grad(loss_fn)(pvals, xb, yb)
+            return ([a + gi for a, gi in zip(acc, g)], l)
+
+        acc0 = [jnp.zeros_like(v) for v in pvals]
+        (grads, _) = jax.lax.scan(micro, acc0, (x, y))[0], None
+        new = [v - 0.1 * g / n_micro for v, g in zip(pvals, grads)]
+        return tuple(new)
+
+    pvals = tuple(p._value for p in params)
+    x = jnp.zeros((n_micro, mb * 2, seq, hidden), jnp.float32)
+    y = jnp.zeros((n_micro, mb * 2, seq, hidden), jnp.float32)
+    lowered = jax.jit(step).lower(pvals, x, y)
+    return lowered.compile().memory_analysis()
+
+
+def fmt(mem):
+    gb = 2.0 ** 20
+    return (f"temp={mem.temp_size_in_bytes/gb:9.1f} MiB  "
+            f"args={mem.argument_size_in_bytes/gb:7.1f} MiB  "
+            f"out={mem.output_size_in_bytes/gb:7.1f} MiB")
+
+
+def main():
+    rows = []
+    for n_micro in (4, 8):
+        for remat in (True, False):
+            mem = engine_memory(n_micro, remat)
+            line = (f"pipeline scan  n_micro={n_micro:<2d} "
+                    f"remat={str(remat):<5s} {fmt(mem)}")
+            log(line)
+            rows.append(line)
+        mem = accum_memory(n_micro)
+        line = (f"grad-accum     n_micro={n_micro:<2d} remat=n/a   "
+                f"{fmt(mem)}")
+        log(line)
+        rows.append(line)
+
+    out = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "PP_MEMORY.md")
+    with open(out, "w") as f:
+        f.write(
+            "# GPipe schedule memory validation (VERDICT r3 item 10)\n\n"
+            "XLA compile-time memory analysis, per device, 8-device CPU "
+            "mesh (dp=2, pp=4),\n8×(Linear(256)+Tanh) trunk, seq=128, "
+            "micro-batch 2.  `temp` is the planner's\nlive-activation + "
+            "workspace accounting — the HBM-residency quantity.\n\n"
+            "```\n" + "\n".join(rows) + "\n```\n\n"
+            "Interpretation: remat bounds the scan's activation "
+            "residency (the 1F1B\nmemory win the docstring claims); "
+            "without remat the scan carries every\ntick's activations "
+            "to the backward.  Re-run: `python "
+            "scripts/pp_memory_probe.py`.\n")
+    log(f"wrote {out}")
+
+
+if __name__ == "__main__":
+    main()

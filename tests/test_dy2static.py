@@ -1,0 +1,112 @@
+"""dy2static AST conversion (SURVEY.md:134, VERDICT r3 item 6):
+python if/while over traced tensors round-trip to_static via
+static.nn.cond/while_loop; unconvertible constructs fall back to trace
+semantics loudly."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import jit, nn
+import paddle_tpu.nn.functional as F
+from paddle_tpu.jit import dy2static
+
+
+def _branchy(x):
+    if x.sum() > 0:
+        y = x * 2
+    else:
+        y = x - 10
+    return y
+
+
+def _loopy(x):
+    s = paddle.zeros([])
+    i = paddle.zeros([], dtype="float32")
+    while i.sum() < 5:
+        s = s + x.sum()
+        i = i + 1
+    return s
+
+
+def _booly(x):
+    if (x.sum() > 0) and (x.max() < 10):
+        r = x + 1
+    else:
+        r = x - 1
+    return r
+
+
+def _escapey(x):
+    for v in [1, 2]:
+        if x.sum() > 0:
+            return x + v
+    return x
+
+
+def test_if_both_branches_compile():
+    sf = jit.to_static(_branchy)
+    a = paddle.to_tensor(np.array([1.0, 2.0], np.float32))
+    b = paddle.to_tensor(np.array([-5.0, -6.0], np.float32))
+    np.testing.assert_allclose(sf(a).numpy(), [2.0, 4.0])
+    # SAME compiled program takes the other branch on data
+    np.testing.assert_allclose(sf(b).numpy(), [-15.0, -16.0])
+
+
+def test_while_loop_converts():
+    sg = jit.to_static(_loopy)
+    out = sg(paddle.to_tensor(np.array([1.0, 1.0], np.float32)))
+    assert float(out) == 10.0
+
+
+def test_bool_ops_convert():
+    sh = jit.to_static(_booly)
+    a = paddle.to_tensor(np.array([1.0, 2.0], np.float32))
+    big = paddle.to_tensor(np.array([20.0, 2.0], np.float32))
+    np.testing.assert_allclose(sh(a).numpy(), [2.0, 3.0])
+    np.testing.assert_allclose(sh(big).numpy(), [19.0, 1.0])
+
+
+def test_eager_semantics_preserved():
+    """Converted functions with concrete predicates run plain Python."""
+    conv = dy2static.convert_function(_branchy)
+    a = paddle.to_tensor(np.array([1.0, 2.0], np.float32))
+    np.testing.assert_allclose(conv(a).numpy(), [2.0, 4.0])
+
+
+def test_unsupported_falls_back():
+    """return inside a branch: not converted, original behavior kept."""
+    conv = dy2static.convert_function(_escapey)
+    a = paddle.to_tensor(np.array([1.0, 2.0], np.float32))
+    np.testing.assert_allclose(conv(a).numpy(), [2.0, 3.0])
+
+
+class _GatedModel(nn.Layer):
+    """Model with data-dependent branching (the VERDICT 'done' bar)."""
+
+    def __init__(self):
+        super().__init__()
+        self.a = nn.Linear(8, 8)
+        self.b = nn.Linear(8, 8)
+
+    def forward(self, x):
+        if x.mean() > 0:
+            h = self.a(x)
+        else:
+            h = self.b(x)
+        return F.relu(h)
+
+
+def test_model_with_data_dependent_branch_roundtrips():
+    paddle.seed(0)
+    m = _GatedModel()
+    xs = [np.random.RandomState(i).randn(4, 8).astype(np.float32) * s
+          for i, s in ((0, 1.0), (1, -1.0))]
+    refs = [m(paddle.to_tensor(x) + 0.5 * np.sign(x.mean())).numpy()
+            for x in xs]
+    sm = jit.to_static(_GatedModel())
+    # fresh instance shares no weights; rebuild with same seed instead
+    paddle.seed(0)
+    sm = jit.to_static(_GatedModel())
+    for x, ref in zip(xs, refs):
+        got = sm(paddle.to_tensor(x) + 0.5 * np.sign(x.mean())).numpy()
+        np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-6)

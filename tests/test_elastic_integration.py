@@ -1,0 +1,161 @@
+"""Elastic fault-recovery integration (VERDICT r3 item 9): a 2-process
+jax.distributed pod loses a rank MID-RUN, the launcher kills the
+survivor and relaunches under --max_restarts, training resumes from the
+checkpoint, and the final weights match an uninterrupted run.  A second
+phase loads the 2-rank distributed checkpoint into a 1-rank process
+(topology change, reshard-on-load)."""
+import os
+import socket
+import subprocess
+import sys
+import textwrap
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+WORKER = textwrap.dedent("""
+    import os
+    import sys
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+    import paddle_tpu as paddle
+    import paddle_tpu.distributed as dist
+    from paddle_tpu import nn, optimizer
+
+    dist.init_parallel_env()
+    rank = dist.get_rank()
+    restart = int(os.environ.get("PADDLE_RESTART_CNT", "0"))
+    ckpt = os.path.join(os.environ["ELASTIC_DIR"], "state.pdparams")
+
+    # cross-process liveness coupling: a psum over the global mesh —
+    # if the peer dies, this blocks (the NCCL-hang analogue) and the
+    # launcher must kill us and relaunch the pod
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+    mesh = Mesh(np.array(jax.devices()), ("dp",))
+    nd = jax.device_count()
+
+    def barrier(tag):
+        local = np.ones((jax.local_device_count(), 1), np.float32)
+        arr = jax.make_array_from_process_local_data(
+            NamedSharding(mesh, P("dp")), local, (nd, 1))
+        out = jax.jit(jax.shard_map(
+            lambda x: jax.lax.psum(x, "dp"), mesh=mesh,
+            in_specs=P("dp"), out_specs=P(), check_vma=False))(arr)
+        assert float(np.asarray(jax.device_get(out))[0, 0]) == nd, tag
+
+    paddle.seed(0)
+    m = nn.Linear(8, 8)
+    opt = optimizer.SGD(learning_rate=0.1, parameters=m.parameters())
+    start = 0
+    if os.path.exists(ckpt):
+        st = paddle.load(ckpt)
+        m.set_state_dict(st["model"])
+        start = int(st["step"])
+        print(f"RANK{rank} RESUMED from step {start}", flush=True)
+
+    for step in range(start, 6):
+        rng = np.random.RandomState(step)
+        x = paddle.to_tensor(rng.randn(8, 8).astype(np.float32))
+        y = paddle.to_tensor(rng.randn(8, 8).astype(np.float32))
+        loss = paddle.nn.functional.mse_loss(m(x), y)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        barrier(f"step{step}")
+        if rank == 0:
+            tmp = ckpt + ".tmp"
+            paddle.save({"model": m.state_dict(), "step": step + 1}, tmp)
+            os.replace(tmp, ckpt)
+        barrier(f"ckpt{step}")
+        if rank == 1 and step == 2 and restart == 0:
+            print("RANK1 DYING at step 2", flush=True)
+            os._exit(9)        # abrupt death mid-run
+
+    w = np.asarray(m.weight._value)
+    np.save(os.path.join(os.environ["ELASTIC_DIR"], f"final_{rank}.npy"),
+            w)
+
+    # phase 2: 2-rank sharded distributed checkpoint for the
+    # reshard-on-load topology change (loaded later by a 1-rank process)
+    from paddle_tpu.distributed.checkpoint import save_state_dict
+    save_state_dict({"w": m.weight},
+                    os.path.join(os.environ["ELASTIC_DIR"], "dist_ckpt"))
+    print(f"RANK{rank} DONE", flush=True)
+""")
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _reference_weights():
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import paddle_tpu as paddle
+    from paddle_tpu import nn, optimizer
+
+    paddle.seed(0)
+    m = nn.Linear(8, 8)
+    opt = optimizer.SGD(learning_rate=0.1, parameters=m.parameters())
+    for step in range(6):
+        rng = np.random.RandomState(step)
+        x = paddle.to_tensor(rng.randn(8, 8).astype(np.float32))
+        y = paddle.to_tensor(rng.randn(8, 8).astype(np.float32))
+        loss = paddle.nn.functional.mse_loss(m(x), y)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+    return np.asarray(m.weight._value)
+
+
+def test_elastic_rank_death_resume(tmp_path):
+    worker = tmp_path / "worker.py"
+    worker.write_text(WORKER)
+    log_dir = tmp_path / "logs"
+    port = _free_port()
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["JAX_PLATFORMS"] = "cpu"
+    env["ELASTIC_DIR"] = str(tmp_path)
+    r = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.distributed.launch",
+         "--master", f"127.0.0.1:{port}", "--nnodes", "1",
+         "--nproc_per_node", "2", "--max_restarts", "1",
+         "--log_dir", str(log_dir), str(worker)],
+        env=env, cwd=REPO, capture_output=True, text=True, timeout=600)
+
+    def logs(suffix=""):
+        out = []
+        for i in range(2):
+            p = log_dir / f"workerlog.{i}{suffix}"
+            if p.exists():
+                out.append(p.read_text())
+        return "\n".join(out)
+
+    all_logs = logs() + logs(".restart1")
+    assert r.returncode == 0, \
+        f"rc={r.returncode}\nstdout:{r.stdout}\n{all_logs}"
+    assert "RANK1 DYING" in logs(), logs()
+    assert "RESUMED from step 3" in logs(".restart1"), logs(".restart1")
+    assert "RANK0 DONE" in logs(".restart1")
+
+    # the interrupted-and-resumed run converges to the SAME weights
+    ref = _reference_weights()
+    for rank in range(2):
+        got = np.load(tmp_path / f"final_{rank}.npy")
+        np.testing.assert_allclose(got, ref, rtol=1e-6, atol=1e-7)
+
+    # phase 2: topology change — load the 2-rank checkpoint at world=1
+    from paddle_tpu.distributed.checkpoint import load_state_dict
+    import paddle_tpu as paddle
+    target = {"w": paddle.zeros([8, 8])}
+    load_state_dict(target, str(tmp_path / "dist_ckpt"))
+    np.testing.assert_allclose(np.asarray(target["w"]._value), ref,
+                               rtol=1e-6, atol=1e-7)
