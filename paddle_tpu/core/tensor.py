@@ -45,6 +45,45 @@ def set_trace_ctx(ctx):
 
 _tensor_counter = [0]
 
+# Serializes every "swap tensor._value for traced values, run, restore"
+# region (jit/trace.py, the pipeline engines' pure sections): the trick
+# temporarily puts tracers into LIVE layer objects, so a second thread
+# touching the same layers mid-trace would read tracers.  All swap
+# users must hold this lock for the whole swap-run-restore span.
+value_swap_lock = threading.RLock()
+
+
+import contextlib as _contextlib
+
+
+@_contextlib.contextmanager
+def swapped_values(swap, save_extra=(), save_grad=False):
+    """THE swap-run-restore protocol, shared by every user of the
+    tensor._value substitution trick (to_static tracing, the pipeline
+    engines' pure sections, scan_layer_stack).
+
+    ``swap``: iterable of (tensor, new_value) pairs substituted for the
+    body.  ``save_extra``: additional tensors whose value/grad linkage
+    must survive the body (mutation targets).  ``save_grad``: also
+    snapshot/restore ``.grad``.  Everything happens under
+    ``value_swap_lock`` with no pre-try window, so an exception anywhere
+    restores state and releases the lock."""
+    with value_swap_lock:
+        swap = list(swap)
+        tensors = [t for t, _ in swap] + list(save_extra)
+        saved = [(t, t._value, t._grad_node,
+                  t.grad if save_grad else None) for t in tensors]
+        try:
+            for t, v in swap:
+                t._value = v
+            yield
+        finally:
+            for t, v, gn, gr in saved:
+                t._value = v
+                t._grad_node = gn
+                if save_grad:
+                    t.grad = gr
+
 
 class Tensor:
     __slots__ = (

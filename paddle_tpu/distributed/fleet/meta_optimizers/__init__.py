@@ -20,7 +20,8 @@ import jax.numpy as jnp
 from ....optimizer.optimizer import Optimizer
 
 __all__ = ["GradientMergeOptimizer", "LambOptimizer",
-           "ShardingOptimizer", "apply_meta_optimizers"]
+           "ShardingOptimizer", "DGCOptimizer", "LocalSGDOptimizer",
+           "FP16AllReduceOptimizer", "apply_meta_optimizers"]
 
 
 class _InnerDelegate(Optimizer):
@@ -231,9 +232,236 @@ class ShardingOptimizer(_InnerDelegate):
                                        opt_vals, params)
 
 
+class DGCOptimizer(_InnerDelegate):
+    """strategy.dgc: Deep Gradient Compression (Lin et al.) — top-k
+    gradient sparsification with local residual accumulation.
+
+    Reference parity: `dgc_optimizer.py` + the DGCMomentum op: each
+    worker keeps the (1 - sparsity) small gradient entries in a local
+    residual and contributes only the top-k entries to the allreduce
+    [UNVERIFIED — empty reference mount].  TPU-native: the collective
+    itself is XLA's; the wrapper implements the rank-local semantics —
+    residual accumulate → top-k mask → masked gradient to the inner
+    optimizer — so the communicated tensor is sparse-in-value (zeros
+    compress over ICI and the convergence behavior matches DGC).
+    """
+
+    def __init__(self, inner, rampup_begin_step=0, sparsity=0.999):
+        self.inner = inner
+        self.rampup_begin_step = int(rampup_begin_step)
+        if isinstance(sparsity, (list, tuple)):
+            sparsity = sparsity[-1]
+        self.sparsity = float(sparsity)
+        self._residual = {}
+        self._count = 0
+
+    def __getattr__(self, name):
+        return getattr(self.inner, name)
+
+    def _compress(self, g, residual):
+        u = residual + g.astype(jnp.float32)
+        k = max(1, int(round(u.size * (1.0 - self.sparsity))))
+        flat = jnp.abs(u).reshape(-1)
+        thresh = jax.lax.top_k(flat, k)[0][-1]
+        mask = jnp.abs(u) >= thresh
+        send = jnp.where(mask, u, 0.0)
+        keep = jnp.where(mask, 0.0, u)
+        return send.astype(g.dtype), keep
+
+    # ---- eager engine ----
+    def step(self):
+        params = [p for p in self.inner._parameter_list
+                  if p.grad is not None]
+        if self._count >= self.rampup_begin_step:
+            for p in params:
+                r = self._residual.get(id(p))
+                if r is None:
+                    r = jnp.zeros(p.grad._value.shape, jnp.float32)
+                send, keep = self._compress(p.grad._value, r)
+                p.grad._value = send
+                self._residual[id(p)] = keep
+        self._count += 1
+        self.inner.step()
+
+    def clear_grad(self, set_to_zero=True):
+        self.inner.clear_grad(set_to_zero)
+
+    # ---- static/compiled engines ----
+    def _ensure_static_state(self, params):
+        from ....core.tensor import Tensor
+        inner_state = self.inner._ensure_static_state(params)
+        residual = [Tensor(jnp.zeros(p._value.shape, jnp.float32),
+                           _internal=True, stop_gradient=True)
+                    for p in params]
+        return residual + list(inner_state)
+
+    def _static_update(self, param_vals, grads, opt_vals, params,
+                       lr=None, step=None):
+        import numpy as np
+        if lr is None:
+            lr = self.inner._lr_tensor._value
+        if step is None:
+            step = self.inner._step_count._value
+            self.inner._step_count._inplace_update(np.asarray(step) + 1)
+        return self._pure_update(lr, step, param_vals, grads, opt_vals,
+                                 params)
+
+    def _pure_update(self, lr, step, param_vals, grads, opt_vals, params):
+        n = len(param_vals)
+        residual = opt_vals[:n]
+        inner_state = tuple(opt_vals[n:])
+        sends, keeps = [], []
+        for g, r in zip(grads, residual):
+            ramped = step >= self.rampup_begin_step
+            send, keep = self._compress(g, r)
+            sends.append(jnp.where(ramped, send, g))
+            keeps.append(jnp.where(ramped, keep, r))
+        # the inner optimizer's grad_clip applies to the SPARSIFIED grad
+        # (parity with the eager path, where inner.step() clips)
+        sends = self.inner._clip_static_grads(tuple(sends))
+        new_p, new_inner = self.inner._pure_update(
+            lr, step, param_vals, tuple(sends), inner_state, params)
+        return tuple(new_p), tuple(keeps) + tuple(new_inner)
+
+
+class LocalSGDOptimizer(_InnerDelegate):
+    """strategy.localsgd: step locally, average parameters across the
+    data-parallel group every k_steps.
+
+    Reference parity: `localsgd_optimizer.py` inserts the periodic
+    c_allreduce(param)/scale program rewrite [UNVERIFIED].  TPU-native:
+    under the single-program SPMD engines parameters are replicated and
+    gradients are already globally averaged, so the sync is an identity
+    — the wrapper's substance is the MULTI-CONTROLLER eager path, where
+    each process trains its own replica and `paddle.distributed.
+    all_reduce` averages the weights every k-th step (comm every k
+    steps instead of every step — localsgd's point).
+    """
+
+    def __init__(self, inner, k_steps=1, begin_step=1):
+        self.inner = inner
+        self.k_steps = max(1, int(k_steps))
+        self.begin_step = int(begin_step)
+        self._count = 0
+
+    def __getattr__(self, name):
+        return getattr(self.inner, name)
+
+    def step(self):
+        self.inner.step()
+        self._count += 1
+        if (self._count >= self.begin_step
+                and self._count % self.k_steps == 0):
+            self._sync_params()
+
+    def _sync_params(self):
+        import jax as _jax
+        if _jax.process_count() <= 1:
+            return  # replicated single-controller: averaging is identity
+        # multi-controller: each process holds its own replica — average
+        # with a REAL cross-process psum (a host-local eager all_reduce
+        # would be an identity no-op, silently skipping the sync)
+        import numpy as np
+        import jax.numpy as jnp
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+        mesh = Mesh(np.array(_jax.devices()), ("lsgd",))
+        nd = _jax.device_count()
+        nl = _jax.local_device_count()
+        avg = _jax.jit(_jax.shard_map(
+            lambda x: jax.lax.pmean(x, "lsgd"), mesh=mesh,
+            in_specs=P("lsgd"), out_specs=P("lsgd"), check_vma=False))
+        for p in self.inner._parameter_list:
+            local = np.broadcast_to(
+                np.asarray(p._value)[None],
+                (nl,) + tuple(p._value.shape))
+            arr = _jax.make_array_from_process_local_data(
+                NamedSharding(mesh, P("lsgd")), local,
+                (nd,) + tuple(p._value.shape))
+            out = avg(arr)
+            host = _jax.device_get(
+                list(out.addressable_shards)[0].data)[0]
+            p._value = jnp.asarray(host, p._value.dtype)
+
+    def clear_grad(self, set_to_zero=True):
+        self.inner.clear_grad(set_to_zero)
+
+    # compiled engines: params replicated + grads globally averaged →
+    # the periodic average is an identity; delegate untouched
+    def _ensure_static_state(self, params):
+        return self.inner._ensure_static_state(params)
+
+    def _static_update(self, param_vals, grads, opt_vals, params,
+                       lr=None, step=None):
+        return self.inner._static_update(param_vals, grads, opt_vals,
+                                         params, lr=lr, step=step)
+
+    def _pure_update(self, lr, step, param_vals, grads, opt_vals, params):
+        return self.inner._pure_update(lr, step, param_vals, grads,
+                                       opt_vals, params)
+
+
+class FP16AllReduceOptimizer(_InnerDelegate):
+    """strategy.fp16_allreduce: halve gradient-communication volume by
+    reducing in half precision.
+
+    Reference parity: `fp16_allreduce_optimizer.py` casts grads to fp16
+    around the c_allreduce [UNVERIFIED].  TPU-native: the collective is
+    XLA-inserted at the gradient's dtype, so communicating in half
+    precision = rounding the gradient through fp16 (bf16 on TPU keeps
+    the fp32 exponent range — the default here) before the update; XLA
+    then moves half-width words over ICI.
+    """
+
+    def __init__(self, inner, dtype="bfloat16"):
+        self.inner = inner
+        self._comm_dtype = jnp.float16 if str(dtype) == "float16" \
+            else jnp.bfloat16
+
+    def __getattr__(self, name):
+        return getattr(self.inner, name)
+
+    def _round(self, g):
+        if g.dtype in (jnp.float16, jnp.bfloat16):
+            return g
+        return g.astype(self._comm_dtype).astype(g.dtype)
+
+    def step(self):
+        for p in self.inner._parameter_list:
+            if p.grad is not None:
+                p.grad._value = self._round(p.grad._value)
+        self.inner.step()
+
+    def clear_grad(self, set_to_zero=True):
+        self.inner.clear_grad(set_to_zero)
+
+    def _ensure_static_state(self, params):
+        return self.inner._ensure_static_state(params)
+
+    def _static_update(self, param_vals, grads, opt_vals, params,
+                       lr=None, step=None):
+        grads = tuple(self._round(g) for g in grads)
+        return self.inner._static_update(param_vals, grads, opt_vals,
+                                         params, lr=lr, step=step)
+
+    def _pure_update(self, lr, step, param_vals, grads, opt_vals, params):
+        grads = tuple(self._round(g) for g in grads)
+        return self.inner._pure_update(lr, step, param_vals, grads,
+                                       opt_vals, params)
+
+
+# strategy flags that are execution-mode switches handled elsewhere in
+# this framework (hybrid engines, amp module, recompute wrapper, ...)
+_HANDLED_ELSEWHERE = {
+    "amp", "recompute", "pipeline", "hybrid_configs", "heter_ccl_mode",
+    "find_unused_parameters", "fuse_all_reduce_ops",
+    "gradient_scale_configs", "tensor_parallel", "without_graph_optimization",
+}
+
+
 def apply_meta_optimizers(optimizer, strategy):
     """Wrap `optimizer` per the DistributedStrategy flags (the
-    reference's meta-optimizer selection in fleet.distributed_optimizer)."""
+    reference's meta-optimizer selection in fleet.distributed_optimizer).
+    Unknown set flags WARN instead of silently doing nothing."""
     if strategy is None:
         return optimizer
     if getattr(strategy, "lamb", False):
@@ -243,6 +471,19 @@ def apply_meta_optimizers(optimizer, strategy):
             lamb_weight_decay=cfg.get("lamb_weight_decay", 0.01),
             exclude_from_weight_decay=cfg.get(
                 "exclude_from_weight_decay", ()))
+    if getattr(strategy, "dgc", False):
+        cfg = getattr(strategy, "dgc_configs", {}) or {}
+        optimizer = DGCOptimizer(
+            optimizer,
+            rampup_begin_step=cfg.get("rampup_begin_step", 0),
+            sparsity=cfg.get("sparsity", [0.999]))
+    if getattr(strategy, "fp16_allreduce", False):
+        optimizer = FP16AllReduceOptimizer(optimizer)
+    if getattr(strategy, "localsgd", False):
+        cfg = getattr(strategy, "localsgd_configs", {}) or {}
+        optimizer = LocalSGDOptimizer(
+            optimizer, k_steps=cfg.get("k_steps", 1),
+            begin_step=cfg.get("begin_step", 1))
     if getattr(strategy, "gradient_merge", False):
         cfg = getattr(strategy, "gradient_merge_configs", {})
         optimizer = GradientMergeOptimizer(
@@ -250,4 +491,17 @@ def apply_meta_optimizers(optimizer, strategy):
             avg=cfg.get("avg", True))
     if getattr(strategy, "sharding", False):
         optimizer = ShardingOptimizer(optimizer)
+
+    handled = {"lamb", "dgc", "fp16_allreduce", "localsgd",
+               "gradient_merge", "sharding"}
+    import logging
+    for flag in sorted(vars(strategy)):
+        if flag.startswith("_") or flag.endswith("_configs"):
+            continue
+        if flag in handled or flag in _HANDLED_ELSEWHERE:
+            continue
+        if getattr(strategy, flag, None) is True:
+            logging.getLogger("paddle_tpu.fleet").warning(
+                "DistributedStrategy.%s is set but has no "
+                "meta-optimizer in this framework; ignored", flag)
     return optimizer

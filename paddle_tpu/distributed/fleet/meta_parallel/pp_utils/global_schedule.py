@@ -136,22 +136,14 @@ class _PureSection:
 
     def __call__(self, param_vals, x_val):
         from .....core.autograd import no_grad
-        saved = [(t, t._value, t._grad_node) for t in self.params]
-        saved_buf = [(b, b._value) for b in self.buffers]
-        try:
-            for t, v in zip(self.params, param_vals):
-                t._value = v
+        from .....core.tensor import swapped_values
+        with swapped_values(zip(self.params, param_vals),
+                            save_extra=self.buffers):
             with no_grad():
                 x = Tensor(x_val, _internal=True, stop_gradient=True)
                 for fn, fwd in self.entries:
                     x = fwd(fn, x) if fwd is not None else fn(x)
             return x._value
-        finally:
-            for t, v, gn in saved:
-                t._value = v
-                t._grad_node = gn
-            for b, v in saved_buf:
-                b._value = v
 
 
 # Layer-level sharding constraints (RowParallelLinear's "replicate the

@@ -94,23 +94,14 @@ class _FunctionalSegment:
 
     def __call__(self, param_vals, x_val):
         from .....core.autograd import no_grad
-        tensors = self.params
-        saved = [(t, t._value, t._grad_node) for t in tensors]
-        saved_buf = [(b, b._value) for b in self.buffers]
-        try:
-            for t, v in zip(tensors, param_vals):
-                t._value = v
+        from .....core.tensor import swapped_values
+        with swapped_values(zip(self.params, param_vals),
+                            save_extra=self.buffers):
             with no_grad():  # jax.grad differentiates; skip the tape
                 x = Tensor(x_val, _internal=True, stop_gradient=True)
                 for fn, fwd in self.segment:
                     x = fwd(fn, x) if fwd is not None else fn(x)
             return x._value
-        finally:
-            for t, v, gn in saved:
-                t._value = v
-                t._grad_node = gn
-            for b, v in saved_buf:
-                b._value = v
 
 
 class SpmdPipelineEngine:

@@ -218,46 +218,47 @@ class TracedFunction:
 
         meta = {}
 
+        state_ids = {id(t) for t in state}
+
         def pure_fn(tensor_arg_vals, ro_vals, rw_vals):
+            from ..core.tensor import swapped_values
             state_vals = tuple(ro_vals) + tuple(rw_vals)
-            saved = [(t, t._value, t._grad_node, t.grad)
-                     for t in touched.values()]
             sub = {id(t): v for t, v in zip(state, state_vals)}
             rctx = _ReplayCtx(sub)
-            set_trace_ctx(rctx)
-            try:
-                new_args, new_kwargs = _bind_args(args, kwargs,
-                                                  tensor_arg_vals)
-                for t, v in zip(state, state_vals):
-                    t._value = v
-                for t in grad_slots:
-                    t.grad = None  # reproduce discovery initial conditions
-                result = fn(*new_args, **new_kwargs)
-                if rctx.missing:
-                    raise _RetraceNeeded(rctx.missing)
-                out_leaves, out_treedef = jax.tree.flatten(
-                    result, is_leaf=_is_tensor_leaf)
-                out_vals = tuple(
-                    l._value if isinstance(l, Tensor) else l
-                    for l in out_leaves)
-                mut_vals = tuple(t._value for t in mutated)
-                grad_vals = tuple(
-                    t.grad._value if t.grad is not None
-                    else jnp.zeros_like(t._value) for t in grad_slots)
-                meta["out_treedef"] = out_treedef
-                meta["out_is_tensor"] = [isinstance(l, Tensor)
-                                         for l in out_leaves]
-                meta["has_grad"] = [t.grad is not None for t in grad_slots]
-                return out_vals, mut_vals, grad_vals
-            finally:
-                set_trace_ctx(None)
-                for t, ov, on in rctx.write_snapshot.values():
-                    t._value = ov
-                    t._grad_node = on
-                for t, v, gn, gr in saved:
-                    t._value = v
-                    t._grad_node = gn
-                    t.grad = gr
+            extra = [t for t in touched.values()
+                     if id(t) not in state_ids]
+            with swapped_values(zip(state, state_vals),
+                                save_extra=extra, save_grad=True):
+                set_trace_ctx(rctx)
+                try:
+                    new_args, new_kwargs = _bind_args(args, kwargs,
+                                                      tensor_arg_vals)
+                    for t in grad_slots:
+                        t.grad = None  # discovery initial conditions
+                    result = fn(*new_args, **new_kwargs)
+                    if rctx.missing:
+                        raise _RetraceNeeded(rctx.missing)
+                    out_leaves, out_treedef = jax.tree.flatten(
+                        result, is_leaf=_is_tensor_leaf)
+                    out_vals = tuple(
+                        l._value if isinstance(l, Tensor) else l
+                        for l in out_leaves)
+                    mut_vals = tuple(t._value for t in mutated)
+                    grad_vals = tuple(
+                        t.grad._value if t.grad is not None
+                        else jnp.zeros_like(t._value)
+                        for t in grad_slots)
+                    meta["out_treedef"] = out_treedef
+                    meta["out_is_tensor"] = [isinstance(l, Tensor)
+                                             for l in out_leaves]
+                    meta["has_grad"] = [t.grad is not None
+                                        for t in grad_slots]
+                    return out_vals, mut_vals, grad_vals
+                finally:
+                    set_trace_ctx(None)
+                    for t, ov, on in rctx.write_snapshot.values():
+                        t._value = ov
+                        t._grad_node = on
 
         from ..framework.flags import get_flags
         jit_kwargs = dict(self._jit_kwargs)

@@ -22,6 +22,9 @@ class BertConfig:
     max_position_embeddings: int = 512
     type_vocab_size: int = 2
     layer_norm_eps: float = 1e-12
+    # lax.scan over stacked layer weights: compile time O(1) in depth
+    # (nn/layer/scanned.py); numerics identical to the unrolled loop
+    use_scan_layers: bool = False
 
 
 class BertEmbeddings(nn.Layer):
@@ -87,6 +90,9 @@ class BertModel(nn.Layer):
 
     def forward(self, input_ids, token_type_ids=None, attn_mask=None):
         x = self.embeddings(input_ids, token_type_ids)
+        if self.config.use_scan_layers and attn_mask is None:
+            from ..nn.layer.scanned import scan_layer_stack
+            return scan_layer_stack(self.encoder, x)
         for layer in self.encoder:
             x = layer(x, attn_mask)
         return x

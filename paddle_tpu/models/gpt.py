@@ -28,6 +28,9 @@ class GPTConfig:
     use_flash_attention: bool = True
     use_recompute: bool = False
     tie_word_embeddings: bool = True
+    # lax.scan over stacked block weights (nn/layer/scanned.py):
+    # compile time O(1) in depth; only the no-cache training path
+    use_scan_layers: bool = False
 
     def __post_init__(self):
         if not self.intermediate_size:
@@ -111,6 +114,12 @@ class GPTModel(nn.Layer):
         past = 0 if cache is None else cache[0][0].shape[1]
         pos = paddle.arange(past, past + s, dtype="int64")
         x = self.wte(input_ids) + self.wpe(pos)
+        if (self.config.use_scan_layers and cache is None
+                and not use_cache):
+            from ..nn.layer.scanned import scan_layer_stack
+            x = scan_layer_stack(self.h, x,
+                                 remat=self._recompute)
+            return self.ln_f(x)
         new_caches = []
         for i, blk in enumerate(self.h):
             layer_cache = None if cache is None else cache[i]

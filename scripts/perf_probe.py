@@ -55,7 +55,8 @@ def raw_matmul():
         f"(peak 197)")
 
 
-def bert_step(use_pallas=True, fwd_only=False, profile=False):
+def bert_step(use_pallas=True, fwd_only=False, profile=False,
+              scan_layers=False):
     import numpy as np
     import paddle_tpu as paddle
     from paddle_tpu import optimizer, static
@@ -72,7 +73,8 @@ def bert_step(use_pallas=True, fwd_only=False, profile=False):
     with static.program_guard(main, startup):
         ids = static.data("ids", [B, S], "int64")
         labels = static.data("labels", [B, S], "int64")
-        model = BertForMaskedLM(BertConfig())
+        model = BertForMaskedLM(BertConfig(
+            use_scan_layers=scan_layers))
         with paddle.amp.auto_cast(dtype="bfloat16", level="O1"):
             loss, _ = model(ids, labels=labels)
         if not fwd_only:
@@ -165,6 +167,10 @@ def main():
     log("bert train pallas=False:")
     t_x = bert_step(use_pallas=False)
     log(f"pallas speedup: {t_x / t_p:.2f}x")
+    log("bert train scan-over-layers:")
+    t_s = bert_step(use_pallas=True, scan_layers=True)
+    log(f"scan vs unrolled: {t_p / t_s:.2f}x step "
+        f"(compile-time win is logged above per config)")
     log("bert train under PADDLE_TPU_X32=1 (s64-free device program):")
     t_32 = bert_x32_subprocess()
     if t_32:

@@ -211,3 +211,186 @@ def test_sharding_meta_optimizer_places_state():
         sharded.clear_grad()
     finally:
         dist.env.set_global_mesh(None)
+
+
+def test_dgc_sparsifies_and_accumulates_residual():
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu import nn, optimizer
+    from paddle_tpu.distributed.fleet.meta_optimizers import DGCOptimizer
+
+    paddle.seed(0)
+    m = nn.Linear(16, 16)
+    inner = optimizer.Momentum(learning_rate=0.1, momentum=0.9,
+                               parameters=m.parameters())
+    opt = DGCOptimizer(inner, rampup_begin_step=0, sparsity=0.9)
+    x = paddle.to_tensor(
+        np.random.RandomState(0).randn(8, 16).astype(np.float32))
+    losses = []
+    for _ in range(12):
+        loss = (m(x) ** 2).mean()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(loss))
+    # converges despite 90% sparsification (residual feedback works)
+    assert losses[-1] < losses[0] * 0.5, losses
+    # residual buffers carry the suppressed mass
+    assert any(float(abs(np.asarray(r)).sum()) > 0
+               for r in opt._residual.values())
+
+
+def test_dgc_static_pure_update_parity_shape():
+    import numpy as np
+    import jax.numpy as jnp
+    import paddle_tpu as paddle
+    from paddle_tpu import nn, optimizer, static
+    from paddle_tpu.distributed.fleet.meta_optimizers import DGCOptimizer
+
+    paddle.seed(0)
+    paddle.enable_static()
+    try:
+        main = static.Program()
+        startup = static.Program()
+        with static.program_guard(main, startup):
+            xv = static.data("x", [4, 8], "float32")
+            m = nn.Linear(8, 8)
+            loss = (m(xv) ** 2).mean()
+            inner = optimizer.SGD(learning_rate=0.1,
+                                  parameters=m.parameters())
+            opt = DGCOptimizer(inner, sparsity=0.5)
+            opt.minimize(loss)
+        exe = static.Executor()
+        x = np.random.RandomState(0).randn(4, 8).astype(np.float32)
+        l0 = float(exe.run(main, feed={"x": x}, fetch_list=[loss])[0])
+        for _ in range(10):
+            lv = float(exe.run(main, feed={"x": x},
+                               fetch_list=[loss])[0])
+        assert lv < l0, (l0, lv)
+    finally:
+        paddle.disable_static()
+
+
+def test_fp16_allreduce_rounds_grads():
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu import nn, optimizer
+    from paddle_tpu.distributed.fleet.meta_optimizers import \
+        FP16AllReduceOptimizer
+
+    paddle.seed(0)
+    m = nn.Linear(8, 8)
+    inner = optimizer.SGD(learning_rate=0.1,
+                          parameters=m.parameters())
+    opt = FP16AllReduceOptimizer(inner)
+    x = paddle.to_tensor(
+        np.random.RandomState(0).randn(4, 8).astype(np.float32))
+    losses = []
+    for _ in range(8):
+        loss = (m(x) ** 2).mean()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(loss))
+    assert losses[-1] < losses[0]
+
+
+def test_localsgd_single_controller_noop_sync():
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu import nn, optimizer
+    from paddle_tpu.distributed.fleet.meta_optimizers import \
+        LocalSGDOptimizer
+
+    paddle.seed(0)
+    m = nn.Linear(8, 8)
+    inner = optimizer.SGD(learning_rate=0.1,
+                          parameters=m.parameters())
+    opt = LocalSGDOptimizer(inner, k_steps=2)
+    x = paddle.to_tensor(
+        np.random.RandomState(0).randn(4, 8).astype(np.float32))
+    losses = []
+    for _ in range(6):
+        loss = (m(x) ** 2).mean()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(loss))
+    assert losses[-1] < losses[0]
+
+
+def test_unknown_strategy_flag_warns(caplog):
+    import logging
+    import paddle_tpu as paddle
+    from paddle_tpu import nn, optimizer
+    from paddle_tpu.distributed import fleet
+    from paddle_tpu.distributed.fleet.meta_optimizers import \
+        apply_meta_optimizers
+
+    paddle.seed(0)
+    m = nn.Linear(4, 4)
+    opt = optimizer.SGD(learning_rate=0.1, parameters=m.parameters())
+    strategy = fleet.DistributedStrategy()
+    strategy.made_up_flag = True
+    with caplog.at_level(logging.WARNING, logger="paddle_tpu.fleet"):
+        apply_meta_optimizers(opt, strategy)
+    assert any("made_up_flag" in r.message for r in caplog.records)
+
+
+def test_localsgd_multiprocess_sync(tmp_path):
+    """2-process pod: replicas diverge locally, LocalSGD's k-th step
+    averages them with a REAL cross-process pmean (r4 review: the
+    eager all_reduce fallback was silently an identity)."""
+    import socket
+    worker = tmp_path / "worker.py"
+    worker.write_text("""
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+import paddle_tpu as paddle
+import paddle_tpu.distributed as dist
+from paddle_tpu import nn, optimizer
+from paddle_tpu.distributed.fleet.meta_optimizers import LocalSGDOptimizer
+
+dist.init_parallel_env()
+rank = dist.get_rank()
+
+paddle.seed(0)
+m = nn.Linear(4, 4)
+# diverge the replicas deliberately
+m.weight.set_value(paddle.full([4, 4], float(rank + 1)))
+inner = optimizer.SGD(learning_rate=0.0, parameters=m.parameters())
+opt = LocalSGDOptimizer(inner, k_steps=2)
+
+x = paddle.to_tensor(np.ones((2, 4), np.float32))
+for step in range(2):
+    loss = (m(x) ** 2).mean()
+    loss.backward()
+    opt.step()          # lr=0: only the sync changes weights
+    opt.clear_grad()
+
+w = np.asarray(m.weight._value)
+# average of 1.0 and 2.0 replicas
+assert np.allclose(w, 1.5), w
+print(f"RANK{rank} LOCALSGD_SYNC_OK")
+""")
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    env = dict(os.environ)
+    REPO_ = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env["PYTHONPATH"] = REPO_ + os.pathsep + env.get("PYTHONPATH", "")
+    env["JAX_PLATFORMS"] = "cpu"
+    log_dir = tmp_path / "logs"
+    r = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.distributed.launch",
+         "--master", f"127.0.0.1:{port}", "--nnodes", "1",
+         "--nproc_per_node", "2", "--log_dir", str(log_dir),
+         str(worker)],
+        env=env, cwd=REPO_, capture_output=True, text=True, timeout=300)
+    logs = "\n".join((log_dir / f"workerlog.{i}").read_text()
+                     for i in range(2))
+    assert r.returncode == 0, f"rc={r.returncode}\n{logs}"
+    assert "RANK0 LOCALSGD_SYNC_OK" in logs
+    assert "RANK1 LOCALSGD_SYNC_OK" in logs

@@ -235,3 +235,55 @@ def test_cache_participates_without_use_cache():
     _, cache = m(paddle.to_tensor(ids.numpy()[:, :5]), use_cache=True)
     last = m(paddle.to_tensor(ids.numpy()[:, 5:]), cache=cache).numpy()
     np.testing.assert_allclose(last[:, -1], full, atol=2e-5, rtol=2e-5)
+
+
+def test_bert_scan_layers_parity():
+    """scan-over-layers trunk (nn/layer/scanned.py) matches the
+    unrolled encoder exactly — same weights, same loss, same grads."""
+    import numpy as np
+    from paddle_tpu.models import BertConfig, BertForMaskedLM
+
+    def run(scan):
+        paddle.seed(0)
+        cfg = BertConfig(vocab_size=128, hidden_size=32,
+                         num_hidden_layers=4, num_attention_heads=2,
+                         intermediate_size=64, max_position_embeddings=32,
+                         use_scan_layers=scan)
+        m = BertForMaskedLM(cfg)
+        ids = paddle.to_tensor(np.random.RandomState(0)
+                               .randint(0, 128, (2, 16)).astype(np.int64))
+        loss, _ = m(ids, labels=ids)
+        loss.backward()
+        g = m.bert.encoder[2].fc1.weight.grad.numpy()
+        return float(loss), g
+
+    l_u, g_u = run(False)
+    l_s, g_s = run(True)
+    assert abs(l_u - l_s) < 1e-4, (l_u, l_s)
+    np.testing.assert_allclose(g_s, g_u, rtol=1e-4, atol=1e-6)
+
+
+def test_gpt_scan_layers_parity():
+    import numpy as np
+    from paddle_tpu.models import (GPTConfig, GPTForCausalLM,
+                                   GPTPretrainingCriterion)
+
+    def run(scan):
+        paddle.seed(1)
+        cfg = GPTConfig(vocab_size=128, hidden_size=32,
+                        num_hidden_layers=4, num_attention_heads=2,
+                        max_position_embeddings=32,
+                        use_flash_attention=False,
+                        use_scan_layers=scan)
+        m = GPTForCausalLM(cfg)
+        crit = GPTPretrainingCriterion()
+        ids = paddle.to_tensor(np.random.RandomState(1)
+                               .randint(0, 128, (2, 16)).astype(np.int64))
+        loss = crit(m(ids), ids)
+        loss.backward()
+        return float(loss), m.gpt.h[1].mlp.fc1.weight.grad.numpy()
+
+    l_u, g_u = run(False)
+    l_s, g_s = run(True)
+    assert abs(l_u - l_s) < 1e-4, (l_u, l_s)
+    np.testing.assert_allclose(g_s, g_u, rtol=1e-4, atol=1e-6)
