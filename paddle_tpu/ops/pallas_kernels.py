@@ -361,7 +361,68 @@ def _flash_bwd(q, k, v, do, out, lse, scale, causal, sq_real, sk_real,
     return dq, dk, dv
 
 
-def _pick_block(seq: int) -> int:
+_autotune_table = None
+
+
+def autotune_cache_path():
+    import os
+    return os.path.join(os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__)))),
+        ".bench_cache", "flash_blocks.json")
+
+
+def _load_autotune():
+    """Flash block-size autotune cache (the reference's
+    phi/kernels/autotune role): scripts/flash_block_sweep.py measures
+    the (block_q, block_k) grid on the real chip in a healthy window and
+    persists the winners; runtime consults them by sequence length.
+    TPU only — interpret-mode tests must not change tiling based on a
+    local tuning file."""
+    global _autotune_table
+    if _autotune_table is None:
+        if _interpret():
+            _autotune_table = {}
+            return _autotune_table
+        import json
+        try:
+            _autotune_table = {
+                int(k): (int(v[0]), int(v[1]))
+                for k, v in json.load(
+                    open(autotune_cache_path())).items()}
+        except Exception:
+            _autotune_table = {}
+    return _autotune_table
+
+
+def set_flash_block_sizes(block_q=None, block_k=None):
+    """Process-wide override for the sweep harness."""
+    global _block_override
+    _block_override = (block_q, block_k)
+
+
+_block_override = (None, None)
+
+
+def _sane_block(b, seq):
+    """Clamp any requested block to a legal bf16 tiling for `seq`."""
+    try:
+        b = int(b)
+    except (TypeError, ValueError):
+        return None
+    if b < 16 or b % 16:
+        return None
+    return min(b, _round_up(max(seq, 16), 16))
+
+
+def _pick_block(seq: int, which: int = 0) -> int:
+    ov = _sane_block(_block_override[which], seq)
+    if ov:
+        return ov
+    tuned = _load_autotune().get(seq)
+    if tuned:
+        t = _sane_block(tuned[which], seq)
+        if t:
+            return t
     # 16-row minimum keeps bf16 blocks on whole (16, 128) tiles
     return 128 if seq >= 128 else _round_up(max(seq, 16), 16)
 
@@ -375,8 +436,8 @@ def _flash_attention_bhsd(q, k, v, scale, causal):
 def _flash_attention_bhsd_fwd(q, k, v, scale, causal):
     bh, sq, d = q.shape
     sk = k.shape[1]
-    block_q = _pick_block(sq)
-    block_k = _pick_block(sk)
+    block_q = _pick_block(sq, 0)
+    block_k = _pick_block(sk, 1)
     qp = _pad_dim(q, 1, _round_up(sq, block_q))
     kp = _pad_dim(k, 1, _round_up(sk, block_k))
     vp = _pad_dim(v, 1, _round_up(sk, block_k))
@@ -389,8 +450,8 @@ def _flash_attention_bhsd_bwd(scale, causal, res, g):
     q, k, v, out_pad, lse = res
     bh, sq, d = q.shape
     sk = k.shape[1]
-    block_q = _pick_block(sq)
-    block_k = _pick_block(sk)
+    block_q = _pick_block(sq, 0)
+    block_k = _pick_block(sk, 1)
     qp = _pad_dim(q, 1, _round_up(sq, block_q))
     kp = _pad_dim(k, 1, _round_up(sk, block_k))
     vp = _pad_dim(v, 1, _round_up(sk, block_k))

@@ -6,8 +6,9 @@ arrive so a mid-run wedge still yields data):
   2. BERT-base fwd-only / fwd+bwd+AdamW step time via the static
      Executor at the bench config,
   3. the same with Pallas kernels disabled (XLA composite path),
-  4. per-op-class timing from 3 repeated steps under jax.profiler
-     (trace written to /tmp/paddle_tpu_profile for offline reading).
+  4. per-op-class timing from repeated steps under jax.profiler
+     (trace written to artifacts/tpu_profile; COMMIT it after capture —
+     VERDICT r3 item 2 wants the trace in the repo).
 
 Usage: PYTHONPATH=/root/repo:/root/.axon_site python -u \
            scripts/perf_probe.py > /tmp/perf_probe.log 2>&1
@@ -91,7 +92,10 @@ def bert_step(use_pallas=True, fwd_only=False, profile=False,
     iters = 10
     if profile:
         import jax
-        jax.profiler.start_trace("/tmp/paddle_tpu_profile")
+        prof_dir = os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "artifacts", "tpu_profile")
+        os.makedirs(prof_dir, exist_ok=True)
+        jax.profiler.start_trace(prof_dir)
     t = time.time()
     for _ in range(iters):
         (lv,) = exe.run(main, feed=feed, fetch_list=[loss])
@@ -175,7 +179,8 @@ def main():
     t_32 = bert_x32_subprocess()
     if t_32:
         log(f"x32 speedup vs x64: {t_p / t_32:.2f}x")
-    log("profiled 3 steps -> /tmp/paddle_tpu_profile")
+    log("profiled steps -> artifacts/tpu_profile (git add + commit "
+        "after capture)")
     bert_step(use_pallas=True, profile=True)
     log("DONE")
 
