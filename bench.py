@@ -396,6 +396,75 @@ print("LLAMA_DRYRUN_OK")
 """
 
 
+def bench_llama(on_tpu, peak):
+    """Config #5's single-chip perf variant: LLaMA architecture (RMSNorm
+    + SwiGLU + RoPE + GQA) shrunk to fit one chip with AdamW state;
+    sharding-stage2 + TP correctness is the llama_dryrun config."""
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu import optimizer, static
+    from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+
+    if on_tpu:
+        cfg = LlamaConfig(vocab_size=32000, hidden_size=1024,
+                          num_hidden_layers=16, num_attention_heads=16,
+                          num_key_value_heads=8, intermediate_size=2816,
+                          max_position_embeddings=1024,
+                          use_recompute=True)
+        B, S, n_iters = 8, 1024, 10
+    else:
+        cfg = LlamaConfig(vocab_size=256, hidden_size=64,
+                          num_hidden_layers=2, num_attention_heads=4,
+                          num_key_value_heads=2, intermediate_size=128,
+                          max_position_embeddings=64)
+        B, S, n_iters = 2, 32, 2
+
+    paddle.enable_static()
+    try:
+        main_prog = static.Program()
+        startup = static.Program()
+        with static.program_guard(main_prog, startup):
+            ids = static.data("ids", [B, S], "int64")
+            labels = static.data("labels", [B, S], "int64")
+            model = LlamaForCausalLM(cfg)
+            with paddle.amp.auto_cast(dtype="bfloat16", level="O1"):
+                logits = model(ids)
+                v = logits.shape[-1]
+                import paddle_tpu.nn.functional as F
+                loss = F.cross_entropy(
+                    paddle.reshape(logits[:, :-1, :], [-1, v]),
+                    paddle.reshape(labels[:, 1:], [-1]))
+            opt = optimizer.AdamW(learning_rate=1e-4,
+                                  parameters=model.parameters())
+            opt.minimize(loss)
+        n_params = sum(int(np.prod(p.shape)) for p in model.parameters())
+        log(f"llama: {n_params/1e6:.0f}M params, B={B} S={S}")
+        exe = static.Executor()
+        rng = np.random.default_rng(0)
+        x = rng.integers(0, cfg.vocab_size, (B, S)).astype(np.int64)
+        fd = {"ids": x, "labels": x}
+        t = time.time()
+        (l0,) = exe.run(main_prog, feed=fd, fetch_list=[loss])
+        log(f"llama: compile+first step {time.time()-t:.1f}s "
+            f"loss={float(l0):.3f}")
+        t = time.time()
+        for _ in range(n_iters):
+            (lv,) = exe.run(main_prog, feed=fd, fetch_list=[loss])
+        dt = (time.time() - t) / n_iters
+        tokens_per_sec = B * S / dt
+        flops_per_token = 6 * n_params + 12 * cfg.num_hidden_layers \
+            * S * cfg.hidden_size
+        mfu = flops_per_token * tokens_per_sec / peak if peak else 0.0
+        log(f"llama: step {dt*1e3:.1f} ms {tokens_per_sec:,.0f} tok/s "
+            f"MFU={mfu:.3f}")
+        return {"tokens_per_sec": round(tokens_per_sec, 1),
+                "step_ms": round(dt * 1e3, 2), "mfu": round(mfu, 4),
+                "n_params_m": round(n_params / 1e6),
+                "hbm_peak_gb": _hbm_peak_gb()}
+    finally:
+        paddle.disable_static()
+
+
 def bench_llama_dryrun():
     t = time.time()
     p = subprocess.run(
@@ -482,6 +551,7 @@ def main():
         "lenet": lambda: bench_lenet(on_tpu),
         "resnet50": lambda: bench_resnet50(on_tpu),
         "gpt": lambda: bench_gpt(on_tpu, peak),
+        "llama": lambda: bench_llama(on_tpu, peak),
         "llama_dryrun": bench_llama_dryrun,
     }
     errors = {}
@@ -520,6 +590,11 @@ def main():
                 "gpt_0p35b_flash_recompute_bf16_tokens_per_sec"] = \
                 res["tokens_per_sec"]
             payload["extra_metrics"]["gpt_mfu"] = res["mfu"]
+        elif name == "llama":
+            payload["extra_metrics"][
+                "llama_0p3b_recompute_bf16_tokens_per_sec"] = \
+                res["tokens_per_sec"]
+            payload["extra_metrics"]["llama_mfu"] = res["mfu"]
         elif name == "llama_dryrun":
             payload["extra_metrics"][
                 "llama_sharding2_tp_dryrun_ok"] = res["ok"]

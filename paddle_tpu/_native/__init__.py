@@ -29,29 +29,40 @@ _store_lib = None
 _store_tried = False
 
 
-def _build_and_load():
-    src = os.path.join(os.path.dirname(__file__), "collate.c")
+
+def _compile_native(src_name, so_name, compilers, flags):
+    """Shared compile-with-mtime-cache-then-load step for every native
+    component (collate, tcp_store, shm_ring)."""
+    src = os.path.join(os.path.dirname(__file__), src_name)
     cache = os.path.join(
         os.path.expanduser(os.environ.get("PADDLE_TPU_CACHE",
                                           "~/.cache/paddle_tpu")),
         "native")
     os.makedirs(cache, exist_ok=True)
-    so = os.path.join(cache, "libptnative.so")
+    so = os.path.join(cache, so_name)
     if not os.path.exists(so) or (os.path.getmtime(so)
                                   < os.path.getmtime(src)):
         tmp = f"{so}.{os.getpid()}.tmp"  # per-pid: N ranks may race here
-        for cc in ("cc", "gcc", "clang"):
+        for cc in compilers:
             try:
                 subprocess.run(
-                    [cc, "-O3", "-shared", "-fPIC", "-o", tmp, src],
-                    check=True, capture_output=True, timeout=120)
+                    [cc, *flags, "-o", tmp, src],
+                    check=True, capture_output=True, timeout=180)
                 os.replace(tmp, so)
                 break
             except (OSError, subprocess.SubprocessError):
                 continue
         else:
             return None
-    lib = ctypes.CDLL(so)
+    return ctypes.CDLL(so)
+
+
+def _build_and_load():
+    lib = _compile_native("collate.c", "libptnative.so",
+                          ("cc", "gcc", "clang"),
+                          ("-O3", "-shared", "-fPIC"))
+    if lib is None:
+        return None
     lib.pt_stack_copy.argtypes = [
         ctypes.POINTER(ctypes.c_char_p), ctypes.c_int64,
         ctypes.c_int64, ctypes.c_char_p]
@@ -101,29 +112,12 @@ def fast_stack(arrays):
 
 def _build_store():
     """Build + load the C++ TCPStore server (tcp_store.cc)."""
-    src = os.path.join(os.path.dirname(__file__), "tcp_store.cc")
-    cache = os.path.join(
-        os.path.expanduser(os.environ.get("PADDLE_TPU_CACHE",
-                                          "~/.cache/paddle_tpu")),
-        "native")
-    os.makedirs(cache, exist_ok=True)
-    so = os.path.join(cache, "libpttcpstore.so")
-    if not os.path.exists(so) or (os.path.getmtime(so)
-                                  < os.path.getmtime(src)):
-        tmp = f"{so}.{os.getpid()}.tmp"
-        for cxx in ("c++", "g++", "clang++"):
-            try:
-                subprocess.run(
-                    [cxx, "-O2", "-std=c++17", "-shared", "-fPIC",
-                     "-pthread", "-o", tmp, src],
-                    check=True, capture_output=True, timeout=180)
-                os.replace(tmp, so)
-                break
-            except (OSError, subprocess.SubprocessError):
-                continue
-        else:
-            return None
-    lib = ctypes.CDLL(so)
+    lib = _compile_native("tcp_store.cc", "libpttcpstore.so",
+                          ("c++", "g++", "clang++"),
+                          ("-O2", "-std=c++17", "-shared", "-fPIC",
+                           "-pthread"))
+    if lib is None:
+        return None
     lib.pt_store_server_start.restype = ctypes.c_void_p
     lib.pt_store_server_start.argtypes = [
         ctypes.c_int, ctypes.POINTER(ctypes.c_int)]
@@ -184,3 +178,118 @@ def gather_rows(src, indices):
         idx.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
         len(idx), row, out.ctypes.data_as(ctypes.c_char_p))
     return out
+
+
+# ---------------------------------------------------------------------
+# Shared-memory batch ring (shm_ring.c): the reference's C++ shared-mem
+# DataLoader tensor path.  One SPSC ring per worker; numpy batch
+# payloads cross process boundaries through shm instead of pickle pipes.
+# ---------------------------------------------------------------------
+_ring_lib = None
+_ring_tried = False
+
+
+def _build_ring_lib():
+    lib = _compile_native("shm_ring.c", "libptshmring.so",
+                          ("cc", "gcc", "clang"),
+                          ("-O2", "-shared", "-fPIC", "-pthread"))
+    if lib is None:
+        return None
+    lib.ptr_ring_create.restype = ctypes.c_void_p
+    lib.ptr_ring_create.argtypes = [ctypes.c_char_p, ctypes.c_int64,
+                                    ctypes.c_int64]
+    lib.ptr_ring_attach.restype = ctypes.c_void_p
+    lib.ptr_ring_attach.argtypes = [ctypes.c_char_p]
+    lib.ptr_ring_slot_bytes.restype = ctypes.c_int64
+    lib.ptr_ring_slot_bytes.argtypes = [ctypes.c_void_p]
+    lib.ptr_ring_acquire_write.restype = ctypes.c_int64
+    lib.ptr_ring_acquire_write.argtypes = [ctypes.c_void_p,
+                                           ctypes.c_double]
+    lib.ptr_ring_commit_write.argtypes = [ctypes.c_void_p,
+                                          ctypes.c_int64]
+    lib.ptr_ring_acquire_read.restype = ctypes.c_int64
+    lib.ptr_ring_acquire_read.argtypes = [ctypes.c_void_p,
+                                          ctypes.c_double]
+    lib.ptr_ring_read_size.restype = ctypes.c_int64
+    lib.ptr_ring_read_size.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+    lib.ptr_ring_release_read.argtypes = [ctypes.c_void_p]
+    lib.ptr_ring_slot_ptr.restype = ctypes.c_void_p
+    lib.ptr_ring_slot_ptr.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+    lib.ptr_ring_close.argtypes = [ctypes.c_void_p, ctypes.c_int]
+    return lib
+
+
+def _get_ring_lib():
+    global _ring_lib, _ring_tried
+    if not _ring_tried:
+        with _lock:
+            if not _ring_tried:
+                try:
+                    _ring_lib = _build_ring_lib()
+                except Exception:
+                    _ring_lib = None
+                _ring_tried = True
+    return _ring_lib
+
+
+def shm_ring_available() -> bool:
+    return _get_ring_lib() is not None
+
+
+class ShmRing:
+    """ctypes face of shm_ring.c; create() in the parent, attach() in
+    the worker.  Payloads are length-prefixed binary blobs."""
+
+    def __init__(self, handle, lib, name, owner):
+        self._h = handle
+        self._lib = lib
+        self.name = name
+        self._owner = owner
+        self.slot_bytes = lib.ptr_ring_slot_bytes(handle)
+
+    @classmethod
+    def create(cls, name, slots, slot_bytes):
+        lib = _get_ring_lib()
+        if lib is None:
+            return None
+        h = lib.ptr_ring_create(name.encode(), int(slots),
+                                int(slot_bytes))
+        return cls(h, lib, name, True) if h else None
+
+    @classmethod
+    def attach(cls, name):
+        lib = _get_ring_lib()
+        if lib is None:
+            return None
+        h = lib.ptr_ring_attach(name.encode())
+        return cls(h, lib, name, False) if h else None
+
+    def write(self, payload: bytes, timeout=120.0) -> bool:
+        if len(payload) > self.slot_bytes:
+            return False  # oversized: caller uses the pipe fallback
+        slot = self._lib.ptr_ring_acquire_write(self._h, float(timeout))
+        if slot < 0:
+            raise TimeoutError("shm ring full")
+        dst = (ctypes.c_char * self.slot_bytes).from_address(
+            self._lib.ptr_ring_slot_ptr(self._h, slot))
+        dst[:len(payload)] = payload
+        self._lib.ptr_ring_commit_write(self._h, len(payload))
+        return True
+
+    def read(self, timeout=120.0) -> bytes:
+        slot = self._lib.ptr_ring_acquire_read(self._h, float(timeout))
+        if slot < 0:
+            raise TimeoutError("shm ring empty")
+        n = self._lib.ptr_ring_read_size(self._h, slot)
+        src = (ctypes.c_char * n).from_address(
+            self._lib.ptr_ring_slot_ptr(self._h, slot))
+        data = bytes(src)
+        self._lib.ptr_ring_release_read(self._h)
+        return data
+
+    def close(self, unlink=None):
+        if self._h:
+            self._lib.ptr_ring_close(
+                self._h, 1 if (self._owner if unlink is None
+                               else unlink) else 0)
+            self._h = None

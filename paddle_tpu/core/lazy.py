@@ -231,10 +231,26 @@ def _aval_of(v):
     return jax.ShapeDtypeStruct(jnp.shape(v), jnp.result_type(v))
 
 
+_key_intern: dict = {}
+_intern_lock = threading.Lock()
+
+
+def _intern_key(key):
+    """Big structural op keys hash O(size) on every dict lookup; the
+    segment wiring key contains one per node per flush, so nodes carry
+    a small interned int instead.  Locked: a get-then-set race could
+    hand one int to two different keys — wrong-replay territory."""
+    i = _key_intern.get(key)
+    if i is None:
+        with _intern_lock:
+            i = _key_intern.setdefault(key, len(_key_intern))
+    return i
+
+
 def record_node(run, inputs, out_avals, key):
     """Append one node to this thread's buffer; returns its outputs."""
     buf = _tls.buffer
-    node = LazyNode(run, inputs, out_avals, key, buf)
+    node = LazyNode(run, inputs, out_avals, _intern_key(key), buf)
     with buf.lock:  # another thread may be force-flushing this buffer
         buf.pending.append(node)
     if len(buf.pending) >= _AUTO_FLUSH_NODES:

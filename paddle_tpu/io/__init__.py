@@ -14,6 +14,7 @@ from __future__ import annotations
 
 import itertools
 import math
+import os
 import queue
 import threading
 from typing import Iterable, Optional
@@ -301,23 +302,65 @@ class _MPUnavailable(RuntimeError):
 
 
 _mp_dataset = None
+_mp_ring = None
+_mp_wid = None
 
 
-def _mp_worker_init(dataset, init_fn, counter):
-    global _mp_dataset
+def _sweep_stale_shm_rings():
+    """Unlink /dev/shm/pt_dl_<pid>_* rings whose owning process is gone
+    (a SIGKILLed run never reaches its finally-unlink; names are unique
+    per run, so creation-time shm_unlink can't reclaim them)."""
+    try:
+        for name in os.listdir("/dev/shm"):
+            if not name.startswith("pt_dl_"):
+                continue
+            try:
+                pid = int(name.split("_")[2])
+                os.kill(pid, 0)       # raises if the owner is gone
+            except (ValueError, IndexError):
+                continue
+            except ProcessLookupError:
+                try:
+                    os.unlink(os.path.join("/dev/shm", name))
+                except OSError:
+                    pass
+            except PermissionError:
+                pass                  # alive under another uid
+    except OSError:
+        pass                          # no /dev/shm on this platform
+
+
+def _mp_worker_init(dataset, init_fn, counter, ring_names=None):
+    global _mp_dataset, _mp_ring, _mp_wid
     _mp_dataset = dataset
+    # explicit 0..num_workers-1 id from a shared counter; the process
+    # _identity is a parent-global counter that drifts out of range on
+    # the second epoch's fresh pool
+    with counter.get_lock():
+        _mp_wid = counter.value
+        counter.value += 1
+    if ring_names and _mp_wid < len(ring_names):
+        # shared-memory batch path (the reference's C++ shared-mem
+        # tensor transport): attach THIS worker's SPSC ring.  A worker
+        # RESPAWNED after a crash (wid >= num_workers) must not reuse a
+        # dead peer's ring — its leftover slots would corrupt SPSC
+        # ordering — so replacements ship batches over the pipe.
+        from .._native import ShmRing
+        _mp_ring = ShmRing.attach(ring_names[_mp_wid])
     if init_fn is not None:
-        # explicit 0..num_workers-1 id from a shared counter; the
-        # process _identity is a parent-global counter that drifts out
-        # of range on the second epoch's fresh pool
-        with counter.get_lock():
-            wid = counter.value
-            counter.value += 1
-        init_fn(wid)
+        init_fn(_mp_wid)
 
 
 def _mp_fetch(indices):
-    return [_mp_dataset[i] for i in indices]
+    samples = [_mp_dataset[i] for i in indices]
+    if _mp_ring is not None:
+        import pickle
+        blob = pickle.dumps(samples, protocol=pickle.HIGHEST_PROTOCOL)
+        # one shm memcpy instead of pipe-chunked transfer; oversized
+        # batches fall back to the pipe for just that batch
+        if _mp_ring.write(blob):
+            return ("__shm__", _mp_wid)
+    return samples
 
 
 def _mp_probe():
@@ -337,6 +380,7 @@ class DataLoader:
         self.prefetch_factor = prefetch_factor
         self.worker_init_fn = worker_init_fn
         self.return_list = return_list
+        self.use_shared_memory = use_shared_memory
         self._iterable = isinstance(dataset, IterableDataset)
         if batch_sampler is not None:
             self.batch_sampler = batch_sampler
@@ -407,13 +451,38 @@ class DataLoader:
 
         dataset = self.dataset
         init_fn = self.worker_init_fn
+        depth = max(2, self.prefetch_factor * self.num_workers)
+
+        # shared-memory batch transport (one SPSC ring per worker; see
+        # _native/shm_ring.c).  Ring depth >= outstanding prefetch so a
+        # worker never deadlocks against a slow consumer.
+        rings, ring_names = [], None
+        if self.use_shared_memory:
+            from .._native import ShmRing, shm_ring_available
+            if shm_ring_available():
+                import uuid
+                _sweep_stale_shm_rings()
+                slot_mb = int(os.environ.get(
+                    "PADDLE_TPU_SHM_SLOT_MB", "16"))
+                tag = uuid.uuid4().hex[:8]
+                names = [f"/pt_dl_{os.getpid()}_{tag}_{w}"
+                         for w in range(self.num_workers)]
+                rings = [ShmRing.create(n, depth + 2, slot_mb << 20)
+                         for n in names]
+                if all(r is not None for r in rings):
+                    ring_names = names
+                else:
+                    for r in rings:
+                        if r is not None:
+                            r.close()
+                    rings = []
 
         try:
             counter = ctx.Value("i", 0)
             pool = ctx.Pool(
                 self.num_workers,
                 initializer=_mp_worker_init,
-                initargs=(dataset, init_fn, counter))
+                initargs=(dataset, init_fn, counter, ring_names))
             # smoke round: spawn-unpickle failures crash CHILDREN after
             # Pool() returns, leaving every result pending forever; a
             # bounded probe turns that hang into the threaded fallback
@@ -423,9 +492,11 @@ class DataLoader:
                 pool.terminate()
             except Exception:
                 pass
+            for r in rings:
+                r.close()
             raise _MPUnavailable(str(e))
         try:
-            depth = max(2, self.prefetch_factor * self.num_workers)
+            import pickle
             pending = queue.Queue()
             it = iter(self.batch_sampler)
 
@@ -443,11 +514,16 @@ class DataLoader:
             while not pending.empty():
                 res = pending.get()
                 samples = res.get()
+                if (isinstance(samples, tuple) and len(samples) == 2
+                        and samples[0] == "__shm__"):
+                    samples = pickle.loads(rings[samples[1]].read())
                 submit_next()
                 yield self.collate_fn(samples)
         finally:
             pool.terminate()
             pool.join()
+            for r in rings:
+                r.close()
 
     def _iter_threaded(self):
         """Prefetch with a thread pool (host-side pipeline; the heavy work

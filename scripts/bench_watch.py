@@ -76,7 +76,8 @@ def main():
                  "scripts/flash_block_sweep.py"], deadline_s=3600)
             run([sys.executable, "-u", "bench.py"],
                 env_extra={"PADDLE_TPU_BENCH_CONFIGS":
-                           "bert,lenet,resnet50,gpt,llama_dryrun"})
+                           "bert,lenet,resnet50,gpt,llama,"
+                           "llama_dryrun"})
             cache = ROOT / ".bench_cache" / "latest.json"
             if cache.exists():
                 log("cache: " + cache.read_text()[:400])
