@@ -21,6 +21,8 @@ __all__ = [
     "cosine_embedding_loss", "triplet_margin_loss", "log_loss", "square_error_cost",
     "sigmoid_focal_loss", "dice_loss", "npair_loss", "poisson_nll_loss",
     "multi_label_soft_margin_loss", "soft_margin_loss", "ctc_loss",
+    "multi_margin_loss", "triplet_margin_with_distance_loss",
+    "hsigmoid_loss",
     "huber_loss", "gaussian_nll_loss",
 ]
 
@@ -474,3 +476,112 @@ def gaussian_nll_loss(input, label, variance, full=False, epsilon=1e-6,
                     (input, label, variance),
                     dict(full=bool(full), eps=float(epsilon),
                          reduction=reduction))
+
+
+def multi_margin_loss(input, label, p=1, margin=1.0, weight=None,
+                      reduction="mean", name=None):
+    """Multi-class margin loss: mean_j max(0, margin - x_y + x_j)^p / C
+    over j != y (reference multi_margin_loss semantics)."""
+    def impl(x, lab, *w, p, margin, reduction):
+        n, c = x.shape
+        if lab.ndim == 2 and lab.shape[-1] == 1:
+            lab = jnp.squeeze(lab, -1)
+        lab = lab.astype(jnp.int32)
+        x_y = jnp.take_along_axis(x, lab[:, None], axis=1)
+        viol = jnp.maximum(margin - x_y + x, 0.0) ** p
+        if w:
+            viol = viol * jnp.take(w[0], lab)[:, None]
+        mask = jnp.arange(c)[None, :] != lab[:, None]
+        loss = jnp.sum(jnp.where(mask, viol, 0.0), axis=1) / c
+        return _reduce(loss, reduction)
+
+    args = (input, label) + ((weight,) if weight is not None else ())
+    return dispatch("multi_margin_loss", impl, args,
+                    dict(p=int(p), margin=float(margin),
+                         reduction=reduction))
+
+
+def triplet_margin_with_distance_loss(input, positive, negative,
+                                      distance_function=None, margin=1.0,
+                                      swap=False, reduction="mean",
+                                      name=None):
+    """Triplet margin loss with a caller-supplied distance (defaults to
+    L2, matching triplet_margin_loss)."""
+    if distance_function is None:
+        return triplet_margin_loss(input, positive, negative,
+                                   margin=margin, swap=swap,
+                                   reduction=reduction)
+    d_ap = distance_function(input, positive)
+    d_an = distance_function(input, negative)
+    if swap:
+        d_pn = distance_function(positive, negative)
+        from ...ops._generated import minimum
+        d_an = minimum(d_an, d_pn)
+    from ...ops._generated import maximum
+    from ...ops.math import scale
+    from ...ops.creation import zeros_like
+    viol = maximum(d_ap - d_an + margin, zeros_like(d_ap))
+    from ...ops.reduction import mean as _mean, sum as _sum
+    if reduction == "mean":
+        return _mean(viol)
+    if reduction == "sum":
+        return _sum(viol)
+    return viol
+
+
+def hsigmoid_loss(input, label, num_classes, weight, bias=None,
+                  path_table=None, path_code=None, is_sparse=False,
+                  name=None):
+    """Hierarchical sigmoid loss over the default complete binary tree
+    (word2vec-style hierarchical softmax).  Leaf l sits at heap node
+    l + num_classes; the path to the root visits internal nodes
+    idx // 2 with left/right codes idx % 2; internal node n uses
+    weight[n - 1].  Custom trees ride path_table/path_code (per-sample
+    [steps] int arrays; -1 padding)."""
+    import numpy as np
+    depth = max(1, int(np.ceil(np.log2(max(num_classes, 2)))) + 1)
+
+    def impl(x, lab, w, *rest, num_classes, depth, has_bias, has_path):
+        if lab.ndim == 2 and lab.shape[-1] == 1:
+            lab = jnp.squeeze(lab, -1)
+        lab = lab.astype(jnp.int32)
+        if has_path:
+            table, code = rest[-2], rest[-1]
+            nodes = table.astype(jnp.int32)
+            codes = code.astype(jnp.float32)
+            valid = nodes >= 0
+            nodes = jnp.maximum(nodes, 0)
+        else:
+            # heap walk from leaf to root, padded to fixed depth
+            idx = lab + num_classes
+            steps = []
+            for _ in range(depth):
+                parent = idx // 2
+                steps.append((parent, (idx % 2).astype(jnp.float32)))
+                idx = parent
+            nodes = jnp.stack([s[0] for s in steps], 1)   # [N, depth]
+            codes = jnp.stack([s[1] for s in steps], 1)
+            valid = nodes >= 1
+            nodes = jnp.maximum(nodes, 1)
+            nodes = nodes - 1  # internal node n -> row n-1
+        logits = jnp.einsum("nd,nsd->ns", x.astype(jnp.float32),
+                            w[nodes].astype(jnp.float32))
+        if has_bias:
+            logits = logits + rest[0][nodes][..., 0] \
+                if rest[0].ndim == 2 else logits + rest[0][nodes]
+        # code 1 -> right child: P = sigmoid(-z); 0 -> sigmoid(z)
+        sign = 1.0 - 2.0 * codes
+        logp = jax.nn.log_sigmoid(sign * logits)
+        return -jnp.sum(jnp.where(valid, logp, 0.0), axis=1,
+                        keepdims=True)
+
+    args = [input, label, weight]
+    has_bias = bias is not None
+    if has_bias:
+        args.append(bias)
+    has_path = path_table is not None and path_code is not None
+    if has_path:
+        args += [path_table, path_code]
+    return dispatch("hsigmoid_loss", impl, tuple(args),
+                    dict(num_classes=int(num_classes), depth=depth,
+                         has_bias=has_bias, has_path=has_path))

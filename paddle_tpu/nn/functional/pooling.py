@@ -16,7 +16,7 @@ __all__ = [
     "max_pool3d", "adaptive_avg_pool1d", "adaptive_avg_pool2d",
     "adaptive_avg_pool3d", "adaptive_max_pool1d", "adaptive_max_pool2d",
     "adaptive_max_pool3d",
-    "max_unpool2d",
+    "max_unpool1d", "max_unpool2d", "max_unpool3d",
 ]
 
 
@@ -111,8 +111,19 @@ def avg_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
 
 def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False,
                ceil_mode=False, data_format="NCL", name=None):
-    return _pool(x, "max", kernel_size, stride, padding, ceil_mode, True, 1,
-                 "NCW", "max_pool1d")
+    out = _pool(x, "max", kernel_size, stride, padding, ceil_mode, True, 1,
+                "NCW", "max_pool1d")
+    if return_mask:
+        # height-1 2-D indices are exactly positions in L
+        from ...ops.manipulation import reshape
+        n, c, l = x.shape
+        k1 = _norm(kernel_size, 1)[0]
+        s1 = _norm(stride if stride is not None else kernel_size, 1)[0]
+        p1 = 0 if isinstance(padding, str) else _norm(padding, 1)[0]
+        idx = _max_pool_indices(
+            reshape(x, [n, c, 1, l]), (1, k1), (1, s1), (0, p1), "NCHW")
+        return out, reshape(idx, list(out.shape))
+    return out
 
 
 def max_pool2d(x, kernel_size, stride=None, padding=0, return_mask=False,
@@ -127,8 +138,49 @@ def max_pool2d(x, kernel_size, stride=None, padding=0, return_mask=False,
 
 def max_pool3d(x, kernel_size, stride=None, padding=0, return_mask=False,
                ceil_mode=False, data_format="NCDHW", name=None):
-    return _pool(x, "max", kernel_size, stride, padding, ceil_mode, True, 3,
-                 data_format, "max_pool3d")
+    out = _pool(x, "max", kernel_size, stride, padding, ceil_mode, True, 3,
+                data_format, "max_pool3d")
+    if return_mask:
+        if data_format == "NDHWC":
+            from ...ops.manipulation import transpose
+            idx = _max_pool3d_indices(
+                transpose(x, [0, 4, 1, 2, 3]), kernel_size, stride,
+                padding)
+            return out, transpose(idx, [0, 2, 3, 4, 1])
+        idx = _max_pool3d_indices(x, kernel_size, stride, padding)
+        return out, idx
+    return out
+
+
+def _max_pool3d_indices(x, kernel, stride, padding):
+    import numpy as np
+    from ...core.tensor import to_tensor
+
+    k = _norm(kernel, 3)
+    s = _norm(stride if stride is not None else kernel, 3)
+    p = _norm(padding, 3) if not isinstance(padding, str) else (0, 0, 0)
+    arr = np.asarray(x._value)
+    n, c, d, h, w = arr.shape
+    od = (d + 2 * p[0] - k[0]) // s[0] + 1
+    oh = (h + 2 * p[1] - k[1]) // s[1] + 1
+    ow = (w + 2 * p[2] - k[2]) // s[2] + 1
+    idx = np.zeros((n, c, od, oh, ow), np.int64)
+    padded = np.pad(
+        arr, ((0, 0), (0, 0), (p[0], p[0]), (p[1], p[1]), (p[2], p[2])),
+        constant_values=-np.inf)
+    for a in range(od):
+        for i in range(oh):
+            for j in range(ow):
+                win = padded[:, :, a * s[0]:a * s[0] + k[0],
+                             i * s[1]:i * s[1] + k[1],
+                             j * s[2]:j * s[2] + k[2]].reshape(n, c, -1)
+                loc = win.argmax(-1)
+                da, di, dj = np.unravel_index(loc, k)
+                idx[:, :, a, i, j] = (
+                    (a * s[0] + da - p[0]) * h * w
+                    + (i * s[1] + di - p[1]) * w
+                    + (j * s[2] + dj - p[2]))
+    return to_tensor(idx)
 
 
 def _max_pool_indices(x, kernel, stride, padding, data_format):
@@ -263,3 +315,63 @@ def max_unpool2d(x, indices, kernel_size, stride=None, padding=0,
 
     return dispatch("max_unpool2d", impl, (x, indices),
                     dict(H=H, W=W))
+
+
+def max_unpool1d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCL", output_size=None, name=None):
+    """1-D unpool: indices are positions in L (reference semantics), so
+    the 2-D scatter applies with a height-1 axis."""
+    from ...ops.manipulation import reshape
+    n, c, ol = x.shape
+    if output_size is not None:
+        output_size = [1, int(output_size[-1])]
+    k1 = _norm(kernel_size, 1)[0]
+    s1 = _norm(stride if stride is not None else kernel_size, 1)[0]
+    p1 = 0 if isinstance(padding, str) else _norm(padding, 1)[0]
+    out = max_unpool2d(reshape(x, [n, c, 1, ol]),
+                       reshape(indices, [n, c, 1, ol]),
+                       (1, k1), (1, s1), (0, p1), "NCHW", output_size)
+    return reshape(out, [n, c, out.shape[-1]])
+
+
+def max_unpool3d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCDHW", output_size=None, name=None):
+    if data_format == "NDHWC":
+        from ...ops.manipulation import transpose
+        out = max_unpool3d(transpose(x, [0, 4, 1, 2, 3]),
+                           transpose(indices, [0, 4, 1, 2, 3]),
+                           kernel_size, stride, padding, "NCDHW",
+                           output_size)
+        return transpose(out, [0, 2, 3, 4, 1])
+    k = _norm(kernel_size, 3)
+    s = _norm(stride if stride is not None else kernel_size, 3)
+    p = _norm(padding, 3) if not isinstance(padding, str) else (0, 0, 0)
+    n, c, od, oh, ow = x.shape
+    if output_size is not None:
+        D, H, W = (int(output_size[-3]), int(output_size[-2]),
+                   int(output_size[-1]))
+    else:
+        D = (od - 1) * s[0] - 2 * p[0] + k[0]
+        H = (oh - 1) * s[1] - 2 * p[1] + k[1]
+        W = (ow - 1) * s[2] - 2 * p[2] + k[2]
+    try:
+        mx = int((indices._value if hasattr(indices, "_value")
+                  else indices).max())
+        if mx >= D * H * W:
+            raise ValueError(
+                f"max_unpool3d: index {mx} outside the inferred "
+                f"{D}x{H}x{W} output; pass output_size")
+    except (TypeError, jax.errors.ConcretizationTypeError):
+        pass
+
+    def impl(v, idx, *, D, H, W):
+        n, c = v.shape[:2]
+        flat = jnp.zeros((n, c, D * H * W), v.dtype)
+        flat = flat.at[
+            jnp.arange(n)[:, None, None],
+            jnp.arange(c)[None, :, None],
+            idx.reshape(n, c, -1)].set(v.reshape(n, c, -1))
+        return flat.reshape(n, c, D, H, W)
+
+    return dispatch("max_unpool3d", impl, (x, indices),
+                    dict(D=D, H=H, W=W))

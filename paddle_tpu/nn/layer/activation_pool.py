@@ -19,7 +19,7 @@ __all__ = [
     "MaxPool3D", "AdaptiveAvgPool1D", "AdaptiveAvgPool2D",
     "AdaptiveAvgPool3D", "AdaptiveMaxPool1D", "AdaptiveMaxPool2D",
     "AdaptiveMaxPool3D",
-    "MaxUnPool2D",
+    "MaxUnPool1D", "MaxUnPool2D", "MaxUnPool3D", "Softmax2D",
 ]
 
 
@@ -188,3 +188,32 @@ class MaxUnPool2D(Layer):
         return F.max_unpool2d(x, indices, self.kernel_size, self.stride,
                               self.padding, self.data_format,
                               self.output_size)
+
+
+class Softmax2D(Layer):
+    """Softmax over the channel dim of NCHW inputs (paddle.nn.Softmax2D)."""
+
+    def forward(self, x):
+        return F.softmax(x, axis=-3)
+
+
+class MaxUnPool1D(Layer):
+    def __init__(self, kernel_size, stride=None, padding=0,
+                 data_format="NCL", output_size=None, name=None):
+        super().__init__()
+        self._a = (kernel_size, stride, padding, data_format, output_size)
+
+    def forward(self, x, indices):
+        k, s, p, df, osz = self._a
+        return F.max_unpool1d(x, indices, k, s, p, df, osz)
+
+
+class MaxUnPool3D(Layer):
+    def __init__(self, kernel_size, stride=None, padding=0,
+                 data_format="NCDHW", output_size=None, name=None):
+        super().__init__()
+        self._a = (kernel_size, stride, padding, data_format, output_size)
+
+    def forward(self, x, indices):
+        k, s, p, df, osz = self._a
+        return F.max_unpool3d(x, indices, k, s, p, df, osz)

@@ -13,8 +13,9 @@ __all__ = ["CrossEntropyLoss", "MSELoss", "L1Loss", "NLLLoss", "BCELoss",
            "MarginRankingLoss", "CTCLoss", "HingeEmbeddingLoss",
            "CosineEmbeddingLoss", "TripletMarginLoss", "PoissonNLLLoss",
            "MultiLabelSoftMarginLoss", "SoftMarginLoss",
-    "HuberLoss", "GaussianNLLLoss",
-]
+           "HuberLoss", "GaussianNLLLoss",
+           "MultiMarginLoss", "TripletMarginWithDistanceLoss",
+           "HSigmoidLoss"]
 
 
 class CrossEntropyLoss(Layer):
@@ -196,3 +197,56 @@ class GaussianNLLLoss(Layer):
     def forward(self, input, label, variance):
         return F.gaussian_nll_loss(input, label, variance, self.full,
                                    self.epsilon, self.reduction)
+
+
+class MultiMarginLoss(Layer):
+    def __init__(self, p=1, margin=1.0, weight=None, reduction="mean",
+                 name=None):
+        super().__init__()
+        self.p, self.margin = p, margin
+        self.weight = weight
+        self.reduction = reduction
+
+    def forward(self, input, label):
+        return F.multi_margin_loss(input, label, self.p, self.margin,
+                                   self.weight, self.reduction)
+
+
+class TripletMarginWithDistanceLoss(Layer):
+    def __init__(self, distance_function=None, margin=1.0, swap=False,
+                 reduction="mean", name=None):
+        super().__init__()
+        self.distance_function = distance_function
+        self.margin, self.swap = margin, swap
+        self.reduction = reduction
+
+    def forward(self, input, positive, negative):
+        return F.triplet_margin_with_distance_loss(
+            input, positive, negative, self.distance_function,
+            self.margin, self.swap, self.reduction)
+
+
+class HSigmoidLoss(Layer):
+    """Hierarchical sigmoid loss layer: owns the internal-node weight
+    (num_classes-1 rows over the default complete binary tree) and
+    optional bias; custom trees via is_custom + per-call path args."""
+
+    def __init__(self, feature_size, num_classes, weight_attr=None,
+                 bias_attr=None, is_custom=False, is_sparse=False,
+                 name=None):
+        super().__init__()
+        self.num_classes = num_classes
+        self.is_custom = is_custom
+        rows = num_classes if is_custom else num_classes - 1
+        self.weight = self.create_parameter(
+            shape=[rows, feature_size], attr=weight_attr)
+        if bias_attr is not False:
+            self.bias = self.create_parameter(
+                shape=[rows, 1], attr=bias_attr, is_bias=True)
+        else:
+            self.bias = None
+
+    def forward(self, input, label, path_table=None, path_code=None):
+        return F.hsigmoid_loss(input, label, self.num_classes,
+                               self.weight, self.bias, path_table,
+                               path_code)

@@ -276,3 +276,144 @@ def test_max_unpool2d_requires_output_size_when_lossy():
     out = paddle.nn.functional.max_unpool2d(
         pooled, idx, 2, output_size=[5, 5]).numpy()
     assert out[0, 0, 2, 3] == 9.0
+
+
+def test_nn_layer_fills_round4():
+    """Round-4 fills: Softmax2D, MaxUnPool1D/3D, MultiMarginLoss,
+    TripletMarginWithDistanceLoss, HSigmoidLoss, BeamSearchDecoder."""
+    rng = np.random.RandomState(0)
+
+    # Softmax2D: channel-dim softmax on NCHW
+    x = paddle.to_tensor(rng.randn(2, 3, 4, 4).astype(np.float32))
+    s = nn.Softmax2D()(x)
+    np.testing.assert_allclose(
+        np.asarray(s._value).sum(1), np.ones((2, 4, 4)), rtol=1e-5)
+
+    # MaxUnPool1D/3D round-trip the argmax positions
+    import paddle_tpu.nn.functional as F
+    x1 = paddle.to_tensor(rng.randn(2, 3, 8).astype(np.float32))
+    p1, idx1 = F.max_pool1d(x1, 2, stride=2, return_mask=True)
+    up1 = nn.MaxUnPool1D(2, stride=2)(p1, idx1)
+    assert up1.shape == [2, 3, 8]
+    got = np.asarray(up1._value)
+    assert np.allclose(got.max(-1), np.asarray(p1._value).max(-1))
+
+    x3 = paddle.to_tensor(rng.randn(1, 2, 4, 4, 4).astype(np.float32))
+    p3, idx3 = F.max_pool3d(x3, 2, stride=2, return_mask=True)
+    up3 = nn.MaxUnPool3D(2, stride=2)(p3, idx3)
+    assert up3.shape == [1, 2, 4, 4, 4]
+
+    # MultiMarginLoss decreases for a confident correct prediction
+    logits = paddle.to_tensor(np.array([[3.0, 0.1, 0.1]], np.float32))
+    bad = paddle.to_tensor(np.array([[0.1, 3.0, 0.1]], np.float32))
+    lab = paddle.to_tensor(np.array([0], np.int64))
+    l_good = float(nn.MultiMarginLoss()(logits, lab))
+    l_bad = float(nn.MultiMarginLoss()(bad, lab))
+    assert l_good < l_bad
+
+    # TripletMarginWithDistanceLoss with a custom distance
+    a = paddle.to_tensor(rng.randn(4, 8).astype(np.float32),
+                         stop_gradient=False)
+    pos = paddle.to_tensor((np.asarray(a._value)
+                            + 0.01 * rng.randn(4, 8)).astype(np.float32))
+    neg = paddle.to_tensor(rng.randn(4, 8).astype(np.float32))
+
+    def l1_dist(u, v):
+        return paddle.sum(paddle.abs(u - v), axis=-1)
+
+    loss = nn.TripletMarginWithDistanceLoss(
+        distance_function=l1_dist, margin=0.5)(a, pos, neg)
+    loss.backward()
+    assert a.grad is not None
+
+    # HSigmoidLoss trains (loss drops on repeated steps)
+    paddle.seed(0)
+    hs = nn.HSigmoidLoss(feature_size=8, num_classes=6)
+    from paddle_tpu import optimizer as opt_mod
+    opt = opt_mod.SGD(learning_rate=0.5, parameters=hs.parameters())
+    feats = paddle.to_tensor(rng.randn(16, 8).astype(np.float32))
+    labels = paddle.to_tensor(rng.randint(0, 6, (16, 1)).astype(np.int64))
+    losses = []
+    for _ in range(10):
+        loss = paddle.mean(hs(feats, labels))
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(loss))
+    assert losses[-1] < losses[0]
+
+
+def test_beam_search_decoder():
+    """A cell rigged to always prefer token sequences 2,2,...,end: the
+    best beam must find them and report correct lengths."""
+    from paddle_tpu.nn import BeamSearchDecoder, dynamic_decode
+
+    V, H = 5, 8
+    emb = nn.Embedding(V, H)
+
+    class Cell(nn.SimpleRNNCell):
+        pass
+
+    paddle.seed(0)
+    cell = Cell(H, H)
+    proj = nn.Linear(H, V)
+    # bias the projection hard toward token 2, then end (3) after step 2
+    with paddle.no_grad():
+        b = np.zeros(V, np.float32)
+        b[2] = 5.0
+        proj.bias.set_value(paddle.to_tensor(b))
+
+    dec = BeamSearchDecoder(cell, start_token=0, end_token=3,
+                            beam_size=3,
+                            embedding_fn=lambda ids: emb(ids),
+                            output_fn=lambda h: proj(h))
+    init = cell.get_initial_states(paddle.zeros([2, H]))
+    seq, lengths = dynamic_decode(dec, inits=init, max_step_num=4)
+    assert seq.shape[0] == 2 and seq.shape[1] == 3
+    assert seq.shape[2] <= 4
+    best = np.asarray(seq._value)[:, 0, :]
+    assert (best[:, 0] == 2).all()  # the biased token wins everywhere
+
+
+def test_beam_search_scores_are_true_log_probs():
+    """r4 review: a dropped '-max' term offset each beam's scores by its
+    own max logit, corrupting cross-beam ranking.  With a cell whose
+    logits differ in scale per input token, the best beam must still be
+    the true max-probability sequence (computed by brute force)."""
+    from paddle_tpu.nn import BeamSearchDecoder, dynamic_decode
+    import itertools
+
+    V, H = 4, 6
+    paddle.seed(3)
+    emb = nn.Embedding(V, H)
+    cell = nn.SimpleRNNCell(H, H)
+    proj = nn.Linear(H, V)
+
+    dec = BeamSearchDecoder(cell, start_token=0, end_token=V - 1,
+                            beam_size=4,
+                            embedding_fn=lambda ids: emb(ids),
+                            output_fn=lambda h: proj(h))
+    init = cell.get_initial_states(paddle.zeros([1, H]))
+    seq, _ = dynamic_decode(dec, inits=init, max_step_num=2)
+    best = tuple(np.asarray(seq._value)[0, 0, :].tolist())
+
+    # brute force all length-2 sequences through the same cell
+    def logprobs(tok, state):
+        out, new_state = cell(emb(paddle.to_tensor(
+            np.array([tok], np.int64))), state)
+        logits = np.asarray(proj(out)._value)[0].astype(np.float64)
+        lp = logits - logits.max()
+        lp = lp - np.log(np.exp(lp).sum())
+        return lp, new_state
+
+    scores = {}
+    lp0, st0 = logprobs(0, init)
+    for t1 in range(V):
+        lp1, st1 = logprobs(t1, st0)
+        if t1 == V - 1:
+            scores[(t1,)] = lp0[t1]
+            continue
+        for t2 in range(V):
+            scores[(t1, t2)] = lp0[t1] + lp1[t2]
+    brute = max(scores, key=scores.get)
+    assert tuple(best[:len(brute)]) == brute, (best, brute, scores)
