@@ -251,14 +251,22 @@ class PipelineParallel(DataParallel):
 class PipelineParallelWithInterleave(PipelineParallel):
     """Interleaved (virtual-pipeline) variant.
 
-    The reference's interleaved 1F1B exists to shrink the pipeline bubble
-    by giving each rank several non-contiguous stage chunks.  Under this
-    framework's single-program SPMD schedule the bubble is governed by
-    the compiled GPipe scan + XLA's latency-hiding scheduler, and the
-    virtual chunks of one rank would still execute serially per tick on a
-    TPU core — so the compiled schedule is identical to
-    PipelineParallel's.  The class is kept for API parity; it accepts and
-    records num_virtual_pipeline_stages.
+    The reference's interleaved 1F1B shrinks the pipeline bubble by
+    giving each rank v non-contiguous stage chunks scheduled
+    ASYNCHRONOUSLY — a rank starts a later chunk of an early microbatch
+    while an earlier chunk of a later microbatch is still elsewhere.
+    That gain fundamentally requires per-rank asynchronous progress.
+    This framework's pipeline is a single lockstep SPMD scan: per tick,
+    every device advances every chunk it holds, so with round-robin
+    chunk placement a device processes its v chunks SERIALLY inside one
+    tick — tick time stays one full stage regardless of v, the
+    fill/drain is (P-1) ticks either way, and the compiled schedule is
+    mathematically identical to PipelineParallel's (same bubble
+    fraction (P-1)/(P-1+m)).  Expressing true interleaved 1F1B needs
+    per-stage programs in a multi-controller runtime, not a
+    single-program scan.  The class is kept for API parity; it accepts
+    and records num_virtual_pipeline_stages and must not be counted as
+    interleaved scheduling.
     """
 
     def __init__(self, layers, hcg=None, strategy=None,
