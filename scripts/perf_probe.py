@@ -159,6 +159,10 @@ def eager_gap():
 
 
 def main():
+    # x32 comparison child runs FIRST: the TPU claim is exclusive per
+    # process, so it must finish before this process initializes jax
+    log("bert train under PADDLE_TPU_X32=1 (s64-free device program):")
+    t_32 = bert_x32_subprocess()
     import jax
     log(f"devices: {jax.devices()}")
     raw_matmul()
@@ -175,8 +179,6 @@ def main():
     t_s = bert_step(use_pallas=True, scan_layers=True)
     log(f"scan vs unrolled: {t_p / t_s:.2f}x step "
         f"(compile-time win is logged above per config)")
-    log("bert train under PADDLE_TPU_X32=1 (s64-free device program):")
-    t_32 = bert_x32_subprocess()
     if t_32:
         log(f"x32 speedup vs x64: {t_p / t_32:.2f}x")
     log("profiled steps -> artifacts/tpu_profile (git add + commit "
