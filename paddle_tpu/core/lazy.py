@@ -281,6 +281,15 @@ def concrete(v):
     return v.force() if isinstance(v, LazyValue) else v
 
 
+def concrete_values(tensors):
+    """``tuple(t._value, forced)`` — THE compiled-call boundary helper:
+    a pending LazyValue handed to a lowered executable (or jit.lower)
+    raises 'Triggering __jax_array__ during abstractification', so
+    every site that feeds raw tensor buffers into compiled code goes
+    through here."""
+    return tuple(concrete(t._value) for t in tensors)
+
+
 def flush():
     """Flush this thread's pending segment."""
     _flush_buffer(_tls.buffer)

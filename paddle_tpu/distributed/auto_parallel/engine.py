@@ -242,8 +242,9 @@ class DistModel:
         if fn is None:
             fn = self._build_step(self._mode, arrs)
             self._steps[key] = fn
-        pvals = tuple(p._value for p in self._trainable)
-        ovals = tuple(t._value for t in self._opt_state)
+        from ...core.lazy import concrete_values
+        pvals = concrete_values(self._trainable)
+        ovals = concrete_values(self._opt_state)
         lr = jnp.asarray(0.0, jnp.float32)
         step_i = jnp.asarray(0, jnp.int32)
         if self._optimizer is not None:

@@ -459,10 +459,11 @@ class GlobalPipelineEngine:
         if fn is None:
             fn = self._build(x, y, with_scaler)
             self._compiled[key] = fn
+        from .....core.lazy import concrete_values
         loss, found_inf, new_outer, new_stacked, new_opt = fn(
-            tuple(t._value for t in self.outer),
-            tuple(t._value for t in self.stacked),
-            tuple(t._value for t in self.opt_state),
+            concrete_values(self.outer),
+            concrete_values(self.stacked),
+            concrete_values(self.opt_state),
             jnp.asarray(lr, jnp.float32),
             jnp.asarray(self._step_host, jnp.int32),
             jnp.asarray(1.0 if scale is None else scale, jnp.float32),

@@ -273,9 +273,10 @@ class SpmdPipelineEngine:
                 jax.ShapeDtypeStruct(x.shape, x.dtype),
                 jax.ShapeDtypeStruct(y.shape, y.dtype))
             self._compiled[key] = fn
+        from .....core.lazy import concrete_values
         loss, new_p, new_opt = fn(
-            tuple(t._value for t in self.stacked),
-            tuple(t._value for t in self.opt_state),
+            concrete_values(self.stacked),
+            concrete_values(self.opt_state),
             jnp.asarray(lr, jnp.float32),
             jnp.asarray(self._step_host, jnp.int64),
             x, y)

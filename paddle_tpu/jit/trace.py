@@ -25,6 +25,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from ..core.lazy import concrete, concrete_values
 from ..core.tensor import Tensor, get_trace_ctx, set_trace_ctx
 
 
@@ -106,7 +107,8 @@ def _tree_key(tree):
 
 def _tensor_arg_values(args, kwargs):
     leaves = jax.tree.flatten((args, kwargs), is_leaf=_is_tensor_leaf)[0]
-    return tuple(l._value for l in leaves if isinstance(l, Tensor))
+    return tuple(concrete(l._value) for l in leaves
+                 if isinstance(l, Tensor))
 
 
 def _bind_args(args, kwargs, tensor_vals):
@@ -266,8 +268,9 @@ class TracedFunction:
             jit_kwargs.setdefault("donate_argnums", (2,))
         jitted = jax.jit(pure_fn, **jit_kwargs)
         arg_vals = _tensor_arg_values(args, kwargs)
-        ro_vals = tuple(t._value for t in ro_state)
-        rw_vals = tuple(t._value for t in rw_state)
+        # pending lazy values cannot cross a jit boundary as arguments
+        ro_vals = concrete_values(ro_state)
+        rw_vals = concrete_values(rw_state)
         compiled = jitted.lower(arg_vals, ro_vals, rw_vals).compile()
         return {
             "compiled": compiled,
@@ -282,8 +285,8 @@ class TracedFunction:
 
     def _run_compiled(self, comp, args, kwargs):
         arg_vals = _tensor_arg_values(args, kwargs)
-        ro_vals = tuple(t._value for t in comp["ro_state"])
-        rw_vals = tuple(t._value for t in comp["rw_state"])
+        ro_vals = concrete_values(comp["ro_state"])
+        rw_vals = concrete_values(comp["rw_state"])
         out_vals, mut_vals, grad_vals = comp["compiled"](
             arg_vals, ro_vals, rw_vals)
         for t, v in zip(comp["mutated"], mut_vals):

@@ -91,8 +91,9 @@ class Executor:
         feed_vals = tuple(
             jnp.asarray(np.asarray(feed[name]), entry["feed_dtypes"][i])
             for i, name in enumerate(entry["feed_names"]))
-        param_vals = tuple(p._value for p in entry["params"])
-        opt_state_vals = tuple(t._value for t in entry["opt_state"])
+        from ..core.lazy import concrete_values
+        param_vals = concrete_values(entry["params"])
+        opt_state_vals = concrete_values(entry["opt_state"])
         lr_val = jnp.asarray(0.0, jnp.float32)
         step_val = jnp.asarray(0, jnp.int32)
         if program._optimize_info is not None:

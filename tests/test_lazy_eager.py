@@ -216,3 +216,55 @@ def test_lazy_flush_error_is_preserved():
         # the value is permanently poisoned with the original cause
         with pytest.raises(RuntimeError, match="segment failed"):
             c._value.force()
+
+
+def test_lazy_to_static_with_pending_state():
+    """Process-wide lazy + to_static'd TRAIN step: the step MUTATES
+    params (backward + opt.step), so after the discovery run the state
+    tensors hold pending LazyValues, and lower()/compiled calls must
+    force them (r4: 'Triggering __jax_array__ during abstractification'
+    — reproduced pre-fix exactly by this test)."""
+    from paddle_tpu import jit, optimizer
+
+    paddle.seed(0)
+    m = nn.Sequential(nn.Linear(6, 6), nn.Tanh(), nn.Linear(6, 6))
+    opt = optimizer.Adam(learning_rate=1e-2,
+                         parameters=m.parameters())
+    x = paddle.to_tensor(np.random.RandomState(0)
+                         .randn(4, 6).astype(np.float32))
+    y = paddle.to_tensor(np.random.RandomState(1)
+                         .randn(4, 6).astype(np.float32))
+
+    def train_step(xb, yb):
+        loss = F.mse_loss(m(xb), yb)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        return loss
+
+    with paddle.incubate.lazy_eager():
+        st = jit.to_static(train_step)
+        losses = [float(st(x, y)) for _ in range(4)]
+    assert losses[-1] < losses[0]
+
+    # also: a pending mutation made OUTSIDE then read through the
+    # compiled executor path
+    from paddle_tpu import static
+    paddle.enable_static()
+    try:
+        with paddle.incubate.lazy_eager():
+            main = static.Program()
+            startup = static.Program()
+            with static.program_guard(main, startup):
+                xv = static.data("x", [2, 6], "float32")
+                lin = nn.Linear(6, 6)
+                out = lin(xv)
+            doubled = lin.weight * 2.0
+            assert isinstance(doubled._value, lazy.LazyValue)
+            lin.weight._value = doubled._value
+            exe = static.Executor()
+            got = exe.run(main, feed={"x": np.zeros((2, 6), np.float32)},
+                          fetch_list=[out])[0]
+            assert np.isfinite(got).all()
+    finally:
+        paddle.disable_static()
