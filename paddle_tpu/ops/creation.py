@@ -16,6 +16,7 @@ from ..core.tensor import Tensor, to_tensor
 from ..framework.random import default_generator
 
 __all__ = [
+    "log_normal", "log_normal_",
     "to_tensor", "zeros", "ones", "full", "empty", "zeros_like", "ones_like",
     "full_like", "empty_like", "arange", "linspace", "logspace", "eye",
     "rand", "randn", "randint", "randint_like", "uniform", "normal",
@@ -391,3 +392,17 @@ def triu_indices(row, col=None, offset=0, dtype="int64"):
     col = row if col is None else col
     r, c = _np.triu_indices(int(row), k=int(offset), m=int(col))
     return to_tensor(_np.stack([r, c]).astype(_np.int64), dtype=dtype)
+
+
+def log_normal(mean=1.0, std=2.0, shape=None, name=None):
+    """Samples from LogNormal(mean, std) — exp of a normal draw
+    (paddle.log_normal)."""
+    from .math import exp as _exp
+    return _exp(normal(float(mean), float(std),
+                       shape if shape is not None else [1]))
+
+
+def log_normal_(x, mean=1.0, std=2.0, name=None):
+    v = log_normal(mean, std, list(x.shape))
+    x._inplace_update(v._value.astype(x._value.dtype))
+    return x

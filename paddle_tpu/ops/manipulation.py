@@ -17,6 +17,7 @@ from ..core.dtypes import to_jax_dtype
 from ..core.tensor import Tensor, to_tensor
 
 __all__ = [
+    "unflatten",
     "reshape", "reshape_", "transpose", "flatten", "squeeze", "squeeze_",
     "unsqueeze", "unsqueeze_", "concat", "stack", "split", "chunk", "tile",
     "expand", "expand_as", "broadcast_to", "broadcast_tensors", "gather",
@@ -813,3 +814,21 @@ def row_stack(x, name=None):
     def impl(*vs):
         return jnp.vstack(vs)
     return dispatch("row_stack", impl, tuple(x), {})
+
+
+def unflatten(x, axis, shape, name=None):
+    """Split one axis into the given shape (paddle.unflatten; the
+    nn.Unflatten layer's functional form).  One -1 entry infers."""
+    axis = int(axis)
+    shape = [int(s) for s in shape]
+    n = x.shape[axis if axis >= 0 else x.ndim + axis]
+    if -1 in shape:
+        known = 1
+        for s in shape:
+            if s != -1:
+                known *= s
+        shape[shape.index(-1)] = n // known
+    new_shape = list(x.shape)
+    ax = axis if axis >= 0 else len(new_shape) + axis
+    new_shape[ax:ax + 1] = shape
+    return reshape(x, new_shape)

@@ -25,6 +25,7 @@ __all__ = [
     "logsumexp", "logit", "lgamma", "digamma", "multiply_", "add_",
     "subtract_", "scale", "stanh", "rad2deg", "deg2rad", "heaviside",
     "hypot", "ldexp", "logaddexp", "inner", "outer", "kron", "trace",
+    "polar", "frexp", "nextafter",
     "deg2rad", "diff", "angle", "conj", "real", "imag", "gcd", "lcm",
     "cumsum", "cumprod", "cummax", "cummin", "sgn", "take", "increment",
     "copysign", "trapezoid", "cumulative_trapezoid", "logcumsumexp", "renorm", "gammaln", "polygamma", "i0", "i1", "sinc", "signbit", "isposinf", "isneginf", "isreal",
@@ -419,3 +420,25 @@ def histogramdd(x, bins=10, ranges=None, density=False, weights=None,
     hist, edges = _np.histogramdd(sample, bins=bins, range=ranges,
                                   density=density, weights=w)
     return to_tensor(hist), [to_tensor(e) for e in edges]
+
+
+def polar(abs, angle, name=None):
+    """Complex tensor from magnitude + phase (paddle.polar)."""
+    return dispatch(
+        "polar",
+        lambda r, t: jax.lax.complex(r * jnp.cos(t), r * jnp.sin(t)),
+        (abs, angle), {})
+
+
+def frexp(x, name=None):
+    """Mantissa/exponent decomposition: x = m * 2**e, 0.5 <= |m| < 1."""
+    def impl(v):
+        m, e = jnp.frexp(v)
+        return m, e.astype(jnp.int32)
+
+    return dispatch("frexp", impl, (x,), {}, differentiable=False)
+
+
+def nextafter(x, y, name=None):
+    return dispatch("nextafter", jnp.nextafter, (x, y), {},
+                    differentiable=False)
