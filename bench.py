@@ -78,14 +78,20 @@ print("PROBE_OK " + json.dumps(
 
 
 def probe_device(wait_s=240, attempts=2, backoff_s=20):
-    """Return {"platform", "kind"} from a subprocess probe, or None."""
+    """Return {"platform", "kind"} from a subprocess probe, or None.
+
+    Probe stderr is captured to ``/tmp/tpu_probe_<ts>.err`` — a failed
+    probe's jax/axon traceback is the primary tunnel diagnostic
+    (TUNNEL.md); discarding it cost rounds 3-4 their root cause."""
     for a in range(attempts):
         t0 = time.time()
-        p = subprocess.Popen(
-            [sys.executable, "-c", _PROBE_CODE],
-            stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True)
-        while time.time() - t0 < wait_s and p.poll() is None:
-            time.sleep(2)
+        err_path = f"/tmp/tpu_probe_{os.getpid()}_{int(t0)}.err"
+        with open(err_path, "w") as err_f:
+            p = subprocess.Popen(
+                [sys.executable, "-c", _PROBE_CODE],
+                stdout=subprocess.PIPE, stderr=err_f, text=True)
+            while time.time() - t0 < wait_s and p.poll() is None:
+                time.sleep(2)
         rc = p.poll()
         if rc == 0:
             for line in (p.stdout.read() or "").splitlines():
@@ -97,9 +103,16 @@ def probe_device(wait_s=240, attempts=2, backoff_s=20):
         elif rc is None:
             # abandoned on purpose — do NOT p.kill() (see module docstring)
             log(f"probe attempt {a+1}/{attempts}: hung >{wait_s}s; "
-                "abandoning the process")
+                f"abandoning the process (stderr: {err_path})")
         else:
-            log(f"probe attempt {a+1}/{attempts}: rc={rc}")
+            tail = ""
+            try:
+                tail = open(err_path, errors="replace").read()[
+                    -400:].replace("\n", " | ")
+            except Exception:
+                pass
+            log(f"probe attempt {a+1}/{attempts}: rc={rc}; "
+                f"stderr tail: {tail}")
         if a + 1 < attempts:
             time.sleep(backoff_s)
     return None

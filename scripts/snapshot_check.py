@@ -1,0 +1,52 @@
+"""Pre-snapshot gate (VERDICT r4 "next" #2).
+
+Run before ANY end-of-round snapshot commit.  Fails loudly if the tree
+would commit red.  Checks, in order:
+
+  1. ``tests/test_codegen.py`` — the ops.yaml registry manifest must be
+     bidirectionally in sync with every ``dispatch()`` site (this is the
+     exact test the r4 snapshot broke).
+  2. A ~60s smoke subset covering the core import, tensor ops, autograd,
+     static executor, and the flagship-model forward.
+
+Usage::
+
+    python scripts/snapshot_check.py   # rc=0 → safe to snapshot
+
+Exit code is nonzero on any failure; the failing pytest output is
+printed.  Keep this list FAST — the full suite still runs in CI/judging;
+this gate only has to catch "committed untested" mistakes.
+"""
+import subprocess
+import sys
+from pathlib import Path
+
+ROOT = Path(__file__).resolve().parent.parent
+
+SMOKE = [
+    "tests/test_codegen.py",
+    "tests/test_tensor.py",
+    "tests/test_autograd.py",
+    "tests/test_static.py",
+    "tests/test_models.py",
+]
+
+
+def main():
+    # Anything missing from SMOKE is a configuration error, not a skip.
+    missing = [p for p in SMOKE if not (ROOT / p).exists()]
+    if missing:
+        print(f"snapshot_check: missing test files: {missing}", file=sys.stderr)
+        return 2
+    cmd = [sys.executable, "-m", "pytest", "-x", "-q", *SMOKE]
+    print("snapshot_check:", " ".join(cmd), flush=True)
+    rc = subprocess.run(cmd, cwd=str(ROOT)).returncode
+    if rc != 0:
+        print("snapshot_check: RED — do not snapshot", file=sys.stderr)
+    else:
+        print("snapshot_check: green")
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())
