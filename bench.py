@@ -65,8 +65,17 @@ def device_peak_flops():
 # ---------------------------------------------------------------------
 # Tunnel probe
 # ---------------------------------------------------------------------
+# The probe child loads axon_probe.py by FILE PATH — importing the
+# paddle_tpu package would execute its __init__ (the whole framework)
+# before the bounded registration runs.
+_AXON_PROBE_PY = str(ROOT / "paddle_tpu" / "utils" / "axon_probe.py")
+
 _PROBE_CODE = r"""
-import json
+import json, importlib.util
+spec = importlib.util.spec_from_file_location("axon_probe", %r)
+ap = importlib.util.module_from_spec(spec)
+spec.loader.exec_module(ap)
+ap.ensure_registered(claim_timeout_s=120)
 import jax
 d = jax.devices()[0]
 import jax.numpy as jnp
@@ -74,21 +83,54 @@ x = jnp.ones((128, 128))
 (x @ x).sum().block_until_ready()
 print("PROBE_OK " + json.dumps(
     {"platform": d.platform, "kind": getattr(d, "device_kind", "")}))
-"""
+""" % _AXON_PROBE_PY
+
+
+_axon_probe_cache = []
+
+
+def _axon_probe_mod():
+    if not _axon_probe_cache:
+        import importlib.util
+        spec = importlib.util.spec_from_file_location(
+            "axon_probe", _AXON_PROBE_PY)
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        _axon_probe_cache.append(mod)
+    return _axon_probe_cache[0]
+
+
+def relay_alive():
+    """Socket-level relay check (<50 ms).  The relay (/root/.relay.py)
+    dies when the driver-side transport closes and is unrestartable
+    in-container; once 8082 refuses, every axon client hangs in a
+    connect-retry loop — even a bounded-claim one (TUNNEL.md)."""
+    return _axon_probe_mod().relay_alive()
 
 
 def probe_device(wait_s=240, attempts=2, backoff_s=20):
     """Return {"platform", "kind"} from a subprocess probe, or None.
 
-    Probe stderr is captured to ``/tmp/tpu_probe_<ts>.err`` — a failed
-    probe's jax/axon traceback is the primary tunnel diagnostic
-    (TUNNEL.md); discarding it cost rounds 3-4 their root cause."""
+    Layered (TUNNEL.md): a dead relay is detected by a plain TCP
+    connect in milliseconds — no jax child is ever spawned against a
+    refused port (it would hang in jaxlib's connect-retry loop).  The
+    jax probe child then self-registers with a FINITE claim timeout so
+    a lost grant exits rc!=0 instead of occupying the allocator queue
+    forever.  Probe stderr is captured to ``/tmp/tpu_probe_<pid>_<ts>
+    .err`` — a failed probe's traceback is the primary tunnel
+    diagnostic; discarding it cost rounds 3-4 their root cause."""
+    self_register_child_env = _axon_probe_mod().self_register_child_env
     for a in range(attempts):
+        if not relay_alive():
+            log("probe: relay dead (ECONNREFUSED 127.0.0.1:8082); "
+                "tunnel is unrecoverable from inside this container")
+            return None
         t0 = time.time()
         err_path = f"/tmp/tpu_probe_{os.getpid()}_{int(t0)}.err"
         with open(err_path, "w") as err_f:
             p = subprocess.Popen(
                 [sys.executable, "-c", _PROBE_CODE],
+                env=self_register_child_env(),
                 stdout=subprocess.PIPE, stderr=err_f, text=True)
             while time.time() - t0 < wait_s and p.poll() is None:
                 time.sleep(2)
@@ -496,7 +538,8 @@ def _bert_x32_subprocess(wait_s=900):
     claim is exclusive per process, so a child spawned while the parent
     holds the device could never start.  Abandoned (never killed) on
     deadline — a kill mid-claim wedges the tunnel."""
-    env = dict(os.environ, PADDLE_TPU_X32="1",
+    env = _axon_probe_mod().self_register_child_env()
+    env.update(PADDLE_TPU_X32="1",
                PADDLE_TPU_BENCH_CONFIGS="bert",
                PADDLE_TPU_BENCH_SUBPROC="1")
     t0 = time.time()
@@ -559,6 +602,25 @@ def main():
     if (info is not None and info.get("platform") == "tpu"
             and not subproc and "bert" in [c.strip() for c in configs]):
         x32_bert = _bert_x32_subprocess()
+
+    if not force_cpu and not os.environ.get("_AXON_REGISTERED"):
+        # started with the sitecustomize gate blanked (subproc children
+        # get self_register_child_env): register with a FINITE claim
+        # timeout so a lost grant raises instead of spinning forever
+        # (TUNNEL.md).  Failure is non-fatal — config runners catch it.
+        if not relay_alive():
+            log("relay dead before registration; emitting unreachable "
+                "marker")
+            print(json.dumps({
+                "metric": HEADLINE, "value": 0.0, "unit": "tokens/s",
+                "vs_baseline": 0.0, "tpu_unreachable": True,
+            }), flush=True)
+            return
+        try:
+            _axon_probe_mod().ensure_registered(claim_timeout_s=300)
+            log("bounded axon registration (claim_timeout_s=300)")
+        except Exception as e:
+            log(f"bounded self-registration failed: {e}")
 
     if force_cpu:
         import jax

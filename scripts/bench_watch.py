@@ -62,9 +62,19 @@ def run(cmd, env_extra=None, deadline_s=3600):
 
 
 def main():
+    import bench
     n = 0
     while True:
         n += 1
+        if not bench.relay_alive():
+            # ms-cheap socket check (TUNNEL.md): a dead relay refuses
+            # 127.0.0.1:8082 and cannot be restarted in-container; a
+            # jax probe against it would hang in connect-retry.  Poll
+            # cheaply and often in case the driver restarts transport.
+            log(f"poll {n}: relay dead (ECONNREFUSED 8082); "
+                "sleeping 60s")
+            time.sleep(60)
+            continue
         info = probe_once(PROBE_WAIT_S)
         if info is not None and info.get("platform") == "tpu":
             log(f"HEALTHY WINDOW (probe {n}): {info}")

@@ -1,0 +1,72 @@
+"""Tunnel probe helpers (TUNNEL.md): socket liveness + bounded-claim
+child env.  No TPU needed — the relay-liveness contract is plain TCP."""
+import os
+import socket
+import importlib.util
+
+import pytest
+
+HERE = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load():
+    spec = importlib.util.spec_from_file_location(
+        "axon_probe", os.path.join(
+            HERE, "paddle_tpu", "utils", "axon_probe.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_relay_alive_true_on_listening_port():
+    ap = _load()
+    srv = socket.socket()
+    srv.bind(("127.0.0.1", 0))
+    srv.listen(1)
+    try:
+        assert ap.relay_alive(port=srv.getsockname()[1]) is True
+    finally:
+        srv.close()
+
+
+def test_relay_alive_false_on_refused_port():
+    ap = _load()
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()  # bound-then-closed: nothing listens here now
+    assert ap.relay_alive(port=port) is False
+
+
+def test_self_register_child_env_blanks_gate_and_sentinel():
+    ap = _load()
+    base = {"PALLAS_AXON_POOL_IPS": "127.0.0.1",
+            "_AXON_REGISTERED": "1", "KEEP": "x"}
+    env = ap.self_register_child_env(base)
+    assert env["PALLAS_AXON_POOL_IPS"] == ""   # sitecustomize gate off
+    assert "_AXON_REGISTERED" not in env       # would no-op the child
+    assert env["KEEP"] == "x"
+    assert base["_AXON_REGISTERED"] == "1"     # base not mutated
+
+
+def test_ensure_registered_is_noop_when_sentinel_set(monkeypatch):
+    ap = _load()
+    monkeypatch.setenv("_AXON_REGISTERED", "1")
+    calls = []
+    monkeypatch.setattr(ap, "bounded_register",
+                        lambda **kw: calls.append(kw))
+    ap.ensure_registered(claim_timeout_s=7)
+    assert calls == []
+
+
+def test_bench_probe_fast_fails_without_relay(monkeypatch):
+    """bench.probe_device must return None in <1s when the relay is
+    down — never spawn a jax child against a refused port."""
+    import sys
+    import time
+    sys.path.insert(0, HERE)
+    import bench
+    monkeypatch.setattr(bench, "relay_alive", lambda: False)
+    t0 = time.time()
+    assert bench.probe_device(wait_s=60, attempts=2) is None
+    assert time.time() - t0 < 1.0
