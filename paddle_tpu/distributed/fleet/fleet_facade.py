@@ -103,6 +103,13 @@ def distributed_model(model):
         init()
         hcg = _fleet_state["hcg"]
     if hcg.get_pipe_parallel_world_size() > 1:
+        n_virtual = getattr(model, "_num_virtual_pipeline_stages", 1)
+        if n_virtual > 1:
+            from .meta_parallel.pipeline_parallel import \
+                PipelineParallelWithInterleave
+            return PipelineParallelWithInterleave(
+                model, hcg, _fleet_state["strategy"],
+                num_virtual_pipeline_stages=n_virtual)
         from .meta_parallel.pipeline_parallel import PipelineParallel
         return PipelineParallel(model, hcg,
                                 _fleet_state["strategy"])

@@ -49,6 +49,8 @@ class PipelineLayer(Layer):
         self._loss_fn = loss_fn
         self._topo = topology
         self._recompute_interval = recompute_interval
+        self._num_virtual_pipeline_stages = \
+            int(num_virtual_pipeline_stages or 1)
         self._num_stages = num_stages or (
             topology.get_dim("pipe") if topology else 1)
         descs = list(layers)

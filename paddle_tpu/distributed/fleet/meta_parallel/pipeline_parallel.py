@@ -68,15 +68,16 @@ class PipelineParallel(DataParallel):
             # GradScaler); secondary: shard_map GPipe (homogeneous, mp=1)
             try:
                 from .pp_utils import GlobalPipelineEngine
+                n_virtual = getattr(self, "_num_virtual_stages", 1)
                 self._engine = GlobalPipelineEngine(
                     self._pipeline_layer, hcg, optimizer,
                     n_micro=max(self.accumulate_steps, 1),
-                    remat=True)
+                    remat=True, n_virtual=n_virtual)
                 logger.info(
                     "pipeline: global-array GPipe engine over pp=%d, "
-                    "%d microbatches",
+                    "%d microbatches, %d virtual stage(s)",
                     hcg.get_pipe_parallel_world_size(),
-                    max(self.accumulate_steps, 1))
+                    max(self.accumulate_steps, 1), n_virtual)
                 return
             except Exception as e:
                 logger.warning(
@@ -251,25 +252,32 @@ class PipelineParallel(DataParallel):
 class PipelineParallelWithInterleave(PipelineParallel):
     """Interleaved (virtual-pipeline) variant.
 
-    The reference's interleaved 1F1B shrinks the pipeline bubble by
-    giving each rank v non-contiguous stage chunks scheduled
-    ASYNCHRONOUSLY — a rank starts a later chunk of an early microbatch
-    while an earlier chunk of a later microbatch is still elsewhere.
-    That gain fundamentally requires per-rank asynchronous progress.
-    This framework's pipeline is a single lockstep SPMD scan: per tick,
-    every device advances every chunk it holds, so with round-robin
-    chunk placement a device processes its v chunks SERIALLY inside one
-    tick — tick time stays one full stage regardless of v, the
-    fill/drain is (P-1) ticks either way, and the compiled schedule is
-    mathematically identical to PipelineParallel's (same bubble
-    fraction (P-1)/(P-1+m)).  Expressing true interleaved 1F1B needs
-    per-stage programs in a multi-controller runtime, not a
-    single-program scan.  The class is kept for API parity; it accepts
-    and records num_virtual_pipeline_stages and must not be counted as
-    interleaved scheduling.
+    Reference parity: `fleet/meta_parallel/pipeline_parallel.py`
+    PipelineParallelWithInterleave (Megatron virtual stages)
+    [UNVERIFIED — empty reference mount; SURVEY.md:156].
+
+    TPU-native redesign: the trunk is cut into pp*v chunks assigned
+    ROUND-ROBIN (chunk c -> mesh slot c % pp, phase c // pp) and the
+    global-array engine's scan computes ONE chunk per slot per tick —
+    each slot's active chunk is selected by a per-(tick, slot) phase
+    index that GATHERS the chunk's weights from a replicated (v, ...)
+    dim of the pp-sharded parameter stack.  Selection over weights is
+    data movement, not a serial loop over v chunks (and not a
+    lax.switch, which under vmap would execute every branch), so a
+    tick costs ~1/v of a full-stage tick and the schedule runs
+    n_micro*v + pp - 1 ticks: the fill/drain bubble shrinks from
+    (pp-1) full-stage ticks to (pp-1) chunk ticks — the Megatron
+    bubble reduction, inside one compiled SPMD program.  See
+    GlobalPipelineEngine(n_virtual=v) and PP_MEMORY.md for the
+    measured bubble/memory table.
     """
 
     def __init__(self, layers, hcg=None, strategy=None,
                  num_virtual_pipeline_stages=None, **kwargs):
         super().__init__(layers, hcg=hcg, strategy=strategy, **kwargs)
-        self._num_virtual_stages = num_virtual_pipeline_stages or 1
+        # kwarg wins; else the PipelineLayer's own recorded request
+        # (constructing this class directly must not silently drop the
+        # layer's num_virtual_pipeline_stages)
+        self._num_virtual_stages = int(
+            num_virtual_pipeline_stages
+            or getattr(layers, "_num_virtual_pipeline_stages", 1) or 1)

@@ -112,6 +112,42 @@ def _find_trunk(sigs, n_stages, max_edge=8):
     return pre, body, post
 
 
+def _interleave_schedule(n_micro, pp, v):
+    """Static per-tick control arrays for the interleaved schedule.
+
+    Chunks are assigned round-robin (chunk c -> slot c % pp, phase
+    c // pp).  Micros are injected in groups of pp; group g's phase-k
+    chunks occupy slot 0 during ticks [g*v*pp + k*pp, ... + pp).  With
+    the activation wrap riding the roll (slot pp-1 -> slot 0), no
+    activation ever waits in a queue: slot 0's wrap arrival for phase
+    k+1 lands exactly when its phase-k window closes.  Total ticks
+    T = ((n_micro-1)//pp)*v*pp + (v-1)*pp + (n_micro-1)%pp + pp
+    (the last micro's final chunk at slot pp-1, inclusive) —
+    = n_micro*v + pp - 1 exactly when pp divides n_micro; a ragged
+    tail finishes a few ticks sooner (its group is partially masked
+    garbage).
+
+    Returns numpy arrays (inj[T] bool, inj_m[T] i32, ext[T] bool,
+    ext_m[T] i32, phase[T, pp] i32).
+    """
+    vp = v * pp
+    g_last = (n_micro - 1) // pp
+    j_last = (n_micro - 1) % pp
+    t_last = g_last * vp + (v - 1) * pp + j_last + (pp - 1)
+    T = t_last + 1
+    ts = np.arange(T)
+    inj_m = (ts // vp) * pp + (ts % pp)
+    inj = ((ts % vp) < pp) & (inj_m < n_micro)
+    r = ts - (pp - 1)
+    ext_m = (r // vp) * pp + (np.maximum(r, 0) % pp)
+    ext = (r >= 0) & ((np.maximum(r, 0) % vp) // pp == v - 1) \
+        & (ext_m >= 0) & (ext_m < n_micro)
+    phase = ((ts[:, None] - np.arange(pp)[None, :]) % vp) // pp
+    return (inj, np.clip(inj_m, 0, n_micro - 1).astype(np.int32),
+            ext, np.clip(ext_m, 0, n_micro - 1).astype(np.int32),
+            phase.astype(np.int32))
+
+
 class _PureSection:
     """Run an ordered list of (layer, forward_func) entries as a pure
     function of its unique parameter leaves (the tensor._value swap trick
@@ -167,14 +203,19 @@ class _SuspendConstraints:
 
 
 def _param_spec(t, extra_leading=None):
-    """PartitionSpec for a parameter: its mp placement if any."""
+    """PartitionSpec for a parameter: its mp placement if any.
+    ``extra_leading``: a single axis name, or a tuple of leading
+    entries (e.g. ``("pp", None)`` for the interleave's stacked
+    (pp, v, ...) layout)."""
     sh = getattr(t, "dist_spec", None)
     if isinstance(sh, NamedSharding):
         entries = tuple(sh.spec)
         entries += (None,) * (t._value.ndim - len(entries))
     else:
         entries = (None,) * t._value.ndim
-    if extra_leading is not None:
+    if isinstance(extra_leading, tuple):
+        entries = extra_leading + entries
+    elif extra_leading is not None:
         entries = (extra_leading,) + entries
     return P(*entries)
 
@@ -184,7 +225,7 @@ class GlobalPipelineEngine:
     sections; composes with mp (tensor parallel) and dp/sharding axes."""
 
     def __init__(self, pipeline_layer, hcg, optimizer, n_micro,
-                 remat=True):
+                 remat=True, n_virtual=1):
         self.pl = pipeline_layer
         self.hcg = hcg
         self.mesh = hcg.mesh
@@ -196,38 +237,57 @@ class GlobalPipelineEngine:
         self.optimizer = optimizer
         self.n_micro = int(n_micro)
         self.n_stages = int(self.mesh.shape["pp"])
+        self.n_virtual = int(n_virtual or 1)
+        if self.n_virtual < 1:
+            raise ValueError("n_virtual must be >= 1")
         self.remat = remat
         self._compiled = {}
         self._step_host = 0
         self._dirty = False
 
+        # Interleave (n_virtual = v > 1): the trunk is cut into
+        # pp*v chunks assigned ROUND-ROBIN — chunk c lives on pp slot
+        # c % pp as its phase c // pp.  Per schedule tick each slot
+        # computes exactly ONE chunk (its weights selected by a
+        # per-slot phase GATHER on a (pp, v, ...) stacked dim — data
+        # movement, not a serial loop over chunks), so a tick costs
+        # 1/v of a full-stage tick and the fill/drain bubble shrinks
+        # from (pp-1) full-stage ticks to (pp-1) chunk ticks — the
+        # Megatron virtual-stage bubble reduction, in one SPMD scan.
+        n_chunks = self.n_stages * self.n_virtual
         entries = list(pipeline_layer.run_function)
         sigs = [_entry_signature(e) for e in entries]
-        split = _find_trunk(sigs, self.n_stages)
+        split = _find_trunk(sigs, n_chunks)
         if split is None:
             raise ValueError(
                 "no periodic trunk divisible into "
-                f"{self.n_stages} stages in {len(entries)} layers")
+                f"{n_chunks} chunks ({self.n_stages} stages x "
+                f"{self.n_virtual} virtual) in {len(entries)} layers")
         pre_n, body_n, post_n = split
-        per_stage_n = body_n // self.n_stages
+        per_chunk_n = body_n // n_chunks
         self.pre = _PureSection(entries[:pre_n])
         self.post = _PureSection(entries[pre_n + body_n:])
-        stage_entries = [
-            entries[pre_n + s * per_stage_n:
-                    pre_n + (s + 1) * per_stage_n]
-            for s in range(self.n_stages)]
-        self.stage_sections = [_PureSection(e) for e in stage_entries]
-        self.body_template = self.stage_sections[0]
-        if any(s.buffers for s in self.stage_sections):
+        chunk_entries = [
+            entries[pre_n + c * per_chunk_n:
+                    pre_n + (c + 1) * per_chunk_n]
+            for c in range(n_chunks)]
+        # chunk_sections[c]: model order; slot s holds chunks
+        # [k*pp + s for k in range(v)] (round-robin)
+        self.chunk_sections = [_PureSection(e) for e in chunk_entries]
+        # kept name: at v=1 a "chunk" IS a stage (back-compat for
+        # sync_params_to_layers and external introspection)
+        self.stage_sections = self.chunk_sections
+        self.body_template = self.chunk_sections[0]
+        if any(s.buffers for s in self.chunk_sections):
             raise ValueError("trunk stages with buffers (e.g. BN "
                              "running stats) are not supported")
         n_bp = len(self.body_template.params)
-        if any(len(s.params) != n_bp for s in self.stage_sections):
+        if any(len(s.params) != n_bp for s in self.chunk_sections):
             raise ValueError("stage param counts differ")
         logger.info(
-            "pipeline(global): pre=%d trunk=%d (%d/stage x %d stages) "
-            "post=%d layers", pre_n, body_n, per_stage_n, self.n_stages,
-            post_n)
+            "pipeline(global): pre=%d trunk=%d (%d/chunk x %d stages "
+            "x %d virtual) post=%d layers", pre_n, body_n, per_chunk_n,
+            self.n_stages, self.n_virtual, post_n)
 
         # outer params: pre+post unique tensors (tied weights dedup here)
         outer, seen = [], set()
@@ -241,18 +301,29 @@ class GlobalPipelineEngine:
                              "pre/post sections is not supported")
         self.outer = outer
 
-        # trunk params stacked on a pp-sharded leading dim
+        # trunk params stacked on a pp-sharded leading dim; with
+        # virtual stages an extra REPLICATED phase dim rides second:
+        # (pp, v, ...), slot s phase k = chunk k*pp + s (round-robin)
         self.stacked = []
+        pp, v = self.n_stages, self.n_virtual
         for i in range(n_bp):
-            arr = jnp.stack([self.stage_sections[s].params[i]._value
-                             for s in range(self.n_stages)])
-            spec = _param_spec(self.stage_sections[0].params[i],
-                               extra_leading="pp")
+            if v == 1:
+                arr = jnp.stack([self.chunk_sections[s].params[i]._value
+                                 for s in range(pp)])
+                extra = ("pp",)
+            else:
+                arr = jnp.stack([
+                    jnp.stack([
+                        self.chunk_sections[k * pp + s].params[i]._value
+                        for k in range(v)])
+                    for s in range(pp)])
+                extra = ("pp", None)
+            tpl = self.chunk_sections[0].params[i]
+            spec = _param_spec(tpl, extra_leading=extra)
             arr = jax.device_put(arr, NamedSharding(self.mesh, spec))
             t = Tensor(arr, _internal=True)
-            t.stop_gradient = self.stage_sections[0].params[
-                i].stop_gradient
-            t.name = self.stage_sections[0].params[i].name + "@pp_stacked"
+            t.stop_gradient = tpl.stop_gradient
+            t.name = tpl.name + "@pp_stacked"
             t.dist_spec = NamedSharding(self.mesh, spec)
             self.stacked.append(t)
 
@@ -315,13 +386,32 @@ class GlobalPipelineEngine:
         pre_idx = [outer_pos[id(t)] for t in pre.params]
         post_idx = [outer_pos[id(t)] for t in post.params]
 
-        def body_one(stage_leaves, x):
-            with _SuspendConstraints():
-                return stage_tpl(stage_leaves, x)
+        n_virtual = self.n_virtual
 
-        if remat:
-            body_one = jax.checkpoint(body_one)
-        body_v = jax.vmap(body_one, in_axes=(0, 0))
+        if n_virtual == 1:
+            def body_one(stage_leaves, x):
+                with _SuspendConstraints():
+                    return stage_tpl(stage_leaves, x)
+
+            if remat:
+                body_one = jax.checkpoint(body_one)
+            body_v = jax.vmap(body_one, in_axes=(0, 0))
+        else:
+            def chunk_one(slot_leaves, phase, x):
+                # phase selects this slot's ACTIVE chunk for the tick:
+                # a gather on the replicated (v, ...) dim — weight data
+                # movement, not execution of all v chunks (a lax.switch
+                # under vmap would compute every branch)
+                leaves = tuple(
+                    jax.lax.dynamic_index_in_dim(w, phase, 0,
+                                                 keepdims=False)
+                    for w in slot_leaves)
+                with _SuspendConstraints():
+                    return stage_tpl(leaves, x)
+
+            if remat:
+                chunk_one = jax.checkpoint(chunk_one)
+            body_v = jax.vmap(chunk_one, in_axes=(0, 0, 0))
 
         def state_constraint(v, leading):
             spec = P(leading, batch_axes,
@@ -359,35 +449,74 @@ class GlobalPipelineEngine:
                 h = pre(pre_vals, xf) if pre.entries else xf
                 h = h.reshape((n_micro, mb) + h.shape[1:])
 
-                def tick(carry, t):
-                    state, outbuf = carry
-                    x_t = jnp.where(
-                        t < n_micro,
-                        jax.lax.dynamic_index_in_dim(
-                            h, jnp.clip(t, 0, n_micro - 1), 0,
-                            keepdims=False),
-                        jnp.zeros_like(h[0]))
-                    state = jnp.roll(state, 1, axis=0)
-                    state = jax.lax.dynamic_update_index_in_dim(
-                        state, x_t, 0, 0)
-                    state = state_constraint(state, "pp")
-                    state = body_v(tuple(s_vals), state)
-                    state = state_constraint(state, "pp")
-                    mi = t - (n_stages - 1)
-                    idx = jnp.clip(mi, 0, n_micro - 1)
-                    cur = jax.lax.dynamic_index_in_dim(
-                        outbuf, idx, 0, keepdims=False)
-                    new = jnp.where(mi >= 0, state[n_stages - 1], cur)
-                    outbuf = jax.lax.dynamic_update_index_in_dim(
-                        outbuf, new, idx, 0)
-                    return (state, outbuf), None
-
                 state0 = jnp.zeros((n_stages,) + h.shape[1:], h.dtype)
                 state0 = state_constraint(state0, "pp")
                 outbuf0 = jnp.zeros_like(h)
-                (_, outbuf), _ = jax.lax.scan(
-                    tick, (state0, outbuf0),
-                    jnp.arange(n_micro + n_stages - 1))
+
+                if n_virtual == 1:
+                    def tick(carry, t):
+                        state, outbuf = carry
+                        x_t = jnp.where(
+                            t < n_micro,
+                            jax.lax.dynamic_index_in_dim(
+                                h, jnp.clip(t, 0, n_micro - 1), 0,
+                                keepdims=False),
+                            jnp.zeros_like(h[0]))
+                        state = jnp.roll(state, 1, axis=0)
+                        state = jax.lax.dynamic_update_index_in_dim(
+                            state, x_t, 0, 0)
+                        state = state_constraint(state, "pp")
+                        state = body_v(tuple(s_vals), state)
+                        state = state_constraint(state, "pp")
+                        mi = t - (n_stages - 1)
+                        idx = jnp.clip(mi, 0, n_micro - 1)
+                        cur = jax.lax.dynamic_index_in_dim(
+                            outbuf, idx, 0, keepdims=False)
+                        new = jnp.where(mi >= 0, state[n_stages - 1],
+                                        cur)
+                        outbuf = jax.lax.dynamic_update_index_in_dim(
+                            outbuf, new, idx, 0)
+                        return (state, outbuf), None
+
+                    (_, outbuf), _ = jax.lax.scan(
+                        tick, (state0, outbuf0),
+                        jnp.arange(n_micro + n_stages - 1))
+                else:
+                    # Interleaved schedule (see __init__): per tick
+                    # every slot computes ONE chunk, phases selected by
+                    # static per-(tick, slot) index arrays.  A micro
+                    # enters slot 0 whenever its phase-0 window is open,
+                    # wraps pp-1 -> 0 at each phase boundary via the
+                    # roll, and exits after v*pp chunk hops.  Ticks:
+                    # n_micro*v + pp - 1 at ~1/v full-stage cost each.
+                    sched = _interleave_schedule(
+                        n_micro, n_stages, n_virtual)
+                    inj, inj_m, ext, ext_m, phase = (
+                        jnp.asarray(a) for a in sched)
+
+                    def tick(carry, x_t):
+                        state, outbuf = carry
+                        inj_t, inj_mt, ext_t, ext_mt, phase_row = x_t
+                        x_in = jax.lax.dynamic_index_in_dim(
+                            h, inj_mt, 0, keepdims=False)
+                        new0 = jnp.where(inj_t, x_in, state[0])
+                        state = jax.lax.dynamic_update_index_in_dim(
+                            state, new0, 0, 0)
+                        state = state_constraint(state, "pp")
+                        state = body_v(tuple(s_vals), phase_row, state)
+                        state = state_constraint(state, "pp")
+                        moved = jnp.roll(state, 1, axis=0)
+                        moved = state_constraint(moved, "pp")
+                        cur = jax.lax.dynamic_index_in_dim(
+                            outbuf, ext_mt, 0, keepdims=False)
+                        outbuf = jax.lax.dynamic_update_index_in_dim(
+                            outbuf, jnp.where(ext_t, moved[0], cur),
+                            ext_mt, 0)
+                        return (moved, outbuf), None
+
+                    (_, outbuf), _ = jax.lax.scan(
+                        tick, (state0, outbuf0),
+                        (inj, inj_m, ext, ext_m, phase))
 
                 of = outbuf.reshape((n_micro * mb,) + outbuf.shape[2:])
                 out = post(post_vals, of) if post.entries else of
@@ -479,13 +608,19 @@ class GlobalPipelineEngine:
         return float(loss), bool(found_inf)
 
     def sync_params_to_layers(self):
-        """Scatter trained trunk params back into the per-stage eager
+        """Scatter trained trunk params back into the per-chunk eager
         layers (outer params are trained in place already)."""
         if not self._dirty:
             return
+        pp, v = self.n_stages, self.n_virtual
         for i, st in enumerate(self.stacked):
             host = np.asarray(st._value)
-            for s in range(self.n_stages):
-                self.stage_sections[s].params[i]._value = \
-                    jnp.asarray(host[s])
+            for s in range(pp):
+                if v == 1:
+                    self.chunk_sections[s].params[i]._value = \
+                        jnp.asarray(host[s])
+                else:
+                    for k in range(v):
+                        self.chunk_sections[k * pp + s].params[
+                            i]._value = jnp.asarray(host[s, k])
         self._dirty = False

@@ -31,7 +31,8 @@ def log(msg):
     print(f"[ppmem] {msg}", flush=True)
 
 
-def build_engine(n_micro, remat, hidden=256, layers=8, seq=128):
+def build_engine(n_micro, remat, hidden=256, layers=8, seq=128,
+                 n_virtual=1):
     import paddle_tpu as paddle
     from paddle_tpu import nn, optimizer
     from paddle_tpu.distributed import fleet
@@ -62,11 +63,14 @@ def build_engine(n_micro, remat, hidden=256, layers=8, seq=128):
     opt = paddle.optimizer.SGD(learning_rate=0.1,
                                parameters=pl.parameters())
     return GlobalPipelineEngine(pl, _ff._fleet_state["hcg"], opt,
-                                n_micro=n_micro, remat=remat)
+                                n_micro=n_micro, remat=remat,
+                                n_virtual=n_virtual)
 
 
-def engine_memory(n_micro, remat, mb=2, hidden=256, seq=128):
-    eng = build_engine(n_micro, remat, hidden=hidden)
+def engine_memory(n_micro, remat, mb=2, hidden=256, seq=128,
+                  n_virtual=1):
+    eng = build_engine(n_micro, remat, hidden=hidden,
+                       n_virtual=n_virtual)
     x = jnp.zeros((n_micro, mb, seq, hidden), jnp.float32)
     y = jnp.zeros((n_micro, mb, seq, hidden), jnp.float32)
     fn = eng._build(x, y, False)
@@ -135,6 +139,25 @@ def fmt(mem):
             f"out={mem.output_size_in_bytes/gb:7.1f} MiB")
 
 
+def bubble_rows():
+    """Analytic schedule accounting (exact for the compiled scans):
+    plain GPipe runs n_micro + pp - 1 ticks of one FULL stage each;
+    interleave v runs n_micro*v + pp - 1 ticks of one CHUNK (= 1/v
+    stage) each.  Cost in stage-tick units = ticks/v; bubble fraction
+    = 1 - ideal/cost."""
+    out = []
+    pp = 4
+    for n_micro in (4, 8, 16):
+        for v in (1, 2):
+            ticks = n_micro * v + pp - 1
+            cost = ticks / v
+            bubble = 1.0 - n_micro / cost
+            out.append(
+                f"pp={pp} n_micro={n_micro:<3d} v={v}:  ticks={ticks:<3d}"
+                f"  cost={cost:6.1f} stage-ticks  bubble={bubble:6.1%}")
+    return out
+
+
 def main():
     rows = []
     for n_micro in (4, 8):
@@ -144,11 +167,19 @@ def main():
                     f"remat={str(remat):<5s} {fmt(mem)}")
             log(line)
             rows.append(line)
+        mem = engine_memory(n_micro, True, n_virtual=2)
+        line = (f"interleave v=2 n_micro={n_micro:<2d} remat=True  "
+                f"{fmt(mem)}")
+        log(line)
+        rows.append(line)
         mem = accum_memory(n_micro)
         line = (f"grad-accum     n_micro={n_micro:<2d} remat=n/a   "
                 f"{fmt(mem)}")
         log(line)
         rows.append(line)
+    brows = bubble_rows()
+    for b in brows:
+        log(b)
 
     out = os.path.join(os.path.dirname(os.path.dirname(
         os.path.abspath(__file__))), "PP_MEMORY.md")
@@ -163,8 +194,16 @@ def main():
             "Interpretation: remat bounds the scan's activation "
             "residency (the 1F1B\nmemory win the docstring claims); "
             "without remat the scan carries every\ntick's activations "
-            "to the backward.  Re-run: `python "
-            "scripts/pp_memory_probe.py`.\n")
+            "to the backward.\n\n"
+            "## Interleaved virtual stages (VERDICT r4 item 5)\n\n"
+            "Schedule accounting — exact for the compiled scans: plain "
+            "GPipe runs\nn_micro + pp - 1 ticks of one FULL stage; "
+            "interleave v runs\nn_micro*v + pp - 1 ticks of one CHUNK "
+            "(1/v stage).  Bubble shrinks ~v x;\nthe interleave rows "
+            "above show the memory cost of the (pp, v, ...) weight\n"
+            "stack + per-tick phase gather.\n\n"
+            "```\n" + "\n".join(brows) + "\n```\n\n"
+            "Re-run: `python scripts/pp_memory_probe.py`.\n")
     log(f"wrote {out}")
 
 

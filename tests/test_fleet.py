@@ -392,3 +392,115 @@ def test_pipeline_global_engine_grad_scaler():
         "scaler retired the engine"
     assert losses[-1] < losses[0]
     assert scaler._scale >= 1024.0  # grew (finite grads) or unchanged
+
+
+def test_interleaved_pipeline_parity_and_schedule():
+    """Virtual-stage interleave (VERDICT r4 "next" #5): pp=2, v=2 over a
+    GPT-shaped trunk (embed -> 4 blocks -> tied head).  The engine must
+    (a) schedule DIFFERENTLY from plain GPipe — n_micro*v + pp - 1
+    chunk ticks with per-(tick,slot) phase gathers, (b) stack weights
+    (pp, v, ...) round-robin, and (c) match the single-device loss
+    curve exactly like the non-interleaved engine does."""
+    from paddle_tpu.distributed import fleet
+    from paddle_tpu.distributed.fleet.meta_parallel.parallel_layers.\
+        pp_layers import PipelineLayer
+    from paddle_tpu.distributed.fleet.meta_parallel.pipeline_parallel \
+        import PipelineParallelWithInterleave
+    from paddle_tpu.distributed.fleet.meta_parallel.pp_utils import \
+        GlobalPipelineEngine
+    from paddle_tpu.distributed.fleet.meta_parallel.pp_utils.\
+        global_schedule import _interleave_schedule
+
+    V, H, S = 32, 16, 6
+
+    class Embed(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.emb = nn.Embedding(V, H)
+
+        def forward(self, x):
+            return self.emb(x)
+
+    class Block(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.l1 = nn.Linear(H, 2 * H)
+            self.l2 = nn.Linear(2 * H, H)
+
+        def forward(self, x):
+            return x + self.l2(paddle.tanh(self.l1(x)))
+
+    class Head(nn.Layer):
+        def __init__(self, embed):
+            super().__init__()
+            self.ln = nn.LayerNorm(H)
+            self.embed = embed
+
+        def forward(self, x):
+            return paddle.matmul(self.ln(x), self.embed.emb.weight,
+                                 transpose_y=True)
+
+    def build(seed):
+        paddle.seed(seed)
+        embed = Embed()
+        return [embed] + [Block() for _ in range(4)] + [Head(embed)]
+
+    def batches(i):
+        rng = np.random.RandomState(77 + i)
+        x = rng.randint(0, V, (8, S)).astype(np.int64)
+        return x, np.roll(x, -1, axis=1)
+
+    def xent(o, l):
+        return paddle.nn.functional.cross_entropy(
+            o.reshape([-1, V]), l.reshape([-1]))
+
+    ref_layers = build(5)
+    ref_model = nn.Sequential(*ref_layers)
+    ref_opt = optimizer.SGD(learning_rate=0.1,
+                            parameters=ref_model.parameters())
+    ref = []
+    for i in range(6):
+        x, y = batches(i)
+        loss = xent(ref_model(paddle.to_tensor(x)), paddle.to_tensor(y))
+        loss.backward()
+        ref_opt.step()
+        ref_opt.clear_grad()
+        ref.append(float(loss))
+
+    strategy = fleet.DistributedStrategy()
+    strategy.hybrid_configs = {"dp_degree": 2, "pp_degree": 2}
+    strategy.pipeline_configs = {"accumulate_steps": 4,
+                                 "micro_batch_size": 2}
+    fleet.init(is_collective=True, strategy=strategy)
+    pl = PipelineLayer(layers=build(5), num_stages=2, loss_fn=xent,
+                       num_virtual_pipeline_stages=2)
+    model = fleet.distributed_model(pl)
+    assert isinstance(model, PipelineParallelWithInterleave)
+    opt = optimizer.SGD(learning_rate=0.1, parameters=pl.parameters())
+
+    losses = []
+    for i in range(6):
+        x, y = batches(i)
+        loss = model.train_batch(
+            (paddle.to_tensor(x), paddle.to_tensor(y)), opt)
+        losses.append(float(loss))
+
+    eng = model._engine
+    assert isinstance(eng, GlobalPipelineEngine) and eng.n_virtual == 2
+    # (b) round-robin (pp, v, ...) stacking: 4 blocks -> 4 chunks
+    assert len(eng.chunk_sections) == 4
+    assert eng.stacked[0]._value.shape[:2] == (2, 2)
+    # (a) schedules differently: interleave tick count vs GPipe's
+    inj, _, ext, _, phase = _interleave_schedule(4, 2, 2)
+    assert len(inj) == 4 * 2 + 2 - 1  # n_micro*v + pp - 1 = 9
+    assert len(inj) != 4 + 2 - 1      # plain GPipe would be 5
+    assert phase.max() == 1 and phase.min() == 0
+    # (c) loss parity with single-device training
+    np.testing.assert_allclose(losses, ref, rtol=2e-4, atol=2e-5)
+
+    # tied embedding trained identically through the interleave
+    eng.sync_params_to_layers()
+    got = np.asarray(pl.run_function[0][0].emb.weight._value)
+    np.testing.assert_allclose(
+        got, np.asarray(ref_layers[0].emb.weight._value),
+        rtol=1e-3, atol=1e-4)
