@@ -72,18 +72,7 @@ def empty(shape, dtype=None, name=None):
     return zeros(shape, dtype, name)
 
 
-def zeros_like(x, dtype=None, name=None):
-    return dispatch("zeros_like",
-                    lambda v, *, dtype: jnp.zeros_like(v, dtype), (x,),
-                    dict(dtype=None if dtype is None else to_jax_dtype(dtype)),
-                    differentiable=False)
-
-
-def ones_like(x, dtype=None, name=None):
-    return dispatch("ones_like",
-                    lambda v, *, dtype: jnp.ones_like(v, dtype), (x,),
-                    dict(dtype=None if dtype is None else to_jax_dtype(dtype)),
-                    differentiable=False)
+from ._generated import zeros_like, ones_like  # noqa: F401
 
 
 def full_like(x, fill_value, dtype=None, name=None):

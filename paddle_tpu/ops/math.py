@@ -55,6 +55,9 @@ from ._generated import (  # noqa: F401
 sgn = sign
 
 
+from ._generated import cumsum, cumprod, logsumexp  # noqa: F401
+
+
 def clip(x, min=None, max=None, name=None):
     min = min.item() if isinstance(min, Tensor) and min.size == 1 else min
     max = max.item() if isinstance(max, Tensor) and max.size == 1 else max
@@ -117,14 +120,6 @@ def nan_to_num(x, nan=0.0, posinf=None, neginf=None, name=None):
         (x,), dict(nan=nan, posinf=posinf, neginf=neginf))
 
 
-def logsumexp(x, axis=None, keepdim=False, name=None):
-    return dispatch(
-        "logsumexp",
-        lambda v, *, axis, keepdims: jax.scipy.special.logsumexp(
-            v, axis=axis, keepdims=keepdims),
-        (x,), dict(axis=_axis(axis), keepdims=bool(keepdim)))
-
-
 def logit(x, eps=None, name=None):
     def impl(v, *, eps):
         if eps is not None:
@@ -132,41 +127,6 @@ def logit(x, eps=None, name=None):
         return jnp.log(v) - jnp.log1p(-v)
 
     return dispatch("logit", impl, (x,), dict(eps=eps))
-
-
-def _axis(axis):
-    if axis is None:
-        return None
-    if isinstance(axis, Tensor):
-        a = axis.numpy().tolist()
-        return tuple(a) if isinstance(a, list) else int(a)
-    if isinstance(axis, (list, tuple)):
-        return tuple(int(a) for a in axis)
-    return int(axis)
-
-
-def cumsum(x, axis=None, dtype=None, name=None):
-    def impl(v, *, axis, dtype):
-        if axis is None:
-            v = v.reshape(-1)
-            axis = 0
-        return jnp.cumsum(v, axis=axis, dtype=dtype)
-
-    return dispatch("cumsum", impl, (x,),
-                    dict(axis=None if axis is None else int(axis),
-                         dtype=None if dtype is None else to_jax_dtype(dtype)))
-
-
-def cumprod(x, dim=None, dtype=None, name=None):
-    def impl(v, *, axis, dtype):
-        if axis is None:
-            v = v.reshape(-1)
-            axis = 0
-        return jnp.cumprod(v, axis=axis, dtype=dtype)
-
-    return dispatch("cumprod", impl, (x,),
-                    dict(axis=None if dim is None else int(dim),
-                         dtype=None if dtype is None else to_jax_dtype(dtype)))
 
 
 def cummax(x, axis=None, dtype="int64", name=None):

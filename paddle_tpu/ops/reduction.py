@@ -20,101 +20,15 @@ __all__ = [
 ]
 
 
-def _axis(axis):
-    if axis is None:
-        return None
-    if isinstance(axis, Tensor):
-        a = axis.numpy().tolist()
-        return tuple(int(x) for x in a) if isinstance(a, list) else int(a)
-    if isinstance(axis, (list, tuple)):
-        return tuple(int(a) for a in axis)
-    return int(axis)
-
-
-def sum(x, axis=None, dtype=None, keepdim=False, name=None):
-    def impl(v, *, axis, dtype, keepdims):
-        if dtype is None and jnp.issubdtype(v.dtype, jnp.bool_):
-            dtype = jnp.int64
-        return jnp.sum(v, axis=axis, dtype=dtype, keepdims=keepdims)
-
-    return dispatch("reduce_sum", impl, (x,),
-                    dict(axis=_axis(axis),
-                         dtype=None if dtype is None else to_jax_dtype(dtype),
-                         keepdims=bool(keepdim)))
-
-
-def nansum(x, axis=None, dtype=None, keepdim=False, name=None):
-    return dispatch(
-        "nansum",
-        lambda v, *, axis, dtype, keepdims: jnp.nansum(
-            v, axis=axis, dtype=dtype, keepdims=keepdims),
-        (x,), dict(axis=_axis(axis),
-                   dtype=None if dtype is None else to_jax_dtype(dtype),
-                   keepdims=bool(keepdim)))
-
-
-def mean(x, axis=None, keepdim=False, name=None):
-    return dispatch(
-        "reduce_mean",
-        lambda v, *, axis, keepdims: jnp.mean(v, axis=axis,
-                                              keepdims=keepdims),
-        (x,), dict(axis=_axis(axis), keepdims=bool(keepdim)))
-
-
-def nanmean(x, axis=None, keepdim=False, name=None):
-    return dispatch(
-        "nanmean",
-        lambda v, *, axis, keepdims: jnp.nanmean(v, axis=axis,
-                                                 keepdims=keepdims),
-        (x,), dict(axis=_axis(axis), keepdims=bool(keepdim)))
-
-
-def max(x, axis=None, keepdim=False, name=None):
-    return dispatch(
-        "reduce_max",
-        lambda v, *, axis, keepdims: jnp.max(v, axis=axis,
-                                             keepdims=keepdims),
-        (x,), dict(axis=_axis(axis), keepdims=bool(keepdim)))
-
-
-def min(x, axis=None, keepdim=False, name=None):
-    return dispatch(
-        "reduce_min",
-        lambda v, *, axis, keepdims: jnp.min(v, axis=axis,
-                                             keepdims=keepdims),
-        (x,), dict(axis=_axis(axis), keepdims=bool(keepdim)))
+# Reduction bindings are GENERATED from ops.yaml (kind: reduction)
+# - python -m paddle_tpu.ops.gen.
+from ._generated import (  # noqa: F401
+    _axis, sum, nansum, mean, nanmean, max, min, prod, all, any,
+    count_nonzero)
 
 
 amax = max
 amin = min
-
-
-def prod(x, axis=None, keepdim=False, dtype=None, name=None):
-    return dispatch(
-        "reduce_prod",
-        lambda v, *, axis, dtype, keepdims: jnp.prod(
-            v, axis=axis, dtype=dtype, keepdims=keepdims),
-        (x,), dict(axis=_axis(axis),
-                   dtype=None if dtype is None else to_jax_dtype(dtype),
-                   keepdims=bool(keepdim)))
-
-
-def all(x, axis=None, keepdim=False, name=None):
-    return dispatch(
-        "reduce_all",
-        lambda v, *, axis, keepdims: jnp.all(v, axis=axis,
-                                             keepdims=keepdims),
-        (x,), dict(axis=_axis(axis), keepdims=bool(keepdim)),
-        differentiable=False)
-
-
-def any(x, axis=None, keepdim=False, name=None):
-    return dispatch(
-        "reduce_any",
-        lambda v, *, axis, keepdims: jnp.any(v, axis=axis,
-                                             keepdims=keepdims),
-        (x,), dict(axis=_axis(axis), keepdims=bool(keepdim)),
-        differentiable=False)
 
 
 def argmax(x, axis=None, keepdim=False, dtype="int64", name=None):
@@ -261,10 +175,3 @@ def nanquantile(x, q, axis=None, keepdim=False, interpolation="linear",
                    method=interpolation))
 
 
-def count_nonzero(x, axis=None, keepdim=False, name=None):
-    return dispatch(
-        "count_nonzero",
-        lambda v, *, axis, keepdims: jnp.count_nonzero(
-            v, axis=axis, keepdims=keepdims).astype(jnp.int64),
-        (x,), dict(axis=_axis(axis), keepdims=bool(keepdim)),
-        differentiable=False)
