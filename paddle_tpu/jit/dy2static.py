@@ -32,6 +32,7 @@ import types
 logger = logging.getLogger("paddle_tpu.dy2static")
 
 __all__ = ["convert_function", "convert_ifelse", "convert_while_loop",
+           "convert_range_for", "convert_for_loop",
            "convert_logical_and", "convert_logical_or",
            "convert_logical_not", "UNDEF"]
 
@@ -93,6 +94,82 @@ def convert_while_loop(cond_fn, body_fn, init_vars):
     return vars_
 
 
+def _as_int(v):
+    from ..core.tensor import Tensor
+    if isinstance(v, Tensor):
+        return int(v.item())        # sync point under lazy mode
+    return int(v)
+
+
+def convert_range_for(bounds, body_fn, init_vars, tgt0):
+    """``for i in range(*bounds): body`` — body_fn(i, *vars) -> vars.
+
+    Concrete bounds run a plain Python loop (unrolled under trace —
+    XLA-friendly for small static trip counts); any TRACED bound lowers
+    to a counter-carried `while_loop`.  Returns (*final_vars,
+    final_target); with zero traced iterations the final target is
+    start - step (Python would leave it untouched — unknowable at
+    trace time), documented caveat.
+    """
+    b = tuple(bounds)
+    if len(b) == 1:
+        start, stop, step = 0, b[0], 1
+    elif len(b) == 2:
+        start, stop, step = b[0], b[1], 1
+    else:
+        start, stop, step = b
+    if not (_is_traced(start) or _is_traced(stop) or _is_traced(step)):
+        vars_, tgt = tuple(init_vars), tgt0
+        for i in range(_as_int(start), _as_int(stop), _as_int(step)):
+            vars_ = tuple(body_fn(i, *vars_))
+            tgt = i
+        return vars_ + (tgt,)
+    if _is_traced(step):
+        raise ValueError(
+            "dy2static: `for i in range(...)` with a TRACED step is not "
+            "supported (the loop direction must be known at trace "
+            "time); pass the step as a Python int")
+    stepi = _as_int(step)
+    _check_no_undef(init_vars, "for")
+
+    def cond_fn(i, *vs):
+        return (i < stop) if stepi > 0 else (i > stop)
+
+    def body(i, *vs):
+        return (i + stepi,) + tuple(body_fn(i, *vs))
+
+    out = convert_while_loop(cond_fn, body, (start,) + tuple(init_vars))
+    return tuple(out[1:]) + (out[0] - stepi,)
+
+
+def convert_for_loop(seq, body_fn, init_vars, tgt0):
+    """``for x in seq: body`` — body_fn(x, *vars) -> vars.
+
+    A TRACED Tensor iterates its leading dim inside a `while_loop`
+    (the trip count is its STATIC shape, so the zero-iteration case is
+    exact); anything else runs the plain Python protocol.  Returns
+    (*final_vars, final_target)."""
+    if not _is_traced(seq):
+        vars_, tgt = tuple(init_vars), tgt0
+        for x in seq:
+            vars_ = tuple(body_fn(x, *vars_))
+            tgt = x
+        return vars_ + (tgt,)
+    n = int(seq.shape[0])
+    if n == 0:
+        return tuple(init_vars) + (tgt0,)
+    _check_no_undef(init_vars, "for")
+
+    def cond_fn(i, *vs):
+        return i < n
+
+    def body(i, *vs):
+        return (i + 1,) + tuple(body_fn(seq[i], *vs))
+
+    out = convert_while_loop(cond_fn, body, (0,) + tuple(init_vars))
+    return tuple(out[1:]) + (seq[n - 1],)
+
+
 def _check_no_undef(vals, kind):
     if any(isinstance(v, _Undefined) for v in
            (vals if isinstance(vals, (tuple, list)) else (vals,))):
@@ -133,17 +210,20 @@ class _Unsupported(Exception):
 
 
 def _assigned_names(nodes):
-    """Names bound by a statement list (shallow: no nested defs)."""
+    """Names bound by a statement list (shallow: no nested defs).
+    Synthetic ``__jst_*`` defs from already-converted inner blocks are
+    NOT user state and must never become carried/UNDEF-initialized
+    vars of an enclosing converted block."""
     out = []
 
     class V(ast.NodeVisitor):
         def visit_Name(self, n):
             if isinstance(n.ctx, (ast.Store, ast.Del)):
-                if n.id not in out:
+                if n.id not in out and not n.id.startswith("__jst_"):
                     out.append(n.id)
 
         def visit_FunctionDef(self, n):
-            if n.name not in out:
+            if n.name not in out and not n.name.startswith("__jst_"):
                 out.append(n.name)
 
         def visit_AsyncFunctionDef(self, n):
@@ -201,10 +281,12 @@ def _block_has_escape(nodes):
 
 
 class _Transformer(ast.NodeTransformer):
-    def __init__(self):
+    def __init__(self, range_is_builtin=True, qualname="?"):
         self.counter = 0
         self.changed = False
         self.seen_names: set = set()      # names assigned so far
+        self.range_is_builtin = range_is_builtin
+        self.qualname = qualname
 
     # --- helpers ---
     def _freshen(self, base):
@@ -325,6 +407,69 @@ class _Transformer(ast.NodeTransformer):
         return self._undef_inits(loop_vars, seen_before) + \
             [cond_def, body_def, call]
 
+    def visit_For(self, node):
+        seen_before = set(self.seen_names)
+        node.iter = self.visit(node.iter)
+        # ALL target names count as assigned before the body converts
+        # (a nested converted `if` must not UNDEF-init the loop target)
+        for t in ast.walk(node.target):
+            if isinstance(t, ast.Name):
+                self.seen_names.add(t.id)
+        if not isinstance(node.target, ast.Name):
+            node.body = self._visit_block(node.body)
+            logger.info("dy2static: %s: `for` with a non-name target "
+                        "keeps trace semantics", self.qualname)
+            return node
+        tgt = node.target.id
+        if tgt in _assigned_names(node.body):
+            node.body = self._visit_block(node.body)
+            logger.info(
+                "dy2static: %s: `for` target %r is reassigned in the "
+                "loop body; keeping trace semantics (conversion would "
+                "overwrite it with the iteration value)",
+                self.qualname, tgt)
+            return node
+        node.body = self._visit_block(node.body)
+        if node.orelse or _block_has_escape(node.body):
+            logger.info(
+                "dy2static: %s: `for` with %s keeps trace semantics",
+                self.qualname,
+                "an else clause" if node.orelse
+                else "break/continue/return")
+            return node
+        mod = [n for n in _assigned_names(node.body) if n != tgt]
+        self.changed = True
+        bname = self._freshen("forbody")
+        body_def = self._make_branch_fn(bname, [tgt] + mod, node.body,
+                                        extra_ret=self._tuple_expr(
+                                            mod, ast.Load))
+        # `for i in range(...)` passes the BOUNDS, not the range object
+        # (range() of a traced scalar would raise before conversion
+        # could see it)
+        if (self.range_is_builtin
+                and isinstance(node.iter, ast.Call)
+                and isinstance(node.iter.func, ast.Name)
+                and node.iter.func.id == "range"
+                and not node.iter.keywords):
+            conv = "convert_range_for"
+            iter_arg = ast.Tuple(elts=list(node.iter.args),
+                                 ctx=ast.Load())
+        else:
+            conv = "convert_for_loop"
+            iter_arg = node.iter
+        tgt0 = (ast.Name(id=tgt, ctx=ast.Load())
+                if tgt in seen_before else self._jst("UNDEF"))
+        call = ast.Assign(
+            targets=[self._tuple_expr(mod + [tgt], ast.Store)],
+            value=ast.Call(
+                func=self._jst(conv),
+                args=[iter_arg,
+                      ast.Name(id=bname, ctx=ast.Load()),
+                      self._tuple_expr(mod, ast.Load),
+                      tgt0],
+                keywords=[]))
+        return self._undef_inits(mod, seen_before) + [body_def, call]
+
     # --- expressions ---
     def visit_BoolOp(self, node):
         self.generic_visit(node)
@@ -388,7 +533,11 @@ def convert_function(fn):
         return fn
     fdef.decorator_list = []    # @to_static etc. must not re-apply
 
-    tr = _Transformer()
+    import builtins
+    tr = _Transformer(
+        range_is_builtin=(raw.__globals__.get("range", builtins.range)
+                          is builtins.range),
+        qualname=raw.__qualname__)
     try:
         tree = tr.visit(tree)
     except _Unsupported as e:

@@ -1,0 +1,14 @@
+"""Module-level `range` shadowing: dy2static must NOT reinterpret its
+arguments as integer loop bounds (test_shadowed_range...)."""
+import paddle_tpu as paddle
+
+
+def range(lo):   # noqa: A001 - deliberate shadow
+    return [lo, lo * 2]
+
+
+def use_shadowed_range(x):
+    s = paddle.zeros([])
+    for v in range(3):
+        s = s + x.sum() * v
+    return s

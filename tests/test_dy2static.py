@@ -110,3 +110,138 @@ def test_model_with_data_dependent_branch_roundtrips():
     for x, ref in zip(xs, refs):
         got = sm(paddle.to_tensor(x) + 0.5 * np.sign(x.mean())).numpy()
         np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-6)
+
+
+def _range_traced(x):
+    n = x.shape[0]
+    s = paddle.zeros([])
+    # trip count from a TRACED scalar: must lower to while_loop
+    k = paddle.cast(x.sum(), "int64")
+    for i in range(k):
+        s = s + x.mean() + i
+    return s
+
+
+def _range_static(x):
+    s = paddle.zeros([])
+    for i in range(3):
+        s = s + x.sum() * (i + 1)
+    return s
+
+
+def _iter_tensor(x):
+    s = paddle.zeros([])
+    for row in x:
+        s = s + row.max()
+    return s
+
+
+def test_for_range_traced_bound_converts():
+    """for i in range(traced_n) lowers to while_loop (VERDICT r4 #6):
+    the SAME compiled program runs different trip counts on data."""
+    sf = jit.to_static(_range_traced)
+    a = np.array([1.0, 1.0, 1.0], np.float32)      # k=3: s=3*1+0+1+2=6
+    np.testing.assert_allclose(float(sf(paddle.to_tensor(a))), 6.0)
+    b = np.array([1.0, 1.0, 1.0, 1.0, 1.0], np.float32)  # k=5: 5+10=15
+    np.testing.assert_allclose(float(sf(paddle.to_tensor(b))), 15.0)
+
+
+def test_for_range_static_unrolls_with_parity():
+    sf = jit.to_static(_range_static)
+    a = np.array([1.0, 2.0], np.float32)
+    np.testing.assert_allclose(float(sf(paddle.to_tensor(a))),
+                               3.0 * (1 + 2 + 3))
+
+
+def test_for_over_tensor_rows():
+    """for row in tensor iterates the leading dim in a while_loop."""
+    sf = jit.to_static(_iter_tensor)
+    a = np.array([[1.0, 5.0], [2.0, 3.0], [9.0, 0.0]], np.float32)
+    np.testing.assert_allclose(float(sf(paddle.to_tensor(a))),
+                               5.0 + 3.0 + 9.0)
+
+
+def test_for_target_read_after_loop():
+    def f(x):
+        s = paddle.zeros([])
+        for i in range(3):
+            s = s + x.sum()
+        return s + i   # python: i == 2 after the loop
+
+    sf = jit.to_static(f)
+    a = np.array([1.0], np.float32)
+    np.testing.assert_allclose(float(sf(paddle.to_tensor(a))),
+                               3.0 + 2.0)
+
+
+def test_for_with_break_falls_back_loudly(caplog):
+    def f(x):
+        s = paddle.zeros([])
+        for i in range(3):
+            if i == 2:
+                break
+            s = s + x.sum()
+        return s
+
+    import logging
+    with caplog.at_level(logging.INFO, "paddle_tpu.dy2static"):
+        sf = jit.to_static(f)
+        out = sf(paddle.to_tensor(np.array([1.0], np.float32)))
+    # unconverted loop still unrolls correctly at trace (static bounds)
+    np.testing.assert_allclose(float(out), 2.0)
+    assert any("break/continue/return" in r.message
+               for r in caplog.records), "fallback must be loud"
+
+
+def test_traced_for_containing_traced_if():
+    """The headline combination: data-dependent trip count AND a
+    data-dependent branch inside the body, one compiled program."""
+    def f(x):
+        s = paddle.zeros([])
+        k = paddle.cast(x.sum(), "int64")
+        for i in range(k):
+            if x.mean() > 0:
+                s = s + 1.0
+            else:
+                s = s - 1.0
+        return s
+
+    sf = jit.to_static(f)
+    a = np.array([1.0, 1.0, 1.0], np.float32)     # k=3, mean>0 -> +3
+    np.testing.assert_allclose(float(sf(paddle.to_tensor(a))), 3.0)
+
+
+def test_for_tuple_target_with_nested_if_keeps_python_semantics():
+    def f(x):
+        s = paddle.zeros([])
+        for a, b in [(1.0, 2.0), (3.0, 4.0)]:
+            if x.mean() > 0:
+                a = a + 1
+            s = s + a + b
+        return s
+
+    sf = jit.to_static(f)
+    out = sf(paddle.to_tensor(np.array([1.0], np.float32)))
+    np.testing.assert_allclose(float(out), (2.0 + 2.0) + (4.0 + 4.0))
+
+
+def test_for_target_reassigned_in_body_falls_back():
+    def f(x):
+        s = paddle.zeros([])
+        for i in range(3):
+            i = i * 10
+            s = s + x.sum() + i
+        return s + i   # python: i == 20 after the loop
+
+    sf = jit.to_static(f)
+    out = sf(paddle.to_tensor(np.array([1.0], np.float32)))
+    np.testing.assert_allclose(float(out), (1 + 0 + 1 + 10 + 1 + 20)
+                               + 20.0)
+
+
+def test_shadowed_range_is_not_reinterpreted():
+    import tests.helper_shadowed_range as mod
+    sf = jit.to_static(mod.use_shadowed_range)
+    out = sf(paddle.to_tensor(np.array([1.0], np.float32)))
+    # custom range(3) yields [3, 6]: s = x.sum()*3 + x.sum()*6
+    np.testing.assert_allclose(float(out), 9.0)
