@@ -105,28 +105,89 @@ def estimate_cost(fn, *example_args) -> CostEstimate:
     return est
 
 
+# measured alpha-beta constants installed by calibration.install_fit()
+# (auto-loaded once from .bench_cache/comm_fit.json when present and
+# recorded on the SAME platform as the running backend);
+# None → datasheet defaults above
+_MEASURED_FIT = None
+_FIT_LOADED = False
+
+
+def _current_platform():
+    """Backend platform WITHOUT forcing jax init (None if undecided)."""
+    import sys
+    jax = sys.modules.get("jax")
+    if jax is None:
+        return None
+    try:
+        return jax.devices()[0].platform
+    except Exception:
+        return None
+
+
+def _measured(kind):
+    global _MEASURED_FIT, _FIT_LOADED
+    if _MEASURED_FIT is None and not _FIT_LOADED:
+        _FIT_LOADED = True
+        try:
+            from .calibration import load_fit
+            payload = load_fit()
+            if payload:
+                plat = _current_platform()
+                if plat is None or payload.get("platform") == plat:
+                    _MEASURED_FIT = payload["fits"]
+                else:
+                    import logging
+                    logging.getLogger("paddle_tpu.auto_parallel").warning(
+                        "ignoring persisted comm fit measured on %r "
+                        "(running on %r); re-run calibration.calibrate()",
+                        payload.get("platform"), plat)
+        except Exception:
+            pass
+    if _MEASURED_FIT is None:
+        return None
+    # all_to_all rides the all_gather constants (same ring wire volume)
+    return _MEASURED_FIT.get(
+        kind, _MEASURED_FIT.get("all_gather")
+        if kind == "all_to_all" else None)
+
+
+def ring_steps_wire(kind: str, nbytes: float, axis_size: int):
+    """(hop steps, per-link wire bytes) of one collective on a ring.
+
+    THE single definition of the ring model — ``comm_cost_seconds``
+    evaluates it and ``calibration.fit_alpha_beta`` builds its design
+    matrix from it, so the two can never drift.  ``nbytes`` convention:
+    the GLOBAL logical array (gathered size for all_gather /
+    all_to_all, the reduced array for all_reduce / reduce_scatter, the
+    payload for permute).
+    """
+    steps = axis_size - 1
+    if kind == "all_reduce":
+        return 2 * steps, 2.0 * nbytes * steps / axis_size   # rs + ag
+    if kind in ("all_gather", "reduce_scatter", "all_to_all"):
+        return steps, nbytes * steps / axis_size
+    if kind == "permute":
+        return 1, float(nbytes)
+    raise ValueError(f"unknown collective kind {kind!r}")
+
+
 def comm_cost_seconds(nbytes: float, axis_size: int, kind: str) -> float:
     """Alpha-beta estimate of one collective on an ICI ring axis.
 
     kind: all_reduce | all_gather | reduce_scatter | all_to_all | permute
+
+    Constants come from a measured calibration fit when one is
+    installed/persisted (see ``calibration.py``), else v5e datasheet
+    estimates.
     """
     if axis_size <= 1 or nbytes <= 0:
         return 0.0
-    steps = axis_size - 1
-    per_hop = _ICI_LAT
-    if kind == "all_reduce":
-        wire = 2.0 * nbytes * steps / axis_size       # rs + ag
-        steps *= 2
-    elif kind in ("all_gather", "reduce_scatter"):
-        wire = nbytes * steps / axis_size
-    elif kind == "all_to_all":
-        wire = nbytes * steps / axis_size
-    elif kind == "permute":
-        wire = nbytes
-        steps = 1
-    else:
-        raise ValueError(f"unknown collective kind {kind!r}")
-    return steps * per_hop + wire / _ICI_BW
+    fit = _measured(kind)
+    per_hop = fit["alpha"] if fit else _ICI_LAT
+    bw = fit["beta"] if fit else _ICI_BW
+    steps, wire = ring_steps_wire(kind, nbytes, axis_size)
+    return steps * per_hop + wire / bw
 
 
 class Planner:

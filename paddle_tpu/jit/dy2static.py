@@ -130,6 +130,10 @@ def convert_range_for(bounds, body_fn, init_vars, tgt0):
             "supported (the loop direction must be known at trace "
             "time); pass the step as a Python int")
     stepi = _as_int(step)
+    if stepi == 0:
+        # mirror Python's range(): a zero step with traced bounds would
+        # otherwise lower to a non-terminating while_loop
+        raise ValueError("range() arg 3 must not be zero")
     _check_no_undef(init_vars, "for")
 
     def cond_fn(i, *vs):
@@ -447,6 +451,7 @@ class _Transformer(ast.NodeTransformer):
         # (range() of a traced scalar would raise before conversion
         # could see it)
         if (self.range_is_builtin
+                and "range" not in self.seen_names  # local/param shadow
                 and isinstance(node.iter, ast.Call)
                 and isinstance(node.iter.func, ast.Name)
                 and node.iter.func.id == "range"

@@ -179,3 +179,57 @@ def test_cost_model_calibrates_against_measured_collectives():
     # model predicts the same ordering for these byte counts
     assert comm_cost_seconds(8 * (1 << 20) * 4, 8, "all_reduce") > \
         comm_cost_seconds(8 * (1 << 12) * 4, 8, "all_reduce")
+
+
+def test_calibration_fit_measures_installs_and_changes_planner(tmp_path):
+    """VERDICT r4 next #10: sweep real collectives on the mesh, fit
+    alpha-beta, persist the fit, and verify the planner's estimates
+    actually move with the fitted constants."""
+    from paddle_tpu.distributed.auto_parallel import calibration, cost_model
+
+    mesh = _mesh((8,), ("x",))
+    samples = calibration.measure_collectives(
+        mesh, "x", sizes=[1 << 12, 1 << 15, 1 << 18], reps=3)
+    for kind in ("all_reduce", "all_gather", "reduce_scatter", "permute"):
+        assert len(samples[kind]) == 3
+        assert all(sec > 0 for _, sec in samples[kind])
+
+    fits = calibration.fit_alpha_beta(samples, 8)
+    for kind, f in fits.items():
+        assert f["alpha"] > 0 and f["beta"] > 0, (kind, f)
+
+    # persistence round-trip via an isolated path
+    path = str(tmp_path / "comm_fit.json")
+    calibration.save_fit(fits, 8, "cpu", path=path)
+    loaded = calibration.load_fit(path)
+    assert loaded["fits"].keys() == fits.keys()
+    assert loaded["axis_size"] == 8
+
+    # installing a fit changes comm_cost_seconds — and hence the
+    # Planner's step estimate — measurably
+    prev_fit, prev_loaded = cost_model._MEASURED_FIT, cost_model._FIT_LOADED
+    try:
+        cost_model._MEASURED_FIT, cost_model._FIT_LOADED = None, True
+        base = cost_model.comm_cost_seconds(1 << 20, 8, "all_reduce")
+        slow = {"all_reduce": {"alpha": 1e-3, "beta": 1e6}}
+        calibration.install_fit(slow)
+        t_slow = cost_model.comm_cost_seconds(1 << 20, 8, "all_reduce")
+        assert t_slow > base * 10
+
+        planner = Planner(mesh=_mesh((8,), ("dp",)))
+        est = estimate_cost(lambda a, b: a @ b,
+                            jax.ShapeDtypeStruct((256, 256), np.float32),
+                            jax.ShapeDtypeStruct((256, 256), np.float32))
+        t_with_slow = planner.estimate_step_seconds(est)
+        calibration.install_fit(
+            {"all_reduce": {"alpha": 1e-9, "beta": 1e15}})
+        t_with_fast = planner.estimate_step_seconds(est)
+        assert t_with_slow > t_with_fast
+
+        # the measured CPU fit itself installs and yields finite costs
+        calibration.install_fit(fits)
+        t_fit = cost_model.comm_cost_seconds(1 << 20, 8, "all_reduce")
+        assert 0 < t_fit < 60
+    finally:
+        cost_model._MEASURED_FIT = prev_fit
+        cost_model._FIT_LOADED = prev_loaded

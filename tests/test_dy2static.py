@@ -245,3 +245,31 @@ def test_shadowed_range_is_not_reinterpreted():
     out = sf(paddle.to_tensor(np.array([1.0], np.float32)))
     # custom range(3) yields [3, 6]: s = x.sum()*3 + x.sum()*6
     np.testing.assert_allclose(float(out), 9.0)
+
+
+def test_locally_shadowed_range_is_not_reinterpreted():
+    """A parameter or local named `range` must suppress the builtin
+    range-for conversion, not just a module-global shadow."""
+    def f(x):
+        range = lambda n: [n, n * 2]  # noqa: E731
+        s = paddle.zeros([])
+        for v in range(3):
+            s = s + x.sum() * v
+        return s
+
+    sf = jit.to_static(f)
+    out = sf(paddle.to_tensor(np.array([1.0], np.float32)))
+    np.testing.assert_allclose(float(out), 3.0 + 6.0)
+
+
+def test_range_zero_step_raises_like_python():
+    def f(x):
+        k = x.shape[0] + paddle.to_tensor(2, dtype="int32")  # traced
+        s = paddle.zeros([])
+        for i in range(5, k, 0):
+            s = s + x.sum()
+        return s
+
+    sf = jit.to_static(f)
+    with pytest.raises(ValueError, match="must not be zero"):
+        sf(paddle.to_tensor(np.array([1.0], np.float32)))
