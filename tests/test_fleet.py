@@ -504,3 +504,155 @@ def test_interleaved_pipeline_parity_and_schedule():
     np.testing.assert_allclose(
         got, np.asarray(ref_layers[0].emb.weight._value),
         rtol=1e-3, atol=1e-4)
+
+
+# ---------------------------------------------------------------------
+# Trunk-detection hardening (VERDICT r4 weak #6 / next #9)
+
+def test_trunk_fingerprint_catches_array_buffer_callable_attrs():
+    """Stages that differ only via an ndarray mask, a registered buffer,
+    or a callable attr must produce DIFFERENT signatures (previously
+    these escaped the fingerprint and could silently merge)."""
+    from paddle_tpu.distributed.fleet.meta_parallel.pp_utils.\
+        global_schedule import _entry_signature
+
+    def make(mask=None, buf=None, hook=None):
+        paddle.seed(0)
+        l = nn.Linear(4, 4)
+        if mask is not None:
+            l.mask = np.asarray(mask, np.float32)
+        if buf is not None:
+            l.register_buffer("aux", paddle.to_tensor(
+                np.asarray(buf, np.float32)))
+        if hook is not None:
+            l.post_fn = hook
+        return (l, None)
+
+    base = _entry_signature(make())
+    assert _entry_signature(make()) == base  # deterministic
+    assert _entry_signature(make(mask=[1, 0, 1, 1])) != base
+    assert _entry_signature(make(mask=[1, 0, 1, 1])) == \
+        _entry_signature(make(mask=[1, 0, 1, 1]))
+    assert _entry_signature(make(mask=[1, 1, 1, 1])) != \
+        _entry_signature(make(mask=[1, 0, 1, 1]))
+    assert _entry_signature(make(buf=[0.0, 0.0])) != base
+    assert _entry_signature(make(hook=lambda x: x * 2)) != base
+
+    # registered forward hooks run in __call__ and change stage math
+    paddle.seed(0)
+    hooked = nn.Linear(4, 4)
+    hooked.register_forward_post_hook(lambda m, i, o: o * 0.5)
+    assert _entry_signature((hooked, None)) != base
+
+    # closure-captured constants distinguish factory-made callables
+    def factory(c):
+        return lambda x: x * c
+
+    paddle.seed(0)
+    a, b = nn.Linear(4, 4), nn.Linear(4, 4)
+    a.post_fn, b.post_fn = factory(1.0), factory(0.5)
+    assert _entry_signature((a, None)) != _entry_signature((b, None))
+    b.post_fn = factory(1.0)
+    assert _entry_signature((a, None)) == _entry_signature((b, None))
+
+    # functools.partial bound args distinguish too
+    import functools
+    a.post_fn = functools.partial(lambda x, c: x * c, c=2.0)
+    b.post_fn = functools.partial(lambda x, c: x * c, c=3.0)
+    assert _entry_signature((a, None)) != _entry_signature((b, None))
+
+
+def test_trunk_deep_post_section_found_loudly(caplog):
+    """A >8-layer post section is legitimate: the bounded fast path
+    misses it, the unbounded retry finds it and warns."""
+    from paddle_tpu.distributed.fleet.meta_parallel.pp_utils.\
+        global_schedule import _find_trunk
+
+    sigs = ["A"] * 8 + [f"tail{i}" for i in range(12)]
+    assert _find_trunk(sigs, 4) is None                  # bounded miss
+    pre, body, post = _find_trunk(sigs, 4, max_edge=len(sigs))
+    assert (pre, body, post) == (0, 8, 12)
+
+
+def test_trunk_chunks_always_structurally_identical():
+    """The invariant behind every split _find_trunk returns: cutting the
+    body into n_stages chunks yields IDENTICAL chunks (all stages run
+    the template's code).  A (A B)x6 body over 4 stages can't pipeline
+    whole (reps=6 not divisible) — the finder may shrink to a valid
+    sub-body, but never return differing chunks; a body with no
+    periodic sub-run at all is rejected outright."""
+    from paddle_tpu.distributed.fleet.meta_parallel.pp_utils.\
+        global_schedule import _find_trunk
+
+    def chunks_of(sigs, n_stages):
+        split = _find_trunk(sigs, n_stages)
+        if split is None:
+            return None
+        pre, body, post = split
+        assert body % n_stages == 0
+        per = body // n_stages
+        seg = sigs[pre:pre + body]
+        return [tuple(seg[i * per:(i + 1) * per])
+                for i in range(n_stages)]
+
+    cks = chunks_of(["A", "B"] * 6, 4)          # shrinks to a sub-body
+    assert cks is not None and len(set(cks)) == 1
+    # multi-layer period dividing evenly: per-chunk = 2 periods
+    assert chunks_of(["A", "B"] * 8, 4) == [("A", "B", "A", "B")] * 4
+    # no periodic run long enough for 8 stages anywhere in 12 layers
+    assert _find_trunk(["A", "B", "C"] * 4, 8) is None
+
+
+def test_pipeline_mask_stage_falls_back_never_wrong(caplog):
+    """END-TO-END adversarial case: a trunk stage that differs ONLY by a
+    plain ndarray attr that changes its math.  The engine must refuse
+    the merge (loud fallback to the eager accumulation path) and the
+    numerics must match the single-device reference exactly."""
+    from paddle_tpu.distributed import fleet
+    from paddle_tpu.distributed.fleet.meta_parallel.parallel_layers.\
+        pp_layers import PipelineLayer
+
+    class Scale(nn.Layer):
+        def __init__(self, mask):
+            super().__init__()
+            self.mask = np.asarray(mask, np.float32)  # plain attr
+
+        def forward(self, x):
+            return x * paddle.to_tensor(self.mask)
+
+    masks = [np.ones(16, np.float32) for _ in range(4)]
+    masks[2] = np.full(16, 0.5, np.float32)       # stage 2 differs
+
+    def build_layers(seed):
+        paddle.seed(seed)
+        return [l for s in range(4)
+                for l in (nn.Linear(16, 16), Scale(masks[s]))]
+
+    def batches(i):
+        rng = np.random.RandomState(31 + i)
+        return (rng.randn(8, 16).astype(np.float32),
+                rng.randn(8, 16).astype(np.float32))
+
+    ref_model = nn.Sequential(*build_layers(5))
+    ref = _train(ref_model, 4, batches)
+
+    strategy = fleet.DistributedStrategy()
+    strategy.hybrid_configs = {"dp_degree": 2, "pp_degree": 4}
+    strategy.pipeline_configs = {"accumulate_steps": 2,
+                                 "micro_batch_size": 2}
+    fleet.init(is_collective=True, strategy=strategy)
+    mse = lambda o, l: paddle.nn.functional.mse_loss(o, l)
+    pl = PipelineLayer(layers=build_layers(5), num_stages=4, loss_fn=mse)
+    model = fleet.distributed_model(pl)
+    opt = optimizer.SGD(learning_rate=0.1, parameters=pl.parameters())
+
+    losses = []
+    for i in range(4):
+        x, y = batches(i)
+        loss = model.train_batch(
+            (paddle.to_tensor(x), paddle.to_tensor(y)), opt)
+        losses.append(float(loss))
+    # the SPMD engines must have REFUSED this model (loud fallback) ...
+    assert model._engine is False, "engine merged mask-differing stages"
+    # ... and the fallback numerics are exact
+    np.testing.assert_allclose(losses, ref, rtol=2e-4, atol=2e-5)
