@@ -572,6 +572,19 @@ def _bert_x32_subprocess(wait_s=900):
 def main():
     force_cpu = os.environ.get("PADDLE_TPU_BENCH_FORCE_CPU") == "1"
     subproc = os.environ.get("PADDLE_TPU_BENCH_SUBPROC") == "1"
+    if (not force_cpu and not subproc
+            and os.environ.get("_AXON_REGISTERED") == "1"):
+        # sitecustomize registered THIS interpreter with an INFINITE
+        # claim timeout; running configs here would make a stuck claim
+        # an immortal allocator-queue occupant (TUNNEL.md round-5
+        # window 2: the 01:25 parent).  Re-exec with the gate blanked
+        # so the fresh interpreter self-registers with a bounded
+        # claim at the registration step below.
+        log("re-exec: replacing sitecustomize's infinite-timeout "
+            "registration with a bounded one")
+        env = _axon_probe_mod().self_register_child_env()
+        os.execve(sys.executable,
+                  [sys.executable, "-u", os.path.abspath(__file__)], env)
     configs = os.environ.get(
         "PADDLE_TPU_BENCH_CONFIGS",
         "bert,lenet,resnet50,gpt,llama_dryrun").split(",")
