@@ -270,6 +270,34 @@ def bench_bert(on_tpu, peak):
         paddle.disable_static()
 
 
+def _dygraph_lazy(on_tpu):
+    """Dygraph-mode decision from MEASURED data (VERDICT r4 #4): when
+    scripts/lazy_probe.py has recorded an on-platform eager/lazy/static
+    3-way, trust it — lazy only stays the TPU default if it does not
+    lose to plain eager there.  With no measurement, keep the round-4
+    default (lazy on TPU: per-op dispatch over the tunnel is ~30 ms)."""
+    if not on_tpu:
+        return False
+    try:
+        data = json.loads(
+            (ROOT / ".bench_cache" / "lazy_probe.json").read_text())
+        if data.get("platform") == "tpu":
+            ratios = [m["lazy_over_eager"]
+                      for m in data.get("models", {}).values()
+                      if "lazy_over_eager" in m]
+            if ratios and sum(r > 1.1 for r in ratios) \
+                    >= (len(ratios) + 1) // 2:
+                log("dygraph: measured lazy/eager ratios "
+                    f"{ratios} — running dygraph configs EAGER")
+                return False
+            if ratios:
+                log(f"dygraph: measured lazy/eager ratios {ratios} — "
+                    "lazy confirmed as TPU dygraph mode")
+    except Exception:
+        pass
+    return True
+
+
 # ---------------------------------------------------------------------
 # Config #1: LeNet dygraph fp32
 # ---------------------------------------------------------------------
@@ -283,8 +311,9 @@ def bench_lenet(on_tpu):
 
     # dygraph on TPU runs in lazy eager mode (SURVEY §7): ops keep
     # imperative semantics but flush as compiled segments — the role the
-    # reference's async CUDA launches play for its dygraph
-    lazy_cm = (paddle.incubate.lazy_eager() if on_tpu
+    # reference's async CUDA launches play for its dygraph.  The mode is
+    # confirmed (or overridden) by lazy_probe.py measurements.
+    lazy_cm = (paddle.incubate.lazy_eager() if _dygraph_lazy(on_tpu)
                else contextlib.nullcontext())
     B = 64
     n_iters = 10 if on_tpu else 3
@@ -331,7 +360,7 @@ def bench_resnet50(on_tpu):
     from paddle_tpu.vision.models import resnet50
     import paddle_tpu.nn.functional as F
 
-    lazy_cm = (paddle.incubate.lazy_eager() if on_tpu
+    lazy_cm = (paddle.incubate.lazy_eager() if _dygraph_lazy(on_tpu)
                else contextlib.nullcontext())
     B, HW = (32, 224) if on_tpu else (2, 64)
     n_iters = 5 if on_tpu else 2

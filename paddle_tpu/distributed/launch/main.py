@@ -20,6 +20,7 @@ from __future__ import annotations
 import argparse
 import os
 import signal
+import struct
 import subprocess
 import sys
 import time
@@ -59,7 +60,12 @@ def _parse_args(argv=None):
 def launch(args):
     """Elastic outer loop (reference: ElasticManager relaunch): run the
     pod; on failure relaunch up to --max_restarts times with
-    PADDLE_RESTART_CNT incremented so workers resume from checkpoints."""
+    PADDLE_RESTART_CNT incremented so workers resume from checkpoints.
+    With --nnodes > 1 the relaunch decision is COORDINATED across the
+    per-node launchers through a TCPStore epoch counter (see
+    _launch_multihost_elastic)."""
+    if args.nnodes > 1:
+        return _launch_multihost_elastic(args)
     restarts = 0
     while True:
         rc = _launch_once(args, restarts)
@@ -70,14 +76,158 @@ def launch(args):
               f"(previous rc={rc})", file=sys.stderr, flush=True)
 
 
-def _launch_once(args, restarts=0):
-    nproc = args.nproc_per_node
-    world = args.nnodes * nproc
+def _master_of(args):
     master = args.master or os.environ.get("MASTER_ADDR", "127.0.0.1")
     if ":" in master:
         addr, port = master.rsplit(":", 1)
     else:
         addr, port = master, os.environ.get("MASTER_PORT", "8476")
+    return addr, int(port)
+
+
+def _launch_multihost_elastic(args):
+    """Cross-host elastic pod (reference: ElasticManager's etcd watch —
+    `fleet/elastic/manager.py` [UNVERIFIED — empty reference mount;
+    SURVEY.md §2.3 elastic row, §5 failure detection]).
+
+    jax.distributed cannot re-admit a single rank into a live
+    coordination service, so — like the reference pod — the restart
+    unit is the WHOLE pod.  The per-node launchers coordinate through a
+    TCPStore (served by the node-0 launcher on master_port+797):
+
+      * any local worker death bumps the shared ``epoch`` counter;
+      * every launcher polls ``epoch``; a bump (local or remote) tears
+        down the local workers — which are typically HUNG in a
+        collective whose peer died, the NCCL-hang analogue — and
+        relaunches them with PADDLE_RESTART_CNT=epoch;
+      * when ``epoch`` exceeds --max_restarts the observing launcher
+        flags ``abort`` and every node exits non-zero;
+      * launchers sync at an epoch barrier so a relaunched rank 0 has
+        released the coordinator port before peers redial it.
+    """
+    from ..store import TCPStore
+    addr, port = _master_of(args)
+    store = TCPStore(addr, port + 797,
+                     is_master=(args.node_rank == 0),
+                     world_size=args.nnodes, timeout=120)
+    epoch = 0
+    rc = 0
+    while True:
+        procs, logs = _spawn_pod(args, epoch)
+        try:
+            rc, peer_bump = _watch_pod(args, procs, store, epoch)
+        except KeyboardInterrupt:
+            for pr in procs:
+                pr.send_signal(signal.SIGINT)
+            return 130
+        finally:
+            for lf in logs:
+                lf.close()
+        if rc == 0 and not peer_bump:
+            # clean completion: node 0 hosts the store server, so it
+            # must outlive every peer's LAST store poll — wait until
+            # all nodes have checked in done before returning
+            try:
+                store.add("done", 1)
+                if args.node_rank == 0:
+                    deadline = time.time() + 120
+                    while store.add("done", 0) < args.nnodes:
+                        if time.time() > deadline:
+                            break
+                        time.sleep(0.1)
+            except Exception:
+                pass
+            return 0
+        try:
+            if rc != 0:
+                # first-failure-wins: k simultaneous node failures in
+                # one round must consume ONE restart, not k, and every
+                # node must read the same next epoch for its barrier
+                if store.add(f"bump{epoch}", 1) == 1:
+                    store.add("epoch", 1)
+            cur = int(store.add("epoch", 0))
+            if cur > args.max_restarts:
+                store.set("abort", b"1")
+                print(f"launch: elastic budget exhausted "
+                      f"(epoch {cur} > max_restarts "
+                      f"{args.max_restarts}); aborting pod",
+                      file=sys.stderr, flush=True)
+                return rc or 1
+            if store.query("abort") is not None:
+                return rc or 1
+            store.barrier(f"epoch{cur}")
+        except Exception as e:
+            # store gone = a peer launcher aborted and took the server
+            print(f"launch: elastic store lost ({e}); aborting",
+                  file=sys.stderr, flush=True)
+            return rc or 1
+        print(f"launch: elastic relaunch -> epoch {cur} "
+              f"(node {args.node_rank})", file=sys.stderr, flush=True)
+        epoch = cur
+
+
+def _watch_pod(args, procs, store, epoch):
+    """Returns (rc, peer_bump).  Kills local workers on either a local
+    failure or (store is not None) a remote epoch bump / abort flag.
+    Shared by the single-node path (store=None) and the multi-host
+    elastic loop — one watch loop, one teardown escalation."""
+    nproc = args.nproc_per_node
+    alive = set(range(nproc))
+    rc = 0
+    peer_bump = False
+    last_poll = 0.0
+    while alive:
+        for i in list(alive):
+            r = procs[i].poll()
+            if r is None:
+                continue
+            alive.discard(i)
+            if r != 0:
+                rc = r
+                print(f"launch: rank {args.node_rank * nproc + i} "
+                      f"exited rc={r}; terminating local pod",
+                      file=sys.stderr, flush=True)
+                _teardown(procs, alive)
+                return rc, peer_bump
+        now = time.time()
+        if store is not None and now - last_poll >= 0.5:
+            last_poll = now
+            try:
+                if store.query("abort") is not None:
+                    _teardown(procs, alive)
+                    return 1, True
+                cur = store.query("epoch")
+                if cur is not None and len(cur) == 8 and \
+                        struct.unpack("<q", cur)[0] > epoch:
+                    print(f"launch: node {args.node_rank} observed "
+                          f"remote epoch bump; terminating local pod",
+                          file=sys.stderr, flush=True)
+                    _teardown(procs, alive)
+                    return rc, True
+            except Exception:
+                _teardown(procs, alive)
+                return 1, True
+        time.sleep(0.1)
+    return rc, peer_bump
+
+
+def _teardown(procs, alive):
+    for j in list(alive):
+        procs[j].terminate()
+    deadline = time.time() + 10
+    for j in list(alive):
+        while procs[j].poll() is None and time.time() < deadline:
+            time.sleep(0.1)
+        if procs[j].poll() is None:
+            procs[j].kill()
+    alive.clear()
+
+
+def _spawn_pod(args, restarts=0):
+    """Spawn this node's worker processes; returns (procs, log files)."""
+    nproc = args.nproc_per_node
+    world = args.nnodes * nproc
+    addr, port = _master_of(args)
 
     os.makedirs(args.log_dir, exist_ok=True)
     procs = []
@@ -105,28 +255,16 @@ def _launch_once(args, restarts=0):
                                       stderr=subprocess.STDOUT))
         print(f"launch: rank {rank} pid {procs[-1].pid} -> {log_path}",
               flush=True)
+    return procs, logs
+
+
+def _launch_once(args, restarts=0):
+    procs, logs = _spawn_pod(args, restarts)
 
     # watch loop (reference: CollectiveController.watch): first failure
     # tears down the pod
-    rc = 0
     try:
-        alive = set(range(nproc))
-        while alive:
-            for i in list(alive):
-                r = procs[i].poll()
-                if r is None:
-                    continue
-                alive.discard(i)
-                if r != 0:
-                    rc = r
-                    print(f"launch: rank {args.node_rank * nproc + i} "
-                          f"exited rc={r}; terminating pod",
-                          file=sys.stderr, flush=True)
-                    for j in alive:
-                        procs[j].terminate()
-                    alive.clear()
-                    break
-            time.sleep(0.2)
+        rc, _ = _watch_pod(args, procs, store=None, epoch=restarts)
     except KeyboardInterrupt:
         for pr in procs:
             pr.send_signal(signal.SIGINT)

