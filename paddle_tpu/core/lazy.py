@@ -71,6 +71,8 @@ _EVER_ENABLED = False
 # segment executable cache: wiring key -> jitted replay fn
 _segment_cache: dict = {}
 _SEGMENT_CACHE_MAX = 512
+# capture statistics (read by jit/sot.py reports): monotonic counters
+stats = {"flushes": 0, "cache_hits": 0, "compiles": 0, "nodes": 0}
 # per-op abstract-eval cache
 _abseval_cache: dict = {}
 _ABSEVAL_CACHE_MAX = 8192
@@ -389,8 +391,13 @@ def _flush_nodes(pending):
     leaf_sig = tuple(
         (jnp.shape(v), str(jnp.result_type(v))) for v in leaves)
     seg_key = (tuple(wiring), tuple(masks), leaf_sig)
+    stats["flushes"] += 1
+    stats["nodes"] += len(pending)
     fn = _segment_cache.get(seg_key)
+    if fn is not None:
+        stats["cache_hits"] += 1
     if fn is None:
+        stats["compiles"] += 1
         runs = [n.run for n in pending]
         wires = [w for _, w in wiring]
 

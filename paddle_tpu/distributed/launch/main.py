@@ -155,7 +155,36 @@ def _launch_multihost_elastic(args):
                 return rc or 1
             if store.query("abort") is not None:
                 return rc or 1
-            store.barrier(f"epoch{cur}")
+            if store.add("done", 0) > 0:
+                # a peer already finished and exited: the pod can never
+                # be reformed at full world size — abort, don't wait
+                print("launch: a peer node completed before this "
+                      "failure; pod cannot be reformed — aborting",
+                      file=sys.stderr, flush=True)
+                store.set("abort", b"1")
+                return rc or 1
+            # epoch barrier that cannot deadlock on a finished peer:
+            # wait until every node has either arrived or checked in
+            # done (a done peer makes reforming impossible -> abort)
+            store.add(f"arrive{cur}", 1)
+            deadline = time.time() + 120
+            while True:
+                arrived = store.add(f"arrive{cur}", 0)
+                done = store.add("done", 0)
+                if arrived >= args.nnodes:
+                    break
+                if done > 0 or store.query("abort") is not None:
+                    print("launch: pod cannot be reformed "
+                          "(peer done/aborted); exiting",
+                          file=sys.stderr, flush=True)
+                    store.set("abort", b"1")
+                    return rc or 1
+                if time.time() > deadline:
+                    print("launch: epoch barrier timed out; aborting",
+                          file=sys.stderr, flush=True)
+                    store.set("abort", b"1")
+                    return rc or 1
+                time.sleep(0.05)
         except Exception as e:
             # store gone = a peer launcher aborted and took the server
             print(f"launch: elastic store lost ({e}); aborting",

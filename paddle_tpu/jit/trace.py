@@ -308,13 +308,29 @@ class TracedFunction:
 
 
 def to_static(function=None, input_spec=None, build_strategy=None,
-              backend=None, **kwargs):
-    """paddle.jit.to_static — decorator or call form."""
+              backend=None, full_graph=True, **kwargs):
+    """paddle.jit.to_static — decorator or call form.
+
+    ``full_graph=True`` (default): AST translation + jax trace — one
+    whole-program compile, Python-free steady state (jit/dy2static.py).
+    ``full_graph=False``: SOT-mode piecewise capture with graph breaks
+    at data-dependent Python (jit/sot.py — the reference's `jit/sot/`
+    bytecode translator role, rebuilt on the lazy-eager engine).
+    """
 
     def decorate(fn):
         if isinstance(fn, TracedFunction):
             return fn
         from ..nn.layer.layers import Layer
+
+        if not full_graph or backend == "sot":
+            from .sot import SotFunction, sot_capture
+            if isinstance(fn, SotFunction):
+                return fn
+            if isinstance(fn, Layer):
+                fn.forward = sot_capture(fn.forward)
+                return fn
+            return sot_capture(fn)
 
         if isinstance(fn, Layer):
             traced = TracedFunction(fn.forward, input_spec)
