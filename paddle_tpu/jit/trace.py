@@ -136,6 +136,7 @@ class TracedFunction:
         # tensors become static.nn.cond/while_loop; unconvertible
         # functions keep trace semantics with a logged reason
         self._fn = convert_function(fn)
+        self._orig_fn = fn  # pre-conversion python fn, for mode switches
         self._input_spec = input_spec
         self._cache = {}
         self._jit_kwargs = jit_kwargs or {}
@@ -319,22 +320,35 @@ def to_static(function=None, input_spec=None, build_strategy=None,
     """
 
     def decorate(fn):
-        if isinstance(fn, TracedFunction):
-            return fn
         from ..nn.layer.layers import Layer
+        from .sot import SotFunction, sot_capture
 
         if not full_graph or backend == "sot":
-            from .sot import SotFunction, sot_capture
             if isinstance(fn, SotFunction):
                 return fn
+            if isinstance(fn, TracedFunction):
+                # mode switch: unwrap back to the python function so the
+                # SOT request isn't silently ignored
+                fn = fn._orig_fn
             if isinstance(fn, Layer):
-                fn.forward = sot_capture(fn.forward)
+                fwd = fn.forward
+                fn.forward = sot_capture(
+                    fwd._orig_fn if isinstance(fwd, TracedFunction)
+                    else fwd)
                 return fn
             return sot_capture(fn)
 
+        if isinstance(fn, SotFunction):
+            fn = fn._fn  # mode switch: SOT -> full-graph AST trace
+        if isinstance(fn, TracedFunction):
+            return fn
+
         if isinstance(fn, Layer):
-            traced = TracedFunction(fn.forward, input_spec)
-            fn.forward = traced
+            fwd = fn.forward
+            if isinstance(fwd, SotFunction):
+                fwd = fwd._fn  # mode switch on a SOT-captured Layer
+            if not isinstance(fwd, TracedFunction):
+                fn.forward = TracedFunction(fwd, input_spec)
             return fn
         return TracedFunction(fn, input_spec)
 

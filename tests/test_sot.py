@@ -147,3 +147,29 @@ def test_sot_namedtuple_output_preserved():
     assert float(out.loss) == 3.0
     np.testing.assert_allclose(np.asarray(out.logits.numpy()),
                                np.full((3,), 2.0))
+
+
+def test_mode_switch_layer_sot_to_full_graph():
+    # to_static(layer, full_graph=False) then full_graph=True must not
+    # wrap the SotFunction — it unwraps back to the python forward
+    import paddle_tpu as paddle
+    from paddle_tpu import nn
+    from paddle_tpu.jit.sot import SotFunction
+    from paddle_tpu.jit.trace import TracedFunction
+    import numpy as np
+
+    layer = nn.Linear(4, 3)
+    paddle.jit.to_static(layer, full_graph=False)
+    assert isinstance(layer.forward, SotFunction)
+    x = paddle.to_tensor(np.ones((2, 4), np.float32))
+    y_sot = layer(x).numpy()
+
+    paddle.jit.to_static(layer, full_graph=True)
+    assert isinstance(layer.forward, TracedFunction)
+    y_ast = layer(x).numpy()
+    np.testing.assert_allclose(y_sot, y_ast, rtol=1e-6)
+
+    # and back again
+    paddle.jit.to_static(layer, full_graph=False)
+    assert isinstance(layer.forward, SotFunction)
+    np.testing.assert_allclose(layer(x).numpy(), y_ast, rtol=1e-6)

@@ -200,3 +200,92 @@ def test_adam_bias_correction_evolves_in_compiled_step():
         paddle.disable_static()
     np.testing.assert_allclose(m_s.weight.numpy(), m_e.weight.numpy(),
                                rtol=1e-4, atol=1e-5)
+
+
+def _build_mlp_program(seed):
+    paddle.seed(seed)
+    main = static.Program()
+    with static.program_guard(main):
+        x = static.data("x", [16, 8], "float32")
+        y = static.data("y", [16, 1], "float32")
+        h = nn.Linear(8, 16)(x)
+        h = paddle.nn.functional.relu(h)
+        pred = nn.Linear(16, 1)(h)
+        loss = paddle.nn.functional.mse_loss(pred, y)
+        opt = optimizer.Adam(learning_rate=0.01,
+                             parameters=main.all_parameters())
+        opt.minimize(loss)
+    return main, loss
+
+
+def test_run_steps_matches_sequential_runs():
+    # N fused device-side steps (lax.fori_loop) == N Executor.run calls:
+    # identical final loss AND identical parameter values.
+    paddle.enable_static()
+    rng = np.random.RandomState(0)
+    xv = rng.rand(16, 8).astype(np.float32)
+    yv = rng.rand(16, 1).astype(np.float32)
+    fd = {"x": xv, "y": yv}
+
+    main_a, loss_a = _build_mlp_program(7)
+    exe_a = static.Executor()
+    for _ in range(5):
+        (la,) = exe_a.run(main_a, feed=fd, fetch_list=[loss_a])
+
+    main_b, loss_b = _build_mlp_program(7)
+    exe_b = static.Executor()
+    (lb,) = exe_b.run_steps(5, main_b, feed=fd, fetch_list=[loss_b])
+
+    np.testing.assert_allclose(np.asarray(la), np.asarray(lb),
+                               rtol=1e-6, atol=1e-7)
+    for pa, pb in zip(main_a.all_parameters(), main_b.all_parameters()):
+        np.testing.assert_allclose(pa.numpy(), pb.numpy(),
+                                   rtol=1e-6, atol=1e-7)
+    # step counter advanced by n on the fused path (Adam bias correction)
+    opt_b = main_b._optimize_info[0]
+    assert int(np.asarray(opt_b._step_count._value)) == 5
+
+
+def test_run_steps_continues_from_run():
+    # interleaving run() and run_steps() keeps one consistent state
+    paddle.enable_static()
+    rng = np.random.RandomState(3)
+    xv = rng.rand(16, 8).astype(np.float32)
+    yv = rng.rand(16, 1).astype(np.float32)
+    fd = {"x": xv, "y": yv}
+
+    main_a, loss_a = _build_mlp_program(11)
+    exe_a = static.Executor()
+    for _ in range(4):
+        (la,) = exe_a.run(main_a, feed=fd, fetch_list=[loss_a])
+
+    main_b, loss_b = _build_mlp_program(11)
+    exe_b = static.Executor()
+    (lb,) = exe_b.run(main_b, feed=fd, fetch_list=[loss_b])
+    (lb,) = exe_b.run_steps(3, main_b, feed=fd, fetch_list=[loss_b])
+    np.testing.assert_allclose(np.asarray(la), np.asarray(lb),
+                               rtol=1e-6, atol=1e-7)
+
+
+def test_run_steps_varying_n_single_compile():
+    # n rides as a dynamic operand: different iteration counts reuse
+    # ONE compiled loop executable and stay numerically exact
+    paddle.enable_static()
+    rng = np.random.RandomState(5)
+    xv = rng.rand(16, 8).astype(np.float32)
+    yv = rng.rand(16, 1).astype(np.float32)
+    fd = {"x": xv, "y": yv}
+
+    main_a, loss_a = _build_mlp_program(21)
+    exe_a = static.Executor()
+    for _ in range(7):
+        (la,) = exe_a.run(main_a, feed=fd, fetch_list=[loss_a])
+
+    main_b, loss_b = _build_mlp_program(21)
+    exe_b = static.Executor()
+    (lb,) = exe_b.run_steps(4, main_b, feed=fd, fetch_list=[loss_b])
+    (lb,) = exe_b.run_steps(3, main_b, feed=fd, fetch_list=[loss_b])
+    np.testing.assert_allclose(np.asarray(la), np.asarray(lb),
+                               rtol=1e-6, atol=1e-7)
+    (entry,) = exe_b._cache.values()
+    assert entry["loop_fn"]._cache_size() == 1
