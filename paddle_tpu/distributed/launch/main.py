@@ -170,25 +170,18 @@ def _launch_multihost_elastic(args):
             deadline = time.time() + 120
             while True:
                 arrived = store.add(f"arrive{cur}", 0)
-                done = store.add("done", 0)
-                if arrived >= args.nnodes:
-                    # a timed-out peer's arrival is never retracted: it
-                    # may have arrived, waited, set abort and left.  The
-                    # count alone must not admit us into a pod that can
-                    # never form — abort wins over the barrier.
-                    if done > 0 or store.query("abort") is not None:
-                        print("launch: barrier formed but a peer "
-                              "done/aborted; exiting",
-                              file=sys.stderr, flush=True)
-                        store.set("abort", b"1")
-                        return rc or 1
-                    break
-                if done > 0 or store.query("abort") is not None:
+                # abort/done wins over a formed barrier: a timed-out
+                # peer's arrival is never retracted, so the count alone
+                # must not admit us into a pod that can never form
+                if store.add("done", 0) > 0 \
+                        or store.query("abort") is not None:
                     print("launch: pod cannot be reformed "
                           "(peer done/aborted); exiting",
                           file=sys.stderr, flush=True)
                     store.set("abort", b"1")
                     return rc or 1
+                if arrived >= args.nnodes:
+                    break
                 if time.time() > deadline:
                     print("launch: epoch barrier timed out; aborting",
                           file=sys.stderr, flush=True)

@@ -347,8 +347,11 @@ def to_static(function=None, input_spec=None, build_strategy=None,
             fwd = fn.forward
             if isinstance(fwd, SotFunction):
                 fwd = fwd._fn  # mode switch on a SOT-captured Layer
-            if not isinstance(fwd, TracedFunction):
-                fn.forward = TracedFunction(fwd, input_spec)
+            if isinstance(fwd, TracedFunction):
+                if input_spec is None:
+                    return fn
+                fwd = fwd._orig_fn  # re-trace under the new input_spec
+            fn.forward = TracedFunction(fwd, input_spec)
             return fn
         return TracedFunction(fn, input_spec)
 

@@ -33,19 +33,16 @@ from ._generated import (  # noqa: F401
     equal, not_equal, greater_than, greater_equal, less_than, less_equal,
     logical_and, logical_or, logical_xor, bitwise_and, bitwise_or,
     bitwise_xor)
+from ._generated import (  # noqa: F401  (sig-kind rows)
+    allclose,
+    bitwise_not,
+    isclose,
+    isin,
+    logical_not,
+)
 
 bitwise_left_shift = _cmp("bitwise_left_shift", jnp.left_shift)
 bitwise_right_shift = _cmp("bitwise_right_shift", jnp.right_shift)
-
-
-def logical_not(x, name=None):
-    return dispatch("logical_not", jnp.logical_not, (x,), {},
-                    differentiable=False)
-
-
-def bitwise_not(x, name=None):
-    return dispatch("bitwise_not", jnp.bitwise_not, (x,), {},
-                    differentiable=False)
 
 
 def equal_all(x, y, name=None):
@@ -57,39 +54,12 @@ def equal_all(x, y, name=None):
     return dispatch("equal_all", impl, (x, y), {}, differentiable=False)
 
 
-def allclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
-    return dispatch(
-        "allclose",
-        lambda a, b, *, rtol, atol, equal_nan: jnp.allclose(
-            a, b, rtol=rtol, atol=atol, equal_nan=equal_nan),
-        (x, y), dict(rtol=float(rtol), atol=float(atol),
-                     equal_nan=bool(equal_nan)),
-        differentiable=False)
-
-
-def isclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
-    return dispatch(
-        "isclose",
-        lambda a, b, *, rtol, atol, equal_nan: jnp.isclose(
-            a, b, rtol=rtol, atol=atol, equal_nan=equal_nan),
-        (x, y), dict(rtol=float(rtol), atol=float(atol),
-                     equal_nan=bool(equal_nan)),
-        differentiable=False)
-
-
 def is_empty(x, name=None):
     return to_tensor(x.size == 0)
 
 
 def is_tensor(x):
     return isinstance(x, Tensor)
-
-
-def isin(x, test_x, assume_unique=False, invert=False, name=None):
-    return dispatch(
-        "isin",
-        lambda a, b, *, invert: jnp.isin(a, b, invert=invert),
-        (x, test_x), dict(invert=bool(invert)), differentiable=False)
 
 
 in1d = isin

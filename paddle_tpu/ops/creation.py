@@ -73,6 +73,13 @@ def empty(shape, dtype=None, name=None):
 
 
 from ._generated import zeros_like, ones_like  # noqa: F401
+from ._generated import (  # noqa: F401  (sig-kind rows)
+    clone,
+    complex,
+    diagflat,
+    tril,
+    triu,
+)
 
 
 def full_like(x, fill_value, dtype=None, name=None):
@@ -289,16 +296,6 @@ def exponential_(x, lam=1.0, name=None):
 
 # ---------------- structured ----------------
 
-def tril(x, diagonal=0, name=None):
-    return dispatch("tril", lambda v, *, k: jnp.tril(v, k), (x,),
-                    dict(k=int(diagonal)))
-
-
-def triu(x, diagonal=0, name=None):
-    return dispatch("triu", lambda v, *, k: jnp.triu(v, k), (x,),
-                    dict(k=int(diagonal)))
-
-
 def diag(x, offset=0, padding_value=0, name=None):
     def impl(v, *, k, pad):
         if v.ndim == 1:
@@ -311,12 +308,6 @@ def diag(x, offset=0, padding_value=0, name=None):
 
     return dispatch("diag", impl, (x,), dict(k=int(offset),
                                              pad=padding_value))
-
-
-def diagflat(x, offset=0, name=None):
-    return dispatch("diagflat",
-                    lambda v, *, k: jnp.diagflat(v, k), (x,),
-                    dict(k=int(offset)))
 
 
 def diag_embed(x, offset=0, dim1=-2, dim2=-1, name=None):
@@ -350,17 +341,6 @@ def assign(x, output=None):
         output._inplace_update(y._value, y._grad_node, y._out_index)
         return output
     return y
-
-
-def clone(x, name=None):
-    # real copy (Paddle clone copies; also keeps snapshots valid when the
-    # compiled-step buffer donation consumes the source buffer)
-    return dispatch("clone", lambda v: jnp.copy(v), (x,), {})
-
-
-def complex(real, imag, name=None):
-    return dispatch("complex", lambda r, i: jax.lax.complex(r, i),
-                    (real, imag), {})
 
 
 def as_tensor(data, dtype=None, place=None):

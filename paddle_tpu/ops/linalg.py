@@ -13,6 +13,19 @@ import jax.numpy as jnp
 
 from ..core.dispatch import dispatch
 from ..core.tensor import Tensor
+from ._generated import (  # noqa: F401  (sig-kind rows)
+    bmm,
+    corrcoef,
+    cov,
+    eigvalsh,
+    matrix_exp,
+    matrix_power,
+    multi_dot,
+    mv,
+    pinv,
+    vander,
+    vecdot,
+)
 
 __all__ = [
     "matmul", "mm", "bmm", "dot", "mv", "t", "norm", "dist", "cond",
@@ -41,25 +54,11 @@ def mm(input, mat2, name=None):
     return matmul(input, mat2)
 
 
-def bmm(x, y, name=None):
-    return dispatch("bmm", jnp.matmul, (x, y), {})
-
-
 def dot(x, y, name=None):
     def impl(a, b):
         return jnp.sum(a * b, axis=-1)
 
     return dispatch("dot", impl, (x, y), {})
-
-
-def vecdot(x, y, axis=-1, name=None):
-    return dispatch("vecdot",
-                    lambda a, b, *, axis: jnp.sum(a * b, axis=axis),
-                    (x, y), dict(axis=int(axis)))
-
-
-def mv(x, vec, name=None):
-    return dispatch("mv", jnp.matmul, (x, vec), {})
 
 
 def t(input, name=None):
@@ -133,13 +132,6 @@ def inv(x, name=None):
 inverse = inv
 
 
-def pinv(x, rcond=1e-15, hermitian=False, name=None):
-    return dispatch("pinv",
-                    lambda v, *, rcond: jnp.linalg.pinv(v, rcond=rcond),
-                    (x,), dict(rcond=float(rcond) if not isinstance(
-                        rcond, Tensor) else float(rcond.item())))
-
-
 def det(x, name=None):
     return dispatch("determinant", jnp.linalg.det, (x,), {})
 
@@ -187,18 +179,6 @@ def eigvals(x, name=None):
     arr = np.asarray(x._value)
     from ..core.tensor import to_tensor
     return to_tensor(np.linalg.eigvals(arr))
-
-
-def eigvalsh(x, UPLO="L", name=None):
-    return dispatch("eigvalsh",
-                    lambda v, *, uplo: jnp.linalg.eigvalsh(v), (x,),
-                    dict(uplo=UPLO))
-
-
-def matrix_power(x, n, name=None):
-    return dispatch("matrix_power",
-                    lambda v, *, n: jnp.linalg.matrix_power(v, n), (x,),
-                    dict(n=int(n)))
 
 
 def matrix_rank(x, tol=None, hermitian=False, name=None):
@@ -291,11 +271,6 @@ def lu_unpack(x, y, unpack_ludata=True, unpack_pivots=True, name=None):
     return dispatch("lu_unpack", impl, (x, y), {})
 
 
-def multi_dot(tensors, name=None):
-    return dispatch("multi_dot", lambda *vs: jnp.linalg.multi_dot(vs),
-                    tuple(tensors), {})
-
-
 def cross(x, y, axis=9, name=None):
     def impl(a, b, *, axis):
         if axis == 9:
@@ -335,20 +310,6 @@ def einsum(equation, *operands):
                     dict(eq=equation))
 
 
-def corrcoef(x, rowvar=True, name=None):
-    return dispatch("corrcoef",
-                    lambda v, *, rowvar: jnp.corrcoef(v, rowvar=rowvar),
-                    (x,), dict(rowvar=bool(rowvar)))
-
-
-def cov(x, rowvar=True, ddof=True, fweights=None, aweights=None, name=None):
-    return dispatch(
-        "cov",
-        lambda v, *, rowvar, ddof: jnp.cov(v, rowvar=rowvar,
-                                           ddof=1 if ddof else 0),
-        (x,), dict(rowvar=bool(rowvar), ddof=bool(ddof)))
-
-
 def householder_product(x, tau, name=None):
     def impl(a, t_):
         m, n = a.shape[-2], a.shape[-1]
@@ -365,17 +326,6 @@ def householder_product(x, tau, name=None):
         return q[..., :, :n]
 
     return dispatch("householder_product", impl, (x, tau), {})
-
-
-def matrix_exp(x, name=None):
-    return dispatch("matrix_exp", jax.scipy.linalg.expm, (x,), {})
-
-
-def vander(x, n=None, increasing=False, name=None):
-    return dispatch(
-        "vander",
-        lambda v, *, n, inc: jnp.vander(v, n, increasing=inc), (x,),
-        dict(n=None if n is None else int(n), inc=bool(increasing)))
 
 
 def pca_lowrank(x, q=None, center=True, niter=2, name=None):

@@ -15,6 +15,12 @@ import jax.numpy as jnp
 from ..core.dispatch import dispatch
 from ..core.dtypes import to_jax_dtype
 from ..core.tensor import Tensor, to_tensor
+from ._generated import (  # noqa: F401  (sig-kind rows)
+    clone,
+    diagonal,
+    rot90,
+    swapaxes,
+)
 
 __all__ = [
     "unflatten",
@@ -66,12 +72,6 @@ def moveaxis(x, source, destination, name=None):
         "moveaxis",
         lambda v, *, s, d: jnp.moveaxis(v, s, d), (x,),
         dict(s=tuple(_int_list(source)), d=tuple(_int_list(destination))))
-
-
-def swapaxes(x, axis1, axis2, name=None):
-    return dispatch("swapaxes",
-                    lambda v, *, a, b: jnp.swapaxes(v, a, b), (x,),
-                    dict(a=int(axis1), b=int(axis2)))
 
 
 def flatten(x, start_axis=0, stop_axis=-1, name=None):
@@ -356,11 +356,6 @@ def flip(x, axis, name=None):
                     dict(axis=tuple(_int_list(axis))))
 
 
-def rot90(x, k=1, axes=(0, 1), name=None):
-    return dispatch("rot90", lambda v, *, k, axes: jnp.rot90(v, k, axes),
-                    (x,), dict(k=int(k), axes=tuple(axes)))
-
-
 def roll(x, shifts, axis=None, name=None):
     return dispatch(
         "roll", lambda v, *, shifts, axis: jnp.roll(v, shifts, axis), (x,),
@@ -601,12 +596,6 @@ def pad(x, pad, mode="constant", value=0.0, data_format="NCHW", name=None):
     return F.pad(x, pad, mode=mode, value=value, data_format=data_format)
 
 
-def clone(x, name=None):
-    # real copy (Paddle clone copies; also keeps snapshots valid when the
-    # compiled-step buffer donation consumes the source buffer)
-    return dispatch("clone", lambda v: jnp.copy(v), (x,), {})
-
-
 def numel(x, name=None):
     return to_tensor(int(np.prod(x.shape)) if x.shape else 1, dtype="int64")
 
@@ -706,15 +695,6 @@ def shard_index(input, index_num, nshards, shard_id, ignore_value=-1):
                          shard_id=int(shard_id),
                          ignore_value=int(ignore_value)),
                     differentiable=False)
-
-
-def diagonal(x, offset=0, axis1=0, axis2=1, name=None):
-    return dispatch(
-        "diagonal",
-        lambda v, offset, axis1, axis2: jnp.diagonal(
-            v, offset=offset, axis1=axis1, axis2=axis2),
-        (x,), dict(offset=int(offset), axis1=int(axis1),
-                   axis2=int(axis2)))
 
 
 def searchsorted(sorted_sequence, values, out_int32=False, right=False,

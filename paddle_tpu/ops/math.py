@@ -56,6 +56,31 @@ sgn = sign
 
 
 from ._generated import cumsum, cumprod, logsumexp  # noqa: F401
+from ._generated import (  # noqa: F401  (sig-kind rows)
+    addmm,
+    copysign,
+    gammaln,
+    i0,
+    i1,
+    inner,
+    isfinite,
+    isinf,
+    isnan,
+    isneginf,
+    isposinf,
+    isreal,
+    kron,
+    lerp,
+    nan_to_num,
+    nextafter,
+    outer,
+    polar,
+    polygamma,
+    signbit,
+    sinc,
+    stanh,
+    trace,
+)
 
 
 def clip(x, min=None, max=None, name=None):
@@ -80,44 +105,6 @@ def increment(x, value=1.0, name=None):
                  dict(value=value))
     x._inplace_update(y._value, y._grad_node, y._out_index)
     return x
-
-
-def stanh(x, scale_a=0.67, scale_b=1.7159, name=None):
-    return dispatch("stanh",
-                    lambda v, *, a, b: b * jnp.tanh(a * v), (x,),
-                    dict(a=float(scale_a), b=float(scale_b)))
-
-
-def lerp(x, y, weight, name=None):
-    return dispatch("lerp", lambda a, b, w: a + w * (b - a), (x, y, weight),
-                    {})
-
-
-def addmm(input, x, y, beta=1.0, alpha=1.0, name=None):
-    return dispatch(
-        "addmm",
-        lambda i, a, b, *, alpha, beta: beta * i + alpha * (a @ b),
-        (input, x, y), dict(alpha=float(alpha), beta=float(beta)))
-
-
-def isnan(x, name=None):
-    return dispatch("isnan", jnp.isnan, (x,), {}, differentiable=False)
-
-
-def isinf(x, name=None):
-    return dispatch("isinf", jnp.isinf, (x,), {}, differentiable=False)
-
-
-def isfinite(x, name=None):
-    return dispatch("isfinite", jnp.isfinite, (x,), {}, differentiable=False)
-
-
-def nan_to_num(x, nan=0.0, posinf=None, neginf=None, name=None):
-    return dispatch(
-        "nan_to_num",
-        lambda v, *, nan, posinf, neginf: jnp.nan_to_num(
-            v, nan=nan, posinf=posinf, neginf=neginf),
-        (x,), dict(nan=nan, posinf=posinf, neginf=neginf))
 
 
 def logit(x, eps=None, name=None):
@@ -185,25 +172,6 @@ def cummin(x, axis=None, dtype="int64", name=None):
                     dict(axis=None if axis is None else int(axis)))
 
 
-def inner(x, y, name=None):
-    return dispatch("inner", jnp.inner, (x, y), {})
-
-
-def outer(x, y, name=None):
-    return dispatch("outer", lambda a, b: jnp.outer(a, b), (x, y), {})
-
-
-def kron(x, y, name=None):
-    return dispatch("kron", jnp.kron, (x, y), {})
-
-
-def trace(x, offset=0, axis1=0, axis2=1, name=None):
-    return dispatch(
-        "trace",
-        lambda v, *, k, a1, a2: jnp.trace(v, k, a1, a2), (x,),
-        dict(k=int(offset), a1=int(axis1), a2=int(axis2)))
-
-
 def diff(x, n=1, axis=-1, prepend=None, append=None, name=None):
     args = [x]
     if prepend is not None:
@@ -252,11 +220,6 @@ def multiply_(x, y, name=None):
     out = multiply(x, y)
     x._inplace_update(out._value, out._grad_node, out._out_index)
     return x
-
-
-def copysign(x, y, name=None):
-    return dispatch("copysign", lambda a, b: jnp.copysign(a, b), (x, y),
-                    {})
 
 
 def trapezoid(y, x=None, dx=None, axis=-1, name=None):
@@ -311,49 +274,6 @@ def renorm(x, p, axis, max_norm, name=None):
                          max_norm=float(max_norm)))
 
 
-def gammaln(x, name=None):
-    return dispatch("gammaln",
-                    lambda v: jax.scipy.special.gammaln(v), (x,), {})
-
-
-def polygamma(x, n, name=None):
-    return dispatch("polygamma",
-                    lambda v, n: jax.scipy.special.polygamma(n, v),
-                    (x,), dict(n=int(n)))
-
-
-def i0(x, name=None):
-    return dispatch("i0", lambda v: jax.scipy.special.i0(v), (x,), {})
-
-
-def i1(x, name=None):
-    return dispatch("i1", lambda v: jax.scipy.special.i1(v), (x,), {})
-
-
-def sinc(x, name=None):
-    return dispatch("sinc", lambda v: jnp.sinc(v), (x,), {})
-
-
-def signbit(x, name=None):
-    return dispatch("signbit", lambda v: jnp.signbit(v), (x,), {},
-                    differentiable=False)
-
-
-def isposinf(x, name=None):
-    return dispatch("isposinf", lambda v: jnp.isposinf(v), (x,), {},
-                    differentiable=False)
-
-
-def isneginf(x, name=None):
-    return dispatch("isneginf", lambda v: jnp.isneginf(v), (x,), {},
-                    differentiable=False)
-
-
-def isreal(x, name=None):
-    return dispatch("isreal", lambda v: jnp.isreal(v), (x,), {},
-                    differentiable=False)
-
-
 def is_complex(x):
     return jnp.issubdtype(
         (x._value if isinstance(x, Tensor) else jnp.asarray(x)).dtype,
@@ -382,14 +302,6 @@ def histogramdd(x, bins=10, ranges=None, density=False, weights=None,
     return to_tensor(hist), [to_tensor(e) for e in edges]
 
 
-def polar(abs, angle, name=None):
-    """Complex tensor from magnitude + phase (paddle.polar)."""
-    return dispatch(
-        "polar",
-        lambda r, t: jax.lax.complex(r * jnp.cos(t), r * jnp.sin(t)),
-        (abs, angle), {})
-
-
 def frexp(x, name=None):
     """Mantissa/exponent decomposition: x = m * 2**e, 0.5 <= |m| < 1."""
     def impl(v):
@@ -399,6 +311,3 @@ def frexp(x, name=None):
     return dispatch("frexp", impl, (x,), {}, differentiable=False)
 
 
-def nextafter(x, y, name=None):
-    return dispatch("nextafter", jnp.nextafter, (x, y), {},
-                    differentiable=False)

@@ -25,6 +25,11 @@ __all__ = [
 from ._generated import (  # noqa: F401
     _axis, sum, nansum, mean, nanmean, max, min, prod, all, any,
     count_nonzero)
+from ._generated import (  # noqa: F401  (sig-kind rows)
+    nanmedian,
+    std,
+    var,
+)
 
 
 amax = max
@@ -59,24 +64,6 @@ def argmin(x, axis=None, keepdim=False, dtype="int64", name=None):
                     differentiable=False)
 
 
-def var(x, axis=None, unbiased=True, keepdim=False, name=None):
-    return dispatch(
-        "variance",
-        lambda v, *, axis, ddof, keepdims: jnp.var(
-            v, axis=axis, ddof=ddof, keepdims=keepdims),
-        (x,), dict(axis=_axis(axis), ddof=1 if unbiased else 0,
-                   keepdims=bool(keepdim)))
-
-
-def std(x, axis=None, unbiased=True, keepdim=False, name=None):
-    return dispatch(
-        "std",
-        lambda v, *, axis, ddof, keepdims: jnp.std(
-            v, axis=axis, ddof=ddof, keepdims=keepdims),
-        (x,), dict(axis=_axis(axis), ddof=1 if unbiased else 0,
-                   keepdims=bool(keepdim)))
-
-
 def median(x, axis=None, keepdim=False, mode="avg", name=None):
     def impl(v, *, axis, keepdims, mode):
         if mode == "avg":
@@ -98,14 +85,6 @@ def median(x, axis=None, keepdim=False, mode="avg", name=None):
     return dispatch("median", impl, (x,),
                     dict(axis=None if axis is None else int(axis),
                          keepdims=bool(keepdim), mode=mode))
-
-
-def nanmedian(x, axis=None, keepdim=False, mode="avg", name=None):
-    return dispatch(
-        "nanmedian",
-        lambda v, *, axis, keepdims: jnp.nanmedian(v, axis=axis,
-                                                   keepdims=keepdims),
-        (x,), dict(axis=_axis(axis), keepdims=bool(keepdim)))
 
 
 def mode(x, axis=-1, keepdim=False, name=None):

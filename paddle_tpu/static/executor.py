@@ -126,6 +126,8 @@ class Executor:
         if call is None:
             return [None for _ in fetch_list]
         entry, feed_vals, param_vals, opt_state_vals, lr_val, step_val = call
+        if entry["compiled"] is None:
+            entry["compiled"] = entry["compile_step"]()
         from ..device import hbm_oom_context
         with hbm_oom_context():
             outs, new_params, new_opt_state = entry["compiled"](
@@ -219,10 +221,15 @@ class Executor:
             for t in opt_state)
         lr_aval = jax.ShapeDtypeStruct((), jnp.float32)
         step_aval = jax.ShapeDtypeStruct((), jnp.int32)
-        compiled = jitted.lower(feed_avals, param_avals,
-                                opt_avals, lr_aval, step_aval).compile()
+        def compile_step():
+            # deferred: a run_steps-only caller (bench fused loop) must
+            # not pay the single-step XLA compile it never invokes
+            return jitted.lower(feed_avals, param_avals, opt_avals,
+                                lr_aval, step_aval).compile()
+
         return {
-            "compiled": compiled,
+            "compiled": None,
+            "compile_step": compile_step,
             "pure": pure,
             "donate": donate,
             "feed_names": feed_names,

@@ -1,0 +1,211 @@
+"""One-shot migration: hand-written single-dispatch op bindings →
+`kind: sig` rows in ops.yaml (VERDICT r4 missing #5 — codegen breadth).
+
+A function qualifies when its body is a single `return dispatch(...)`
+(docstring allowed) and the expression's free names are limited to the
+generator runtime namespace (dispatch/jax/jnp/Tensor/_axis/_dt +
+builtins + its own parameters).  For each one the script
+
+  1. rewrites its ops.yaml row from flow-style `kind: manual` to a
+     block row with `kind: sig`, `sig:` and a literal-block `expr:`;
+  2. deletes the def from its module and adds the name to the module's
+     `from ._generated import (...)` re-export;
+  3. regenerates _generated.py.
+
+Run from the repo root; idempotent only in the sense that already-
+migrated functions no longer exist in the modules.
+"""
+from __future__ import annotations
+
+import ast
+import builtins
+import pathlib
+import re
+import sys
+
+ROOT = pathlib.Path(__file__).resolve().parents[1]
+OPS = ROOT / "paddle_tpu" / "ops"
+MODULES = ["math.py", "manipulation.py", "creation.py", "reduction.py",
+           "comparison.py", "linalg.py", "logic.py"]
+ALLOWED = {"dispatch", "jax", "jnp", "Tensor", "_axis", "_dt"} | set(
+    dir(builtins))
+
+
+def _signature_of(fn: ast.FunctionDef, src: str) -> str | None:
+    a = fn.args
+    if a.posonlyargs or a.vararg or a.kwarg or a.kwonlyargs:
+        return None
+    parts = []
+    defaults = [None] * (len(a.args) - len(a.defaults)) + list(a.defaults)
+    for arg, d in zip(a.args, defaults):
+        if arg.arg == "name":
+            continue
+        if d is None:
+            parts.append(arg.arg)
+        else:
+            parts.append(f"{arg.arg}={ast.get_source_segment(src, d)}")
+    return ", ".join(parts)
+
+
+def _free_names(node: ast.AST, params: set) -> set:
+    names = set()
+
+    class V(ast.NodeVisitor):
+        def visit_Name(self, n):
+            if isinstance(n.ctx, ast.Load):
+                names.add(n.id)
+
+        def visit_Lambda(self, n):
+            inner = {x.arg for x in (n.args.args + n.args.kwonlyargs)}
+            if n.args.vararg:
+                inner.add(n.args.vararg.arg)
+            if n.args.kwarg:
+                inner.add(n.args.kwarg.arg)
+            for d in n.args.defaults + [
+                    x for x in n.args.kw_defaults if x]:
+                self.visit(d)
+            sub = _free_names(n.body, params | inner)
+            names.update(sub)
+
+    V().visit(node)
+    return {n for n in names if n not in params}
+
+
+def candidates(path: pathlib.Path):
+    src = path.read_text()
+    tree = ast.parse(src)
+    for node in tree.body:
+        if not isinstance(node, ast.FunctionDef):
+            continue
+        if node.name.startswith("_") or node.decorator_list:
+            continue
+        body = list(node.body)
+        if body and isinstance(body[0], ast.Expr) and isinstance(
+                body[0].value, ast.Constant):
+            body = body[1:]
+        if len(body) != 1 or not isinstance(body[0], ast.Return):
+            continue
+        ret = body[0].value
+        if not (isinstance(ret, ast.Call)
+                and getattr(ret.func, "id", "") == "dispatch"
+                and ret.args and isinstance(ret.args[0], ast.Constant)):
+            continue
+        sig = _signature_of(node, src)
+        if sig is None:
+            continue
+        params = {x.arg for x in node.args.args}
+        free = _free_names(ret, params)
+        if free - ALLOWED:
+            continue
+        expr = ast.get_source_segment(src, ret)
+        yield node, sig, expr, ret.args[0].value
+
+
+def rewrite_yaml(yaml_path: pathlib.Path, migrations: dict):
+    """migrations: api -> (op, sig, expr)."""
+    lines = yaml_path.read_text().splitlines(keepends=True)
+    out = []
+    done = set()
+    for line in lines:
+        m = re.match(r"- \{(.*)\}\s*$", line.strip())
+        row = None
+        if m and "kind: manual" in line:
+            fields = {}
+            for part in re.split(r",\s*(?=[a-z_]+:)", m.group(1)):
+                k, _, v = part.partition(":")
+                fields[k.strip()] = v.strip()
+            row = fields
+        api = row.get("api") if row else None
+        if api in migrations and api not in done:
+            op, sig, expr = migrations[api]
+            assert row.get("op") == op, (api, row.get("op"), op)
+            done.add(api)
+            block = [f"- api: {api}\n", f"  op: {op}\n",
+                     "  kind: sig\n"]
+            for k in ("amp", "vjp", "differentiable"):
+                if k in row:
+                    block.append(f"  {k}: {row[k]}\n")
+            block.append(f"  sig: {sig!r}\n")
+            block.append("  expr: |\n")
+            for el in expr.splitlines():
+                block.append(f"    {el.rstrip()}\n" if el.strip()
+                             else "\n")
+            out.extend(block)
+        else:
+            out.append(line)
+    missing = set(migrations) - done
+    assert not missing, f"yaml rows not found for: {sorted(missing)}"
+    yaml_path.write_text("".join(out))
+
+
+def rewrite_module(path: pathlib.Path, names: list):
+    src = path.read_text()
+    tree = ast.parse(src)
+    lines = src.splitlines(keepends=True)
+    drop = set()
+    for node in tree.body:
+        if isinstance(node, ast.FunctionDef) and node.name in names:
+            start = min([node.lineno] + [d.lineno
+                                         for d in node.decorator_list])
+            for i in range(start - 1, node.end_lineno):
+                drop.add(i)
+            # also the blank lines following the def
+            j = node.end_lineno
+            while j < len(lines) and lines[j].strip() == "":
+                drop.add(j)
+                j += 1
+    kept = [l for i, l in enumerate(lines) if i not in drop]
+    imp = ("from ._generated import (  # noqa: F401  (sig-kind rows)\n"
+           + "".join(f"    {n},\n" for n in sorted(names)) + ")\n")
+    # insert after the last top-level import
+    out, inserted = [], False
+    tree2 = ast.parse("".join(kept))
+    last_import_end = max((n.end_lineno for n in tree2.body if isinstance(
+        n, (ast.Import, ast.ImportFrom))), default=0)
+    for i, l in enumerate(kept):
+        out.append(l)
+        if i + 1 == last_import_end and not inserted:
+            out.append(imp)
+            inserted = True
+    if not inserted:
+        out.insert(0, imp)
+    path.write_text("".join(out))
+
+
+def main():
+    yaml_path = OPS / "ops.yaml"
+    manual_apis = set()
+    for line in yaml_path.read_text().splitlines():
+        m = re.search(r"api: ([a-z0-9_]+),", line)
+        if m and "kind: manual" in line:
+            manual_apis.add(m.group(1))
+    all_migrations = {}
+    per_module = {}
+    for mod in MODULES:
+        p = OPS / mod
+        if not p.exists():
+            continue
+        for node, sig, expr, op in candidates(p):
+            if node.name not in manual_apis:
+                print(f"skip {mod}:{node.name} (no manual yaml row "
+                      f"under that api)")
+                continue
+            all_migrations[node.name] = (op, sig, expr)
+            per_module.setdefault(mod, []).append(node.name)
+    print(f"migrating {len(all_migrations)} ops:",
+          {m: len(v) for m, v in per_module.items()})
+    rewrite_yaml(yaml_path, all_migrations)
+    for mod, names in per_module.items():
+        rewrite_module(OPS / mod, names)
+    # load gen.py standalone: importing paddle_tpu.ops would pull the
+    # rewritten modules, whose `from ._generated import ...` lines need
+    # the regeneration that hasn't happened yet
+    import importlib.util
+    spec = importlib.util.spec_from_file_location("gen", OPS / "gen.py")
+    gen = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(gen)
+    gen.main()
+
+
+if __name__ == "__main__":
+    main()
