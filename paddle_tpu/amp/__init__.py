@@ -77,6 +77,10 @@ def _amp_caster(op_name, args):
     st = amp_state()
     if st is None or not st.enable:
         return args
+    if op_name == "cast":
+        # never rewrite cast's own input: _cast_tensor dispatches
+        # "cast", so casting it again recurses forever
+        return args
     if st.level == "O2":
         # cast everything except black list
         if op_name in BLACK_LIST:

@@ -170,11 +170,16 @@ def dispatch(name: str, impl: Callable, args: Sequence[Any], attrs=None,
 
     attrs = attrs or {}
 
-    if _state.static_hook is not None:
-        return _state.static_hook(name, impl, args, attrs)
-
+    # AMP runs BEFORE the static hook: auto_cast inside program_guard
+    # must record cast ops into the Program (the reference's static AMP
+    # pass role).  Variables are Tensors with aval _values, so the
+    # caster's dtype checks work symbolically.  Round-5 window-3 found
+    # the opposite order silently building all-f32 "AMP" programs.
     if _state.amp_caster is not None:
         args = _state.amp_caster(name, args)
+
+    if _state.static_hook is not None:
+        return _state.static_hook(name, impl, args, attrs)
 
     tensor_idx = [i for i, a in enumerate(args) if isinstance(a, Tensor)]
     tensors = [args[i] for i in tensor_idx]

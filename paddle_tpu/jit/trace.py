@@ -341,7 +341,9 @@ def to_static(function=None, input_spec=None, build_strategy=None,
         if isinstance(fn, SotFunction):
             fn = fn._fn  # mode switch: SOT -> full-graph AST trace
         if isinstance(fn, TracedFunction):
-            return fn
+            if input_spec is None:
+                return fn
+            fn = fn._orig_fn  # re-trace under the new input_spec
 
         if isinstance(fn, Layer):
             fwd = fn.forward

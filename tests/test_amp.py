@@ -112,3 +112,44 @@ def test_amp_conv_transpose_backward_bf16():
     loss = h.sum()
     loss.backward()
     assert conv.weight.grad is not None
+
+
+def test_static_auto_cast_records_bf16_casts():
+    """auto_cast inside program_guard must actually rewrite dtypes:
+    round-5 found the static hook consuming ops before the AMP caster
+    ran, silently building all-f32 'AMP' programs."""
+    import jax
+    import numpy as np
+    from paddle_tpu import static, optimizer
+
+    paddle.enable_static()
+    try:
+        main = static.Program()
+        with static.program_guard(main):
+            x = static.data("x", [4, 8], "float32")
+            y = static.data("y", [4, 1], "float32")
+            lin = paddle.nn.Linear(8, 1)
+            with paddle.amp.auto_cast(dtype="bfloat16", level="O1"):
+                loss = paddle.nn.functional.mse_loss(lin(x), y)
+            opt = optimizer.SGD(learning_rate=0.1,
+                                parameters=main.all_parameters())
+            opt.minimize(loss)
+        exe = static.Executor()
+        fd = {"x": np.ones((4, 8), np.float32),
+              "y": np.ones((4, 1), np.float32)}
+        call, _ = exe._prologue(main, fd, [loss], 0)
+        entry, fv, pv, ov, lr, st = call
+        aval = lambda t: jax.tree.map(
+            lambda a: jax.ShapeDtypeStruct(np.shape(a), a.dtype), t)
+        txt = jax.jit(entry["pure"]).lower(
+            aval(fv), aval(pv), aval(ov),
+            jax.ShapeDtypeStruct((), np.float32),
+            jax.ShapeDtypeStruct((), np.int32)).as_text()
+        assert "bf16" in txt, "static auto_cast(bfloat16) produced no bf16"
+        # and the compiled step still trains
+        (l0,) = exe.run(main, feed=fd, fetch_list=[loss])
+        for _ in range(5):
+            (l1,) = exe.run(main, feed=fd, fetch_list=[loss])
+        assert float(l1) < float(l0)
+    finally:
+        paddle.disable_static()
