@@ -58,6 +58,7 @@ sgn = sign
 from ._generated import cumsum, cumprod, logsumexp  # noqa: F401
 from ._generated import (  # noqa: F401  (sig-kind rows)
     addmm,
+    clip,
     copysign,
     gammaln,
     i0,
@@ -81,13 +82,6 @@ from ._generated import (  # noqa: F401  (sig-kind rows)
     stanh,
     trace,
 )
-
-
-def clip(x, min=None, max=None, name=None):
-    min = min.item() if isinstance(min, Tensor) and min.size == 1 else min
-    max = max.item() if isinstance(max, Tensor) and max.size == 1 else max
-    return dispatch("clip", lambda v, *, lo, hi: jnp.clip(v, lo, hi), (x,),
-                    dict(lo=min, hi=max))
 
 
 def scale(x, scale=1.0, bias=0.0, bias_after_scale=True, act=None, name=None):

@@ -27,6 +27,7 @@ from ._generated import (  # noqa: F401
     count_nonzero)
 from ._generated import (  # noqa: F401  (sig-kind rows)
     nanmedian,
+    nanquantile,
     std,
     var,
 )
@@ -140,17 +141,5 @@ def quantile(x, q, axis=None, keepdim=False, interpolation="linear",
     return dispatch("quantile", impl, (x,),
                     dict(q=qv, axis=ax, keepdims=bool(keepdim),
                          method=interpolation))
-
-
-def nanquantile(x, q, axis=None, keepdim=False, interpolation="linear",
-                name=None):
-    qv = q if not isinstance(q, Tensor) else q.numpy()
-    return dispatch(
-        "nanquantile",
-        lambda v, *, q, axis, keepdims, method: jnp.nanquantile(
-            v.astype(jnp.float32), jnp.asarray(q), axis=axis,
-            keepdims=keepdims, method=method),
-        (x,), dict(q=qv, axis=_axis(axis), keepdims=bool(keepdim),
-                   method=interpolation))
 
 

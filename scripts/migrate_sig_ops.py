@@ -71,8 +71,32 @@ def _free_names(node: ast.AST, params: set) -> set:
     return {n for n in names if n not in params}
 
 
+def _stmt_source(lines, stmt, dedent=4):
+    """Full-line slice of a statement, dedented by the function-body
+    indent — unlike get_source_segment this keeps if/else internal
+    indentation consistent."""
+    out = []
+    for ln in lines[stmt.lineno - 1:stmt.end_lineno]:
+        ln = ln.rstrip("\n")
+        out.append(ln[dedent:] if ln[:dedent].strip() == "" else ln)
+    return "\n".join(out)
+
+
+def _bound_names(stmt):
+    names = set()
+    for n in ast.walk(stmt):
+        if isinstance(n, ast.Name) and isinstance(
+                n.ctx, (ast.Store, ast.Del)):
+            names.add(n.id)
+    return names
+
+
+MAX_PRELUDE = 3
+
+
 def candidates(path: pathlib.Path):
     src = path.read_text()
+    lines = src.splitlines()
     tree = ast.parse(src)
     for node in tree.body:
         if not isinstance(node, ast.FunctionDef):
@@ -83,22 +107,36 @@ def candidates(path: pathlib.Path):
         if body and isinstance(body[0], ast.Expr) and isinstance(
                 body[0].value, ast.Constant):
             body = body[1:]
-        if len(body) != 1 or not isinstance(body[0], ast.Return):
+        if not 1 <= len(body) <= 1 + MAX_PRELUDE \
+                or not isinstance(body[-1], ast.Return):
             continue
-        ret = body[0].value
+        prelude_stmts, ret = body[:-1], body[-1].value
         if not (isinstance(ret, ast.Call)
                 and getattr(ret.func, "id", "") == "dispatch"
                 and ret.args and isinstance(ret.args[0], ast.Constant)):
+            continue
+        if any(isinstance(s, (ast.FunctionDef, ast.Return, ast.Global,
+                              ast.Nonlocal, ast.Import, ast.ImportFrom))
+               for s in prelude_stmts):
             continue
         sig = _signature_of(node, src)
         if sig is None:
             continue
         params = {x.arg for x in node.args.args}
-        free = _free_names(ret, params)
-        if free - ALLOWED:
+        ok = True
+        for s in prelude_stmts:
+            if _free_names(s, params) - ALLOWED:
+                ok = False
+                break
+            params |= _bound_names(s)
+        if not ok or _free_names(ret, params) - ALLOWED:
             continue
-        expr = ast.get_source_segment(src, ret)
-        yield node, sig, expr, ret.args[0].value
+        prelude = "\n".join(_stmt_source(lines, s)
+                            for s in prelude_stmts) or None
+        expr_src = _stmt_source(lines, body[-1])
+        assert expr_src.startswith("return ")
+        expr = expr_src[len("return "):]
+        yield node, sig, prelude, expr, ret.args[0].value
 
 
 def rewrite_yaml(yaml_path: pathlib.Path, migrations: dict):
@@ -117,7 +155,7 @@ def rewrite_yaml(yaml_path: pathlib.Path, migrations: dict):
             row = fields
         api = row.get("api") if row else None
         if api in migrations and api not in done:
-            op, sig, expr = migrations[api]
+            op, sig, prelude, expr = migrations[api]
             assert row.get("op") == op, (api, row.get("op"), op)
             done.add(api)
             block = [f"- api: {api}\n", f"  op: {op}\n",
@@ -126,6 +164,11 @@ def rewrite_yaml(yaml_path: pathlib.Path, migrations: dict):
                 if k in row:
                     block.append(f"  {k}: {row[k]}\n")
             block.append(f"  sig: {sig!r}\n")
+            if prelude:
+                block.append("  prelude: |\n")
+                for pl in prelude.splitlines():
+                    block.append(f"    {pl.rstrip()}\n" if pl.strip()
+                                 else "\n")
             block.append("  expr: |\n")
             for el in expr.splitlines():
                 block.append(f"    {el.rstrip()}\n" if el.strip()
@@ -154,15 +197,27 @@ def rewrite_module(path: pathlib.Path, names: list):
             while j < len(lines) and lines[j].strip() == "":
                 drop.add(j)
                 j += 1
-    kept = [l for i, l in enumerate(lines) if i not in drop]
-    imp = ("from ._generated import (  # noqa: F401  (sig-kind rows)\n"
-           + "".join(f"    {n},\n" for n in sorted(names)) + ")\n")
+    kept = "".join(l for i, l in enumerate(lines) if i not in drop)
+    header = "from ._generated import (  # noqa: F401  (sig-kind rows)\n"
+    block = re.compile(re.escape(header) + r"((?:    \w+,\n)+)\)\n")
+    m = block.search(kept)
+    if m:
+        # extend the existing sig-kind import block (keep it sorted)
+        merged = sorted(set(m.group(1).splitlines()) |
+                        {f"    {n}," for n in names})
+        kept = (kept[:m.start()] + header
+                + "".join(ln + "\n" for ln in merged) + ")\n"
+                + kept[m.end():])
+        path.write_text(kept)
+        return
+    imp = header + "".join(f"    {n},\n" for n in sorted(names)) + ")\n"
     # insert after the last top-level import
     out, inserted = [], False
-    tree2 = ast.parse("".join(kept))
+    kept_lines = kept.splitlines(keepends=True)
+    tree2 = ast.parse(kept)
     last_import_end = max((n.end_lineno for n in tree2.body if isinstance(
         n, (ast.Import, ast.ImportFrom))), default=0)
-    for i, l in enumerate(kept):
+    for i, l in enumerate(kept_lines):
         out.append(l)
         if i + 1 == last_import_end and not inserted:
             out.append(imp)
@@ -185,12 +240,12 @@ def main():
         p = OPS / mod
         if not p.exists():
             continue
-        for node, sig, expr, op in candidates(p):
+        for node, sig, prelude, expr, op in candidates(p):
             if node.name not in manual_apis:
                 print(f"skip {mod}:{node.name} (no manual yaml row "
                       f"under that api)")
                 continue
-            all_migrations[node.name] = (op, sig, expr)
+            all_migrations[node.name] = (op, sig, prelude, expr)
             per_module.setdefault(mod, []).append(node.name)
     print(f"migrating {len(all_migrations)} ops:",
           {m: len(v) for m, v in per_module.items()})
