@@ -60,7 +60,8 @@ class DType:
         return self.name in ("float16", "bfloat16", "float32", "float64")
 
     def is_integer(self):
-        return self.name in ("uint8", "int8", "int16", "int32", "int64")
+        return self.name in ("uint8", "uint16", "uint32", "uint64",
+                             "int8", "int16", "int32", "int64")
 
     def is_complex(self):
         return self.name in ("complex64", "complex128")
@@ -70,6 +71,13 @@ class DType:
 dtype = DType
 
 uint8 = DType("uint8", np.uint8)
+# u16/u32/u64 are not public Paddle dtypes but must round-trip through
+# static Program Variables: JAX PRNG keys are uint32, and rng ops are
+# recorded ops since the Executor threads generator state (VarDesc's
+# UINT16/32/64 play the same internal role upstream)
+uint16 = DType("uint16", np.uint16)
+uint32 = DType("uint32", np.uint32)
+uint64 = DType("uint64", np.uint64)
 int8 = DType("int8", np.int8)
 int16 = DType("int16", np.int16)
 int32 = DType("int32", np.int32)
@@ -84,6 +92,9 @@ bool_ = DType("bool", np.bool_)
 
 _NP_TO_PADDLE = {
     np.dtype(np.uint8): uint8,
+    np.dtype(np.uint16): uint16,
+    np.dtype(np.uint32): uint32,
+    np.dtype(np.uint64): uint64,
     np.dtype(np.int8): int8,
     np.dtype(np.int16): int16,
     np.dtype(np.int32): int32,

@@ -24,6 +24,11 @@ class Generator:
             jax.random.PRNGKey(seed_val), _internal=True, stop_gradient=True)
         self._state.name = "rng_state"
         self._state.persistable = True
+        # the static Executor threads tensors so marked as loop-carried
+        # rng state (arg in, final state out) instead of baking them as
+        # compile-time constants — see static/executor.py
+        self._state._is_rng_state = True
+        self._state._generator = self
 
     def manual_seed(self, seed_val: int):
         self._state._inplace_update(jax.random.PRNGKey(int(seed_val)))

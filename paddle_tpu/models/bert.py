@@ -22,8 +22,16 @@ class BertConfig:
     max_position_embeddings: int = 512
     type_vocab_size: int = 2
     layer_norm_eps: float = 1e-12
+    # Paddle-parity defaults (paddlenlp BertConfig): dropout on the
+    # embeddings, each sublayer output, and the attention probs.  The
+    # static Executor threads the generator state per step, so dropout
+    # works in static programs and the fused run_steps loop.
+    hidden_dropout_prob: float = 0.1
+    attention_probs_dropout_prob: float = 0.1
     # lax.scan over stacked layer weights: compile time O(1) in depth
-    # (nn/layer/scanned.py); numerics identical to the unrolled loop
+    # (nn/layer/scanned.py); numerics identical to the unrolled loop.
+    # Requires dropout == 0 (per-layer rng inside the scanned stack is
+    # not threaded) — BertModel falls back to the unrolled loop loudly.
     use_scan_layers: bool = False
 
 
@@ -38,6 +46,7 @@ class BertEmbeddings(nn.Layer):
                                                   cfg.hidden_size)
         self.layer_norm = nn.LayerNorm(cfg.hidden_size,
                                        epsilon=cfg.layer_norm_eps)
+        self.dropout = nn.Dropout(cfg.hidden_dropout_prob)
 
     def forward(self, input_ids, token_type_ids=None):
         b, s = input_ids.shape
@@ -45,7 +54,7 @@ class BertEmbeddings(nn.Layer):
         x = self.word_embeddings(input_ids) + self.position_embeddings(pos)
         if token_type_ids is not None:
             x = x + self.token_type_embeddings(token_type_ids)
-        return self.layer_norm(x)
+        return self.dropout(self.layer_norm(x))
 
 
 class BertSelfAttention(nn.Layer):
@@ -53,6 +62,7 @@ class BertSelfAttention(nn.Layer):
         super().__init__()
         self.num_heads = cfg.num_attention_heads
         self.head_dim = cfg.hidden_size // cfg.num_attention_heads
+        self.attn_drop_p = cfg.attention_probs_dropout_prob
         self.qkv = nn.Linear(cfg.hidden_size, 3 * cfg.hidden_size)
         self.out = nn.Linear(cfg.hidden_size, cfg.hidden_size)
 
@@ -61,7 +71,9 @@ class BertSelfAttention(nn.Layer):
         qkv = paddle.reshape(self.qkv(x),
                              [b, s, 3, self.num_heads, self.head_dim])
         q, k, v = paddle.unbind(qkv, axis=2)
-        out = F.scaled_dot_product_attention(q, k, v, attn_mask=attn_mask)
+        out = F.scaled_dot_product_attention(
+            q, k, v, attn_mask=attn_mask, dropout_p=self.attn_drop_p,
+            training=self.training)
         return self.out(paddle.reshape(out, [b, s, h]))
 
 
@@ -73,10 +85,12 @@ class BertLayer(nn.Layer):
         self.fc1 = nn.Linear(cfg.hidden_size, cfg.intermediate_size)
         self.fc2 = nn.Linear(cfg.intermediate_size, cfg.hidden_size)
         self.ln2 = nn.LayerNorm(cfg.hidden_size, epsilon=cfg.layer_norm_eps)
+        self.dropout = nn.Dropout(cfg.hidden_dropout_prob)
 
     def forward(self, x, attn_mask=None):
-        x = self.ln1(x + self.attention(x, attn_mask))
-        x = self.ln2(x + self.fc2(F.gelu(self.fc1(x), approximate=True)))
+        x = self.ln1(x + self.dropout(self.attention(x, attn_mask)))
+        x = self.ln2(x + self.dropout(
+            self.fc2(F.gelu(self.fc1(x), approximate=True))))
         return x
 
 
@@ -90,9 +104,23 @@ class BertModel(nn.Layer):
 
     def forward(self, input_ids, token_type_ids=None, attn_mask=None):
         x = self.embeddings(input_ids, token_type_ids)
-        if self.config.use_scan_layers and attn_mask is None:
-            from ..nn.layer.scanned import scan_layer_stack
-            return scan_layer_stack(self.encoder, x)
+        cfg = self.config
+        drop_active = self.training and (
+            cfg.hidden_dropout_prob > 0
+            or cfg.attention_probs_dropout_prob > 0)
+        if cfg.use_scan_layers and attn_mask is None:
+            if drop_active:
+                if not getattr(self, "_scan_fallback_warned", False):
+                    self._scan_fallback_warned = True
+                    import logging
+                    logging.getLogger("paddle_tpu.models").warning(
+                        "use_scan_layers requires dropout == 0 "
+                        "(per-layer rng is not threaded through the "
+                        "scanned stack); falling back to the unrolled "
+                        "layer loop")
+            else:
+                from ..nn.layer import scanned
+                return scanned.scan_layer_stack(self.encoder, x)
         for layer in self.encoder:
             x = layer(x, attn_mask)
         return x

@@ -128,20 +128,16 @@ def prelu(x, weight, data_format="NCHW", name=None):
 def rrelu(x, lower=1.0 / 8.0, upper=1.0 / 3.0, training=False, name=None):
     if not training:
         return leaky_relu(x, (lower + upper) / 2.0)
-    from ...ops.creation import _rng_dispatch
-    from ...framework.random import default_generator
-    g = default_generator()
+    from .common import _rng_op
 
     def impl(key, v, *, lo, hi):
-        new, sub = jax.random.split(key)
-        a = jax.random.uniform(sub, v.shape, v.dtype, lo, hi)
-        return jnp.where(v >= 0, v, a * v), new
+        a = jax.random.uniform(key, v.shape, v.dtype, lo, hi)
+        return jnp.where(v >= 0, v, a * v)
 
-    out, newk = dispatch("rrelu", impl, (g.state_tensor, x),
-                         dict(lo=float(lower), hi=float(upper)))
-    if isinstance(newk, Tensor):
-        g.state_tensor._inplace_update(newk._value)
-    return out
+    # _rng_op handles the split + state advance, and threads the rng
+    # chain through static Programs (see common._rng_op)
+    return _rng_op("rrelu", impl, (x,),
+                   dict(lo=float(lower), hi=float(upper)))
 
 
 def softplus(x, beta=1.0, threshold=20.0, name=None):
@@ -216,28 +212,24 @@ def glu(x, axis=-1, name=None):
 
 
 def gumbel_softmax(x, temperature=1.0, hard=False, axis=-1, name=None):
-    from ...framework.random import default_generator
-    g = default_generator()
+    from .common import _rng_op
 
     def impl(key, v, *, tau, hard, axis):
-        new, sub = jax.random.split(key)
-        gumbel = jax.random.gumbel(sub, v.shape, v.dtype)
+        gumbel = jax.random.gumbel(key, v.shape, v.dtype)
         y = jax.nn.softmax((v + gumbel) / tau, axis=axis)
         if hard:
             idx = jnp.argmax(y, axis=axis, keepdims=True)
-            onehot = jnp.zeros_like(y).at[...].set(0.0)
             hard_y = (jnp.arange(v.shape[axis]).reshape(
                 tuple(v.shape[axis] if i == (axis % v.ndim) else 1
                       for i in range(v.ndim))) == idx).astype(v.dtype)
             y = hard_y + jax.lax.stop_gradient(-y) + y
-        return y, new
+        return y
 
-    out, newk = dispatch("gumbel_softmax", impl, (g.state_tensor, x),
-                         dict(tau=float(temperature), hard=bool(hard),
-                              axis=int(axis)))
-    if isinstance(newk, Tensor):
-        g.state_tensor._inplace_update(newk._value)
-    return out
+    # _rng_op handles the split + state advance, and threads the rng
+    # chain through static Programs (see common._rng_op)
+    return _rng_op("gumbel_softmax", impl, (x,),
+                   dict(tau=float(temperature), hard=bool(hard),
+                        axis=int(axis)))
 
 
 def maxout(x, groups, axis=1, name=None):

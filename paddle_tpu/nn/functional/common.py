@@ -40,6 +40,28 @@ def _rng_op(name, impl_with_key, tensors, attrs):
         new, sub = jax.random.split(key)
         return impl_with_key(sub, *vs, **at), new
 
+    from ...core.dispatch import get_dispatch_state
+    from ...static.framework import Variable
+    symbolic = any(isinstance(t, Variable) for t in tensors)
+    if get_dispatch_state().static_hook is not None and symbolic:
+        # static build: thread the rng chain through the Program.  The
+        # first rng op reads the generator's state tensor (which the
+        # Executor passes as a run-time argument, NOT a baked
+        # constant); later ops read the previous op's new-state
+        # Variable, and the Executor writes the final state back to
+        # the generator after each run — same functionalized-side-
+        # effect design as the lr/step threading.
+        from ...static.framework import default_main_program
+        prog = default_main_program()
+        chain = getattr(prog, "_rng_chain", None)
+        if chain is None:
+            chain = prog._rng_chain = {}
+        state_in = chain.get(id(g), (g.state_tensor,))[0]
+        out, newk = dispatch(name, impl, (state_in,) + tuple(tensors),
+                             attrs)
+        chain[id(g)] = (newk, g)
+        return out
+
     out, newk = dispatch(name, impl, (g.state_tensor,) + tuple(tensors),
                          attrs)
     if isinstance(newk, Tensor):

@@ -96,6 +96,7 @@ class Executor:
         from ..core.lazy import concrete_values
         param_vals = concrete_values(entry["params"])
         opt_state_vals = concrete_values(entry["opt_state"])
+        rng_vals = concrete_values(entry["rng_states"])
         lr_val = jnp.asarray(0.0, jnp.float32)
         step_val = jnp.asarray(0, jnp.int32)
         if program._optimize_info is not None:
@@ -106,15 +107,18 @@ class Executor:
                 np.asarray(optimizer._step_count._value), jnp.int32)
             optimizer._step_count._inplace_update(
                 np.asarray(optimizer._step_count._value) + n_steps)
-        return (entry, feed_vals, param_vals, opt_state_vals, lr_val,
-                step_val), fetch_list
+        return (entry, feed_vals, param_vals, opt_state_vals, rng_vals,
+                lr_val, step_val), fetch_list
 
     @staticmethod
-    def _epilogue(entry, outs, new_params, new_opt_state, return_numpy):
+    def _epilogue(entry, outs, new_params, new_opt_state, new_rng,
+                  return_numpy):
         for p, v in zip(entry["params"], new_params):
             p._value = v
         for t, v in zip(entry["opt_state"], new_opt_state):
             t._value = v
+        for t, v in zip(entry["rng_states"], new_rng):
+            t._value = v  # eager rng continues from the program's state
         if return_numpy:
             return [np.asarray(o) for o in outs]
         return [Tensor(o, _internal=True) for o in outs]
@@ -125,15 +129,17 @@ class Executor:
         call, fetch_list = self._prologue(program, feed, fetch_list, 1)
         if call is None:
             return [None for _ in fetch_list]
-        entry, feed_vals, param_vals, opt_state_vals, lr_val, step_val = call
+        (entry, feed_vals, param_vals, opt_state_vals, rng_vals,
+         lr_val, step_val) = call
         if entry["compiled"] is None:
             entry["compiled"] = entry["compile_step"]()
         from ..device import hbm_oom_context
         with hbm_oom_context():
-            outs, new_params, new_opt_state = entry["compiled"](
-                feed_vals, param_vals, opt_state_vals, lr_val, step_val)
+            outs, new_params, new_opt_state, new_rng = entry["compiled"](
+                feed_vals, param_vals, opt_state_vals, rng_vals,
+                lr_val, step_val)
         return self._epilogue(entry, outs, new_params, new_opt_state,
-                              return_numpy)
+                              new_rng, return_numpy)
 
     # ------------------------------------------------------------------
     def _cache_key(self, program, feed, fetch_list):
@@ -164,30 +170,45 @@ class Executor:
         trainable = [t for t in captured if not t.stop_gradient]
         opt = program._optimize_info  # (optimizer, loss_var) or None
 
+        # generator state tensors thread as run-time args with the
+        # program's final rng state written back after each run
+        # (functionalized side effect — baking them as constants would
+        # replay the SAME dropout masks every step).  _rng_op built the
+        # chain: {id(generator): (final_state_var, generator)}.
+        chain = getattr(program, "_rng_chain", None) or {}
+        finals = {id(g.state_tensor): v for v, g in chain.values()}
+        rng_states = [t for t in captured
+                      if getattr(t, "_is_rng_state", False)
+                      and id(t) in finals]
+        rng_final_vars = [finals[id(t)] for t in rng_states]
+
         opt_state: list = []
         if opt is not None:
             optimizer, loss_var = opt
             # materialize accumulators eagerly (once)
             opt_state = optimizer._ensure_static_state(trainable)
 
-        def run_ops(feed_vals, param_vals):
+        def run_ops(feed_vals, param_vals, rng_vals):
             env = dict(zip(feed_names, feed_vals))
-            pmap = {id(p): v for p, v in zip(trainable, param_vals)}
+            cmap = {id(p): v for p, v in zip(trainable, param_vals)}
+            cmap.update(
+                {id(t): v for t, v in zip(rng_states, rng_vals)})
             return run_program_ops(
-                block.ops, env, lambda i: pmap.get(id(i), i._value))
+                block.ops, env, lambda i: cmap.get(id(i), i._value))
 
         if opt is None:
-            def pure(feed_vals, param_vals, opt_vals, lr, step):
+            def pure(feed_vals, param_vals, opt_vals, rng_vals, lr, step):
                 del lr, step
-                env = run_ops(feed_vals, param_vals)
-                return tuple(env[v.name] for v in fetch_vars), param_vals, \
-                    opt_vals
+                env = run_ops(feed_vals, param_vals, rng_vals)
+                return (tuple(env[v.name] for v in fetch_vars),
+                        param_vals, opt_vals,
+                        tuple(env[v.name] for v in rng_final_vars))
         else:
             optimizer, loss_var = opt
 
-            def pure(feed_vals, param_vals, opt_vals, lr, step):
+            def pure(feed_vals, param_vals, opt_vals, rng_vals, lr, step):
                 def loss_fn(pvals):
-                    env = run_ops(feed_vals, pvals)
+                    env = run_ops(feed_vals, pvals, rng_vals)
                     return env[loss_var.name].astype(jnp.float32), env
 
                 (loss, env), grads = jax.value_and_grad(
@@ -198,8 +219,9 @@ class Executor:
                 new_params, new_opt = optimizer._static_update(
                     param_vals, grads, opt_vals, trainable, lr=lr,
                     step=step)
-                return tuple(env[v.name] for v in fetch_vars), \
-                    tuple(new_params), tuple(new_opt)
+                return (tuple(env[v.name] for v in fetch_vars),
+                        tuple(new_params), tuple(new_opt),
+                        tuple(env[v.name] for v in rng_final_vars))
 
         # params + optimizer state are donated: the step consumes the old
         # buffers and p._value is rebound to the outputs, so XLA aliases
@@ -219,13 +241,17 @@ class Executor:
         opt_avals = tuple(
             jax.ShapeDtypeStruct(tuple(t._value.shape), t._value.dtype)
             for t in opt_state)
+        rng_avals = tuple(
+            jax.ShapeDtypeStruct(tuple(t._value.shape), t._value.dtype)
+            for t in rng_states)
         lr_aval = jax.ShapeDtypeStruct((), jnp.float32)
         step_aval = jax.ShapeDtypeStruct((), jnp.int32)
+
         def compile_step():
             # deferred: a run_steps-only caller (bench fused loop) must
             # not pay the single-step XLA compile it never invokes
             return jitted.lower(feed_avals, param_avals, opt_avals,
-                                lr_aval, step_aval).compile()
+                                rng_avals, lr_aval, step_aval).compile()
 
         return {
             "compiled": None,
@@ -236,6 +262,7 @@ class Executor:
             "feed_dtypes": feed_dtypes,
             "params": trainable,
             "opt_state": opt_state,
+            "rng_states": rng_states,
             "loop_fn": None,
         }
 
@@ -261,7 +288,8 @@ class Executor:
                                           n_iters)
         if call is None:
             return [None for _ in fetch_list]
-        entry, feed_vals, param_vals, opt_state_vals, lr_val, step_val = call
+        (entry, feed_vals, param_vals, opt_state_vals, rng_vals,
+         lr_val, step_val) = call
 
         loop_fn = entry.get("loop_fn")
         if loop_fn is None:
@@ -271,20 +299,20 @@ class Executor:
             # n rides as a dynamic operand (fori_loop lowers to
             # while_loop) so ONE compile serves every iteration count —
             # a varying chunk size must not recompile the train step.
-            def loop(feed_vals, param_vals, opt_vals, lr, step0, n):
+            def loop(feed_vals, param_vals, opt_vals, rngs, lr, step0, n):
                 def body(i, carry):
-                    params, opts = carry
-                    _, params, opts = pure(feed_vals, params, opts,
-                                           lr, step0 + i)
-                    return (params, opts)
+                    params, opts, rng = carry
+                    _, params, opts, rng = pure(feed_vals, params, opts,
+                                                rng, lr, step0 + i)
+                    return (params, opts, rng)
 
-                params, opts = lax.fori_loop(
-                    0, n - 1, body, (param_vals, opt_vals))
+                params, opts, rngs = lax.fori_loop(
+                    0, n - 1, body, (param_vals, opt_vals, rngs))
                 # final step outside the loop so the fetches come out
                 # without being carried through every iteration
-                outs, params, opts = pure(feed_vals, params, opts, lr,
-                                          step0 + n - 1)
-                return outs, params, opts
+                outs, params, opts, rngs = pure(
+                    feed_vals, params, opts, rngs, lr, step0 + n - 1)
+                return outs, params, opts, rngs
 
             loop_fn = jax.jit(
                 loop, donate_argnums=(1, 2) if entry["donate"] else ())
@@ -292,11 +320,11 @@ class Executor:
 
         from ..device import hbm_oom_context
         with hbm_oom_context():
-            outs, new_params, new_opt_state = loop_fn(
-                feed_vals, param_vals, opt_state_vals, lr_val, step_val,
-                jnp.asarray(n_iters, jnp.int32))
+            outs, new_params, new_opt_state, new_rng = loop_fn(
+                feed_vals, param_vals, opt_state_vals, rng_vals,
+                lr_val, step_val, jnp.asarray(n_iters, jnp.int32))
         return self._epilogue(entry, outs, new_params, new_opt_state,
-                              return_numpy)
+                              new_rng, return_numpy)
 
     def close(self):
         self._cache.clear()

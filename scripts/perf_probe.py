@@ -61,7 +61,7 @@ def raw_matmul():
 
 
 def bert_step(use_pallas=True, fwd_only=False, profile=False,
-              scan_layers=False):
+              scan_layers=False, no_dropout=False):
     import numpy as np
     import paddle_tpu as paddle
     from paddle_tpu import optimizer, static
@@ -72,6 +72,9 @@ def bert_step(use_pallas=True, fwd_only=False, profile=False,
     reset_probe_cache()
 
     B, S = 32, 128
+    kw = (dict(hidden_dropout_prob=0.0,
+               attention_probs_dropout_prob=0.0)
+          if no_dropout or scan_layers else {})
     paddle.enable_static()
     main = static.Program()
     startup = static.Program()
@@ -79,7 +82,7 @@ def bert_step(use_pallas=True, fwd_only=False, profile=False,
         ids = static.data("ids", [B, S], "int64")
         labels = static.data("labels", [B, S], "int64")
         model = BertForMaskedLM(BertConfig(
-            use_scan_layers=scan_layers))
+            use_scan_layers=scan_layers, **kw))
         with paddle.amp.auto_cast(dtype="bfloat16", level="O1"):
             loss, _ = model(ids, labels=labels)
         if not fwd_only:
@@ -184,18 +187,24 @@ def main():
     import jax
     log(f"devices: {jax.devices()}")
     raw_matmul()
-    log("bert train pallas=True (fused run_steps loop):")
+    log("bert train headline-mirror (dropout on, fused run_steps loop):")
     t_p = bert_step(use_pallas=True)
     log("profiled steps -> artifacts/tpu_profile (git add + commit "
         "after capture)")
     bert_step(use_pallas=True, profile=True)
-    log("bert train pallas=False:")
-    t_x = bert_step(use_pallas=False)
-    log(f"pallas speedup: {t_x / t_p:.2f}x")
-    log("bert train scan-over-layers:")
+    # the flash-kernel comparison needs dropout 0 on BOTH arms —
+    # attention dropout excludes the Pallas path, so a dropout-on pair
+    # would compare the XLA composite against itself
+    log("bert train pallas=True (no dropout):")
+    t_u = bert_step(use_pallas=True, no_dropout=True)
+    log("bert train pallas=False (no dropout):")
+    t_x = bert_step(use_pallas=False, no_dropout=True)
+    log(f"pallas speedup: {t_x / t_u:.2f}x")
+    log("bert train scan-over-layers (dropout 0 — scan requires it):")
     t_s = bert_step(use_pallas=True, scan_layers=True)
-    log(f"scan vs unrolled: {t_p / t_s:.2f}x step "
+    log(f"scan vs unrolled: {t_u / t_s:.2f}x step "
         f"(compile-time win is logged above per config)")
+    log(f"dropout cost: {t_p / t_u:.2f}x (headline vs no-dropout)")
     log("bert fwd-only (per-step dispatch, tunnel-RTT-bound):")
     bert_step(fwd_only=True)
     log("eager-vs-lazy dygraph gap:")

@@ -138,11 +138,11 @@ def test_static_auto_cast_records_bf16_casts():
         fd = {"x": np.ones((4, 8), np.float32),
               "y": np.ones((4, 1), np.float32)}
         call, _ = exe._prologue(main, fd, [loss], 0)
-        entry, fv, pv, ov, lr, st = call
+        entry, fv, pv, ov, rv, lr, st = call
         aval = lambda t: jax.tree.map(
             lambda a: jax.ShapeDtypeStruct(np.shape(a), a.dtype), t)
         txt = jax.jit(entry["pure"]).lower(
-            aval(fv), aval(pv), aval(ov),
+            aval(fv), aval(pv), aval(ov), aval(rv),
             jax.ShapeDtypeStruct((), np.float32),
             jax.ShapeDtypeStruct((), np.int32)).as_text()
         assert "bf16" in txt, "static auto_cast(bfloat16) produced no bf16"

@@ -248,11 +248,24 @@ def test_bert_scan_layers_parity():
         cfg = BertConfig(vocab_size=128, hidden_size=32,
                          num_hidden_layers=4, num_attention_heads=2,
                          intermediate_size=64, max_position_embeddings=32,
-                         use_scan_layers=scan)
+                         use_scan_layers=scan,
+                         # scan requires dropout 0 (falls back loudly
+                         # otherwise, which would make this test vacuous)
+                         hidden_dropout_prob=0.0,
+                         attention_probs_dropout_prob=0.0)
         m = BertForMaskedLM(cfg)
+        from paddle_tpu.nn.layer.scanned import scan_layer_stack
+        import unittest.mock as mock
         ids = paddle.to_tensor(np.random.RandomState(0)
                                .randint(0, 128, (2, 16)).astype(np.int64))
-        loss, _ = m(ids, labels=ids)
+        if scan:  # guard against a silent fallback to the unrolled loop
+            with mock.patch(
+                    "paddle_tpu.nn.layer.scanned.scan_layer_stack",
+                    side_effect=scan_layer_stack) as spy:
+                loss, _ = m(ids, labels=ids)
+            assert spy.called, "scan path silently fell back"
+        else:
+            loss, _ = m(ids, labels=ids)
         loss.backward()
         g = m.bert.encoder[2].fc1.weight.grad.numpy()
         return float(loss), g

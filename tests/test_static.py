@@ -289,3 +289,103 @@ def test_run_steps_varying_n_single_compile():
                                rtol=1e-6, atol=1e-7)
     (entry,) = exe_b._cache.values()
     assert entry["loop_fn"]._cache_size() == 1
+
+
+def _build_dropout_program(seed):
+    paddle.seed(seed)
+    main = static.Program()
+    with static.program_guard(main):
+        x = static.data("x", [16, 8], "float32")
+        y = static.data("y", [16, 1], "float32")
+        h = nn.Linear(8, 16)(x)
+        h = paddle.nn.functional.dropout(h, p=0.5, training=True)
+        pred = nn.Linear(16, 1)(h)
+        loss = paddle.nn.functional.mse_loss(pred, y)
+        opt = optimizer.Adam(learning_rate=0.01,
+                             parameters=main.all_parameters())
+        opt.minimize(loss)
+    return main, loss
+
+
+def test_static_dropout_threads_rng_state():
+    """rng ops record into the Program and the Executor threads the
+    generator state (arg in, final state out) — NOT baked constants:
+    masks must differ across run() calls, and eager rng must continue
+    from the program's final state."""
+    paddle.enable_static()
+    paddle.seed(0)
+    main = static.Program()
+    with static.program_guard(main):
+        x = static.data("x", [64, 64], "float32")
+        y = paddle.nn.functional.dropout(x, p=0.5, training=True)
+    exe = static.Executor()
+    fd = {"x": np.ones((64, 64), np.float32)}
+    (a,) = exe.run(main, feed=fd, fetch_list=[y])
+    (b,) = exe.run(main, feed=fd, fetch_list=[y])
+    assert not (a == b).all(), "same dropout mask every run"
+    # p=0.5 sanity: roughly half survive
+    assert 0.3 < (a != 0).mean() < 0.7
+    # eager rng continues from the program's final state
+    from paddle_tpu.framework.random import default_generator
+    s0 = np.asarray(default_generator().state_tensor._value).copy()
+    (c,) = exe.run(main, feed=fd, fetch_list=[y])
+    s1 = np.asarray(default_generator().state_tensor._value)
+    assert not (s0 == s1).all(), "generator state did not advance"
+
+
+def test_run_steps_rng_matches_sequential():
+    """The fused loop must advance the rng chain per iteration exactly
+    like sequential run() calls: same final loss, same final state."""
+    paddle.enable_static()
+    rng = np.random.RandomState(0)
+    xv = rng.rand(16, 8).astype(np.float32)
+    yv = rng.rand(16, 1).astype(np.float32)
+    fd = {"x": xv, "y": yv}
+    from paddle_tpu.framework.random import default_generator
+
+    main_a, loss_a = _build_dropout_program(33)
+    ga = np.asarray(default_generator().state_tensor._value).copy()
+    exe_a = static.Executor()
+    for _ in range(4):
+        (la,) = exe_a.run(main_a, feed=fd, fetch_list=[loss_a])
+    sa = np.asarray(default_generator().state_tensor._value).copy()
+
+    main_b, loss_b = _build_dropout_program(33)
+    default_generator().state_tensor._inplace_update(ga)  # same start
+    exe_b = static.Executor()
+    (lb,) = exe_b.run_steps(4, main_b, feed=fd, fetch_list=[loss_b])
+    sb = np.asarray(default_generator().state_tensor._value)
+
+    np.testing.assert_allclose(np.asarray(la), np.asarray(lb),
+                               rtol=1e-6, atol=1e-7)
+    np.testing.assert_array_equal(sa, sb)
+
+
+def test_eager_rng_under_enable_static_stays_eager():
+    """enable_static() + dropout on an EAGER tensor must execute
+    eagerly, advance the generator, and never touch the program's rng
+    chain (review: corrupting the chain with an eager Tensor made
+    later static rng ops bake a constant key)."""
+    from paddle_tpu.framework.random import default_generator
+    paddle.enable_static()
+    paddle.seed(0)
+    main = static.Program()
+    with static.program_guard(main):
+        # eager data prep inside static mode
+        ev = paddle.to_tensor(np.ones((32, 32), np.float32))
+        s0 = np.asarray(default_generator().state_tensor._value).copy()
+        e1 = paddle.nn.functional.dropout(ev, p=0.5, training=True)
+        assert not isinstance(e1, static.Variable)
+        e1.numpy()  # eager result materializes
+        s1 = np.asarray(default_generator().state_tensor._value)
+        assert not (s0 == s1).all(), "eager rng did not advance"
+        assert not getattr(main, "_rng_chain", None), \
+            "eager rng op leaked into the program's rng chain"
+        # and a static dropout recorded AFTER still threads properly
+        x = static.data("x", [32, 32], "float32")
+        y = paddle.nn.functional.dropout(x, p=0.5, training=True)
+    exe = static.Executor()
+    fd = {"x": np.ones((32, 32), np.float32)}
+    (a,) = exe.run(main, feed=fd, fetch_list=[y])
+    (b,) = exe.run(main, feed=fd, fetch_list=[y])
+    assert not (a == b).all(), "static mask baked to a constant"
