@@ -78,14 +78,20 @@ def main():
         info = probe_once(PROBE_WAIT_S)
         if info is not None and info.get("platform") == "tpu":
             log(f"HEALTHY WINDOW (probe {n}): {info}")
+            # pause between children: claim BURSTS precede lost grants
+            # (TUNNEL.md window-3: the 4th rapid claim cycle stalled)
             run([sys.executable, "-u", "bench.py"],
                 env_extra={"PADDLE_TPU_BENCH_CONFIGS": "bert"})
+            time.sleep(30)
             run([sys.executable, "-u", "scripts/perf_probe.py"],
                 deadline_s=5400)
+            time.sleep(30)
             run([sys.executable, "-u",
                  "scripts/flash_block_sweep.py"], deadline_s=3600)
+            time.sleep(30)
             run([sys.executable, "-u", "scripts/lazy_probe.py"],
                 deadline_s=3600)
+            time.sleep(30)
             run([sys.executable, "-u", "bench.py"],
                 env_extra={"PADDLE_TPU_BENCH_CONFIGS":
                            "bert,lenet,resnet50,gpt,llama,"
