@@ -85,15 +85,18 @@ class GPTBlock(nn.Layer):
         self.attn = GPTAttention(cfg)
         self.ln_2 = nn.LayerNorm(cfg.hidden_size)
         self.mlp = GPTMLP(cfg)
+        # GPT-2 style residual dropout (config default 0.0 — a no-op
+        # unless the user opts in; scan_layers requires it stay 0)
+        self.dropout = nn.Dropout(cfg.hidden_dropout_prob)
 
     def forward(self, x, cache=None, use_cache=False):
         if use_cache:
             a, new_cache = self.attn(self.ln_1(x), cache, True)
-            x = x + a
-            x = x + self.mlp(self.ln_2(x))
+            x = x + self.dropout(a)
+            x = x + self.dropout(self.mlp(self.ln_2(x)))
             return x, new_cache
-        x = x + self.attn(self.ln_1(x), cache)
-        x = x + self.mlp(self.ln_2(x))
+        x = x + self.dropout(self.attn(self.ln_1(x), cache))
+        x = x + self.dropout(self.mlp(self.ln_2(x)))
         return x
 
 
@@ -114,12 +117,22 @@ class GPTModel(nn.Layer):
         past = 0 if cache is None else cache[0][0].shape[1]
         pos = paddle.arange(past, past + s, dtype="int64")
         x = self.wte(input_ids) + self.wpe(pos)
+        drop_active = (self.training
+                       and self.config.hidden_dropout_prob > 0)
         if (self.config.use_scan_layers and cache is None
-                and not use_cache):
-            from ..nn.layer.scanned import scan_layer_stack
-            x = scan_layer_stack(self.h, x,
-                                 remat=self._recompute)
+                and not use_cache and not drop_active):
+            from ..nn.layer import scanned
+            x = scanned.scan_layer_stack(self.h, x,
+                                         remat=self._recompute)
             return self.ln_f(x)
+        if (self.config.use_scan_layers and drop_active
+                and not getattr(self, "_scan_fallback_warned", False)):
+            self._scan_fallback_warned = True
+            import logging
+            logging.getLogger("paddle_tpu.models").warning(
+                "use_scan_layers requires dropout == 0 (per-layer rng "
+                "is not threaded through the scanned stack); falling "
+                "back to the unrolled layer loop")
         new_caches = []
         for i, blk in enumerate(self.h):
             layer_cache = None if cache is None else cache[i]
