@@ -47,6 +47,7 @@ class GPTAttention(nn.Layer):
         super().__init__()
         self.num_heads = cfg.num_attention_heads
         self.head_dim = cfg.hidden_size // cfg.num_attention_heads
+        self.use_flash = cfg.use_flash_attention
         self.qkv_proj = nn.Linear(cfg.hidden_size, 3 * cfg.hidden_size)
         self.out_proj = nn.Linear(cfg.hidden_size, cfg.hidden_size)
 
@@ -60,7 +61,13 @@ class GPTAttention(nn.Layer):
             # mask is bottom-right aligned, so new rows see everything
             k = paddle.concat([cache[0], k], axis=1)
             v = paddle.concat([cache[1], v], axis=1)
-        out = F.scaled_dot_product_attention(q, k, v, is_causal=True)
+        if self.use_flash:
+            out = F.scaled_dot_product_attention(q, k, v, is_causal=True)
+        else:
+            from ..nn.functional.flash_attention import sdp_kernel
+            with sdp_kernel(enable_flash=False):
+                out = F.scaled_dot_product_attention(q, k, v,
+                                                     is_causal=True)
         out = paddle.reshape(out, [b, s, h])
         out = self.out_proj(out)
         if use_cache:

@@ -90,8 +90,9 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None,
     """
     scale = 1.0 / (query.shape[-1] ** 0.5)
     drop = float(dropout_p) if training else 0.0
-    use_pallas = drop == 0.0 and _use_pallas(query.shape[-1],
-                                             key.shape[1], query.dtype)
+    use_pallas = (drop == 0.0 and _flash_allowed()
+                  and _use_pallas(query.shape[-1], key.shape[1],
+                                  query.dtype))
 
     if drop > 0.0:
         from .common import _rng_op
@@ -195,14 +196,35 @@ def flash_attn_unpadded(query, key, value, cu_seqlens_q, cu_seqlens_k,
     return dispatch("flash_attn_unpadded", impl, tensors, attrs), None
 
 
-class sdp_kernel:
-    """Context manager parity shim (backend selection is automatic here)."""
+import threading as _threading
 
-    def __init__(self, *args, **kwargs):
-        pass
+_sdp_override = _threading.local()
+
+
+class sdp_kernel:
+    """Backend-selection context (reference: paddle.nn.functional.
+    sdp_kernel / torch.backends.cuda.sdp_kernel [UNVERIFIED]).
+
+    ``enable_flash=False`` forces the XLA composite even where the
+    Pallas kernel is eligible; with ``enable_flash=True`` (default)
+    selection stays automatic (_use_pallas gate).  ``enable_math`` /
+    ``enable_mem_efficient`` are accepted for parity; the composite is
+    the math path and Pallas flash is inherently memory-efficient.
+    """
+
+    def __init__(self, enable_math=True, enable_flash=True,
+                 enable_mem_efficient=True):
+        self._enable_flash = bool(enable_flash)
 
     def __enter__(self):
+        self._prev = getattr(_sdp_override, "enable_flash", None)
+        _sdp_override.enable_flash = self._enable_flash
         return self
 
     def __exit__(self, *exc):
+        _sdp_override.enable_flash = self._prev
         return False
+
+
+def _flash_allowed() -> bool:
+    return getattr(_sdp_override, "enable_flash", None) is not False

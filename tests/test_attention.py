@@ -93,3 +93,41 @@ def test_flash_attention_api():
     out, _ = F.flash_attention(q, q, q, causal=True)
     ref = _np_attn(q.numpy(), q.numpy(), q.numpy(), True)
     np.testing.assert_allclose(out.numpy(), ref, atol=1e-5)
+
+
+def test_sdp_kernel_context_forces_composite():
+    """sdp_kernel(enable_flash=False) must force the XLA composite even
+    where the Pallas gate would fire; numerics stay identical."""
+    import numpy as np
+    import paddle_tpu as paddle
+    import paddle_tpu.nn.functional as F
+    import importlib
+    # the functional package re-exports the flash_attention FUNCTION,
+    # shadowing the submodule attribute — load the module explicitly
+    fa = importlib.import_module(
+        "paddle_tpu.nn.functional.flash_attention")
+
+    rng = np.random.default_rng(0)
+    q = paddle.to_tensor(rng.standard_normal((2, 16, 2, 32),
+                                             ).astype(np.float32))
+    k = paddle.to_tensor(rng.standard_normal((2, 16, 2, 32),
+                                             ).astype(np.float32))
+    v = paddle.to_tensor(rng.standard_normal((2, 16, 2, 32),
+                                             ).astype(np.float32))
+    base = F.scaled_dot_product_attention(q, k, v, is_causal=True).numpy()
+    calls = []
+    orig = fa._use_pallas
+
+    def spy(*a, **kw):
+        calls.append(a)
+        return orig(*a, **kw)
+
+    fa._use_pallas = spy
+    try:
+        with fa.sdp_kernel(enable_flash=False):
+            alt = F.scaled_dot_product_attention(
+                q, k, v, is_causal=True).numpy()
+        assert not calls, "pallas gate consulted despite enable_flash=False"
+    finally:
+        fa._use_pallas = orig
+    np.testing.assert_allclose(base, alt, rtol=1e-5, atol=1e-6)
