@@ -5,6 +5,8 @@ mount].
 """
 from __future__ import annotations
 
+import threading as _threading
+
 from ..core.autograd import (backward, grad, no_grad, enable_grad,
                              set_grad_enabled, is_grad_enabled)
 
@@ -18,14 +20,27 @@ class PyLayerContext:
         self.attrs = {}
 
     def save_for_backward(self, *tensors):
-        self._saved = list(tensors)
+        hooks = saved_tensors_hooks._current()
+        if hooks is not None:
+            pack, _ = hooks
+            self._saved = [pack(t) for t in tensors]
+            self._saved_hooks = hooks
+        else:
+            self._saved = list(tensors)
+            self._saved_hooks = None
+
+    def _unpacked(self):
+        if getattr(self, "_saved_hooks", None) is not None:
+            _, unpack = self._saved_hooks
+            return [unpack(p) for p in self._saved]
+        return self._saved
 
     @property
     def saved_tensor(self):
-        return self._saved
+        return self._unpacked()
 
     def saved_tensors(self):
-        return self._saved
+        return self._unpacked()
 
     def mark_not_inplace(self, *args):
         pass
@@ -98,11 +113,30 @@ class PyLayer(metaclass=PyLayerMeta):
 
 
 class saved_tensors_hooks:
+    """Intercept PyLayer activation saving (reference:
+    `paddle.autograd.saved_tensors_hooks` [UNVERIFIED]): while active,
+    ``ctx.save_for_backward`` stores ``pack_hook(t)`` and backward
+    reads ``unpack_hook(packed)`` — the offload-to-host / compress
+    pattern.  Scope: PyLayer saves.  The built-in op backwards hold
+    residuals inside jax.vjp closures, which XLA buffer-manages on
+    device; rematerialization there is ``paddle.distributed.fleet.
+    recompute`` / ``jax.checkpoint``, not host hooks.
+    """
+
+    _tls = _threading.local()
+
+    @classmethod
+    def _current(cls):
+        return getattr(cls._tls, "active", None)
+
     def __init__(self, pack_hook, unpack_hook):
-        pass
+        self._hooks = (pack_hook, unpack_hook)
 
     def __enter__(self):
+        self._prev = saved_tensors_hooks._current()
+        saved_tensors_hooks._tls.active = self._hooks
         return self
 
     def __exit__(self, *exc):
+        saved_tensors_hooks._tls.active = self._prev
         return False

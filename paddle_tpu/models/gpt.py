@@ -61,13 +61,10 @@ class GPTAttention(nn.Layer):
             # mask is bottom-right aligned, so new rows see everything
             k = paddle.concat([cache[0], k], axis=1)
             v = paddle.concat([cache[1], v], axis=1)
-        if self.use_flash:
+        from ..nn.functional.flash_attention import sdp_kernel
+        # enable_flash=True is exactly the automatic-selection default
+        with sdp_kernel(enable_flash=self.use_flash):
             out = F.scaled_dot_product_attention(q, k, v, is_causal=True)
-        else:
-            from ..nn.functional.flash_attention import sdp_kernel
-            with sdp_kernel(enable_flash=False):
-                out = F.scaled_dot_product_attention(q, k, v,
-                                                     is_causal=True)
         out = paddle.reshape(out, [b, s, h])
         out = self.out_proj(out)
         if use_cache:

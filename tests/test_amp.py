@@ -153,3 +153,83 @@ def test_static_auto_cast_records_bf16_casts():
         assert float(l1) < float(l0)
     finally:
         paddle.disable_static()
+
+
+def test_rewrite_program_bf16_post_hoc_pass():
+    """static.amp.bf16.rewrite_program_bf16: cast insertion over a
+    program built WITHOUT autocast — white ops get bf16 inputs, the
+    step still trains, grads stay f32 on the params."""
+    import jax
+    import numpy as np
+    from paddle_tpu import static, optimizer
+    from paddle_tpu.static import amp as samp
+
+    paddle.enable_static()
+    try:
+        main = static.Program()
+        with static.program_guard(main):
+            x = static.data("x", [8, 16], "float32")
+            y = static.data("y", [8, 1], "float32")
+            h = paddle.nn.Linear(16, 32)(x)
+            h = paddle.nn.functional.relu(h)
+            pred = paddle.nn.Linear(32, 1)(h)
+            loss = paddle.nn.functional.mse_loss(pred, y)
+        n_ops = len(main.global_block().ops)
+        samp.bf16.rewrite_program_bf16(main)
+        assert len(main.global_block().ops) > n_ops, "no casts inserted"
+        with static.program_guard(main):
+            opt = optimizer.SGD(learning_rate=0.1,
+                                parameters=main.all_parameters())
+            opt.minimize(loss)
+        exe = static.Executor()
+        rng = np.random.RandomState(0)
+        fd = {"x": rng.rand(8, 16).astype(np.float32),
+              "y": rng.rand(8, 1).astype(np.float32)}
+        call, _ = exe._prologue(main, fd, [loss], 0)
+        entry, fv, pv, ov, rv, lr, st = call
+        aval = lambda t: jax.tree.map(
+            lambda a: jax.ShapeDtypeStruct(np.shape(a), a.dtype), t)
+        txt = jax.jit(entry["pure"]).lower(
+            aval(fv), aval(pv), aval(ov), aval(rv),
+            jax.ShapeDtypeStruct((), np.float32),
+            jax.ShapeDtypeStruct((), np.int32)).as_text()
+        assert "bf16" in txt, "rewrite produced no bf16"
+        losses = [float(exe.run(main, feed=fd, fetch_list=[loss])[0])
+                  for _ in range(30)]
+        assert losses[-1] < losses[0] * 0.7, (losses[0], losses[-1])
+        for p in main.all_parameters():  # params stayed f32 (O1 rewrite)
+            assert p._value.dtype == np.float32
+    finally:
+        paddle.disable_static()
+
+
+def test_rewrite_program_bf16_restores_f32_for_black_ops():
+    """A black op downstream of a white op must get an f32 cast-back:
+    the pass tracks EFFECTIVE dtypes (build-time avals go stale as it
+    retargets), otherwise softmax/norm silently run in bf16."""
+    import jax.numpy as jnp
+    from paddle_tpu import static
+    from paddle_tpu.static import amp as samp
+
+    paddle.enable_static()
+    try:
+        main = static.Program()
+        with static.program_guard(main):
+            x = static.data("x", [4, 8], "float32")
+            h = paddle.matmul(x, paddle.to_tensor(
+                np.ones((8, 8), np.float32)))      # white
+            s = paddle.nn.functional.softmax(h)    # black
+        samp.bf16.rewrite_program_bf16(main)
+        ops = main.global_block().ops
+        sm = next(o for o in ops if o.type == "softmax")
+        casts_to_f32 = [o for o in ops if o.type == "cast"
+                        and any(o.outputs[0] is i for i in sm.inputs)
+                        and o.outputs[0]._value.dtype == jnp.float32]
+        assert casts_to_f32, (
+            "softmax input not cast back to f32 after a white matmul")
+        exe = static.Executor()
+        (out,) = exe.run(main, feed={"x": np.ones((4, 8), np.float32)},
+                         fetch_list=[s])
+        np.testing.assert_allclose(np.asarray(out).sum(), 4.0, rtol=1e-5)
+    finally:
+        paddle.disable_static()

@@ -133,3 +133,49 @@ def test_pylayer():
     y.sum().backward()
     np.testing.assert_allclose(y.numpy(), [3.0])
     np.testing.assert_allclose(x.grad.numpy(), [2.0])
+
+
+def test_saved_tensors_hooks_pack_unpack():
+    """saved_tensors_hooks intercepts PyLayer saves: pack runs at
+    save_for_backward, unpack at backward read (the offload/compress
+    pattern)."""
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu.autograd import PyLayer, saved_tensors_hooks
+
+    events = []
+
+    class Square(PyLayer):
+        @staticmethod
+        def forward(ctx, x):
+            ctx.save_for_backward(x)
+            return x * x
+
+        @staticmethod
+        def backward(ctx, dy):
+            (x,) = ctx.saved_tensors()
+            return dy * 2.0 * x
+
+    def pack(t):
+        events.append("pack")
+        return np.asarray(t.numpy())        # "offload": device -> host
+
+    def unpack(h):
+        events.append("unpack")
+        return paddle.to_tensor(h)
+
+    x = paddle.to_tensor(np.array([3.0], np.float32))
+    x.stop_gradient = False
+    with saved_tensors_hooks(pack, unpack):
+        y = Square.apply(x)
+    assert events == ["pack"]               # packed at save time
+    y.backward()
+    assert "unpack" in events
+    np.testing.assert_allclose(x.grad.numpy(), [6.0])
+    # outside the context, saving is untouched
+    events.clear()
+    x2 = paddle.to_tensor(np.array([2.0], np.float32))
+    x2.stop_gradient = False
+    Square.apply(x2).backward()
+    assert events == []
+    np.testing.assert_allclose(x2.grad.numpy(), [4.0])
