@@ -107,6 +107,14 @@ class Optimizer:
             return
         if self._grad_clip is not None:
             self._grad_clip(params)
+        l1 = self._l1_coeff()
+        if l1:
+            # L1Decay: g += coeff * sign(p), post-clip like the
+            # reference's append_regularization_ops ordering
+            from ..core.autograd import no_grad
+            with no_grad():
+                for p in params:
+                    p.grad = p.grad + l1 * p.detach().sign()
         self._apply(params)
         self._step_count._inplace_update(self._step_count._value + 1)
 
@@ -192,6 +200,12 @@ class Optimizer:
             # optimizer.state_dict()).
             self._step_count._inplace_update(np.asarray(step) + 1)
         grads = self._clip_static_grads(grads)
+        l1 = self._l1_coeff()
+        if l1:
+            grads = tuple(
+                (g.astype(jnp.float32)
+                 + l1 * jnp.sign(pv.astype(jnp.float32))).astype(g.dtype)
+                for g, pv in zip(grads, param_vals))
         return self._pure_update(lr, step, param_vals, grads, opt_vals,
                                  params)
 
@@ -263,12 +277,24 @@ class Optimizer:
     set_dict = set_state_dict
 
     def _decay_coeff(self):
+        """L2 coefficient for the per-optimizer `g + wd*p` decay term.
+        L1Decay returns 0.0 here — its `coeff*sign(p)` term is added to
+        the gradients at the two common points (step /_static_update),
+        not per-optimizer (it used to silently apply as L2)."""
         wd = self._weight_decay
         if wd is None:
+            return 0.0
+        from ..regularizer import L1Decay
+        if isinstance(wd, L1Decay):
             return 0.0
         if hasattr(wd, "_coeff"):
             return float(wd._coeff)
         return float(wd)
+
+    def _l1_coeff(self):
+        from ..regularizer import L1Decay
+        wd = self._weight_decay
+        return float(wd._coeff) if isinstance(wd, L1Decay) else 0.0
 
 
 class SGD(Optimizer):

@@ -32,7 +32,12 @@ def decorate(optimizer, amp_lists=None, init_loss_scaling=2.0 ** 15,
 
 
 def cast_model_to_fp16(program, amp_lists=None, use_fp16_guard=True):
-    return program
+    """fp16 variant of the bf16 rewrite (fp16 works on TPU but bf16 is
+    the native dtype — same exponent range as f32, no loss scaling)."""
+    import jax.numpy as jnp
+    lists = amp_lists or CustomOpLists()
+    return _rewrite_program(program, lists.white_list,
+                            lists.black_list, jnp.float16)
 
 
 def fp16_guard():

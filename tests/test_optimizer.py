@@ -141,3 +141,55 @@ def test_lamb_and_others_run():
         opt.step()
         opt.clear_grad()
         assert np.isfinite(w.numpy()).all()
+
+
+def test_l1_decay_applies_sign_not_l2():
+    """weight_decay=L1Decay must add coeff*sign(p) to grads (it used to
+    silently apply as L2: coeff*p)."""
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu import optimizer
+
+    p = paddle.to_tensor(np.array([2.0, -3.0], np.float32))
+    p.stop_gradient = False
+    opt = optimizer.SGD(learning_rate=0.1, parameters=[p],
+                        weight_decay=paddle.regularizer.L1Decay(0.5))
+    (p * 0.0).sum().backward()   # zero data gradient
+    opt.step()
+    # p' = p - lr * coeff * sign(p) = [2-0.05, -3+0.05]
+    np.testing.assert_allclose(p.numpy(), [1.95, -2.95], rtol=1e-6)
+
+    # L2 still behaves as before
+    q = paddle.to_tensor(np.array([2.0, -3.0], np.float32))
+    q.stop_gradient = False
+    opt2 = optimizer.SGD(learning_rate=0.1, parameters=[q],
+                         weight_decay=paddle.regularizer.L2Decay(0.5))
+    (q * 0.0).sum().backward()
+    opt2.step()
+    np.testing.assert_allclose(q.numpy(), [1.9, -2.85], rtol=1e-6)
+
+
+def test_l1_decay_static_parity():
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu import static, optimizer
+
+    paddle.enable_static()
+    try:
+        main = static.Program()
+        with static.program_guard(main):
+            x = static.data("x", [1, 2], "float32")
+            w = paddle.create_parameter([2, 1], "float32")
+            w.set_value(np.array([[2.0], [-3.0]], np.float32))
+            loss = (paddle.matmul(x, w) * 0.0).sum()
+            opt = optimizer.SGD(
+                learning_rate=0.1, parameters=[w],
+                weight_decay=paddle.regularizer.L1Decay(0.5))
+            opt.minimize(loss)
+        exe = static.Executor()
+        exe.run(main, feed={"x": np.ones((1, 2), np.float32)},
+                fetch_list=[loss])
+        np.testing.assert_allclose(
+            w.numpy().ravel(), [1.95, -2.95], rtol=1e-6)
+    finally:
+        paddle.disable_static()
