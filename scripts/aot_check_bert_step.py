@@ -1,0 +1,111 @@
+"""AOT-compile the FULL bench BERT train step (fused run_steps loop,
+AMP bf16, dropout rng threading, Pallas kernels forced on) against a
+v5e topology — no hardware needed.
+
+aot_check_kernels.py covers the kernels in isolation; this covers the
+whole headline program: static AMP cast insertion, the rng chain, the
+fori_loop carry, donation, AND the Pallas calls embedded in a real
+train step all have to Mosaic-compile together.  A failure here would
+otherwise burn the first minutes of a healthy tunnel window.
+
+Run: python -u scripts/aot_check_bert_step.py
+"""
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ.setdefault("TPU_WORKER_HOSTNAMES", "localhost")
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import topologies
+
+import paddle_tpu.ops.pallas_kernels as pk
+import paddle_tpu.ops.pallas_gate as pg
+
+# trace the Mosaic (non-interpret) kernel path and force the gate open:
+# there is no device to probe, but the kernels must compile for v5e
+pk._interpret = lambda: False
+pg.pallas_enabled = lambda name: True
+
+import paddle_tpu as paddle  # noqa: E402
+from paddle_tpu import optimizer, static  # noqa: E402
+from paddle_tpu.models import BertConfig, BertForMaskedLM  # noqa: E402
+
+TOPOLOGY = os.environ.get("PADDLE_TPU_AOT_TOPOLOGY", "v5e:2x2x1")
+
+
+def main():
+    topo = topologies.get_topology_desc(TOPOLOGY, "tpu")
+    sharding = jax.sharding.SingleDeviceSharding(topo.devices[0])
+
+    B, S = 64, 128  # the bench headline config
+    paddle.enable_static()
+    main_prog = static.Program()
+    startup = static.Program()
+    t = time.time()
+    with static.program_guard(main_prog, startup):
+        ids = static.data("ids", [B, S], "int64")
+        labels = static.data("labels", [B, S], "int64")
+        model = BertForMaskedLM(BertConfig())
+        with paddle.amp.auto_cast(dtype="bfloat16", level="O1"):
+            loss, _ = model(ids, labels=labels)
+        opt = optimizer.AdamW(learning_rate=1e-4,
+                              parameters=model.parameters())
+        opt.minimize(loss)
+    print(f"program built: {len(main_prog.global_block().ops)} ops "
+          f"({time.time()-t:.1f}s)", flush=True)
+
+    exe = static.Executor()
+    rng = np.random.default_rng(0)
+    x = rng.integers(0, 30522, (B, S)).astype(np.int64)
+    feed = {"ids": x, "labels": x}
+    call, _ = exe._prologue(main_prog, feed, [loss], 0)
+    entry, fv, pv, ov, rv, lr_v, st_v = call
+    pure = entry["pure"]
+
+    def aval(tree):
+        return jax.tree.map(
+            lambda a: jax.ShapeDtypeStruct(np.shape(a), np.asarray(a).dtype,
+                                           sharding=sharding), tree)
+
+    from jax import lax
+
+    def loop(feed_vals, param_vals, opt_vals, rngs, lr, step0, n):
+        def body(i, carry):
+            params, opts, r = carry
+            _, params, opts, r = pure(feed_vals, params, opts, r,
+                                      lr, step0 + i)
+            return (params, opts, r)
+
+        params, opts, rngs = lax.fori_loop(
+            0, n - 1, body, (param_vals, opt_vals, rngs))
+        outs, params, opts, rngs = pure(feed_vals, params, opts, rngs,
+                                        lr, step0 + n - 1)
+        return outs, params, opts, rngs
+
+    avals = (aval(fv), aval(pv), aval(ov), aval(rv),
+             jax.ShapeDtypeStruct((), jnp.float32, sharding=sharding),
+             jax.ShapeDtypeStruct((), jnp.int32, sharding=sharding),
+             jax.ShapeDtypeStruct((), jnp.int32, sharding=sharding))
+    t = time.time()
+    lowered = jax.jit(loop, donate_argnums=(1, 2)).lower(*avals)
+    txt = lowered.as_text()
+    n_bf16 = txt.count("bf16")
+    n_pallas = txt.count("tpu_custom_call")
+    print(f"lowered for {TOPOLOGY}: bf16 mentions={n_bf16} "
+          f"pallas custom-calls={n_pallas} ({time.time()-t:.1f}s)",
+          flush=True)
+    assert n_bf16 > 0, "AMP produced no bf16 in the lowered step"
+    t = time.time()
+    lowered.compile()
+    print(f"XLA+Mosaic compile OK ({time.time()-t:.1f}s)", flush=True)
+    print("BERT_STEP_AOT_OK", flush=True)
+
+
+if __name__ == "__main__":
+    main()
