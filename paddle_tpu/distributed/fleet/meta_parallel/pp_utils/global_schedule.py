@@ -651,6 +651,7 @@ class GlobalPipelineEngine:
                 found_inf = jnp.bool_(False)
 
             p_in = train_leaves
+            grads = optimizer._l1_grads(grads, p_in)
             new_p, new_opt = optimizer._pure_update(
                 lr, step, p_in, grads, opt_vals, trainable)
             if with_scaler:

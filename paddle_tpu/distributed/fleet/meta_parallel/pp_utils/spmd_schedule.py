@@ -240,6 +240,7 @@ class SpmdPipelineEngine:
             # dp-replicated params: true grad = sum of per-copy grads
             if batch_axes:
                 grads = jax.lax.psum(grads, batch_axes)
+            grads = optimizer._l1_grads(tuple(grads), tuple(p_locals))
             new_p, new_opt = optimizer._pure_update(
                 lr, step, tuple(p_locals), tuple(grads),
                 tuple(o[0] for o in opt_vals), stacked_t)

@@ -110,11 +110,10 @@ class Optimizer:
         l1 = self._l1_coeff()
         if l1:
             # L1Decay: g += coeff * sign(p), post-clip like the
-            # reference's append_regularization_ops ordering
-            from ..core.autograd import no_grad
-            with no_grad():
-                for p in params:
-                    p.grad = p.grad + l1 * p.detach().sign()
+            # reference's append_regularization_ops ordering (step()
+            # already runs under no_grad)
+            for p in params:
+                p.grad = p.grad + l1 * p.detach().sign()
         self._apply(params)
         self._step_count._inplace_update(self._step_count._value + 1)
 
@@ -200,12 +199,7 @@ class Optimizer:
             # optimizer.state_dict()).
             self._step_count._inplace_update(np.asarray(step) + 1)
         grads = self._clip_static_grads(grads)
-        l1 = self._l1_coeff()
-        if l1:
-            grads = tuple(
-                (g.astype(jnp.float32)
-                 + l1 * jnp.sign(pv.astype(jnp.float32))).astype(g.dtype)
-                for g, pv in zip(grads, param_vals))
+        grads = self._l1_grads(grads, param_vals)
         return self._pure_update(lr, step, param_vals, grads, opt_vals,
                                  params)
 
@@ -295,6 +289,18 @@ class Optimizer:
         from ..regularizer import L1Decay
         wd = self._weight_decay
         return float(wd._coeff) if isinstance(wd, L1Decay) else 0.0
+
+    def _l1_grads(self, grads, param_vals):
+        """Traced L1Decay term: g += coeff*sign(p).  Shared by every
+        traced update entry point that bypasses _static_update (the
+        pipeline schedules call _pure_update directly)."""
+        l1 = self._l1_coeff()
+        if not l1:
+            return grads
+        return tuple(
+            (g.astype(jnp.float32)
+             + l1 * jnp.sign(pv.astype(jnp.float32))).astype(g.dtype)
+            for g, pv in zip(grads, param_vals))
 
 
 class SGD(Optimizer):

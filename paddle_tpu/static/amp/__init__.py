@@ -32,12 +32,17 @@ def decorate(optimizer, amp_lists=None, init_loss_scaling=2.0 ** 15,
 
 
 def cast_model_to_fp16(program, amp_lists=None, use_fp16_guard=True):
-    """fp16 variant of the bf16 rewrite (fp16 works on TPU but bf16 is
-    the native dtype — same exponent range as f32, no loss scaling)."""
+    """Pure-fp16 (O2) pass like the reference's cast_model_to_fp16:
+    parameters go to fp16, black-list ops keep f32 inputs.  (fp16
+    works on TPU but bf16 is the native dtype — same exponent range
+    as f32, no loss scaling needed; see bf16.cast_model_to_bf16.)"""
     import jax.numpy as jnp
+    for p in program.all_parameters():
+        if p._value.dtype == jnp.float32:
+            p._value = p._value.astype(jnp.float16)
     lists = amp_lists or CustomOpLists()
-    return _rewrite_program(program, lists.white_list,
-                            lists.black_list, jnp.float16)
+    return _rewrite_program(program, set(), lists.black_list,
+                            jnp.float16)
 
 
 def fp16_guard():

@@ -101,6 +101,9 @@ def main():
           f"pallas custom-calls={n_pallas} ({time.time()-t:.1f}s)",
           flush=True)
     assert n_bf16 > 0, "AMP produced no bf16 in the lowered step"
+    assert n_pallas > 0, (
+        "no Pallas custom calls in the lowered step — the gate "
+        "monkeypatch stopped taking effect")
     t = time.time()
     lowered.compile()
     print(f"XLA+Mosaic compile OK ({time.time()-t:.1f}s)", flush=True)
