@@ -28,16 +28,20 @@ class Model:
             self._metrics = metrics if isinstance(metrics, (list, tuple)) \
                 else [metrics]
 
-    def _one_batch(self, batch, train=True):
+    def _one_batch(self, batch, train=True, accumulate=1, step_now=True):
         *inputs, label = batch if isinstance(batch, (list, tuple)) else \
             (batch,)
         preds = self.network(*inputs)
         loss = self._loss(preds, label) if self._loss is not None else preds
         metrics_out = []
         if train:
-            loss.backward()
-            self._optimizer.step()
-            self._optimizer.clear_grad()
+            # gradient accumulation: scale so the summed grads equal
+            # the mean over the accumulation window; step only on the
+            # window boundary
+            (loss / accumulate if accumulate > 1 else loss).backward()
+            if step_now:
+                self._optimizer.step()
+                self._optimizer.clear_grad()
         for m in self._metrics:
             m.update(m.compute(preds, label))
             metrics_out.append(m.accumulate())
@@ -68,9 +72,15 @@ class Model:
             for m in self._metrics:
                 m.reset()
             cbs.on_epoch_begin(epoch, {})
+            acc = max(1, int(accumulate_grad_batches))
+            pending = False
             for step, batch in enumerate(loader):
                 cbs.on_train_batch_begin(step, {})
-                loss, mets = self._one_batch(batch, train=True)
+                step_now = (step + 1) % acc == 0
+                loss, mets = self._one_batch(
+                    batch, train=True, accumulate=acc,
+                    step_now=step_now)
+                pending = not step_now
                 it_count += 1
                 logs = {"loss": float(loss.item())}
                 for m, v in zip(self._metrics, mets):
@@ -83,8 +93,17 @@ class Model:
                         msg += f" {m.name()}={v if not isinstance(v, list) else v[0]:.4f}"
                     print(msg)
                 if num_iters is not None and it_count >= num_iters:
+                    if pending:  # flush the partial accumulation window
+                        self._optimizer.step()
+                        self._optimizer.clear_grad()
                     cbs.on_train_end({})
                     return history
+            if pending:
+                # trailing partial window: step it rather than leaking
+                # its grads into the next epoch
+                self._optimizer.step()
+                self._optimizer.clear_grad()
+                pending = False
             history.append(float(loss.item()))
             # eval metrics reach monitoring callbacks exactly once,
             # through evaluate()'s on_eval_end; on_epoch_end carries the
@@ -146,8 +165,33 @@ class Model:
             psave(self._optimizer.state_dict(), path + ".pdopt")
 
     def load(self, path, skip_mismatch=False, reset_optimizer=False):
+        import os
         from .framework.io import load as pload
-        self.network.set_state_dict(pload(path + ".pdparams"))
+        state = pload(path + ".pdparams")
+        if skip_mismatch:
+            current = self.network.state_dict()
+            kept, dropped = {}, []
+            for k, v in state.items():
+                cur = current.get(k)
+                if cur is not None and list(np.shape(v)) == list(
+                        cur.shape):
+                    kept[k] = v
+                else:
+                    dropped.append(k)
+            if dropped:
+                print(f"Model.load(skip_mismatch=True): skipped "
+                      f"{len(dropped)} mismatched/missing keys "
+                      f"(e.g. {dropped[:3]})")
+                # the saved optimizer moments are shaped for the OLD
+                # parameters; positional restore would install
+                # wrong-shape accumulators for the resized ones
+                reset_optimizer = True
+            state = kept
+        self.network.set_state_dict(state)
+        opt_path = path + ".pdopt"
+        if (not reset_optimizer and self._optimizer is not None
+                and os.path.exists(opt_path)):
+            self._optimizer.set_state_dict(pload(opt_path))
 
     def parameters(self, *args, **kwargs):
         return self.network.parameters()

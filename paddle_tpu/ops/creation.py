@@ -177,6 +177,17 @@ standard_normal = randn
 
 
 def uniform(shape, dtype=None, min=-1.0, max=1.0, seed=0, name=None):
+    if seed != 0:
+        # paddle semantics: a non-zero seed fixes the sample (every
+        # call returns the same values) without touching the global
+        # generator state
+        return dispatch(
+            "uniform",
+            lambda *, shape, dtype, lo, hi, seed: jax.random.uniform(
+                jax.random.PRNGKey(seed), shape, dtype, lo, hi),
+            (), dict(shape=_shape(shape), dtype=_jd(dtype),
+                     lo=float(min), hi=float(max), seed=int(seed)),
+            differentiable=False)
     return _rng_dispatch(
         "uniform",
         lambda k, *, shape, dtype, lo, hi: jax.random.uniform(
@@ -311,15 +322,34 @@ def diag(x, offset=0, padding_value=0, name=None):
 
 
 def diag_embed(x, offset=0, dim1=-2, dim2=-1, name=None):
-    def impl(v, *, k):
+    def impl(v, *, k, d1, d2):
         n = v.shape[-1] + abs(k)
         out = jnp.zeros(v.shape[:-1] + (n, n), v.dtype)
         idx = jnp.arange(v.shape[-1])
         r = idx + max(-k, 0)
         c = idx + max(k, 0)
-        return out.at[..., r, c].set(v)
+        out = out.at[..., r, c].set(v)
+        # the two new axes materialize as the LAST two; move them to
+        # the requested positions (paddle defaults dim1=-2, dim2=-1)
+        nd = out.ndim
+        d1, d2 = d1 % nd, d2 % nd
+        if d1 == d2:
+            raise ValueError(
+                f"diag_embed: dim1 and dim2 must differ, both resolve "
+                f"to {d1}")
+        if (d1, d2) != (nd - 2, nd - 1):
+            rest = [a for a in range(nd) if a not in (nd - 2, nd - 1)]
+            perm = [None] * nd
+            perm[d1], perm[d2] = nd - 2, nd - 1
+            it = iter(rest)
+            for i in range(nd):
+                if perm[i] is None:
+                    perm[i] = next(it)
+            out = jnp.transpose(out, perm)
+        return out
 
-    return dispatch("diag_embed", impl, (x,), dict(k=int(offset)))
+    return dispatch("diag_embed", impl, (x,),
+                    dict(k=int(offset), d1=int(dim1), d2=int(dim2)))
 
 
 def meshgrid(*args, **kwargs):

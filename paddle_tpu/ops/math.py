@@ -110,26 +110,17 @@ def logit(x, eps=None, name=None):
     return dispatch("logit", impl, (x,), dict(eps=eps))
 
 
-def cummax(x, axis=None, dtype="int64", name=None):
-    def impl(v, *, axis):
-        if axis is None:
-            v = v.reshape(-1)
-            axis = 0
-        vals = jax.lax.associative_scan(jnp.maximum, v, axis=axis)
-        idx = jnp.argmax(
-            jnp.cumsum((v == vals).astype(jnp.int32), axis=axis) *
-            (v == vals), axis=axis)
-        return vals, vals  # indices approximated below
-
-    # Simpler correct version via numpy-style scan for values; indices via
-    # where value first achieved.
-    def impl2(v, *, axis):
+def _cum_extreme_impl(combine):
+    """values via associative scan; indices = LAST position achieving
+    the running extreme (torch/paddle tie convention), as the requested
+    (paddle: `dtype`) integer type."""
+    def impl(v, *, axis, idt):
         if axis is None:
             vf = v.reshape(-1)
             ax = 0
         else:
             vf, ax = v, axis
-        vals = jax.lax.associative_scan(jnp.maximum, vf, axis=ax)
+        vals = jax.lax.associative_scan(combine, vf, axis=ax)
         n = vf.shape[ax]
         ar = jnp.arange(n)
         shp = [1] * vf.ndim
@@ -138,32 +129,21 @@ def cummax(x, axis=None, dtype="int64", name=None):
         hit = (vf == vals)
         idx = jax.lax.associative_scan(
             jnp.maximum, jnp.where(hit, ar, -1), axis=ax)
-        return vals, idx.astype(jnp.int64)
+        return vals, idx.astype(idt)
 
-    return dispatch("cummax", impl2, (x,),
-                    dict(axis=None if axis is None else int(axis)))
+    return impl
+
+
+def cummax(x, axis=None, dtype="int64", name=None):
+    return dispatch("cummax", _cum_extreme_impl(jnp.maximum), (x,),
+                    dict(axis=None if axis is None else int(axis),
+                         idt=to_jax_dtype(dtype)))
 
 
 def cummin(x, axis=None, dtype="int64", name=None):
-    def impl(v, *, axis):
-        if axis is None:
-            vf = v.reshape(-1)
-            ax = 0
-        else:
-            vf, ax = v, axis
-        vals = jax.lax.associative_scan(jnp.minimum, vf, axis=ax)
-        n = vf.shape[ax]
-        ar = jnp.arange(n)
-        shp = [1] * vf.ndim
-        shp[ax] = n
-        ar = ar.reshape(shp)
-        hit = (vf == vals)
-        idx = jax.lax.associative_scan(
-            jnp.maximum, jnp.where(hit, ar, -1), axis=ax)
-        return vals, idx.astype(jnp.int64)
-
-    return dispatch("cummin", impl, (x,),
-                    dict(axis=None if axis is None else int(axis)))
+    return dispatch("cummin", _cum_extreme_impl(jnp.minimum), (x,),
+                    dict(axis=None if axis is None else int(axis),
+                         idt=to_jax_dtype(dtype)))
 
 
 def diff(x, n=1, axis=-1, prepend=None, append=None, name=None):
@@ -245,14 +225,18 @@ def cumulative_trapezoid(y, x=None, dx=None, axis=-1, name=None):
 
 
 def logcumsumexp(x, axis=None, dtype=None, name=None):
-    def impl(v, axis):
+    def impl(v, axis, dtype):
+        if dtype is not None:
+            v = v.astype(dtype)
         if axis is None:
             v, axis = v.reshape(-1), 0
         # global-max stabilization: exact in log domain, one pass
         mx = jnp.max(v, axis=axis, keepdims=True)
         return jnp.log(jnp.cumsum(jnp.exp(v - mx), axis=axis)) + mx
 
-    return dispatch("logcumsumexp", impl, (x,), dict(axis=axis))
+    return dispatch("logcumsumexp", impl, (x,),
+                    dict(axis=axis, dtype=None if dtype is None
+                         else to_jax_dtype(dtype)))
 
 
 def renorm(x, p, axis, max_norm, name=None):

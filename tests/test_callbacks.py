@@ -99,3 +99,51 @@ def test_reduce_lr_on_plateau():
     cb.on_eval_end({"loss": 1.0})
     cb.on_eval_end({"loss": 1.0})  # no improvement → reduce
     assert float(m._optimizer.get_lr()) == 0.05
+
+
+def test_fit_accumulate_grad_batches():
+    """accumulate_grad_batches steps the optimizer once per window with
+    mean-equivalent gradients (it used to be silently ignored)."""
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu import nn, optimizer
+
+    class Counting(optimizer.SGD):
+        steps = 0
+
+        def step(self):
+            Counting.steps += 1
+            super().step()
+
+    xs = np.random.RandomState(0).rand(8, 4).astype(np.float32)
+    ys = xs.sum(1, keepdims=True).astype(np.float32)
+    ds = [(xs[i], ys[i]) for i in range(8)]
+
+    paddle.seed(0)
+    net = nn.Linear(4, 1)
+    m = paddle.Model(net)
+    m.prepare(optimizer=Counting(learning_rate=0.01,
+                                 parameters=net.parameters()),
+              loss=paddle.nn.MSELoss())
+    m.fit(ds, batch_size=2, epochs=1, verbose=0,
+          accumulate_grad_batches=2)
+    assert Counting.steps == 2, Counting.steps  # 4 batches / window 2
+
+
+def test_model_load_skip_mismatch(tmp_path):
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu import nn
+
+    src = nn.Sequential(nn.Linear(4, 3), nn.Linear(3, 2))
+    m1 = paddle.Model(src)
+    m1.save(str(tmp_path / "ck"))
+
+    dst = nn.Sequential(nn.Linear(4, 3), nn.Linear(3, 5))  # head resized
+    w_head_before = dst[1].weight.numpy().copy()
+    m2 = paddle.Model(dst)
+    m2.load(str(tmp_path / "ck"), skip_mismatch=True)
+    # matching layer loaded, mismatched head untouched
+    np.testing.assert_allclose(dst[0].weight.numpy(),
+                               src[0].weight.numpy())
+    np.testing.assert_allclose(dst[1].weight.numpy(), w_head_before)
