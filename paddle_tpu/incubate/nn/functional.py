@@ -121,36 +121,85 @@ def fused_rotary_position_embedding(q, k=None, v=None, sin=None, cos=None,
         freqs = jnp.outer(t, inv)
         return jnp.sin(freqs).astype(dtype), jnp.cos(freqs).astype(dtype)
 
-    def rope(v, sin_, cos_, neox):
-        B, S, H, D = v.shape
+    def rotate(v, s, c, neox):
+        """s/c already broadcastable to [B-or-1, S, 1, D/2]."""
+        D = v.shape[-1]
         if neox:
             v1, v2 = v[..., :D // 2], v[..., D // 2:]
-            s = sin_[None, :, None, :]
-            c = cos_[None, :, None, :]
             return jnp.concatenate([v1 * c - v2 * s, v2 * c + v1 * s], -1)
         v1, v2 = v[..., 0::2], v[..., 1::2]
-        s = sin_[None, :, None, :]
-        c = cos_[None, :, None, :]
         out = jnp.stack([v1 * c - v2 * s, v2 * c + v1 * s], axis=-1)
         return out.reshape(v.shape)
 
-    def impl(qv, *rest, has_k, has_v, neox, base):
+    def rope(v, sin_, cos_, neox):  # [S, D/2] tables
+        return rotate(v, sin_[None, :, None, :], cos_[None, :, None, :],
+                      neox)
+
+    if time_major:
+        raise NotImplementedError(
+            "fused_rotary_position_embedding: time_major layout is not "
+            "supported (use [B, S, H, D])")
+
+    def impl(qv, *rest, has_k, has_v, has_sc, has_pos, neox, base):
         i = 0
         kv = rest[i] if has_k else None
         i += 1 if has_k else 0
         vv = rest[i] if has_v else None
+        i += 1 if has_v else 0
         S, D = qv.shape[1], qv.shape[-1]
-        sin_, cos_ = make_sincos(S, D, qv.dtype, base)
-        outs = [rope(qv, sin_, cos_, neox)]
+        if has_sc:
+            # user-supplied tables: accept [S, D/2] or paddle's
+            # [1, S, 1, D/2] (squeeze the broadcast dims)
+            sin_, cos_ = rest[i], rest[i + 1]
+            i += 2
+            sin_ = sin_.reshape(sin_.shape[-3], sin_.shape[-1]) \
+                if sin_.ndim == 4 else sin_
+            cos_ = cos_.reshape(cos_.shape[-3], cos_.shape[-1]) \
+                if cos_.ndim == 4 else cos_
+            sin_ = sin_.astype(qv.dtype)
+            cos_ = cos_.astype(qv.dtype)
+        else:
+            sin_, cos_ = make_sincos(S, D, qv.dtype, base)
+        if has_pos:
+            pos = rest[i]
+            if has_sc:
+                # user table: clamp (table assumed to cover positions;
+                # jnp.take's default fill mode would emit NaN)
+                sin_p = jnp.take(sin_, pos, axis=0, mode="clip")
+                cos_p = jnp.take(cos_, pos, axis=0, mode="clip")
+            else:
+                # no table: compute the angle directly from the
+                # position — exact for ANY id (KV-cache decode reaches
+                # positions >= this call's seq_len)
+                inv = 1.0 / (base ** (
+                    jnp.arange(0, D, 2, dtype=jnp.float32) / D))
+                fr = pos.astype(jnp.float32)[..., None] * inv
+                sin_p = jnp.sin(fr).astype(qv.dtype)
+                cos_p = jnp.cos(fr).astype(qv.dtype)
+
+            def apply(v, s_, c_, nx, _sp=sin_p, _cp=cos_p):
+                del s_, c_
+                return rotate(v, _sp[:, :, None, :], _cp[:, :, None, :],
+                              nx)
+        else:
+            apply = rope
+        outs = [apply(qv, sin_, cos_, neox)]
         if kv is not None:
-            outs.append(rope(kv, sin_, cos_, neox))
+            outs.append(apply(kv, sin_, cos_, neox))
         if vv is not None:
             outs.append(vv)
         return tuple(outs) if len(outs) > 1 else outs[0]
 
+    has_sc = sin is not None and cos is not None
     args = (q,) + tuple(t for t in (k, v) if t is not None)
+    if has_sc:
+        args += (sin, cos)
+    if position_ids is not None:
+        args += (position_ids,)
     out = dispatch("fused_rope", impl, args,
                    dict(has_k=k is not None, has_v=v is not None,
+                        has_sc=has_sc,
+                        has_pos=position_ids is not None,
                         neox=bool(use_neox_rotary_style),
                         base=float(rotary_emb_base)))
     if isinstance(out, tuple):

@@ -140,6 +140,14 @@ def rrelu(x, lower=1.0 / 8.0, upper=1.0 / 3.0, training=False, name=None):
                    dict(lo=float(lower), hi=float(upper)))
 
 
+def _rrelu_infer(v, *, lo, hi):
+    return jnp.where(v >= 0, v, (lo + hi) / 2.0 * v)
+
+
+from .common import RNG_INFER_IMPLS as _INFER  # noqa: E402
+_INFER["rrelu"] = _rrelu_infer
+
+
 def softplus(x, beta=1.0, threshold=20.0, name=None):
     return dispatch(
         "softplus",

@@ -33,6 +33,12 @@ def linear(x, weight, bias=None, name=None):
                     {})
 
 
+# Program.clone(for_test=True) replaces train-only rng ops with these
+# inference impls (signature: (*tensor_vals, **attrs) -> value — no key,
+# no state advance).  Registered next to each op's definition.
+RNG_INFER_IMPLS = {}
+
+
 def _rng_op(name, impl_with_key, tensors, attrs):
     g = default_generator()
 
@@ -93,6 +99,10 @@ def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train",
                         upscale=(mode == "upscale_in_train")))
 
 
+RNG_INFER_IMPLS["dropout"] = (
+    lambda v, *, p, axis, upscale: v if upscale else v * (1.0 - p))
+
+
 def dropout2d(x, p=0.5, training=True, data_format="NCHW", name=None):
     axis = (0, 1) if data_format == "NCHW" else (0, 3)
     return dropout(x, p, axis=axis, training=training)
@@ -123,6 +133,9 @@ def alpha_dropout(x, p=0.5, training=True, name=None):
         return _alpha_dropout_body(key, v, p, v.shape)
 
     return _rng_op("alpha_dropout", impl, (x,), dict(p=float(p)))
+
+
+RNG_INFER_IMPLS["alpha_dropout"] = lambda v, *, p: v
 
 
 def _norm_pad(pad, ndim, data_format):
@@ -579,3 +592,6 @@ def feature_alpha_dropout(x, p=0.5, training=True, name=None):
 
     return _rng_op("feature_alpha_dropout", impl, (x,),
                    dict(p=float(p)))
+
+
+RNG_INFER_IMPLS["feature_alpha_dropout"] = lambda v, *, p: v

@@ -150,50 +150,62 @@ def flash_attn_unpadded(query, key, value, cu_seqlens_q, cu_seqlens_k,
     """
     drop = float(dropout) if training else 0.0
 
-    def impl_with_key(key_arr, q, k, v, cu_q, cu_k, *, causal, scale, p):
-        tq, h, d = q.shape
-        tk = k.shape[0]
-        pos_q = jnp.arange(tq)
-        pos_k = jnp.arange(tk)
-        # sequence id of each packed token: index of the bucket it falls in
-        seg_q = jnp.searchsorted(cu_q, pos_q, side="right") - 1
-        seg_k = jnp.searchsorted(cu_k, pos_k, side="right") - 1
-        same = seg_q[:, None] == seg_k[None, :]
-        if causal:
-            # position within own sequence
-            off_q = pos_q - jnp.take(cu_q, seg_q)
-            off_k = pos_k - jnp.take(cu_k, seg_k)
-            same = jnp.logical_and(same,
-                                   off_k[None, :] <= off_q[:, None])
-        qt = jnp.swapaxes(q[None], 1, 2)
-        kt = jnp.swapaxes(k[None], 1, 2)
-        vt = jnp.swapaxes(v[None], 1, 2)
-        scores = jnp.einsum("bhqd,bhkd->bhqk", qt, kt,
-                            preferred_element_type=jnp.float32) * scale
-        scores = jnp.where(same[None, None], scores,
-                           jnp.asarray(-1e30, scores.dtype))
-        probs = jax.nn.softmax(scores, axis=-1)
-        any_visible = jnp.any(same, axis=-1)[None, None, :, None]
-        probs = jnp.where(any_visible, probs, 0.0).astype(q.dtype)
-        if p > 0.0:
-            keep = jax.random.bernoulli(key_arr, 1.0 - p, probs.shape)
-            probs = jnp.where(keep, probs / (1.0 - p),
-                              jnp.zeros((), probs.dtype))
-        out = jnp.einsum("bhqk,bhkd->bhqd", probs, vt,
-                         preferred_element_type=jnp.float32)
-        return jnp.swapaxes(out, 1, 2)[0].astype(q.dtype)
-
     tensors = (query, key, value, cu_seqlens_q, cu_seqlens_k)
     attrs = dict(causal=bool(causal), scale=float(scale), p=drop)
     if drop > 0.0:
         from .common import _rng_op
-        return _rng_op("flash_attn_unpadded_drop", impl_with_key, tensors,
-                       attrs), None
+        return _rng_op("flash_attn_unpadded_drop", _varlen_attention,
+                       tensors, attrs), None
 
     def impl(*args, **at):
-        return impl_with_key(None, *args, **at)
+        return _varlen_attention(None, *args, **at)
 
     return dispatch("flash_attn_unpadded", impl, tensors, attrs), None
+
+
+def _varlen_attention(key_arr, q, k, v, cu_q, cu_k, *, causal, scale, p):
+    tq, h, d = q.shape
+    tk = k.shape[0]
+    pos_q = jnp.arange(tq)
+    pos_k = jnp.arange(tk)
+    # sequence id of each packed token: index of the bucket it falls in
+    seg_q = jnp.searchsorted(cu_q, pos_q, side="right") - 1
+    seg_k = jnp.searchsorted(cu_k, pos_k, side="right") - 1
+    same = seg_q[:, None] == seg_k[None, :]
+    if causal:
+        # position within own sequence
+        off_q = pos_q - jnp.take(cu_q, seg_q)
+        off_k = pos_k - jnp.take(cu_k, seg_k)
+        same = jnp.logical_and(same,
+                               off_k[None, :] <= off_q[:, None])
+    qt = jnp.swapaxes(q[None], 1, 2)
+    kt = jnp.swapaxes(k[None], 1, 2)
+    vt = jnp.swapaxes(v[None], 1, 2)
+    scores = jnp.einsum("bhqd,bhkd->bhqk", qt, kt,
+                        preferred_element_type=jnp.float32) * scale
+    scores = jnp.where(same[None, None], scores,
+                       jnp.asarray(-1e30, scores.dtype))
+    probs = jax.nn.softmax(scores, axis=-1)
+    any_visible = jnp.any(same, axis=-1)[None, None, :, None]
+    probs = jnp.where(any_visible, probs, 0.0).astype(q.dtype)
+    if p > 0.0:
+        keep = jax.random.bernoulli(key_arr, 1.0 - p, probs.shape)
+        probs = jnp.where(keep, probs / (1.0 - p),
+                          jnp.zeros((), probs.dtype))
+    out = jnp.einsum("bhqk,bhkd->bhqd", probs, vt,
+                     preferred_element_type=jnp.float32)
+    return jnp.swapaxes(out, 1, 2)[0].astype(q.dtype)
+
+
+# Program.clone(for_test=True): attention still computes, dropout off
+from .common import RNG_INFER_IMPLS as _INFER  # noqa: E402
+
+_INFER["scaled_dot_product_attention_drop"] = (
+    lambda q, k, v, *mask, causal, scale, p: _sdpa_ref(
+        q, k, v, mask[0] if mask else None, causal, scale))
+_INFER["flash_attn_unpadded_drop"] = (
+    lambda q, k, v, cu_q, cu_k, *, causal, scale, p: _varlen_attention(
+        None, q, k, v, cu_q, cu_k, causal=causal, scale=scale, p=0.0))
 
 
 import threading as _threading

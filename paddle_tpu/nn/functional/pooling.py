@@ -89,15 +89,32 @@ def _pool(x, kind, kernel, stride, padding, ceil_mode, exclusive, nsp,
                          exclusive=bool(exclusive)))
 
 
+def _with_divisor(out, kernel, nsp, padding, divisor):
+    """divisor_override: window SUM / divisor (paddle semantics)."""
+    if isinstance(padding, str):
+        raise NotImplementedError(
+            "divisor_override with string padding is not supported")
+    denom = 1
+    for k in _norm(kernel, nsp):
+        denom *= k
+    return out * (float(denom) / float(divisor))
+
+
 def avg_pool1d(x, kernel_size, stride=None, padding=0, exclusive=True,
                ceil_mode=False, data_format="NCL", name=None):
     return _pool(x, "avg", kernel_size, stride, padding, ceil_mode,
-                 exclusive, 1, "NCW", "avg_pool1d")
+                 exclusive, 1,
+                 "NCW" if data_format == "NCL" else "NWC", "avg_pool1d")
 
 
 def avg_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
                exclusive=True, divisor_override=None, data_format="NCHW",
                name=None):
+    if divisor_override is not None:
+        out = _pool(x, "avg", kernel_size, stride, padding, ceil_mode,
+                    False, 2, data_format, "avg_pool2d")
+        return _with_divisor(out, kernel_size, 2, padding,
+                             divisor_override)
     return _pool(x, "avg", kernel_size, stride, padding, ceil_mode,
                  exclusive, 2, data_format, "avg_pool2d")
 
@@ -105,6 +122,11 @@ def avg_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
 def avg_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
                exclusive=True, divisor_override=None, data_format="NCDHW",
                name=None):
+    if divisor_override is not None:
+        out = _pool(x, "avg", kernel_size, stride, padding, ceil_mode,
+                    False, 3, data_format, "avg_pool3d")
+        return _with_divisor(out, kernel_size, 3, padding,
+                             divisor_override)
     return _pool(x, "avg", kernel_size, stride, padding, ceil_mode,
                  exclusive, 3, data_format, "avg_pool3d")
 
@@ -112,8 +134,11 @@ def avg_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
 def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False,
                ceil_mode=False, data_format="NCL", name=None):
     out = _pool(x, "max", kernel_size, stride, padding, ceil_mode, True, 1,
-                "NCW", "max_pool1d")
+                "NCW" if data_format == "NCL" else "NWC", "max_pool1d")
     if return_mask:
+        if data_format != "NCL":
+            raise NotImplementedError(
+                "max_pool1d(return_mask=True) supports NCL only")
         # height-1 2-D indices are exactly positions in L
         from ...ops.manipulation import reshape
         n, c, l = x.shape
@@ -244,28 +269,102 @@ def _adaptive(x, out_size, kind, nsp, op_name):
                     dict(out_size=out_size, kind=kind))
 
 
+def _channels_last_wrap(x, data_format, nsp, fn):
+    """_adaptive assumes channels-first; NHWC-family formats transpose
+    around it (they were silently treated as channels-first before)."""
+    if data_format.startswith("NC"):
+        return fn(x)
+    from ...ops.manipulation import transpose
+    nd = nsp + 2
+    to_cf = [0, nd - 1] + list(range(1, nd - 1))
+    to_cl = [0] + list(range(2, nd)) + [1]
+    return transpose(fn(transpose(x, to_cf)), to_cl)
+
+
 def adaptive_avg_pool1d(x, output_size, name=None):
     return _adaptive(x, output_size, "avg", 1, "adaptive_avg_pool1d")
 
 
 def adaptive_avg_pool2d(x, output_size, data_format="NCHW", name=None):
-    return _adaptive(x, output_size, "avg", 2, "adaptive_avg_pool2d")
+    return _channels_last_wrap(
+        x, data_format, 2,
+        lambda v: _adaptive(v, output_size, "avg", 2,
+                            "adaptive_avg_pool2d"))
 
 
 def adaptive_avg_pool3d(x, output_size, data_format="NCDHW", name=None):
-    return _adaptive(x, output_size, "avg", 3, "adaptive_avg_pool3d")
+    return _channels_last_wrap(
+        x, data_format, 3,
+        lambda v: _adaptive(v, output_size, "avg", 3,
+                            "adaptive_avg_pool3d"))
+
+
+def _adaptive_max_mask(x, output_size, nsp, op_name):
+    """Flat spatial argmax index per adaptive window (paddle's
+    return_mask).  General variable-window case via per-window slices —
+    shapes are static so XLA unrolls it."""
+    out_size = _norm(output_size, nsp)
+
+    def impl(v, *, out_size):
+        sp = v.shape[2:]
+        # iterate output cells along each dim; nsp <= 3 and output
+        # sizes are small in practice
+        import itertools
+        cells = [[( (i * sp[d]) // out_size[d],
+                    -(-((i + 1) * sp[d]) // out_size[d]))
+                  for i in range(out_size[d])] for d in range(nsp)]
+        rows = []
+        for coords in itertools.product(*[range(len(c)) for c in cells]):
+            seg = v
+            offs = []
+            for d, ci in enumerate(coords):
+                st, en = cells[d][ci]
+                seg = jax.lax.slice_in_dim(seg, st, en, axis=2 + d)
+                offs.append(st)
+            flat = seg.reshape(seg.shape[:2] + (-1,))
+            loc = jnp.argmax(flat, axis=-1)
+            # unravel within the window, then to global flat index
+            strides_w = np.cumprod(
+                [1] + list(seg.shape[2:][::-1]))[::-1][1:]
+            strides_g = np.cumprod([1] + list(sp[::-1]))[::-1][1:]
+            gidx = jnp.zeros_like(loc)
+            rem = loc
+            for d in range(nsp):
+                cw = int(strides_w[d])
+                gd = rem // cw + offs[d]
+                rem = rem % cw
+                gidx = gidx + gd * int(strides_g[d])
+            rows.append(gidx)
+        stacked = jnp.stack(rows, axis=-1)
+        return stacked.reshape(v.shape[:2] + tuple(out_size)).astype(
+            jnp.int64)
+
+    return dispatch(op_name + "_mask", impl, (x,),
+                    dict(out_size=out_size))
 
 
 def adaptive_max_pool1d(x, output_size, return_mask=False, name=None):
-    return _adaptive(x, output_size, "max", 1, "adaptive_max_pool1d")
+    out = _adaptive(x, output_size, "max", 1, "adaptive_max_pool1d")
+    if return_mask:
+        return out, _adaptive_max_mask(x, output_size, 1,
+                                       "adaptive_max_pool1d")
+    return out
 
 
 def adaptive_max_pool2d(x, output_size, return_mask=False, name=None):
-    return _adaptive(x, output_size, "max", 2, "adaptive_max_pool2d")
+    out = _adaptive(x, output_size, "max", 2, "adaptive_max_pool2d")
+    if return_mask:
+        return out, _adaptive_max_mask(x, output_size, 2,
+                                       "adaptive_max_pool2d")
+    return out
 
 
 def adaptive_max_pool3d(x, output_size, return_mask=False, name=None):
-    return _adaptive(x, output_size, "max", 3, "adaptive_max_pool3d")
+    out = _adaptive(x, output_size, "max", 3, "adaptive_max_pool3d")
+    if return_mask:
+        return out, _adaptive_max_mask(x, output_size, 3,
+                                       "adaptive_max_pool3d")
+    return out
 
 
 def max_unpool2d(x, indices, kernel_size, stride=None, padding=0,

@@ -477,16 +477,18 @@ def unique(x, return_index=False, return_inverse=False, return_counts=False,
     res = np.unique(arr, return_index=return_index,
                     return_inverse=return_inverse,
                     return_counts=return_counts, axis=axis)
+    from ..core.dtypes import to_jax_dtype
+    idt = to_jax_dtype(dtype)
     if not isinstance(res, tuple):
         return to_tensor(res)
     outs = [to_tensor(res[0])]
     i = 1
     if return_index:
-        outs.append(to_tensor(res[i].astype(np.int64))); i += 1
+        outs.append(to_tensor(res[i].astype(idt))); i += 1
     if return_inverse:
-        outs.append(to_tensor(res[i].astype(np.int64))); i += 1
+        outs.append(to_tensor(res[i].astype(idt))); i += 1
     if return_counts:
-        outs.append(to_tensor(res[i].astype(np.int64))); i += 1
+        outs.append(to_tensor(res[i].astype(idt))); i += 1
     return tuple(outs)
 
 
@@ -502,14 +504,16 @@ def unique_consecutive(x, return_inverse=False, return_counts=False,
         sliced[1:].reshape(sliced.shape[0] - 1, -1) !=
         sliced[:-1].reshape(sliced.shape[0] - 1, -1), axis=1)
     out = np.moveaxis(sliced[keep], 0, axis)
+    from ..core.dtypes import to_jax_dtype
+    idt = to_jax_dtype(dtype)
     outs = [to_tensor(out)]
     if return_inverse:
         inv = np.cumsum(keep) - 1
-        outs.append(to_tensor(inv.astype(np.int64)))
+        outs.append(to_tensor(inv.astype(idt)))
     if return_counts:
         idx = np.nonzero(keep)[0]
         counts = np.diff(np.append(idx, arr.shape[axis]))
-        outs.append(to_tensor(counts.astype(np.int64)))
+        outs.append(to_tensor(counts.astype(idt)))
     return outs[0] if len(outs) == 1 else tuple(outs)
 
 

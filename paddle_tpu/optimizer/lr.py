@@ -216,7 +216,10 @@ class ReduceOnPlateau(LRScheduler):
         from ..core.tensor import Tensor
         cur = float(metrics.item()) if isinstance(metrics, Tensor) else \
             float(metrics)
-        self.last_epoch += 1
+        if epoch is None:
+            self.last_epoch += 1
+        else:
+            self.last_epoch = int(epoch)
         if self.best is None:
             self.best = cur
             return

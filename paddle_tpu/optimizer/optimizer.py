@@ -131,6 +131,23 @@ class Optimizer:
         from ..static.framework import Variable, in_static_mode, \
             default_main_program
 
+        if parameters is not None:
+            # restrict the update set (paddle: minimize's `parameters`
+            # overrides the constructor list)
+            self._parameter_list = list(parameters)
+        if no_grad_set:
+            excl = {id(t) for t in no_grad_set}
+            if self._parameter_list:
+                self._parameter_list = [
+                    p for p in self._parameter_list
+                    if id(p) not in excl]
+            else:
+                # no explicit list ("all trainables"): record the
+                # exclusion for the Executor's update-set selection —
+                # an empty _parameter_list would read as "no
+                # restriction" there and as "update nothing" in eager
+                self._no_grad_ids = (
+                    getattr(self, "_no_grad_ids", set()) | excl)
         if in_static_mode() and isinstance(loss, Variable):
             # static graph: attach to the program; Executor lowers
             # forward+grad+update into one XLA executable.

@@ -209,6 +209,9 @@ class QAT:
 
     def convert(self, model, inplace=True):
         """Strip the wrappers, leaving scale metadata on the layers."""
+        if not inplace:
+            import copy
+            model = copy.deepcopy(model)
         for name, child in list(getattr(model, "_sub_layers",
                                         {}).items()):
             if isinstance(child, _QuantedWrapper):
@@ -252,6 +255,9 @@ class PTQ:
     def convert(self, model, inplace=True):
         """After calibration: replace observers with fixed-scale
         fake-quant (so the exported graph carries the PTQ scales)."""
+        if not inplace:
+            import copy
+            model = copy.deepcopy(model)
         for name, child in list(getattr(model, "_sub_layers",
                                         {}).items()):
             if isinstance(child, _QuantedWrapper):

@@ -90,10 +90,11 @@ class Executor:
             entry = self._build(program, feed, fetch_list)
             self._cache[key] = entry
 
+        from ..core.lazy import concrete_values
         feed_vals = tuple(
             jnp.asarray(np.asarray(feed[name]), entry["feed_dtypes"][i])
-            for i, name in enumerate(entry["feed_names"]))
-        from ..core.lazy import concrete_values
+            for i, name in enumerate(entry["feed_names"])
+        ) + concrete_values(entry["frozen"])
         param_vals = concrete_values(entry["params"])
         opt_state_vals = concrete_values(entry["opt_state"])
         rng_vals = concrete_values(entry["rng_states"])
@@ -167,8 +168,26 @@ class Executor:
                 if not isinstance(i, Variable) and id(i) not in seen:
                     seen.add(id(i))
                     captured.append(i)
-        trainable = [t for t in captured if not t.stop_gradient]
         opt = program._optimize_info  # (optimizer, loss_var) or None
+        # the optimizer's parameter list restricts the UPDATE set: a
+        # captured trainable the user excluded must stay frozen (it
+        # used to be updated regardless)
+        allowed = None
+        excluded = set()
+        if opt is not None:
+            if getattr(opt[0], "_parameter_list", None):
+                allowed = {id(p) for p in opt[0]._parameter_list}
+            excluded = getattr(opt[0], "_no_grad_ids", set())
+        trainable = [t for t in captured if not t.stop_gradient
+                     and (allowed is None or id(t) in allowed)
+                     and id(t) not in excluded]
+        # excluded-but-mutable params still ride as runtime arguments
+        # (not updated, not donated): baking them as compile-time
+        # constants would go stale when another optimizer/program
+        # mutates them between runs (alternating-optimizer training)
+        tids = {id(t) for t in trainable}
+        frozen = [t for t in captured if not t.stop_gradient
+                  and id(t) not in tids]
 
         # generator state tensors thread as run-time args with the
         # program's final rng state written back after each run
@@ -188,9 +207,14 @@ class Executor:
             # materialize accumulators eagerly (once)
             opt_state = optimizer._ensure_static_state(trainable)
 
+        n_feed = len(feed_names)
+
         def run_ops(feed_vals, param_vals, rng_vals):
-            env = dict(zip(feed_names, feed_vals))
+            # feed_vals tail carries the frozen params (see _prologue)
+            env = dict(zip(feed_names, feed_vals[:n_feed]))
             cmap = {id(p): v for p, v in zip(trainable, param_vals)}
+            cmap.update(
+                {id(t): v for t, v in zip(frozen, feed_vals[n_feed:])})
             cmap.update(
                 {id(t): v for t, v in zip(rng_states, rng_vals)})
             return run_program_ops(
@@ -234,7 +258,9 @@ class Executor:
         feed_avals = tuple(
             jax.ShapeDtypeStruct(tuple(np.asarray(feed[n]).shape),
                                  feed_dtypes[i])
-            for i, n in enumerate(feed_names))
+            for i, n in enumerate(feed_names)) + tuple(
+            jax.ShapeDtypeStruct(tuple(t._value.shape), t._value.dtype)
+            for t in frozen)
         param_avals = tuple(
             jax.ShapeDtypeStruct(tuple(p._value.shape), p._value.dtype)
             for p in trainable)
@@ -259,6 +285,7 @@ class Executor:
             "pure": pure,
             "donate": donate,
             "feed_names": feed_names,
+            "frozen": frozen,
             "feed_dtypes": feed_dtypes,
             "params": trainable,
             "opt_state": opt_state,

@@ -137,7 +137,49 @@ class Program:
         return out
 
     def clone(self, for_test=False):
-        return self
+        """Copy the Program (ops are copied, Variables/captured tensors
+        shared).  ``for_test=True`` additionally rewrites train-only
+        rng ops (dropout family, rrelu, attention dropout) to their
+        inference impls via nn.functional's RNG_INFER_IMPLS registry —
+        the reference's test-program derivation role, which matters
+        here because static dropout is real (the Executor threads the
+        generator state)."""
+        from ..nn.functional.common import RNG_INFER_IMPLS
+
+        p = Program()
+        p.random_seed = self.random_seed
+        p._seed = self._seed
+        p.blocks = []
+        for blk in self.blocks:
+            nb = Block(p, blk.idx, blk.parent_idx)
+            nb.vars = dict(blk.vars)
+            for op in blk.ops:
+                impl = op.impl
+                if for_test and op.type in RNG_INFER_IMPLS:
+                    infer = RNG_INFER_IMPLS[op.type]
+                    attrs = dict(op.attrs)
+
+                    def impl(key, *vs, _infer=infer, _at=attrs):
+                        # state passes through untouched: inference
+                        # consumes no randomness
+                        return _infer(*vs, **_at), key
+                nb.ops.append(OpDesc(op.type, impl, list(op.inputs),
+                                     dict(op.attrs), list(op.outputs)))
+            p.blocks.append(nb)
+        p.current_block_idx = min(self.current_block_idx,
+                                  len(p.blocks) - 1)
+        # the rng chain always transfers: rewritten inference ops pass
+        # the state through untouched, and unregistered stochastic ops
+        # (gumbel_softmax) must keep threading or their key would bake
+        # as a constant (identical noise every run)
+        if getattr(self, "_rng_chain", None):
+            p._rng_chain = dict(self._rng_chain)
+        if not for_test:
+            # a training clone keeps its attached optimizer; for_test
+            # drops it (the reference prunes backward+update ops)
+            p._optimize_info = self._optimize_info
+            p._loss_var = self._loss_var
+        return p
 
     def __str__(self):
         lines = [f"Program(blocks={len(self.blocks)})"]

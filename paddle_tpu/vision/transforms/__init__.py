@@ -76,6 +76,8 @@ class Normalize:
 
 
 def resize(img, size, interpolation="bilinear"):
+    """nearest and (default) bilinear; it used to do nearest no matter
+    what `interpolation` said."""
     arr = np.asarray(img, np.float32)
     chw = arr.ndim == 3 and arr.shape[0] in (1, 3, 4)
     if isinstance(size, int):
@@ -83,11 +85,35 @@ def resize(img, size, interpolation="bilinear"):
     h_axis = 1 if chw else 0
     in_h, in_w = arr.shape[h_axis], arr.shape[h_axis + 1]
     oh, ow = size
-    ys = (np.arange(oh) * in_h / oh).astype(np.int64).clip(0, in_h - 1)
-    xs = (np.arange(ow) * in_w / ow).astype(np.int64).clip(0, in_w - 1)
+    if interpolation in ("nearest", "nearest_neighbor"):
+        ys = (np.arange(oh) * in_h / oh).astype(np.int64).clip(0, in_h - 1)
+        xs = (np.arange(ow) * in_w / ow).astype(np.int64).clip(0, in_w - 1)
+        if chw:
+            return arr[:, ys][:, :, xs]
+        return arr[ys][:, xs]
+    if interpolation not in ("bilinear", "linear"):
+        raise NotImplementedError(
+            f"resize interpolation={interpolation!r} (nearest/bilinear "
+            "supported)")
+    # bilinear, half-pixel centers (torchvision/paddle convention)
+    sy = (np.arange(oh) + 0.5) * in_h / oh - 0.5
+    sx = (np.arange(ow) + 0.5) * in_w / ow - 0.5
+    y0 = np.clip(np.floor(sy).astype(np.int64), 0, in_h - 1)
+    x0 = np.clip(np.floor(sx).astype(np.int64), 0, in_w - 1)
+    y1 = np.clip(y0 + 1, 0, in_h - 1)
+    x1 = np.clip(x0 + 1, 0, in_w - 1)
+    wy = np.clip(sy - y0, 0.0, 1.0)[:, None]
+    wx = np.clip(sx - x0, 0.0, 1.0)[None, :]
     if chw:
-        return arr[:, ys][:, :, xs]
-    return arr[ys][:, xs]
+        g = lambda ys_, xs_: arr[:, ys_][:, :, xs_]
+        wy_, wx_ = wy[None], wx[None]
+    else:
+        g = lambda ys_, xs_: arr[ys_][:, xs_]
+        wy_ = wy if arr.ndim == 2 else wy[..., None]
+        wx_ = wx if arr.ndim == 2 else wx[..., None]
+    top = g(y0, x0) * (1 - wx_) + g(y0, x1) * wx_
+    bot = g(y1, x0) * (1 - wx_) + g(y1, x1) * wx_
+    return top * (1 - wy_) + bot * wy_
 
 
 class Resize:

@@ -389,3 +389,125 @@ def test_eager_rng_under_enable_static_stays_eager():
     (a,) = exe.run(main, feed=fd, fetch_list=[y])
     (b,) = exe.run(main, feed=fd, fetch_list=[y])
     assert not (a == b).all(), "static mask baked to a constant"
+
+
+def test_clone_for_test_disables_dropout():
+    """main.clone(for_test=True): dropout ops rewrite to inference
+    impls (deterministic identity), the training program keeps its
+    stochastic masks, and the two programs are independent objects."""
+    paddle.enable_static()
+    paddle.seed(0)
+    main = static.Program()
+    with static.program_guard(main):
+        x = static.data("x", [16, 16], "float32")
+        y = paddle.nn.functional.dropout(x, p=0.5, training=True)
+    test_prog = main.clone(for_test=True)
+    assert test_prog is not main
+    exe = static.Executor()
+    fd = {"x": np.ones((16, 16), np.float32)}
+    (a,) = exe.run(test_prog, feed=fd, fetch_list=[y])
+    (b,) = exe.run(test_prog, feed=fd, fetch_list=[y])
+    np.testing.assert_array_equal(a, np.ones((16, 16), np.float32))
+    np.testing.assert_array_equal(a, b)
+    # the ORIGINAL still trains stochastically
+    (c,) = exe.run(main, feed=fd, fetch_list=[y])
+    (d,) = exe.run(main, feed=fd, fetch_list=[y])
+    assert not (c == d).all()
+
+
+def test_clone_for_test_rrelu_mean_slope():
+    paddle.enable_static()
+    main = static.Program()
+    with static.program_guard(main):
+        x = static.data("x", [4, 4], "float32")
+        y = paddle.nn.functional.rrelu(x, lower=0.25, upper=0.75,
+                                       training=True)
+    test_prog = main.clone(for_test=True)
+    exe = static.Executor()
+    fd = {"x": -np.ones((4, 4), np.float32)}
+    (a,) = exe.run(test_prog, feed=fd, fetch_list=[y])
+    np.testing.assert_allclose(a, -0.5 * np.ones((4, 4)), rtol=1e-6)
+
+
+def test_static_update_respects_parameter_subset():
+    """A captured trainable excluded from the optimizer's parameter
+    list must stay frozen in the compiled step (it used to be updated
+    regardless)."""
+    paddle.enable_static()
+    main = static.Program()
+    with static.program_guard(main):
+        x = static.data("x", [4, 4], "float32")
+        lin1 = nn.Linear(4, 4)
+        lin2 = nn.Linear(4, 1)
+        loss = lin2(lin1(x)).sum()
+        opt = optimizer.SGD(learning_rate=0.1,
+                            parameters=lin2.parameters())
+        opt.minimize(loss)
+    w1 = lin1.weight.numpy().copy()
+    w2 = lin2.weight.numpy().copy()
+    exe = static.Executor()
+    exe.run(main, feed={"x": np.ones((4, 4), np.float32)},
+            fetch_list=[loss])
+    np.testing.assert_array_equal(lin1.weight.numpy(), w1)  # frozen
+    assert not (lin2.weight.numpy() == w2).all()            # updated
+
+
+def test_minimize_no_grad_set_without_parameter_list():
+    """no_grad_set must freeze its params even when the optimizer was
+    built without an explicit parameter list (an empty list would read
+    as 'no restriction')."""
+    paddle.enable_static()
+    main = static.Program()
+    with static.program_guard(main):
+        x = static.data("x", [4, 4], "float32")
+        lin1 = nn.Linear(4, 4)
+        lin2 = nn.Linear(4, 1)
+        loss = lin2(lin1(x)).sum()
+        opt = optimizer.SGD(learning_rate=0.1)
+        opt.minimize(loss, no_grad_set=set(lin1.parameters()))
+    w1 = lin1.weight.numpy().copy()
+    w2 = lin2.weight.numpy().copy()
+    exe = static.Executor()
+    exe.run(main, feed={"x": np.ones((4, 4), np.float32)},
+            fetch_list=[loss])
+    np.testing.assert_array_equal(lin1.weight.numpy(), w1)  # frozen
+    assert not (lin2.weight.numpy() == w2).all()            # updated
+
+
+def test_training_clone_keeps_optimizer():
+    """clone(for_test=False) keeps the attached optimizer: running the
+    clone still updates parameters (clone used to return self, so this
+    pattern trained; the copying clone must not silently regress it)."""
+    paddle.enable_static()
+    main, loss = _build_mlp_program(55)
+    train_prog = main.clone()
+    assert train_prog is not main
+    exe = static.Executor()
+    rng = np.random.RandomState(0)
+    fd = {"x": rng.rand(16, 8).astype(np.float32),
+          "y": rng.rand(16, 1).astype(np.float32)}
+    w = main.all_parameters()[0].numpy().copy()
+    exe.run(train_prog, feed=fd, fetch_list=[loss])
+    assert not (main.all_parameters()[0].numpy() == w).all()
+
+
+def test_frozen_params_ride_as_runtime_args():
+    """A param excluded from the update set must NOT bake as a
+    compile-time constant: mutating it between runs changes the next
+    run's result (alternating-optimizer pattern)."""
+    paddle.enable_static()
+    main = static.Program()
+    with static.program_guard(main):
+        x = static.data("x", [2, 4], "float32")
+        lin1 = nn.Linear(4, 4)
+        lin2 = nn.Linear(4, 1)
+        loss = lin2(lin1(x)).sum()
+        opt = optimizer.SGD(learning_rate=0.0,
+                            parameters=lin2.parameters())
+        opt.minimize(loss)
+    exe = static.Executor()
+    fd = {"x": np.ones((2, 4), np.float32)}
+    (l0,) = exe.run(main, feed=fd, fetch_list=[loss])
+    lin1.weight.set_value(np.zeros_like(lin1.weight.numpy()))
+    (l1,) = exe.run(main, feed=fd, fetch_list=[loss])
+    assert float(l0) != float(l1), "frozen param baked as a constant"
