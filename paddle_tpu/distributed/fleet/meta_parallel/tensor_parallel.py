@@ -1,12 +1,21 @@
 """TensorParallel wrapper.
 
-Reference parity: `fleet/meta_parallel/tensor_parallel.py` (broadcast
-inputs/params across mp group) [UNVERIFIED — empty reference mount].
-TPU-native: the mp_layers already placed weights on the 'mp' axis; inputs
-stay replicated (XLA broadcasts), so the wrapper only handles dp-axis input
-sharding like DataParallel.
+Reference parity: `fleet/meta_parallel/tensor_parallel.py` — broadcast
+inputs and NON-distributed parameters across the mp group so every mp
+rank starts from identical replicated weights [UNVERIFIED — empty
+reference mount].
+
+TPU-native: the mp_layers already place their weights on the 'mp' mesh
+axis, so the wrapper must (a) NOT clobber those placements when it
+replicates everything else (DataParallel's blanket replication would
+reshard a ColumnParallelLinear weight back to replicated), and (b) in
+multi-process mode align the replicated parameters to mp-rank 0's
+values — each process initializes with its own host RNG, which is the
+exact divergence the reference's broadcast exists to fix.
 """
 from __future__ import annotations
+
+import jax
 
 from ...parallel import DataParallel
 
@@ -15,5 +24,31 @@ __all__ = ["TensorParallel"]
 
 class TensorParallel(DataParallel):
     def __init__(self, layers, hcg=None, strategy=None, **kwargs):
-        super().__init__(layers)
         self._hcg = hcg
+        super().__init__(layers)
+
+    def _sync_replicated_params(self, params):
+        """Multi-process: align replicated params to process 0's
+        values (each process initializes with its own host RNG — the
+        divergence the reference's mp-group broadcast exists to fix).
+        Uses multihost_utils.broadcast_one_to_all, which really moves
+        data (the eager collective API's broadcast is an identity on
+        already-replicated arrays)."""
+        if jax.process_count() <= 1:
+            return
+        if self._hcg is not None:
+            group = self._hcg.get_model_parallel_group()
+            nranks = getattr(group, "nranks", 1) if group else 1
+            if nranks > 1 and nranks != jax.process_count():
+                import logging
+                logging.getLogger("paddle_tpu.distributed").warning(
+                    "TensorParallel: mp group (%d ranks) is a strict "
+                    "subset of the %d processes; parameter sync "
+                    "broadcasts from global process 0 — per-subgroup "
+                    "sources are not supported", nranks,
+                    jax.process_count())
+        from jax.experimental import multihost_utils
+        for p in params:
+            p._value = jax.device_put(
+                multihost_utils.broadcast_one_to_all(p._value),
+                p._value.sharding)

@@ -43,15 +43,28 @@ class DataParallel(Layer):
         self._replicate_params()
 
     def _replicate_params(self):
-        """Broadcast-equivalent: place every param replicated on the mesh."""
+        """Broadcast-equivalent: place every param replicated on the
+        mesh.  Params already carrying a non-replicated sharding (mp
+        layers, hand-sharded weights) keep their placement — blanket
+        replication would silently clobber it."""
         if self._dp_axis is None or get_world_size() <= 1:
             return
         rep = NamedSharding(self._mesh, P())
+        replicated = []
         for p in self._layers.parameters():
+            sh = getattr(p._value, "sharding", None)
+            if sh is not None and not sh.is_fully_replicated:
+                continue
             try:
                 p._value = jax.device_put(p._value, rep)
+                replicated.append(p)
             except Exception:
                 pass
+        self._sync_replicated_params(replicated)
+
+    def _sync_replicated_params(self, params):
+        """Hook: TensorParallel aligns replicated params across
+        processes here; single-process DataParallel needs nothing."""
 
     def _shard_input(self, t):
         if not isinstance(t, Tensor) or self._dp_axis is None or \

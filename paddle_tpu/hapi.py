@@ -198,6 +198,47 @@ class Model:
 
 
 def summary(net, input_size=None, dtypes=None, input=None):
+    """Param table; with `input_size` (shape tuple, list of shapes for
+    multi-input) or a concrete `input`, also runs a forward pass with
+    hooks and reports each sublayer's output shape (the reference
+    summary's behavior — both were ignored before)."""
+    out_shapes = {}
+    if input_size is not None or input is not None:
+        if input is None:
+            sizes = (input_size if isinstance(input_size, list)
+                     else [input_size])
+            dts = list(dtypes) if isinstance(dtypes, (list, tuple)) \
+                else [dtypes] * len(sizes)
+            if len(dts) < len(sizes):  # pad: zip would drop inputs
+                dts += [None] * (len(sizes) - len(dts))
+            input = [to_tensor(np.zeros(
+                tuple(s), dtype=np.dtype(d or "float32")))
+                for s, d in zip(sizes, dts)]
+        inputs = input if isinstance(input, (list, tuple)) else [input]
+        handles = []
+        names = {id(m): n for n, m in net.named_sublayers()}
+
+        def make_hook(mod):
+            def hook(layer, ins, outs):
+                o = outs[0] if isinstance(outs, (list, tuple)) else outs
+                if hasattr(o, "shape"):
+                    out_shapes[names.get(id(mod), type(mod).__name__)] \
+                        = tuple(o.shape)
+            return hook
+
+        for _, m in net.named_sublayers():
+            handles.append(m.register_forward_post_hook(make_hook(m)))
+        from .core.autograd import no_grad
+        was_training = net.training
+        try:
+            net.eval()
+            with no_grad():
+                net(*inputs)
+        finally:
+            if was_training:  # restore even when the probe raises
+                net.train()
+            for h in handles:
+                h.remove()
     rows = []
     total = 0
     trainable = 0
@@ -211,8 +252,16 @@ def summary(net, input_size=None, dtypes=None, input=None):
     lines = [f"{'Layer':<{width}}{'Shape':<24}{'Param #':<12}"]
     for name, shape, n in rows:
         lines.append(f"{name:<{width}}{str(shape):<24}{n:<12}")
+    if out_shapes:
+        lines.append("-" * (width + 36))
+        lines.append(f"{'Sublayer':<{width}}{'Output shape':<24}")
+        for name, shp in out_shapes.items():
+            lines.append(f"{name:<{width}}{str(shp):<24}")
     lines.append("-" * (width + 36))
     lines.append(f"Total params: {total:,}")
     lines.append(f"Trainable params: {trainable:,}")
     print("\n".join(lines))
-    return {"total_params": total, "trainable_params": trainable}
+    out = {"total_params": total, "trainable_params": trainable}
+    if out_shapes:
+        out["output_shapes"] = out_shapes
+    return out

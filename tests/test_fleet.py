@@ -656,3 +656,31 @@ def test_pipeline_mask_stage_falls_back_never_wrong(caplog):
     assert model._engine is False, "engine merged mask-differing stages"
     # ... and the fallback numerics are exact
     np.testing.assert_allclose(losses, ref, rtol=2e-4, atol=2e-5)
+
+
+def test_tensor_parallel_wrapper_preserves_mp_sharding():
+    """TensorParallel must not reshard mp-placed weights back to
+    replicated (DataParallel's blanket replication did), while still
+    replicating plain params."""
+    import numpy as np
+    import jax
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+    import paddle_tpu as paddle
+    from paddle_tpu import nn
+    from paddle_tpu.distributed.fleet.meta_parallel import TensorParallel
+
+    _reset_mesh(Mesh(np.array(jax.devices()[:8]).reshape(2, 4),
+                     ("dp", "mp")))
+    mesh = dist.env.global_mesh()
+    model = nn.Sequential(nn.Linear(4, 8), nn.Linear(8, 2))
+    # place one weight on the mp axis by hand (mp_layers' role)
+    w = model[0].weight
+    w._value = jax.device_put(w._value,
+                              NamedSharding(mesh, P(None, "mp")))
+    tp = TensorParallel(model)
+    assert not model[0].weight._value.sharding.is_fully_replicated, \
+        "mp-sharded weight was clobbered back to replicated"
+    assert model[1].weight._value.sharding.is_fully_replicated
+    x = paddle.to_tensor(np.ones((8, 4), np.float32))
+    out = tp(x)
+    assert list(out.shape) == [8, 2]
