@@ -48,7 +48,10 @@ class TensorParallel(DataParallel):
                     "sources are not supported", nranks,
                     jax.process_count())
         from jax.experimental import multihost_utils
-        for p in params:
-            p._value = jax.device_put(
-                multihost_utils.broadcast_one_to_all(p._value),
-                p._value.sharding)
+        if not params:
+            return
+        # one pytree collective, not one blocking broadcast per param
+        synced = multihost_utils.broadcast_one_to_all(
+            [p._value for p in params])
+        for p, v in zip(params, synced):
+            p._value = jax.device_put(v, p._value.sharding)

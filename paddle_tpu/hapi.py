@@ -205,8 +205,12 @@ def summary(net, input_size=None, dtypes=None, input=None):
     out_shapes = {}
     if input_size is not None or input is not None:
         if input is None:
-            sizes = (input_size if isinstance(input_size, list)
-                     else [input_size])
+            # multi-input iff the elements are themselves shapes; a
+            # flat [1, 28, 28] list is ONE shape (paddle-style)
+            multi = (isinstance(input_size, (list, tuple)) and input_size
+                     and all(isinstance(s, (list, tuple))
+                             for s in input_size))
+            sizes = list(input_size) if multi else [input_size]
             dts = list(dtypes) if isinstance(dtypes, (list, tuple)) \
                 else [dtypes] * len(sizes)
             if len(dts) < len(sizes):  # pad: zip would drop inputs
@@ -216,18 +220,16 @@ def summary(net, input_size=None, dtypes=None, input=None):
                 for s, d in zip(sizes, dts)]
         inputs = input if isinstance(input, (list, tuple)) else [input]
         handles = []
-        names = {id(m): n for n, m in net.named_sublayers()}
 
-        def make_hook(mod):
+        def make_hook(name):
             def hook(layer, ins, outs):
                 o = outs[0] if isinstance(outs, (list, tuple)) else outs
                 if hasattr(o, "shape"):
-                    out_shapes[names.get(id(mod), type(mod).__name__)] \
-                        = tuple(o.shape)
+                    out_shapes[name] = tuple(o.shape)
             return hook
 
-        for _, m in net.named_sublayers():
-            handles.append(m.register_forward_post_hook(make_hook(m)))
+        for n, m in net.named_sublayers():
+            handles.append(m.register_forward_post_hook(make_hook(n)))
         from .core.autograd import no_grad
         was_training = net.training
         try:

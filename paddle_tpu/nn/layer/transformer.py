@@ -49,13 +49,19 @@ class MultiHeadAttention(Layer):
         key = query if key is None else key
         value = query if value is None else value
         q = self._shape(self.q_proj(query))
-        k = self._shape(self.k_proj(key))
-        v = self._shape(self.v_proj(value))
-        if cache is not None:
-            from ...ops.manipulation import concat
-            k = concat([cache.k, k], axis=1)
-            v = concat([cache.v, v], axis=1)
-            new_cache = MultiHeadAttention.Cache(k, v)
+        if isinstance(cache, MultiHeadAttention.StaticCache):
+            # pre-projected encoder memory (cross-attention): reuse as
+            # is — re-projecting (or concatenating) would be wrong
+            k, v = cache.k, cache.v
+            new_cache = cache
+        else:
+            k = self._shape(self.k_proj(key))
+            v = self._shape(self.v_proj(value))
+            if cache is not None:
+                from ...ops.manipulation import concat
+                k = concat([cache.k, k], axis=1)
+                v = concat([cache.v, v], axis=1)
+                new_cache = MultiHeadAttention.Cache(k, v)
         out = F.scaled_dot_product_attention(
             q, k, v, attn_mask=attn_mask,
             dropout_p=self.dropout if self.training else 0.0,
@@ -69,16 +75,20 @@ class MultiHeadAttention(Layer):
         return out
 
     def gen_cache(self, key, value=None, type=None):
-        if value is None:
-            from ...ops.creation import zeros
-            b = key.shape[0]
-            k = zeros([b, 0, self.num_heads, self.head_dim],
-                      dtype=key.dtype)
-            v = zeros([b, 0, self.num_heads, self.head_dim],
-                      dtype=key.dtype)
-            return MultiHeadAttention.Cache(k, v)
-        return MultiHeadAttention.StaticCache(
-            self._shape(self.k_proj(key)), self._shape(self.v_proj(value)))
+        if type is MultiHeadAttention.StaticCache or value is not None:
+            # paddle: type=StaticCache projects k/v from `key` when no
+            # separate value is given (cross-attention memory)
+            value = key if value is None else value
+            return MultiHeadAttention.StaticCache(
+                self._shape(self.k_proj(key)),
+                self._shape(self.v_proj(value)))
+        from ...ops.creation import zeros
+        b = key.shape[0]
+        k = zeros([b, 0, self.num_heads, self.head_dim],
+                  dtype=key.dtype)
+        v = zeros([b, 0, self.num_heads, self.head_dim],
+                  dtype=key.dtype)
+        return MultiHeadAttention.Cache(k, v)
 
 
 def _get_activation(name):
@@ -112,7 +122,11 @@ class TransformerEncoderLayer(Layer):
         residual = src
         if self.normalize_before:
             src = self.norm1(src)
-        src = self.self_attn(src, src, src, src_mask)
+        if cache is None:
+            src = self.self_attn(src, src, src, src_mask)
+        else:  # incremental encoding (paddle cache protocol)
+            src, new_cache = self.self_attn(src, src, src, src_mask,
+                                            cache=cache)
         src = residual + self.dropout1(src)
         if not self.normalize_before:
             src = self.norm1(src)
@@ -123,7 +137,10 @@ class TransformerEncoderLayer(Layer):
         src = residual + self.dropout2(src)
         if not self.normalize_before:
             src = self.norm2(src)
-        return src
+        return src if cache is None else (src, new_cache)
+
+    def gen_cache(self, src):
+        return self.self_attn.gen_cache(src)
 
 
 class TransformerEncoder(Layer):
@@ -136,13 +153,23 @@ class TransformerEncoder(Layer):
         self.num_layers = num_layers
         self.norm = norm
 
-    def forward(self, src, src_mask=None):
+    def forward(self, src, src_mask=None, cache=None):
         out = src
-        for layer in self.layers:
-            out = layer(out, src_mask)
+        new_caches = []
+        for i, layer in enumerate(self.layers):
+            if cache is None:
+                out = layer(out, src_mask)
+            else:
+                out, c = layer(out, src_mask, cache=cache[i])
+                new_caches.append(c)
         if self.norm is not None:
             out = self.norm(out)
-        return out
+        if cache is None:
+            return out
+        return out, new_caches
+
+    def gen_cache(self, src):
+        return [layer.gen_cache(src) for layer in self.layers]
 
 
 def _clone_layer(layer):
@@ -205,17 +232,27 @@ class TransformerDecoderLayer(Layer):
 
     def forward(self, tgt, memory, tgt_mask=None, memory_mask=None,
                 cache=None):
+        # paddle cache protocol: cache = (incremental Cache for
+        # self-attn, StaticCache of projected memory for cross-attn)
         residual = tgt
         if self.normalize_before:
             tgt = self.norm1(tgt)
-        tgt = self.self_attn(tgt, tgt, tgt, tgt_mask)
+        if cache is None:
+            tgt = self.self_attn(tgt, tgt, tgt, tgt_mask)
+        else:
+            tgt, inc_cache = self.self_attn(tgt, tgt, tgt, tgt_mask,
+                                            cache=cache[0])
         tgt = residual + self.dropout1(tgt)
         if not self.normalize_before:
             tgt = self.norm1(tgt)
         residual = tgt
         if self.normalize_before:
             tgt = self.norm2(tgt)
-        tgt = self.cross_attn(tgt, memory, memory, memory_mask)
+        if cache is None:
+            tgt = self.cross_attn(tgt, memory, memory, memory_mask)
+        else:
+            tgt, static_cache = self.cross_attn(
+                tgt, memory, memory, memory_mask, cache=cache[1])
         tgt = residual + self.dropout2(tgt)
         if not self.normalize_before:
             tgt = self.norm2(tgt)
@@ -226,7 +263,13 @@ class TransformerDecoderLayer(Layer):
         tgt = residual + self.dropout3(tgt)
         if not self.normalize_before:
             tgt = self.norm3(tgt)
-        return tgt
+        if cache is None:
+            return tgt
+        return tgt, (inc_cache, static_cache)
+
+    def gen_cache(self, memory):
+        return (self.self_attn.gen_cache(memory),
+                self.cross_attn.gen_cache(memory, memory))
 
 
 class TransformerDecoder(Layer):
@@ -241,11 +284,25 @@ class TransformerDecoder(Layer):
     def forward(self, tgt, memory, tgt_mask=None, memory_mask=None,
                 cache=None):
         out = tgt
-        for layer in self.layers:
-            out = layer(out, memory, tgt_mask, memory_mask)
+        new_caches = []
+        for i, layer in enumerate(self.layers):
+            if cache is None:
+                out = layer(out, memory, tgt_mask, memory_mask)
+            else:
+                out, c = layer(out, memory, tgt_mask, memory_mask,
+                               cache=cache[i])
+                new_caches.append(c)
         if self.norm is not None:
             out = self.norm(out)
-        return out
+        if cache is None:
+            return out
+        return out, new_caches
+
+    def gen_cache(self, memory, do_zip=False):
+        caches = [layer.gen_cache(memory) for layer in self.layers]
+        if do_zip:
+            return list(zip(*caches))
+        return caches
 
 
 class Transformer(Layer):

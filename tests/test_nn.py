@@ -417,3 +417,56 @@ def test_beam_search_scores_are_true_log_probs():
             scores[(t1, t2)] = lp0[t1] + lp1[t2]
     brute = max(scores, key=scores.get)
     assert tuple(best[:len(brute)]) == brute, (best, brute, scores)
+
+
+def test_transformer_decoder_incremental_cache_parity():
+    """Decoder cache protocol: step-by-step decode with gen_cache must
+    match the full-sequence forward under a causal mask (cache was
+    silently ignored; StaticCache was wrongly re-projected)."""
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu import nn
+
+    paddle.seed(0)
+    d, h, S = 16, 2, 5
+    layer = nn.TransformerDecoderLayer(d, h, 32, dropout=0.0)
+    dec = nn.TransformerDecoder(layer, 2)
+    dec.eval()
+    rng = np.random.default_rng(0)
+    tgt = paddle.to_tensor(rng.standard_normal((2, S, d)).astype(np.float32))
+    mem = paddle.to_tensor(rng.standard_normal((2, 3, d)).astype(np.float32))
+
+    causal = np.triu(np.full((S, S), -1e9, np.float32), 1)
+    full = dec(tgt, mem, tgt_mask=paddle.to_tensor(causal)).numpy()
+
+    caches = dec.gen_cache(mem)
+    outs = []
+    for t in range(S):
+        step = paddle.to_tensor(tgt.numpy()[:, t:t + 1])
+        out, caches = dec(step, mem, cache=caches)
+        outs.append(out.numpy())
+    inc = np.concatenate(outs, axis=1)
+    np.testing.assert_allclose(inc, full, rtol=1e-4, atol=1e-5)
+
+
+def test_transformer_encoder_incremental_cache():
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu import nn
+
+    paddle.seed(0)
+    layer = nn.TransformerEncoderLayer(16, 2, 32, dropout=0.0)
+    enc = nn.TransformerEncoder(layer, 2)
+    enc.eval()
+    rng = np.random.default_rng(1)
+    src = paddle.to_tensor(rng.standard_normal((2, 4, 16)).astype(np.float32))
+    causal = np.triu(np.full((4, 4), -1e9, np.float32), 1)
+    full = enc(src, src_mask=paddle.to_tensor(causal)).numpy()
+    caches = enc.gen_cache(src)
+    outs = []
+    for t in range(4):
+        step = paddle.to_tensor(src.numpy()[:, t:t + 1])
+        out, caches = enc(step, cache=caches)
+        outs.append(out.numpy())
+    np.testing.assert_allclose(np.concatenate(outs, axis=1), full,
+                               rtol=1e-4, atol=1e-5)
