@@ -75,13 +75,17 @@ class MultiHeadAttention(Layer):
         return out
 
     def gen_cache(self, key, value=None, type=None):
-        if type is MultiHeadAttention.StaticCache or value is not None:
-            # paddle: type=StaticCache projects k/v from `key` when no
-            # separate value is given (cross-attention memory)
+        """paddle semantics: type=StaticCache projects k/v from the
+        memory; the default (Cache) seeds an incremental cache — empty
+        when value is None, else Cache(key, value) VERBATIM (resuming
+        from previously produced k/v)."""
+        if type is MultiHeadAttention.StaticCache:
             value = key if value is None else value
             return MultiHeadAttention.StaticCache(
                 self._shape(self.k_proj(key)),
                 self._shape(self.v_proj(value)))
+        if value is not None:
+            return MultiHeadAttention.Cache(key, value)
         from ...ops.creation import zeros
         b = key.shape[0]
         k = zeros([b, 0, self.num_heads, self.head_dim],
@@ -269,7 +273,8 @@ class TransformerDecoderLayer(Layer):
 
     def gen_cache(self, memory):
         return (self.self_attn.gen_cache(memory),
-                self.cross_attn.gen_cache(memory, memory))
+                self.cross_attn.gen_cache(
+                    memory, type=MultiHeadAttention.StaticCache))
 
 
 class TransformerDecoder(Layer):

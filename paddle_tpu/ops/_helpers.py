@@ -1,0 +1,51 @@
+"""Argument-normalization helpers shared by the hand-written op
+modules and the yaml-generated bindings (_generated.py imports these,
+so they must not import any ops module)."""
+from __future__ import annotations
+
+import builtins
+
+import numpy as np
+
+from ..core.dtypes import default_dtype, to_jax_dtype
+from ..core.tensor import Tensor
+
+
+def _axis(axis):
+    if axis is None:
+        return None
+    if isinstance(axis, Tensor):
+        a = axis.numpy().tolist()
+        return tuple(int(x) for x in a) if isinstance(a, list) else int(a)
+    if isinstance(axis, (list, tuple)):
+        return tuple(int(a) for a in axis)
+    return int(axis)
+
+
+def _dt(dtype):
+    return None if dtype is None else to_jax_dtype(dtype)
+
+
+def _jd(dtype, default=None):
+    if dtype is None:
+        return to_jax_dtype(default) if default is not None else \
+            to_jax_dtype(default_dtype())
+    return to_jax_dtype(dtype)
+
+
+def _shape(shape):
+    if isinstance(shape, Tensor):
+        return tuple(int(s) for s in shape.numpy().tolist())
+    if isinstance(shape, (int, np.integer)):
+        return (int(shape),)
+    return tuple(int(s) if not isinstance(s, Tensor) else int(s.item())
+                 for s in shape)
+
+
+def _int_list(v):
+    if isinstance(v, Tensor):
+        out = v.numpy().tolist()
+        return out if isinstance(out, builtins.list) else [out]
+    if isinstance(v, (int, np.integer)):
+        return [int(v)]
+    return [int(i.item()) if isinstance(i, Tensor) else int(i) for i in v]

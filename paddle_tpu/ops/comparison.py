@@ -36,6 +36,7 @@ from ._generated import (  # noqa: F401
 from ._generated import (  # noqa: F401  (sig-kind rows)
     allclose,
     bitwise_not,
+    equal_all,
     isclose,
     isin,
     logical_not,
@@ -43,15 +44,6 @@ from ._generated import (  # noqa: F401  (sig-kind rows)
 
 bitwise_left_shift = _cmp("bitwise_left_shift", jnp.left_shift)
 bitwise_right_shift = _cmp("bitwise_right_shift", jnp.right_shift)
-
-
-def equal_all(x, y, name=None):
-    def impl(a, b):
-        if a.shape != b.shape:
-            return jnp.asarray(False)
-        return jnp.all(a == b)
-
-    return dispatch("equal_all", impl, (x, y), {}, differentiable=False)
 
 
 def is_empty(x, name=None):

@@ -27,30 +27,7 @@ __all__ = [
 ]
 
 
-def _shape(shape):
-    if isinstance(shape, Tensor):
-        return tuple(int(s) for s in shape.numpy().tolist())
-    if isinstance(shape, (int, np.integer)):
-        return (int(shape),)
-    return tuple(int(s) if not isinstance(s, Tensor) else int(s.item())
-                 for s in shape)
-
-
-def _jd(dtype, default=None):
-    if dtype is None:
-        return to_jax_dtype(default) if default is not None else to_jax_dtype(
-            default_dtype())
-    return to_jax_dtype(dtype)
-
-
-def zeros(shape, dtype=None, name=None):
-    return dispatch("zeros", lambda *, shape, dtype: jnp.zeros(shape, dtype),
-                    (), dict(shape=_shape(shape), dtype=_jd(dtype)))
-
-
-def ones(shape, dtype=None, name=None):
-    return dispatch("ones", lambda *, shape, dtype: jnp.ones(shape, dtype),
-                    (), dict(shape=_shape(shape), dtype=_jd(dtype)))
+from ._helpers import _jd, _shape  # noqa: F401
 
 
 def full(shape, fill_value, dtype=None, name=None):
@@ -77,18 +54,15 @@ from ._generated import (  # noqa: F401  (sig-kind rows)
     clone,
     complex,
     diagflat,
+    eye,
+    full_like,
+    linspace,
+    logspace,
+    ones,
     tril,
     triu,
+    zeros,
 )
-
-
-def full_like(x, fill_value, dtype=None, name=None):
-    return dispatch(
-        "full_like",
-        lambda v, *, value, dtype: jnp.full_like(v, value, dtype), (x,),
-        dict(value=fill_value,
-             dtype=None if dtype is None else to_jax_dtype(dtype)),
-        differentiable=False)
 
 
 def empty_like(x, dtype=None, name=None):
@@ -112,34 +86,6 @@ def arange(start=0, end=None, step=1, dtype=None, name=None):
         "arange",
         lambda *, start, end, step, dtype: jnp.arange(start, end, step, dtype),
         (), dict(start=start, end=end, step=step, dtype=_jd(dtype)))
-
-
-def linspace(start, stop, num, dtype=None, name=None):
-    start = start.item() if isinstance(start, Tensor) else start
-    stop = stop.item() if isinstance(stop, Tensor) else stop
-    num = int(num.item()) if isinstance(num, Tensor) else int(num)
-    return dispatch(
-        "linspace",
-        lambda *, start, stop, num, dtype: jnp.linspace(
-            start, stop, num, dtype=dtype),
-        (), dict(start=start, stop=stop, num=num, dtype=_jd(dtype)))
-
-
-def logspace(start, stop, num, base=10.0, dtype=None, name=None):
-    return dispatch(
-        "logspace",
-        lambda *, start, stop, num, base, dtype: jnp.logspace(
-            start, stop, num, base=base, dtype=dtype),
-        (), dict(start=float(start), stop=float(stop), num=int(num),
-                 base=float(base), dtype=_jd(dtype)))
-
-
-def eye(num_rows, num_columns=None, dtype=None, name=None):
-    return dispatch(
-        "eye", lambda *, n, m, dtype: jnp.eye(n, m, dtype=dtype), (),
-        dict(n=int(num_rows),
-             m=None if num_columns is None else int(num_columns),
-             dtype=_jd(dtype)))
 
 
 # ---------------- random ----------------

@@ -15,14 +15,22 @@ from ..core.dispatch import dispatch
 from ..core.tensor import Tensor
 from ._generated import (  # noqa: F401  (sig-kind rows)
     bmm,
+    cholesky_solve,
     corrcoef,
     cov,
+    dot,
+    eigh,
     eigvalsh,
+    matmul,
     matrix_exp,
     matrix_power,
+    matrix_rank,
     multi_dot,
     mv,
     pinv,
+    solve,
+    svd,
+    triangular_solve,
     vander,
     vecdot,
 )
@@ -38,27 +46,8 @@ __all__ = [
 ]
 
 
-def matmul(x, y, transpose_x=False, transpose_y=False, name=None):
-    def impl(a, b, *, tx, ty):
-        if tx:
-            a = jnp.swapaxes(a, -1, -2) if a.ndim > 1 else a
-        if ty:
-            b = jnp.swapaxes(b, -1, -2) if b.ndim > 1 else b
-        return jnp.matmul(a, b)
-
-    return dispatch("matmul_v2", impl, (x, y),
-                    dict(tx=bool(transpose_x), ty=bool(transpose_y)))
-
-
 def mm(input, mat2, name=None):
     return matmul(input, mat2)
-
-
-def dot(x, y, name=None):
-    def impl(a, b):
-        return jnp.sum(a * b, axis=-1)
-
-    return dispatch("dot", impl, (x, y), {})
 
 
 def t(input, name=None):
@@ -144,13 +133,6 @@ def slogdet(x, name=None):
     return dispatch("slogdeterminant", impl, (x,), {})
 
 
-def svd(x, full_matrices=False, name=None):
-    def impl(v, *, fm):
-        return tuple(jnp.linalg.svd(v, full_matrices=fm))
-
-    return dispatch("svd", impl, (x,), dict(fm=bool(full_matrices)))
-
-
 def qr(x, mode="reduced", name=None):
     def impl(v, *, mode):
         if mode == "r":
@@ -168,54 +150,10 @@ def eig(x, name=None):
     return to_tensor(w), to_tensor(v)
 
 
-def eigh(x, UPLO="L", name=None):
-    def impl(v, *, uplo):
-        return tuple(jnp.linalg.eigh(v, symmetrize_input=True))
-
-    return dispatch("eigh", impl, (x,), dict(uplo=UPLO))
-
-
 def eigvals(x, name=None):
     arr = np.asarray(x._value)
     from ..core.tensor import to_tensor
     return to_tensor(np.linalg.eigvals(arr))
-
-
-def matrix_rank(x, tol=None, hermitian=False, name=None):
-    def impl(v, *, tol):
-        return jnp.linalg.matrix_rank(v, tol=tol).astype(jnp.int64)
-
-    t_ = tol.item() if isinstance(tol, Tensor) else tol
-    return dispatch("matrix_rank", impl, (x,), dict(tol=t_),
-                    differentiable=False)
-
-
-def solve(x, y, name=None):
-    def impl(a, b):
-        if b.ndim == a.ndim - 1:
-            return jnp.linalg.solve(a, b[..., None])[..., 0]
-        return jnp.linalg.solve(a, b)
-
-    return dispatch("solve", impl, (x, y), {})
-
-
-def triangular_solve(x, y, upper=True, transpose=False, unitriangular=False,
-                     name=None):
-    def impl(a, b, *, upper, trans, unit):
-        return jax.scipy.linalg.solve_triangular(
-            a, b, lower=not upper, trans=1 if trans else 0,
-            unit_diagonal=unit)
-
-    return dispatch("triangular_solve", impl, (x, y),
-                    dict(upper=bool(upper), trans=bool(transpose),
-                         unit=bool(unitriangular)))
-
-
-def cholesky_solve(x, y, upper=False, name=None):
-    def impl(b, L, *, upper):
-        return jax.scipy.linalg.cho_solve((L, not upper), b)
-
-    return dispatch("cholesky_solve", impl, (x, y), dict(upper=bool(upper)))
 
 
 def lstsq(x, y, rcond=None, driver=None, name=None):

@@ -16,10 +16,31 @@ from ..core.dispatch import dispatch
 from ..core.dtypes import to_jax_dtype
 from ..core.tensor import Tensor, to_tensor
 from ._generated import (  # noqa: F401  (sig-kind rows)
+    broadcast_to,
+    cast,
     clone,
+    column_stack,
+    concat,
     diagonal,
+    flip,
+    gather,
+    gather_nd,
+    index_put,
+    index_sample,
+    index_select,
+    masked_fill,
+    moveaxis,
+    reshape,
+    roll,
     rot90,
+    row_stack,
+    scatter_nd_add,
+    stack,
     swapaxes,
+    take_along_axis,
+    tile,
+    transpose,
+    unsqueeze,
 )
 
 __all__ = [
@@ -40,38 +61,13 @@ __all__ = [
 ]
 
 
-def _int_list(v):
-    if isinstance(v, Tensor):
-        out = v.numpy().tolist()
-        return out if isinstance(out, builtins.list) else [out]
-    if isinstance(v, (int, np.integer)):
-        return [int(v)]
-    return [int(i.item()) if isinstance(i, Tensor) else int(i) for i in v]
-
-
-def reshape(x, shape, name=None):
-    shape = tuple(_int_list(shape))
-    return dispatch("reshape", lambda v, *, shape: jnp.reshape(v, shape),
-                    (x,), dict(shape=shape))
+from ._helpers import _int_list  # noqa: F401
 
 
 def reshape_(x, shape, name=None):
     y = reshape(x, shape)
     x._inplace_update(y._value, y._grad_node, y._out_index)
     return x
-
-
-def transpose(x, perm, name=None):
-    perm = tuple(_int_list(perm))
-    return dispatch("transpose", lambda v, *, perm: jnp.transpose(v, perm),
-                    (x,), dict(perm=perm))
-
-
-def moveaxis(x, source, destination, name=None):
-    return dispatch(
-        "moveaxis",
-        lambda v, *, s, d: jnp.moveaxis(v, s, d), (x,),
-        dict(s=tuple(_int_list(source)), d=tuple(_int_list(destination))))
 
 
 def flatten(x, start_axis=0, stop_axis=-1, name=None):
@@ -109,31 +105,10 @@ def squeeze_(x, axis=None, name=None):
     return x
 
 
-def unsqueeze(x, axis, name=None):
-    axes = tuple(_int_list(axis))
-    return dispatch("unsqueeze",
-                    lambda v, *, axes: jnp.expand_dims(v, axes), (x,),
-                    dict(axes=axes))
-
-
 def unsqueeze_(x, axis, name=None):
     y = unsqueeze(x, axis)
     x._inplace_update(y._value, y._grad_node, y._out_index)
     return x
-
-
-def concat(x, axis=0, name=None):
-    axis = int(axis.item()) if isinstance(axis, Tensor) else int(axis)
-    xs = builtins.list(x)
-    return dispatch("concat",
-                    lambda *vs, axis: jnp.concatenate(vs, axis), tuple(xs),
-                    dict(axis=axis))
-
-
-def stack(x, axis=0, name=None):
-    xs = builtins.list(x)
-    return dispatch("stack", lambda *vs, axis: jnp.stack(vs, axis),
-                    tuple(xs), dict(axis=int(axis)))
 
 
 def split(x, num_or_sections, axis=0, name=None):
@@ -193,11 +168,6 @@ def chunk(x, chunks, axis=0, name=None):
     return split(x, int(chunks), axis)
 
 
-def tile(x, repeat_times, name=None):
-    return dispatch("tile", lambda v, *, reps: jnp.tile(v, reps), (x,),
-                    dict(reps=tuple(_int_list(repeat_times))))
-
-
 def expand(x, shape, name=None):
     shape = _int_list(shape)
 
@@ -216,40 +186,11 @@ def expand_as(x, y, name=None):
     return expand(x, y.shape)
 
 
-def broadcast_to(x, shape, name=None):
-    return dispatch("broadcast_to",
-                    lambda v, *, shape: jnp.broadcast_to(v, shape), (x,),
-                    dict(shape=tuple(_int_list(shape))))
-
-
 def broadcast_tensors(inputs, name=None):
     outs = dispatch("broadcast_tensors",
                     lambda *vs: tuple(jnp.broadcast_arrays(*vs)),
                     tuple(inputs), {})
     return builtins.list(outs)
-
-
-def cast(x, dtype):
-    jd = to_jax_dtype(dtype)
-    return dispatch("cast", lambda v, *, dtype: jnp.asarray(v, dtype), (x,),
-                    dict(dtype=jd))
-
-
-def gather(x, index, axis=0, name=None):
-    axis = int(axis.item()) if isinstance(axis, Tensor) else int(axis)
-
-    def impl(v, idx, *, axis):
-        return jnp.take(v, idx.reshape(-1) if idx.ndim > 1 else idx,
-                        axis=axis)
-
-    return dispatch("gather", impl, (x, index), dict(axis=axis))
-
-
-def gather_nd(x, index, name=None):
-    def impl(v, idx):
-        return v[tuple(jnp.moveaxis(idx, -1, 0))]
-
-    return dispatch("gather_nd", impl, (x, index), {})
 
 
 def scatter(x, index, updates, overwrite=True, name=None):
@@ -279,27 +220,6 @@ def scatter_nd(index, updates, shape, name=None):
                     dict(shape=tuple(_int_list(shape))))
 
 
-def scatter_nd_add(x, index, updates, name=None):
-    def impl(v, idx, upd):
-        return v.at[tuple(jnp.moveaxis(idx, -1, 0))].add(upd)
-
-    return dispatch("scatter_nd_add", impl, (x, index, updates), {})
-
-
-def index_select(x, index, axis=0, name=None):
-    def impl(v, idx, *, axis):
-        return jnp.take(v, idx, axis=axis)
-
-    return dispatch("index_select", impl, (x, index), dict(axis=int(axis)))
-
-
-def index_sample(x, index, name=None):
-    def impl(v, idx):
-        return jnp.take_along_axis(v, idx, axis=1)
-
-    return dispatch("index_sample", impl, (x, index), {})
-
-
 def index_add(x, index, axis, value, name=None):
     def impl(v, idx, val, *, axis):
         vm = jnp.moveaxis(v, axis, 0)
@@ -311,29 +231,11 @@ def index_add(x, index, axis, value, name=None):
                     dict(axis=int(axis)))
 
 
-def index_put(x, indices, value, accumulate=False, name=None):
-    def impl(v, val, *idx, accumulate):
-        if accumulate:
-            return v.at[tuple(idx)].add(val)
-        return v.at[tuple(idx)].set(val)
-
-    return dispatch("index_put", impl, (x, value) + tuple(indices),
-                    dict(accumulate=bool(accumulate)))
-
-
 def masked_select(x, mask, name=None):
     # dynamic output shape → eager-only (host roundtrip), like Paddle's
     # D2H-sync ops.
     vals = np.asarray(x._value)[np.asarray(mask._value)]
     return to_tensor(vals)
-
-
-def masked_fill(x, mask, value, name=None):
-    def impl(v, m, *, value):
-        return jnp.where(m, jnp.asarray(value, v.dtype), v)
-
-    value = value.item() if isinstance(value, Tensor) else value
-    return dispatch("masked_fill", impl, (x, mask), dict(value=value))
 
 
 def where(condition, x=None, y=None, name=None):
@@ -349,21 +251,6 @@ def nonzero(x, as_tuple=False):
     if as_tuple:
         return tuple(to_tensor(i.astype(np.int64)) for i in nz)
     return to_tensor(np.stack(nz, axis=1).astype(np.int64))
-
-
-def flip(x, axis, name=None):
-    return dispatch("flip", lambda v, *, axis: jnp.flip(v, axis), (x,),
-                    dict(axis=tuple(_int_list(axis))))
-
-
-def roll(x, shifts, axis=None, name=None):
-    return dispatch(
-        "roll", lambda v, *, shifts, axis: jnp.roll(v, shifts, axis), (x,),
-        dict(shifts=tuple(_int_list(shifts)) if not isinstance(shifts, int)
-             else int(shifts),
-             axis=None if axis is None else (
-                 tuple(_int_list(axis)) if not isinstance(axis, int)
-                 else int(axis))))
 
 
 def repeat_interleave(x, repeats, axis=None, name=None):
@@ -394,14 +281,6 @@ def unbind(x, axis=0, name=None):
 
 
 unstack = unbind
-
-
-def take_along_axis(arr, indices, axis, broadcast=True, name=None):
-    def impl(v, idx, *, axis):
-        return jnp.take_along_axis(v, idx, axis=axis)
-
-    return dispatch("take_along_axis", impl, (arr, indices),
-                    dict(axis=int(axis)))
 
 
 def put_along_axis(arr, indices, values, axis, reduce="assign",
@@ -786,18 +665,6 @@ def slice_scatter(x, value, axes, starts, ends, strides, name=None):
     return dispatch("slice_scatter", impl, (x, value),
                     dict(axes=tuple(axes), starts=tuple(starts),
                          ends=tuple(ends), strides=tuple(strides)))
-
-
-def column_stack(x, name=None):
-    def impl(*vs):
-        return jnp.column_stack(vs)
-    return dispatch("column_stack", impl, tuple(x), {})
-
-
-def row_stack(x, name=None):
-    def impl(*vs):
-        return jnp.vstack(vs)
-    return dispatch("row_stack", impl, tuple(x), {})
 
 
 def unflatten(x, axis, shape, name=None):

@@ -72,11 +72,13 @@ from ._generated import (  # noqa: F401  (sig-kind rows)
     isreal,
     kron,
     lerp,
+    logit,
     nan_to_num,
     nextafter,
     outer,
     polar,
     polygamma,
+    scale,
     signbit,
     sinc,
     stanh,
@@ -84,30 +86,11 @@ from ._generated import (  # noqa: F401  (sig-kind rows)
 )
 
 
-def scale(x, scale=1.0, bias=0.0, bias_after_scale=True, act=None, name=None):
-    def impl(v, s, *, bias, after):
-        s = jnp.asarray(s, v.dtype)
-        return v * s + bias if after else (v + bias) * s
-
-    s = scale if isinstance(scale, Tensor) else float(scale)
-    return dispatch("scale", impl, (x, s),
-                    dict(bias=float(bias), after=bool(bias_after_scale)))
-
-
 def increment(x, value=1.0, name=None):
     y = dispatch("increment", lambda v, *, value: v + value, (x,),
                  dict(value=value))
     x._inplace_update(y._value, y._grad_node, y._out_index)
     return x
-
-
-def logit(x, eps=None, name=None):
-    def impl(v, *, eps):
-        if eps is not None:
-            v = jnp.clip(v, eps, 1.0 - eps)
-        return jnp.log(v) - jnp.log1p(-v)
-
-    return dispatch("logit", impl, (x,), dict(eps=eps))
 
 
 def _cum_extreme_impl(combine):

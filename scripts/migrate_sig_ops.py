@@ -27,8 +27,9 @@ ROOT = pathlib.Path(__file__).resolve().parents[1]
 OPS = ROOT / "paddle_tpu" / "ops"
 MODULES = ["math.py", "manipulation.py", "creation.py", "reduction.py",
            "comparison.py", "linalg.py", "logic.py"]
-ALLOWED = {"dispatch", "jax", "jnp", "Tensor", "_axis", "_dt"} | set(
-    dir(builtins))
+ALLOWED = {"dispatch", "jax", "jnp", "np", "builtins", "Tensor",
+           "to_jax_dtype", "_axis", "_dt", "_int_list", "_jd",
+           "_shape"} | set(dir(builtins))
 
 
 def _signature_of(fn: ast.FunctionDef, src: str) -> str | None:
@@ -55,8 +56,9 @@ def _free_names(node: ast.AST, params: set) -> set:
             if isinstance(n.ctx, ast.Load):
                 names.add(n.id)
 
-        def visit_Lambda(self, n):
-            inner = {x.arg for x in (n.args.args + n.args.kwonlyargs)}
+        def _scoped(self, n, body):
+            inner = {x.arg for x in (n.args.args + n.args.kwonlyargs
+                                     + n.args.posonlyargs)}
             if n.args.vararg:
                 inner.add(n.args.vararg.arg)
             if n.args.kwarg:
@@ -64,8 +66,17 @@ def _free_names(node: ast.AST, params: set) -> set:
             for d in n.args.defaults + [
                     x for x in n.args.kw_defaults if x]:
                 self.visit(d)
-            sub = _free_names(n.body, params | inner)
-            names.update(sub)
+            for sub_node in body:
+                names.update(_free_names(sub_node, params | inner))
+
+        def visit_Lambda(self, n):
+            self._scoped(n, [n.body])
+
+        def visit_FunctionDef(self, n):
+            # a nested `def impl(...)` prelude: binds its name in the
+            # enclosing scope; its body sees its own params
+            self._scoped(n, n.body)
+            names.discard(n.name)
 
     V().visit(node)
     return {n for n in names if n not in params}
@@ -83,11 +94,29 @@ def _stmt_source(lines, stmt, dedent=4):
 
 
 def _bound_names(stmt):
+    """Names a prelude statement binds in the ENCLOSING scope.  Nested
+    function bodies bind only their own name — their internal stores
+    must not leak (the expr would then reference a local that doesn't
+    exist in the generated binding)."""
     names = set()
-    for n in ast.walk(stmt):
+
+    def walk(n, top):
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                and not top:
+            names.add(n.name)
+            return
+        if isinstance(n, ast.Lambda) and not top:
+            return
         if isinstance(n, ast.Name) and isinstance(
                 n.ctx, (ast.Store, ast.Del)):
             names.add(n.id)
+        for c in ast.iter_child_nodes(n):
+            walk(c, False)
+
+    if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+        names.add(stmt.name)
+        return names
+    walk(stmt, True)
     return names
 
 
@@ -115,8 +144,8 @@ def candidates(path: pathlib.Path):
                 and getattr(ret.func, "id", "") == "dispatch"
                 and ret.args and isinstance(ret.args[0], ast.Constant)):
             continue
-        if any(isinstance(s, (ast.FunctionDef, ast.Return, ast.Global,
-                              ast.Nonlocal, ast.Import, ast.ImportFrom))
+        if any(isinstance(s, (ast.Return, ast.Global, ast.Nonlocal,
+                              ast.Import, ast.ImportFrom))
                for s in prelude_stmts):
             continue
         sig = _signature_of(node, src)
