@@ -11,7 +11,7 @@ import jax
 import jax.numpy as jnp
 
 from ..core.dispatch import dispatch
-from ..core.dtypes import convert_dtype, default_dtype, to_jax_dtype
+from ..core.dtypes import convert_dtype, default_dtype
 from ..core.tensor import Tensor, to_tensor
 from ..framework.random import default_generator
 

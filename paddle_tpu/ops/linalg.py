@@ -12,15 +12,16 @@ import jax
 import jax.numpy as jnp
 
 from ..core.dispatch import dispatch
-from ..core.tensor import Tensor
 from ._generated import (  # noqa: F401  (sig-kind rows)
     bmm,
+    cholesky,
     cholesky_solve,
     corrcoef,
     cov,
     dot,
     eigh,
     eigvalsh,
+    lstsq,
     matmul,
     matrix_exp,
     matrix_power,
@@ -106,14 +107,6 @@ def cond(x, p=None, name=None):
     return dispatch("cond", impl, (x,), dict(p=p))
 
 
-def cholesky(x, upper=False, name=None):
-    def impl(v, *, upper):
-        L = jnp.linalg.cholesky(v)
-        return jnp.swapaxes(L, -1, -2) if upper else L
-
-    return dispatch("cholesky", impl, (x,), dict(upper=bool(upper)))
-
-
 def inv(x, name=None):
     return dispatch("inverse", jnp.linalg.inv, (x,), {})
 
@@ -154,14 +147,6 @@ def eigvals(x, name=None):
     arr = np.asarray(x._value)
     from ..core.tensor import to_tensor
     return to_tensor(np.linalg.eigvals(arr))
-
-
-def lstsq(x, y, rcond=None, driver=None, name=None):
-    def impl(a, b, *, rcond):
-        sol, res, rank, sv = jnp.linalg.lstsq(a, b, rcond=rcond)
-        return sol, res, rank.astype(jnp.int64), sv
-
-    return dispatch("lstsq", impl, (x, y), dict(rcond=rcond))
 
 
 def lu(x, pivot=True, get_infos=False, name=None):

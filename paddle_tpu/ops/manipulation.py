@@ -16,15 +16,19 @@ from ..core.dispatch import dispatch
 from ..core.dtypes import to_jax_dtype
 from ..core.tensor import Tensor, to_tensor
 from ._generated import (  # noqa: F401  (sig-kind rows)
+    argsort,
     broadcast_to,
     cast,
     clone,
     column_stack,
     concat,
     diagonal,
+    flatten,
     flip,
     gather,
     gather_nd,
+    index_add,
+    index_fill,
     index_put,
     index_sample,
     index_select,
@@ -34,7 +38,12 @@ from ._generated import (  # noqa: F401  (sig-kind rows)
     roll,
     rot90,
     row_stack,
+    scatter,
+    scatter_nd,
     scatter_nd_add,
+    select_scatter,
+    shard_index,
+    sort,
     stack,
     swapaxes,
     take_along_axis,
@@ -68,17 +77,6 @@ def reshape_(x, shape, name=None):
     y = reshape(x, shape)
     x._inplace_update(y._value, y._grad_node, y._out_index)
     return x
-
-
-def flatten(x, start_axis=0, stop_axis=-1, name=None):
-    def impl(v, *, s, e):
-        nd = v.ndim
-        s_, e_ = s % nd if nd else 0, e % nd if nd else 0
-        new_shape = v.shape[:s_] + (-1,) + v.shape[e_ + 1:]
-        return jnp.reshape(v, new_shape)
-
-    return dispatch("flatten", impl, (x,),
-                    dict(s=int(start_axis), e=int(stop_axis)))
 
 
 def flatten_(x, start_axis=0, stop_axis=-1, name=None):
@@ -193,42 +191,10 @@ def broadcast_tensors(inputs, name=None):
     return builtins.list(outs)
 
 
-def scatter(x, index, updates, overwrite=True, name=None):
-    def impl(v, idx, upd, *, overwrite):
-        idx = idx.reshape(-1)
-        if overwrite:
-            return v.at[idx].set(upd)
-        base = v.at[idx].set(jnp.zeros_like(upd))
-        return base.at[idx].add(upd)
-
-    return dispatch("scatter", impl, (x, index, updates),
-                    dict(overwrite=bool(overwrite)))
-
-
 def scatter_(x, index, updates, overwrite=True, name=None):
     y = scatter(x, index, updates, overwrite)
     x._inplace_update(y._value, y._grad_node, y._out_index)
     return x
-
-
-def scatter_nd(index, updates, shape, name=None):
-    def impl(idx, upd, *, shape):
-        z = jnp.zeros(shape, upd.dtype)
-        return z.at[tuple(jnp.moveaxis(idx, -1, 0))].add(upd)
-
-    return dispatch("scatter_nd", impl, (index, updates),
-                    dict(shape=tuple(_int_list(shape))))
-
-
-def index_add(x, index, axis, value, name=None):
-    def impl(v, idx, val, *, axis):
-        vm = jnp.moveaxis(v, axis, 0)
-        valm = jnp.moveaxis(val, axis, 0)
-        out = vm.at[idx].add(valm)
-        return jnp.moveaxis(out, 0, axis)
-
-    return dispatch("index_add", impl, (x, index, value),
-                    dict(axis=int(axis)))
 
 
 def masked_select(x, mask, name=None):
@@ -311,25 +277,6 @@ def put_along_axis(arr, indices, values, axis, reduce="assign",
     values_arg = values if isinstance(values, Tensor) else to_tensor(values)
     return dispatch("put_along_axis", impl, (arr, indices, values_arg),
                     dict(axis=int(axis), reduce=reduce))
-
-
-def sort(x, axis=-1, descending=False, stable=False, name=None):
-    def impl(v, *, axis, desc):
-        out = jnp.sort(v, axis=axis)
-        return jnp.flip(out, axis) if desc else out
-
-    return dispatch("sort", impl, (x,),
-                    dict(axis=int(axis), desc=bool(descending)))
-
-
-def argsort(x, axis=-1, descending=False, stable=False, name=None):
-    def impl(v, *, axis, desc):
-        out = jnp.argsort(v, axis=axis, stable=True)
-        return (jnp.flip(out, axis) if desc else out).astype(jnp.int64)
-
-    return dispatch("argsort", impl, (x,),
-                    dict(axis=int(axis), desc=bool(descending)),
-                    differentiable=False)
 
 
 def topk(x, k, axis=-1, largest=True, sorted=True, name=None):
@@ -566,20 +513,6 @@ def unfold(x, axis, size, step, name=None):
                     dict(axis=int(axis), size=int(size), step=int(step), n=n))
 
 
-def shard_index(input, index_num, nshards, shard_id, ignore_value=-1):
-    def impl(v, *, index_num, nshards, shard_id, ignore_value):
-        size = index_num // nshards
-        lo, hi = shard_id * size, (shard_id + 1) * size
-        ok = (v >= lo) & (v < hi)
-        return jnp.where(ok, v - lo, ignore_value)
-
-    return dispatch("shard_index", impl, (input,),
-                    dict(index_num=int(index_num), nshards=int(nshards),
-                         shard_id=int(shard_id),
-                         ignore_value=int(ignore_value)),
-                    differentiable=False)
-
-
 def searchsorted(sorted_sequence, values, out_int32=False, right=False,
                  name=None):
     def impl(seq, vals, right, out_int32):
@@ -604,18 +537,6 @@ def bucketize(x, sorted_sequence, out_int32=False, right=False,
               name=None):
     return searchsorted(sorted_sequence, x, out_int32=out_int32,
                         right=right)
-
-
-def index_fill(x, index, axis, value, name=None):
-    def impl(v, idx, axis, value):
-        moved = jnp.moveaxis(v, axis, 0)
-        moved = moved.at[idx].set(value)
-        return jnp.moveaxis(moved, 0, axis)
-
-    return dispatch("index_fill", impl, (x, index),
-                    dict(axis=int(axis),
-                         value=float(value) if not isinstance(
-                             value, (list, tuple)) else value))
 
 
 def masked_scatter(x, mask, value, name=None):
@@ -643,16 +564,6 @@ def masked_scatter(x, mask, value, name=None):
         return jnp.where(flat_m, src[take], flat_v).reshape(v.shape)
 
     return dispatch("masked_scatter", impl, (x, mask, value), {})
-
-
-def select_scatter(x, values, axis, index, name=None):
-    def impl(v, src, axis, index):
-        idx = [builtins.slice(None)] * v.ndim  # `slice` op shadows builtin
-        idx[axis] = index
-        return v.at[tuple(idx)].set(src)
-
-    return dispatch("select_scatter", impl, (x, values),
-                    dict(axis=int(axis), index=int(index)))
 
 
 def slice_scatter(x, value, axes, starts, ends, strides, name=None):

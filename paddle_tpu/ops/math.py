@@ -60,6 +60,7 @@ from ._generated import (  # noqa: F401  (sig-kind rows)
     addmm,
     clip,
     copysign,
+    frexp,
     gammaln,
     i0,
     i1,
@@ -72,6 +73,7 @@ from ._generated import (  # noqa: F401  (sig-kind rows)
     isreal,
     kron,
     lerp,
+    logcumsumexp,
     logit,
     nan_to_num,
     nextafter,
@@ -82,7 +84,9 @@ from ._generated import (  # noqa: F401  (sig-kind rows)
     signbit,
     sinc,
     stanh,
+    take,
     trace,
+    trapezoid,
 )
 
 
@@ -148,18 +152,6 @@ def diff(x, n=1, axis=-1, prepend=None, append=None, name=None):
                          has_app=append is not None))
 
 
-def take(x, index, mode="raise", name=None):
-    def impl(v, idx, *, mode):
-        flat = v.reshape(-1)
-        if mode == "wrap":
-            idx = jnp.mod(idx, flat.shape[0])
-        elif mode == "clip":
-            idx = jnp.clip(idx, 0, flat.shape[0] - 1)
-        return flat[idx]
-
-    return dispatch("take", impl, (x, index), dict(mode=mode))
-
-
 # in-place variants
 def add_(x, y, name=None):
     out = add(x, y)
@@ -179,15 +171,6 @@ def multiply_(x, y, name=None):
     return x
 
 
-def trapezoid(y, x=None, dx=None, axis=-1, name=None):
-    def impl(yv, *maybe_x, dx, axis):
-        xv = maybe_x[0] if maybe_x else None
-        return jnp.trapezoid(yv, x=xv, dx=1.0 if dx is None else dx,
-                             axis=axis)
-    args = (y, x) if x is not None else (y,)
-    return dispatch("trapezoid", impl, args, dict(dx=dx, axis=axis))
-
-
 def cumulative_trapezoid(y, x=None, dx=None, axis=-1, name=None):
     def impl(yv, *maybe_x, dx, axis):
         import jax.scipy.integrate as _ji  # noqa: F401  (availability)
@@ -205,21 +188,6 @@ def cumulative_trapezoid(y, x=None, dx=None, axis=-1, name=None):
     args = (y, x) if x is not None else (y,)
     return dispatch("cumulative_trapezoid", impl, args,
                     dict(dx=dx, axis=axis))
-
-
-def logcumsumexp(x, axis=None, dtype=None, name=None):
-    def impl(v, axis, dtype):
-        if dtype is not None:
-            v = v.astype(dtype)
-        if axis is None:
-            v, axis = v.reshape(-1), 0
-        # global-max stabilization: exact in log domain, one pass
-        mx = jnp.max(v, axis=axis, keepdims=True)
-        return jnp.log(jnp.cumsum(jnp.exp(v - mx), axis=axis)) + mx
-
-    return dispatch("logcumsumexp", impl, (x,),
-                    dict(axis=axis, dtype=None if dtype is None
-                         else to_jax_dtype(dtype)))
 
 
 def renorm(x, p, axis, max_norm, name=None):
@@ -261,14 +229,5 @@ def histogramdd(x, bins=10, ranges=None, density=False, weights=None,
     hist, edges = _np.histogramdd(sample, bins=bins, range=ranges,
                                   density=density, weights=w)
     return to_tensor(hist), [to_tensor(e) for e in edges]
-
-
-def frexp(x, name=None):
-    """Mantissa/exponent decomposition: x = m * 2**e, 0.5 <= |m| < 1."""
-    def impl(v):
-        m, e = jnp.frexp(v)
-        return m, e.astype(jnp.int32)
-
-    return dispatch("frexp", impl, (x,), {}, differentiable=False)
 
 

@@ -26,6 +26,8 @@ from ._generated import (  # noqa: F401
     _axis, sum, nansum, mean, nanmean, max, min, prod, all, any,
     count_nonzero)
 from ._generated import (  # noqa: F401  (sig-kind rows)
+    kthvalue,
+    median,
     nanmedian,
     nanquantile,
     std,
@@ -65,29 +67,6 @@ def argmin(x, axis=None, keepdim=False, dtype="int64", name=None):
                     differentiable=False)
 
 
-def median(x, axis=None, keepdim=False, mode="avg", name=None):
-    def impl(v, *, axis, keepdims, mode):
-        if mode == "avg":
-            return jnp.median(v, axis=axis, keepdims=keepdims)
-        # 'min' mode: lower of the two middle values + its index
-        if axis is None:
-            vf = v.reshape(-1)
-            ax = 0
-        else:
-            vf, ax = v, axis
-        n = vf.shape[ax]
-        k = (n - 1) // 2
-        srt = jnp.sort(vf, axis=ax)
-        val = jnp.take(srt, k, axis=ax)
-        if keepdims:
-            val = jnp.expand_dims(val, ax if axis is not None else ())
-        return val
-
-    return dispatch("median", impl, (x,),
-                    dict(axis=None if axis is None else int(axis),
-                         keepdims=bool(keepdim), mode=mode))
-
-
 def mode(x, axis=-1, keepdim=False, name=None):
     arr = np.asarray(x._value)
     ax = int(axis) % arr.ndim
@@ -107,21 +86,6 @@ def mode(x, axis=-1, keepdim=False, name=None):
         indices = np.squeeze(indices, ax)
     from ..core.tensor import to_tensor
     return to_tensor(vals), to_tensor(indices.astype(np.int64))
-
-
-def kthvalue(x, k, axis=-1, keepdim=False, name=None):
-    def impl(v, *, k, axis, keepdims):
-        srt = jnp.sort(v, axis=axis)
-        idxs = jnp.argsort(v, axis=axis, stable=True)
-        val = jnp.take(srt, k - 1, axis=axis)
-        idx = jnp.take(idxs, k - 1, axis=axis)
-        if keepdims:
-            val = jnp.expand_dims(val, axis)
-            idx = jnp.expand_dims(idx, axis)
-        return val, idx.astype(jnp.int64)
-
-    return dispatch("kthvalue", impl, (x,),
-                    dict(k=int(k), axis=int(axis), keepdims=bool(keepdim)))
 
 
 def quantile(x, q, axis=None, keepdim=False, interpolation="linear",

@@ -66,8 +66,10 @@ def _free_names(node: ast.AST, params: set) -> set:
             for d in n.args.defaults + [
                     x for x in n.args.kw_defaults if x]:
                 self.visit(d)
+            bound = params | inner
             for sub_node in body:
-                names.update(_free_names(sub_node, params | inner))
+                names.update(_free_names(sub_node, bound))
+                bound = bound | _bound_names(sub_node)
 
         def visit_Lambda(self, n):
             self._scoped(n, [n.body])
@@ -122,6 +124,28 @@ def _bound_names(stmt):
 
 MAX_PRELUDE = 3
 
+_SHADOWED = None
+
+
+def _generated_shadowed_builtins():
+    """Builtin names that will exist as op bindings in _generated.py
+    (every non-manual yaml api + a safety margin of the current
+    generated file's defs)."""
+    global _SHADOWED
+    if _SHADOWED is None:
+        import re
+        apis = set()
+        for line in (OPS / "ops.yaml").read_text().splitlines():
+            m = re.search(r"api: ([a-z0-9_]+)", line)
+            if m:
+                apis.add(m.group(1))
+        gen = OPS / "_generated.py"
+        if gen.exists():
+            apis |= set(re.findall(r"^def ([a-z0-9_]+)\(",
+                                   gen.read_text(), re.M))
+        _SHADOWED = apis & set(dir(builtins))
+    return _SHADOWED
+
 
 def candidates(path: pathlib.Path):
     src = path.read_text()
@@ -153,12 +177,37 @@ def candidates(path: pathlib.Path):
             continue
         params = {x.arg for x in node.args.args}
         ok = True
+        free_all = set()
+        # signature DEFAULT expressions are copied verbatim into the
+        # generated def and evaluate at import time there — their free
+        # names face the same ALLOWED/shadow constraints as the body
+        for d in node.args.defaults + [
+                x for x in node.args.kw_defaults if x]:
+            free_all |= _free_names(d, set())
+        if free_all - ALLOWED:
+            continue
         for s in prelude_stmts:
-            if _free_names(s, params) - ALLOWED:
+            f = _free_names(s, params)
+            free_all |= f
+            if f - ALLOWED:
                 ok = False
                 break
             params |= _bound_names(s)
-        if not ok or _free_names(ret, params) - ALLOWED:
+        if ok:
+            f = _free_names(ret, params)
+            free_all |= f
+            ok = not (f - ALLOWED)
+        if not ok:
+            continue
+        # builtin-shadow hazard: inside _generated.py, a reference to a
+        # builtin whose name is ALSO a generated op binding (min, max,
+        # abs, sum, ...) resolves to the op, not the builtin — skip
+        # such candidates (they must stay in their home module, where
+        # the op name is not in scope)
+        if free_all & _generated_shadowed_builtins():
+            print(f"skip {path.name}:{node.name} (uses a builtin "
+                  f"shadowed by a generated op: "
+                  f"{sorted(free_all & _generated_shadowed_builtins())})")
             continue
         prelude = "\n".join(_stmt_source(lines, s)
                             for s in prelude_stmts) or None
