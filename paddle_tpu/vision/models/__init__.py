@@ -9,3 +9,6 @@ from .resnet import (ResNet, resnet18, resnet34, resnet50, resnet101,
                      wide_resnet101_2, resnext50_32x4d)
 from .vgg import VGG, vgg11, vgg13, vgg16, vgg19
 from .mobilenet import MobileNetV2, mobilenet_v2
+from .small_nets import (AlexNet, alexnet, SqueezeNet, squeezenet1_0,
+                         squeezenet1_1, MobileNetV1, mobilenet_v1,
+                         ShuffleNetV2, shufflenet_v2_x1_0)

@@ -1,0 +1,248 @@
+"""AlexNet / SqueezeNet / MobileNetV1 / ShuffleNetV2.
+
+Reference parity: `python/paddle/vision/models/{alexnet,squeezenet,
+mobilenetv1,shufflenetv2}.py` [UNVERIFIED — empty reference mount].
+Architectures follow the original papers with Paddle's constructor
+conventions (scale/num_classes/with_pool).
+"""
+from ...nn import (AdaptiveAvgPool2D, BatchNorm2D, Conv2D, Dropout,
+                   Layer, LayerList, Linear, MaxPool2D, ReLU, Sequential)
+from ...nn import functional as F
+from ...ops.manipulation import concat, flatten, reshape, transpose
+
+__all__ = ["AlexNet", "alexnet", "SqueezeNet", "squeezenet1_0",
+           "squeezenet1_1", "MobileNetV1", "mobilenet_v1",
+           "ShuffleNetV2", "shufflenet_v2_x1_0"]
+
+
+class AlexNet(Layer):
+    def __init__(self, num_classes=1000, dropout=0.5):
+        super().__init__()
+        self.features = Sequential(
+            Conv2D(3, 64, 11, stride=4, padding=2), ReLU(),
+            MaxPool2D(3, stride=2),
+            Conv2D(64, 192, 5, padding=2), ReLU(),
+            MaxPool2D(3, stride=2),
+            Conv2D(192, 384, 3, padding=1), ReLU(),
+            Conv2D(384, 256, 3, padding=1), ReLU(),
+            Conv2D(256, 256, 3, padding=1), ReLU(),
+            MaxPool2D(3, stride=2),
+        )
+        self.avgpool = AdaptiveAvgPool2D((6, 6))
+        self.classifier = Sequential(
+            Dropout(dropout), Linear(256 * 6 * 6, 4096), ReLU(),
+            Dropout(dropout), Linear(4096, 4096), ReLU(),
+            Linear(4096, num_classes),
+        )
+
+    def forward(self, x):
+        x = self.avgpool(self.features(x))
+        return self.classifier(flatten(x, 1))
+
+
+def alexnet(pretrained=False, **kwargs):
+    return AlexNet(**kwargs)
+
+
+class _Fire(Layer):
+    def __init__(self, inp, squeeze, e1, e3):
+        super().__init__()
+        self.squeeze = Conv2D(inp, squeeze, 1)
+        self.expand1 = Conv2D(squeeze, e1, 1)
+        self.expand3 = Conv2D(squeeze, e3, 3, padding=1)
+
+    def forward(self, x):
+        x = F.relu(self.squeeze(x))
+        return concat([F.relu(self.expand1(x)),
+                       F.relu(self.expand3(x))], axis=1)
+
+
+class SqueezeNet(Layer):
+    def __init__(self, version="1.0", num_classes=1000, with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        if version == "1.0":
+            self.features = Sequential(
+                Conv2D(3, 96, 7, stride=2), ReLU(),
+                MaxPool2D(3, stride=2, ceil_mode=True),
+                _Fire(96, 16, 64, 64), _Fire(128, 16, 64, 64),
+                _Fire(128, 32, 128, 128),
+                MaxPool2D(3, stride=2, ceil_mode=True),
+                _Fire(256, 32, 128, 128), _Fire(256, 48, 192, 192),
+                _Fire(384, 48, 192, 192), _Fire(384, 64, 256, 256),
+                MaxPool2D(3, stride=2, ceil_mode=True),
+                _Fire(512, 64, 256, 256),
+            )
+        else:
+            self.features = Sequential(
+                Conv2D(3, 64, 3, stride=2), ReLU(),
+                MaxPool2D(3, stride=2, ceil_mode=True),
+                _Fire(64, 16, 64, 64), _Fire(128, 16, 64, 64),
+                MaxPool2D(3, stride=2, ceil_mode=True),
+                _Fire(128, 32, 128, 128), _Fire(256, 32, 128, 128),
+                MaxPool2D(3, stride=2, ceil_mode=True),
+                _Fire(256, 48, 192, 192), _Fire(384, 48, 192, 192),
+                _Fire(384, 64, 256, 256), _Fire(512, 64, 256, 256),
+            )
+        self.classifier = Sequential(
+            Dropout(0.5), Conv2D(512, num_classes, 1), ReLU(),
+            AdaptiveAvgPool2D(1),
+        )
+
+    def forward(self, x):
+        x = self.classifier(self.features(x))
+        return flatten(x, 1)
+
+
+def squeezenet1_0(pretrained=False, **kwargs):
+    return SqueezeNet("1.0", **kwargs)
+
+
+def squeezenet1_1(pretrained=False, **kwargs):
+    return SqueezeNet("1.1", **kwargs)
+
+
+class _DWSep(Sequential):
+    """Depthwise-separable block: dw 3x3 + pw 1x1, BN+ReLU each."""
+
+    def __init__(self, inp, oup, stride):
+        super().__init__(
+            Conv2D(inp, inp, 3, stride=stride, padding=1, groups=inp,
+                   bias_attr=False),
+            BatchNorm2D(inp), ReLU(),
+            Conv2D(inp, oup, 1, bias_attr=False),
+            BatchNorm2D(oup), ReLU(),
+        )
+
+
+class MobileNetV1(Layer):
+    def __init__(self, scale=1.0, num_classes=1000, with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+
+        def c(ch):
+            return max(8, int(ch * scale))
+
+        cfg = [(64, 1), (128, 2), (128, 1), (256, 2), (256, 1),
+               (512, 2)] + [(512, 1)] * 5 + [(1024, 2), (1024, 1)]
+        layers = [Conv2D(3, c(32), 3, stride=2, padding=1,
+                         bias_attr=False),
+                  BatchNorm2D(c(32)), ReLU()]
+        inp = c(32)
+        for oup, stride in cfg:
+            layers.append(_DWSep(inp, c(oup), stride))
+            inp = c(oup)
+        self.features = Sequential(*layers)
+        if with_pool:
+            self.pool = AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.fc = Linear(c(1024), num_classes)
+
+    def forward(self, x):
+        x = self.features(x)
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = self.fc(flatten(x, 1))
+        return x
+
+
+def mobilenet_v1(pretrained=False, scale=1.0, **kwargs):
+    return MobileNetV1(scale=scale, **kwargs)
+
+
+def _channel_shuffle(x, groups):
+    n, c, h, w = x.shape
+    x = reshape(x, [n, groups, c // groups, h, w])
+    x = transpose(x, [0, 2, 1, 3, 4])
+    return reshape(x, [n, c, h, w])
+
+
+class _ShuffleUnit(Layer):
+    def __init__(self, inp, oup, stride):
+        super().__init__()
+        self.stride = stride
+        branch = oup // 2
+        if stride == 2:
+            self.branch1 = Sequential(
+                Conv2D(inp, inp, 3, stride=2, padding=1, groups=inp,
+                       bias_attr=False),
+                BatchNorm2D(inp),
+                Conv2D(inp, branch, 1, bias_attr=False),
+                BatchNorm2D(branch), ReLU(),
+            )
+            b2_in = inp
+        else:
+            self.branch1 = None
+            b2_in = inp // 2
+        self.branch2 = Sequential(
+            Conv2D(b2_in, branch, 1, bias_attr=False),
+            BatchNorm2D(branch), ReLU(),
+            Conv2D(branch, branch, 3, stride=stride, padding=1,
+                   groups=branch, bias_attr=False),
+            BatchNorm2D(branch),
+            Conv2D(branch, branch, 1, bias_attr=False),
+            BatchNorm2D(branch), ReLU(),
+        )
+
+    def forward(self, x):
+        if self.stride == 2:
+            out = concat([self.branch1(x), self.branch2(x)], axis=1)
+        else:
+            half = x.shape[1] // 2
+            x1, x2 = x[:, :half], x[:, half:]
+            out = concat([x1, self.branch2(x2)], axis=1)
+        return _channel_shuffle(out, 2)
+
+
+class ShuffleNetV2(Layer):
+    def __init__(self, scale=1.0, act="relu", num_classes=1000,
+                 with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        stage_out = {0.25: [24, 24, 48, 96, 512],
+                     0.5: [24, 48, 96, 192, 1024],
+                     1.0: [24, 116, 232, 464, 1024],
+                     1.5: [24, 176, 352, 704, 1024],
+                     2.0: [24, 244, 488, 976, 2048]}[scale]
+        self.conv1 = Sequential(
+            Conv2D(3, stage_out[0], 3, stride=2, padding=1,
+                   bias_attr=False),
+            BatchNorm2D(stage_out[0]), ReLU(),
+        )
+        self.maxpool = MaxPool2D(3, stride=2, padding=1)
+        stages = []
+        inp = stage_out[0]
+        for i, repeats in enumerate((4, 8, 4)):
+            oup = stage_out[i + 1]
+            units = [_ShuffleUnit(inp, oup, 2)]
+            units += [_ShuffleUnit(oup, oup, 1)
+                      for _ in range(repeats - 1)]
+            stages.append(Sequential(*units))
+            inp = oup
+        self.stages = LayerList(stages)
+        self.conv5 = Sequential(
+            Conv2D(inp, stage_out[-1], 1, bias_attr=False),
+            BatchNorm2D(stage_out[-1]), ReLU(),
+        )
+        if with_pool:
+            self.pool = AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.fc = Linear(stage_out[-1], num_classes)
+
+    def forward(self, x):
+        x = self.maxpool(self.conv1(x))
+        for stage in self.stages:
+            x = stage(x)
+        x = self.conv5(x)
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = self.fc(flatten(x, 1))
+        return x
+
+
+def shufflenet_v2_x1_0(pretrained=False, **kwargs):
+    return ShuffleNetV2(scale=1.0, **kwargs)

@@ -300,3 +300,34 @@ def test_gpt_scan_layers_parity():
     l_s, g_s = run(True)
     assert abs(l_u - l_s) < 1e-4, (l_u, l_s)
     np.testing.assert_allclose(g_s, g_u, rtol=1e-4, atol=1e-6)
+
+
+def test_small_vision_nets_forward():
+    """AlexNet/SqueezeNet/MobileNetV1/ShuffleNetV2: construct, forward
+    a small batch, sane logits shape + param counts in the expected
+    ballpark of the original architectures."""
+    import numpy as np
+    from paddle_tpu.vision.models import (alexnet, squeezenet1_1,
+                                          mobilenet_v1,
+                                          shufflenet_v2_x1_0)
+
+    x = paddle.to_tensor(np.random.default_rng(0).standard_normal(
+        (1, 3, 64, 64)).astype(np.float32))
+    expected_m = {  # params (millions) from the original papers
+        "alexnet": (alexnet, 61.1),
+        "squeezenet1_1": (squeezenet1_1, 1.24),
+        "mobilenet_v1": (mobilenet_v1, 4.23),
+        "shufflenet_v2_x1_0": (shufflenet_v2_x1_0, 2.28),
+    }
+    for name, (ctor, m_ref) in expected_m.items():
+        paddle.seed(0)
+        net = ctor(num_classes=10)
+        net.eval()
+        out = net(x)
+        assert list(out.shape) == [1, 10], (name, out.shape)
+        n = sum(int(np.prod(p.shape)) for p in net.parameters())
+        # classifier shrinks with num_classes=10; allow wide tolerance
+        full = sum(int(np.prod(p.shape))
+                   for p in ctor(num_classes=1000).parameters())
+        assert abs(full / 1e6 - m_ref) / m_ref < 0.08, (
+            name, full / 1e6, m_ref)
