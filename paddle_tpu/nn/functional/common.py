@@ -207,7 +207,7 @@ def interpolate(x, size=None, scale_factor=None, mode="nearest",
              "trilinear": "linear", "linear": "linear", "bicubic": "cubic",
              "area": "linear"}[mode.lower()]
 
-    def impl(v, *, out_sp, jmode, cf, align):
+    def impl(v, *, out_sp, jmode, cf, align, mode1):
         if cf:  # channels-first -> resize spatial dims only
             target = v.shape[:2] + tuple(out_sp)
         else:
@@ -217,15 +217,24 @@ def interpolate(x, size=None, scale_factor=None, mode="nearest",
         if align:
             # align_corners resize: linear interp with endpoint alignment
             return _resize_align_corners(v, target, cf)
+        if mode1:
+            # paddle align_mode=1: src = dst*scale (jax.image.resize
+            # implements only the align_mode=0 half-pixel convention)
+            return _resize_align_mode1(v, target, cf)
         return jax.image.resize(v, target, method=jmode)
 
     return dispatch("interpolate", impl, (x,),
                     dict(out_sp=tuple(out_sp), jmode=jmode,
                          cf=data_format.startswith("NC"),
-                         align=bool(align_corners) and jmode == "linear"))
+                         align=bool(align_corners) and jmode == "linear",
+                         mode1=(int(align_mode) == 1
+                                and not align_corners
+                                and jmode == "linear")))
 
 
-def _resize_align_corners(v, target, cf):
+def _resize_linear_by_pos(v, target, cf, pos_of):
+    """Separable linear resize; ``pos_of(n_in, n_out)`` maps output
+    indices to fractional source positions."""
     sp_axes = range(2, v.ndim) if cf else range(1, v.ndim - 1)
     out = v
     for ax in sp_axes:
@@ -237,7 +246,7 @@ def _resize_align_corners(v, target, cf):
             idx_hi = idx_lo
             w = jnp.zeros((1,), v.dtype)
         else:
-            pos = jnp.linspace(0.0, n_in - 1.0, n_out)
+            pos = jnp.clip(pos_of(n_in, n_out), 0.0, n_in - 1.0)
             idx_lo = jnp.floor(pos).astype(jnp.int32)
             idx_hi = jnp.minimum(idx_lo + 1, n_in - 1)
             w = (pos - idx_lo).astype(v.dtype)
@@ -249,6 +258,19 @@ def _resize_align_corners(v, target, cf):
         out = lo * (1 - w) + hi * w
         v = out
     return out
+
+
+def _resize_align_corners(v, target, cf):
+    return _resize_linear_by_pos(
+        v, target, cf,
+        lambda n_in, n_out: jnp.linspace(0.0, n_in - 1.0, n_out))
+
+
+def _resize_align_mode1(v, target, cf):
+    """paddle align_mode=1 (align_corners False): src = dst * scale."""
+    return _resize_linear_by_pos(
+        v, target, cf,
+        lambda n_in, n_out: jnp.arange(n_out) * (n_in / n_out))
 
 
 def upsample(x, size=None, scale_factor=None, mode="nearest",

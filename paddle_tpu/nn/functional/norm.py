@@ -144,24 +144,52 @@ def batch_norm(x, running_mean, running_var, weight=None, bias=None,
 def instance_norm(x, running_mean=None, running_var=None, weight=None,
                   bias=None, use_input_stats=True, momentum=0.9,
                   epsilon=1e-05, data_format="NCHW", name=None):
-    def impl(v, *wb, eps, has_w, has_b):
-        axes = tuple(range(2, v.ndim))
-        mean = jnp.mean(v, axis=axes, keepdims=True)
-        var = jnp.var(v, axis=axes, keepdims=True)
+    """Channels-last formats normalize over their own spatial axes (they
+    were silently treated as channels-first); use_input_stats=False
+    normalizes with the provided running statistics (per paddle; the
+    running stats are not updated here — InstanceNorm layers don't
+    track them by default)."""
+    if not use_input_stats and (running_mean is None
+                                or running_var is None):
+        raise ValueError(
+            "instance_norm(use_input_stats=False) requires both "
+            "running_mean and running_var")
+    use_running = not use_input_stats
+
+    def impl(v, *rest, eps, has_w, has_b, cl, use_running):
+        if cl:
+            v = jnp.moveaxis(v, -1, 1)
+        i = 0
+        if use_running:
+            shape = [1, v.shape[1]] + [1] * (v.ndim - 2)
+            mean = rest[i].reshape(shape).astype(v.dtype)
+            var = rest[i + 1].reshape(shape).astype(v.dtype)
+            i += 2
+        else:
+            axes = tuple(range(2, v.ndim))
+            vf = v.astype(jnp.float32)  # f32 accumulation for bf16/f16
+            mean = jnp.mean(vf, axis=axes, keepdims=True).astype(v.dtype)
+            var = jnp.var(vf, axis=axes, keepdims=True).astype(v.dtype)
         out = (v - mean) * jax.lax.rsqrt(var + eps)
         shape = [1, v.shape[1]] + [1] * (v.ndim - 2)
-        i = 0
         if has_w:
-            out = out * wb[i].reshape(shape)
+            out = out * rest[i].reshape(shape)
             i += 1
         if has_b:
-            out = out + wb[i].reshape(shape)
+            out = out + rest[i].reshape(shape)
+        if cl:
+            out = jnp.moveaxis(out, 1, -1)
         return out
 
-    args = (x,) + tuple(t for t in (weight, bias) if t is not None)
+    args = (x,)
+    if use_running:
+        args += (running_mean, running_var)
+    args += tuple(t for t in (weight, bias) if t is not None)
     return dispatch("instance_norm", impl, args,
                     dict(eps=float(epsilon), has_w=weight is not None,
-                         has_b=bias is not None))
+                         has_b=bias is not None,
+                         cl=not data_format.startswith("NC"),
+                         use_running=use_running))
 
 
 def group_norm(x, num_groups, epsilon=1e-05, weight=None, bias=None,

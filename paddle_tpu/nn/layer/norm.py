@@ -188,6 +188,7 @@ class _InstanceNormBase(Layer):
                  name=None):
         super().__init__()
         self._epsilon = epsilon
+        self._data_format = data_format
         if weight_attr is False:
             self.weight = None
         else:
@@ -203,7 +204,8 @@ class _InstanceNormBase(Layer):
 
     def forward(self, x):
         return F.instance_norm(x, weight=self.weight, bias=self.bias,
-                               epsilon=self._epsilon)
+                               epsilon=self._epsilon,
+                               data_format=self._data_format)
 
 
 class InstanceNorm1D(_InstanceNormBase):
