@@ -223,13 +223,16 @@ def interpolate(x, size=None, scale_factor=None, mode="nearest",
             return _resize_align_mode1(v, target, cf)
         return jax.image.resize(v, target, method=jmode)
 
+    # align flags apply to the LINEAR family only (paddle ignores them
+    # for area/nearest, which also map to jmode 'linear'/'nearest')
+    linear_family = mode.lower() in ("linear", "bilinear", "trilinear")
     return dispatch("interpolate", impl, (x,),
                     dict(out_sp=tuple(out_sp), jmode=jmode,
                          cf=data_format.startswith("NC"),
-                         align=bool(align_corners) and jmode == "linear",
+                         align=bool(align_corners) and linear_family,
                          mode1=(int(align_mode) == 1
                                 and not align_corners
-                                and jmode == "linear")))
+                                and linear_family)))
 
 
 def _resize_linear_by_pos(v, target, cf, pos_of):

@@ -392,7 +392,8 @@ def soft_margin_loss(input, label, reduction="mean", name=None):
 def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
              reduction="mean", norm_by_times=False):
     """CTC via dynamic-programming in pure JAX (replaces warpctc)."""
-    def impl(lp, lab, in_len, lab_len, *, blank, reduction):
+    def impl(lp, lab, in_len, lab_len, *, blank, reduction,
+             norm_by_times):
         # lp: [T, B, C] logits (paddle convention); normalize
         lp = jax.nn.log_softmax(lp, axis=-1)
         T, B, C = lp.shape
@@ -441,6 +442,12 @@ def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
             jnp.take_along_axis(alpha, idx_last[:, None], axis=1)[:, 0],
             jnp.take_along_axis(alpha, idx_prev[:, None], axis=1)[:, 0])
         loss = -ll
+        if norm_by_times:
+            # paddle/warpctc: normalize the GRADIENTS by the number of
+            # time steps — the forward loss value stays unchanged
+            # (forward(a - a/T + a/T) == a; grad flows only via a/T)
+            t = jnp.maximum(in_len.astype(loss.dtype), 1.0)
+            loss = jax.lax.stop_gradient(loss - loss / t) + loss / t
         if reduction == "mean":
             return jnp.mean(loss / jnp.maximum(lab_len, 1))
         if reduction == "sum":
@@ -449,7 +456,8 @@ def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
 
     return dispatch("ctc_loss", impl,
                     (log_probs, labels, input_lengths, label_lengths),
-                    dict(blank=int(blank), reduction=reduction))
+                    dict(blank=int(blank), reduction=reduction,
+                         norm_by_times=bool(norm_by_times)))
 
 
 def huber_loss(input, label, delta=1.0, reduction="mean", name=None):

@@ -160,8 +160,8 @@ def instance_norm(x, running_mean=None, running_var=None, weight=None,
         if cl:
             v = jnp.moveaxis(v, -1, 1)
         i = 0
+        shape = [1, v.shape[1]] + [1] * (v.ndim - 2)
         if use_running:
-            shape = [1, v.shape[1]] + [1] * (v.ndim - 2)
             mean = rest[i].reshape(shape).astype(v.dtype)
             var = rest[i + 1].reshape(shape).astype(v.dtype)
             i += 2
@@ -171,7 +171,6 @@ def instance_norm(x, running_mean=None, running_var=None, weight=None,
             mean = jnp.mean(vf, axis=axes, keepdims=True).astype(v.dtype)
             var = jnp.var(vf, axis=axes, keepdims=True).astype(v.dtype)
         out = (v - mean) * jax.lax.rsqrt(var + eps)
-        shape = [1, v.shape[1]] + [1] * (v.ndim - 2)
         if has_w:
             out = out * rest[i].reshape(shape)
             i += 1
@@ -225,22 +224,26 @@ def group_norm(x, num_groups, epsilon=1e-05, weight=None, bias=None,
 
 def local_response_norm(x, size, alpha=1e-4, beta=0.75, k=1.0,
                         data_format="NCHW", name=None):
-    def impl(v, *, size, alpha, beta, k):
+    def impl(v, *, size, alpha, beta, k, caxis):
+        ch = caxis % v.ndim
         sq = jnp.square(v)
         half = size // 2
         pad_width = [(0, 0)] * v.ndim
-        pad_width[1] = (half, size - 1 - half)
+        pad_width[ch] = (half, size - 1 - half)
         padded = jnp.pad(sq, pad_width)
         acc = jnp.zeros_like(v)
         for i in range(size):
             acc = acc + jax.lax.slice_in_dim(
-                padded, i, i + v.shape[1], axis=1)
+                padded, i, i + v.shape[ch], axis=ch)
         div = jnp.power(k + alpha * acc / size, beta)
         return v / div
 
+    # channels-last formats normalize across their LAST axis (it was
+    # silently always axis 1)
+    caxis = 1 if data_format.startswith("NC") else -1
     return dispatch("lrn", impl, (x,),
                     dict(size=int(size), alpha=float(alpha),
-                         beta=float(beta), k=float(k)))
+                         beta=float(beta), k=float(k), caxis=caxis))
 
 
 def normalize(x, p=2, axis=1, epsilon=1e-12, name=None):
