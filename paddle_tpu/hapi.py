@@ -121,7 +121,8 @@ class Model:
                  num_workers=0, callbacks=None, num_iters=None):
         from .callbacks import CallbackList
         loader = eval_data if isinstance(eval_data, DataLoader) else \
-            DataLoader(eval_data, batch_size=batch_size)
+            DataLoader(eval_data, batch_size=batch_size,
+                       num_workers=num_workers)
         cbs = CallbackList(callbacks, model=self, params=None)
         self.network.eval()
         for m in self._metrics:
@@ -147,7 +148,8 @@ class Model:
     def predict(self, test_data, batch_size=1, num_workers=0,
                 stack_outputs=False, callbacks=None, verbose=1):
         loader = test_data if isinstance(test_data, DataLoader) else \
-            DataLoader(test_data, batch_size=batch_size)
+            DataLoader(test_data, batch_size=batch_size,
+                       num_workers=num_workers)
         self.network.eval()
         outs = []
         from .core.autograd import no_grad
@@ -156,6 +158,13 @@ class Model:
                 inputs = batch[0] if isinstance(batch, (list, tuple)) else \
                     batch
                 outs.append(self.network(inputs))
+        if stack_outputs and outs:
+            # paddle: concatenate the per-batch outputs along batch dim
+            from .ops.manipulation import concat
+            if isinstance(outs[0], (list, tuple)):
+                return [concat([o[i] for o in outs], axis=0)
+                        for i in range(len(outs[0]))]
+            return concat(outs, axis=0)
         return outs
 
     def save(self, path, training=True):

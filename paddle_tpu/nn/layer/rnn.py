@@ -117,9 +117,12 @@ class _RNNBase(Layer):
                 shapes = [[gate * hidden_size, in_sz],
                           [gate * hidden_size, hidden_size],
                           [gate * hidden_size], [gate * hidden_size]]
-                for n, s in zip(names, shapes):
+                attrs = [weight_ih_attr, weight_hh_attr, bias_ih_attr,
+                         bias_hh_attr]
+                for n, s, a in zip(names, shapes, attrs):
                     p = self.create_parameter(
-                        shape=s, default_initializer=I.Uniform(-std, std))
+                        shape=s, attr=a,
+                        default_initializer=I.Uniform(-std, std))
                     self.add_parameter(n, p)
                     self._weight_names.append(n)
 
