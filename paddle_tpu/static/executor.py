@@ -127,6 +127,12 @@ class Executor:
     def run(self, program=None, feed=None, fetch_list=None,
             feed_var_name="feed", fetch_var_name="fetch", scope=None,
             return_numpy=True, use_program_cache=True):
+        if isinstance(program, CompiledProgram):
+            program = program._program
+        from .io import _LoadedInferenceProgram
+        if isinstance(program, _LoadedInferenceProgram):
+            return program.run(feed or {}, fetch_list,
+                               return_numpy=return_numpy)
         call, fetch_list = self._prologue(program, feed, fetch_list, 1)
         if call is None:
             return [None for _ in fetch_list]
@@ -311,6 +317,13 @@ class Executor:
         step counter advances per iteration in-graph.
         """
         assert n_iters >= 1
+        if isinstance(program, CompiledProgram):
+            program = program._program
+        from .io import _LoadedInferenceProgram
+        if isinstance(program, _LoadedInferenceProgram):
+            raise TypeError(
+                "run_steps needs a training Program; a loaded inference "
+                "program carries no train state to loop over")
         call, fetch_list = self._prologue(program, feed, fetch_list,
                                           n_iters)
         if call is None:

@@ -28,8 +28,21 @@ def _export_program(program, feed_vars, fetch_vars):
     from .framework import Variable
 
     block = program.global_block()
+    # backward slice to the fetch targets (the reference's
+    # prune_backward/inference-program pruning): ops feeding only an
+    # unfetched head (e.g. the training loss, which needs a `labels`
+    # feed the inference signature doesn't have) are dropped
+    needed = {v.name for v in fetch_vars}
+    ops = []
+    for op in reversed(block.ops):
+        if any(o.name in needed for o in op.outputs):
+            ops.append(op)
+            needed.update(i.name for i in op.inputs
+                          if isinstance(i, Variable))
+    ops.reverse()
+
     captured, seen = [], set()
-    for op in block.ops:
+    for op in ops:
         for i in op.inputs:
             if not isinstance(i, Variable) and id(i) not in seen:
                 seen.add(id(i))
@@ -42,7 +55,7 @@ def _export_program(program, feed_vars, fetch_vars):
         from .executor import run_program_ops
         env = {v.name: x for v, x in zip(feed_vars, feed_vals)}
         smap = {id(t): x for t, x in zip(captured, state_vals)}
-        run_program_ops(block.ops, env, lambda i: smap[id(i)])
+        run_program_ops(ops, env, lambda i: smap[id(i)])
         return tuple(env[v.name] for v in fetch_vars)
 
     state_avals = tuple(
@@ -100,12 +113,51 @@ def save_inference_model(path_prefix, feed_vars, fetch_vars, executor,
         os.remove(path_prefix + ".pdexec")
 
 
+class _LoadedInferenceProgram:
+    """Runnable inference program returned by load_inference_model (the
+    reference's deserialized `inference_program` role): wraps a
+    predictor over the exported StableHLO blob; Executor.run recognizes
+    it and feeds/fetches by name."""
+
+    def __init__(self, path_prefix, meta):
+        self._prefix = path_prefix
+        self._meta = meta
+        self._predictor = None
+
+    def _pred(self):
+        if self._predictor is None:
+            from ..inference import Config, create_predictor
+            self._predictor = create_predictor(Config(
+                self._prefix + ".pdmodel", self._prefix + ".pdiparams"))
+        return self._predictor
+
+    def run(self, feed, fetch_list, return_numpy=True):
+        pred = self._pred()
+        for name in pred.get_input_names():
+            pred.get_input_handle(name).copy_from_cpu(
+                np.asarray(feed[name]))
+        pred.run()
+        wanted = [getattr(f, "name", f) for f in (fetch_list or
+                                                  self._meta["fetch_names"])]
+        outs = []
+        from ..core.tensor import Tensor
+        for name in wanted:
+            arr = np.asarray(pred.get_output_handle(name).copy_to_cpu())
+            outs.append(arr if return_numpy
+                        else Tensor(arr, _internal=True))
+        return outs
+
+
 def load_inference_model(path_prefix, executor, **kwargs):
+    """Returns the reference-parity triple
+    ``[inference_program, feed_names, fetch_names]``; run it with
+    ``exe.run(program, feed={name: array}, fetch_list=...)``."""
     with open(path_prefix + ".pdmodel", "rb") as f:
         meta = pickle.load(f)
-    with open(path_prefix + ".pdiparams", "rb") as f:
-        params = pickle.load(f)
-    return [meta, meta["feed_names"], meta["fetch_names"], params]
+    # weights load lazily inside the predictor on first run — reading
+    # .pdiparams here would deserialize them twice
+    prog = _LoadedInferenceProgram(path_prefix, meta)
+    return [prog, list(meta["feed_names"]), list(meta["fetch_names"])]
 
 
 def save(program, model_path, **kwargs):

@@ -94,3 +94,61 @@ def test_checkpoint_resume_continues_training(tmp_path):
     opt2.set_state_dict(paddle.load(str(tmp_path / "m.pdopt")))
     got = [step(net2, opt2) for _ in range(2)]
     np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-6)
+
+
+def test_static_amp_dropout_train_eval_export(tmp_path):
+    """Round-5 capstone: BERT-tiny MLM pretraining the way the bench
+    does it — static graph + AMP bf16 + REAL dropout + the fused
+    run_steps loop — then eval through a for_test clone (dropout off,
+    deterministic) and export/reload the encoder for inference."""
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu import optimizer, static
+    from paddle_tpu.models import BertConfig, BertForMaskedLM
+
+    cfg = BertConfig(vocab_size=64, hidden_size=32, num_hidden_layers=2,
+                     num_attention_heads=2, intermediate_size=64,
+                     max_position_embeddings=16)
+    B, S = 4, 8
+    paddle.enable_static()
+    try:
+        paddle.seed(0)
+        main = static.Program()
+        startup = static.Program()
+        with static.program_guard(main, startup):
+            ids = static.data("ids", [B, S], "int64")
+            labels = static.data("labels", [B, S], "int64")
+            model = BertForMaskedLM(cfg)
+            with paddle.amp.auto_cast(dtype="bfloat16", level="O1"):
+                loss, logits = model(ids, labels=labels)
+        test_prog = main.clone(for_test=True)  # BEFORE minimize
+        with static.program_guard(main, startup):
+            opt = optimizer.AdamW(learning_rate=1e-3,
+                                  parameters=model.parameters())
+            opt.minimize(loss)
+
+        exe = static.Executor()
+        rng = np.random.default_rng(0)
+        x = rng.integers(0, cfg.vocab_size, (B, S)).astype(np.int64)
+        fd = {"ids": x, "labels": x}
+        (l0,) = exe.run_steps(1, main, feed=fd, fetch_list=[loss])
+        (l1,) = exe.run_steps(8, main, feed=fd, fetch_list=[loss])
+        assert float(l1) < float(l0), (float(l0), float(l1))
+
+        # eval clone: dropout off => deterministic, and independent of
+        # the training program's rng draw
+        (e1,) = exe.run(test_prog, feed=fd, fetch_list=[loss])
+        (e2,) = exe.run(test_prog, feed=fd, fetch_list=[loss])
+        np.testing.assert_allclose(np.asarray(e1), np.asarray(e2),
+                                   rtol=1e-6)
+
+        # export the eval forward and reload it without the class
+        static.save_inference_model(str(tmp_path / "bert"), [ids],
+                                    [logits], exe, program=test_prog)
+        [prog2, feeds2, fetches2] = static.load_inference_model(
+            str(tmp_path / "bert"), exe)
+        (out,) = exe.run(prog2, feed={feeds2[0]: x},
+                         fetch_list=fetches2)
+        assert np.asarray(out).shape == (B, S, cfg.vocab_size)
+    finally:
+        paddle.disable_static()
