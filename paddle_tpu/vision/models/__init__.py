@@ -12,3 +12,6 @@ from .mobilenet import MobileNetV2, mobilenet_v2
 from .small_nets import (AlexNet, alexnet, SqueezeNet, squeezenet1_0,
                          squeezenet1_1, MobileNetV1, mobilenet_v1,
                          ShuffleNetV2, shufflenet_v2_x1_0)
+from .densenet_googlenet import (DenseNet, densenet121, densenet161,
+                                 densenet169, densenet201, GoogLeNet,
+                                 googlenet)

@@ -1,0 +1,186 @@
+"""DenseNet + GoogLeNet.
+
+Reference parity: `python/paddle/vision/models/{densenet,googlenet}.py`
+[UNVERIFIED — empty reference mount].  Architectures follow the
+original papers (DenseNet-BC growth/transition; GoogLeNet a la
+Inception-v1 with optional aux heads).
+"""
+from ...nn import (AdaptiveAvgPool2D, AvgPool2D, BatchNorm2D, Conv2D,
+                   Dropout, Layer, LayerList, Linear, MaxPool2D, ReLU,
+                   Sequential)
+from ...nn import functional as F
+from ...ops.manipulation import concat, flatten
+
+__all__ = ["DenseNet", "densenet121", "densenet161", "densenet169",
+           "densenet201", "GoogLeNet", "googlenet"]
+
+_DENSE_CFG = {
+    121: (64, 32, (6, 12, 24, 16)),
+    161: (96, 48, (6, 12, 36, 24)),
+    169: (64, 32, (6, 12, 32, 32)),
+    201: (64, 32, (6, 12, 48, 32)),
+}
+
+
+class _DenseLayer(Layer):
+    def __init__(self, inp, growth, bn_size=4, drop=0.0):
+        super().__init__()
+        self.norm1 = BatchNorm2D(inp)
+        self.conv1 = Conv2D(inp, bn_size * growth, 1, bias_attr=False)
+        self.norm2 = BatchNorm2D(bn_size * growth)
+        self.conv2 = Conv2D(bn_size * growth, growth, 3, padding=1,
+                            bias_attr=False)
+        self.drop = drop
+
+    def forward(self, x):
+        out = self.conv1(F.relu(self.norm1(x)))
+        out = self.conv2(F.relu(self.norm2(out)))
+        if self.drop > 0 and self.training:
+            out = F.dropout(out, self.drop)
+        return concat([x, out], axis=1)
+
+
+class _Transition(Sequential):
+    def __init__(self, inp, oup):
+        super().__init__(BatchNorm2D(inp), ReLU(),
+                         Conv2D(inp, oup, 1, bias_attr=False),
+                         AvgPool2D(2, stride=2))
+
+
+class DenseNet(Layer):
+    def __init__(self, layers=121, bn_size=4, dropout=0.0,
+                 num_classes=1000, with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        init_f, growth, blocks = _DENSE_CFG[layers]
+        feats = [Conv2D(3, init_f, 7, stride=2, padding=3,
+                        bias_attr=False),
+                 BatchNorm2D(init_f), ReLU(),
+                 MaxPool2D(3, stride=2, padding=1)]
+        ch = init_f
+        for i, n in enumerate(blocks):
+            for _ in range(n):
+                feats.append(_DenseLayer(ch, growth, bn_size, dropout))
+                ch += growth
+            if i != len(blocks) - 1:
+                feats.append(_Transition(ch, ch // 2))
+                ch //= 2
+        feats += [BatchNorm2D(ch), ReLU()]
+        self.features = Sequential(*feats)
+        if with_pool:
+            self.pool = AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.classifier = Linear(ch, num_classes)
+
+    def forward(self, x):
+        x = self.features(x)
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = self.classifier(flatten(x, 1))
+        return x
+
+
+def densenet121(pretrained=False, **kw):
+    return DenseNet(121, **kw)
+
+
+def densenet161(pretrained=False, **kw):
+    return DenseNet(161, **kw)
+
+
+def densenet169(pretrained=False, **kw):
+    return DenseNet(169, **kw)
+
+
+def densenet201(pretrained=False, **kw):
+    return DenseNet(201, **kw)
+
+
+class _BasicConv(Sequential):
+    def __init__(self, inp, oup, kernel, **kw):
+        super().__init__(Conv2D(inp, oup, kernel, bias_attr=False, **kw),
+                         BatchNorm2D(oup), ReLU())
+
+
+class _Inception(Layer):
+    def __init__(self, inp, c1, c3r, c3, c5r, c5, pp):
+        super().__init__()
+        self.b1 = _BasicConv(inp, c1, 1)
+        self.b2 = Sequential(_BasicConv(inp, c3r, 1),
+                             _BasicConv(c3r, c3, 3, padding=1))
+        self.b3 = Sequential(_BasicConv(inp, c5r, 1),
+                             _BasicConv(c5r, c5, 5, padding=2))
+        self.b4 = Sequential(MaxPool2D(3, stride=1, padding=1),
+                             _BasicConv(inp, pp, 1))
+
+    def forward(self, x):
+        return concat([self.b1(x), self.b2(x), self.b3(x), self.b4(x)],
+                      axis=1)
+
+
+class GoogLeNet(Layer):
+    """Inception v1; returns (out, aux1, aux2) like the reference —
+    aux heads are active in train mode."""
+
+    def __init__(self, num_classes=1000, with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        self.pre = Sequential(
+            _BasicConv(3, 64, 7, stride=2, padding=3),
+            MaxPool2D(3, stride=2, ceil_mode=True),
+            _BasicConv(64, 64, 1),
+            _BasicConv(64, 192, 3, padding=1),
+            MaxPool2D(3, stride=2, ceil_mode=True),
+        )
+        self.i3a = _Inception(192, 64, 96, 128, 16, 32, 32)
+        self.i3b = _Inception(256, 128, 128, 192, 32, 96, 64)
+        self.pool3 = MaxPool2D(3, stride=2, ceil_mode=True)
+        self.i4a = _Inception(480, 192, 96, 208, 16, 48, 64)
+        self.i4b = _Inception(512, 160, 112, 224, 24, 64, 64)
+        self.i4c = _Inception(512, 128, 128, 256, 24, 64, 64)
+        self.i4d = _Inception(512, 112, 144, 288, 32, 64, 64)
+        self.i4e = _Inception(528, 256, 160, 320, 32, 128, 128)
+        self.pool4 = MaxPool2D(3, stride=2, ceil_mode=True)
+        self.i5a = _Inception(832, 256, 160, 320, 32, 128, 128)
+        self.i5b = _Inception(832, 384, 192, 384, 48, 128, 128)
+
+        def aux(inp):
+            return Sequential(
+                AdaptiveAvgPool2D(4), _BasicConv(inp, 128, 1))
+
+        self.aux1_conv = aux(512)
+        self.aux1_fc = Sequential(Linear(128 * 16, 1024), ReLU(),
+                                  Dropout(0.7),
+                                  Linear(1024, num_classes))
+        self.aux2_conv = aux(528)
+        self.aux2_fc = Sequential(Linear(128 * 16, 1024), ReLU(),
+                                  Dropout(0.7),
+                                  Linear(1024, num_classes))
+        if with_pool:
+            self.pool5 = AdaptiveAvgPool2D(1)
+        self.dropout = Dropout(0.2)
+        if num_classes > 0:
+            self.fc = Linear(1024, num_classes)
+
+    def forward(self, x):
+        x = self.i3b(self.i3a(self.pre(x)))
+        x = self.i4a(self.pool3(x))
+        aux1 = (self.aux1_fc(flatten(self.aux1_conv(x), 1))
+                if self.training and self.num_classes > 0 else None)
+        x = self.i4d(self.i4c(self.i4b(x)))
+        aux2 = (self.aux2_fc(flatten(self.aux2_conv(x), 1))
+                if self.training and self.num_classes > 0 else None)
+        x = self.pool4(self.i4e(x))
+        x = self.i5b(self.i5a(x))
+        if self.with_pool:
+            x = self.pool5(x)
+        if self.num_classes > 0:
+            x = self.fc(self.dropout(flatten(x, 1)))
+        return x, aux1, aux2
+
+
+def googlenet(pretrained=False, **kw):
+    return GoogLeNet(**kw)

@@ -331,3 +331,31 @@ def test_small_vision_nets_forward():
                    for p in ctor(num_classes=1000).parameters())
         assert abs(full / 1e6 - m_ref) / m_ref < 0.08, (
             name, full / 1e6, m_ref)
+
+
+def test_densenet_googlenet_forward():
+    import numpy as np
+    from paddle_tpu.vision.models import densenet121, googlenet
+
+    x = paddle.to_tensor(np.random.default_rng(0).standard_normal(
+        (1, 3, 64, 64)).astype(np.float32))
+    paddle.seed(0)
+    d = densenet121(num_classes=10)
+    d.eval()
+    out = d(x)
+    assert list(out.shape) == [1, 10]
+    full = sum(int(np.prod(p.shape))
+               for p in densenet121(num_classes=1000).parameters())
+    assert abs(full / 1e6 - 7.98) / 7.98 < 0.08, full / 1e6
+
+    paddle.seed(0)
+    g = googlenet(num_classes=10)
+    out, a1, a2 = g(x)  # train mode: aux heads active
+    assert list(out.shape) == [1, 10]
+    assert a1 is not None and list(a1.shape) == [1, 10]
+    g.eval()
+    out, a1, a2 = g(x)
+    assert a1 is None and a2 is None
+    gfull = sum(int(np.prod(p.shape))
+                for p in googlenet(num_classes=1000).parameters())
+    assert abs(gfull / 1e6 - 13.37) / 13.37 < 0.25, gfull / 1e6
