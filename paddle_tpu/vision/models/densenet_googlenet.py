@@ -6,7 +6,7 @@ original papers (DenseNet-BC growth/transition; GoogLeNet a la
 Inception-v1 with optional aux heads).
 """
 from ...nn import (AdaptiveAvgPool2D, AvgPool2D, BatchNorm2D, Conv2D,
-                   Dropout, Layer, LayerList, Linear, MaxPool2D, ReLU,
+                   Dropout, Layer, Linear, MaxPool2D, ReLU,
                    Sequential)
 from ...nn import functional as F
 from ...ops.manipulation import concat, flatten
@@ -151,14 +151,15 @@ class GoogLeNet(Layer):
             return Sequential(
                 AdaptiveAvgPool2D(4), _BasicConv(inp, 128, 1))
 
-        self.aux1_conv = aux(512)
-        self.aux1_fc = Sequential(Linear(128 * 16, 1024), ReLU(),
-                                  Dropout(0.7),
-                                  Linear(1024, num_classes))
-        self.aux2_conv = aux(528)
-        self.aux2_fc = Sequential(Linear(128 * 16, 1024), ReLU(),
-                                  Dropout(0.7),
-                                  Linear(1024, num_classes))
+        if num_classes > 0:  # aux heads can never run without classes
+            self.aux1_conv = aux(512)
+            self.aux1_fc = Sequential(Linear(128 * 16, 1024), ReLU(),
+                                      Dropout(0.7),
+                                      Linear(1024, num_classes))
+            self.aux2_conv = aux(528)
+            self.aux2_fc = Sequential(Linear(128 * 16, 1024), ReLU(),
+                                      Dropout(0.7),
+                                      Linear(1024, num_classes))
         if with_pool:
             self.pool5 = AdaptiveAvgPool2D(1)
         self.dropout = Dropout(0.2)

@@ -12,7 +12,10 @@ import numpy as np
 
 __all__ = ["Compose", "ToTensor", "Normalize", "Resize", "CenterCrop",
            "RandomCrop", "RandomHorizontalFlip", "RandomVerticalFlip",
-           "Transpose", "BrightnessTransform", "Pad", "RandomResizedCrop",
+           "Transpose", "BrightnessTransform", "ContrastTransform",
+           "SaturationTransform", "HueTransform", "ColorJitter",
+           "Grayscale", "RandomRotation", "RandomRotate",
+           "RandomErasing", "Pad", "RandomResizedCrop",
            "to_tensor", "normalize", "resize", "hflip", "vflip"]
 
 
@@ -159,14 +162,38 @@ class RandomCrop:
 
 
 class RandomResizedCrop:
+    """Crop a random area/aspect region, then resize (the reference's
+    train-time augmentation; a dead `if False` used to make this a
+    plain resize with no crop at all)."""
+
     def __init__(self, size, scale=(0.08, 1.0), ratio=(3 / 4, 4 / 3),
                  interpolation="bilinear", keys=None):
         self.size = (size, size) if isinstance(size, int) else size
         self.scale = scale
+        self.ratio = ratio
+        self.interpolation = interpolation
 
     def __call__(self, img):
-        return resize(RandomCrop(self.size)(img) if False else img,
-                      self.size)
+        arr = np.asarray(img)
+        chw = arr.ndim == 3 and arr.shape[0] in (1, 3, 4)
+        h_axis = 1 if chw else 0
+        h, w = arr.shape[h_axis], arr.shape[h_axis + 1]
+        for _ in range(10):
+            area = h * w * np.random.uniform(*self.scale)
+            ratio = np.exp(np.random.uniform(np.log(self.ratio[0]),
+                                             np.log(self.ratio[1])))
+            ch_ = int(round(np.sqrt(area / ratio)))
+            cw_ = int(round(np.sqrt(area * ratio)))
+            if 0 < ch_ <= h and 0 < cw_ <= w:
+                i = np.random.randint(0, h - ch_ + 1)
+                j = np.random.randint(0, w - cw_ + 1)
+                break
+        else:  # central fallback (torchvision behavior)
+            ch_ = cw_ = min(h, w)
+            i, j = (h - ch_) // 2, (w - cw_) // 2
+        crop = (arr[:, i:i + ch_, j:j + cw_] if chw
+                else arr[i:i + ch_, j:j + cw_])
+        return resize(crop, self.size, self.interpolation)
 
 
 def hflip(img):
@@ -237,3 +264,187 @@ class Pad:
             return np.pad(arr, ((t, b), (l, r), (0, 0)),
                           constant_values=self.fill)
         return np.pad(arr, ((t, b), (l, r)), constant_values=self.fill)
+
+
+def _img_max(arr):
+    return 255.0 if np.asarray(arr).max() > 1.5 else 1.0
+
+
+def _jitter_factor(value):
+    # reference samples from [max(0, 1-v), 1+v] — never negative
+    return np.random.uniform(max(0.0, 1.0 - value), 1.0 + value)
+
+
+def _rgb_caxis(arr):
+    """Channel axis if arr is a real multi-channel image, else None."""
+    if arr.ndim != 3:
+        return None
+    if arr.shape[0] in (3, 4):
+        return 0
+    if arr.shape[-1] in (3, 4):
+        return -1
+    return None
+
+
+class ContrastTransform:
+    def __init__(self, value, keys=None):
+        self.value = value
+
+    def __call__(self, img):
+        arr = np.asarray(img, np.float32)
+        factor = _jitter_factor(self.value)
+        mean = arr.mean()
+        return np.clip(mean + (arr - mean) * factor, 0, _img_max(arr))
+
+
+class SaturationTransform:
+    def __init__(self, value, keys=None):
+        self.value = value
+
+    def __call__(self, img):
+        arr = np.asarray(img, np.float32)
+        caxis = _rgb_caxis(arr)
+        if caxis is None:
+            return arr  # saturation is a no-op on grayscale
+        factor = _jitter_factor(self.value)
+        gray = arr.mean(axis=caxis, keepdims=True)
+        return np.clip(gray + (arr - gray) * factor, 0, _img_max(arr))
+
+
+class HueTransform:
+    """Hue shift by rotating RGB channels toward their mean (cheap
+    approximation of an HSV hue rotation; value in [-0.5, 0.5])."""
+
+    def __init__(self, value, keys=None):
+        self.value = value
+
+    def __call__(self, img):
+        arr = np.asarray(img, np.float32)
+        caxis = _rgb_caxis(arr)
+        if caxis is None:
+            return arr  # hue is a no-op on grayscale
+        shift = np.random.uniform(-self.value, self.value)
+        rolled = np.roll(arr, 1, axis=caxis)
+        return np.clip(arr + shift * (rolled - arr), 0, _img_max(arr))
+
+
+class ColorJitter:
+    """Apply brightness/contrast/saturation/hue jitter in random order
+    (reference transform)."""
+
+    def __init__(self, brightness=0, contrast=0, saturation=0, hue=0,
+                 keys=None):
+        self._ts = []
+        if brightness:
+            self._ts.append(BrightnessTransform(brightness))
+        if contrast:
+            self._ts.append(ContrastTransform(contrast))
+        if saturation:
+            self._ts.append(SaturationTransform(saturation))
+        if hue:
+            self._ts.append(HueTransform(hue))
+
+    def __call__(self, img):
+        for i in np.random.permutation(len(self._ts)):
+            img = self._ts[int(i)](img)
+        return np.asarray(img, np.float32)
+
+
+class Grayscale:
+    def __init__(self, num_output_channels=1, keys=None):
+        self.n = num_output_channels
+
+    def __call__(self, img):
+        arr = np.asarray(img, np.float32)
+        if arr.ndim == 2:
+            gray = arr[..., None] if self.n > 1 else arr
+            return np.repeat(gray, self.n, -1) if self.n > 1 else gray
+        caxis = _rgb_caxis(arr)
+        if caxis is None:
+            # already single-channel: repeat/squeeze to n channels
+            ch = 0 if arr.shape[0] == 1 else -1
+            gray = arr
+            return (np.repeat(gray, self.n, axis=ch) if self.n > 1
+                    else gray)
+        w = np.array([0.299, 0.587, 0.114], np.float32)
+        if caxis == 0:
+            gray = np.tensordot(w, arr[:3], axes=1)[None]
+        else:
+            gray = np.tensordot(arr[..., :3], w, axes=1)[..., None]
+        return np.repeat(gray, self.n, axis=caxis) if self.n > 1 else gray
+
+
+class RandomRotation:
+    """Rotate by a random angle (nearest-neighbor resample, constant
+    fill — the reference's default interpolation)."""
+
+    def __init__(self, degrees, fill=0, keys=None):
+        if isinstance(degrees, numbers.Number):
+            degrees = (-abs(degrees), abs(degrees))
+        self.degrees = tuple(degrees)
+        self.fill = fill
+
+    def __call__(self, img):
+        arr = np.asarray(img, np.float32)
+        deg = np.random.uniform(*self.degrees)
+        rad = np.deg2rad(deg)
+        chw = arr.ndim == 3 and arr.shape[0] in (1, 3, 4)
+        h_ax = 1 if chw else 0
+        h, w = arr.shape[h_ax], arr.shape[h_ax + 1]
+        cy, cx = (h - 1) / 2.0, (w - 1) / 2.0
+        yy, xx = np.meshgrid(np.arange(h), np.arange(w), indexing="ij")
+        c, s = np.cos(rad), np.sin(rad)
+        # inverse map: output pixel -> source location
+        sy = c * (yy - cy) + s * (xx - cx) + cy
+        sx = -s * (yy - cy) + c * (xx - cx) + cx
+        iy = np.round(sy).astype(np.int64)
+        ix = np.round(sx).astype(np.int64)
+        valid = (iy >= 0) & (iy < h) & (ix >= 0) & (ix < w)
+        iy, ix = iy.clip(0, h - 1), ix.clip(0, w - 1)
+        if chw:
+            out = arr[:, iy, ix]
+            out = np.where(valid[None], out, np.float32(self.fill))
+        else:
+            out = arr[iy, ix]
+            mask = valid if arr.ndim == 2 else valid[..., None]
+            out = np.where(mask, out, np.float32(self.fill))
+        return out
+
+
+class RandomErasing:
+    """Erase a random rectangle (reference defaults: p=0.5, scale
+    (0.02, 0.33), ratio (0.3, 3.3), zero fill)."""
+
+    def __init__(self, prob=0.5, scale=(0.02, 0.33), ratio=(0.3, 3.3),
+                 value=0, keys=None):
+        self.prob = prob
+        self.scale = scale
+        self.ratio = ratio
+        self.value = value
+
+    def __call__(self, img):
+        arr = np.asarray(img, np.float32).copy()
+        if np.random.rand() >= self.prob:
+            return arr
+        chw = arr.ndim == 3 and arr.shape[0] in (1, 3, 4)
+        h_ax = 1 if chw else 0
+        h, w = arr.shape[h_ax], arr.shape[h_ax + 1]
+        for _ in range(10):
+            area = h * w * np.random.uniform(*self.scale)
+            ratio = np.exp(np.random.uniform(np.log(self.ratio[0]),
+                                             np.log(self.ratio[1])))
+            eh = int(round(np.sqrt(area * ratio)))
+            ew = int(round(np.sqrt(area / ratio)))
+            if eh < h and ew < w and eh > 0 and ew > 0:
+                y = np.random.randint(0, h - eh + 1)
+                x = np.random.randint(0, w - ew + 1)
+                if chw:
+                    arr[:, y:y + eh, x:x + ew] = self.value
+                else:
+                    arr[y:y + eh, x:x + ew] = self.value
+                break
+        return arr
+
+
+class RandomRotate(RandomRotation):
+    pass
