@@ -49,12 +49,30 @@ def _cell_step(mode, x_t, state, wi, wh, bi, bh):
     return (h,), h
 
 
+def _reverse_sequence(x, lens):
+    """Reverse [T, B, ...] within each sequence's valid region (the
+    reference's sequence-aware reversal for the backward direction)."""
+    T = x.shape[0]
+    t = jnp.arange(T)[:, None]
+    idx = jnp.where(t < lens[None, :], lens[None, :] - 1 - t, t)
+    idx = idx.reshape(idx.shape + (1,) * (x.ndim - 2))
+    return jnp.take_along_axis(x, jnp.broadcast_to(idx, x.shape), axis=0)
+
+
 def _run_rnn(mode, x, init_states, weights, num_layers, bidirect,
-             time_major, dropout, training):
+             time_major, dropout, training, lens=None):
     """x: [B, T, I] (or [T, B, I] if time_major).  weights: flat list per
-    (layer, direction): wi, wh, bi, bh."""
+    (layer, direction): wi, wh, bi, bh.  ``lens`` ([B] int): variable
+    sequence lengths — states freeze past each sequence's end (so the
+    returned final state is the state AT the end, not at T), padded
+    outputs are zero, and the backward direction runs over the
+    within-length reversal."""
     if not time_major:
         x = jnp.swapaxes(x, 0, 1)  # [T, B, I]
+    T = x.shape[0]
+    valid = None
+    if lens is not None:
+        valid = (jnp.arange(T)[:, None] < lens[None, :])  # [T, B]
     ndir = 2 if bidirect else 1
     out = x
     finals_h, finals_c = [], []
@@ -68,15 +86,33 @@ def _run_rnn(mode, x, init_states, weights, num_layers, bidirect,
                 st = (init_states[0][sidx], init_states[1][sidx])
             else:
                 st = (init_states[0][sidx],)
-            seq = out if d == 0 else jnp.flip(out, 0)
+            if d == 0:
+                seq = out
+            elif lens is None:
+                seq = jnp.flip(out, 0)
+            else:
+                seq = _reverse_sequence(out, lens)
 
             def step(carry, x_t):
-                new_state, y = _cell_step(mode, x_t, carry, wi, wh, bi, bh)
+                if valid is None:
+                    new_state, y = _cell_step(mode, x_t, carry, wi, wh,
+                                              bi, bh)
+                    return new_state, y
+                x_t, v = x_t
+                new_state, y = _cell_step(mode, x_t, carry, wi, wh,
+                                          bi, bh)
+                v = v[:, None]
+                new_state = tuple(
+                    jnp.where(v, ns, c)
+                    for ns, c in zip(new_state, carry))
+                y = jnp.where(v, y, jnp.zeros((), y.dtype))
                 return new_state, y
 
-            final, ys = jax.lax.scan(step, st, seq)
+            xs = seq if valid is None else (seq, valid)
+            final, ys = jax.lax.scan(step, st, xs)
             if d == 1:
-                ys = jnp.flip(ys, 0)
+                ys = (jnp.flip(ys, 0) if lens is None
+                      else _reverse_sequence(ys, lens))
             dir_outs.append(ys)
             finals_h.append(final[0])
             if mode == "LSTM":
@@ -147,15 +183,21 @@ class _RNNBase(Layer):
         mode, nl, bd, tm = self.mode, self.num_layers, self.bidirect, \
             self.time_major
 
-        def impl(x, *arrs, mode, nl, bd, tm):
+        def impl(x, *arrs, mode, nl, bd, tm, has_len):
             n_states = 2 if mode == "LSTM" else 1
             states = arrs[:n_states]
-            ws = arrs[n_states:]
-            return _run_rnn(mode, x, states, ws, nl, bd, tm, 0.0, False)
+            lens = arrs[n_states] if has_len else None
+            ws = arrs[n_states + (1 if has_len else 0):]
+            return _run_rnn(mode, x, states, ws, nl, bd, tm, 0.0, False,
+                            lens=lens)
 
-        args = (inputs,) + tuple(initial_states) + tuple(weights)
+        args = (inputs,) + tuple(initial_states)
+        if sequence_length is not None:
+            args += (sequence_length,)
+        args += tuple(weights)
         out = dispatch("rnn", impl, args,
-                       dict(mode=mode, nl=nl, bd=bd, tm=tm))
+                       dict(mode=mode, nl=nl, bd=bd, tm=tm,
+                            has_len=sequence_length is not None))
         if self.mode == "LSTM":
             y, h, c = out
             return y, (h, c)
@@ -303,6 +345,21 @@ class GRUCell(RNNCellBase):
         return y, h
 
 
+def _zero_states(states):
+    if isinstance(states, (tuple, list)):
+        return type(states)(_zero_states(s) for s in states)
+    return states * 0
+
+
+def _mask_states(keep, new, old):
+    """where(keep, new, old) over a state pytree (Tensor or nest)."""
+    from ...ops.manipulation import where
+    if isinstance(new, (tuple, list)):
+        return type(new)(_mask_states(keep, n, o)
+                        for n, o in zip(new, old))
+    return where(keep.unsqueeze(-1), new, old)
+
+
 class RNN(Layer):
     def __init__(self, cell, is_reverse=False, time_major=False):
         super().__init__()
@@ -313,14 +370,31 @@ class RNN(Layer):
     def forward(self, inputs, initial_states=None, sequence_length=None):
         t_axis = 0 if self.time_major else 1
         steps = inputs.shape[t_axis]
-        from ...ops.manipulation import unbind, stack
+        from ...ops.manipulation import unbind, stack, where
         xs = unbind(inputs, t_axis)
+        order = range(steps)
         if self.is_reverse:
             xs = xs[::-1]
+            order = range(steps - 1, -1, -1)
         states = initial_states
         outs = []
-        for x in xs:
-            y, states = self.cell(x, states)
+        for t, x in zip(order, xs):
+            y, new_states = self.cell(x, states)
+            if sequence_length is not None and states is None:
+                # masking needs a concrete carry to freeze from: the
+                # cell's own default initial state is zeros, in ITS
+                # structure and dtype (LSTM cells carry (h, c))
+                states = _zero_states(new_states)
+            if sequence_length is not None:
+                # freeze state and zero output past each sequence's end
+                # (for the reverse direction the padding comes FIRST in
+                # processing order, so freezing the carry there makes
+                # the pass start from the sequence's true last token)
+                keep = sequence_length > t          # [B] bool
+                y = where(keep.unsqueeze(-1), y, y * 0)
+                states = _mask_states(keep, new_states, states)
+            else:
+                states = new_states
             outs.append(y)
         if self.is_reverse:
             outs = outs[::-1]
@@ -338,8 +412,10 @@ class BiRNN(Layer):
         from ...ops.manipulation import concat
         st_fw, st_bw = (initial_states if initial_states is not None
                         else (None, None))
-        y_fw, s_fw = self.rnn_fw(inputs, st_fw)
-        y_bw, s_bw = self.rnn_bw(inputs, st_bw)
+        y_fw, s_fw = self.rnn_fw(inputs, st_fw,
+                                 sequence_length=sequence_length)
+        y_bw, s_bw = self.rnn_bw(inputs, st_bw,
+                                 sequence_length=sequence_length)
         return concat([y_fw, y_bw], -1), (s_fw, s_bw)
 
 

@@ -470,3 +470,83 @@ def test_transformer_encoder_incremental_cache():
         outs.append(out.numpy())
     np.testing.assert_allclose(np.concatenate(outs, axis=1), full,
                                rtol=1e-4, atol=1e-5)
+
+
+def test_rnn_sequence_length_matches_torch_packed():
+    """LSTM/GRU with sequence_length: bidirectional outputs match
+    torch's pack_padded_sequence reference exactly (state freezing +
+    within-length reversal)."""
+    import numpy as np
+    import torch
+    import paddle_tpu as paddle
+    from paddle_tpu import nn
+
+    rng = np.random.default_rng(0)
+    B, T, I, H = 3, 6, 4, 5
+    x = rng.standard_normal((B, T, I)).astype(np.float32)
+    lens = np.array([6, 3, 5], np.int64)
+    for pcls, tcls in [(nn.LSTM, torch.nn.LSTM), (nn.GRU, torch.nn.GRU)]:
+        paddle.seed(0)
+        pl = pcls(I, H, direction="bidirect")
+        th = tcls(I, H, batch_first=True, bidirectional=True)
+        tsd = th.state_dict()
+        ours = dict(pl.named_parameters())
+        for k in tsd:
+            tsd[k] = torch.tensor(ours[k].numpy())
+        th.load_state_dict(tsd)
+        y, _ = pl(paddle.to_tensor(x),
+                  sequence_length=paddle.to_tensor(lens))
+        packed = torch.nn.utils.rnn.pack_padded_sequence(
+            torch.tensor(x), torch.tensor(lens), batch_first=True,
+            enforce_sorted=False)
+        ty, _ = th(packed)
+        ty, _ = torch.nn.utils.rnn.pad_packed_sequence(
+            ty, batch_first=True, total_length=T)
+        np.testing.assert_allclose(y.numpy(), ty.detach().numpy(),
+                                   rtol=1e-4, atol=1e-5)
+
+
+def test_rnn_cell_wrapper_sequence_length():
+    """The generic RNN(cell) wrapper freezes states and zeroes outputs
+    past each sequence's end; final state == state at the true end."""
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu import nn
+
+    rng = np.random.default_rng(1)
+    B, T, I, H = 2, 5, 3, 4
+    x = rng.standard_normal((B, T, I)).astype(np.float32)
+    lens = np.array([5, 3], np.int64)
+    paddle.seed(2)
+    cell = nn.GRUCell(I, H)
+    rnn = nn.RNN(cell)
+    y, h = rnn(paddle.to_tensor(x),
+               sequence_length=paddle.to_tensor(lens))
+    # padded outputs are zero
+    np.testing.assert_allclose(y.numpy()[1, 3:], 0.0)
+    # final state of seq 1 == running only its valid prefix
+    y2, h2 = rnn(paddle.to_tensor(x[1:, :3]))
+    np.testing.assert_allclose(h.numpy()[1], h2.numpy()[0],
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_rnn_cell_wrapper_lstm_sequence_length():
+    """LSTM cells carry (h, c): the masked wrapper must freeze the
+    tuple structure (the zeros carry follows the cell's own shape)."""
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu import nn
+
+    rng = np.random.default_rng(3)
+    x = rng.standard_normal((2, 5, 3)).astype(np.float32)
+    lens = np.array([5, 2], np.int64)
+    paddle.seed(4)
+    rnn = nn.RNN(nn.LSTMCell(3, 4))
+    y, (h, c) = rnn(paddle.to_tensor(x),
+                    sequence_length=paddle.to_tensor(lens))
+    np.testing.assert_allclose(y.numpy()[1, 2:], 0.0)
+    y2, (h2, c2) = rnn(paddle.to_tensor(x[1:, :2]))
+    np.testing.assert_allclose(h.numpy()[1], h2.numpy()[0],
+                               rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(c.numpy()[1], c2.numpy()[0],
+                               rtol=1e-5, atol=1e-6)
