@@ -395,6 +395,9 @@ def one_hot(x, num_classes, name=None):
 
 
 def embedding(x, weight, padding_idx=None, sparse=False, name=None):
+    # `sparse` is accepted for parity and runs DENSE by design: sparse
+    # gradients are a GPU scatter optimization; XLA's fused
+    # scatter-add makes the dense path the fast one on TPU.
     # eager bounds check: jnp.take clamps out-of-range ids SILENTLY
     # (garbage lookups, NaN losses downstream); the reference raises.
     # Concrete HOST-side ids only — traced ids follow XLA clamp

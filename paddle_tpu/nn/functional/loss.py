@@ -541,7 +541,9 @@ def hsigmoid_loss(input, label, num_classes, weight, bias=None,
                   path_table=None, path_code=None, is_sparse=False,
                   name=None):
     """Hierarchical sigmoid loss over the default complete binary tree
-    (word2vec-style hierarchical softmax).  Leaf l sits at heap node
+    (word2vec-style hierarchical softmax).  `is_sparse` is accepted for
+    parity and runs dense by design (sparse grads are a GPU scatter
+    optimization; XLA fuses the dense scatter-add).  Leaf l sits at heap node
     l + num_classes; the path to the root visits internal nodes
     idx // 2 with left/right codes idx % 2; internal node n uses
     weight[n - 1].  Custom trees ride path_table/path_code (per-sample
