@@ -550,3 +550,53 @@ def test_rnn_cell_wrapper_lstm_sequence_length():
                                rtol=1e-5, atol=1e-6)
     np.testing.assert_allclose(c.numpy()[1], c2.numpy()[0],
                                rtol=1e-5, atol=1e-6)
+
+
+def test_loss_parity_vs_torch():
+    """Five-loss numerics audit against torch: kl_div, margin_ranking,
+    smooth_l1, cosine_embedding, cross_entropy with label smoothing."""
+    import numpy as np
+    import torch
+    import torch.nn.functional as TF
+    import paddle_tpu as paddle
+    import paddle_tpu.nn.functional as F
+
+    rng = np.random.default_rng(0)
+    a = rng.standard_normal((4, 5)).astype(np.float32)
+    b = rng.standard_normal((4, 5)).astype(np.float32)
+    lp = np.log(np.abs(a) + 0.1).astype(np.float32)
+    tgt = (np.abs(b) / np.abs(b).sum(1, keepdims=True)).astype(np.float32)
+    np.testing.assert_allclose(
+        F.kl_div(paddle.to_tensor(lp), paddle.to_tensor(tgt),
+                 reduction="mean").numpy(),
+        TF.kl_div(torch.tensor(lp), torch.tensor(tgt),
+                  reduction="mean").numpy(), rtol=1e-5, atol=1e-6)
+    lab = np.sign(rng.standard_normal(4)).astype(np.float32)
+    np.testing.assert_allclose(
+        F.margin_ranking_loss(paddle.to_tensor(a[:, 0]),
+                              paddle.to_tensor(a[:, 1]),
+                              paddle.to_tensor(lab), margin=0.3).numpy(),
+        TF.margin_ranking_loss(torch.tensor(a[:, 0]),
+                               torch.tensor(a[:, 1]),
+                               torch.tensor(lab), margin=0.3).numpy(),
+        rtol=1e-5)
+    np.testing.assert_allclose(
+        F.smooth_l1_loss(paddle.to_tensor(a), paddle.to_tensor(b)).numpy(),
+        TF.smooth_l1_loss(torch.tensor(a), torch.tensor(b)).numpy(),
+        rtol=1e-5)
+    v1 = rng.standard_normal((4, 6)).astype(np.float32)
+    v2 = rng.standard_normal((4, 6)).astype(np.float32)
+    y = np.array([1, -1, 1, -1], np.float32)
+    np.testing.assert_allclose(
+        F.cosine_embedding_loss(paddle.to_tensor(v1), paddle.to_tensor(v2),
+                                paddle.to_tensor(y), margin=0.2).numpy(),
+        TF.cosine_embedding_loss(torch.tensor(v1), torch.tensor(v2),
+                                 torch.tensor(y), margin=0.2).numpy(),
+        rtol=1e-5)
+    logits = rng.standard_normal((6, 10)).astype(np.float32)
+    labels = rng.integers(0, 10, 6).astype(np.int64)
+    np.testing.assert_allclose(
+        F.cross_entropy(paddle.to_tensor(logits), paddle.to_tensor(labels),
+                        label_smoothing=0.1).numpy(),
+        TF.cross_entropy(torch.tensor(logits), torch.tensor(labels),
+                         label_smoothing=0.1).numpy(), rtol=1e-5)
