@@ -1,0 +1,112 @@
+"""paddle.signal: stft / istft.
+
+Reference parity: `python/paddle/signal.py` [UNVERIFIED — empty
+reference mount].  Pure-jnp framing + (r)fft; istft reconstructs by
+overlap-add with squared-window COLA normalization (torch-verified in
+tests/test_distribution_fft.py).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .core.dispatch import dispatch
+from .core.tensor import Tensor
+
+__all__ = ["stft", "istft"]
+
+
+def _frame(v, frame_length, hop):
+    n_frames = 1 + (v.shape[-1] - frame_length) // hop
+    starts = jnp.arange(n_frames) * hop
+    idx = starts[:, None] + jnp.arange(frame_length)[None, :]
+    return v[..., idx]  # [..., n_frames, frame_length]
+
+
+def stft(x, n_fft, hop_length=None, win_length=None, window=None,
+         center=True, pad_mode="reflect", normalized=False,
+         onesided=True, name=None):
+    """Returns [..., n_fft//2+1 (or n_fft), n_frames] complex frames —
+    paddle/torch layout (freq before time)."""
+    hop_length = hop_length or n_fft // 4
+    win_length = win_length or n_fft
+
+    def impl(v, *w, n_fft, hop, win_length, center, pad_mode,
+             normalized, onesided):
+        wdt = (v.real.dtype if jnp.iscomplexobj(v) else v.dtype)
+        win = (w[0].astype(wdt) if w
+               else jnp.ones((win_length,), wdt))
+        if win.shape[-1] < n_fft:  # center-pad the window to n_fft
+            lp = (n_fft - win.shape[-1]) // 2
+            win = jnp.pad(win, (lp, n_fft - win.shape[-1] - lp))
+        if center:
+            pad = [(0, 0)] * (v.ndim - 1) + [(n_fft // 2, n_fft // 2)]
+            v = jnp.pad(v, pad, mode=pad_mode)
+        frames = _frame(v, n_fft, hop) * win
+        spec = (jnp.fft.rfft(frames, axis=-1) if onesided
+                else jnp.fft.fft(frames, axis=-1))
+        if normalized:
+            spec = spec / jnp.sqrt(jnp.asarray(n_fft, jnp.float32))
+        return jnp.swapaxes(spec, -1, -2)  # [..., freq, time]
+
+    args = (x,) + ((window,) if window is not None else ())
+    return dispatch("stft", impl, args,
+                    dict(n_fft=int(n_fft), hop=int(hop_length),
+                         win_length=int(win_length), center=bool(center),
+                         pad_mode=pad_mode, normalized=bool(normalized),
+                         onesided=bool(onesided)))
+
+
+def istft(x, n_fft, hop_length=None, win_length=None, window=None,
+          center=True, normalized=False, onesided=True, length=None,
+          return_complex=False, name=None):
+    if return_complex and onesided:
+        raise ValueError(
+            "istft(return_complex=True) requires onesided=False — a "
+            "onesided spectrum reconstructs a real signal")
+    hop_length = hop_length or n_fft // 4
+    win_length = win_length or n_fft
+
+    def impl(spec, *w, n_fft, hop, win_length, center, normalized,
+             onesided, length, return_complex):
+        spec = jnp.swapaxes(spec, -1, -2)  # [..., time, freq]
+        if normalized:
+            spec = spec * jnp.sqrt(jnp.asarray(n_fft, jnp.float32))
+        frames = (jnp.fft.irfft(spec, n=n_fft, axis=-1) if onesided
+                  else jnp.fft.ifft(spec, axis=-1))
+        if not return_complex and not onesided:
+            frames = frames.real
+        win = (w[0].astype(frames.real.dtype) if w
+               else jnp.ones((win_length,), frames.real.dtype))
+        if win.shape[-1] < n_fft:
+            lp = (n_fft - win.shape[-1]) // 2
+            win = jnp.pad(win, (lp, n_fft - win.shape[-1] - lp))
+        frames = frames * win
+        n_frames = frames.shape[-2]
+        out_len = n_fft + hop * (n_frames - 1)
+        # overlap-add via scatter-add over frame positions
+        idx = (jnp.arange(n_frames)[:, None] * hop
+               + jnp.arange(n_fft)[None, :]).reshape(-1)
+        flat = frames.reshape(frames.shape[:-2] + (-1,))
+        out = jnp.zeros(frames.shape[:-2] + (out_len,), flat.dtype)
+        out = out.at[..., idx].add(flat)
+        # squared-window COLA normalization
+        wsq = jnp.zeros((out_len,), win.dtype)
+        wsq = wsq.at[idx].add(jnp.tile(win * win, n_frames))
+        out = out / jnp.maximum(wsq, 1e-11)
+        if center:
+            out = out[..., n_fft // 2:]
+            if length is None:
+                out = out[..., :out.shape[-1] - n_fft // 2]
+        if length is not None:
+            out = out[..., :length]
+        return out
+
+    args = (x,) + ((window,) if window is not None else ())
+    return dispatch("istft", impl, args,
+                    dict(n_fft=int(n_fft), hop=int(hop_length),
+                         win_length=int(win_length), center=bool(center),
+                         normalized=bool(normalized),
+                         onesided=bool(onesided),
+                         length=None if length is None else int(length),
+                         return_complex=bool(return_complex)))

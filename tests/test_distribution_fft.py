@@ -143,3 +143,27 @@ def test_fft_name_kwarg():
     x = paddle.to_tensor(np.ones(8, np.float32))
     fft.fft(x, name="n")  # reference signature accepts name=
     fft.fftn(x, name="n")
+
+
+def test_signal_stft_istft_roundtrip_vs_torch():
+    """paddle.signal.stft/istft match torch and reconstruct the input
+    (COLA overlap-add with squared-window normalization)."""
+    import numpy as np
+    import torch
+    import paddle_tpu as paddle
+
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((2, 400)).astype(np.float32)
+    win = np.hanning(200).astype(np.float32)
+    got = paddle.signal.stft(paddle.to_tensor(x), n_fft=200,
+                             hop_length=100,
+                             window=paddle.to_tensor(win)).numpy()
+    ref = torch.stft(torch.tensor(x), n_fft=200, hop_length=100,
+                     window=torch.tensor(win),
+                     return_complex=True).numpy()
+    np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-4)
+    rec = paddle.signal.istft(paddle.to_tensor(got), n_fft=200,
+                              hop_length=100,
+                              window=paddle.to_tensor(win),
+                              length=400).numpy()
+    np.testing.assert_allclose(rec, x, rtol=1e-3, atol=1e-4)
