@@ -43,6 +43,7 @@ __all__ = [
     "triangular_solve", "cholesky_solve", "lstsq", "lu", "multi_dot",
     "cross", "histogram", "bincount", "einsum", "corrcoef", "cov",
     "householder_product", "matrix_exp", "vecdot", "vander", "pca_lowrank",
+    "vector_norm", "matrix_norm", "svdvals", "ormqr",
     "lu_unpack",
 ]
 
@@ -261,3 +262,48 @@ def pca_lowrank(x, q=None, center=True, niter=2, name=None):
     q = q or min(6, x.shape[-2], x.shape[-1])
     return dispatch("pca_lowrank", impl, (x,),
                     dict(q=int(q), center=bool(center)))
+
+
+def vector_norm(x, p=2.0, axis=None, keepdim=False, name=None):
+    from ._helpers import _axis as _ax
+    return dispatch(
+        "vector_norm",
+        lambda v, *, p, axis, keepdims: jnp.linalg.vector_norm(
+            v, ord=p, axis=axis, keepdims=keepdims),
+        (x,), dict(p=float(p) if p not in (float("inf"), -float("inf"))
+                   else p,
+                   axis=_ax(axis), keepdims=bool(keepdim)))
+
+
+def matrix_norm(x, p="fro", axis=(-2, -1), keepdim=False, name=None):
+    return dispatch(
+        "matrix_norm",
+        lambda v, *, p, keepdims: jnp.linalg.matrix_norm(
+            v, ord=p, keepdims=keepdims),
+        (x,), dict(p=p if isinstance(p, str) else float(p),
+                   keepdims=bool(keepdim)))
+
+
+def svdvals(x, name=None):
+    return dispatch("svdvals", jnp.linalg.svdvals, (x,), {})
+
+
+def ormqr(x, tau, other, left=True, transpose=False, name=None):
+    """Multiply `other` by the Q of a householder (geqrf) factorization
+    (reference: torch/paddle ormqr). Q is materialized via
+    householder_product — O(m^2 k) like the reference's blocked apply."""
+    def impl(a, t, y, *, left, transpose):
+        m, k = a.shape[-2], t.shape[-1]
+        if k < m:
+            # the FULL m x m Q: pad with zero reflectors (tau=0 ==
+            # identity) so all m columns materialize
+            pad_a = [(0, 0)] * (a.ndim - 1) + [(0, m - a.shape[-1])]
+            pad_t = [(0, 0)] * (t.ndim - 1) + [(0, m - k)]
+            a = jnp.pad(a, pad_a)
+            t = jnp.pad(t, pad_t)
+        q = jax.lax.linalg.householder_product(a, t)
+        qm = jnp.swapaxes(q, -1, -2) if transpose else q
+        return jnp.matmul(qm, y) if left else jnp.matmul(y, qm)
+
+    return dispatch("ormqr", impl, (x, tau, other),
+                    dict(left=bool(left), transpose=bool(transpose)))

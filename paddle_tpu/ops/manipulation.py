@@ -66,6 +66,7 @@ __all__ = [
     "swapaxes", "as_strided", "view", "view_as", "tensordot", "atleast_1d",
     "atleast_2d", "atleast_3d", "tolist", "flatten_", "unfold",
     "shard_index", "tensor_split", "hsplit", "vsplit", "dsplit",
+    "as_complex", "as_real",
     "diagonal", "searchsorted", "bucketize", "index_fill", "masked_scatter", "select_scatter", "slice_scatter", "column_stack", "row_stack",
 ]
 
@@ -594,3 +595,18 @@ def unflatten(x, axis, shape, name=None):
     ax = axis if axis >= 0 else len(new_shape) + axis
     new_shape[ax:ax + 1] = shape
     return reshape(x, new_shape)
+
+
+def as_complex(x, name=None):
+    """[..., 2] real pairs -> complex (paddle.as_complex)."""
+    return dispatch(
+        "as_complex",
+        lambda v: jax.lax.complex(v[..., 0], v[..., 1]), (x,), {})
+
+
+def as_real(x, name=None):
+    """complex -> [..., 2] real pairs (paddle.as_real)."""
+    return dispatch(
+        "as_real",
+        lambda v: jnp.stack([jnp.real(v), jnp.imag(v)], axis=-1),
+        (x,), {})

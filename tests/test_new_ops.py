@@ -127,3 +127,38 @@ def test_lu_unpack_batched():
     P, L, U = linalg.lu_unpack(lu, piv)
     rec = np.einsum("bij,bjk,bkl->bil", P.numpy(), L.numpy(), U.numpy())
     np.testing.assert_allclose(rec, a, atol=1e-4)
+
+
+def test_linalg_norms_svdvals_ormqr_as_complex():
+    """matrix/vector_norm, svdvals, ormqr (full-Q apply), and
+    as_complex/as_real round trip — torch-verified."""
+    import numpy as np
+    import torch
+    import paddle_tpu as paddle
+
+    rng = np.random.default_rng(0)
+    a = rng.standard_normal((3, 4, 5)).astype(np.float32)
+    np.testing.assert_allclose(
+        paddle.linalg.vector_norm(paddle.to_tensor(a), p=3,
+                                  axis=-1).numpy(),
+        torch.linalg.vector_norm(torch.tensor(a), ord=3, dim=-1).numpy(),
+        rtol=1e-5)
+    np.testing.assert_allclose(
+        paddle.linalg.matrix_norm(paddle.to_tensor(a), p="fro").numpy(),
+        torch.linalg.matrix_norm(torch.tensor(a)).numpy(), rtol=1e-5)
+    np.testing.assert_allclose(
+        paddle.linalg.svdvals(paddle.to_tensor(a)).numpy(),
+        torch.linalg.svdvals(torch.tensor(a)).numpy(),
+        rtol=1e-4, atol=1e-5)
+    m = rng.standard_normal((5, 3)).astype(np.float32)
+    y = rng.standard_normal((5, 2)).astype(np.float32)
+    tq, ttau = torch.geqrf(torch.tensor(m))
+    np.testing.assert_allclose(
+        paddle.linalg.ormqr(paddle.to_tensor(tq.numpy()),
+                            paddle.to_tensor(ttau.numpy()),
+                            paddle.to_tensor(y)).numpy(),
+        torch.ormqr(tq, ttau, torch.tensor(y)).numpy(),
+        rtol=1e-4, atol=1e-5)
+    c = paddle.as_complex(paddle.to_tensor(a[..., :2].copy()))
+    np.testing.assert_allclose(paddle.as_real(c).numpy(), a[..., :2],
+                               rtol=1e-6)
