@@ -270,17 +270,25 @@ def vector_norm(x, p=2.0, axis=None, keepdim=False, name=None):
         "vector_norm",
         lambda v, *, p, axis, keepdims: jnp.linalg.vector_norm(
             v, ord=p, axis=axis, keepdims=keepdims),
-        (x,), dict(p=float(p) if p not in (float("inf"), -float("inf"))
-                   else p,
-                   axis=_ax(axis), keepdims=bool(keepdim)))
+        (x,), dict(p=float(p), axis=_ax(axis),
+                   keepdims=bool(keepdim)))
 
 
 def matrix_norm(x, p="fro", axis=(-2, -1), keepdim=False, name=None):
+    def impl(v, *, p, axis, keepdims):
+        a1, a2 = axis
+        # normalize the two matrix axes to the trailing positions
+        v = jnp.moveaxis(v, (a1 % v.ndim, a2 % v.ndim), (-2, -1))
+        out = jnp.linalg.matrix_norm(v, ord=p, keepdims=keepdims)
+        if keepdims:
+            out = jnp.moveaxis(out, (-2, -1),
+                               (a1 % out.ndim, a2 % out.ndim))
+        return out
+
     return dispatch(
-        "matrix_norm",
-        lambda v, *, p, keepdims: jnp.linalg.matrix_norm(
-            v, ord=p, keepdims=keepdims),
+        "matrix_norm", impl,
         (x,), dict(p=p if isinstance(p, str) else float(p),
+                   axis=tuple(int(a) for a in axis),
                    keepdims=bool(keepdim)))
 
 
@@ -294,6 +302,7 @@ def ormqr(x, tau, other, left=True, transpose=False, name=None):
     householder_product — O(m^2 k) like the reference's blocked apply."""
     def impl(a, t, y, *, left, transpose):
         m, k = a.shape[-2], t.shape[-1]
+        a = a[..., :, :k]  # wide geqrf: Q comes from the first k reflectors
         if k < m:
             # the FULL m x m Q: pad with zero reflectors (tau=0 ==
             # identity) so all m columns materialize
@@ -302,7 +311,12 @@ def ormqr(x, tau, other, left=True, transpose=False, name=None):
             a = jnp.pad(a, pad_a)
             t = jnp.pad(t, pad_t)
         q = jax.lax.linalg.householder_product(a, t)
-        qm = jnp.swapaxes(q, -1, -2) if transpose else q
+        if transpose:
+            qm = jnp.swapaxes(q, -1, -2)
+            if jnp.iscomplexobj(q):  # torch/paddle: conjugate transpose
+                qm = jnp.conj(qm)
+        else:
+            qm = q
         return jnp.matmul(qm, y) if left else jnp.matmul(y, qm)
 
     return dispatch("ormqr", impl, (x, tau, other),

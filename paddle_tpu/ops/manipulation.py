@@ -599,6 +599,10 @@ def unflatten(x, axis, shape, name=None):
 
 def as_complex(x, name=None):
     """[..., 2] real pairs -> complex (paddle.as_complex)."""
+    if x.shape[-1] != 2:
+        raise ValueError(
+            f"as_complex: the last dimension must be exactly 2 (got "
+            f"{x.shape[-1]})")
     return dispatch(
         "as_complex",
         lambda v: jax.lax.complex(v[..., 0], v[..., 1]), (x,), {})

@@ -7,11 +7,9 @@ tests/test_distribution_fft.py).
 """
 from __future__ import annotations
 
-import jax
 import jax.numpy as jnp
 
 from .core.dispatch import dispatch
-from .core.tensor import Tensor
 
 __all__ = ["stft", "istft"]
 
