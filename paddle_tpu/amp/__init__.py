@@ -188,10 +188,14 @@ class GradScaler:
             g = p.grad._value.astype(jnp.float32) * inv
             p.grad._local_value_update(g.astype(p.grad._value.dtype))
         # found_inf check (host sync; same cost profile as reference
-        # check_finite_and_unscale kernel + D2H flag read)
-        for p in optimizer._params_with_grad():
+        # check_finite_and_unscale kernel + D2H flag read) — routed
+        # through the shared nonfinite sentinel so the skip-step is
+        # attributed to a NAMED tensor (debugging.last_nonfinite())
+        for i, p in enumerate(optimizer._params_with_grad()):
             if not bool(jnp.isfinite(p.grad._value.astype(
                     jnp.float32)).all()):
+                from .debugging import first_nonfinite
+                first_nonfinite([(p.name or f"param_{i}", p.grad)])
                 found = True
                 break
         self._found_inf = found

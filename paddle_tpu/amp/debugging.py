@@ -1,4 +1,13 @@
-"""paddle.amp.debugging parity shims (op stats / nan-inf checks)."""
+"""paddle.amp.debugging parity shims (op stats / nan-inf checks).
+
+Robustness extensions (fault_tolerance layer): nonfinite checks report
+the FIRST offending tensor by name/op instead of a bare boolean, the
+last report is kept for post-mortem (``last_nonfinite()``), and
+``skip_step_on_nonfinite`` is the shared skip-step hook — GradScaler,
+bare optimizers, and the collective watchdog all route through the same
+sentinel so "NaN gradient ⇒ skip the update, keep training" behaves
+identically everywhere.
+"""
 from __future__ import annotations
 
 import contextlib
@@ -9,7 +18,8 @@ import jax.numpy as jnp
 __all__ = ["enable_operator_stats_collection",
            "disable_operator_stats_collection", "collect_operator_stats",
            "check_numerics", "enable_tensor_checker",
-           "disable_tensor_checker", "DebugMode"]
+           "disable_tensor_checker", "DebugMode", "NonFiniteError",
+           "first_nonfinite", "last_nonfinite", "skip_step_on_nonfinite"]
 
 
 class DebugMode:
@@ -18,7 +28,19 @@ class DebugMode:
     CHECK_ALL = 4
 
 
+class NonFiniteError(FloatingPointError):
+    """NaN/Inf detected; names the offending tensor and producing op."""
+
+    def __init__(self, var_name="", op_type="", kind="nan/inf"):
+        self.var_name = var_name
+        self.op_type = op_type
+        self.kind = kind
+        where = ":".join(p for p in (op_type, var_name) if p) or "<tensor>"
+        super().__init__(f"{kind} detected in {where}")
+
+
 _collecting = {"on": False, "stats": {}}
+_last_nonfinite = {"report": None}
 
 
 def enable_operator_stats_collection():
@@ -39,17 +61,85 @@ def collect_operator_stats():
         disable_operator_stats_collection()
 
 
+def _kind_of(arr):
+    """'nan', 'inf', 'nan/inf' or None for a host array."""
+    has_nan = bool(np.isnan(arr).any())
+    has_inf = bool(np.isinf(arr).any())
+    if has_nan and has_inf:
+        return "nan/inf"
+    if has_nan:
+        return "nan"
+    if has_inf:
+        return "inf"
+    return None
+
+
+def _record(var_name, op_type, kind):
+    report = {"var_name": var_name, "op_type": op_type, "kind": kind}
+    _last_nonfinite["report"] = report
+    return report
+
+
+def last_nonfinite():
+    """The most recent nonfinite report ({var_name, op_type, kind}) or
+    None — the watchdog/elastic layers read this for diagnostics."""
+    return _last_nonfinite["report"]
+
+
+def first_nonfinite(named_tensors):
+    """Scan ``named_tensors`` (dict name->Tensor, or iterable of
+    (name, tensor)) and return the FIRST offending report, else None."""
+    items = named_tensors.items() if hasattr(named_tensors, "items") \
+        else named_tensors
+    for name, t in items:
+        if t is None:
+            continue
+        arr = np.asarray(getattr(t, "_value", t), np.float32)
+        kind = _kind_of(arr)
+        if kind is not None:
+            return _record(name, "", kind)
+    return None
+
+
 def check_numerics(tensor, op_type="", var_name="",
                    debug_mode=DebugMode.CHECK_NAN_INF_AND_ABORT):
     arr = np.asarray(tensor._value, np.float32)
-    has_nan = bool(np.isnan(arr).any())
-    has_inf = bool(np.isinf(arr).any())
-    if (has_nan or has_inf) and \
-            debug_mode == DebugMode.CHECK_NAN_INF_AND_ABORT:
-        raise FloatingPointError(
-            f"nan/inf detected in {op_type}:{var_name}")
+    kind = _kind_of(arr)
+    has_nan = kind in ("nan", "nan/inf")
+    has_inf = kind in ("inf", "nan/inf")
+    if kind is not None:
+        _record(var_name, op_type, kind)
+        if debug_mode == DebugMode.CHECK_NAN_INF_AND_ABORT:
+            raise NonFiniteError(var_name, op_type, kind)
     from ..core.tensor import to_tensor
     return to_tensor(has_nan), to_tensor(has_inf)
+
+
+def skip_step_on_nonfinite(optimizer, named_grads=None):
+    """NaN sentinel → optimizer skip-step (the shared hook).
+
+    Checks the gradients about to be applied (``named_grads`` overrides;
+    default: the optimizer's params-with-grad).  If any is nonfinite,
+    records the first offending name (``last_nonfinite()``), does NOT
+    step, and returns True; otherwise steps and returns False.
+    """
+    if named_grads is None:
+        from ..optimizer.optimizer import run_pre_step_hooks
+        params = optimizer._params_with_grad()
+        # run the pre-step hooks HERE so injected faults (grad.poison)
+        # land before the check; step() below won't re-run them
+        run_pre_step_hooks(optimizer, params)
+        named_grads = [(p.name or f"param_{i}", p.grad)
+                       for i, p in enumerate(params)]
+    report = first_nonfinite(named_grads)
+    if report is not None:
+        # not stepping: clear the hooks-already-ran latch so the next
+        # independent step() runs its hooks normally
+        from ..optimizer import optimizer as _opt
+        _opt._hooks_ran.flag = False
+        return True
+    optimizer.step()
+    return False
 
 
 def enable_tensor_checker(config=None):

@@ -8,6 +8,8 @@ Built in layers (SURVEY.md §2.3):
   auto_parallel/    — shard_tensor / ProcessMesh / Shard/Replicate
   launch/           — python -m paddle_tpu.distributed.launch
   checkpoint/       — sharded save/load with resharding
+  fault_tolerance/  — fault injection, collective watchdog, retry,
+                      crash-safe checkpoint primitives
 """
 from .env import (init_parallel_env, get_rank, get_world_size,
                   is_initialized, global_mesh, set_global_mesh, ParallelEnv)
@@ -34,6 +36,7 @@ from .checkpoint.save_load import save_state_dict, load_state_dict
 from .store import TCPStore
 from .split_api import split
 from . import utils
+from . import fault_tolerance
 
 spawn = None  # set by launch module
 

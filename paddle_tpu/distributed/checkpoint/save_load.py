@@ -8,6 +8,14 @@ TPU-native: each host saves the addressable shards of its global arrays
 with their index coordinates; load assembles the global value and
 device_puts it under the *current* sharding — resharding across topologies
 falls out (the Orbax-style flow, dependency-free).
+
+Crash safety (fault_tolerance layer): every file is written atomically
+(tmp + fsync + os.replace), the coordinator commits the checkpoint by
+writing a sha256 ``manifest.json`` LAST, and load validates before
+trusting — a worker killed mid-save leaves either the previous complete
+checkpoint or a visibly-incomplete directory (no manifest), never a
+silently-torn one.  ``load_state_dict(..., fallback_path=...)`` rolls
+back to the last good generation on corruption.
 """
 from __future__ import annotations
 
@@ -19,6 +27,11 @@ import numpy as np
 import jax
 
 from ...core.tensor import Tensor
+from ..fault_tolerance.atomic import (atomic_write, write_manifest,
+                                      validate_checkpoint,
+                                      latest_good_checkpoint,
+                                      CheckpointCorruptionError)
+from ..fault_tolerance.plan import fault_point
 
 __all__ = ["save_state_dict", "load_state_dict"]
 
@@ -59,18 +72,53 @@ def save_state_dict(state_dict, path, process_group=None,
             pieces.append({"index": [[0, d] for d in arr.shape],
                            "data": np.asarray(arr)})
         shards[name] = pieces
-    with open(os.path.join(path, f"shard_{rank}.pkl"), "wb") as f:
+    shard_path = os.path.join(path, f"shard_{rank}.pkl")
+    with atomic_write(shard_path) as f:
         pickle.dump(shards, f)
+    # FaultPlan site "checkpoint.write": a drop/kill here models a
+    # worker dying MID-SAVE — the manifest never lands, so the
+    # checkpoint is visibly incomplete (not silently torn)
+    fault_point("checkpoint.write", path=shard_path)
     if rank == coordinator_rank:
-        with open(os.path.join(path, "metadata.json"), "w") as f:
+        with atomic_write(os.path.join(path, "metadata.json"), "w") as f:
             json.dump(meta, f)
+        # commit record, written LAST: a checkpoint without a manifest
+        # is by definition incomplete
+        write_manifest(path)
+        # FaultPlan site "checkpoint.commit": a "corrupt" event here
+        # mangles a committed file — post-commit bit-rot/torn replace,
+        # exactly what the checksum manifest must catch at load time
+        fault_point("checkpoint.commit", path=shard_path)
 
 
 def load_state_dict(state_dict, path, process_group=None,
                     coordinator_rank=0, unique_id=None,
-                    offload=False):
+                    offload=False, fallback_path=None, verify=True):
     """Fill `state_dict`'s tensors in place, resharding to their current
-    placement."""
+    placement.
+
+    With ``verify`` (default), the checkpoint's manifest + checksums are
+    validated first; a corrupt/incomplete checkpoint raises
+    :class:`CheckpointCorruptionError` — or, when ``fallback_path`` is
+    given (a sibling checkpoint or a directory of checkpoints), falls
+    back to the newest valid generation instead.
+    """
+    if verify:
+        ok, reasons = validate_checkpoint(path)
+        if not ok:
+            fb = None
+            if fallback_path is not None:
+                ok_fb, _ = validate_checkpoint(fallback_path)
+                fb = fallback_path if ok_fb else \
+                    latest_good_checkpoint(fallback_path)
+            if fb is None:
+                raise CheckpointCorruptionError(path, reasons)
+            import warnings
+            warnings.warn(
+                f"checkpoint {path!r} failed validation "
+                f"({'; '.join(reasons)}); falling back to last good "
+                f"checkpoint {fb!r}", RuntimeWarning, stacklevel=2)
+            path = fb
     with open(os.path.join(path, "metadata.json")) as f:
         meta = json.load(f)
     all_shards = {}

@@ -17,12 +17,15 @@ Execution contexts:
 """
 from __future__ import annotations
 
+import functools
+
 import numpy as np
 import jax
 import jax.numpy as jnp
 
 from ...core.dispatch import dispatch
 from ...core.tensor import Tensor, to_tensor
+from ..fault_tolerance.watchdog import get_watchdog
 from .group import Group, _get_default_group
 from .reduce_op import ReduceOp
 
@@ -33,6 +36,40 @@ __all__ = ["all_gather", "all_gather_object", "broadcast", "reduce",
            "broadcast_object_list", "scatter_object_list",
     "monitored_barrier",
 ]
+
+
+def _trace_clean():
+    """True when we're in plain eager execution (no jit/shard_map trace
+    in flight).  The watchdog only wraps eager entry points: inside a
+    trace XLA owns the collective and thread-hopping the trace context
+    would corrupt it."""
+    try:
+        return jax.core.trace_state_clean()
+    except Exception:
+        return True
+
+
+def _watched(op_name):
+    """Collective-watchdog wrapper (fault_tolerance layer).
+
+    Disabled (the default) this is one global read per call.  Enabled
+    (enable_watchdog() / PADDLE_TPU_WATCHDOG_TIMEOUT), the op body runs
+    under a deadline and a timeout raises CollectiveTimeoutError naming
+    the op, the group, and which ranks checked in — instead of hanging
+    the training job forever on a dead peer."""
+    def deco(fn):
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            wd = get_watchdog()
+            if wd is None or not _trace_clean():
+                return fn(*args, **kwargs)
+            g = kwargs.get("group")
+            if g is None:
+                g = next((a for a in args if isinstance(a, Group)), None)
+            return wd.run(lambda: fn(*args, **kwargs), op_name,
+                          group=g if g is not None else _group(None))
+        return wrapper
+    return deco
 
 
 def _axis_in_scope(axis_name):
@@ -104,6 +141,7 @@ def _apply_inplace(tensor, new_tensor):
     return tensor
 
 
+@_watched("all_reduce")
 def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
     """In-place all-reduce (see communication/all_reduce.py for docs)."""
     g = _group(group)
@@ -130,6 +168,7 @@ def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
     return tensor
 
 
+@_watched("all_gather")
 def all_gather(tensor_list, tensor, group=None, sync_op=True, axis=0):
     g = _group(group)
     axis_name = g.axis_name
@@ -175,6 +214,7 @@ def all_gather_object(object_list, obj, group=None):
     object_list.extend([obj for _ in range(max(g.nranks, 1))])
 
 
+@_watched("broadcast")
 def broadcast(tensor, src=0, group=None, sync_op=True):
     g = _group(group)
     if _axis_in_scope(g.axis_name):
@@ -197,6 +237,7 @@ def reduce(tensor, dst=0, op=ReduceOp.SUM, group=None, sync_op=True):
     return all_reduce(tensor, op, group, sync_op)
 
 
+@_watched("scatter")
 def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
     g = _group(group)
     if _axis_in_scope(g.axis_name):
@@ -224,6 +265,7 @@ def gather(tensor, gather_list=None, dst=0, group=None, sync_op=True):
     return lst
 
 
+@_watched("reduce_scatter")
 def reduce_scatter(tensor, tensor_list, op=ReduceOp.SUM, group=None,
                    sync_op=True):
     g = _group(group)
@@ -246,6 +288,7 @@ def reduce_scatter(tensor, tensor_list, op=ReduceOp.SUM, group=None,
     return tensor
 
 
+@_watched("alltoall")
 def alltoall(out_tensor_list, in_tensor_list, group=None, sync_op=True):
     g = _group(group)
     if _axis_in_scope(g.axis_name):
@@ -270,6 +313,7 @@ def alltoall(out_tensor_list, in_tensor_list, group=None, sync_op=True):
     return _Work()
 
 
+@_watched("alltoall_single")
 def alltoall_single(out_tensor, in_tensor, in_split_sizes=None,
                     out_split_sizes=None, group=None, sync_op=True):
     g = _group(group)
@@ -287,6 +331,7 @@ def alltoall_single(out_tensor, in_tensor, in_split_sizes=None,
     return _apply_inplace(out_tensor, in_tensor)
 
 
+@_watched("send")
 def send(tensor, dst=0, group=None, sync_op=True):
     g = _group(group)
     if _axis_in_scope(g.axis_name):
@@ -309,6 +354,7 @@ def send(tensor, dst=0, group=None, sync_op=True):
 _p2p_buffer = {}
 
 
+@_watched("recv")
 def recv(tensor, src=0, group=None, sync_op=True):
     g = _group(group)
     if _axis_in_scope(g.axis_name):
@@ -363,6 +409,7 @@ def batch_isend_irecv(p2p_op_list):
     return works
 
 
+@_watched("barrier")
 def barrier(group=None):
     g = _group(group)
     if _axis_in_scope(g.axis_name):
@@ -418,6 +465,12 @@ def scatter_object_list(out_object_list, in_object_list=None, src=0,
 
 
 def monitored_barrier(group=None, timeout=None, wait_all_ranks=False):
-    """Barrier with a watchdog timeout; in one SPMD process the barrier
-    is the device-collective barrier and the timeout is advisory."""
-    return barrier(group)
+    """Barrier with a real watchdog deadline: on expiry raises
+    CollectiveTimeoutError naming the barrier and (when a store-backed
+    watchdog is enabled) the ranks that checked in vs. went missing."""
+    wd = get_watchdog()
+    if wd is None or not _trace_clean():
+        return barrier(group)
+    # barrier.__wrapped__: don't nest a second watchdog thread
+    return wd.run(lambda: barrier.__wrapped__(group), "monitored_barrier",
+                  group=_group(group), timeout=timeout)

@@ -1,0 +1,42 @@
+"""Fault-tolerance subsystem (cross-cutting robustness layer).
+
+Four pieces, each consumed by a different layer of the distributed
+stack:
+
+  plan.py      deterministic seeded fault injection — FaultPlan,
+               inject(), fault_point() sites threaded through the
+               store / heartbeat / collective / checkpoint / optimizer
+               paths; replayable via PADDLE_TPU_FAULT_PLAN.
+  watchdog.py  collective watchdog — bounded waits on the communication
+               entry points with a which-ranks-checked-in diagnostic
+               instead of an eternal hang.
+  retry.py     exponential backoff with deterministic jitter + bounded
+               retry_call; the TCPStore client's hardening primitives.
+  atomic.py    crash-safe checkpoint primitives — atomic_write,
+               checksum manifests, validate/latest-good scanning.
+
+See README.md §"Fault tolerance" for the env knobs.
+"""
+from .plan import (FaultEvent, FaultPlan, inject, fault_point, active_plan,
+                   clear_active_plan, InjectedFault, InjectedConnectionError,
+                   SimulatedWorkerDeath, ENV_FAULT_PLAN, corrupt_file)
+from .retry import backoff_delays, retry_call, RetryExhausted
+from .watchdog import (CollectiveWatchdog, CollectiveTimeoutError,
+                       enable_watchdog, disable_watchdog, get_watchdog,
+                       ENV_WATCHDOG_TIMEOUT)
+from .atomic import (atomic_write, file_sha256, write_manifest,
+                     validate_checkpoint, latest_good_checkpoint,
+                     CheckpointCorruptionError, MANIFEST_NAME)
+from .faults import poison_gradients
+
+__all__ = [
+    "FaultEvent", "FaultPlan", "inject", "fault_point", "active_plan",
+    "clear_active_plan", "InjectedFault", "InjectedConnectionError",
+    "SimulatedWorkerDeath", "ENV_FAULT_PLAN", "corrupt_file",
+    "backoff_delays", "retry_call", "RetryExhausted",
+    "CollectiveWatchdog", "CollectiveTimeoutError", "enable_watchdog",
+    "disable_watchdog", "get_watchdog", "ENV_WATCHDOG_TIMEOUT",
+    "atomic_write", "file_sha256", "write_manifest", "validate_checkpoint",
+    "latest_good_checkpoint", "CheckpointCorruptionError", "MANIFEST_NAME",
+    "poison_gradients",
+]

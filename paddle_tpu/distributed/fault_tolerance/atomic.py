@@ -1,0 +1,131 @@
+"""Crash-safe file primitives for checkpointing.
+
+The failure model is a worker dying MID-WRITE (preemption, OOM-kill,
+pod teardown): a torn file must never be mistaken for a checkpoint.
+Three layers of defense:
+
+  * ``atomic_write``: tmp-file + fsync + ``os.replace`` — a file either
+    has its complete new contents or doesn't exist; no torn states.
+  * per-file sha256 sidecars + a ``manifest.json`` written LAST — a
+    checkpoint directory is valid iff the manifest exists and every
+    listed file's checksum matches (the manifest doubles as the commit
+    record: no manifest ⇒ the save never finished).
+  * ``latest_good_checkpoint``: scan a root for the newest directory
+    that passes validation — the load-time fallback target.
+"""
+from __future__ import annotations
+
+import contextlib
+import hashlib
+import json
+import os
+
+__all__ = ["atomic_write", "file_sha256", "write_manifest",
+           "validate_checkpoint", "latest_good_checkpoint",
+           "CheckpointCorruptionError", "MANIFEST_NAME"]
+
+MANIFEST_NAME = "manifest.json"
+
+
+class CheckpointCorruptionError(RuntimeError):
+    """A checkpoint failed validation; ``.path`` / ``.reasons`` say why."""
+
+    def __init__(self, path, reasons):
+        self.path = path
+        self.reasons = list(reasons)
+        super().__init__(
+            f"corrupt/incomplete checkpoint at {path!r}: "
+            + "; ".join(self.reasons))
+
+
+@contextlib.contextmanager
+def atomic_write(path, mode="wb"):
+    """Write to ``path`` all-or-nothing: stage into a same-directory tmp
+    file, fsync, then ``os.replace`` (atomic on POSIX).  On any error
+    the tmp file is removed and ``path`` is untouched."""
+    tmp = f"{path}.tmp.{os.getpid()}"
+    f = open(tmp, mode)
+    try:
+        yield f
+        f.flush()
+        os.fsync(f.fileno())
+        f.close()
+        os.replace(tmp, path)
+    except BaseException:
+        f.close()
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+
+
+def file_sha256(path):
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            h.update(chunk)
+    return h.hexdigest()
+
+
+def write_manifest(ckpt_dir, files=None, extra=None):
+    """Commit record: checksums of ``files`` (default: every regular
+    file already in ``ckpt_dir``), written atomically and LAST."""
+    if files is None:
+        files = [n for n in sorted(os.listdir(ckpt_dir))
+                 if n != MANIFEST_NAME
+                 and os.path.isfile(os.path.join(ckpt_dir, n))]
+    manifest = {"format": 1,
+                "files": {n: file_sha256(os.path.join(ckpt_dir, n))
+                          for n in files}}
+    if extra:
+        manifest.update(extra)
+    with atomic_write(os.path.join(ckpt_dir, MANIFEST_NAME), "w") as f:
+        json.dump(manifest, f, indent=1)
+    return manifest
+
+
+def validate_checkpoint(ckpt_dir):
+    """Returns (ok, reasons).  Valid ⇔ manifest present, every listed
+    file present with a matching sha256."""
+    mpath = os.path.join(ckpt_dir, MANIFEST_NAME)
+    if not os.path.isdir(ckpt_dir):
+        return False, [f"not a directory: {ckpt_dir}"]
+    if not os.path.exists(mpath):
+        return False, ["no manifest (save never completed)"]
+    try:
+        with open(mpath) as f:
+            manifest = json.load(f)
+    except (OSError, ValueError) as e:
+        return False, [f"unreadable manifest: {e}"]
+    reasons = []
+    for name, want in manifest.get("files", {}).items():
+        p = os.path.join(ckpt_dir, name)
+        if not os.path.exists(p):
+            reasons.append(f"missing file {name}")
+            continue
+        got = file_sha256(p)
+        if got != want:
+            reasons.append(f"checksum mismatch on {name} "
+                           f"(want {want[:12]}…, got {got[:12]}…)")
+    return (not reasons), reasons
+
+
+def latest_good_checkpoint(root):
+    """Newest (by mtime, then name) subdirectory of ``root`` that passes
+    validation, or None.  ``root`` itself is considered too, so both
+    layouts work: a directory-of-checkpoints and a single checkpoint."""
+    candidates = []
+    if os.path.isdir(root):
+        if os.path.exists(os.path.join(root, MANIFEST_NAME)):
+            candidates.append(root)
+        for name in os.listdir(root):
+            p = os.path.join(root, name)
+            if os.path.isdir(p):
+                candidates.append(p)
+    candidates.sort(key=lambda p: (os.path.getmtime(p), p), reverse=True)
+    for p in candidates:
+        ok, _ = validate_checkpoint(p)
+        if ok:
+            return p
+    return None

@@ -1,0 +1,47 @@
+"""Fault realizations that need framework imports (tensors, optimizer).
+
+Kept out of plan.py so activating a plan from the env var never drags
+jax/numpy into the rendezvous path's import graph.
+"""
+from __future__ import annotations
+
+from .plan import fault_point
+
+__all__ = ["install_grad_poison_hook", "poison_gradients"]
+
+_installed = False
+
+
+def poison_gradients(params, kind="nan"):
+    """Overwrite the gradients of ``params`` with NaN (or Inf): the
+    silent-corruption fault the skip-step path must catch."""
+    import numpy as np
+    import jax.numpy as jnp
+
+    bad = np.nan if kind != "inf" else np.inf
+    n = 0
+    for p in params:
+        g = getattr(p, "grad", None)
+        if g is None:
+            continue
+        g._local_value_update(jnp.full(g._value.shape, bad, g._value.dtype))
+        n += 1
+    return n
+
+
+def _pre_step_poison(optimizer, params):
+    ev = fault_point("grad.poison")
+    if ev is not None and params:
+        poison_gradients(params[:1] if ev.arg == "first" else params,
+                         kind=(ev.arg or "nan"))
+
+
+def install_grad_poison_hook():
+    """Register the ``grad.poison`` site on the optimizer's pre-step
+    hook chain (idempotent; a no-op until a plan schedules the site)."""
+    global _installed
+    if _installed:
+        return
+    from ...optimizer.optimizer import register_pre_step_hook
+    register_pre_step_hook(_pre_step_poison)
+    _installed = True

@@ -1,0 +1,291 @@
+"""Deterministic, seeded fault injection (FaultPlan + inject()).
+
+The robustness layer's core contract: every failure mode the distributed
+stack must survive (dropped sockets, stalled heartbeats, killed workers,
+torn checkpoints, NaN gradients) can be *replayed exactly*.  Production
+code is instrumented with named ``fault_point(site)`` calls; a
+``FaultPlan`` decides — deterministically, from explicit triggers or a
+seeded RNG — whether that call fires a fault, and records every firing
+in ``plan.history`` so two runs of the same plan produce byte-identical
+failure sequences.
+
+Sites currently instrumented:
+  store.connect / store.<op>   TCPStore client (distributed/store.py)
+  heartbeat.beat               ElasticManager (fleet/elastic/manager.py)
+  collective.<op>              watchdog-wrapped collectives (ops.py)
+  checkpoint.write             shard writes (checkpoint/save_load.py)
+  grad.poison                  optimizer pre-step hook (NaN gradients)
+  worker.step                  user training loops / smoke scripts
+
+Activation: ``with inject(plan): ...`` or the ``PADDLE_TPU_FAULT_PLAN``
+env var (JSON, or the compact ``site:action:k=v,...;site2:...`` form) so
+a *relaunched* worker replays the same plan without code changes.
+"""
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import random
+import threading
+import time
+
+__all__ = ["FaultEvent", "FaultPlan", "inject", "fault_point",
+           "active_plan", "clear_active_plan", "InjectedFault",
+           "InjectedConnectionError", "SimulatedWorkerDeath",
+           "ENV_FAULT_PLAN"]
+
+ENV_FAULT_PLAN = "PADDLE_TPU_FAULT_PLAN"
+
+
+class InjectedFault(Exception):
+    """Marker base so handlers can tell injected faults from real ones."""
+
+
+class InjectedConnectionError(ConnectionError, InjectedFault):
+    """A dropped socket/op (subclass of ConnectionError so production
+    retry paths treat it exactly like a real transient error)."""
+
+
+class SimulatedWorkerDeath(RuntimeError, InjectedFault):
+    """A simulated worker kill; escapes retry loops by design."""
+
+
+_ACTIONS = ("drop", "delay", "stall", "kill", "corrupt", "nan")
+
+
+class FaultEvent:
+    """One scheduled fault: *site* + *action* + trigger.
+
+    Trigger is either occurrence-based (fire on calls
+    ``after <= n < after+count`` at the site) or probability-based
+    (``prob`` drawn from the plan's seeded RNG — still deterministic
+    for a fixed seed and call order).
+    """
+
+    def __init__(self, site, action, after=0, count=1, prob=None,
+                 delay=0.0, arg=None):
+        if action not in _ACTIONS:
+            raise ValueError(
+                f"FaultEvent: unknown action {action!r} (one of {_ACTIONS})")
+        self.site = site
+        self.action = action
+        self.after = int(after)
+        self.count = None if count in (None, "inf") else int(count)
+        self.prob = None if prob is None else float(prob)
+        self.delay = float(delay)
+        self.arg = arg
+        self.fired = 0
+
+    def to_dict(self):
+        return {"site": self.site, "action": self.action,
+                "after": self.after, "count": self.count,
+                "prob": self.prob, "delay": self.delay, "arg": self.arg}
+
+    @classmethod
+    def from_dict(cls, d):
+        return cls(d["site"], d["action"], d.get("after", 0),
+                   d.get("count", 1), d.get("prob"), d.get("delay", 0.0),
+                   d.get("arg"))
+
+    def __repr__(self):
+        return (f"FaultEvent({self.site!r}, {self.action!r}, "
+                f"after={self.after}, count={self.count}, "
+                f"prob={self.prob}, delay={self.delay})")
+
+
+class FaultPlan:
+    """A seeded, replayable schedule of FaultEvents.
+
+    ``history`` is the ground truth of what fired: a list of
+    ``(site, action, occurrence_index)`` tuples.  The acceptance
+    contract is that re-running the same plan against the same program
+    yields an identical ``history``.
+    """
+
+    def __init__(self, events=None, seed=0):
+        self.seed = int(seed)
+        self.rng = random.Random(self.seed)
+        self.events = list(events or [])
+        self.history = []
+        self._site_calls = {}
+        self._lock = threading.Lock()
+
+    # -- construction ----------------------------------------------------
+    def add(self, site, action, **kwargs):
+        self.events.append(FaultEvent(site, action, **kwargs))
+        return self
+
+    @classmethod
+    def parse(cls, spec):
+        """Parse JSON (``{"seed": 7, "events": [...]}``) or the compact
+        form ``site:action[:k=v[,k=v...]][;site2:...]``  (optionally
+        prefixed ``seed=N;``)."""
+        spec = spec.strip()
+        if spec.startswith("{"):
+            d = json.loads(spec)
+            return cls([FaultEvent.from_dict(e) for e in d.get("events", [])],
+                       seed=d.get("seed", 0))
+        seed = 0
+        events = []
+        for part in filter(None, (p.strip() for p in spec.split(";"))):
+            if part.startswith("seed="):
+                seed = int(part[5:])
+                continue
+            fields = part.split(":")
+            if len(fields) < 2:
+                raise ValueError(f"FaultPlan.parse: bad event {part!r}")
+            site, action = fields[0], fields[1]
+            kwargs = {}
+            if len(fields) > 2:
+                for kv in filter(None, fields[2].split(",")):
+                    k, _, v = kv.partition("=")
+                    kwargs[k] = (None if v == "inf" and k == "count"
+                                 else float(v) if k in ("prob", "delay")
+                                 else int(v) if k in ("after", "count")
+                                 else v)
+            events.append(FaultEvent(site, action, **kwargs))
+        return cls(events, seed=seed)
+
+    def to_json(self):
+        return json.dumps({"seed": self.seed,
+                           "events": [e.to_dict() for e in self.events]})
+
+    def reset(self):
+        """Rewind for an identical replay: same seed, same triggers."""
+        self.rng = random.Random(self.seed)
+        self.history = []
+        self._site_calls = {}
+        for e in self.events:
+            e.fired = 0
+        return self
+
+    # -- firing ----------------------------------------------------------
+    def _match(self, site):
+        n = self._site_calls[site] = self._site_calls.get(site, 0) + 1
+        idx = n - 1  # occurrence index of THIS call
+        for ev in self.events:
+            if ev.site != site:
+                continue
+            if ev.prob is not None:
+                # one RNG draw per (matching event, call): deterministic
+                # for a fixed seed and call order
+                if self.rng.random() < ev.prob and \
+                        (ev.count is None or ev.fired < ev.count):
+                    ev.fired += 1
+                    return ev, idx
+                continue
+            if idx < ev.after:
+                continue
+            if ev.count is not None and ev.fired >= ev.count:
+                continue
+            ev.fired += 1
+            return ev, idx
+        return None, idx
+
+    def fire(self, site, path=None):
+        """Called by instrumented code.  Returns the fired FaultEvent
+        (or None), after performing any centrally-realizable action:
+        delay/stall sleep here; drop/kill raise; corrupt mangles
+        ``path``; nan is realized by the caller (it owns the tensor)."""
+        with self._lock:
+            ev, idx = self._match(site)
+            if ev is None:
+                return None
+            self.history.append((site, ev.action, idx))
+        if ev.action in ("delay", "stall"):
+            time.sleep(ev.delay)
+        elif ev.action == "drop":
+            raise InjectedConnectionError(
+                f"fault-injection: dropped {site} (occurrence {idx})")
+        elif ev.action == "kill":
+            raise SimulatedWorkerDeath(
+                f"fault-injection: worker killed at {site} "
+                f"(occurrence {idx})")
+        elif ev.action == "corrupt" and path is not None:
+            corrupt_file(path, seed=self.seed)
+        return ev
+
+
+def corrupt_file(path, seed=0):
+    """Deterministically mangle a file in place (torn/bit-rotted write):
+    flip a run of bytes at a seed-derived offset and truncate the tail."""
+    with open(path, "rb") as f:
+        data = bytearray(f.read())
+    if not data:
+        data = bytearray(b"\x00")
+    rng = random.Random((seed, len(data)).__hash__())
+    off = rng.randrange(len(data))
+    for i in range(off, min(off + 16, len(data))):
+        data[i] ^= 0xFF
+    # torn write: drop the last quarter
+    keep = max(1, (3 * len(data)) // 4)
+    with open(path, "wb") as f:
+        f.write(bytes(data[:keep]))
+
+
+# -- global activation ---------------------------------------------------
+_active = None
+_env_checked = False
+_state_lock = threading.Lock()
+
+
+def active_plan():
+    """The installed FaultPlan, else one parsed from
+    ``PADDLE_TPU_FAULT_PLAN`` (checked once), else None."""
+    global _active, _env_checked
+    if _active is not None:
+        return _active
+    if not _env_checked:
+        with _state_lock:
+            if not _env_checked:
+                _env_checked = True
+                spec = os.environ.get(ENV_FAULT_PLAN)
+                if spec:
+                    _active = FaultPlan.parse(spec)
+                    _install_hooks()
+    return _active
+
+
+def clear_active_plan():
+    global _active, _env_checked
+    _active = None
+    _env_checked = False
+
+
+def fault_point(site, path=None):
+    """Instrumentation hook.  No-op (one global read) when no plan is
+    active; otherwise lets the plan fire at this site."""
+    plan = active_plan()
+    if plan is None:
+        return None
+    return plan.fire(site, path=path)
+
+
+def _install_hooks():
+    """Attach cross-layer hooks that need heavyweight imports (kept out
+    of plan activation's critical path; idempotent, best effort)."""
+    try:
+        from .faults import install_grad_poison_hook
+        install_grad_poison_hook()
+    except Exception:
+        pass
+
+
+@contextlib.contextmanager
+def inject(plan):
+    """Activate ``plan`` for the dynamic extent of the block.
+
+    The plan is reset on entry so each ``inject()`` run of the same plan
+    replays the identical failure sequence."""
+    global _active
+    if isinstance(plan, str):
+        plan = FaultPlan.parse(plan)
+    plan.reset()
+    prev = _active
+    _active = plan
+    _install_hooks()
+    try:
+        yield plan
+    finally:
+        _active = prev

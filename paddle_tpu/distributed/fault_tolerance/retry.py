@@ -1,0 +1,75 @@
+"""Retry/backoff primitives shared by the rendezvous + elastic layers.
+
+Exponential backoff with *deterministic* jitter: the jitter sequence
+comes from a seeded RNG so a replayed run (same seed) sleeps the same
+schedule — required for the FaultPlan replay contract.  The default
+seed derives from the rank so a thundering herd of restarting workers
+still decorrelates.
+"""
+from __future__ import annotations
+
+import os
+import random
+import time
+
+__all__ = ["backoff_delays", "retry_call", "RetryExhausted",
+           "ENV_STORE_RETRIES"]
+
+ENV_STORE_RETRIES = "PADDLE_TPU_STORE_RETRIES"
+
+
+class RetryExhausted(RuntimeError):
+    """All attempts failed; ``.last`` carries the final exception."""
+
+    def __init__(self, msg, last=None):
+        super().__init__(msg)
+        self.last = last
+
+
+def _default_seed():
+    return int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+
+
+def backoff_delays(base=0.05, factor=2.0, max_delay=2.0, jitter=0.25,
+                   seed=None):
+    """Yield an unbounded exponential backoff schedule.
+
+    delay_i = min(base * factor**i, max_delay) * U(1-jitter, 1+jitter)
+    with U drawn from a seeded RNG (deterministic per seed)."""
+    rng = random.Random(_default_seed() if seed is None else seed)
+    d = float(base)
+    while True:
+        j = 1.0 + jitter * (2.0 * rng.random() - 1.0) if jitter else 1.0
+        yield min(d, max_delay) * j
+        d *= factor
+
+
+def retry_call(fn, exceptions=(OSError,), retries=3, deadline=None,
+               base=0.05, factor=2.0, max_delay=2.0, jitter=0.25,
+               seed=None, on_retry=None, what="operation"):
+    """Call ``fn()`` with bounded retries and backoff.
+
+    ``retries`` is the number of RE-tries (total attempts = retries+1);
+    ``deadline`` is an absolute ``time.monotonic()`` cutoff that caps
+    the whole loop.  ``on_retry(attempt, exc)`` observes each failure
+    (diagnostics / test hooks)."""
+    delays = backoff_delays(base, factor, max_delay, jitter, seed)
+    last = None
+    for attempt in range(retries + 1):
+        try:
+            return fn()
+        except exceptions as e:
+            last = e
+            if on_retry is not None:
+                on_retry(attempt, e)
+            if attempt >= retries:
+                break
+            delay = next(delays)
+            if deadline is not None:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    break
+                delay = min(delay, remaining)
+            time.sleep(delay)
+    raise RetryExhausted(
+        f"{what}: {retries + 1} attempts failed (last: {last})", last=last)

@@ -1,0 +1,172 @@
+"""Collective watchdog: bounded waits + rank-roster diagnostics.
+
+A hung collective is the worst distributed failure mode: every healthy
+rank parks inside XLA/NCCL-equivalent plumbing forever with zero signal
+about *who* is missing.  The watchdog wraps the Python entry points of
+``distributed/communication/ops.py``; each wrapped call
+
+  1. checks in to the rendezvous store (``wd/<op>/<seq>/<rank>``) so
+     peers can be audited post-mortem,
+  2. runs the op body on a worker thread with a deadline,
+  3. on expiry raises :class:`CollectiveTimeoutError` naming the op,
+     the group, and exactly which ranks checked in vs. went missing —
+     instead of hanging forever.
+
+Off by default (zero overhead beyond one global read).  Enable with
+``enable_watchdog(timeout=...)`` or ``PADDLE_TPU_WATCHDOG_TIMEOUT``.
+Traced/compiled collectives (inside jit / shard_map) are never wrapped:
+XLA owns those and thread-hopping would corrupt the trace context.
+"""
+from __future__ import annotations
+
+import os
+import threading
+
+from .plan import fault_point
+
+__all__ = ["CollectiveWatchdog", "CollectiveTimeoutError",
+           "enable_watchdog", "disable_watchdog", "get_watchdog",
+           "ENV_WATCHDOG_TIMEOUT"]
+
+ENV_WATCHDOG_TIMEOUT = "PADDLE_TPU_WATCHDOG_TIMEOUT"
+
+
+class CollectiveTimeoutError(RuntimeError):
+    """A collective did not complete within the watchdog deadline.
+
+    Carries the diagnostic roster: ``op``, ``group``, ``timeout``,
+    ``checked_in`` (ranks that entered the op) and ``missing`` (ranks
+    that never did) — when a store was available to audit them."""
+
+    def __init__(self, op, group=None, timeout=None, checked_in=None,
+                 missing=None):
+        self.op = op
+        self.group = group
+        self.timeout = timeout
+        self.checked_in = checked_in
+        self.missing = missing
+        roster = ""
+        if checked_in is not None or missing is not None:
+            roster = (f"; ranks checked in: {sorted(checked_in or [])}, "
+                      f"missing: {sorted(missing or [])}")
+        gdesc = f" on {group}" if group is not None else ""
+        super().__init__(
+            f"collective '{op}'{gdesc} timed out after {timeout}s"
+            f"{roster}. A missing rank is likely dead or stuck — see "
+            f"ElasticManager.dead_ranks() / the launcher log for which "
+            f"worker to restart.")
+
+
+class CollectiveWatchdog:
+    """Deadline + roster audit for host-side collective entry points."""
+
+    def __init__(self, timeout=None, store=None, rank=None,
+                 world_size=None, key_prefix="wd"):
+        if timeout is None:
+            timeout = float(os.environ.get(ENV_WATCHDOG_TIMEOUT, "300"))
+        self.timeout = float(timeout)
+        self.store = store
+        self.rank = int(os.environ.get("PADDLE_TRAINER_ID", "0")) \
+            if rank is None else int(rank)
+        self.world_size = int(os.environ.get("PADDLE_TRAINERS_NUM", "1")) \
+            if world_size is None else int(world_size)
+        self.key_prefix = key_prefix
+        self._seq = {}
+        self._lock = threading.Lock()
+
+    # -- roster ----------------------------------------------------------
+    def _op_seq(self, op_name):
+        with self._lock:
+            n = self._seq[op_name] = self._seq.get(op_name, 0) + 1
+        return n
+
+    def _checkin(self, op_name, seq):
+        if self.store is None:
+            return
+        try:
+            self.store.set(
+                f"{self.key_prefix}/{op_name}/{seq}/{self.rank}", b"1")
+        except Exception:
+            pass  # diagnostics must never fail the op itself
+
+    def _roster(self, op_name, seq):
+        if self.store is None:
+            return None, None
+        checked, missing = [], []
+        for r in range(self.world_size):
+            try:
+                present = self.store.query(
+                    f"{self.key_prefix}/{op_name}/{seq}/{r}") is not None
+            except Exception:
+                present = False
+            (checked if present else missing).append(r)
+        return checked, missing
+
+    # -- execution -------------------------------------------------------
+    def run(self, fn, op_name, group=None, timeout=None):
+        """Run ``fn()`` under the deadline; re-raise its exception or
+        raise CollectiveTimeoutError with the rank roster on expiry."""
+        deadline = self.timeout if timeout is None else float(timeout)
+        if deadline <= 0:
+            fault_point("collective." + op_name)
+            return fn()
+        seq = self._op_seq(op_name)
+        self._checkin(op_name, seq)
+        box = {}
+        done = threading.Event()
+
+        def _target():
+            try:
+                # stall/drop faults land inside the watched region so
+                # the deadline (not the caller) observes them
+                fault_point("collective." + op_name)
+                box["value"] = fn()
+            except BaseException as e:  # noqa: BLE001 — re-raised below
+                box["error"] = e
+            finally:
+                done.set()
+
+        t = threading.Thread(target=_target, daemon=True,
+                             name=f"watchdog-{op_name}-{seq}")
+        t.start()
+        if not done.wait(deadline):
+            checked, missing = self._roster(op_name, seq)
+            raise CollectiveTimeoutError(op_name, group=group,
+                                         timeout=deadline,
+                                         checked_in=checked,
+                                         missing=missing)
+        if "error" in box:
+            raise box["error"]
+        return box.get("value")
+
+
+# -- global instance -----------------------------------------------------
+_watchdog = None
+_env_checked = False
+
+
+def enable_watchdog(timeout=None, store=None, rank=None, world_size=None):
+    """Install the process-global watchdog; returns it."""
+    global _watchdog
+    _watchdog = CollectiveWatchdog(timeout=timeout, store=store, rank=rank,
+                                   world_size=world_size)
+    return _watchdog
+
+
+def disable_watchdog():
+    global _watchdog, _env_checked
+    _watchdog = None
+    _env_checked = True  # explicit disable beats the env var
+
+
+def get_watchdog():
+    """The enabled watchdog, else one auto-enabled from
+    ``PADDLE_TPU_WATCHDOG_TIMEOUT`` (checked once), else None."""
+    global _watchdog, _env_checked
+    if _watchdog is not None:
+        return _watchdog
+    if not _env_checked:
+        _env_checked = True
+        if os.environ.get(ENV_WATCHDOG_TIMEOUT):
+            _watchdog = CollectiveWatchdog()
+    return _watchdog

@@ -19,9 +19,14 @@ load if the topology changed).
 """
 from __future__ import annotations
 
+import json
 import os
 import threading
 import time
+
+from ...fault_tolerance.plan import fault_point, InjectedFault
+from ...fault_tolerance.atomic import (validate_checkpoint,
+                                       latest_good_checkpoint)
 
 __all__ = ["ElasticStore", "ElasticManager"]
 
@@ -95,6 +100,15 @@ class ElasticManager:
     Each rank calls start(); the rank-0 watcher (or the launcher)
     polls dead_ranks() and triggers the relaunch path when a rank goes
     silent past the timeout (the reference's etcd-watch equivalent).
+
+    Staleness is judged on the WATCHER's ``time.monotonic()`` clock: a
+    beat carries a per-rank sequence number and the watcher tracks how
+    long (monotonic) the observed value has gone unchanged.  Comparing
+    the writer's wall clock against the watcher's (the old scheme) let
+    an NTP step / wall-clock jump on either host fabricate or mask a
+    failure; cross-process monotonic clocks aren't comparable, but
+    *change detection* against a local monotonic reference is immune to
+    both skew and jumps.
     """
 
     def __init__(self, rank=None, world_size=None, timeout=30.0,
@@ -109,6 +123,9 @@ class ElasticManager:
         self.store = store or ElasticStore()
         self._stop = threading.Event()
         self._thread = None
+        self._seq = 0
+        # rank -> (last raw beat value, monotonic time it last changed)
+        self._seen = {}
 
     # ---- heartbeat side ----
     def start(self):
@@ -118,7 +135,15 @@ class ElasticManager:
         return self
 
     def beat(self):
-        self.store.set(f"hb_{self.rank}", repr(time.time()))
+        try:
+            # FaultPlan site: "drop" silences this rank (the watcher
+            # must notice), "delay"/"stall" simulates a straggler
+            fault_point("heartbeat.beat")
+        except InjectedFault:
+            return
+        self._seq += 1
+        self.store.set(f"hb_{self.rank}",
+                       f"{self._seq}:{time.time()!r}")
 
     def _loop(self):
         while not self._stop.wait(self.interval):
@@ -131,17 +156,72 @@ class ElasticManager:
 
     # ---- watcher side ----
     def last_beat(self, rank):
+        """Wall-clock time of the rank's last beat (diagnostics only —
+        liveness decisions use monotonic change detection)."""
         v = self.store.get(f"hb_{rank}")
-        return float(v) if v else None
+        if not v:
+            return None
+        _, _, wall = v.partition(":")
+        return float(wall or v)
 
     def dead_ranks(self):
-        now = time.time()
+        now = time.monotonic()
         dead = []
         for r in range(self.world):
-            t = self.last_beat(r)
-            if t is None or now - t > self.timeout:
-                dead.append(r)
+            raw = self.store.get(f"hb_{r}")
+            if raw is None:
+                dead.append(r)  # never joined (or key lost)
+                continue
+            prev = self._seen.get(r)
+            if prev is None or prev[0] != raw:
+                self._seen[r] = (raw, now)  # fresh beat observed
+                continue
+            if now - prev[1] > self.timeout:
+                dead.append(r)  # value unchanged past the deadline
         return dead
 
     def healthy(self):
         return not self.dead_ranks()
+
+    # ---- checkpoint auto-resume wiring ----
+    # The relaunch path (launch/main.py --max_restarts) restarts the
+    # whole pod; workers then ask the elastic registry where to resume.
+    # record_checkpoint() is called after a save completes (only valid
+    # checkpoints are recorded); resume_checkpoint() re-validates at
+    # read time and falls back to the newest good sibling, so a torn
+    # write between record and relaunch can't wedge the pod.
+    _CKPT_KEY = "ckpt_latest"
+
+    def record_checkpoint(self, path, step=None, validate=True):
+        """Publish ``path`` as the resume target (rank 0, post-save).
+        Returns False (and records nothing) if validation fails."""
+        if validate:
+            ok, _ = validate_checkpoint(path)
+            if not ok:
+                return False
+        self.store.set(self._CKPT_KEY,
+                       json.dumps({"path": path, "step": step}))
+        return True
+
+    def resume_checkpoint(self):
+        """(path, step) to resume from, or (None, None).
+
+        The recorded checkpoint is re-validated; on corruption the
+        search falls back to the newest valid checkpoint next to it
+        (crash-safe saves keep the previous generation intact)."""
+        rec = self.store.get(self._CKPT_KEY)
+        if rec:
+            try:
+                d = json.loads(rec)
+            except ValueError:
+                d = {}
+            path = d.get("path")
+            if path:
+                ok, _ = validate_checkpoint(path)
+                if ok:
+                    return path, d.get("step")
+                fallback = latest_good_checkpoint(
+                    os.path.dirname(path.rstrip(os.sep)))
+                if fallback:
+                    return fallback, None
+        return None, None

@@ -12,6 +12,19 @@ reference's design unchanged.  The SERVER is native C++
 blocking GET/WAIT park the caller server-side), built on first use; a
 pure-python server is the fallback when no C++ toolchain exists.  The
 client speaks the length-prefixed wire protocol over one socket.
+
+Hardening (fault_tolerance layer):
+  * connect phase: exponential backoff with deterministic jitter — the
+    master binding late (the startup race) no longer fails rank N hard
+    on the first ECONNREFUSED;
+  * per-op deadlines: the client socket carries ``timeout`` via
+    ``settimeout``, so a dead server turns a blocking get into a named
+    TimeoutError instead of an eternal hang;
+  * bounded replay: idempotent ops (get/query/wait/num_keys) reconnect
+    and retry up to ``PADDLE_TPU_STORE_RETRIES`` times on transient
+    socket errors (a store restart mid-rendezvous is survivable);
+  * ``fault_point("store.connect")`` / ``("store.<op>")`` sites let the
+    FaultPlan drop or delay any of this deterministically.
 """
 from __future__ import annotations
 
@@ -20,6 +33,9 @@ import socket
 import struct
 import threading
 import time
+
+from .fault_tolerance.plan import fault_point
+from .fault_tolerance.retry import backoff_delays, ENV_STORE_RETRIES
 
 __all__ = ["TCPStore"]
 
@@ -33,10 +49,12 @@ class _PyStoreServer:
         self._stop = False
         self._srv = socket.create_server(("0.0.0.0", port))
         self.port = self._srv.getsockname()[1]
-        self._threads = []
-        t = threading.Thread(target=self._accept, daemon=True)
-        t.start()
-        self._threads.append(t)
+        self._conns = set()
+        self._conn_lock = threading.Lock()
+        self._workers = []
+        self._accept_thread = threading.Thread(target=self._accept,
+                                               daemon=True)
+        self._accept_thread.start()
 
     def _accept(self):
         while not self._stop:
@@ -44,10 +62,18 @@ class _PyStoreServer:
                 conn, _ = self._srv.accept()
             except OSError:
                 break
+            if self._stop:  # woken by stop()'s self-connect
+                conn.close()
+                break
+            with self._conn_lock:
+                self._conns.add(conn)
+                # reap finished workers so a long-lived server doesn't
+                # accumulate dead Thread objects
+                self._workers = [t for t in self._workers if t.is_alive()]
             t = threading.Thread(target=self._serve, args=(conn,),
                                  daemon=True)
             t.start()
-            self._threads.append(t)
+            self._workers.append(t)
 
     def _read_n(self, conn, n):
         buf = b""
@@ -85,6 +111,8 @@ class _PyStoreServer:
                         while key not in self._data and not self._stop:
                             self._cv.wait(0.1)
                         val = self._data.get(key, b"")
+                    if self._stop and key not in self._data:
+                        return
                     if cmd == b"W":
                         conn.sendall(b"\x01")
                     else:
@@ -117,15 +145,48 @@ class _PyStoreServer:
             pass
         finally:
             conn.close()
+            with self._conn_lock:
+                self._conns.discard(conn)
 
     def stop(self):
+        if self._stop:
+            return
         self._stop = True
+        try:
+            # closing the listener does NOT interrupt a blocked accept()
+            # on Linux — poke it awake so the accept thread can exit
+            with socket.create_connection(("127.0.0.1", self.port),
+                                          timeout=0.5):
+                pass
+        except OSError:
+            pass
         try:
             self._srv.close()
         except OSError:
             pass
         with self._cv:
             self._cv.notify_all()
+        # closing live connections unblocks workers parked in recv()
+        with self._conn_lock:
+            conns = list(self._conns)
+        for c in conns:
+            try:
+                c.close()
+            except OSError:
+                pass
+        if self._accept_thread is not threading.current_thread():
+            self._accept_thread.join(timeout=2)
+        for t in self._workers:
+            if t is not threading.current_thread():
+                t.join(timeout=1)
+
+    close = stop
+
+    def __del__(self):  # pragma: no cover - best effort
+        try:
+            self.stop()
+        except Exception:
+            pass
 
 
 class TCPStore:
@@ -133,14 +194,19 @@ class TCPStore:
     master rank).
 
     TCPStore(host, port, is_master=False, world_size=1, timeout=...)
-    with set/get/add/wait/delete_key/num_keys/barrier.
+    with set/get/add/wait/delete_key/num_keys/barrier.  ``timeout``
+    bounds the connect phase, every single op (via socket.settimeout),
+    and barrier(); ``retries`` (default ``PADDLE_TPU_STORE_RETRIES``,
+    3) bounds the replay of idempotent ops across reconnects.
     """
 
     def __init__(self, host="127.0.0.1", port=0, is_master=False,
-                 world_size=1, timeout=300, **kwargs):
+                 world_size=1, timeout=300, retries=None, **kwargs):
         self._host = host
         self._world_size = world_size
-        self._timeout = timeout
+        self._timeout = float(timeout)
+        self._retries = int(os.environ.get(ENV_STORE_RETRIES, "3")) \
+            if retries is None else int(retries)
         self._server = None
         self._native_handle = None
         if is_master:
@@ -155,24 +221,50 @@ class TCPStore:
         self.port = port
         self._sock = None
         self._lock = threading.Lock()
-        self._connect()
+        # deterministic jitter (seeded by rank) decorrelates a restart
+        # herd without losing replayability
+        self._op_delays = backoff_delays(base=0.02, factor=2.0,
+                                         max_delay=0.5)
+        with self._lock:
+            self._connect()
 
     # -- wire ------------------------------------------------------------
     def _connect(self):
-        deadline = time.time() + self._timeout
+        """Connect with exponential backoff + jitter until ``timeout``:
+        the master rank binding late (startup race) is expected, not
+        fatal."""
+        deadline = time.monotonic() + self._timeout
+        delays = backoff_delays(base=0.05, factor=1.6, max_delay=1.0)
         last = None
-        while time.time() < deadline:
+        while True:
             try:
+                fault_point("store.connect")
                 self._sock = socket.create_connection(
-                    (self._host, self.port), timeout=self._timeout)
+                    (self._host, self.port),
+                    timeout=min(self._timeout, 5.0))
+                # per-op deadline: every later recv/send on this socket
+                # fails with TimeoutError instead of hanging forever
+                self._sock.settimeout(self._timeout)
                 self._sock.setsockopt(socket.IPPROTO_TCP,
                                       socket.TCP_NODELAY, 1)
                 return
             except OSError as e:
                 last = e
-                time.sleep(0.05)
-        raise TimeoutError(
-            f"TCPStore: cannot reach {self._host}:{self.port} ({last})")
+                self._sock = None
+            delay = next(delays)
+            if time.monotonic() + delay >= deadline:
+                raise TimeoutError(
+                    f"TCPStore: cannot reach {self._host}:{self.port} "
+                    f"within {self._timeout}s (last error: {last})")
+            time.sleep(delay)
+
+    def _drop_sock(self):
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+            self._sock = None
 
     def _read_n(self, n):
         buf = b""
@@ -191,75 +283,111 @@ class TCPStore:
         msg += payload
         self._sock.sendall(msg)
 
+    def _call(self, op_name, fn, idempotent=False):
+        """Run one wire op under the lock.  Transient socket errors
+        drop the connection; idempotent ops reconnect and replay up to
+        ``retries`` times (the store may have restarted — get/wait/query
+        replay safely; set/add/delete never do)."""
+        attempts = (self._retries + 1) if idempotent else 1
+        last = None
+        for i in range(attempts):
+            with self._lock:
+                try:
+                    if self._sock is None:
+                        self._connect()
+                    fault_point("store." + op_name)
+                    return fn()
+                except TimeoutError as e:
+                    # reply stream is now desynced: poison the socket so
+                    # the next op reconnects cleanly
+                    self._drop_sock()
+                    raise TimeoutError(
+                        f"TCPStore {op_name!r}: no reply within "
+                        f"{self._timeout}s from "
+                        f"{self._host}:{self.port}") from e
+                except (ConnectionError, OSError) as e:
+                    last = e
+                    self._drop_sock()
+            if i + 1 < attempts:
+                time.sleep(next(self._op_delays))
+        raise ConnectionError(
+            f"TCPStore {op_name!r}: {attempts} attempt(s) failed against "
+            f"{self._host}:{self.port} (last error: {last})")
+
     # -- API -------------------------------------------------------------
     def set(self, key, value):
         if isinstance(value, str):
             value = value.encode()
-        with self._lock:
-            self._req(b"S", key,
-                      struct.pack("<Q", len(value)) + bytes(value))
+        value = bytes(value)
+
+        def fn():
+            self._req(b"S", key, struct.pack("<Q", len(value)) + value)
             self._read_n(1)
+        self._call("set", fn)
 
     def get(self, key):
-        """Blocking get (waits until the key exists)."""
-        with self._lock:
+        """Blocking get (waits until the key exists, up to timeout)."""
+        def fn():
             self._req(b"G", key)
             (vlen,) = struct.unpack("<Q", self._read_n(8))
             return self._read_n(vlen) if vlen else b""
+        return self._call("get", fn, idempotent=True)
 
     def query(self, key):
         """Non-blocking get: returns None when absent."""
-        with self._lock:
+        def fn():
             self._req(b"Q", key)
             has = self._read_n(1) == b"\x01"
             if not has:
                 return None
             (vlen,) = struct.unpack("<Q", self._read_n(8))
             return self._read_n(vlen) if vlen else b""
+        return self._call("query", fn, idempotent=True)
 
     def add(self, key, amount=1):
-        with self._lock:
+        def fn():
             self._req(b"A", key, struct.pack("<q", int(amount)))
             (now,) = struct.unpack("<q", self._read_n(8))
             return now
+        return self._call("add", fn)
 
     def wait(self, keys):
         if isinstance(keys, str):
             keys = [keys]
         for k in keys:
-            with self._lock:
+            def fn(k=k):
                 self._req(b"W", k)
                 self._read_n(1)
+            self._call("wait", fn, idempotent=True)
 
     def delete_key(self, key):
-        with self._lock:
+        def fn():
             self._req(b"D", key)
             self._read_n(1)
+        self._call("delete_key", fn)
         return True
 
     def num_keys(self):
-        with self._lock:
+        def fn():
             self._req(b"N")
             (n,) = struct.unpack("<q", self._read_n(8))
             return n
+        return self._call("num_keys", fn, idempotent=True)
 
     def barrier(self, tag="barrier"):
         """All world_size ranks block until everyone arrived."""
         n = self.add(f"__{tag}__", 1)
         round_ = (n - 1) // self._world_size
         target = (round_ + 1) * self._world_size
-        deadline = time.time() + self._timeout
+        deadline = time.monotonic() + self._timeout
         while self.add(f"__{tag}__", 0) < target:
-            if time.time() > deadline:
+            if time.monotonic() > deadline:
                 raise TimeoutError(f"TCPStore barrier {tag!r} timed out")
             time.sleep(0.002)
 
     def close(self):
-        try:
-            if self._sock is not None:
-                self._sock.close()
-        except OSError:
-            pass
+        with self._lock:
+            self._drop_sock()
         if self._native_handle is not None:
             from .._native import stop_tcp_store_server
             stop_tcp_store_server(self._native_handle)

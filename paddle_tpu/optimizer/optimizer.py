@@ -13,6 +13,7 @@ compilation.
 """
 from __future__ import annotations
 
+import threading
 from collections import OrderedDict
 
 import numpy as np
@@ -24,7 +25,37 @@ from ..core.tensor import Tensor, to_tensor
 from .lr import LRScheduler
 
 __all__ = ["Optimizer", "SGD", "Momentum", "Adam", "AdamW", "Adamax",
-           "Adagrad", "Adadelta", "RMSProp", "Lamb"]
+           "Adagrad", "Adadelta", "RMSProp", "Lamb",
+           "register_pre_step_hook", "run_pre_step_hooks"]
+
+# Pre-step hooks: callables(optimizer, params) run at the top of every
+# step() — the fault-tolerance layer's seam (gradient poisoning under a
+# FaultPlan, NaN sentinels) without the optimizer importing any of it.
+_pre_step_hooks = []
+_hooks_ran = threading.local()
+
+
+def register_pre_step_hook(fn):
+    """Register ``fn(optimizer, params)`` to run before each update.
+    Returns a zero-arg remover."""
+    _pre_step_hooks.append(fn)
+
+    def remove():
+        try:
+            _pre_step_hooks.remove(fn)
+        except ValueError:
+            pass
+    return remove
+
+
+def run_pre_step_hooks(optimizer, params):
+    """Run the hooks ahead of step() — sentinels (amp.debugging.
+    skip_step_on_nonfinite) call this so injected faults land BEFORE
+    their gradient check; the immediately-following step() won't run
+    the hooks a second time."""
+    for hook in _pre_step_hooks:
+        hook(optimizer, params)
+    _hooks_ran.flag = True
 
 
 class Optimizer:
@@ -105,6 +136,11 @@ class Optimizer:
         params = self._params_with_grad()
         if not params:
             return
+        if getattr(_hooks_ran, "flag", False):
+            _hooks_ran.flag = False  # sentinel already ran them
+        else:
+            for hook in _pre_step_hooks:
+                hook(self, params)
         if self._grad_clip is not None:
             self._grad_clip(params)
         l1 = self._l1_coeff()

@@ -1,0 +1,205 @@
+#!/usr/bin/env python
+"""Fault-injection smoke check: the whole matrix, end to end, one command.
+
+    python scripts/fault_smoke.py [--seed N]
+
+Runs every fault class the fault_tolerance subsystem claims to handle —
+dropped rendezvous sockets, a store restart mid-rendezvous, a stalled
+collective, a stalled heartbeat, a torn checkpoint, a killed save, NaN
+gradients — each under a seeded FaultPlan, and verifies the survive-or-
+named-diagnostic contract plus exact replay determinism.  Exits 0 iff
+every scenario passes.  CPU-only, no TPU needed.
+"""
+import argparse
+import os
+import sys
+import time
+import traceback
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+import paddle_tpu as paddle  # noqa: E402
+from paddle_tpu.distributed import fault_tolerance as ft  # noqa: E402
+from paddle_tpu.distributed.fault_tolerance.plan import (  # noqa: E402
+    FaultPlan, inject, SimulatedWorkerDeath)
+from paddle_tpu.distributed.store import (  # noqa: E402
+    TCPStore, _PyStoreServer)
+
+RESULTS = []
+
+
+def scenario(name):
+    def deco(fn):
+        RESULTS.append((name, fn))
+        return fn
+    return deco
+
+
+@scenario("store: dropped connects survived via backoff")
+def _store_backoff(seed):
+    srv = _PyStoreServer(0)
+    try:
+        plan = FaultPlan(seed=seed).add("store.connect", "drop", count=3)
+        with inject(plan):
+            store = TCPStore("127.0.0.1", srv.port, timeout=15)
+        store.set("k", b"v")
+        assert store.get("k") == b"v"
+        store.close()
+        assert len(plan.history) == 3, plan.history
+        return plan.history
+    finally:
+        srv.stop()
+
+
+@scenario("store: restart mid-rendezvous, idempotent replay")
+def _store_restart(seed):
+    srv = _PyStoreServer(0)
+    port = srv.port
+    store = TCPStore("127.0.0.1", port, timeout=10)
+    store.set("x", b"1")
+    srv.stop()
+    srv2 = _PyStoreServer(port)
+    try:
+        assert store.query("x") is None  # reconnected to the new server
+        store.close()
+        return ["reconnected"]
+    finally:
+        srv2.stop()
+
+
+@scenario("collective: straggler surfaces as named timeout + roster")
+def _collective_timeout(seed):
+    import paddle_tpu.distributed as dist
+    srv = _PyStoreServer(0)
+    store = TCPStore("127.0.0.1", srv.port, timeout=5)
+    try:
+        ft.enable_watchdog(timeout=0.3, store=store, rank=0, world_size=2)
+        plan = FaultPlan(seed=seed).add("collective.all_reduce", "stall",
+                                       delay=1.5)
+        t = paddle.to_tensor(np.ones(4, np.float32))
+        try:
+            with inject(plan):
+                dist.all_reduce(t)
+        except ft.CollectiveTimeoutError as e:
+            assert e.op == "all_reduce" and e.missing == [1], e
+            return plan.history
+        raise AssertionError("watchdog did not fire")
+    finally:
+        ft.disable_watchdog()
+        store.close()
+        srv.stop()
+
+
+@scenario("heartbeat: stalled rank detected on monotonic clock")
+def _heartbeat_stall(seed):
+    import tempfile
+    from paddle_tpu.distributed.fleet.elastic.manager import (
+        ElasticManager, ElasticStore)
+    with tempfile.TemporaryDirectory() as d:
+        store = ElasticStore(path=d)
+        writer = ElasticManager(rank=0, world_size=1, timeout=0.3,
+                                interval=0.05, store=store)
+        watcher = ElasticManager(rank=0, world_size=1, timeout=0.3,
+                                 interval=0.05, store=store)
+        plan = FaultPlan(seed=seed).add("heartbeat.beat", "drop",
+                                       after=1, count=None)
+        with inject(plan):
+            writer.start()
+            time.sleep(0.05)
+            assert watcher.dead_ranks() == []
+            time.sleep(0.6)
+            dead = watcher.dead_ranks()
+            writer.stop()
+        assert dead == [0], dead
+        return plan.history[:2]
+
+
+@scenario("checkpoint: post-commit rot caught, falls back to last good")
+def _checkpoint_rot(seed):
+    import tempfile
+    from paddle_tpu.distributed.checkpoint import (save_state_dict,
+                                                   load_state_dict)
+    with tempfile.TemporaryDirectory() as d:
+        good, bad = os.path.join(d, "g1"), os.path.join(d, "g2")
+        st = {"w": paddle.to_tensor(np.arange(4, dtype=np.float32))}
+        save_state_dict(st, good)
+        plan = FaultPlan(seed=seed).add("checkpoint.commit", "corrupt")
+        with inject(plan):
+            save_state_dict(st, bad)
+        ok, _ = ft.validate_checkpoint(bad)
+        assert not ok
+        target = {"w": paddle.to_tensor(np.zeros(4, np.float32))}
+        import warnings
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            load_state_dict(target, bad, fallback_path=d)
+        np.testing.assert_allclose(np.asarray(target["w"]._value),
+                                   np.arange(4, dtype=np.float32))
+        return plan.history
+
+
+@scenario("checkpoint: kill mid-save leaves visibly-incomplete dir")
+def _checkpoint_kill(seed):
+    import tempfile
+    from paddle_tpu.distributed.checkpoint import save_state_dict
+    with tempfile.TemporaryDirectory() as d:
+        ck = os.path.join(d, "ck")
+        st = {"w": paddle.to_tensor(np.ones(4, np.float32))}
+        plan = FaultPlan(seed=seed).add("checkpoint.write", "kill")
+        try:
+            with inject(plan):
+                save_state_dict(st, ck)
+        except SimulatedWorkerDeath:
+            ok, reasons = ft.validate_checkpoint(ck)
+            assert not ok and "manifest" in reasons[0], reasons
+            return plan.history
+        raise AssertionError("kill did not fire")
+
+
+@scenario("gradients: NaN poison caught by skip-step sentinel")
+def _nan_skip(seed):
+    from paddle_tpu import nn, optimizer
+    from paddle_tpu.amp import debugging
+    paddle.seed(0)
+    m = nn.Linear(4, 4)
+    opt = optimizer.SGD(learning_rate=0.1, parameters=m.parameters())
+    loss = m(paddle.to_tensor(np.ones((2, 4), np.float32))).sum()
+    loss.backward()
+    before = np.asarray(m.weight._value).copy()
+    plan = FaultPlan(seed=seed).add("grad.poison", "nan")
+    with inject(plan):
+        skipped = debugging.skip_step_on_nonfinite(opt)
+    assert skipped and debugging.last_nonfinite()["kind"] == "nan"
+    np.testing.assert_array_equal(np.asarray(m.weight._value), before)
+    return plan.history
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--seed", type=int, default=7)
+    args = ap.parse_args()
+    failures = 0
+    for name, fn in RESULTS:
+        t0 = time.monotonic()
+        try:
+            h1 = fn(args.seed)
+            h2 = fn(args.seed)  # determinism: identical replay
+            assert h1 == h2, f"replay diverged: {h1} vs {h2}"
+            dt = time.monotonic() - t0
+            print(f"PASS  {name}  ({dt:.1f}s, replayed identically)")
+        except Exception:
+            failures += 1
+            print(f"FAIL  {name}")
+            traceback.print_exc()
+    total = len(RESULTS)
+    print(f"\nfault smoke: {total - failures}/{total} scenarios passed "
+          f"(seed={args.seed})")
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,6 +1,7 @@
 """TCPStore rendezvous: native C++ server + python client, multiprocess."""
 import multiprocessing as mp
 import os
+import socket
 import sys
 import time
 
@@ -106,6 +107,40 @@ def test_multiprocess_barrier_rendezvous():
             assert vals == [0, 1, 2, 3]
     finally:
         master.close()
+
+
+def test_connect_timeout_path_is_bounded_and_named():
+    """No server: the client backs off with jitter and fails within the
+    deadline with a named TimeoutError — not a first-ECONNREFUSED hard
+    crash, not an unbounded hang."""
+    # grab a port with nothing listening on it
+    probe = socket.socket()
+    probe.bind(("127.0.0.1", 0))
+    port = probe.getsockname()[1]
+    probe.close()
+    t0 = time.monotonic()
+    with pytest.raises(TimeoutError, match=str(port)):
+        TCPStore("127.0.0.1", port, is_master=False, timeout=1)
+    elapsed = time.monotonic() - t0
+    assert elapsed < 10  # bounded by the deadline (plus slack)
+
+
+def test_per_op_timeout_kwarg_plumbs_to_socket():
+    srv = _PyStoreServer(0)
+    try:
+        store = TCPStore("127.0.0.1", srv.port, world_size=1, timeout=1)
+        assert store._sock.gettimeout() == 1.0  # settimeout plumbed
+        t0 = time.monotonic()
+        with pytest.raises(TimeoutError, match="get"):
+            store.get("key_that_never_arrives")
+        assert time.monotonic() - t0 < 8
+        # the connection was poisoned by the timeout; the next op
+        # transparently reconnects
+        store.set("k", b"v")
+        assert store.get("k") == b"v"
+        store.close()
+    finally:
+        srv.stop()
 
 
 def test_elastic_store_over_tcp_store(monkeypatch):
