@@ -205,6 +205,17 @@ def _hbm_peak_gb():
     return None
 
 
+def _mem_estimate(exe):
+    """The memory guard's pre-flight breakdown for the executable this
+    bench just ran (XLA memory_analysis + top-k resident buffers) —
+    recorded so an OOM'd config's report says WHAT did not fit."""
+    try:
+        est = exe.last_memory_estimate()
+        return est.to_dict() if est is not None else None
+    except Exception:
+        return None
+
+
 # ---------------------------------------------------------------------
 # Config #3 (headline): BERT-base MLM, static graph, AMP bf16
 # ---------------------------------------------------------------------
@@ -273,7 +284,8 @@ def bench_bert(on_tpu, peak):
             f"achieved={achieved/1e12:.1f} TF/s MFU={mfu:.3f}")
         return {"tokens_per_sec": round(tokens_per_sec, 1),
                 "step_ms": round(dt * 1e3, 2), "mfu": round(mfu, 4),
-                "hbm_peak_gb": _hbm_peak_gb()}
+                "hbm_peak_gb": _hbm_peak_gb(),
+                "memory_estimate": _mem_estimate(exe)}
     finally:
         paddle.disable_static()
 
@@ -419,7 +431,9 @@ def bench_resnet50(on_tpu):
             return attempt(B)
         except Exception as e:  # halve batch on HBM exhaustion
             last = e
-            if "RESOURCE_EXHAUSTED" not in str(e):
+            from paddle_tpu.memory import MemoryGuardError
+            if not isinstance(e, MemoryGuardError) \
+                    and "RESOURCE_EXHAUSTED" not in str(e):
                 raise
             nxt = (f"retrying at B={sizes[i + 1]}"
                    if i + 1 < len(sizes) else "no smaller size; giving up")
@@ -489,7 +503,8 @@ def bench_gpt(on_tpu, peak):
             return {"tokens_per_sec": round(tokens_per_sec, 1),
                     "step_ms": round(dt * 1e3, 2), "mfu": round(mfu, 4),
                     "n_params_m": round(n_params / 1e6), "batch": B,
-                    "hbm_peak_gb": _hbm_peak_gb()}
+                    "hbm_peak_gb": _hbm_peak_gb(),
+                    "memory_estimate": _mem_estimate(exe)}
         finally:
             paddle.disable_static()
 
@@ -501,7 +516,9 @@ def bench_gpt(on_tpu, peak):
             return attempt(B, S, n_iters)
         except Exception as e:  # halve batch on HBM exhaustion
             last = e
-            if "RESOURCE_EXHAUSTED" not in str(e):
+            from paddle_tpu.memory import MemoryGuardError
+            if not isinstance(e, MemoryGuardError) \
+                    and "RESOURCE_EXHAUSTED" not in str(e):
                 raise
             nxt = (f"retrying at B={sizes[i + 1][0]}"
                    if i + 1 < len(sizes) else "no smaller size; giving up")
@@ -790,6 +807,9 @@ def main():
             if res.get("hbm_peak_gb"):
                 payload["extra_metrics"]["bert_hbm_peak_gb"] = \
                     res["hbm_peak_gb"]
+            if res.get("memory_estimate"):
+                payload["extra_metrics"]["bert_memory_estimate"] = \
+                    res["memory_estimate"]
             if x32_bert:
                 # x32 (s64-free device program) measured pre-claim in a
                 # child; report the better headline, honestly labeled
@@ -811,6 +831,9 @@ def main():
                 "gpt_0p35b_flash_recompute_bf16_tokens_per_sec"] = \
                 res["tokens_per_sec"]
             payload["extra_metrics"]["gpt_mfu"] = res["mfu"]
+            if res.get("memory_estimate"):
+                payload["extra_metrics"]["gpt_memory_estimate"] = \
+                    res["memory_estimate"]
         elif name == "llama":
             payload["extra_metrics"][
                 "llama_0p3b_recompute_bf16_tokens_per_sec"] = \

@@ -7,6 +7,8 @@ API (synchronize maps to blocking on the last dispatched value).
 """
 from __future__ import annotations
 
+import contextlib as _contextlib
+
 import jax
 
 from ..core.place import (set_device, get_device, device_count,
@@ -226,23 +228,16 @@ def empty_cache():
     _CudaNamespace.empty_cache()
 
 
-class hbm_oom_context:
-    """Re-raise XLA RESOURCE_EXHAUSTED with the allocator summary
-    attached — the reference prints allocator stats on CUDA OOM."""
+@_contextlib.contextmanager
+def hbm_oom_context(program="<program>", estimate=None, site="exec.oom"):
+    """Re-raise XLA RESOURCE_EXHAUSTED structured (the reference prints
+    allocator stats on CUDA OOM).
 
-    def __enter__(self):
-        return self
-
-    def __exit__(self, etype, e, tb):
-        if e is None:
-            return False
-        msg = str(e)
-        if ("RESOURCE_EXHAUSTED" in msg or "Out of memory" in msg
-                or "out of memory" in msg):
-            raise RuntimeError(
-                f"{msg}\n\n{memory_summary()}\n"
-                "hints: shrink the batch, enable recompute "
-                "(jax.checkpoint / use_recompute), AMP bf16, or shard "
-                "params/optimizer state over a mesh axis "
-                "(sharding stage 2/3)") from e
-        return False
+    Delegates to the memory guard: the body runs under the injectable
+    ``exec.oom`` fault site and allocator failures re-raise as
+    ``memory.TpuOutOfMemoryError`` carrying the pre-flight estimate (the
+    caller's, or the thread's last one), live ``memory_stats()``, and
+    remediation hints.  Non-OOM errors pass through untouched."""
+    from ..memory.guard import oom_context
+    with oom_context(program=program, estimate=estimate, site=site):
+        yield

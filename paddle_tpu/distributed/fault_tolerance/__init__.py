@@ -19,7 +19,8 @@ See README.md §"Fault tolerance" for the env knobs.
 """
 from .plan import (FaultEvent, FaultPlan, inject, fault_point, active_plan,
                    clear_active_plan, InjectedFault, InjectedConnectionError,
-                   SimulatedWorkerDeath, ENV_FAULT_PLAN, corrupt_file)
+                   SimulatedWorkerDeath, InjectedResourceExhausted,
+                   ENV_FAULT_PLAN, corrupt_file)
 from .retry import backoff_delays, retry_call, RetryExhausted
 from .watchdog import (CollectiveWatchdog, CollectiveTimeoutError,
                        enable_watchdog, disable_watchdog, get_watchdog,
@@ -32,7 +33,8 @@ from .faults import poison_gradients
 __all__ = [
     "FaultEvent", "FaultPlan", "inject", "fault_point", "active_plan",
     "clear_active_plan", "InjectedFault", "InjectedConnectionError",
-    "SimulatedWorkerDeath", "ENV_FAULT_PLAN", "corrupt_file",
+    "SimulatedWorkerDeath", "InjectedResourceExhausted", "ENV_FAULT_PLAN",
+    "corrupt_file",
     "backoff_delays", "retry_call", "RetryExhausted",
     "CollectiveWatchdog", "CollectiveTimeoutError", "enable_watchdog",
     "disable_watchdog", "get_watchdog", "ENV_WATCHDOG_TIMEOUT",

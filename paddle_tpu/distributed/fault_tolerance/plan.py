@@ -15,6 +15,7 @@ Sites currently instrumented:
   collective.<op>              watchdog-wrapped collectives (ops.py)
   checkpoint.write             shard writes (checkpoint/save_load.py)
   grad.poison                  optimizer pre-step hook (NaN gradients)
+  exec.oom                     executor/jit dispatch (memory/guard.py)
   worker.step                  user training loops / smoke scripts
 
 Activation: ``with inject(plan): ...`` or the ``PADDLE_TPU_FAULT_PLAN``
@@ -33,7 +34,7 @@ import time
 __all__ = ["FaultEvent", "FaultPlan", "inject", "fault_point",
            "active_plan", "clear_active_plan", "InjectedFault",
            "InjectedConnectionError", "SimulatedWorkerDeath",
-           "ENV_FAULT_PLAN"]
+           "InjectedResourceExhausted", "ENV_FAULT_PLAN"]
 
 ENV_FAULT_PLAN = "PADDLE_TPU_FAULT_PLAN"
 
@@ -51,7 +52,14 @@ class SimulatedWorkerDeath(RuntimeError, InjectedFault):
     """A simulated worker kill; escapes retry loops by design."""
 
 
-_ACTIONS = ("drop", "delay", "stall", "kill", "corrupt", "nan")
+class InjectedResourceExhausted(RuntimeError, InjectedFault):
+    """A simulated device OOM.  The message contains RESOURCE_EXHAUSTED
+    so the memory guard's detection path treats it exactly like a real
+    XLA allocator failure (and the degradation ladder can be exercised
+    on CPU)."""
+
+
+_ACTIONS = ("drop", "delay", "stall", "kill", "corrupt", "nan", "oom")
 
 
 class FaultEvent:
@@ -202,6 +210,10 @@ class FaultPlan:
             raise SimulatedWorkerDeath(
                 f"fault-injection: worker killed at {site} "
                 f"(occurrence {idx})")
+        elif ev.action == "oom":
+            raise InjectedResourceExhausted(
+                f"RESOURCE_EXHAUSTED: fault-injection: out of memory "
+                f"at {site} (occurrence {idx})")
         elif ev.action == "corrupt" and path is not None:
             corrupt_file(path, seed=self.seed)
         return ev

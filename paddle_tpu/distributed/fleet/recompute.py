@@ -37,7 +37,11 @@ def recompute(function, *args, use_reentrant=True, preserve_rng_state=True,
     from ...nn.layer.layers import Layer
 
     params = []
-    fn_self = getattr(function, "__self__", None)
+    # a Layer passed directly (`recompute(blk, x)`) owns its params just
+    # like a bound method's __self__ does — without this, layer-call
+    # remat silently dropped every parameter gradient
+    fn_self = function if isinstance(function, Layer) \
+        else getattr(function, "__self__", None)
     if isinstance(fn_self, Layer):
         params = [p for p in fn_self.parameters() if not p.stop_gradient]
 

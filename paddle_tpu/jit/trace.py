@@ -149,7 +149,10 @@ class TracedFunction:
     def __call__(self, *args, **kwargs):
         if get_trace_ctx() is not None:
             return self._fn(*args, **kwargs)  # nested: already tracing
-        key = _tree_key((args, kwargs))
+        from ..memory.guard import remat_enabled
+        # the ladder's remat flip changes the traced program: a cached
+        # no-remat executable must not serve a remat-enabled retry
+        key = (_tree_key((args, kwargs)), remat_enabled())
         comp = self._cache.get(key)
         if comp is None:
             first_result, comp = self._discover_and_compile(args, kwargs)
@@ -273,8 +276,20 @@ class TracedFunction:
         ro_vals = concrete_values(ro_state)
         rw_vals = concrete_values(rw_state)
         compiled = jitted.lower(arg_vals, ro_vals, rw_vals).compile()
+        # memory guard pre-flight: hold the fresh executable to the HBM
+        # budget before its first dispatch (raises HbmBudgetError)
+        from ..memory.estimator import named_buffer_sizes
+        from ..memory.guard import preflight_check
+        label = f"jit:{getattr(self._orig_fn, '__qualname__', self._fn)}"
+        estimate = preflight_check(
+            compiled, program=label,
+            named_buffers=named_buffer_sizes(
+                [(f"state:{t.name or ('tensor_%d' % i)}", t)
+                 for i, t in enumerate(state)]))
         return {
             "compiled": compiled,
+            "label": label,
+            "estimate": estimate,
             "ro_state": ro_state,
             "rw_state": rw_state,
             "mutated": mutated,
@@ -288,8 +303,11 @@ class TracedFunction:
         arg_vals = _tensor_arg_values(args, kwargs)
         ro_vals = concrete_values(comp["ro_state"])
         rw_vals = concrete_values(comp["rw_state"])
-        out_vals, mut_vals, grad_vals = comp["compiled"](
-            arg_vals, ro_vals, rw_vals)
+        from ..memory.guard import oom_context
+        with oom_context(program=comp["label"],
+                         estimate=comp["estimate"]):
+            out_vals, mut_vals, grad_vals = comp["compiled"](
+                arg_vals, ro_vals, rw_vals)
         for t, v in zip(comp["mutated"], mut_vals):
             t._value = v
             t._grad_node = None

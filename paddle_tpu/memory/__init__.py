@@ -1,0 +1,42 @@
+"""HBM memory guard (pre-flight prediction, diagnosis, degradation).
+
+Three layers, consumed by both executors:
+
+  estimator.py  pre-flight footprint from ``Compiled.memory_analysis()``
+                + named parameter/optimizer-state residency, held to a
+                per-device budget (``PADDLE_TPU_HBM_BUDGET`` on CPU,
+                the allocator's real bytes_limit on TPU)
+  guard.py      the policy plane — HbmBudgetError BEFORE dispatch,
+                RESOURCE_EXHAUSTED re-raised as TpuOutOfMemoryError
+                with the estimator's breakdown + live memory_stats(),
+                the injectable ``exec.oom`` fault site, and the global
+                remat hook
+  ladder.py     opt-in degradation: remat → micro-batch grad
+                accumulation → halve batch, each rung logged
+
+See README.md §"Memory guard" for the env knobs.
+"""
+from .errors import (MemoryGuardError, HbmBudgetError, TpuOutOfMemoryError,
+                     format_bytes)
+from .estimator import (MemoryEstimate, ENV_HBM_BUDGET, parse_bytes,
+                        device_hbm_budget, analyze_compiled,
+                        named_buffer_sizes, check_budget)
+from .guard import (ENV_MEMORY_GUARD, guard_enabled, guard_mode,
+                    GuardPolicy, set_guard_policy, get_guard_policy,
+                    preflight_check, oom_context, is_oom_error,
+                    remat_enabled, set_remat, remat_scope, last_estimate,
+                    record_estimate)
+from .ladder import (GradAccumulator, split_feed, batch_size_of,
+                     run_with_ladder)
+
+__all__ = [
+    "MemoryGuardError", "HbmBudgetError", "TpuOutOfMemoryError",
+    "format_bytes",
+    "MemoryEstimate", "ENV_HBM_BUDGET", "parse_bytes", "device_hbm_budget",
+    "analyze_compiled", "named_buffer_sizes", "check_budget",
+    "ENV_MEMORY_GUARD", "guard_enabled", "guard_mode", "GuardPolicy",
+    "set_guard_policy", "get_guard_policy", "preflight_check",
+    "oom_context", "is_oom_error", "remat_enabled", "set_remat",
+    "remat_scope", "last_estimate", "record_estimate",
+    "GradAccumulator", "split_feed", "batch_size_of", "run_with_ladder",
+]

@@ -1,0 +1,133 @@
+"""Structured HBM exhaustion errors (the memory guard's vocabulary).
+
+Two failure shapes, one report format:
+
+  HbmBudgetError       pre-flight: the compiled executable's memory
+                       analysis says the program cannot fit the budget —
+                       raised BEFORE any device dispatch.
+  TpuOutOfMemoryError  runtime: the chip actually returned
+                       RESOURCE_EXHAUSTED; re-raised with the
+                       estimator's breakdown, the live allocator
+                       counters, and the fault-injection site id so the
+                       failure is replayable.
+
+Both subclass RuntimeError (existing ``except RuntimeError`` /
+"memory"-matching handlers keep working) and render the same top-k
+largest-buffer table, so an OOM report reads identically whether it was
+predicted or suffered.
+"""
+from __future__ import annotations
+
+__all__ = ["MemoryGuardError", "HbmBudgetError", "TpuOutOfMemoryError",
+           "format_bytes"]
+
+_GIB = 2.0 ** 30
+
+
+def format_bytes(n):
+    """Human-readable byte count (MiB under 1 GiB, else GiB)."""
+    if n is None:
+        return "?"
+    n = float(n)
+    if abs(n) < 2 ** 30:
+        return f"{n / 2 ** 20:.1f} MiB"
+    return f"{n / _GIB:.2f} GiB"
+
+
+class MemoryGuardError(RuntimeError):
+    """Base for memory-guard errors.
+
+    Attributes
+    ----------
+    program : str            name of the offending executable
+    estimate : MemoryEstimate | None   pre-flight breakdown (if one ran)
+    budget : int | None      HBM budget in bytes the program was held to
+    top_buffers : list[(name, bytes)]  largest resident buffers, desc
+    site : str               fault-injection site id ("exec.oom")
+    """
+
+    def __init__(self, message, program="<program>", estimate=None,
+                 budget=None, top_buffers=(), site="exec.oom"):
+        super().__init__(message)
+        self.program = program
+        self.estimate = estimate
+        self.budget = budget
+        self.top_buffers = list(top_buffers)
+        self.site = site
+
+
+def _report_lines(program, estimate, budget, top_buffers, shortfall=None):
+    lines = [f"  program: {program}"]
+    if estimate is not None:
+        lines.append(f"  estimated footprint: "
+                     f"{format_bytes(estimate.total_bytes)}"
+                     f" (args {format_bytes(estimate.argument_bytes)}"
+                     f" + temps {format_bytes(estimate.temp_bytes)}"
+                     f" + outputs {format_bytes(estimate.output_bytes)}"
+                     f" + code {format_bytes(estimate.generated_code_bytes)}"
+                     f" - aliased {format_bytes(estimate.alias_bytes)})")
+    if budget is not None:
+        lines.append(f"  HBM budget: {format_bytes(budget)}")
+    if shortfall is not None:
+        lines.append(f"  shortfall: {format_bytes(shortfall)}")
+    if top_buffers:
+        lines.append("  largest buffers:")
+        for name, nbytes in top_buffers:
+            lines.append(f"    {format_bytes(nbytes):>12}  {name}")
+    return lines
+
+
+_HINTS = ("hints: enable the degradation ladder "
+          "(PADDLE_TPU_MEMORY_GUARD=ladder / memory.GuardPolicy), enable "
+          "recompute (use_recompute / memory.remat_scope), accumulate "
+          "micro-batch gradients, shrink the batch, use AMP bf16, or "
+          "shard params/optimizer state over a mesh axis (stage 2/3)")
+
+
+class HbmBudgetError(MemoryGuardError):
+    """Predicted out-of-memory: raised after lowering, before execution.
+
+    Carries the shortfall (estimated footprint minus budget) and the
+    top-k largest buffers so the report names WHAT does not fit.
+    """
+
+    def __init__(self, program, estimate, budget, top_buffers=(),
+                 site="exec.oom"):
+        self.shortfall = max(0, int(estimate.total_bytes) - int(budget))
+        lines = ["predicted HBM out-of-memory (pre-flight check failed "
+                 "before device dispatch):"]
+        lines += _report_lines(program, estimate, budget, top_buffers,
+                               shortfall=self.shortfall)
+        lines.append(_HINTS)
+        super().__init__("\n".join(lines), program=program,
+                         estimate=estimate, budget=budget,
+                         top_buffers=top_buffers, site=site)
+
+
+class TpuOutOfMemoryError(MemoryGuardError):
+    """The chip reported RESOURCE_EXHAUSTED at runtime.
+
+    Wraps the raw XLA error with the pre-flight estimate (when one was
+    computed for this executable), a live ``memory_stats()`` snapshot,
+    and the fault-plan site id so the same OOM can be injected and
+    replayed (``FaultPlan.add("exec.oom", "oom")``).
+    """
+
+    def __init__(self, cause_message, program="<program>", estimate=None,
+                 budget=None, top_buffers=(), stats=None, site="exec.oom"):
+        self.stats = dict(stats or {})
+        lines = [f"out of device memory in {program!r} "
+                 f"(RESOURCE_EXHAUSTED at site {site!r}):",
+                 f"  {cause_message.strip().splitlines()[0][:300]}"]
+        lines += _report_lines(program, estimate, budget, top_buffers)
+        if self.stats:
+            lines.append("  live allocator:")
+            for key in ("bytes_in_use", "peak_bytes_in_use", "bytes_limit",
+                        "largest_alloc_size"):
+                if key in self.stats:
+                    lines.append(
+                        f"    {key:<22}{format_bytes(self.stats[key])}")
+        lines.append(_HINTS)
+        super().__init__("\n".join(lines), program=program,
+                         estimate=estimate, budget=budget,
+                         top_buffers=top_buffers, site=site)

@@ -1,0 +1,177 @@
+"""Pre-flight HBM estimation from XLA's compiled memory analysis.
+
+After ``jax.jit(...).lower(...).compile()`` the executable exposes
+``memory_analysis()`` — XLA's own buffer-assignment totals (argument /
+output / temp / generated-code bytes, plus input-output aliasing from
+buffer donation).  That is the ground truth of what the program will
+ask the allocator for, available BEFORE the first dispatch, so an
+over-budget step can be refused while the error is still cheap.
+
+The per-device budget comes from ``PADDLE_TPU_HBM_BUDGET`` (bytes, or
+``512M`` / ``8G`` suffix form — the CPU-test knob) or, on real TPU,
+the allocator's ``bytes_limit`` from ``memory_stats()``.
+"""
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+from typing import List, Optional, Tuple
+
+from .errors import HbmBudgetError
+
+__all__ = ["MemoryEstimate", "ENV_HBM_BUDGET", "parse_bytes",
+           "device_hbm_budget", "analyze_compiled", "named_buffer_sizes",
+           "check_budget"]
+
+ENV_HBM_BUDGET = "PADDLE_TPU_HBM_BUDGET"
+
+
+@dataclass
+class MemoryEstimate:
+    """One compiled executable's predicted HBM footprint."""
+
+    program: str = "<program>"
+    argument_bytes: int = 0       # inputs incl. params + optimizer state
+    output_bytes: int = 0
+    temp_bytes: int = 0           # activations / scratch
+    generated_code_bytes: int = 0
+    alias_bytes: int = 0          # donated in→out aliasing (not doubled)
+    # named resident buffers (params, opt state, feeds), largest first
+    buffers: List[Tuple[str, int]] = field(default_factory=list)
+
+    @property
+    def total_bytes(self) -> int:
+        return (self.argument_bytes + self.output_bytes + self.temp_bytes
+                + self.generated_code_bytes - self.alias_bytes)
+
+    def top_buffers(self, k=5):
+        """Top-k largest buffers, with XLA's temp/output totals ranked
+        alongside the named residents so the report names the real
+        hog even when it is activation scratch."""
+        rows = list(self.buffers)
+        if self.temp_bytes:
+            rows.append(("<xla temp buffers (activations/scratch)>",
+                         self.temp_bytes))
+        if self.output_bytes:
+            rows.append(("<xla outputs>", self.output_bytes))
+        rows.sort(key=lambda r: r[1], reverse=True)
+        return rows[:k]
+
+    def to_dict(self):
+        gib = 2.0 ** 30
+        return {
+            "program": self.program,
+            "argument_gb": round(self.argument_bytes / gib, 4),
+            "output_gb": round(self.output_bytes / gib, 4),
+            "temp_gb": round(self.temp_bytes / gib, 4),
+            "generated_code_gb": round(self.generated_code_bytes / gib, 4),
+            "alias_gb": round(self.alias_bytes / gib, 4),
+            "total_gb": round(self.total_bytes / gib, 4),
+            "top_buffers": [
+                {"name": n, "gb": round(b / gib, 4)}
+                for n, b in self.top_buffers(5)],
+        }
+
+
+def parse_bytes(spec):
+    """``"1073741824"`` | ``"512M"`` | ``"8G"`` | ``"1.5G"`` → bytes."""
+    if spec is None:
+        return None
+    if isinstance(spec, (int, float)):
+        return int(spec)
+    s = str(spec).strip().upper()
+    if not s:
+        return None
+    mult = 1
+    for suffix, m in (("KIB", 2**10), ("MIB", 2**20), ("GIB", 2**30),
+                      ("KB", 10**3), ("MB", 10**6), ("GB", 10**9),
+                      ("K", 2**10), ("M", 2**20), ("G", 2**30),
+                      ("B", 1)):
+        if s.endswith(suffix):
+            s = s[:-len(suffix)]
+            mult = m
+            break
+    return int(float(s) * mult)
+
+
+def device_hbm_budget(device=None):
+    """The budget a program must fit: ``PADDLE_TPU_HBM_BUDGET`` if set
+    (the CPU-test override), else the device allocator's ``bytes_limit``
+    (real on TPU; absent on CPU → None, meaning 'no check')."""
+    env = os.environ.get(ENV_HBM_BUDGET)
+    if env:
+        try:
+            return parse_bytes(env)
+        except ValueError:
+            import logging
+            logging.getLogger("paddle_tpu.memory").warning(
+                "unparseable %s=%r; ignoring", ENV_HBM_BUDGET, env)
+    from ..device import memory_stats
+    limit = memory_stats(device).get("bytes_limit")
+    return int(limit) if limit else None
+
+
+def named_buffer_sizes(named_tensors):
+    """[(name, Tensor-or-array)] → [(name, nbytes)] sorted desc.
+    Duplicate underlying buffers (same object) are counted once."""
+    out = []
+    seen = set()
+    for i, (name, t) in enumerate(named_tensors):
+        if t is None:
+            continue
+        v = getattr(t, "_value", t)
+        if id(v) in seen:
+            continue
+        seen.add(id(v))
+        try:
+            nbytes = int(v.size) * int(v.dtype.itemsize)
+        except Exception:
+            continue
+        out.append((name or f"buffer_{i}", nbytes))
+    out.sort(key=lambda r: r[1], reverse=True)
+    return out
+
+
+def analyze_compiled(compiled, program="<program>", named_buffers=None):
+    """Build a MemoryEstimate from ``Compiled.memory_analysis()``.
+
+    Returns None when the backend exposes no analysis (never raises —
+    estimation must not break execution on exotic backends)."""
+    try:
+        ma = compiled.memory_analysis()
+    except Exception:
+        return None
+    if ma is None:
+        return None
+
+    def _get(attr):
+        try:
+            return int(getattr(ma, attr, 0) or 0)
+        except Exception:
+            return 0
+
+    return MemoryEstimate(
+        program=program,
+        argument_bytes=_get("argument_size_in_bytes"),
+        output_bytes=_get("output_size_in_bytes"),
+        temp_bytes=_get("temp_size_in_bytes"),
+        generated_code_bytes=_get("generated_code_size_in_bytes"),
+        alias_bytes=_get("alias_size_in_bytes"),
+        buffers=list(named_buffers or []),
+    )
+
+
+def check_budget(estimate, budget=None, top_k=5, site="exec.oom"):
+    """Raise HbmBudgetError iff ``estimate`` exceeds ``budget``.
+
+    budget=None (no env override, no device limit) disables the check.
+    Returns the estimate for chaining."""
+    if estimate is None:
+        return None
+    if budget is None:
+        budget = device_hbm_budget()
+    if budget is not None and estimate.total_bytes > budget:
+        raise HbmBudgetError(estimate.program, estimate, budget,
+                             top_buffers=estimate.top_buffers(top_k),
+                             site=site)
+    return estimate

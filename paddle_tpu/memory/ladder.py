@@ -1,0 +1,238 @@
+"""The degradation ladder: retry an OOMing train step, rung by rung.
+
+Rung order (GuardPolicy.rungs):
+
+  remat        flip the global recompute hook on — transformer/GPT
+               blocks re-trace under jax.checkpoint, trading FLOPs for
+               activation memory
+  grad_accum   split the batch into ``policy.micro_batches``
+               micro-batches and accumulate gradients through the
+               optimizer's pre-step hook chain (apply every k-th step,
+               grads scaled by 1/k so the applied update equals the
+               full-batch step)
+  halve_batch  last resort: halve the batch with a loud warning,
+               repeatedly, down to ``policy.min_batch``
+
+``run_with_ladder`` drives an eager/jit train step through the rungs on
+*predicted* OOM (HbmBudgetError escaping a guarded jit compile) or
+*actual* OOM (TpuOutOfMemoryError / RESOURCE_EXHAUSTED, including the
+injected ``exec.oom`` fault).  Every rung taken is recorded on the
+policy and logged at WARNING so degraded runs are visibly degraded.
+"""
+from __future__ import annotations
+
+import logging
+
+import numpy as np
+
+from .errors import MemoryGuardError
+from .guard import (GuardPolicy, get_guard_policy, is_oom_error,
+                    remat_enabled, set_remat)
+
+__all__ = ["GradAccumulator", "split_feed", "batch_size_of",
+           "run_with_ladder"]
+
+logger = logging.getLogger("paddle_tpu.memory")
+
+
+# -- gradient accumulation via the optimizer pre-step hook ---------------
+class GradAccumulator:
+    """Accumulate gradients over ``k`` optimizer.step() calls.
+
+    Rides the PR-1 pre-step hook chain: on non-boundary steps the hook
+    sets ``optimizer._skip_apply`` so step() keeps the accumulated
+    ``p.grad`` and does not advance the step counter; on every k-th call
+    it scales the summed grads by 1/k (micro-losses are means over B/k,
+    so the applied update equals the full-batch mean-loss step) and
+    lets the fused apply run.
+    """
+
+    def __init__(self, k):
+        if int(k) < 1:
+            raise ValueError(f"GradAccumulator: k must be >= 1, got {k}")
+        self.k = int(k)
+        self._count = 0
+        self._opt = None
+        self._remove = None
+        self.just_applied = False
+
+    def attach(self, optimizer):
+        """Bind to ``optimizer`` and register on the global pre-step
+        hook chain.  Returns a zero-arg remover (also ``detach``)."""
+        from ..optimizer.optimizer import register_pre_step_hook
+        self._opt = optimizer
+        self._count = 0
+        self._remove = register_pre_step_hook(self)
+        return self.detach
+
+    def detach(self):
+        if self._remove is not None:
+            self._remove()
+            self._remove = None
+        self._opt = None
+
+    def __call__(self, optimizer, params):
+        if self._opt is not None and optimizer is not self._opt:
+            return  # a different optimizer's step; not ours to gate
+        self._count += 1
+        if self._count % self.k != 0:
+            self.just_applied = False
+            optimizer._skip_apply = True
+            return
+        inv = 1.0 / self.k
+        for p in params:
+            if p.grad is not None:
+                p.grad._local_value_update(p.grad._value * inv)
+        self.just_applied = True
+
+
+# -- feed slicing --------------------------------------------------------
+def batch_size_of(feed, axis=0):
+    """Leading-dim size shared by the batched arrays in ``feed``
+    (None when nothing in the feed has a batch axis)."""
+    for v in feed.values():
+        a = np.asarray(getattr(v, "_value", v))
+        if a.ndim > axis:
+            return int(a.shape[axis])
+    return None
+
+
+def split_feed(feed, k, axis=0):
+    """Split ``feed``'s batch axis into ``k`` contiguous micro-feeds.
+
+    Only arrays whose leading dim equals the feed's batch size are
+    sliced; scalars and non-batched values ride along whole.  ``k`` is
+    clamped to the batch size; micro-batches must divide evenly (the
+    1/k grad scaling assumes equal sizes) — trailing remainder rows go
+    to the last micro-batch only when unavoidable, with a warning.
+    """
+    b = batch_size_of(feed, axis)
+    if b is None or b <= 1:
+        return [feed]
+    k = max(1, min(int(k), b))
+    if b % k:
+        logger.warning("split_feed: batch %d not divisible by %d "
+                       "micro-batches; grad-accum equivalence is "
+                       "approximate", b, k)
+    bounds = [round(i * b / k) for i in range(k + 1)]
+    micros = []
+    for lo, hi in zip(bounds[:-1], bounds[1:]):
+        m = {}
+        for name, v in feed.items():
+            a = np.asarray(getattr(v, "_value", v))
+            if a.ndim > axis and a.shape[axis] == b:
+                idx = [slice(None)] * a.ndim
+                idx[axis] = slice(lo, hi)
+                m[name] = a[tuple(idx)]
+            else:
+                m[name] = v
+        micros.append(m)
+    return micros
+
+
+def _halve_feed(feed, axis=0):
+    b = batch_size_of(feed, axis)
+    half = max(1, b // 2)
+    out = {}
+    for name, v in feed.items():
+        a = np.asarray(getattr(v, "_value", v))
+        if a.ndim > axis and a.shape[axis] == b:
+            idx = [slice(None)] * a.ndim
+            idx[axis] = slice(0, half)
+            out[name] = a[tuple(idx)]
+        else:
+            out[name] = v
+    return out, half
+
+
+# -- the ladder ----------------------------------------------------------
+def _oomish(exc):
+    return isinstance(exc, MemoryGuardError) or is_oom_error(exc)
+
+
+def run_with_ladder(forward_backward, feed, optimizer=None, policy=None,
+                    batch_axis=0):
+    """Run one train step, degrading through the ladder on OOM.
+
+    ``forward_backward(feed)`` computes the loss and runs backward
+    (populating ``p.grad``); ``optimizer.step()`` / ``clear_grad()``
+    are driven here so the grad-accum rung can gate them.  With
+    ``optimizer=None`` only inference-style retries apply (remat,
+    halve_batch).
+
+    Returns ``(loss, policy)`` — ``policy.taken`` lists the rungs
+    engaged, ``[]`` for a clean first-try run.
+    """
+    policy = (policy if policy is not None
+              else get_guard_policy() or GuardPolicy())
+    pending = [r for r in policy.rungs]
+    cur_feed = feed
+    accum = False
+
+    def _attempt():
+        if accum and optimizer is not None:
+            micros = split_feed(cur_feed, policy.micro_batches, batch_axis)
+            acc = GradAccumulator(len(micros))
+            acc.attach(optimizer)
+            try:
+                for m in micros:
+                    loss = forward_backward(m)
+                    optimizer.step()
+            finally:
+                acc.detach()
+            optimizer.clear_grad()
+            return loss
+        loss = forward_backward(cur_feed)
+        if optimizer is not None:
+            optimizer.step()
+            optimizer.clear_grad()
+        return loss
+
+    while True:
+        try:
+            return _attempt(), policy
+        except Exception as e:
+            if not _oomish(e):
+                raise
+            if optimizer is not None:
+                optimizer.clear_grad()  # drop partial accumulation
+            engaged = False
+            while pending and not engaged:
+                rung = pending.pop(0)
+                if rung == "remat":
+                    if remat_enabled():
+                        continue
+                    set_remat(True)
+                    policy.record("remat",
+                                  "recompute enabled on guarded blocks")
+                    engaged = True
+                elif rung == "grad_accum":
+                    if optimizer is None or accum:
+                        continue
+                    b = batch_size_of(cur_feed, batch_axis)
+                    if b is None or b <= 1:
+                        continue
+                    accum = True
+                    policy.record(
+                        "grad_accum",
+                        f"{min(policy.micro_batches, b)} micro-batches "
+                        f"over batch {b}")
+                    engaged = True
+                elif rung == "halve_batch":
+                    b = batch_size_of(cur_feed, batch_axis)
+                    if b is None or b <= policy.min_batch:
+                        continue
+                    cur_feed, half = _halve_feed(cur_feed, batch_axis)
+                    policy.record("halve_batch",
+                                  f"batch {b} -> {half}")
+                    logger.warning(
+                        "memory guard: HALVING BATCH %d -> %d — results "
+                        "are NOT comparable to the requested batch size",
+                        b, half)
+                    if half > policy.min_batch:
+                        pending.insert(0, "halve_batch")  # may halve again
+                    engaged = True
+            if not engaged:
+                logger.error("memory guard: degradation ladder exhausted "
+                             "(rungs taken: %s); re-raising", policy.taken)
+                raise

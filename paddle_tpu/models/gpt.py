@@ -123,11 +123,15 @@ class GPTModel(nn.Layer):
         x = self.wte(input_ids) + self.wpe(pos)
         drop_active = (self.training
                        and self.config.hidden_dropout_prob > 0)
+        # the memory guard's ladder can flip recompute on globally
+        # without touching the model config
+        from ..memory.guard import remat_enabled
+        use_remat = self._recompute or remat_enabled()
         if (self.config.use_scan_layers and cache is None
                 and not use_cache and not drop_active):
             from ..nn.layer import scanned
             x = scanned.scan_layer_stack(self.h, x,
-                                         remat=self._recompute)
+                                         remat=use_remat)
             return self.ln_f(x)
         if (self.config.use_scan_layers and drop_active
                 and not getattr(self, "_scan_fallback_warned", False)):
@@ -143,7 +147,7 @@ class GPTModel(nn.Layer):
             if use_cache:
                 x, c = blk(x, layer_cache, True)
                 new_caches.append(c)
-            elif self._recompute and layer_cache is None:
+            elif use_remat and layer_cache is None:
                 from ..distributed.fleet.recompute import recompute
                 x = recompute(blk, x)
             else:

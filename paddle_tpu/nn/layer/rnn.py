@@ -352,12 +352,17 @@ def _zero_states(states):
 
 
 def _mask_states(keep, new, old):
-    """where(keep, new, old) over a state pytree (Tensor or nest)."""
+    """where(keep, new, old) over a state pytree (Tensor or nest).
+
+    ``keep`` is [B]; each state leaf may be any rank >= 1 with batch
+    leading (a custom cell can carry [B, H, W] maps), so the mask is
+    reshaped to [B, 1, ..., 1] to broadcast on the batch axis only."""
     from ...ops.manipulation import where
     if isinstance(new, (tuple, list)):
         return type(new)(_mask_states(keep, n, o)
                         for n, o in zip(new, old))
-    return where(keep.unsqueeze(-1), new, old)
+    return where(keep.reshape([-1] + [1] * (len(new.shape) - 1)),
+                 new, old)
 
 
 class RNN(Layer):
@@ -391,7 +396,10 @@ class RNN(Layer):
                 # processing order, so freezing the carry there makes
                 # the pass start from the sequence's true last token)
                 keep = sequence_length > t          # [B] bool
-                y = where(keep.unsqueeze(-1), y, y * 0)
+                # broadcast over batch only: a custom cell's output may
+                # be higher-rank than [B, H]
+                y = where(keep.reshape([-1] + [1] * (len(y.shape) - 1)),
+                          y, y * 0)
                 states = _mask_states(keep, new_states, states)
             else:
                 states = new_states

@@ -156,13 +156,22 @@ class TransformerEncoder(Layer):
              _clone_layer(encoder_layer) for i in range(num_layers)])
         self.num_layers = num_layers
         self.norm = norm
+        # per-instance recompute opt-in; the memory guard's global
+        # remat hook (memory.set_remat) overrides it on OOM degradation
+        self.enable_recompute = False
 
     def forward(self, src, src_mask=None, cache=None):
+        from ...memory.guard import remat_enabled
+        use_remat = self.enable_recompute or remat_enabled()
         out = src
         new_caches = []
         for i, layer in enumerate(self.layers):
             if cache is None:
-                out = layer(out, src_mask)
+                if use_remat:
+                    from ...distributed.fleet.recompute import recompute
+                    out = recompute(layer, out, src_mask)
+                else:
+                    out = layer(out, src_mask)
             else:
                 out, c = layer(out, src_mask, cache=cache[i])
                 new_caches.append(c)
@@ -285,14 +294,22 @@ class TransformerDecoder(Layer):
              for i in range(num_layers)])
         self.num_layers = num_layers
         self.norm = norm
+        self.enable_recompute = False
 
     def forward(self, tgt, memory, tgt_mask=None, memory_mask=None,
                 cache=None):
+        from ...memory.guard import remat_enabled
+        use_remat = self.enable_recompute or remat_enabled()
         out = tgt
         new_caches = []
         for i, layer in enumerate(self.layers):
             if cache is None:
-                out = layer(out, memory, tgt_mask, memory_mask)
+                if use_remat:
+                    from ...distributed.fleet.recompute import recompute
+                    out = recompute(layer, out, memory, tgt_mask,
+                                    memory_mask)
+                else:
+                    out = layer(out, memory, tgt_mask, memory_mask)
             else:
                 out, c = layer(out, memory, tgt_mask, memory_mask,
                                cache=cache[i])

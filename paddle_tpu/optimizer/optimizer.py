@@ -141,6 +141,13 @@ class Optimizer:
         else:
             for hook in _pre_step_hooks:
                 hook(self, params)
+        if getattr(self, "_skip_apply", False):
+            # a hook (gradient accumulation, skip-step sentinel) asked
+            # this step() to be a no-op: keep accumulated grads AND the
+            # step counter untouched (Adam bias correction must count
+            # applied updates only)
+            self._skip_apply = False
+            return
         if self._grad_clip is not None:
             self._grad_clip(params)
         l1 = self._l1_coeff()
@@ -167,16 +174,16 @@ class Optimizer:
         from ..static.framework import Variable, in_static_mode, \
             default_main_program
 
+        # `parameters`/`no_grad_set` restrict the update set for THIS
+        # call only (paddle semantics); the constructor list must not be
+        # permanently overwritten by one minimize() invocation.
+        scoped = self._parameter_list
         if parameters is not None:
-            # restrict the update set (paddle: minimize's `parameters`
-            # overrides the constructor list)
-            self._parameter_list = list(parameters)
+            scoped = list(parameters)
         if no_grad_set:
             excl = {id(t) for t in no_grad_set}
-            if self._parameter_list:
-                self._parameter_list = [
-                    p for p in self._parameter_list
-                    if id(p) not in excl]
+            if scoped:
+                scoped = [p for p in scoped if id(p) not in excl]
             else:
                 # no explicit list ("all trainables"): record the
                 # exclusion for the Executor's update-set selection —
@@ -190,9 +197,18 @@ class Optimizer:
             prog = default_main_program()
             prog._optimize_info = (self, loss)
             prog._loss_var = loss
+            if scoped is not self._parameter_list:
+                prog._minimize_params = list(scoped)
             return None, None
         loss.backward()
-        self.step()
+        if scoped is not self._parameter_list:
+            prev, self._parameter_list = self._parameter_list, scoped
+            try:
+                self.step()
+            finally:
+                self._parameter_list = prev
+        else:
+            self.step()
         return None, None
 
     # ---- static-graph path (used by static.Executor) ----

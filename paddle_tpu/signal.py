@@ -55,6 +55,46 @@ def stft(x, n_fft, hop_length=None, win_length=None, window=None,
                          onesided=bool(onesided)))
 
 
+def _check_nola(window_val, n_frames, n_fft, hop, win_length, center,
+                length):
+    """Reject windows whose squared overlap-add ~vanishes somewhere in
+    the returned region (NOLA violation): the COLA normalization would
+    divide by its 1e-11 floor there and amplify garbage ~1e11x instead
+    of reconstructing the signal."""
+    import numpy as np
+
+    if window_val is None:
+        win = np.ones((win_length,), np.float64)
+    else:
+        win = np.asarray(window_val, np.float64)
+    if win.shape[-1] < n_fft:
+        lp = (n_fft - win.shape[-1]) // 2
+        win = np.pad(win, (lp, n_fft - win.shape[-1] - lp))
+    out_len = n_fft + hop * (n_frames - 1)
+    wsq = np.zeros((out_len,), np.float64)
+    w2 = win * win
+    for i in range(n_frames):
+        wsq[i * hop:i * hop + n_fft] += w2
+    lo = n_fft // 2 if center else 0
+    if length is not None:
+        hi = min(lo + int(length), out_len)
+    elif center:
+        hi = out_len - n_fft // 2
+    else:
+        hi = out_len
+    if hi <= lo:
+        return
+    lowest = wsq[lo:hi].min()
+    if lowest < 1e-11:
+        raise ValueError(
+            "istft: window fails the NOLA (nonzero overlap-add) "
+            f"constraint for hop_length={hop}: the squared-window "
+            f"overlap-add reaches {lowest:.3e} inside the output region, "
+            "so the signal there cannot be reconstructed.  Use a longer "
+            "window, a smaller hop_length, or a window that overlaps to "
+            "a nonzero sum.")
+
+
 def istft(x, n_fft, hop_length=None, win_length=None, window=None,
           center=True, normalized=False, onesided=True, length=None,
           return_complex=False, name=None):
@@ -64,6 +104,14 @@ def istft(x, n_fft, hop_length=None, win_length=None, window=None,
             "onesided spectrum reconstructs a real signal")
     hop_length = hop_length or n_fft // 4
     win_length = win_length or n_fft
+
+    # NOLA pre-check on the concrete window (skipped when the window is
+    # a traced value — shapes alone can't prove the violation then)
+    wval = getattr(window, "_value", window) if window is not None else None
+    import jax
+    if not isinstance(wval, jax.core.Tracer) and len(x.shape) >= 2:
+        _check_nola(wval, int(x.shape[-1]), int(n_fft), int(hop_length),
+                    int(win_length), bool(center), length)
 
     def impl(spec, *w, n_fft, hop, win_length, center, normalized,
              onesided, length, return_complex):

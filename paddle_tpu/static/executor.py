@@ -66,6 +66,13 @@ class Executor:
     def __init__(self, place=None):
         self.place = place
         self._cache = {}
+        self._last_estimate = None
+
+    def last_memory_estimate(self):
+        """The memory guard's pre-flight estimate for the most recently
+        compiled executable (run or run_steps), or None when no guard
+        analysis ran — bench.py records this in the BENCH json."""
+        return self._last_estimate
 
     def _prologue(self, program, feed, fetch_list, n_steps):
         """Shared by run()/run_steps(): resolve (program, feed, fetch),
@@ -141,7 +148,8 @@ class Executor:
         if entry["compiled"] is None:
             entry["compiled"] = entry["compile_step"]()
         from ..device import hbm_oom_context
-        with hbm_oom_context():
+        with hbm_oom_context(program=entry["program_label"],
+                             estimate=entry["estimate"]):
             outs, new_params, new_opt_state, new_rng = entry["compiled"](
                 feed_vals, param_vals, opt_state_vals, rng_vals,
                 lr_val, step_val)
@@ -177,11 +185,15 @@ class Executor:
         opt = program._optimize_info  # (optimizer, loss_var) or None
         # the optimizer's parameter list restricts the UPDATE set: a
         # captured trainable the user excluded must stay frozen (it
-        # used to be updated regardless)
+        # used to be updated regardless).  A minimize(parameters=...)
+        # call scopes its restriction to the program, not the optimizer.
         allowed = None
         excluded = set()
         if opt is not None:
-            if getattr(opt[0], "_parameter_list", None):
+            scoped = getattr(program, "_minimize_params", None)
+            if scoped is not None:
+                allowed = {id(p) for p in scoped}
+            elif getattr(opt[0], "_parameter_list", None):
                 allowed = {id(p) for p in opt[0]._parameter_list}
             excluded = getattr(opt[0], "_no_grad_ids", set())
         trainable = [t for t in captured if not t.stop_gradient
@@ -279,15 +291,19 @@ class Executor:
         lr_aval = jax.ShapeDtypeStruct((), jnp.float32)
         step_aval = jax.ShapeDtypeStruct((), jnp.int32)
 
-        def compile_step():
-            # deferred: a run_steps-only caller (bench fused loop) must
-            # not pay the single-step XLA compile it never invokes
-            return jitted.lower(feed_avals, param_avals, opt_avals,
-                                rng_avals, lr_aval, step_aval).compile()
+        # named resident buffers for the memory guard's top-k report
+        # (params + optimizer state + frozen captures; feeds from avals)
+        from ..memory.estimator import named_buffer_sizes
+        named_buffers = named_buffer_sizes(
+            [(f"param:{p.name}", p) for p in trainable]
+            + [(f"opt_state:{t.name}", t) for t in opt_state]
+            + [(f"frozen:{t.name}", t) for t in frozen])
+        named_buffers += [
+            (f"feed:{n}", int(np.prod(a.shape)) * a.dtype.itemsize)
+            for n, a in zip(feed_names, feed_avals)]
 
-        return {
+        entry = {
             "compiled": None,
-            "compile_step": compile_step,
             "pure": pure,
             "donate": donate,
             "feed_names": feed_names,
@@ -296,27 +312,63 @@ class Executor:
             "params": trainable,
             "opt_state": opt_state,
             "rng_states": rng_states,
+            "named_buffers": named_buffers,
+            "program_label": f"static.Program#{block.idx}"
+                             f"[{len(block.ops)} ops]",
+            "estimate": None,
             "loop_fn": None,
+            "loop_estimate": None,
         }
+
+        def compile_step():
+            # deferred: a run_steps-only caller (bench fused loop) must
+            # not pay the single-step XLA compile it never invokes
+            compiled = jitted.lower(feed_avals, param_avals, opt_avals,
+                                    rng_avals, lr_aval, step_aval).compile()
+            # pre-flight: hold the executable to the HBM budget BEFORE
+            # the first dispatch (raises HbmBudgetError when over)
+            from ..memory.guard import preflight_check
+            entry["estimate"] = preflight_check(
+                compiled, program=entry["program_label"],
+                named_buffers=named_buffers)
+            self._last_estimate = entry["estimate"]
+            return compiled
+
+        entry["compile_step"] = compile_step
+        return entry
 
     # ------------------------------------------------------------------
     def run_steps(self, n_iters, program=None, feed=None, fetch_list=None,
                   return_numpy=True):
-        """Run the (program, feed) train step ``n_iters`` times as ONE
-        device program — ``lax.fori_loop`` over the step body with the
-        parameter/optimizer state as the loop carry — and return the
-        LAST iteration's fetches.
+        """Run ``n_iters`` train steps on ONE feed batch with a frozen
+        learning rate: every iteration re-reads the SAME ``feed`` dict
+        (no per-step data loading) and the LR resolved at call time (an
+        LRScheduler only advances between ``run_steps`` calls, never
+        inside one).
+
+        The loop is a single device program — ``lax.fori_loop`` over the
+        step body with the parameter/optimizer state as the loop carry —
+        returning the LAST iteration's fetches.  Callers who need a
+        fresh batch or an LR change per step must call ``run()`` per
+        step (or chunk: one ``run_steps`` call per batch); passing a
+        sequence of per-step feed dicts is rejected.
 
         TPU-first rationale: ``run()`` pays a host→device dispatch and a
         fetch sync per step; on a remote-tunneled TPU that round trip
         (~100 ms class) dwarfs a BERT-base step and the chip idles.  The
         reference hides the same overhead behind async CUDA launches
         [UNVERIFIED — empty reference mount]; the XLA-native equivalent
-        is to put the loop on the device.  LR is resolved once per call
-        (LRScheduler granularity is per ``run_steps`` call); the Adam
-        step counter advances per iteration in-graph.
+        is to put the loop on the device.  The Adam step counter still
+        advances per iteration in-graph.
         """
         assert n_iters >= 1
+        if isinstance(feed, (list, tuple)):
+            raise TypeError(
+                "run_steps(feed=...) takes ONE feed dict reused for all "
+                f"{n_iters} iterations (same-batch semantics); got a "
+                f"{type(feed).__name__} of {len(feed)} — per-step-varying "
+                "feeds need run() per step, or one run_steps call per "
+                "batch")
         if isinstance(program, CompiledProgram):
             program = program._program
         from .io import _LoadedInferenceProgram
@@ -354,12 +406,24 @@ class Executor:
                     feed_vals, params, opts, rngs, lr, step0 + n - 1)
                 return outs, params, opts, rngs
 
+            # AOT-compile (rather than dispatch through jax.jit) so the
+            # fused loop gets the same pre-flight budget check as run():
+            # memory_analysis is only exposed on an explicit Compiled
             loop_fn = jax.jit(
-                loop, donate_argnums=(1, 2) if entry["donate"] else ())
+                loop, donate_argnums=(1, 2) if entry["donate"] else ()
+            ).lower(feed_vals, param_vals, opt_state_vals, rng_vals,
+                    lr_val, step_val,
+                    jax.ShapeDtypeStruct((), jnp.int32)).compile()
+            from ..memory.guard import preflight_check
+            entry["loop_estimate"] = preflight_check(
+                loop_fn, program=entry["program_label"] + ".run_steps",
+                named_buffers=entry["named_buffers"])
+            self._last_estimate = entry["loop_estimate"]
             entry["loop_fn"] = loop_fn
 
         from ..device import hbm_oom_context
-        with hbm_oom_context():
+        with hbm_oom_context(program=entry["program_label"] + ".run_steps",
+                             estimate=entry["loop_estimate"]):
             outs, new_params, new_opt_state, new_rng = loop_fn(
                 feed_vals, param_vals, opt_state_vals, rng_vals,
                 lr_val, step_val, jnp.asarray(n_iters, jnp.int32))

@@ -1,0 +1,218 @@
+#!/usr/bin/env python
+"""Memory-guard smoke check: the whole OOM story, end to end, one command.
+
+    python scripts/oom_smoke.py [--seed N]
+
+Measures the real XLA footprint of a GPT-mini train step on CPU, sets
+PADDLE_TPU_HBM_BUDGET below it, and verifies every layer of the guard:
+the pre-flight HbmBudgetError (with its top-k buffer report), the
+structured TpuOutOfMemoryError wrapping of an injected exec.oom fault,
+and the degradation ladder carrying the over-budget step to completion
+(remat and/or grad-accum rungs logged).  Exits 0 iff every scenario
+passes.  CPU-only, no TPU needed.
+"""
+import argparse
+import logging
+import os
+import sys
+import time
+import traceback
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+import paddle_tpu as paddle  # noqa: E402
+from paddle_tpu import nn, optimizer, static  # noqa: E402
+from paddle_tpu.distributed.fault_tolerance.plan import (  # noqa: E402
+    FaultPlan, inject)
+from paddle_tpu.memory import (GuardPolicy, HbmBudgetError,  # noqa: E402
+                               TpuOutOfMemoryError, run_with_ladder)
+from paddle_tpu.memory.guard import (last_estimate, remat_scope,  # noqa: E402
+                                     set_remat)
+
+RESULTS = []
+
+GPT_CFG = dict(vocab_size=128, hidden_size=64, num_hidden_layers=2,
+               num_attention_heads=4, max_position_embeddings=64)
+B, T = 16, 48
+
+
+def scenario(name):
+    def deco(fn):
+        RESULTS.append((name, fn))
+        return fn
+    return deco
+
+
+def gpt_step(seed):
+    from paddle_tpu.models import GPTConfig, GPTForCausalLM
+    from paddle_tpu.models.gpt import GPTPretrainingCriterion
+    paddle.seed(seed)
+    m = GPTForCausalLM(GPTConfig(**GPT_CFG))
+    m.train()
+    opt = optimizer.SGD(learning_rate=0.01, parameters=m.parameters())
+    crit = GPTPretrainingCriterion()
+
+    def fb(ids, labels):
+        loss = crit(m(ids), labels)
+        loss.backward()
+        return loss
+
+    return m, opt, paddle.jit.to_static(fb)
+
+
+def gpt_feed(seed):
+    rng = np.random.RandomState(seed)
+    return {"ids": rng.randint(0, GPT_CFG["vocab_size"],
+                               (B, T)).astype(np.int64),
+            "labels": rng.randint(0, GPT_CFG["vocab_size"],
+                                  (B, T)).astype(np.int64)}
+
+
+def measure_budget(seed):
+    """Footprints of the full and remat'd step; a budget between them."""
+    feed = gpt_feed(seed)
+    _, _, step = gpt_step(seed)
+    step(paddle.to_tensor(feed["ids"]), paddle.to_tensor(feed["labels"]))
+    e_full = last_estimate().total_bytes
+    with remat_scope(True):
+        _, _, step_r = gpt_step(seed)
+        step_r(paddle.to_tensor(feed["ids"]),
+               paddle.to_tensor(feed["labels"]))
+        e_remat = last_estimate().total_bytes
+    assert e_remat < e_full, (e_remat, e_full)
+    return feed, (e_full + e_remat) // 2, e_full
+
+
+@scenario("pre-flight: over-budget step refused with top-k buffer report")
+def _preflight_refusal(seed):
+    feed, budget, e_full = measure_budget(seed)
+    os.environ["PADDLE_TPU_HBM_BUDGET"] = str(budget)
+    try:
+        _, _, step = gpt_step(seed)
+        try:
+            step(paddle.to_tensor(feed["ids"]),
+                 paddle.to_tensor(feed["labels"]))
+        except HbmBudgetError as e:
+            assert e.shortfall > 0 and "state:" in str(e), e
+            print(f"      refused: estimate {e_full}B > budget {budget}B, "
+                  f"shortfall {e.shortfall}B")
+            return [e.program, e.shortfall]
+        raise AssertionError("over-budget step was not refused")
+    finally:
+        os.environ.pop("PADDLE_TPU_HBM_BUDGET", None)
+
+
+@scenario("ladder: over-budget step completes via remat/grad-accum")
+def _ladder_completion(seed):
+    feed, budget, _ = measure_budget(seed)
+    os.environ["PADDLE_TPU_HBM_BUDGET"] = str(budget)
+    try:
+        m, opt, step = gpt_step(seed)
+
+        def fb(f):
+            return step(paddle.to_tensor(f["ids"]),
+                        paddle.to_tensor(f["labels"]))
+
+        loss, policy = run_with_ladder(fb, feed, optimizer=opt,
+                                       policy=GuardPolicy())
+        taken = [r for r, _ in policy.taken]
+        assert taken and taken[0] in ("remat", "grad_accum"), policy.taken
+        assert np.isfinite(float(loss)), loss
+        print(f"      completed at loss {float(loss):.3f} via rungs "
+              f"{taken}")
+        return taken
+    finally:
+        os.environ.pop("PADDLE_TPU_HBM_BUDGET", None)
+        set_remat(False)
+
+
+@scenario("diagnosis: injected exec.oom wrapped as TpuOutOfMemoryError")
+def _structured_diagnosis(seed):
+    paddle.enable_static()
+    try:
+        main = static.Program()
+        with static.program_guard(main):
+            x = static.data("x", [8, 16], "float32")
+            y = static.data("y", [8, 1], "float32")
+            pred = nn.Linear(16, 1)(x)
+            loss = paddle.nn.functional.mse_loss(pred, y)
+            opt = optimizer.SGD(learning_rate=0.1,
+                                parameters=main.all_parameters())
+            opt.minimize(loss)
+        feed = {"x": np.ones((8, 16), np.float32),
+                "y": np.ones((8, 1), np.float32)}
+        exe = static.Executor()
+        exe.run(main, feed=feed, fetch_list=[loss])  # compile clean
+        plan = FaultPlan(seed=seed).add("exec.oom", "oom", count=1)
+        try:
+            with inject(plan):
+                exe.run(main, feed=feed, fetch_list=[loss])
+        except TpuOutOfMemoryError as e:
+            assert e.site == "exec.oom", e.site
+            assert "RESOURCE_EXHAUSTED" in str(e)
+            assert e.estimate is not None
+            exe.run(main, feed=feed, fetch_list=[loss])  # plan spent
+            return plan.history
+        raise AssertionError("injected OOM was not wrapped")
+    finally:
+        paddle.disable_static()
+
+
+@scenario("ladder on injection: remat -> grad_accum -> halve_batch order")
+def _ladder_rung_order(seed):
+    paddle.seed(seed)
+    m = nn.Linear(4, 1)
+    opt = optimizer.SGD(learning_rate=0.1, parameters=m.parameters())
+    rng = np.random.RandomState(seed)
+    feed = {"x": rng.rand(8, 4).astype(np.float32),
+            "y": rng.rand(8, 1).astype(np.float32)}
+    from paddle_tpu.distributed.fault_tolerance.plan import fault_point
+
+    def fb(f):
+        fault_point("exec.oom")
+        loss = paddle.nn.functional.mse_loss(
+            m(paddle.to_tensor(f["x"])), paddle.to_tensor(f["y"]))
+        loss.backward()
+        return loss
+
+    plan = FaultPlan(seed=seed).add("exec.oom", "oom", count=3)
+    try:
+        with inject(plan):
+            loss, policy = run_with_ladder(fb, feed, optimizer=opt,
+                                           policy=GuardPolicy())
+        taken = [r for r, _ in policy.taken]
+        assert taken == ["remat", "grad_accum", "halve_batch"], taken
+        assert np.isfinite(float(loss))
+        return taken
+    finally:
+        set_remat(False)
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--seed", type=int, default=7)
+    args = ap.parse_args()
+    logging.basicConfig(level=logging.WARNING)
+    failures = 0
+    for name, fn in RESULTS:
+        t0 = time.monotonic()
+        try:
+            fn(args.seed)
+            dt = time.monotonic() - t0
+            print(f"PASS  {name}  ({dt:.1f}s)")
+        except Exception:
+            failures += 1
+            print(f"FAIL  {name}")
+            traceback.print_exc()
+    total = len(RESULTS)
+    print(f"\noom smoke: {total - failures}/{total} scenarios passed "
+          f"(seed={args.seed})")
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -167,3 +167,27 @@ def test_signal_stft_istft_roundtrip_vs_torch():
                               window=paddle.to_tensor(win),
                               length=400).numpy()
     np.testing.assert_allclose(rec, x, rtol=1e-3, atol=1e-4)
+
+
+def test_istft_rejects_nola_violating_window():
+    """A window/hop combination whose squared overlap-add vanishes
+    inside the output region must raise instead of 'reconstructing'
+    1e11x-amplified garbage through the normalization floor."""
+    x = np.random.RandomState(0).randn(400).astype(np.float32)
+    spec = paddle.signal.stft(paddle.to_tensor(x), n_fft=64,
+                              hop_length=16)
+    # zero window: overlap-add is identically zero everywhere
+    with pytest.raises(ValueError, match="NOLA"):
+        paddle.signal.istft(spec, n_fft=64, hop_length=16,
+                            window=paddle.to_tensor(
+                                np.zeros(64, np.float32)))
+    # short window + hop > win_length: gaps between frames
+    with pytest.raises(ValueError, match="NOLA"):
+        paddle.signal.istft(spec, n_fft=64, hop_length=16,
+                            win_length=8,
+                            window=paddle.to_tensor(
+                                np.ones(8, np.float32)))
+    # a proper window still reconstructs
+    rec = paddle.signal.istft(spec, n_fft=64, hop_length=16,
+                              length=400).numpy()
+    np.testing.assert_allclose(rec, x, rtol=1e-3, atol=1e-4)

@@ -1,0 +1,422 @@
+"""Memory guard: pre-flight HBM estimation, structured OOM diagnosis,
+and the degradation ladder (remat -> grad_accum -> halve_batch).
+
+CPU-only: budgets come from PADDLE_TPU_HBM_BUDGET and runtime OOM from
+the injected ``exec.oom`` fault, so every layer is testable without a
+TPU.  The GPT-mini acceptance test measures the real XLA estimate of a
+full train step, sets the budget just below it, and asserts the
+unguarded run refuses pre-flight while the guarded run completes
+through the ladder.
+"""
+import logging
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import memory, nn, optimizer, static
+from paddle_tpu.distributed.fault_tolerance.plan import (
+    FaultPlan, InjectedResourceExhausted, fault_point, inject)
+from paddle_tpu.memory import (GradAccumulator, GuardPolicy, HbmBudgetError,
+                               TpuOutOfMemoryError, analyze_compiled,
+                               batch_size_of, check_budget,
+                               device_hbm_budget, parse_bytes,
+                               run_with_ladder, split_feed)
+from paddle_tpu.memory.estimator import MemoryEstimate
+from paddle_tpu.memory.guard import (last_estimate, remat_enabled,
+                                     remat_scope, set_guard_policy,
+                                     set_remat)
+
+pytestmark = pytest.mark.memory
+
+
+@pytest.fixture(autouse=True)
+def _guard_reset(monkeypatch):
+    """Each test starts with no budget, default guard mode, remat off,
+    and no installed policy — and leaves the process the same way."""
+    monkeypatch.delenv("PADDLE_TPU_HBM_BUDGET", raising=False)
+    monkeypatch.delenv("PADDLE_TPU_MEMORY_GUARD", raising=False)
+    set_remat(False)
+    set_guard_policy(None)
+    yield
+    set_remat(False)
+    set_guard_policy(None)
+    paddle.disable_static()
+
+
+# ---------------------------------------------------------------- units
+def test_parse_bytes_forms():
+    assert parse_bytes("1024") == 1024
+    assert parse_bytes(2048) == 2048
+    assert parse_bytes("512M") == 512 * 2**20
+    assert parse_bytes("8G") == 8 * 2**30
+    assert parse_bytes("1.5G") == int(1.5 * 2**30)
+    assert parse_bytes("2GiB") == 2 * 2**30
+    assert parse_bytes("3MB") == 3 * 10**6
+    assert parse_bytes("") is None
+    assert parse_bytes(None) is None
+
+
+def test_device_hbm_budget_env(monkeypatch):
+    monkeypatch.setenv("PADDLE_TPU_HBM_BUDGET", "64M")
+    assert device_hbm_budget() == 64 * 2**20
+    # CPU allocator exposes no bytes_limit -> no check
+    monkeypatch.delenv("PADDLE_TPU_HBM_BUDGET")
+    assert device_hbm_budget() is None
+
+
+def test_estimator_matches_actual_jitted_program():
+    """XLA's memory analysis vs. the actual array sizes of a small
+    program: argument bytes are exact, outputs within alignment slop."""
+    import jax
+
+    def f(a, b):
+        return a @ b, (a * 2.0).sum()
+
+    a = np.zeros((64, 128), np.float32)
+    b = np.zeros((128, 32), np.float32)
+    compiled = jax.jit(f).lower(a, b).compile()
+    est = analyze_compiled(compiled, program="probe",
+                           named_buffers=[("input:a", a.nbytes),
+                                          ("input:b", b.nbytes)])
+    assert est is not None
+    assert est.argument_bytes == a.nbytes + b.nbytes
+    expect_out = 64 * 32 * 4 + 4
+    assert expect_out <= est.output_bytes <= expect_out + 4096
+    assert est.total_bytes >= est.argument_bytes + est.output_bytes
+    # the matmul needs scratch; the report ranks it with the residents
+    names = [n for n, _ in est.top_buffers(10)]
+    assert "input:a" in names
+
+
+def test_hbm_budget_error_topk_report():
+    est = MemoryEstimate(program="gpt-mini step",
+                         argument_bytes=800, output_bytes=100,
+                         temp_bytes=3000, generated_code_bytes=50,
+                         buffers=[("param:embedding.w_0", 600),
+                                  ("opt:adam_m:embedding.w_0", 200)])
+    with pytest.raises(HbmBudgetError) as ei:
+        check_budget(est, budget=1000)
+    e = ei.value
+    assert e.program == "gpt-mini step"
+    assert e.budget == 1000
+    assert e.shortfall == est.total_bytes - 1000
+    assert e.site == "exec.oom"
+    # report names the program, the shortfall, and the top-k buffers
+    msg = str(e)
+    assert "gpt-mini step" in msg and "shortfall" in msg
+    assert "param:embedding.w_0" in msg
+    assert "<xla temp buffers (activations/scratch)>" in msg
+    # temps (3000) outrank the largest named resident (600)
+    assert e.top_buffers[0][0].startswith("<xla temp")
+    # within budget: no raise, estimate passes through
+    assert check_budget(est, budget=est.total_bytes) is est
+    # no budget at all: check disabled
+    assert check_budget(est, budget=None) is est
+
+
+def test_split_feed_and_batch_size():
+    feed = {"x": np.zeros((8, 4), np.float32),
+            "y": np.zeros((8, 1), np.float32),
+            "lr": np.float32(0.1)}
+    assert batch_size_of(feed) == 8
+    micros = split_feed(feed, 2)
+    assert len(micros) == 2
+    assert micros[0]["x"].shape == (4, 4)
+    assert micros[1]["y"].shape == (4, 1)
+    assert micros[0]["lr"] == np.float32(0.1)  # non-batched rides whole
+    # k clamps to the batch size
+    assert len(split_feed({"x": np.zeros((2, 3))}, 5)) == 2
+
+
+# ------------------------------------------- static executor pre-flight
+def _static_train_program():
+    main = static.Program()
+    with static.program_guard(main):
+        x = static.data("x", [16, 32], "float32")
+        y = static.data("y", [16, 1], "float32")
+        h = nn.Linear(32, 64)(x)
+        h = paddle.nn.functional.relu(h)
+        pred = nn.Linear(64, 1)(h)
+        loss = paddle.nn.functional.mse_loss(pred, y)
+        opt = optimizer.SGD(learning_rate=0.1,
+                            parameters=main.all_parameters())
+        opt.minimize(loss)
+    feed = {"x": np.random.RandomState(0).rand(16, 32).astype(np.float32),
+            "y": np.ones((16, 1), np.float32)}
+    return main, loss, feed
+
+
+def test_static_preflight_over_budget_names_buffers(monkeypatch):
+    paddle.enable_static()
+    main, loss, feed = _static_train_program()
+    monkeypatch.setenv("PADDLE_TPU_HBM_BUDGET", "4K")
+    exe = static.Executor()
+    with pytest.raises(HbmBudgetError) as ei:
+        exe.run(main, feed=feed, fetch_list=[loss])
+    msg = str(ei.value)
+    assert "param:" in msg            # top-k names the resident params
+    assert "HBM budget" in msg and "shortfall" in msg
+    assert ei.value.estimate is not None
+    assert ei.value.estimate.total_bytes > 4096
+
+
+def test_static_preflight_under_budget_records_estimate(monkeypatch):
+    paddle.enable_static()
+    main, loss, feed = _static_train_program()
+    monkeypatch.setenv("PADDLE_TPU_HBM_BUDGET", "4G")
+    exe = static.Executor()
+    (lv,) = exe.run(main, feed=feed, fetch_list=[loss])
+    assert np.isfinite(float(lv))
+    est = exe.last_memory_estimate()
+    assert est is not None and est.total_bytes > 0
+    d = est.to_dict()
+    assert d["total_gb"] >= 0 and d["top_buffers"]
+
+
+# ------------------------------------------ structured runtime diagnosis
+def test_injected_oom_becomes_structured_error():
+    paddle.enable_static()
+    main, loss, feed = _static_train_program()
+    exe = static.Executor()
+    exe.run(main, feed=feed, fetch_list=[loss])  # compile clean
+    plan = FaultPlan(seed=1).add("exec.oom", "oom", count=1)
+    with inject(plan):
+        with pytest.raises(TpuOutOfMemoryError) as ei:
+            exe.run(main, feed=feed, fetch_list=[loss])
+    e = ei.value
+    assert e.site == "exec.oom"
+    assert "RESOURCE_EXHAUSTED" in str(e)
+    assert "static.Program" in str(e)        # names the program
+    assert isinstance(e.__cause__, InjectedResourceExhausted)
+    assert e.estimate is not None            # pre-flight breakdown rides
+    assert plan.history and plan.history[0][0] == "exec.oom"
+    # the plan is spent: the next run is clean again
+    exe.run(main, feed=feed, fetch_list=[loss])
+
+
+def test_guard_off_passes_raw_error_through(monkeypatch):
+    monkeypatch.setenv("PADDLE_TPU_MEMORY_GUARD", "off")
+    paddle.enable_static()
+    main, loss, feed = _static_train_program()
+    exe = static.Executor()
+    exe.run(main, feed=feed, fetch_list=[loss])
+    plan = FaultPlan(seed=1).add("exec.oom", "oom", count=1)
+    with inject(plan):
+        with pytest.raises(InjectedResourceExhausted):
+            exe.run(main, feed=feed, fetch_list=[loss])
+
+
+# ------------------------------------------------------------ the ladder
+def _eager_step():
+    paddle.seed(5)
+    m = nn.Linear(4, 1)
+    opt = optimizer.SGD(learning_rate=0.1, parameters=m.parameters())
+    rng = np.random.RandomState(2)
+    feed = {"x": rng.rand(8, 4).astype(np.float32),
+            "y": rng.rand(8, 1).astype(np.float32)}
+
+    def forward_backward(f):
+        fault_point("exec.oom")  # the guarded-dispatch probe
+        pred = m(paddle.to_tensor(f["x"]))
+        loss = paddle.nn.functional.mse_loss(pred, paddle.to_tensor(f["y"]))
+        loss.backward()
+        return loss
+
+    return m, opt, feed, forward_backward
+
+
+def _rungs(policy):
+    return [r for r, _ in policy.taken]
+
+
+def test_ladder_rung_remat():
+    m, opt, feed, fb = _eager_step()
+    plan = FaultPlan(seed=3).add("exec.oom", "oom", count=1)
+    with inject(plan):
+        loss, policy = run_with_ladder(fb, feed, optimizer=opt,
+                                       policy=GuardPolicy())
+    assert _rungs(policy) == ["remat"]
+    assert remat_enabled()  # the rung flipped the global hook
+    assert np.isfinite(float(loss))
+
+
+def test_ladder_rung_grad_accum():
+    m, opt, feed, fb = _eager_step()
+    w0 = m.weight.numpy().copy()
+    plan = FaultPlan(seed=3).add("exec.oom", "oom", count=2)
+    with inject(plan):
+        loss, policy = run_with_ladder(fb, feed, optimizer=opt,
+                                       policy=GuardPolicy())
+    assert _rungs(policy) == ["remat", "grad_accum"]
+    assert np.isfinite(float(loss))
+    assert not np.allclose(m.weight.numpy(), w0)  # the update applied
+    assert m.weight.grad is None or np.allclose(
+        m.weight.grad.numpy(), 0)  # and the grads were cleared
+
+
+def test_ladder_rung_halve_batch(caplog):
+    m, opt, feed, fb = _eager_step()
+    plan = FaultPlan(seed=3).add("exec.oom", "oom", count=3)
+    with caplog.at_level(logging.WARNING, logger="paddle_tpu.memory"):
+        with inject(plan):
+            loss, policy = run_with_ladder(fb, feed, optimizer=opt,
+                                           policy=GuardPolicy())
+    assert _rungs(policy) == ["remat", "grad_accum", "halve_batch"]
+    assert np.isfinite(float(loss))
+    assert any("HALVING BATCH" in r.message for r in caplog.records)
+
+
+def test_ladder_exhausted_reraises():
+    m, opt, feed, fb = _eager_step()
+    plan = FaultPlan(seed=3).add("exec.oom", "oom", count=None)  # always
+    with inject(plan):
+        with pytest.raises(RuntimeError, match="RESOURCE_EXHAUSTED"):
+            run_with_ladder(fb, feed, optimizer=opt,
+                            policy=GuardPolicy())
+
+
+def test_clean_run_takes_no_rungs():
+    m, opt, feed, fb = _eager_step()
+    loss, policy = run_with_ladder(fb, feed, optimizer=opt,
+                                   policy=GuardPolicy())
+    assert policy.taken == []
+    assert not remat_enabled()
+
+
+# -------------------------------------------- grad-accum equivalence
+def test_grad_accum_numerically_equals_full_batch():
+    """k accumulated micro-steps must apply the same update as one
+    full-batch step: grads sum across backward calls, the boundary hook
+    scales by 1/k (micro-losses are means over B/k)."""
+    rng = np.random.RandomState(7)
+    x = rng.rand(8, 6).astype(np.float32)
+    y = rng.rand(8, 3).astype(np.float32)
+
+    def make():
+        paddle.seed(11)
+        m = nn.Linear(6, 3)
+        opt = optimizer.SGD(learning_rate=0.2,
+                            parameters=m.parameters())
+        return m, opt
+
+    m_full, o_full = make()
+    loss = paddle.nn.functional.mse_loss(
+        m_full(paddle.to_tensor(x)), paddle.to_tensor(y))
+    loss.backward()
+    o_full.step()
+    o_full.clear_grad()
+
+    m_acc, o_acc = make()
+    w0 = m_acc.weight.numpy().copy()
+    acc = GradAccumulator(2)
+    acc.attach(o_acc)
+    try:
+        for sl in (slice(0, 4), slice(4, 8)):
+            loss = paddle.nn.functional.mse_loss(
+                m_acc(paddle.to_tensor(x[sl])),
+                paddle.to_tensor(y[sl]))
+            loss.backward()
+            o_acc.step()
+            if sl.start == 0:
+                # non-boundary: apply skipped, weights untouched
+                assert not acc.just_applied
+                np.testing.assert_array_equal(m_acc.weight.numpy(), w0)
+        assert acc.just_applied
+    finally:
+        acc.detach()
+    o_acc.clear_grad()
+
+    np.testing.assert_allclose(m_acc.weight.numpy(),
+                               m_full.weight.numpy(), rtol=1e-5, atol=1e-7)
+    np.testing.assert_allclose(m_acc.bias.numpy(),
+                               m_full.bias.numpy(), rtol=1e-5, atol=1e-7)
+    # detached: plain steps apply again
+    loss = paddle.nn.functional.mse_loss(
+        m_acc(paddle.to_tensor(x)), paddle.to_tensor(y))
+    loss.backward()
+    before = m_acc.weight.numpy().copy()
+    o_acc.step()
+    assert not np.allclose(m_acc.weight.numpy(), before)
+
+
+# --------------------------------- GPT-mini acceptance (budget-driven)
+_GPT_CFG = dict(vocab_size=128, hidden_size=64, num_hidden_layers=2,
+                num_attention_heads=4, max_position_embeddings=64)
+_B, _T = 16, 48
+
+
+def _gpt_train_step():
+    """A fresh GPT-mini + to_static forward/backward step (one XLA
+    executable -> one pre-flight estimate)."""
+    from paddle_tpu.models import GPTConfig, GPTForCausalLM
+    from paddle_tpu.models.gpt import GPTPretrainingCriterion
+    paddle.seed(0)
+    m = GPTForCausalLM(GPTConfig(**_GPT_CFG))
+    m.train()
+    opt = optimizer.SGD(learning_rate=0.01, parameters=m.parameters())
+    crit = GPTPretrainingCriterion()
+
+    def fb(ids, labels):
+        logits = m(ids)
+        loss = crit(logits, labels)
+        loss.backward()
+        return loss
+
+    return m, opt, paddle.jit.to_static(fb)
+
+
+def _gpt_feed():
+    rng = np.random.RandomState(0)
+    return {"ids": rng.randint(0, _GPT_CFG["vocab_size"],
+                               (_B, _T)).astype(np.int64),
+            "labels": rng.randint(0, _GPT_CFG["vocab_size"],
+                                  (_B, _T)).astype(np.int64)}
+
+
+def test_gpt_mini_budget_guard_acceptance(monkeypatch, caplog):
+    """The acceptance criterion end to end: with the HBM budget set
+    below a GPT-mini train step's measured footprint, the unguarded run
+    raises HbmBudgetError naming the top-k buffers, and the guarded run
+    completes through the ladder with remat/grad-accum logged."""
+    feed = _gpt_feed()
+
+    # measure the real footprints (no budget -> pre-flight records only)
+    _, _, step = _gpt_train_step()
+    step(paddle.to_tensor(feed["ids"]), paddle.to_tensor(feed["labels"]))
+    e_full = last_estimate().total_bytes
+    with remat_scope(True):
+        _, _, step_r = _gpt_train_step()
+        step_r(paddle.to_tensor(feed["ids"]),
+               paddle.to_tensor(feed["labels"]))
+        e_remat = last_estimate().total_bytes
+    assert e_remat < e_full, (e_remat, e_full)  # remat must save memory
+
+    budget = (e_full + e_remat) // 2
+    monkeypatch.setenv("PADDLE_TPU_HBM_BUDGET", str(budget))
+
+    # unguarded: pre-flight refuses before any dispatch
+    _, _, step_cold = _gpt_train_step()
+    with pytest.raises(HbmBudgetError) as ei:
+        step_cold(paddle.to_tensor(feed["ids"]),
+                  paddle.to_tensor(feed["labels"]))
+    assert ei.value.shortfall > 0
+    assert "state:" in str(ei.value)  # top-k names the model state
+    assert ei.value.estimate.total_bytes == e_full
+
+    # guarded: the ladder degrades until the step fits and completes
+    m, opt, step_g = _gpt_train_step()
+
+    def fb(f):
+        return step_g(paddle.to_tensor(f["ids"]),
+                      paddle.to_tensor(f["labels"]))
+
+    policy = GuardPolicy()
+    with caplog.at_level(logging.WARNING, logger="paddle_tpu.memory"):
+        loss, policy = run_with_ladder(fb, feed, optimizer=opt,
+                                       policy=policy)
+    assert np.isfinite(float(loss))
+    taken = _rungs(policy)
+    assert taken, "over-budget run must degrade through the ladder"
+    assert taken[0] in ("remat", "grad_accum")
+    assert any("degradation rung" in r.message for r in caplog.records)

@@ -193,3 +193,30 @@ def test_l1_decay_static_parity():
             w.numpy().ravel(), [1.95, -2.95], rtol=1e-6)
     finally:
         paddle.disable_static()
+
+
+def test_minimize_parameters_scopes_single_call():
+    """minimize(parameters=...) restricts the update to THIS call only;
+    the constructor's parameter list survives for later steps."""
+    paddle.seed(21)
+    m1 = nn.Linear(3, 2)
+    m2 = nn.Linear(3, 2)
+    opt = optimizer.SGD(learning_rate=0.1,
+                        parameters=list(m1.parameters())
+                        + list(m2.parameters()))
+    x = paddle.to_tensor(np.ones((4, 3), np.float32))
+    w1_0, w2_0 = m1.weight.numpy().copy(), m2.weight.numpy().copy()
+
+    loss = (m1(x) + m2(x)).sum()
+    opt.minimize(loss, parameters=list(m1.parameters()))
+    assert not np.allclose(m1.weight.numpy(), w1_0)  # scoped set moved
+    np.testing.assert_array_equal(m2.weight.numpy(), w2_0)  # rest frozen
+    opt.clear_grad()
+
+    # the restriction did not stick: a plain step updates everything
+    w1_1, w2_1 = m1.weight.numpy().copy(), m2.weight.numpy().copy()
+    loss = (m1(x) + m2(x)).sum()
+    loss.backward()
+    opt.step()
+    assert not np.allclose(m1.weight.numpy(), w1_1)
+    assert not np.allclose(m2.weight.numpy(), w2_1)

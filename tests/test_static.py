@@ -286,11 +286,14 @@ def test_run_steps_varying_n_single_compile():
     main_b, loss_b = _build_mlp_program(21)
     exe_b = static.Executor()
     (lb,) = exe_b.run_steps(4, main_b, feed=fd, fetch_list=[loss_b])
+    (entry,) = exe_b._cache.values()
+    loop_first = entry["loop_fn"]
+    assert loop_first is not None
     (lb,) = exe_b.run_steps(3, main_b, feed=fd, fetch_list=[loss_b])
     np.testing.assert_allclose(np.asarray(la), np.asarray(lb),
                                rtol=1e-6, atol=1e-7)
-    (entry,) = exe_b._cache.values()
-    assert entry["loop_fn"]._cache_size() == 1
+    # a different n reuses the ONE AOT-compiled loop executable
+    assert entry["loop_fn"] is loop_first
 
 
 def _build_dropout_program(seed):
@@ -513,3 +516,18 @@ def test_frozen_params_ride_as_runtime_args():
     lin1.weight.set_value(np.zeros_like(lin1.weight.numpy()))
     (l1,) = exe.run(main, feed=fd, fetch_list=[loss])
     assert float(l0) != float(l1), "frozen param baked as a constant"
+
+
+def test_run_steps_rejects_per_step_feed_list():
+    """run_steps reuses ONE feed dict for every iteration; a sequence of
+    per-step feeds is a semantics error, not a silent same-batch loop."""
+    paddle.enable_static()
+    main, loss = _build_mlp_program(13)
+    exe = static.Executor()
+    fd = {"x": np.ones((16, 8), np.float32),
+          "y": np.ones((16, 1), np.float32)}
+    with pytest.raises(TypeError, match="ONE feed dict"):
+        exe.run_steps(3, main, feed=[fd, fd, fd], fetch_list=[loss])
+    # the dict form still works after the rejection
+    (lv,) = exe.run_steps(2, main, feed=fd, fetch_list=[loss])
+    assert np.isfinite(float(np.asarray(lv)))
