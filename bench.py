@@ -746,6 +746,11 @@ def main():
         f"init={time.time()-t0:.0f}s")
 
     import paddle_tpu as paddle
+    # per-phase telemetry (compile/dispatch/collective ms, h2d/d2h
+    # bytes) rides every config via the observability timeline; span
+    # overhead is host-side microseconds against ms-class steps
+    from paddle_tpu import observability as obs
+    obs.enable(True)
 
     pallas_ok = None
     if on_tpu:
@@ -798,7 +803,19 @@ def main():
             import traceback
             traceback.print_exc(file=sys.stderr)
             errors[name] = f"{type(e).__name__}: {e}"[:200]
+            try:
+                obs.get_timeline().clear()
+            except Exception:
+                pass
             continue
+        try:
+            phases = obs.phase_breakdown()
+            obs.get_timeline().clear()
+            if phases["compile_count"] or phases["dispatch_count"] \
+                    or phases["collective_count"]:
+                payload["extra_metrics"][f"{name}_phases"] = phases
+        except Exception:
+            pass
         if name == "bert":
             payload["value"] = res["tokens_per_sec"]
             payload["vs_baseline"] = round(res["mfu"] / 0.40, 3) \

@@ -77,6 +77,9 @@ def _kind_of(arr):
 def _record(var_name, op_type, kind):
     report = {"var_name": var_name, "op_type": op_type, "kind": kind}
     _last_nonfinite["report"] = report
+    from .. import observability as obs
+    obs.instant("amp.nonfinite", cat="amp", var_name=var_name,
+                op_type=op_type, kind=kind)
     return report
 
 

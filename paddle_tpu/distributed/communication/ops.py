@@ -23,6 +23,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from ... import observability as obs
 from ...core.dispatch import dispatch
 from ...core.tensor import Tensor, to_tensor
 from ..fault_tolerance.watchdog import get_watchdog
@@ -49,25 +50,67 @@ def _trace_clean():
         return True
 
 
-def _watched(op_name):
-    """Collective-watchdog wrapper (fault_tolerance layer).
+def _payload_bytes(args, kwargs):
+    """Total tensor payload of a collective call (obs-enabled only):
+    Tensor args plus tensors inside list args (all_gather/alltoall)."""
+    n = 0
 
-    Disabled (the default) this is one global read per call.  Enabled
-    (enable_watchdog() / PADDLE_TPU_WATCHDOG_TIMEOUT), the op body runs
-    under a deadline and a timeout raises CollectiveTimeoutError naming
-    the op, the group, and which ranks checked in — instead of hanging
-    the training job forever on a dead peer."""
+    def add(t):
+        nonlocal n
+        try:
+            v = t._value
+            n += int(v.size) * v.dtype.itemsize
+        except Exception:
+            pass
+
+    for a in list(args) + list(kwargs.values()):
+        if isinstance(a, Tensor):
+            add(a)
+        elif isinstance(a, (list, tuple)):
+            for t in a:
+                if isinstance(t, Tensor):
+                    add(t)
+    return n
+
+
+def _watched(op_name):
+    """Collective-watchdog wrapper (fault_tolerance layer) + telemetry.
+
+    Disabled (the default) this is two global reads per call.  With the
+    watchdog enabled (enable_watchdog() / PADDLE_TPU_WATCHDOG_TIMEOUT),
+    the op body runs under a deadline and a timeout raises
+    CollectiveTimeoutError naming the op, the group, and which ranks
+    checked in — instead of hanging the training job forever on a dead
+    peer.  With observability collecting (PADDLE_TPU_OBS), every eager
+    entry records a ``collective`` span carrying duration + payload
+    bytes + group size on the shared step timeline."""
     def deco(fn):
         @functools.wraps(fn)
         def wrapper(*args, **kwargs):
-            wd = get_watchdog()
-            if wd is None or not _trace_clean():
-                return fn(*args, **kwargs)
-            g = kwargs.get("group")
-            if g is None:
-                g = next((a for a in args if isinstance(a, Group)), None)
-            return wd.run(lambda: fn(*args, **kwargs), op_name,
-                          group=g if g is not None else _group(None))
+            g = None
+            if obs.enabled() and _trace_clean():
+                g = kwargs.get("group")
+                if g is None:
+                    g = next((a for a in args if isinstance(a, Group)),
+                             None)
+                g = g if g is not None else _group(None)
+                sp = obs.span("collective:" + op_name, cat="collective",
+                              bytes=_payload_bytes(args, kwargs),
+                              nranks=g.nranks, group=g.id)
+            else:
+                sp = obs._NULL_SPAN
+            with sp:
+                wd = get_watchdog()
+                if wd is None or not _trace_clean():
+                    return fn(*args, **kwargs)
+                if g is None:
+                    g = kwargs.get("group")
+                    if g is None:
+                        g = next((a for a in args
+                                  if isinstance(a, Group)), None)
+                    g = g if g is not None else _group(None)
+                return wd.run(lambda: fn(*args, **kwargs), op_name,
+                              group=g)
         return wrapper
     return deco
 

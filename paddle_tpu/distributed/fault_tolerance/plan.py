@@ -31,6 +31,8 @@ import random
 import threading
 import time
 
+from ... import observability as obs
+
 __all__ = ["FaultEvent", "FaultPlan", "inject", "fault_point",
            "active_plan", "clear_active_plan", "InjectedFault",
            "InjectedConnectionError", "SimulatedWorkerDeath",
@@ -201,6 +203,8 @@ class FaultPlan:
             if ev is None:
                 return None
             self.history.append((site, ev.action, idx))
+        obs.instant("fault." + ev.action, cat="fault", site=site,
+                    occurrence=idx)
         if ev.action in ("delay", "stall"):
             time.sleep(ev.delay)
         elif ev.action == "drop":

@@ -60,6 +60,10 @@ def retry_call(fn, exceptions=(OSError,), retries=3, deadline=None,
             return fn()
         except exceptions as e:
             last = e
+            from ... import observability as obs
+            obs.instant("fault.retry", cat="fault", what=what,
+                        attempt=attempt,
+                        error=f"{type(e).__name__}: {e}"[:200])
             if on_retry is not None:
                 on_retry(attempt, e)
             if attempt >= retries:

@@ -131,6 +131,10 @@ class CollectiveWatchdog:
         t.start()
         if not done.wait(deadline):
             checked, missing = self._roster(op_name, seq)
+            from ... import observability as obs
+            obs.instant("fault.watchdog_timeout", cat="fault",
+                        op=op_name, timeout=deadline,
+                        checked_in=checked, missing=missing)
             raise CollectiveTimeoutError(op_name, group=group,
                                          timeout=deadline,
                                          checked_in=checked,

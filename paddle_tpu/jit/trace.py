@@ -25,6 +25,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from .. import observability as obs
 from ..core.lazy import concrete, concrete_values
 from ..core.tensor import Tensor, get_trace_ctx, set_trace_ctx
 
@@ -275,12 +276,15 @@ class TracedFunction:
         # pending lazy values cannot cross a jit boundary as arguments
         ro_vals = concrete_values(ro_state)
         rw_vals = concrete_values(rw_state)
-        compiled = jitted.lower(arg_vals, ro_vals, rw_vals).compile()
+        label = f"jit:{getattr(self._orig_fn, '__qualname__', self._fn)}"
+        flow = obs.next_flow_id()
+        with obs.span("compile:" + label, cat="compile", flow_out=flow,
+                      n_state=len(state)):
+            compiled = jitted.lower(arg_vals, ro_vals, rw_vals).compile()
         # memory guard pre-flight: hold the fresh executable to the HBM
         # budget before its first dispatch (raises HbmBudgetError)
         from ..memory.estimator import named_buffer_sizes
         from ..memory.guard import preflight_check
-        label = f"jit:{getattr(self._orig_fn, '__qualname__', self._fn)}"
         estimate = preflight_check(
             compiled, program=label,
             named_buffers=named_buffer_sizes(
@@ -289,6 +293,7 @@ class TracedFunction:
         return {
             "compiled": compiled,
             "label": label,
+            "flow": flow,
             "estimate": estimate,
             "ro_state": ro_state,
             "rw_state": rw_state,
@@ -304,8 +309,10 @@ class TracedFunction:
         ro_vals = concrete_values(comp["ro_state"])
         rw_vals = concrete_values(comp["rw_state"])
         from ..memory.guard import oom_context
-        with oom_context(program=comp["label"],
-                         estimate=comp["estimate"]):
+        with obs.span(comp["label"], cat="dispatch",
+                      flow_in=comp["flow"]), \
+                oom_context(program=comp["label"],
+                            estimate=comp["estimate"]):
             out_vals, mut_vals, grad_vals = comp["compiled"](
                 arg_vals, ro_vals, rw_vals)
         for t, v in zip(comp["mutated"], mut_vals):

@@ -29,6 +29,7 @@ import logging
 import os
 import threading
 
+from .. import observability as obs
 from .errors import HbmBudgetError, TpuOutOfMemoryError
 from .estimator import analyze_compiled, check_budget, device_hbm_budget
 
@@ -85,6 +86,8 @@ class GuardPolicy:
 
     def record(self, rung, detail=""):
         self.taken.append((rung, detail))
+        obs.instant("memory.ladder", cat="memory", rung=rung,
+                    detail=detail)
         logger.warning("memory guard: degradation rung %r engaged%s",
                        rung, f" ({detail})" if detail else "")
 
@@ -169,6 +172,9 @@ def preflight_check(compiled, program="<program>", named_buffers=None,
     record_estimate(est)
     if budget is None:
         budget = device_hbm_budget()
+    obs.instant("memory.preflight", cat="memory", program=program,
+                total_bytes=est.total_bytes, temp_bytes=est.temp_bytes,
+                argument_bytes=est.argument_bytes, budget=budget)
     if raise_on_over:
         check_budget(est, budget=budget, site=OOM_SITE)
     return est
@@ -212,6 +218,8 @@ def oom_context(program="<program>", estimate=None, device=None,
         except Exception:
             stats = {}
         top = estimate.top_buffers(5) if estimate is not None else ()
+        obs.instant("memory.oom", cat="memory", program=program,
+                    site=site, error=str(e)[:200])
         raise TpuOutOfMemoryError(
             str(e), program=program, estimate=estimate,
             budget=device_hbm_budget(device), top_buffers=top,

@@ -1,0 +1,199 @@
+"""Exporters: chrome-trace JSON (Perfetto), append-only JSONL, summaries.
+
+Chrome-trace layout: pid = rank, tid = stream lane by category (compile /
+dispatch / collective / memory / fault / ...), ``X`` complete events in
+microseconds, ``M`` metadata naming processes and lanes, and ``s``/``f``
+flow events drawing the compile→dispatch arrow for every executable
+(the compile span carries ``flow_out``, its dispatches ``flow_in``).
+
+The JSONL sink is one ``Event.to_dict()`` JSON object per line,
+append-only, for machine consumption (fleet aggregation, test replay —
+``load_jsonl`` round-trips it).
+
+``summary(view=...)`` renders the text table (op view: per-name totals;
+step view: per-step per-category totals); ``phase_breakdown()`` is the
+compact dict bench.py attaches to the BENCH json.
+"""
+from __future__ import annotations
+
+import json
+import os
+import time
+
+from .timeline import get_timeline, obs_dir
+
+__all__ = ["CATEGORY_LANES", "chrome_trace", "export_chrome_trace",
+           "export_jsonl", "load_jsonl", "summary", "phase_breakdown"]
+
+# tid lanes, one per category, so each stream renders as its own track
+CATEGORY_LANES = {"host": 0, "compile": 1, "dispatch": 2, "collective": 3,
+                  "memory": 4, "fault": 5, "amp": 6}
+_EXTRA_LANE_BASE = 16
+
+
+def _lane(cat, extra):
+    lane = CATEGORY_LANES.get(cat)
+    if lane is None:
+        lane = extra.setdefault(cat, _EXTRA_LANE_BASE + len(extra))
+    return lane
+
+
+def chrome_trace(events=None, process_name="paddle_tpu"):
+    """Build the chrome-trace dict (``{"traceEvents": [...]}``)."""
+    if events is None:
+        events = get_timeline().events()
+    extra_lanes = {}
+    trace = []
+    pids = set()
+    lanes_used = {}
+    for e in events:
+        tid = _lane(e.cat, extra_lanes)
+        pids.add(e.rank)
+        lanes_used.setdefault((e.rank, tid), e.cat)
+        args = dict(e.attrs or {})
+        if e.step is not None:
+            args["step"] = e.step
+        ts_us = e.ts * 1e6
+        if e.dur is not None:
+            trace.append({"ph": "X", "name": e.name, "cat": e.cat,
+                          "pid": e.rank, "tid": tid,
+                          "ts": round(ts_us, 3),
+                          "dur": round(e.dur * 1e6, 3), "args": args})
+        else:
+            trace.append({"ph": "i", "name": e.name, "cat": e.cat,
+                          "pid": e.rank, "tid": tid,
+                          "ts": round(ts_us, 3), "s": "t", "args": args})
+        # flow arrows: start at the producing span's end, finish (bp=e:
+        # bind to the enclosing slice) at each consumer span's start
+        if e.flow_out is not None and e.dur is not None:
+            trace.append({"ph": "s", "id": e.flow_out, "pid": e.rank,
+                          "tid": tid, "ts": round((e.ts + e.dur) * 1e6, 3),
+                          "name": "compile→dispatch", "cat": "flow"})
+        if e.flow_in is not None:
+            trace.append({"ph": "f", "bp": "e", "id": e.flow_in,
+                          "pid": e.rank, "tid": tid,
+                          "ts": round(ts_us, 3),
+                          "name": "compile→dispatch", "cat": "flow"})
+    meta = []
+    for pid in sorted(pids):
+        meta.append({"ph": "M", "name": "process_name", "pid": pid,
+                     "tid": 0, "args": {"name": f"{process_name} "
+                                                f"rank {pid}"}})
+    for (pid, tid), cat in sorted(lanes_used.items()):
+        meta.append({"ph": "M", "name": "thread_name", "pid": pid,
+                     "tid": tid, "args": {"name": cat}})
+    return {"traceEvents": meta + trace, "displayTimeUnit": "ms"}
+
+
+def export_chrome_trace(path=None, events=None, process_name="paddle_tpu"):
+    """Serialize the timeline as chrome-trace JSON; returns the path."""
+    if path is None:
+        path = os.path.join(
+            obs_dir(), f"trace_{os.getpid()}_{int(time.time())}.json")
+    os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+    with open(path, "w") as f:
+        json.dump(chrome_trace(events, process_name=process_name), f)
+    return path
+
+
+def export_jsonl(path=None, events=None, append=True):
+    """Append the timeline to a JSONL sink; returns the path."""
+    if events is None:
+        events = get_timeline().events()
+    if path is None:
+        path = os.path.join(obs_dir(), f"events_{os.getpid()}.jsonl")
+    os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+    with open(path, "a" if append else "w") as f:
+        for e in events:
+            f.write(json.dumps(e.to_dict()) + "\n")
+    return path
+
+
+def load_jsonl(path):
+    """Read a JSONL sink back as a list of event dicts."""
+    out = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if line:
+                out.append(json.loads(line))
+    return out
+
+
+def summary(view="op", events=None, limit=30):
+    """Text summary table.
+
+    ``view="op"``: per-name call count / total / avg / max ms, largest
+    total first.  ``view="step"``: per-step totals split by category.
+    """
+    if events is None:
+        events = get_timeline().events()
+    spans = [e for e in events if e.dur is not None]
+    lines = []
+    if view == "step":
+        steps = {}
+        cats = set()
+        for e in spans:
+            row = steps.setdefault(e.step, {})
+            row[e.cat] = row.get(e.cat, 0.0) + e.dur * 1e3
+            cats.add(e.cat)
+        cats = sorted(cats)
+        lines.append(f"{'Step':<8}" + "".join(f"{c + '(ms)':<16}"
+                                              for c in cats))
+        for step in sorted(steps, key=lambda s: (s is None, s)):
+            row = steps[step]
+            label = "-" if step is None else str(step)
+            lines.append(f"{label:<8}" + "".join(
+                f"{row.get(c, 0.0):<16.3f}" for c in cats))
+    else:
+        agg = {}
+        for e in spans:
+            tot, n, mx = agg.get(e.name, (0.0, 0, 0.0))
+            d = e.dur * 1e3
+            agg[e.name] = (tot + d, n + 1, max(mx, d))
+        lines.append(f"{'Name':<44}{'Calls':<8}{'Total(ms)':<12}"
+                     f"{'Avg(ms)':<12}{'Max(ms)':<12}")
+        for name, (tot, n, mx) in sorted(agg.items(),
+                                         key=lambda kv: -kv[1][0])[:limit]:
+            lines.append(f"{name:<44}{n:<8}{tot:<12.3f}"
+                         f"{tot / n:<12.3f}{mx:<12.3f}")
+    n_instant = len(events) - len(spans)
+    if n_instant:
+        lines.append(f"[{n_instant} instant events: "
+                     + ", ".join(sorted({e.cat for e in events
+                                         if e.dur is None})) + "]")
+    dropped = get_timeline().dropped if events is None else 0
+    if dropped:
+        lines.append(f"[{dropped} events dropped at capacity]")
+    return "\n".join(lines)
+
+
+def phase_breakdown(events=None):
+    """Compact per-phase totals for the BENCH json: compile / dispatch /
+    collective milliseconds, collective payload bytes, and the
+    host↔device transfer bytes the dispatch spans recorded."""
+    if events is None:
+        events = get_timeline().events()
+    out = {"compile_ms": 0.0, "dispatch_ms": 0.0, "collective_ms": 0.0,
+           "collective_bytes": 0, "h2d_bytes": 0, "d2h_bytes": 0,
+           "compile_count": 0, "dispatch_count": 0, "collective_count": 0}
+    for e in events:
+        if e.dur is None:
+            continue
+        ms = e.dur * 1e3
+        attrs = e.attrs or {}
+        if e.cat == "compile":
+            out["compile_ms"] += ms
+            out["compile_count"] += 1
+        elif e.cat == "dispatch":
+            out["dispatch_ms"] += ms
+            out["dispatch_count"] += 1
+            out["h2d_bytes"] += int(attrs.get("h2d_bytes", 0) or 0)
+            out["d2h_bytes"] += int(attrs.get("d2h_bytes", 0) or 0)
+        elif e.cat == "collective":
+            out["collective_ms"] += ms
+            out["collective_count"] += 1
+            out["collective_bytes"] += int(attrs.get("bytes", 0) or 0)
+    for k in ("compile_ms", "dispatch_ms", "collective_ms"):
+        out[k] = round(out[k], 3)
+    return out

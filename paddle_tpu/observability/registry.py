@@ -1,0 +1,234 @@
+"""Thread-safe metrics registry: counters, gauges, bounded histograms.
+
+A process-wide singleton (``get_registry()``) holding named metrics.
+Mutators are gated on the same ``PADDLE_TPU_OBS`` switch as the
+timeline: disabled, ``inc``/``set``/``observe`` return immediately
+after one global read, so permanently-instrumented code costs nothing
+in production runs that don't opt in.
+
+Histograms keep a bounded reservoir (fixed-stride decimation: once the
+reservoir is full every k-th observation is kept, k doubling each time
+it refills) so memory stays O(reservoir) for unbounded streams while
+count/sum/min/max stay exact.
+"""
+from __future__ import annotations
+
+import threading
+
+from .timeline import enabled
+
+__all__ = ["Counter", "Gauge", "Histogram", "MetricsRegistry",
+           "get_registry"]
+
+
+class Counter:
+    """Monotonic counter."""
+
+    __slots__ = ("name", "_lock", "_value")
+
+    def __init__(self, name):
+        self.name = name
+        self._lock = threading.Lock()
+        self._value = 0
+
+    def inc(self, n=1):
+        if not enabled():
+            return self
+        if n < 0:
+            raise ValueError(f"Counter {self.name!r}: inc({n}) — counters "
+                             "are monotonic; use a Gauge for ups and downs")
+        with self._lock:
+            self._value += n
+        return self
+
+    @property
+    def value(self):
+        return self._value
+
+    def snapshot(self):
+        return self._value
+
+    def reset(self):
+        with self._lock:
+            self._value = 0
+
+
+class Gauge:
+    """Last-written value."""
+
+    __slots__ = ("name", "_lock", "_value")
+
+    def __init__(self, name):
+        self.name = name
+        self._lock = threading.Lock()
+        self._value = None
+
+    def set(self, v):
+        if not enabled():
+            return self
+        with self._lock:
+            self._value = v
+        return self
+
+    @property
+    def value(self):
+        return self._value
+
+    def snapshot(self):
+        return self._value
+
+    def reset(self):
+        with self._lock:
+            self._value = None
+
+
+class Histogram:
+    """Streaming histogram with a bounded reservoir.
+
+    Exact ``count``/``sum``/``min``/``max``; percentiles come from the
+    reservoir (every k-th sample once full, k doubling per refill — a
+    deterministic decimation, so replayed runs snapshot identically).
+    """
+
+    __slots__ = ("name", "reservoir_size", "_lock", "_count", "_sum",
+                 "_min", "_max", "_samples", "_stride", "_skip")
+
+    def __init__(self, name, reservoir=1024):
+        self.name = name
+        self.reservoir_size = max(2, int(reservoir))
+        self._lock = threading.Lock()
+        self._count = 0
+        self._sum = 0.0
+        self._min = None
+        self._max = None
+        self._samples = []
+        self._stride = 1
+        self._skip = 0
+
+    def observe(self, v):
+        if not enabled():
+            return self
+        v = float(v)
+        with self._lock:
+            self._count += 1
+            self._sum += v
+            self._min = v if self._min is None else min(self._min, v)
+            self._max = v if self._max is None else max(self._max, v)
+            self._skip += 1
+            if self._skip >= self._stride:
+                self._skip = 0
+                self._samples.append(v)
+                if len(self._samples) >= self.reservoir_size:
+                    # decimate: keep every 2nd sample, double the stride
+                    self._samples = self._samples[::2]
+                    self._stride *= 2
+        return self
+
+    @property
+    def count(self):
+        return self._count
+
+    @property
+    def sum(self):
+        return self._sum
+
+    def percentile(self, p):
+        """p in [0, 100], from the reservoir (None when empty)."""
+        with self._lock:
+            samples = sorted(self._samples)
+        if not samples:
+            return None
+        idx = min(len(samples) - 1,
+                  max(0, int(round(p / 100.0 * (len(samples) - 1)))))
+        return samples[idx]
+
+    def snapshot(self):
+        with self._lock:
+            samples = sorted(self._samples)
+            count, total = self._count, self._sum
+            lo, hi = self._min, self._max
+
+        def pct(p):
+            if not samples:
+                return None
+            return samples[min(len(samples) - 1,
+                               max(0, int(round(p / 100.0
+                                                * (len(samples) - 1)))))]
+
+        return {"count": count, "sum": total, "min": lo, "max": hi,
+                "mean": (total / count) if count else None,
+                "p50": pct(50), "p90": pct(90), "p99": pct(99)}
+
+    def reset(self):
+        with self._lock:
+            self._count = 0
+            self._sum = 0.0
+            self._min = self._max = None
+            self._samples = []
+            self._stride = 1
+            self._skip = 0
+
+
+class MetricsRegistry:
+    """Named metrics, one instance per name; type collisions raise."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._metrics = {}
+
+    def _get(self, name, cls, *args, **kwargs):
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is None:
+                m = self._metrics[name] = cls(name, *args, **kwargs)
+            elif not isinstance(m, cls):
+                raise TypeError(
+                    f"metric {name!r} already registered as "
+                    f"{type(m).__name__}, requested {cls.__name__}")
+            return m
+
+    def counter(self, name):
+        return self._get(name, Counter)
+
+    def gauge(self, name):
+        return self._get(name, Gauge)
+
+    def histogram(self, name, reservoir=1024):
+        return self._get(name, Histogram, reservoir)
+
+    def metrics(self):
+        with self._lock:
+            return dict(self._metrics)
+
+    def snapshot(self):
+        """{"counters": {...}, "gauges": {...}, "histograms": {...}}."""
+        out = {"counters": {}, "gauges": {}, "histograms": {}}
+        for name, m in self.metrics().items():
+            if isinstance(m, Counter):
+                out["counters"][name] = m.snapshot()
+            elif isinstance(m, Gauge):
+                out["gauges"][name] = m.snapshot()
+            else:
+                out["histograms"][name] = m.snapshot()
+        return out
+
+    def reset(self):
+        for m in self.metrics().values():
+            m.reset()
+
+    def clear(self):
+        with self._lock:
+            self._metrics.clear()
+
+
+_registry = None
+_registry_lock = threading.Lock()
+
+
+def get_registry():
+    global _registry
+    if _registry is None:
+        with _registry_lock:
+            if _registry is None:
+                _registry = MetricsRegistry()
+    return _registry

@@ -1,19 +1,33 @@
-"""paddle.profiler over jax.profiler.
+"""paddle.profiler over the observability core (+ jax.profiler).
 
 Reference parity: `python/paddle/profiler/` (Profiler with CLOSED→WARMUP→
 RECORD scheduler, RecordEvent spans, chrome-trace export;
 `fluid/platform/profiler/` host+CUPTI tracers) [UNVERIFIED — empty
-reference mount].  TPU-native: jax.profiler captures XLA/TPU timelines
-(XPlane → TensorBoard/perfetto); RecordEvent maps to TraceAnnotation.
+reference mount].
+
+Rebuilt as a thin shim over ``paddle_tpu.observability`` (ISSUE 3):
+``RecordEvent`` records spans into the shared bounded timeline (plus an
+XLA TraceAnnotation so the name shows in the device trace),
+``Profiler.step()`` drives timeline step attribution,
+``export_chrome_tracing`` serializes a real Perfetto-loadable trace
+through the shared exporter, and ``summary()`` renders the shared op
+view.  ``jax.profiler.start_trace/stop_trace`` still captures the
+XLA/TPU XPlane timeline alongside, per the RECORD schedule.
+
+A Profiler session force-enables collection for its duration (and
+restores the prior ``PADDLE_TPU_OBS`` state on stop), so profiling
+works without the env var; the session's host events are cleared on
+stop after the ``on_trace_ready`` handler has consumed them.
 """
 from __future__ import annotations
 
-import contextlib
 import os
 import time
 from enum import Enum
 
 import jax
+
+from .. import observability as _obs
 
 __all__ = ["Profiler", "RecordEvent", "ProfilerTarget", "ProfilerState",
            "make_scheduler", "export_chrome_tracing", "load_profiler_result",
@@ -49,12 +63,17 @@ class SummaryView(Enum):
 
 
 def make_scheduler(closed=0, ready=0, record=1, repeat=0, skip_first=0):
+    """CLOSED×closed → READY×ready → RECORD×record, cycling; after
+    ``repeat`` full cycles (0 = forever) the schedule stays CLOSED."""
+    total = closed + ready + record
+
     def scheduler(step):
         s = step - skip_first
-        if s < 0:
+        if s < 0 or total <= 0:
             return ProfilerState.CLOSED
-        total = closed + ready + record
-        pos = s % total if total else 0
+        if repeat and s >= repeat * total:
+            return ProfilerState.CLOSED
+        pos = s % total
         if pos < closed:
             return ProfilerState.CLOSED
         if pos < closed + ready:
@@ -67,32 +86,33 @@ def make_scheduler(closed=0, ready=0, record=1, repeat=0, skip_first=0):
 
 
 def export_chrome_tracing(dir_name, worker_name=None):
+    """on_trace_ready handler factory: serialize the session's timeline
+    as chrome-trace JSON under ``dir_name`` (Perfetto-loadable, via the
+    shared exporter).  The written path is kept on
+    ``prof._last_trace_path``."""
     def handler(prof):
         prof._log_dir = dir_name
+        name = worker_name or f"worker_{os.getpid()}"
+        path = os.path.join(dir_name, f"{name}.pt.trace.json")
+        prof._last_trace_path = _obs.export_chrome_trace(path)
+        return prof._last_trace_path
 
     return handler
 
 
-class _HostEvent:
-    __slots__ = ("name", "start", "end")
-
-    def __init__(self, name, start, end):
-        self.name, self.start, self.end = name, start, end
-
-
-_host_events = []
-
-
 class RecordEvent:
-    """Host-side span + XLA TraceAnnotation (shows in the TPU timeline)."""
+    """Host-side span (shared timeline) + XLA TraceAnnotation (shows in
+    the device timeline).  Recording follows the observability gate —
+    a Profiler session enables it; so does ``PADDLE_TPU_OBS``."""
 
     def __init__(self, name, event_type=None):
         self.name = name
         self._ann = None
-        self._t0 = None
+        self._span = None
 
     def begin(self):
-        self._t0 = time.perf_counter()
+        self._span = _obs.span(self.name, cat="host")
+        self._span.begin()
         try:
             self._ann = jax.profiler.TraceAnnotation(self.name)
             self._ann.__enter__()
@@ -102,9 +122,10 @@ class RecordEvent:
     def end(self):
         if self._ann is not None:
             self._ann.__exit__(None, None, None)
-        if self._t0 is not None:
-            _host_events.append(
-                _HostEvent(self.name, self._t0, time.perf_counter()))
+            self._ann = None
+        if self._span is not None:
+            self._span.end()
+            self._span = None
 
     def __enter__(self):
         self.begin()
@@ -129,11 +150,15 @@ class Profiler:
         self._active = False
         self._log_dir = os.environ.get("PADDLE_TPU_PROFILE_DIR",
                                        "/tmp/paddle_tpu_profile")
+        self._last_trace_path = None
         self._timer_only = timer_only
         self._step_times = []
         self._last_step_t = None
+        self._prev_obs = None
 
     def start(self):
+        self._prev_obs = _obs.enable(True)
+        _obs.set_step(self._step)
         self._last_step_t = time.perf_counter()
         self._maybe_toggle()
 
@@ -146,6 +171,12 @@ class Profiler:
             self._active = False
         if self._on_trace_ready:
             self._on_trace_ready(self)
+        # the handler has consumed the session's events; release the
+        # bounded buffer so back-to-back sessions never accumulate
+        _obs.get_timeline().clear()
+        if self._prev_obs is not None:
+            _obs.enable(self._prev_obs)
+            self._prev_obs = None
 
     def step(self, num_samples=None):
         now = time.perf_counter()
@@ -153,6 +184,7 @@ class Profiler:
             self._step_times.append(now - self._last_step_t)
         self._last_step_t = now
         self._step += 1
+        _obs.set_step(self._step)
         self._maybe_toggle()
 
     def step_info(self, unit=None):
@@ -184,21 +216,15 @@ class Profiler:
 
     def summary(self, sorted_by=None, op_detail=True, thread_sep=False,
                 time_unit="ms", views=None):
-        from collections import defaultdict
-        agg = defaultdict(lambda: [0.0, 0])
-        for e in _host_events:
-            agg[e.name][0] += (e.end - e.start) * 1000
-            agg[e.name][1] += 1
-        lines = [f"{'Name':<40}{'Calls':<8}{'Total(ms)':<12}"]
-        for name, (tot, n) in sorted(agg.items(), key=lambda kv: -kv[1][0]):
-            lines.append(f"{name:<40}{n:<8}{tot:<12.3f}")
+        view = "step" if views == SummaryView.OverView else "op"
+        lines = [_obs.summary(view=view)]
         # device memory footprint (SURVEY.md:101 allocator stats)
         from ..device import memory_stats
         s = memory_stats()
         if s:
             gb = 2.0 ** 30
             lines.append(
-                f"{'HBM in_use / peak (GiB)':<40}"
+                f"{'HBM in_use / peak (GiB)':<44}"
                 f"{s.get('bytes_in_use', 0)/gb:<8.3f}"
                 f"{s.get('peak_bytes_in_use', 0)/gb:<12.3f}")
         out = "\n".join(lines)
@@ -206,7 +232,10 @@ class Profiler:
         return out
 
     def export(self, path=None, format="json"):
-        pass
+        """Serialize the current timeline (chrome-trace json or jsonl)."""
+        if format == "jsonl":
+            return _obs.export_jsonl(path, append=False)
+        return _obs.export_chrome_trace(path)
 
     def __enter__(self):
         self.start()
@@ -218,4 +247,12 @@ class Profiler:
 
 
 def load_profiler_result(filename):
-    return None
+    """Load an exported trace back (chrome-trace json or jsonl)."""
+    import json
+    try:
+        if str(filename).endswith(".jsonl"):
+            return _obs.load_jsonl(filename)
+        with open(filename) as f:
+            return json.load(f)
+    except Exception:
+        return None

@@ -20,6 +20,7 @@ import jax
 import jax.numpy as jnp
 
 from ..core.tensor import Tensor
+from .. import observability as obs
 from .framework import Program, Variable, default_main_program
 
 __all__ = ["Executor", "CompiledProgram", "BuildStrategy", "ExecutionStrategy"]
@@ -60,6 +61,30 @@ def run_program_ops(ops, env, capture_value):
         else:
             env[op.outputs[0].name] = out
     return env
+
+
+def _nbytes_of(vals):
+    """Total payload bytes of a value tuple — only computed when the
+    observability layer is collecting (dispatch-span h2d/d2h attrs)."""
+    if not obs.enabled():
+        return 0
+    n = 0
+    for v in vals:
+        try:
+            n += int(v.size) * v.dtype.itemsize
+        except Exception:
+            pass
+    return n
+
+
+def _obs_step(step_val):
+    """Step id for span attribution (None when not collecting)."""
+    if not obs.enabled():
+        return None
+    try:
+        return int(step_val)
+    except Exception:
+        return None
 
 
 class Executor:
@@ -147,12 +172,16 @@ class Executor:
          lr_val, step_val) = call
         if entry["compiled"] is None:
             entry["compiled"] = entry["compile_step"]()
+        sp = obs.span(entry["program_label"], cat="dispatch",
+                      step=_obs_step(step_val), flow_in=entry["flow"],
+                      h2d_bytes=_nbytes_of(feed_vals))
         from ..device import hbm_oom_context
-        with hbm_oom_context(program=entry["program_label"],
-                             estimate=entry["estimate"]):
+        with sp, hbm_oom_context(program=entry["program_label"],
+                                 estimate=entry["estimate"]):
             outs, new_params, new_opt_state, new_rng = entry["compiled"](
                 feed_vals, param_vals, opt_state_vals, rng_vals,
                 lr_val, step_val)
+            sp.set("d2h_bytes", _nbytes_of(outs))
         return self._epilogue(entry, outs, new_params, new_opt_state,
                               new_rng, return_numpy)
 
@@ -318,13 +347,19 @@ class Executor:
             "estimate": None,
             "loop_fn": None,
             "loop_estimate": None,
+            "flow": obs.next_flow_id(),
+            "loop_flow": obs.next_flow_id(),
         }
 
         def compile_step():
             # deferred: a run_steps-only caller (bench fused loop) must
             # not pay the single-step XLA compile it never invokes
-            compiled = jitted.lower(feed_avals, param_avals, opt_avals,
-                                    rng_avals, lr_aval, step_aval).compile()
+            with obs.span("compile:" + entry["program_label"],
+                          cat="compile", flow_out=entry["flow"],
+                          ops=len(block.ops)):
+                compiled = jitted.lower(feed_avals, param_avals,
+                                        opt_avals, rng_avals, lr_aval,
+                                        step_aval).compile()
             # pre-flight: hold the executable to the HBM budget BEFORE
             # the first dispatch (raises HbmBudgetError when over)
             from ..memory.guard import preflight_check
@@ -409,11 +444,14 @@ class Executor:
             # AOT-compile (rather than dispatch through jax.jit) so the
             # fused loop gets the same pre-flight budget check as run():
             # memory_analysis is only exposed on an explicit Compiled
-            loop_fn = jax.jit(
-                loop, donate_argnums=(1, 2) if entry["donate"] else ()
-            ).lower(feed_vals, param_vals, opt_state_vals, rng_vals,
-                    lr_val, step_val,
-                    jax.ShapeDtypeStruct((), jnp.int32)).compile()
+            with obs.span("compile:" + entry["program_label"]
+                          + ".run_steps", cat="compile",
+                          flow_out=entry["loop_flow"]):
+                loop_fn = jax.jit(
+                    loop, donate_argnums=(1, 2) if entry["donate"] else ()
+                ).lower(feed_vals, param_vals, opt_state_vals, rng_vals,
+                        lr_val, step_val,
+                        jax.ShapeDtypeStruct((), jnp.int32)).compile()
             from ..memory.guard import preflight_check
             entry["loop_estimate"] = preflight_check(
                 loop_fn, program=entry["program_label"] + ".run_steps",
@@ -421,12 +459,18 @@ class Executor:
             self._last_estimate = entry["loop_estimate"]
             entry["loop_fn"] = loop_fn
 
+        sp = obs.span(entry["program_label"] + ".run_steps",
+                      cat="dispatch", step=_obs_step(step_val),
+                      flow_in=entry["loop_flow"], n_iters=n_iters,
+                      h2d_bytes=_nbytes_of(feed_vals))
         from ..device import hbm_oom_context
-        with hbm_oom_context(program=entry["program_label"] + ".run_steps",
-                             estimate=entry["loop_estimate"]):
+        with sp, hbm_oom_context(program=entry["program_label"]
+                                 + ".run_steps",
+                                 estimate=entry["loop_estimate"]):
             outs, new_params, new_opt_state, new_rng = loop_fn(
                 feed_vals, param_vals, opt_state_vals, rng_vals,
                 lr_val, step_val, jnp.asarray(n_iters, jnp.int32))
+            sp.set("d2h_bytes", _nbytes_of(outs))
         return self._epilogue(entry, outs, new_params, new_opt_state,
                               new_rng, return_numpy)
 

@@ -1,0 +1,230 @@
+#!/usr/bin/env python
+"""Observability smoke check: full instrumentation, end to end, one command.
+
+    python scripts/obs_smoke.py [--seed N] [--out DIR] [--overhead]
+
+Runs a GPT-mini train step under PADDLE_TPU_OBS=1 (two steps: one
+compile, one cached dispatch), an eager collective, and a fault-plan
+injection, then exports the timeline and validates the whole story:
+
+  * the chrome-trace JSON parses and carries >=1 compile span, >=1
+    dispatch span, and >=1 collective span with a ``bytes`` attr
+    (pid/tid = rank/stream lane, compile->dispatch flow arrows);
+  * the JSONL sink replays ``memory.preflight`` and ``fault.*`` events.
+
+Prints the op-view summary table and the trace path.  ``--overhead``
+additionally measures the disabled-mode cost of the instrumented hot
+path (the <=2% acceptance bar).  Exits 0 iff every scenario passes.
+CPU-only, no TPU needed.
+"""
+import argparse
+import json
+import logging
+import os
+import sys
+import tempfile
+import time
+import traceback
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["PADDLE_TPU_OBS"] = "1"
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+import paddle_tpu as paddle  # noqa: E402
+from paddle_tpu import observability as obs  # noqa: E402
+from paddle_tpu import optimizer  # noqa: E402
+from paddle_tpu.distributed.fault_tolerance.plan import (  # noqa: E402
+    FaultPlan, inject, fault_point)
+
+RESULTS = []
+
+GPT_CFG = dict(vocab_size=128, hidden_size=64, num_hidden_layers=2,
+               num_attention_heads=4, max_position_embeddings=64)
+B, T = 8, 32
+
+
+def scenario(name):
+    def deco(fn):
+        RESULTS.append((name, fn))
+        return fn
+    return deco
+
+
+def gpt_step(seed):
+    from paddle_tpu.models import GPTConfig, GPTForCausalLM
+    from paddle_tpu.models.gpt import GPTPretrainingCriterion
+    paddle.seed(seed)
+    m = GPTForCausalLM(GPTConfig(**GPT_CFG))
+    m.train()
+    opt = optimizer.SGD(learning_rate=0.01, parameters=m.parameters())
+    crit = GPTPretrainingCriterion()
+
+    def fb(ids, labels):
+        loss = crit(m(ids), labels)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        return loss
+
+    return paddle.jit.to_static(fb)
+
+
+def gpt_feed(seed):
+    rng = np.random.RandomState(seed)
+    return (paddle.to_tensor(rng.randint(
+                0, GPT_CFG["vocab_size"], (B, T)).astype(np.int64)),
+            paddle.to_tensor(rng.randint(
+                0, GPT_CFG["vocab_size"], (B, T)).astype(np.int64)))
+
+
+@scenario("instrumented GPT-mini run: compile/dispatch/collective spans")
+def _instrumented_run(seed, out_dir):
+    obs.get_timeline().clear()
+    ids, labels = gpt_feed(seed)
+    step = gpt_step(seed)
+    obs.set_step(0)
+    loss0 = step(ids, labels)      # discovery + XLA compile
+    obs.set_step(1)
+    loss1 = step(ids, labels)      # cached dispatch
+    obs.set_step(None)
+    assert np.isfinite(float(loss0)) and np.isfinite(float(loss1))
+
+    import paddle_tpu.distributed as dist
+    t = paddle.to_tensor(np.ones((32, 32), np.float32))
+    dist.all_reduce(t)
+
+    plan = FaultPlan(seed=seed).add("worker.step", "delay", count=1,
+                                    delay=0.0)
+    with inject(plan):
+        fault_point("worker.step")
+    assert plan.history == [("worker.step", "delay", 0)], plan.history
+
+    evs = obs.get_timeline().events()
+    by_cat = {}
+    for e in evs:
+        by_cat.setdefault(e.cat, []).append(e)
+    assert by_cat.get("compile"), "no compile span recorded"
+    assert by_cat.get("dispatch"), "no dispatch span recorded"
+    assert by_cat.get("collective"), "no collective span recorded"
+    print(f"      {len(evs)} events: "
+          + ", ".join(f"{k}:{len(v)}" for k, v in sorted(by_cat.items())))
+
+
+@scenario("chrome trace: parseable, spans + bytes attr + flow arrows")
+def _chrome_trace(seed, out_dir):
+    path = obs.export_chrome_trace(os.path.join(out_dir, "obs_smoke.json"))
+    with open(path) as f:
+        data = json.load(f)
+    evs = data["traceEvents"]
+    spans = [e for e in evs if e.get("ph") == "X"]
+    compiles = [e for e in spans if e["cat"] == "compile"]
+    dispatches = [e for e in spans if e["cat"] == "dispatch"]
+    collectives = [e for e in spans if e["cat"] == "collective"]
+    assert len(compiles) >= 1, "chrome trace: no compile span"
+    assert len(dispatches) >= 1, "chrome trace: no dispatch span"
+    assert len(collectives) >= 1, "chrome trace: no collective span"
+    assert all(c["args"].get("bytes", 0) > 0 for c in collectives), \
+        "collective span missing bytes attr"
+    # compile->dispatch flow arrow pair present and bound
+    starts = {e["id"] for e in evs if e.get("ph") == "s"}
+    finishes = {e["id"] for e in evs if e.get("ph") == "f"}
+    assert starts & finishes, "no compile->dispatch flow pair"
+    print(f"      {len(spans)} spans, collective payload "
+          f"{collectives[0]['args']['bytes']}B -> {path}")
+    return path
+
+
+@scenario("jsonl sink: memory.preflight + fault.* events replay")
+def _jsonl_sink(seed, out_dir):
+    path = os.path.join(out_dir, "obs_smoke.jsonl")
+    if os.path.exists(path):
+        os.remove(path)
+    obs.export_jsonl(path)
+    rows = obs.load_jsonl(path)
+    names = {r["name"] for r in rows}
+    assert any(n == "memory.preflight" for n in names), \
+        f"no memory.preflight in jsonl ({sorted(names)})"
+    assert any(n.startswith("fault.") for n in names), \
+        f"no fault.* event in jsonl ({sorted(names)})"
+    kinds = {r["type"] for r in rows}
+    assert kinds == {"span", "instant"}, kinds
+    print(f"      {len(rows)} rows replayed from {path}")
+
+
+@scenario("phase breakdown: compile/dispatch/collective totals populated")
+def _phase_breakdown(seed, out_dir):
+    b = obs.phase_breakdown()
+    assert b["compile_count"] >= 1 and b["compile_ms"] > 0, b
+    assert b["dispatch_count"] >= 1, b
+    assert b["collective_count"] >= 1 and b["collective_bytes"] > 0, b
+    print(f"      compile {b['compile_ms']:.1f}ms, dispatch "
+          f"{b['dispatch_ms']:.2f}ms, collective {b['collective_ms']:.2f}ms"
+          f" / {b['collective_bytes']}B, h2d {b['h2d_bytes']}B")
+
+
+def measure_overhead(seed):
+    """Disabled-mode cost of the instrumented hot path: the same jit
+    dispatch loop with collection off vs a timeline-bypassing baseline
+    is not separable, so compare obs-off vs obs-on instead and report
+    both against the acceptance bar (off must be ~free)."""
+    ids, labels = gpt_feed(seed)
+    step = gpt_step(seed)
+    step(ids, labels)  # compile outside the timed region
+
+    def loop(n):
+        t0 = time.perf_counter()
+        for _ in range(n):
+            float(step(ids, labels))
+        return time.perf_counter() - t0
+
+    loop(10)  # warm
+    obs.disable()
+    obs.get_timeline().clear()
+    t_off = min(loop(100) for _ in range(3))
+    obs.enable(True)
+    t_on = min(loop(100) for _ in range(3))
+    obs.get_timeline().clear()
+    print(f"100-step loop: obs off {t_off*1e3:.1f}ms, "
+          f"on {t_on*1e3:.1f}ms ({(t_on/t_off - 1)*100:+.2f}%)")
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--seed", type=int, default=7)
+    ap.add_argument("--out", default=None,
+                    help="export dir (default: a fresh tempdir)")
+    ap.add_argument("--overhead", action="store_true",
+                    help="also time the disabled-mode hot path")
+    args = ap.parse_args()
+    logging.basicConfig(level=logging.WARNING)
+    out_dir = args.out or tempfile.mkdtemp(prefix="paddle_tpu_obs_")
+    failures = 0
+    trace_path = None
+    for name, fn in RESULTS:
+        t0 = time.monotonic()
+        try:
+            r = fn(args.seed, out_dir)
+            if r:
+                trace_path = r
+            print(f"PASS  {name}  ({time.monotonic() - t0:.1f}s)")
+        except Exception:
+            failures += 1
+            print(f"FAIL  {name}")
+            traceback.print_exc()
+    print("\n===== op-view summary =====")
+    print(obs.summary(view="op"))
+    if trace_path:
+        print(f"\ntrace: {trace_path}  (load in ui.perfetto.dev)")
+    if args.overhead:
+        measure_overhead(args.seed)
+    total = len(RESULTS)
+    print(f"\nobs smoke: {total - failures}/{total} scenarios passed "
+          f"(seed={args.seed})")
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

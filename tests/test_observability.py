@@ -1,0 +1,405 @@
+"""Observability subsystem suite (ISSUE 3): metrics registry semantics,
+span/step timeline, chrome-trace + JSONL exporters, profiler shims, and
+the executor/jit/collective/memory-guard/fault-plan integrations."""
+import json
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.observability as obs
+from paddle_tpu import nn, optimizer, static
+from paddle_tpu.observability.registry import (Counter, Gauge, Histogram,
+                                               MetricsRegistry)
+from paddle_tpu.observability.timeline import Timeline
+
+pytestmark = pytest.mark.obs
+
+
+@pytest.fixture(autouse=True)
+def _obs_session():
+    """Each test runs collecting into a fresh timeline/registry; the
+    prior enabled-state is restored afterwards."""
+    prev = obs.enable(True)
+    obs.get_timeline().clear()
+    obs.get_registry().reset()
+    yield
+    obs.get_timeline().clear()
+    obs.get_registry().reset()
+    obs.enable(prev)
+
+
+def _spans(cat=None):
+    evs = [e for e in obs.get_timeline().events() if e.dur is not None]
+    return [e for e in evs if cat is None or e.cat == cat]
+
+
+def _instants(cat=None):
+    evs = [e for e in obs.get_timeline().events() if e.dur is None]
+    return [e for e in evs if cat is None or e.cat == cat]
+
+
+# ---------------------------------------------------------------------
+# metrics registry
+# ---------------------------------------------------------------------
+class TestRegistry:
+    def test_counter_gauge_histogram(self):
+        reg = MetricsRegistry()
+        c = reg.counter("steps")
+        c.inc().inc(4)
+        assert c.value == 5
+        g = reg.gauge("lr")
+        g.set(0.1)
+        assert g.value == 0.1
+        h = reg.histogram("step_ms")
+        for v in range(100):
+            h.observe(float(v))
+        snap = h.snapshot()
+        assert snap["count"] == 100
+        assert snap["min"] == 0.0 and snap["max"] == 99.0
+        assert snap["sum"] == pytest.approx(4950.0)
+        assert 40.0 <= snap["p50"] <= 60.0
+        assert snap["p99"] >= snap["p90"] >= snap["p50"]
+
+    def test_counter_monotonic(self):
+        reg = MetricsRegistry()
+        with pytest.raises(ValueError):
+            reg.counter("c").inc(-1)
+
+    def test_same_name_same_instance_type_collision_raises(self):
+        reg = MetricsRegistry()
+        assert reg.counter("x") is reg.counter("x")
+        with pytest.raises(TypeError):
+            reg.gauge("x")
+
+    def test_histogram_reservoir_bounded(self):
+        h = Histogram("h", reservoir=64)
+        for v in range(10_000):
+            h.observe(v)
+        assert h.count == 10_000
+        assert len(h._samples) < 64
+        # decimated reservoir still spans the stream
+        assert h.percentile(0) < h.percentile(100)
+
+    def test_disabled_mode_noop(self):
+        reg = MetricsRegistry()
+        c, g, h = reg.counter("c"), reg.gauge("g"), reg.histogram("h")
+        obs.disable()
+        c.inc(10)
+        g.set(3)
+        h.observe(1.0)
+        assert c.value == 0
+        assert g.value is None
+        assert h.count == 0
+
+    def test_singleton_snapshot(self):
+        reg = obs.get_registry()
+        assert reg is obs.get_registry()
+        reg.counter("dispatches").inc(2)
+        snap = reg.snapshot()
+        assert snap["counters"]["dispatches"] == 2
+
+
+# ---------------------------------------------------------------------
+# timeline / spans
+# ---------------------------------------------------------------------
+class TestTimeline:
+    def test_span_records_duration_and_attrs(self):
+        with obs.span("work", cat="host", foo=1) as sp:
+            sp.set("bar", 2)
+        (e,) = _spans()
+        assert e.name == "work" and e.cat == "host"
+        assert e.dur >= 0
+        assert e.attrs == {"foo": 1, "bar": 2}
+
+    def test_span_nesting_orders_by_start(self):
+        with obs.span("outer"):
+            with obs.span("inner"):
+                pass
+        inner, outer = _spans()  # inner exits (records) first
+        assert inner.name == "inner" and outer.name == "outer"
+        assert outer.ts <= inner.ts
+        assert outer.ts + outer.dur >= inner.ts + inner.dur
+
+    def test_step_attribution(self):
+        obs.set_step(3)
+        with obs.span("a"):
+            pass
+        obs.set_step(4)
+        obs.instant("marker")
+        a, = _spans()
+        m, = _instants()
+        assert a.step == 3 and m.step == 4
+        obs.set_step(None)
+
+    def test_disabled_records_nothing(self):
+        obs.disable()
+        with obs.span("ghost"):
+            pass
+        obs.instant("ghost")
+        assert obs.span("x") is obs._NULL_SPAN
+        obs.enable(True)
+        assert len(obs.get_timeline()) == 0
+
+    def test_bounded_buffer_counts_drops(self):
+        tl = Timeline(capacity=8)
+        for i in range(20):
+            tl.add_instant(f"e{i}", "host")
+        assert len(tl) == 8
+        assert tl.dropped == 12
+        # oldest evicted, newest kept
+        assert [e.name for e in tl.events()][-1] == "e19"
+
+    def test_clear_resets(self):
+        obs.instant("x")
+        obs.get_timeline().clear()
+        assert len(obs.get_timeline()) == 0
+        assert obs.get_timeline().dropped == 0
+
+
+# ---------------------------------------------------------------------
+# exporters
+# ---------------------------------------------------------------------
+class TestExporters:
+    def _populate(self):
+        flow = obs.next_flow_id()
+        with obs.span("compile:prog", cat="compile", flow_out=flow):
+            pass
+        with obs.span("prog", cat="dispatch", step=0, flow_in=flow,
+                      h2d_bytes=128):
+            pass
+        with obs.span("collective:all_reduce", cat="collective",
+                      bytes=64):
+            pass
+        obs.instant("memory.preflight", cat="memory", total_bytes=1)
+        return flow
+
+    def test_chrome_trace_schema_roundtrip(self, tmp_path):
+        flow = self._populate()
+        path = obs.export_chrome_trace(str(tmp_path / "trace.json"))
+        data = json.loads(open(path).read())
+        evs = data["traceEvents"]
+        assert isinstance(evs, list) and evs
+        X = [e for e in evs if e["ph"] == "X"]
+        assert len(X) == 3
+        for e in X:
+            assert {"name", "cat", "pid", "tid", "ts", "dur",
+                    "args"} <= set(e)
+        # pid = rank, tid = per-category stream lane
+        cats = {e["cat"]: e["tid"] for e in X}
+        assert len(set(cats.values())) == 3
+        # instant event present
+        assert any(e["ph"] == "i" and e["name"] == "memory.preflight"
+                   for e in evs)
+        # flow arrow: s at compile end, f bound to the dispatch start
+        s = [e for e in evs if e["ph"] == "s" and e["id"] == flow]
+        f = [e for e in evs if e["ph"] == "f" and e["id"] == flow]
+        assert len(s) == 1 and len(f) == 1
+        assert s[0]["ts"] <= f[0]["ts"]
+        # thread metadata names the lanes
+        lanes = {e["args"]["name"] for e in evs
+                 if e["ph"] == "M" and e["name"] == "thread_name"}
+        assert {"compile", "dispatch", "collective"} <= lanes
+
+    def test_jsonl_sink_replay(self, tmp_path):
+        self._populate()
+        path = str(tmp_path / "events.jsonl")
+        obs.export_jsonl(path)
+        rows = obs.load_jsonl(path)
+        assert len(rows) == 4
+        byname = {r["name"]: r for r in rows}
+        assert byname["prog"]["type"] == "span"
+        assert byname["prog"]["attrs"]["h2d_bytes"] == 128
+        assert byname["memory.preflight"]["type"] == "instant"
+        # append-only: a second export grows the sink
+        obs.export_jsonl(path)
+        assert len(obs.load_jsonl(path)) == 8
+
+    def test_summary_views(self):
+        self._populate()
+        op = obs.summary(view="op")
+        assert "compile:prog" in op and "Calls" in op
+        step = obs.summary(view="step")
+        assert "dispatch(ms)" in step
+
+    def test_phase_breakdown(self):
+        self._populate()
+        b = obs.phase_breakdown()
+        assert b["compile_count"] == 1
+        assert b["dispatch_count"] == 1
+        assert b["collective_bytes"] == 64
+        assert b["h2d_bytes"] == 128
+
+
+# ---------------------------------------------------------------------
+# profiler shims
+# ---------------------------------------------------------------------
+class TestProfilerShims:
+    def test_make_scheduler_repeat_closes(self):
+        from paddle_tpu.profiler import ProfilerState, make_scheduler
+        sched = make_scheduler(closed=1, ready=1, record=2, repeat=2)
+        states = [sched(s) for s in range(12)]
+        one_cycle = [ProfilerState.CLOSED, ProfilerState.READY,
+                     ProfilerState.RECORD, ProfilerState.RECORD_AND_RETURN]
+        assert states[:8] == one_cycle * 2
+        # after `repeat` full cycles the schedule must stay CLOSED
+        assert states[8:] == [ProfilerState.CLOSED] * 4
+
+    def test_make_scheduler_total_zero(self):
+        from paddle_tpu.profiler import ProfilerState, make_scheduler
+        sched = make_scheduler(closed=0, ready=0, record=0)
+        assert sched(0) == ProfilerState.CLOSED
+        assert sched(5) == ProfilerState.CLOSED
+
+    def test_record_event_records_span(self):
+        from paddle_tpu.profiler import RecordEvent
+        with RecordEvent("my_region"):
+            pass
+        assert any(e.name == "my_region" for e in _spans("host"))
+
+    def test_export_chrome_tracing_writes_trace(self, tmp_path):
+        from paddle_tpu.profiler import Profiler, export_chrome_tracing
+        prof = Profiler(
+            timer_only=True,
+            on_trace_ready=export_chrome_tracing(str(tmp_path), "w0"))
+        with prof:
+            from paddle_tpu.profiler import RecordEvent
+            with RecordEvent("step_region"):
+                pass
+            prof.step()
+        path = os.path.join(str(tmp_path), "w0.pt.trace.json")
+        assert prof._last_trace_path == path
+        data = json.loads(open(path).read())
+        assert any(e.get("name") == "step_region"
+                   for e in data["traceEvents"])
+
+    def test_profiler_stop_clears_host_buffer(self):
+        # the PR-2-era module-global _host_events list is gone; the
+        # bounded timeline is the host buffer and stop() releases it
+        import paddle_tpu.profiler as profiler
+        assert not hasattr(profiler, "_host_events")
+        prof = profiler.Profiler(timer_only=True)
+        with prof:
+            with profiler.RecordEvent("r"):
+                pass
+            assert any(e.name == "r" for e in _spans())
+        assert len(obs.get_timeline()) == 0
+
+    def test_profiler_restores_disabled_state(self):
+        from paddle_tpu.profiler import Profiler
+        obs.disable()
+        with Profiler(timer_only=True):
+            assert obs.enabled()  # session force-enables
+        assert not obs.enabled()
+        obs.enable(True)
+
+    def test_load_profiler_result_roundtrip(self, tmp_path):
+        from paddle_tpu.profiler import Profiler, load_profiler_result
+        with obs.span("x"):
+            pass
+        prof = Profiler(timer_only=True)
+        path = prof.export(str(tmp_path / "t.json"))
+        assert load_profiler_result(path)["traceEvents"]
+
+
+# ---------------------------------------------------------------------
+# integrations
+# ---------------------------------------------------------------------
+class TestIntegration:
+    def _run_static(self, n_steps=2):
+        paddle.enable_static()
+        try:
+            main = static.Program()
+            with static.program_guard(main):
+                x = static.data("x", [8, 16], "float32")
+                y = static.data("y", [8, 1], "float32")
+                pred = nn.Linear(16, 1)(x)
+                loss = paddle.nn.functional.mse_loss(pred, y)
+                opt = optimizer.SGD(learning_rate=0.1,
+                                    parameters=main.all_parameters())
+                opt.minimize(loss)
+            feed = {"x": np.ones((8, 16), np.float32),
+                    "y": np.ones((8, 1), np.float32)}
+            exe = static.Executor()
+            for _ in range(n_steps):
+                exe.run(main, feed=feed, fetch_list=[loss])
+        finally:
+            paddle.disable_static()
+
+    def test_executor_two_step_run_emits_spans(self):
+        import paddle_tpu.distributed as dist
+        self._run_static(n_steps=2)
+        t = paddle.to_tensor(np.ones((4, 4), np.float32))
+        dist.all_reduce(t)
+
+        compiles = _spans("compile")
+        dispatches = _spans("dispatch")
+        collectives = _spans("collective")
+        assert len(compiles) == 1  # cached executable: one compile
+        assert len(dispatches) == 2
+        # step attribution: the optimizer step counter rides the spans
+        assert [d.step for d in dispatches] == [0, 1]
+        assert dispatches[0].attrs["h2d_bytes"] > 0
+        assert dispatches[0].attrs["d2h_bytes"] > 0
+        # compile→dispatch flow link
+        assert compiles[0].flow_out is not None
+        assert all(d.flow_in == compiles[0].flow_out for d in dispatches)
+        # collective span carries payload bytes + group size
+        (c,) = collectives
+        assert c.name == "collective:all_reduce"
+        assert c.attrs["bytes"] == 4 * 4 * 4
+        assert c.attrs["nranks"] >= 1
+        # memory-guard preflight rode the compile
+        pre = _instants("memory")
+        assert any(e.name == "memory.preflight" for e in pre)
+
+    def test_jit_compile_and_dispatch_spans(self):
+        m = nn.Linear(4, 2)
+
+        @paddle.jit.to_static
+        def fwd(x):
+            return m(x)
+
+        x = paddle.to_tensor(np.ones((2, 4), np.float32))
+        fwd(x)  # discovery + compile
+        fwd(x)  # cached dispatch
+        compiles = [e for e in _spans("compile") if "jit:" in e.name]
+        dispatches = [e for e in _spans("dispatch") if "jit:" in e.name]
+        assert len(compiles) == 1
+        assert len(dispatches) == 1
+        assert dispatches[0].flow_in == compiles[0].flow_out
+
+    def test_fault_injection_emits_event(self):
+        from paddle_tpu.distributed.fault_tolerance.plan import (
+            FaultPlan, InjectedConnectionError, fault_point, inject)
+        plan = FaultPlan(seed=3).add("worker.step", "drop", count=1)
+        with inject(plan):
+            with pytest.raises(InjectedConnectionError):
+                fault_point("worker.step")
+        (e,) = _instants("fault")
+        assert e.name == "fault.drop"
+        assert e.attrs == {"site": "worker.step", "occurrence": 0}
+
+    def test_ladder_rung_emits_event(self):
+        from paddle_tpu.memory.guard import GuardPolicy
+        GuardPolicy().record("remat", "test detail")
+        (e,) = _instants("memory")
+        assert e.name == "memory.ladder"
+        assert e.attrs["rung"] == "remat"
+
+    def test_nonfinite_sentinel_emits_event(self):
+        from paddle_tpu.amp.debugging import check_numerics
+        t = paddle.to_tensor(np.array([1.0, np.nan], np.float32))
+        try:
+            check_numerics(t, "op", "var")
+        except Exception:
+            pass
+        assert any(e.name == "amp.nonfinite"
+                   for e in _instants("amp"))
+
+    def test_disabled_executor_run_emits_nothing(self):
+        obs.disable()
+        self._run_static(n_steps=1)
+        obs.enable(True)
+        assert len(obs.get_timeline()) == 0
