@@ -216,6 +216,65 @@ def _mem_estimate(exe):
         return None
 
 
+def _cold_warm_compile(exe, prog, fd, loss, on_tpu):
+    """Cold vs persistent-cache-warm compile of the single-step
+    executable.  ``run(use_program_cache=False)`` forces a rebuild;
+    ``jax.clear_caches()`` then drops the in-memory executable so the
+    second compile is served from PADDLE_TPU_COMPILE_CACHE_DIR's disk
+    cache — warm_ms << cold_ms is the persistent cache working.
+    Skipped on TPU unless PADDLE_TPU_BENCH_COLDWARM=1 (two extra
+    minutes-class compiles)."""
+    from paddle_tpu.device import compile_cache_enabled
+    from paddle_tpu import observability as obs
+    if not compile_cache_enabled():
+        return None
+    if on_tpu and os.environ.get("PADDLE_TPU_BENCH_COLDWARM") != "1":
+        return None
+
+    def compile_ms(run):
+        before = obs.phase_breakdown()["compile_ms"]
+        run()
+        return round(obs.phase_breakdown()["compile_ms"] - before, 3)
+
+    try:
+        import jax
+        cold = compile_ms(lambda: exe.run(
+            prog, feed=fd, fetch_list=[loss], use_program_cache=False))
+        jax.clear_caches()
+        warm = compile_ms(lambda: exe.run(
+            prog, feed=fd, fetch_list=[loss], use_program_cache=False))
+        log(f"compile cache: cold={cold:.0f} ms warm={warm:.0f} ms")
+        return {"cold_ms": cold, "warm_ms": warm}
+    except Exception as e:
+        log(f"cold/warm compile measurement failed: {e}")
+        return None
+
+
+def _pipeline_overlap(exe, prog, loss, make_feed, n=6):
+    """Short async-pipeline probe: run() with return_numpy=False behind
+    a DeviceFeeder and read the measured depth / h2d-overlap ratio off
+    the recorded spans (the same trace scripts/pipeline_smoke.py
+    asserts on)."""
+    from paddle_tpu import observability as obs
+    from paddle_tpu.io import DeviceFeeder
+    try:
+        mark = len(obs.get_timeline().events())
+        handles = []
+        with DeviceFeeder([make_feed(i) for i in range(n)]) as feeder:
+            for fb in feeder:
+                handles.append(exe.run(prog, feed=fb, fetch_list=[loss],
+                                       return_numpy=False)[0])
+        for h in handles:
+            float(h)  # sync at the end, not per step
+        stats = obs.pipeline_stats(obs.get_timeline().events()[mark:])
+        log(f"pipeline: depth={stats['measured_depth']} "
+            f"overlap={stats['overlap_ratio']:.2f}")
+        return stats
+    except Exception as e:
+        log(f"pipeline overlap probe failed: {e}")
+        return None
+
+
 # ---------------------------------------------------------------------
 # Config #3 (headline): BERT-base MLM, static graph, AMP bf16
 # ---------------------------------------------------------------------
@@ -282,10 +341,25 @@ def bench_bert(on_tpu, peak):
         mfu = achieved / peak if peak else 0.0
         log(f"bert: tokens/s={tokens_per_sec:,.0f} "
             f"achieved={achieved/1e12:.1f} TF/s MFU={mfu:.3f}")
-        return {"tokens_per_sec": round(tokens_per_sec, 1),
-                "step_ms": round(dt * 1e3, 2), "mfu": round(mfu, 4),
-                "hbm_peak_gb": _hbm_peak_gb(),
-                "memory_estimate": _mem_estimate(exe)}
+        res = {"tokens_per_sec": round(tokens_per_sec, 1),
+               "step_ms": round(dt * 1e3, 2), "mfu": round(mfu, 4),
+               "hbm_peak_gb": _hbm_peak_gb(),
+               "memory_estimate": _mem_estimate(exe)}
+
+        # satellite probes: persistent-compile-cache cold/warm delta and
+        # the async pipeline's measured depth / h2d-overlap ratio
+        cc = _cold_warm_compile(exe, main_prog, fd, loss, on_tpu)
+        if cc is not None:
+            res["compile_cache"] = cc
+
+        def make_feed(i):
+            xi = rng.integers(0, cfg.vocab_size, (B, S)).astype(np.int64)
+            return {"ids": xi, "labels": xi}
+
+        pl = _pipeline_overlap(exe, main_prog, loss, make_feed)
+        if pl is not None:
+            res["pipeline"] = pl
+        return res
     finally:
         paddle.disable_static()
 
@@ -752,6 +826,14 @@ def main():
     from paddle_tpu import observability as obs
     obs.enable(True)
 
+    # persistent XLA compile cache: warm re-runs of the bench skip the
+    # minutes-class BERT/GPT compiles (PADDLE_TPU_COMPILE_CACHE_DIR
+    # overrides; the cold/warm delta is reported per config)
+    os.environ.setdefault("PADDLE_TPU_COMPILE_CACHE_DIR",
+                          str(ROOT / ".bench_cache" / "xla_cache"))
+    from paddle_tpu.device import ensure_compile_cache
+    ensure_compile_cache()
+
     pallas_ok = None
     if on_tpu:
         from paddle_tpu.framework.flags import get_flags
@@ -827,6 +909,14 @@ def main():
             if res.get("memory_estimate"):
                 payload["extra_metrics"]["bert_memory_estimate"] = \
                     res["memory_estimate"]
+            if res.get("compile_cache"):
+                payload["extra_metrics"]["bert_compile_cold_ms"] = \
+                    res["compile_cache"]["cold_ms"]
+                payload["extra_metrics"]["bert_compile_warm_ms"] = \
+                    res["compile_cache"]["warm_ms"]
+            if res.get("pipeline"):
+                payload["extra_metrics"]["bert_pipeline"] = \
+                    res["pipeline"]
             if x32_bert:
                 # x32 (s64-free device program) measured pre-claim in a
                 # child; report the better headline, honestly labeled

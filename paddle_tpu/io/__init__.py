@@ -27,7 +27,7 @@ __all__ = ["Dataset", "IterableDataset", "TensorDataset", "ComposeDataset",
            "ChainDataset", "Subset", "ConcatDataset", "random_split",
            "Sampler", "SequenceSampler", "RandomSampler", "WeightedRandomSampler",
            "BatchSampler", "DistributedBatchSampler", "DataLoader",
-           "get_worker_info", "default_collate_fn"]
+           "DeviceFeeder", "get_worker_info", "default_collate_fn"]
 
 
 class Dataset:
@@ -381,6 +381,13 @@ class DataLoader:
         self.worker_init_fn = worker_init_fn
         self.return_list = return_list
         self.use_shared_memory = use_shared_memory
+        self.persistent_workers = persistent_workers
+        # persistent-worker state: (pool, rings) kept across epochs when
+        # persistent_workers=True; spawn-mode re-pickling of the dataset
+        # and fork/ring setup then happen once, not per epoch
+        self._mp_pool = None
+        self._mp_rings = []
+        self._thread_pool = None
         self._iterable = isinstance(dataset, IterableDataset)
         if batch_sampler is not None:
             self.batch_sampler = batch_sampler
@@ -425,13 +432,9 @@ class DataLoader:
         for indices in self.batch_sampler:
             yield self.collate_fn([self.dataset[i] for i in indices])
 
-    def _iter_multiprocess(self):
-        """Real multiprocess workers (the reference's dataloader_iter
-        worker pool): the dataset is shared into forked workers
-        (copy-on-write, nothing pickled per item), workers run
-        __getitem__ — the GIL-bound decode/augment cost — and ship
-        sample lists back; the parent collates so jax device arrays
-        never cross the pipe."""
+    def _mp_create_pool(self):
+        """Create the worker pool + shm rings (one-time when
+        persistent_workers, per-epoch otherwise)."""
         import multiprocessing as mp
 
         # forking after the XLA runtime started its thread pools can
@@ -449,8 +452,6 @@ class DataLoader:
         except ValueError as e:  # pragma: no cover - non-POSIX
             raise _MPUnavailable(str(e))
 
-        dataset = self.dataset
-        init_fn = self.worker_init_fn
         depth = max(2, self.prefetch_factor * self.num_workers)
 
         # shared-memory batch transport (one SPSC ring per worker; see
@@ -482,7 +483,8 @@ class DataLoader:
             pool = ctx.Pool(
                 self.num_workers,
                 initializer=_mp_worker_init,
-                initargs=(dataset, init_fn, counter, ring_names))
+                initargs=(self.dataset, self.worker_init_fn, counter,
+                          ring_names))
             # smoke round: spawn-unpickle failures crash CHILDREN after
             # Pool() returns, leaving every result pending forever; a
             # bounded probe turns that hang into the threaded fallback
@@ -495,6 +497,69 @@ class DataLoader:
             for r in rings:
                 r.close()
             raise _MPUnavailable(str(e))
+        return pool, rings
+
+    def _mp_teardown(self, pool=None, rings=None):
+        """Terminate a pool + rings (default: the persistent ones)."""
+        own = pool is None and rings is None
+        pool = pool if pool is not None else self._mp_pool
+        rings = rings if rings is not None else self._mp_rings
+        if pool is not None:
+            try:
+                pool.terminate()
+                pool.join()
+            except Exception:
+                pass
+        for r in rings or []:
+            try:
+                r.close()
+            except Exception:
+                pass
+        if own or pool is self._mp_pool:
+            self._mp_pool, self._mp_rings = None, []
+
+    @staticmethod
+    def _mp_drain_pending(pending, rings):
+        """Consume every outstanding worker result so a kept-alive pool's
+        shm rings hold no unread slots for the next epoch (early ``break``
+        leaves up to ``depth`` results in flight)."""
+        import pickle
+        while not pending.empty():
+            samples = pending.get().get(timeout=60)
+            if (isinstance(samples, tuple) and len(samples) == 2
+                    and samples[0] == "__shm__"):
+                pickle.loads(rings[samples[1]].read())
+
+    def shutdown(self):
+        """Stop persistent workers (no-op when none are alive)."""
+        self._mp_teardown()
+        tp = self._thread_pool
+        if tp is not None:
+            self._thread_pool = None
+            tp.shutdown(wait=False)
+
+    def __del__(self):
+        try:
+            self.shutdown()
+        except Exception:
+            pass
+
+    def _iter_multiprocess(self):
+        """Real multiprocess workers (the reference's dataloader_iter
+        worker pool): the dataset is shared into forked workers
+        (copy-on-write, nothing pickled per item), workers run
+        __getitem__ — the GIL-bound decode/augment cost — and ship
+        sample lists back; the parent collates so jax device arrays
+        never cross the pipe.  With persistent_workers=True the pool
+        and rings outlive the epoch and are reused by the next one."""
+        if self._mp_pool is not None:
+            pool, rings = self._mp_pool, self._mp_rings
+        else:
+            pool, rings = self._mp_create_pool()
+            if self.persistent_workers:
+                self._mp_pool, self._mp_rings = pool, rings
+        depth = max(2, self.prefetch_factor * self.num_workers)
+        keep = self.persistent_workers
         try:
             import pickle
             pending = queue.Queue()
@@ -519,21 +584,37 @@ class DataLoader:
                     samples = pickle.loads(rings[samples[1]].read())
                 submit_next()
                 yield self.collate_fn(samples)
+            if keep:
+                pending = None  # clean exhaustion: nothing left in flight
         finally:
-            pool.terminate()
-            pool.join()
-            for r in rings:
-                r.close()
+            if keep and pool is self._mp_pool:
+                if pending is not None:
+                    try:
+                        self._mp_drain_pending(pending, rings)
+                    except Exception:
+                        # a worker died mid-drain: the pool is no longer
+                        # trustworthy for reuse
+                        self._mp_teardown()
+            else:
+                self._mp_teardown(pool, rings)
 
     def _iter_threaded(self):
         """Prefetch with a thread pool (host-side pipeline; the heavy work
         — decode/augment — releases the GIL in numpy, and device transfer
-        overlaps via jax async dispatch)."""
+        overlaps via jax async dispatch).  persistent_workers keeps the
+        executor across epochs."""
         from concurrent.futures import ThreadPoolExecutor
 
+        keep = self.persistent_workers
+        if keep and self._thread_pool is not None:
+            pool = self._thread_pool
+        else:
+            pool = ThreadPoolExecutor(max_workers=self.num_workers)
+            if keep:
+                self._thread_pool = pool
         depth = max(2, self.prefetch_factor * self.num_workers)
-        with ThreadPoolExecutor(max_workers=self.num_workers) as pool:
-            pending = queue.Queue()
+        pending = queue.Queue()
+        try:
             it = iter(self.batch_sampler)
 
             def submit_next():
@@ -554,3 +635,15 @@ class DataLoader:
                 fut = pending.get()
                 submit_next()
                 yield fut.result()
+        finally:
+            if keep and pool is self._thread_pool:
+                while not pending.empty():  # early exit: let stragglers
+                    try:                    # finish so state stays clean
+                        pending.get().result(timeout=60)
+                    except Exception:
+                        pass
+            else:
+                pool.shutdown(wait=True)
+
+
+from .device_feeder import DeviceFeeder  # noqa: E402  (imports core.pipeline)

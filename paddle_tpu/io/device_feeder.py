@@ -1,0 +1,208 @@
+"""Device-side input prefetch: the h2d stage of the async step pipeline.
+
+``DeviceFeeder`` wraps any host batch source (a ``DataLoader`` — whose
+multiprocess/threaded workers remain the decode/augment stage — or any
+iterable of feed dicts / tensors) and keeps a bounded queue of batches
+ALREADY transferred to the device: a background thread ``jax.device_
+put``s batch N+1 while the caller's step N computes, so the h2d
+transfer overlaps compute instead of serializing in front of it.
+
+    loader = DataLoader(ds, batch_size=64, num_workers=4,
+                        persistent_workers=True)
+    with DeviceFeeder(loader) as feeder:          # depth from
+        for feed in feeder:                       # PADDLE_TPU_PIPELINE_DEPTH
+            loss = exe.run(prog, feed=feed, fetch_list=[loss_var],
+                           return_numpy=False)
+
+Works identically for dygraph (``as_tensors=True`` wraps the leaves as
+eager Tensors).  Each transfer is recorded as an ``h2d`` span (bytes +
+batch index) on the observability timeline, and the prefetch-queue
+depth as the ``pipeline.feeder_depth`` gauge.  Iteration is epoch-
+scoped and restartable: each ``__iter__`` spawns one prefetch thread,
+and early loop exit (``break``) or ``close()`` drains it cleanly —
+the source's persistent workers survive for the next epoch.
+"""
+from __future__ import annotations
+
+import queue
+import threading
+
+import numpy as np
+import jax
+
+from .. import observability as obs
+from ..core.pipeline import pipeline_depth
+from ..core.tensor import Tensor
+
+__all__ = ["DeviceFeeder"]
+
+_SENTINEL = object()
+
+
+def _leaf_to_device(v, device):
+    """One pytree leaf → device array (None for non-array leaves)."""
+    if isinstance(v, Tensor):
+        v = v._value
+    if isinstance(v, jax.Array):
+        arr = v
+    elif isinstance(v, (np.ndarray, np.generic)):
+        arr = v
+    elif isinstance(v, (int, float, bool)):
+        return None  # scalars pass through untouched
+    else:
+        return None
+    return jax.device_put(arr, device)
+
+
+class DeviceFeeder:
+    """Bounded double-buffered device prefetch over a host batch source.
+
+    Parameters
+    ----------
+    source : iterable        DataLoader or any iterable of batches
+                             (dict / list / tuple / array pytrees)
+    depth : int | None       prefetch bound; None → PADDLE_TPU_PIPELINE_DEPTH
+    device : jax.Device | None   target device (default: default device)
+    as_tensors : bool        wrap device leaves as eager Tensors (dygraph)
+    """
+
+    def __init__(self, source, depth=None, device=None, as_tensors=False):
+        self.source = source
+        self._depth = depth
+        self.device = device
+        self.as_tensors = as_tensors
+        self._epoch_stop = None
+        self._epoch_thread = None
+        self._epoch_queue = None
+        self._lock = threading.Lock()
+
+    @property
+    def depth(self):
+        return (self._depth if self._depth is not None
+                else pipeline_depth())
+
+    # -- transfer ---------------------------------------------------------
+    def _to_device(self, batch, index):
+        nbytes = [0]
+
+        def convert(v):
+            dev = _leaf_to_device(v, self.device)
+            if dev is None:
+                return v
+            try:
+                nbytes[0] += int(dev.size) * dev.dtype.itemsize
+            except Exception:
+                pass
+            return Tensor(dev, _internal=True, stop_gradient=True) \
+                if self.as_tensors else dev
+
+        def walk(b):
+            if isinstance(b, dict):
+                return {k: walk(v) for k, v in b.items()}
+            if isinstance(b, (list, tuple)):
+                return type(b)(walk(v) for v in b)
+            return convert(b)
+
+        with obs.span("h2d:prefetch", cat="h2d", batch=index) as sp:
+            out = walk(batch)
+            sp.set("h2d_bytes", nbytes[0])
+        return out
+
+    # -- epoch lifecycle --------------------------------------------------
+    @staticmethod
+    def _stop_epoch(stop, thread, q):
+        """Stop one epoch's prefetch thread and drain its queue (early
+        loop exit / close): the thread may be blocked on a full queue
+        and must observe the stop flag."""
+        stop.set()
+        while thread.is_alive():
+            try:
+                q.get_nowait()
+            except queue.Empty:
+                pass
+            thread.join(timeout=0.05)
+
+    def _teardown_epoch(self, only=None):
+        """Tear down the tracked epoch (or ``only`` that specific one —
+        a stale generator must never kill its successor's epoch)."""
+        with self._lock:
+            current = (self._epoch_stop, self._epoch_thread,
+                       self._epoch_queue)
+            if only is not None and current[0] is not only[0]:
+                current = only          # superseded: stop just our own
+            else:
+                self._epoch_stop = self._epoch_thread = None
+                self._epoch_queue = None
+        if current[0] is None:
+            return
+        self._stop_epoch(*current)
+        if obs.enabled():
+            obs.get_registry().gauge("pipeline.feeder_depth").set(0)
+
+    def close(self):
+        """Drain the in-flight epoch (safe to call at any time)."""
+        self._teardown_epoch()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+    def __len__(self):
+        return len(self.source)
+
+    # -- iteration --------------------------------------------------------
+    def __iter__(self):
+        self._teardown_epoch()  # a fresh epoch preempts a stale one
+        depth = self.depth
+        stop = threading.Event()
+        q = queue.Queue(maxsize=max(1, depth))
+
+        def put(item):
+            while not stop.is_set():
+                try:
+                    q.put(item, timeout=0.1)
+                    return True
+                except queue.Full:
+                    continue
+            return False
+
+        def worker():
+            try:
+                for i, batch in enumerate(self.source):
+                    if stop.is_set():
+                        return
+                    if not put((self._to_device(batch, i), None)):
+                        return
+                put((_SENTINEL, None))
+            except BaseException as e:  # surfaces in the consumer
+                put((_SENTINEL, e))
+
+        thread = threading.Thread(target=worker, daemon=True,
+                                  name="DeviceFeeder-prefetch")
+        with self._lock:
+            self._epoch_stop, self._epoch_thread = stop, thread
+            self._epoch_queue = q
+        thread.start()
+        gauge = (obs.get_registry().gauge("pipeline.feeder_depth")
+                 if obs.enabled() else None)
+        try:
+            while True:
+                item, err = q.get()
+                if gauge is not None:
+                    gauge.set(q.qsize())
+                if item is _SENTINEL:
+                    if err is not None:
+                        raise err
+                    return
+                yield item
+        finally:
+            self._teardown_epoch(only=(stop, thread, q))

@@ -278,18 +278,40 @@ class TracedFunction:
         rw_vals = concrete_values(rw_state)
         label = f"jit:{getattr(self._orig_fn, '__qualname__', self._fn)}"
         flow = obs.next_flow_id()
+        from ..device.compile_cache import (ensure_compile_cache,
+                                            record_compile_metrics)
+        ensure_compile_cache()  # PADDLE_TPU_COMPILE_CACHE_DIR
+        import time as _time
+        t0 = _time.perf_counter()
         with obs.span("compile:" + label, cat="compile", flow_out=flow,
                       n_state=len(state)):
             compiled = jitted.lower(arg_vals, ro_vals, rw_vals).compile()
+        record_compile_metrics((_time.perf_counter() - t0) * 1e3,
+                               kind="to_static")
         # memory guard pre-flight: hold the fresh executable to the HBM
-        # budget before its first dispatch (raises HbmBudgetError)
+        # budget before its first dispatch (raises HbmBudgetError).  The
+        # async window keeps up to depth-1 extra steps' args/outputs
+        # live; the guard accounts for them.
+        from ..core.pipeline import pipeline_depth
         from ..memory.estimator import named_buffer_sizes
         from ..memory.guard import preflight_check
+
+        def _nbytes(vals):
+            n = 0
+            for v in vals:
+                try:
+                    n += int(v.size) * v.dtype.itemsize
+                except Exception:
+                    pass
+            return n
+
         estimate = preflight_check(
             compiled, program=label,
             named_buffers=named_buffer_sizes(
                 [(f"state:{t.name or ('tensor_%d' % i)}", t)
-                 for i, t in enumerate(state)]))
+                 for i, t in enumerate(state)]),
+            pipeline_depth=pipeline_depth(),
+            per_step_io_bytes=_nbytes(arg_vals))
         return {
             "compiled": compiled,
             "label": label,
@@ -315,6 +337,14 @@ class TracedFunction:
                             estimate=comp["estimate"]):
             out_vals, mut_vals, grad_vals = comp["compiled"](
                 arg_vals, ro_vals, rw_vals)
+        # bound the async dispatch pipeline: at most depth-1 older steps
+        # stay un-synchronized (PADDLE_TPU_PIPELINE_DEPTH); outputs stay
+        # live device arrays — reading them is still the sync point.
+        # mut_vals are not admitted: they get donated to the next call.
+        from ..core.pipeline import get_window
+        get_window().admit(
+            tuple(v for v in out_vals if isinstance(v, jax.Array)),
+            label=comp["label"])
         for t, v in zip(comp["mutated"], mut_vals):
             t._value = v
             t._grad_node = None

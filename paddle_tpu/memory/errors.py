@@ -66,6 +66,13 @@ def _report_lines(program, estimate, budget, top_buffers, shortfall=None):
                      f" + outputs {format_bytes(estimate.output_bytes)}"
                      f" + code {format_bytes(estimate.generated_code_bytes)}"
                      f" - aliased {format_bytes(estimate.alias_bytes)})")
+        if getattr(estimate, "pipeline_bytes", 0):
+            lines.append(
+                f"  pipeline in-flight buffers: "
+                f"{format_bytes(estimate.pipeline_bytes)} "
+                f"({estimate.pipeline_depth - 1} extra step(s) at "
+                f"PADDLE_TPU_PIPELINE_DEPTH={estimate.pipeline_depth}; "
+                f"lower the depth to 1 to reclaim)")
     if budget is not None:
         lines.append(f"  HBM budget: {format_bytes(budget)}")
     if shortfall is not None:

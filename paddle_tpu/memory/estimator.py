@@ -36,13 +36,18 @@ class MemoryEstimate:
     temp_bytes: int = 0           # activations / scratch
     generated_code_bytes: int = 0
     alias_bytes: int = 0          # donated in→out aliasing (not doubled)
+    # async step pipeline: extra copies of per-step feeds + outputs kept
+    # live by the in-flight window (depth-1 un-synchronized steps)
+    pipeline_bytes: int = 0
+    pipeline_depth: int = 1
     # named resident buffers (params, opt state, feeds), largest first
     buffers: List[Tuple[str, int]] = field(default_factory=list)
 
     @property
     def total_bytes(self) -> int:
         return (self.argument_bytes + self.output_bytes + self.temp_bytes
-                + self.generated_code_bytes - self.alias_bytes)
+                + self.generated_code_bytes - self.alias_bytes
+                + self.pipeline_bytes)
 
     def top_buffers(self, k=5):
         """Top-k largest buffers, with XLA's temp/output totals ranked
@@ -54,6 +59,10 @@ class MemoryEstimate:
                          self.temp_bytes))
         if self.output_bytes:
             rows.append(("<xla outputs>", self.output_bytes))
+        if self.pipeline_bytes:
+            rows.append((f"<pipeline in-flight buffers "
+                         f"(depth={self.pipeline_depth})>",
+                         self.pipeline_bytes))
         rows.sort(key=lambda r: r[1], reverse=True)
         return rows[:k]
 
@@ -66,6 +75,8 @@ class MemoryEstimate:
             "temp_gb": round(self.temp_bytes / gib, 4),
             "generated_code_gb": round(self.generated_code_bytes / gib, 4),
             "alias_gb": round(self.alias_bytes / gib, 4),
+            "pipeline_gb": round(self.pipeline_bytes / gib, 4),
+            "pipeline_depth": self.pipeline_depth,
             "total_gb": round(self.total_bytes / gib, 4),
             "top_buffers": [
                 {"name": n, "gb": round(b / gib, 4)}

@@ -155,13 +155,20 @@ def last_estimate():
 
 
 def preflight_check(compiled, program="<program>", named_buffers=None,
-                    budget=None, raise_on_over=True):
+                    budget=None, raise_on_over=True, pipeline_depth=1,
+                    per_step_io_bytes=0):
     """Estimate ``compiled``'s footprint and hold it to the HBM budget.
 
     Runs right after AOT compilation, before the first dispatch.  Returns
     the MemoryEstimate (None when the backend has no memory analysis or
     the guard is off).  Raises HbmBudgetError when over budget, unless
     ``raise_on_over=False`` (the ladder probes budgets that way).
+
+    ``pipeline_depth`` > 1 (PADDLE_TPU_PIPELINE_DEPTH) charges the async
+    step pipeline's in-flight buffers: each of the depth-1 extra
+    un-synchronized steps keeps its outputs plus ``per_step_io_bytes``
+    of feeds live, so the estimate covers the pipelined steady state,
+    not just one isolated step.
     """
     if not guard_enabled():
         return None
@@ -169,12 +176,18 @@ def preflight_check(compiled, program="<program>", named_buffers=None,
                            named_buffers=named_buffers)
     if est is None:
         return None
+    extra_steps = max(0, int(pipeline_depth) - 1)
+    if extra_steps:
+        est.pipeline_depth = int(pipeline_depth)
+        est.pipeline_bytes = extra_steps * (
+            est.output_bytes + int(per_step_io_bytes))
     record_estimate(est)
     if budget is None:
         budget = device_hbm_budget()
     obs.instant("memory.preflight", cat="memory", program=program,
                 total_bytes=est.total_bytes, temp_bytes=est.temp_bytes,
-                argument_bytes=est.argument_bytes, budget=budget)
+                argument_bytes=est.argument_bytes,
+                pipeline_bytes=est.pipeline_bytes, budget=budget)
     if raise_on_over:
         check_budget(est, budget=budget, site=OOM_SITE)
     return est

@@ -33,7 +33,7 @@ from .registry import (  # noqa: F401
 )
 from .export import (  # noqa: F401
     CATEGORY_LANES, chrome_trace, export_chrome_trace, export_jsonl,
-    load_jsonl, phase_breakdown, summary,
+    load_jsonl, phase_breakdown, pipeline_stats, summary,
 )
 
 __all__ = [
@@ -44,4 +44,5 @@ __all__ = [
     "Counter", "Gauge", "Histogram", "MetricsRegistry", "get_registry",
     "CATEGORY_LANES", "chrome_trace", "export_chrome_trace",
     "export_jsonl", "load_jsonl", "summary", "phase_breakdown",
+    "pipeline_stats",
 ]

@@ -23,11 +23,13 @@ import time
 from .timeline import get_timeline, obs_dir
 
 __all__ = ["CATEGORY_LANES", "chrome_trace", "export_chrome_trace",
-           "export_jsonl", "load_jsonl", "summary", "phase_breakdown"]
+           "export_jsonl", "load_jsonl", "summary", "phase_breakdown",
+           "pipeline_stats"]
 
 # tid lanes, one per category, so each stream renders as its own track
 CATEGORY_LANES = {"host": 0, "compile": 1, "dispatch": 2, "collective": 3,
-                  "memory": 4, "fault": 5, "amp": 6}
+                  "memory": 4, "fault": 5, "amp": 6, "h2d": 7, "d2h": 8,
+                  "pipeline": 9}
 _EXTRA_LANE_BASE = 16
 
 
@@ -175,8 +177,10 @@ def phase_breakdown(events=None):
     if events is None:
         events = get_timeline().events()
     out = {"compile_ms": 0.0, "dispatch_ms": 0.0, "collective_ms": 0.0,
+           "h2d_ms": 0.0, "d2h_ms": 0.0, "pipeline_wait_ms": 0.0,
            "collective_bytes": 0, "h2d_bytes": 0, "d2h_bytes": 0,
-           "compile_count": 0, "dispatch_count": 0, "collective_count": 0}
+           "compile_count": 0, "dispatch_count": 0, "collective_count": 0,
+           "h2d_count": 0, "d2h_count": 0, "pipeline_wait_count": 0}
     for e in events:
         if e.dur is None:
             continue
@@ -194,6 +198,84 @@ def phase_breakdown(events=None):
             out["collective_ms"] += ms
             out["collective_count"] += 1
             out["collective_bytes"] += int(attrs.get("bytes", 0) or 0)
-    for k in ("compile_ms", "dispatch_ms", "collective_ms"):
+        elif e.cat == "h2d":
+            out["h2d_ms"] += ms
+            out["h2d_count"] += 1
+            out["h2d_bytes"] += int(attrs.get("h2d_bytes", 0) or 0)
+        elif e.cat == "d2h":
+            out["d2h_ms"] += ms
+            out["d2h_count"] += 1
+            out["d2h_bytes"] += int(attrs.get("d2h_bytes", 0) or 0)
+        elif e.cat == "pipeline":
+            out["pipeline_wait_ms"] += ms
+            out["pipeline_wait_count"] += 1
+    for k in ("compile_ms", "dispatch_ms", "collective_ms", "h2d_ms",
+              "d2h_ms", "pipeline_wait_ms"):
         out[k] = round(out[k], 3)
     return out
+
+
+def pipeline_stats(events=None):
+    """Measured async-pipeline health from the timeline.
+
+    ``overlap_ms``/``overlap_ratio``: how much of the recorded h2d
+    transfer time ran WHILE a step was in flight (dispatched but not
+    yet synchronized) — the device prefetch doing its job (1.0 = every
+    transfer fully hidden behind compute).  ``measured_depth``: the max
+    number of concurrently in-flight steps + open h2d transfers, i.e.
+    the pipeline depth the run actually achieved (1 = fully serial).
+    """
+    if events is None:
+        events = get_timeline().events()
+    dispatch = sorted((e.ts, e.ts + e.dur) for e in events
+                      if e.dur is not None and e.cat == "dispatch")
+    syncs = sorted((e.ts, e.ts + e.dur) for e in events
+                   if e.dur is not None and e.cat in ("pipeline", "d2h"))
+    h2d = [(e.ts, e.ts + e.dur) for e in events
+           if e.dur is not None and e.cat == "h2d"]
+
+    # Under async dispatch the ``dispatch`` span closes when the host
+    # enqueue returns, not when the device finishes — so a step is IN
+    # FLIGHT from its dispatch start until the sync that retires it
+    # (its ``pipeline.wait`` or first ``d2h`` read), matched FIFO.  A
+    # dispatch with no later sync falls back to its own span, so a
+    # purely synchronous trace never fabricates overlap.
+    inflight = []
+    si = 0
+    for a, b in dispatch:
+        while si < len(syncs) and syncs[si][1] < b:
+            si += 1
+        if si < len(syncs):
+            inflight.append((a, max(b, syncs[si][1])))
+            si += 1
+        else:
+            inflight.append((a, b))
+
+    def _overlap(a, b):
+        return max(0.0, min(a[1], b[1]) - max(a[0], b[0]))
+
+    total_h2d = sum(b - a for a, b in h2d)
+    overlap = 0.0
+    for seg in h2d:
+        covered = sum(_overlap(seg, d) for d in inflight)
+        overlap += min(covered, seg[1] - seg[0])
+
+    # measured depth: sweep starts/ends of the in-flight + h2d lanes
+    edges = []
+    for a, b in inflight + h2d:
+        edges.append((a, 1))
+        edges.append((b, -1))
+    edges.sort()
+    depth = cur = 0
+    for _, d in edges:
+        cur += d
+        depth = max(depth, cur)
+
+    return {
+        "h2d_ms": round(total_h2d * 1e3, 3),
+        "overlap_ms": round(overlap * 1e3, 3),
+        "overlap_ratio": round(overlap / total_h2d, 4) if total_h2d else 0.0,
+        "measured_depth": depth,
+        "dispatch_count": len(dispatch),
+        "h2d_count": len(h2d),
+    }

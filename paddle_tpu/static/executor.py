@@ -13,6 +13,9 @@ and cached — XLA performs scheduling, fusion, and memory planning.  Repeat
 """
 from __future__ import annotations
 
+import hashlib
+import time
+from collections import OrderedDict
 from typing import Dict, List, Optional, Sequence
 
 import numpy as np
@@ -87,11 +90,70 @@ def _obs_step(step_val):
         return None
 
 
+def _feed_shape(v):
+    """Feed value shape WITHOUT forcing a device→host transfer —
+    np.asarray on a live jax.Array would synchronize the pipeline."""
+    s = getattr(v, "shape", None)
+    return tuple(s) if s is not None else tuple(np.asarray(v).shape)
+
+
+def _as_feed_val(v, dtype):
+    """Feed value → device array of `dtype`.  Values already on device
+    (DeviceFeeder output, eager Tensors) pass through without touching
+    the host; only genuinely host-side values pay the h2d conversion."""
+    if isinstance(v, Tensor):
+        v = v._value
+    if isinstance(v, jax.Array):
+        return v if v.dtype == dtype else jnp.asarray(v, dtype)
+    return jnp.asarray(np.asarray(v), dtype)
+
+
+def _program_fingerprint(program):
+    """Structural identity of a Program: op types + input/output variable
+    names and captured-constant shapes/dtypes + whether an optimizer is
+    attached.  Keyed WITH id(program) in the executable cache (captured
+    parameter Tensors are per-program-object; the fingerprint detects
+    structural mutation of the same object and gives two Executor
+    instances a shared handle on the same program)."""
+    block = program.global_block()
+    cached = getattr(program, "_ptpu_fingerprint", None)
+    if cached is not None and cached[0] == len(block.ops):
+        return cached[1]
+    h = hashlib.sha1()
+    for op in block.ops:
+        h.update(str(op.type).encode())
+        for i in op.inputs:
+            if isinstance(i, Variable):
+                h.update(b"v" + i.name.encode())
+            else:
+                v = getattr(i, "_value", None)
+                h.update(b"c" + str(getattr(v, "shape", ())).encode()
+                         + str(getattr(v, "dtype", "?")).encode())
+        for o in op.outputs:
+            h.update(b"o" + str(getattr(o, "name", o)).encode())
+    h.update(b"opt" if program._optimize_info is not None else b"noopt")
+    fp = h.hexdigest()[:16]
+    program._ptpu_fingerprint = (len(block.ops), fp)
+    return fp
+
+
 class Executor:
+    # process-wide executable cache keyed by (id(program), fingerprint,
+    # feed-spec, fetch-spec): a second Executor over the same program
+    # reuses the compiled entry without re-lowering.  Entries hold a
+    # strong ref to their program (id() reuse after GC must not alias a
+    # dead program's entry); bounded FIFO keeps that from accumulating.
+    _shared_cache: "OrderedDict" = OrderedDict()
+    _SHARED_CACHE_CAP = 16
+
     def __init__(self, place=None):
         self.place = place
         self._cache = {}
         self._last_estimate = None
+
+    @classmethod
+    def clear_shared_cache(cls):
+        cls._shared_cache.clear()
 
     def last_memory_estimate(self):
         """The memory guard's pre-flight estimate for the most recently
@@ -99,7 +161,8 @@ class Executor:
         analysis ran — bench.py records this in the BENCH json."""
         return self._last_estimate
 
-    def _prologue(self, program, feed, fetch_list, n_steps):
+    def _prologue(self, program, feed, fetch_list, n_steps,
+                  use_program_cache=True):
         """Shared by run()/run_steps(): resolve (program, feed, fetch),
         get-or-build the cache entry, convert feeds, snapshot param/opt
         state, and advance the host-side lr/step bookkeeping by
@@ -117,16 +180,36 @@ class Executor:
             return None, fetch_list
 
         key = self._cache_key(program, feed, fetch_list)
-        entry = self._cache.get(key)
-        if entry is None:
+        if not use_program_cache:
+            # honor run(use_program_cache=False): evict any cached
+            # executable for this (program, feed, fetch) and build
+            # fresh WITHOUT storing — the next cached run rebuilds too
+            self._cache.pop(key, None)
+            Executor._shared_cache.pop(key, None)
             entry = self._build(program, feed, fetch_list)
-            self._cache[key] = entry
+        else:
+            entry = self._cache.get(key)
+            if entry is None:
+                entry = Executor._shared_cache.get(key)
+                if entry is None:
+                    entry = self._build(program, feed, fetch_list)
+                    entry["program"] = program  # pin: no id() reuse
+                    Executor._shared_cache[key] = entry
+                    while (len(Executor._shared_cache)
+                           > Executor._SHARED_CACHE_CAP):
+                        Executor._shared_cache.popitem(last=False)
+                else:
+                    Executor._shared_cache.move_to_end(key)
+                self._cache[key] = entry
 
         from ..core.lazy import concrete_values
-        feed_vals = tuple(
-            jnp.asarray(np.asarray(feed[name]), entry["feed_dtypes"][i])
-            for i, name in enumerate(entry["feed_names"])
-        ) + concrete_values(entry["frozen"])
+        with obs.span("h2d:feed", cat="h2d",
+                      program=entry["program_label"]) as h2d_sp:
+            feed_vals = tuple(
+                _as_feed_val(feed[name], entry["feed_dtypes"][i])
+                for i, name in enumerate(entry["feed_names"])
+            ) + concrete_values(entry["frozen"])
+            h2d_sp.set("h2d_bytes", _nbytes_of(feed_vals))
         param_vals = concrete_values(entry["params"])
         opt_state_vals = concrete_values(entry["opt_state"])
         rng_vals = concrete_values(entry["rng_states"])
@@ -145,7 +228,7 @@ class Executor:
 
     @staticmethod
     def _epilogue(entry, outs, new_params, new_opt_state, new_rng,
-                  return_numpy):
+                  return_numpy, step=None, fetch_labels=None):
         for p, v in zip(entry["params"], new_params):
             p._value = v
         for t, v in zip(entry["opt_state"], new_opt_state):
@@ -153,8 +236,25 @@ class Executor:
         for t, v in zip(entry["rng_states"], new_rng):
             t._value = v  # eager rng continues from the program's state
         if return_numpy:
+            # the synchronous sync point: d2h every fetch before return
             return [np.asarray(o) for o in outs]
-        return [Tensor(o, _internal=True) for o in outs]
+        # non-blocking path: the dispatch stays in flight.  Admit it to
+        # the bounded pipeline window (depth 1 blocks it right here —
+        # synchronous semantics) and hand back lazy handles whose FIRST
+        # HOST READ is the sync point.
+        # only the fetch outputs are admitted: param/opt buffers are
+        # donated to the NEXT dispatch and can no longer be blocked on
+        from ..core.pipeline import FetchHandle, get_window
+        get_window().admit(tuple(outs), label=entry["program_label"],
+                           step=step)
+        labels = fetch_labels or [None] * len(outs)
+        return [FetchHandle(o, label=l, step=step)
+                for o, l in zip(outs, labels)]
+
+    @staticmethod
+    def _fetch_labels(fetch_list):
+        return [f.name if isinstance(f, Variable) else str(f)
+                for f in fetch_list]
 
     def run(self, program=None, feed=None, fetch_list=None,
             feed_var_name="feed", fetch_var_name="fetch", scope=None,
@@ -165,7 +265,8 @@ class Executor:
         if isinstance(program, _LoadedInferenceProgram):
             return program.run(feed or {}, fetch_list,
                                return_numpy=return_numpy)
-        call, fetch_list = self._prologue(program, feed, fetch_list, 1)
+        call, fetch_list = self._prologue(program, feed, fetch_list, 1,
+                                          use_program_cache)
         if call is None:
             return [None for _ in fetch_list]
         (entry, feed_vals, param_vals, opt_state_vals, rng_vals,
@@ -183,16 +284,19 @@ class Executor:
                 lr_val, step_val)
             sp.set("d2h_bytes", _nbytes_of(outs))
         return self._epilogue(entry, outs, new_params, new_opt_state,
-                              new_rng, return_numpy)
+                              new_rng, return_numpy,
+                              step=_obs_step(step_val),
+                              fetch_labels=self._fetch_labels(fetch_list))
 
     # ------------------------------------------------------------------
     def _cache_key(self, program, feed, fetch_list):
+        # _feed_shape (not np.asarray) so device-resident feed values —
+        # the whole point of the prefetch pipeline — are not pulled
+        # back to the host just to key the cache
         feed_sig = tuple(sorted(
-            (k, tuple(np.asarray(v).shape)) for k, v in feed.items()))
-        fetch_sig = tuple(
-            f.name if isinstance(f, Variable) else str(f)
-            for f in fetch_list)
-        return (id(program), len(program.global_block().ops), feed_sig,
+            (k, _feed_shape(v)) for k, v in feed.items()))
+        fetch_sig = tuple(self._fetch_labels(fetch_list))
+        return (id(program), _program_fingerprint(program), feed_sig,
                 fetch_sig)
 
     def _build(self, program, feed, fetch_list):
@@ -303,8 +407,7 @@ class Executor:
         donate = get_flags("FLAGS_buffer_donation")["FLAGS_buffer_donation"]
         jitted = jax.jit(pure, donate_argnums=(1, 2) if donate else ())
         feed_avals = tuple(
-            jax.ShapeDtypeStruct(tuple(np.asarray(feed[n]).shape),
-                                 feed_dtypes[i])
+            jax.ShapeDtypeStruct(_feed_shape(feed[n]), feed_dtypes[i])
             for i, n in enumerate(feed_names)) + tuple(
             jax.ShapeDtypeStruct(tuple(t._value.shape), t._value.dtype)
             for t in frozen)
@@ -354,18 +457,31 @@ class Executor:
         def compile_step():
             # deferred: a run_steps-only caller (bench fused loop) must
             # not pay the single-step XLA compile it never invokes
+            from ..device.compile_cache import (ensure_compile_cache,
+                                                record_compile_metrics)
+            ensure_compile_cache()  # PADDLE_TPU_COMPILE_CACHE_DIR
+            t0 = time.perf_counter()
             with obs.span("compile:" + entry["program_label"],
                           cat="compile", flow_out=entry["flow"],
                           ops=len(block.ops)):
                 compiled = jitted.lower(feed_avals, param_avals,
                                         opt_avals, rng_avals, lr_aval,
                                         step_aval).compile()
+            record_compile_metrics((time.perf_counter() - t0) * 1e3,
+                                   kind="executor")
             # pre-flight: hold the executable to the HBM budget BEFORE
-            # the first dispatch (raises HbmBudgetError when over)
+            # the first dispatch (raises HbmBudgetError when over).
+            # per-step feed bytes × (depth-1) extra in-flight steps ride
+            # as a pipeline line item in the estimate.
+            from ..core.pipeline import pipeline_depth
             from ..memory.guard import preflight_check
             entry["estimate"] = preflight_check(
                 compiled, program=entry["program_label"],
-                named_buffers=named_buffers)
+                named_buffers=named_buffers,
+                pipeline_depth=pipeline_depth(),
+                per_step_io_bytes=sum(
+                    sz for n, sz in named_buffers
+                    if n.startswith("feed:")))
             self._last_estimate = entry["estimate"]
             return compiled
 
@@ -444,6 +560,10 @@ class Executor:
             # AOT-compile (rather than dispatch through jax.jit) so the
             # fused loop gets the same pre-flight budget check as run():
             # memory_analysis is only exposed on an explicit Compiled
+            from ..device.compile_cache import (ensure_compile_cache,
+                                                record_compile_metrics)
+            ensure_compile_cache()
+            t0 = time.perf_counter()
             with obs.span("compile:" + entry["program_label"]
                           + ".run_steps", cat="compile",
                           flow_out=entry["loop_flow"]):
@@ -452,10 +572,17 @@ class Executor:
                 ).lower(feed_vals, param_vals, opt_state_vals, rng_vals,
                         lr_val, step_val,
                         jax.ShapeDtypeStruct((), jnp.int32)).compile()
+            record_compile_metrics((time.perf_counter() - t0) * 1e3,
+                                   kind="run_steps")
+            from ..core.pipeline import pipeline_depth
             from ..memory.guard import preflight_check
             entry["loop_estimate"] = preflight_check(
                 loop_fn, program=entry["program_label"] + ".run_steps",
-                named_buffers=entry["named_buffers"])
+                named_buffers=entry["named_buffers"],
+                pipeline_depth=pipeline_depth(),
+                per_step_io_bytes=sum(
+                    sz for n, sz in entry["named_buffers"]
+                    if n.startswith("feed:")))
             self._last_estimate = entry["loop_estimate"]
             entry["loop_fn"] = loop_fn
 
@@ -472,7 +599,9 @@ class Executor:
                 lr_val, step_val, jnp.asarray(n_iters, jnp.int32))
             sp.set("d2h_bytes", _nbytes_of(outs))
         return self._epilogue(entry, outs, new_params, new_opt_state,
-                              new_rng, return_numpy)
+                              new_rng, return_numpy,
+                              step=_obs_step(step_val),
+                              fetch_labels=self._fetch_labels(fetch_list))
 
     def close(self):
         self._cache.clear()
