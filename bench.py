@@ -601,6 +601,66 @@ def bench_gpt(on_tpu, peak):
 
 
 # ---------------------------------------------------------------------
+# Serving: continuous-batching decode through the paged KV cache
+# (GenerationEngine) — headline tokens/sec of a mixed-length greedy
+# burst plus the median prefill latency from the recorded timeline
+# ---------------------------------------------------------------------
+def bench_gpt_decode(on_tpu):
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu import observability as obs
+    from paddle_tpu.inference.serving import GenerationEngine
+    from paddle_tpu.models import GPTConfig, GPTForCausalLM
+
+    if on_tpu:
+        cfg = GPTConfig(hidden_size=1024, num_hidden_layers=24,
+                        num_attention_heads=16, use_flash_attention=True,
+                        max_position_embeddings=1024)
+        n_req, max_new, max_batch, max_prompt = 16, 64, 8, 256
+    else:
+        cfg = GPTConfig(vocab_size=256, hidden_size=128,
+                        num_hidden_layers=2, num_attention_heads=2,
+                        use_flash_attention=False,
+                        max_position_embeddings=128)
+        n_req, max_new, max_batch, max_prompt = 8, 16, 4, 48
+    paddle.seed(0)
+    model = GPTForCausalLM(cfg)
+    model.eval()
+    rng = np.random.default_rng(0)
+    prompts = [list(rng.integers(
+        1, cfg.vocab_size, size=int(rng.integers(4, max_prompt))))
+        for _ in range(n_req)]
+    eng = GenerationEngine(model, max_batch=max_batch,
+                           max_model_len=cfg.max_position_embeddings)
+    try:
+        t = time.time()
+        eng.generate(prompts, max_new_tokens=max_new)  # compiles
+        log(f"gpt_decode: compile+first burst {time.time() - t:.1f}s "
+            f"({eng.stats()['prefill_compiles']} prefill + "
+            f"{eng.stats()['decode_compiles']} decode programs)")
+        obs.get_timeline().clear()
+        t = time.time()
+        eng.generate(prompts, max_new_tokens=max_new)
+        dt = time.time() - t
+        tokens_per_sec = n_req * max_new / dt
+        pf = sorted(e.dur for e in obs.get_timeline().events()
+                    if e.cat == "prefill" and e.dur is not None)
+        prefill_ms = pf[len(pf) // 2] * 1e3 if pf else 0.0
+        s = eng.stats()
+        log(f"gpt_decode: {n_req} reqs x {max_new} tok in {dt:.2f}s "
+            f"{tokens_per_sec:,.0f} tok/s, prefill {prefill_ms:.1f} ms, "
+            f"kv high-water {s['high_water']}/{s['num_blocks']}")
+        return {"tokens_per_sec": round(tokens_per_sec, 1),
+                "prefill_ms": round(prefill_ms, 2),
+                "n_requests": n_req, "max_new_tokens": max_new,
+                "max_batch": max_batch,
+                "kv_high_water": s["high_water"],
+                "kv_blocks": s["num_blocks"]}
+    finally:
+        eng.close()
+
+
+# ---------------------------------------------------------------------
 # Config #5: LLaMA sharding stage2 + TP — correctness dryrun on the
 # 8-device CPU mesh in a subprocess (multi-chip hardware is not
 # available; the sharded program must still build + execute)
@@ -867,6 +927,7 @@ def main():
         "lenet": lambda: bench_lenet(on_tpu),
         "resnet50": lambda: bench_resnet50(on_tpu),
         "gpt": lambda: bench_gpt(on_tpu, peak),
+        "gpt_decode": lambda: bench_gpt_decode(on_tpu),
         "llama": lambda: bench_llama(on_tpu, peak),
         "llama_dryrun": bench_llama_dryrun,
     }
@@ -941,6 +1002,13 @@ def main():
             if res.get("memory_estimate"):
                 payload["extra_metrics"]["gpt_memory_estimate"] = \
                     res["memory_estimate"]
+        elif name == "gpt_decode":
+            payload["extra_metrics"]["gpt_decode_tokens_per_sec"] = \
+                res["tokens_per_sec"]
+            payload["extra_metrics"]["gpt_prefill_ms"] = \
+                res["prefill_ms"]
+            payload["extra_metrics"]["gpt_decode_kv_high_water"] = \
+                res["kv_high_water"]
         elif name == "llama":
             payload["extra_metrics"][
                 "llama_0p3b_recompute_bf16_tokens_per_sec"] = \

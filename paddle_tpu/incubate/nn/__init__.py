@@ -3,4 +3,4 @@ from . import functional
 from .functional import (fused_linear, fused_feedforward,
                          fused_multi_head_attention, fused_rms_norm,
                          fused_layer_norm, fused_rotary_position_embedding,
-                         fused_bias_act, swiglu)
+                         fused_bias_act, swiglu, top_p_sampling)

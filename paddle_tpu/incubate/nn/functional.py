@@ -17,7 +17,8 @@ from ...core.tensor import Tensor
 __all__ = ["fused_linear", "fused_feedforward", "fused_multi_head_attention",
            "fused_rms_norm", "fused_layer_norm",
            "fused_rotary_position_embedding", "fused_bias_act", "swiglu",
-           "fused_dropout_add", "fused_linear_activation"]
+           "fused_dropout_add", "fused_linear_activation",
+           "top_p_sampling"]
 
 
 def fused_linear(x, weight, bias=None, transpose_weight=False, name=None):
@@ -274,3 +275,71 @@ def fused_multi_head_attention(x, qkv_weight, linear_weight,
         out = F.layer_norm(out, [out.shape[-1]], ln_scale, ln_bias,
                            ln_epsilon)
     return out
+
+
+def _nucleus_mask(probs, top_p):
+    """Keep-mask of each row's smallest prefix of descending-probability
+    tokens whose cumulative mass reaches ``top_p[row]`` (rows with
+    ``top_p >= 1`` keep everything).  Boundary rule: a token stays while
+    the cumulative mass *before* it is < top_p — matching
+    models/generation._sample_logits and the serving engine's in-graph
+    sampler, which imports this helper."""
+    order = jnp.argsort(-probs, axis=-1)
+    sorted_p = jnp.take_along_axis(probs, order, axis=-1)
+    cum = jnp.cumsum(sorted_p, axis=-1)
+    keep_sorted = (cum - sorted_p) < top_p[:, None]
+    rows = jnp.arange(probs.shape[0])[:, None]
+    keep = jnp.zeros(probs.shape, bool).at[rows, order].set(keep_sorted)
+    return keep | (top_p[:, None] >= 1.0)
+
+
+def top_p_sampling(x, ps, threshold=None, seed=-1, name=None):
+    """Nucleus (top-p) sampling over a batch of probability rows.
+
+    x: [B, V] probabilities (renormalized internally); ps: [B] or [B, 1]
+    per-row nucleus thresholds.  ``threshold`` additionally drops
+    candidates whose filtered probability falls below it.  ``seed >= 0``
+    draws with a fixed PRNG key — repeated calls with the same inputs
+    and seed return identical tokens; ``seed == -1`` (default) threads
+    the global generator like ``paddle.multinomial``.
+
+    Returns ``(next_scores [B, 1], next_ids [B, 1] int64)`` where the
+    score is the (pre-filter, renormalized) probability of the chosen
+    token.
+    """
+    thr = None if threshold is None else float(threshold)
+
+    def impl(key, probs, p_row, *, thr, stateful):
+        if stateful:
+            new, sub = jax.random.split(key)
+        else:
+            new, sub = key, key
+        pr = probs.astype(jnp.float32)
+        pr = pr / jnp.sum(pr, axis=-1, keepdims=True)
+        p_flat = p_row.reshape(-1).astype(jnp.float32)
+        filt = jnp.where(_nucleus_mask(pr, p_flat), pr, 0.0)
+        if thr is not None:
+            filt = jnp.where(filt >= thr, filt, 0.0)
+        filt = filt / jnp.sum(filt, axis=-1, keepdims=True)
+        ids = jax.random.categorical(
+            sub, jnp.log(jnp.maximum(filt, 1e-30)), axis=-1)
+        scores = jnp.take_along_axis(
+            pr, ids[:, None], axis=-1).astype(probs.dtype)
+        return scores, ids[:, None].astype(jnp.int64), new
+
+    if seed is None or int(seed) < 0:
+        from ...framework.random import default_generator
+        g = default_generator()
+        scores, ids, newk = dispatch(
+            "top_p_sampling", impl, (g.state_tensor, x, ps),
+            dict(thr=thr, stateful=True), differentiable=False)
+        if isinstance(newk, Tensor):
+            g.state_tensor._inplace_update(newk._value)
+        return scores, ids
+
+    key = Tensor(jax.random.PRNGKey(int(seed)), _internal=True,
+                 stop_gradient=True)
+    scores, ids, _ = dispatch(
+        "top_p_sampling", impl, (key, x, ps),
+        dict(thr=thr, stateful=False), differentiable=False)
+    return scores, ids

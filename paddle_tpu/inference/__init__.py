@@ -307,6 +307,10 @@ class Predictor:
         """Run the executable.  Either stage inputs through the handles
         (reference style) or pass a list of arrays positionally."""
         import jax.numpy as jnp
+        # the lock protects only the handle state (input staging, output
+        # binding): the executable itself is a pure function of
+        # (state_vals, xs), so concurrent run() calls overlap on device
+        # instead of serializing the whole step
         with self._lock:
             if inputs is not None:
                 for n, x in zip(self._input_order, inputs):
@@ -319,13 +323,15 @@ class Predictor:
                         f"input {n!r} not set: call "
                         f"get_input_handle({n!r}).copy_from_cpu(...)")
                 xs.append(jnp.asarray(h._host))
-            out = self._exported.call(self._state_vals, *xs)
-            if not isinstance(out, (tuple, list)):
-                out = (out,)
+        out = self._exported.call(self._state_vals, *xs)
+        if not isinstance(out, (tuple, list)):
+            out = (out,)
+        with self._lock:
             for n, o in zip(self._output_order, out):
                 self._outputs[n]._device = o
-            return [self._outputs[n].copy_to_cpu()
-                    for n in self._output_order]
+        # build the return from this call's own results, not the shared
+        # handles — a concurrent run() may rebind them immediately
+        return [np.asarray(o) for o in out]
 
     def clear_intermediate_tensor(self):
         pass  # XLA frees intermediates at executable exit

@@ -1,0 +1,22 @@
+"""LLM serving: paged KV cache, continuous batching, generation engine.
+
+The multi-request generation layer over models/gpt.py — see
+README.md §"Serving".  Entry point: ``GenerationEngine``.
+"""
+from .kv_cache import (ENV_KV_BLOCK_SIZE, RESIDENT_NAME, PagedKVCache,
+                       kv_block_size)
+from .attention import (PagedCacheView, PagedLayerCache, kv_cache_scatter,
+                        paged_attention)
+from .scheduler import (ENV_MAX_BATCH, ContinuousBatchingScheduler,
+                        Request, bucket_for, length_buckets,
+                        max_batch_size)
+from .engine import GenerationEngine, serving_sample_next
+
+__all__ = [
+    "ENV_KV_BLOCK_SIZE", "RESIDENT_NAME", "PagedKVCache", "kv_block_size",
+    "PagedCacheView", "PagedLayerCache", "kv_cache_scatter",
+    "paged_attention",
+    "ENV_MAX_BATCH", "ContinuousBatchingScheduler", "Request",
+    "bucket_for", "length_buckets", "max_batch_size",
+    "GenerationEngine", "serving_sample_next",
+]

@@ -1,0 +1,413 @@
+"""GenerationEngine: multi-request LLM serving over the paged KV cache.
+
+Drives ``models/gpt.py`` as a continuous-batching server:
+
+  * two ``jit.to_static`` step families — a batch-1 **prefill** per
+    power-of-two length bucket and ONE fixed-shape ``[max_batch, 1]``
+    **decode** — so a mixed-length workload compiles at most
+    ``len(buckets) + 1`` executables.  The paged cache's driving arrays
+    (slot mapping, block tables, context lengths, positions) are
+    read-only state Tensors whose values the engine swaps before every
+    call; the pool tensors are mutated state (donated, updated in
+    place);
+  * sampling happens **in-graph** (``serving_sample_next``): greedy
+    argmax, temperature, per-request top-k and top-p, with each draw
+    keyed by ``fold_in(PRNGKey(request.seed), absolute_position)`` —
+    deterministic under any schedule, batch packing, or preemption;
+  * the decode loop never blocks the host: next-step input ids are the
+    previous step's device-side output array (no host read), and
+    results drain lazily ``pipeline_depth - 1`` steps behind dispatch
+    through the PR-4 in-flight window;
+  * observability: ``prefill`` / ``decode`` timeline lanes, and
+    ``serving.tokens_per_sec`` / ``serving.kv_blocks_in_use`` /
+    ``serving.queue_depth`` metrics.
+
+See README.md §"Serving" for usage and knobs.
+"""
+from __future__ import annotations
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ... import observability as obs
+from ...core.dispatch import dispatch
+from ...core.tensor import Tensor
+from ...core.autograd import no_grad
+from ...core.pipeline import pipeline_depth
+from ...incubate.nn.functional import _nucleus_mask
+from .kv_cache import PagedKVCache
+from .attention import PagedCacheView
+from .scheduler import (ContinuousBatchingScheduler, Request, bucket_for,
+                        max_batch_size)
+
+__all__ = ["GenerationEngine", "serving_sample_next"]
+
+
+# ---------------------------------------------------------------------
+# in-graph sampling
+# ---------------------------------------------------------------------
+def _sample_next_impl(logits, last_index, seeds, positions, do_sample,
+                      top_k, top_p, temperature):
+    """logits [B, S, V] -> next token [B] int64.
+
+    Row r reads logits[r, last_index[r]]; greedy rows take the argmax;
+    sampling rows apply temperature -> top-k -> top-p (the dense
+    baseline's filter order) and draw with a key folded from
+    (seed, absolute position) so the result does not depend on how the
+    scheduler packed or when it ran this row."""
+    B, S, V = logits.shape
+    rows = jnp.arange(B)
+    z = logits[rows, last_index.astype(jnp.int32)].astype(jnp.float32)
+    greedy = jnp.argmax(z, axis=-1)
+
+    temp = temperature.astype(jnp.float32)
+    z_t = z / jnp.where(temp > 0, temp, 1.0)[:, None]
+    p = jax.nn.softmax(z_t, axis=-1)
+    # per-row k: static jax.lax.top_k can't vary by row, so threshold
+    # against the kth largest probability (k <= 0 keeps everything)
+    k = jnp.clip(top_k.astype(jnp.int32), 0, V)
+    p_desc = jnp.flip(jnp.sort(p, axis=-1), axis=-1)
+    kth = jnp.take_along_axis(p_desc, jnp.maximum(k - 1, 0)[:, None],
+                              axis=-1)
+    p = jnp.where((k > 0)[:, None] & (p < kth), 0.0, p)
+    p = p / jnp.sum(p, axis=-1, keepdims=True)
+    p = jnp.where(_nucleus_mask(p, top_p.astype(jnp.float32)), p, 0.0)
+    logp = jnp.log(jnp.maximum(p, 1e-30))
+
+    def draw(seed, position, row_logp):
+        key = jax.random.fold_in(
+            jax.random.PRNGKey(seed.astype(jnp.uint32)),
+            position.astype(jnp.uint32))
+        return jax.random.categorical(key, row_logp)
+
+    sampled = jax.vmap(draw)(seeds, positions, logp)
+    use_sample = do_sample & (temp > 0)
+    return jnp.where(use_sample, sampled, greedy).astype(jnp.int64)
+
+
+def serving_sample_next(logits, last_index, seeds, positions, do_sample,
+                        top_k, top_p, temperature):
+    """Batched next-token selection (see _sample_next_impl)."""
+    return dispatch("serving_sample_next", _sample_next_impl,
+                    (logits, last_index, seeds, positions, do_sample,
+                     top_k, top_p, temperature), {},
+                    differentiable=False)
+
+
+# ---------------------------------------------------------------------
+# the engine
+# ---------------------------------------------------------------------
+class GenerationEngine:
+    """Multi-request generation over one causal-LM model.
+
+    ``add_request()`` enqueues, ``step()`` advances the whole batch one
+    scheduler action, ``generate()`` is the run-to-completion
+    convenience.  Results are full token sequences (prompt + generated,
+    truncated at EOS).
+    """
+
+    def __init__(self, model, config=None, max_batch=None,
+                 block_size=None, num_blocks=None, max_model_len=None,
+                 buckets=None, hbm_fraction=0.3):
+        import paddle_tpu as paddle
+        cfg = config or getattr(model, "config", None) \
+            or model.gpt.config
+        self.model = model
+        model.eval()
+        num_layers = cfg.num_hidden_layers
+        num_heads = cfg.num_attention_heads
+        head_dim = cfg.hidden_size // num_heads
+        self.max_model_len = int(min(
+            max_model_len or cfg.max_position_embeddings,
+            cfg.max_position_embeddings))
+        param = next(iter(model.parameters()))
+        self.cache = PagedKVCache(
+            num_layers, num_heads, head_dim, dtype=param.dtype,
+            block_size=block_size, num_blocks=num_blocks,
+            max_model_len=self.max_model_len, hbm_fraction=hbm_fraction)
+        self.max_batch = int(max_batch or max_batch_size())
+        self.scheduler = ContinuousBatchingScheduler(
+            self.cache, self.max_batch, buckets)
+        self.buckets = self.scheduler.buckets
+
+        self._prefill_view = PagedCacheView(self.cache, "prefill")
+        self._decode_view = PagedCacheView(self.cache, "decode")
+        self._prefill_fn = paddle.jit.to_static(self._prefill_step)
+        self._decode_fn = paddle.jit.to_static(self._decode_step)
+
+        self._rows = [None] * self.max_batch
+        self._last_tokens = jnp.zeros((self.max_batch,), jnp.int64)
+        self._pending = []        # [(rows_reqs, device_tokens)]
+        self._results = {}        # req.id -> Request
+        self._req_counter = 0
+        self._step_idx = 0
+        self._step_finished = []
+        self._tokens_generated = 0
+
+    # -- traced step functions (one compile per arg-shape bucket) -------
+    def _prefill_step(self, ids, seeds, do_sample, top_k, top_p,
+                      temperature):
+        view = self._prefill_view
+        with no_grad():
+            logits = self.model(ids, cache=view, use_cache=False)
+            ctx = view.context_lens          # [1] true prompt length
+            return serving_sample_next(
+                logits, ctx - 1, seeds, ctx, do_sample, top_k, top_p,
+                temperature)
+
+    def _decode_step(self, ids, seeds, do_sample, top_k, top_p,
+                     temperature):
+        view = self._decode_view
+        with no_grad():
+            logits = self.model(ids, cache=view, use_cache=False)
+            ctx = view.context_lens          # [B] ctx incl. new token
+            return serving_sample_next(
+                logits, ctx - ctx, seeds, ctx, do_sample, top_k, top_p,
+                temperature)
+
+    # -- public API -----------------------------------------------------
+    def add_request(self, prompt, max_new_tokens=16, do_sample=False,
+                    top_k=0, top_p=1.0, temperature=1.0, seed=0,
+                    eos_token_id=None, request_id=None):
+        """Enqueue one prompt; returns the request id."""
+        prompt = [int(t) for t in np.asarray(prompt).reshape(-1)]
+        if not prompt:
+            raise ValueError("empty prompt")
+        if len(prompt) >= self.max_model_len:
+            raise ValueError(
+                f"prompt length {len(prompt)} >= max_model_len "
+                f"{self.max_model_len}")
+        max_new_tokens = min(int(max_new_tokens),
+                             self.max_model_len - len(prompt))
+        if request_id is None:
+            request_id = f"req{self._req_counter}"
+        self._req_counter += 1
+        req = Request(request_id, prompt, max_new_tokens=max_new_tokens,
+                      do_sample=do_sample, top_k=top_k, top_p=top_p,
+                      temperature=temperature, seed=seed,
+                      eos_token_id=eos_token_id)
+        self.scheduler.submit(req)
+        obs.get_registry().gauge("serving.queue_depth").set(
+            self.scheduler.queue_depth)
+        return request_id
+
+    def has_unfinished(self):
+        return self.scheduler.has_work() or bool(self._pending)
+
+    def step(self):
+        """One scheduler action (a prefill or a batched decode) plus a
+        lazy drain.  Returns the requests that finished this step."""
+        self._step_idx += 1
+        self._step_finished = []
+        action, payload = self.scheduler.next_action()
+        if action == "prefill":
+            self._run_prefill(payload)
+        elif action == "decode":
+            self._run_decode()
+        elif self._pending:
+            self._drain(0)       # nothing to schedule: retire in flight
+        self._drain(max(0, pipeline_depth() - 1))
+        self._collect_finished()
+        obs.get_registry().gauge("serving.queue_depth").set(
+            self.scheduler.queue_depth)
+        return list(self._step_finished)
+
+    def generate(self, prompts, **kwargs):
+        """Run a batch of prompts to completion.  Returns one full token
+        list (prompt + generated) per prompt, in order."""
+        ids = [self.add_request(p, **kwargs) for p in prompts]
+        t0 = time.perf_counter()
+        n0 = self._tokens_generated
+        while self.has_unfinished():
+            self.step()
+        elapsed = time.perf_counter() - t0
+        if elapsed > 0:
+            obs.get_registry().gauge("serving.tokens_per_sec").set(
+                (self._tokens_generated - n0) / elapsed)
+        return [self.result(i) for i in ids]
+
+    def result(self, request_id):
+        """Full token sequence of a finished request."""
+        req = self._results[request_id]
+        return list(req.prompt) + list(req.generated)
+
+    def stats(self):
+        s = self.cache.stats()
+        s.update(queue_depth=self.scheduler.queue_depth,
+                 running=len(self.scheduler.running),
+                 tokens_generated=self._tokens_generated,
+                 prefill_compiles=len(self._prefill_fn._cache),
+                 decode_compiles=len(self._decode_fn._cache))
+        return s
+
+    def close(self):
+        self.cache.close()
+
+    # -- prefill --------------------------------------------------------
+    def _run_prefill(self, req):
+        L = len(req.prompt)
+        bucket = bucket_for(L, self.buckets)
+        self.scheduler.begin_prefill(req)
+        row = self._rows.index(None)
+        self._rows[row] = req
+        req.row = row
+
+        ids = np.zeros((1, bucket), np.int64)
+        ids[0, :L] = req.prompt
+        slots = np.zeros(bucket, np.int32)   # pad tokens -> pad block 0
+        slots[:L] = self.cache.slot_mapping(req.id, 0, L)
+        table = self.cache.block_table(req.id)[None, :]
+        self._prefill_view.set_inputs(
+            slots, table, np.array([L], np.int32),
+            np.arange(bucket, dtype=np.int64)[None, :])
+
+        args = self._control_tensors([req], 1)
+        with obs.span(f"prefill:b{bucket}", cat="prefill",
+                      step=self._step_idx, request=req.id, length=L):
+            tok = self._prefill_fn(self._tensor(ids), *args)
+        self._last_tokens = self._last_tokens.at[row].set(tok._value[0])
+        req.n_scheduled = 1
+        self._pending.append(([(0, req)], tok._value))
+
+    # -- decode ---------------------------------------------------------
+    def _run_decode(self):
+        appended = {}            # req.id -> length before this round
+        while True:
+            action, payload = self.scheduler.next_action()
+            if action != "decode":
+                # preemption (or a finish) turned the next action into a
+                # prefill: the slots reserved this round were never
+                # dispatched — roll them back or the surviving rows'
+                # context advances past their real tokens
+                self._rollback_slots(appended)
+                return
+            active = payload
+            if self._reserve_slots(active, appended):
+                break
+        self._dispatch_decode(active)
+
+    def _rollback_slots(self, appended):
+        for rid, before in appended.items():
+            if rid in self.cache:        # freed rows need no rollback
+                self.cache.truncate(rid, before)
+
+    def _reserve_slots(self, active, appended):
+        """Extend every active sequence by one slot; on pool exhaustion
+        retire in-flight work, then preempt the youngest sequence to the
+        waiting queue.  Returns False when the active set changed."""
+        for req in active:
+            if req.id in appended:
+                continue
+            before = self.cache.length(req.id)
+            if self.cache.append(req.id):
+                appended[req.id] = before
+                continue
+            self._drain(0)
+            self._collect_finished()     # finished rows free blocks
+            if req.done:
+                return False             # freed itself: rebuild active
+            if self.cache.append(req.id):
+                appended[req.id] = before
+                continue
+            victim = self.scheduler.preempt_youngest()
+            if victim is None:
+                raise RuntimeError(
+                    "KV pool exhausted with nothing left to preempt")
+            self._preempt(victim)
+            appended.pop(victim.id, None)
+            return False
+        return True
+
+    def _preempt(self, victim):
+        """Requeue-by-recompute: all of the victim's tokens are already
+        drained (the caller forced lag 0), so its prompt+generated
+        resubmits at the head of the queue and the resumed run is
+        position-for-position identical."""
+        obs.instant("serving.preempt", cat="decode", request=victim.id,
+                    generated=len(victim.generated))
+        if victim.row is not None:
+            self._rows[victim.row] = None
+        self.scheduler.requeue(victim, victim.generated)
+
+    def _dispatch_decode(self, active):
+        B, W = self.max_batch, self.cache.table_width
+        slots = np.zeros(B, np.int32)
+        table = np.zeros((B, W), np.int32)
+        ctx = np.zeros(B, np.int32)
+        pos = np.zeros((B, 1), np.int64)
+        rows_reqs = []
+        for req in active:
+            r = req.row
+            length = self.cache.length(req.id)   # incl. this new slot
+            slots[r] = self.cache.slot_mapping(req.id, length - 1, 1)[0]
+            table[r] = self.cache.block_table(req.id)
+            ctx[r] = length
+            pos[r, 0] = length - 1               # input token's position
+            rows_reqs.append((r, req))
+        self._decode_view.set_inputs(slots, table, ctx, pos)
+
+        args = self._control_tensors(
+            [self._rows[r] for r in range(B)], B)
+        ids = Tensor(self._last_tokens[:, None], _internal=True,
+                     stop_gradient=True)
+        with obs.span("decode", cat="decode", step=self._step_idx,
+                      batch=len(active)):
+            tok = self._decode_fn(ids, *args)
+        self._last_tokens = tok._value
+        for _, req in rows_reqs:
+            req.n_scheduled += 1
+        self._pending.append((rows_reqs, tok._value))
+
+    def _control_tensors(self, reqs, n):
+        """Per-row sampling controls; None entries are masked rows."""
+        seeds = np.zeros(n, np.int32)
+        do_sample = np.zeros(n, bool)
+        top_k = np.zeros(n, np.int32)
+        top_p = np.ones(n, np.float32)
+        temp = np.ones(n, np.float32)
+        for i, req in enumerate(reqs):
+            if req is None:
+                continue
+            seeds[i] = req.seed
+            do_sample[i] = req.do_sample
+            top_k[i] = req.top_k
+            top_p[i] = req.top_p
+            temp[i] = req.temperature
+        return tuple(self._tensor(a)
+                     for a in (seeds, do_sample, top_k, top_p, temp))
+
+    @staticmethod
+    def _tensor(arr):
+        return Tensor(jnp.asarray(arr), _internal=True,
+                      stop_gradient=True)
+
+    # -- draining -------------------------------------------------------
+    def _drain(self, lag):
+        """Read dispatched token arrays older than ``lag`` steps back to
+        the host — the only device synchronization in the loop."""
+        while len(self._pending) > lag:
+            rows_reqs, device_toks = self._pending.pop(0)
+            host = np.asarray(device_toks)
+            for idx, req in rows_reqs:
+                if req.done:
+                    continue     # tokens raced past EOS: discard
+                token = int(host[idx])
+                req.generated.append(token)
+                self._tokens_generated += 1
+                if (req.eos_token_id is not None
+                        and token == req.eos_token_id):
+                    req.done = True
+                elif len(req.generated) >= req.max_new_tokens:
+                    req.done = True
+
+    def _collect_finished(self):
+        for req in list(self.scheduler.running):
+            if req.done:
+                if req.row is not None:
+                    self._rows[req.row] = None
+                self.scheduler.finish(req)
+                self._results[req.id] = req
+                self._step_finished.append(req)

@@ -1,0 +1,295 @@
+"""Paged KV-cache manager: a fixed block pool + per-sequence block tables.
+
+vLLM-style paging mapped onto this framework's state machinery
+(*Ragged Paged Attention*, PAPERS.md): instead of one contiguous,
+growing [B, S, H, D] cache per sequence (the dense `use_cache` path in
+models/generation.py — every length compiles its own executable and a
+long sequence pins worst-case memory), K/V live in a pool of fixed-size
+blocks
+
+    k_pool[layer]: [num_blocks, num_heads, block_size, head_dim]
+
+and each sequence owns an ordered list of block ids (its *block table*).
+Token `i` of a sequence lives at flat slot ``table[i // bs] * bs +
+i % bs``.  Appending a token never moves data; freeing a sequence
+returns whole blocks to the pool; admission control is a free-list
+length check.
+
+Block 0 is reserved as the *pad block*: padded batch rows scatter their
+garbage K/V there and padded block-table entries point at it — it is
+never attributed to a real sequence, and paged attention masks it out
+via context_lens.
+
+The pool tensors are ordinary framework Tensors.  The engine's
+``to_static`` step functions read them (discovered as state) and write
+them via ``_inplace_update`` (mutated state → donated to XLA), so the
+compiled decode step updates the cache in place at 1x memory.
+
+HBM accounting: the pool registers itself with the memory guard
+(``register_resident``) as a named **"kv cache blocks"** line item, so
+every subsequent pre-flight charges it and an over-budget program's
+``HbmBudgetError`` reports the pool next to params/opt-state.  The
+engine's own steps carry the pool as an argument already, and the
+guard skips the double charge via buffer identity.
+
+Sizing: ``num_blocks`` explicit, or derived from the HBM budget
+(``PADDLE_TPU_HBM_BUDGET`` / device bytes_limit) via ``hbm_fraction``.
+``PADDLE_TPU_KV_BLOCK_SIZE`` (default 16) sets the block size.
+
+Utilization rides the observability registry: gauges
+``serving.kv_blocks_total`` / ``serving.kv_blocks_in_use`` /
+``serving.kv_utilization`` plus a host-side high-water mark.
+"""
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+from ... import observability as obs
+
+__all__ = ["ENV_KV_BLOCK_SIZE", "kv_block_size", "PagedKVCache",
+           "RESIDENT_NAME"]
+
+ENV_KV_BLOCK_SIZE = "PADDLE_TPU_KV_BLOCK_SIZE"
+_DEFAULT_BLOCK_SIZE = 16
+RESIDENT_NAME = "kv cache blocks"
+
+# when no budget is visible (CPU tests without PADDLE_TPU_HBM_BUDGET)
+_DEFAULT_NUM_BLOCKS = 256
+_MIN_NUM_BLOCKS = 8
+_MAX_NUM_BLOCKS = 65536
+
+
+def kv_block_size():
+    """Tokens per KV block (PADDLE_TPU_KV_BLOCK_SIZE, default 16)."""
+    try:
+        v = int(os.environ.get(ENV_KV_BLOCK_SIZE, _DEFAULT_BLOCK_SIZE))
+    except ValueError:
+        return _DEFAULT_BLOCK_SIZE
+    return max(1, v)
+
+
+class PagedKVCache:
+    """Block pool + allocator + per-sequence block tables.
+
+    Host-side bookkeeping only lives here (free list, tables, lengths);
+    the device-side gather/scatter is in serving/attention.py, driven by
+    the arrays this class builds (slot mappings, padded block tables,
+    context lengths).
+    """
+
+    def __init__(self, num_layers, num_heads, head_dim, dtype="float32",
+                 block_size=None, num_blocks=None, max_model_len=None,
+                 hbm_fraction=0.3, register=True):
+        import jax.numpy as jnp
+        from ...core.dtypes import to_jax_dtype
+        from ...core.tensor import Tensor
+
+        self.num_layers = int(num_layers)
+        self.num_heads = int(num_heads)
+        self.head_dim = int(head_dim)
+        self.block_size = int(block_size or kv_block_size())
+        self._jdtype = jnp.dtype(to_jax_dtype(dtype))
+        self.bytes_per_block = (2 * self.num_layers * self.num_heads
+                                * self.block_size * self.head_dim
+                                * self._jdtype.itemsize)
+        if num_blocks is None:
+            num_blocks = self._blocks_from_budget(hbm_fraction)
+        # +1: block 0 is the reserved pad block, never allocated
+        self.num_blocks = max(_MIN_NUM_BLOCKS, int(num_blocks)) + 1
+        self.max_model_len = int(max_model_len) if max_model_len else None
+        # fixed block-table width: enough blocks for the longest
+        # sequence the model can hold (bounds the decode program shape)
+        cap = self.max_model_len or (self.num_blocks - 1) * self.block_size
+        self.table_width = max(
+            1, -(-cap // self.block_size))  # ceil div
+
+        shape = (self.num_blocks, self.num_heads, self.block_size,
+                 self.head_dim)
+        self._pools = []  # [(k_tensor, v_tensor)] per layer
+        for i in range(self.num_layers):
+            k = Tensor(jnp.zeros(shape, self._jdtype), _internal=True,
+                       stop_gradient=True)
+            k.name = f"kv_cache.k.layer{i}"
+            v = Tensor(jnp.zeros(shape, self._jdtype), _internal=True,
+                       stop_gradient=True)
+            v.name = f"kv_cache.v.layer{i}"
+            self._pools.append((k, v))
+
+        self._free = list(range(self.num_blocks - 1, 0, -1))  # pop() → 1
+        self._tables = {}      # seq_id -> [block ids]
+        self._lengths = {}     # seq_id -> tokens stored
+        self.high_water = 0    # max blocks in use, ever
+        self._registered = False
+        if register:
+            self._register_resident()
+        self._update_gauges()
+
+    # -- sizing ----------------------------------------------------------
+    def _blocks_from_budget(self, fraction):
+        from ...memory.estimator import device_hbm_budget
+        budget = device_hbm_budget()
+        if not budget:
+            return _DEFAULT_NUM_BLOCKS
+        n = int(budget * float(fraction)) // self.bytes_per_block
+        return max(_MIN_NUM_BLOCKS, min(_MAX_NUM_BLOCKS, n))
+
+    @property
+    def pool_bytes(self):
+        return self.num_blocks * self.bytes_per_block
+
+    def _register_resident(self):
+        from ...memory.guard import register_resident
+        register_resident(
+            RESIDENT_NAME, self.pool_bytes,
+            buffer_ids=lambda: {id(t._value)
+                                for kv in self._pools for t in kv})
+        self._registered = True
+
+    def close(self):
+        """Drop the memory-guard charge (the pool itself dies with the
+        last reference)."""
+        if self._registered:
+            from ...memory.guard import unregister_resident
+            unregister_resident(RESIDENT_NAME)
+            self._registered = False
+
+    # -- pool tensors ----------------------------------------------------
+    def layer_pools(self, layer):
+        """(k_pool, v_pool) Tensors for one layer."""
+        return self._pools[layer]
+
+    def pool_tensors(self):
+        return [t for kv in self._pools for t in kv]
+
+    # -- allocator -------------------------------------------------------
+    @property
+    def free_blocks(self):
+        return len(self._free)
+
+    @property
+    def blocks_in_use(self):
+        return (self.num_blocks - 1) - len(self._free)
+
+    def blocks_needed(self, num_tokens):
+        return -(-int(num_tokens) // self.block_size)
+
+    def can_allocate(self, num_tokens):
+        return self.blocks_needed(num_tokens) <= len(self._free)
+
+    def allocate(self, seq_id, num_tokens):
+        """Reserve blocks for a sequence's first ``num_tokens`` tokens
+        (prefill).  Raises KeyError on duplicate ids, returns False when
+        the pool cannot hold it."""
+        if seq_id in self._tables:
+            raise KeyError(f"sequence {seq_id!r} already allocated")
+        need = self.blocks_needed(num_tokens)
+        if need > len(self._free):
+            return False
+        self._tables[seq_id] = [self._free.pop() for _ in range(need)]
+        self._lengths[seq_id] = int(num_tokens)
+        self._update_gauges()
+        return True
+
+    def append(self, seq_id, num_tokens=1):
+        """Extend a sequence by ``num_tokens`` slots (decode).  Returns
+        False (state unchanged) when a needed block isn't available."""
+        length = self._lengths[seq_id]
+        need = (self.blocks_needed(length + num_tokens)
+                - len(self._tables[seq_id]))
+        if need > len(self._free):
+            return False
+        for _ in range(need):
+            self._tables[seq_id].append(self._free.pop())
+        self._lengths[seq_id] = length + int(num_tokens)
+        self._update_gauges()
+        return True
+
+    def truncate(self, seq_id, length):
+        """Shrink a sequence back to ``length`` tokens, returning whole
+        blocks past the new end to the pool.  Rolls back decode slots
+        that were reserved but never dispatched (the engine aborts a
+        decode round when preemption turns the next action into a
+        prefill — without this, the sequence's context would advance
+        past its real tokens and attend over unwritten slots)."""
+        length = int(length)
+        if length > self._lengths[seq_id]:
+            raise ValueError(
+                f"truncate({seq_id!r}, {length}) beyond current "
+                f"length {self._lengths[seq_id]}")
+        table = self._tables[seq_id]
+        keep = self.blocks_needed(length)
+        while len(table) > keep:
+            self._free.append(table.pop())
+        self._lengths[seq_id] = length
+        self._update_gauges()
+
+    def __contains__(self, seq_id):
+        return seq_id in self._tables
+
+    def free(self, seq_id):
+        """Return a sequence's blocks to the pool."""
+        blocks = self._tables.pop(seq_id, None)
+        if blocks is None:
+            return 0
+        self._lengths.pop(seq_id, None)
+        self._free.extend(reversed(blocks))
+        self._update_gauges()
+        return len(blocks)
+
+    def length(self, seq_id):
+        return self._lengths[seq_id]
+
+    def sequences(self):
+        return list(self._tables)
+
+    # -- device-side driving arrays --------------------------------------
+    def slot_mapping(self, seq_id, start, count):
+        """Flat pool slots for positions [start, start+count) — the
+        scatter targets for newly computed K/V."""
+        table = self._tables[seq_id]
+        pos = np.arange(int(start), int(start) + int(count))
+        blocks = np.asarray(table, np.int32)[pos // self.block_size]
+        return (blocks * self.block_size
+                + (pos % self.block_size)).astype(np.int32)
+
+    def block_table(self, seq_id, width=None):
+        """The sequence's block table padded to ``width`` (default: the
+        pool's fixed table_width) with the pad block 0."""
+        width = int(width or self.table_width)
+        table = self._tables[seq_id]
+        if len(table) > width:
+            raise ValueError(
+                f"sequence {seq_id!r} spans {len(table)} blocks "
+                f"> table width {width}")
+        out = np.zeros(width, np.int32)
+        out[:len(table)] = table
+        return out
+
+    # -- gauges ----------------------------------------------------------
+    def _update_gauges(self):
+        used = self.blocks_in_use
+        self.high_water = max(self.high_water, used)
+        reg = obs.get_registry()
+        reg.gauge("serving.kv_blocks_total").set(self.num_blocks - 1)
+        reg.gauge("serving.kv_blocks_in_use").set(used)
+        reg.gauge("serving.kv_utilization").set(
+            used / max(1, self.num_blocks - 1))
+
+    def stats(self):
+        return {
+            "num_blocks": self.num_blocks - 1,
+            "block_size": self.block_size,
+            "blocks_in_use": self.blocks_in_use,
+            "free_blocks": self.free_blocks,
+            "high_water": self.high_water,
+            "pool_bytes": self.pool_bytes,
+            "sequences": len(self._tables),
+        }
+
+    def __repr__(self):
+        return (f"PagedKVCache(blocks={self.num_blocks - 1}x"
+                f"{self.block_size}, layers={self.num_layers}, "
+                f"in_use={self.blocks_in_use}, "
+                f"high_water={self.high_water})")

@@ -1,0 +1,200 @@
+"""Continuous-batching scheduler: admission, interleave, preemption.
+
+Policy (vLLM-style iteration-level scheduling):
+
+  * **prefill first**: whenever a row and enough free blocks exist, the
+    oldest waiting request is admitted with a batch-1 prefill bucketed
+    to the next power-of-two length — each bucket is one compiled
+    program, so a mixed workload compiles ``len(buckets)`` prefill
+    executables plus ONE fixed-shape decode executable, total bounded
+    by ``len(buckets) + 1``;
+  * **decode otherwise**: all running sequences advance one token per
+    step in a single fixed ``[max_batch, 1]`` program (finished rows
+    ride along as masked padding until drained);
+  * **preempt to requeue**: when the block pool cannot extend every
+    running sequence, the *youngest* (most recently admitted) running
+    sequence is evicted — its blocks freed, its prompt+generated tokens
+    requeued at the head of the waiting queue for recompute-style
+    resumption.  Greedy decoding and the engine's position-keyed
+    sampling make the resumed continuation identical to the uninterrupted
+    one, so preemption is invisible in the output.
+
+The scheduler owns no device state: the engine asks ``next_action()``,
+performs the device work, and reports back (``begin_prefill`` /
+``finish`` / ``preempt``).
+"""
+from __future__ import annotations
+
+import os
+from collections import deque
+
+__all__ = ["ENV_MAX_BATCH", "max_batch_size", "length_buckets",
+           "bucket_for", "Request", "ContinuousBatchingScheduler"]
+
+ENV_MAX_BATCH = "PADDLE_TPU_MAX_BATCH"
+_DEFAULT_MAX_BATCH = 8
+_MIN_BUCKET = 16
+
+
+def max_batch_size():
+    """Decode batch width (PADDLE_TPU_MAX_BATCH, default 8)."""
+    try:
+        v = int(os.environ.get(ENV_MAX_BATCH, _DEFAULT_MAX_BATCH))
+    except ValueError:
+        return _DEFAULT_MAX_BATCH
+    return max(1, v)
+
+
+def length_buckets(max_len, min_bucket=_MIN_BUCKET):
+    """Power-of-two prefill buckets up to (and capped at) ``max_len``."""
+    out = []
+    b = min_bucket
+    while b < max_len:
+        out.append(b)
+        b *= 2
+    out.append(max_len)
+    return out
+
+
+def bucket_for(length, buckets):
+    """Smallest bucket >= length."""
+    for b in buckets:
+        if length <= b:
+            return b
+    raise ValueError(
+        f"prompt length {length} exceeds largest bucket {buckets[-1]}")
+
+
+class Request:
+    """One generation request and its host-side progress."""
+
+    __slots__ = ("id", "prompt", "max_new_tokens", "do_sample", "top_k",
+                 "top_p", "temperature", "seed", "eos_token_id",
+                 "generated", "n_scheduled", "row", "arrival", "done",
+                 "preemptions")
+
+    def __init__(self, id, prompt, max_new_tokens=16, do_sample=False,
+                 top_k=0, top_p=1.0, temperature=1.0, seed=0,
+                 eos_token_id=None):
+        self.id = id
+        self.prompt = list(prompt)
+        self.max_new_tokens = int(max_new_tokens)
+        self.do_sample = bool(do_sample)
+        self.top_k = int(top_k)
+        self.top_p = float(top_p)
+        self.temperature = float(temperature)
+        self.seed = int(seed)
+        self.eos_token_id = eos_token_id
+        self.generated = []       # host-read tokens, in order
+        self.n_scheduled = 0      # tokens sampled on device (>= drained)
+        self.row = None           # decode batch row while running
+        self.arrival = -1         # admission-order stamp
+        self.done = False
+        self.preemptions = 0
+
+    @property
+    def remaining(self):
+        """Tokens still to schedule."""
+        return max(0, self.max_new_tokens - self.n_scheduled)
+
+    def __repr__(self):
+        return (f"Request({self.id!r}, prompt={len(self.prompt)}tok, "
+                f"gen={len(self.generated)}/{self.max_new_tokens}, "
+                f"row={self.row}, done={self.done})")
+
+
+class ContinuousBatchingScheduler:
+    """Iteration-level scheduling over a shared PagedKVCache."""
+
+    def __init__(self, cache, max_batch=None, buckets=None):
+        self.cache = cache
+        self.max_batch = int(max_batch or max_batch_size())
+        cap = cache.max_model_len or (
+            (cache.num_blocks - 1) * cache.block_size)
+        self.buckets = list(buckets) if buckets else length_buckets(cap)
+        self.waiting = deque()
+        self.running = []
+        self._arrival = 0
+
+    # -- queue ----------------------------------------------------------
+    def submit(self, request):
+        request.arrival = self._arrival
+        self._arrival += 1
+        self.waiting.append(request)
+
+    def has_work(self):
+        return bool(self.waiting or self.running)
+
+    @property
+    def queue_depth(self):
+        return len(self.waiting)
+
+    # -- policy ---------------------------------------------------------
+    def next_action(self):
+        """("prefill", request) | ("decode", [requests]) | ("idle", None).
+
+        Decode schedules only sequences that still owe tokens; rows
+        whose requests finished scheduling but are still draining
+        in-flight results do not appear (the engine masks them).
+        """
+        if self.waiting and len(self.running) < self.max_batch:
+            req = self.waiting[0]
+            # +1 block headroom: the token sampled at prefill needs a
+            # slot at the first decode step
+            if self.cache.can_allocate(len(req.prompt) + 1):
+                return ("prefill", req)
+            if not self.running:
+                need = self.cache.blocks_needed(len(req.prompt) + 1)
+                raise RuntimeError(
+                    f"request {req.id!r} needs {need} KV blocks but the "
+                    f"pool only has {self.cache.free_blocks} free and "
+                    f"nothing is running to preempt — the pool is too "
+                    f"small for this prompt")
+        decodable = [r for r in self.running
+                     if not r.done and r.remaining > 0]
+        if decodable:
+            return ("decode", decodable)
+        return ("idle", None)
+
+    # -- engine callbacks -----------------------------------------------
+    def begin_prefill(self, request):
+        """Pop from waiting, allocate the prompt's blocks."""
+        assert self.waiting and self.waiting[0] is request
+        if not self.cache.allocate(request.id, len(request.prompt)):
+            raise RuntimeError(
+                f"allocation for {request.id!r} raced the free list")
+        self.waiting.popleft()
+        self.running.append(request)
+
+    def finish(self, request):
+        """Return a finished (or dead) request's blocks to the pool."""
+        self.cache.free(request.id)
+        if request in self.running:
+            self.running.remove(request)
+        request.row = None
+
+    def preempt_youngest(self, exclude=()):
+        """Pick the preemption victim: youngest running sequence not in
+        ``exclude``.  Returns None when nothing is evictable."""
+        candidates = [r for r in self.running
+                      if not r.done and r not in exclude]
+        if not candidates:
+            candidates = [r for r in self.running if not r.done]
+        if not candidates:
+            return None
+        return max(candidates, key=lambda r: r.arrival)
+
+    def requeue(self, request, tokens_so_far):
+        """Evict ``request`` and put it back at the head of the waiting
+        queue, its prompt extended by everything generated so far, so the
+        resumed prefill recomputes the evicted K/V exactly."""
+        self.cache.free(request.id)
+        if request in self.running:
+            self.running.remove(request)
+        request.prompt = list(request.prompt) + list(tokens_so_far)
+        request.max_new_tokens = request.max_new_tokens - len(tokens_so_far)
+        request.generated = []
+        request.n_scheduled = 0
+        request.row = None
+        request.preemptions += 1
+        self.waiting.appendleft(request)

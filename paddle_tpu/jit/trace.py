@@ -311,7 +311,11 @@ class TracedFunction:
                 [(f"state:{t.name or ('tensor_%d' % i)}", t)
                  for i, t in enumerate(state)]),
             pipeline_depth=pipeline_depth(),
-            per_step_io_bytes=_nbytes(arg_vals))
+            per_step_io_bytes=_nbytes(arg_vals),
+            # state this step already carries (e.g. the serving KV pool
+            # as donated rw_state) is in argument_bytes; don't let a
+            # registered resident charge it twice
+            resident_skip_ids={id(v) for v in (*ro_vals, *rw_vals)})
         return {
             "compiled": compiled,
             "label": label,

@@ -25,7 +25,8 @@ from .guard import (ENV_MEMORY_GUARD, guard_enabled, guard_mode,
                     GuardPolicy, set_guard_policy, get_guard_policy,
                     preflight_check, oom_context, is_oom_error,
                     remat_enabled, set_remat, remat_scope, last_estimate,
-                    record_estimate)
+                    record_estimate, register_resident,
+                    unregister_resident, resident_items)
 from .ladder import (GradAccumulator, split_feed, batch_size_of,
                      run_with_ladder)
 
@@ -38,5 +39,6 @@ __all__ = [
     "set_guard_policy", "get_guard_policy", "preflight_check",
     "oom_context", "is_oom_error", "remat_enabled", "set_remat",
     "remat_scope", "last_estimate", "record_estimate",
+    "register_resident", "unregister_resident", "resident_items",
     "GradAccumulator", "split_feed", "batch_size_of", "run_with_ladder",
 ]

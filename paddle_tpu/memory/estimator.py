@@ -40,6 +40,10 @@ class MemoryEstimate:
     # live by the in-flight window (depth-1 un-synchronized steps)
     pipeline_bytes: int = 0
     pipeline_depth: int = 1
+    # process-wide registered residents (e.g. the serving KV-cache block
+    # pool) that live in HBM alongside this program but are NOT among
+    # its arguments — see guard.register_resident()
+    resident_bytes: int = 0
     # named resident buffers (params, opt state, feeds), largest first
     buffers: List[Tuple[str, int]] = field(default_factory=list)
 
@@ -47,7 +51,7 @@ class MemoryEstimate:
     def total_bytes(self) -> int:
         return (self.argument_bytes + self.output_bytes + self.temp_bytes
                 + self.generated_code_bytes - self.alias_bytes
-                + self.pipeline_bytes)
+                + self.pipeline_bytes + self.resident_bytes)
 
     def top_buffers(self, k=5):
         """Top-k largest buffers, with XLA's temp/output totals ranked
@@ -77,6 +81,7 @@ class MemoryEstimate:
             "alias_gb": round(self.alias_bytes / gib, 4),
             "pipeline_gb": round(self.pipeline_bytes / gib, 4),
             "pipeline_depth": self.pipeline_depth,
+            "resident_gb": round(self.resident_bytes / gib, 4),
             "total_gb": round(self.total_bytes / gib, 4),
             "top_buffers": [
                 {"name": n, "gb": round(b / gib, 4)}

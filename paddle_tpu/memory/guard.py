@@ -36,7 +36,8 @@ from .estimator import analyze_compiled, check_budget, device_hbm_budget
 __all__ = ["ENV_MEMORY_GUARD", "guard_enabled", "guard_mode", "GuardPolicy",
            "set_guard_policy", "get_guard_policy", "preflight_check",
            "oom_context", "is_oom_error", "remat_enabled", "set_remat",
-           "remat_scope", "last_estimate", "record_estimate"]
+           "remat_scope", "last_estimate", "record_estimate",
+           "register_resident", "unregister_resident", "resident_items"]
 
 ENV_MEMORY_GUARD = "PADDLE_TPU_MEMORY_GUARD"
 OOM_SITE = "exec.oom"
@@ -142,6 +143,40 @@ def remat_scope(on=True):
         set_remat(prev)
 
 
+# -- process-wide resident buffers --------------------------------------
+# Long-lived device allocations that are NOT arguments of the program
+# being pre-flighted (the serving engine's paged KV-cache block pool is
+# the canonical one) still occupy HBM while any program runs.  They
+# register here as a named line item so every preflight charges them and
+# HbmBudgetError reports e.g. "kv cache blocks" next to params/opt-state.
+_residents = {}
+_residents_lock = threading.Lock()
+
+
+def register_resident(name, nbytes, buffer_ids=None):
+    """Charge a long-lived device allocation against every future
+    preflight.  ``buffer_ids`` is an optional zero-arg callable returning
+    the current ``id()`` set of the backing jax arrays — when a program's
+    own arguments include those buffers (the engine's decode step takes
+    the pool as donated state, already counted in argument_bytes), the
+    preflight skips the double charge but keeps the named line item."""
+    with _residents_lock:
+        _residents[name] = (int(nbytes), buffer_ids)
+    obs.instant("memory.resident", cat="memory", resident=name,
+                nbytes=int(nbytes))
+
+
+def unregister_resident(name):
+    with _residents_lock:
+        return _residents.pop(name, None) is not None
+
+
+def resident_items():
+    """Snapshot [(name, nbytes, buffer_ids_fn)] of registered residents."""
+    with _residents_lock:
+        return [(n, b, f) for n, (b, f) in _residents.items()]
+
+
 # -- estimates ----------------------------------------------------------
 def record_estimate(estimate):
     """Remember the latest per-thread estimate (bench/reporting reads it
@@ -156,7 +191,7 @@ def last_estimate():
 
 def preflight_check(compiled, program="<program>", named_buffers=None,
                     budget=None, raise_on_over=True, pipeline_depth=1,
-                    per_step_io_bytes=0):
+                    per_step_io_bytes=0, resident_skip_ids=None):
     """Estimate ``compiled``'s footprint and hold it to the HBM budget.
 
     Runs right after AOT compilation, before the first dispatch.  Returns
@@ -169,6 +204,11 @@ def preflight_check(compiled, program="<program>", named_buffers=None,
     un-synchronized steps keeps its outputs plus ``per_step_io_bytes``
     of feeds live, so the estimate covers the pipelined steady state,
     not just one isolated step.
+
+    Registered residents (register_resident) are charged into
+    ``est.resident_bytes`` and named in ``est.buffers`` — except when
+    ``resident_skip_ids`` shows the resident's backing arrays are among
+    this program's own arguments (already in argument_bytes).
     """
     if not guard_enabled():
         return None
@@ -181,6 +221,15 @@ def preflight_check(compiled, program="<program>", named_buffers=None,
         est.pipeline_depth = int(pipeline_depth)
         est.pipeline_bytes = extra_steps * (
             est.output_bytes + int(per_step_io_bytes))
+    skip = set(resident_skip_ids or ())
+    for rname, rbytes, ids_fn in resident_items():
+        est.buffers.append((rname, rbytes))
+        try:
+            rids = set(ids_fn() or ()) if ids_fn is not None else set()
+        except Exception:
+            rids = set()
+        if not (skip and rids & skip):
+            est.resident_bytes += rbytes
     record_estimate(est)
     if budget is None:
         budget = device_hbm_budget()

@@ -56,6 +56,16 @@ class GPTAttention(nn.Layer):
         qkv = self.qkv_proj(x)
         qkv = paddle.reshape(qkv, [b, s, 3, self.num_heads, self.head_dim])
         q, k, v = paddle.unbind(qkv, axis=2)     # each [b, s, nh, hd]
+        if cache is not None and hasattr(cache, "attend"):
+            # paged serving cache (inference/serving): the layer view
+            # scatters K/V into the block pool and attends through the
+            # block tables; dense semantics below stay untouched
+            out = cache.attend(q, k, v, use_flash=self.use_flash)
+            out = paddle.reshape(out, [b, s, h])
+            out = self.out_proj(out)
+            if use_cache:
+                return out, cache
+            return out
         if cache is not None:
             # decode: extend K/V with the cached prefix; the SDPA causal
             # mask is bottom-right aligned, so new rows see everything
@@ -118,8 +128,14 @@ class GPTModel(nn.Layer):
 
     def forward(self, input_ids, cache=None, use_cache=False):
         b, s = input_ids.shape
-        past = 0 if cache is None else cache[0][0].shape[1]
-        pos = paddle.arange(past, past + s, dtype="int64")
+        if cache is not None and getattr(cache, "position_ids", None) \
+                is not None:
+            # paged serving cache: rows sit at different absolute
+            # positions, so the engine supplies them per step
+            pos = cache.position_ids
+        else:
+            past = 0 if cache is None else cache[0][0].shape[1]
+            pos = paddle.arange(past, past + s, dtype="int64")
         x = self.wte(input_ids) + self.wpe(pos)
         drop_active = (self.training
                        and self.config.hidden_dropout_prob > 0)

@@ -29,7 +29,7 @@ __all__ = ["CATEGORY_LANES", "chrome_trace", "export_chrome_trace",
 # tid lanes, one per category, so each stream renders as its own track
 CATEGORY_LANES = {"host": 0, "compile": 1, "dispatch": 2, "collective": 3,
                   "memory": 4, "fault": 5, "amp": 6, "h2d": 7, "d2h": 8,
-                  "pipeline": 9}
+                  "pipeline": 9, "prefill": 10, "decode": 11}
 _EXTRA_LANE_BASE = 16
 
 
@@ -178,9 +178,11 @@ def phase_breakdown(events=None):
         events = get_timeline().events()
     out = {"compile_ms": 0.0, "dispatch_ms": 0.0, "collective_ms": 0.0,
            "h2d_ms": 0.0, "d2h_ms": 0.0, "pipeline_wait_ms": 0.0,
+           "prefill_ms": 0.0, "decode_ms": 0.0,
            "collective_bytes": 0, "h2d_bytes": 0, "d2h_bytes": 0,
            "compile_count": 0, "dispatch_count": 0, "collective_count": 0,
-           "h2d_count": 0, "d2h_count": 0, "pipeline_wait_count": 0}
+           "h2d_count": 0, "d2h_count": 0, "pipeline_wait_count": 0,
+           "prefill_count": 0, "decode_count": 0}
     for e in events:
         if e.dur is None:
             continue
@@ -209,8 +211,11 @@ def phase_breakdown(events=None):
         elif e.cat == "pipeline":
             out["pipeline_wait_ms"] += ms
             out["pipeline_wait_count"] += 1
+        elif e.cat in ("prefill", "decode"):
+            out[f"{e.cat}_ms"] += ms
+            out[f"{e.cat}_count"] += 1
     for k in ("compile_ms", "dispatch_ms", "collective_ms", "h2d_ms",
-              "d2h_ms", "pipeline_wait_ms"):
+              "d2h_ms", "pipeline_wait_ms", "prefill_ms", "decode_ms"):
         out[k] = round(out[k], 3)
     return out
 

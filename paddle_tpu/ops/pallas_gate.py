@@ -80,8 +80,19 @@ def _probe_softmax_cross_entropy():
     jax.block_until_ready(fn(x))
 
 
+def _probe_paged_attention():
+    from . import pallas_kernels as pk
+    q = jnp.zeros((2, 1, 2, 64), jnp.float32)
+    pool = jnp.zeros((4, 2, 16, 64), jnp.float32)
+    bt = jnp.array([[1, 2], [3, 0]], jnp.int32)
+    cl = jnp.array([20, 5], jnp.int32)
+    fn = jax.jit(lambda q, kp, vp: pk.paged_attention(q, kp, vp, bt, cl))
+    jax.block_until_ready(fn(q, pool, pool))
+
+
 _PROBES = {
     "flash_attention": _probe_flash_attention,
+    "paged_attention": _probe_paged_attention,
     "layer_norm": _probe_layer_norm,
     "rms_norm": _probe_rms_norm,
     "softmax_cross_entropy": _probe_softmax_cross_entropy,

@@ -94,6 +94,39 @@ def test_static_save_inference_model_predictor(tmp_path):
         paddle.disable_static()
 
 
+def test_predictor_run_two_threads(jit_artifact):
+    """Two threads sharing one predictor must each get their own
+    inputs' outputs: the lock covers only handle staging, and run()
+    returns from its call-local results rather than the shared output
+    handles (which a concurrent run may rebind at any time)."""
+    import threading
+
+    prefix, x, want = jit_artifact
+    config = Config(prefix + ".pdmodel", prefix + ".pdiparams")
+    pred = create_predictor(config)
+    x2 = np.random.default_rng(2).normal(size=(4, 8)).astype(np.float32)
+    want2 = pred.run([x2])[0]
+
+    errors = []
+
+    def worker(inp, expect):
+        try:
+            for _ in range(25):
+                got = pred.run([inp])[0]
+                np.testing.assert_allclose(got, expect, rtol=1e-5,
+                                           atol=1e-5)
+        except Exception as e:  # pragma: no cover - failure path
+            errors.append(e)
+
+    threads = [threading.Thread(target=worker, args=(x, want)),
+               threading.Thread(target=worker, args=(x2, want2))]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errors, errors
+
+
 def test_missing_exec_is_loud(tmp_path):
     paddle.disable_static()
     net = _Net()

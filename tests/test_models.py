@@ -220,6 +220,30 @@ def test_kv_cache_decode_matches_full_recompute():
         np.testing.assert_array_equal(with_cache, without)
 
 
+def test_gpt_dense_cache_decode_logits_match_full_forward():
+    """Prefill-then-N-decode through the dense KV cache reproduces the
+    full-sequence forward's logits at every decoded position (not just
+    the argmax) — the reference the paged serving path is held to."""
+    from paddle_tpu.models import GPTConfig, GPTForCausalLM
+    paddle.seed(13)
+    m = GPTForCausalLM(GPTConfig(
+        vocab_size=48, hidden_size=32, num_hidden_layers=2,
+        num_attention_heads=2, max_position_embeddings=32))
+    m.eval()
+    ids = np.random.RandomState(8).randint(0, 48, (2, 12)).astype(
+        np.int64)
+    L, N = 5, 7
+    full = m(paddle.to_tensor(ids)).numpy()
+    logits, cache = m(paddle.to_tensor(ids[:, :L]), use_cache=True)
+    np.testing.assert_allclose(logits.numpy(), full[:, :L],
+                               atol=2e-5, rtol=2e-5)
+    for t in range(N):
+        step, cache = m(paddle.to_tensor(ids[:, L + t:L + t + 1]),
+                        cache=cache, use_cache=True)
+        np.testing.assert_allclose(step.numpy()[:, 0], full[:, L + t],
+                                   atol=2e-5, rtol=2e-5)
+
+
 def test_cache_participates_without_use_cache():
     """Feeding a cache while use_cache=False must still attend over the
     cached prefix (not silently drop it)."""
