@@ -932,6 +932,8 @@ def main():
         "llama_dryrun": bench_llama_dryrun,
     }
     errors = {}
+    from collections import Counter as _Counter
+    lint_log_seen = _Counter()
     for name in configs:
         name = name.strip()
         fn = runners.get(name)
@@ -952,11 +954,23 @@ def main():
                 pass
             continue
         try:
+            events = obs.get_timeline().events()
             phases = obs.phase_breakdown()
             obs.get_timeline().clear()
             if phases["compile_count"] or phases["dispatch_count"] \
                     or phases["collective_count"]:
                 payload["extra_metrics"][f"{name}_phases"] = phases
+            # per-config tpu_lint counts: host-sync findings from this
+            # config's timeline + diagnostics logged during its run
+            from paddle_tpu import analysis
+            cfg_lint = _Counter(
+                d.code for d in analysis.audit_host_sync(events))
+            log_counts = _Counter(analysis.get_log().counts())
+            cfg_lint += log_counts - lint_log_seen
+            lint_log_seen = log_counts
+            if cfg_lint:
+                payload["extra_metrics"][f"{name}_lint"] = \
+                    dict(cfg_lint)
         except Exception:
             pass
         if name == "bert":
@@ -1022,6 +1036,13 @@ def main():
         if on_tpu and not subproc:  # child must not clobber the
             save_cache(payload)     # parent's richer capture
 
+    try:
+        from paddle_tpu import analysis
+        lint = analysis.lint_summary()
+        if lint["counts"] or lint["pallas"]:
+            payload["lint"] = lint
+    except Exception:
+        pass
     if errors:
         payload["errors"] = errors
     print(json.dumps(payload), flush=True)

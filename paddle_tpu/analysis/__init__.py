@@ -1,0 +1,41 @@
+"""tpu_lint: static program analysis for TPU programs.
+
+Inspects programs *before dispatch* and emits structured ``Diagnostic``
+records with stable codes (TPU1xx tiling, TPU2xx recompile risk,
+TPU3xx host sync, TPU4xx dtype/precision), severity, site and fix
+hint.  Entry points:
+
+* ``Executor.analyze_program(...)`` / ``to_static fn.analyze_program()``
+  — lint a program/step as it would run;
+* ``scripts/tpu_lint.py --models`` — CLI over the bundled models;
+* ``analysis.tiling.check_pallas_call`` — validate a kernel block plan
+  (``ops/pallas_gate.py`` uses it to diagnose probe failures);
+* ``analysis.analyze_runtime()`` — audit the live process (timeline,
+  executable caches) after steps ran;
+* ``observability.lint_summary_table()`` — render recorded findings.
+"""
+from . import diagnostics, dtype_audit, host_sync, recompile, tiling
+from .diagnostics import (CODES, ERROR, INFO, SEVERITIES, WARNING,
+                          Diagnostic, DiagnosticLog, DiagnosticReport,
+                          describe_code, get_log, record, reset_log)
+from .dtype_audit import audit_jaxpr, check_collective_payload, iter_eqns
+from .host_sync import audit_host_sync, sync_budget
+from .program import analyze_runtime, analyze_traced, lint_summary
+from .recompile import (audit_eager_cache, audit_executor_cache,
+                        audit_trace_cache, audit_weak_types)
+from .tiling import (LANE, VMEM_BYTES, audit_flash_attention,
+                     audit_paged_attention, check_block_spec,
+                     check_pallas_call, estimate_vmem_bytes, min_tile)
+
+__all__ = [
+    "CODES", "ERROR", "INFO", "LANE", "SEVERITIES", "VMEM_BYTES",
+    "WARNING", "Diagnostic", "DiagnosticLog", "DiagnosticReport",
+    "analyze_runtime", "analyze_traced", "audit_eager_cache",
+    "audit_executor_cache", "audit_flash_attention", "audit_host_sync",
+    "audit_jaxpr", "audit_paged_attention", "audit_trace_cache",
+    "audit_weak_types", "check_block_spec", "check_collective_payload",
+    "check_pallas_call", "describe_code", "diagnostics", "dtype_audit",
+    "estimate_vmem_bytes", "get_log", "host_sync", "iter_eqns",
+    "lint_summary", "min_tile", "record", "recompile", "reset_log",
+    "sync_budget", "tiling",
+]

@@ -1,0 +1,151 @@
+"""Dtype/AMP analyzer: the TPU4xx family, walked over a traced jaxpr.
+
+TPU performance is dtype-shaped: bf16 matmuls run the MXU at full rate,
+f32 at half, and f64 only exists as a software emulation.  This module
+walks a program's jaxpr (including sub-jaxprs of pjit/scan/cond/
+custom_vjp equations) and reports:
+
+* **TPU401** — f32 ``dot_general``/``conv`` in a program that also
+  runs bf16 ones: under autocast that means an op escaped the AMP
+  white list and is paying the half-rate path.  ``amp="bfloat16"``
+  makes the check unconditional; ``amp="auto"`` (default) infers a
+  bf16 program from the presence of bf16 matmuls.
+* **TPU402** — float64 values anywhere in the program.  The global
+  x64 mode (paddle-parity int64/float64 semantics) makes stray f64
+  reachable from any python float, which is exactly why it needs
+  flagging: on TPU it is emulated.  Severity stays "warning" because
+  CPU traces legitimately carry f64 scalars.
+* **TPU403** — collective equations with f64 payloads (emulated math
+  *and* 2x wire bytes).  The runtime side — payload dtype/shape
+  mismatches across a tensor list — is ``check_collective_payload``,
+  called from the communication wrapper.
+"""
+from __future__ import annotations
+
+from collections import Counter
+
+from .diagnostics import Diagnostic
+
+__all__ = ["iter_eqns", "audit_jaxpr", "check_collective_payload"]
+
+_DOT_PRIMS = {"dot_general", "conv_general_dilated"}
+_COLLECTIVE_PRIMS = {"psum", "pmax", "pmin", "all_gather", "all_to_all",
+                     "ppermute", "reduce_scatter", "psum_scatter"}
+
+
+def _sub_jaxprs(eqn):
+    for v in eqn.params.values():
+        vs = v if isinstance(v, (tuple, list)) else (v,)
+        for item in vs:
+            inner = getattr(item, "jaxpr", None)
+            if inner is not None and hasattr(inner, "eqns"):
+                yield inner
+            elif hasattr(item, "eqns"):
+                yield item
+
+
+def iter_eqns(jaxpr):
+    """All equations of a jaxpr, sub-jaxprs included (pre-order)."""
+    jaxpr = getattr(jaxpr, "jaxpr", jaxpr)
+    for eqn in jaxpr.eqns:
+        yield eqn
+        for sub in _sub_jaxprs(eqn):
+            yield from iter_eqns(sub)
+
+
+def _out_dtype(eqn):
+    for var in eqn.outvars:
+        aval = getattr(var, "aval", None)
+        dt = getattr(aval, "dtype", None)
+        if dt is not None:
+            return str(dt)
+    return None
+
+
+def audit_jaxpr(closed_jaxpr, *, amp="auto", site=""):
+    """TPU401/402/403 over one traced program."""
+    f64_prims = Counter()
+    dot_dtypes = Counter()
+    bad_collectives = Counter()
+    for eqn in iter_eqns(closed_jaxpr):
+        prim = eqn.primitive.name
+        dt = _out_dtype(eqn)
+        if dt == "float64":
+            f64_prims[prim] += 1
+        if prim in _DOT_PRIMS and dt is not None:
+            dot_dtypes[dt] += 1
+        if prim in _COLLECTIVE_PRIMS:
+            for var in eqn.invars:
+                adt = str(getattr(getattr(var, "aval", None), "dtype",
+                                  ""))
+                if adt == "float64":
+                    bad_collectives[prim] += 1
+
+    diags = []
+    f32_dots = dot_dtypes.get("float32", 0)
+    bf16_dots = dot_dtypes.get("bfloat16", 0) + dot_dtypes.get(
+        "float16", 0)
+    mixed = amp in ("bfloat16", "float16") and f32_dots \
+        or (amp == "auto" and bf16_dots and f32_dots)
+    if mixed:
+        diags.append(Diagnostic(
+            "TPU401",
+            f"{f32_dots} f32 matmul/conv equation(s) alongside "
+            f"{bf16_dots} low-precision one(s): ops escaped the AMP "
+            "white list and run the MXU at half rate",
+            site=site,
+            hint="check amp.auto_cast coverage (custom_white_list) or "
+                 "cast the op's inputs explicitly",
+            data={"f32_dots": f32_dots, "bf16_dots": bf16_dots}))
+    if f64_prims:
+        top = ", ".join(f"{p} x{n}" for p, n in
+                        f64_prims.most_common(4))
+        diags.append(Diagnostic(
+            "TPU402",
+            f"{sum(f64_prims.values())} float64 equation(s) in the "
+            f"program ({top}): TPU emulates f64 in software",
+            site=site,
+            hint="cast inputs/literals to float32, or run with "
+                 "PADDLE_TPU_X32=1 to canonicalize the whole process",
+            data={"f64_eqns": sum(f64_prims.values())}))
+    for prim, n in bad_collectives.items():
+        diags.append(Diagnostic(
+            "TPU403",
+            f"collective {prim} carries float64 payload(s) x{n}: "
+            "emulated math plus double wire bytes",
+            site=site,
+            hint="reduce in float32 (cast before the collective)"))
+    return diags
+
+
+def check_collective_payload(op, tensors, *, group=None):
+    """Runtime TPU403 check for one collective call: mixed dtypes or
+    shapes across the payload list, or wide (f64/i64-beyond-need)
+    floats.  Returns diagnostics (caller records them)."""
+    infos = []
+    for t in tensors:
+        v = getattr(t, "_value", t)
+        shape = tuple(getattr(v, "shape", ()))
+        dtype = str(getattr(v, "dtype", "?"))
+        infos.append((shape, dtype))
+    diags = []
+    site = f"collective:{op}"
+    dtypes = {d for _, d in infos}
+    shapes = {s for s, _ in infos}
+    if len(infos) > 1 and (len(dtypes) > 1 or len(shapes) > 1):
+        diags.append(Diagnostic(
+            "TPU403",
+            f"{op} payload list mixes shapes/dtypes "
+            f"({sorted(dtypes)}, {len(shapes)} shapes): ranks must "
+            "agree element-wise or the collective deadlocks/corrupts",
+            site=site,
+            hint="make every rank pass identically-shaped, "
+                 "identically-typed tensors"))
+    if "float64" in dtypes:
+        diags.append(Diagnostic(
+            "TPU403",
+            f"{op} payload is float64: emulated math plus double wire "
+            "bytes",
+            site=site,
+            hint="cast to float32 before the collective"))
+    return diags

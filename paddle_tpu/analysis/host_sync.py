@@ -1,0 +1,110 @@
+"""Host-sync analyzer: the TPU3xx family, read off the obs timeline.
+
+The async pipeline (PR 4) made ``Executor.run(..., return_numpy=False)``
+non-blocking and moved the sync point to the first host read of a
+``FetchHandle`` — which records a ``cat="d2h"`` span with step
+attribution.  Dispatches record ``cat="dispatch"`` spans.  That is
+enough evidence to find the two classic serializers without any new
+instrumentation:
+
+* **TPU301 early read** — a d2h sync for step N landing before step
+  N+1 was dispatched: the host blocked on the value it just launched,
+  so device compute and host work never overlap (the pattern
+  ``loss = exe.run(...); print(float(loss))`` in a loop).
+* **TPU302 budget** — more d2h syncs attributed to one step than the
+  per-step budget (``PADDLE_TPU_LINT_SYNC_BUDGET``, default 2: one
+  loss read + one metric read).
+
+Run it over ``obs.get_timeline().events()`` after a few steps of the
+real loop; both diagnostics aggregate (one record per pattern, worst
+offenders listed) instead of flagging every event.
+"""
+from __future__ import annotations
+
+import os
+from collections import Counter
+
+from .diagnostics import Diagnostic
+
+__all__ = ["audit_host_sync", "sync_budget"]
+
+
+def sync_budget(default=2):
+    try:
+        return int(os.environ.get("PADDLE_TPU_LINT_SYNC_BUDGET",
+                                  default))
+    except ValueError:
+        return default
+
+
+def audit_host_sync(events=None, budget=None, site="step loop"):
+    """TPU301/TPU302 over a list of timeline events."""
+    if events is None:
+        from .. import observability as obs
+        events = obs.get_timeline().events()
+    if budget is None:
+        budget = sync_budget()
+
+    dispatches = sorted(
+        (e for e in events
+         if getattr(e, "cat", None) == "dispatch"
+         and getattr(e, "dur", None) is not None),
+        key=lambda e: e.ts)
+    d2h = sorted(
+        (e for e in events if getattr(e, "cat", None) == "d2h"),
+        key=lambda e: e.ts)
+    diags = []
+    if not d2h:
+        return diags
+
+    # -- TPU301: reads that land in the gap before the next dispatch --
+    early = []
+    starts = [d.ts for d in dispatches]
+    for e in d2h:
+        # the dispatch this read follows
+        idx = None
+        for i, ts in enumerate(starts):
+            if ts <= e.ts:
+                idx = i
+            else:
+                break
+        if idx is None or idx + 1 >= len(dispatches):
+            continue  # before the loop, or after the last step: fine
+        launched = dispatches[idx]
+        nxt = dispatches[idx + 1]
+        if e.ts >= nxt.ts:
+            continue
+        same_step = (e.step is not None and launched.step is not None
+                     and e.step == launched.step)
+        if same_step or (e.step is None and launched.step is None):
+            early.append(e)
+    if early:
+        names = [e.name for e in early[:4]]
+        diags.append(Diagnostic(
+            "TPU301",
+            f"{len(early)} d2h sync(s) of a step's own fetch before the "
+            f"next step was dispatched (e.g. {names}): the pipeline "
+            "serializes to depth 1",
+            site=site,
+            hint="keep FetchHandles un-read until the value is needed "
+                 "(log every k steps), or raise "
+                 "PADDLE_TPU_PIPELINE_DEPTH overlap by deferring "
+                 ".numpy()/float() calls",
+            data={"early_reads": len(early)}))
+
+    # -- TPU302: per-step sync counts over budget ----------------------
+    per_step = Counter(e.step for e in d2h if e.step is not None)
+    over = {s: n for s, n in per_step.items() if n > budget}
+    if over:
+        worst = sorted(over.items(), key=lambda kv: -kv[1])[:4]
+        diags.append(Diagnostic(
+            "TPU302",
+            f"{len(over)} step(s) exceeded the per-step host-sync "
+            f"budget of {budget} (worst: "
+            f"{', '.join(f'step {s}: {n} syncs' for s, n in worst)})",
+            site=site,
+            hint="batch metric reads (fetch once, slice on host) or "
+                 "raise PADDLE_TPU_LINT_SYNC_BUDGET if the reads are "
+                 "intentional",
+            data={"budget": budget, "steps_over": len(over)}))
+    return diags

@@ -1,0 +1,203 @@
+"""Recompile-risk analyzer: the TPU2xx family.
+
+Everything on TPU compiles; the question is how often.  Three caches
+hold the evidence, and this module audits their key structure instead
+of adding instrumentation:
+
+* ``static.executor.Executor._shared_cache`` — keyed
+  ``(id(program), fingerprint, feed_sig, fetch_sig)``.  Same program +
+  fingerprint with many distinct feed signatures = shape drift
+  (TPU202); same program id with several fingerprints = in-place
+  structural mutation (TPU204).
+* ``jit.trace.TracedFunction._cache`` — keyed by ``_tree_key`` strings
+  whose leaf tokens are ``T{shape}:{dtype}`` (Tensors),
+  ``A{shape}:{dtype}`` (arrays) and ``V{value!r}`` (static python
+  leaves).  Two keys over the same treedef differing only in a ``V``
+  token = a python scalar baked into the trace (TPU203); differing in a
+  ``T``/``A`` shape = shape drift (TPU202).
+* ``core.dispatch._eager_fwd_cache`` — per-op executables keyed
+  ``(name, code, statics, attr_sig, aval_sig)``.  One op accumulating
+  many entries that differ only in statics/avals is the
+  per-op-recompile signature of the 1000x-off eager path.
+
+Weak-typed inputs (TPU201) are read straight off a traced jaxpr's
+invars.
+"""
+from __future__ import annotations
+
+from collections import defaultdict
+
+from .diagnostics import Diagnostic
+
+__all__ = ["audit_executor_cache", "audit_trace_cache",
+           "audit_eager_cache", "audit_weak_types"]
+
+# distinct variants of "the same" program/call tolerated before the
+# churn diagnostics fire (2 shapes may be train vs eval; 3+ is drift)
+DRIFT_THRESHOLD = 3
+
+
+def audit_executor_cache(cache=None, threshold=DRIFT_THRESHOLD):
+    """TPU202/TPU204 over the executor's shared executable cache."""
+    if cache is None:
+        from ..static.executor import Executor
+        cache = Executor._shared_cache
+    diags = []
+    by_prog = defaultdict(set)        # (pid, fp, fetch) -> {feed_sig}
+    fps = defaultdict(set)            # pid -> {fingerprint}
+    labels = {}
+    for key, entry in list(cache.items()):
+        try:
+            pid, fp, feed_sig, fetch_sig = key
+        except (TypeError, ValueError):
+            continue
+        by_prog[(pid, fp, fetch_sig)].add(feed_sig)
+        fps[pid].add(fp)
+        if isinstance(entry, dict):
+            labels[pid] = entry.get("program_label", f"program#{pid}")
+    for (pid, fp, fetch_sig), feeds in by_prog.items():
+        if len(feeds) >= threshold:
+            shapes = sorted(str(dict(f)) for f in feeds)[:4]
+            diags.append(Diagnostic(
+                "TPU202",
+                f"{labels.get(pid, f'program#{pid}')} compiled for "
+                f"{len(feeds)} distinct feed shapes (e.g. "
+                f"{'; '.join(shapes)})",
+                site=labels.get(pid, f"program#{pid}"),
+                hint="pad or bucket batch/sequence dims to a fixed set "
+                     "of shapes; each new shape pays a full XLA compile",
+                data={"variants": len(feeds)}))
+    for pid, fpset in fps.items():
+        if len(fpset) > 1:
+            diags.append(Diagnostic(
+                "TPU204",
+                f"{labels.get(pid, f'program#{pid}')} was structurally "
+                f"mutated in place: {len(fpset)} fingerprints cached "
+                "for one Program object",
+                site=labels.get(pid, f"program#{pid}"),
+                hint="clone() the program before editing it, or expect "
+                     "a rebuild of every cached executable"))
+    return diags
+
+
+def _parse_tree_key(key):
+    """(treedef_str, leaf_tokens) from a _tree_key string, else None."""
+    if isinstance(key, tuple):          # (tree_key, remat) cache key
+        key = key[0]
+    if not isinstance(key, str):
+        return None
+    parts = key.split("|")
+    return parts[0], parts[1:]
+
+
+def audit_trace_cache(traced, threshold=DRIFT_THRESHOLD):
+    """TPU202/TPU203 over one TracedFunction's signature cache."""
+    cache = getattr(traced, "_cache", traced)
+    label = getattr(getattr(traced, "_orig_fn", None), "__qualname__",
+                    None) or "to_static"
+    site = f"jit:{label}"
+    groups = defaultdict(list)        # treedef -> [leaf_tokens]
+    for key in list(cache.keys() if hasattr(cache, "keys") else cache):
+        parsed = _parse_tree_key(key)
+        if parsed:
+            groups[parsed[0]].append(parsed[1])
+    diags = []
+    for treedef, variants in groups.items():
+        if len(variants) < 2:
+            continue
+        scalar_slots, shape_slots = set(), set()
+        width = min(len(v) for v in variants)
+        for pos in range(width):
+            tokens = {v[pos] for v in variants}
+            if len(tokens) == 1:
+                continue
+            if all(t.startswith("V") for t in tokens):
+                scalar_slots.add(pos)
+            else:
+                shape_slots.add(pos)
+        if scalar_slots and len(variants) >= 2:
+            examples = sorted(
+                {v[pos] for v in variants for pos in scalar_slots})[:5]
+            diags.append(Diagnostic(
+                "TPU203",
+                f"{len(variants)} traces of {label} differ only by "
+                f"python-scalar argument value(s) {examples}: each new "
+                "value is a fresh compile",
+                site=site,
+                hint="pass changing scalars as 0-d tensors "
+                     "(paddle.to_tensor(x)) so they ride as runtime "
+                     "arguments",
+                data={"variants": len(variants)}))
+        if shape_slots and len(variants) >= threshold:
+            diags.append(Diagnostic(
+                "TPU202",
+                f"{len(variants)} traces of {label} differ in tensor "
+                "shape/dtype: shape drift recompiles the step",
+                site=site,
+                hint="pad or bucket inputs to a fixed shape set",
+                data={"variants": len(variants)}))
+    return diags
+
+
+def audit_eager_cache(cache=None, per_op_threshold=16):
+    """Flag ops accumulating many per-signature eager executables."""
+    if cache is None:
+        from ..core.dispatch import _eager_fwd_cache
+        cache = _eager_fwd_cache
+    per_op = defaultdict(lambda: {"n": 0, "statics": set(),
+                                  "avals": set()})
+    for key in list(cache.keys()):
+        try:
+            name, _code, statics, attr_sig, aval_sig = key
+        except (TypeError, ValueError):
+            continue
+        rec = per_op[name]
+        rec["n"] += 1
+        rec["statics"].add((statics, attr_sig))
+        rec["avals"].add(aval_sig)
+    diags = []
+    for name, rec in sorted(per_op.items(), key=lambda kv: -kv[1]["n"]):
+        if rec["n"] < per_op_threshold:
+            continue
+        if len(rec["statics"]) > len(rec["avals"]):
+            diags.append(Diagnostic(
+                "TPU203",
+                f"eager op {name!r} holds {rec['n']} jitted variants, "
+                f"{len(rec['statics'])} distinct static-arg "
+                "signatures: python scalars are fragmenting the per-op "
+                "cache",
+                site=f"eager:{name}",
+                hint="move changing scalars into tensors, or wrap the "
+                     "loop in paddle.jit.to_static / incubate."
+                     "lazy_eager() to amortize dispatch"))
+        else:
+            diags.append(Diagnostic(
+                "TPU202",
+                f"eager op {name!r} holds {rec['n']} jitted variants "
+                f"across {len(rec['avals'])} input-shape signatures",
+                site=f"eager:{name}",
+                hint="bucket input shapes, or trace the loop with "
+                     "paddle.jit.to_static"))
+    return diags
+
+
+def audit_weak_types(closed_jaxpr, site=""):
+    """TPU201: weak-typed inputs retrace when the literal context
+    changes (a python float promotes differently against f32 vs bf16)."""
+    diags = []
+    jaxpr = getattr(closed_jaxpr, "jaxpr", closed_jaxpr)
+    weak = []
+    for i, var in enumerate(jaxpr.invars):
+        aval = getattr(var, "aval", None)
+        if getattr(aval, "weak_type", False):
+            weak.append((i, str(getattr(aval, "dtype", "?"))))
+    if weak:
+        diags.append(Diagnostic(
+            "TPU201",
+            f"{len(weak)} weak-typed program input(s) "
+            f"{weak[:4]}: python-number promotion decides their dtype "
+            "per trace",
+            site=site,
+            hint="cast explicitly (astype/to_tensor with dtype) at the "
+                 "program boundary"))
+    return diags

@@ -73,6 +73,36 @@ def _payload_bytes(args, kwargs):
     return n
 
 
+# payload signatures already linted, so a hot loop records each
+# TPU403 pattern once per process rather than per call
+_lint_seen: set = set()
+
+
+def _lint_payload(op_name, args):
+    """Runtime tpu_lint of a collective payload (TPU403: mixed
+    shapes/dtypes in a tensor list, f64 on the wire)."""
+    tensors = []
+    for a in args:
+        if isinstance(a, Tensor):
+            tensors.append(a)
+        elif isinstance(a, (list, tuple)):
+            tensors.extend(t for t in a if isinstance(t, Tensor))
+    if not tensors:
+        return
+    try:
+        sig = (op_name, tuple(
+            (tuple(getattr(t._value, "shape", ())),
+             str(getattr(t._value, "dtype", "?"))) for t in tensors))
+    except Exception:
+        return
+    if sig in _lint_seen:
+        return
+    _lint_seen.add(sig)
+    from ...analysis import check_collective_payload, record
+    for d in check_collective_payload(op_name, tensors):
+        record(d)
+
+
 def _watched(op_name):
     """Collective-watchdog wrapper (fault_tolerance layer) + telemetry.
 
@@ -97,6 +127,7 @@ def _watched(op_name):
                 sp = obs.span("collective:" + op_name, cat="collective",
                               bytes=_payload_bytes(args, kwargs),
                               nranks=g.nranks, group=g.id)
+                _lint_payload(op_name, args)
             else:
                 sp = obs._NULL_SPAN
             with sp:

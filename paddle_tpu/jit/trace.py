@@ -161,6 +161,37 @@ class TracedFunction:
             return first_result
         return self._run_compiled(comp, args, kwargs)
 
+    def analyze_program(self, *args, **kwargs):
+        """Static analysis (tpu_lint) of the compiled step for a call
+        signature: re-trace the cached pure function to a jaxpr (no XLA
+        compile) and run the dtype/amp + weak-type audits, plus the
+        recompile-risk audit over this function's trace cache.
+
+        With arguments, analyzes that signature (it must have been
+        called once already); with no arguments, analyzes the most
+        recently compiled one.  Returns a
+        ``paddle_tpu.analysis.DiagnosticReport``.
+        """
+        from ..analysis import analyze_traced
+        from ..memory.guard import remat_enabled
+        if args or kwargs:
+            key = (_tree_key((args, kwargs)), remat_enabled())
+            comp = self._cache.get(key)
+            if comp is None:
+                raise RuntimeError(
+                    "analyze_program: this call signature has not been "
+                    "traced yet; call the function once first")
+        else:
+            if not self._cache:
+                raise RuntimeError(
+                    "analyze_program: nothing traced yet; call the "
+                    "function once first")
+            comp = next(reversed(self._cache.values()))
+        with obs.span("analyze:" + comp["label"], cat="analysis"):
+            jaxpr = jax.make_jaxpr(comp["pure_fn"])(*comp["avals"])
+            return analyze_traced(jaxpr, label=comp["label"],
+                                  trace_cache=self._cache)
+
     # ------------------------------------------------------------------
     def _discover_and_compile(self, args, kwargs):
         ctx = _DiscoveryCtx()
@@ -316,11 +347,19 @@ class TracedFunction:
             # as donated rw_state) is in argument_bytes; don't let a
             # registered resident charge it twice
             resident_skip_ids={id(v) for v in (*ro_vals, *rw_vals)})
+        def _avalize(vals):
+            return tuple(jax.ShapeDtypeStruct(tuple(v.shape), v.dtype)
+                         for v in vals)
+
         return {
             "compiled": compiled,
             "label": label,
             "flow": flow,
             "estimate": estimate,
+            # for analyze_program: re-trace to a jaxpr without compiling
+            "pure_fn": pure_fn,
+            "avals": (_avalize(arg_vals), _avalize(ro_vals),
+                      _avalize(rw_vals)),
             "ro_state": ro_state,
             "rw_state": rw_state,
             "mutated": mutated,

@@ -33,7 +33,8 @@ from .registry import (  # noqa: F401
 )
 from .export import (  # noqa: F401
     CATEGORY_LANES, chrome_trace, export_chrome_trace, export_jsonl,
-    load_jsonl, phase_breakdown, pipeline_stats, summary,
+    lint_summary_table, load_jsonl, phase_breakdown, pipeline_stats,
+    summary,
 )
 
 __all__ = [
@@ -43,6 +44,6 @@ __all__ = [
     "set_step", "current_step", "next_flow_id", "obs_dir",
     "Counter", "Gauge", "Histogram", "MetricsRegistry", "get_registry",
     "CATEGORY_LANES", "chrome_trace", "export_chrome_trace",
-    "export_jsonl", "load_jsonl", "summary", "phase_breakdown",
-    "pipeline_stats",
+    "export_jsonl", "lint_summary_table", "load_jsonl", "summary",
+    "phase_breakdown", "pipeline_stats",
 ]

@@ -24,12 +24,13 @@ from .timeline import get_timeline, obs_dir
 
 __all__ = ["CATEGORY_LANES", "chrome_trace", "export_chrome_trace",
            "export_jsonl", "load_jsonl", "summary", "phase_breakdown",
-           "pipeline_stats"]
+           "pipeline_stats", "lint_summary_table"]
 
 # tid lanes, one per category, so each stream renders as its own track
 CATEGORY_LANES = {"host": 0, "compile": 1, "dispatch": 2, "collective": 3,
                   "memory": 4, "fault": 5, "amp": 6, "h2d": 7, "d2h": 8,
-                  "pipeline": 9, "prefill": 10, "decode": 11}
+                  "pipeline": 9, "prefill": 10, "decode": 11,
+                  "analysis": 12}
 _EXTRA_LANE_BASE = 16
 
 
@@ -284,3 +285,42 @@ def pipeline_stats(events=None):
         "dispatch_count": len(dispatch),
         "h2d_count": len(h2d),
     }
+
+
+def lint_summary_table(events=None, limit=20):
+    """Text table of tpu_lint findings recorded on the timeline.
+
+    The analyzers emit each diagnostic as a ``cat="analysis"`` instant
+    named ``lint:<code>`` with severity/site/message attrs
+    (``paddle_tpu.analysis``); this groups them per code the way
+    ``summary()`` groups spans per op.
+    """
+    if events is None:
+        events = get_timeline().events()
+    per_code = {}
+    for e in events:
+        if e.cat != "analysis" or not e.name.startswith("lint:"):
+            continue
+        code = e.name[len("lint:"):]
+        attrs = e.attrs or {}
+        rec = per_code.setdefault(
+            code, {"count": 0, "severity": attrs.get("severity", "?"),
+                   "sites": []})
+        rec["count"] += 1
+        site = attrs.get("site")
+        if site and site not in rec["sites"]:
+            rec["sites"].append(site)
+    if not per_code:
+        return "tpu_lint: no diagnostics recorded"
+    lines = [f"{'code':<8} {'sev':<8} {'count':>5}  sites"]
+    order = {"error": 0, "warning": 1, "info": 2}
+    for code, rec in sorted(
+            per_code.items(),
+            key=lambda kv: (order.get(kv[1]["severity"], 3),
+                            -kv[1]["count"]))[:limit]:
+        sites = ", ".join(rec["sites"][:3])
+        if len(rec["sites"]) > 3:
+            sites += f", +{len(rec['sites']) - 3} more"
+        lines.append(f"{code:<8} {rec['severity']:<8} "
+                     f"{rec['count']:>5}  {sites}")
+    return "\n".join(lines)

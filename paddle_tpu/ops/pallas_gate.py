@@ -17,22 +17,56 @@ testing `jax.default_backend()` directly.  The gate:
      on Mosaic failure it logs loudly and the caller falls back to the
      XLA composite — the framework keeps running.
 
-On non-TPU backends this returns False (call sites use the XLA
-composite; the kernels themselves are still exercised in interpret mode
-by tests/test_pallas_kernels.py).
+A failed probe is *diagnosed*, not silent: the Mosaic error and any
+static tiling findings (``analysis.tiling`` over the kernel's block
+plan) are cached in a ``ProbeResult``, queryable via ``probe_report()``,
+recorded to the analysis diagnostic log, and emitted as a
+``cat="analysis"`` instant so fallbacks show up on the observability
+timeline (BENCH_r02 fell back invisibly and the round died blind).
+
+On non-TPU backends ``pallas_enabled`` returns False (call sites use
+the XLA composite; the kernels themselves are still exercised in
+interpret mode by tests/test_pallas_kernels.py).  ``probe_kernel(name,
+force=True)`` runs a probe anyway — in interpret mode — so the CLI and
+tests exercise the full diagnosis path off-hardware.
 """
 from __future__ import annotations
 
 import logging
+import traceback
 
 import jax
 import jax.numpy as jnp
 
-__all__ = ["pallas_enabled", "probe_all", "reset_probe_cache"]
+__all__ = ["pallas_enabled", "probe_all", "probe_kernel", "probe_report",
+           "reset_probe_cache", "ProbeResult"]
 
 _logger = logging.getLogger("paddle_tpu.pallas")
 
-_probe_ok: dict = {}
+# kernel name -> ProbeResult (populated lazily, cleared by reset)
+_probe_results: dict = {}
+
+
+class ProbeResult:
+    """Outcome of one kernel probe compile, with failure diagnosis."""
+
+    __slots__ = ("kernel", "ok", "error", "error_type", "diagnostics")
+
+    def __init__(self, kernel, ok, error=None, error_type=None,
+                 diagnostics=()):
+        self.kernel = kernel
+        self.ok = ok
+        self.error = error
+        self.error_type = error_type
+        self.diagnostics = list(diagnostics)
+
+    def to_dict(self):
+        d = {"kernel": self.kernel, "ok": self.ok, "probed": True}
+        if not self.ok:
+            d["error"] = self.error
+            d["error_type"] = self.error_type
+            d["diagnostics"] = [x.to_dict() for x in self.diagnostics]
+        return d
 
 
 def _flag_on() -> bool:
@@ -99,6 +133,57 @@ _PROBES = {
 }
 
 
+def _static_diagnose(kernel):
+    """Static tiling audit of the kernel's block plan at probe shape —
+    attributes a Mosaic failure to a concrete TPU1xx rule when one is
+    violated (plan shapes mirror the _probe_* functions above)."""
+    from ..analysis import tiling
+    if kernel == "flash_attention":
+        return list(tiling.audit_flash_attention(
+            1, 128, 128, 1, 64, dtype=jnp.bfloat16, causal=True))
+    if kernel == "paged_attention":
+        return list(tiling.audit_paged_attention(
+            2, 64, 16, num_blocks=4, dtype=jnp.float32))
+    return []
+
+
+def _run_probe(kernel: str) -> ProbeResult:
+    """Execute the probe now and cache a diagnosed ProbeResult."""
+    from ..analysis.diagnostics import Diagnostic, record
+    try:
+        _PROBES[kernel]()
+        result = ProbeResult(kernel, True)
+        _logger.info("pallas kernel %s: probe compile OK", kernel)
+    except Exception as exc:
+        err = "".join(traceback.format_exception_only(type(exc), exc))
+        err = err.strip()
+        try:
+            diags = _static_diagnose(kernel)
+        except Exception:
+            diags = []
+        diags.append(Diagnostic(
+            "TPU110",
+            f"pallas kernel {kernel} failed its probe compile "
+            f"({type(exc).__name__}); dispatch falls back to the XLA "
+            "composite",
+            site=f"pallas_gate[{kernel}]",
+            hint="probe_report() carries the full error; set "
+                 "FLAGS_use_pallas_kernels=0 to silence the probe",
+            data={"error": err[:2000]}))
+        result = ProbeResult(kernel, False, error=err,
+                             error_type=type(exc).__name__,
+                             diagnostics=diags)
+        for d in diags:
+            record(d)
+        _logger.exception(
+            "pallas kernel %s FAILED its probe compile; falling back to "
+            "the XLA composite for this process (%d diagnostic(s); see "
+            "pallas_gate.probe_report()). Set FLAGS_use_pallas_kernels=0 "
+            "to silence the probe.", kernel, len(diags))
+    _probe_results[kernel] = result
+    return result
+
+
 def pallas_enabled(kernel: str) -> bool:
     """True iff the named Pallas kernel should be used right now."""
     if kernel not in _PROBES:
@@ -107,21 +192,46 @@ def pallas_enabled(kernel: str) -> bool:
         return False
     if not _flag_on():
         return False
-    ok = _probe_ok.get(kernel)
-    if ok is None:
-        try:
-            _PROBES[kernel]()
-            ok = True
-            _logger.info("pallas kernel %s: probe compile OK", kernel)
-        except Exception:
-            _logger.exception(
-                "pallas kernel %s FAILED its probe compile on TPU; "
-                "falling back to the XLA composite for this process. "
-                "Set FLAGS_use_pallas_kernels=0 to silence the probe.",
-                kernel)
-            ok = False
-        _probe_ok[kernel] = ok
-    return ok
+    result = _probe_results.get(kernel)
+    if result is None:
+        result = _run_probe(kernel)
+    return result.ok
+
+
+def probe_kernel(kernel: str, force: bool = False) -> ProbeResult:
+    """Probe one kernel and return the cached ProbeResult.
+
+    With ``force=True`` the probe runs even off-TPU (interpret mode) —
+    the CLI and tests use this to exercise the diagnosis path without
+    hardware.  Without force, mirrors ``pallas_enabled`` gating.
+    """
+    if kernel not in _PROBES:
+        raise ValueError(f"unknown pallas kernel {kernel!r}")
+    if not force and (jax.default_backend() != "tpu" or not _flag_on()):
+        return ProbeResult(kernel, False,
+                           error="not probed (non-TPU backend or "
+                                 "FLAGS_use_pallas_kernels off)",
+                           error_type="skipped")
+    result = _probe_results.get(kernel)
+    if result is None:
+        result = _run_probe(kernel)
+    return result
+
+
+def probe_report(kernel: str = None) -> dict:
+    """Cached probe outcomes: {kernel: {ok, error, diagnostics, ...}}.
+
+    Kernels never probed in this process report ``{"probed": False}``.
+    Pass a kernel name for just that entry.
+    """
+    names = [kernel] if kernel else list(_PROBES)
+    out = {}
+    for name in names:
+        if name not in _PROBES:
+            raise ValueError(f"unknown pallas kernel {name!r}")
+        res = _probe_results.get(name)
+        out[name] = res.to_dict() if res else {"probed": False}
+    return out[kernel] if kernel else out
 
 
 def probe_all(raise_on_failure: bool = False) -> dict:
@@ -134,9 +244,12 @@ def probe_all(raise_on_failure: bool = False) -> dict:
     if raise_on_failure and jax.default_backend() == "tpu" and _flag_on():
         bad = [k for k, v in results.items() if not v]
         if bad:
-            raise RuntimeError(f"pallas kernels failed probe compile: {bad}")
+            reasons = {k: (_probe_results[k].error or "")[:200]
+                       for k in bad}
+            raise RuntimeError(
+                f"pallas kernels failed probe compile: {reasons}")
     return results
 
 
 def reset_probe_cache() -> None:
-    _probe_ok.clear()
+    _probe_results.clear()

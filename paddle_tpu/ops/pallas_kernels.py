@@ -52,10 +52,12 @@ from jax.experimental.pallas import tpu as pltpu
 
 __all__ = [
     "flash_attention",
+    "flash_block_plan",
     "fused_layer_norm",
     "fused_rms_norm",
     "fused_softmax_cross_entropy",
     "paged_attention",
+    "paged_block_plan",
 ]
 
 _NEG_INF = -1e30
@@ -404,28 +406,96 @@ def set_flash_block_sizes(block_q=None, block_k=None):
 _block_override = (None, None)
 
 
-def _sane_block(b, seq):
-    """Clamp any requested block to a legal bf16 tiling for `seq`."""
+def _min_rows(dtype) -> int:
+    """Mosaic minimum sublane rows for `dtype`: 8 for 4-byte, 16 for
+    2-byte (bf16/f16), 32 for 1-byte tiles."""
+    return {1: 32, 2: 16}.get(jnp.dtype(dtype).itemsize, 8)
+
+
+def _sane_block(b, seq, min_rows=16):
+    """Clamp any requested block to a legal tiling for `seq`/`dtype`."""
     try:
         b = int(b)
     except (TypeError, ValueError):
         return None
-    if b < 16 or b % 16:
+    if b < min_rows or b % min_rows:
         return None
-    return min(b, _round_up(max(seq, 16), 16))
+    return min(b, _round_up(max(seq, min_rows), min_rows))
 
 
-def _pick_block(seq: int, which: int = 0) -> int:
-    ov = _sane_block(_block_override[which], seq)
+def _pick_block(seq: int, which: int = 0, dtype=jnp.float32) -> int:
+    """Q/K block rows for `seq`: legal by construction for `dtype`
+    (sublane multiple of _min_rows), covering `seq` after _round_up
+    padding.  Overrides and autotuned values are clamped to legality
+    rather than trusted — an illegal sweep value degrades to the
+    default instead of crashing Mosaic."""
+    mr = _min_rows(dtype)
+    ov = _sane_block(_block_override[which], seq, mr)
     if ov:
         return ov
     tuned = _load_autotune().get(seq)
     if tuned:
-        t = _sane_block(tuned[which], seq)
+        t = _sane_block(tuned[which], seq, mr)
         if t:
             return t
-    # 16-row minimum keeps bf16 blocks on whole (16, 128) tiles
-    return 128 if seq >= 128 else _round_up(max(seq, 16), 16)
+    return 128 if seq >= 128 else _round_up(max(seq, mr), mr)
+
+
+def flash_block_plan(batch, seq_q, seq_k, heads, head_dim,
+                     dtype=jnp.float32):
+    """The exact forward block plan `_flash_fwd` uses for these shapes.
+
+    Returns grid, chosen block sizes, and per-operand
+    (name, block_shape, padded_array_shape, dtype) tuples in
+    pallas_call order — the input `analysis.tiling.check_pallas_call`
+    validates statically (and the gate uses to diagnose probe
+    failures).  Keep in lockstep with `_flash_fwd`'s specs.
+    """
+    dtype = jnp.dtype(dtype)
+    block_q = _pick_block(seq_q, 0, dtype)
+    block_k = _pick_block(seq_k, 1, dtype)
+    bh = batch * heads
+    sq_pad = _round_up(seq_q, block_q)
+    sk_pad = _round_up(seq_k, block_k)
+    d = head_dim
+    f32 = jnp.dtype(jnp.float32)
+    return {
+        "grid": (bh, sq_pad // block_q),
+        "block_q": block_q,
+        "block_k": block_k,
+        "operands": [
+            ("q", (1, block_q, d), (bh, sq_pad, d), dtype),
+            ("k", (1, sk_pad, d), (bh, sk_pad, d), dtype),
+            ("v", (1, sk_pad, d), (bh, sk_pad, d), dtype),
+            ("out", (1, block_q, d), (bh, sq_pad, d), dtype),
+            ("lse", (1, block_q, _STAT_LANES), (bh, sq_pad, _STAT_LANES),
+             f32),
+        ],
+        "scratch": (),
+    }
+
+
+def paged_block_plan(num_heads, head_dim, block_size, num_blocks=64,
+                     batch=1, table_width=8, dtype=jnp.float32):
+    """The paged decode-attention block plan (see `paged_attention`)."""
+    dtype = jnp.dtype(dtype)
+    f32 = jnp.dtype(jnp.float32)
+    D = head_dim
+    pool = (num_blocks, num_heads, block_size, D)
+    return {
+        "grid": (batch, num_heads, table_width),
+        "operands": [
+            ("q", (1, 1, 1, D), (batch, num_heads, 1, D), dtype),
+            ("k_pool", (1, 1, block_size, D), pool, dtype),
+            ("v_pool", (1, 1, block_size, D), pool, dtype),
+            ("out", (1, 1, 1, D), (batch, num_heads, 1, D), dtype),
+        ],
+        "scratch": (
+            ((_STAT_LANES, D), f32),
+            ((_STAT_LANES, _STAT_LANES), f32),
+            ((_STAT_LANES, _STAT_LANES), f32),
+        ),
+    }
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
@@ -437,8 +507,8 @@ def _flash_attention_bhsd(q, k, v, scale, causal):
 def _flash_attention_bhsd_fwd(q, k, v, scale, causal):
     bh, sq, d = q.shape
     sk = k.shape[1]
-    block_q = _pick_block(sq, 0)
-    block_k = _pick_block(sk, 1)
+    block_q = _pick_block(sq, 0, q.dtype)
+    block_k = _pick_block(sk, 1, q.dtype)
     qp = _pad_dim(q, 1, _round_up(sq, block_q))
     kp = _pad_dim(k, 1, _round_up(sk, block_k))
     vp = _pad_dim(v, 1, _round_up(sk, block_k))
@@ -451,8 +521,8 @@ def _flash_attention_bhsd_bwd(scale, causal, res, g):
     q, k, v, out_pad, lse = res
     bh, sq, d = q.shape
     sk = k.shape[1]
-    block_q = _pick_block(sq, 0)
-    block_k = _pick_block(sk, 1)
+    block_q = _pick_block(sq, 0, q.dtype)
+    block_k = _pick_block(sk, 1, q.dtype)
     qp = _pad_dim(q, 1, _round_up(sq, block_q))
     kp = _pad_dim(k, 1, _round_up(sk, block_k))
     vp = _pad_dim(v, 1, _round_up(sk, block_k))

@@ -289,6 +289,33 @@ class Executor:
                               fetch_labels=self._fetch_labels(fetch_list))
 
     # ------------------------------------------------------------------
+    def analyze_program(self, program=None, feed=None, fetch_list=None):
+        """Static analysis (tpu_lint) of the program as this Executor
+        would run it: trace the step function to a jaxpr — no XLA
+        compile — and run the dtype/amp and weak-type audits, plus the
+        recompile-risk audit over the shared executable cache.
+
+        Takes the same (program, feed, fetch_list) as ``run``; feed
+        values are only used for shapes/dtypes.  Returns a
+        ``paddle_tpu.analysis.DiagnosticReport`` (also emitted to the
+        observability timeline as ``cat="analysis"`` instants).
+        """
+        import jax as _jax
+
+        from ..analysis import analyze_traced
+        call, fetch_list = self._prologue(program, feed, fetch_list, 0)
+        if call is None:
+            from ..analysis import DiagnosticReport
+            return DiagnosticReport(label="static.Program[empty]")
+        entry = call[0]
+        with obs.span("analyze:" + entry["program_label"],
+                      cat="analysis"):
+            jaxpr = _jax.make_jaxpr(entry["pure"])(*entry["avals"])
+            return analyze_traced(
+                jaxpr, label=entry["program_label"],
+                executor_cache=Executor._shared_cache)
+
+    # ------------------------------------------------------------------
     def _cache_key(self, program, feed, fetch_list):
         # _feed_shape (not np.asarray) so device-resident feed values —
         # the whole point of the prefetch pipeline — are not pulled
@@ -437,6 +464,8 @@ class Executor:
         entry = {
             "compiled": None,
             "pure": pure,
+            "avals": (feed_avals, param_avals, opt_avals, rng_avals,
+                      lr_aval, step_aval),
             "donate": donate,
             "feed_names": feed_names,
             "frozen": frozen,

@@ -1,0 +1,236 @@
+#!/usr/bin/env python
+"""tpu_lint: static TPU-readiness lint for paddle_tpu programs.
+
+    python scripts/tpu_lint.py --models [--fail-on {error,warning,never}]
+                               [--json] [--only lenet,bert,gpt]
+
+Lints the bundled models without needing a TPU:
+
+  * **lenet** — dygraph train step through ``jit.to_static`` +
+    ``analyze_program()`` (the trace-cache / recompile-risk path);
+  * **bert**  — static-graph MLM step (AMP bf16) through
+    ``Executor.analyze_program`` (the fingerprint-cache path);
+  * **gpt**   — static-graph causal-LM step (AMP bf16 + recompute);
+  * **pallas** — flash / paged attention block plans checked against the
+    Mosaic tiling rules (``analysis.tiling``), no kernel launch.
+
+Every finding is a structured ``Diagnostic`` (stable TPUxxx code,
+severity, site, fix hint).  Exit code is 1 iff any diagnostic at or
+above ``--fail-on`` severity was found (default: error).  Runs in the
+tier-1 suite via tests/test_analysis.py so new error-severity findings
+on the bundled models break the build.  CPU-only.
+"""
+import argparse
+import json
+import os
+import sys
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+MODELS = ("lenet", "bert", "gpt", "pallas")
+
+
+def lint_lenet():
+    """Dygraph LeNet step via to_static — exercises the jit trace path."""
+    import paddle_tpu as paddle
+    from paddle_tpu import optimizer
+    from paddle_tpu.vision.models import LeNet
+    import paddle_tpu.nn.functional as F
+
+    paddle.disable_static()
+    paddle.seed(0)
+    model = LeNet(num_classes=10)
+    opt = optimizer.Adam(learning_rate=1e-3,
+                         parameters=model.parameters())
+
+    def train_step(img, label):
+        loss = F.cross_entropy(model(img), label)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        return loss
+
+    traced = paddle.jit.to_static(train_step)
+    rng = np.random.default_rng(0)
+    img = paddle.to_tensor(
+        rng.standard_normal((8, 1, 28, 28)).astype(np.float32))
+    label = paddle.to_tensor(rng.integers(0, 10, (8,)).astype(np.int64))
+    traced(img, label)  # discovery trace
+    return traced.analyze_program(img, label)
+
+
+def _lint_static(build):
+    import paddle_tpu as paddle
+    from paddle_tpu import static
+
+    paddle.enable_static()
+    try:
+        main, startup = static.Program(), static.Program()
+        with static.program_guard(main, startup):
+            feed, fetch = build(static)
+        exe = static.Executor()
+        exe.run(startup)
+        return exe.analyze_program(main, feed=feed, fetch_list=fetch)
+    finally:
+        paddle.disable_static()
+
+
+def lint_bert():
+    """Static BERT MLM step (AMP bf16) — exercises the executor path."""
+    import paddle_tpu as paddle
+    from paddle_tpu import optimizer
+    from paddle_tpu.models import BertConfig, BertForMaskedLM
+
+    B, S = 4, 64
+    rng = np.random.default_rng(1)
+
+    def build(static):
+        ids = static.data("ids", [B, S], "int64")
+        labels = static.data("labels", [B, S], "int64")
+        model = BertForMaskedLM(BertConfig(
+            hidden_size=128, num_hidden_layers=2, num_attention_heads=2,
+            intermediate_size=256))
+        with paddle.amp.auto_cast(dtype="bfloat16", level="O1"):
+            loss, _ = model(ids, labels=labels)
+        opt = optimizer.AdamW(learning_rate=1e-4,
+                              parameters=model.parameters())
+        opt.minimize(loss)
+        feed = {"ids": rng.integers(0, 1000, (B, S)).astype(np.int64),
+                "labels": rng.integers(0, 1000, (B, S)).astype(np.int64)}
+        return feed, [loss]
+
+    return _lint_static(build)
+
+
+def lint_gpt():
+    """Static GPT causal-LM step (AMP bf16 + recompute)."""
+    import paddle_tpu as paddle
+    from paddle_tpu import optimizer
+    from paddle_tpu.models import (GPTConfig, GPTForCausalLM,
+                                   GPTPretrainingCriterion)
+
+    B, S = 4, 64
+    rng = np.random.default_rng(2)
+
+    def build(static):
+        ids = static.data("ids", [B, S], "int64")
+        labels = static.data("labels", [B, S], "int64")
+        model = GPTForCausalLM(GPTConfig(
+            vocab_size=256, hidden_size=128, num_hidden_layers=2,
+            num_attention_heads=2, use_flash_attention=False,
+            use_recompute=True, max_position_embeddings=128))
+        criterion = GPTPretrainingCriterion()
+        with paddle.amp.auto_cast(dtype="bfloat16", level="O1"):
+            loss = criterion(model(ids), labels)
+        opt = optimizer.AdamW(learning_rate=1e-4,
+                              parameters=model.parameters())
+        opt.minimize(loss)
+        feed = {"ids": rng.integers(0, 256, (B, S)).astype(np.int64),
+                "labels": rng.integers(0, 256, (B, S)).astype(np.int64)}
+        return feed, [loss]
+
+    return _lint_static(build)
+
+
+def lint_pallas():
+    """Flash / paged attention block plans vs the Mosaic tiling rules."""
+    import jax.numpy as jnp
+    from paddle_tpu import analysis
+    from paddle_tpu.analysis.diagnostics import DiagnosticReport, record
+
+    report = DiagnosticReport(label="pallas block plans")
+    for dtype in (jnp.float32, jnp.bfloat16):
+        for seq in (64, 128, 1024):
+            r = analysis.audit_flash_attention(
+                batch=1, seq_q=seq, seq_k=seq, heads=4, head_dim=64,
+                dtype=dtype, causal=True)
+            report.extend(r.diagnostics)
+    r = analysis.audit_paged_attention(num_heads=8, head_dim=64,
+                                       block_size=16, num_blocks=64,
+                                       dtype=jnp.bfloat16)
+    report.extend(r.diagnostics)
+    for d in report.diagnostics:
+        record(d)
+    return report
+
+
+LINTERS = {"lenet": lint_lenet, "bert": lint_bert, "gpt": lint_gpt,
+           "pallas": lint_pallas}
+
+
+def run_models(names):
+    from paddle_tpu.analysis.diagnostics import (Diagnostic,
+                                                 DiagnosticReport, record)
+    results, combined = {}, DiagnosticReport(label="tpu_lint --models")
+    for name in names:
+        t = time.time()
+        try:
+            rep = LINTERS[name]()
+        except Exception as exc:  # lint must not crash the gate silently
+            diag = Diagnostic(
+                code="TPU110", severity="error",
+                message=f"linting {name} raised "
+                        f"{type(exc).__name__}: {exc}",
+                site=f"tpu_lint:{name}",
+                hint="fix the model build/trace before trusting the "
+                     "lint result for this model")
+            record(diag)
+            rep = DiagnosticReport(label=name)
+            rep.add(diag)
+        results[name] = rep
+        combined.extend(rep.diagnostics)
+        print(f"[tpu_lint] {name}: {len(rep.diagnostics)} finding(s), "
+              f"{len(rep.errors())} error(s)  "
+              f"({time.time() - t:.1f}s)", file=sys.stderr)
+    return results, combined
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--models", action="store_true",
+                    help="lint the bundled models (lenet, bert, gpt) "
+                         "and the Pallas block plans")
+    ap.add_argument("--only", default=",".join(MODELS),
+                    help="comma-separated subset of: %s" % (MODELS,))
+    ap.add_argument("--fail-on", default="error",
+                    choices=("error", "warning", "never"),
+                    help="exit 1 when a diagnostic at/above this "
+                         "severity is found (default: error)")
+    ap.add_argument("--json", action="store_true",
+                    help="print machine-readable JSON instead of text")
+    args = ap.parse_args(argv)
+
+    if not args.models:
+        ap.error("nothing to do: pass --models")
+    names = [n.strip() for n in args.only.split(",") if n.strip()]
+    unknown = [n for n in names if n not in LINTERS]
+    if unknown:
+        ap.error(f"unknown model(s) {unknown}; choose from {MODELS}")
+
+    results, combined = run_models(names)
+
+    if args.json:
+        print(json.dumps({
+            "models": {n: [d.to_dict() for d in r]
+                       for n, r in results.items()},
+            "counts": combined.counts(),
+            "ok": combined.ok(fail_on=args.fail_on),
+        }, indent=2, default=str))
+    else:
+        for name in names:
+            print(results[name].render())
+        counts = combined.counts()
+        tally = ", ".join(f"{c}×{k}" for k, c in sorted(counts.items()))
+        print(f"tpu_lint: {len(combined.diagnostics)} finding(s)"
+              + (f" ({tally})" if tally else ""))
+
+    return 0 if combined.ok(fail_on=args.fail_on) else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
