@@ -64,9 +64,12 @@ def lint_summary(events=None):
     try:
         from ..ops.pallas_gate import probe_report
         for name, info in probe_report().items():
+            # unprobed kernels are reported too — an all-fallback run
+            # must be visible in the artifact, not an empty dict
             if not info.get("probed"):
+                pallas[name] = {"probed": False}
                 continue
-            pallas[name] = {"ok": info["ok"]}
+            pallas[name] = {"probed": True, "ok": info["ok"]}
             if not info["ok"]:
                 pallas[name]["error"] = (info.get("error") or "")[:200]
     except Exception:

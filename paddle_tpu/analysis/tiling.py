@@ -23,7 +23,8 @@ from .diagnostics import Diagnostic, DiagnosticReport
 
 __all__ = ["LANE", "VMEM_BYTES", "min_tile", "check_block_spec",
            "check_pallas_call", "estimate_vmem_bytes",
-           "audit_flash_attention", "audit_paged_attention"]
+           "audit_flash_attention", "audit_paged_attention",
+           "audit_layer_norm_residual", "audit_matmul_epilogue"]
 
 LANE = 128
 # per-core VMEM; Mosaic needs headroom for double buffering, so the
@@ -160,16 +161,47 @@ def check_pallas_call(operands, *, scratch=(), site="pallas_call",
 
 
 def audit_flash_attention(batch, seq_q, seq_k, heads, head_dim,
-                          dtype="float32", causal=False):
-    """Statically validate the exact block plan ``_flash_fwd`` would
-    use for these shapes (see ``ops.pallas_kernels.flash_block_plan``)."""
+                          dtype="float32", causal=False,
+                          direction="fwd"):
+    """Statically validate the exact block plan the flash kernels would
+    use for these shapes (see ``ops.pallas_kernels.flash_block_plan``).
+    ``direction``: ``"fwd"``, ``"bwd_dq"`` or ``"bwd_dkv"``."""
     from ..ops.pallas_kernels import flash_block_plan
     plan = flash_block_plan(batch, seq_q, seq_k, heads, head_dim,
-                            dtype=dtype)
+                            dtype=dtype, direction=direction)
     report = check_pallas_call(
         plan["operands"], scratch=plan.get("scratch", ()),
-        site=f"flash_attention[{np.dtype(dtype).name} q={seq_q} "
-             f"k={seq_k} d={head_dim}]")
+        site=f"flash_attention.{direction}[{np.dtype(dtype).name} "
+             f"q={seq_q} k={seq_k} d={head_dim}]")
+    report.plan = plan
+    return report
+
+
+def audit_layer_norm_residual(rows, hidden, dtype="float32",
+                              direction="fwd"):
+    """Statically validate the fused layernorm+residual block plan
+    (see ``ops.pallas_fused.ln_residual_block_plan``)."""
+    from ..ops.pallas_fused import ln_residual_block_plan
+    plan = ln_residual_block_plan(rows, hidden, dtype=dtype,
+                                  direction=direction)
+    report = check_pallas_call(
+        plan["operands"], scratch=plan.get("scratch", ()),
+        site=f"layer_norm_residual.{direction}"
+             f"[{np.dtype(dtype).name} rows={rows} n={hidden}]")
+    report.plan = plan
+    return report
+
+
+def audit_matmul_epilogue(m, k, n, dtype="float32", direction="fwd"):
+    """Statically validate the matmul-epilogue fusion block plan
+    (see ``ops.pallas_fused.matmul_epilogue_block_plan``)."""
+    from ..ops.pallas_fused import matmul_epilogue_block_plan
+    plan = matmul_epilogue_block_plan(m, k, n, dtype=dtype,
+                                      direction=direction)
+    report = check_pallas_call(
+        plan["operands"], scratch=plan.get("scratch", ()),
+        site=f"matmul_epilogue.{direction}"
+             f"[{np.dtype(dtype).name} m={m} k={k} n={n}]")
     report.plan = plan
     return report
 

@@ -14,7 +14,9 @@ lists before the impl runs.
 """
 from __future__ import annotations
 
+import os
 import threading
+import time
 from typing import Any, Callable, Optional, Sequence
 
 import jax
@@ -23,6 +25,7 @@ import jax.numpy as jnp
 from . import autograd
 from . import lazy as _lazy
 from .dtypes import to_paddle_dtype
+from ..observability.timeline import enabled as _obs_enabled
 
 __all__ = ["dispatch", "OpDef", "OP_REGISTRY", "register_op"]
 
@@ -71,6 +74,43 @@ _EAGER_JIT_MAX = 4096
 _eager_fwd_cache: dict = {}
 _eager_vjp_cache: dict = {}
 _bwd_apply = None
+
+# dtype -> str(dtype) memo: numpy dtype __str__ allocates on every call
+# and _jit_key stringifies every operand's dtype on every eager dispatch
+# — at trace-cache-hit steady state that was a measurable slice of the
+# 1000x eager overhead (lenet_dygraph triage).
+_DTYPE_STR: dict = {}
+
+
+def _dtype_str(dt):
+    s = _DTYPE_STR.get(dt)
+    if s is None:
+        s = _DTYPE_STR[dt] = str(dt)
+    return s
+
+
+# Live per-op cache-fragmentation watch at the insert sites: an op
+# accumulating many jitted variants is quietly recompiling instead of
+# hitting its cache.  Crossing the threshold records the TPU202/TPU203
+# classification from analysis.audit_eager_cache once per op.
+_FRAG_THRESHOLD = int(os.environ.get(
+    "PADDLE_TPU_EAGER_FRAG_THRESHOLD", "16"))
+_frag_counts: dict = {}
+_frag_flagged: set = set()
+
+
+def _note_cache_insert(name):
+    n = _frag_counts.get(name, 0) + 1
+    _frag_counts[name] = n
+    if n != _FRAG_THRESHOLD or name in _frag_flagged:
+        return
+    _frag_flagged.add(name)
+    from ..analysis.diagnostics import record
+    from ..analysis.recompile import audit_eager_cache
+    merged = {**_eager_fwd_cache, **_eager_vjp_cache}
+    for d in audit_eager_cache(cache=merged, per_op_threshold=1):
+        if d.site == f"eager:{name}":
+            record(d)
 
 
 def _get_bwd_apply():
@@ -145,7 +185,7 @@ def _jit_key(name, impl, args, tensor_idx, arrays, attrs):
             (k, _static_sig(v)) for k, v in attrs.items()))
     except TypeError:
         return None
-    aval_sig = tuple((v.shape, str(v.dtype)) for v in arrays)
+    aval_sig = tuple((v.shape, _dtype_str(v.dtype)) for v in arrays)
     return (name, code, statics, attr_sig, aval_sig)
 
 
@@ -165,7 +205,25 @@ def dispatch(name: str, impl: Callable, args: Sequence[Any], attrs=None,
 
     ``args`` may mix Tensors and raw python values (scalars keep JAX weak-type
     promotion).  Returns Tensor or tuple of Tensors mirroring impl's output.
+
+    With observability on, eager dispatches feed the
+    ``eager.dispatch_us`` histogram (host-side overhead per op — the
+    metric behind the lenet_dygraph 1000x triage); off, the timing
+    costs one global read.
     """
+    if _obs_enabled() and _state.static_hook is None:
+        t0 = time.perf_counter()
+        try:
+            return _dispatch(name, impl, args, attrs, differentiable)
+        finally:
+            from ..observability.registry import get_registry
+            get_registry().histogram("eager.dispatch_us").observe(
+                (time.perf_counter() - t0) * 1e6)
+    return _dispatch(name, impl, args, attrs, differentiable)
+
+
+def _dispatch(name: str, impl: Callable, args: Sequence[Any], attrs,
+              differentiable: bool):
     from .tensor import Tensor
 
     attrs = attrs or {}
@@ -227,6 +285,7 @@ def dispatch(name: str, impl: Callable, args: Sequence[Any], attrs=None,
 
                 cached = jax.jit(pure_fwd)
                 _eager_fwd_cache[key] = cached
+                _note_cache_insert(name)
             if cached is not None:
                 return _wrap(cached(*arrays), name, node=None)
         full = list(args)
@@ -258,6 +317,7 @@ def dispatch(name: str, impl: Callable, args: Sequence[Any], attrs=None,
 
             cached = jax.jit(pure_pair)
             _eager_vjp_cache[key] = cached
+            _note_cache_insert(name)
         if cached is not None:
             outs, raw_vjp = cached(*arrays)
             apply = _get_bwd_apply()

@@ -227,10 +227,24 @@ class LazyNode:
             _flush_buffer(buf)
 
 
+_aval_intern: dict = {}
+
+
 def _aval_of(v):
+    """ShapeDtypeStruct for one dispatch operand, interned by
+    (shape, dtype): the lazy recorder abstractifies every operand of
+    every recorded op, and a training loop re-sees the same handful of
+    signatures millions of times (the lenet eager-dispatch triage)."""
     if isinstance(v, LazyValue):
-        return jax.ShapeDtypeStruct(v.aval.shape, v.aval.dtype)
-    return jax.ShapeDtypeStruct(jnp.shape(v), jnp.result_type(v))
+        sig = (v.aval.shape, v.aval.dtype)
+    else:
+        sig = (jnp.shape(v), jnp.result_type(v))
+    aval = _aval_intern.get(sig)
+    if aval is None:
+        if len(_aval_intern) >= 4096:
+            return jax.ShapeDtypeStruct(*sig)
+        aval = _aval_intern[sig] = jax.ShapeDtypeStruct(*sig)
+    return aval
 
 
 _key_intern: dict = {}

@@ -88,9 +88,13 @@ class BertLayer(nn.Layer):
         self.dropout = nn.Dropout(cfg.hidden_dropout_prob)
 
     def forward(self, x, attn_mask=None):
-        x = self.ln1(x + self.dropout(self.attention(x, attn_mask)))
-        x = self.ln2(x + self.dropout(
-            self.fc2(F.gelu(self.fc1(x), approximate=True))))
+        # post-norm: residual adds fuse into the LN kernel; fc1's
+        # bias+gelu fold into the matmul epilogue (both TPU-gated)
+        x = self.ln1.forward_fused(
+            self.dropout(self.attention(x, attn_mask)), x)
+        h = F.linear_act(x, self.fc1.weight, self.fc1.bias,
+                         act="gelu_tanh")
+        x = self.ln2.forward_fused(self.dropout(self.fc2(h)), x)
         return x
 
 
@@ -137,8 +141,9 @@ class TiedMLMHead(nn.Layer):
                                epsilon=cfg.layer_norm_eps)
 
     def forward(self, hidden, word_embedding_weight, labels=None):
-        hidden = self.ln(F.gelu(self.transform(hidden),
-                                approximate=True))
+        hidden = self.ln(F.linear_act(
+            hidden, self.transform.weight, self.transform.bias,
+            act="gelu_tanh"))
         logits = paddle.matmul(hidden, word_embedding_weight,
                                transpose_y=True)
         if labels is None:

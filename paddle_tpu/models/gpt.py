@@ -89,7 +89,9 @@ class GPTMLP(nn.Layer):
         self.fc2 = nn.Linear(cfg.intermediate_size, cfg.hidden_size)
 
     def forward(self, x):
-        return self.fc2(F.gelu(self.fc1(x), approximate=True))
+        # fc1's bias+gelu fold into the matmul epilogue on TPU
+        return self.fc2(F.linear_act(x, self.fc1.weight, self.fc1.bias,
+                                     act="gelu_tanh"))
 
 
 class GPTBlock(nn.Layer):

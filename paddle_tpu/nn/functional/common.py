@@ -15,7 +15,8 @@ from ...core.tensor import Tensor, to_tensor
 from ...framework.random import default_generator
 
 __all__ = [
-    "linear", "dropout", "dropout2d", "dropout3d", "alpha_dropout", "pad",
+    "linear", "linear_act", "dropout", "dropout2d", "dropout3d",
+    "alpha_dropout", "pad",
     "interpolate", "upsample", "cosine_similarity", "pixel_shuffle",
     "pixel_unshuffle", "unfold", "fold", "one_hot", "embedding",
     "label_smooth", "bilinear", "class_center_sample", "zeropad2d",
@@ -31,6 +32,47 @@ def linear(x, weight, bias=None, name=None):
         return dispatch("linear", lambda v, w: v @ w, (x, weight), {})
     return dispatch("linear", lambda v, w, b: v @ w + b, (x, weight, bias),
                     {})
+
+
+def _apply_act(z, act):
+    """XLA epilogue matching ops.pallas_fused.ACTIVATIONS semantics."""
+    if act == "none":
+        return z
+    if act == "relu":
+        return jax.nn.relu(z)
+    if act == "gelu":
+        return jax.nn.gelu(z, approximate=False)
+    if act == "gelu_tanh":
+        return jax.nn.gelu(z, approximate=True)
+    if act == "silu":
+        return jax.nn.silu(z)
+    raise ValueError(f"unknown activation {act!r}")
+
+
+def linear_act(x, weight, bias=None, act="none", name=None):
+    """act(x @ W + b) with the bias+activation fused into the matmul
+    epilogue on TPU (``matmul_epilogue`` gate); one kernel instead of a
+    matmul plus two elementwise passes over the (rows, out) activation.
+    ``act``: one of none/relu/gelu/gelu_tanh/silu."""
+    from ...ops.pallas_fused import ACTIVATIONS
+    if act not in ACTIVATIONS:
+        raise ValueError(
+            f"unknown activation {act!r}; expected one of {ACTIVATIONS}")
+    from ...ops.pallas_gate import pallas_enabled
+    use_pallas = bias is not None and pallas_enabled("matmul_epilogue")
+
+    def impl(v, w, *b, act, use_pallas=False):
+        if use_pallas:
+            from ...ops.pallas_fused import fused_linear_act
+            return fused_linear_act(v, w, b[0], act)
+        z = v @ w
+        if b:
+            z = z + b[0]
+        return _apply_act(z, act)
+
+    args = (x, weight) + ((bias,) if bias is not None else ())
+    return dispatch("linear_act", impl, args,
+                    dict(act=act, use_pallas=use_pallas))
 
 
 # Program.clone(for_test=True) replaces train-only rng ops with these

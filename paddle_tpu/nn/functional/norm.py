@@ -14,9 +14,9 @@ import jax.numpy as jnp
 from ...core.dispatch import dispatch
 from ...core.tensor import Tensor
 
-__all__ = ["layer_norm", "batch_norm", "instance_norm", "group_norm",
-           "local_response_norm", "normalize", "rms_norm",
-           "spectral_norm"]
+__all__ = ["layer_norm", "batch_norm", "fused_residual_layer_norm",
+           "instance_norm", "group_norm", "local_response_norm",
+           "normalize", "rms_norm", "spectral_norm"]
 
 
 def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-05,
@@ -50,6 +50,51 @@ def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-05,
 
     args = (x,) + tuple(t for t in (weight, bias) if t is not None)
     return dispatch("layer_norm", impl, args,
+                    dict(eps=float(epsilon), naxes=naxes,
+                         has_w=weight is not None, has_b=bias is not None,
+                         use_pallas=use_pallas))
+
+
+def fused_residual_layer_norm(x, residual, normalized_shape, weight=None,
+                              bias=None, epsilon=1e-05, name=None):
+    """layer_norm(x + residual) with the add fused into the norm.
+
+    The post-norm transformer sublayer epilogue.  On TPU (behind the
+    ``layer_norm_residual`` gate) a single Pallas kernel streams x and
+    the residual once, adds in f32 and normalizes in the same pass; the
+    XLA fallback computes the identical f32 add + f32-stat composite so
+    both paths agree bitwise-closely for bf16 inputs.
+    """
+    if isinstance(normalized_shape, int):
+        normalized_shape = (normalized_shape,)
+    naxes = len(tuple(normalized_shape))
+    from ...ops.pallas_gate import pallas_enabled
+    use_pallas = (naxes == 1 and weight is not None and bias is not None
+                  and pallas_enabled("layer_norm_residual"))
+
+    def impl(v, r, *wb, eps, naxes, has_w, has_b, use_pallas=False):
+        if use_pallas:
+            from ...ops.pallas_fused import fused_layer_norm_residual
+            return fused_layer_norm_residual(v, r, wb[0], wb[1], eps=eps)
+        axes = tuple(range(v.ndim - naxes, v.ndim))
+        # the add itself runs in f32 (matching the kernel) so bf16
+        # residual streams don't round twice
+        vf = v.astype(jnp.float32) + r.astype(jnp.float32)
+        mean = jnp.mean(vf, axis=axes, keepdims=True)
+        var = jnp.mean(jnp.square(vf - mean), axis=axes, keepdims=True)
+        out = (vf - mean) * jax.lax.rsqrt(var + eps)
+        out = out.astype(v.dtype)
+        i = 0
+        if has_w:
+            out = out * wb[i]
+            i += 1
+        if has_b:
+            out = out + wb[i]
+        return out
+
+    args = (x, residual) + tuple(t for t in (weight, bias)
+                                 if t is not None)
+    return dispatch("fused_residual_layer_norm", impl, args,
                     dict(eps=float(epsilon), naxes=naxes,
                          has_w=weight is not None, has_b=bias is not None,
                          use_pallas=use_pallas))

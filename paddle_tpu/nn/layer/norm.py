@@ -41,6 +41,14 @@ class LayerNorm(Layer):
         return F.layer_norm(x, self._normalized_shape, self.weight,
                             self.bias, self._epsilon)
 
+    def forward_fused(self, x, residual):
+        """layer_norm(x + residual) — the post-norm transformer sublayer
+        epilogue, with the residual add fused into the norm kernel on
+        TPU (``layer_norm_residual`` gate)."""
+        return F.fused_residual_layer_norm(
+            x, residual, self._normalized_shape, self.weight, self.bias,
+            self._epsilon)
+
 
 class RMSNorm(Layer):
     def __init__(self, hidden_size, epsilon=1e-6, weight_attr=None,

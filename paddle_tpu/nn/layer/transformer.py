@@ -121,6 +121,17 @@ class TransformerEncoderLayer(Layer):
         self.dropout1 = Dropout(dropout)
         self.dropout2 = Dropout(dropout)
         self.activation = _get_activation(activation)
+        self._act_name = activation
+
+    def _ffn(self, src):
+        # bias + activation fold into the first matmul's epilogue on
+        # TPU (matmul_epilogue gate); XLA fallback is the composite
+        if self.linear1.bias is not None:
+            h = F.linear_act(src, self.linear1.weight, self.linear1.bias,
+                             act=self._act_name)
+        else:
+            h = self.activation(self.linear1(src))
+        return self.linear2(self.dropout(h))
 
     def forward(self, src, src_mask=None, cache=None):
         residual = src
@@ -131,16 +142,19 @@ class TransformerEncoderLayer(Layer):
         else:  # incremental encoding (paddle cache protocol)
             src, new_cache = self.self_attn(src, src, src, src_mask,
                                             cache=cache)
-        src = residual + self.dropout1(src)
-        if not self.normalize_before:
-            src = self.norm1(src)
+        src = self.dropout1(src)
+        if self.normalize_before:
+            src = residual + src
+        else:  # post-norm: residual add fused into the norm kernel
+            src = self.norm1.forward_fused(src, residual)
         residual = src
         if self.normalize_before:
             src = self.norm2(src)
-        src = self.linear2(self.dropout(self.activation(self.linear1(src))))
-        src = residual + self.dropout2(src)
-        if not self.normalize_before:
-            src = self.norm2(src)
+        src = self.dropout2(self._ffn(src))
+        if self.normalize_before:
+            src = residual + src
+        else:
+            src = self.norm2.forward_fused(src, residual)
         return src if cache is None else (src, new_cache)
 
     def gen_cache(self, src):
@@ -242,6 +256,9 @@ class TransformerDecoderLayer(Layer):
         self.dropout2 = Dropout(dropout)
         self.dropout3 = Dropout(dropout)
         self.activation = _get_activation(activation)
+        self._act_name = activation
+
+    _ffn = TransformerEncoderLayer._ffn
 
     def forward(self, tgt, memory, tgt_mask=None, memory_mask=None,
                 cache=None):
@@ -255,9 +272,11 @@ class TransformerDecoderLayer(Layer):
         else:
             tgt, inc_cache = self.self_attn(tgt, tgt, tgt, tgt_mask,
                                             cache=cache[0])
-        tgt = residual + self.dropout1(tgt)
-        if not self.normalize_before:
-            tgt = self.norm1(tgt)
+        tgt = self.dropout1(tgt)
+        if self.normalize_before:
+            tgt = residual + tgt
+        else:  # post-norm: residual add fused into the norm kernel
+            tgt = self.norm1.forward_fused(tgt, residual)
         residual = tgt
         if self.normalize_before:
             tgt = self.norm2(tgt)
@@ -266,16 +285,19 @@ class TransformerDecoderLayer(Layer):
         else:
             tgt, static_cache = self.cross_attn(
                 tgt, memory, memory, memory_mask, cache=cache[1])
-        tgt = residual + self.dropout2(tgt)
-        if not self.normalize_before:
-            tgt = self.norm2(tgt)
+        tgt = self.dropout2(tgt)
+        if self.normalize_before:
+            tgt = residual + tgt
+        else:
+            tgt = self.norm2.forward_fused(tgt, residual)
         residual = tgt
         if self.normalize_before:
             tgt = self.norm3(tgt)
-        tgt = self.linear2(self.dropout(self.activation(self.linear1(tgt))))
-        tgt = residual + self.dropout3(tgt)
-        if not self.normalize_before:
-            tgt = self.norm3(tgt)
+        tgt = self.dropout3(self._ffn(tgt))
+        if self.normalize_before:
+            tgt = residual + tgt
+        else:
+            tgt = self.norm3.forward_fused(tgt, residual)
         if cache is None:
             return tgt
         return tgt, (inc_cache, static_cache)

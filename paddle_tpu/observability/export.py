@@ -30,7 +30,7 @@ __all__ = ["CATEGORY_LANES", "chrome_trace", "export_chrome_trace",
 CATEGORY_LANES = {"host": 0, "compile": 1, "dispatch": 2, "collective": 3,
                   "memory": 4, "fault": 5, "amp": 6, "h2d": 7, "d2h": 8,
                   "pipeline": 9, "prefill": 10, "decode": 11,
-                  "analysis": 12}
+                  "analysis": 12, "kernel": 13}
 _EXTRA_LANE_BASE = 16
 
 
@@ -174,22 +174,42 @@ def summary(view="op", events=None, limit=30):
 def phase_breakdown(events=None):
     """Compact per-phase totals for the BENCH json: compile / dispatch /
     collective milliseconds, collective payload bytes, and the
-    host↔device transfer bytes the dispatch spans recorded."""
+    host↔device transfer bytes the dispatch spans recorded.
+
+    Pallas kernel dispatch spans (``cat="kernel"``, named
+    ``kernel:<name>.<direction>`` by ``pallas_kernels._kernel_span``)
+    aggregate into ``kernel_ms``/``kernel_count`` plus one
+    ``kernel_<name>_<direction>_ms``/``_count`` pair per kernel+direction
+    so the bench shows exactly where fused-kernel time went."""
     if events is None:
         events = get_timeline().events()
     out = {"compile_ms": 0.0, "dispatch_ms": 0.0, "collective_ms": 0.0,
            "h2d_ms": 0.0, "d2h_ms": 0.0, "pipeline_wait_ms": 0.0,
-           "prefill_ms": 0.0, "decode_ms": 0.0,
+           "prefill_ms": 0.0, "decode_ms": 0.0, "kernel_ms": 0.0,
            "collective_bytes": 0, "h2d_bytes": 0, "d2h_bytes": 0,
            "compile_count": 0, "dispatch_count": 0, "collective_count": 0,
            "h2d_count": 0, "d2h_count": 0, "pipeline_wait_count": 0,
-           "prefill_count": 0, "decode_count": 0}
+           "prefill_count": 0, "decode_count": 0, "kernel_count": 0}
+    kernel_keys = []
     for e in events:
         if e.dur is None:
             continue
         ms = e.dur * 1e3
         attrs = e.attrs or {}
-        if e.cat == "compile":
+        if e.cat == "kernel":
+            out["kernel_ms"] += ms
+            out["kernel_count"] += 1
+            name = e.name
+            if name.startswith("kernel:"):
+                name = name[len("kernel:"):]
+            key = "kernel_" + name.replace(".", "_").replace(":", "_")
+            if key + "_ms" not in out:
+                out[key + "_ms"] = 0.0
+                out[key + "_count"] = 0
+                kernel_keys.append(key + "_ms")
+            out[key + "_ms"] += ms
+            out[key + "_count"] += 1
+        elif e.cat == "compile":
             out["compile_ms"] += ms
             out["compile_count"] += 1
         elif e.cat == "dispatch":
@@ -216,7 +236,8 @@ def phase_breakdown(events=None):
             out[f"{e.cat}_ms"] += ms
             out[f"{e.cat}_count"] += 1
     for k in ("compile_ms", "dispatch_ms", "collective_ms", "h2d_ms",
-              "d2h_ms", "pipeline_wait_ms", "prefill_ms", "decode_ms"):
+              "d2h_ms", "pipeline_wait_ms", "prefill_ms", "decode_ms",
+              "kernel_ms", *kernel_keys):
         out[k] = round(out[k], 3)
     return out
 

@@ -1,0 +1,427 @@
+"""Fused training-path Pallas kernels: layernorm+residual-add and
+matmul-epilogue (bias + activation folded into the matmul consumer).
+
+Reference parity: the reference ships these as hand-written CUDA
+fusions — `fused_layernorm_residual_dropout_bias` and the cuBLASLt
+epilogue path behind `fused_gemm_epilogue` [UNVERIFIED — empty
+reference mount].
+
+TPU-native design: same Mosaic tiling discipline as
+`pallas_kernels.py` (this module reuses its helpers and the layer-norm
+backward kernel outright — the LN+residual backward is the LN backward
+with the saved sum `s = x + residual` in place of `x`, since
+`d(x)/d(residual)` are identical).  Both kernels are `jax.custom_vjp`
+so the eager tape and `to_static` differentiate through the
+hand-written backward, and both export block plans
+(`ln_residual_block_plan` / `matmul_epilogue_block_plan`) that
+`analysis.tiling` verifies statically before anything touches the TPU.
+
+Activation math is hand-differentiated in f32 inside the kernels; the
+names mirror the XLA fallbacks the nn.functional layer keeps bit-exact:
+``gelu`` = erf form (`jax.nn.gelu(approximate=False)`), ``gelu_tanh`` =
+tanh form (`approximate=True`), ``silu``, ``relu``, ``none``.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+from .pallas_kernels import (_STAT_LANES, _demote_f64, _interpret,
+                             _kernel_span, _ln_block_rows, _ln_bwd_kernel,
+                             _min_rows, _pad_dim, _round_up, _x32)
+
+__all__ = [
+    "ACTIVATIONS",
+    "fused_layer_norm_residual",
+    "fused_linear_act",
+    "ln_residual_block_plan",
+    "matmul_epilogue_block_plan",
+]
+
+ACTIVATIONS = ("none", "relu", "gelu", "gelu_tanh", "silu")
+
+_SQRT_2 = 2.0 ** 0.5
+_INV_SQRT_2PI = 0.3989422804014327     # 1/sqrt(2*pi)
+_GELU_C = 0.7978845608028654           # sqrt(2/pi)
+_GELU_A = 0.044715
+
+
+def _act_f32(z, act):
+    if act == "none":
+        return z
+    if act == "relu":
+        return jnp.maximum(z, 0.0)
+    if act == "gelu":
+        return 0.5 * z * (1.0 + jax.lax.erf(z / _SQRT_2))
+    if act == "gelu_tanh":
+        t = jnp.tanh(_GELU_C * (z + _GELU_A * z * z * z))
+        return 0.5 * z * (1.0 + t)
+    if act == "silu":
+        return z * jax.nn.sigmoid(z)
+    raise ValueError(f"act must be one of {ACTIVATIONS}, got {act!r}")
+
+
+def _act_grad_f32(z, act):
+    if act == "none":
+        return jnp.ones_like(z)
+    if act == "relu":
+        return (z > 0.0).astype(z.dtype)
+    if act == "gelu":
+        # d/dz [z*Phi(z)] = Phi(z) + z*phi(z)
+        phi = _INV_SQRT_2PI * jnp.exp(-0.5 * z * z)
+        return 0.5 * (1.0 + jax.lax.erf(z / _SQRT_2)) + z * phi
+    if act == "gelu_tanh":
+        u = _GELU_C * (z + _GELU_A * z * z * z)
+        t = jnp.tanh(u)
+        du = _GELU_C * (1.0 + 3.0 * _GELU_A * z * z)
+        return 0.5 * (1.0 + t) + 0.5 * z * (1.0 - t * t) * du
+    if act == "silu":
+        s = jax.nn.sigmoid(z)
+        return s * (1.0 + z * (1.0 - s))
+    raise ValueError(f"act must be one of {ACTIVATIONS}, got {act!r}")
+
+
+# =====================================================================
+# Fused layernorm + residual add
+# =====================================================================
+
+def _ln_res_block_rows(rows, n):
+    # the forward streams 4 (br, N) blocks (x, r, out, s) where plain LN
+    # streams 2; halve the row budget so the double-buffered VMEM
+    # estimate stays well under the 16MB ceiling at BERT-base widths
+    return min(_ln_block_rows(rows, n), 256)
+
+
+def _ln_res_fwd_kernel(x_ref, r_ref, g_ref, b_ref, o_ref, s_ref,
+                       mu_ref, rstd_ref, *, eps):
+    # add and statistics both run in f32; the saved sum is stored in
+    # the input dtype (the residual stream's own precision)
+    s = (x_ref[:].astype(jnp.float32)
+         + r_ref[:].astype(jnp.float32))                # (block_rows, N)
+    br = s.shape[0]
+    mu = jnp.mean(s, axis=-1, keepdims=True)
+    sc = s - mu
+    var = jnp.mean(sc * sc, axis=-1, keepdims=True)
+    rstd = jax.lax.rsqrt(var + eps)
+    shat = sc * rstd
+    o_ref[:] = (shat * g_ref[:].astype(jnp.float32)
+                + b_ref[:].astype(jnp.float32)).astype(o_ref.dtype)
+    s_ref[:] = s.astype(s_ref.dtype)
+    mu_ref[:] = jnp.broadcast_to(mu, (br, _STAT_LANES))
+    rstd_ref[:] = jnp.broadcast_to(rstd, (br, _STAT_LANES))
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4,))
+def _fused_ln_residual_2d(x, r, gamma, beta, eps):
+    return _fused_ln_residual_2d_fwd(x, r, gamma, beta, eps)[0]
+
+
+@_x32
+def _fused_ln_residual_2d_fwd(x, r, gamma, beta, eps):
+    rows, n = x.shape
+    br = _ln_res_block_rows(rows, n)
+    rows_pad = _round_up(rows, br)
+    xp = _pad_dim(x, 0, rows_pad)
+    rp = _pad_dim(r, 0, rows_pad)
+    with _kernel_span("layer_norm_residual", "fwd"):
+        out, s, mu, rstd = pl.pallas_call(
+            functools.partial(_ln_res_fwd_kernel, eps=eps),
+            grid=(rows_pad // br,),
+            in_specs=[
+                pl.BlockSpec((br, n), lambda i: (i, 0)),
+                pl.BlockSpec((br, n), lambda i: (i, 0)),
+                pl.BlockSpec((1, n), lambda i: (0, 0)),
+                pl.BlockSpec((1, n), lambda i: (0, 0)),
+            ],
+            out_specs=[
+                pl.BlockSpec((br, n), lambda i: (i, 0)),
+                pl.BlockSpec((br, n), lambda i: (i, 0)),
+                pl.BlockSpec((br, _STAT_LANES), lambda i: (i, 0)),
+                pl.BlockSpec((br, _STAT_LANES), lambda i: (i, 0)),
+            ],
+            out_shape=[
+                jax.ShapeDtypeStruct((rows_pad, n), x.dtype),
+                jax.ShapeDtypeStruct((rows_pad, n), x.dtype),
+                jax.ShapeDtypeStruct((rows_pad, _STAT_LANES), jnp.float32),
+                jax.ShapeDtypeStruct((rows_pad, _STAT_LANES), jnp.float32),
+            ],
+            interpret=_interpret(),
+        )(xp, rp, gamma.reshape(1, n), beta.reshape(1, n))
+    return out[:rows], (s[:rows], gamma, mu, rstd)
+
+
+@_x32
+def _fused_ln_residual_2d_bwd(eps, res, do):
+    s, gamma, mu, rstd = res
+    rows, n = s.shape
+    br = _ln_res_block_rows(rows, n)
+    rows_pad = _round_up(rows, br)
+    sp = _pad_dim(s, 0, rows_pad)
+    dop = _pad_dim(do, 0, rows_pad)
+    with _kernel_span("layer_norm_residual", "bwd"):
+        dx, dg_acc, db_acc = pl.pallas_call(
+            _ln_bwd_kernel,
+            grid=(rows_pad // br,),
+            in_specs=[
+                pl.BlockSpec((br, n), lambda i: (i, 0)),
+                pl.BlockSpec((1, n), lambda i: (0, 0)),
+                pl.BlockSpec((br, _STAT_LANES), lambda i: (i, 0)),
+                pl.BlockSpec((br, _STAT_LANES), lambda i: (i, 0)),
+                pl.BlockSpec((br, n), lambda i: (i, 0)),
+            ],
+            out_specs=[
+                pl.BlockSpec((br, n), lambda i: (i, 0)),
+                pl.BlockSpec((8, n), lambda i: (0, 0)),
+                pl.BlockSpec((8, n), lambda i: (0, 0)),
+            ],
+            out_shape=[
+                jax.ShapeDtypeStruct((rows_pad, n), s.dtype),
+                jax.ShapeDtypeStruct((8, n), jnp.float32),
+                jax.ShapeDtypeStruct((8, n), jnp.float32),
+            ],
+            interpret=_interpret(),
+        )(sp, gamma.reshape(1, n), mu, rstd, dop)
+    dgamma = dg_acc[0].astype(gamma.dtype)
+    dbeta = db_acc[0].astype(gamma.dtype)
+    dx = dx[:rows]
+    return dx, dx, dgamma, dbeta  # d(x) == d(residual)
+
+
+_fused_ln_residual_2d.defvjp(_fused_ln_residual_2d_fwd,
+                             _fused_ln_residual_2d_bwd)
+
+
+def fused_layer_norm_residual(x, residual, gamma, beta, eps=1e-5):
+    """LayerNorm(x + residual) over the last dim, fused; differentiable.
+
+    The residual add, mean/variance, normalize and affine all run in a
+    single VMEM pass (one read of x/residual instead of the unfused
+    add-then-norm's two), and the backward reuses the plain LN backward
+    on the saved sum.
+    """
+    x, residual, gamma, beta = _demote_f64(x, residual, gamma, beta)
+    shape = x.shape
+    n = shape[-1]
+    out = _fused_ln_residual_2d(x.reshape(-1, n), residual.reshape(-1, n),
+                                gamma, beta, float(eps))
+    return out.reshape(shape)
+
+
+def ln_residual_block_plan(rows, hidden, dtype=jnp.float32,
+                           direction="fwd"):
+    """The exact block plan the LN+residual kernels use for (rows, N).
+
+    Same contract as `flash_block_plan`: per-operand (name, block_shape,
+    padded_array_shape, dtype) in pallas_call order, statically
+    checkable by `analysis.tiling.check_pallas_call`.  Keep in lockstep
+    with `_fused_ln_residual_2d_fwd` / `_fused_ln_residual_2d_bwd`.
+    """
+    dtype = jnp.dtype(dtype)
+    f32 = jnp.dtype(jnp.float32)
+    n = hidden
+    br = _ln_res_block_rows(rows, n)
+    rows_pad = _round_up(rows, br)
+    row_blk = lambda name, dt: (  # noqa: E731 - local table helper
+        name, (br, n), (rows_pad, n), dt)
+    stat = lambda name: (  # noqa: E731
+        name, (br, _STAT_LANES), (rows_pad, _STAT_LANES), f32)
+    if direction == "fwd":
+        operands = [
+            row_blk("x", dtype), row_blk("residual", dtype),
+            ("gamma", (1, n), (1, n), dtype),
+            ("beta", (1, n), (1, n), dtype),
+            row_blk("out", dtype), row_blk("s", dtype),
+            stat("mu"), stat("rstd"),
+        ]
+    elif direction == "bwd":
+        operands = [
+            row_blk("s", dtype),
+            ("gamma", (1, n), (1, n), dtype),
+            stat("mu"), stat("rstd"),
+            row_blk("do", dtype), row_blk("dx", dtype),
+            ("dgamma", (8, n), (8, n), f32),
+            ("dbeta", (8, n), (8, n), f32),
+        ]
+    else:
+        raise ValueError(f"direction must be fwd|bwd, got {direction!r}")
+    return {
+        "direction": direction,
+        "grid": (rows_pad // br,),
+        "block_rows": br,
+        "operands": operands,
+        "scratch": (),
+    }
+
+
+# =====================================================================
+# Matmul-epilogue fusion: act(x @ w + b)
+# =====================================================================
+
+def _me_fwd_kernel(x_ref, w_ref, b_ref, o_ref, z_ref, *, act):
+    # f32 operands: Mosaic's tpu.matmul rejects bf16 inputs here (same
+    # convention as the flash kernels); accumulation + epilogue in f32
+    z = jax.lax.dot_general(
+        x_ref[:].astype(jnp.float32), w_ref[:].astype(jnp.float32),
+        (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)             # (bm, bn)
+    z = z + b_ref[:].astype(jnp.float32)
+    z_ref[:] = z.astype(z_ref.dtype)
+    o_ref[:] = _act_f32(z, act).astype(o_ref.dtype)
+
+
+def _me_bwd_kernel(z_ref, g_ref, dz_ref, db_ref, *, act):
+    z = z_ref[:].astype(jnp.float32)
+    g = g_ref[:].astype(jnp.float32)
+    dz = g * _act_grad_f32(z, act)
+    dz_ref[:] = dz.astype(dz_ref.dtype)
+
+    # dbias: sequential-grid accumulation — the grid is (n_blocks,
+    # m_blocks) with m minor, so every revisit of this db block is
+    # consecutive
+    @pl.when(pl.program_id(1) == 0)
+    def _init():
+        db_ref[:] = jnp.zeros_like(db_ref)
+
+    db = jnp.sum(dz, axis=0, keepdims=True)             # (1, bn)
+    db_ref[:] = db_ref[:] + jnp.broadcast_to(db, db_ref.shape)
+
+
+def _me_blocks(m, k, n, dtype):
+    """(bm, bn, m_pad, n_pad): full-K resident rows, N split so the
+    double-buffered (K, bn) weight block stays under ~6MB of VMEM."""
+    itemsize = jnp.dtype(dtype).itemsize
+    bm = min(_round_up(max(m, 1), _min_rows(dtype)), 128)
+    bn = 512
+    while bn > 128 and 2 * k * bn * itemsize > (6 << 20):
+        bn //= 2
+    bn = min(bn, _round_up(max(n, 1), 128))
+    return bm, bn, _round_up(m, bm), _round_up(n, bn)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
+def _matmul_epilogue_2d(x, w, b, act):
+    return _matmul_epilogue_2d_fwd(x, w, b, act)[0]
+
+
+@_x32
+def _matmul_epilogue_2d_fwd(x, w, b, act):
+    m, k = x.shape
+    n = w.shape[1]
+    bm, bn, m_pad, n_pad = _me_blocks(m, k, n, x.dtype)
+    xp = _pad_dim(x, 0, m_pad)
+    wp = _pad_dim(w, 1, n_pad)
+    bp = _pad_dim(b.reshape(1, n), 1, n_pad)
+    with _kernel_span("matmul_epilogue", "fwd"):
+        out, z = pl.pallas_call(
+            functools.partial(_me_fwd_kernel, act=act),
+            grid=(m_pad // bm, n_pad // bn),
+            in_specs=[
+                pl.BlockSpec((bm, k), lambda i, j: (i, 0)),
+                pl.BlockSpec((k, bn), lambda i, j: (0, j)),
+                pl.BlockSpec((1, bn), lambda i, j: (0, j)),
+            ],
+            out_specs=[
+                pl.BlockSpec((bm, bn), lambda i, j: (i, j)),
+                pl.BlockSpec((bm, bn), lambda i, j: (i, j)),
+            ],
+            out_shape=[
+                jax.ShapeDtypeStruct((m_pad, n_pad), x.dtype),
+                jax.ShapeDtypeStruct((m_pad, n_pad), x.dtype),
+            ],
+            interpret=_interpret(),
+        )(xp, wp, bp)
+    return out[:m, :n], (x, w, b, z[:m, :n])
+
+
+@_x32
+def _matmul_epilogue_2d_bwd(act, res, g):
+    x, w, b, z = res
+    m, k = x.shape
+    n = w.shape[1]
+    bm, bn, m_pad, n_pad = _me_blocks(m, k, n, x.dtype)
+    zp = _pad_dim(_pad_dim(z, 0, m_pad), 1, n_pad)
+    gp = _pad_dim(_pad_dim(g, 0, m_pad), 1, n_pad)
+    with _kernel_span("matmul_epilogue", "bwd"):
+        dz_pad, db_acc = pl.pallas_call(
+            functools.partial(_me_bwd_kernel, act=act),
+            grid=(n_pad // bn, m_pad // bm),
+            in_specs=[
+                pl.BlockSpec((bm, bn), lambda j, i: (i, j)),
+                pl.BlockSpec((bm, bn), lambda j, i: (i, j)),
+            ],
+            out_specs=[
+                pl.BlockSpec((bm, bn), lambda j, i: (i, j)),
+                pl.BlockSpec((8, bn), lambda j, i: (0, j)),
+            ],
+            out_shape=[
+                jax.ShapeDtypeStruct((m_pad, n_pad), x.dtype),
+                jax.ShapeDtypeStruct((8, n_pad), jnp.float32),
+            ],
+            interpret=_interpret(),
+        )(zp, gp)
+    dz = dz_pad[:m, :n]
+    # dx / dw are plain matmuls XLA already schedules optimally — the
+    # fusion win is the epilogue, so hand these back to XLA
+    dx = jax.lax.dot_general(
+        dz, w, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32).astype(x.dtype)
+    dw = jax.lax.dot_general(
+        x, dz, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32).astype(w.dtype)
+    db = db_acc[0, :n].astype(b.dtype)
+    return dx, dw, db
+
+
+_matmul_epilogue_2d.defvjp(_matmul_epilogue_2d_fwd,
+                           _matmul_epilogue_2d_bwd)
+
+
+def fused_linear_act(x, w, b, act="none"):
+    """act(x @ w + b) with bias + activation fused into the matmul
+    consumer; differentiable.  x: [..., K]; w: [K, N]; b: [N]."""
+    if act not in ACTIVATIONS:
+        raise ValueError(f"act must be one of {ACTIVATIONS}, got {act!r}")
+    x, w, b = _demote_f64(x, w, b)
+    shape = x.shape
+    k = shape[-1]
+    n = w.shape[-1]
+    out = _matmul_epilogue_2d(x.reshape(-1, k), w, b.reshape(n), act)
+    return out.reshape(shape[:-1] + (n,))
+
+
+def matmul_epilogue_block_plan(m, k, n, dtype=jnp.float32,
+                               direction="fwd"):
+    """The exact block plan `_matmul_epilogue_2d_{fwd,bwd}` uses for
+    an (m, k) @ (k, n) problem.  Same contract as `flash_block_plan`."""
+    dtype = jnp.dtype(dtype)
+    f32 = jnp.dtype(jnp.float32)
+    bm, bn, m_pad, n_pad = _me_blocks(m, k, n, dtype)
+    out_blk = lambda name: (  # noqa: E731 - local table helper
+        name, (bm, bn), (m_pad, n_pad), dtype)
+    if direction == "fwd":
+        grid = (m_pad // bm, n_pad // bn)
+        operands = [
+            ("x", (bm, k), (m_pad, k), dtype),
+            ("w", (k, bn), (k, n_pad), dtype),
+            ("b", (1, bn), (1, n_pad), dtype),
+            out_blk("out"), out_blk("z"),
+        ]
+    elif direction == "bwd":
+        grid = (n_pad // bn, m_pad // bm)
+        operands = [
+            out_blk("z"), out_blk("g"), out_blk("dz"),
+            ("db", (8, bn), (8, n_pad), f32),
+        ]
+    else:
+        raise ValueError(f"direction must be fwd|bwd, got {direction!r}")
+    return {
+        "direction": direction,
+        "grid": grid,
+        "block_m": bm,
+        "block_n": bn,
+        "operands": operands,
+        "scratch": (),
+    }

@@ -114,6 +114,28 @@ def _probe_softmax_cross_entropy():
     jax.block_until_ready(fn(x))
 
 
+def _probe_layer_norm_residual():
+    from . import pallas_fused as pf
+    x = jnp.zeros((32, 256), jnp.bfloat16)
+    g = jnp.ones((256,), jnp.bfloat16)
+    fn = jax.jit(jax.grad(
+        lambda x, r, g, b: pf.fused_layer_norm_residual(
+            x, r, g, b).astype(jnp.float32).sum(), argnums=(0, 1, 2, 3)))
+    jax.block_until_ready(fn(x, x, g, g))
+
+
+def _probe_matmul_epilogue():
+    from . import pallas_fused as pf
+    x = jnp.zeros((32, 128), jnp.bfloat16)
+    w = jnp.ones((128, 256), jnp.bfloat16)
+    b = jnp.zeros((256,), jnp.bfloat16)
+    fn = jax.jit(jax.grad(
+        lambda x, w, b: pf.fused_linear_act(
+            x, w, b, "gelu_tanh").astype(jnp.float32).sum(),
+        argnums=(0, 1, 2)))
+    jax.block_until_ready(fn(x, w, b))
+
+
 def _probe_paged_attention():
     from . import pallas_kernels as pk
     q = jnp.zeros((2, 1, 2, 64), jnp.float32)
@@ -128,6 +150,8 @@ _PROBES = {
     "flash_attention": _probe_flash_attention,
     "paged_attention": _probe_paged_attention,
     "layer_norm": _probe_layer_norm,
+    "layer_norm_residual": _probe_layer_norm_residual,
+    "matmul_epilogue": _probe_matmul_epilogue,
     "rms_norm": _probe_rms_norm,
     "softmax_cross_entropy": _probe_softmax_cross_entropy,
 }
@@ -139,11 +163,27 @@ def _static_diagnose(kernel):
     violated (plan shapes mirror the _probe_* functions above)."""
     from ..analysis import tiling
     if kernel == "flash_attention":
-        return list(tiling.audit_flash_attention(
-            1, 128, 128, 1, 64, dtype=jnp.bfloat16, causal=True))
+        diags = []
+        for direction in ("fwd", "bwd_dq", "bwd_dkv"):
+            diags.extend(tiling.audit_flash_attention(
+                1, 128, 128, 1, 64, dtype=jnp.bfloat16, causal=True,
+                direction=direction))
+        return diags
     if kernel == "paged_attention":
         return list(tiling.audit_paged_attention(
             2, 64, 16, num_blocks=4, dtype=jnp.float32))
+    if kernel == "layer_norm_residual":
+        diags = []
+        for direction in ("fwd", "bwd"):
+            diags.extend(tiling.audit_layer_norm_residual(
+                32, 256, dtype=jnp.bfloat16, direction=direction))
+        return diags
+    if kernel == "matmul_epilogue":
+        diags = []
+        for direction in ("fwd", "bwd"):
+            diags.extend(tiling.audit_matmul_epilogue(
+                32, 128, 256, dtype=jnp.bfloat16, direction=direction))
+        return diags
     return []
 
 
@@ -151,7 +191,15 @@ def _run_probe(kernel: str) -> ProbeResult:
     """Execute the probe now and cache a diagnosed ProbeResult."""
     from ..analysis.diagnostics import Diagnostic, record
     try:
-        _PROBES[kernel]()
+        # Probe under x32.  The kernels trace their pallas_calls under
+        # disable_x64 (pallas_kernels._x32), but interpret-mode lowering
+        # of the grid loop happens at *call* time, where the framework's
+        # global x64 flag leaks i64 loop carries into the i32 kernel
+        # body and StableHLO rejects the mixed compare.  x32 at call
+        # time matches what the kernels actually compute.
+        from jax.experimental import disable_x64
+        with disable_x64():
+            _PROBES[kernel]()
         result = ProbeResult(kernel, True)
         _logger.info("pallas kernel %s: probe compile OK", kernel)
     except Exception as exc:

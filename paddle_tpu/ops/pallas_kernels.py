@@ -101,6 +101,19 @@ def _interpret() -> bool:
     return jax.default_backend() != "tpu"
 
 
+def _kernel_span(name: str, direction: str):
+    """Timeline span around one pallas_call build+dispatch.
+
+    Spans land in the ``kernel`` category so `phase_breakdown()` can
+    attribute step time per kernel and direction
+    (``kernel_<name>_<direction>_ms``).  The timeline returns a no-op
+    singleton when observability is disabled, so this costs one global
+    read on the hot path.
+    """
+    from ..observability.timeline import span
+    return span(f"kernel:{name}.{direction}", cat="kernel")
+
+
 def _round_up(x: int, m: int) -> int:
     return ((x + m - 1) // m) * m
 
@@ -111,7 +124,9 @@ def _pad_dim(x, dim, target, value=0.0):
         return x
     widths = [(0, 0)] * x.ndim
     widths[dim] = (0, pad)
-    return jnp.pad(x, widths, constant_values=value)
+    # dtype-matched fill: a python float is a strong f64 under the
+    # framework's global x64 mode and would promote the padded array
+    return jnp.pad(x, widths, constant_values=jnp.asarray(value, x.dtype))
 
 
 def _lanes(x2d):
@@ -285,7 +300,8 @@ def _flash_fwd(q, k, v, scale, causal, sq_real, sk_real, block_q, block_k):
     sk_pad = k.shape[1]
     offset = sk_real - sq_real  # causal alignment for cross-length attn
     grid = (bh, sq_pad // block_q)
-    out, lse = pl.pallas_call(
+    with _kernel_span("flash_attention", "fwd"):
+        out, lse = pl.pallas_call(
         functools.partial(_attn_fwd_kernel, scale=scale, causal=causal,
                           block_k=block_k, sk_real=sk_real, offset=offset),
         grid=grid,
@@ -323,7 +339,8 @@ def _flash_bwd(q, k, v, do, out, lse, scale, causal, sq_real, sk_real,
     row = jnp.arange(sq_pad)[None, :, None]
     empty = jnp.logical_or(row >= sq_real, lse <= _NEG_INF / 2)
     lse_safe = jnp.where(empty, jnp.float32(1e30), lse)
-    dq = pl.pallas_call(
+    with _kernel_span("flash_attention", "bwd_dq"):
+        dq = pl.pallas_call(
         functools.partial(_attn_bwd_dq_kernel, scale=scale, causal=causal,
                           block_k=block_k, sk_real=sk_real, offset=offset),
         grid=(bh, sq_pad // block_q),
@@ -339,7 +356,8 @@ def _flash_bwd(q, k, v, do, out, lse, scale, causal, sq_real, sk_real,
         out_shape=jax.ShapeDtypeStruct((bh, sq_pad, d), q.dtype),
         interpret=_interpret(),
     )(q, k, v, do, lse_safe, delta)
-    dk, dv = pl.pallas_call(
+    with _kernel_span("flash_attention", "bwd_dkv"):
+        dk, dv = pl.pallas_call(
         functools.partial(_attn_bwd_dkv_kernel, scale=scale, causal=causal,
                           block_q=block_q, sq_real=sq_real, offset=offset),
         grid=(bh, sk_pad // block_k),
@@ -442,14 +460,17 @@ def _pick_block(seq: int, which: int = 0, dtype=jnp.float32) -> int:
 
 
 def flash_block_plan(batch, seq_q, seq_k, heads, head_dim,
-                     dtype=jnp.float32):
-    """The exact forward block plan `_flash_fwd` uses for these shapes.
+                     dtype=jnp.float32, direction="fwd"):
+    """The exact block plan the flash kernels use for these shapes.
 
-    Returns grid, chosen block sizes, and per-operand
-    (name, block_shape, padded_array_shape, dtype) tuples in
-    pallas_call order — the input `analysis.tiling.check_pallas_call`
-    validates statically (and the gate uses to diagnose probe
-    failures).  Keep in lockstep with `_flash_fwd`'s specs.
+    ``direction`` selects the pallas_call being described: ``"fwd"``
+    (`_flash_fwd`), ``"bwd_dq"`` (the dq pass of `_flash_bwd`) or
+    ``"bwd_dkv"`` (its dk/dv pass).  Returns grid, chosen block sizes,
+    and per-operand (name, block_shape, padded_array_shape, dtype)
+    tuples in pallas_call order — the input
+    `analysis.tiling.check_pallas_call` validates statically (and the
+    gate uses to diagnose probe failures).  Keep in lockstep with the
+    kernel builders' specs.
     """
     dtype = jnp.dtype(dtype)
     block_q = _pick_block(seq_q, 0, dtype)
@@ -459,20 +480,50 @@ def flash_block_plan(batch, seq_q, seq_k, heads, head_dim,
     sk_pad = _round_up(seq_k, block_k)
     d = head_dim
     f32 = jnp.dtype(jnp.float32)
-    return {
-        "grid": (bh, sq_pad // block_q),
+    base = {
+        "direction": direction,
         "block_q": block_q,
         "block_k": block_k,
-        "operands": [
-            ("q", (1, block_q, d), (bh, sq_pad, d), dtype),
-            ("k", (1, sk_pad, d), (bh, sk_pad, d), dtype),
-            ("v", (1, sk_pad, d), (bh, sk_pad, d), dtype),
-            ("out", (1, block_q, d), (bh, sq_pad, d), dtype),
-            ("lse", (1, block_q, _STAT_LANES), (bh, sq_pad, _STAT_LANES),
-             f32),
-        ],
         "scratch": (),
     }
+    q_blk = ("q", (1, block_q, d), (bh, sq_pad, d), dtype)
+    q_full = ("q", (1, sq_pad, d), (bh, sq_pad, d), dtype)
+    k_blk = ("k", (1, block_k, d), (bh, sk_pad, d), dtype)
+    k_full = ("k", (1, sk_pad, d), (bh, sk_pad, d), dtype)
+    v_blk = ("v", (1, block_k, d), (bh, sk_pad, d), dtype)
+    v_full = ("v", (1, sk_pad, d), (bh, sk_pad, d), dtype)
+    stat_blk = lambda name: (  # noqa: E731 - local table helper
+        name, (1, block_q, _STAT_LANES), (bh, sq_pad, _STAT_LANES), f32)
+    stat_full = lambda name: (  # noqa: E731
+        name, (1, sq_pad, _STAT_LANES), (bh, sq_pad, _STAT_LANES), f32)
+    if direction == "fwd":
+        base["grid"] = (bh, sq_pad // block_q)
+        base["operands"] = [
+            q_blk, k_full, v_full,
+            ("out", (1, block_q, d), (bh, sq_pad, d), dtype),
+            stat_blk("lse"),
+        ]
+    elif direction == "bwd_dq":
+        base["grid"] = (bh, sq_pad // block_q)
+        base["operands"] = [
+            q_blk, k_full, v_full,
+            ("do", (1, block_q, d), (bh, sq_pad, d), dtype),
+            stat_blk("lse"), stat_blk("delta"),
+            ("dq", (1, block_q, d), (bh, sq_pad, d), dtype),
+        ]
+    elif direction == "bwd_dkv":
+        base["grid"] = (bh, sk_pad // block_k)
+        base["operands"] = [
+            q_full, k_blk, v_blk,
+            ("do", (1, sq_pad, d), (bh, sq_pad, d), dtype),
+            stat_full("lse"), stat_full("delta"),
+            ("dk", (1, block_k, d), (bh, sk_pad, d), dtype),
+            ("dv", (1, block_k, d), (bh, sk_pad, d), dtype),
+        ]
+    else:
+        raise ValueError(
+            f"direction must be fwd|bwd_dq|bwd_dkv, got {direction!r}")
+    return base
 
 
 def paged_block_plan(num_heads, head_dim, block_size, num_blocks=64,
@@ -620,7 +671,8 @@ def _fused_layer_norm_2d_fwd(x, gamma, beta, eps):
     br = _ln_block_rows(rows, n)
     rows_pad = _round_up(rows, br)
     xp = _pad_dim(x, 0, rows_pad)
-    out, mu, rstd = pl.pallas_call(
+    with _kernel_span("layer_norm", "fwd"):
+        out, mu, rstd = pl.pallas_call(
         functools.partial(_ln_fwd_kernel, eps=eps),
         grid=(rows_pad // br,),
         in_specs=[
@@ -652,7 +704,8 @@ def _fused_layer_norm_2d_bwd(eps, res, do):
     nb = rows_pad // br
     xp = _pad_dim(x, 0, rows_pad)
     dop = _pad_dim(do, 0, rows_pad)
-    dx, dg_acc, db_acc = pl.pallas_call(
+    with _kernel_span("layer_norm", "bwd"):
+        dx, dg_acc, db_acc = pl.pallas_call(
         _ln_bwd_kernel,
         grid=(nb,),
         in_specs=[
@@ -733,7 +786,8 @@ def _fused_rms_norm_2d_fwd(x, gamma, eps):
     br = _ln_block_rows(rows, n)
     rows_pad = _round_up(rows, br)
     xp = _pad_dim(x, 0, rows_pad)
-    out, rstd = pl.pallas_call(
+    with _kernel_span("rms_norm", "fwd"):
+        out, rstd = pl.pallas_call(
         functools.partial(_rms_fwd_kernel, eps=eps),
         grid=(rows_pad // br,),
         in_specs=[
@@ -762,7 +816,8 @@ def _fused_rms_norm_2d_bwd(eps, res, do):
     nb = rows_pad // br
     xp = _pad_dim(x, 0, rows_pad)
     dop = _pad_dim(do, 0, rows_pad)
-    dx, dg_acc = pl.pallas_call(
+    with _kernel_span("rms_norm", "bwd"):
+        dx, dg_acc = pl.pallas_call(
         _rms_bwd_kernel,
         grid=(nb,),
         in_specs=[
@@ -877,7 +932,8 @@ def _fused_xent_2d_fwd(logits, labels):
     xp = _pad_dim(_pad_dim(logits, 0, rows_pad), 1, v_pad,
                   value=_NEG_INF)
     lp = _lanes(_pad_dim(labels.astype(jnp.int32), 0, rows_pad, value=-1))
-    loss, lse = pl.pallas_call(
+    with _kernel_span("softmax_cross_entropy", "fwd"):
+        loss, lse = pl.pallas_call(
         functools.partial(_xent_fwd_kernel, block_v=bv),
         grid=(rows_pad // br, v_pad // bv),
         in_specs=[
@@ -912,7 +968,8 @@ def _fused_xent_2d_bwd(res, g):
     lp = _lanes(_pad_dim(labels.astype(jnp.int32), 0, rows_pad, value=-1))
     lsep = _pad_dim(lse, 0, rows_pad)
     gp = _lanes(_pad_dim(g.astype(jnp.float32), 0, rows_pad))
-    dx = pl.pallas_call(
+    with _kernel_span("softmax_cross_entropy", "bwd"):
+        dx = pl.pallas_call(
         functools.partial(_xent_bwd_kernel, block_v=bv),
         grid=(rows_pad // br, v_pad // bv),
         in_specs=[
@@ -1032,7 +1089,8 @@ def paged_attention(q, k_pool, v_pool, block_tables, context_lens,
     bt = block_tables.astype(jnp.int32)
     cl = context_lens.astype(jnp.int32)
 
-    out = pl.pallas_call(
+    with _kernel_span("paged_attention", "fwd"):
+        out = pl.pallas_call(
         functools.partial(_paged_attn_kernel, block_size=block_size,
                           scale=float(scale), w_last=W - 1),
         grid_spec=pltpu.PrefetchScalarGridSpec(

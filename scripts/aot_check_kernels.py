@@ -25,10 +25,13 @@ import jax
 import jax.numpy as jnp
 from jax.experimental import topologies
 
+import paddle_tpu.ops.pallas_fused as pf
 import paddle_tpu.ops.pallas_kernels as pk
 
 # lower the non-interpret (Mosaic) path even though we trace on CPU
+# (pallas_fused binds _interpret by value at import — patch both)
 pk._interpret = lambda: False
+pf._interpret = lambda: False
 
 TOPOLOGY = os.environ.get("PADDLE_TPU_AOT_TOPOLOGY", "v5e:2x2x1")
 topo = topologies.get_topology_desc(TOPOLOGY, "tpu")
@@ -79,6 +82,24 @@ for tag, (rows, n) in [("bert", (768, 768)), ("wide", (4096, 4096)),
         jax.grad(lambda x, g: pk.fused_rms_norm(
             x, g).astype(f32).sum(), argnums=(0, 1)), x, g)
 
+# fused layernorm+residual at transformer shapes
+for tag, (rows, n) in [("bert", (768, 768)), ("ragged", (100, 768))]:
+    x, g = ((rows, n), bf16), ((n,), bf16)
+    ok &= aot_compile(
+        f"ln_residual fwd+bwd {tag}",
+        jax.grad(lambda x, r, g, b: pf.fused_layer_norm_residual(
+            x, r, g, b).astype(f32).sum(), argnums=(0, 1, 2, 3)),
+        x, x, g, g)
+
+# matmul-epilogue fusion at BERT/GPT FFN shapes
+for tag, (m, k, n) in [("bert_ffn", (768, 768, 3072)),
+                       ("uneven", (300, 768, 640))]:
+    ok &= aot_compile(
+        f"matmul_epilogue fwd+bwd {tag}",
+        jax.grad(lambda x, w, b: pf.fused_linear_act(
+            x, w, b, "gelu_tanh").astype(f32).sum(), argnums=(0, 1, 2)),
+        ((m, k), bf16), ((k, n), bf16), ((n,), bf16))
+
 # softmax xent at LM-head shapes
 for tag, (rows, v) in [("bert", (768, 30522)), ("llama", (512, 32000))]:
     ok &= aot_compile(
@@ -100,11 +121,15 @@ def _ring_check():
     n_dev = len(topo.devices)
     mesh = Mesh(np.array(topo.devices).reshape(n_dev), ("sep",))
     spec = P(None, "sep", None, None)
-    fn = jax.shard_map(
-        functools.partial(ring_flash_attention_local, axis="sep",
-                          axis_size=n_dev, causal=True, scale=0.125),
-        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
-        check_vma=False)
+    body = functools.partial(ring_flash_attention_local, axis="sep",
+                             axis_size=n_dev, causal=True, scale=0.125)
+    if hasattr(jax, "shard_map"):
+        fn = jax.shard_map(body, mesh=mesh, in_specs=(spec, spec, spec),
+                           out_specs=spec, check_vma=False)
+    else:  # jax < 0.5: experimental API, check_rep instead of check_vma
+        from jax.experimental.shard_map import shard_map
+        fn = shard_map(body, mesh=mesh, in_specs=(spec, spec, spec),
+                       out_specs=spec, check_rep=False)
     qa = jax.ShapeDtypeStruct(
         (2, 512, 4, 64), bf16,
         sharding=jax.sharding.NamedSharding(mesh, spec))

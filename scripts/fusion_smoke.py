@@ -1,0 +1,75 @@
+#!/usr/bin/env python
+"""fusion_smoke: probe every gated Pallas kernel in interpret mode.
+
+    python scripts/fusion_smoke.py [--json]
+
+Force-probes each kernel registered with ``pallas_gate`` (flash
+attention, paged attention, layer_norm, layer_norm+residual,
+matmul-epilogue, rms_norm, softmax cross-entropy) — fwd AND bwd where
+the probe takes a grad — without needing a TPU, then prints the
+``probe_report()`` outcome and the per-kernel timing the
+``cat="kernel"`` spans recorded.  Exit code 1 iff any kernel fails its
+probe: a red run here means the same kernel would silently fall back
+to the XLA composite on hardware.  Runs in the tier-1 suite via
+tests/test_analysis.py (``perf`` marker).
+"""
+import argparse
+import json
+import os
+import sys
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def run(emit_json=False, out=sys.stdout):
+    from paddle_tpu import observability as obs
+    from paddle_tpu.ops import pallas_gate as pg
+
+    pg.reset_probe_cache()
+    timings = {}
+    with obs.enabled_scope():
+        for kernel in pg._PROBES:
+            t0 = time.time()
+            pg.probe_kernel(kernel, force=True)
+            timings[kernel] = round((time.time() - t0) * 1e3, 1)
+        phases = obs.phase_breakdown(obs.get_timeline().events())
+    report = pg.probe_report()
+    pg.reset_probe_cache()
+
+    kernel_phases = {k: v for k, v in phases.items()
+                     if k.startswith("kernel")}
+    ok = all(r.get("ok") for r in report.values())
+    if emit_json:
+        print(json.dumps({"ok": ok, "probes": report,
+                          "probe_wall_ms": timings,
+                          "kernel_phases": kernel_phases}, indent=2,
+                         default=str), file=out)
+    else:
+        for kernel, rec in report.items():
+            status = "OK" if rec.get("ok") else "FAIL"
+            line = f"[fusion_smoke] {kernel:<24} {status:<6} " \
+                   f"({timings[kernel]:.0f} ms)"
+            if not rec.get("ok"):
+                line += f"  {rec.get('error', '')[:120]}"
+            print(line, file=out)
+        print(f"[fusion_smoke] kernel spans: "
+              f"{kernel_phases.get('kernel_count', 0)} dispatches, "
+              f"{kernel_phases.get('kernel_ms', 0.0)} ms total",
+              file=out)
+    return ok, report
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--json", action="store_true",
+                    help="print machine-readable JSON instead of text")
+    args = ap.parse_args(argv)
+    ok, _ = run(emit_json=args.json)
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -138,7 +138,9 @@ def lint_gpt():
 
 
 def lint_pallas():
-    """Flash / paged attention block plans vs the Mosaic tiling rules."""
+    """Fused-suite block plans vs the Mosaic tiling rules: flash
+    attention (fwd + both backward passes), layernorm+residual and
+    matmul-epilogue fusion (fwd + bwd), paged decode attention."""
     import jax.numpy as jnp
     from paddle_tpu import analysis
     from paddle_tpu.analysis.diagnostics import DiagnosticReport, record
@@ -146,9 +148,17 @@ def lint_pallas():
     report = DiagnosticReport(label="pallas block plans")
     for dtype in (jnp.float32, jnp.bfloat16):
         for seq in (64, 128, 1024):
-            r = analysis.audit_flash_attention(
-                batch=1, seq_q=seq, seq_k=seq, heads=4, head_dim=64,
-                dtype=dtype, causal=True)
+            for direction in ("fwd", "bwd_dq", "bwd_dkv"):
+                r = analysis.audit_flash_attention(
+                    batch=1, seq_q=seq, seq_k=seq, heads=4, head_dim=64,
+                    dtype=dtype, causal=True, direction=direction)
+                report.extend(r.diagnostics)
+        for direction in ("fwd", "bwd"):
+            r = analysis.audit_layer_norm_residual(
+                512, 768, dtype=dtype, direction=direction)
+            report.extend(r.diagnostics)
+            r = analysis.audit_matmul_epilogue(
+                512, 768, 3072, dtype=dtype, direction=direction)
             report.extend(r.diagnostics)
     r = analysis.audit_paged_attention(num_heads=8, head_dim=64,
                                        block_size=16, num_blocks=64,

@@ -233,3 +233,217 @@ def test_paged_attention_kernel_matches_fallback():
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                atol=2e-5, rtol=2e-5)
     assert float(jnp.abs(out[2]).sum()) == 0.0
+
+
+# ---------------------------------------------------------------------
+# Fused training suite (pallas_fused + bf16 flash parity)
+# ---------------------------------------------------------------------
+from paddle_tpu.ops import pallas_fused as pf  # noqa: E402
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_attention_bf16_fwd_bwd(causal):
+    """bf16 parity fwd AND bwd vs the f32 reference (inputs rounded to
+    bf16 first so both paths see identical operands)."""
+    shape = (1, 48, 2, 32)
+    kq, kk, kv = jax.random.split(jax.random.PRNGKey(21), 3)
+    q = jax.random.normal(kq, shape, jnp.float32).astype(jnp.bfloat16)
+    k = jax.random.normal(kk, shape, jnp.float32).astype(jnp.bfloat16)
+    v = jax.random.normal(kv, shape, jnp.float32).astype(jnp.bfloat16)
+
+    out = pk.flash_attention(q, k, v, causal=causal)
+    ref = _sdpa_ref(q, k, v, causal, 1.0 / 32 ** 0.5)
+    assert out.dtype == jnp.bfloat16
+    np.testing.assert_allclose(
+        np.asarray(out, np.float32), np.asarray(ref, np.float32),
+        atol=3e-2, rtol=3e-2)
+
+    def f_pl(q, k, v):
+        o = pk.flash_attention(q, k, v, causal=causal)
+        return jnp.sum(o.astype(jnp.float32) ** 2)
+
+    def f_ref(q, k, v):
+        o = _sdpa_ref(q, k, v, causal, 1.0 / 32 ** 0.5)
+        return jnp.sum(o.astype(jnp.float32) ** 2)
+
+    g_pl = jax.grad(f_pl, argnums=(0, 1, 2))(q, k, v)
+    g_ref = jax.grad(f_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g_pl, g_ref):
+        np.testing.assert_allclose(
+            np.asarray(a, np.float32), np.asarray(b, np.float32),
+            atol=1e-1, rtol=6e-2)
+
+
+def _ln_res_ref(x, r, g, b, eps=1e-5):
+    """XLA reference with the kernel's semantics: residual add and
+    statistics in f32, output cast back to the input dtype."""
+    s = x.astype(jnp.float32) + r.astype(jnp.float32)
+    mu = jnp.mean(s, -1, keepdims=True)
+    var = jnp.mean(jnp.square(s - mu), -1, keepdims=True)
+    out = ((s - mu) * jax.lax.rsqrt(var + eps)).astype(x.dtype)
+    return out * g + b
+
+
+@pytest.mark.parametrize("dtype", [jnp.float32, jnp.bfloat16])
+def test_fused_layer_norm_residual(dtype):
+    kx, kr = jax.random.split(jax.random.PRNGKey(22))
+    x = (jax.random.normal(kx, (37, 96), jnp.float32) * 2).astype(dtype)
+    r = jax.random.normal(kr, (37, 96), jnp.float32).astype(dtype)
+    gamma = (jax.random.normal(jax.random.PRNGKey(23), (96,)) + 1
+             ).astype(dtype)
+    beta = jax.random.normal(jax.random.PRNGKey(24), (96,)).astype(dtype)
+
+    fwd_tol = 1e-5 if dtype == jnp.float32 else 6e-2
+    out = pf.fused_layer_norm_residual(x, r, gamma, beta, eps=1e-5)
+    ref = _ln_res_ref(x, r, gamma, beta)
+    assert out.dtype == dtype
+    np.testing.assert_allclose(
+        np.asarray(out, np.float32), np.asarray(ref, np.float32),
+        atol=fwd_tol, rtol=fwd_tol)
+
+    def loss_pl(x, r, g, b):
+        o = pf.fused_layer_norm_residual(x, r, g, b)
+        return jnp.sum(jnp.sin(o.astype(jnp.float32)))
+
+    def loss_ref(x, r, g, b):
+        return jnp.sum(jnp.sin(_ln_res_ref(x, r, g, b
+                                           ).astype(jnp.float32)))
+
+    gp = jax.grad(loss_pl, argnums=(0, 1, 2, 3))(x, r, gamma, beta)
+    gr = jax.grad(loss_ref, argnums=(0, 1, 2, 3))(x, r, gamma, beta)
+    atol, rtol = ((1e-4, 1e-4) if dtype == jnp.float32
+                  else (1.5e-1, 6e-2))
+    for a, b in zip(gp, gr):
+        np.testing.assert_allclose(
+            np.asarray(a, np.float32), np.asarray(b, np.float32),
+            atol=atol, rtol=rtol)
+
+
+def test_fused_layer_norm_residual_multiblock():
+    """rows > block_rows: the grid streams multiple row blocks and the
+    bwd dgamma/dbeta accumulator must sum across all of them."""
+    kx, kr = jax.random.split(jax.random.PRNGKey(25))
+    x = jax.random.normal(kx, (300, 256), jnp.float32)
+    r = jax.random.normal(kr, (300, 256), jnp.float32)
+    gamma = jax.random.normal(jax.random.PRNGKey(26), (256,)) + 1
+    beta = jax.random.normal(jax.random.PRNGKey(27), (256,))
+    out = pf.fused_layer_norm_residual(x, r, gamma, beta)
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(_ln_res_ref(x, r, gamma, beta)),
+        atol=1e-5, rtol=1e-5)
+    gp = jax.grad(lambda *a: jnp.sum(
+        pf.fused_layer_norm_residual(*a) ** 2),
+        argnums=(0, 1, 2, 3))(x, r, gamma, beta)
+    gr = jax.grad(lambda *a: jnp.sum(_ln_res_ref(*a) ** 2),
+                  argnums=(0, 1, 2, 3))(x, r, gamma, beta)
+    for a, b in zip(gp, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=1e-3, rtol=1e-4)
+
+
+def _linear_act_ref(x, w, b, act):
+    z = (x.astype(jnp.float32) @ w.astype(jnp.float32)
+         + b.astype(jnp.float32))
+    if act == "relu":
+        z = jax.nn.relu(z)
+    elif act == "gelu":
+        z = jax.nn.gelu(z, approximate=False)
+    elif act == "gelu_tanh":
+        z = jax.nn.gelu(z, approximate=True)
+    elif act == "silu":
+        z = jax.nn.silu(z)
+    return z.astype(x.dtype)
+
+
+@pytest.mark.parametrize("act", pf.ACTIVATIONS)
+def test_matmul_epilogue(act):
+    kx, kw, kb = jax.random.split(jax.random.PRNGKey(28), 3)
+    x = jax.random.normal(kx, (40, 96), jnp.float32)
+    w = jax.random.normal(kw, (96, 64), jnp.float32) * 0.1
+    b = jax.random.normal(kb, (64,), jnp.float32)
+    out = pf.fused_linear_act(x, w, b, act)
+    ref = _linear_act_ref(x, w, b, act)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-4, rtol=2e-4)
+    gp = jax.grad(lambda *a: jnp.sum(pf.fused_linear_act(*a, act) ** 2),
+                  argnums=(0, 1, 2))(x, w, b)
+    gr = jax.grad(lambda *a: jnp.sum(_linear_act_ref(*a, act) ** 2),
+                  argnums=(0, 1, 2))(x, w, b)
+    for a, b_ in zip(gp, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                   atol=2e-3, rtol=2e-4)
+
+
+def test_matmul_epilogue_bf16_multiblock():
+    """bf16 + shapes past one (block_m, block_n) tile: grid streaming,
+    db accumulation across the minor m axis, z saved in bf16."""
+    kx, kw, kb = jax.random.split(jax.random.PRNGKey(29), 3)
+    x = jax.random.normal(kx, (300, 128), jnp.float32
+                          ).astype(jnp.bfloat16)
+    w = (jax.random.normal(kw, (128, 640), jnp.float32) * 0.1
+         ).astype(jnp.bfloat16)
+    b = jax.random.normal(kb, (640,), jnp.float32).astype(jnp.bfloat16)
+    out = pf.fused_linear_act(x, w, b, "gelu_tanh")
+    ref = _linear_act_ref(x, w, b, "gelu_tanh")
+    assert out.dtype == jnp.bfloat16
+    np.testing.assert_allclose(
+        np.asarray(out, np.float32), np.asarray(ref, np.float32),
+        atol=6e-2, rtol=6e-2)
+    gp = jax.grad(lambda *a: jnp.sum(
+        pf.fused_linear_act(*a, "gelu_tanh").astype(jnp.float32)),
+        argnums=(0, 1, 2))(x, w, b)
+    gr = jax.grad(lambda *a: jnp.sum(
+        _linear_act_ref(*a, "gelu_tanh").astype(jnp.float32)),
+        argnums=(0, 1, 2))(x, w, b)
+    for a, b_ in zip(gp, gr):
+        np.testing.assert_allclose(
+            np.asarray(a, np.float32), np.asarray(b_, np.float32),
+            atol=1.5e-1, rtol=6e-2)
+
+
+def test_grad_through_fused_transformer_block():
+    """jax.grad through a full post-norm transformer block built from
+    the fused suite (flash attention → LN+residual → matmul-epilogue
+    FFN → LN+residual) vs the same block from XLA composites."""
+    B, S, H, D, FF = 1, 32, 2, 16, 64
+    E = H * D
+    keys = jax.random.split(jax.random.PRNGKey(30), 8)
+    x = jax.random.normal(keys[0], (B, S, E), jnp.float32)
+    w_qkv = jax.random.normal(keys[1], (E, 3 * E)) * 0.1
+    w_o = jax.random.normal(keys[2], (E, E)) * 0.1
+    w1 = jax.random.normal(keys[3], (E, FF)) * 0.1
+    b1 = jax.random.normal(keys[4], (FF,)) * 0.1
+    w2 = jax.random.normal(keys[5], (FF, E)) * 0.1
+    g1 = jax.random.normal(keys[6], (E,)) + 1
+    g2 = jax.random.normal(keys[7], (E,)) + 1
+    z1 = jnp.zeros((E,))
+
+    def block(x, w_qkv, w_o, w1, b1, w2, g1, g2, fused):
+        qkv = x @ w_qkv
+        q, k, v = jnp.split(qkv.reshape(B, S, H, 3 * D), 3, axis=-1)
+        if fused:
+            a = pk.flash_attention(q, k, v, causal=True)
+        else:
+            a = _sdpa_ref(q, k, v, True, 1.0 / D ** 0.5)
+        a = a.reshape(B, S, E) @ w_o
+        if fused:
+            h = pf.fused_layer_norm_residual(a, x, g1, z1)
+            f = pf.fused_linear_act(h, w1, b1, "gelu_tanh") @ w2
+            return pf.fused_layer_norm_residual(f, h, g2, z1)
+        h = _ln_res_ref(a, x, g1, z1)
+        f = _linear_act_ref(h, w1, b1, "gelu_tanh") @ w2
+        return _ln_res_ref(f, h, g2, z1)
+
+    params = (x, w_qkv, w_o, w1, b1, w2, g1, g2)
+    out_f = block(*params, True)
+    out_r = block(*params, False)
+    np.testing.assert_allclose(np.asarray(out_f), np.asarray(out_r),
+                               atol=1e-4, rtol=1e-4)
+    loss = lambda *p, fused: jnp.sum(block(*p, fused) ** 2)  # noqa: E731
+    gf = jax.grad(lambda *p: loss(*p, fused=True),
+                  argnums=tuple(range(8)))(*params)
+    gr = jax.grad(lambda *p: loss(*p, fused=False),
+                  argnums=tuple(range(8)))(*params)
+    for a, b in zip(gf, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=2e-3, rtol=2e-4)
