@@ -632,8 +632,10 @@ def bench_gpt(on_tpu, peak):
 
 # ---------------------------------------------------------------------
 # Serving: continuous-batching decode through the paged KV cache
-# (GenerationEngine) — headline tokens/sec of a mixed-length greedy
-# burst plus the median prefill latency from the recorded timeline
+# (GenerationEngine) — headline tokens/sec of a 16-request greedy burst
+# sharing one system prompt (the multi-tenant trace of ROADMAP item 2),
+# plus median prefill latency, median TTFT, and the COW prefix-cache
+# hit rate of the timed burst
 # ---------------------------------------------------------------------
 def bench_gpt_decode(on_tpu):
     import numpy as np
@@ -646,19 +648,22 @@ def bench_gpt_decode(on_tpu):
         cfg = GPTConfig(hidden_size=1024, num_hidden_layers=24,
                         num_attention_heads=16, use_flash_attention=True,
                         max_position_embeddings=1024)
-        n_req, max_new, max_batch, max_prompt = 16, 64, 8, 256
+        n_req, max_new, max_batch = 16, 64, 8
+        shared_len, tail_max = 512, 64
     else:
         cfg = GPTConfig(vocab_size=256, hidden_size=128,
                         num_hidden_layers=2, num_attention_heads=2,
                         use_flash_attention=False,
                         max_position_embeddings=128)
-        n_req, max_new, max_batch, max_prompt = 8, 16, 4, 48
+        n_req, max_new, max_batch = 8, 16, 4
+        shared_len, tail_max = 32, 16
     paddle.seed(0)
     model = GPTForCausalLM(cfg)
     model.eval()
     rng = np.random.default_rng(0)
-    prompts = [list(rng.integers(
-        1, cfg.vocab_size, size=int(rng.integers(4, max_prompt))))
+    shared = list(rng.integers(1, cfg.vocab_size, size=shared_len))
+    prompts = [shared + list(rng.integers(
+        1, cfg.vocab_size, size=int(rng.integers(4, tail_max))))
         for _ in range(n_req)]
     eng = GenerationEngine(model, max_batch=max_batch,
                            max_model_len=cfg.max_position_embeddings)
@@ -666,22 +671,38 @@ def bench_gpt_decode(on_tpu):
         t = time.time()
         eng.generate(prompts, max_new_tokens=max_new)  # compiles
         log(f"gpt_decode: compile+first burst {time.time() - t:.1f}s "
-            f"({eng.stats()['prefill_compiles']} prefill + "
-            f"{eng.stats()['decode_compiles']} decode programs)")
+            f"({eng.stats()['step_compiles']} unified step program(s))")
         obs.get_timeline().clear()
+        hit0 = eng.cache._hit_tokens
+        look0 = eng.cache._lookup_tokens
         t = time.time()
-        eng.generate(prompts, max_new_tokens=max_new)
+        ids = [eng.add_request(p, max_new_tokens=max_new)
+               for p in prompts]
+        while eng.has_unfinished():
+            eng.step()
         dt = time.time() - t
         tokens_per_sec = n_req * max_new / dt
         pf = sorted(e.dur for e in obs.get_timeline().events()
                     if e.cat == "prefill" and e.dur is not None)
         prefill_ms = pf[len(pf) // 2] * 1e3 if pf else 0.0
+        ttfts = sorted(
+            (r.t_first_token - r.t_submit) * 1e3
+            for r in (eng._results[i] for i in ids)
+            if r.t_first_token is not None and r.t_submit is not None)
+        ttft_ms = ttfts[len(ttfts) // 2] if ttfts else 0.0
+        hit_rate = ((eng.cache._hit_tokens - hit0)
+                    / max(1, eng.cache._lookup_tokens - look0))
         s = eng.stats()
-        log(f"gpt_decode: {n_req} reqs x {max_new} tok in {dt:.2f}s "
-            f"{tokens_per_sec:,.0f} tok/s, prefill {prefill_ms:.1f} ms, "
+        log(f"gpt_decode: {n_req} reqs ({shared_len}-tok shared prefix) "
+            f"x {max_new} tok in {dt:.2f}s {tokens_per_sec:,.0f} tok/s, "
+            f"prefill {prefill_ms:.1f} ms, ttft {ttft_ms:.1f} ms, "
+            f"prefix hit rate {hit_rate:.0%}, "
             f"kv high-water {s['high_water']}/{s['num_blocks']}")
         return {"tokens_per_sec": round(tokens_per_sec, 1),
                 "prefill_ms": round(prefill_ms, 2),
+                "ttft_ms": round(ttft_ms, 2),
+                "prefix_hit_rate": round(hit_rate, 4),
+                "shared_prefix_len": shared_len,
                 "n_requests": n_req, "max_new_tokens": max_new,
                 "max_batch": max_batch,
                 "kv_high_water": s["high_water"],
@@ -1052,6 +1073,9 @@ def main():
                 res["tokens_per_sec"]
             payload["extra_metrics"]["gpt_prefill_ms"] = \
                 res["prefill_ms"]
+            payload["extra_metrics"]["gpt_ttft_ms"] = res["ttft_ms"]
+            payload["extra_metrics"]["gpt_prefix_hit_rate"] = \
+                res["prefix_hit_rate"]
             payload["extra_metrics"]["gpt_decode_kv_high_water"] = \
                 res["kv_high_water"]
         elif name == "llama":

@@ -25,8 +25,9 @@ from .recompile import (audit_eager_cache, audit_executor_cache,
                         audit_trace_cache, audit_weak_types)
 from .tiling import (LANE, VMEM_BYTES, audit_flash_attention,
                      audit_layer_norm_residual, audit_matmul_epilogue,
-                     audit_paged_attention, check_block_spec,
-                     check_pallas_call, estimate_vmem_bytes, min_tile)
+                     audit_paged_attention, audit_ragged_attention,
+                     check_block_spec, check_pallas_call,
+                     estimate_vmem_bytes, min_tile)
 
 __all__ = [
     "CODES", "ERROR", "INFO", "LANE", "SEVERITIES", "VMEM_BYTES",
@@ -34,7 +35,8 @@ __all__ = [
     "analyze_runtime", "analyze_traced", "audit_eager_cache",
     "audit_executor_cache", "audit_flash_attention", "audit_host_sync",
     "audit_jaxpr", "audit_layer_norm_residual", "audit_matmul_epilogue",
-    "audit_paged_attention", "audit_trace_cache",
+    "audit_paged_attention", "audit_ragged_attention",
+    "audit_trace_cache",
     "audit_weak_types", "check_block_spec", "check_collective_payload",
     "check_pallas_call", "describe_code", "diagnostics", "dtype_audit",
     "estimate_vmem_bytes", "get_log", "host_sync", "iter_eqns",

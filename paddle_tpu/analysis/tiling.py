@@ -24,7 +24,8 @@ from .diagnostics import Diagnostic, DiagnosticReport
 __all__ = ["LANE", "VMEM_BYTES", "min_tile", "check_block_spec",
            "check_pallas_call", "estimate_vmem_bytes",
            "audit_flash_attention", "audit_paged_attention",
-           "audit_layer_norm_residual", "audit_matmul_epilogue"]
+           "audit_ragged_attention", "audit_layer_norm_residual",
+           "audit_matmul_epilogue"]
 
 LANE = 128
 # per-core VMEM; Mosaic needs headroom for double buffering, so the
@@ -216,5 +217,23 @@ def audit_paged_attention(num_heads, head_dim, block_size, num_blocks=64,
         plan["operands"], scratch=plan.get("scratch", ()),
         site=f"paged_attention[{np.dtype(dtype).name} H={num_heads} "
              f"D={head_dim} bs={block_size}]")
+    report.plan = plan
+    return report
+
+
+def audit_ragged_attention(num_heads, head_dim, block_size,
+                           num_q_blocks=4, block_q=None, num_blocks=64,
+                           table_width=8, dtype="float32"):
+    """Statically validate the ragged mixed prefill+decode attention
+    block plan (see ``ops.pallas_ragged.ragged_block_plan``)."""
+    from ..ops.pallas_ragged import ragged_block_plan
+    plan = ragged_block_plan(num_heads, head_dim, block_size,
+                             num_q_blocks=num_q_blocks, block_q=block_q,
+                             num_blocks=num_blocks,
+                             table_width=table_width, dtype=dtype)
+    report = check_pallas_call(
+        plan["operands"], scratch=plan.get("scratch", ()),
+        site=f"ragged_attention[{np.dtype(dtype).name} H={num_heads} "
+             f"D={head_dim} bs={block_size} bq={plan['block_q']}]")
     report.plan = plan
     return report

@@ -1,22 +1,29 @@
-"""LLM serving: paged KV cache, continuous batching, generation engine.
+"""LLM serving: paged KV cache with COW prefix caching, chunked-prefill
+continuous batching, and the unified ragged generation engine.
 
 The multi-request generation layer over models/gpt.py — see
 README.md §"Serving".  Entry point: ``GenerationEngine``.
 """
-from .kv_cache import (ENV_KV_BLOCK_SIZE, RESIDENT_NAME, PagedKVCache,
-                       kv_block_size)
-from .attention import (PagedCacheView, PagedLayerCache, kv_cache_scatter,
-                        paged_attention)
-from .scheduler import (ENV_MAX_BATCH, ContinuousBatchingScheduler,
-                        Request, bucket_for, length_buckets,
-                        max_batch_size)
-from .engine import GenerationEngine, serving_sample_next
+from .kv_cache import (ENV_KV_BLOCK_SIZE, ENV_PREFIX_CACHE,
+                       RESIDENT_NAME, PagedKVCache, kv_block_size,
+                       prefix_cache_enabled)
+from .attention import (PagedCacheView, PagedLayerCache,
+                        RaggedCacheView, RaggedLayerCache,
+                        kv_cache_scatter, paged_attention,
+                        ragged_attention)
+from .scheduler import (ENV_MAX_BATCH, ENV_PREFILL_CHUNK,
+                        ContinuousBatchingScheduler, PrefillChunk,
+                        Request, max_batch_size, prefill_chunk_size)
+from .engine import (GenerationEngine, ragged_sample_next,
+                     serving_sample_next)
 
 __all__ = [
-    "ENV_KV_BLOCK_SIZE", "RESIDENT_NAME", "PagedKVCache", "kv_block_size",
-    "PagedCacheView", "PagedLayerCache", "kv_cache_scatter",
-    "paged_attention",
-    "ENV_MAX_BATCH", "ContinuousBatchingScheduler", "Request",
-    "bucket_for", "length_buckets", "max_batch_size",
-    "GenerationEngine", "serving_sample_next",
+    "ENV_KV_BLOCK_SIZE", "ENV_PREFIX_CACHE", "RESIDENT_NAME",
+    "PagedKVCache", "kv_block_size", "prefix_cache_enabled",
+    "PagedCacheView", "PagedLayerCache", "RaggedCacheView",
+    "RaggedLayerCache", "kv_cache_scatter", "paged_attention",
+    "ragged_attention",
+    "ENV_MAX_BATCH", "ENV_PREFILL_CHUNK", "ContinuousBatchingScheduler",
+    "PrefillChunk", "Request", "max_batch_size", "prefill_chunk_size",
+    "GenerationEngine", "ragged_sample_next", "serving_sample_next",
 ]

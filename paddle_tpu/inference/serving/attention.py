@@ -39,8 +39,9 @@ import jax.numpy as jnp
 from ...core.dispatch import dispatch
 from ...core.tensor import Tensor
 
-__all__ = ["kv_cache_scatter", "paged_attention", "PagedCacheView",
-           "PagedLayerCache"]
+__all__ = ["kv_cache_scatter", "paged_attention", "ragged_attention",
+           "PagedCacheView", "PagedLayerCache", "RaggedCacheView",
+           "RaggedLayerCache"]
 
 _NEG_INF = -1e30
 
@@ -136,6 +137,101 @@ def paged_attention(q, k_pool, v_pool, block_tables, context_lens,
 
 
 # ---------------------------------------------------------------------
+# ragged mixed prefill+decode attention (one flat token buffer)
+# ---------------------------------------------------------------------
+def _ragged_ref(q, k_pool, v_pool, block_tables, context_lens, seq_ids,
+                q_starts, q_valids, block_q, scale):
+    """Pure-XLA segment-gather fallback for `ragged_paged_attention`.
+
+    q: [T, H, D] flat block-aligned ragged queries (see
+    ops/pallas_ragged.py for the seq_ids/q_starts/q_valids layout;
+    ``seq_ids == S`` is the null segment).  Mirrors `_paged_ref`'s
+    numerics op-for-op (f32 score einsum, -1e30 mask, f32 softmax,
+    any_visible zeroing, f32 output einsum) with per-segment causal
+    masking; a fully masked row emits exact zeros."""
+    T, H, D = q.shape
+    nb, _, bs, _ = k_pool.shape
+    S, W = block_tables.shape
+    nqb = T // block_q
+    # null-segment row: zero table (pad block) + zero context
+    bt = jnp.concatenate([block_tables.astype(jnp.int32),
+                          jnp.zeros((1, W), jnp.int32)], axis=0)
+    cl = jnp.concatenate([context_lens.astype(jnp.int32),
+                          jnp.zeros((1,), jnp.int32)], axis=0)
+    sid = seq_ids.astype(jnp.int32)
+    bt_q = bt[sid]                                 # [nqb, W]
+    k = k_pool[bt_q]                               # [nqb, W, H, bs, D]
+    k = jnp.moveaxis(k, 2, 1).reshape(nqb, H, W * bs, D)
+    v = v_pool[bt_q]
+    v = jnp.moveaxis(v, 2, 1).reshape(nqb, H, W * bs, D)
+    qt = jnp.swapaxes(q.reshape(nqb, block_q, H, D), 1, 2)
+    scores = jnp.einsum("nhqd,nhkd->nhqk", qt, k,
+                        preferred_element_type=jnp.float32) * scale
+    row = jnp.arange(block_q, dtype=jnp.int32)
+    col = jnp.arange(W * bs, dtype=jnp.int32)
+    pos = q_starts.astype(jnp.int32)[:, None] + row[None, :]
+    visible = ((row[None, :, None] < q_valids.astype(jnp.int32)
+                [:, None, None])
+               & (col[None, None, :] <= pos[:, :, None])
+               & (col[None, None, :] < cl[sid][:, None, None]))
+    scores = jnp.where(visible[:, None, :, :], scores,
+                       jnp.asarray(_NEG_INF, scores.dtype))
+    probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+    any_visible = jnp.any(scores > -1e29, axis=-1, keepdims=True)
+    probs = jnp.where(any_visible, probs, jnp.zeros((), probs.dtype))
+    out = jnp.einsum("nhqk,nhkd->nhqd", probs, v,
+                     preferred_element_type=jnp.float32).astype(q.dtype)
+    return jnp.swapaxes(out, 1, 2).reshape(T, H, D)
+
+
+def _ragged_attention_impl(q, k_pool, v_pool, block_tables,
+                           context_lens, seq_ids, q_starts, q_valids,
+                           *, block_q, scale, use_pallas):
+    if use_pallas:
+        from ...ops.pallas_ragged import ragged_paged_attention as _krn
+        out = _krn(q[0], k_pool, v_pool, block_tables, context_lens,
+                   seq_ids, q_starts, q_valids, block_q=block_q,
+                   scale=scale)
+    else:
+        out = _ragged_ref(q[0], k_pool, v_pool, block_tables,
+                          context_lens, seq_ids, q_starts, q_valids,
+                          block_q, scale)
+    return out[None]
+
+
+def _use_pallas_ragged(head_dim, block_size, dtype, block_q):
+    jd = jnp.dtype(dtype)
+    if jd not in (jnp.dtype(jnp.float32), jnp.dtype(jnp.bfloat16)):
+        return False
+    if head_dim > 256 or block_size % 8 != 0:
+        return False
+    from ...ops.pallas_kernels import _min_rows
+    if block_q % _min_rows(jd):
+        return False
+    from ...ops.pallas_gate import pallas_enabled
+    return pallas_enabled("ragged_attention")
+
+
+def ragged_attention(q, k_pool, v_pool, block_tables, context_lens,
+                     seq_ids, q_starts, q_valids, block_q, scale=None):
+    """Mixed prefill+decode attention for q [1, T, H, D] over paged
+    K/V, where T packs every scheduled token of a serving step into
+    block-aligned ragged segments (ops/pallas_ragged.py)."""
+    head_dim = q.shape[-1]
+    if scale is None:
+        scale = 1.0 / math.sqrt(head_dim)
+    kv = k_pool._value if isinstance(k_pool, Tensor) else k_pool
+    use_pallas = _use_pallas_ragged(head_dim, kv.shape[2], kv.dtype,
+                                    int(block_q))
+    return dispatch("ragged_paged_attention", _ragged_attention_impl,
+                    (q, k_pool, v_pool, block_tables, context_lens,
+                     seq_ids, q_starts, q_valids),
+                    dict(block_q=int(block_q), scale=float(scale),
+                         use_pallas=use_pallas),
+                    differentiable=False)
+
+
+# ---------------------------------------------------------------------
 # the model-facing cache adapter
 # ---------------------------------------------------------------------
 class PagedLayerCache:
@@ -224,3 +320,89 @@ class PagedCacheView:
             return tensor
         tensor._value = val
         return tensor
+
+
+class RaggedLayerCache:
+    """One layer's view of the ragged mixed-batch step."""
+
+    __slots__ = ("_view", "_layer")
+
+    def __init__(self, view, layer):
+        self._view = view
+        self._layer = layer
+
+    def attend(self, q, k, v, use_flash=True):
+        """Scatter this step's K/V into the pool, then run ragged
+        attention over every segment — prefill chunks and decode rows
+        share one kernel call.  q/k/v: [1, T, H, D] Tensors."""
+        view = self._view
+        k_pool, v_pool = view.cache.layer_pools(self._layer)
+        new_k, new_v = kv_cache_scatter(k_pool, v_pool, k, v,
+                                        view.slot_mapping)
+        k_pool._inplace_update(new_k._value)
+        v_pool._inplace_update(new_v._value)
+        return ragged_attention(q, new_k, new_v, view.block_tables,
+                                view.context_lens, view.seq_ids,
+                                view.q_starts, view.q_valids,
+                                view.block_q)
+
+
+class RaggedCacheView:
+    """Adapts PagedKVCache to the model for the unified ragged step.
+
+    Same value-swap staging contract as `PagedCacheView` (one set of
+    driving Tensors, re-read by the single compiled executable every
+    dispatch), extended with the per-q-block segment descriptors and
+    the per-sequence sampling indices the engine's in-graph sampler
+    reads (``last_index`` into the flat token dim, ``sample_pos``
+    absolute positions for schedule-invariant keys).
+    """
+
+    mode = "ragged"
+
+    def __init__(self, cache, block_q):
+        self.cache = cache
+        self.block_q = int(block_q)
+        self.slot_mapping = None   # [T] int32 flat pool slots
+        self.block_tables = None   # [S, W] int32
+        self.context_lens = None   # [S] int32
+        self.position_ids = None   # [1, T] int64 absolute positions
+        self.seq_ids = None        # [T // block_q] int32 (S = null)
+        self.q_starts = None       # [T // block_q] int32
+        self.q_valids = None       # [T // block_q] int32
+        self.last_index = None     # [S] int32 flat sampling index
+        self.sample_pos = None     # [S] int64 absolute sampling pos
+        self._layers = [RaggedLayerCache(self, i)
+                        for i in range(cache.num_layers)]
+
+    def __getitem__(self, layer):
+        return self._layers[layer]
+
+    def __len__(self):
+        return len(self._layers)
+
+    def set_inputs(self, slot_mapping, block_tables, context_lens,
+                   position_ids, seq_ids, q_starts, q_valids,
+                   last_index, sample_pos):
+        """Stage this step's driving arrays (shapes fixed for the
+        lifetime of the engine — ONE compiled executable)."""
+        self.slot_mapping = self._stage(
+            "slot_mapping", self.slot_mapping, slot_mapping, jnp.int32)
+        self.block_tables = self._stage(
+            "block_tables", self.block_tables, block_tables, jnp.int32)
+        self.context_lens = self._stage(
+            "context_lens", self.context_lens, context_lens, jnp.int32)
+        self.position_ids = self._stage(
+            "position_ids", self.position_ids, position_ids, jnp.int64)
+        self.seq_ids = self._stage(
+            "seq_ids", self.seq_ids, seq_ids, jnp.int32)
+        self.q_starts = self._stage(
+            "q_starts", self.q_starts, q_starts, jnp.int32)
+        self.q_valids = self._stage(
+            "q_valids", self.q_valids, q_valids, jnp.int32)
+        self.last_index = self._stage(
+            "last_index", self.last_index, last_index, jnp.int32)
+        self.sample_pos = self._stage(
+            "sample_pos", self.sample_pos, sample_pos, jnp.int64)
+
+    _stage = PagedCacheView._stage

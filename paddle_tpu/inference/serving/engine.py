@@ -1,31 +1,41 @@
 """GenerationEngine: multi-request LLM serving over the paged KV cache.
 
-Drives ``models/gpt.py`` as a continuous-batching server:
+Drives ``models/gpt.py`` as a continuous-batching server around ONE
+unified ragged step program:
 
-  * two ``jit.to_static`` step families — a batch-1 **prefill** per
-    power-of-two length bucket and ONE fixed-shape ``[max_batch, 1]``
-    **decode** — so a mixed-length workload compiles at most
-    ``len(buckets) + 1`` executables.  The paged cache's driving arrays
-    (slot mapping, block tables, context lengths, positions) are
-    read-only state Tensors whose values the engine swaps before every
-    call; the pool tensors are mutated state (donated, updated in
-    place);
-  * sampling happens **in-graph** (``serving_sample_next``): greedy
+  * a single ``jit.to_static`` **step** over a fixed
+    ``[1, token_budget]`` flat token buffer packs at most one prefill
+    *chunk* plus every decode row into the same executable
+    (ops/pallas_ragged.py) — the PR-5 pow2 prefill-bucket compile
+    family is retired, so a mixed workload compiles ~1–2 programs
+    total instead of ``len(buckets) + 1``.  The ragged cache view's
+    driving arrays (slot mapping, block tables, context lengths,
+    segment descriptors, sampling indices) are read-only state Tensors
+    whose values the engine swaps before every call; the pool tensors
+    are mutated state (donated, updated in place);
+  * **prefix caching**: admission consults the COW prefix index
+    (kv_cache.py) — a request sharing an already-cached prompt prefix
+    starts prefill at the first uncached block, and each landed chunk
+    commits its full blocks back to the index;
+  * sampling happens **in-graph** (``ragged_sample_next``): greedy
     argmax, temperature, per-request top-k and top-p, with each draw
     keyed by ``fold_in(PRNGKey(request.seed), absolute_position)`` —
-    deterministic under any schedule, batch packing, or preemption;
-  * the decode loop never blocks the host: next-step input ids are the
-    previous step's device-side output array (no host read), and
-    results drain lazily ``pipeline_depth - 1`` steps behind dispatch
-    through the PR-4 in-flight window;
-  * observability: ``prefill`` / ``decode`` timeline lanes, and
-    ``serving.tokens_per_sec`` / ``serving.kv_blocks_in_use`` /
+    deterministic under any schedule, chunking, batch packing, or
+    preemption;
+  * the step loop never blocks the host: decode input ids are the
+    previous step's device-side output array (an eager device scatter,
+    no host read), and results drain lazily ``pipeline_depth - 1``
+    steps behind dispatch through the PR-4 in-flight window;
+  * observability: ``prefill:chunk`` / ``decode`` timeline lanes, and
+    ``serving.tokens_per_sec`` / ``serving.ttft_ms`` /
+    ``serving.prefix_hit_rate`` / ``serving.kv_blocks_shared`` /
     ``serving.queue_depth`` metrics.
 
 See README.md §"Serving" for usage and knobs.
 """
 from __future__ import annotations
 
+import contextlib
 import time
 
 import jax
@@ -38,29 +48,23 @@ from ...core.tensor import Tensor
 from ...core.autograd import no_grad
 from ...core.pipeline import pipeline_depth
 from ...incubate.nn.functional import _nucleus_mask
+from ...ops.pallas_ragged import ragged_q_block
 from .kv_cache import PagedKVCache
-from .attention import PagedCacheView
-from .scheduler import (ContinuousBatchingScheduler, Request, bucket_for,
-                        max_batch_size)
+from .attention import RaggedCacheView
+from .scheduler import (ContinuousBatchingScheduler, Request,
+                        max_batch_size, prefill_chunk_size)
 
-__all__ = ["GenerationEngine", "serving_sample_next"]
+__all__ = ["GenerationEngine", "serving_sample_next",
+           "ragged_sample_next"]
 
 
 # ---------------------------------------------------------------------
 # in-graph sampling
 # ---------------------------------------------------------------------
-def _sample_next_impl(logits, last_index, seeds, positions, do_sample,
-                      top_k, top_p, temperature):
-    """logits [B, S, V] -> next token [B] int64.
-
-    Row r reads logits[r, last_index[r]]; greedy rows take the argmax;
-    sampling rows apply temperature -> top-k -> top-p (the dense
-    baseline's filter order) and draw with a key folded from
-    (seed, absolute position) so the result does not depend on how the
-    scheduler packed or when it ran this row."""
-    B, S, V = logits.shape
-    rows = jnp.arange(B)
-    z = logits[rows, last_index.astype(jnp.int32)].astype(jnp.float32)
+def _filter_and_draw(z, seeds, positions, do_sample, top_k, top_p,
+                     temperature):
+    """z [B, V] f32 -> next token [B] int64 (see _sample_next_impl)."""
+    V = z.shape[-1]
     greedy = jnp.argmax(z, axis=-1)
 
     temp = temperature.astype(jnp.float32)
@@ -88,10 +92,49 @@ def _sample_next_impl(logits, last_index, seeds, positions, do_sample,
     return jnp.where(use_sample, sampled, greedy).astype(jnp.int64)
 
 
+def _sample_next_impl(logits, last_index, seeds, positions, do_sample,
+                      top_k, top_p, temperature):
+    """logits [B, S, V] -> next token [B] int64.
+
+    Row r reads logits[r, last_index[r]]; greedy rows take the argmax;
+    sampling rows apply temperature -> top-k -> top-p (the dense
+    baseline's filter order) and draw with a key folded from
+    (seed, absolute position) so the result does not depend on how the
+    scheduler packed or when it ran this row."""
+    B, S, V = logits.shape
+    rows = jnp.arange(B)
+    z = logits[rows, last_index.astype(jnp.int32)].astype(jnp.float32)
+    return _filter_and_draw(z, seeds, positions, do_sample, top_k,
+                            top_p, temperature)
+
+
 def serving_sample_next(logits, last_index, seeds, positions, do_sample,
                         top_k, top_p, temperature):
     """Batched next-token selection (see _sample_next_impl)."""
     return dispatch("serving_sample_next", _sample_next_impl,
+                    (logits, last_index, seeds, positions, do_sample,
+                     top_k, top_p, temperature), {},
+                    differentiable=False)
+
+
+def _ragged_sample_impl(logits, last_index, seeds, positions, do_sample,
+                        top_k, top_p, temperature):
+    """logits [1, T, V] (flat ragged step) -> next token [S] int64.
+
+    Sequence s reads the flat row ``last_index[s]`` — its last valid
+    query this step.  Rows that scheduled no sampling token this step
+    (mid-prefill, idle) read a stale index and produce garbage the
+    engine never drains.  Same filter/draw semantics as
+    `_sample_next_impl`."""
+    z = logits[0, last_index.astype(jnp.int32)].astype(jnp.float32)
+    return _filter_and_draw(z, seeds, positions, do_sample, top_k,
+                            top_p, temperature)
+
+
+def ragged_sample_next(logits, last_index, seeds, positions, do_sample,
+                       top_k, top_p, temperature):
+    """Next-token selection over the flat ragged step's logits."""
+    return dispatch("ragged_sample_next", _ragged_sample_impl,
                     (logits, last_index, seeds, positions, do_sample,
                      top_k, top_p, temperature), {},
                     differentiable=False)
@@ -104,14 +147,15 @@ class GenerationEngine:
     """Multi-request generation over one causal-LM model.
 
     ``add_request()`` enqueues, ``step()`` advances the whole batch one
-    scheduler action, ``generate()`` is the run-to-completion
+    unified ragged step, ``generate()`` is the run-to-completion
     convenience.  Results are full token sequences (prompt + generated,
     truncated at EOS).
     """
 
     def __init__(self, model, config=None, max_batch=None,
                  block_size=None, num_blocks=None, max_model_len=None,
-                 buckets=None, hbm_fraction=0.3):
+                 prefill_chunk=None, hbm_fraction=0.3,
+                 prefix_cache=None):
         import paddle_tpu as paddle
         cfg = config or getattr(model, "config", None) \
             or model.gpt.config
@@ -127,16 +171,28 @@ class GenerationEngine:
         self.cache = PagedKVCache(
             num_layers, num_heads, head_dim, dtype=param.dtype,
             block_size=block_size, num_blocks=num_blocks,
-            max_model_len=self.max_model_len, hbm_fraction=hbm_fraction)
+            max_model_len=self.max_model_len, hbm_fraction=hbm_fraction,
+            prefix_cache=prefix_cache)
         self.max_batch = int(max_batch or max_batch_size())
-        self.scheduler = ContinuousBatchingScheduler(
-            self.cache, self.max_batch, buckets)
-        self.buckets = self.scheduler.buckets
 
-        self._prefill_view = PagedCacheView(self.cache, "prefill")
-        self._decode_view = PagedCacheView(self.cache, "decode")
-        self._prefill_fn = paddle.jit.to_static(self._prefill_step)
-        self._decode_fn = paddle.jit.to_static(self._decode_step)
+        # unified step geometry: one prefill chunk (padded to whole
+        # q-blocks) + one q-block per decode row, ALL in a single
+        # fixed-shape program — token_budget never changes, so the
+        # engine compiles once
+        self.block_q = ragged_q_block(self.cache._jdtype)
+        chunk = min(int(prefill_chunk or prefill_chunk_size()),
+                    self.max_model_len)
+        self.prefill_chunk = max(1, chunk)
+        chunk_pad = -(-self.prefill_chunk // self.block_q) * self.block_q
+        self.token_budget = (chunk_pad
+                             + (self.max_batch - 1) * self.block_q)
+        self.num_q_blocks = self.token_budget // self.block_q
+
+        self.scheduler = ContinuousBatchingScheduler(
+            self.cache, self.max_batch, self.prefill_chunk)
+
+        self._view = RaggedCacheView(self.cache, self.block_q)
+        self._step_fn = paddle.jit.to_static(self._ragged_step)
 
         self._rows = [None] * self.max_batch
         self._last_tokens = jnp.zeros((self.max_batch,), jnp.int64)
@@ -147,26 +203,15 @@ class GenerationEngine:
         self._step_finished = []
         self._tokens_generated = 0
 
-    # -- traced step functions (one compile per arg-shape bucket) -------
-    def _prefill_step(self, ids, seeds, do_sample, top_k, top_p,
-                      temperature):
-        view = self._prefill_view
-        with no_grad():
-            logits = self.model(ids, cache=view, use_cache=False)
-            ctx = view.context_lens          # [1] true prompt length
-            return serving_sample_next(
-                logits, ctx - 1, seeds, ctx, do_sample, top_k, top_p,
-                temperature)
-
-    def _decode_step(self, ids, seeds, do_sample, top_k, top_p,
+    # -- the ONE traced step function -----------------------------------
+    def _ragged_step(self, ids, seeds, do_sample, top_k, top_p,
                      temperature):
-        view = self._decode_view
+        view = self._view
         with no_grad():
             logits = self.model(ids, cache=view, use_cache=False)
-            ctx = view.context_lens          # [B] ctx incl. new token
-            return serving_sample_next(
-                logits, ctx - ctx, seeds, ctx, do_sample, top_k, top_p,
-                temperature)
+            return ragged_sample_next(
+                logits, view.last_index, seeds, view.sample_pos,
+                do_sample, top_k, top_p, temperature)
 
     # -- public API -----------------------------------------------------
     def add_request(self, prompt, max_new_tokens=16, do_sample=False,
@@ -198,15 +243,19 @@ class GenerationEngine:
         return self.scheduler.has_work() or bool(self._pending)
 
     def step(self):
-        """One scheduler action (a prefill or a batched decode) plus a
-        lazy drain.  Returns the requests that finished this step."""
+        """One unified ragged step (admissions + at most one prefill
+        chunk + every decode row) plus a lazy drain.  Returns the
+        requests that finished this step."""
         self._step_idx += 1
         self._step_finished = []
-        action, payload = self.scheduler.next_action()
-        if action == "prefill":
-            self._run_prefill(payload)
-        elif action == "decode":
-            self._run_decode()
+        while True:
+            action, payload = self.scheduler.next_action()
+            if action == "admit":
+                self._admit(payload)
+                continue
+            break
+        if action == "step":
+            self._run_step(payload)
         elif self._pending:
             self._drain(0)       # nothing to schedule: retire in flight
         self._drain(max(0, pipeline_depth() - 1))
@@ -239,55 +288,44 @@ class GenerationEngine:
         s.update(queue_depth=self.scheduler.queue_depth,
                  running=len(self.scheduler.running),
                  tokens_generated=self._tokens_generated,
-                 prefill_compiles=len(self._prefill_fn._cache),
-                 decode_compiles=len(self._decode_fn._cache))
+                 token_budget=self.token_budget,
+                 step_compiles=len(self._step_fn._cache))
         return s
 
     def close(self):
         self.cache.close()
 
-    # -- prefill --------------------------------------------------------
-    def _run_prefill(self, req):
-        L = len(req.prompt)
-        bucket = bucket_for(L, self.buckets)
+    # -- admission ------------------------------------------------------
+    def _admit(self, req):
+        """Allocate the prompt (prefix-aware) and seat the request."""
         self.scheduler.begin_prefill(req)
         row = self._rows.index(None)
         self._rows[row] = req
         req.row = row
+        if req.cached_prefix:
+            obs.instant("serving.prefix_hit", cat="prefill",
+                        request=req.id, cached=req.cached_prefix,
+                        prompt=len(req.prompt))
+        obs.get_registry().gauge("serving.prefix_hit_rate").set(
+            self.cache.prefix_hit_rate)
 
-        ids = np.zeros((1, bucket), np.int64)
-        ids[0, :L] = req.prompt
-        slots = np.zeros(bucket, np.int32)   # pad tokens -> pad block 0
-        slots[:L] = self.cache.slot_mapping(req.id, 0, L)
-        table = self.cache.block_table(req.id)[None, :]
-        self._prefill_view.set_inputs(
-            slots, table, np.array([L], np.int32),
-            np.arange(bucket, dtype=np.int64)[None, :])
-
-        args = self._control_tensors([req], 1)
-        with obs.span(f"prefill:b{bucket}", cat="prefill",
-                      step=self._step_idx, request=req.id, length=L):
-            tok = self._prefill_fn(self._tensor(ids), *args)
-        self._last_tokens = self._last_tokens.at[row].set(tok._value[0])
-        req.n_scheduled = 1
-        self._pending.append(([(0, req)], tok._value))
-
-    # -- decode ---------------------------------------------------------
-    def _run_decode(self):
+    # -- the unified step -----------------------------------------------
+    def _run_step(self, plan):
         appended = {}            # req.id -> length before this round
         while True:
+            chunk, decodes = plan
+            if self._reserve_slots(decodes, appended):
+                break
+            # preemption (or a finish) changed the schedule: slots
+            # reserved this round were never dispatched — re-ask; if
+            # the next action is no longer a step, roll back or the
+            # surviving rows' context advances past their real tokens
             action, payload = self.scheduler.next_action()
-            if action != "decode":
-                # preemption (or a finish) turned the next action into a
-                # prefill: the slots reserved this round were never
-                # dispatched — roll them back or the surviving rows'
-                # context advances past their real tokens
+            if action != "step":
                 self._rollback_slots(appended)
                 return
-            active = payload
-            if self._reserve_slots(active, appended):
-                break
-        self._dispatch_decode(active)
+            plan = payload
+        self._dispatch_step(chunk, decodes)
 
     def _rollback_slots(self, appended):
         for rid, before in appended.items():
@@ -295,7 +333,7 @@ class GenerationEngine:
                 self.cache.truncate(rid, before)
 
     def _reserve_slots(self, active, appended):
-        """Extend every active sequence by one slot; on pool exhaustion
+        """Extend every decode sequence by one slot; on pool exhaustion
         retire in-flight work, then preempt the youngest sequence to the
         waiting queue.  Returns False when the active set changed."""
         for req in active:
@@ -324,42 +362,110 @@ class GenerationEngine:
     def _preempt(self, victim):
         """Requeue-by-recompute: all of the victim's tokens are already
         drained (the caller forced lag 0), so its prompt+generated
-        resubmits at the head of the queue and the resumed run is
-        position-for-position identical."""
+        resubmits at the head of the queue.  Its written blocks are
+        prefix-indexed on free, so the resumed prefill keeps whatever
+        the pool doesn't actually reclaim."""
         obs.instant("serving.preempt", cat="decode", request=victim.id,
                     generated=len(victim.generated))
         if victim.row is not None:
             self._rows[victim.row] = None
         self.scheduler.requeue(victim, victim.generated)
 
-    def _dispatch_decode(self, active):
-        B, W = self.max_batch, self.cache.table_width
-        slots = np.zeros(B, np.int32)
-        table = np.zeros((B, W), np.int32)
-        ctx = np.zeros(B, np.int32)
-        pos = np.zeros((B, 1), np.int64)
-        rows_reqs = []
-        for req in active:
+    def _dispatch_step(self, chunk, decodes):
+        """Pack the chunk + decode rows into the flat ragged buffer and
+        dispatch the ONE compiled step."""
+        T, S, BQ = self.token_budget, self.max_batch, self.block_q
+        W = self.cache.table_width
+        NQB = self.num_q_blocks
+        ids = np.zeros((1, T), np.int64)
+        slots = np.zeros(T, np.int32)        # pad rows -> pad block 0
+        positions = np.zeros((1, T), np.int64)
+        seq_ids = np.full(NQB, S, np.int32)  # S = null segment
+        q_starts = np.zeros(NQB, np.int32)
+        q_valids = np.zeros(NQB, np.int32)
+        tables = np.zeros((S, W), np.int32)
+        ctx = np.zeros(S, np.int32)
+        last_index = np.zeros(S, np.int32)
+        sample_pos = np.zeros(S, np.int64)
+
+        flat = 0
+        rows_reqs = []           # rows that sample a token this step
+        decode_feed = []         # (flat_idx, row): device-token inputs
+        for req in decodes:
             r = req.row
             length = self.cache.length(req.id)   # incl. this new slot
-            slots[r] = self.cache.slot_mapping(req.id, length - 1, 1)[0]
-            table[r] = self.cache.block_table(req.id)
+            seg = flat // BQ
+            seq_ids[seg] = r
+            q_starts[seg] = length - 1
+            q_valids[seg] = 1
+            slots[flat] = self.cache.slot_mapping(
+                req.id, length - 1, 1)[0]
+            positions[0, flat] = length - 1
+            decode_feed.append((flat, r))
+            tables[r] = self.cache.block_table(req.id)
             ctx[r] = length
-            pos[r, 0] = length - 1               # input token's position
+            last_index[r] = flat
+            sample_pos[r] = length
             rows_reqs.append((r, req))
-        self._decode_view.set_inputs(slots, table, ctx, pos)
+            flat += BQ
+        if chunk is not None:
+            req, start, n = chunk
+            r = req.row
+            ids[0, flat:flat + n] = req.prompt[start:start + n]
+            slots[flat:flat + n] = self.cache.slot_mapping(
+                req.id, start, n)
+            positions[0, flat:flat + n] = np.arange(start, start + n)
+            nseg = -(-n // BQ)
+            for j in range(nseg):
+                seq_ids[flat // BQ + j] = r
+                q_starts[flat // BQ + j] = start + j * BQ
+                q_valids[flat // BQ + j] = min(BQ, n - j * BQ)
+            tables[r] = self.cache.block_table(req.id)
+            ctx[r] = start + n
+            if start + n == len(req.prompt):
+                # prompt complete: sample the first new token
+                last_index[r] = flat + n - 1
+                sample_pos[r] = start + n
+                rows_reqs.append((r, req))
+            flat += nseg * BQ
 
+        self._view.set_inputs(slots, tables, ctx, positions, seq_ids,
+                              q_starts, q_valids, last_index,
+                              sample_pos)
         args = self._control_tensors(
-            [self._rows[r] for r in range(B)], B)
-        ids = Tensor(self._last_tokens[:, None], _internal=True,
-                     stop_gradient=True)
-        with obs.span("decode", cat="decode", step=self._step_idx,
-                      batch=len(active)):
-            tok = self._decode_fn(ids, *args)
+            [self._rows[r] for r in range(S)], S)
+        ids_dev = jnp.asarray(ids)
+        if decode_feed:
+            flat_idx = np.asarray([f for f, _ in decode_feed], np.int32)
+            rows = np.asarray([r for _, r in decode_feed], np.int32)
+            # previous step's device-side tokens feed this step's
+            # inputs with no host read
+            ids_dev = ids_dev.at[0, flat_idx].set(
+                self._last_tokens[rows])
+        ids_t = Tensor(ids_dev, _internal=True, stop_gradient=True)
+
+        with contextlib.ExitStack() as stack:
+            if decodes:
+                stack.enter_context(obs.span(
+                    "decode", cat="decode", step=self._step_idx,
+                    batch=len(decodes)))
+            if chunk is not None:
+                stack.enter_context(obs.span(
+                    "prefill:chunk", cat="prefill", step=self._step_idx,
+                    request=chunk.request.id, start=chunk.start,
+                    tokens=chunk.length))
+            tok = self._step_fn(ids_t, *args)
         self._last_tokens = tok._value
         for _, req in rows_reqs:
             req.n_scheduled += 1
-        self._pending.append((rows_reqs, tok._value))
+        if rows_reqs:
+            self._pending.append((rows_reqs, tok._value))
+        if chunk is not None:
+            req = chunk.request
+            req.num_computed = chunk.start + chunk.length
+            # landed blocks join the prefix index for future sharers
+            self.cache.commit_prefix(
+                req.id, req.prompt[:req.num_computed])
 
     def _control_tensors(self, reqs, n):
         """Per-row sampling controls; None entries are masked rows."""
@@ -395,6 +501,14 @@ class GenerationEngine:
                 if req.done:
                     continue     # tokens raced past EOS: discard
                 token = int(host[idx])
+                if not req.generated and req.t_first_token is None:
+                    req.t_first_token = time.perf_counter()
+                    if req.t_submit is not None:
+                        ttft = (req.t_first_token - req.t_submit) * 1e3
+                        reg = obs.get_registry()
+                        reg.gauge("serving.ttft_ms").set(ttft)
+                        reg.histogram(
+                            "serving.ttft_ms_hist").observe(ttft)
                 req.generated.append(token)
                 self._tokens_generated += 1
                 if (req.eos_token_id is not None

@@ -1,4 +1,4 @@
-"""Paged KV-cache manager: a fixed block pool + per-sequence block tables.
+"""Paged KV-cache manager: block pool + block tables + COW prefix cache.
 
 vLLM-style paging mapped onto this framework's state machinery
 (*Ragged Paged Attention*, PAPERS.md): instead of one contiguous,
@@ -20,17 +20,39 @@ garbage K/V there and padded block-table entries point at it — it is
 never attributed to a real sequence, and paged attention masks it out
 via context_lens.
 
+**Copy-on-write prefix caching** (``PADDLE_TPU_PREFIX_CACHE``, default
+on): every FULL block of a prompt gets a chain hash
+
+    h_i = hash((h_{i-1}, tuple(block_tokens)))
+
+so a block's identity covers its whole prefix.  ``allocate(...,
+tokens=)`` walks the chain against the hash index and reuses every hit
+block (refcount += 1) instead of recomputing it — a fleet of requests
+sharing a system prompt pays ONE prefill.  Hits are capped at
+``num_tokens - 1`` so at least one token is computed for logits.
+Freed blocks whose content is still indexed park in an LRU
+(refcount 0, children evicted before parents); eviction only happens
+when the free list runs dry, so prefix credit survives preemption:
+``free(..., tokens=)`` hashes the dying sequence's full blocks first
+and ``requeue`` re-enters through ``allocate`` which finds them again.
+Writes into a shared block trigger a COW split (device-side block
+copy + table swap); writes into a privately-held but still-indexed
+block just de-index it.  ``truncate`` never touches block contents —
+it releases whole blocks refcount-aware, so preemption rollback cannot
+corrupt a prefix another sequence still reads.
+
 The pool tensors are ordinary framework Tensors.  The engine's
 ``to_static`` step functions read them (discovered as state) and write
 them via ``_inplace_update`` (mutated state → donated to XLA), so the
-compiled decode step updates the cache in place at 1x memory.
+compiled step updates the cache in place at 1x memory.
 
 HBM accounting: the pool registers itself with the memory guard
-(``register_resident``) as a named **"kv cache blocks"** line item, so
-every subsequent pre-flight charges it and an over-budget program's
-``HbmBudgetError`` reports the pool next to params/opt-state.  The
-engine's own steps carry the pool as an argument already, and the
-guard skips the double charge via buffer identity.
+(``register_resident``) as a named **"kv cache blocks"** line item —
+the charge is the PHYSICAL pool size, fixed at construction, so shared
+prefix blocks are never double-charged no matter how many logical
+copies exist (``stats()`` reports ``logical_blocks`` vs
+``physical_blocks`` to make the sharing visible in ``HbmBudgetError``
+triage).
 
 Sizing: ``num_blocks`` explicit, or derived from the HBM budget
 (``PADDLE_TPU_HBM_BUDGET`` / device bytes_limit) via ``hbm_fraction``.
@@ -38,20 +60,23 @@ Sizing: ``num_blocks`` explicit, or derived from the HBM budget
 
 Utilization rides the observability registry: gauges
 ``serving.kv_blocks_total`` / ``serving.kv_blocks_in_use`` /
-``serving.kv_utilization`` plus a host-side high-water mark.
+``serving.kv_utilization`` / ``serving.kv_blocks_shared`` /
+``serving.prefix_hit_rate`` plus a host-side high-water mark.
 """
 from __future__ import annotations
 
 import os
+from collections import OrderedDict
 
 import numpy as np
 
 from ... import observability as obs
 
-__all__ = ["ENV_KV_BLOCK_SIZE", "kv_block_size", "PagedKVCache",
-           "RESIDENT_NAME"]
+__all__ = ["ENV_KV_BLOCK_SIZE", "ENV_PREFIX_CACHE", "kv_block_size",
+           "prefix_cache_enabled", "PagedKVCache", "RESIDENT_NAME"]
 
 ENV_KV_BLOCK_SIZE = "PADDLE_TPU_KV_BLOCK_SIZE"
+ENV_PREFIX_CACHE = "PADDLE_TPU_PREFIX_CACHE"
 _DEFAULT_BLOCK_SIZE = 16
 RESIDENT_NAME = "kv cache blocks"
 
@@ -70,18 +95,27 @@ def kv_block_size():
     return max(1, v)
 
 
-class PagedKVCache:
-    """Block pool + allocator + per-sequence block tables.
+def prefix_cache_enabled():
+    """Whether COW prefix caching is on (PADDLE_TPU_PREFIX_CACHE,
+    default "1"; "0"/"false"/"off" disable)."""
+    return os.environ.get(ENV_PREFIX_CACHE, "1").lower() not in (
+        "0", "false", "off")
 
-    Host-side bookkeeping only lives here (free list, tables, lengths);
-    the device-side gather/scatter is in serving/attention.py, driven by
-    the arrays this class builds (slot mappings, padded block tables,
-    context lengths).
+
+class PagedKVCache:
+    """Block pool + allocator + per-sequence block tables + COW prefix
+    cache.
+
+    Host-side bookkeeping only lives here (free list, tables, lengths,
+    refcounts, the prefix hash index); the device-side gather/scatter
+    is in serving/attention.py, driven by the arrays this class builds
+    (slot mappings, padded block tables, context lengths).  The only
+    device work initiated here is the COW block copy.
     """
 
     def __init__(self, num_layers, num_heads, head_dim, dtype="float32",
                  block_size=None, num_blocks=None, max_model_len=None,
-                 hbm_fraction=0.3, register=True):
+                 hbm_fraction=0.3, register=True, prefix_cache=None):
         import jax.numpy as jnp
         from ...core.dtypes import to_jax_dtype
         from ...core.tensor import Tensor
@@ -104,6 +138,8 @@ class PagedKVCache:
         cap = self.max_model_len or (self.num_blocks - 1) * self.block_size
         self.table_width = max(
             1, -(-cap // self.block_size))  # ceil div
+        self.prefix_cache = (prefix_cache_enabled()
+                             if prefix_cache is None else bool(prefix_cache))
 
         shape = (self.num_blocks, self.num_heads, self.block_size,
                  self.head_dim)
@@ -120,6 +156,15 @@ class PagedKVCache:
         self._free = list(range(self.num_blocks - 1, 0, -1))  # pop() → 1
         self._tables = {}      # seq_id -> [block ids]
         self._lengths = {}     # seq_id -> tokens stored
+        # prefix-cache state
+        self._ref = {}         # block -> refcount (blocks in any table)
+        self._hash_of = {}     # block -> chain hash (full prefix blocks)
+        self._by_hash = {}     # chain hash -> canonical block
+        self._cached_free = OrderedDict()  # refcount-0 indexed blocks LRU
+        self._cached_len = {}  # seq_id -> tokens served from the cache
+        self._hit_tokens = 0   # prefix tokens reused, cumulative
+        self._lookup_tokens = 0  # prompt tokens that consulted the index
+        self.cow_splits = 0    # COW block copies performed, cumulative
         self.high_water = 0    # max blocks in use, ever
         self._registered = False
         if register:
@@ -166,53 +211,228 @@ class PagedKVCache:
     # -- allocator -------------------------------------------------------
     @property
     def free_blocks(self):
-        return len(self._free)
+        """Blocks available for allocation: virgin free blocks plus the
+        evictable refcount-0 prefix-cache LRU."""
+        return len(self._free) + len(self._cached_free)
 
     @property
     def blocks_in_use(self):
-        return (self.num_blocks - 1) - len(self._free)
+        """PHYSICAL blocks held by live sequences (shared counted
+        once; parked cache blocks are not in use)."""
+        return (self.num_blocks - 1) - self.free_blocks
+
+    @property
+    def logical_blocks(self):
+        """Sum of table lengths: what the sequences would occupy
+        WITHOUT sharing."""
+        return sum(len(t) for t in self._tables.values())
+
+    @property
+    def shared_blocks(self):
+        """Physical blocks referenced by more than one sequence."""
+        return sum(1 for c in self._ref.values() if c > 1)
 
     def blocks_needed(self, num_tokens):
         return -(-int(num_tokens) // self.block_size)
 
-    def can_allocate(self, num_tokens):
-        return self.blocks_needed(num_tokens) <= len(self._free)
+    def can_allocate(self, num_tokens, tokens=None, headroom=0):
+        """Admission check; with ``tokens`` prefix-cache hits count as
+        already available (a hit parked in the LRU is reactivated, not
+        consumed from the free capacity).  ``headroom`` blocks are held
+        back for the decode growth of already-running sequences — an
+        admission that consumed them could be preempted right back out
+        by the very decode appends it displaced, and the retry would
+        livelock."""
+        hits = self._prefix_hits(tokens, num_tokens)
+        need = self.blocks_needed(num_tokens) - len(hits)
+        # same capacity formula as allocate(): a parked hit block is
+        # reactivated, not consumed — but it must not ALSO be counted
+        # as evictable free capacity
+        hits_parked = sum(1 for b in hits if b in self._cached_free)
+        capacity = (len(self._free)
+                    + len(self._cached_free) - hits_parked)
+        return need + int(headroom) <= capacity
 
-    def allocate(self, seq_id, num_tokens):
+    def _chain_hash(self, prev, block_tokens):
+        return hash((prev, tuple(int(t) for t in block_tokens)))
+
+    def _prefix_hits(self, tokens, num_tokens):
+        """Indexed blocks covering the longest cached block-aligned
+        prefix of ``tokens``, capped so at least one of ``num_tokens``
+        is still computed (the model must produce logits)."""
+        hits = []
+        if not self.prefix_cache or tokens is None:
+            return hits
+        bs = self.block_size
+        h = None
+        max_reuse = int(num_tokens) - 1   # leave >= 1 token to compute
+        for b in range(min(len(tokens), int(num_tokens)) // bs):
+            if (b + 1) * bs > max_reuse:
+                break
+            h = self._chain_hash(h, tokens[b * bs:(b + 1) * bs])
+            blk = self._by_hash.get(h)
+            if blk is None:
+                break
+            hits.append(blk)
+        return hits
+
+    def _take_block(self):
+        """One writable block: prefer virgin free blocks, else evict
+        the least-recently-used refcount-0 cached block (de-indexing
+        its hash — the prefix is gone once the block is reused)."""
+        if self._free:
+            return self._free.pop()
+        blk, _ = self._cached_free.popitem(last=False)
+        h = self._hash_of.pop(blk, None)
+        if h is not None and self._by_hash.get(h) == blk:
+            del self._by_hash[h]
+        return blk
+
+    def _activate(self, blk):
+        """Bring a hit block into a table (refcount += 1; un-park it
+        from the LRU if it was refcount-0)."""
+        if blk in self._cached_free:
+            del self._cached_free[blk]
+            self._ref[blk] = 1
+        else:
+            self._ref[blk] = self._ref.get(blk, 0) + 1
+
+    def _release(self, blk):
+        """Drop one table reference.  A still-indexed block parks in
+        the evictable LRU (most-recently-freed last); anything else
+        returns to the virgin free list."""
+        c = self._ref.get(blk, 1) - 1
+        if c > 0:
+            self._ref[blk] = c
+            return
+        self._ref.pop(blk, None)
+        if blk in self._hash_of:
+            self._cached_free[blk] = None
+            self._cached_free.move_to_end(blk)
+        else:
+            self._free.append(blk)
+
+    def allocate(self, seq_id, num_tokens, tokens=None):
         """Reserve blocks for a sequence's first ``num_tokens`` tokens
-        (prefill).  Raises KeyError on duplicate ids, returns False when
-        the pool cannot hold it."""
+        (prefill).  With ``tokens`` (the prompt) the prefix index is
+        consulted and every leading cached block is SHARED instead of
+        reserved fresh — ``cached_prefix_len()`` reports how many
+        tokens the caller may skip.  Raises KeyError on duplicate ids,
+        returns False when the pool cannot hold it."""
         if seq_id in self._tables:
             raise KeyError(f"sequence {seq_id!r} already allocated")
-        need = self.blocks_needed(num_tokens)
-        if need > len(self._free):
+        hits = self._prefix_hits(tokens, num_tokens)
+        need = self.blocks_needed(num_tokens) - len(hits)
+        hits_parked = sum(1 for b in hits if b in self._cached_free)
+        if need > len(self._free) + (len(self._cached_free)
+                                     - hits_parked):
             return False
-        self._tables[seq_id] = [self._free.pop() for _ in range(need)]
+        for blk in hits:
+            self._activate(blk)
+        table = list(hits)
+        for _ in range(need):
+            blk = self._take_block()
+            self._ref[blk] = 1
+            table.append(blk)
+        self._tables[seq_id] = table
         self._lengths[seq_id] = int(num_tokens)
+        cached = len(hits) * self.block_size
+        self._cached_len[seq_id] = cached
+        if self.prefix_cache and tokens is not None:
+            self._hit_tokens += cached
+            self._lookup_tokens += int(num_tokens)
         self._update_gauges()
         return True
 
+    def cached_prefix_len(self, seq_id):
+        """Prompt tokens served from the prefix cache at allocate()
+        time — prefill may start at this offset."""
+        return self._cached_len.get(seq_id, 0)
+
+    def commit_prefix(self, seq_id, tokens):
+        """Index every FULL block covered by ``tokens`` (the sequence's
+        written prefix so far) into the prefix cache.  Called by the
+        engine after each prefill chunk lands; blocks already indexed
+        (cache hits) just extend the chain."""
+        if not self.prefix_cache:
+            return
+        bs = self.block_size
+        table = self._tables[seq_id]
+        n = min(int(len(tokens)), self._lengths[seq_id]) // bs
+        h = None
+        for b in range(n):
+            blk = table[b]
+            if blk in self._hash_of:
+                h = self._hash_of[blk]
+                continue
+            h = self._chain_hash(h, tokens[b * bs:(b + 1) * bs])
+            other = self._by_hash.get(h)
+            if other is None:
+                self._hash_of[blk] = h
+                self._by_hash[h] = blk
+            # duplicate content under another canonical block: leave
+            # this one unindexed, future lookups hit the canonical one
+
+    def _ensure_writable(self, seq_id, position):
+        """Make the block holding ``position`` safe to scatter into.
+        Shared block → COW split (device copy + table swap); private
+        but still hash-indexed → de-index (the write invalidates the
+        cached prefix)."""
+        idx = int(position) // self.block_size
+        table = self._tables[seq_id]
+        if idx >= len(table):
+            return
+        blk = table[idx]
+        if self._ref.get(blk, 1) > 1:
+            new = self._take_block()
+            self._copy_block(blk, new)
+            table[idx] = new
+            self._ref[new] = 1
+            self._ref[blk] -= 1
+            self.cow_splits += 1
+            obs.instant("serving.cow_split", cat="decode",
+                        src=blk, dst=new)
+        elif blk in self._hash_of:
+            h = self._hash_of.pop(blk)
+            if self._by_hash.get(h) == blk:
+                del self._by_hash[h]
+
+    def _copy_block(self, src, dst):
+        """Device-side block copy, all layers (the COW split)."""
+        for k, v in self._pools:
+            k._inplace_update(k._value.at[dst].set(k._value[src]))
+            v._inplace_update(v._value.at[dst].set(v._value[src]))
+
     def append(self, seq_id, num_tokens=1):
         """Extend a sequence by ``num_tokens`` slots (decode).  Returns
-        False (state unchanged) when a needed block isn't available."""
+        False (state unchanged) when a needed block isn't available.
+        Writing into a still-shared tail block COW-splits it first."""
         length = self._lengths[seq_id]
-        need = (self.blocks_needed(length + num_tokens)
-                - len(self._tables[seq_id]))
-        if need > len(self._free):
+        table = self._tables[seq_id]
+        need = self.blocks_needed(length + num_tokens) - len(table)
+        cow = 0
+        if length % self.block_size:
+            idx = length // self.block_size
+            if idx < len(table) and self._ref.get(table[idx], 1) > 1:
+                cow = 1                      # split consumes one block
+        if need + cow > self.free_blocks:
             return False
+        if length % self.block_size:
+            self._ensure_writable(seq_id, length)
         for _ in range(need):
-            self._tables[seq_id].append(self._free.pop())
+            blk = self._take_block()
+            self._ref[blk] = 1
+            self._tables[seq_id].append(blk)
         self._lengths[seq_id] = length + int(num_tokens)
         self._update_gauges()
         return True
 
     def truncate(self, seq_id, length):
-        """Shrink a sequence back to ``length`` tokens, returning whole
-        blocks past the new end to the pool.  Rolls back decode slots
-        that were reserved but never dispatched (the engine aborts a
-        decode round when preemption turns the next action into a
-        prefill — without this, the sequence's context would advance
-        past its real tokens and attend over unwritten slots)."""
+        """Shrink a sequence back to ``length`` tokens, releasing whole
+        blocks past the new end (refcount-aware: a shared block just
+        drops one reference — its content is NEVER touched, so rolling
+        back decode slots that were reserved but never dispatched
+        cannot corrupt a prefix another sequence still reads)."""
         length = int(length)
         if length > self._lengths[seq_id]:
             raise ValueError(
@@ -221,20 +441,29 @@ class PagedKVCache:
         table = self._tables[seq_id]
         keep = self.blocks_needed(length)
         while len(table) > keep:
-            self._free.append(table.pop())
+            self._release(table.pop())
         self._lengths[seq_id] = length
         self._update_gauges()
 
     def __contains__(self, seq_id):
         return seq_id in self._tables
 
-    def free(self, seq_id):
-        """Return a sequence's blocks to the pool."""
-        blocks = self._tables.pop(seq_id, None)
-        if blocks is None:
+    def free(self, seq_id, tokens=None):
+        """Drop a sequence's references.  With ``tokens`` (its full
+        written token list) every full block is indexed into the prefix
+        cache FIRST, so a preempted-and-requeued request — or the next
+        request sharing the prompt — re-enters through `allocate` with
+        its prefix credit intact.  Children release before parents so
+        LRU eviction consumes the chain tip first."""
+        if seq_id not in self._tables:
             return 0
+        if tokens is not None:
+            self.commit_prefix(seq_id, tokens)
+        blocks = self._tables.pop(seq_id)
         self._lengths.pop(seq_id, None)
-        self._free.extend(reversed(blocks))
+        self._cached_len.pop(seq_id, None)
+        for blk in reversed(blocks):
+            self._release(blk)
         self._update_gauges()
         return len(blocks)
 
@@ -243,6 +472,11 @@ class PagedKVCache:
 
     def sequences(self):
         return list(self._tables)
+
+    @property
+    def prefix_hit_rate(self):
+        """Fraction of looked-up prompt tokens served from the cache."""
+        return self._hit_tokens / max(1, self._lookup_tokens)
 
     # -- device-side driving arrays --------------------------------------
     def slot_mapping(self, seq_id, start, count):
@@ -276,6 +510,8 @@ class PagedKVCache:
         reg.gauge("serving.kv_blocks_in_use").set(used)
         reg.gauge("serving.kv_utilization").set(
             used / max(1, self.num_blocks - 1))
+        reg.gauge("serving.kv_blocks_shared").set(self.shared_blocks)
+        reg.gauge("serving.prefix_hit_rate").set(self.prefix_hit_rate)
 
     def stats(self):
         return {
@@ -283,6 +519,12 @@ class PagedKVCache:
             "block_size": self.block_size,
             "blocks_in_use": self.blocks_in_use,
             "free_blocks": self.free_blocks,
+            "logical_blocks": self.logical_blocks,
+            "physical_blocks": self.blocks_in_use,
+            "shared_blocks": self.shared_blocks,
+            "cached_free_blocks": len(self._cached_free),
+            "cow_splits": self.cow_splits,
+            "prefix_hit_rate": self.prefix_hit_rate,
             "high_water": self.high_water,
             "pool_bytes": self.pool_bytes,
             "sequences": len(self._tables),
@@ -292,4 +534,5 @@ class PagedKVCache:
         return (f"PagedKVCache(blocks={self.num_blocks - 1}x"
                 f"{self.block_size}, layers={self.num_layers}, "
                 f"in_use={self.blocks_in_use}, "
+                f"shared={self.shared_blocks}, "
                 f"high_water={self.high_water})")

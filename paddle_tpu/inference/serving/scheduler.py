@@ -1,39 +1,52 @@
-"""Continuous-batching scheduler: admission, interleave, preemption.
+"""Continuous-batching scheduler: admission, chunked prefill, preemption.
 
-Policy (vLLM-style iteration-level scheduling):
+Policy (vLLM-style iteration-level scheduling over ONE unified step):
 
-  * **prefill first**: whenever a row and enough free blocks exist, the
-    oldest waiting request is admitted with a batch-1 prefill bucketed
-    to the next power-of-two length — each bucket is one compiled
-    program, so a mixed workload compiles ``len(buckets)`` prefill
-    executables plus ONE fixed-shape decode executable, total bounded
-    by ``len(buckets) + 1``;
-  * **decode otherwise**: all running sequences advance one token per
-    step in a single fixed ``[max_batch, 1]`` program (finished rows
-    ride along as masked padding until drained);
+  * **one step program**: every scheduler step packs at most one
+    prefill *chunk* (``PADDLE_TPU_PREFILL_CHUNK`` tokens of the oldest
+    request still computing its prompt) plus every decodable row into a
+    single fixed ``[token_budget]`` ragged program — long prompts
+    stream through in chunks INTERLEAVED with decode instead of
+    stalling the batch, and the pow2 prefill-bucket compile family of
+    PR 5 is gone (one executable, ~1–2 compiles total);
+  * **admission**: whenever a row and enough free blocks exist — and
+    no running request is still computing its prompt — the oldest
+    waiting request is admitted.  Admission consults the prefix cache
+    (``allocate(..., tokens=prompt)``): a request sharing an
+    already-cached prompt prefix starts prefill at the first uncached
+    block (``num_computed = cached_prefix``).  Serializing admission
+    behind in-flight prefill costs nothing (only one chunk runs per
+    step) and lets a shared-prefix burst hit the blocks the previous
+    request just committed.  Admission also keeps one free block of
+    headroom per running sequence (a watermark): without it a tight
+    pool admits, the displaced decode appends preempt the admission
+    right back out, and the retry livelocks;
   * **preempt to requeue**: when the block pool cannot extend every
     running sequence, the *youngest* (most recently admitted) running
-    sequence is evicted — its blocks freed, its prompt+generated tokens
-    requeued at the head of the waiting queue for recompute-style
-    resumption.  Greedy decoding and the engine's position-keyed
-    sampling make the resumed continuation identical to the uninterrupted
-    one, so preemption is invisible in the output.
+    sequence is evicted — its WRITTEN blocks are hash-indexed into the
+    prefix cache on free (``free(..., tokens=)``), so the requeued
+    request re-enters through `allocate` with its prefix credit intact
+    and re-prefills only what eviction actually reclaimed.  Greedy
+    decoding and the engine's position-keyed sampling make the resumed
+    continuation identical to the uninterrupted one.
 
 The scheduler owns no device state: the engine asks ``next_action()``,
 performs the device work, and reports back (``begin_prefill`` /
-``finish`` / ``preempt``).
+``finish`` / ``requeue``).
 """
 from __future__ import annotations
 
 import os
-from collections import deque
+from collections import deque, namedtuple
 
-__all__ = ["ENV_MAX_BATCH", "max_batch_size", "length_buckets",
-           "bucket_for", "Request", "ContinuousBatchingScheduler"]
+__all__ = ["ENV_MAX_BATCH", "ENV_PREFILL_CHUNK", "max_batch_size",
+           "prefill_chunk_size", "Request", "PrefillChunk",
+           "ContinuousBatchingScheduler"]
 
 ENV_MAX_BATCH = "PADDLE_TPU_MAX_BATCH"
+ENV_PREFILL_CHUNK = "PADDLE_TPU_PREFILL_CHUNK"
 _DEFAULT_MAX_BATCH = 8
-_MIN_BUCKET = 16
+_DEFAULT_PREFILL_CHUNK = 256
 
 
 def max_batch_size():
@@ -45,24 +58,20 @@ def max_batch_size():
     return max(1, v)
 
 
-def length_buckets(max_len, min_bucket=_MIN_BUCKET):
-    """Power-of-two prefill buckets up to (and capped at) ``max_len``."""
-    out = []
-    b = min_bucket
-    while b < max_len:
-        out.append(b)
-        b *= 2
-    out.append(max_len)
-    return out
+def prefill_chunk_size():
+    """Prefill tokens per step (PADDLE_TPU_PREFILL_CHUNK, default 256):
+    the fixed chunk a long prompt is split into so prefill interleaves
+    with decode inside the unified step program."""
+    try:
+        v = int(os.environ.get(ENV_PREFILL_CHUNK,
+                               _DEFAULT_PREFILL_CHUNK))
+    except ValueError:
+        return _DEFAULT_PREFILL_CHUNK
+    return max(1, v)
 
 
-def bucket_for(length, buckets):
-    """Smallest bucket >= length."""
-    for b in buckets:
-        if length <= b:
-            return b
-    raise ValueError(
-        f"prompt length {length} exceeds largest bucket {buckets[-1]}")
+#: one scheduled slice of a prompt: ``request.prompt[start:start+length]``
+PrefillChunk = namedtuple("PrefillChunk", ["request", "start", "length"])
 
 
 class Request:
@@ -70,8 +79,9 @@ class Request:
 
     __slots__ = ("id", "prompt", "max_new_tokens", "do_sample", "top_k",
                  "top_p", "temperature", "seed", "eos_token_id",
-                 "generated", "n_scheduled", "row", "arrival", "done",
-                 "preemptions")
+                 "generated", "n_scheduled", "num_computed",
+                 "cached_prefix", "row", "arrival", "done",
+                 "preemptions", "t_submit", "t_first_token")
 
     def __init__(self, id, prompt, max_new_tokens=16, do_sample=False,
                  top_k=0, top_p=1.0, temperature=1.0, seed=0,
@@ -87,18 +97,28 @@ class Request:
         self.eos_token_id = eos_token_id
         self.generated = []       # host-read tokens, in order
         self.n_scheduled = 0      # tokens sampled on device (>= drained)
-        self.row = None           # decode batch row while running
+        self.num_computed = 0     # prompt tokens whose K/V are in cache
+        self.cached_prefix = 0    # of those, served by the prefix cache
+        self.row = None           # batch row while running
         self.arrival = -1         # admission-order stamp
         self.done = False
         self.preemptions = 0
+        self.t_submit = None      # wall clock at submit (TTFT start)
+        self.t_first_token = None  # wall clock at first drained token
 
     @property
     def remaining(self):
         """Tokens still to schedule."""
         return max(0, self.max_new_tokens - self.n_scheduled)
 
+    @property
+    def prefilling(self):
+        """Still computing prompt K/V (chunked prefill in progress)."""
+        return self.num_computed < len(self.prompt)
+
     def __repr__(self):
         return (f"Request({self.id!r}, prompt={len(self.prompt)}tok, "
+                f"computed={self.num_computed}, "
                 f"gen={len(self.generated)}/{self.max_new_tokens}, "
                 f"row={self.row}, done={self.done})")
 
@@ -106,12 +126,10 @@ class Request:
 class ContinuousBatchingScheduler:
     """Iteration-level scheduling over a shared PagedKVCache."""
 
-    def __init__(self, cache, max_batch=None, buckets=None):
+    def __init__(self, cache, max_batch=None, prefill_chunk=None):
         self.cache = cache
         self.max_batch = int(max_batch or max_batch_size())
-        cap = cache.max_model_len or (
-            (cache.num_blocks - 1) * cache.block_size)
-        self.buckets = list(buckets) if buckets else length_buckets(cap)
+        self.prefill_chunk = int(prefill_chunk or prefill_chunk_size())
         self.waiting = deque()
         self.running = []
         self._arrival = 0
@@ -120,6 +138,9 @@ class ContinuousBatchingScheduler:
     def submit(self, request):
         request.arrival = self._arrival
         self._arrival += 1
+        if request.t_submit is None:
+            import time
+            request.t_submit = time.perf_counter()
         self.waiting.append(request)
 
     def has_work(self):
@@ -131,18 +152,35 @@ class ContinuousBatchingScheduler:
 
     # -- policy ---------------------------------------------------------
     def next_action(self):
-        """("prefill", request) | ("decode", [requests]) | ("idle", None).
+        """("admit", request) | ("step", (chunk, decodes)) |
+        ("idle", None).
 
-        Decode schedules only sequences that still owe tokens; rows
-        whose requests finished scheduling but are still draining
-        in-flight results do not appear (the engine masks them).
+        ``chunk`` is a `PrefillChunk` (or None) for the OLDEST running
+        request still computing its prompt; ``decodes`` are the fully
+        prefilled sequences that still owe tokens.  Both ride in the
+        same unified step.  Admission is surfaced as its own action so
+        the engine allocates (prefix-aware) and immediately re-asks.
         """
-        if self.waiting and len(self.running) < self.max_batch:
+        # admission waits while any running request is still computing
+        # its prompt: only ONE chunk is scheduled per step (oldest
+        # first), so admitting early cannot start prefill any sooner —
+        # it can only allocate blocks before the in-flight prompt's
+        # prefix is committed, turning would-be prefix hits into misses
+        prefilling = any(r.prefilling and not r.done
+                         for r in self.running)
+        if (self.waiting and not prefilling
+                and len(self.running) < self.max_batch):
             req = self.waiting[0]
-            # +1 block headroom: the token sampled at prefill needs a
-            # slot at the first decode step
-            if self.cache.can_allocate(len(req.prompt) + 1):
-                return ("prefill", req)
+            # +1 token: the sample at end of prefill needs a slot at
+            # the first decode step.  One block of headroom per live
+            # running sequence: their next decode append may cross a
+            # block boundary, and an admission that ate that block
+            # would be preempted straight back out (livelock).
+            headroom = sum(1 for r in self.running if not r.done)
+            if self.cache.can_allocate(len(req.prompt) + 1,
+                                       tokens=req.prompt,
+                                       headroom=headroom):
+                return ("admit", req)
             if not self.running:
                 need = self.cache.blocks_needed(len(req.prompt) + 1)
                 raise RuntimeError(
@@ -150,25 +188,41 @@ class ContinuousBatchingScheduler:
                     f"pool only has {self.cache.free_blocks} free and "
                     f"nothing is running to preempt — the pool is too "
                     f"small for this prompt")
-        decodable = [r for r in self.running
-                     if not r.done and r.remaining > 0]
-        if decodable:
-            return ("decode", decodable)
+        chunk = None
+        for r in self.running:           # oldest admitted first
+            if not r.done and r.prefilling:
+                n = min(self.prefill_chunk,
+                        len(r.prompt) - r.num_computed)
+                chunk = PrefillChunk(r, r.num_computed, n)
+                break
+        decodes = [r for r in self.running
+                   if not r.done and not r.prefilling
+                   and r.remaining > 0]
+        if chunk is not None or decodes:
+            return ("step", (chunk, decodes))
         return ("idle", None)
 
     # -- engine callbacks -----------------------------------------------
     def begin_prefill(self, request):
-        """Pop from waiting, allocate the prompt's blocks."""
+        """Pop from waiting, allocate the prompt's blocks — consulting
+        the prefix index, so a cached prefix is shared (refcounted) and
+        prefill starts at the first uncached block."""
         assert self.waiting and self.waiting[0] is request
-        if not self.cache.allocate(request.id, len(request.prompt)):
+        if not self.cache.allocate(request.id, len(request.prompt),
+                                   tokens=request.prompt):
             raise RuntimeError(
                 f"allocation for {request.id!r} raced the free list")
+        request.cached_prefix = self.cache.cached_prefix_len(request.id)
+        request.num_computed = request.cached_prefix
         self.waiting.popleft()
         self.running.append(request)
 
     def finish(self, request):
-        """Return a finished (or dead) request's blocks to the pool."""
-        self.cache.free(request.id)
+        """Return a finished (or dead) request's blocks to the pool,
+        indexing its full blocks so a follow-up sharing the prompt
+        still hits."""
+        self.cache.free(request.id,
+                        tokens=self._written_tokens(request))
         if request in self.running:
             self.running.remove(request)
         request.row = None
@@ -184,17 +238,35 @@ class ContinuousBatchingScheduler:
             return None
         return max(candidates, key=lambda r: r.arrival)
 
+    def _written_tokens(self, request):
+        """The token list actually WRITTEN to the request's blocks —
+        what `free(tokens=)` may safely hash.  Mid-prefill, only
+        ``num_computed`` prompt tokens landed (the rest of the
+        allocation is unwritten); after prefill, everything up to the
+        cache length (the last sampled token is not yet scattered)."""
+        full = list(request.prompt) + list(request.generated)
+        written = request.num_computed
+        if not request.prefilling and request.id in self.cache:
+            written = self.cache.length(request.id)
+        return full[:written]
+
     def requeue(self, request, tokens_so_far):
         """Evict ``request`` and put it back at the head of the waiting
-        queue, its prompt extended by everything generated so far, so the
-        resumed prefill recomputes the evicted K/V exactly."""
-        self.cache.free(request.id)
+        queue, its prompt extended by everything generated so far.  The
+        written blocks are prefix-indexed on free, so the resumed
+        prefill SKIPS every block still cached and recomputes only what
+        the pool actually reclaimed."""
+        self.cache.free(request.id,
+                        tokens=self._written_tokens(request))
         if request in self.running:
             self.running.remove(request)
         request.prompt = list(request.prompt) + list(tokens_so_far)
-        request.max_new_tokens = request.max_new_tokens - len(tokens_so_far)
+        request.max_new_tokens = (request.max_new_tokens
+                                  - len(tokens_so_far))
         request.generated = []
         request.n_scheduled = 0
+        request.num_computed = 0
+        request.cached_prefix = 0
         request.row = None
         request.preemptions += 1
         self.waiting.appendleft(request)

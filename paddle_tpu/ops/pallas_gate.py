@@ -146,9 +146,26 @@ def _probe_paged_attention():
     jax.block_until_ready(fn(q, pool, pool))
 
 
+def _probe_ragged_attention():
+    from . import pallas_ragged as pr
+    block_q = pr.ragged_q_block(jnp.float32)
+    nqb = 3                       # one 2-block prefill + one decode
+    q = jnp.zeros((nqb * block_q, 2, 64), jnp.float32)
+    pool = jnp.zeros((4, 2, 16, 64), jnp.float32)
+    bt = jnp.array([[1, 2], [3, 0]], jnp.int32)
+    cl = jnp.array([20, 5], jnp.int32)
+    sid = jnp.array([0, 0, 1], jnp.int32)
+    qs = jnp.array([4, 4 + block_q, 4], jnp.int32)
+    qv = jnp.array([block_q, block_q, 1], jnp.int32)
+    fn = jax.jit(lambda q, kp, vp: pr.ragged_paged_attention(
+        q, kp, vp, bt, cl, sid, qs, qv, block_q=block_q))
+    jax.block_until_ready(fn(q, pool, pool))
+
+
 _PROBES = {
     "flash_attention": _probe_flash_attention,
     "paged_attention": _probe_paged_attention,
+    "ragged_attention": _probe_ragged_attention,
     "layer_norm": _probe_layer_norm,
     "layer_norm_residual": _probe_layer_norm_residual,
     "matmul_epilogue": _probe_matmul_epilogue,
@@ -172,6 +189,10 @@ def _static_diagnose(kernel):
     if kernel == "paged_attention":
         return list(tiling.audit_paged_attention(
             2, 64, 16, num_blocks=4, dtype=jnp.float32))
+    if kernel == "ragged_attention":
+        return list(tiling.audit_ragged_attention(
+            2, 64, 16, num_q_blocks=3, num_blocks=4, table_width=2,
+            dtype=jnp.float32))
     if kernel == "layer_norm_residual":
         diags = []
         for direction in ("fwd", "bwd"):

@@ -1061,7 +1061,13 @@ def _paged_attn_kernel(bt_ref, cl_ref, q_ref, k_ref, v_ref, o_ref,
         l = l_ref[:, :1]
         l_safe = jnp.where(l == 0.0, 1.0, l)
         out = acc_ref[...] / l_safe
-        out = jnp.where(l > 0.0, out, 0.0)              # ctx==0 pad row
+        # ctx==0 pad row -> zeros.  Broadcast the f32 stat and compare
+        # at full shape, never broadcast the (rows, 1) predicate: the
+        # Mosaic lowering of a bool broadcast_in_dim expands i1 through
+        # an integer select/compare whose width follows the x64 mode AT
+        # LOWERING TIME (outside the _x32 scope), and the layout pass
+        # aborts on i64 ("bitwidth_ <= 32").
+        out = jnp.where(jnp.broadcast_to(l, out.shape) > 0.0, out, 0.0)
         o_ref[...] = out[:1][None, None].astype(o_ref.dtype)
 
 

@@ -1,0 +1,277 @@
+"""Ragged paged attention: ONE kernel for mixed prefill+decode batches.
+
+The serving engine (inference/serving/engine.py) packs every scheduled
+token of a step — one prefill *chunk* plus every decode row — into a
+single flat, block-aligned query buffer
+
+    q: [T, H, D]      T = num_q_blocks * block_q
+
+where each sequence owns a run of whole ``block_q``-row q-blocks
+(*Ragged Paged Attention*, PAPERS.md / arxiv 2604.15464).  Three
+per-q-block scalar arrays describe the ragged layout:
+
+    seq_ids[i]   which sequence q-block ``i`` belongs to
+                 (``num_seqs`` = null segment: all rows padding)
+    q_starts[i]  absolute KV position of the block's first row,
+                 i.e. ``context_len - query_len + i_local * block_q``
+    q_valids[i]  valid rows in the block (trailing rows are padding)
+
+K/V live in the PR-5 paged pool ``[num_blocks, H, block_size, D]``;
+``block_tables [S, W]`` / ``context_lens [S]`` are scalar-prefetched
+exactly like `paged_attention`, and the grid is
+
+    (num_q_blocks, num_heads, W)     w innermost, sequential
+
+so the online-softmax state (acc/m/l) in VMEM scratch survives the
+walk over a sequence's KV blocks.  Causal masking happens inside each
+ragged segment: row ``r`` of q-block ``i`` sees KV position ``c`` iff
+
+    r < q_valids[i]  and  c <= q_starts[i] + r  and  c < context_len
+
+which makes a decode row (query_len 1, start ``ctx-1``) and a prefill
+chunk row fall out of the same predicate.  A fully masked row keeps
+``l == 0`` and emits exact zeros — the same any-visible semantics as
+the XLA fallback (`serving/attention._ragged_ref`) and the dense paged
+kernel.
+
+Gated through ``pallas_gate`` ("ragged_attention" probe);
+`ragged_block_plan` exports the exact specs for
+`analysis.tiling.audit_ragged_attention` / tpu_lint.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from .pallas_kernels import (_NEG_INF, _STAT_LANES, _demote_f64,
+                             _interpret, _kernel_span, _lanes, _min_rows,
+                             _x32)
+
+__all__ = ["ragged_paged_attention", "ragged_block_plan",
+           "ragged_q_block", "ragged_segments"]
+
+
+def ragged_q_block(dtype) -> int:
+    """Rows per ragged q-block: the Mosaic minimum sublane count for
+    ``dtype`` (8 f32 / 16 bf16), never below the stat-lane width."""
+    return max(_STAT_LANES, _min_rows(jnp.dtype(dtype)))
+
+
+def ragged_segments(query_lens, context_lens, block_q,
+                    num_q_blocks=None, num_seqs=None):
+    """Host-side ragged layout for a mixed batch (numpy, no tracing).
+
+    Returns ``(seq_ids, q_starts, q_valids, offsets, total_rows)``:
+    per-q-block descriptor arrays (padded to ``num_q_blocks`` with the
+    ``num_seqs`` null segment when given) plus each sequence's flat row
+    offset and the total flat rows used.
+    """
+    query_lens = [int(x) for x in query_lens]
+    context_lens = [int(x) for x in context_lens]
+    if num_seqs is None:
+        num_seqs = len(query_lens)
+    sids, starts, valids, offsets = [], [], [], []
+    off = 0
+    for s, (ql, cl) in enumerate(zip(query_lens, context_lens)):
+        offsets.append(off)
+        if ql == 0:
+            continue
+        if ql > cl:
+            raise ValueError(
+                f"sequence {s}: query_len {ql} > context_len {cl}")
+        base = cl - ql
+        nseg = -(-ql // block_q)
+        for j in range(nseg):
+            sids.append(s)
+            starts.append(base + j * block_q)
+            valids.append(min(block_q, ql - j * block_q))
+        off += nseg * block_q
+    if num_q_blocks is not None:
+        if len(sids) > num_q_blocks:
+            raise ValueError(
+                f"{len(sids)} q-blocks exceed budget {num_q_blocks}")
+        pad = num_q_blocks - len(sids)
+        sids += [num_seqs] * pad
+        starts += [0] * pad
+        valids += [0] * pad
+    return (np.asarray(sids, np.int32), np.asarray(starts, np.int32),
+            np.asarray(valids, np.int32),
+            np.asarray(offsets, np.int32), off)
+
+
+def _ragged_attn_kernel(bt_ref, cl_ref, sid_ref, qs_ref, qv_ref,
+                        q_ref, k_ref, v_ref, o_ref,
+                        acc_ref, m_ref, l_ref, *, block_size, block_q,
+                        scale, w_last):
+    """One (q-block, head, table-slot) program over the paged pool.
+
+    Scalar-prefetched ``seq_ids`` route each q-block to its sequence's
+    block table; the null segment (``seq_ids == num_seqs``) reads
+    ``context_len 0`` from the padded tail of ``cl_ref`` so its guard
+    never fires and the emit writes zeros.
+    """
+    i = pl.program_id(0)
+    w = pl.program_id(2)
+    sid = sid_ref[i]
+    ctx = cl_ref[sid]
+    qs = qs_ref[i]
+    qv = qv_ref[i]
+
+    @pl.when(w == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+        m_ref[...] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+
+    @pl.when(w * block_size < ctx)
+    def _block():
+        q = q_ref[0].astype(jnp.float32)                # (bq, D)
+        k = k_ref[0, 0].astype(jnp.float32)             # (bs, D)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale  # (bq, bs)
+        row = jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+        col = (jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+               + w * block_size)
+        # causal inside the ragged segment: row r sits at absolute
+        # position qs + r and padding rows (r >= qv) see nothing
+        mask = (row < qv) & (col <= row + qs) & (col < ctx)
+        s = jnp.where(mask, s, _NEG_INF)
+        m_prev = m_ref[:, :1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.where(mask, jnp.exp(s - m_new), 0.0)
+        alpha = jnp.exp(m_prev - m_new)
+        l_ref[...] = _lanes(alpha * l_ref[:, :1]
+                            + jnp.sum(p, axis=-1, keepdims=True))
+        v = v_ref[0, 0].astype(jnp.float32)             # (bs, D)
+        acc_ref[...] = acc_ref[...] * alpha + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_ref[...] = _lanes(m_new)
+
+    @pl.when(w == w_last)
+    def _emit():
+        l = l_ref[:, :1]
+        l_safe = jnp.where(l == 0.0, 1.0, l)
+        out = acc_ref[...] / l_safe
+        # masked/null rows -> zeros.  Broadcast the f32 stat, never the
+        # (bq, 1) predicate: Mosaic lowers a bool broadcast_in_dim
+        # through an integer select/compare whose width follows the x64
+        # mode at LOWERING time (outside _x32) and aborts on i64
+        # ("bitwidth_ <= 32") — see _paged_attn_kernel.
+        out = jnp.where(jnp.broadcast_to(l, out.shape) > 0.0, out, 0.0)
+        o_ref[...] = out[None].astype(o_ref.dtype)
+
+
+@_x32
+def ragged_paged_attention(q, k_pool, v_pool, block_tables, context_lens,
+                           seq_ids, q_starts, q_valids, block_q=None,
+                           scale=None):
+    """Mixed prefill+decode attention over the paged KV pool.
+
+    q: [T, H, D] flat block-aligned ragged queries (T % block_q == 0);
+    k_pool/v_pool: [num_blocks, H, block_size, D];
+    block_tables: [S, W] int32; context_lens: [S] int32;
+    seq_ids/q_starts/q_valids: [T // block_q] int32 (see module doc;
+    ``seq_ids == S`` marks a null/pad q-block).  Returns [T, H, D].
+    """
+    q, k_pool, v_pool = _demote_f64(q, k_pool, v_pool)
+    T, H, D = q.shape
+    if block_q is None:
+        block_q = ragged_q_block(q.dtype)
+    block_q = int(block_q)
+    if T % block_q:
+        raise ValueError(f"flat query rows {T} not a multiple of "
+                         f"block_q {block_q}")
+    nqb = T // block_q
+    if seq_ids.shape[0] != nqb:
+        raise ValueError(f"{seq_ids.shape[0]} segment descriptors for "
+                         f"{nqb} q-blocks")
+    num_blocks, _, block_size, _ = k_pool.shape
+    S, W = block_tables.shape
+    if scale is None:
+        scale = 1.0 / (D ** 0.5)
+
+    qt = jnp.swapaxes(q, 0, 1)                          # [H, T, D]
+    # null segment: seq_ids == S indexes the appended zero row / zero
+    # context so the kernel's guard skips every KV block
+    bt = jnp.concatenate(
+        [block_tables.astype(jnp.int32),
+         jnp.zeros((1, W), jnp.int32)], axis=0)          # [S+1, W]
+    cl = jnp.concatenate(
+        [context_lens.astype(jnp.int32),
+         jnp.zeros((1,), jnp.int32)], axis=0)            # [S+1]
+    sid = seq_ids.astype(jnp.int32)
+    qs = q_starts.astype(jnp.int32)
+    qv = q_valids.astype(jnp.int32)
+
+    with _kernel_span("ragged_attention", "fwd"):
+        out = pl.pallas_call(
+            functools.partial(
+                _ragged_attn_kernel, block_size=block_size,
+                block_q=block_q, scale=float(scale), w_last=W - 1),
+            grid_spec=pltpu.PrefetchScalarGridSpec(
+                num_scalar_prefetch=5,
+                grid=(nqb, H, W),
+                in_specs=[
+                    pl.BlockSpec(
+                        (1, block_q, D),
+                        lambda i, h, w, bt, cl, sid, qs, qv: (h, i, 0)),
+                    pl.BlockSpec(
+                        (1, 1, block_size, D),
+                        lambda i, h, w, bt, cl, sid, qs, qv:
+                            (bt[sid[i], w], h, 0, 0)),
+                    pl.BlockSpec(
+                        (1, 1, block_size, D),
+                        lambda i, h, w, bt, cl, sid, qs, qv:
+                            (bt[sid[i], w], h, 0, 0)),
+                ],
+                out_specs=pl.BlockSpec(
+                    (1, block_q, D),
+                    lambda i, h, w, bt, cl, sid, qs, qv: (h, i, 0)),
+                scratch_shapes=[
+                    pltpu.VMEM((block_q, D), jnp.float32),
+                    pltpu.VMEM((block_q, _STAT_LANES), jnp.float32),
+                    pltpu.VMEM((block_q, _STAT_LANES), jnp.float32),
+                ],
+            ),
+            out_shape=jax.ShapeDtypeStruct((H, T, D), q.dtype),
+            interpret=_interpret(),
+        )(bt, cl, sid, qs, qv, qt, k_pool, v_pool)
+    return jnp.swapaxes(out, 0, 1)                      # [T, H, D]
+
+
+def ragged_block_plan(num_heads, head_dim, block_size, num_q_blocks=4,
+                      block_q=None, num_blocks=64, table_width=8,
+                      dtype=jnp.float32):
+    """The ragged mixed-batch attention block plan (see
+    `ragged_paged_attention`).  Scalar-prefetch operands (block tables,
+    context lens, segment descriptors) are untiled and omitted, like
+    `paged_block_plan`."""
+    dtype = jnp.dtype(dtype)
+    f32 = jnp.dtype(jnp.float32)
+    if block_q is None:
+        block_q = ragged_q_block(dtype)
+    D = head_dim
+    T = num_q_blocks * block_q
+    pool = (num_blocks, num_heads, block_size, D)
+    return {
+        "grid": (num_q_blocks, num_heads, table_width),
+        "block_q": block_q,
+        "operands": [
+            ("q", (1, block_q, D), (num_heads, T, D), dtype),
+            ("k_pool", (1, 1, block_size, D), pool, dtype),
+            ("v_pool", (1, 1, block_size, D), pool, dtype),
+            ("out", (1, block_q, D), (num_heads, T, D), dtype),
+        ],
+        "scratch": (
+            ((block_q, D), f32),
+            ((block_q, _STAT_LANES), f32),
+            ((block_q, _STAT_LANES), f32),
+        ),
+    }

@@ -20,6 +20,9 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 # compile-only TPU client (overrides any inherited JAX_PLATFORMS=axon/tpu)
 os.environ["JAX_PLATFORMS"] = "cpu"
 os.environ.setdefault("TPU_WORKER_HOSTNAMES", "localhost")
+# skip libtpu's GCP metadata-server queries: off-GCP each env var lookup
+# retries 30x and client startup takes ~7 min instead of ~0 s
+os.environ.setdefault("TPU_SKIP_MDS_QUERY", "1")
 
 import jax
 import jax.numpy as jnp
@@ -27,11 +30,14 @@ from jax.experimental import topologies
 
 import paddle_tpu.ops.pallas_fused as pf
 import paddle_tpu.ops.pallas_kernels as pk
+import paddle_tpu.ops.pallas_ragged as pr
 
 # lower the non-interpret (Mosaic) path even though we trace on CPU
-# (pallas_fused binds _interpret by value at import — patch both)
+# (pallas_fused/pallas_ragged bind _interpret by value at import —
+# patch all three)
 pk._interpret = lambda: False
 pf._interpret = lambda: False
+pr._interpret = lambda: False
 
 TOPOLOGY = os.environ.get("PADDLE_TPU_AOT_TOPOLOGY", "v5e:2x2x1")
 topo = topologies.get_topology_desc(TOPOLOGY, "tpu")
@@ -99,6 +105,28 @@ for tag, (m, k, n) in [("bert_ffn", (768, 768, 3072)),
         jax.grad(lambda x, w, b: pf.fused_linear_act(
             x, w, b, "gelu_tanh").astype(f32).sum(), argnums=(0, 1, 2)),
         ((m, k), bf16), ((k, n), bf16), ((n,), bf16))
+
+# paged decode attention (scalar-prefetched block tables): the index
+# maps trace at lower time outside the _x32 scope, which is exactly
+# what this compile-only pipeline catches and interpret mode cannot
+for tag, dt in [("f32", f32), ("bf16", bf16)]:
+    B, H, D, bs, W, NB = 4, 8, 64, 16, 8, 128
+    ok &= aot_compile(
+        f"paged_attn {tag}", pk.paged_attention,
+        ((B, 1, H, D), dt), ((NB, H, bs, D), dt), ((NB, H, bs, D), dt),
+        ((B, W), i32), ((B,), i32))
+
+# ragged mixed prefill+decode attention at serving shapes (the unified
+# step dispatches this for every mixed batch; descriptors are runtime
+# operands, so one compile covers every packing)
+for tag, dt in [("f32", f32), ("bf16", bf16)]:
+    bq = pr.ragged_q_block(dt)
+    T, H, D, bs, W, S, NB = 4 * bq, 8, 64, 16, 8, 8, 128
+    ok &= aot_compile(
+        f"ragged_attn {tag}", pr.ragged_paged_attention,
+        ((T, H, D), dt), ((NB, H, bs, D), dt), ((NB, H, bs, D), dt),
+        ((S, W), i32), ((S,), i32), ((4,), i32), ((4,), i32),
+        ((4,), i32))
 
 # softmax xent at LM-head shapes
 for tag, (rows, v) in [("bert", (768, 30522)), ("llama", (512, 32000))]:

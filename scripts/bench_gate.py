@@ -1,0 +1,160 @@
+#!/usr/bin/env python
+"""bench_gate: perf regression gate over the rolling last-good capture.
+
+    python scripts/bench_gate.py [--threshold 0.05]
+                                 [--last-good BENCH_LAST_GOOD.json]
+                                 [--fresh PATH] [--json]
+
+ROADMAP item 5: runs ``bench.py`` in a subprocess for a FRESH capture
+(or reads one from ``--fresh``), loads the repo-root
+``BENCH_LAST_GOOD.json`` rolling artifact that bench.py maintains, and
+compares every shared higher-is-better throughput metric — the
+headline plus all ``*_tokens_per_sec`` / ``*_imgs_per_sec`` entries in
+``extra_metrics``.  Exits 1 iff any shared metric regressed by more
+than ``--threshold`` (default 5%).
+
+A missing last-good artifact, an unreachable TPU, or a cached
+(re-emitted, non-live) fresh capture is a SKIP — exit 0 with a loud
+note — not a pass and not a failure: the gate only judges
+live-vs-live numbers from the same platform, mirroring bench.py's own
+"never exit 1 for a dead tunnel" rule.  The fresh capture is archived
+to ``.bench_cache/gate_capture.json`` either way.
+"""
+import argparse
+import json
+import os
+import subprocess
+import sys
+from pathlib import Path
+
+ROOT = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(ROOT))
+
+GATE_SUFFIXES = ("_tokens_per_sec", "_imgs_per_sec")
+
+
+def log(msg):
+    print(f"[bench_gate] {msg}", file=sys.stderr, flush=True)
+
+
+def capture_fresh(timeout_s):
+    """Run bench.py in a subprocess; its contract is ONE JSON line on
+    stdout (diagnostics go to stderr)."""
+    cmd = [sys.executable, str(ROOT / "bench.py")]
+    log("capturing fresh: " + " ".join(cmd))
+    proc = subprocess.run(cmd, cwd=str(ROOT), stdout=subprocess.PIPE,
+                          timeout=timeout_s, text=True)
+    if proc.returncode != 0:
+        raise RuntimeError(f"bench.py exited rc={proc.returncode}")
+    lines = [ln for ln in proc.stdout.splitlines() if ln.strip()]
+    if not lines:
+        raise RuntimeError("bench.py printed no JSON line")
+    return json.loads(lines[-1])
+
+
+def gated_metrics(payload):
+    """{name: value} of the headline + throughput extra metrics."""
+    out = {}
+    if payload.get("metric") and payload.get("value", 0) > 0:
+        out[payload["metric"]] = float(payload["value"])
+    for name, v in (payload.get("extra_metrics") or {}).items():
+        if name.endswith(GATE_SUFFIXES) and isinstance(v, (int, float)) \
+                and v > 0:
+            out[name] = float(v)
+    return out
+
+
+def compare(last_good, fresh, threshold):
+    """(regressions, rows) over metrics present in BOTH captures."""
+    old = gated_metrics(last_good)
+    new = gated_metrics(fresh)
+    rows, regressions = [], []
+    for name in sorted(set(old) & set(new)):
+        delta = new[name] / old[name] - 1.0
+        verdict = "ok"
+        if delta < -threshold:
+            verdict = "REGRESSION"
+            regressions.append(name)
+        rows.append({"metric": name, "last_good": old[name],
+                     "fresh": new[name], "delta": round(delta, 4),
+                     "verdict": verdict})
+    return regressions, rows
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--threshold", type=float, default=0.05,
+                    help="max tolerated fractional drop (default 0.05)")
+    ap.add_argument("--last-good",
+                    default=str(ROOT / "BENCH_LAST_GOOD.json"),
+                    help="rolling last-good artifact written by bench.py")
+    ap.add_argument("--fresh", default=None,
+                    help="use this capture JSON instead of running "
+                         "bench.py (testing / re-judging a capture)")
+    ap.add_argument("--timeout", type=int, default=5400,
+                    help="bench.py subprocess timeout in seconds")
+    ap.add_argument("--json", action="store_true",
+                    help="print a machine-readable verdict")
+    args = ap.parse_args(argv)
+
+    def emit(status, rows=(), note=""):
+        if args.json:
+            print(json.dumps({"status": status, "note": note,
+                              "threshold": args.threshold,
+                              "rows": list(rows)}, indent=1))
+        else:
+            for r in rows:
+                print(f"  {r['verdict']:>10}  {r['metric']}: "
+                      f"{r['last_good']:,.1f} -> {r['fresh']:,.1f} "
+                      f"({r['delta']:+.1%})")
+            print(f"bench_gate: {status}" + (f" — {note}" if note else ""))
+
+    last_path = Path(args.last_good)
+    if not last_path.exists():
+        emit("SKIP", note=f"no last-good artifact at {last_path}; "
+             "nothing to compare against (bench.py writes it on the "
+             "first healthy capture)")
+        return 0
+    last_good = json.loads(last_path.read_text())
+
+    if args.fresh:
+        fresh = json.loads(Path(args.fresh).read_text())
+    else:
+        fresh = capture_fresh(args.timeout)
+    try:
+        archive = ROOT / ".bench_cache" / "gate_capture.json"
+        archive.parent.mkdir(exist_ok=True)
+        archive.write_text(json.dumps(fresh, indent=1))
+    except Exception as e:
+        log(f"archive write failed: {e}")
+
+    if fresh.get("tpu_unreachable") or fresh.get("tpu_unreachable_now") \
+            or fresh.get("cached") or not fresh.get("value", 0) > 0:
+        emit("SKIP", note="fresh capture is not a live measurement "
+             "(unreachable TPU or re-emitted cache); refusing to judge")
+        return 0
+    if last_good.get("platform") != fresh.get("platform"):
+        emit("SKIP", note=f"platform mismatch: last-good "
+             f"{last_good.get('platform')} vs fresh "
+             f"{fresh.get('platform')}")
+        return 0
+
+    regressions, rows = compare(last_good, fresh, args.threshold)
+    if not rows:
+        emit("SKIP", note="no shared throughput metrics between the "
+             "two captures")
+        return 0
+    if regressions:
+        emit("FAIL", rows, note=f"{len(regressions)} metric(s) dropped "
+             f">{args.threshold:.0%} vs "
+             f"{last_good.get('git_rev', '?')} "
+             f"({last_good.get('captured_at', '?')})")
+        return 1
+    emit("PASS", rows,
+         note=f"no metric dropped >{args.threshold:.0%} vs "
+         f"{last_good.get('git_rev', '?')}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -140,7 +140,8 @@ def lint_gpt():
 def lint_pallas():
     """Fused-suite block plans vs the Mosaic tiling rules: flash
     attention (fwd + both backward passes), layernorm+residual and
-    matmul-epilogue fusion (fwd + bwd), paged decode attention."""
+    matmul-epilogue fusion (fwd + bwd), paged decode attention, ragged
+    mixed prefill+decode attention."""
     import jax.numpy as jnp
     from paddle_tpu import analysis
     from paddle_tpu.analysis.diagnostics import DiagnosticReport, record
@@ -164,6 +165,13 @@ def lint_pallas():
                                        block_size=16, num_blocks=64,
                                        dtype=jnp.bfloat16)
     report.extend(r.diagnostics)
+    for dtype in (jnp.float32, jnp.bfloat16):
+        r = analysis.audit_ragged_attention(num_heads=8, head_dim=64,
+                                            block_size=16,
+                                            num_q_blocks=8,
+                                            num_blocks=64,
+                                            dtype=dtype)
+        report.extend(r.diagnostics)
     for d in report.diagnostics:
         record(d)
     return report

@@ -236,6 +236,100 @@ def test_paged_attention_kernel_matches_fallback():
 
 
 # ---------------------------------------------------------------------
+# Ragged mixed prefill+decode attention (pallas_ragged)
+# ---------------------------------------------------------------------
+def _ragged_case(query_lens, context_lens, dtype, seed=30, H=4, D=32,
+                 bs=16, W=4, pad_blocks=0):
+    """Build a ragged batch + paged pool and return (kernel, fallback)
+    outputs at the given dtype."""
+    from paddle_tpu.inference.serving.attention import _ragged_ref
+    from paddle_tpu.ops import pallas_ragged as pr
+
+    block_q = pr.ragged_q_block(dtype)
+    S = len(query_lens)
+    sid, qs, qv, _, rows = pr.ragged_segments(query_lens, context_lens,
+                                              block_q)
+    nqb = len(sid) + pad_blocks
+    sid, qs, qv, _, _ = pr.ragged_segments(query_lens, context_lens,
+                                           block_q, num_q_blocks=nqb,
+                                           num_seqs=S)
+    nb = S * W + 1
+    kq, kk, kv = jax.random.split(jax.random.PRNGKey(seed), 3)
+    q = jax.random.normal(kq, (nqb * block_q, H, D),
+                          jnp.float32).astype(dtype)
+    k_pool = jax.random.normal(kk, (nb, H, bs, D),
+                               jnp.float32).astype(dtype)
+    v_pool = jax.random.normal(kv, (nb, H, bs, D),
+                               jnp.float32).astype(dtype)
+    tables = np.zeros((S, W), np.int32)
+    for s, ctx in enumerate(context_lens):
+        for w in range(-(-int(ctx) // bs)):
+            tables[s, w] = 1 + s * W + w
+    bt = jnp.asarray(tables)
+    cl = jnp.asarray(np.asarray(context_lens, np.int32))
+    sid, qs, qv = jnp.asarray(sid), jnp.asarray(qs), jnp.asarray(qv)
+    scale = 1.0 / D ** 0.5
+    out = pr.ragged_paged_attention(q, k_pool, v_pool, bt, cl, sid, qs,
+                                    qv, block_q=block_q, scale=scale)
+    ref = _ragged_ref(q, k_pool, v_pool, bt, cl, sid, qs, qv, block_q,
+                      scale)
+    return np.asarray(out, np.float32), np.asarray(ref, np.float32)
+
+
+_RAGGED_CASES = {
+    # every row a single-token decode step (the PR-5 steady state)
+    "pure_decode": ([1, 1, 1], [60, 17, 5]),
+    # one prompt prefilled whole (query == context, multiple q-blocks)
+    "pure_prefill": ([20], [20]),
+    # prefill chunk + two decode rows in ONE batch
+    "mixed": ([12, 1, 1], [30, 25, 9]),
+    # chunk starting mid-prompt exactly at a q-block boundary
+    # (query_len a multiple of block_q, base context > 0)
+    "chunk_boundary": ([16, 1], [48, 33]),
+}
+
+
+@pytest.mark.parametrize("case", sorted(_RAGGED_CASES))
+@pytest.mark.parametrize("dtype", [jnp.float32, jnp.bfloat16])
+def test_ragged_attention_kernel_matches_fallback(case, dtype):
+    """Ragged mixed-batch kernel vs the pure-XLA segment-gather
+    fallback, at the paged-attention parity tolerance for f32."""
+    qls, ctxs = _RAGGED_CASES[case]
+    out, ref = _ragged_case(qls, ctxs, dtype)
+    tol = 2e-5 if dtype == jnp.float32 else 2e-2
+    np.testing.assert_allclose(out, ref, atol=tol, rtol=tol)
+
+
+def test_ragged_attention_null_segments_emit_zeros():
+    """ctx==0 rows: a sequence with nothing cached plus trailing pad
+    q-blocks (seq_ids == S) must emit exact zeros, not NaN."""
+    from paddle_tpu.ops import pallas_ragged as pr
+    block_q = pr.ragged_q_block(jnp.float32)
+    out, ref = _ragged_case([1, 0], [25, 0], jnp.float32, pad_blocks=2)
+    np.testing.assert_allclose(out, ref, atol=2e-5, rtol=2e-5)
+    # the ctx==0 sequence schedules no queries; blocks 1-2 are pure
+    # pad segments and must come back as exact zeros
+    assert out.shape[0] == 3 * block_q
+    assert float(np.abs(out[block_q:]).sum()) == 0.0
+
+
+def test_ragged_segments_layout():
+    """Host-side descriptor builder: segment split, padding sentinel,
+    and the over-budget guard."""
+    from paddle_tpu.ops import pallas_ragged as pr
+    sid, qs, qv, offs, rows = pr.ragged_segments(
+        [12, 1, 0, 1], [30, 25, 7, 9], 8, num_q_blocks=6)
+    assert sid.tolist() == [0, 0, 1, 3, 4, 4]   # seq 2 has no queries
+    assert qs.tolist() == [18, 26, 24, 8, 0, 0]
+    assert qv.tolist() == [8, 4, 1, 1, 0, 0]
+    assert offs.tolist() == [0, 16, 24, 24] and rows == 32
+    with pytest.raises(ValueError):
+        pr.ragged_segments([12], [30], 8, num_q_blocks=1)
+    with pytest.raises(ValueError):
+        pr.ragged_segments([31], [30], 8)       # query > context
+
+
+# ---------------------------------------------------------------------
 # Fused training suite (pallas_fused + bf16 flash parity)
 # ---------------------------------------------------------------------
 from paddle_tpu.ops import pallas_fused as pf  # noqa: E402

@@ -1,7 +1,8 @@
-"""LLM serving: paged KV cache, paged attention, continuous batching,
-GenerationEngine, and the seeded sampling ops.
+"""LLM serving: paged KV cache with COW prefix caching, ragged
+attention, chunked-prefill continuous batching, GenerationEngine, and
+the seeded sampling ops.
 
-CPU tier-1: the paged attention runs its pure-XLA fallback here (the
+CPU tier-1: the ragged attention runs its pure-XLA fallback here (the
 Pallas kernel itself is covered in interpret mode by
 tests/test_pallas_kernels.py), so these tests exercise the exact
 semantics the TPU path serves.
@@ -12,8 +13,7 @@ import pytest
 import paddle_tpu as paddle
 from paddle_tpu.inference.serving import (ContinuousBatchingScheduler,
                                           GenerationEngine, PagedKVCache,
-                                          Request, bucket_for,
-                                          length_buckets)
+                                          PrefillChunk, Request)
 from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLM
 
 pytestmark = pytest.mark.serve
@@ -25,7 +25,8 @@ VOCAB = 97
 def _serving_env(monkeypatch):
     for var in ("PADDLE_TPU_HBM_BUDGET", "PADDLE_TPU_MEMORY_GUARD",
                 "PADDLE_TPU_KV_BLOCK_SIZE", "PADDLE_TPU_MAX_BATCH",
-                "PADDLE_TPU_PIPELINE_DEPTH"):
+                "PADDLE_TPU_PIPELINE_DEPTH", "PADDLE_TPU_PREFIX_CACHE",
+                "PADDLE_TPU_PREFILL_CHUNK"):
         monkeypatch.delenv(var, raising=False)
     yield
 
@@ -186,32 +187,136 @@ def test_paged_attention_matches_dense():
 
 
 # ---------------------------------------------------------------------
+# COW prefix cache
+# ---------------------------------------------------------------------
+def test_prefix_cache_hash_hit_and_refcounts():
+    c = PagedKVCache(num_layers=1, num_heads=2, head_dim=8,
+                     block_size=4, num_blocks=10, max_model_len=40,
+                     register=False)
+    p = list(range(1, 13))                     # 3 full blocks
+    assert c.allocate("a", 12, tokens=p)
+    assert c.cached_prefix_len("a") == 0       # cold cache
+    c.commit_prefix("a", p)
+    # same prompt again: the first two blocks are shared; the reuse cap
+    # (num_tokens - 1) keeps the last block computed for logits
+    assert c.allocate("b", 12, tokens=p)
+    assert c.cached_prefix_len("b") == 8
+    assert c.shared_blocks == 2
+    s = c.stats()
+    assert s["logical_blocks"] == 6 and s["physical_blocks"] == 4
+    assert c.prefix_hit_rate == pytest.approx(8 / 24)
+    # a third reader piles onto the same physical blocks
+    assert c.allocate("d", 12, tokens=p)
+    assert c.blocks_in_use == 5 and c.shared_blocks == 2
+
+
+def test_prefix_cache_cow_split_on_write():
+    c = PagedKVCache(num_layers=1, num_heads=2, head_dim=8,
+                     block_size=4, num_blocks=10, max_model_len=40,
+                     register=False)
+    p = list(range(1, 13))
+    assert c.allocate("a", 12, tokens=p)
+    c.commit_prefix("a", p)
+    assert c.allocate("b", 12, tokens=p)       # shares blocks 0 and 1
+    shared = c._tables["b"][1]
+    assert c._tables["a"][1] == shared
+    # roll b back into the shared block, then write: the write must
+    # COW-split instead of corrupting a's copy
+    c.truncate("b", 6)
+    assert c.shared_blocks == 2                # truncate never splits
+    assert c.append("b", 1)
+    assert c.cow_splits == 1 and c.stats()["cow_splits"] == 1
+    assert c._tables["a"][1] == shared         # a keeps the original
+    assert c._tables["b"][1] != shared
+    assert c._tables["b"][0] == c._tables["a"][0]  # block 0 still shared
+
+
+def test_prefix_cache_eviction_order_children_first():
+    c = PagedKVCache(num_layers=1, num_heads=1, head_dim=8,
+                     block_size=4, num_blocks=8, max_model_len=32,
+                     register=False)
+    p = list(range(1, 13))
+    assert c.allocate("a", 12, tokens=p)
+    c.free("a", tokens=p)                      # all 3 full blocks parked
+    assert c.free_blocks == 8 and len(c._cached_free) == 3
+    # pressure evicts the chain TIP first, parents last — a shorter
+    # shared prefix survives as long as possible
+    assert c.allocate("big", 24)               # 6 blocks: evicts one
+    assert len(c._cached_free) == 2
+    assert c.allocate("b", 5, tokens=p[:5])    # root block still hits
+    assert c.cached_prefix_len("b") == 4
+
+
+def test_prefix_cache_truncate_of_shared_block():
+    c = PagedKVCache(num_layers=1, num_heads=1, head_dim=8,
+                     block_size=4, num_blocks=10, max_model_len=40,
+                     register=False)
+    p = list(range(1, 13))
+    assert c.allocate("a", 12, tokens=p)
+    c.commit_prefix("a", p)
+    assert c.allocate("b", 12, tokens=p)
+    used = c.blocks_in_use
+    c.truncate("b", 4)    # drops b's private tail AND one shared block
+    # the shared block just lost a reference — a still reads it
+    assert c.length("a") == 12 and c.shared_blocks == 1
+    assert c.blocks_in_use == used - 1         # only the private block
+    assert c._ref[c._tables["a"][1]] == 1
+    # freeing a parks its (still-indexed) blocks instead of losing them
+    c.free("a", tokens=p)
+    assert c.allocate("d", 12, tokens=p)
+    assert c.cached_prefix_len("d") == 8
+
+
+def test_prefix_cache_disabled_env(monkeypatch):
+    monkeypatch.setenv("PADDLE_TPU_PREFIX_CACHE", "0")
+    c = PagedKVCache(num_layers=1, num_heads=1, head_dim=8,
+                     block_size=4, num_blocks=10, max_model_len=40,
+                     register=False)
+    p = list(range(1, 13))
+    assert c.allocate("a", 12, tokens=p)
+    c.commit_prefix("a", p)
+    assert c.allocate("b", 12, tokens=p)
+    assert c.cached_prefix_len("b") == 0 and c.shared_blocks == 0
+
+
+# ---------------------------------------------------------------------
 # scheduler policy
 # ---------------------------------------------------------------------
-def test_scheduler_admission_and_preemption_order():
+def test_scheduler_admission_chunking_and_preemption_order():
     c = PagedKVCache(num_layers=1, num_heads=1, head_dim=8,
                      block_size=4, num_blocks=6, max_model_len=24,
                      register=False)
-    s = ContinuousBatchingScheduler(c, max_batch=2, buckets=[16, 24])
-    a, b, d = (Request("a", [1] * 6), Request("b", [1] * 6),
-               Request("d", [1] * 6))
+    s = ContinuousBatchingScheduler(c, max_batch=2, prefill_chunk=4)
+    a, b, d = (Request("a", [1] * 6), Request("b", [2] * 6),
+               Request("d", [3] * 6))
     for r in (a, b, d):
         s.submit(r)
     # oldest first; admission respects the free-block budget
     act, req = s.next_action()
-    assert act == "prefill" and req is a
+    assert act == "admit" and req is a
     s.begin_prefill(a)
+    # admission is serialized behind in-flight prefill: the next action
+    # is a's first chunk, not b's admission
+    act, (chunk, decodes) = s.next_action()
+    assert act == "step" and chunk == PrefillChunk(a, 0, 4)
+    assert decodes == []
+    a.num_computed = 4
+    act, (chunk, decodes) = s.next_action()
+    assert chunk == PrefillChunk(a, 4, 2)      # ragged tail chunk
+    a.num_computed = 6                         # prefill complete
     act, req = s.next_action()
-    assert act == "prefill" and req is b
+    assert act == "admit" and req is b
     s.begin_prefill(b)
-    # batch full (max_batch=2): decode, not a third prefill
-    act, reqs = s.next_action()
-    assert act == "decode" and reqs == [a, b]
+    # batch full (max_batch=2): b's chunk rides with a's decode in ONE
+    # unified step — no separate prefill/decode programs
+    act, (chunk, decodes) = s.next_action()
+    assert act == "step" and chunk.request is b and decodes == [a]
+    b.num_computed = 6
     # youngest running is the preemption victim
     assert s.preempt_youngest() is b
     s.requeue(b, [42, 43])
     assert s.waiting[0] is b and b.prompt[-2:] == [42, 43]
-    assert b.preemptions == 1 and c.blocks_in_use == 2
+    assert b.preemptions == 1 and b.num_computed == 0
     # a prompt that can never fit raises instead of livelocking
     s.finish(a)
     big = Request("big", [1] * 23)
@@ -222,41 +327,83 @@ def test_scheduler_admission_and_preemption_order():
         with pytest.raises(RuntimeError):
             while True:
                 act, req = s.next_action()
-                if act != "prefill":
+                if act != "admit":
                     break
                 s.begin_prefill(req)
     finally:
         c.free("hog")
 
 
-def test_length_buckets():
-    assert length_buckets(100) == [16, 32, 64, 100]
-    assert bucket_for(17, [16, 32, 64]) == 32
-    with pytest.raises(ValueError):
-        bucket_for(65, [16, 32, 64])
+def test_scheduler_requeue_preserves_prefix_credit():
+    """Satellite: a preempted request re-enters with its still-cached
+    prefix blocks instead of re-prefilling from token 0."""
+    c = PagedKVCache(num_layers=1, num_heads=1, head_dim=8,
+                     block_size=4, num_blocks=8, max_model_len=32,
+                     register=False)
+    s = ContinuousBatchingScheduler(c, max_batch=2, prefill_chunk=8)
+    a = Request("a", list(range(1, 9)), max_new_tokens=4)
+    s.submit(a)
+    act, req = s.next_action()
+    assert act == "admit"
+    s.begin_prefill(a)
+    a.num_computed = 8                   # both full blocks written
+    s.requeue(a, [99])                   # preempted after one token
+    assert "a" not in c and a.num_computed == 0
+    # re-admission: the written blocks were hash-indexed on free, so
+    # allocate() shares them and prefill skips the cached prefix
+    act, req = s.next_action()
+    assert act == "admit" and req is a
+    s.begin_prefill(a)
+    assert a.cached_prefix == 8 and a.num_computed == 8
+    assert a.prompt == list(range(1, 9)) + [99]
 
 
 # ---------------------------------------------------------------------
 # engine end-to-end
 # ---------------------------------------------------------------------
 def test_engine_greedy_parity_and_bounded_compiles(gpt_mini):
-    """Greedy decoding through the engine (paged cache, continuous
-    batching, any packing) is token-for-token identical to sequential
-    per-request dense-cache generation, and the mixed workload compiles
-    at most len(buckets) prefill programs + 1 decode program."""
+    """Greedy decoding through the engine (paged cache, chunked
+    prefill, continuous batching, any packing) is token-for-token
+    identical to sequential per-request dense-cache generation, and the
+    whole mixed workload runs through ONE compiled unified step
+    program — the pow2 bucket-compile family is gone."""
     prompts = _prompts((3, 7, 12, 5, 30, 9), seed=0)
     base = [_dense_generate(gpt_mini, p, max_new_tokens=6)
             for p in prompts]
     eng = GenerationEngine(gpt_mini, num_blocks=64, max_batch=3,
-                           max_model_len=64)
+                           max_model_len=64, prefill_chunk=16)
     try:
         res = eng.generate(prompts, max_new_tokens=6)
         assert res == base
         s = eng.stats()
-        assert s["prefill_compiles"] <= len(eng.buckets)
-        assert s["decode_compiles"] == 1
+        assert s["step_compiles"] <= 2
         assert s["blocks_in_use"] == 0        # everything freed
         assert s["high_water"] > 0
+    finally:
+        eng.close()
+
+
+def test_engine_shared_prefix_burst_hits_cache(gpt_mini):
+    """A burst sharing one system prompt pays ~one prefill: every
+    request after the first reuses the shared blocks (greedy output
+    still exactly matches the dense path)."""
+    rng = np.random.RandomState(11)
+    shared = list(rng.randint(1, VOCAB, size=16))   # 4 full 4-blocks
+    prompts = [shared + list(rng.randint(1, VOCAB, size=3 + i))
+               for i in range(4)]
+    base = [_dense_generate(gpt_mini, p, max_new_tokens=5)
+            for p in prompts]
+    eng = GenerationEngine(gpt_mini, num_blocks=64, max_batch=3,
+                           block_size=4, max_model_len=64,
+                           prefill_chunk=16)
+    try:
+        res = eng.generate(prompts, max_new_tokens=5)
+        assert res == base
+        n = len(prompts)
+        assert eng.cache._hit_tokens >= (n - 1) * len(shared)
+        s = eng.stats()
+        assert s["prefix_hit_rate"] > 0.5
+        assert s["step_compiles"] <= 2
     finally:
         eng.close()
 
@@ -266,19 +413,21 @@ def test_engine_greedy_preemption_invariant(gpt_mini):
     flips to the victim's re-prefill) must roll back the KV slots it
     reserved for the surviving rows — a leak silently advances their
     context past the real tokens and they attend over unwritten
-    slots."""
-    prompts = _prompts((3, 7, 12, 5), seed=3)
+    slots.  Tiny prompts admit together under the admission
+    watermark; DECODE GROWTH (3 rows x ~24 tokens vs 8 blocks of 4)
+    then overflows the pool and forces preemption."""
+    prompts = _prompts((2, 3, 4, 3), seed=3)
     ref_eng = GenerationEngine(gpt_mini, num_blocks=64, max_batch=1,
                                max_model_len=64)
     try:
-        ref = [ref_eng.generate([p], max_new_tokens=8)[0]
+        ref = [ref_eng.generate([p], max_new_tokens=20)[0]
                for p in prompts]
     finally:
         ref_eng.close()
     eng = GenerationEngine(gpt_mini, num_blocks=8, block_size=4,
                            max_batch=3, max_model_len=64)
     try:
-        ids = [eng.add_request(p, max_new_tokens=8) for p in prompts]
+        ids = [eng.add_request(p, max_new_tokens=20) for p in prompts]
         while eng.has_unfinished():
             eng.step()
         got = [eng.result(i) for i in ids]
@@ -294,9 +443,10 @@ def test_engine_greedy_preemption_invariant(gpt_mini):
 def test_engine_sampling_schedule_invariant(gpt_mini):
     """Seeded sampling keys on (request seed, absolute position), so a
     preempted, repacked, tiny-pool run draws the same tokens as an
-    unconstrained sequential run."""
-    prompts = _prompts((3, 7, 12, 5, 9, 4), seed=1)
-    kw = dict(max_new_tokens=8, do_sample=True, top_k=20, top_p=0.9,
+    unconstrained sequential run.  Sized like the greedy preemption
+    test: decode growth, not admission pressure, overflows the pool."""
+    prompts = _prompts((2, 3, 4, 2, 3, 4), seed=1)
+    kw = dict(max_new_tokens=20, do_sample=True, top_k=20, top_p=0.9,
               temperature=0.8)
     ref_eng = GenerationEngine(gpt_mini, num_blocks=64, max_batch=1,
                                max_model_len=64)
