@@ -4,7 +4,10 @@ Headline: BERT-base MLM pretraining step (BASELINE.md config #3 — static
 graph + StandaloneExecutor-equivalent, AMP bf16).  Additional BASELINE.md
 configs ride in ``extra_metrics``: LeNet dygraph fp32 (#1), ResNet50
 dygraph AMP bf16 (#2), GPT flash+recompute bf16 (#4, sized to one chip),
-LLaMA sharding-stage2+TP dryrun on the 8-device CPU mesh (#5).
+LLaMA sharding-stage2+TP dryrun on the 8-device CPU mesh (#5), and the
+ISSUE-9 BERT-mini data-parallel step under MeshPlan("dp=2") (#6 —
+``bert_dp_tokens_per_sec``, forced 8-device host mesh when the runtime
+has a single device).
 
 `vs_baseline`: BASELINE.md's operative target is "match A100"; with no
 published reference numbers (empty mount — see BASELINE.md caveat) the
@@ -810,6 +813,107 @@ def bench_llama_dryrun():
     return {"ok": ok, "seconds": round(time.time() - t, 1)}
 
 
+# ---------------------------------------------------------------------
+# Config #6 (ISSUE 9): BERT-mini data-parallel scale-out — the SAME
+# static program under MeshPlan("dp=2"), batch split over the mesh by
+# the executor's partition-rule sharding.  Inline when the runtime
+# already exposes >=2 devices; otherwise re-run in a subprocess on the
+# forced 8-device host mesh (the XLA device-count flag must be set
+# before jax initializes).
+# ---------------------------------------------------------------------
+def _bert_dp_body(n_iters=4):
+    """BERT-mini DP training step under an explicit MeshPlan; returns
+    the metrics dict (callable inline or from the subprocess)."""
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu import optimizer, static
+    from paddle_tpu import observability as obs
+    from paddle_tpu.distributed.auto_parallel.sharding import (
+        BERT_RULES, MeshPlan, annotate_params, clear_mesh_plan,
+        set_mesh_plan)
+    from paddle_tpu.models import BertConfig, BertForMaskedLM
+
+    B, S = 8, 64
+    paddle.enable_static()
+    try:
+        plan = MeshPlan("dp=2", rules=BERT_RULES())
+        set_mesh_plan(plan)
+        main_prog, startup = static.Program(), static.Program()
+        with static.program_guard(main_prog, startup):
+            ids = static.data("ids", [B, S], "int64")
+            labels = static.data("labels", [B, S], "int64")
+            model = BertForMaskedLM(BertConfig(
+                hidden_size=128, num_hidden_layers=2,
+                num_attention_heads=2, intermediate_size=256))
+            annotate_params(model)
+            loss, _ = model(ids, labels=labels)
+            opt = optimizer.AdamW(learning_rate=1e-4,
+                                  parameters=model.parameters())
+            opt.minimize(loss)
+        exe = static.Executor()
+        exe.run(startup)
+        rng = np.random.default_rng(0)
+        fd = {"ids": rng.integers(0, 1000, (B, S)).astype(np.int64),
+              "labels": rng.integers(0, 1000, (B, S)).astype(np.int64)}
+        t = time.time()
+        (l0,) = exe.run_steps(1, main_prog, feed=fd, fetch_list=[loss])
+        compile_s = time.time() - t
+        log(f"bert_dp: compile+first step {compile_s:.1f}s "
+            f"loss={float(l0):.3f} mesh={plan.describe()}")
+        t = time.time()
+        (lv,) = exe.run_steps(n_iters, main_prog, feed=fd,
+                              fetch_list=[loss])
+        dt = (time.time() - t) / n_iters
+        tokens_per_sec = B * S / dt
+        log(f"bert_dp: step {dt*1e3:.1f} ms "
+            f"{tokens_per_sec:,.0f} tok/s loss={float(lv):.3f}")
+        return {"tokens_per_sec": round(tokens_per_sec, 1),
+                "step_ms": round(dt * 1e3, 2),
+                "compile_first_s": round(compile_s, 1),
+                "loss": round(float(lv), 4),
+                "mesh": plan.describe(),
+                "phases": obs.phase_breakdown()}
+    finally:
+        clear_mesh_plan()
+        paddle.disable_static()
+
+
+_BERT_DP_SUB = r"""
+import os, json
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "") +
+                           " --xla_force_host_platform_device_count=8")
+import jax
+jax.config.update("jax_platforms", "cpu")
+from paddle_tpu import observability as obs
+obs.enable(True)
+import bench
+print("BERT_DP_JSON: " + json.dumps(bench._bert_dp_body()))
+"""
+
+
+def bench_bert_dp(on_tpu):
+    import jax
+    if jax.device_count() >= 2:
+        res = _bert_dp_body()
+        res["forced_host_mesh"] = False
+        return res
+    t = time.time()
+    p = subprocess.run(
+        [sys.executable, "-c", _BERT_DP_SUB], cwd=str(ROOT),
+        capture_output=True, text=True, timeout=1800)
+    for line in p.stdout.splitlines():
+        if line.startswith("BERT_DP_JSON:"):
+            res = json.loads(line[len("BERT_DP_JSON:"):])
+            res["forced_host_mesh"] = True
+            res["seconds"] = round(time.time() - t, 1)
+            log(f"bert_dp (forced host mesh): "
+                f"{res['tokens_per_sec']:,.0f} tok/s "
+                f"({res['seconds']:.0f}s)")
+            return res
+    raise RuntimeError("bert_dp subprocess produced no result: "
+                       + (p.stderr or "")[-400:])
+
+
 def _bert_x32_subprocess(wait_s=900):
     """Run the BERT config under PADDLE_TPU_X32=1 in a child; parse its
     JSON line.  MUST run before the parent initializes jax — the TPU
@@ -865,7 +969,7 @@ def main():
                   [sys.executable, "-u", os.path.abspath(__file__)], env)
     configs = os.environ.get(
         "PADDLE_TPU_BENCH_CONFIGS",
-        "bert,lenet,resnet50,gpt,llama_dryrun").split(",")
+        "bert,lenet,resnet50,gpt,llama_dryrun,bert_dp").split(",")
 
     info = None
     if not force_cpu and not subproc:  # the parent already probed
@@ -982,6 +1086,7 @@ def main():
         "gpt_decode": lambda: bench_gpt_decode(on_tpu),
         "llama": lambda: bench_llama(on_tpu, peak),
         "llama_dryrun": bench_llama_dryrun,
+        "bert_dp": lambda: bench_bert_dp(on_tpu),
     }
     errors = {}
     from collections import Counter as _Counter
@@ -1086,6 +1191,18 @@ def main():
         elif name == "llama_dryrun":
             payload["extra_metrics"][
                 "llama_sharding2_tp_dryrun_ok"] = res["ok"]
+        elif name == "bert_dp":
+            payload["extra_metrics"]["bert_dp_tokens_per_sec"] = \
+                res["tokens_per_sec"]
+            payload["extra_metrics"]["bert_dp_step_ms"] = res["step_ms"]
+            payload["extra_metrics"]["bert_dp_mesh"] = res["mesh"]
+            payload["extra_metrics"]["bert_dp_forced_host_mesh"] = \
+                res["forced_host_mesh"]
+            # per-shard/axis phases from the SHARDED run itself (the
+            # subprocess case measured them in the child's timeline)
+            if res.get("phases"):
+                payload["extra_metrics"]["bert_dp_phases"] = \
+                    res["phases"]
         if errors:
             payload["errors"] = errors
         if on_tpu and not subproc:  # child must not clobber the

@@ -69,6 +69,13 @@ CODES = {
                "software", WARNING),
     "TPU403": ("collective payload dtype/shape mismatch (or a software-"
                "emulated wide dtype) on the wire", WARNING),
+    # -- SPMD sharding (TPU5xx) ----------------------------------------
+    "TPU501": ("parameter matched by no partition rule: silently "
+               "replicated on every device of the mesh", WARNING),
+    "TPU502": ("large parameter fully replicated under an fsdp/tp "
+               "mesh: every device pays its full HBM cost", WARNING),
+    "TPU503": ("collective payload dimension not divisible by the mesh "
+               "axis size: ragged shards or a padded transfer", WARNING),
 }
 
 

@@ -22,11 +22,15 @@ __all__ = ["analyze_traced", "analyze_runtime", "lint_summary"]
 
 
 def analyze_traced(closed_jaxpr, label="", *, amp="auto",
-                   executor_cache=None, trace_cache=None, emit=True):
+                   executor_cache=None, trace_cache=None, emit=True,
+                   mesh_plan=None, named_params=None):
     """Static audits over one traced program: weak types (TPU201),
     dtype/amp (TPU4xx), plus cache-churn audits when the owning cache
-    is provided.  ``emit=True`` records every finding to the process
-    diagnostic log and the observability timeline."""
+    is provided, plus sharding audits (TPU501/502) when the executor
+    compiled under a mesh plan (``named_params`` is its
+    ``[(name, shape, nbytes)]`` parameter inventory).  ``emit=True``
+    records every finding to the process diagnostic log and the
+    observability timeline."""
     report = DiagnosticReport(label=label)
     report.extend(audit_weak_types(closed_jaxpr, site=label))
     report.extend(audit_jaxpr(closed_jaxpr, amp=amp, site=label))
@@ -34,6 +38,10 @@ def analyze_traced(closed_jaxpr, label="", *, amp="auto",
         report.extend(audit_executor_cache(executor_cache))
     if trace_cache is not None:
         report.extend(audit_trace_cache(trace_cache))
+    if mesh_plan is not None and named_params:
+        from .sharding_audit import audit_sharding
+        report.extend(audit_sharding(mesh_plan, named_params,
+                                     site=label))
     if emit:
         report.emit()
     return report

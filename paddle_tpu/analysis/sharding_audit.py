@@ -1,0 +1,108 @@
+"""TPU5xx: SPMD sharding lint over a MeshPlan + named parameters.
+
+Three checks, all cheap (no tracing, no devices — a *virtual*
+``MeshPlan`` works, so ``scripts/tpu_lint.py`` runs them on a
+single-device host):
+
+* **TPU501** — a parameter matched by no partition rule.  The executor
+  replicates it silently; on a real mesh that is usually a forgotten
+  rule, not a choice.  Only fires when the plan HAS rules (an empty
+  rule set means pure data parallelism where replication is the plan).
+* **TPU502** — a parameter larger than
+  ``PADDLE_TPU_LINT_REPLICATED_BYTES`` (default 1 MiB) that resolves to
+  a fully-replicated layout while the mesh has a model/fsdp axis of
+  size > 1: every device pays the full HBM cost of a buffer the mesh
+  could split.
+* **TPU503** — a collective payload whose leading dim is not divisible
+  by the mesh axis (group) size: scatter/alltoall-class ops get ragged
+  shards or a padded transfer.  ``distributed/communication/ops.py``
+  calls :func:`check_collective_axis` per payload.
+"""
+from __future__ import annotations
+
+import os
+
+from .diagnostics import Diagnostic, DiagnosticReport
+
+__all__ = ["ENV_REPLICATED_THRESHOLD", "replicated_threshold",
+           "audit_sharding", "check_collective_axis"]
+
+ENV_REPLICATED_THRESHOLD = "PADDLE_TPU_LINT_REPLICATED_BYTES"
+_SPLIT_OPS = ("scatter", "alltoall", "alltoall_single", "reduce_scatter")
+
+
+def replicated_threshold():
+    try:
+        return int(os.environ.get(ENV_REPLICATED_THRESHOLD, 1 << 20))
+    except ValueError:
+        return 1 << 20
+
+
+def audit_sharding(plan, named_params, site=""):
+    """TPU501/TPU502 over ``named_params`` = ``[(name, shape, nbytes)]``
+    against a :class:`~...sharding.MeshPlan`.  Returns a list of
+    ``Diagnostic``; the caller decides whether to record them."""
+    out = []
+    if plan is None or not named_params:
+        return out
+    model_axes = [a for a in ("tp", "fsdp")
+                  if plan.axis_sizes.get(a, 1) > 1]
+    threshold = replicated_threshold()
+    for name, shape, nbytes in named_params:
+        matched, spec = plan.match(name, shape)
+        if plan.rules and not matched:
+            out.append(Diagnostic(
+                "TPU501",
+                f"param {name!r} {tuple(shape)} matched no partition "
+                f"rule on mesh {plan.describe()}; it will be replicated",
+                site=site or name,
+                hint="add a rule for it (or an explicit catch-all "
+                     "('.*', PartitionSpec()) if replication is "
+                     "intended)",
+                data={"param": name, "shape": list(shape)}))
+            continue
+        if (model_axes and nbytes > threshold
+                and plan.shard_factor(spec) == 1):
+            out.append(Diagnostic(
+                "TPU502",
+                f"param {name!r} ({nbytes / 2**20:.1f} MiB) is fully "
+                f"replicated on mesh {plan.describe()} — axis "
+                f"{model_axes} could split it",
+                site=site or name,
+                hint=f"shard a divisible dim over {model_axes}, or "
+                     f"raise {ENV_REPLICATED_THRESHOLD} if replication "
+                     "is intended",
+                data={"param": name, "nbytes": int(nbytes)}))
+    return out
+
+
+def check_collective_axis(op_name, tensors, group_size, site=""):
+    """TPU503: payload leading dims must divide by the axis (group)
+    size for scatter/alltoall/reduce_scatter-class collectives."""
+    out = []
+    if not group_size or group_size <= 1:
+        return out
+    if not any(op_name.startswith(p) for p in _SPLIT_OPS):
+        return out
+    for t in tensors:
+        shape = tuple(getattr(getattr(t, "_value", t), "shape", ()) or ())
+        if not shape:
+            continue
+        if shape[0] % group_size != 0:
+            out.append(Diagnostic(
+                "TPU503",
+                f"{op_name}: payload dim0 {shape[0]} not divisible by "
+                f"group size {group_size} (shape {shape})",
+                site=site or op_name,
+                hint="pad the payload (or size the batch) to a "
+                     "multiple of the mesh axis",
+                data={"op": op_name, "shape": list(shape),
+                      "group_size": int(group_size)}))
+    return out
+
+
+def audit_report(plan, named_params, label=""):
+    """Convenience: run :func:`audit_sharding` into a fresh report."""
+    rep = DiagnosticReport(label=label or "sharding")
+    rep.extend(audit_sharding(plan, named_params, site=label))
+    return rep

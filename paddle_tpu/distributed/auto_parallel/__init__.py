@@ -4,5 +4,8 @@ from .api import (ProcessMesh, shard_tensor, shard_op, Shard, Replicate,
 from . import completion
 from . import cost_model
 from . import engine
+from . import sharding
+from .sharding import (MeshPlan, annotate_params, get_mesh_plan,
+                       match_partition_rules, set_mesh_plan)
 from .cost_model import Planner, estimate_cost, comm_cost_seconds
 from .engine import Strategy, DistModel, Engine, to_static

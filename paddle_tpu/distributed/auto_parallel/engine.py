@@ -48,9 +48,13 @@ class Strategy:
 def _global_mesh():
     from .api import get_mesh
     from ..env import global_mesh
+    from .sharding import get_mesh_plan
     m = get_mesh()
     if m is not None:
         return m.jax_mesh() if hasattr(m, "jax_mesh") else m
+    plan = get_mesh_plan()
+    if plan is not None and not plan.is_virtual:
+        return plan.mesh
     return global_mesh()
 
 
@@ -65,6 +69,25 @@ class DistModel:
         self._optimizer = optimizer
         self._strategy = strategy or Strategy()
         self._metrics = metrics or []
+        # seed-era Strategy flags must not silently run single-device:
+        # pipeline and gradient_merge have no SPMD lowering here —
+        # refuse loudly and name the supported path.  sharding.enable
+        # delegates to a MeshPlan (fsdp axis of the requested degree).
+        for feature in ("pipeline", "gradient_merge"):
+            if getattr(getattr(self._strategy, feature), "enable", False):
+                raise NotImplementedError(
+                    f"Strategy.{feature}.enable is not lowered by this "
+                    "engine and would silently run single-device. Use "
+                    "paddle_tpu.distributed.auto_parallel.sharding."
+                    "MeshPlan (env PADDLE_TPU_MESH, e.g. 'dp=4,tp=2') "
+                    "with static.Executor or jit.to_static instead.")
+        if mesh is None and getattr(self._strategy.sharding, "enable",
+                                    False):
+            degree = int(getattr(self._strategy.sharding, "degree", 1)
+                         or 1)
+            if degree > 1:
+                from .sharding import MeshPlan
+                mesh = MeshPlan(f"fsdp={degree}").mesh
         self._mesh = mesh or _global_mesh()
         self._mode = "train" if optimizer is not None else "predict"
         self._steps = {}

@@ -61,11 +61,19 @@ def save_state_dict(state_dict, path, process_group=None,
             "dtype": t.dtype.name,
         }
         pieces = []
+        seen_idx = set()
         try:
             for s in arr.addressable_shards:
                 idx = [[sl.start or 0,
                         sl.stop if sl.stop is not None else dim]
                        for sl, dim in zip(s.index, arr.shape)]
+                # under an SPMD mesh a replicated (or partially
+                # replicated) array repeats the same shard on every
+                # device of the replica axes — write each index once
+                key = tuple(map(tuple, idx))
+                if key in seen_idx:
+                    continue
+                seen_idx.add(key)
                 pieces.append({"index": idx,
                                "data": np.asarray(s.data)})
         except Exception:
@@ -144,8 +152,19 @@ def load_state_dict(state_dict, path, process_group=None,
             idx = tuple(slice(a, b) for a, b in piece["index"])
             full[idx] = piece["data"]
         val = jnp.asarray(full, t._value.dtype)
+        # reshard to the current placement: the tensor's live sharding
+        # if it has been placed, else the active MeshPlan's rule for it
+        # (loading a fresh model under a NEW mesh topology lands each
+        # param pre-sharded instead of replicated)
+        sh = getattr(t._value, "sharding", None)
+        if sh is None or getattr(sh, "is_fully_replicated", True):
+            from ..auto_parallel import sharding as spmd
+            plan = spmd.get_mesh_plan()
+            if plan is not None and not plan.is_virtual:
+                sh = plan.sharding(plan.spec_for(
+                    spmd.spmd_name(t), tuple(val.shape)))
         try:
-            val = jax.device_put(val, t._value.sharding)
+            val = jax.device_put(val, sh) if sh is not None else val
         except Exception:
             pass
         t._inplace_update(val)

@@ -78,9 +78,10 @@ def _payload_bytes(args, kwargs):
 _lint_seen: set = set()
 
 
-def _lint_payload(op_name, args):
+def _lint_payload(op_name, args, group=None):
     """Runtime tpu_lint of a collective payload (TPU403: mixed
-    shapes/dtypes in a tensor list, f64 on the wire)."""
+    shapes/dtypes in a tensor list, f64 on the wire; TPU503: payload
+    dim not divisible by the group's mesh-axis size)."""
     tensors = []
     for a in args:
         if isinstance(a, Tensor):
@@ -92,15 +93,23 @@ def _lint_payload(op_name, args):
     try:
         sig = (op_name, tuple(
             (tuple(getattr(t._value, "shape", ())),
-             str(getattr(t._value, "dtype", "?"))) for t in tensors))
+             str(getattr(t._value, "dtype", "?"))) for t in tensors),
+            getattr(group, "nranks", None))
     except Exception:
         return
     if sig in _lint_seen:
         return
     _lint_seen.add(sig)
-    from ...analysis import check_collective_payload, record
+    from ...analysis import (check_collective_axis,
+                             check_collective_payload, record)
     for d in check_collective_payload(op_name, tensors):
         record(d)
+    if group is not None:
+        site = f"{op_name}(group={group.id}, " \
+               f"axis={getattr(group, 'axis_name', None)})"
+        for d in check_collective_axis(op_name, tensors, group.nranks,
+                                       site=site):
+            record(d)
 
 
 def _watched(op_name):
@@ -126,8 +135,10 @@ def _watched(op_name):
                 g = g if g is not None else _group(None)
                 sp = obs.span("collective:" + op_name, cat="collective",
                               bytes=_payload_bytes(args, kwargs),
-                              nranks=g.nranks, group=g.id)
-                _lint_payload(op_name, args)
+                              nranks=g.nranks, group=g.id,
+                              axis=str(g.axis_name)
+                              if g.axis_name is not None else None)
+                _lint_payload(op_name, args, g)
             else:
                 sp = obs._NULL_SPAN
             with sp:

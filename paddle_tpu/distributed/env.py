@@ -82,11 +82,20 @@ def init_parallel_env(strategy=None):
 
 
 def global_mesh():
-    """The framework-wide device mesh (created lazily)."""
+    """The framework-wide device mesh (created lazily).
+
+    An active :class:`~.auto_parallel.sharding.MeshPlan`
+    (``PADDLE_TPU_MESH`` or ``set_mesh_plan``) defines the topology;
+    otherwise every visible device forms a 1-D ``dp`` mesh."""
     global _global_mesh
     if _global_mesh is None:
-        devs = np.array(jax.devices())
-        _global_mesh = jax.sharding.Mesh(devs, ("dp",))
+        from .auto_parallel.sharding import get_mesh_plan
+        plan = get_mesh_plan()
+        if plan is not None and not plan.is_virtual:
+            _global_mesh = plan.mesh
+        else:
+            devs = np.array(jax.devices())
+            _global_mesh = jax.sharding.Mesh(devs, ("dp",))
     return _global_mesh
 
 

@@ -16,6 +16,7 @@ from .scheduler import (ENV_MAX_BATCH, ENV_PREFILL_CHUNK,
                         Request, max_batch_size, prefill_chunk_size)
 from .engine import (GenerationEngine, ragged_sample_next,
                      serving_sample_next)
+from .dp import DataParallelEngine
 
 __all__ = [
     "ENV_KV_BLOCK_SIZE", "ENV_PREFIX_CACHE", "RESIDENT_NAME",
@@ -26,4 +27,5 @@ __all__ = [
     "ENV_MAX_BATCH", "ENV_PREFILL_CHUNK", "ContinuousBatchingScheduler",
     "PrefillChunk", "Request", "max_batch_size", "prefill_chunk_size",
     "GenerationEngine", "ragged_sample_next", "serving_sample_next",
+    "DataParallelEngine",
 ]

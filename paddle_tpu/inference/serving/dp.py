@@ -1,0 +1,122 @@
+"""Data-parallel serving: N replica GenerationEngines behind one front.
+
+The first sharded-serving step (ISSUE 9): weights are **replicated** —
+every replica drives the same model object, so there is exactly one set
+of parameters in memory — while each replica owns a **private paged KV
+pool** and scheduler.  Requests dispatch to the least-loaded replica;
+decode batches on different replicas advance independently, so one
+replica draining a long prefill never stalls another's decode loop.
+
+Per-shard observability: each replica's work runs under
+``obs.tag(shard="dp<i>")``, so every prefill/decode/dispatch span the
+inner engine emits lands on that replica's lane —
+``phase_breakdown()["shards"]`` and ``pipeline_stats()["per_shard"]``
+then show per-replica skew directly.
+
+Sizing: when ``hbm_fraction`` is not given, the single-engine default
+is divided by the replica count so the combined pools claim no more
+HBM than one engine would.  Each replica compiles its own step
+executable (the ragged step closes over the replica's cache view);
+with identical geometry that is ``dp`` compiles of the same program —
+acceptable for the host-simulation scale this targets, and the
+``stats()["step_compiles"]`` aggregate makes it visible.
+"""
+from __future__ import annotations
+
+from ... import observability as obs
+from .engine import GenerationEngine
+
+__all__ = ["DataParallelEngine"]
+
+
+class DataParallelEngine:
+    """Least-loaded data-parallel front over replica GenerationEngines.
+
+    ``dp=None`` takes the replica count from the active
+    :class:`~...distributed.auto_parallel.sharding.MeshPlan`'s ``dp``
+    axis (``PADDLE_TPU_MESH=dp=4`` → 4 replicas) and falls back to 1.
+    """
+
+    def __init__(self, model, dp=None, hbm_fraction=None,
+                 **engine_kwargs):
+        if dp is None:
+            from ...distributed.auto_parallel.sharding import \
+                get_mesh_plan
+            plan = get_mesh_plan()
+            dp = plan.axis_sizes.get("dp", 1) if plan is not None else 1
+        self.dp = int(dp)
+        if self.dp < 1:
+            raise ValueError(f"dp must be >= 1, got {dp}")
+        if hbm_fraction is None:
+            hbm_fraction = 0.3 / self.dp
+        self.engines = [
+            GenerationEngine(model, hbm_fraction=hbm_fraction,
+                             **engine_kwargs)
+            for _ in range(self.dp)
+        ]
+        self._owner = {}          # request_id -> shard index
+        self._req_counter = 0
+
+    # -- dispatch ---------------------------------------------------------
+    def _load(self, i):
+        eng = self.engines[i]
+        return (eng.scheduler.queue_depth + len(eng.scheduler.running)
+                + len(eng._pending))
+
+    def add_request(self, prompt, request_id=None, **kwargs):
+        """Enqueue one prompt on the least-loaded replica."""
+        if request_id is None:
+            request_id = f"dpreq{self._req_counter}"
+        self._req_counter += 1
+        shard = min(range(self.dp), key=self._load)
+        with obs.tag(shard=f"dp{shard}"):
+            self.engines[shard].add_request(prompt,
+                                            request_id=request_id,
+                                            **kwargs)
+        self._owner[request_id] = shard
+        return request_id
+
+    # -- stepping ---------------------------------------------------------
+    def has_unfinished(self):
+        return any(e.has_unfinished() for e in self.engines)
+
+    def step(self):
+        """Advance every replica that has work one step.  Returns the
+        requests that finished this step, across all replicas."""
+        finished = []
+        for i, eng in enumerate(self.engines):
+            if not eng.has_unfinished():
+                continue
+            with obs.tag(shard=f"dp{i}"):
+                finished.extend(eng.step())
+        return finished
+
+    def generate(self, prompts, **kwargs):
+        """Run a batch of prompts to completion across the replicas.
+        Returns one full token list per prompt, in order."""
+        ids = [self.add_request(p, **kwargs) for p in prompts]
+        while self.has_unfinished():
+            self.step()
+        return [self.result(i) for i in ids]
+
+    def result(self, request_id):
+        return self.engines[self._owner[request_id]].result(request_id)
+
+    # -- bookkeeping ------------------------------------------------------
+    def stats(self):
+        """Aggregate totals plus a ``per_shard`` breakdown."""
+        per_shard = {}
+        total = {"tokens_generated": 0, "queue_depth": 0, "running": 0,
+                 "step_compiles": 0}
+        for i, eng in enumerate(self.engines):
+            s = eng.stats()
+            per_shard[f"dp{i}"] = s
+            for k in total:
+                total[k] += int(s.get(k, 0))
+        total["dp"] = self.dp
+        total["per_shard"] = per_shard
+        return total
+
+    def close(self):
+        for eng in self.engines:
+            eng.close()

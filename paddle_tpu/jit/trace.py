@@ -151,9 +151,12 @@ class TracedFunction:
         if get_trace_ctx() is not None:
             return self._fn(*args, **kwargs)  # nested: already tracing
         from ..memory.guard import remat_enabled
+        from ..distributed.auto_parallel.sharding import plan_cache_token
         # the ladder's remat flip changes the traced program: a cached
-        # no-remat executable must not serve a remat-enabled retry
-        key = (_tree_key((args, kwargs)), remat_enabled())
+        # no-remat executable must not serve a remat-enabled retry; the
+        # mesh token keeps executables from crossing plan switches
+        key = (_tree_key((args, kwargs)), remat_enabled(),
+               plan_cache_token())
         comp = self._cache.get(key)
         if comp is None:
             first_result, comp = self._discover_and_compile(args, kwargs)
@@ -174,8 +177,10 @@ class TracedFunction:
         """
         from ..analysis import analyze_traced
         from ..memory.guard import remat_enabled
+        from ..distributed.auto_parallel.sharding import plan_cache_token
         if args or kwargs:
-            key = (_tree_key((args, kwargs)), remat_enabled())
+            key = (_tree_key((args, kwargs)), remat_enabled(),
+                   plan_cache_token())
             comp = self._cache.get(key)
             if comp is None:
                 raise RuntimeError(
@@ -190,7 +195,9 @@ class TracedFunction:
         with obs.span("analyze:" + comp["label"], cat="analysis"):
             jaxpr = jax.make_jaxpr(comp["pure_fn"])(*comp["avals"])
             return analyze_traced(jaxpr, label=comp["label"],
-                                  trace_cache=self._cache)
+                                  trace_cache=self._cache,
+                                  mesh_plan=comp.get("plan"),
+                                  named_params=comp.get("spmd_named"))
 
     # ------------------------------------------------------------------
     def _discover_and_compile(self, args, kwargs):
@@ -302,11 +309,39 @@ class TracedFunction:
         jit_kwargs = dict(self._jit_kwargs)
         if get_flags("FLAGS_buffer_donation")["FLAGS_buffer_donation"]:
             jit_kwargs.setdefault("donate_argnums", (2,))
-        jitted = jax.jit(pure_fn, **jit_kwargs)
         arg_vals = _tensor_arg_values(args, kwargs)
         # pending lazy values cannot cross a jit boundary as arguments
         ro_vals = concrete_values(ro_state)
         rw_vals = concrete_values(rw_state)
+        # SPMD mesh plan: tensor args batch-shard over the data axes,
+        # state lays out by partition rule (all-replicated with no rules
+        # — pure DP); output shardings are left to the partitioner so
+        # donated rw state keeps its input layout
+        from ..distributed.auto_parallel import sharding as spmd
+        plan = spmd.get_mesh_plan()
+        arg_shardings = state_shardings = None
+        if plan is not None:
+            ns = plan.sharding
+            arg_shardings = tuple(ns(plan.batch_spec(v.shape))
+                                  for v in arg_vals)
+            ro_sh = tuple(ns(plan.spec_for(spmd.spmd_name(t),
+                                           tuple(t._value.shape)))
+                          for t in ro_state)
+            rw_sh = tuple(ns(plan.spec_for(spmd.spmd_name(t),
+                                           tuple(t._value.shape)))
+                          for t in rw_state)
+            state_shardings = (ro_sh, rw_sh)
+            jit_kwargs["in_shardings"] = (arg_shardings, ro_sh, rw_sh)
+            # place once: state buffers then stay sharded across calls
+            for tensors, shs in ((ro_state, ro_sh), (rw_state, rw_sh)):
+                for t, sh in zip(tensors, shs):
+                    if getattr(t._value, "sharding", None) != sh:
+                        t._value = jax.device_put(concrete(t._value), sh)
+            ro_vals = concrete_values(ro_state)
+            rw_vals = concrete_values(rw_state)
+            arg_vals = tuple(jax.device_put(v, sh) for v, sh in
+                             zip(arg_vals, arg_shardings))
+        jitted = jax.jit(pure_fn, **jit_kwargs)
         label = f"jit:{getattr(self._orig_fn, '__qualname__', self._fn)}"
         flow = obs.next_flow_id()
         from ..device.compile_cache import (ensure_compile_cache,
@@ -336,11 +371,23 @@ class TracedFunction:
                     pass
             return n
 
+        named_buffers = named_buffer_sizes(
+            [(f"state:{t.name or ('tensor_%d' % i)}", t)
+             for i, t in enumerate(state)])
+        if plan is not None:
+            # per-DEVICE charge: sharded state divides by its axis-size
+            # product, replicated state is charged whole
+            flat_sh = dict(zip(
+                (f"state:{t.name or ('tensor_%d' % i)}"
+                 for i, t in enumerate(state)),
+                (plan.spec_for(spmd.spmd_name(t), tuple(t._value.shape))
+                 for t in state)))
+            named_buffers = [
+                (n, sz // plan.shard_factor(flat_sh.get(n)))
+                for n, sz in named_buffers]
         estimate = preflight_check(
             compiled, program=label,
-            named_buffers=named_buffer_sizes(
-                [(f"state:{t.name or ('tensor_%d' % i)}", t)
-                 for i, t in enumerate(state)]),
+            named_buffers=named_buffers,
             pipeline_depth=pipeline_depth(),
             per_step_io_bytes=_nbytes(arg_vals),
             # state this step already carries (e.g. the serving KV pool
@@ -364,6 +411,12 @@ class TracedFunction:
             "rw_state": rw_state,
             "mutated": mutated,
             "grad_slots": grad_slots,
+            "plan": plan,
+            "arg_shardings": arg_shardings,
+            "spmd_named": [(spmd.spmd_name(t), tuple(t._value.shape),
+                            int(np.prod(t._value.shape))
+                            * t._value.dtype.itemsize)
+                           for t in state] if plan is not None else None,
             "out_treedef": meta["out_treedef"],
             "out_is_tensor": meta["out_is_tensor"],
             "has_grad": meta["has_grad"],
@@ -371,11 +424,18 @@ class TracedFunction:
 
     def _run_compiled(self, comp, args, kwargs):
         arg_vals = _tensor_arg_values(args, kwargs)
+        if comp.get("arg_shardings"):
+            arg_vals = tuple(
+                v if getattr(v, "sharding", None) == sh
+                else jax.device_put(v, sh)
+                for v, sh in zip(arg_vals, comp["arg_shardings"]))
         ro_vals = concrete_values(comp["ro_state"])
         rw_vals = concrete_values(comp["rw_state"])
         from ..memory.guard import oom_context
         with obs.span(comp["label"], cat="dispatch",
-                      flow_in=comp["flow"]), \
+                      flow_in=comp["flow"],
+                      **({"mesh": comp["plan"].describe()}
+                         if comp.get("plan") is not None else {})), \
                 oom_context(program=comp["label"],
                             estimate=comp["estimate"]):
             out_vals, mut_vals, grad_vals = comp["compiled"](

@@ -26,7 +26,7 @@ instrument itself without import cycles.
 from .timeline import (  # noqa: F401
     _NULL_SPAN, ENV_OBS, ENV_OBS_CAPACITY, ENV_OBS_DIR, Event, Timeline,
     current_step, disable, enable, enabled, enabled_scope, get_timeline,
-    instant, next_flow_id, obs_dir, set_step, span,
+    instant, next_flow_id, obs_dir, set_step, span, tag,
 )
 from .registry import (  # noqa: F401
     Counter, Gauge, Histogram, MetricsRegistry, get_registry,
@@ -39,7 +39,7 @@ from .export import (  # noqa: F401
 
 __all__ = [
     "ENV_OBS", "ENV_OBS_DIR", "ENV_OBS_CAPACITY",
-    "Event", "Timeline", "get_timeline", "span", "instant",
+    "Event", "Timeline", "get_timeline", "span", "instant", "tag",
     "enabled", "enable", "disable", "enabled_scope",
     "set_step", "current_step", "next_flow_id", "obs_dir",
     "Counter", "Gauge", "Histogram", "MetricsRegistry", "get_registry",

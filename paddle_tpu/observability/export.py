@@ -180,7 +180,15 @@ def phase_breakdown(events=None):
     ``kernel:<name>.<direction>`` by ``pallas_kernels._kernel_span``)
     aggregate into ``kernel_ms``/``kernel_count`` plus one
     ``kernel_<name>_<direction>_ms``/``_count`` pair per kernel+direction
-    so the bench shows exactly where fused-kernel time went."""
+    so the bench shows exactly where fused-kernel time went.
+
+    SPMD attribution: dispatch spans emitted under an active
+    :class:`~..distributed.auto_parallel.sharding.MeshPlan` carry a
+    ``mesh`` attr (surfaced as ``mesh``), collective spans carry the
+    mesh ``axis`` they ran on (aggregated as
+    ``collective_axis_<axis>_ms``/``_count``/``_bytes``), and serving
+    DP engines stamp ``shard="dp<i>"`` — those lanes aggregate under
+    ``shards[<shard>]`` so per-replica skew is visible in the bench."""
     if events is None:
         events = get_timeline().events()
     out = {"compile_ms": 0.0, "dispatch_ms": 0.0, "collective_ms": 0.0,
@@ -191,11 +199,27 @@ def phase_breakdown(events=None):
            "h2d_count": 0, "d2h_count": 0, "pipeline_wait_count": 0,
            "prefill_count": 0, "decode_count": 0, "kernel_count": 0}
     kernel_keys = []
+    axis_keys = []
+    shards = {}
+
+    def _shard_row(label):
+        return shards.setdefault(label, {
+            "dispatch_ms": 0.0, "dispatch_count": 0,
+            "prefill_ms": 0.0, "prefill_count": 0,
+            "decode_ms": 0.0, "decode_count": 0,
+            "collective_ms": 0.0, "collective_count": 0})
+
     for e in events:
         if e.dur is None:
             continue
         ms = e.dur * 1e3
         attrs = e.attrs or {}
+        shard = attrs.get("shard")
+        if shard and e.cat in ("dispatch", "prefill", "decode",
+                               "collective"):
+            row = _shard_row(str(shard))
+            row[f"{e.cat}_ms"] += ms
+            row[f"{e.cat}_count"] += 1
         if e.cat == "kernel":
             out["kernel_ms"] += ms
             out["kernel_count"] += 1
@@ -217,10 +241,24 @@ def phase_breakdown(events=None):
             out["dispatch_count"] += 1
             out["h2d_bytes"] += int(attrs.get("h2d_bytes", 0) or 0)
             out["d2h_bytes"] += int(attrs.get("d2h_bytes", 0) or 0)
+            if attrs.get("mesh"):
+                out["mesh"] = str(attrs["mesh"])
         elif e.cat == "collective":
             out["collective_ms"] += ms
             out["collective_count"] += 1
-            out["collective_bytes"] += int(attrs.get("bytes", 0) or 0)
+            nbytes = int(attrs.get("bytes", 0) or 0)
+            out["collective_bytes"] += nbytes
+            axis = attrs.get("axis")
+            if axis:
+                key = f"collective_axis_{axis}"
+                if key + "_ms" not in out:
+                    out[key + "_ms"] = 0.0
+                    out[key + "_count"] = 0
+                    out[key + "_bytes"] = 0
+                    axis_keys.append(key + "_ms")
+                out[key + "_ms"] += ms
+                out[key + "_count"] += 1
+                out[key + "_bytes"] += nbytes
         elif e.cat == "h2d":
             out["h2d_ms"] += ms
             out["h2d_count"] += 1
@@ -237,23 +275,19 @@ def phase_breakdown(events=None):
             out[f"{e.cat}_count"] += 1
     for k in ("compile_ms", "dispatch_ms", "collective_ms", "h2d_ms",
               "d2h_ms", "pipeline_wait_ms", "prefill_ms", "decode_ms",
-              "kernel_ms", *kernel_keys):
+              "kernel_ms", *kernel_keys, *axis_keys):
         out[k] = round(out[k], 3)
+    if shards:
+        for row in shards.values():
+            for k in list(row):
+                if k.endswith("_ms"):
+                    row[k] = round(row[k], 3)
+        out["shards"] = {k: shards[k] for k in sorted(shards)}
     return out
 
 
-def pipeline_stats(events=None):
-    """Measured async-pipeline health from the timeline.
-
-    ``overlap_ms``/``overlap_ratio``: how much of the recorded h2d
-    transfer time ran WHILE a step was in flight (dispatched but not
-    yet synchronized) — the device prefetch doing its job (1.0 = every
-    transfer fully hidden behind compute).  ``measured_depth``: the max
-    number of concurrently in-flight steps + open h2d transfers, i.e.
-    the pipeline depth the run actually achieved (1 = fully serial).
-    """
-    if events is None:
-        events = get_timeline().events()
+def _pipeline_lane_stats(events):
+    """Core pipeline sweep over one lane's worth of span events."""
     dispatch = sorted((e.ts, e.ts + e.dur) for e in events
                       if e.dur is not None and e.cat == "dispatch")
     syncs = sorted((e.ts, e.ts + e.dur) for e in events
@@ -306,6 +340,39 @@ def pipeline_stats(events=None):
         "dispatch_count": len(dispatch),
         "h2d_count": len(h2d),
     }
+
+
+def pipeline_stats(events=None):
+    """Measured async-pipeline health from the timeline.
+
+    ``overlap_ms``/``overlap_ratio``: how much of the recorded h2d
+    transfer time ran WHILE a step was in flight (dispatched but not
+    yet synchronized) — the device prefetch doing its job (1.0 = every
+    transfer fully hidden behind compute).  ``measured_depth``: the max
+    number of concurrently in-flight steps + open h2d transfers, i.e.
+    the pipeline depth the run actually achieved (1 = fully serial).
+
+    Spans stamped with a ``shard`` attr (serving DP engines emit
+    ``shard="dp<i>"``) additionally get an independent per-shard sweep
+    under ``per_shard[<shard>]`` — in-flight matching happens within
+    each shard's own lane so one replica's sync never retires another
+    replica's dispatch.  The top-level numbers stay the whole-process
+    aggregate and are unchanged for unsharded traces.
+    """
+    if events is None:
+        events = get_timeline().events()
+    out = _pipeline_lane_stats(events)
+    lanes = {}
+    for e in events:
+        if e.dur is None:
+            continue
+        shard = (e.attrs or {}).get("shard")
+        if shard:
+            lanes.setdefault(str(shard), []).append(e)
+    if lanes:
+        out["per_shard"] = {k: _pipeline_lane_stats(v)
+                            for k, v in sorted(lanes.items())}
+    return out
 
 
 def lint_summary_table(events=None, limit=20):

@@ -241,6 +241,44 @@ def current_step():
     return get_timeline().current_step()
 
 
+# -- ambient span attrs ---------------------------------------------------
+# a stack of attr dicts every span/instant opened inside inherits —
+# the serving DP engine tags each replica's work ``shard="dp<i>"`` so
+# the inner prefill/decode/dispatch spans land on per-shard lanes
+# without the emitting code knowing it runs inside a shard
+_ambient_attrs = []
+
+
+class _TagCM:
+    __slots__ = ("attrs",)
+
+    def __init__(self, attrs):
+        self.attrs = attrs
+
+    def __enter__(self):
+        _ambient_attrs.append(self.attrs)
+        return self
+
+    def __exit__(self, *exc):
+        _ambient_attrs.pop()
+        return False
+
+
+def tag(**attrs):
+    """Ambient attrs: spans/instants opened inside inherit them
+    (explicit attrs win on key collision)."""
+    return _TagCM(attrs)
+
+
+def ambient_attrs():
+    if not _ambient_attrs:
+        return None
+    out = {}
+    for d in _ambient_attrs:
+        out.update(d)
+    return out
+
+
 # -- span context managers -----------------------------------------------
 class _NullSpan:
     """Shared do-nothing span: the disabled-mode fast path."""
@@ -311,6 +349,9 @@ def span(name, cat="host", step=None, flow_in=None, flow_out=None,
     """Timed region.  Disabled → the shared no-op singleton."""
     if not enabled():
         return _NULL_SPAN
+    amb = ambient_attrs()
+    if amb:
+        attrs = {**amb, **attrs}
     return _SpanCM(name, cat, step, attrs or None, flow_in, flow_out)
 
 
@@ -318,5 +359,8 @@ def instant(name, cat="host", step=None, **attrs):
     """Zero-duration marker.  Disabled → no-op."""
     if not enabled():
         return None
+    amb = ambient_attrs()
+    if amb:
+        attrs = {**amb, **attrs}
     return get_timeline().add_instant(name, cat, step=step,
                                       attrs=attrs or None)

@@ -97,15 +97,37 @@ def _feed_shape(v):
     return tuple(s) if s is not None else tuple(np.asarray(v).shape)
 
 
-def _as_feed_val(v, dtype):
+def _as_feed_val(v, dtype, sharding=None):
     """Feed value → device array of `dtype`.  Values already on device
     (DeviceFeeder output, eager Tensors) pass through without touching
-    the host; only genuinely host-side values pay the h2d conversion."""
+    the host; only genuinely host-side values pay the h2d conversion.
+    Under an SPMD plan ``sharding`` lays the value out across the mesh
+    (per-shard device_put; a no-op when already laid out that way)."""
     if isinstance(v, Tensor):
         v = v._value
     if isinstance(v, jax.Array):
-        return v if v.dtype == dtype else jnp.asarray(v, dtype)
-    return jnp.asarray(np.asarray(v), dtype)
+        out = v if v.dtype == dtype else jnp.asarray(v, dtype)
+    else:
+        out = jnp.asarray(np.asarray(v), dtype)
+    if sharding is not None and getattr(out, "sharding", None) != sharding:
+        out = jax.device_put(out, sharding)
+    return out
+
+
+def _place_entry_state(entry):
+    """Lay a cache entry's resident state (params, optimizer state, rng,
+    frozen captures) out across the active mesh.  Rebinds each tensor's
+    ``_value`` to the sharded global array; runs once per entry."""
+    for tensors, shardings in (
+            (entry["params"], entry["param_shardings"]),
+            (entry["opt_state"], entry["opt_shardings"]),
+            (entry["rng_states"], entry["rng_shardings"]),
+            (entry["frozen"], entry["frozen_shardings"])):
+        for t, sh in zip(tensors, shardings):
+            v = t._value
+            if getattr(v, "sharding", None) != sh:
+                t._value = jax.device_put(v, sh)
+    entry["placed"] = True
 
 
 def _program_fingerprint(program):
@@ -203,10 +225,18 @@ class Executor:
                 self._cache[key] = entry
 
         from ..core.lazy import concrete_values
+        if entry.get("plan") is not None and not entry.get("placed"):
+            # first dispatch under a mesh plan: lay the train state out
+            # across the mesh once; afterwards outputs stay sharded
+            # (out_shardings) so steady-state steps do no resharding
+            _place_entry_state(entry)
+        feed_shs = entry.get("feed_shardings") or (None,) * len(
+            entry["feed_names"])
         with obs.span("h2d:feed", cat="h2d",
                       program=entry["program_label"]) as h2d_sp:
             feed_vals = tuple(
-                _as_feed_val(feed[name], entry["feed_dtypes"][i])
+                _as_feed_val(feed[name], entry["feed_dtypes"][i],
+                             feed_shs[i])
                 for i, name in enumerate(entry["feed_names"])
             ) + concrete_values(entry["frozen"])
             h2d_sp.set("h2d_bytes", _nbytes_of(feed_vals))
@@ -275,7 +305,9 @@ class Executor:
             entry["compiled"] = entry["compile_step"]()
         sp = obs.span(entry["program_label"], cat="dispatch",
                       step=_obs_step(step_val), flow_in=entry["flow"],
-                      h2d_bytes=_nbytes_of(feed_vals))
+                      h2d_bytes=_nbytes_of(feed_vals),
+                      **({"mesh": entry["plan"].describe()}
+                         if entry.get("plan") is not None else {}))
         from ..device import hbm_oom_context
         with sp, hbm_oom_context(program=entry["program_label"],
                                  estimate=entry["estimate"]):
@@ -313,7 +345,9 @@ class Executor:
             jaxpr = _jax.make_jaxpr(entry["pure"])(*entry["avals"])
             return analyze_traced(
                 jaxpr, label=entry["program_label"],
-                executor_cache=Executor._shared_cache)
+                executor_cache=Executor._shared_cache,
+                mesh_plan=entry.get("plan"),
+                named_params=entry.get("spmd_named"))
 
     # ------------------------------------------------------------------
     def _cache_key(self, program, feed, fetch_list):
@@ -323,8 +357,12 @@ class Executor:
         feed_sig = tuple(sorted(
             (k, _feed_shape(v)) for k, v in feed.items()))
         fetch_sig = tuple(self._fetch_labels(fetch_list))
+        # mesh topology + partition rules key the cache too: an
+        # executable compiled for dp=4 must never serve dp=2 (or
+        # single-device) dispatches.  None when unsharded.
+        from ..distributed.auto_parallel.sharding import plan_cache_token
         return (id(program), _program_fingerprint(program), feed_sig,
-                fetch_sig)
+                fetch_sig, plan_cache_token())
 
     def _build(self, program, feed, fetch_list):
         feed_names = sorted(feed.keys())
@@ -432,7 +470,6 @@ class Executor:
         # FLAGS_buffer_donation=0 opts out (e.g. stale detach() views).
         from ..framework.flags import get_flags
         donate = get_flags("FLAGS_buffer_donation")["FLAGS_buffer_donation"]
-        jitted = jax.jit(pure, donate_argnums=(1, 2) if donate else ())
         feed_avals = tuple(
             jax.ShapeDtypeStruct(_feed_shape(feed[n]), feed_dtypes[i])
             for i, n in enumerate(feed_names)) + tuple(
@@ -450,6 +487,65 @@ class Executor:
         lr_aval = jax.ShapeDtypeStruct((), jnp.float32)
         step_aval = jax.ShapeDtypeStruct((), jnp.int32)
 
+        # -- SPMD mesh plan: partition specs + NamedShardings ----------
+        # Under an active MeshPlan the step compiles with explicit
+        # in/out shardings: params/opt-state by partition rule (matched
+        # against structural _spmd_name, see sharding.annotate_params),
+        # feeds batch-sharded over the data axes, rng/lr/step and
+        # fetches replicated.  out_shardings mirror in_shardings for
+        # the train state so donation aliases shard-for-shard and the
+        # steady state never reshards.
+        from ..distributed.auto_parallel import sharding as spmd
+        plan = spmd.get_mesh_plan()
+        param_specs = opt_specs = frozen_specs = None
+        jit_shardings = {}
+        spmd_named = None
+        if plan is not None:
+            def _pspec(t):
+                return plan.spec_for(spmd.spmd_name(t),
+                                     tuple(t._value.shape))
+
+            param_specs = [_pspec(p) for p in trainable]
+            spec_by_param = {id(p): s
+                             for p, s in zip(trainable, param_specs)}
+            # optimizer accumulators inherit the owning param's layout
+            # (they are named "<param.name>_<acc>" and shape-match it);
+            # shape-mismatched state (scalars, (1,) slots) replicates
+            by_len = sorted(trainable, key=lambda p: -len(p.name))
+
+            def _opt_spec(t):
+                for p in by_len:
+                    if (t.name.startswith(p.name + "_")
+                            and tuple(t._value.shape)
+                            == tuple(p._value.shape)):
+                        return spec_by_param[id(p)]
+                return spmd._pspec()()
+
+            opt_specs = [_opt_spec(t) for t in opt_state]
+            frozen_specs = [_pspec(t) for t in frozen]
+            feed_specs = [plan.batch_spec(a.shape)
+                          for a in feed_avals[:len(feed_names)]]
+            ns = plan.sharding
+            repl = plan.replicated()
+            feed_shardings = tuple(ns(s) for s in feed_specs) + tuple(
+                ns(s) for s in frozen_specs)
+            param_shardings = tuple(ns(s) for s in param_specs)
+            opt_shardings = tuple(ns(s) for s in opt_specs)
+            rng_shardings = tuple(repl for _ in rng_states)
+            in_shardings = (feed_shardings, param_shardings,
+                            opt_shardings, rng_shardings, repl, repl)
+            out_shardings = (tuple(repl for _ in fetch_vars),
+                             param_shardings, opt_shardings,
+                             rng_shardings)
+            jit_shardings = {"in_shardings": in_shardings,
+                             "out_shardings": out_shardings}
+            spmd_named = [(spmd.spmd_name(t), tuple(t._value.shape),
+                           int(np.prod(t._value.shape))
+                           * t._value.dtype.itemsize)
+                          for t in trainable + frozen]
+        jitted = jax.jit(pure, donate_argnums=(1, 2) if donate else (),
+                         **jit_shardings)
+
         # named resident buffers for the memory guard's top-k report
         # (params + optimizer state + frozen captures; feeds from avals)
         from ..memory.estimator import named_buffer_sizes
@@ -460,6 +556,22 @@ class Executor:
         named_buffers += [
             (f"feed:{n}", int(np.prod(a.shape)) * a.dtype.itemsize)
             for n, a in zip(feed_names, feed_avals)]
+        if plan is not None:
+            # preflight charges per-DEVICE bytes: sharded residents
+            # divide by their axis-size product, replicated ones are
+            # charged whole (acceptance: per-device <= 1/axis_size of
+            # the replicated estimate for sharded residents)
+            factor = {}
+            for p, s in zip(trainable, param_specs):
+                factor[f"param:{p.name}"] = plan.shard_factor(s)
+            for t, s in zip(opt_state, opt_specs):
+                factor[f"opt_state:{t.name}"] = plan.shard_factor(s)
+            for t, s in zip(frozen, frozen_specs):
+                factor[f"frozen:{t.name}"] = plan.shard_factor(s)
+            for n, s in zip(feed_names, feed_specs):
+                factor[f"feed:{n}"] = plan.shard_factor(s)
+            named_buffers = [(n, sz // factor.get(n, 1))
+                             for n, sz in named_buffers]
 
         entry = {
             "compiled": None,
@@ -481,7 +593,18 @@ class Executor:
             "loop_estimate": None,
             "flow": obs.next_flow_id(),
             "loop_flow": obs.next_flow_id(),
+            "plan": plan,
+            "placed": plan is None,
+            "spmd_named": spmd_named,
         }
+        if plan is not None:
+            entry["feed_shardings"] = feed_shardings[:len(feed_names)]
+            entry["frozen_shardings"] = feed_shardings[len(feed_names):]
+            entry["param_shardings"] = param_shardings
+            entry["opt_shardings"] = opt_shardings
+            entry["rng_shardings"] = rng_shardings
+            entry["in_shardings"] = in_shardings
+            entry["out_shardings"] = out_shardings
 
         def compile_step():
             # deferred: a run_steps-only caller (bench fused loop) must
@@ -593,11 +716,20 @@ class Executor:
                                                 record_compile_metrics)
             ensure_compile_cache()
             t0 = time.perf_counter()
+            loop_shardings = {}
+            if entry.get("plan") is not None:
+                # same layout as the single step; the iteration count n
+                # rides replicated
+                loop_shardings = {
+                    "in_shardings": (*entry["in_shardings"],
+                                     entry["plan"].replicated()),
+                    "out_shardings": entry["out_shardings"]}
             with obs.span("compile:" + entry["program_label"]
                           + ".run_steps", cat="compile",
                           flow_out=entry["loop_flow"]):
                 loop_fn = jax.jit(
-                    loop, donate_argnums=(1, 2) if entry["donate"] else ()
+                    loop, donate_argnums=(1, 2) if entry["donate"] else (),
+                    **loop_shardings
                 ).lower(feed_vals, param_vals, opt_state_vals, rng_vals,
                         lr_val, step_val,
                         jax.ShapeDtypeStruct((), jnp.int32)).compile()
@@ -618,7 +750,9 @@ class Executor:
         sp = obs.span(entry["program_label"] + ".run_steps",
                       cat="dispatch", step=_obs_step(step_val),
                       flow_in=entry["loop_flow"], n_iters=n_iters,
-                      h2d_bytes=_nbytes_of(feed_vals))
+                      h2d_bytes=_nbytes_of(feed_vals),
+                      **({"mesh": entry["plan"].describe()}
+                         if entry.get("plan") is not None else {}))
         from ..device import hbm_oom_context
         with sp, hbm_oom_context(program=entry["program_label"]
                                  + ".run_steps",

@@ -64,12 +64,36 @@ def gated_metrics(payload):
     return out
 
 
-def compare(last_good, fresh, threshold):
-    """(regressions, rows) over metrics present in BOTH captures."""
+def host_mesh_metrics(payload):
+    """Throughput metrics measured on the FORCED host mesh (a config
+    marks itself with ``<cfg>_forced_host_mesh: true`` — bench.py's
+    ``bert_dp`` sharded config does when the runtime has one device).
+    These numbers come from the same 8-device CPU host mesh regardless
+    of the capture's platform, so they stay comparable across captures
+    a platform mismatch would otherwise disqualify."""
+    em = payload.get("extra_metrics") or {}
+    out = set()
+    for name, flag in em.items():
+        if not (name.endswith("_forced_host_mesh") and flag):
+            continue
+        prefix = name[:-len("_forced_host_mesh")]
+        for n, v in em.items():
+            if n.startswith(prefix) and n.endswith(GATE_SUFFIXES) \
+                    and isinstance(v, (int, float)) and v > 0:
+                out.add(n)
+    return out
+
+
+def compare(last_good, fresh, threshold, only=None):
+    """(regressions, rows) over metrics present in BOTH captures.
+    ``only`` restricts the comparison to that set of metric names."""
     old = gated_metrics(last_good)
     new = gated_metrics(fresh)
+    names = set(old) & set(new)
+    if only is not None:
+        names &= set(only)
     rows, regressions = [], []
-    for name in sorted(set(old) & set(new)):
+    for name in sorted(names):
         delta = new[name] / old[name] - 1.0
         verdict = "ok"
         if delta < -threshold:
@@ -133,13 +157,27 @@ def main(argv=None):
         emit("SKIP", note="fresh capture is not a live measurement "
              "(unreachable TPU or re-emitted cache); refusing to judge")
         return 0
+    only = None
+    mismatch_note = ""
     if last_good.get("platform") != fresh.get("platform"):
-        emit("SKIP", note=f"platform mismatch: last-good "
-             f"{last_good.get('platform')} vs fresh "
-             f"{fresh.get('platform')}")
-        return 0
+        # platform-bound metrics are incomparable across platforms, but
+        # forced-host-mesh sharded configs measured the SAME 8-device
+        # CPU mesh in both captures — judge those instead of skipping
+        only = host_mesh_metrics(last_good) & host_mesh_metrics(fresh)
+        if not only:
+            emit("SKIP", note=f"platform mismatch: last-good "
+                 f"{last_good.get('platform')} vs fresh "
+                 f"{fresh.get('platform')}")
+            return 0
+        mismatch_note = (f" [platform mismatch "
+                         f"{last_good.get('platform')} vs "
+                         f"{fresh.get('platform')}: judging "
+                         f"forced-host-mesh metrics only]")
+        log("platform mismatch; comparing host-mesh metrics: "
+            + ", ".join(sorted(only)))
 
-    regressions, rows = compare(last_good, fresh, args.threshold)
+    regressions, rows = compare(last_good, fresh, args.threshold,
+                                only=only)
     if not rows:
         emit("SKIP", note="no shared throughput metrics between the "
              "two captures")
@@ -148,11 +186,11 @@ def main(argv=None):
         emit("FAIL", rows, note=f"{len(regressions)} metric(s) dropped "
              f">{args.threshold:.0%} vs "
              f"{last_good.get('git_rev', '?')} "
-             f"({last_good.get('captured_at', '?')})")
+             f"({last_good.get('captured_at', '?')})" + mismatch_note)
         return 1
     emit("PASS", rows,
          note=f"no metric dropped >{args.threshold:.0%} vs "
-         f"{last_good.get('git_rev', '?')}")
+         f"{last_good.get('git_rev', '?')}" + mismatch_note)
     return 0
 
 

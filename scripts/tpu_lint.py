@@ -12,7 +12,10 @@ Lints the bundled models without needing a TPU:
     ``Executor.analyze_program`` (the fingerprint-cache path);
   * **gpt**   — static-graph causal-LM step (AMP bf16 + recompute);
   * **pallas** — flash / paged attention block plans checked against the
-    Mosaic tiling rules (``analysis.tiling``), no kernel launch.
+    Mosaic tiling rules (``analysis.tiling``), no kernel launch;
+  * **sharding** — built-in BERT/GPT partition-rule sets audited against
+    virtual ``dp=2,tp=2`` / ``fsdp=2`` meshes (TPU501 rule miss,
+    TPU502 large-replicated), no multi-device runtime needed.
 
 Every finding is a structured ``Diagnostic`` (stable TPUxxx code,
 severity, site, fix hint).  Exit code is 1 iff any diagnostic at or
@@ -32,7 +35,7 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(
 
 import numpy as np  # noqa: E402
 
-MODELS = ("lenet", "bert", "gpt", "pallas")
+MODELS = ("lenet", "bert", "gpt", "pallas", "sharding")
 
 
 def lint_lenet():
@@ -177,8 +180,53 @@ def lint_pallas():
     return report
 
 
+def lint_sharding():
+    """Partition-rule coverage for the built-in BERT/GPT rule sets on
+    virtual meshes (TPU501/502) — no multi-device runtime needed.
+
+    Builds each bundled model dygraph, stamps structural param names
+    (``annotate_params``), and audits the inventory against virtual
+    ``dp=2,tp=2`` and ``fsdp=2`` MeshPlans: a param no rule matches is
+    TPU501; a large param the plan leaves replicated under a model-
+    parallel mesh is TPU502."""
+    import paddle_tpu as paddle
+    from paddle_tpu.analysis.diagnostics import DiagnosticReport, record
+    from paddle_tpu.analysis.sharding_audit import audit_sharding
+    from paddle_tpu.distributed.auto_parallel.sharding import (
+        BERT_RULES, GPT_RULES, MeshPlan, annotate_params)
+    from paddle_tpu.models import (BertConfig, BertForMaskedLM,
+                                   GPTConfig, GPTForCausalLM)
+
+    paddle.disable_static()
+    paddle.seed(0)
+    builds = {
+        "bert": (BERT_RULES(), lambda: BertForMaskedLM(BertConfig(
+            hidden_size=128, num_hidden_layers=2, num_attention_heads=2,
+            intermediate_size=256))),
+        "gpt": (GPT_RULES(), lambda: GPTForCausalLM(GPTConfig(
+            vocab_size=256, hidden_size=128, num_hidden_layers=2,
+            num_attention_heads=2, use_flash_attention=False,
+            max_position_embeddings=128))),
+    }
+    report = DiagnosticReport(label="sharding rules")
+    for model_name, (rules, build) in builds.items():
+        named = annotate_params(build())
+        inventory = [(name, tuple(p.shape),
+                      int(getattr(p._value, "nbytes", 0)))
+                     for name, p in named.items()]
+        for mesh_spec in ("dp=2,tp=2", "fsdp=2"):
+            plan = MeshPlan(mesh_spec, rules=rules, virtual=True)
+            diags = audit_sharding(
+                plan, inventory,
+                site=f"{model_name}[{mesh_spec}]")
+            for d in diags:
+                record(d)
+            report.extend(diags)
+    return report
+
+
 LINTERS = {"lenet": lint_lenet, "bert": lint_bert, "gpt": lint_gpt,
-           "pallas": lint_pallas}
+           "pallas": lint_pallas, "sharding": lint_sharding}
 
 
 def run_models(names):
