@@ -693,6 +693,9 @@ def bench_gpt_decode(on_tpu):
             for r in (eng._results[i] for i in ids)
             if r.t_first_token is not None and r.t_submit is not None)
         ttft_ms = ttfts[len(ttfts) // 2] if ttfts else 0.0
+        p99_ttft_ms = (ttfts[min(len(ttfts) - 1,
+                                 int(round(0.99 * (len(ttfts) - 1))))]
+                       if ttfts else 0.0)
         hit_rate = ((eng.cache._hit_tokens - hit0)
                     / max(1, eng.cache._lookup_tokens - look0))
         s = eng.stats()
@@ -701,17 +704,50 @@ def bench_gpt_decode(on_tpu):
             f"prefill {prefill_ms:.1f} ms, ttft {ttft_ms:.1f} ms, "
             f"prefix hit rate {hit_rate:.0%}, "
             f"kv high-water {s['high_water']}/{s['num_blocks']}")
-        return {"tokens_per_sec": round(tokens_per_sec, 1),
-                "prefill_ms": round(prefill_ms, 2),
-                "ttft_ms": round(ttft_ms, 2),
-                "prefix_hit_rate": round(hit_rate, 4),
-                "shared_prefix_len": shared_len,
-                "n_requests": n_req, "max_new_tokens": max_new,
-                "max_batch": max_batch,
-                "kv_high_water": s["high_water"],
-                "kv_blocks": s["num_blocks"]}
+        out = {"tokens_per_sec": round(tokens_per_sec, 1),
+               "prefill_ms": round(prefill_ms, 2),
+               "ttft_ms": round(ttft_ms, 2),
+               "p99_ttft_ms": round(p99_ttft_ms, 2),
+               "prefix_hit_rate": round(hit_rate, 4),
+               "shared_prefix_len": shared_len,
+               "n_requests": n_req, "max_new_tokens": max_new,
+               "max_batch": max_batch,
+               "kv_high_water": s["high_water"],
+               "kv_blocks": s["num_blocks"]}
     finally:
         eng.close()
+
+    # speculative phase: the target drafts for itself (greedy ->
+    # every draft accepted), so this isolates the verify-step overhead
+    # against the plain decode loop above
+    spec_eng = GenerationEngine(model, max_batch=max_batch,
+                                max_model_len=cfg.max_position_embeddings,
+                                speculative=model)
+    try:
+        t = time.time()
+        spec_eng.generate(prompts, max_new_tokens=max_new)  # compiles
+        log(f"gpt_decode[spec]: compile+first burst "
+            f"{time.time() - t:.1f}s "
+            f"({spec_eng.stats()['step_compiles']} program(s))")
+        t = time.time()
+        ids = [spec_eng.add_request(p, max_new_tokens=max_new)
+               for p in prompts]
+        while spec_eng.has_unfinished():
+            spec_eng.step()
+        sdt = time.time() - t
+        spec_tps = n_req * max_new / sdt
+        ss = spec_eng.stats()
+        log(f"gpt_decode[spec]: {n_req} reqs x {max_new} tok in "
+            f"{sdt:.2f}s {spec_tps:,.0f} tok/s, accept rate "
+            f"{ss['spec_accept_rate']:.0%} "
+            f"({ss['tokens_accepted']}/{ss['tokens_drafted']})")
+        out["spec_tokens_per_sec"] = round(spec_tps, 1)
+        out["spec_accept_rate"] = round(ss["spec_accept_rate"], 4)
+        out["spec_tokens_drafted"] = ss["tokens_drafted"]
+        out["spec_tokens_accepted"] = ss["tokens_accepted"]
+    finally:
+        spec_eng.close()
+    return out
 
 
 # ---------------------------------------------------------------------
@@ -1179,10 +1215,17 @@ def main():
             payload["extra_metrics"]["gpt_prefill_ms"] = \
                 res["prefill_ms"]
             payload["extra_metrics"]["gpt_ttft_ms"] = res["ttft_ms"]
+            payload["extra_metrics"]["gpt_p99_ttft_ms"] = \
+                res["p99_ttft_ms"]
             payload["extra_metrics"]["gpt_prefix_hit_rate"] = \
                 res["prefix_hit_rate"]
             payload["extra_metrics"]["gpt_decode_kv_high_water"] = \
                 res["kv_high_water"]
+            if "spec_tokens_per_sec" in res:
+                payload["extra_metrics"]["gpt_spec_tokens_per_sec"] = \
+                    res["spec_tokens_per_sec"]
+                payload["extra_metrics"]["gpt_spec_accept_rate"] = \
+                    res["spec_accept_rate"]
         elif name == "llama":
             payload["extra_metrics"][
                 "llama_0p3b_recompute_bf16_tokens_per_sec"] = \

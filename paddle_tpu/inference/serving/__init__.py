@@ -1,5 +1,6 @@
 """LLM serving: paged KV cache with COW prefix caching, chunked-prefill
-continuous batching, and the unified ragged generation engine.
+continuous batching, the unified ragged generation engine, speculative
+decoding, SLO-aware multi-tenant scheduling, and streaming delivery.
 
 The multi-request generation layer over models/gpt.py — see
 README.md §"Serving".  Entry point: ``GenerationEngine``.
@@ -12,8 +13,17 @@ from .attention import (PagedCacheView, PagedLayerCache,
                         kv_cache_scatter, paged_attention,
                         ragged_attention)
 from .scheduler import (ENV_MAX_BATCH, ENV_PREFILL_CHUNK,
-                        ContinuousBatchingScheduler, PrefillChunk,
-                        Request, max_batch_size, prefill_chunk_size)
+                        AdmissionPolicy, ContinuousBatchingScheduler,
+                        PrefillChunk, Request, TokenBudgetPolicy,
+                        VictimPolicy, YoungestFirst, max_batch_size,
+                        prefill_chunk_size)
+from .speculative import (ENV_SPEC_DRAFT, ENV_SPEC_K,
+                          DraftModelProposer, DraftWorker,
+                          NgramProposer, SpeculativeConfig, spec_draft,
+                          spec_k)
+from .slo import SLOPolicy, TenantSpec
+from .streaming import (ENV_STREAM_QUEUE, StreamEvent, TokenStream,
+                        stream_queue_depth)
 from .engine import (GenerationEngine, ragged_sample_next,
                      serving_sample_next)
 from .dp import DataParallelEngine
@@ -26,6 +36,14 @@ __all__ = [
     "ragged_attention",
     "ENV_MAX_BATCH", "ENV_PREFILL_CHUNK", "ContinuousBatchingScheduler",
     "PrefillChunk", "Request", "max_batch_size", "prefill_chunk_size",
+    "AdmissionPolicy", "TokenBudgetPolicy", "VictimPolicy",
+    "YoungestFirst",
+    "ENV_SPEC_K", "ENV_SPEC_DRAFT", "SpeculativeConfig",
+    "NgramProposer", "DraftModelProposer", "DraftWorker", "spec_k",
+    "spec_draft",
+    "SLOPolicy", "TenantSpec",
+    "ENV_STREAM_QUEUE", "StreamEvent", "TokenStream",
+    "stream_queue_depth",
     "GenerationEngine", "ragged_sample_next", "serving_sample_next",
     "DataParallelEngine",
 ]

@@ -355,7 +355,10 @@ class RaggedCacheView:
     dispatch), extended with the per-q-block segment descriptors and
     the per-sequence sampling indices the engine's in-graph sampler
     reads (``last_index`` into the flat token dim, ``sample_pos``
-    absolute positions for schedule-invariant keys).
+    absolute positions for schedule-invariant keys).  Both sampling
+    arrays are ``[S, C]``: C sampling *columns* per row — C = 1 for
+    plain decode, C = k + 1 under speculative decoding, where column j
+    samples the target token following draft j (serving/speculative.py).
     """
 
     mode = "ragged"
@@ -370,8 +373,8 @@ class RaggedCacheView:
         self.seq_ids = None        # [T // block_q] int32 (S = null)
         self.q_starts = None       # [T // block_q] int32
         self.q_valids = None       # [T // block_q] int32
-        self.last_index = None     # [S] int32 flat sampling index
-        self.sample_pos = None     # [S] int64 absolute sampling pos
+        self.last_index = None     # [S, C] int32 flat sampling indices
+        self.sample_pos = None     # [S, C] int64 absolute sampling pos
         self._layers = [RaggedLayerCache(self, i)
                         for i in range(cache.num_layers)]
 

@@ -91,13 +91,42 @@ class DataParallelEngine:
                 finished.extend(eng.step())
         return finished
 
-    def generate(self, prompts, **kwargs):
+    def generate(self, prompts, stream=False, **kwargs):
         """Run a batch of prompts to completion across the replicas.
-        Returns one full token list per prompt, in order."""
+
+        ``stream=False``: one full token list per prompt, in order.
+        ``stream=True``: a generator of
+        :class:`~.streaming.StreamEvent` tuples across all replicas,
+        yielding tokens as their owning replica commits them."""
+        if stream:
+            return self._generate_stream(prompts, **kwargs)
         ids = [self.add_request(p, **kwargs) for p in prompts]
         while self.has_unfinished():
             self.step()
         return [self.result(i) for i in ids]
+
+    def open_stream(self, request_id):
+        """Live token queue for a request, on its owning replica."""
+        return self.engines[self._owner[request_id]].open_stream(
+            request_id)
+
+    def _generate_stream(self, prompts, **kwargs):
+        ids = [self.add_request(p, **kwargs) for p in prompts]
+        streams = [self.open_stream(i) for i in ids]
+        try:
+            while True:
+                if self.has_unfinished():
+                    self.step()
+                for st in streams:
+                    for ev in st.drain():
+                        yield ev
+                if all(st.done for st in streams):
+                    return
+        finally:
+            for i in ids:
+                shard = self._owner.get(i)
+                if shard is not None:
+                    self.engines[shard]._streams.pop(i, None)
 
     def result(self, request_id):
         return self.engines[self._owner[request_id]].result(request_id)
@@ -106,7 +135,8 @@ class DataParallelEngine:
     def stats(self):
         """Aggregate totals plus a ``per_shard`` breakdown."""
         per_shard = {}
-        total = {"tokens_generated": 0, "queue_depth": 0, "running": 0,
+        total = {"tokens_generated": 0, "tokens_drafted": 0,
+                 "tokens_accepted": 0, "queue_depth": 0, "running": 0,
                  "step_compiles": 0}
         for i, eng in enumerate(self.engines):
             s = eng.stats()

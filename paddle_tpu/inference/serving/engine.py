@@ -26,10 +26,30 @@ unified ragged step program:
     previous step's device-side output array (an eager device scatter,
     no host read), and results drain lazily ``pipeline_depth - 1``
     steps behind dispatch through the PR-4 in-flight window;
+  * **speculative decoding** (``speculative=`` / PADDLE_TPU_SPEC_K,
+    serving/speculative.py): a proposer drafts up to k tokens per
+    decode row and the SAME compiled step verifies all k+1 positions at
+    once — each spec row is a (k+1)-token prefill-like segment, the
+    sampler reads k+1 columns (``last_index``/``sample_pos`` go
+    ``[S, C]``), acceptance is deterministic token matching, and
+    rejection is one paged-cache ``truncate()``.  Output is
+    bit-identical to the non-speculative engine.  Spec steps drain
+    host-synchronously (the accept decision gates the next feed), so
+    ``speculative=None`` keeps the device-fed pipelined loop untouched;
+  * **SLO multi-tenant serving** (``slo=`` + serving/slo.py): an
+    :class:`~.slo.SLOPolicy` plugs into all three scheduler policy
+    hooks (admission, victim, token budget) and the engine feeds it
+    per-token/TTFT/finish callbacks for quota charging and
+    ``serving.slo_violations`` accounting;
+  * **streaming** (``generate(stream=True)`` + serving/streaming.py):
+    tokens are pushed into bounded per-request :class:`TokenStream`
+    queues as they are committed and yielded as
+    :class:`~.streaming.StreamEvent` tuples;
   * observability: ``prefill:chunk`` / ``decode`` timeline lanes, and
     ``serving.tokens_per_sec`` / ``serving.ttft_ms`` /
     ``serving.prefix_hit_rate`` / ``serving.kv_blocks_shared`` /
-    ``serving.queue_depth`` metrics.
+    ``serving.queue_depth`` metrics, plus per-tenant token instants
+    feeding ``phase_breakdown()["tenants"]``.
 
 See README.md §"Serving" for usage and knobs.
 """
@@ -53,6 +73,8 @@ from .kv_cache import PagedKVCache
 from .attention import RaggedCacheView
 from .scheduler import (ContinuousBatchingScheduler, Request,
                         max_batch_size, prefill_chunk_size)
+from .speculative import SpeculativeConfig
+from .streaming import TokenStream
 
 __all__ = ["GenerationEngine", "serving_sample_next",
            "ragged_sample_next"]
@@ -119,16 +141,31 @@ def serving_sample_next(logits, last_index, seeds, positions, do_sample,
 
 def _ragged_sample_impl(logits, last_index, seeds, positions, do_sample,
                         top_k, top_p, temperature):
-    """logits [1, T, V] (flat ragged step) -> next token [S] int64.
+    """logits [1, T, V] (flat ragged step) -> next tokens, int64.
 
-    Sequence s reads the flat row ``last_index[s]`` — its last valid
-    query this step.  Rows that scheduled no sampling token this step
-    (mid-prefill, idle) read a stale index and produce garbage the
-    engine never drains.  Same filter/draw semantics as
-    `_sample_next_impl`."""
-    z = logits[0, last_index.astype(jnp.int32)].astype(jnp.float32)
-    return _filter_and_draw(z, seeds, positions, do_sample, top_k,
-                            top_p, temperature)
+    With 1-D ``last_index`` [S]: sequence s reads the flat row
+    ``last_index[s]`` — its last valid query this step — and the result
+    is [S].  With 2-D ``last_index`` [S, C] (speculative verify):
+    column j reads the logits following draft prefix d_1..d_j, and the
+    per-row controls (seed, filters) are broadcast across the C
+    columns, so every column draws with the key the sequential step
+    would have used at that absolute position — the result is [S, C].
+    Rows/columns that scheduled no sampling token this step
+    (mid-prefill, idle, width < C) read a clamped/stale index and
+    produce garbage the engine never drains.  Same filter/draw
+    semantics as `_sample_next_impl`."""
+    li = last_index.astype(jnp.int32)
+    if li.ndim == 1:
+        z = logits[0, li].astype(jnp.float32)
+        return _filter_and_draw(z, seeds, positions, do_sample, top_k,
+                                top_p, temperature)
+    S, C = li.shape
+    z = logits[0, li.reshape(-1)].astype(jnp.float32)
+    rep = lambda a: jnp.repeat(a, C, axis=0)  # noqa: E731
+    out = _filter_and_draw(z, rep(seeds), positions.reshape(-1),
+                           rep(do_sample), rep(top_k), rep(top_p),
+                           rep(temperature))
+    return out.reshape(S, C)
 
 
 def ragged_sample_next(logits, last_index, seeds, positions, do_sample,
@@ -155,7 +192,7 @@ class GenerationEngine:
     def __init__(self, model, config=None, max_batch=None,
                  block_size=None, num_blocks=None, max_model_len=None,
                  prefill_chunk=None, hbm_fraction=0.3,
-                 prefix_cache=None):
+                 prefix_cache=None, speculative=None, slo=None):
         import paddle_tpu as paddle
         cfg = config or getattr(model, "config", None) \
             or model.gpt.config
@@ -188,8 +225,22 @@ class GenerationEngine:
                              + (self.max_batch - 1) * self.block_q)
         self.num_q_blocks = self.token_budget // self.block_q
 
+        # SLO policy (slo.py): one object drives all three scheduler
+        # policy hooks plus the engine's accounting callbacks
+        self.slo = slo
         self.scheduler = ContinuousBatchingScheduler(
-            self.cache, self.max_batch, self.prefill_chunk)
+            self.cache, self.max_batch, self.prefill_chunk,
+            victim_policy=slo, admission_policy=slo, budget_policy=slo)
+
+        # speculative decoding (speculative.py): verify segments are
+        # k+1 tokens wide and must fit one q-block
+        self.spec = SpeculativeConfig.resolve(speculative)
+        self.proposer = None
+        self.spec_cols = 1
+        if self.spec is not None:
+            self.spec.k = max(1, min(self.spec.k, self.block_q - 1))
+            self.spec_cols = self.spec.k + 1
+            self.proposer = self.spec.build_proposer(self)
 
         self._view = RaggedCacheView(self.cache, self.block_q)
         self._step_fn = paddle.jit.to_static(self._ragged_step)
@@ -198,10 +249,14 @@ class GenerationEngine:
         self._last_tokens = jnp.zeros((self.max_batch,), jnp.int64)
         self._pending = []        # [(rows_reqs, device_tokens)]
         self._results = {}        # req.id -> Request
+        self._streams = {}        # req.id -> TokenStream
         self._req_counter = 0
         self._step_idx = 0
         self._step_finished = []
         self._tokens_generated = 0
+        self._tokens_drafted = 0
+        self._tokens_accepted = 0
+        self._step_tenant_tokens = {}
 
     # -- the ONE traced step function -----------------------------------
     def _ragged_step(self, ids, seeds, do_sample, top_k, top_p,
@@ -216,7 +271,7 @@ class GenerationEngine:
     # -- public API -----------------------------------------------------
     def add_request(self, prompt, max_new_tokens=16, do_sample=False,
                     top_k=0, top_p=1.0, temperature=1.0, seed=0,
-                    eos_token_id=None, request_id=None):
+                    eos_token_id=None, request_id=None, tenant=None):
         """Enqueue one prompt; returns the request id."""
         prompt = [int(t) for t in np.asarray(prompt).reshape(-1)]
         if not prompt:
@@ -233,7 +288,7 @@ class GenerationEngine:
         req = Request(request_id, prompt, max_new_tokens=max_new_tokens,
                       do_sample=do_sample, top_k=top_k, top_p=top_p,
                       temperature=temperature, seed=seed,
-                      eos_token_id=eos_token_id)
+                      eos_token_id=eos_token_id, tenant=tenant)
         self.scheduler.submit(req)
         obs.get_registry().gauge("serving.queue_depth").set(
             self.scheduler.queue_depth)
@@ -248,6 +303,7 @@ class GenerationEngine:
         requests that finished this step."""
         self._step_idx += 1
         self._step_finished = []
+        self._step_tenant_tokens = {}
         while True:
             action, payload = self.scheduler.next_action()
             if action == "admit":
@@ -255,18 +311,33 @@ class GenerationEngine:
                 continue
             break
         if action == "step":
-            self._run_step(payload)
+            if self.proposer is not None:
+                self._run_spec_step(payload)
+            else:
+                self._run_step(payload)
         elif self._pending:
             self._drain(0)       # nothing to schedule: retire in flight
         self._drain(max(0, pipeline_depth() - 1))
         self._collect_finished()
-        obs.get_registry().gauge("serving.queue_depth").set(
-            self.scheduler.queue_depth)
+        reg = obs.get_registry()
+        reg.gauge("serving.queue_depth").set(self.scheduler.queue_depth)
+        for t, n in self._step_tenant_tokens.items():
+            reg.counter(f"serving.tenant.{t}.tokens").inc(n)
+            obs.instant("serving.tenant.tokens", cat="decode",
+                        step=self._step_idx, tenant=t, n=n)
         return list(self._step_finished)
 
-    def generate(self, prompts, **kwargs):
-        """Run a batch of prompts to completion.  Returns one full token
-        list (prompt + generated) per prompt, in order."""
+    def generate(self, prompts, stream=False, **kwargs):
+        """Run a batch of prompts to completion.
+
+        ``stream=False``: returns one full token list
+        (prompt + generated) per prompt, in order.
+        ``stream=True``: returns a generator of
+        :class:`~.streaming.StreamEvent` tuples, yielding each token as
+        it is committed (decode drain or speculative acceptance)
+        instead of waiting for completions."""
+        if stream:
+            return self._generate_stream(prompts, **kwargs)
         ids = [self.add_request(p, **kwargs) for p in prompts]
         t0 = time.perf_counter()
         n0 = self._tokens_generated
@@ -278,6 +349,30 @@ class GenerationEngine:
                 (self._tokens_generated - n0) / elapsed)
         return [self.result(i) for i in ids]
 
+    def open_stream(self, request_id):
+        """Bounded live token queue for an enqueued request; the engine
+        pushes committed tokens into it during step()."""
+        st = self._streams.get(request_id)
+        if st is None:
+            st = self._streams[request_id] = TokenStream(request_id)
+        return st
+
+    def _generate_stream(self, prompts, **kwargs):
+        ids = [self.add_request(p, **kwargs) for p in prompts]
+        streams = [self.open_stream(i) for i in ids]
+        try:
+            while True:
+                if self.has_unfinished():
+                    self.step()
+                for st in streams:
+                    for ev in st.drain():
+                        yield ev
+                if all(st.done for st in streams):
+                    return
+        finally:
+            for i in ids:
+                self._streams.pop(i, None)
+
     def result(self, request_id):
         """Full token sequence of a finished request."""
         req = self._results[request_id]
@@ -285,14 +380,24 @@ class GenerationEngine:
 
     def stats(self):
         s = self.cache.stats()
+        compiles = len(self._step_fn._cache)
+        if self.proposer is not None:
+            compiles += self.proposer.step_compiles
         s.update(queue_depth=self.scheduler.queue_depth,
                  running=len(self.scheduler.running),
                  tokens_generated=self._tokens_generated,
+                 tokens_drafted=self._tokens_drafted,
+                 tokens_accepted=self._tokens_accepted,
+                 spec_accept_rate=(self._tokens_accepted
+                                   / self._tokens_drafted
+                                   if self._tokens_drafted else 0.0),
                  token_budget=self.token_budget,
-                 step_compiles=len(self._step_fn._cache))
+                 step_compiles=compiles)
         return s
 
     def close(self):
+        if self.proposer is not None:
+            self.proposer.close()
         self.cache.close()
 
     # -- admission ------------------------------------------------------
@@ -332,25 +437,27 @@ class GenerationEngine:
             if rid in self.cache:        # freed rows need no rollback
                 self.cache.truncate(rid, before)
 
-    def _reserve_slots(self, active, appended):
-        """Extend every decode sequence by one slot; on pool exhaustion
-        retire in-flight work, then preempt the youngest sequence to the
-        waiting queue.  Returns False when the active set changed."""
+    def _reserve_slots(self, active, appended, widths=None):
+        """Extend every decode sequence by its step width (1 slot, or
+        1 + drafts under speculation); on pool exhaustion retire
+        in-flight work, then preempt the policy's victim to the waiting
+        queue.  Returns False when the active set changed."""
         for req in active:
             if req.id in appended:
                 continue
+            w = 1 if widths is None else widths.get(req.id, 1)
             before = self.cache.length(req.id)
-            if self.cache.append(req.id):
+            if self.cache.append(req.id, w):
                 appended[req.id] = before
                 continue
             self._drain(0)
             self._collect_finished()     # finished rows free blocks
             if req.done:
                 return False             # freed itself: rebuild active
-            if self.cache.append(req.id):
+            if self.cache.append(req.id, w):
                 appended[req.id] = before
                 continue
-            victim = self.scheduler.preempt_youngest()
+            victim = self.scheduler.select_victim()
             if victim is None:
                 raise RuntimeError(
                     "KV pool exhausted with nothing left to preempt")
@@ -369,6 +476,8 @@ class GenerationEngine:
                     generated=len(victim.generated))
         if victim.row is not None:
             self._rows[victim.row] = None
+        if self.proposer is not None:
+            self.proposer.drop(victim.id)
         self.scheduler.requeue(victim, victim.generated)
 
     def _dispatch_step(self, chunk, decodes):
@@ -453,7 +562,9 @@ class GenerationEngine:
                 stack.enter_context(obs.span(
                     "prefill:chunk", cat="prefill", step=self._step_idx,
                     request=chunk.request.id, start=chunk.start,
-                    tokens=chunk.length))
+                    tokens=chunk.length,
+                    **({"tenant": chunk.request.tenant}
+                       if chunk.request.tenant else {})))
             tok = self._step_fn(ids_t, *args)
         self._last_tokens = tok._value
         for _, req in rows_reqs:
@@ -464,6 +575,176 @@ class GenerationEngine:
             req = chunk.request
             req.num_computed = chunk.start + chunk.length
             # landed blocks join the prefix index for future sharers
+            self.cache.commit_prefix(
+                req.id, req.prompt[:req.num_computed])
+
+    # -- the speculative step -------------------------------------------
+    def _run_spec_step(self, plan):
+        """Spec variant of `_run_step`: propose -> reserve ``k_row + 1``
+        slots per decode row -> ONE verify dispatch -> host-synchronous
+        accept/rollback.  Proposals are deterministic (greedy draft /
+        n-gram lookup over an unchanged history), so re-proposing after
+        a preemption re-plan yields identical widths for surviving
+        rows."""
+        appended = {}            # req.id -> length before this round
+        while True:
+            chunk, decodes = plan
+            drafts = self._propose(decodes)
+            widths = {r.id: 1 + len(drafts.get(r.id, ()))
+                      for r in decodes}
+            if self._reserve_slots(decodes, appended, widths):
+                break
+            action, payload = self.scheduler.next_action()
+            if action != "step":
+                self._rollback_slots(appended)
+                return
+            plan = payload
+        self._dispatch_spec_step(chunk, decodes, drafts, appended)
+
+    def _propose(self, decodes):
+        """Drafts for every decode row that still has room to speculate
+        (``kmax >= 1`` after the remaining-token and max_model_len
+        clamps; a row with no room verifies as a plain width-1 step)."""
+        items = []
+        for req in decodes:
+            history = list(req.prompt) + list(req.generated)
+            # the row's verify segment starts where its last committed
+            # token will scatter (cache-length invariant; do NOT read
+            # cache.length here — a re-plan retry may already have
+            # appended this row's slots)
+            base = len(history) - 1
+            kmax = min(self.spec.k,
+                       req.max_new_tokens - len(req.generated) - 1,
+                       self.max_model_len - base - 1)
+            if kmax >= 1:
+                items.append((req, history, kmax))
+        if not items:
+            return {}
+        return self.proposer.propose_batch(items)
+
+    def _dispatch_spec_step(self, chunk, decodes, drafts, appended):
+        """Pack the chunk + per-row verify segments (the row's last
+        known token plus its drafts, one q-block each) into the flat
+        buffer, dispatch the ONE compiled step, then read the ``[S, C]``
+        samples back and accept the longest draft prefix that matches
+        the target's own tokens.  Rejected positions roll back with one
+        refcount-aware ``truncate()`` — the preemption-rollback path."""
+        T, S, BQ = self.token_budget, self.max_batch, self.block_q
+        C = self.spec_cols
+        W = self.cache.table_width
+        NQB = self.num_q_blocks
+        ids = np.zeros((1, T), np.int64)
+        slots = np.zeros(T, np.int32)        # pad rows -> pad block 0
+        positions = np.zeros((1, T), np.int64)
+        seq_ids = np.full(NQB, S, np.int32)  # S = null segment
+        q_starts = np.zeros(NQB, np.int32)
+        q_valids = np.zeros(NQB, np.int32)
+        tables = np.zeros((S, W), np.int32)
+        ctx = np.zeros(S, np.int32)
+        last_index = np.zeros((S, C), np.int32)
+        sample_pos = np.zeros((S, C), np.int64)
+
+        flat = 0
+        spec_rows = []           # (req, base, drafts)
+        for req in decodes:
+            r = req.row
+            base = appended[req.id]          # length before this step
+            w = self.cache.length(req.id) - base     # 1 + len(drafts)
+            d = [int(t) for t in drafts.get(req.id, [])][:w - 1]
+            seg = flat // BQ
+            seq_ids[seg] = r
+            q_starts[seg] = base
+            q_valids[seg] = w
+            # feed = last committed token + the draft continuation
+            ids[0, flat] = req.generated[-1]
+            if d:
+                ids[0, flat + 1:flat + w] = d
+            slots[flat:flat + w] = self.cache.slot_mapping(
+                req.id, base, w)
+            positions[0, flat:flat + w] = np.arange(base, base + w)
+            tables[r] = self.cache.block_table(req.id)
+            ctx[r] = base + w
+            for j in range(C):
+                jj = min(j, w - 1)           # clamp unused columns
+                last_index[r, j] = flat + jj
+                sample_pos[r, j] = base + 1 + jj
+            spec_rows.append((req, base, d))
+            flat += BQ
+        chunk_row = None
+        if chunk is not None:
+            req, start, n = chunk
+            r = req.row
+            ids[0, flat:flat + n] = req.prompt[start:start + n]
+            slots[flat:flat + n] = self.cache.slot_mapping(
+                req.id, start, n)
+            positions[0, flat:flat + n] = np.arange(start, start + n)
+            nseg = -(-n // BQ)
+            for j in range(nseg):
+                seq_ids[flat // BQ + j] = r
+                q_starts[flat // BQ + j] = start + j * BQ
+                q_valids[flat // BQ + j] = min(BQ, n - j * BQ)
+            tables[r] = self.cache.block_table(req.id)
+            ctx[r] = start + n
+            if start + n == len(req.prompt):
+                # prompt complete: sample the first new token (col 0)
+                last_index[r, :] = flat + n - 1
+                sample_pos[r, :] = start + n
+                chunk_row = (r, req)
+            flat += nseg * BQ
+
+        self._view.set_inputs(slots, tables, ctx, positions, seq_ids,
+                              q_starts, q_valids, last_index,
+                              sample_pos)
+        args = self._control_tensors(
+            [self._rows[r] for r in range(S)], S)
+        ids_t = self._tensor(ids)
+        with contextlib.ExitStack() as stack:
+            if decodes:
+                stack.enter_context(obs.span(
+                    "decode", cat="decode", step=self._step_idx,
+                    batch=len(decodes), spec=True))
+            if chunk is not None:
+                stack.enter_context(obs.span(
+                    "prefill:chunk", cat="prefill", step=self._step_idx,
+                    request=chunk.request.id, start=chunk.start,
+                    tokens=chunk.length,
+                    **({"tenant": chunk.request.tenant}
+                       if chunk.request.tenant else {})))
+            tok = self._step_fn(ids_t, *args)
+        # the accept decision gates the next step's feed, so spec steps
+        # drain host-synchronously (no _pending window)
+        host = np.asarray(tok._value)
+
+        for req, base, d in spec_rows:
+            if req.done:
+                continue
+            row_tok = host[req.row]
+            # column j is the target's token following draft prefix
+            # d[:j]; accept while the draft agrees with the target
+            a = 0
+            while a < len(d) and int(row_tok[a]) == d[a]:
+                a += 1
+            self._tokens_drafted += len(d)
+            self._tokens_accepted += a
+            committed = 0
+            for j in range(a + 1):       # accepted prefix + bonus token
+                self._commit_token(req, int(row_tok[j]))
+                committed += 1
+                if req.done:
+                    break
+            # positions past the last committed token hold rejected
+            # drafts: roll the paged cache back to the verified length
+            self.cache.truncate(req.id, base + committed)
+            req.n_scheduled = len(req.generated)
+            self.proposer.commit(req.id, base + 1 + a)
+        if chunk_row is not None:
+            r, req = chunk_row
+            if not req.done:
+                self._commit_token(req, int(host[r, 0]))
+                req.n_scheduled = len(req.generated)
+        if chunk is not None:
+            req = chunk.request
+            req.num_computed = chunk.start + chunk.length
             self.cache.commit_prefix(
                 req.id, req.prompt[:req.num_computed])
 
@@ -490,7 +771,37 @@ class GenerationEngine:
         return Tensor(jnp.asarray(arr), _internal=True,
                       stop_gradient=True)
 
-    # -- draining -------------------------------------------------------
+    # -- committing + draining ------------------------------------------
+    def _commit_token(self, req, token):
+        """Append one accepted/drained token to ``req`` plus everything
+        that hangs off a committed token: TTFT metrics, SLO charging,
+        per-tenant accounting, streaming delivery, EOS/max-new cut."""
+        if not req.generated and req.t_first_token is None:
+            req.t_first_token = time.perf_counter()
+            if req.t_submit is not None:
+                ttft = (req.t_first_token - req.t_submit) * 1e3
+                reg = obs.get_registry()
+                reg.gauge("serving.ttft_ms").set(ttft)
+                reg.histogram("serving.ttft_ms_hist").observe(ttft)
+                if self.slo is not None:
+                    self.slo.on_first_token(req, ttft)
+        req.generated.append(token)
+        self._tokens_generated += 1
+        if self.slo is not None:
+            self.slo.on_tokens(req, 1)
+        if req.tenant:
+            self._step_tenant_tokens[req.tenant] = \
+                self._step_tenant_tokens.get(req.tenant, 0) + 1
+        if (req.eos_token_id is not None
+                and token == req.eos_token_id):
+            req.done = True
+        elif len(req.generated) >= req.max_new_tokens:
+            req.done = True
+        stream = self._streams.get(req.id)
+        if stream is not None:
+            stream.put(token, len(req.generated) - 1,
+                       finished=req.done)
+
     def _drain(self, lag):
         """Read dispatched token arrays older than ``lag`` steps back to
         the host — the only device synchronization in the loop."""
@@ -500,22 +811,7 @@ class GenerationEngine:
             for idx, req in rows_reqs:
                 if req.done:
                     continue     # tokens raced past EOS: discard
-                token = int(host[idx])
-                if not req.generated and req.t_first_token is None:
-                    req.t_first_token = time.perf_counter()
-                    if req.t_submit is not None:
-                        ttft = (req.t_first_token - req.t_submit) * 1e3
-                        reg = obs.get_registry()
-                        reg.gauge("serving.ttft_ms").set(ttft)
-                        reg.histogram(
-                            "serving.ttft_ms_hist").observe(ttft)
-                req.generated.append(token)
-                self._tokens_generated += 1
-                if (req.eos_token_id is not None
-                        and token == req.eos_token_id):
-                    req.done = True
-                elif len(req.generated) >= req.max_new_tokens:
-                    req.done = True
+                self._commit_token(req, int(host[idx]))
 
     def _collect_finished(self):
         for req in list(self.scheduler.running):
@@ -523,5 +819,12 @@ class GenerationEngine:
                 if req.row is not None:
                     self._rows[req.row] = None
                 self.scheduler.finish(req)
+                if self.proposer is not None:
+                    self.proposer.drop(req.id)
+                if self.slo is not None:
+                    self.slo.on_finish(req)
+                stream = self._streams.get(req.id)
+                if stream is not None:
+                    stream.close()
                 self._results[req.id] = req
                 self._step_finished.append(req)

@@ -115,7 +115,8 @@ class PagedKVCache:
 
     def __init__(self, num_layers, num_heads, head_dim, dtype="float32",
                  block_size=None, num_blocks=None, max_model_len=None,
-                 hbm_fraction=0.3, register=True, prefix_cache=None):
+                 hbm_fraction=0.3, register=True, prefix_cache=None,
+                 resident_name=None):
         import jax.numpy as jnp
         from ...core.dtypes import to_jax_dtype
         from ...core.tensor import Tensor
@@ -166,6 +167,9 @@ class PagedKVCache:
         self._lookup_tokens = 0  # prompt tokens that consulted the index
         self.cow_splits = 0    # COW block copies performed, cumulative
         self.high_water = 0    # max blocks in use, ever
+        # a second pool in the same process (the speculative draft
+        # cache) charges its own line item so HBM triage separates them
+        self.resident_name = resident_name or RESIDENT_NAME
         self._registered = False
         if register:
             self._register_resident()
@@ -187,7 +191,7 @@ class PagedKVCache:
     def _register_resident(self):
         from ...memory.guard import register_resident
         register_resident(
-            RESIDENT_NAME, self.pool_bytes,
+            self.resident_name, self.pool_bytes,
             buffer_ids=lambda: {id(t._value)
                                 for kv in self._pools for t in kv})
         self._registered = True
@@ -197,7 +201,7 @@ class PagedKVCache:
         last reference)."""
         if self._registered:
             from ...memory.guard import unregister_resident
-            unregister_resident(RESIDENT_NAME)
+            unregister_resident(self.resident_name)
             self._registered = False
 
     # -- pool tensors ----------------------------------------------------

@@ -22,13 +22,29 @@ Policy (vLLM-style iteration-level scheduling over ONE unified step):
     pool admits, the displaced decode appends preempt the admission
     right back out, and the retry livelocks;
   * **preempt to requeue**: when the block pool cannot extend every
-    running sequence, the *youngest* (most recently admitted) running
-    sequence is evicted — its WRITTEN blocks are hash-indexed into the
-    prefix cache on free (``free(..., tokens=)``), so the requeued
-    request re-enters through `allocate` with its prefix credit intact
-    and re-prefills only what eviction actually reclaimed.  Greedy
-    decoding and the engine's position-keyed sampling make the resumed
-    continuation identical to the uninterrupted one.
+    running sequence, a victim is evicted — its WRITTEN blocks are
+    hash-indexed into the prefix cache on free (``free(..., tokens=)``),
+    so the requeued request re-enters through `allocate` with its
+    prefix credit intact and re-prefills only what eviction actually
+    reclaimed.  Greedy decoding and the engine's position-keyed
+    sampling make the resumed continuation identical to the
+    uninterrupted one.
+
+**Pluggable policies** (the SLO layer in serving/slo.py plugs in here
+without forking the scheduler):
+
+  * :class:`VictimPolicy` picks the preemption victim.  The default,
+    :class:`YoungestFirst`, keeps the historical youngest-first
+    behavior (most recently admitted loses);
+  * :class:`AdmissionPolicy` picks WHICH waiting request admits next
+    (default: FIFO head).  Returning ``None`` defers admission — but
+    never when nothing is running (the engine must stay
+    work-conserving, so an idle pool always admits);
+  * :class:`TokenBudgetPolicy` filters the decode rows a step may
+    schedule (per-tenant token quotas).  A filter that empties a
+    non-empty decode set while no prefill chunk is pending is overruled
+    with the oldest row — throttling shapes rates, it never stalls the
+    engine.
 
 The scheduler owns no device state: the engine asks ``next_action()``,
 performs the device work, and reports back (``begin_prefill`` /
@@ -41,7 +57,8 @@ from collections import deque, namedtuple
 
 __all__ = ["ENV_MAX_BATCH", "ENV_PREFILL_CHUNK", "max_batch_size",
            "prefill_chunk_size", "Request", "PrefillChunk",
-           "ContinuousBatchingScheduler"]
+           "VictimPolicy", "YoungestFirst", "AdmissionPolicy",
+           "TokenBudgetPolicy", "ContinuousBatchingScheduler"]
 
 ENV_MAX_BATCH = "PADDLE_TPU_MAX_BATCH"
 ENV_PREFILL_CHUNK = "PADDLE_TPU_PREFILL_CHUNK"
@@ -74,6 +91,41 @@ def prefill_chunk_size():
 PrefillChunk = namedtuple("PrefillChunk", ["request", "start", "length"])
 
 
+# ---------------------------------------------------------------------
+# pluggable scheduling policies
+# ---------------------------------------------------------------------
+class VictimPolicy:
+    """Picks the preemption victim from the evictable running set."""
+
+    def select_victim(self, candidates):
+        """``candidates`` is a non-empty list of running Requests."""
+        raise NotImplementedError
+
+
+class YoungestFirst(VictimPolicy):
+    """The historical default: the most recently admitted loses (its
+    re-prefill is the cheapest, and its written blocks stay prefix-
+    indexed for the resume)."""
+
+    def select_victim(self, candidates):
+        return max(candidates, key=lambda r: r.arrival)
+
+
+class AdmissionPolicy:
+    """Picks which waiting request admits next (default: FIFO head).
+    ``None`` defers admission this step."""
+
+    def select_admission(self, waiting, running):
+        return waiting[0]
+
+
+class TokenBudgetPolicy:
+    """Filters the decode rows one step may schedule (default: all)."""
+
+    def filter_decodes(self, decodes):
+        return decodes
+
+
 class Request:
     """One generation request and its host-side progress."""
 
@@ -81,11 +133,11 @@ class Request:
                  "top_p", "temperature", "seed", "eos_token_id",
                  "generated", "n_scheduled", "num_computed",
                  "cached_prefix", "row", "arrival", "done",
-                 "preemptions", "t_submit", "t_first_token")
+                 "preemptions", "t_submit", "t_first_token", "tenant")
 
     def __init__(self, id, prompt, max_new_tokens=16, do_sample=False,
                  top_k=0, top_p=1.0, temperature=1.0, seed=0,
-                 eos_token_id=None):
+                 eos_token_id=None, tenant=None):
         self.id = id
         self.prompt = list(prompt)
         self.max_new_tokens = int(max_new_tokens)
@@ -95,6 +147,7 @@ class Request:
         self.temperature = float(temperature)
         self.seed = int(seed)
         self.eos_token_id = eos_token_id
+        self.tenant = tenant      # SLO tenant name (None = untagged)
         self.generated = []       # host-read tokens, in order
         self.n_scheduled = 0      # tokens sampled on device (>= drained)
         self.num_computed = 0     # prompt tokens whose K/V are in cache
@@ -126,10 +179,15 @@ class Request:
 class ContinuousBatchingScheduler:
     """Iteration-level scheduling over a shared PagedKVCache."""
 
-    def __init__(self, cache, max_batch=None, prefill_chunk=None):
+    def __init__(self, cache, max_batch=None, prefill_chunk=None,
+                 victim_policy=None, admission_policy=None,
+                 budget_policy=None):
         self.cache = cache
         self.max_batch = int(max_batch or max_batch_size())
         self.prefill_chunk = int(prefill_chunk or prefill_chunk_size())
+        self.victim_policy = victim_policy or YoungestFirst()
+        self.admission_policy = admission_policy or AdmissionPolicy()
+        self.budget_policy = budget_policy or TokenBudgetPolicy()
         self.waiting = deque()
         self.running = []
         self._arrival = 0
@@ -170,18 +228,27 @@ class ContinuousBatchingScheduler:
                          for r in self.running)
         if (self.waiting and not prefilling
                 and len(self.running) < self.max_batch):
-            req = self.waiting[0]
+            req = self.admission_policy.select_admission(
+                list(self.waiting), self.running)
+            if req is None and not self.running:
+                # work conservation: a deferring policy may shape the
+                # admission ORDER, but an idle engine always admits
+                req = self.waiting[0]
+            if req is not None and req is not self.waiting[0]:
+                # begin_prefill pops the head; rotate the pick there
+                self.waiting.remove(req)
+                self.waiting.appendleft(req)
             # +1 token: the sample at end of prefill needs a slot at
             # the first decode step.  One block of headroom per live
             # running sequence: their next decode append may cross a
             # block boundary, and an admission that ate that block
             # would be preempted straight back out (livelock).
             headroom = sum(1 for r in self.running if not r.done)
-            if self.cache.can_allocate(len(req.prompt) + 1,
-                                       tokens=req.prompt,
-                                       headroom=headroom):
+            if req is not None and self.cache.can_allocate(
+                    len(req.prompt) + 1, tokens=req.prompt,
+                    headroom=headroom):
                 return ("admit", req)
-            if not self.running:
+            if req is not None and not self.running:
                 need = self.cache.blocks_needed(len(req.prompt) + 1)
                 raise RuntimeError(
                     f"request {req.id!r} needs {need} KV blocks but the "
@@ -198,6 +265,13 @@ class ContinuousBatchingScheduler:
         decodes = [r for r in self.running
                    if not r.done and not r.prefilling
                    and r.remaining > 0]
+        if decodes:
+            allowed = self.budget_policy.filter_decodes(list(decodes))
+            if not allowed and chunk is None:
+                # work conservation: quotas shape rates, never stall —
+                # an emptied step keeps the oldest row moving
+                allowed = [decodes[0]]
+            decodes = [r for r in decodes if r in allowed]
         if chunk is not None or decodes:
             return ("step", (chunk, decodes))
         return ("idle", None)
@@ -227,16 +301,20 @@ class ContinuousBatchingScheduler:
             self.running.remove(request)
         request.row = None
 
-    def preempt_youngest(self, exclude=()):
-        """Pick the preemption victim: youngest running sequence not in
-        ``exclude``.  Returns None when nothing is evictable."""
+    def select_victim(self, exclude=()):
+        """Pick the preemption victim through the :class:`VictimPolicy`
+        hook (default youngest-first).  Returns None when nothing is
+        evictable."""
         candidates = [r for r in self.running
                       if not r.done and r not in exclude]
         if not candidates:
             candidates = [r for r in self.running if not r.done]
         if not candidates:
             return None
-        return max(candidates, key=lambda r: r.arrival)
+        return self.victim_policy.select_victim(candidates)
+
+    #: historical name; the selection now routes through the hook
+    preempt_youngest = select_victim
 
     def _written_tokens(self, request):
         """The token list actually WRITTEN to the request's blocks —

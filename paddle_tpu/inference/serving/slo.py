@@ -1,0 +1,225 @@
+"""SLO-aware multi-tenant scheduling: quotas, priorities, deadlines.
+
+Plugs into the three policy hooks of
+:class:`~.scheduler.ContinuousBatchingScheduler` (admission, preemption
+victim, per-step token budget) — one :class:`SLOPolicy` object
+implements all three, so ``GenerationEngine(slo=policy)`` turns the
+preempt-youngest batch engine into a multi-tenant service without
+forking the scheduler:
+
+  * **tenants** (:class:`TenantSpec`): a priority class, a token-bucket
+    rate quota (``tokens_per_s`` refill, ``burst`` cap), and TTFT/TPOT
+    latency targets.  Requests carry ``tenant=<name>``; unknown or
+    untagged requests fall back to a default spec (unlimited,
+    priority 0);
+  * **admission** is EDF over per-request deadlines within the highest
+    eligible priority class: while a request is waiting its deadline is
+    ``t_submit + ttft_target``; once decoding it is
+    ``t_first_token + generated * tpot_target``.  Tenants whose bucket
+    is dry are deferred (the scheduler's work-conservation guard still
+    admits when nothing is running);
+  * **preemption victims** are the lowest priority class first, and
+    within a class the LATEST deadline — the request with the most
+    slack absorbs the eviction;
+  * **per-step token budget**: decode rows of a dry tenant sit out the
+    step (their KV state is untouched; they resume when the bucket
+    refills).  The scheduler guarantees the filter never stalls the
+    engine outright.
+
+Violations ride the observability registry: the
+``serving.slo_violations`` counter plus per-tenant
+``serving.tenant.<name>.tokens`` / ``.ttft_ms`` / ``.ttft_ms_hist`` /
+``.violations`` metrics, and ``phase_breakdown()["tenants"]`` breaks
+prefill time and committed tokens down per tenant.
+
+``clock`` is injectable so quota/deadline behavior is deterministic
+under test.
+"""
+from __future__ import annotations
+
+import time
+
+from ... import observability as obs
+from .scheduler import AdmissionPolicy, TokenBudgetPolicy, VictimPolicy
+
+__all__ = ["TenantSpec", "SLOPolicy"]
+
+_INF = float("inf")
+
+
+class TenantSpec:
+    """One tenant's contract: priority, rate quota, latency targets.
+
+    ``priority``: higher wins admission and survives preemption longer.
+    ``tokens_per_s``: token-bucket refill rate (None = unmetered);
+    ``burst``: bucket capacity (default 2s worth of refill).
+    ``ttft_target_ms`` / ``tpot_target_ms``: deadline targets; both
+    optional (None = no deadline pressure, no violation accounting).
+    """
+
+    __slots__ = ("name", "priority", "tokens_per_s", "burst",
+                 "ttft_target_ms", "tpot_target_ms")
+
+    def __init__(self, name, priority=0, tokens_per_s=None, burst=None,
+                 ttft_target_ms=None, tpot_target_ms=None):
+        self.name = str(name)
+        self.priority = int(priority)
+        self.tokens_per_s = (None if tokens_per_s is None
+                             else float(tokens_per_s))
+        if burst is None and self.tokens_per_s is not None:
+            burst = max(1.0, 2.0 * self.tokens_per_s)
+        self.burst = None if burst is None else float(burst)
+        self.ttft_target_ms = (None if ttft_target_ms is None
+                               else float(ttft_target_ms))
+        self.tpot_target_ms = (None if tpot_target_ms is None
+                               else float(tpot_target_ms))
+
+    def __repr__(self):
+        return (f"TenantSpec({self.name!r}, prio={self.priority}, "
+                f"rate={self.tokens_per_s}, ttft={self.ttft_target_ms})")
+
+
+class _TokenBucket:
+    """Classic token bucket; balance may go negative after a burst
+    commit (speculative acceptance lands k+1 tokens at once) and the
+    tenant then sits out until refill pays the debt back."""
+
+    __slots__ = ("rate", "burst", "balance", "_last")
+
+    def __init__(self, rate, burst):
+        self.rate = rate          # tokens per second, None = unmetered
+        self.burst = burst
+        self.balance = burst if burst is not None else _INF
+        self._last = None
+
+    def _refill(self, now):
+        if self.rate is None:
+            return
+        if self._last is not None:
+            self.balance = min(self.burst,
+                               self.balance + (now - self._last)
+                               * self.rate)
+        self._last = now
+
+    def ok(self, now):
+        self._refill(now)
+        return self.rate is None or self.balance > 0
+
+    def spend(self, n, now):
+        self._refill(now)
+        if self.rate is not None:
+            self.balance -= n
+
+
+class SLOPolicy(VictimPolicy, AdmissionPolicy, TokenBudgetPolicy):
+    """EDF + priority classes + per-tenant token quotas (module doc)."""
+
+    def __init__(self, tenants=(), default=None, clock=None):
+        if isinstance(tenants, dict):
+            tenants = list(tenants.values())
+        self.tenants = {t.name: t for t in tenants}
+        self.default = default or TenantSpec("_default")
+        self.clock = clock or time.perf_counter
+        self._buckets = {}
+        self.violations = 0
+
+    # -- tenant lookup --------------------------------------------------
+    def spec_for(self, req):
+        t = getattr(req, "tenant", None)
+        return self.tenants.get(t, self.default) if t else self.default
+
+    def _bucket(self, spec):
+        b = self._buckets.get(spec.name)
+        if b is None:
+            b = self._buckets[spec.name] = _TokenBucket(
+                spec.tokens_per_s, spec.burst)
+        return b
+
+    def deadline(self, req, now):
+        """Seconds-domain EDF deadline (inf when no target applies)."""
+        spec = self.spec_for(req)
+        if req.t_first_token is None:
+            if spec.ttft_target_ms is None:
+                return _INF
+            start = req.t_submit if req.t_submit is not None else now
+            return start + spec.ttft_target_ms / 1e3
+        if spec.tpot_target_ms is None:
+            return _INF
+        return (req.t_first_token
+                + (len(req.generated) + 1) * spec.tpot_target_ms / 1e3)
+
+    # -- the three scheduler hooks --------------------------------------
+    def select_admission(self, waiting, running):
+        now = self.clock()
+        eligible = [r for r in waiting
+                    if self._bucket(self.spec_for(r)).ok(now)]
+        if not eligible:
+            return None           # all dry: defer (scheduler guards idle)
+        return min(eligible,
+                   key=lambda r: (-self.spec_for(r).priority,
+                                  self.deadline(r, now), r.arrival))
+
+    def select_victim(self, candidates):
+        now = self.clock()
+        return max(candidates,
+                   key=lambda r: (-self.spec_for(r).priority,
+                                  self.deadline(r, now), r.arrival))
+
+    def filter_decodes(self, decodes):
+        now = self.clock()
+        return [r for r in decodes
+                if self._bucket(self.spec_for(r)).ok(now)]
+
+    # -- engine callbacks (accounting + violations) ---------------------
+    def on_tokens(self, req, n):
+        """``n`` tokens committed for ``req`` — charge its bucket.
+        (The engine itself owns the ``serving.tenant.<t>.tokens``
+        counter; this hook only meters the quota.)"""
+        self._bucket(self.spec_for(req)).spend(n, self.clock())
+
+    def on_first_token(self, req, ttft_ms):
+        spec = self.spec_for(req)
+        reg = obs.get_registry()
+        if req.tenant:
+            reg.gauge(f"serving.tenant.{spec.name}.ttft_ms").set(ttft_ms)
+            reg.histogram(
+                f"serving.tenant.{spec.name}.ttft_ms_hist").observe(
+                ttft_ms)
+        if spec.ttft_target_ms is not None \
+                and ttft_ms > spec.ttft_target_ms:
+            self._violation(spec, req, "ttft", ttft_ms,
+                            spec.ttft_target_ms)
+
+    def on_finish(self, req):
+        spec = self.spec_for(req)
+        if (spec.tpot_target_ms is not None
+                and req.t_first_token is not None
+                and len(req.generated) > 1):
+            tpot = ((self.clock() - req.t_first_token) * 1e3
+                    / (len(req.generated) - 1))
+            if tpot > spec.tpot_target_ms:
+                self._violation(spec, req, "tpot", tpot,
+                                spec.tpot_target_ms)
+
+    def _violation(self, spec, req, kind, measured_ms, target_ms):
+        self.violations += 1
+        reg = obs.get_registry()
+        reg.counter("serving.slo_violations").inc()
+        reg.counter(f"serving.tenant.{spec.name}.violations").inc()
+        obs.instant("serving.slo_violation", cat="decode",
+                    tenant=spec.name, request=req.id, kind=kind,
+                    measured_ms=round(measured_ms, 3),
+                    target_ms=target_ms)
+
+    # -- introspection --------------------------------------------------
+    def snapshot(self):
+        """Per-tenant bucket balances + violation total (tests/smoke)."""
+        now = self.clock()
+        out = {"violations": self.violations, "tenants": {}}
+        for name, b in self._buckets.items():
+            b._refill(now)
+            out["tenants"][name] = {
+                "balance": (None if b.rate is None
+                            else round(b.balance, 3)),
+                "rate": b.rate}
+        return out

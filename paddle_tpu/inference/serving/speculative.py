@@ -1,0 +1,390 @@
+"""Speculative decoding: draft proposers + the paged-cache verify path.
+
+The engine stays on its ONE unified ragged step (engine.py): under
+speculation a decode row stops being a 1-token segment and becomes a
+``k+1``-token "prefill-like" segment — the row's last known token plus
+k draft continuations, verified in a single dispatch with the row's own
+causal mask (ops/pallas_ragged.py segment descriptors; no new kernel).
+The sampler reads ``k+1`` columns per row (``last_index``/``sample_pos``
+are ``[S, C]``), so column j is the target model's token following
+draft prefix ``d_1..d_j`` — computed with EXACTLY the arithmetic the
+sequential step would use, which is what makes greedy (and seeded
+sampled) speculative output bit-identical to non-speculative output.
+
+Acceptance is deterministic token-matching: draft ``d_{j+1}`` is
+accepted iff it equals the target's own column-j token (greedy argmax,
+or the position-keyed seeded draw).  That trades the classic
+Leviathan-style stochastic acceptance-rate boost for exact output
+parity with the non-speculative engine — the property the serving
+stack's preemption/requeue machinery already relies on.  Rejection
+costs one ``truncate()`` on the paged KV cache (kv_cache.py): the
+reject/rollback path IS the preemption rollback path.
+
+Two proposers:
+
+  * :class:`NgramProposer` (default, ``PADDLE_TPU_SPEC_DRAFT=ngram``):
+    self-drafting prompt lookup — the most recent earlier occurrence of
+    the sequence's trailing n-gram proposes the tokens that followed
+    it.  Free (host-side, no extra model), great on repetitive or
+    shared-prefix traffic, useless on white noise;
+  * :class:`DraftModelProposer` (``PADDLE_TPU_SPEC_DRAFT=model`` plus a
+    draft model): a smaller GPT proposes greedily through its own
+    :class:`DraftWorker` — a private small paged pool (separate
+    memory-guard line item) and ONE fixed-shape traced step of its own
+    (every proposal round packs one q-block per row), so the whole
+    engine stays at <= 3 compiled programs.
+
+Knobs: ``PADDLE_TPU_SPEC_K`` (draft length k, default 4, clamped to
+``block_q - 1`` so a verify segment always fits one q-block) and
+``PADDLE_TPU_SPEC_DRAFT`` (``ngram`` | ``model``).
+"""
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+from ... import observability as obs
+
+__all__ = ["ENV_SPEC_K", "ENV_SPEC_DRAFT", "spec_k", "spec_draft",
+           "SpeculativeConfig", "NgramProposer", "DraftModelProposer",
+           "DraftWorker"]
+
+ENV_SPEC_K = "PADDLE_TPU_SPEC_K"
+ENV_SPEC_DRAFT = "PADDLE_TPU_SPEC_DRAFT"
+_DEFAULT_K = 4
+
+
+def spec_k():
+    """Draft length k (PADDLE_TPU_SPEC_K, default 4; <= 0 disables)."""
+    try:
+        return int(os.environ.get(ENV_SPEC_K, _DEFAULT_K))
+    except ValueError:
+        return _DEFAULT_K
+
+
+def spec_draft():
+    """Proposer kind (PADDLE_TPU_SPEC_DRAFT: "ngram" | "model")."""
+    return os.environ.get(ENV_SPEC_DRAFT, "ngram").strip().lower()
+
+
+class SpeculativeConfig:
+    """How an engine speculates: draft length + proposer.
+
+    ``GenerationEngine(speculative=...)`` accepts a SpeculativeConfig,
+    ``True`` (env-driven defaults), an int (k with the default
+    proposer), or a draft model object (``method="model"``).  With
+    ``speculative=None`` the engine enables speculation only when
+    ``PADDLE_TPU_SPEC_K`` is set to a positive value.
+    """
+
+    def __init__(self, k=None, method=None, draft_model=None, ngram=3,
+                 draft_num_blocks=None):
+        self.k = spec_k() if k is None else int(k)
+        if self.k < 1:
+            raise ValueError(f"speculative k must be >= 1, got {self.k}")
+        self.method = (method or
+                       ("model" if draft_model is not None
+                        else spec_draft()))
+        if self.method not in ("ngram", "model"):
+            raise ValueError(f"unknown proposer {self.method!r} "
+                             "(expected ngram|model)")
+        if self.method == "model" and draft_model is None:
+            # a model proposer without a model cannot draft: fall back
+            # to self-drafting rather than failing the whole engine
+            self.method = "ngram"
+        self.draft_model = draft_model
+        self.ngram = int(ngram)
+        self.draft_num_blocks = draft_num_blocks
+
+    @staticmethod
+    def resolve(arg):
+        """Normalize the engine's ``speculative=`` argument; returns a
+        SpeculativeConfig or None (speculation off)."""
+        if arg is None:
+            return SpeculativeConfig() if spec_k() > 0 and \
+                os.environ.get(ENV_SPEC_K) is not None else None
+        if isinstance(arg, SpeculativeConfig):
+            return arg
+        if arg is True:
+            return SpeculativeConfig()
+        if isinstance(arg, int):
+            return SpeculativeConfig(k=arg)
+        # duck-typed draft model (anything with parameters())
+        if hasattr(arg, "parameters"):
+            return SpeculativeConfig(draft_model=arg, method="model")
+        raise TypeError(f"speculative= expects SpeculativeConfig, "
+                        f"True, int, or a draft model; got {type(arg)}")
+
+    def build_proposer(self, engine):
+        if self.method == "model":
+            return DraftModelProposer(
+                self.draft_model, max_batch=engine.max_batch,
+                max_model_len=engine.max_model_len,
+                num_blocks=self.draft_num_blocks)
+        return NgramProposer(n=self.ngram)
+
+
+# ---------------------------------------------------------------------
+# proposers
+# ---------------------------------------------------------------------
+class Proposer:
+    """Drafting interface the engine drives once per step."""
+
+    def propose_batch(self, items):
+        """``items``: [(request, history_tokens, kmax)] for every
+        decode row this step.  Returns {request_id: [draft tokens]}
+        with at most kmax drafts per row (empty list = no speculation
+        for that row this step)."""
+        raise NotImplementedError
+
+    def commit(self, request_id, n_valid):
+        """Acceptance landed: the request's verified history is
+        ``n_valid`` tokens long (prompt + generated)."""
+
+    def drop(self, request_id):
+        """The request finished or was preempted; forget its state."""
+
+    def close(self):
+        pass
+
+    @property
+    def step_compiles(self):
+        return 0
+
+
+class NgramProposer(Proposer):
+    """Self-drafting prompt lookup (stateless, host-side).
+
+    Finds the most recent earlier occurrence of the sequence's trailing
+    n-gram (longest n first, down to a single token) and proposes the
+    tokens that followed it.  Rejected proposals cost one truncate —
+    acceptance is pure profit on repetitive traffic."""
+
+    def __init__(self, n=3, min_n=1):
+        self.n = max(1, int(n))
+        self.min_n = max(1, int(min_n))
+
+    def propose_batch(self, items):
+        return {req.id: self._propose(history, kmax)
+                for req, history, kmax in items}
+
+    def _propose(self, history, kmax):
+        if kmax < 1 or len(history) < 2:
+            return []
+        for n in range(min(self.n, len(history) - 1),
+                       self.min_n - 1, -1):
+            pat = history[-n:]
+            # most recent earlier occurrence of the trailing n-gram
+            for i in range(len(history) - n - 1, -1, -1):
+                if history[i:i + n] == pat:
+                    cont = history[i + n:i + n + kmax]
+                    if cont:
+                        return [int(t) for t in cont]
+                    break     # match flush with the suffix: shorter n
+        return []
+
+
+class DraftModelProposer(Proposer):
+    """Greedy proposals from a smaller causal LM via a DraftWorker."""
+
+    def __init__(self, model, max_batch, max_model_len, num_blocks=None):
+        self.worker = DraftWorker(model, max_batch=max_batch,
+                                  max_model_len=max_model_len,
+                                  num_blocks=num_blocks)
+
+    def propose_batch(self, items):
+        return self.worker.propose_batch(items)
+
+    def commit(self, request_id, n_valid):
+        self.worker.commit(request_id, n_valid)
+
+    def drop(self, request_id):
+        self.worker.drop(request_id)
+
+    def close(self):
+        self.worker.close()
+
+    @property
+    def step_compiles(self):
+        return self.worker.step_compiles
+
+
+# ---------------------------------------------------------------------
+# the draft-model worker
+# ---------------------------------------------------------------------
+class DraftWorker:
+    """Drives the draft model over its own small paged pool.
+
+    One fixed-shape traced ragged step (``max_batch`` segments of one
+    q-block each), reused for every proposal round: round r feeds each
+    row min(gap, block_q) catch-up tokens — or the single previous
+    draft — and samples the next greedy draft for every row whose cache
+    is caught up to its verified history.  The draft pool registers its
+    own memory-guard line item ("draft kv cache blocks") so target and
+    draft HBM are triaged separately; ``commit()`` truncates the draft
+    cache back to the verified prefix exactly like the target's
+    reject path.
+    """
+
+    RESIDENT_NAME = "draft kv cache blocks"
+
+    def __init__(self, model, max_batch, max_model_len, num_blocks=None):
+        import paddle_tpu as paddle
+        from ...ops.pallas_ragged import ragged_q_block
+        from .kv_cache import PagedKVCache
+        from .attention import RaggedCacheView
+
+        cfg = getattr(model, "config", None) or model.gpt.config
+        self.model = model
+        model.eval()
+        self.max_batch = int(max_batch)
+        self.max_model_len = int(min(max_model_len,
+                                     cfg.max_position_embeddings))
+        num_heads = cfg.num_attention_heads
+        head_dim = cfg.hidden_size // num_heads
+        param = next(iter(model.parameters()))
+        if num_blocks is None:
+            # enough for every row at full length, plus pad block
+            from .kv_cache import kv_block_size
+            bs = kv_block_size()
+            num_blocks = self.max_batch * -(-self.max_model_len // bs)
+        self.cache = PagedKVCache(
+            cfg.num_hidden_layers, num_heads, head_dim,
+            dtype=param.dtype, num_blocks=num_blocks,
+            max_model_len=self.max_model_len,
+            resident_name=self.RESIDENT_NAME)
+        self.block_q = ragged_q_block(self.cache._jdtype)
+        self.token_budget = self.max_batch * self.block_q
+        self._view = RaggedCacheView(self.cache, self.block_q)
+        self._step_fn = paddle.jit.to_static(self._ragged_step)
+
+    def _ragged_step(self, ids, seeds, do_sample, top_k, top_p,
+                     temperature):
+        from ...core.autograd import no_grad
+        from .engine import ragged_sample_next
+        view = self._view
+        with no_grad():
+            logits = self.model(ids, cache=view, use_cache=False)
+            return ragged_sample_next(
+                logits, view.last_index, seeds, view.sample_pos,
+                do_sample, top_k, top_p, temperature)
+
+    @property
+    def step_compiles(self):
+        return len(self._step_fn._cache)
+
+    # -- lifecycle ------------------------------------------------------
+    def commit(self, request_id, n_valid):
+        """Roll the draft cache back to ``n_valid`` scattered tokens —
+        positions at and past ``n_valid`` hold now-rejected drafts."""
+        if request_id in self.cache:
+            self.cache.truncate(
+                request_id,
+                min(self.cache.length(request_id), max(0, n_valid)))
+
+    def drop(self, request_id):
+        self.cache.free(request_id)
+
+    def close(self):
+        self.cache.close()
+
+    # -- drafting -------------------------------------------------------
+    def propose_batch(self, items):
+        """Run up to max(kmax) rounds of the draft step; returns
+        {request_id: drafts}.  Rows whose draft cache lags their
+        verified history spend rounds catching up (block_q tokens per
+        round) before they start proposing."""
+        out = {req.id: [] for req, _, _ in items}
+        rows = []
+        max_k = 0
+        for req, history, kmax in items:
+            kmax = min(int(kmax),
+                       self.max_model_len - len(history))
+            if kmax < 1:
+                continue
+            if req.id not in self.cache:
+                if not self.cache.allocate(req.id, 0):
+                    continue
+            # discard anything past the verified history (drafts from a
+            # round the engine aborted before verification)
+            cur = self.cache.length(req.id)
+            if cur > len(history):
+                self.cache.truncate(req.id, len(history))
+            rows.append([req, [int(t) for t in history], kmax])
+            max_k = max(max_k, kmax)
+        for _ in range(max_k):
+            live = [r for r in rows if len(out[r[0].id]) < r[2]]
+            if not live:
+                break
+            if not self._round(live, out):
+                break
+        return out
+
+    def _round(self, live, out):
+        """One draft dispatch over every live row; appends one proposal
+        per caught-up row into ``out``.  Returns False when the draft
+        pool cannot host any row (drafting pauses, serving continues)."""
+        import jax.numpy as jnp
+        from ...core.tensor import Tensor
+
+        T, S, BQ = self.token_budget, self.max_batch, self.block_q
+        W = self.cache.table_width
+        ids = np.zeros((1, T), np.int64)
+        slots = np.zeros(T, np.int32)
+        positions = np.zeros((1, T), np.int64)
+        seq_ids = np.full(T // BQ, S, np.int32)
+        q_starts = np.zeros(T // BQ, np.int32)
+        q_valids = np.zeros(T // BQ, np.int32)
+        tables = np.zeros((S, W), np.int32)
+        ctx = np.zeros(S, np.int32)
+        last_index = np.zeros((S, 1), np.int32)
+        sample_pos = np.zeros((S, 1), np.int64)
+
+        flat = 0
+        sampled = []              # (slot row, engine request, full)
+        for slot, (req, history, kmax) in enumerate(live):
+            full = history + out[req.id]
+            cur = self.cache.length(req.id)
+            if cur >= len(full):
+                start, feed = len(full) - 1, 1   # re-derive last logits
+            else:
+                start, feed = cur, min(len(full) - cur, BQ)
+            if start + feed > cur:
+                if not self.cache.append(req.id, start + feed - cur):
+                    continue     # draft pool full: skip this row
+            seg = flat // BQ
+            seq_ids[seg] = slot
+            q_starts[seg] = start
+            q_valids[seg] = feed
+            ids[0, flat:flat + feed] = full[start:start + feed]
+            slots[flat:flat + feed] = self.cache.slot_mapping(
+                req.id, start, feed)
+            positions[0, flat:flat + feed] = np.arange(start,
+                                                       start + feed)
+            tables[slot] = self.cache.block_table(req.id)
+            ctx[slot] = start + feed
+            last_index[slot, 0] = flat + feed - 1
+            sample_pos[slot, 0] = start + feed
+            if start + feed == len(full):    # caught up: sample counts
+                sampled.append((slot, req, full))
+            flat += BQ
+        if flat == 0:
+            return False
+        self._view.set_inputs(slots, tables, ctx, positions, seq_ids,
+                              q_starts, q_valids, last_index,
+                              sample_pos)
+        zeros_i = np.zeros(S, np.int32)
+        args = tuple(Tensor(jnp.asarray(a), _internal=True,
+                            stop_gradient=True)
+                     for a in (zeros_i, np.zeros(S, bool), zeros_i,
+                               np.ones(S, np.float32),
+                               np.ones(S, np.float32)))
+        ids_t = Tensor(jnp.asarray(ids), _internal=True,
+                       stop_gradient=True)
+        with obs.span("decode:draft", cat="decode", batch=len(live)):
+            tok = self._step_fn(ids_t, *args)
+        host = np.asarray(tok._value)
+        for slot, req, full in sampled:
+            out[req.id].append(int(host[slot, 0]))
+        return True

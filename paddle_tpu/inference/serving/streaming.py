@@ -1,0 +1,86 @@
+"""Streaming token delivery for the serving engine.
+
+``GenerationEngine.generate(stream=True)`` (and the data-parallel
+front-end) yields tokens as they are *committed* — i.e. as soon as a
+decode drain or a speculative acceptance appends them to
+``Request.generated`` — instead of buffering whole completions.  The
+plumbing is deliberately host-side and tiny:
+
+  * :class:`TokenStream` is a bounded per-request queue the engine
+    pushes :class:`StreamEvent` tuples into from ``_commit_token``.
+    The bound (``PADDLE_TPU_STREAM_QUEUE``, default 64) keeps a slow
+    consumer from holding token history alive indefinitely: on
+    overflow the OLDEST event is dropped and ``dropped`` counts it, so
+    the engine never blocks on a consumer (SLO isolation: one stalled
+    client cannot stall the batch).
+  * ``close()`` enqueues a terminal event with ``finished=True`` so
+    drains can distinguish "no tokens yet" from "request done".
+
+Events carry the absolute completion index so consumers can detect the
+gap when events were dropped.
+"""
+from __future__ import annotations
+
+import os
+from collections import deque, namedtuple
+
+__all__ = ["ENV_STREAM_QUEUE", "StreamEvent", "TokenStream",
+           "stream_queue_depth"]
+
+ENV_STREAM_QUEUE = "PADDLE_TPU_STREAM_QUEUE"
+
+
+def stream_queue_depth():
+    """Per-request stream bound (``PADDLE_TPU_STREAM_QUEUE``, >=1)."""
+    return max(1, int(os.environ.get(ENV_STREAM_QUEUE, "64")))
+
+
+# request_id: owning request; token: int token id (None on the terminal
+# event); index: 0-based position in the completion; finished: True on
+# the terminal event (token may still be set when the last committed
+# token and the finish coincide).
+StreamEvent = namedtuple("StreamEvent",
+                         ["request_id", "token", "index", "finished"])
+
+
+class TokenStream:
+    """Bounded drop-oldest event queue for one request (module doc)."""
+
+    __slots__ = ("request_id", "maxlen", "dropped", "closed", "_q")
+
+    def __init__(self, request_id, maxlen=None):
+        self.request_id = request_id
+        self.maxlen = maxlen or stream_queue_depth()
+        self.dropped = 0       # events evicted by the bound
+        self.closed = False
+        self._q = deque()
+
+    def __len__(self):
+        return len(self._q)
+
+    def put(self, token, index, finished=False):
+        if self.closed:
+            return
+        if len(self._q) >= self.maxlen:
+            self._q.popleft()
+            self.dropped += 1
+        self._q.append(StreamEvent(self.request_id, token, index,
+                                   finished))
+        if finished:
+            self.closed = True
+
+    def close(self):
+        """Terminal marker; idempotent."""
+        if not self.closed:
+            self.put(None, -1, finished=True)
+
+    def drain(self):
+        """Pop and return all queued events (possibly empty)."""
+        out = list(self._q)
+        self._q.clear()
+        return out
+
+    @property
+    def done(self):
+        """True once closed AND fully drained."""
+        return self.closed and not self._q

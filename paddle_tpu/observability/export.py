@@ -188,7 +188,13 @@ def phase_breakdown(events=None):
     mesh ``axis`` they ran on (aggregated as
     ``collective_axis_<axis>_ms``/``_count``/``_bytes``), and serving
     DP engines stamp ``shard="dp<i>"`` — those lanes aggregate under
-    ``shards[<shard>]`` so per-replica skew is visible in the bench."""
+    ``shards[<shard>]`` so per-replica skew is visible in the bench.
+
+    Multi-tenant serving attribution: prefill spans carry the owning
+    request's ``tenant`` attr and the engine emits one
+    ``serving.tenant.tokens`` instant per step and tenant, so
+    ``tenants[<name>]`` breaks prefill time, committed tokens, and SLO
+    violations down per tenant."""
     if events is None:
         events = get_timeline().events()
     out = {"compile_ms": 0.0, "dispatch_ms": 0.0, "collective_ms": 0.0,
@@ -201,6 +207,7 @@ def phase_breakdown(events=None):
     kernel_keys = []
     axis_keys = []
     shards = {}
+    tenants = {}
 
     def _shard_row(label):
         return shards.setdefault(label, {
@@ -209,17 +216,33 @@ def phase_breakdown(events=None):
             "decode_ms": 0.0, "decode_count": 0,
             "collective_ms": 0.0, "collective_count": 0})
 
+    def _tenant_row(label):
+        return tenants.setdefault(label, {
+            "prefill_ms": 0.0, "prefill_count": 0,
+            "tokens": 0, "violations": 0})
+
     for e in events:
+        attrs = e.attrs or {}
         if e.dur is None:
+            tenant = attrs.get("tenant")
+            if tenant and e.name == "serving.tenant.tokens":
+                _tenant_row(str(tenant))["tokens"] += \
+                    int(attrs.get("n", 0) or 0)
+            elif tenant and e.name == "serving.slo_violation":
+                _tenant_row(str(tenant))["violations"] += 1
             continue
         ms = e.dur * 1e3
-        attrs = e.attrs or {}
         shard = attrs.get("shard")
         if shard and e.cat in ("dispatch", "prefill", "decode",
                                "collective"):
             row = _shard_row(str(shard))
             row[f"{e.cat}_ms"] += ms
             row[f"{e.cat}_count"] += 1
+        tenant = attrs.get("tenant")
+        if tenant and e.cat == "prefill":
+            row = _tenant_row(str(tenant))
+            row["prefill_ms"] += ms
+            row["prefill_count"] += 1
         if e.cat == "kernel":
             out["kernel_ms"] += ms
             out["kernel_count"] += 1
@@ -283,6 +306,10 @@ def phase_breakdown(events=None):
                 if k.endswith("_ms"):
                     row[k] = round(row[k], 3)
         out["shards"] = {k: shards[k] for k in sorted(shards)}
+    if tenants:
+        for row in tenants.values():
+            row["prefill_ms"] = round(row["prefill_ms"], 3)
+        out["tenants"] = {k: tenants[k] for k in sorted(tenants)}
     return out
 
 

@@ -8,10 +8,11 @@
 ROADMAP item 5: runs ``bench.py`` in a subprocess for a FRESH capture
 (or reads one from ``--fresh``), loads the repo-root
 ``BENCH_LAST_GOOD.json`` rolling artifact that bench.py maintains, and
-compares every shared higher-is-better throughput metric — the
-headline plus all ``*_tokens_per_sec`` / ``*_imgs_per_sec`` entries in
-``extra_metrics``.  Exits 1 iff any shared metric regressed by more
-than ``--threshold`` (default 5%).
+compares every shared gated metric: higher-is-better throughput (the
+headline plus all ``*_tokens_per_sec`` / ``*_imgs_per_sec`` /
+``*_accept_rate`` entries in ``extra_metrics``) and lower-is-better
+latency (``*_p99_ttft_ms``).  Exits 1 iff any shared metric regressed
+by more than ``--threshold`` (default 5%) in its bad direction.
 
 A missing last-good artifact, an unreachable TPU, or a cached
 (re-emitted, non-live) fresh capture is a SKIP — exit 0 with a loud
@@ -30,7 +31,9 @@ from pathlib import Path
 ROOT = Path(__file__).resolve().parent.parent
 sys.path.insert(0, str(ROOT))
 
-GATE_SUFFIXES = ("_tokens_per_sec", "_imgs_per_sec")
+GATE_SUFFIXES = ("_tokens_per_sec", "_imgs_per_sec", "_accept_rate")
+#: lower-is-better latency metrics: a RISE beyond the threshold fails
+LOW_SUFFIXES = ("_p99_ttft_ms",)
 
 
 def log(msg):
@@ -53,13 +56,13 @@ def capture_fresh(timeout_s):
 
 
 def gated_metrics(payload):
-    """{name: value} of the headline + throughput extra metrics."""
+    """{name: value} of the headline + throughput/latency extras."""
     out = {}
     if payload.get("metric") and payload.get("value", 0) > 0:
         out[payload["metric"]] = float(payload["value"])
     for name, v in (payload.get("extra_metrics") or {}).items():
-        if name.endswith(GATE_SUFFIXES) and isinstance(v, (int, float)) \
-                and v > 0:
+        if name.endswith(GATE_SUFFIXES + LOW_SUFFIXES) \
+                and isinstance(v, (int, float)) and v > 0:
             out[name] = float(v)
     return out
 
@@ -96,7 +99,8 @@ def compare(last_good, fresh, threshold, only=None):
     for name in sorted(names):
         delta = new[name] / old[name] - 1.0
         verdict = "ok"
-        if delta < -threshold:
+        lower_better = name.endswith(LOW_SUFFIXES)
+        if (delta > threshold) if lower_better else (delta < -threshold):
             verdict = "REGRESSION"
             regressions.append(name)
         rows.append({"metric": name, "last_good": old[name],

@@ -16,7 +16,12 @@ PADDLE_TPU_OBS=1 and validates the whole story:
   * greedy engine output is token-for-token identical to sequential
     per-request dense-cache ``model.generate``;
   * a deliberately tiny block pool forces preemption-to-requeue and the
-    seeded-sampling results still match an unconstrained run.
+    seeded-sampling results still match an unconstrained run;
+  * speculative decoding (self-drafting) is bit-identical to the plain
+    engine with drafts actually accepted, within the compile budget;
+  * a bursty two-tenant SLO run: a low-priority flood cannot push the
+    high-priority tenant's p99 TTFT anywhere near the flood's own, and
+    the per-tenant metrics/phase breakdown come out populated.
 
 Prints tokens/sec and the KV-pool block high-water mark.  Exits 0 iff
 every scenario passes.  CPU-only, no TPU required.
@@ -37,7 +42,8 @@ import numpy as np  # noqa: E402
 
 import paddle_tpu as paddle  # noqa: E402
 from paddle_tpu import observability as obs  # noqa: E402
-from paddle_tpu.inference.serving import GenerationEngine  # noqa: E402
+from paddle_tpu.inference.serving import (GenerationEngine,  # noqa: E402
+                                          SLOPolicy, TenantSpec)
 from paddle_tpu.models import GPTConfig, GPTForCausalLM  # noqa: E402
 
 RESULTS = []
@@ -197,6 +203,88 @@ def _preemption(args):
         assert got == ref, "preemption changed sampled output"
         print(f"      {preemptions} preemption(s); all {len(prompts)} "
               f"sampled continuations identical to the roomy run")
+    finally:
+        eng.close()
+
+
+@scenario("speculative decoding: self-draft parity, drafts accepted")
+def _speculative(args):
+    model = build_model(args.seed)
+    prompts = mixed_prompts(args.seed + 4, 8)
+    base_eng = GenerationEngine(model, num_blocks=256, max_batch=4,
+                                max_model_len=128)
+    try:
+        base = base_eng.generate(prompts, max_new_tokens=8)
+    finally:
+        base_eng.close()
+    eng = GenerationEngine(model, num_blocks=256, max_batch=4,
+                           max_model_len=128, speculative=model)
+    try:
+        t0 = time.perf_counter()
+        got = eng.generate(prompts, max_new_tokens=8)
+        elapsed = time.perf_counter() - t0
+        assert got == base, "speculative output diverged from plain"
+        s = eng.stats()
+        assert s["tokens_drafted"] > 0 and s["spec_accept_rate"] > 0
+        assert s["step_compiles"] <= 3, s["step_compiles"]
+        assert s["blocks_in_use"] == 0
+        print(f"      {len(prompts)} requests bit-identical; "
+              f"{s['tokens_accepted']}/{s['tokens_drafted']} drafts "
+              f"accepted ({s['spec_accept_rate']:.0%}), "
+              f"{s['step_compiles']} compiles (bound 3), {elapsed:.2f}s")
+    finally:
+        eng.close()
+
+
+@scenario("bursty 2-tenant SLO: gold p99 TTFT bounded under free flood")
+def _slo_burst(args):
+    model = build_model(args.seed)
+    rng = np.random.RandomState(args.seed + 5)
+    free_prompts = [list(rng.randint(1, VOCAB, size=int(L)))
+                    for L in rng.choice([7, 11, 20], size=12)]
+    gold_prompts = [list(rng.randint(1, VOCAB, size=5))
+                    for _ in range(3)]
+    slo = SLOPolicy(tenants=[
+        TenantSpec("gold", priority=10, ttft_target_ms=60_000),
+        TenantSpec("free", priority=0)])
+    obs.get_timeline().clear()
+    eng = GenerationEngine(model, num_blocks=256, max_batch=4,
+                           max_model_len=128, speculative=model,
+                           slo=slo)
+    try:
+        free_ids = [eng.add_request(p, tenant="free", max_new_tokens=8)
+                    for p in free_prompts]
+        for _ in range(2):          # the flood is already in flight...
+            eng.step()
+        gold_ids = [eng.add_request(p, tenant="gold", max_new_tokens=8)
+                    for p in gold_prompts]
+        while eng.has_unfinished():
+            eng.step()
+        for i, p in zip(free_ids + gold_ids, free_prompts + gold_prompts):
+            r = eng.result(i)
+            assert r[:len(p)] == p and len(r) == len(p) + 8
+
+        reg = obs.get_registry()
+        p99_gold = reg.histogram(
+            "serving.tenant.gold.ttft_ms_hist").percentile(99)
+        p99_free = reg.histogram(
+            "serving.tenant.free.ttft_ms_hist").percentile(99)
+        assert p99_gold is not None and p99_free is not None
+        # gold arrived AFTER the 12-deep flood yet jumps the queue on
+        # priority: its p99 TTFT must stay well under the flood's tail
+        assert p99_gold < 0.5 * p99_free, (
+            f"gold p99 TTFT {p99_gold:.0f}ms not bounded vs free flood "
+            f"{p99_free:.0f}ms")
+        s = eng.stats()
+        assert s["spec_accept_rate"] > 0
+        tenants = obs.phase_breakdown()["tenants"]
+        assert tenants["gold"]["tokens"] == 8 * len(gold_prompts)
+        assert tenants["free"]["tokens"] == 8 * len(free_prompts)
+        print(f"      gold p99 TTFT {p99_gold:.0f}ms vs free "
+              f"{p99_free:.0f}ms under a 12-request flood; accept rate "
+              f"{s['spec_accept_rate']:.0%}, violations "
+              f"{slo.violations}; per-tenant tokens "
+              f"{ {t: v['tokens'] for t, v in sorted(tenants.items())} }")
     finally:
         eng.close()
 

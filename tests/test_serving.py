@@ -12,8 +12,11 @@ import pytest
 
 import paddle_tpu as paddle
 from paddle_tpu.inference.serving import (ContinuousBatchingScheduler,
-                                          GenerationEngine, PagedKVCache,
-                                          PrefillChunk, Request)
+                                          GenerationEngine, NgramProposer,
+                                          PagedKVCache, PrefillChunk,
+                                          Request, SpeculativeConfig,
+                                          StreamEvent, TokenStream,
+                                          VictimPolicy)
 from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLM
 
 pytestmark = pytest.mark.serve
@@ -26,7 +29,8 @@ def _serving_env(monkeypatch):
     for var in ("PADDLE_TPU_HBM_BUDGET", "PADDLE_TPU_MEMORY_GUARD",
                 "PADDLE_TPU_KV_BLOCK_SIZE", "PADDLE_TPU_MAX_BATCH",
                 "PADDLE_TPU_PIPELINE_DEPTH", "PADDLE_TPU_PREFIX_CACHE",
-                "PADDLE_TPU_PREFILL_CHUNK"):
+                "PADDLE_TPU_PREFILL_CHUNK", "PADDLE_TPU_SPEC_K",
+                "PADDLE_TPU_SPEC_DRAFT", "PADDLE_TPU_STREAM_QUEUE"):
         monkeypatch.delenv(var, raising=False)
     yield
 
@@ -565,3 +569,253 @@ def test_top_p_sampling_threshold():
         _, ids = top_p_sampling(x, ps, threshold=0.2, seed=seed)
         seen.add(int(np.asarray(ids._value)[0, 0]))
     assert seen <= {0, 1}      # candidates below the threshold dropped
+
+
+# ---------------------------------------------------------------------
+# scheduler policy hooks
+# ---------------------------------------------------------------------
+def test_victim_policy_hook_overrides_default():
+    """Satellite: preemption-victim selection is a pluggable policy;
+    youngest-first is merely the default implementation."""
+    c = PagedKVCache(num_layers=1, num_heads=1, head_dim=8,
+                     block_size=4, num_blocks=8, max_model_len=32,
+                     register=False)
+
+    class OldestFirst(VictimPolicy):
+        def select_victim(self, candidates):
+            return min(candidates, key=lambda r: r.arrival)
+
+    s = ContinuousBatchingScheduler(c, max_batch=2, prefill_chunk=8,
+                                    victim_policy=OldestFirst())
+    a, b = Request("a", [1] * 4), Request("b", [2] * 4)
+    for r in (a, b):
+        s.submit(r)
+        act, req = s.next_action()
+        assert act == "admit"
+        s.begin_prefill(req)
+        req.num_computed = len(req.prompt)
+    assert s.select_victim() is a              # policy, not youngest
+    assert s.preempt_youngest() is a           # alias routes through it
+    assert s.select_victim(exclude=(a,)) is b
+
+
+# ---------------------------------------------------------------------
+# speculative decoding
+# ---------------------------------------------------------------------
+def test_ngram_proposer_lookup():
+    p = NgramProposer(n=3)
+    h = [1, 2, 3, 9, 1, 2, 3]
+    # trailing [1,2,3] last occurred at the start; propose what followed
+    assert p._propose(h, 4) == [9, 1, 2, 3]
+    assert p._propose(h, 2) == [9, 1]          # kmax caps the proposal
+    assert p._propose([5, 6, 7], 4) == []      # no earlier occurrence
+    assert p._propose(h, 0) == []
+
+
+def test_engine_spec_greedy_parity_ngram(gpt_mini):
+    """Tentpole: greedy speculative output is BIT-IDENTICAL to the
+    non-speculative engine (same model, same prompts), drafts actually
+    flow, and the verify path adds no compiled programs."""
+    prompts = _prompts((3, 7, 12, 5, 9), seed=0)
+    eng = GenerationEngine(gpt_mini, num_blocks=64, max_batch=3,
+                           max_model_len=64, prefill_chunk=16)
+    try:
+        base = eng.generate(prompts, max_new_tokens=10)
+    finally:
+        eng.close()
+    spec = GenerationEngine(gpt_mini, num_blocks=64, max_batch=3,
+                            max_model_len=64, prefill_chunk=16,
+                            speculative=SpeculativeConfig(k=3,
+                                                          method="ngram"))
+    try:
+        got = spec.generate(prompts, max_new_tokens=10)
+        s = spec.stats()
+        assert got == base
+        assert s["tokens_drafted"] > 0
+        assert s["step_compiles"] <= 3
+        assert s["blocks_in_use"] == 0
+    finally:
+        spec.close()
+
+
+def test_engine_spec_greedy_parity_draft_model(gpt_mini):
+    """Draft-model speculation (self-draft -> near-100% accept): still
+    bit-identical, accept counters run, and target + draft stay within
+    the <= 3 compiled-programs budget."""
+    prompts = _prompts((3, 7, 12, 5), seed=2)
+    eng = GenerationEngine(gpt_mini, num_blocks=64, max_batch=3,
+                           max_model_len=64, prefill_chunk=16)
+    try:
+        base = eng.generate(prompts, max_new_tokens=10)
+    finally:
+        eng.close()
+    # the bundled model drafts for itself: every greedy draft matches
+    spec = GenerationEngine(gpt_mini, num_blocks=64, max_batch=3,
+                            max_model_len=64, prefill_chunk=16,
+                            speculative=gpt_mini)
+    try:
+        got = spec.generate(prompts, max_new_tokens=10)
+        s = spec.stats()
+        assert got == base
+        assert s["tokens_drafted"] > 0
+        assert s["tokens_accepted"] == s["tokens_drafted"]
+        assert s["spec_accept_rate"] == 1.0
+        assert s["step_compiles"] <= 3
+        # the draft pool is a separate line item and frees cleanly
+        assert spec.proposer.worker.cache.blocks_in_use == 0
+    finally:
+        spec.close()
+
+
+def test_engine_spec_full_rejection_rolls_back(gpt_mini):
+    """Satellite: a proposer that is ALWAYS wrong forces the full
+    rejection path every step — output must still be identical and the
+    paged cache must roll back cleanly (no leaked blocks)."""
+    prompts = _prompts((3, 7, 5), seed=4)
+    eng = GenerationEngine(gpt_mini, num_blocks=64, max_batch=3,
+                           max_model_len=64)
+    try:
+        base = eng.generate(prompts, max_new_tokens=8)
+    finally:
+        eng.close()
+
+    class AlwaysWrong(NgramProposer):
+        def propose_batch(self, items):
+            return {req.id: [(int(h[-1]) + 1) % VOCAB] * kmax
+                    for req, h, kmax in items}
+
+    spec = GenerationEngine(gpt_mini, num_blocks=64, max_batch=3,
+                            max_model_len=64,
+                            speculative=SpeculativeConfig(k=3,
+                                                          method="ngram"))
+    spec.proposer = AlwaysWrong()
+    try:
+        got = spec.generate(prompts, max_new_tokens=8)
+        s = spec.stats()
+        assert got == base
+        assert s["tokens_drafted"] > 0 and s["tokens_accepted"] == 0
+        assert s["blocks_in_use"] == 0        # every reject rolled back
+    finally:
+        spec.close()
+
+
+def test_engine_spec_preemption_invariant(gpt_mini):
+    """Satellite: preemption mid-speculation — a tiny pool forces
+    evictions while rows carry multi-token verify segments; the victim
+    re-enters with prefix credit and output matches the unconstrained
+    engine exactly."""
+    prompts = _prompts((2, 3, 4, 3), seed=3)
+    ref = GenerationEngine(gpt_mini, num_blocks=64, max_batch=1,
+                           max_model_len=64)
+    try:
+        base = [ref.generate([p], max_new_tokens=20)[0] for p in prompts]
+    finally:
+        ref.close()
+    eng = GenerationEngine(gpt_mini, num_blocks=8, block_size=4,
+                           max_batch=3, max_model_len=64,
+                           speculative=SpeculativeConfig(k=3,
+                                                         method="ngram"))
+    try:
+        ids = [eng.add_request(p, max_new_tokens=20) for p in prompts]
+        while eng.has_unfinished():
+            eng.step()
+        got = [eng.result(i) for i in ids]
+        preempted = sum(eng._results[i].preemptions for i in ids)
+        assert preempted > 0, "pool was sized to force preemption"
+        assert got == base
+        assert eng.stats()["blocks_in_use"] == 0
+    finally:
+        eng.close()
+
+
+def test_engine_spec_sampling_parity(gpt_mini):
+    """Seeded sampling keys on absolute position, so acceptance-by-
+    token-matching preserves the exact sampled sequence too."""
+    prompts = _prompts((3, 8, 5), seed=6)
+    kw = dict(max_new_tokens=10, do_sample=True, top_k=20,
+              temperature=0.9)
+    eng = GenerationEngine(gpt_mini, num_blocks=64, max_batch=3,
+                           max_model_len=64)
+    try:
+        base = eng.generate(prompts, seed=42, **kw)
+    finally:
+        eng.close()
+    spec = GenerationEngine(gpt_mini, num_blocks=64, max_batch=3,
+                            max_model_len=64, speculative=gpt_mini)
+    try:
+        assert spec.generate(prompts, seed=42, **kw) == base
+    finally:
+        spec.close()
+
+
+def test_engine_spec_env_knob(gpt_mini, monkeypatch):
+    monkeypatch.setenv("PADDLE_TPU_SPEC_K", "2")
+    eng = GenerationEngine(gpt_mini, num_blocks=32, max_batch=2,
+                           max_model_len=64)
+    try:
+        assert eng.spec is not None and eng.spec.k == 2
+        assert eng.spec_cols == 3 and eng.proposer is not None
+    finally:
+        eng.close()
+    monkeypatch.setenv("PADDLE_TPU_SPEC_K", "0")
+    eng2 = GenerationEngine(gpt_mini, num_blocks=32, max_batch=2,
+                            max_model_len=64)
+    try:
+        assert eng2.spec is None and eng2.proposer is None
+    finally:
+        eng2.close()
+
+
+# ---------------------------------------------------------------------
+# streaming delivery
+# ---------------------------------------------------------------------
+def test_token_stream_bounded_drop_oldest():
+    st = TokenStream("r", maxlen=3)
+    for i in range(5):
+        st.put(100 + i, i)
+    assert st.dropped == 2 and len(st) == 3
+    evs = st.drain()
+    assert [e.token for e in evs] == [102, 103, 104]
+    assert [e.index for e in evs] == [2, 3, 4]   # gap marks the drop
+    assert st.drain() == [] and not st.done
+    st.close()
+    (term,) = st.drain()
+    assert term.finished and term.token is None
+    assert st.done
+    st.put(9, 9)                                # closed: ignored
+    assert st.drain() == []
+
+
+def test_engine_generate_stream_yields_tokens_in_order(gpt_mini):
+    """Satellite: stream=True yields every generated token as a
+    StreamEvent, per request in commit order, matching the non-stream
+    output exactly (speculative engine: tokens appear as accepted)."""
+    prompts = _prompts((3, 7, 5), seed=8)
+    eng = GenerationEngine(gpt_mini, num_blocks=64, max_batch=3,
+                           max_model_len=64)
+    try:
+        base = eng.generate(prompts, max_new_tokens=8)
+    finally:
+        eng.close()
+    spec = GenerationEngine(gpt_mini, num_blocks=64, max_batch=3,
+                            max_model_len=64,
+                            speculative=SpeculativeConfig(k=3,
+                                                          method="ngram"))
+    try:
+        ids = {}
+        toks = {}
+        finished = set()
+        for ev in spec.generate(prompts, max_new_tokens=8, stream=True):
+            assert isinstance(ev, StreamEvent)
+            if ev.token is not None:
+                toks.setdefault(ev.request_id, []).append(ev.token)
+                assert ev.index == len(toks[ev.request_id]) - 1
+            if ev.finished:
+                finished.add(ev.request_id)
+        ids = sorted(toks, key=lambda r: int(r[3:]))   # req0, req1, ...
+        assert [toks[i] for i in ids] == \
+            [base[j][len(prompts[j]):] for j in range(len(prompts))]
+        assert finished == set(ids)
+        assert spec._streams == {}            # streams cleaned up
+    finally:
+        spec.close()

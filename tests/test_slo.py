@@ -1,0 +1,187 @@
+"""SLO-aware multi-tenant scheduling: quotas, EDF, work conservation.
+
+Pure host-side policy tests (no model, no device) — the SLOPolicy gets
+a manual clock so token-bucket refill and deadline math are exact, and
+the scheduler-integration tests drive a real ContinuousBatchingScheduler
+over an unregistered PagedKVCache.
+"""
+import pytest
+
+from paddle_tpu.inference.serving import (ContinuousBatchingScheduler,
+                                          PagedKVCache, Request,
+                                          SLOPolicy, TenantSpec)
+
+pytestmark = pytest.mark.serve
+
+
+class ManualClock:
+    def __init__(self, t=0.0):
+        self.t = float(t)
+
+    def advance(self, dt):
+        self.t += float(dt)
+
+    def __call__(self):
+        return self.t
+
+
+def _req(rid, tenant=None, t_submit=0.0, arrival=0, prompt_len=4):
+    r = Request(rid, [1] * prompt_len, tenant=tenant)
+    r.t_submit = t_submit
+    r.arrival = arrival
+    return r
+
+
+# ---------------------------------------------------------------------
+# token-bucket quotas
+# ---------------------------------------------------------------------
+def test_token_bucket_quota_burst():
+    clk = ManualClock()
+    slo = SLOPolicy(tenants=[TenantSpec("a", tokens_per_s=10, burst=5)],
+                    clock=clk)
+    r = _req("r0", tenant="a")
+    # burst capacity admits immediately
+    assert slo.select_admission([r], []) is r
+    slo.on_tokens(r, 5)                      # burn the whole burst
+    assert slo.snapshot()["tenants"]["a"]["balance"] == 0
+    assert slo.select_admission([r], []) is None     # dry: defer
+    clk.advance(0.2)                         # 10 tok/s * 0.2s = +2
+    assert slo.select_admission([r], []) is r
+    assert slo.snapshot()["tenants"]["a"]["balance"] == 2
+    clk.advance(10.0)                        # refill caps at burst
+    assert slo.snapshot()["tenants"]["a"]["balance"] == 5
+
+
+def test_token_bucket_debt_from_burst_commit():
+    """A speculative acceptance can commit k+1 tokens at once; the
+    bucket goes NEGATIVE and the tenant sits out until refill pays the
+    debt back (ok() needs balance > 0, not >= 0)."""
+    clk = ManualClock()
+    slo = SLOPolicy(tenants=[TenantSpec("b", tokens_per_s=2, burst=2)],
+                    clock=clk)
+    r = _req("r0", tenant="b")
+    assert slo.select_admission([r], []) is r
+    slo.on_tokens(r, 4)                      # overdraft: balance -2
+    assert slo.snapshot()["tenants"]["b"]["balance"] == -2
+    clk.advance(1.0)                         # +2 -> 0: debt paid, not +
+    assert slo.select_admission([r], []) is None
+    clk.advance(1.0)                         # +2 -> 2 (capped at burst)
+    assert slo.select_admission([r], []) is r
+
+
+def test_two_tenant_burst_isolation():
+    """One tenant flooding its quota cannot starve the other: once the
+    hog's bucket is dry, the quiet tenant's requests admit ahead of the
+    hog's earlier arrivals."""
+    clk = ManualClock()
+    slo = SLOPolicy(tenants=[
+        TenantSpec("hog", tokens_per_s=10, burst=4),
+        TenantSpec("quiet", tokens_per_s=10, burst=4)], clock=clk)
+    h1 = _req("h1", tenant="hog", arrival=0)
+    h2 = _req("h2", tenant="hog", arrival=1)
+    q1 = _req("q1", tenant="quiet", arrival=2)
+    waiting = [h1, h2, q1]
+    assert slo.select_admission(waiting, []) is h1   # FIFO while funded
+    slo.on_tokens(h1, 4)                             # hog bucket dry
+    assert slo.select_admission(waiting, []) is q1   # isolation
+    clk.advance(0.5)                                 # hog refills +5->4
+    assert slo.select_admission(waiting, []) is h1
+
+
+# ---------------------------------------------------------------------
+# EDF + priority classes
+# ---------------------------------------------------------------------
+def test_edf_admission_order():
+    clk = ManualClock(1.0)
+    slo = SLOPolicy(tenants=[
+        TenantSpec("gold", priority=10, ttft_target_ms=500),
+        TenantSpec("bronze", priority=0, ttft_target_ms=100)],
+        clock=clk)
+    b_early = _req("b0", tenant="bronze", t_submit=0.0, arrival=0)
+    b_late = _req("b1", tenant="bronze", t_submit=0.9, arrival=1)
+    g = _req("g0", tenant="gold", t_submit=0.95, arrival=2)
+    # priority class dominates: gold admits first despite the later
+    # deadline and the latest arrival
+    assert slo.select_admission([b_early, b_late, g], []) is g
+    # within a class: earliest deadline first (t_submit + ttft target)
+    assert slo.select_admission([b_late, b_early], []) is b_early
+
+
+def test_edf_victim_selection():
+    """Preemption evicts the lowest priority class, and within it the
+    request with the MOST slack (latest deadline)."""
+    clk = ManualClock(0.0)
+    slo = SLOPolicy(tenants=[
+        TenantSpec("gold", priority=10, ttft_target_ms=100),
+        TenantSpec("bronze", priority=0, ttft_target_ms=100)],
+        clock=clk)
+    g = _req("g0", tenant="gold", t_submit=0.0, arrival=0)
+    b1 = _req("b1", tenant="bronze", t_submit=0.0, arrival=1)
+    b2 = _req("b2", tenant="bronze", t_submit=0.05, arrival=2)
+    assert slo.select_victim([g, b1, b2]) is b2      # latest deadline
+    assert slo.select_victim([g, b1]) is b1          # never gold first
+    assert slo.select_victim([g]) is g
+
+
+def test_deadline_shifts_from_ttft_to_tpot():
+    clk = ManualClock(0.0)
+    slo = SLOPolicy(tenants=[TenantSpec("t", ttft_target_ms=100,
+                                        tpot_target_ms=50)], clock=clk)
+    r = _req("r0", tenant="t", t_submit=2.0)
+    assert slo.deadline(r, clk()) == pytest.approx(2.1)   # waiting: TTFT
+    r.t_first_token = 3.0
+    r.generated = [5, 6]
+    # decoding: t_first_token + (generated+1) * tpot
+    assert slo.deadline(r, clk()) == pytest.approx(3.15)
+    untagged = _req("u0")
+    assert slo.deadline(untagged, clk()) == float("inf")
+
+
+# ---------------------------------------------------------------------
+# scheduler integration: starvation freedom / work conservation
+# ---------------------------------------------------------------------
+def test_slo_starvation_freedom_work_conservation():
+    """Quotas shape RATES, never stall the engine: a dry tenant still
+    admits when nothing is running, and an emptied decode filter keeps
+    the oldest row moving."""
+    clk = ManualClock()
+    slo = SLOPolicy(tenants=[TenantSpec("m", tokens_per_s=1, burst=1)],
+                    clock=clk)
+    cache = PagedKVCache(num_layers=1, num_heads=1, head_dim=8,
+                         block_size=4, num_blocks=6, max_model_len=24,
+                         register=False)
+    s = ContinuousBatchingScheduler(cache, max_batch=2, prefill_chunk=8,
+                                    victim_policy=slo,
+                                    admission_policy=slo,
+                                    budget_policy=slo)
+    r = Request("a", [1] * 4, tenant="m")
+    slo.on_tokens(r, 5)                     # bucket deep in debt
+    assert slo.select_admission([r], []) is None
+    s.submit(r)
+    act, req = s.next_action()              # idle engine still admits
+    assert act == "admit" and req is r
+    s.begin_prefill(r)
+    r.num_computed = len(r.prompt)
+    assert slo.filter_decodes([r]) == []    # policy would stall it...
+    act, (chunk, decodes) = s.next_action()
+    assert act == "step" and chunk is None
+    assert decodes == [r]                   # ...the scheduler does not
+
+
+def test_slo_violation_accounting():
+    """Violation counting is a plain attribute — it works even with the
+    observability registry disabled (PADDLE_TPU_OBS unset)."""
+    clk = ManualClock(0.0)
+    slo = SLOPolicy(tenants=[TenantSpec("t", ttft_target_ms=10,
+                                        tpot_target_ms=1)], clock=clk)
+    r = _req("r0", tenant="t")
+    slo.on_first_token(r, 5.0)              # within target
+    assert slo.violations == 0
+    slo.on_first_token(r, 50.0)             # 50ms > 10ms target
+    assert slo.violations == 1
+    r.t_first_token = 0.0
+    r.generated = [1, 2, 3]
+    clk.advance(1.0)                        # 1s / 2 tokens = 500ms TPOT
+    slo.on_finish(r)
+    assert slo.violations == 2
+    assert slo.snapshot()["violations"] == 2
