@@ -950,6 +950,123 @@ def bench_bert_dp(on_tpu):
                        + (p.stderr or "")[-400:])
 
 
+# ---------------------------------------------------------------------
+# bert_tp: the same BERT-mini step under tp=2 — the executor routes
+# row-parallel matmuls through the overlapped all-gather/reduce-scatter
+# ring (distributed/auto_parallel/overlap.py), so this config is the
+# BENCH-json evidence that the overlap path trains correctly and how
+# much of the tp collective hides under compute.
+# ---------------------------------------------------------------------
+def _bert_tp_body(n_iters=4):
+    """BERT-mini TP training step under ``tp=2``; returns the metrics
+    dict including the measured per-axis overlap ratio."""
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu import optimizer, static
+    from paddle_tpu import observability as obs
+    from paddle_tpu.distributed.auto_parallel import overlap as ovl
+    from paddle_tpu.distributed.auto_parallel.sharding import (
+        BERT_RULES, MeshPlan, annotate_params, clear_mesh_plan,
+        set_mesh_plan)
+    from paddle_tpu.models import BertConfig, BertForMaskedLM
+
+    B, S = 8, 64
+    paddle.enable_static()
+    try:
+        plan = MeshPlan("tp=2", rules=BERT_RULES())
+        set_mesh_plan(plan)
+        mode = ovl.select_mode(plan)
+        main_prog, startup = static.Program(), static.Program()
+        with static.program_guard(main_prog, startup):
+            ids = static.data("ids", [B, S], "int64")
+            labels = static.data("labels", [B, S], "int64")
+            model = BertForMaskedLM(BertConfig(
+                hidden_size=128, num_hidden_layers=2,
+                num_attention_heads=2, intermediate_size=256))
+            annotate_params(model)
+            loss, _ = model(ids, labels=labels)
+            opt = optimizer.AdamW(learning_rate=1e-4,
+                                  parameters=model.parameters())
+            opt.minimize(loss)
+        exe = static.Executor()
+        exe.run(startup)
+        rng = np.random.default_rng(0)
+        fd = {"ids": rng.integers(0, 1000, (B, S)).astype(np.int64),
+              "labels": rng.integers(0, 1000, (B, S)).astype(np.int64)}
+        t = time.time()
+        (l0,) = exe.run_steps(1, main_prog, feed=fd, fetch_list=[loss])
+        compile_s = time.time() - t
+        log(f"bert_tp: compile+first step {compile_s:.1f}s "
+            f"loss={float(l0):.3f} mesh={plan.describe()} mode={mode}")
+        t = time.time()
+        (lv,) = exe.run_steps(n_iters, main_prog, feed=fd,
+                              fetch_list=[loss])
+        dt = (time.time() - t) / n_iters
+        tokens_per_sec = B * S / dt
+        # overlap evidence: drive the BERT-shaped sharded matmul
+        # step-wise from the host so the timeline carries real
+        # collective+compute spans, then read the per-axis ratio off
+        # the same stats surface phase_breakdown() exposes
+        obs.get_timeline().clear()
+        h = 128
+        a = rng.standard_normal((B * S, h)).astype(np.float32)
+        w = rng.standard_normal((h, h)).astype(np.float32)
+        for _ in range(3):
+            ovl.measured_sharded_matmul(a, w, plan=plan, mode=mode)
+        overlap = obs.collective_overlap_stats().get("tp", {})
+        log(f"bert_tp: step {dt*1e3:.1f} ms "
+            f"{tokens_per_sec:,.0f} tok/s loss={float(lv):.3f} "
+            f"overlap_ratio={overlap.get('overlap_ratio', 0.0):.2f}")
+        return {"tokens_per_sec": round(tokens_per_sec, 1),
+                "step_ms": round(dt * 1e3, 2),
+                "compile_first_s": round(compile_s, 1),
+                "loss": round(float(lv), 4),
+                "mesh": plan.describe(),
+                "overlap_mode": mode,
+                "overlap_ratio_tp": overlap.get("overlap_ratio", 0.0),
+                "phases": obs.phase_breakdown()}
+    finally:
+        clear_mesh_plan()
+        paddle.disable_static()
+
+
+_BERT_TP_SUB = r"""
+import os, json
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "") +
+                           " --xla_force_host_platform_device_count=8")
+import jax
+jax.config.update("jax_platforms", "cpu")
+from paddle_tpu import observability as obs
+obs.enable(True)
+import bench
+print("BERT_TP_JSON: " + json.dumps(bench._bert_tp_body()))
+"""
+
+
+def bench_bert_tp(on_tpu):
+    import jax
+    if jax.device_count() >= 2:
+        res = _bert_tp_body()
+        res["forced_host_mesh"] = False
+        return res
+    t = time.time()
+    p = subprocess.run(
+        [sys.executable, "-c", _BERT_TP_SUB], cwd=str(ROOT),
+        capture_output=True, text=True, timeout=1800)
+    for line in p.stdout.splitlines():
+        if line.startswith("BERT_TP_JSON:"):
+            res = json.loads(line[len("BERT_TP_JSON:"):])
+            res["forced_host_mesh"] = True
+            res["seconds"] = round(time.time() - t, 1)
+            log(f"bert_tp (forced host mesh): "
+                f"{res['tokens_per_sec']:,.0f} tok/s "
+                f"overlap_ratio={res['overlap_ratio_tp']:.2f} "
+                f"({res['seconds']:.0f}s)")
+            return res
+    raise RuntimeError("bert_tp subprocess produced no result: "
+                       + (p.stderr or "")[-400:])
+
+
 def _bert_x32_subprocess(wait_s=900):
     """Run the BERT config under PADDLE_TPU_X32=1 in a child; parse its
     JSON line.  MUST run before the parent initializes jax — the TPU
@@ -1005,7 +1122,8 @@ def main():
                   [sys.executable, "-u", os.path.abspath(__file__)], env)
     configs = os.environ.get(
         "PADDLE_TPU_BENCH_CONFIGS",
-        "bert,lenet,resnet50,gpt,llama_dryrun,bert_dp").split(",")
+        "bert,lenet,resnet50,gpt,llama_dryrun,bert_dp,bert_tp"
+        ).split(",")
 
     info = None
     if not force_cpu and not subproc:  # the parent already probed
@@ -1123,6 +1241,7 @@ def main():
         "llama": lambda: bench_llama(on_tpu, peak),
         "llama_dryrun": bench_llama_dryrun,
         "bert_dp": lambda: bench_bert_dp(on_tpu),
+        "bert_tp": lambda: bench_bert_tp(on_tpu),
     }
     errors = {}
     from collections import Counter as _Counter
@@ -1245,6 +1364,20 @@ def main():
             # subprocess case measured them in the child's timeline)
             if res.get("phases"):
                 payload["extra_metrics"]["bert_dp_phases"] = \
+                    res["phases"]
+        elif name == "bert_tp":
+            payload["extra_metrics"]["bert_tp_tokens_per_sec"] = \
+                res["tokens_per_sec"]
+            payload["extra_metrics"]["bert_tp_step_ms"] = res["step_ms"]
+            payload["extra_metrics"]["bert_tp_mesh"] = res["mesh"]
+            payload["extra_metrics"]["bert_tp_overlap_mode"] = \
+                res["overlap_mode"]
+            payload["extra_metrics"]["overlap_ratio_tp"] = \
+                res["overlap_ratio_tp"]
+            payload["extra_metrics"]["bert_tp_forced_host_mesh"] = \
+                res["forced_host_mesh"]
+            if res.get("phases"):
+                payload["extra_metrics"]["bert_tp_phases"] = \
                     res["phases"]
         if errors:
             payload["errors"] = errors

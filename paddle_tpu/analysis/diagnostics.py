@@ -76,6 +76,9 @@ CODES = {
                "mesh: every device pays its full HBM cost", WARNING),
     "TPU503": ("collective payload dimension not divisible by the mesh "
                "axis size: ragged shards or a padded transfer", WARNING),
+    "TPU504": ("hot-path tensor-parallel matmul whose collective cannot "
+               "overlap with compute: the MXU idles for the full "
+               "transfer", WARNING),
 }
 
 

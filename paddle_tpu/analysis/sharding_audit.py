@@ -17,6 +17,13 @@ single-device host):
   by the mesh axis (group) size: scatter/alltoall-class ops get ragged
   shards or a padded transfer.  ``distributed/communication/ops.py``
   calls :func:`check_collective_axis` per payload.
+* **TPU504** — a hot-path tensor-parallel matmul whose collective is
+  not overlap-eligible: either the token dim does not divide by the
+  ``tp`` tile count (ragged last tile forces the sequential path), or
+  ``PADDLE_TPU_OVERLAP`` disables overlap outright while the mesh has
+  tp > 1.  Either way the MXU idles for the full transfer; the
+  message shows the tile arithmetic so the fix (pad/resize, or flip
+  the flag) is obvious.  :func:`audit_overlap`.
 """
 from __future__ import annotations
 
@@ -25,7 +32,7 @@ import os
 from .diagnostics import Diagnostic, DiagnosticReport
 
 __all__ = ["ENV_REPLICATED_THRESHOLD", "replicated_threshold",
-           "audit_sharding", "check_collective_axis"]
+           "audit_overlap", "audit_sharding", "check_collective_axis"]
 
 ENV_REPLICATED_THRESHOLD = "PADDLE_TPU_LINT_REPLICATED_BYTES"
 _SPLIT_OPS = ("scatter", "alltoall", "alltoall_single", "reduce_scatter")
@@ -73,6 +80,79 @@ def audit_sharding(plan, named_params, site=""):
                      f"raise {ENV_REPLICATED_THRESHOLD} if replication "
                      "is intended",
                 data={"param": name, "nbytes": int(nbytes)}))
+    return out
+
+
+def _spec_axes(spec):
+    """Flat set of mesh-axis names a PartitionSpec entry list uses."""
+    axes = set()
+    for e in tuple(spec or ()):
+        if e is None:
+            continue
+        for a in (e if isinstance(e, (tuple, list)) else (e,)):
+            axes.add(a)
+    return axes
+
+
+def audit_overlap(plan, named_params, tokens_hint=None, site=""):
+    """TPU504 over TP-sharded 2-D matmul weights.
+
+    ``tokens_hint`` is the hot-path row count feeding those matmuls
+    (tokens per device step — batch*seq after dp splitting).  Two ways
+    a weight's collective loses its overlap:
+
+    * the row dim doesn't divide by the tp tile count — the ragged
+      last tile forces the padded sequential path; the diagnostic
+      shows the tile arithmetic;
+    * ``PADDLE_TPU_OVERLAP`` forces sequential while the mesh has
+      tp > 1 — every TP matmul's collective runs with the MXU idle.
+
+    Cheap and virtual-plan safe (pure arithmetic, no devices).
+    """
+    from ..distributed.auto_parallel import overlap as _ov
+    out = []
+    if plan is None or not named_params:
+        return out
+    tp = plan.axis_sizes.get("tp", 1)
+    if tp <= 1:
+        return out
+    forced_seq = _ov.overlap_flag() == "sequential"
+    for name, shape, nbytes in named_params:
+        if len(tuple(shape)) != 2:
+            continue
+        matched, spec = plan.match(name, shape)
+        if not matched or "tp" not in _spec_axes(spec):
+            continue
+        if forced_seq:
+            out.append(Diagnostic(
+                "TPU504",
+                f"TP matmul weight {name!r} {tuple(shape)}: "
+                f"{_ov.ENV_OVERLAP}=sequential pins its collective to "
+                f"the non-overlapped path on mesh {plan.describe()}",
+                site=site or name,
+                hint=f"unset {_ov.ENV_OVERLAP} (auto probes the mesh) "
+                     "or set it to overlap",
+                data={"param": name, "shape": list(shape),
+                      "tp": int(tp), "reason": "flag"}))
+            continue
+        if tokens_hint is None:
+            continue
+        if not _ov.overlap_eligible(tokens_hint, tp):
+            out.append(Diagnostic(
+                "TPU504",
+                f"TP matmul weight {name!r} {tuple(shape)}: token dim "
+                f"{int(tokens_hint)} doesn't tile over tp={tp} "
+                f"({_ov.tile_arithmetic(tokens_hint, tp)}); the ring "
+                "falls back to the padded sequential schedule",
+                site=site or name,
+                hint="size batch*seq to a multiple of the tp axis so "
+                     "tiles stay even and the collective hides under "
+                     "compute",
+                data={"param": name, "shape": list(shape),
+                      "tokens": int(tokens_hint), "tp": int(tp),
+                      "tile_arithmetic":
+                          _ov.tile_arithmetic(tokens_hint, tp),
+                      "reason": "ragged"}))
     return out
 
 

@@ -376,6 +376,24 @@ def _flush_nodes(pending):
 
     for n in pending:
         slots = []
+        node_leaves = set()      # leaf indices already used by THIS node
+
+        def leaf_slot(v):
+            # share leaves ACROSS nodes, but aliased operands of one
+            # node must stay distinct jit arguments: the recorded vjp
+            # arity came from an abstract probe with per-occurrence
+            # tracers, and jax dedupes jaxpr consts by identity — one
+            # tracer in two operand slots drops residuals at replay
+            k = leaf_pos.get(id(v))
+            if k is None or k in node_leaves:
+                new = len(leaves)
+                leaves.append(v)
+                if k is None:
+                    leaf_pos[id(v)] = new
+                k = new
+            node_leaves.add(k)
+            return ("l", k)
+
         for v in n.inputs:
             if isinstance(v, LazyValue) and v._concrete is not None:
                 v = v._concrete
@@ -384,22 +402,11 @@ def _flush_nodes(pending):
                 if ni is None:
                     # produced by another thread's (or a failed)
                     # segment: materialize it now
-                    v = v.force()
-                    k = leaf_pos.get(id(v))
-                    if k is None:
-                        k = len(leaves)
-                        leaf_pos[id(v)] = k
-                        leaves.append(v)
-                    slots.append(("l", k))
+                    slots.append(leaf_slot(v.force()))
                     continue
                 slots.append(("n", ni, v.out_index))
             else:
-                k = leaf_pos.get(id(v))
-                if k is None:
-                    k = len(leaves)
-                    leaf_pos[id(v)] = k
-                    leaves.append(v)
-                slots.append(("l", k))
+                slots.append(leaf_slot(v))
         wiring.append((n.key, tuple(slots)))
 
     leaf_sig = tuple(

@@ -344,11 +344,17 @@ class Tensor:
             return bool(self.numpy())
         except (jax.errors.TracerBoolConversionError,
                 jax.errors.TracerArrayConversionError) as e:
-            raise e from RuntimeError(  # clearer advice
+            # jax's traceback filtering re-raises from its own
+            # sentinel, clobbering any __cause__ we chain — put the
+            # advice in the message itself so it survives
+            advice = (
                 "python control flow on a traced Tensor (inside "
                 "to_static / jit).  Use paddle.static.nn.cond / "
                 "while_loop / switch_case, which lower to XLA control "
                 "flow and stay traceable.")
+            e.args = ((f"{e.args[0]}\n{advice}",) + e.args[1:]
+                      if e.args else (advice,))
+            raise
 
     def __int__(self):
         return int(self.numpy())

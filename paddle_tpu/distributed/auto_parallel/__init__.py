@@ -7,5 +7,10 @@ from . import engine
 from . import sharding
 from .sharding import (MeshPlan, annotate_params, get_mesh_plan,
                        match_partition_rules, set_mesh_plan)
+from . import overlap
+from .overlap import (overlap_report, select_mode, sharded_matmul,
+                      tile_arithmetic)
+from . import pipeline
+from .pipeline import PipelineSchedule, one_f_one_b_order
 from .cost_model import Planner, estimate_cost, comm_cost_seconds
 from .engine import Strategy, DistModel, Engine, to_static

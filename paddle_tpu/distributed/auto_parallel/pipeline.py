@@ -1,0 +1,314 @@
+"""Microbatch 1F1B pipeline parallelism over the ``pp`` mesh axis.
+
+The ``pp`` axis (``PADDLE_TPU_MESH="dp=2;pp=2"``) partitions the
+*program*, not tensors: each pipeline stage is a pure callable
+``stage_fn(params, x) -> y`` compiled under the sub-plan
+``MeshPlan.stage_plan(s)`` (the plan minus ``pp``, over that stage's
+device slice), so a stage shards exactly like a non-pipelined program
+on its subset of the mesh.
+
+Scheduling is classic 1F1B: every stage fills a warmup window of
+``min(M, S - s)`` forward microbatches, then strictly alternates
+backward/forward until the drain — bounding live activations per stage
+to the window instead of GPipe's full ``M``.  The schedule is produced
+by :func:`one_f_one_b_order` (a deterministic cycle simulation, unit
+testable) and *executed* through ``core.pipeline.InFlightWindow``
+instances — one per stage, depth = warmup window + 1 — so the in-flight
+accounting, ``pipeline.wait`` spans, and ``pipeline_stats()`` lanes the
+async executor already has cover pipeline-parallel runs too.
+
+Numerics: with equal microbatches and a mean-reducing ``loss_fn``, the
+pipeline loss is the mean of microbatch losses and gradients are the
+mean of microbatch gradients — identical to the full-batch step up to
+float summation order (the pp=2 vs pp=1 parity test in
+tests/test_sharding.py holds this to rtol 1e-6 in f32).
+
+Memory: :meth:`PipelineSchedule.preflight` routes through
+``memory.guard.preflight_check`` with per-stage residents and the
+microbatch in-flight activation buffers as a named line item, so the
+pipeline's steady state is budgeted before the first dispatch.
+"""
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+from ... import observability as obs
+
+__all__ = ["ENV_MICROBATCHES", "PipelineSchedule", "max_in_flight",
+           "num_microbatches_default", "one_f_one_b_order"]
+
+ENV_MICROBATCHES = "PADDLE_TPU_MICROBATCHES"
+
+
+def num_microbatches_default(num_stages):
+    """``PADDLE_TPU_MICROBATCHES`` or 2×stages (keeps the pipe full
+    through the steady state with a modest activation window)."""
+    env = os.environ.get(ENV_MICROBATCHES, "").strip()
+    if env:
+        n = int(env)
+        if n < 1:
+            raise ValueError(f"{ENV_MICROBATCHES} must be >= 1, got {n}")
+        return n
+    return max(1, 2 * int(num_stages))
+
+
+def one_f_one_b_order(num_stages, num_microbatches):
+    """Flat dispatch order ``[(kind, stage, microbatch)]``, kind in
+    ``{"F", "B"}``, following the 1F1B schedule.
+
+    Deterministic cycle simulation: per cycle each stage issues at most
+    one op, readiness is judged against the previous cycle's state
+    (stage ``s`` can forward microbatch ``m`` only after stage ``s-1``
+    finished it in an earlier cycle), and once a stage's warmup window
+    ``min(M, S - s)`` is full it only drains backwards — stalling if
+    none is ready — so per-stage in-flight activations never exceed
+    the window (``max_in_flight`` equals it exactly in steady state).
+    """
+    S, M = int(num_stages), int(num_microbatches)
+    if S < 1 or M < 1:
+        raise ValueError(f"need >=1 stage and >=1 microbatch, got "
+                         f"S={S}, M={M}")
+    order = []
+    fwd = [0] * S   # forwards issued per stage
+    bwd = [0] * S   # backwards issued per stage
+    while any(b < M for b in bwd):
+        f0, b0 = list(fwd), list(bwd)
+        issued = False
+        for s in range(S):
+            warm = min(M, S - s)
+            can_f = fwd[s] < M and (s == 0 or fwd[s] < f0[s - 1])
+            can_b = bwd[s] < f0[s] and (s == S - 1 or bwd[s] < b0[s + 1])
+            if (f0[s] - b0[s]) >= warm:
+                # window full: strictly one-B-then-one-F — drain a
+                # backward or STALL; running another forward here is
+                # GPipe's memory curve, not 1F1B's
+                if can_b:
+                    order.append(("B", s, bwd[s]))
+                    bwd[s] += 1
+                    issued = True
+            elif can_f:
+                order.append(("F", s, fwd[s]))
+                fwd[s] += 1
+                issued = True
+            elif can_b:
+                order.append(("B", s, bwd[s]))
+                bwd[s] += 1
+                issued = True
+        if not issued:
+            raise RuntimeError(
+                f"1F1B schedule deadlocked at fwd={fwd} bwd={bwd} "
+                f"(S={S}, M={M})")
+    return order
+
+
+def max_in_flight(order, num_stages):
+    """Per-stage peak of forwarded-but-not-backpropagated microbatches
+    observed in ``order`` — the activation window the memory guard
+    charges (≤ ``min(M, S - s)`` by construction)."""
+    peak = [0] * int(num_stages)
+    live = [0] * int(num_stages)
+    for kind, s, _ in order:
+        live[s] += 1 if kind == "F" else -1
+        peak[s] = max(peak[s], live[s])
+    return peak
+
+
+def _tree_add(a, b):
+    import jax
+    return jax.tree_util.tree_map(lambda x, y: x + y, a, b)
+
+
+def _tree_scale(a, k):
+    import jax
+    return jax.tree_util.tree_map(lambda x: x * k, a)
+
+
+def _split_batch(x, num_microbatches):
+    import jax.numpy as jnp
+    n = x.shape[0]
+    if n % num_microbatches != 0:
+        raise ValueError(
+            f"batch dim {n} not divisible by num_microbatches="
+            f"{num_microbatches}")
+    return jnp.split(x, num_microbatches, axis=0)
+
+
+class PipelineSchedule:
+    """1F1B runner over ``len(stage_fns)`` pipeline stages.
+
+    ``stage_fns``: pure callables ``fn(params, x) -> y``.
+    ``stage_params``: one parameter pytree per stage.
+    ``loss_fn(pred, target) -> scalar`` (mean-reduced) closes the last
+    stage.  ``plan`` supplies stage placement (``pp`` axis); ``None``
+    or ``pp=1`` runs every stage on the default device — same numbers,
+    no pipeline hardware.
+    """
+
+    def __init__(self, stage_fns, stage_params, loss_fn, *, plan=None,
+                 num_microbatches=None):
+        from . import sharding as spmd
+        import jax
+        from ...core.pipeline import InFlightWindow
+        self.stage_fns = list(stage_fns)
+        self.num_stages = len(self.stage_fns)
+        if self.num_stages < 1:
+            raise ValueError("need at least one stage")
+        self.loss_fn = loss_fn
+        self.plan = plan if plan is not None else spmd.get_mesh_plan()
+        if self.plan is not None and self.plan.num_stages > 1 \
+                and self.plan.num_stages != self.num_stages:
+            raise ValueError(
+                f"plan has pp={self.plan.num_stages} but "
+                f"{self.num_stages} stage functions were given")
+        self.num_microbatches = int(
+            num_microbatches if num_microbatches is not None
+            else num_microbatches_default(self.num_stages))
+        self.order = one_f_one_b_order(self.num_stages,
+                                       self.num_microbatches)
+        self._peaks = max_in_flight(self.order, self.num_stages)
+
+        piped = (self.plan is not None and not self.plan.is_virtual
+                 and self.plan.num_stages > 1)
+        self._stage_plans = []
+        self._stage_devs = []
+        for s in range(self.num_stages):
+            sp = self.plan.stage_plan(s) if piped else (
+                self.plan if self.plan is not None
+                and not self.plan.is_virtual
+                and self.plan.num_stages == 1 else None)
+            self._stage_plans.append(sp)
+            if piped:
+                self._stage_devs.append(self.plan.stage_devices(s)[0])
+            else:
+                self._stage_devs.append(None)
+        # place each stage's params on its slice of the mesh
+        self.stage_params = []
+        for s, params in enumerate(stage_params):
+            self.stage_params.append(self._place(s, params))
+        # one in-flight window per stage, depth = warmup window + 1,
+        # layered on the executor's async-pipeline machinery
+        self._windows = [InFlightWindow(depth=self._peaks[s] + 1)
+                         for s in range(self.num_stages)]
+
+    def _place(self, stage, tree):
+        import jax
+        sp = self._stage_plans[stage]
+        if sp is not None:
+            return jax.device_put(tree, sp.replicated())
+        dev = self._stage_devs[stage]
+        if dev is not None:
+            return jax.device_put(tree, dev)
+        return tree
+
+    def _stage_call(self, stage, params, x):
+        return self.stage_fns[stage](params, x)
+
+    # -- memory preflight -------------------------------------------------
+    def activation_shapes(self, x_microbatch):
+        """Per-stage output ShapeDtypeStructs for one microbatch."""
+        import jax
+        shapes = []
+        cur = x_microbatch
+        for s in range(self.num_stages):
+            cur = jax.eval_shape(self.stage_fns[s],
+                                 self.stage_params[s], cur)
+            shapes.append(cur)
+        return shapes
+
+    def microbatch_buffer_bytes(self, x_microbatch):
+        """Bytes of the 1F1B in-flight activation window: each stage
+        holds up to its warmup peak of forwarded microbatch outputs."""
+        import jax
+        total = 0
+        for s, sds in enumerate(self.activation_shapes(x_microbatch)):
+            act = sum(int(np.prod(l.shape)) * l.dtype.itemsize
+                      for l in jax.tree_util.tree_leaves(sds))
+            total += self._peaks[s] * act
+        return int(total)
+
+    def preflight(self, x, y=None, budget=None, raise_on_over=True):
+        """Budget the pipeline's steady state before dispatching.
+
+        Named line items: per-stage parameter residents plus the
+        microbatch in-flight activation buffers (the 1F1B window).
+        The compiled estimate comes from stage 0's AOT lowering; the
+        line items carry the cross-stage state it cannot see.
+        """
+        import jax
+        from ...memory import guard
+        from ...memory.estimator import named_buffer_sizes
+        x_mb = _split_batch(jax.numpy.asarray(x),
+                            self.num_microbatches)[0]
+        named = []
+        for s, params in enumerate(self.stage_params):
+            leaves = jax.tree_util.tree_leaves(params)
+            rows = named_buffer_sizes(
+                [(f"pp stage {s} residents", l) for l in leaves])
+            named.append((f"pp stage {s} residents",
+                          sum(n for _, n in rows)))
+        named.append(("pp microbatch in-flight buffers",
+                      self.microbatch_buffer_bytes(x_mb)))
+        try:
+            compiled = jax.jit(self.stage_fns[0]).lower(
+                self.stage_params[0], x_mb).compile()
+        except Exception:
+            compiled = None
+        return guard.preflight_check(
+            compiled, program=f"pipeline_1f1b[S={self.num_stages},"
+            f"M={self.num_microbatches}]", named_buffers=named,
+            budget=budget, raise_on_over=raise_on_over)
+
+    # -- the 1F1B step ----------------------------------------------------
+    def step(self, x, y):
+        """One pipelined training step: ``(loss, [stage_grads])``.
+
+        ``loss`` is the mean of microbatch losses; gradients are the
+        mean of microbatch gradients — full-batch parity for
+        mean-reducing losses.
+        """
+        import jax
+        xs = _split_batch(x, self.num_microbatches)
+        ys = _split_batch(y, self.num_microbatches)
+        S, M = self.num_stages, self.num_microbatches
+        outs, vjps, cots = {}, {}, {}
+        losses = [None] * M
+        grads = [None] * S
+        loss_grad = jax.value_and_grad(self.loss_fn)
+        for kind, s, m in self.order:
+            if kind == "F":
+                xin = xs[m] if s == 0 else outs[(s - 1, m)]
+                xin = self._place(s, xin)      # stage-to-stage transfer
+                with obs.span(f"dispatch:pp.fwd[s{s}]", cat="dispatch",
+                              step=m, stage=s):
+                    out, vjp = jax.vjp(
+                        lambda p, t, _s=s: self._stage_call(_s, p, t),
+                        self.stage_params[s], xin)
+                outs[(s, m)] = out
+                vjps[(s, m)] = vjp
+                self._windows[s].admit(
+                    jax.tree_util.tree_leaves(out),
+                    label=f"pp.fwd:s{s}", step=m)
+            else:
+                if s == S - 1:
+                    loss, dy = loss_grad(outs[(s, m)],
+                                         self._place(s, ys[m]))
+                    losses[m] = loss
+                else:
+                    dy = cots.pop((s, m))
+                dy = self._place(s, dy)
+                with obs.span(f"dispatch:pp.bwd[s{s}]", cat="dispatch",
+                              step=m, stage=s):
+                    dparams, dx = vjps.pop((s, m))(dy)
+                grads[s] = dparams if grads[s] is None \
+                    else _tree_add(grads[s], dparams)
+                if s > 0:
+                    cots[(s - 1, m)] = dx
+                outs.pop((s, m), None)
+        for w in self._windows:
+            w.drain()
+        import jax.numpy as jnp
+        loss = jnp.mean(jnp.stack(losses))
+        grads = [_tree_scale(g, 1.0 / M) for g in grads]
+        return loss, grads

@@ -42,10 +42,14 @@ ENV_MESH = "PADDLE_TPU_MESH"
 #: of feeds is sharded across these (fsdp shards params *and* batch).
 DATA_AXES = ("dp", "fsdp")
 MODEL_AXES = ("tp",)
-KNOWN_AXES = DATA_AXES + MODEL_AXES
+#: stage axis: pipeline parallelism.  Not a sharding axis — partition
+#: rules and batch specs never place tensors on it; it partitions the
+#: *program* into stages (see auto_parallel.pipeline / stage_plan).
+PIPELINE_AXES = ("pp",)
+KNOWN_AXES = DATA_AXES + MODEL_AXES + PIPELINE_AXES
 
 __all__ = [
-    "ENV_MESH", "DATA_AXES", "MODEL_AXES", "KNOWN_AXES",
+    "ENV_MESH", "DATA_AXES", "MODEL_AXES", "KNOWN_AXES", "PIPELINE_AXES",
     "BERT_RULES", "GPT_RULES", "MeshPlan", "annotate_params",
     "clear_mesh_plan", "gather_value", "gather_named", "get_mesh_plan",
     "make_shard_and_gather_fns", "match_partition_rules",
@@ -60,12 +64,14 @@ def _pspec():
 
 
 def parse_mesh_spec(spec):
-    """``"dp=4,tp=2"`` -> ``{"dp": 4, "tp": 2}`` (ordered, validated)."""
+    """``"dp=4,tp=2"`` -> ``{"dp": 4, "tp": 2}`` (ordered, validated).
+    ``;`` separates segments too (``"dp=4;pp=2"``) so the env knob
+    composes with shell-quoted specs."""
     if isinstance(spec, dict):
         items = list(spec.items())
     else:
         items = []
-        for part in str(spec).split(","):
+        for part in str(spec).replace(";", ",").split(","):
             part = part.strip()
             if not part:
                 continue
@@ -285,13 +291,59 @@ class MeshPlan:
         return tuple((pat, str(spec)) for pat, spec in self.rules)
 
     def cache_token(self):
-        """Hashable token identifying mesh topology + rule set; mixed
-        into executable-cache keys so plans never share executables."""
-        return (tuple(self.axis_sizes.items()), self.rules_token())
+        """Hashable token identifying mesh topology + rule set + the
+        configured collective-overlap mode; mixed into executable-cache
+        keys so plans never share executables.  The pp axis enters via
+        ``axis_sizes``; the overlap mode via ``overlap.mode_token()``."""
+        from . import overlap as _overlap
+        return (tuple(self.axis_sizes.items()), self.rules_token(),
+                _overlap.mode_token())
 
     def __repr__(self):
         return (f"MeshPlan({self.describe()}, rules={len(self.rules)}"
                 f"{', virtual' if self._virtual else ''})")
+
+    # -- pipeline stages --------------------------------------------------
+    @property
+    def num_stages(self):
+        """Pipeline depth: size of the ``pp`` axis (1 = no pipeline)."""
+        return self.axis_sizes.get("pp", 1)
+
+    def stage_plan(self, stage):
+        """The sub-plan one pipeline stage computes under.
+
+        Slices this plan's device array along the ``pp`` axis and
+        rebuilds a MeshPlan over the remaining axes (same rules), so a
+        stage's step function compiles and shards exactly like a
+        non-pipelined program on its device subset.  Returns ``None``
+        when nothing but ``pp`` (or nothing at all) remains — the stage
+        runs as a plain jitted function on its slice's first device.
+        """
+        stages = self.num_stages
+        if not 0 <= stage < stages:
+            raise ValueError(f"stage {stage} out of range for "
+                             f"pp={stages}")
+        rest = {a: n for a, n in self.axis_sizes.items()
+                if a != "pp" and n > 1}
+        if "pp" not in self.axis_sizes:
+            return self if stage == 0 else None
+        if self._virtual:
+            return MeshPlan(rest, rules=self.rules, virtual=True) \
+                if rest else None
+        arr = np.asarray(self.mesh.devices)
+        idx = self.axis_names.index("pp")
+        devs = list(np.take(arr, [stage], axis=idx).ravel())
+        if not rest:
+            return None
+        return MeshPlan(rest, rules=self.rules, devices=devs)
+
+    def stage_devices(self, stage):
+        """Devices backing one pipeline stage (row of the pp axis)."""
+        arr = np.asarray(self.mesh.devices)
+        if "pp" not in self.axis_sizes:
+            return list(arr.ravel())
+        idx = self.axis_names.index("pp")
+        return list(np.take(arr, [stage], axis=idx).ravel())
 
     # -- spec resolution --------------------------------------------------
     def data_axes(self):

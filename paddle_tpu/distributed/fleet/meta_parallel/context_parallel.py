@@ -36,6 +36,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ....core.tensor import Tensor
 from ...env import global_mesh
+from ...jax_compat import shard_map as _shard_map
 
 __all__ = ["ring_attention_local", "ring_attention",
            "ulysses_attention_local", "ulysses_attention"]
@@ -141,12 +142,11 @@ def _global_wrapper(local_fn, q, k, v, sep_axis, causal, scale, mesh):
     fn = _WRAPPER_CACHE.get(key)
     if fn is None:
         spec = P(None, sep_axis, None, None)            # shard seq dim
-        fn = jax.shard_map(
+        fn = _shard_map(
             functools.partial(local_fn, axis=sep_axis,
                               axis_size=axis_size, causal=causal,
                               scale=scale),
-            mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
-            check_vma=False)
+            mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
         _WRAPPER_CACHE[key] = fn
     if any(isinstance(x, Tensor) for x in (q, k, v)):
         # through the dispatch layer so the eager tape records a grad

@@ -34,6 +34,7 @@ import jax.numpy as jnp
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ...env import global_mesh
+from ...jax_compat import shard_map as _shard_map
 
 __all__ = ["global_scatter_local", "global_gather_local",
            "moe_ep_forward_local", "ExpertParallelEngine"]
@@ -175,10 +176,9 @@ class ExpertParallelEngine:
         tok_spec = P(self.tok_axes)
         p_specs = tuple(P(axis, *([None] * (a.ndim - 1)))
                         for a in stacked)
-        fn = jax.shard_map(
+        fn = _shard_map(
             device_fn, mesh=mesh,
             in_specs=(p_specs, tok_spec, tok_spec, tok_spec, tok_spec),
-            out_specs=tok_spec,
-            check_vma=False)
+            out_specs=tok_spec)
         y = fn(tuple(stacked), x_val, probs, topk_idx, topk_val)
         return y, aux

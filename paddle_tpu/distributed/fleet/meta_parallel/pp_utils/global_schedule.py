@@ -573,8 +573,11 @@ class GlobalPipelineEngine:
                                 keepdims=False),
                             jnp.zeros_like(h[0]))
                         state = jnp.roll(state, 1, axis=0)
+                        # i32 index: a bare python 0 is i64 under the
+                        # global x64 and trips the hlo verifier against
+                        # the partitioner's i32 shard-offset arithmetic
                         state = jax.lax.dynamic_update_index_in_dim(
-                            state, x_t, 0, 0)
+                            state, x_t, jnp.int32(0), 0)
                         state = state_constraint(state, "pp")
                         state = body_v(tuple(s_vals), state)
                         state = state_constraint(state, "pp")
@@ -588,9 +591,13 @@ class GlobalPipelineEngine:
                             outbuf, new, idx, 0)
                         return (state, outbuf), None
 
+                    # i32 tick index: an i64 scan carry (global x64)
+                    # collides with the partitioner's i32 offset math
+                    # inside dynamic_update_slice after spmd-partitioning
                     (_, outbuf), _ = jax.lax.scan(
                         tick, (state0, outbuf0),
-                        jnp.arange(n_micro + n_stages - 1))
+                        jnp.arange(n_micro + n_stages - 1,
+                                   dtype=jnp.int32))
                 else:
                     # Interleaved schedule (see __init__): per tick
                     # every slot computes ONE chunk, phases selected by
@@ -602,7 +609,9 @@ class GlobalPipelineEngine:
                     sched = _interleave_schedule(
                         n_micro, n_stages, n_virtual)
                     inj, inj_m, ext, ext_m, phase = (
-                        jnp.asarray(a) for a in sched)
+                        jnp.asarray(a, jnp.int32)
+                        if np.asarray(a).dtype.kind in "iu"
+                        else jnp.asarray(a) for a in sched)
 
                     def tick(carry, x_t):
                         state, outbuf = carry
@@ -611,7 +620,7 @@ class GlobalPipelineEngine:
                             h, inj_mt, 0, keepdims=False)
                         new0 = jnp.where(inj_t, x_in, state[0])
                         state = jax.lax.dynamic_update_index_in_dim(
-                            state, new0, 0, 0)
+                            state, new0, jnp.int32(0), 0)
                         state = state_constraint(state, "pp")
                         state = body_v(tuple(s_vals), phase_row, state)
                         state = state_constraint(state, "pp")

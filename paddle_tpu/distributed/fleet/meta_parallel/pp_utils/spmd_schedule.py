@@ -47,6 +47,7 @@ import jax.numpy as jnp
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ....communication.group import Group  # noqa: F401  (API surface)
+from ....jax_compat import shard_map as _shard_map
 from .....core.tensor import Tensor
 
 logger = logging.getLogger("paddle_tpu.pipeline")
@@ -257,12 +258,11 @@ class SpmdPipelineEngine:
         data_spec_y = P(None, batch_axes if batch_axes else None,
                         *([None] * (len(y_aval.shape) - 2)))
 
-        smapped = jax.shard_map(
+        smapped = _shard_map(
             device_fn, mesh=mesh,
             in_specs=(tuple(p_specs), tuple(o_specs), rep, rep,
                       data_spec_x, data_spec_y),
-            out_specs=(rep, tuple(p_specs), tuple(o_specs)),
-            check_vma=False)
+            out_specs=(rep, tuple(p_specs), tuple(o_specs)))
 
         jitted = jax.jit(smapped, donate_argnums=(0, 1))
         return jitted

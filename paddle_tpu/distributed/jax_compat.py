@@ -1,0 +1,53 @@
+"""Bridges over jax APIs that moved between releases.
+
+Newer jax spells these ``jax.shard_map`` (with ``check_vma=``) and
+``jax.lax.axis_size``; the pinned 0.4.37 has
+``jax.experimental.shard_map.shard_map`` (with ``check_rep=``) and
+``jax.core.axis_frame(name)`` returning the size directly.  Call sites
+that need to run on either go through this module.
+"""
+import inspect
+
+import jax
+
+__all__ = ["shard_map", "axis_size", "distributed_initialized"]
+
+_CHECK_KWARG = None  # resolved once per process
+
+
+def shard_map(fn, *, mesh, in_specs, out_specs, check=False):
+    """shard_map with the replication check spelled per installed jax
+    (``check_vma`` vs ``check_rep``)."""
+    global _CHECK_KWARG
+    sm = getattr(jax, "shard_map", None)
+    if sm is None:
+        from jax.experimental.shard_map import shard_map as sm
+    if _CHECK_KWARG is None:
+        params = inspect.signature(sm).parameters
+        _CHECK_KWARG = next(
+            (k for k in ("check_vma", "check_rep") if k in params), "")
+    kw = {_CHECK_KWARG: check} if _CHECK_KWARG else {}
+    return sm(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs, **kw)
+
+
+def axis_size(axis_name):
+    """Static size of a named mesh axis, callable inside a traced
+    shard_map/pmap body (the result is a Python int, so it can drive
+    e.g. ppermute permutation lists)."""
+    if hasattr(jax.lax, "axis_size"):
+        return int(jax.lax.axis_size(axis_name))
+    frame = jax.core.axis_frame(axis_name)
+    return frame if isinstance(frame, int) else frame.size
+
+
+def distributed_initialized():
+    """``jax.distributed.is_initialized()`` where it exists, else the
+    coordination client's presence in the runtime global state."""
+    fn = getattr(jax.distributed, "is_initialized", None)
+    if fn is not None:
+        return bool(fn())
+    try:
+        from jax._src.distributed import global_state
+        return global_state.client is not None
+    except Exception:
+        return False

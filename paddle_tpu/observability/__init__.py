@@ -32,9 +32,9 @@ from .registry import (  # noqa: F401
     Counter, Gauge, Histogram, MetricsRegistry, get_registry,
 )
 from .export import (  # noqa: F401
-    CATEGORY_LANES, chrome_trace, export_chrome_trace, export_jsonl,
-    lint_summary_table, load_jsonl, phase_breakdown, pipeline_stats,
-    summary,
+    CATEGORY_LANES, chrome_trace, collective_overlap_stats,
+    export_chrome_trace, export_jsonl, lint_summary_table, load_jsonl,
+    phase_breakdown, pipeline_stats, summary,
 )
 
 __all__ = [
@@ -43,7 +43,7 @@ __all__ = [
     "enabled", "enable", "disable", "enabled_scope",
     "set_step", "current_step", "next_flow_id", "obs_dir",
     "Counter", "Gauge", "Histogram", "MetricsRegistry", "get_registry",
-    "CATEGORY_LANES", "chrome_trace", "export_chrome_trace",
-    "export_jsonl", "lint_summary_table", "load_jsonl", "summary",
-    "phase_breakdown", "pipeline_stats",
+    "CATEGORY_LANES", "chrome_trace", "collective_overlap_stats",
+    "export_chrome_trace", "export_jsonl", "lint_summary_table",
+    "load_jsonl", "summary", "phase_breakdown", "pipeline_stats",
 ]

@@ -47,17 +47,26 @@ class CompiledProgram:
         self._build_strategy = build_strategy
 
 
-def run_program_ops(ops, env, capture_value):
+def run_program_ops(ops, env, capture_value, op_override=None):
     """THE Program walker: evaluate the op list over `env`
     (Variable name → array).  Non-Variable inputs are captured eager
     Tensors (parameters/constants) resolved through `capture_value`.
     Shared by Executor compilation and static/io._export_program so the
     execution semantics of a Program cannot diverge between run and
-    save_inference_model."""
+    save_inference_model.
+
+    ``op_override(op, in_vals)`` — optional per-op interception (the
+    collective-overlap router swaps eligible TP matmuls for their
+    decomposed shard_map form); returning ``NotImplemented`` falls
+    through to the op's recorded impl."""
     for op in ops:
         in_vals = [env[i.name] if isinstance(i, Variable)
                    else capture_value(i) for i in op.inputs]
-        out = op.impl(*in_vals)
+        out = NotImplemented
+        if op_override is not None:
+            out = op_override(op, in_vals)
+        if out is NotImplemented:
+            out = op.impl(*in_vals)
         if isinstance(out, (tuple, list)):
             for var, v in zip(op.outputs, out):
                 env[var.name] = v
@@ -425,6 +434,20 @@ class Executor:
 
         n_feed = len(feed_names)
 
+        # -- collective overlap: resolved once per build ----------------
+        # Under a tp plan with overlap selected (PADDLE_TPU_OVERLAP +
+        # probe), eligible row-parallel linears trace through the
+        # decomposed matmul-reduce-scatter ring instead of leaving the
+        # all-reduce to GSPMD; the mode is part of plan_cache_token so
+        # an env flip rebuilds.
+        from ..distributed.auto_parallel import sharding as spmd
+        from ..distributed.auto_parallel import overlap as _overlap
+        plan = spmd.get_mesh_plan()
+        overlap_mode = _overlap.select_mode(plan)
+        overlap_routed: list = []
+        op_override = _overlap.executor_linear_override(
+            plan, overlap_mode, routed=overlap_routed)
+
         def run_ops(feed_vals, param_vals, rng_vals):
             # feed_vals tail carries the frozen params (see _prologue)
             env = dict(zip(feed_names, feed_vals[:n_feed]))
@@ -434,7 +457,8 @@ class Executor:
             cmap.update(
                 {id(t): v for t, v in zip(rng_states, rng_vals)})
             return run_program_ops(
-                block.ops, env, lambda i: cmap.get(id(i), i._value))
+                block.ops, env, lambda i: cmap.get(id(i), i._value),
+                op_override=op_override)
 
         if opt is None:
             def pure(feed_vals, param_vals, opt_vals, rng_vals, lr, step):
@@ -494,9 +518,8 @@ class Executor:
         # feeds batch-sharded over the data axes, rng/lr/step and
         # fetches replicated.  out_shardings mirror in_shardings for
         # the train state so donation aliases shard-for-shard and the
-        # steady state never reshards.
-        from ..distributed.auto_parallel import sharding as spmd
-        plan = spmd.get_mesh_plan()
+        # steady state never reshards.  (plan fetched above, before
+        # run_ops, so the overlap router sees the same plan.)
         param_specs = opt_specs = frozen_specs = None
         jit_shardings = {}
         spmd_named = None
@@ -596,6 +619,8 @@ class Executor:
             "plan": plan,
             "placed": plan is None,
             "spmd_named": spmd_named,
+            "overlap_mode": overlap_mode,
+            "overlap_routed": overlap_routed,
         }
         if plan is not None:
             entry["feed_shardings"] = feed_shardings[:len(feed_names)]

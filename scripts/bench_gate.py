@@ -14,12 +14,16 @@ headline plus all ``*_tokens_per_sec`` / ``*_imgs_per_sec`` /
 latency (``*_p99_ttft_ms``).  Exits 1 iff any shared metric regressed
 by more than ``--threshold`` (default 5%) in its bad direction.
 
-A missing last-good artifact, an unreachable TPU, or a cached
-(re-emitted, non-live) fresh capture is a SKIP — exit 0 with a loud
-note — not a pass and not a failure: the gate only judges
-live-vs-live numbers from the same platform, mirroring bench.py's own
-"never exit 1 for a dead tunnel" rule.  The fresh capture is archived
-to ``.bench_cache/gate_capture.json`` either way.
+The gate is HARD whenever a live fresh capture exists: a regression
+exits 1, and so does a live capture the gate cannot judge (platform
+mismatch with no shared forced-host-mesh metrics, or no shared gated
+metrics at all) — silently waving a live round through is how perf
+regressions land.  SKIP (exit 0 with a loud note) is reserved for
+rounds with nothing live to judge: an unreachable TPU or a cached
+(re-emitted, non-live) fresh capture, mirroring bench.py's own "never
+exit 1 for a dead tunnel" rule.  A live capture with no last-good
+artifact SEEDs one (written to ``--last-good``, exit 0).  The fresh
+capture is archived to ``.bench_cache/gate_capture.json`` either way.
 """
 import argparse
 import json
@@ -138,12 +142,8 @@ def main(argv=None):
             print(f"bench_gate: {status}" + (f" — {note}" if note else ""))
 
     last_path = Path(args.last_good)
-    if not last_path.exists():
-        emit("SKIP", note=f"no last-good artifact at {last_path}; "
-             "nothing to compare against (bench.py writes it on the "
-             "first healthy capture)")
-        return 0
-    last_good = json.loads(last_path.read_text())
+    last_good = json.loads(last_path.read_text()) \
+        if last_path.exists() else None
 
     if args.fresh:
         fresh = json.loads(Path(args.fresh).read_text())
@@ -161,6 +161,22 @@ def main(argv=None):
         emit("SKIP", note="fresh capture is not a live measurement "
              "(unreachable TPU or re-emitted cache); refusing to judge")
         return 0
+
+    # from here on the capture is LIVE: every exit path is a verdict —
+    # seed, pass, or fail — never a silent wave-through
+    if last_good is None:
+        try:
+            last_path.write_text(json.dumps(fresh, indent=1))
+        except Exception as e:
+            log(f"seeding last-good failed: {e}")
+            emit("FAIL", note=f"no last-good at {last_path} and seeding "
+                 f"it from the live capture failed: {e}")
+            return 1
+        emit("SEEDED", note=f"no last-good artifact existed; live "
+             f"capture written to {last_path} — the next live round "
+             "is gated against it")
+        return 0
+
     only = None
     mismatch_note = ""
     if last_good.get("platform") != fresh.get("platform"):
@@ -169,10 +185,13 @@ def main(argv=None):
         # CPU mesh in both captures — judge those instead of skipping
         only = host_mesh_metrics(last_good) & host_mesh_metrics(fresh)
         if not only:
-            emit("SKIP", note=f"platform mismatch: last-good "
+            emit("FAIL", note=f"platform mismatch: last-good "
                  f"{last_good.get('platform')} vs fresh "
-                 f"{fresh.get('platform')}")
-            return 0
+                 f"{fresh.get('platform')} and no shared forced-host-"
+                 "mesh metrics to judge — a live round may not pass "
+                 "unjudged; re-seed by moving the last-good artifact "
+                 "aside")
+            return 1
         mismatch_note = (f" [platform mismatch "
                          f"{last_good.get('platform')} vs "
                          f"{fresh.get('platform')}: judging "
@@ -183,9 +202,10 @@ def main(argv=None):
     regressions, rows = compare(last_good, fresh, args.threshold,
                                 only=only)
     if not rows:
-        emit("SKIP", note="no shared throughput metrics between the "
-             "two captures")
-        return 0
+        emit("FAIL", note="live capture shares no gated metrics with "
+             "the last-good artifact — a live round may not pass "
+             "unjudged; re-seed by moving the last-good artifact aside")
+        return 1
     if regressions:
         emit("FAIL", rows, note=f"{len(regressions)} metric(s) dropped "
              f">{args.threshold:.0%} vs "

@@ -18,6 +18,12 @@ initializes — no accelerator needed) and asserts, per plan:
     checkpoint must not trigger a recompile) and the loss keeps
     improving on the overfit batch.
 
+Then an overlapped-matmul scenario on the tp=2 plan: three calls to
+the overlapped sharded matmul compile exactly once (AOT cache), the
+overlapped product is bit-equal to the sequential fallback, and the
+host-driven measured ring records a per-axis overlap ratio > 0 on the
+timeline (the sequential ring records ~0).
+
 Exit 0 and the ``SHARDING_SMOKE_OK`` sentinel on success; exit 1 with
 a traceback on the first violated invariant.  Runs in tier-1 via
 tests/test_sharding.py.
@@ -129,6 +135,49 @@ def run_scenario(mesh_spec):
         paddle.disable_static()
 
 
+def run_overlap_scenario():
+    """Tile-level compute/comm overlap: compile-once, bit-exactness vs
+    the sequential fallback, and a measured >0 overlap ratio."""
+    from paddle_tpu import observability as obs
+    from paddle_tpu.distributed.auto_parallel import overlap as ovl
+    from paddle_tpu.distributed.auto_parallel.sharding import MeshPlan
+
+    obs.enable(True)
+    obs.get_timeline().clear()
+    plan = MeshPlan("tp=2", rules={})
+    rng = np.random.default_rng(0)
+    a = rng.standard_normal((64, 32)).astype(np.float32)
+    b = rng.standard_normal((32, 16)).astype(np.float32)
+
+    outs = [np.asarray(ovl.sharded_matmul(
+        a, b, direction="ag", plan=plan, mode="overlap"))
+        for _ in range(3)]
+    compiles = _compile_count()
+    assert compiles == 1, (
+        f"[overlap] 3 overlapped matmul calls produced {compiles} "
+        "compile spans; the AOT cache must absorb repeats")
+    seq = np.asarray(ovl.sharded_matmul(
+        a, b, direction="ag", plan=plan, mode="sequential"))
+    for o in outs:
+        assert np.array_equal(o, seq), (
+            "[overlap] overlapped product != sequential fallback")
+
+    obs.get_timeline().clear()
+    m = np.asarray(ovl.measured_sharded_matmul(
+        a, b, plan=plan, mode="overlap"))
+    assert np.array_equal(m, seq), (
+        "[overlap] measured ring product != sequential fallback")
+    stats = obs.collective_overlap_stats().get("tp", {})
+    ratio = stats.get("overlap_ratio", 0.0)
+    assert ratio > 0, (
+        f"[overlap] measured overlap ratio {ratio} not > 0 "
+        f"(stats={stats})")
+    return {"mesh": "tp=2", "compile_spans": compiles,
+            "overlap_ratio_tp": ratio,
+            "collective_ms": stats.get("collective_ms", 0.0),
+            "overlapped_ms": stats.get("overlapped_ms", 0.0)}
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--mesh", default="dp=2;tp=2",
@@ -154,6 +203,12 @@ def main(argv=None):
         print(f"[sharding_smoke] {spec}: losses={res['losses']} "
               f"sharded={res['params_sharded']}/{res['params_total']}",
               file=sys.stderr)
+    ov = run_overlap_scenario()
+    results.append(ov)
+    print(f"[sharding_smoke] overlap[tp=2]: "
+          f"ratio={ov['overlap_ratio_tp']:.3f} "
+          f"({ov['overlapped_ms']:.1f}/{ov['collective_ms']:.1f} ms), "
+          f"compile_spans={ov['compile_spans']}", file=sys.stderr)
     if args.json:
         print(json.dumps({"scenarios": results, "ok": True}, indent=1))
     print("SHARDING_SMOKE_OK")

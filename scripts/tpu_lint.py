@@ -188,10 +188,13 @@ def lint_sharding():
     (``annotate_params``), and audits the inventory against virtual
     ``dp=2,tp=2`` and ``fsdp=2`` MeshPlans: a param no rule matches is
     TPU501; a large param the plan leaves replicated under a model-
-    parallel mesh is TPU502."""
+    parallel mesh is TPU502; a TP matmul weight whose collective can't
+    overlap with compute (ragged token tiling or overlap forced off)
+    is TPU504."""
     import paddle_tpu as paddle
     from paddle_tpu.analysis.diagnostics import DiagnosticReport, record
-    from paddle_tpu.analysis.sharding_audit import audit_sharding
+    from paddle_tpu.analysis.sharding_audit import (audit_overlap,
+                                                    audit_sharding)
     from paddle_tpu.distributed.auto_parallel.sharding import (
         BERT_RULES, GPT_RULES, MeshPlan, annotate_params)
     from paddle_tpu.models import (BertConfig, BertForMaskedLM,
@@ -218,6 +221,12 @@ def lint_sharding():
             plan = MeshPlan(mesh_spec, rules=rules, virtual=True)
             diags = audit_sharding(
                 plan, inventory,
+                site=f"{model_name}[{mesh_spec}]")
+            # hot-path tokens per device step for the bundled minis:
+            # batch 8 x seq 16, divisible by every tp tile count here,
+            # so a TPU504 means a rule/flag regression, not the hint
+            diags += audit_overlap(
+                plan, inventory, tokens_hint=128,
                 site=f"{model_name}[{mesh_spec}]")
             for d in diags:
                 record(d)

@@ -163,9 +163,10 @@ def test_cost_model_calibrates_against_measured_collectives():
 
     def measure(n):
         x = jnp.ones((8, n), jnp.float32)
-        f = jax.jit(jax.shard_map(
+        from paddle_tpu.distributed.jax_compat import shard_map
+        f = jax.jit(shard_map(
             lambda v: jax.lax.psum(v, "x"), mesh=mesh,
-            in_specs=P("x"), out_specs=P(), check_vma=False))
+            in_specs=P("x"), out_specs=P()))
         jax.block_until_ready(f(x))
         t = time.time()
         for _ in range(5):

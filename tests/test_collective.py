@@ -134,7 +134,8 @@ def test_ppermute_send_recv_shard_map(mesh):
         t = Tensor(v, _internal=True)
 
         def impl(val, *, axis):
-            n = jax.lax.axis_size(axis)
+            from paddle_tpu.distributed.jax_compat import axis_size
+            n = axis_size(axis)
             perm = [(i, (i + 1) % n) for i in range(n)]
             return jax.lax.ppermute(val, axis, perm)
 

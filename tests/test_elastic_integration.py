@@ -41,9 +41,10 @@ WORKER = textwrap.dedent("""
         local = np.ones((jax.local_device_count(), 1), np.float32)
         arr = jax.make_array_from_process_local_data(
             NamedSharding(mesh, P("dp")), local, (nd, 1))
-        out = jax.jit(jax.shard_map(
+        from paddle_tpu.distributed.jax_compat import shard_map
+        out = jax.jit(shard_map(
             lambda x: jax.lax.psum(x, "dp"), mesh=mesh,
-            in_specs=P("dp"), out_specs=P(), check_vma=False))(arr)
+            in_specs=P("dp"), out_specs=P()))(arr)
         assert float(np.asarray(jax.device_get(out))[0, 0]) == nd, tag
 
     paddle.seed(0)

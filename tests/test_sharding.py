@@ -291,6 +291,31 @@ class TestAudits:
                            str(1 << 30))
         assert audit_sharding(plan, big) == []
 
+    def test_tpu504_ragged_tokens(self):
+        from paddle_tpu.analysis.sharding_audit import audit_overlap
+        plan = MeshPlan("tp=2", rules=[(r".*", P("tp", None))],
+                        virtual=True)
+        inv = [("enc.fc2.weight", (64, 32), 64 * 32 * 4)]
+        assert audit_overlap(plan, inv, tokens_hint=128) == []
+        diags = audit_overlap(plan, inv, tokens_hint=129)
+        assert [d.code for d in diags] == ["TPU504"]
+        # the tile arithmetic is shown, not just asserted
+        assert "129 % 2" in diags[0].message
+        assert diags[0].data["reason"] == "ragged"
+
+    def test_tpu504_overlap_forced_off(self, monkeypatch):
+        from paddle_tpu.analysis.sharding_audit import audit_overlap
+        plan = MeshPlan("tp=2", rules=[(r".*", P("tp", None))],
+                        virtual=True)
+        inv = [("enc.fc2.weight", (64, 32), 64 * 32 * 4)]
+        monkeypatch.setenv("PADDLE_TPU_OVERLAP", "sequential")
+        diags = audit_overlap(plan, inv, tokens_hint=128)
+        assert [d.code for d in diags] == ["TPU504"]
+        assert diags[0].data["reason"] == "flag"
+        # no tp axis -> nothing to overlap, no diagnostic
+        dp_plan = MeshPlan("dp=2", rules=[(r".*", P())], virtual=True)
+        assert audit_overlap(dp_plan, inv, tokens_hint=129) == []
+
     def test_tpu503_indivisible_payload(self):
         from paddle_tpu.analysis.sharding_audit import \
             check_collective_axis
@@ -377,6 +402,270 @@ class TestServingDP:
             assert dp.dp == 2
         finally:
             dp.close()
+
+
+# ---------------------------------------------------------------------
+# Overlapped sharded matmuls (ISSUE 11 tentpole)
+# ---------------------------------------------------------------------
+class TestOverlappedMatmul:
+    def _mats(self, m, k, n, dtype=np.float32, seed=0):
+        rng = np.random.default_rng(seed)
+        return (rng.standard_normal((m, k)).astype(dtype),
+                rng.standard_normal((k, n)).astype(dtype))
+
+    def test_ag_f32_bitexact_vs_sequential(self):
+        from paddle_tpu.distributed.auto_parallel.overlap import \
+            sharded_matmul
+        plan = MeshPlan("tp=4", rules={})
+        a, b = self._mats(32, 16, 8)
+        ov = np.asarray(sharded_matmul(a, b, direction="ag", plan=plan,
+                                       mode="overlap"))
+        sq = np.asarray(sharded_matmul(a, b, direction="ag", plan=plan,
+                                       mode="sequential"))
+        assert np.array_equal(ov, sq)
+        np.testing.assert_allclose(ov, a @ b, rtol=1e-6)
+
+    def test_rs_f32_bitexact_vs_sequential(self):
+        from paddle_tpu.distributed.auto_parallel.overlap import \
+            sharded_matmul
+        plan = MeshPlan("tp=4", rules={})
+        a, b = self._mats(16, 32, 8, seed=1)
+        ov = np.asarray(sharded_matmul(a, b, direction="rs", plan=plan,
+                                       mode="overlap"))
+        sq = np.asarray(sharded_matmul(a, b, direction="rs", plan=plan,
+                                       mode="sequential"))
+        assert np.array_equal(ov, sq)
+        # vs the unsharded dot the k-split accumulation order differs:
+        # float-rounding scale only
+        np.testing.assert_allclose(ov, a @ b, rtol=1e-4)
+
+    def test_bf16_both_directions(self):
+        import jax.numpy as jnp
+        from paddle_tpu.distributed.auto_parallel.overlap import \
+            sharded_matmul
+        plan = MeshPlan("tp=4", rules={})
+        a32, b32 = self._mats(32, 16, 8, seed=2)
+        a = jnp.asarray(a32, jnp.bfloat16)
+        b = jnp.asarray(b32, jnp.bfloat16)
+        for direction in ("ag", "rs"):
+            ov = sharded_matmul(a, b, direction=direction, plan=plan,
+                                mode="overlap")
+            sq = sharded_matmul(a, b, direction=direction, plan=plan,
+                                mode="sequential")
+            assert ov.dtype == jnp.bfloat16
+            # both modes accumulate in f32 and cast once at the end,
+            # so tile count never changes the bf16 result
+            assert np.array_equal(np.asarray(ov, np.float32),
+                                  np.asarray(sq, np.float32))
+            ref = np.asarray(a, np.float32) @ np.asarray(b, np.float32)
+            np.testing.assert_allclose(np.asarray(ov, np.float32),
+                                       ref, rtol=5e-2, atol=0.5)
+
+    def test_uneven_last_tiles_padded(self):
+        # 30 and 18 don't divide by tp=4: the wrapper zero-pads to the
+        # tile grid and slices back — same numbers as the even case
+        from paddle_tpu.distributed.auto_parallel.overlap import \
+            sharded_matmul
+        plan = MeshPlan("tp=4", rules={})
+        a, b = self._mats(30, 18, 12, seed=3)
+        for direction in ("ag", "rs"):
+            ov = np.asarray(sharded_matmul(a, b, direction=direction,
+                                           plan=plan, mode="overlap"))
+            sq = np.asarray(sharded_matmul(a, b, direction=direction,
+                                           plan=plan,
+                                           mode="sequential"))
+            assert ov.shape == (30, 12)
+            assert np.array_equal(ov, sq)
+            np.testing.assert_allclose(ov, a @ b, rtol=1e-4,
+                                       atol=1e-6)
+
+    def test_measured_driver_overlap_ratio(self):
+        from paddle_tpu import observability as obs
+        from paddle_tpu.distributed.auto_parallel.overlap import \
+            measured_sharded_matmul
+        plan = MeshPlan("tp=4", rules={})
+        a, b = self._mats(32, 16, 8, seed=4)
+        obs.enable(True)
+        obs.get_timeline().clear()
+        out = np.asarray(measured_sharded_matmul(a, b, plan=plan,
+                                                 mode="overlap"))
+        np.testing.assert_allclose(out, a @ b, rtol=1e-6)
+        stats = obs.collective_overlap_stats()
+        assert stats["tp"]["overlap_ratio"] > 0
+        assert stats["tp"]["count"] == 3      # P-1 ring hops
+        pb = obs.phase_breakdown()
+        assert pb["overlap_ratio_tp"] == stats["tp"]["overlap_ratio"]
+        assert obs.pipeline_stats()["overlap"]["tp"]["overlap_ratio"] \
+            == stats["tp"]["overlap_ratio"]
+        # sequential driver on a fresh timeline: the hop is blocked on
+        # before the dot dispatches, so nothing hides under compute
+        obs.get_timeline().clear()
+        measured_sharded_matmul(a, b, plan=plan, mode="sequential")
+        seq = obs.collective_overlap_stats()
+        assert seq["tp"]["overlap_ratio"] < \
+            stats["tp"]["overlap_ratio"]
+
+    def test_executor_routes_overlapped_matmuls(self):
+        # the static executor's op_override sends row-parallel linear
+        # ops through the ring decomposition; the entry records which
+        static.Executor.clear_shared_cache()
+        _train_losses("tp=2", n_steps=1)
+        entry = next(e for e in static.Executor._shared_cache.values()
+                     if e.get("plan") is not None)
+        assert entry["overlap_mode"] == "overlap"
+        routed = entry["overlap_routed"]
+        assert len(routed) == 4       # attention.out + fc2, 2 layers
+        assert all(n.endswith((".attention.out.weight", ".fc2.weight"))
+                   for n in routed)
+
+    def test_overlap_flag_forces_sequential(self, monkeypatch):
+        from paddle_tpu.distributed.auto_parallel.overlap import \
+            select_mode
+        monkeypatch.setenv("PADDLE_TPU_OVERLAP", "sequential")
+        plan = MeshPlan("tp=2", rules={})
+        assert select_mode(plan) == "sequential"
+        monkeypatch.setenv("PADDLE_TPU_OVERLAP", "overlap")
+        assert select_mode(plan) == "overlap"
+        # no model axis -> nothing to overlap even when forced on
+        assert select_mode(MeshPlan("dp=2", rules={})) == "sequential"
+
+
+# ---------------------------------------------------------------------
+# Pipeline parallelism: the pp axis + 1F1B schedule (ISSUE 11)
+# ---------------------------------------------------------------------
+def _two_stage_mlp():
+    import jax.numpy as jnp
+    rng = np.random.default_rng(0)
+    w1 = jnp.asarray(rng.standard_normal((8, 16)).astype(np.float32))
+    w2 = jnp.asarray(rng.standard_normal((16, 8)).astype(np.float32))
+
+    def s0(params, x):
+        return jnp.tanh(x @ params["w"])
+
+    def s1(params, x):
+        return x @ params["w"]
+
+    def loss_fn(pred, y):
+        return jnp.mean((pred - y) ** 2)
+
+    return [s0, s1], [{"w": w1}, {"w": w2}], loss_fn
+
+
+class TestPipelineParallel:
+    def test_parse_pp_axis_and_stage_plans(self):
+        assert parse_mesh_spec("dp=2;pp=2") == {"dp": 2, "pp": 2}
+        assert parse_mesh_spec("pp=4") == {"pp": 4}
+        plan = MeshPlan("dp=2,pp=2", rules={})
+        assert plan.num_stages == 2
+        for s in range(2):
+            sub = plan.stage_plan(s)
+            assert sub is not None and sub.axis_sizes == {"dp": 2}
+            # 4 mesh devices / 2 stages -> 2 devices per stage slice
+            assert len(plan.stage_devices(s)) == 2
+        # device slices of distinct stages don't intersect
+        d0 = {str(d) for d in plan.stage_devices(0)}
+        d1 = {str(d) for d in plan.stage_devices(1)}
+        assert not (d0 & d1)
+        # pp-only plan: stage sub-plan degenerates to a single device
+        pp_only = MeshPlan("pp=2", rules={})
+        assert pp_only.stage_plan(0) is None
+        assert len(pp_only.stage_devices(0)) == 1
+
+    def test_one_f_one_b_order_properties(self):
+        from paddle_tpu.distributed.auto_parallel.pipeline import (
+            max_in_flight, one_f_one_b_order)
+        for S, M in ((1, 3), (2, 4), (4, 8), (3, 2)):
+            order = one_f_one_b_order(S, M)
+            fwd_seen = [set() for _ in range(S)]
+            bwd_seen = [set() for _ in range(S)]
+            for kind, s, m in order:
+                if kind == "F":
+                    if s > 0:        # upstream stage forwarded m first
+                        assert m in fwd_seen[s - 1]
+                    fwd_seen[s].add(m)
+                else:
+                    assert m in fwd_seen[s]
+                    if s < S - 1:    # downstream stage backpropped m
+                        assert m in bwd_seen[s + 1]
+                    bwd_seen[s].add(m)
+            assert all(len(f) == M for f in fwd_seen)
+            assert all(len(b) == M for b in bwd_seen)
+            peaks = max_in_flight(order, S)
+            assert all(peaks[s] <= min(M, S - s) for s in range(S))
+
+    def test_1f1b_parity_vs_full_batch(self):
+        import jax
+        from paddle_tpu.distributed.auto_parallel.pipeline import \
+            PipelineSchedule
+        stages, params, loss_fn = _two_stage_mlp()
+        rng = np.random.default_rng(1)
+        x = np.asarray(rng.standard_normal((8, 8)), np.float32)
+        y = np.asarray(rng.standard_normal((8, 8)), np.float32)
+        sched = PipelineSchedule(stages, params, loss_fn,
+                                 plan=MeshPlan("pp=2", rules={}),
+                                 num_microbatches=4)
+        loss, grads = sched.step(x, y)
+
+        def full(p0, p1, xv, yv):
+            return loss_fn(stages[1](p1, stages[0](p0, xv)), yv)
+
+        ref_loss, ref_grads = jax.value_and_grad(
+            full, argnums=(0, 1))(params[0], params[1], x, y)
+        np.testing.assert_allclose(float(loss), float(ref_loss),
+                                   rtol=1e-6)
+        # grads: microbatch summation order differs from the
+        # full-batch reduction; float-rounding drift only
+        for got, want in zip(grads, ref_grads):
+            np.testing.assert_allclose(np.asarray(got["w"]),
+                                       np.asarray(want["w"]),
+                                       rtol=1e-4, atol=1e-7)
+
+    def test_1f1b_pp1_matches_pp2(self):
+        from paddle_tpu.distributed.auto_parallel.pipeline import \
+            PipelineSchedule
+        stages, params, loss_fn = _two_stage_mlp()
+        rng = np.random.default_rng(2)
+        x = np.asarray(rng.standard_normal((8, 8)), np.float32)
+        y = np.asarray(rng.standard_normal((8, 8)), np.float32)
+        l2, g2 = PipelineSchedule(
+            stages, params, loss_fn, plan=MeshPlan("pp=2", rules={}),
+            num_microbatches=4).step(x, y)
+        l1, g1 = PipelineSchedule(
+            stages, params, loss_fn, plan=None,
+            num_microbatches=4).step(x, y)
+        np.testing.assert_allclose(float(l2), float(l1), rtol=1e-6)
+        for a, b in zip(g2, g1):
+            np.testing.assert_allclose(np.asarray(a["w"]),
+                                       np.asarray(b["w"]), rtol=1e-5)
+
+    def test_preflight_microbatch_line_item(self):
+        from paddle_tpu.distributed.auto_parallel.pipeline import \
+            PipelineSchedule
+        stages, params, loss_fn = _two_stage_mlp()
+        sched = PipelineSchedule(stages, params, loss_fn,
+                                 plan=MeshPlan("pp=2", rules={}),
+                                 num_microbatches=4)
+        x = np.zeros((8, 8), np.float32)
+        est = sched.preflight(x, raise_on_over=False)
+        assert est is not None
+        names = [n for n, _ in est.buffers]
+        assert "pp microbatch in-flight buffers" in names
+        assert "pp stage 0 residents" in names
+        assert "pp stage 1 residents" in names
+        mb = dict(est.buffers)["pp microbatch in-flight buffers"]
+        assert mb == sched.microbatch_buffer_bytes(
+            np.zeros((2, 8), np.float32))
+        assert mb > 0
+
+    def test_cache_token_tracks_pp_and_overlap_mode(self, monkeypatch):
+        base = MeshPlan("dp=2", rules={}, virtual=True)
+        with_pp = MeshPlan("dp=2,pp=2", rules={}, virtual=True)
+        assert base.cache_token() != with_pp.cache_token()
+        tok = base.cache_token()
+        monkeypatch.setenv("PADDLE_TPU_OVERLAP", "sequential")
+        assert base.cache_token() != tok
+        monkeypatch.delenv("PADDLE_TPU_OVERLAP")
+        assert base.cache_token() == tok
 
 
 # ---------------------------------------------------------------------
