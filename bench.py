@@ -747,6 +747,57 @@ def bench_gpt_decode(on_tpu):
         out["spec_tokens_accepted"] = ss["tokens_accepted"]
     finally:
         spec_eng.close()
+
+    # fault-tolerance phase: kill 1 of 2 replicas mid-burst and report
+    # the worst failover recovery (requeue + reroute + stream
+    # migration), then flood a shed-bounded engine for the shed rate —
+    # both lower-better, judged by bench_gate
+    from paddle_tpu.distributed.fault_tolerance import FaultPlan, inject
+    from paddle_tpu.inference.serving import (DataParallelEngine,
+                                              RequestRejected)
+    dp = DataParallelEngine(model, dp=2, max_batch=max_batch,
+                            max_model_len=cfg.max_position_embeddings)
+    try:
+        dp.generate(prompts[:2], max_new_tokens=4)  # compiles replicas
+        hist = obs.get_registry().histogram(
+            "serving.failover_recovery_ms")
+        count0 = hist.snapshot()["count"]
+        t = time.time()
+        with inject(FaultPlan.parse(
+                "serve.replica_down.dp0:kill:after=2,count=1")):
+            dp.generate(prompts, max_new_tokens=max_new)
+        fdt = time.time() - t
+        ds = dp.stats()
+        snap = hist.snapshot()
+        recovery_ms = (snap["max"] or 0.0) if snap["count"] > count0 \
+            else 0.0
+        log(f"gpt_decode[fault]: killed 1/2 replicas mid-burst, "
+            f"{ds['failovers']} failover(s), {ds['replays']} replay(s), "
+            f"recovery {recovery_ms:.2f} ms, burst {fdt:.2f}s")
+        out["failover_recovery_ms"] = round(recovery_ms, 2)
+        out["failover_replays"] = ds["replays"]
+    finally:
+        dp.close()
+    shed_eng = GenerationEngine(model, max_batch=max_batch,
+                                max_model_len=cfg.max_position_embeddings,
+                                shed_depth=max_batch * 2)
+    try:
+        admitted, rejected = 0, 0
+        for p in prompts * 2:
+            try:
+                shed_eng.add_request(p, max_new_tokens=4)
+                admitted += 1
+            except RequestRejected:
+                rejected += 1
+        while shed_eng.has_unfinished():
+            shed_eng.step()
+        shed_rate = rejected / max(1, admitted + rejected)
+        log(f"gpt_decode[fault]: shed {rejected}/{admitted + rejected} "
+            f"of a {len(prompts) * 2}-deep flood "
+            f"(depth bound {max_batch * 2})")
+        out["shed_rate"] = round(shed_rate, 4)
+    finally:
+        shed_eng.close()
     return out
 
 
@@ -1345,6 +1396,14 @@ def main():
                     res["spec_tokens_per_sec"]
                 payload["extra_metrics"]["gpt_spec_accept_rate"] = \
                     res["spec_accept_rate"]
+            if "failover_recovery_ms" in res:
+                payload["extra_metrics"]["gpt_failover_recovery_ms"] = \
+                    res["failover_recovery_ms"]
+                payload["extra_metrics"]["gpt_failover_replays"] = \
+                    res["failover_replays"]
+            if "shed_rate" in res:
+                payload["extra_metrics"]["gpt_shed_rate"] = \
+                    res["shed_rate"]
         elif name == "llama":
             payload["extra_metrics"][
                 "llama_0p3b_recompute_bf16_tokens_per_sec"] = \

@@ -21,7 +21,8 @@ from .plan import (FaultEvent, FaultPlan, inject, fault_point, active_plan,
                    clear_active_plan, InjectedFault, InjectedConnectionError,
                    SimulatedWorkerDeath, InjectedResourceExhausted,
                    ENV_FAULT_PLAN, corrupt_file)
-from .retry import backoff_delays, retry_call, RetryExhausted
+from .retry import (backoff_delays, retry_call, RetryExhausted,
+                    RetryPolicy)
 from .watchdog import (CollectiveWatchdog, CollectiveTimeoutError,
                        enable_watchdog, disable_watchdog, get_watchdog,
                        ENV_WATCHDOG_TIMEOUT)
@@ -35,7 +36,7 @@ __all__ = [
     "clear_active_plan", "InjectedFault", "InjectedConnectionError",
     "SimulatedWorkerDeath", "InjectedResourceExhausted", "ENV_FAULT_PLAN",
     "corrupt_file",
-    "backoff_delays", "retry_call", "RetryExhausted",
+    "backoff_delays", "retry_call", "RetryExhausted", "RetryPolicy",
     "CollectiveWatchdog", "CollectiveTimeoutError", "enable_watchdog",
     "disable_watchdog", "get_watchdog", "ENV_WATCHDOG_TIMEOUT",
     "atomic_write", "file_sha256", "write_manifest", "validate_checkpoint",

@@ -17,6 +17,10 @@ Sites currently instrumented:
   grad.poison                  optimizer pre-step hook (NaN gradients)
   exec.oom                     executor/jit dispatch (memory/guard.py)
   worker.step                  user training loops / smoke scripts
+  serve.step_fail              serving step dispatch (serving/engine.py)
+  serve.step_hang              serving step completion (watchdog target)
+  serve.replica_down.<shard>   per-replica step (serving/dp.py)
+  serve.alloc_fail             KV block allocation (serving/kv_cache.py)
 
 Activation: ``with inject(plan): ...`` or the ``PADDLE_TPU_FAULT_PLAN``
 env var (JSON, or the compact ``site:action:k=v,...;site2:...`` form) so

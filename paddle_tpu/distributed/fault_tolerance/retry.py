@@ -1,10 +1,17 @@
-"""Retry/backoff primitives shared by the rendezvous + elastic layers.
+"""Retry/backoff primitives shared by the rendezvous, elastic, and
+serving layers.
 
 Exponential backoff with *deterministic* jitter: the jitter sequence
 comes from a seeded RNG so a replayed run (same seed) sleeps the same
 schedule — required for the FaultPlan replay contract.  The default
 seed derives from the rank so a thundering herd of restarting workers
 still decorrelates.
+
+:class:`RetryPolicy` packages one backoff schedule (max attempts,
+jittered exponential, injectable clock/sleep) as a reusable object so
+every consumer — ``retry_call``, the TCPStore connect loop, the serving
+fleet's replica-probation re-admission — shares ONE implementation
+instead of hand-rolling its own loop.
 """
 from __future__ import annotations
 
@@ -12,8 +19,8 @@ import os
 import random
 import time
 
-__all__ = ["backoff_delays", "retry_call", "RetryExhausted",
-           "ENV_STORE_RETRIES"]
+__all__ = ["backoff_delays", "retry_call", "RetryPolicy",
+           "RetryExhausted", "ENV_STORE_RETRIES"]
 
 ENV_STORE_RETRIES = "PADDLE_TPU_STORE_RETRIES"
 
@@ -44,36 +51,86 @@ def backoff_delays(base=0.05, factor=2.0, max_delay=2.0, jitter=0.25,
         d *= factor
 
 
+class RetryPolicy:
+    """A reusable retry/backoff schedule (module doc).
+
+    ``retries`` is the number of RE-tries (total attempts =
+    retries + 1); ``retries=None`` means unbounded attempts — the loop
+    is then capped only by the ``deadline`` passed to :meth:`call`.
+    ``clock``/``sleep`` are injectable so consumers that schedule
+    *future* re-admission times (the serving fleet's replica probation)
+    are deterministic under test.
+    """
+
+    __slots__ = ("retries", "base", "factor", "max_delay", "jitter",
+                 "seed", "clock", "sleep")
+
+    def __init__(self, retries=3, base=0.05, factor=2.0, max_delay=2.0,
+                 jitter=0.25, seed=None, clock=None, sleep=None):
+        self.retries = None if retries is None else int(retries)
+        self.base = float(base)
+        self.factor = float(factor)
+        self.max_delay = float(max_delay)
+        self.jitter = float(jitter)
+        self.seed = seed
+        self.clock = clock or time.monotonic
+        self.sleep = sleep or time.sleep
+
+    def delays(self):
+        """A FRESH deterministic delay generator (same seed → same
+        schedule, so a replayed run backs off identically)."""
+        return backoff_delays(self.base, self.factor, self.max_delay,
+                              self.jitter, self.seed)
+
+    def call(self, fn, exceptions=(OSError,), deadline=None,
+             on_retry=None, what="operation"):
+        """Call ``fn()`` under this policy.
+
+        ``deadline`` is an absolute ``self.clock()`` cutoff that caps
+        the whole loop; ``on_retry(attempt, exc)`` observes each
+        failure (diagnostics / test hooks).  Raises
+        :class:`RetryExhausted` (``.last`` holds the final exception)
+        when attempts or the deadline run out."""
+        delays = self.delays()
+        last = None
+        attempt = 0
+        while True:
+            try:
+                return fn()
+            except exceptions as e:
+                last = e
+                from ... import observability as obs
+                obs.instant("fault.retry", cat="fault", what=what,
+                            attempt=attempt,
+                            error=f"{type(e).__name__}: {e}"[:200])
+                if on_retry is not None:
+                    on_retry(attempt, e)
+                if self.retries is not None and attempt >= self.retries:
+                    break
+                delay = next(delays)
+                if deadline is not None:
+                    remaining = deadline - self.clock()
+                    if remaining <= 0:
+                        break
+                    delay = min(delay, remaining)
+                self.sleep(delay)
+                attempt += 1
+        n = "unbounded" if self.retries is None else self.retries + 1
+        raise RetryExhausted(
+            f"{what}: {n} attempts failed (last: {last})", last=last)
+
+    def __repr__(self):
+        return (f"RetryPolicy(retries={self.retries}, base={self.base}, "
+                f"factor={self.factor}, max_delay={self.max_delay}, "
+                f"jitter={self.jitter}, seed={self.seed})")
+
+
 def retry_call(fn, exceptions=(OSError,), retries=3, deadline=None,
                base=0.05, factor=2.0, max_delay=2.0, jitter=0.25,
                seed=None, on_retry=None, what="operation"):
-    """Call ``fn()`` with bounded retries and backoff.
-
-    ``retries`` is the number of RE-tries (total attempts = retries+1);
-    ``deadline`` is an absolute ``time.monotonic()`` cutoff that caps
-    the whole loop.  ``on_retry(attempt, exc)`` observes each failure
-    (diagnostics / test hooks)."""
-    delays = backoff_delays(base, factor, max_delay, jitter, seed)
-    last = None
-    for attempt in range(retries + 1):
-        try:
-            return fn()
-        except exceptions as e:
-            last = e
-            from ... import observability as obs
-            obs.instant("fault.retry", cat="fault", what=what,
-                        attempt=attempt,
-                        error=f"{type(e).__name__}: {e}"[:200])
-            if on_retry is not None:
-                on_retry(attempt, e)
-            if attempt >= retries:
-                break
-            delay = next(delays)
-            if deadline is not None:
-                remaining = deadline - time.monotonic()
-                if remaining <= 0:
-                    break
-                delay = min(delay, remaining)
-            time.sleep(delay)
-    raise RetryExhausted(
-        f"{what}: {retries + 1} attempts failed (last: {last})", last=last)
+    """Call ``fn()`` with bounded retries and backoff — the functional
+    shorthand over :class:`RetryPolicy` (see its docs for semantics)."""
+    return RetryPolicy(retries, base, factor, max_delay, jitter,
+                       seed).call(fn, exceptions=exceptions,
+                                  deadline=deadline, on_retry=on_retry,
+                                  what=what)

@@ -35,7 +35,8 @@ import threading
 import time
 
 from .fault_tolerance.plan import fault_point
-from .fault_tolerance.retry import backoff_delays, ENV_STORE_RETRIES
+from .fault_tolerance.retry import (backoff_delays, ENV_STORE_RETRIES,
+                                    RetryExhausted, RetryPolicy)
 
 __all__ = ["TCPStore"]
 
@@ -233,30 +234,33 @@ class TCPStore:
         """Connect with exponential backoff + jitter until ``timeout``:
         the master rank binding late (startup race) is expected, not
         fatal."""
-        deadline = time.monotonic() + self._timeout
-        delays = backoff_delays(base=0.05, factor=1.6, max_delay=1.0)
-        last = None
-        while True:
+
+        def attempt():
+            fault_point("store.connect")
             try:
-                fault_point("store.connect")
                 self._sock = socket.create_connection(
                     (self._host, self.port),
                     timeout=min(self._timeout, 5.0))
-                # per-op deadline: every later recv/send on this socket
-                # fails with TimeoutError instead of hanging forever
-                self._sock.settimeout(self._timeout)
-                self._sock.setsockopt(socket.IPPROTO_TCP,
-                                      socket.TCP_NODELAY, 1)
-                return
-            except OSError as e:
-                last = e
+            except OSError:
                 self._sock = None
-            delay = next(delays)
-            if time.monotonic() + delay >= deadline:
-                raise TimeoutError(
-                    f"TCPStore: cannot reach {self._host}:{self.port} "
-                    f"within {self._timeout}s (last error: {last})")
-            time.sleep(delay)
+                raise
+            # per-op deadline: every later recv/send on this socket
+            # fails with TimeoutError instead of hanging forever
+            self._sock.settimeout(self._timeout)
+            self._sock.setsockopt(socket.IPPROTO_TCP,
+                                  socket.TCP_NODELAY, 1)
+
+        policy = RetryPolicy(retries=None, base=0.05, factor=1.6,
+                             max_delay=1.0)
+        try:
+            policy.call(attempt, exceptions=(OSError,),
+                        deadline=time.monotonic() + self._timeout,
+                        what="store.connect")
+        except RetryExhausted as e:
+            raise TimeoutError(
+                f"TCPStore: cannot reach {self._host}:{self.port} "
+                f"within {self._timeout}s (last error: {e.last})") \
+                from e.last
 
     def _drop_sock(self):
         if self._sock is not None:

@@ -1,9 +1,12 @@
 """LLM serving: paged KV cache with COW prefix caching, chunked-prefill
 continuous batching, the unified ragged generation engine, speculative
-decoding, SLO-aware multi-tenant scheduling, and streaming delivery.
+decoding, SLO-aware multi-tenant scheduling, streaming delivery, and
+serving-tier fault tolerance (replica health/failover with
+deterministic replay, decode watchdog, load shedding).
 
 The multi-request generation layer over models/gpt.py — see
-README.md §"Serving".  Entry point: ``GenerationEngine``.
+README.md §"Serving" and §"Serving fault tolerance".  Entry point:
+``GenerationEngine`` (one replica) / ``DataParallelEngine`` (a fleet).
 """
 from .kv_cache import (ENV_KV_BLOCK_SIZE, ENV_PREFIX_CACHE,
                        RESIDENT_NAME, PagedKVCache, kv_block_size,
@@ -24,9 +27,13 @@ from .speculative import (ENV_SPEC_DRAFT, ENV_SPEC_K,
 from .slo import SLOPolicy, TenantSpec
 from .streaming import (ENV_STREAM_QUEUE, StreamEvent, TokenStream,
                         stream_queue_depth)
-from .engine import (GenerationEngine, ragged_sample_next,
+from .errors import (RequestRejected, ServingError, ServingStepTimeout,
+                     ServingUnavailable)
+from .engine import (ENV_SHED_DEPTH, ENV_STEP_DEADLINE_MS,
+                     GenerationEngine, ragged_sample_next,
                      serving_sample_next)
-from .dp import DataParallelEngine
+from .dp import (HEALTHY, PROBATION, UNHEALTHY, DataParallelEngine,
+                 ReplicaHealth)
 
 __all__ = [
     "ENV_KV_BLOCK_SIZE", "ENV_PREFIX_CACHE", "RESIDENT_NAME",
@@ -44,6 +51,10 @@ __all__ = [
     "SLOPolicy", "TenantSpec",
     "ENV_STREAM_QUEUE", "StreamEvent", "TokenStream",
     "stream_queue_depth",
+    "RequestRejected", "ServingError", "ServingStepTimeout",
+    "ServingUnavailable",
+    "ENV_SHED_DEPTH", "ENV_STEP_DEADLINE_MS",
     "GenerationEngine", "ragged_sample_next", "serving_sample_next",
-    "DataParallelEngine",
+    "DataParallelEngine", "ReplicaHealth",
+    "HEALTHY", "PROBATION", "UNHEALTHY",
 ]

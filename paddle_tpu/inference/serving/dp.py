@@ -3,15 +3,41 @@
 The first sharded-serving step (ISSUE 9): weights are **replicated** —
 every replica drives the same model object, so there is exactly one set
 of parameters in memory — while each replica owns a **private paged KV
-pool** and scheduler.  Requests dispatch to the least-loaded replica;
-decode batches on different replicas advance independently, so one
-replica draining a long prefill never stalls another's decode loop.
+pool** and scheduler.  Decode batches on different replicas advance
+independently, so one replica draining a long prefill never stalls
+another's decode loop.
+
+**Prefix-cache-aware routing** (ISSUE 12): a request routes to the
+replica whose paged pool already holds the longest cached prefix of its
+prompt (``PagedKVCache.prefix_match_tokens`` walks the same block chain
+hash the prefix index uses), falling back to least-loaded — with a
+load-skew guard so affinity never piles more than one full batch of
+extra work onto a warm replica.
+
+**Replica health + failover** (ISSUE 12): each replica carries a
+:class:`ReplicaHealth` state machine (HEALTHY → UNHEALTHY on step
+failure or watchdog deadline miss → PROBATION re-admission on a
+:class:`~...distributed.fault_tolerance.retry.RetryPolicy` backoff
+schedule).  When a replica's step raises, every in-flight request is
+harvested — committed progress is folded into the prompt by the
+scheduler's ``requeue`` — and **replayed** on a healthy replica.
+Because sampling is keyed by ``fold_in(seed, absolute_position)`` the
+replayed continuation is bit-identical to the uninterrupted run, and
+because the replay routes through prefix affinity the re-prefill hits
+whatever prefix the surviving replica already holds.  Streams migrate
+with their request; the stream layer dedups re-delivered positions, so
+consumers observe exactly-once delivery over at-least-once steps.
 
 Per-shard observability: each replica's work runs under
 ``obs.tag(shard="dp<i>")``, so every prefill/decode/dispatch span the
 inner engine emits lands on that replica's lane —
 ``phase_breakdown()["shards"]`` and ``pipeline_stats()["per_shard"]``
-then show per-replica skew directly.
+then show per-replica skew directly.  Fault handling adds
+``serving.failovers`` / ``serving.replays`` counters, a
+``serving.failover_recovery_ms`` histogram, per-replica
+``serving.replica_health.dp<i>`` gauges (1 healthy, 0.5 probation,
+0 unhealthy) and ``serving.failover`` / ``serving.replica_health``
+timeline instants.
 
 Sizing: when ``hbm_fraction`` is not given, the single-engine default
 is divided by the replica count so the combined pools claim no more
@@ -23,21 +49,103 @@ acceptable for the host-simulation scale this targets, and the
 """
 from __future__ import annotations
 
-from ... import observability as obs
-from .engine import GenerationEngine
+import time
 
-__all__ = ["DataParallelEngine"]
+from ... import observability as obs
+from ...distributed.fault_tolerance.plan import fault_point
+from ...distributed.fault_tolerance.retry import RetryPolicy
+from .engine import GenerationEngine
+from .errors import ServingUnavailable
+
+__all__ = ["DataParallelEngine", "ReplicaHealth",
+           "HEALTHY", "PROBATION", "UNHEALTHY"]
+
+HEALTHY = "healthy"
+PROBATION = "probation"
+UNHEALTHY = "unhealthy"
+
+_HEALTH_SCORE = {HEALTHY: 1.0, PROBATION: 0.5, UNHEALTHY: 0.0}
+
+
+class ReplicaHealth:
+    """Per-replica health state machine (module doc).
+
+    ``record_failure()`` on a HEALTHY replica counts consecutive
+    failures against ``fail_threshold``; crossing it (or ANY failure
+    while on PROBATION) demotes to UNHEALTHY and schedules the next
+    probe at ``clock() + next(policy.delays())`` — successive demotions
+    walk the policy's jittered-exponential schedule, so a flapping
+    replica is re-admitted more and more reluctantly.  ``eligible()``
+    promotes UNHEALTHY → PROBATION once the probe time arrives; a
+    successful step (``record_success``) restores HEALTHY and resets
+    the backoff.
+    """
+
+    __slots__ = ("name", "policy", "fail_threshold", "clock", "state",
+                 "consecutive", "failures", "next_probe_at", "_delays")
+
+    def __init__(self, name, policy=None, fail_threshold=1, clock=None):
+        self.name = name
+        self.policy = policy or RetryPolicy(retries=None, base=0.05,
+                                            factor=2.0, max_delay=5.0)
+        self.fail_threshold = max(1, int(fail_threshold))
+        self.clock = clock or self.policy.clock
+        self.state = HEALTHY
+        self.consecutive = 0
+        self.failures = 0
+        self.next_probe_at = 0.0
+        self._delays = None
+
+    def _transition(self, state):
+        if state != self.state:
+            self.state = state
+            obs.instant("serving.replica_health", cat="fault",
+                        replica=self.name, state=state)
+        obs.get_registry().gauge(
+            f"serving.replica_health.{self.name}").set(
+            _HEALTH_SCORE[state])
+
+    def eligible(self):
+        """May this replica take (or keep) work right now?"""
+        if self.state == UNHEALTHY and self.clock() >= self.next_probe_at:
+            self._transition(PROBATION)
+        return self.state != UNHEALTHY
+
+    def record_success(self):
+        self.consecutive = 0
+        self._delays = None
+        self._transition(HEALTHY)
+
+    def record_failure(self):
+        self.consecutive += 1
+        self.failures += 1
+        if (self.state == PROBATION
+                or self.consecutive >= self.fail_threshold):
+            if self._delays is None:
+                self._delays = self.policy.delays()
+            self.next_probe_at = self.clock() + next(self._delays)
+            self._transition(UNHEALTHY)
+
+    def snapshot(self):
+        return {"state": self.state, "failures": self.failures,
+                "consecutive": self.consecutive,
+                "next_probe_at": self.next_probe_at}
 
 
 class DataParallelEngine:
-    """Least-loaded data-parallel front over replica GenerationEngines.
+    """Prefix-affinity data-parallel front over replica engines with
+    health-checked failover (module doc).
 
     ``dp=None`` takes the replica count from the active
     :class:`~...distributed.auto_parallel.sharding.MeshPlan`'s ``dp``
     axis (``PADDLE_TPU_MESH=dp=4`` → 4 replicas) and falls back to 1.
+    ``fail_threshold`` consecutive step failures (or deadline misses)
+    mark a replica UNHEALTHY; ``probation_policy`` (a
+    :class:`RetryPolicy`) paces its re-admission probes.
     """
 
     def __init__(self, model, dp=None, hbm_fraction=None,
+                 fail_threshold=1, probation_policy=None, clock=None,
                  **engine_kwargs):
         if dp is None:
             from ...distributed.auto_parallel.sharding import \
@@ -49,13 +157,22 @@ class DataParallelEngine:
             raise ValueError(f"dp must be >= 1, got {dp}")
         if hbm_fraction is None:
             hbm_fraction = 0.3 / self.dp
+        self.clock = clock or time.monotonic
         self.engines = [
             GenerationEngine(model, hbm_fraction=hbm_fraction,
                              **engine_kwargs)
             for _ in range(self.dp)
         ]
+        self.health = [
+            ReplicaHealth(f"dp{i}", policy=probation_policy,
+                          fail_threshold=fail_threshold,
+                          clock=self.clock)
+            for i in range(self.dp)
+        ]
         self._owner = {}          # request_id -> shard index
         self._req_counter = 0
+        self._failovers = 0
+        self._replays = 0
 
     # -- dispatch ---------------------------------------------------------
     def _load(self, i):
@@ -63,14 +180,44 @@ class DataParallelEngine:
         return (eng.scheduler.queue_depth + len(eng.scheduler.running)
                 + len(eng._pending))
 
+    def _route(self, prompt, exclude=()):
+        """Pick the replica for ``prompt``: longest cached prefix wins
+        (warm KV makes its prefill nearly free), with a least-loaded
+        fallback and a skew guard — affinity may cost at most one extra
+        batch of queue depth over the least-loaded eligible replica."""
+        eligible = [i for i in range(self.dp)
+                    if i not in exclude and self.health[i].eligible()]
+        if not eligible:
+            raise ServingUnavailable(
+                "no healthy replica available (all "
+                f"{self.dp} are unhealthy and backing off)")
+        loads = {i: self._load(i) for i in eligible}
+        min_load = min(loads.values())
+        aff = {i: self.engines[i].cache.prefix_match_tokens(prompt)
+               for i in eligible}
+        best = max(eligible, key=lambda i: (aff[i], -loads[i], -i))
+        if (aff[best] > 0
+                and loads[best] - min_load
+                <= self.engines[best].max_batch):
+            return best, aff[best]
+        best = min(eligible, key=lambda i: (loads[i], i))
+        return best, aff[best]
+
     def add_request(self, prompt, request_id=None, **kwargs):
-        """Enqueue one prompt on the least-loaded replica."""
+        """Enqueue one prompt on the best replica (prefix affinity,
+        then load).  Raises the engine's structured
+        :class:`~.errors.RequestRejected` when the chosen replica is
+        shedding, and :class:`~.errors.ServingUnavailable` when no
+        replica is eligible."""
         if request_id is None:
             request_id = f"dpreq{self._req_counter}"
         self._req_counter += 1
-        shard = min(range(self.dp), key=self._load)
+        prompt_list = [int(t) for t in prompt]
+        shard, affinity = self._route(prompt_list)
+        if affinity > 0:
+            obs.get_registry().counter("serving.prefix_routed").inc()
         with obs.tag(shard=f"dp{shard}"):
-            self.engines[shard].add_request(prompt,
+            self.engines[shard].add_request(prompt_list,
                                             request_id=request_id,
                                             **kwargs)
         self._owner[request_id] = shard
@@ -81,15 +228,79 @@ class DataParallelEngine:
         return any(e.has_unfinished() for e in self.engines)
 
     def step(self):
-        """Advance every replica that has work one step.  Returns the
-        requests that finished this step, across all replicas."""
+        """Advance every eligible replica that has work one step; a
+        replica whose step raises fails over (its requests replay on a
+        healthy replica).  Returns the requests that finished this
+        step, across all replicas."""
         finished = []
         for i, eng in enumerate(self.engines):
             if not eng.has_unfinished():
                 continue
-            with obs.tag(shard=f"dp{i}"):
-                finished.extend(eng.step())
+            if not self.health[i].eligible():
+                continue          # backing off; its work waits or moved
+            try:
+                with obs.tag(shard=f"dp{i}"):
+                    fault_point(f"serve.replica_down.dp{i}")
+                    finished.extend(eng.step())
+                self.health[i].record_success()
+            except Exception as e:
+                self._failover(i, e)
         return finished
+
+    def _failover(self, replica, error):
+        """Harvest every request on a failed replica and replay it on a
+        healthy one.  The scheduler's ``requeue`` folds committed
+        progress into the prompt, so the replay (a) produces
+        bit-identical remaining tokens (position-keyed sampling) and
+        (b) re-prefills through the target's prefix cache.  Streams
+        migrate with their request; re-committed positions dedup in the
+        stream layer.  With no eligible target the requests park on the
+        failed replica (nothing is lost) and
+        :class:`ServingUnavailable` raises."""
+        t0 = self.clock()
+        self.health[replica].record_failure()
+        eng = self.engines[replica]
+        # a failed step's engine-level abort may already have requeued
+        # its batch; harvest whatever is still seated, then the queue
+        for req in list(eng.scheduler.running):
+            if req.row is not None:
+                eng._rows[req.row] = None
+            if eng.proposer is not None:
+                eng.proposer.drop(req.id)
+            eng.scheduler.requeue(req, req.generated)
+        eng._pending.clear()      # undrained device tokens: the replay
+        # regenerates them bit-identically, so dropping them is safe
+        moved = list(eng.scheduler.waiting)
+        eng.scheduler.waiting.clear()
+        try:
+            for req in moved:
+                target, affinity = self._route(req.prompt,
+                                               exclude=(replica,))
+                tgt = self.engines[target]
+                tgt.scheduler.submit(req)     # keeps t_submit: honest TTFT
+                self._owner[req.id] = target
+                st = eng._streams.pop(req.id, None)
+                if st is not None:
+                    tgt._streams[req.id] = st
+        except ServingUnavailable:
+            # park everything back; a later step() retries once some
+            # replica's probation window opens
+            for req in reversed(moved):
+                if self._owner.get(req.id) == replica:
+                    eng.scheduler.waiting.appendleft(req)
+            raise
+        recovery_ms = (self.clock() - t0) * 1e3
+        self._failovers += 1
+        self._replays += len(moved)
+        reg = obs.get_registry()
+        reg.counter("serving.failovers").inc()
+        reg.counter("serving.replays").inc(len(moved))
+        reg.histogram("serving.failover_recovery_ms").observe(
+            recovery_ms)
+        obs.instant("serving.failover", cat="fault",
+                    replica=f"dp{replica}", replayed=len(moved),
+                    recovery_ms=round(recovery_ms, 3),
+                    error=f"{type(error).__name__}: {error}"[:200])
 
     def generate(self, prompts, stream=False, **kwargs):
         """Run a batch of prompts to completion across the replicas.
@@ -133,17 +344,23 @@ class DataParallelEngine:
 
     # -- bookkeeping ------------------------------------------------------
     def stats(self):
-        """Aggregate totals plus a ``per_shard`` breakdown."""
+        """Aggregate totals plus ``per_shard`` and ``replica_health``
+        breakdowns."""
         per_shard = {}
         total = {"tokens_generated": 0, "tokens_drafted": 0,
                  "tokens_accepted": 0, "queue_depth": 0, "running": 0,
-                 "step_compiles": 0}
+                 "step_compiles": 0, "shed_requests": 0,
+                 "step_timeouts": 0, "alloc_fails": 0}
         for i, eng in enumerate(self.engines):
             s = eng.stats()
             per_shard[f"dp{i}"] = s
             for k in total:
                 total[k] += int(s.get(k, 0))
         total["dp"] = self.dp
+        total["failovers"] = self._failovers
+        total["replays"] = self._replays
+        total["replica_health"] = {h.name: h.snapshot()
+                                   for h in self.health}
         total["per_shard"] = per_shard
         return total
 

@@ -56,6 +56,7 @@ See README.md §"Serving" for usage and knobs.
 from __future__ import annotations
 
 import contextlib
+import os
 import time
 
 import jax
@@ -67,8 +68,10 @@ from ...core.dispatch import dispatch
 from ...core.tensor import Tensor
 from ...core.autograd import no_grad
 from ...core.pipeline import pipeline_depth
+from ...distributed.fault_tolerance.plan import fault_point
 from ...incubate.nn.functional import _nucleus_mask
 from ...ops.pallas_ragged import ragged_q_block
+from .errors import RequestRejected, ServingStepTimeout
 from .kv_cache import PagedKVCache
 from .attention import RaggedCacheView
 from .scheduler import (ContinuousBatchingScheduler, Request,
@@ -77,7 +80,13 @@ from .speculative import SpeculativeConfig
 from .streaming import TokenStream
 
 __all__ = ["GenerationEngine", "serving_sample_next",
-           "ragged_sample_next"]
+           "ragged_sample_next", "ENV_STEP_DEADLINE_MS",
+           "ENV_SHED_DEPTH"]
+
+#: per-step wall-clock deadline in ms (watchdog; unset/empty disables)
+ENV_STEP_DEADLINE_MS = "PADDLE_TPU_SERVE_STEP_DEADLINE_MS"
+#: admission load-shedding bound on queue depth (unset/0 disables)
+ENV_SHED_DEPTH = "PADDLE_TPU_SERVE_SHED_DEPTH"
 
 
 # ---------------------------------------------------------------------
@@ -192,7 +201,8 @@ class GenerationEngine:
     def __init__(self, model, config=None, max_batch=None,
                  block_size=None, num_blocks=None, max_model_len=None,
                  prefill_chunk=None, hbm_fraction=0.3,
-                 prefix_cache=None, speculative=None, slo=None):
+                 prefix_cache=None, speculative=None, slo=None,
+                 step_deadline_ms=None, shed_depth=None, clock=None):
         import paddle_tpu as paddle
         cfg = config or getattr(model, "config", None) \
             or model.gpt.config
@@ -245,6 +255,21 @@ class GenerationEngine:
         self._view = RaggedCacheView(self.cache, self.block_q)
         self._step_fn = paddle.jit.to_static(self._ragged_step)
 
+        # fault-tolerance knobs: a per-step wall-clock deadline (the
+        # decode watchdog) and an admission queue-depth bound (load
+        # shedding).  The clock is injectable so watchdog tests are
+        # deterministic (same pattern as slo.py).
+        self.clock = clock or time.perf_counter
+        if step_deadline_ms is None:
+            v = os.environ.get(ENV_STEP_DEADLINE_MS, "")
+            step_deadline_ms = float(v) if v else None
+        self.step_deadline_ms = (float(step_deadline_ms)
+                                 if step_deadline_ms else None)
+        if shed_depth is None:
+            v = os.environ.get(ENV_SHED_DEPTH, "")
+            shed_depth = int(v) if v else 0
+        self.shed_depth = int(shed_depth or 0)
+
         self._rows = [None] * self.max_batch
         self._last_tokens = jnp.zeros((self.max_batch,), jnp.int64)
         self._pending = []        # [(rows_reqs, device_tokens)]
@@ -257,6 +282,10 @@ class GenerationEngine:
         self._tokens_drafted = 0
         self._tokens_accepted = 0
         self._step_tenant_tokens = {}
+        self._step_timeouts = 0
+        self._step_aborts = 0
+        self._shed_requests = 0
+        self._alloc_fails = 0
 
     # -- the ONE traced step function -----------------------------------
     def _ragged_step(self, ids, seeds, do_sample, top_k, top_p,
@@ -282,6 +311,18 @@ class GenerationEngine:
                 f"{self.max_model_len}")
         max_new_tokens = min(int(max_new_tokens),
                              self.max_model_len - len(prompt))
+        depth = self.scheduler.queue_depth
+        if self.shed_depth and depth >= self.shed_depth:
+            # backpressure: overload degrades to a fast structured
+            # rejection (the 429 path) instead of a TTFT collapse
+            self._shed_requests += 1
+            obs.get_registry().counter("serving.shed_requests").inc()
+            obs.instant("serving.shed", cat="fault", queue_depth=depth,
+                        shed_depth=self.shed_depth)
+            raise RequestRejected(
+                "overloaded", queue_depth=depth,
+                shed_depth=self.shed_depth,
+                request_id=request_id or f"req{self._req_counter}")
         if request_id is None:
             request_id = f"req{self._req_counter}"
         self._req_counter += 1
@@ -304,10 +345,26 @@ class GenerationEngine:
         self._step_idx += 1
         self._step_finished = []
         self._step_tenant_tokens = {}
+        allow_admission = True
         while True:
-            action, payload = self.scheduler.next_action()
+            action, payload = self.scheduler.next_action(allow_admission)
             if action == "admit":
-                self._admit(payload)
+                try:
+                    self._admit(payload)
+                except Exception as e:
+                    # allocation failed (e.g. injected serve.alloc_fail):
+                    # allocate() raises before any pool mutation and
+                    # begin_prefill before any queue mutation, so the
+                    # request simply stays at the queue head and retries
+                    # NEXT step — admission closes for the rest of THIS
+                    # step so one fault cannot retry-loop it.
+                    allow_admission = False
+                    self._alloc_fails += 1
+                    obs.get_registry().counter(
+                        "serving.alloc_fails").inc()
+                    obs.instant("serving.alloc_fail", cat="fault",
+                                request=payload.id,
+                                error=f"{type(e).__name__}: {e}"[:200])
                 continue
             break
         if action == "step":
@@ -392,7 +449,11 @@ class GenerationEngine:
                                    / self._tokens_drafted
                                    if self._tokens_drafted else 0.0),
                  token_budget=self.token_budget,
-                 step_compiles=compiles)
+                 step_compiles=compiles,
+                 step_timeouts=self._step_timeouts,
+                 step_aborts=self._step_aborts,
+                 shed_requests=self._shed_requests,
+                 alloc_fails=self._alloc_fails)
         return s
 
     def close(self):
@@ -430,7 +491,7 @@ class GenerationEngine:
                 self._rollback_slots(appended)
                 return
             plan = payload
-        self._dispatch_step(chunk, decodes)
+        self._dispatch_step(chunk, decodes, appended)
 
     def _rollback_slots(self, appended):
         for rid, before in appended.items():
@@ -480,7 +541,70 @@ class GenerationEngine:
             self.proposer.drop(victim.id)
         self.scheduler.requeue(victim, victim.generated)
 
-    def _dispatch_step(self, chunk, decodes):
+    def _abort_step(self, chunk, decodes, appended, kind, error):
+        """Unwind a failed or hung step: retire everything already in
+        flight from EARLIER steps, roll every reserved-but-undispatched
+        slot back through the refcount-aware ``truncate()``, and requeue
+        the affected requests with their committed progress.  Because
+        sampling is keyed by (seed, absolute position), stepping again —
+        here or on another replica — replays them bit-identically; the
+        positions past the committed length were never prefix-indexed
+        (``commit_prefix`` only hashes fully-covered blocks), so the
+        garbage KV a half-run step may have written can never be shared.
+        Returns the requeued request ids."""
+        self._drain(0)                   # prior steps' tokens commit
+        self._collect_finished()
+        affected = []
+        reqs = list(decodes)
+        if chunk is not None and chunk.request not in reqs:
+            reqs.append(chunk.request)
+        for req in reqs:
+            if req.done or req not in self.scheduler.running:
+                continue
+            if req.id in appended and req.id in self.cache:
+                self.cache.truncate(req.id, appended[req.id])
+            if req.row is not None:
+                self._rows[req.row] = None
+            if self.proposer is not None:
+                self.proposer.drop(req.id)
+            self.scheduler.requeue(req, req.generated)
+            affected.append(req.id)
+        self._step_aborts += 1
+        obs.get_registry().counter("serving.step_aborts").inc()
+        obs.instant(f"serving.{kind}", cat="fault", step=self._step_idx,
+                    requests=len(affected),
+                    **({"error": f"{type(error).__name__}: {error}"
+                        [:200]} if error is not None else {}))
+        return affected
+
+    def _checked_dispatch(self, ids_t, args, chunk, decodes, appended):
+        """The ONE device dispatch, wrapped by the chaos sites and the
+        decode watchdog.  A raising step (injected ``serve.step_fail``
+        or a real error) aborts-and-requeues then re-raises; a step that
+        outlives ``step_deadline_ms`` (injected ``serve.step_hang``
+        stalls here) aborts-and-requeues then raises the structured
+        :class:`ServingStepTimeout`."""
+        t0 = self.clock()
+        try:
+            fault_point("serve.step_fail")
+            tok = self._step_fn(ids_t, *args)
+            fault_point("serve.step_hang")
+        except Exception as e:
+            self._abort_step(chunk, decodes, appended, "step_fail", e)
+            raise
+        elapsed_ms = (self.clock() - t0) * 1e3
+        if (self.step_deadline_ms is not None
+                and elapsed_ms > self.step_deadline_ms):
+            self._step_timeouts += 1
+            obs.get_registry().counter("serving.step_timeouts").inc()
+            affected = self._abort_step(chunk, decodes, appended,
+                                        "step_timeout", None)
+            raise ServingStepTimeout(self._step_idx, elapsed_ms,
+                                     self.step_deadline_ms,
+                                     requests=affected)
+        return tok
+
+    def _dispatch_step(self, chunk, decodes, appended):
         """Pack the chunk + decode rows into the flat ragged buffer and
         dispatch the ONE compiled step."""
         T, S, BQ = self.token_budget, self.max_batch, self.block_q
@@ -565,7 +689,8 @@ class GenerationEngine:
                     tokens=chunk.length,
                     **({"tenant": chunk.request.tenant}
                        if chunk.request.tenant else {})))
-            tok = self._step_fn(ids_t, *args)
+            tok = self._checked_dispatch(ids_t, args, chunk, decodes,
+                                         appended)
         self._last_tokens = tok._value
         for _, req in rows_reqs:
             req.n_scheduled += 1
@@ -710,7 +835,8 @@ class GenerationEngine:
                     tokens=chunk.length,
                     **({"tenant": chunk.request.tenant}
                        if chunk.request.tenant else {})))
-            tok = self._step_fn(ids_t, *args)
+            tok = self._checked_dispatch(ids_t, args, chunk, decodes,
+                                         appended)
         # the accept decision gates the next step's feed, so spec steps
         # drain host-synchronously (no _pending window)
         host = np.asarray(tok._value)
@@ -799,7 +925,10 @@ class GenerationEngine:
             req.done = True
         stream = self._streams.get(req.id)
         if stream is not None:
-            stream.put(token, len(req.generated) - 1,
+            # absolute completion index: stream_offset carries tokens a
+            # requeue (preemption or failover replay) folded into the
+            # prompt, so replayed commits dedup instead of re-delivering
+            stream.put(token, req.stream_offset + len(req.generated) - 1,
                        finished=req.done)
 
     def _drain(self, lag):

@@ -325,6 +325,10 @@ class PagedKVCache:
         returns False when the pool cannot hold it."""
         if seq_id in self._tables:
             raise KeyError(f"sequence {seq_id!r} already allocated")
+        # Chaos site: an injected allocation failure fires BEFORE any
+        # pool mutation, so a failed admission provably leaks nothing.
+        from ...distributed.fault_tolerance.plan import fault_point
+        fault_point("serve.alloc_fail")
         hits = self._prefix_hits(tokens, num_tokens)
         need = self.blocks_needed(num_tokens) - len(hits)
         hits_parked = sum(1 for b in hits if b in self._cached_free)
@@ -347,6 +351,18 @@ class PagedKVCache:
             self._lookup_tokens += int(num_tokens)
         self._update_gauges()
         return True
+
+    def prefix_match_tokens(self, tokens):
+        """How many leading tokens of ``tokens`` this pool could serve
+        from its prefix cache RIGHT NOW, without allocating anything.
+        Used by the data-parallel router to send a request (or a
+        failover replay) to the replica already holding its prefix."""
+        if tokens is None:
+            return 0
+        # num_tokens = len+1 lifts the "leave one to compute" cap so a
+        # full-prompt match counts every block.
+        hits = self._prefix_hits(tokens, len(tokens) + 1)
+        return len(hits) * self.block_size
 
     def cached_prefix_len(self, seq_id):
         """Prompt tokens served from the prefix cache at allocate()

@@ -133,7 +133,8 @@ class Request:
                  "top_p", "temperature", "seed", "eos_token_id",
                  "generated", "n_scheduled", "num_computed",
                  "cached_prefix", "row", "arrival", "done",
-                 "preemptions", "t_submit", "t_first_token", "tenant")
+                 "preemptions", "t_submit", "t_first_token", "tenant",
+                 "stream_offset")
 
     def __init__(self, id, prompt, max_new_tokens=16, do_sample=False,
                  top_k=0, top_p=1.0, temperature=1.0, seed=0,
@@ -158,6 +159,9 @@ class Request:
         self.preemptions = 0
         self.t_submit = None      # wall clock at submit (TTFT start)
         self.t_first_token = None  # wall clock at first drained token
+        self.stream_offset = 0    # completion tokens folded into the
+        # prompt by requeue(); stream indices stay absolute across
+        # preemption and failover replay (exactly-once delivery)
 
     @property
     def remaining(self):
@@ -209,7 +213,7 @@ class ContinuousBatchingScheduler:
         return len(self.waiting)
 
     # -- policy ---------------------------------------------------------
-    def next_action(self):
+    def next_action(self, allow_admission=True):
         """("admit", request) | ("step", (chunk, decodes)) |
         ("idle", None).
 
@@ -218,6 +222,9 @@ class ContinuousBatchingScheduler:
         prefilled sequences that still owe tokens.  Both ride in the
         same unified step.  Admission is surfaced as its own action so
         the engine allocates (prefix-aware) and immediately re-asks.
+        ``allow_admission=False`` skips the admission branch — the
+        engine uses it after an admission failed mid-step (e.g. an
+        injected allocation fault) so one step cannot retry-loop.
         """
         # admission waits while any running request is still computing
         # its prompt: only ONE chunk is scheduled per step (oldest
@@ -226,7 +233,7 @@ class ContinuousBatchingScheduler:
         # prefix is committed, turning would-be prefix hits into misses
         prefilling = any(r.prefilling and not r.done
                          for r in self.running)
-        if (self.waiting and not prefilling
+        if (allow_admission and self.waiting and not prefilling
                 and len(self.running) < self.max_batch):
             req = self.admission_policy.select_admission(
                 list(self.waiting), self.running)
@@ -341,6 +348,7 @@ class ContinuousBatchingScheduler:
         request.prompt = list(request.prompt) + list(tokens_so_far)
         request.max_new_tokens = (request.max_new_tokens
                                   - len(tokens_so_far))
+        request.stream_offset += len(tokens_so_far)
         request.generated = []
         request.n_scheduled = 0
         request.num_computed = 0

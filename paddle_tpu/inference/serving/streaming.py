@@ -16,6 +16,14 @@ plumbing is deliberately host-side and tiny:
   * ``close()`` enqueues a terminal event with ``finished=True`` so
     drains can distinguish "no tokens yet" from "request done".
 
+Delivery semantics under faults: engine *steps* are at-least-once —
+a failover or watchdog rollback replays committed progress on another
+replica, which re-commits the same (position, token) pairs — but the
+stream is exactly-once: events carry the absolute completion index and
+the stream drops any event whose index it has already accepted
+(``duplicates`` counts them).  Consumers therefore never see a token
+twice even when the step that produced it ran twice.
+
 Events carry the absolute completion index so consumers can detect the
 gap when events were dropped.
 """
@@ -23,6 +31,8 @@ from __future__ import annotations
 
 import os
 from collections import deque, namedtuple
+
+from ... import observability as obs
 
 __all__ = ["ENV_STREAM_QUEUE", "StreamEvent", "TokenStream",
            "stream_queue_depth"]
@@ -46,14 +56,17 @@ StreamEvent = namedtuple("StreamEvent",
 class TokenStream:
     """Bounded drop-oldest event queue for one request (module doc)."""
 
-    __slots__ = ("request_id", "maxlen", "dropped", "closed", "_q")
+    __slots__ = ("request_id", "maxlen", "dropped", "duplicates",
+                 "closed", "_q", "_next_index")
 
     def __init__(self, request_id, maxlen=None):
         self.request_id = request_id
         self.maxlen = maxlen or stream_queue_depth()
         self.dropped = 0       # events evicted by the bound
+        self.duplicates = 0    # replayed events suppressed by dedup
         self.closed = False
         self._q = deque()
+        self._next_index = 0   # next completion index not yet accepted
 
     def __len__(self):
         return len(self._q)
@@ -61,9 +74,25 @@ class TokenStream:
     def put(self, token, index, finished=False):
         if self.closed:
             return
+        # Exactly-once delivery: replay after failover re-commits
+        # already-delivered positions; drop them here.  A replayed
+        # finish still closes the stream, but only the terminal marker
+        # is delivered — never the duplicate token.
+        if 0 <= index < self._next_index:
+            self.duplicates += 1
+            if finished:
+                self._q.append(StreamEvent(self.request_id, None, -1,
+                                           True))
+                self.closed = True
+            return
+        if index >= self._next_index:
+            self._next_index = index + 1
         if len(self._q) >= self.maxlen:
             self._q.popleft()
             self.dropped += 1
+            obs.instant("stream.dropped", cat="serve",
+                        request_id=self.request_id,
+                        dropped_total=self.dropped)
         self._q.append(StreamEvent(self.request_id, token, index,
                                    finished))
         if finished:
@@ -79,6 +108,11 @@ class TokenStream:
         out = list(self._q)
         self._q.clear()
         return out
+
+    def stats(self):
+        return {"queued": len(self._q), "dropped": self.dropped,
+                "duplicates": self.duplicates, "closed": self.closed,
+                "next_index": self._next_index}
 
     @property
     def done(self):

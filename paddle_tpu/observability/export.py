@@ -194,7 +194,12 @@ def phase_breakdown(events=None):
     request's ``tenant`` attr and the engine emits one
     ``serving.tenant.tokens`` instant per step and tenant, so
     ``tenants[<name>]`` breaks prefill time, committed tokens, and SLO
-    violations down per tenant."""
+    violations down per tenant.
+
+    Serving-fault attribution: when any ``serving.failover`` /
+    ``serving.step_timeout`` / ``serving.shed`` instant fired, the
+    breakdown gains ``failover_count`` / ``failover_recovery_ms`` /
+    ``replays`` / ``step_timeout_count`` / ``shed_count``."""
     if events is None:
         events = get_timeline().events()
     out = {"compile_ms": 0.0, "dispatch_ms": 0.0, "collective_ms": 0.0,
@@ -208,6 +213,8 @@ def phase_breakdown(events=None):
     axis_keys = []
     shards = {}
     tenants = {}
+    faults = {"failover_count": 0, "failover_recovery_ms": 0.0,
+              "replays": 0, "step_timeout_count": 0, "shed_count": 0}
 
     def _shard_row(label):
         return shards.setdefault(label, {
@@ -230,6 +237,15 @@ def phase_breakdown(events=None):
                     int(attrs.get("n", 0) or 0)
             elif tenant and e.name == "serving.slo_violation":
                 _tenant_row(str(tenant))["violations"] += 1
+            elif e.name == "serving.failover":
+                faults["failover_count"] += 1
+                faults["replays"] += int(attrs.get("replayed", 0) or 0)
+                faults["failover_recovery_ms"] += \
+                    float(attrs.get("recovery_ms", 0) or 0)
+            elif e.name == "serving.step_timeout":
+                faults["step_timeout_count"] += 1
+            elif e.name == "serving.shed":
+                faults["shed_count"] += 1
             continue
         ms = e.dur * 1e3
         shard = attrs.get("shard")
@@ -316,6 +332,12 @@ def phase_breakdown(events=None):
         for row in tenants.values():
             row["prefill_ms"] = round(row["prefill_ms"], 3)
         out["tenants"] = {k: tenants[k] for k in sorted(tenants)}
+    # serving-fault keys ride along only when a fault actually fired
+    # (same conditional pattern as "mesh"/"shards"/"tenants")
+    if any(faults.values()):
+        faults["failover_recovery_ms"] = round(
+            faults["failover_recovery_ms"], 3)
+        out.update(faults)
     return out
 
 

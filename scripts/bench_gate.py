@@ -37,7 +37,7 @@ sys.path.insert(0, str(ROOT))
 
 GATE_SUFFIXES = ("_tokens_per_sec", "_imgs_per_sec", "_accept_rate")
 #: lower-is-better latency metrics: a RISE beyond the threshold fails
-LOW_SUFFIXES = ("_p99_ttft_ms",)
+LOW_SUFFIXES = ("_p99_ttft_ms", "_failover_recovery_ms", "_shed_rate")
 
 
 def log(msg):

@@ -1,0 +1,605 @@
+"""Serving-tier fault tolerance: replica failover with deterministic
+replay, the decode watchdog, load shedding, alloc-fault admission, the
+RetryPolicy extraction, and exactly-once stream delivery.
+
+Everything here leans on two invariants the serving stack already
+guarantees: sampling keyed by ``fold_in(seed, absolute_position)``
+makes any replay bit-identical, and ``commit_prefix`` only indexing
+fully-covered blocks makes half-run steps unshareable — so the chaos
+scenarios can demand exact token parity, not just "it recovered".
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import observability as obs
+from paddle_tpu.distributed.fault_tolerance import (FaultPlan, inject,
+                                                    RetryExhausted,
+                                                    RetryPolicy)
+from paddle_tpu.inference.serving import (DataParallelEngine,
+                                          GenerationEngine,
+                                          ReplicaHealth, RequestRejected,
+                                          ServingStepTimeout,
+                                          ServingUnavailable, TokenStream,
+                                          HEALTHY, PROBATION, UNHEALTHY)
+from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLM
+
+pytestmark = pytest.mark.faults
+
+VOCAB = 97
+
+
+@pytest.fixture(autouse=True)
+def _serving_env(monkeypatch):
+    for var in ("PADDLE_TPU_HBM_BUDGET", "PADDLE_TPU_MEMORY_GUARD",
+                "PADDLE_TPU_KV_BLOCK_SIZE", "PADDLE_TPU_MAX_BATCH",
+                "PADDLE_TPU_PIPELINE_DEPTH", "PADDLE_TPU_PREFIX_CACHE",
+                "PADDLE_TPU_PREFILL_CHUNK", "PADDLE_TPU_SPEC_K",
+                "PADDLE_TPU_SPEC_DRAFT", "PADDLE_TPU_STREAM_QUEUE",
+                "PADDLE_TPU_SERVE_STEP_DEADLINE_MS",
+                "PADDLE_TPU_SERVE_SHED_DEPTH", "PADDLE_TPU_FAULT_PLAN"):
+        monkeypatch.delenv(var, raising=False)
+    yield
+
+
+@pytest.fixture(scope="module")
+def gpt_mini():
+    cfg = GPTConfig(vocab_size=VOCAB, hidden_size=32,
+                    num_hidden_layers=2, num_attention_heads=4,
+                    max_position_embeddings=64)
+    paddle.seed(7)
+    model = GPTForCausalLM(cfg)
+    model.eval()
+    return model
+
+
+def _shared_prompts(n, seed=0, shared_len=16):
+    rng = np.random.RandomState(seed)
+    shared = list(rng.randint(1, VOCAB, size=shared_len))
+    return [shared + list(rng.randint(1, VOCAB, size=2 + i % 4))
+            for i in range(n)]
+
+
+def _dp(model, **kw):
+    kw.setdefault("dp", 2)
+    kw.setdefault("num_blocks", 128)
+    kw.setdefault("max_batch", 4)
+    kw.setdefault("block_size", 8)
+    kw.setdefault("max_model_len", 64)
+    return DataParallelEngine(model, **kw)
+
+
+class SimClock:
+    """Manually advanced monotonic clock for deterministic tests."""
+
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+
+# ---------------------------------------------------------------------
+# RetryPolicy (satellite: one backoff implementation everywhere)
+# ---------------------------------------------------------------------
+class TestRetryPolicy:
+    def test_delays_deterministic_and_fresh(self):
+        p = RetryPolicy(base=0.1, factor=2.0, max_delay=1.0, seed=5)
+        a = [next(g) for g in [p.delays()] for _ in range(4)]
+        b = [next(g) for g in [p.delays()] for _ in range(4)]
+        assert a == b          # same seed -> same schedule, per call
+        assert a[0] < a[-1]    # exponential growth
+
+    def test_call_counts_attempts_and_sleeps(self):
+        slept = []
+        p = RetryPolicy(retries=2, base=0.5, jitter=0.0,
+                        sleep=slept.append)
+        calls = []
+
+        def boom():
+            calls.append(1)
+            raise OSError("nope")
+
+        with pytest.raises(RetryExhausted) as ei:
+            p.call(boom, what="unit")
+        assert len(calls) == 3          # retries=2 -> 3 attempts
+        assert len(slept) == 2          # no sleep after the last
+        assert isinstance(ei.value.last, OSError)
+
+    def test_unbounded_retries_stop_at_deadline(self):
+        clock = SimClock()
+        slept = []
+
+        def sleep(d):
+            slept.append(d)
+            clock.t += d
+
+        p = RetryPolicy(retries=None, base=1.0, factor=1.0,
+                        jitter=0.0, clock=clock, sleep=sleep)
+        with pytest.raises(RetryExhausted):
+            p.call(lambda: (_ for _ in ()).throw(OSError("x")),
+                   deadline=3.5, what="unit")
+        assert clock.t <= 3.5 + 1.0     # last delay capped to remaining
+        assert len(slept) >= 3
+
+    def test_uncaught_exceptions_pass_through(self):
+        p = RetryPolicy(retries=5)
+        with pytest.raises(ValueError):
+            p.call(lambda: (_ for _ in ()).throw(ValueError("v")),
+                   exceptions=(OSError,))
+
+
+# ---------------------------------------------------------------------
+# ReplicaHealth (tentpole a: probation with backoff re-admission)
+# ---------------------------------------------------------------------
+class TestReplicaHealth:
+    def _health(self, clock, threshold=2):
+        policy = RetryPolicy(retries=None, base=1.0, factor=2.0,
+                             max_delay=100.0, jitter=0.0, clock=clock)
+        return ReplicaHealth("dp0", policy=policy,
+                             fail_threshold=threshold, clock=clock)
+
+    def test_threshold_then_backoff_readmission(self):
+        clock = SimClock()
+        h = self._health(clock)
+        h.record_failure()
+        assert h.state == HEALTHY and h.eligible()
+        h.record_failure()              # crosses fail_threshold=2
+        assert h.state == UNHEALTHY and not h.eligible()
+        assert h.next_probe_at == pytest.approx(1.0)
+        clock.t = 1.5
+        assert h.eligible()             # probe window open
+        assert h.state == PROBATION
+        h.record_failure()              # ANY probation failure demotes
+        assert h.state == UNHEALTHY
+        assert h.next_probe_at == pytest.approx(1.5 + 2.0)  # backoff x2
+        clock.t = 4.0
+        assert h.eligible()
+        h.record_success()
+        assert h.state == HEALTHY and h.consecutive == 0
+        # success reset the schedule: the next demotion backs off from
+        # the base delay again
+        h.record_failure()
+        h.record_failure()
+        assert h.next_probe_at == pytest.approx(4.0 + 1.0)
+
+    def test_snapshot_fields(self):
+        h = self._health(SimClock())
+        h.record_failure()
+        snap = h.snapshot()
+        assert snap["state"] == HEALTHY
+        assert snap["failures"] == 1 and snap["consecutive"] == 1
+
+
+# ---------------------------------------------------------------------
+# failover bit-parity (tentpole a + acceptance criterion)
+# ---------------------------------------------------------------------
+class TestFailover:
+    @pytest.mark.parametrize("sample_kwargs", [
+        {},                                                  # greedy
+        {"do_sample": True, "seed": 11, "top_k": 20,
+         "temperature": 0.8},                                # seeded
+    ], ids=["greedy", "seeded"])
+    def test_replica_kill_bit_parity(self, gpt_mini, sample_kwargs):
+        """Killing 1 of 2 replicas mid-burst completes every request
+        bit-identical to the no-fault run, with replays recorded and
+        the replayed prefills hitting the survivor's prefix cache."""
+        prompts = _shared_prompts(6, seed=3)
+        ref = _dp(gpt_mini)
+        try:
+            want = ref.generate(prompts, max_new_tokens=8,
+                                **sample_kwargs)
+        finally:
+            ref.close()
+        plan = FaultPlan.parse(
+            "serve.replica_down.dp0:kill:after=2,count=1")
+        dp = _dp(gpt_mini)
+        try:
+            with inject(plan):
+                got = dp.generate(prompts, max_new_tokens=8,
+                                  **sample_kwargs)
+            s = dp.stats()
+        finally:
+            dp.close()
+        assert got == want
+        assert s["failovers"] == 1
+        assert s["replays"] > 0
+        assert s["per_shard"]["dp1"]["prefix_hit_rate"] > 0
+        assert s["replica_health"]["dp0"]["state"] != HEALTHY
+        assert s["replica_health"]["dp1"]["state"] == HEALTHY
+
+    def test_step_fail_failover_bit_parity(self, gpt_mini):
+        """An engine-level step failure (injected at the dispatch fault
+        site) aborts/rolls back inside the engine, then the DP front
+        fails the replica over — still bit-identical."""
+        prompts = _shared_prompts(6, seed=4)
+        ref = _dp(gpt_mini)
+        try:
+            want = ref.generate(prompts, max_new_tokens=6)
+        finally:
+            ref.close()
+        plan = FaultPlan.parse("serve.step_fail:drop:after=1,count=1")
+        dp = _dp(gpt_mini)
+        try:
+            with inject(plan):
+                got = dp.generate(prompts, max_new_tokens=6)
+            s = dp.stats()
+        finally:
+            dp.close()
+        assert got == want
+        assert s["failovers"] == 1
+        assert s["step_timeouts"] == 0
+
+    def test_streams_survive_failover_exactly_once(self, gpt_mini):
+        """Streams migrate with their requests; every consumer sees
+        each completion index exactly once, in order, despite the
+        at-least-once replay underneath."""
+        prompts = _shared_prompts(4, seed=5)
+        plan = FaultPlan.parse(
+            "serve.replica_down.dp0:kill:after=2,count=1")
+        dp = _dp(gpt_mini)
+        try:
+            with inject(plan):
+                events = list(dp.generate(prompts, stream=True,
+                                          max_new_tokens=6))
+            assert dp.stats()["failovers"] == 1
+        finally:
+            dp.close()
+        per_req = {}
+        for ev in events:
+            if ev.index >= 0:
+                per_req.setdefault(ev.request_id, []).append(ev.index)
+        assert len(per_req) == len(prompts)
+        for rid, idxs in per_req.items():
+            assert idxs == list(range(6)), (
+                f"{rid}: indices {idxs} not exactly-once/in-order")
+
+    def test_no_eligible_target_parks_and_raises(self, gpt_mini):
+        """dp=1: a failing replica has nowhere to fail over — requests
+        park (nothing lost) and ServingUnavailable surfaces."""
+        clock = SimClock()
+        dp = _dp(gpt_mini, dp=1, clock=clock)
+        try:
+            dp.add_request(list(range(1, 9)), max_new_tokens=4)
+            plan = FaultPlan.parse(
+                "serve.replica_down.dp0:kill:after=0,count=1")
+            with inject(plan):
+                with pytest.raises(ServingUnavailable):
+                    dp.step()
+            assert dp.engines[0].scheduler.queue_depth == 1
+            # probation re-opens the replica and the request completes
+            clock.t = 100.0
+            while dp.has_unfinished():
+                dp.step()
+            assert dp.stats()["replica_health"]["dp0"]["state"] == HEALTHY
+        finally:
+            dp.close()
+
+
+# ---------------------------------------------------------------------
+# prefix-cache-aware routing (tentpole a)
+# ---------------------------------------------------------------------
+class TestPrefixRouting:
+    def test_warm_replica_wins_over_index_order(self, gpt_mini):
+        """A request whose prefix is cached on dp1 routes there, even
+        though least-loaded tie-breaking would pick dp0."""
+        rng = np.random.RandomState(9)
+        warm = list(rng.randint(1, VOCAB, size=24))  # 3 full blocks
+        dp = _dp(gpt_mini)
+        try:
+            # warm dp1 directly (bypassing the router on purpose)
+            dp.engines[1].add_request(warm, request_id="warmup",
+                                      max_new_tokens=2)
+            dp._owner["warmup"] = 1
+            while dp.has_unfinished():
+                dp.step()
+            rid = dp.add_request(warm + [3, 4], max_new_tokens=2)
+            assert dp._owner[rid] == 1
+            cold = list(rng.randint(1, VOCAB, size=10))
+            rid2 = dp.add_request(cold, max_new_tokens=2)
+            assert dp._owner[rid2] == 0   # least-loaded tie -> dp0
+        finally:
+            dp.close()
+
+    def test_skew_guard_overrides_affinity(self, gpt_mini):
+        """Affinity yields to least-loaded once the warm replica is
+        more than one full batch deeper than the coldest."""
+        rng = np.random.RandomState(10)
+        warm = list(rng.randint(1, VOCAB, size=24))
+        dp = _dp(gpt_mini, max_batch=2)
+        try:
+            dp.engines[1].add_request(warm, request_id="warmup",
+                                      max_new_tokens=2)
+            dp._owner["warmup"] = 1
+            while dp.has_unfinished():
+                dp.step()
+            # pile queue depth onto dp1 only (> max_batch deeper)
+            for k in range(4):
+                dp.engines[1].add_request(
+                    list(rng.randint(1, VOCAB, size=6)),
+                    request_id=f"pile{k}", max_new_tokens=2)
+                dp._owner[f"pile{k}"] = 1
+            rid = dp.add_request(warm + [5], max_new_tokens=2)
+            assert dp._owner[rid] == 0
+            while dp.has_unfinished():
+                dp.step()
+        finally:
+            dp.close()
+
+    def test_unhealthy_replica_excluded_from_routing(self, gpt_mini):
+        clock = SimClock()
+        dp = _dp(gpt_mini, clock=clock)
+        try:
+            dp.health[0].record_failure()    # threshold 1 -> unhealthy
+            assert dp.health[0].state == UNHEALTHY
+            rid = dp.add_request(list(range(1, 9)), max_new_tokens=2)
+            assert dp._owner[rid] == 1
+        finally:
+            dp.close()
+
+
+# ---------------------------------------------------------------------
+# decode watchdog (tentpole b)
+# ---------------------------------------------------------------------
+class TestWatchdog:
+    def test_hang_timeout_requeues_with_prefix_credit(self, gpt_mini):
+        """A hung step trips the deadline, rolls back through the
+        refcount-aware truncate/requeue, the requeued request re-admits
+        THROUGH the prefix cache, and the finish is bit-identical."""
+        prompts = _shared_prompts(3, seed=6, shared_len=24)
+        ref = GenerationEngine(gpt_mini, num_blocks=128, max_batch=4,
+                               block_size=8, max_model_len=64)
+        try:
+            want = ref.generate(prompts, max_new_tokens=6)
+        finally:
+            ref.close()
+        clock = SimClock()
+        eng = GenerationEngine(gpt_mini, num_blocks=128, max_batch=4,
+                               block_size=8, max_model_len=64,
+                               step_deadline_ms=1000.0, clock=clock)
+        orig = eng._step_fn
+        calls = {"n": 0}
+
+        def step_fn(*a, **kw):
+            calls["n"] += 1
+            if calls["n"] == 3:
+                clock.t += 5.0       # a 5s hang on the third dispatch
+            return orig(*a, **kw)
+
+        eng._step_fn = step_fn
+        try:
+            ids = [eng.add_request(p, max_new_tokens=6) for p in prompts]
+            hits_before = eng.cache._hit_tokens
+            timeouts = []
+            while eng.has_unfinished():
+                try:
+                    eng.step()
+                except ServingStepTimeout as e:
+                    timeouts.append(e)
+            got = [eng.result(i) for i in ids]
+            eng._step_fn = orig
+            s = eng.stats()
+        finally:
+            eng.close()
+        assert len(timeouts) == 1
+        e = timeouts[0]
+        assert e.elapsed_ms > e.deadline_ms == 1000.0
+        assert e.requests, "timeout rolled back no requests"
+        assert got == want
+        assert s["step_timeouts"] == 1
+        assert s["blocks_in_use"] == 0
+        # the rolled-back request re-prefilled through the prefix cache
+        assert eng.cache._hit_tokens > hits_before
+
+    def test_env_knob_sets_deadline(self, gpt_mini, monkeypatch):
+        monkeypatch.setenv("PADDLE_TPU_SERVE_STEP_DEADLINE_MS", "123.5")
+        eng = GenerationEngine(gpt_mini, num_blocks=32, max_batch=2)
+        try:
+            assert eng.step_deadline_ms == 123.5
+        finally:
+            eng.close()
+
+
+# ---------------------------------------------------------------------
+# load shedding (tentpole b)
+# ---------------------------------------------------------------------
+class TestShedding:
+    def test_overload_returns_structured_rejections(self, gpt_mini):
+        eng = GenerationEngine(gpt_mini, num_blocks=64, max_batch=2,
+                               shed_depth=2)
+        try:
+            admitted, rejected = [], []
+            for k in range(8):
+                try:
+                    admitted.append(eng.add_request(
+                        list(range(1, 7)), max_new_tokens=2))
+                except RequestRejected as e:
+                    rejected.append(e)
+            assert rejected, "flood never hit the shed bound"
+            r = rejected[0].to_response()
+            assert r["code"] == 429
+            assert r["reason"] == "overloaded"
+            assert r["queue_depth"] >= r["shed_depth"] == 2
+            assert r["request_id"]
+            while eng.has_unfinished():
+                eng.step()
+            for rid in admitted:
+                assert len(eng.result(rid)) > 0
+            assert eng.stats()["shed_requests"] == len(rejected)
+        finally:
+            eng.close()
+
+    def test_env_knob_sets_depth(self, gpt_mini, monkeypatch):
+        monkeypatch.setenv("PADDLE_TPU_SERVE_SHED_DEPTH", "5")
+        eng = GenerationEngine(gpt_mini, num_blocks=32, max_batch=2)
+        try:
+            assert eng.shed_depth == 5
+        finally:
+            eng.close()
+
+
+# ---------------------------------------------------------------------
+# admission alloc faults (tentpole c: serve.alloc_fail site)
+# ---------------------------------------------------------------------
+class TestAllocFault:
+    def test_alloc_fail_leaks_nothing_and_retries(self, gpt_mini):
+        eng = GenerationEngine(gpt_mini, num_blocks=128, max_batch=4,
+                               block_size=8, max_model_len=64)
+        try:
+            base = eng.cache.stats()
+            prompts = _shared_prompts(4, seed=8)
+            plan = FaultPlan.parse(
+                "serve.alloc_fail:oom:after=0,count=2")
+            ids = [eng.add_request(p, max_new_tokens=4)
+                   for p in prompts]
+            with inject(plan):
+                while eng.has_unfinished():
+                    eng.step()
+            got = [eng.result(i) for i in ids]
+            s = eng.cache.stats()
+            assert eng.stats()["alloc_fails"] == 2
+            assert all(len(g) > 0 for g in got)
+            assert s["physical_blocks"] == base["physical_blocks"]
+            assert s["blocks_in_use"] == base["blocks_in_use"]
+        finally:
+            eng.close()
+
+    def test_alloc_fault_then_parity(self, gpt_mini):
+        """Admission faults only delay requests; the tokens are still
+        bit-identical to the fault-free run."""
+        prompts = _shared_prompts(4, seed=12)
+        ref = GenerationEngine(gpt_mini, num_blocks=128, max_batch=4,
+                               block_size=8, max_model_len=64)
+        try:
+            want = ref.generate(prompts, max_new_tokens=4)
+        finally:
+            ref.close()
+        eng = GenerationEngine(gpt_mini, num_blocks=128, max_batch=4,
+                               block_size=8, max_model_len=64)
+        try:
+            ids = [eng.add_request(p, max_new_tokens=4)
+                   for p in prompts]
+            with inject(FaultPlan.parse(
+                    "serve.alloc_fail:oom:after=1,count=1")):
+                while eng.has_unfinished():
+                    eng.step()
+            got = [eng.result(i) for i in ids]
+        finally:
+            eng.close()
+        assert got == want
+
+
+# ---------------------------------------------------------------------
+# streaming satellites: drop accounting + exactly-once dedup
+# ---------------------------------------------------------------------
+class TestTokenStreamFaults:
+    def test_drop_oldest_counted_in_stats(self):
+        st = TokenStream("r", maxlen=2)
+        for i in range(4):
+            st.put(100 + i, i)
+        assert st.dropped == 2
+        s = st.stats()
+        assert s["dropped"] == 2 and s["queued"] == 2
+        assert [e.index for e in st.drain()] == [2, 3]
+
+    def test_replayed_positions_dedup(self):
+        st = TokenStream("r")
+        st.put(5, 0)
+        st.put(6, 1)
+        # failover replay re-delivers the same absolute positions
+        st.put(5, 0)
+        st.put(6, 1)
+        st.put(7, 2)
+        assert st.duplicates == 2
+        assert [(e.token, e.index) for e in st.drain()] == \
+            [(5, 0), (6, 1), (7, 2)]
+        assert st.stats()["duplicates"] == 2
+
+    def test_replayed_finish_closes_with_terminal_only(self):
+        st = TokenStream("r")
+        st.put(5, 0)
+        st.put(6, 1, finished=True)
+        st.drain()
+        st2 = TokenStream("r")
+        st2.put(5, 0)
+        st2.put(6, 1, finished=True)
+        st2.drain()
+        # replay of the finishing commit on a still-open stream
+        st3 = TokenStream("r")
+        st3.put(5, 0)
+        st3.put(6, 1)
+        st3.drain()
+        st3.put(6, 1, finished=True)
+        evs = st3.drain()
+        assert st3.closed and st3.duplicates == 1
+        assert len(evs) == 1
+        assert evs[0].token is None and evs[0].finished
+
+
+# ---------------------------------------------------------------------
+# observability (tentpole d)
+# ---------------------------------------------------------------------
+class TestFaultObservability:
+    @pytest.fixture(autouse=True)
+    def _obs_on(self):
+        obs.enable()
+        obs.get_timeline().clear()
+        yield
+        obs.get_timeline().clear()
+        obs.disable()
+
+    def test_phase_breakdown_surfaces_fault_keys(self, gpt_mini):
+        prompts = _shared_prompts(4, seed=13)
+        plan = FaultPlan.parse(
+            "serve.replica_down.dp0:kill:after=2,count=1")
+        dp = _dp(gpt_mini)
+        try:
+            with inject(plan):
+                dp.generate(prompts, max_new_tokens=4)
+        finally:
+            dp.close()
+        from paddle_tpu.observability.export import phase_breakdown
+        pb = phase_breakdown()
+        assert pb.get("failover_count", 0) >= 1
+        assert pb.get("replays", 0) > 0
+        assert pb.get("failover_recovery_ms", -1.0) >= 0.0
+        hist = obs.get_registry().histogram(
+            "serving.failover_recovery_ms")
+        assert hist.snapshot()["count"] >= 1
+
+    def test_breakdown_has_no_fault_keys_without_faults(self, gpt_mini):
+        eng = GenerationEngine(gpt_mini, num_blocks=64, max_batch=2)
+        try:
+            eng.generate([[1, 2, 3]], max_new_tokens=2)
+        finally:
+            eng.close()
+        from paddle_tpu.observability.export import phase_breakdown
+        pb = phase_breakdown()
+        assert "failover_count" not in pb
+        assert "shed_count" not in pb
+
+
+# ---------------------------------------------------------------------
+# CI gate: the chaos smoke runs green inside tier-1
+# ---------------------------------------------------------------------
+def _load_chaos_smoke():
+    import importlib.util
+    import os
+    path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "scripts", "chaos_smoke.py")
+    spec = importlib.util.spec_from_file_location("chaos_smoke_cli", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+class TestChaosSmokeGate:
+    def test_all_scenarios_pass(self, capsys):
+        smoke = _load_chaos_smoke()
+        ok, report = smoke.run(seed=7, requests=4)
+        capsys.readouterr()
+        assert ok, report
+        # the acceptance evidence is recorded, not just "it passed"
+        assert report["kill_greedy"]["replays"] > 0
+        assert report["kill_seeded"]["replays"] > 0
+        assert report["kill_greedy"]["survivor_prefix_hit_rate"] > 0
