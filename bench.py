@@ -672,7 +672,7 @@ def bench_gpt_decode(on_tpu):
                            max_model_len=cfg.max_position_embeddings)
     try:
         t = time.time()
-        eng.generate(prompts, max_new_tokens=max_new)  # compiles
+        ref_out = eng.generate(prompts, max_new_tokens=max_new)  # compiles
         log(f"gpt_decode: compile+first burst {time.time() - t:.1f}s "
             f"({eng.stats()['step_compiles']} unified step program(s))")
         obs.get_timeline().clear()
@@ -714,8 +714,47 @@ def bench_gpt_decode(on_tpu):
                "max_batch": max_batch,
                "kv_high_water": s["high_water"],
                "kv_blocks": s["num_blocks"]}
+        float_bytes_per_block = eng.cache.bytes_per_block
     finally:
         eng.close()
+
+    # int8 phase: weights AND paged KV quantized end-to-end (dequant
+    # fused in the matmul epilogue, per-slot scales in the ragged
+    # kernel); reports decode throughput, the block-capacity ratio at
+    # a fixed byte budget, and greedy parity vs the float burst above
+    # (bench_gate refuses captures whose greedy match drops)
+    from paddle_tpu.quantization import greedy_match_ratio
+    paddle.seed(0)
+    model_q = GPTForCausalLM(cfg)
+    model_q.eval()
+    q_eng = GenerationEngine(model_q, max_batch=max_batch,
+                             max_model_len=cfg.max_position_embeddings,
+                             kv_cache_dtype="int8", weight_dtype="int8")
+    try:
+        t = time.time()
+        got = q_eng.generate(prompts, max_new_tokens=max_new)  # compiles
+        log(f"gpt_decode[int8]: compile+first burst "
+            f"{time.time() - t:.1f}s "
+            f"({q_eng.stats()['step_compiles']} program(s))")
+        t = time.time()
+        ids = [q_eng.add_request(p, max_new_tokens=max_new)
+               for p in prompts]
+        while q_eng.has_unfinished():
+            q_eng.step()
+        qdt = time.time() - t
+        int8_tps = n_req * max_new / qdt
+        match = greedy_match_ratio(ref_out, got)
+        blocks_ratio = (float_bytes_per_block
+                        / q_eng.cache.bytes_per_block)
+        log(f"gpt_decode[int8]: {n_req} reqs x {max_new} tok in "
+            f"{qdt:.2f}s {int8_tps:,.0f} tok/s, greedy match "
+            f"{match:.1%} vs float, {blocks_ratio:.2f}x blocks per "
+            f"byte budget")
+        out["int8_tokens_per_sec"] = round(int8_tps, 1)
+        out["int8_greedy_match"] = round(match, 4)
+        out["int8_kv_blocks_ratio"] = round(blocks_ratio, 4)
+    finally:
+        q_eng.close()
 
     # speculative phase: the target drafts for itself (greedy ->
     # every draft accepted), so this isolates the verify-step overhead
@@ -1391,6 +1430,14 @@ def main():
                 res["prefix_hit_rate"]
             payload["extra_metrics"]["gpt_decode_kv_high_water"] = \
                 res["kv_high_water"]
+            if "int8_tokens_per_sec" in res:
+                payload["extra_metrics"][
+                    "gpt_decode_int8_tokens_per_sec"] = \
+                    res["int8_tokens_per_sec"]
+                payload["extra_metrics"]["gpt_int8_greedy_match"] = \
+                    res["int8_greedy_match"]
+                payload["extra_metrics"]["gpt_int8_kv_blocks_ratio"] = \
+                    res["int8_kv_blocks_ratio"]
             if "spec_tokens_per_sec" in res:
                 payload["extra_metrics"]["gpt_spec_tokens_per_sec"] = \
                     res["spec_tokens_per_sec"]

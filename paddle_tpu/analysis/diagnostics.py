@@ -69,6 +69,12 @@ CODES = {
                "software", WARNING),
     "TPU403": ("collective payload dtype/shape mismatch (or a software-"
                "emulated wide dtype) on the wire", WARNING),
+    "TPU404": ("per-channel int8 scale overflow: a quantization scale is "
+               "nonfinite, zero, or collapses the channel to a constant",
+               WARNING),
+    "TPU405": ("int8 matmul lowered onto a plan whose tiles are not "
+               "(32, 128)-legal: the int8 operand forces a relayout",
+               WARNING),
     # -- SPMD sharding (TPU5xx) ----------------------------------------
     "TPU501": ("parameter matched by no partition rule: silently "
                "replicated on every device of the mesh", WARNING),

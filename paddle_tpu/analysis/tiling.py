@@ -193,18 +193,49 @@ def audit_layer_norm_residual(rows, hidden, dtype="float32",
     return report
 
 
-def audit_matmul_epilogue(m, k, n, dtype="float32", direction="fwd"):
+def audit_matmul_epilogue(m, k, n, dtype="float32", direction="fwd",
+                          weight_dtype=None):
     """Statically validate the matmul-epilogue fusion block plan
-    (see ``ops.pallas_fused.matmul_epilogue_block_plan``)."""
+    (see ``ops.pallas_fused.matmul_epilogue_block_plan``).
+
+    ``weight_dtype="int8"`` audits the dequant-fused int8-weight
+    variant; tile violations on the int8 operand additionally raise
+    TPU405 (int8 needs (32,128)-legal tiles)."""
     from ..ops.pallas_fused import matmul_epilogue_block_plan
     plan = matmul_epilogue_block_plan(m, k, n, dtype=dtype,
-                                      direction=direction)
+                                      direction=direction,
+                                      weight_dtype=weight_dtype)
+    wtag = ""
+    if weight_dtype is not None:
+        wtag = f" w={np.dtype(weight_dtype).name}"
+    site = (f"matmul_epilogue.{direction}"
+            f"[{np.dtype(dtype).name}{wtag} m={m} k={k} n={n}]")
     report = check_pallas_call(
-        plan["operands"], scratch=plan.get("scratch", ()),
-        site=f"matmul_epilogue.{direction}"
-             f"[{np.dtype(dtype).name} m={m} k={k} n={n}]")
+        plan["operands"], scratch=plan.get("scratch", ()), site=site)
+    _flag_int8_relayout(report, plan, site=site)
     report.plan = plan
     return report
+
+
+def _flag_int8_relayout(report, plan, *, site):
+    """Append TPU405 when an int8 operand in ``plan`` has a tile
+    violation (TPU101/TPU102): int8 demands (32,128)-legal tiles, and
+    an illegal block forces Mosaic to relayout the narrow operand."""
+    int8_ops = {name for name, block, shape, dtype in plan["operands"]
+                if np.dtype(dtype).itemsize == 1}
+    if not int8_ops:
+        return
+    hit = any(d.code in ("TPU101", "TPU102") and
+              any(f"[{op}]" in (d.site or "") for op in int8_ops)
+              for d in report)
+    if hit:
+        report.add(Diagnostic(
+            "TPU405",
+            "int8 operand tiled below the (32,128) minimum: Mosaic "
+            "relayouts the quantized tensor before the MXU",
+            site=site,
+            hint="round the sublane block dim up to 32 (int8 itemsize "
+                 "1 => 32-row minimum tile)"))
 
 
 def audit_paged_attention(num_heads, head_dim, block_size, num_blocks=64,
@@ -223,17 +254,28 @@ def audit_paged_attention(num_heads, head_dim, block_size, num_blocks=64,
 
 def audit_ragged_attention(num_heads, head_dim, block_size,
                            num_q_blocks=4, block_q=None, num_blocks=64,
-                           table_width=8, dtype="float32"):
+                           table_width=8, dtype="float32",
+                           kv_dtype=None):
     """Statically validate the ragged mixed prefill+decode attention
-    block plan (see ``ops.pallas_ragged.ragged_block_plan``)."""
+    block plan (see ``ops.pallas_ragged.ragged_block_plan``).
+
+    ``kv_dtype="int8"`` audits the quantized-KV variant, whose plan
+    carries int8 k/v pools plus f32 per-slot scale tables; int8 tile
+    violations additionally raise TPU405."""
     from ..ops.pallas_ragged import ragged_block_plan
     plan = ragged_block_plan(num_heads, head_dim, block_size,
                              num_q_blocks=num_q_blocks, block_q=block_q,
                              num_blocks=num_blocks,
-                             table_width=table_width, dtype=dtype)
+                             table_width=table_width, dtype=dtype,
+                             kv_dtype=kv_dtype)
+    kvtag = ""
+    if kv_dtype is not None:
+        kvtag = f" kv={np.dtype(kv_dtype).name}"
+    site = (f"ragged_attention[{np.dtype(dtype).name}{kvtag} "
+            f"H={num_heads} D={head_dim} bs={block_size} "
+            f"bq={plan['block_q']}]")
     report = check_pallas_call(
-        plan["operands"], scratch=plan.get("scratch", ()),
-        site=f"ragged_attention[{np.dtype(dtype).name} H={num_heads} "
-             f"D={head_dim} bs={block_size} bq={plan['block_q']}]")
+        plan["operands"], scratch=plan.get("scratch", ()), site=site)
+    _flag_int8_relayout(report, plan, site=site)
     report.plan = plan
     return report

@@ -39,7 +39,8 @@ import jax.numpy as jnp
 from ...core.dispatch import dispatch
 from ...core.tensor import Tensor
 
-__all__ = ["kv_cache_scatter", "paged_attention", "ragged_attention",
+__all__ = ["kv_cache_scatter", "kv_cache_scatter_quant",
+           "paged_attention", "ragged_attention",
            "PagedCacheView", "PagedLayerCache", "RaggedCacheView",
            "RaggedLayerCache"]
 
@@ -69,6 +70,47 @@ def kv_cache_scatter(k_pool, v_pool, k_new, v_new, slot_mapping):
     """Returns the updated (k_pool, v_pool) Tensors."""
     return dispatch("kv_cache_scatter", _kv_scatter_impl,
                     (k_pool, v_pool, k_new, v_new, slot_mapping), {},
+                    differentiable=False)
+
+
+def _quantize_tokens(flat, lanes):
+    """Per-token symmetric int8 quantization: one amax over each
+    token's (H, D) slice.  Deterministic pure function of the token's
+    values, so a failover replay that re-scatters the same K/V
+    reproduces the pool AND the scale tables bit-identically.  Returns
+    (int8 [T, H, D], scales [T, lanes] f32)."""
+    f = flat.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(f), axis=(1, 2))            # [T]
+    scale = jnp.where(amax > 0.0, amax / 127.0, 1.0)
+    q = jnp.clip(jnp.round(f / scale[:, None, None]), -127.0, 127.0)
+    return (q.astype(jnp.int8),
+            jnp.broadcast_to(scale[:, None], (scale.shape[0], lanes)))
+
+
+def _kv_scatter_quant_impl(k_pool, v_pool, k_scales, v_scales,
+                           k_new, v_new, slots):
+    """Int8 variant of `_kv_scatter_impl`: quantize each new token
+    independently and write its dequant scale into the per-slot tables
+    ``[nb, bs, lanes]`` next to the int8 block data.  A block filling
+    up over many decode steps never re-scales already-written slots."""
+    nb, H, bs, D = k_pool.shape
+    lanes = k_scales.shape[-1]
+    blk = slots // bs
+    off = slots % bs
+    qk, sk = _quantize_tokens(k_new.reshape(-1, H, D), lanes)
+    qv, sv = _quantize_tokens(v_new.reshape(-1, H, D), lanes)
+    return (k_pool.at[blk, :, off, :].set(qk),
+            v_pool.at[blk, :, off, :].set(qv),
+            k_scales.at[blk, off, :].set(sk),
+            v_scales.at[blk, off, :].set(sv))
+
+
+def kv_cache_scatter_quant(k_pool, v_pool, k_scales, v_scales,
+                           k_new, v_new, slot_mapping):
+    """Returns updated (k_pool, v_pool, k_scales, v_scales) Tensors."""
+    return dispatch("kv_cache_scatter_quant", _kv_scatter_quant_impl,
+                    (k_pool, v_pool, k_scales, v_scales, k_new, v_new,
+                     slot_mapping), {},
                     differentiable=False)
 
 
@@ -140,7 +182,8 @@ def paged_attention(q, k_pool, v_pool, block_tables, context_lens,
 # ragged mixed prefill+decode attention (one flat token buffer)
 # ---------------------------------------------------------------------
 def _ragged_ref(q, k_pool, v_pool, block_tables, context_lens, seq_ids,
-                q_starts, q_valids, block_q, scale):
+                q_starts, q_valids, block_q, scale,
+                k_scales=None, v_scales=None):
     """Pure-XLA segment-gather fallback for `ragged_paged_attention`.
 
     q: [T, H, D] flat block-aligned ragged queries (see
@@ -148,7 +191,13 @@ def _ragged_ref(q, k_pool, v_pool, block_tables, context_lens, seq_ids,
     ``seq_ids == S`` is the null segment).  Mirrors `_paged_ref`'s
     numerics op-for-op (f32 score einsum, -1e30 mask, f32 softmax,
     any_visible zeroing, f32 output einsum) with per-segment causal
-    masking; a fully masked row emits exact zeros."""
+    masking; a fully masked row emits exact zeros.
+
+    Int8 pools pass ``k_scales``/``v_scales`` ``[nb, bs, lanes]``: the
+    gathered tiles are dequantized to f32 BEFORE the score/output
+    matmuls — the same pre-dot op order as the kernel's VMEM dequant,
+    so the two paths agree bitwise.
+    """
     T, H, D = q.shape
     nb, _, bs, _ = k_pool.shape
     S, W = block_tables.shape
@@ -161,8 +210,13 @@ def _ragged_ref(q, k_pool, v_pool, block_tables, context_lens, seq_ids,
     sid = seq_ids.astype(jnp.int32)
     bt_q = bt[sid]                                 # [nqb, W]
     k = k_pool[bt_q]                               # [nqb, W, H, bs, D]
-    k = jnp.moveaxis(k, 2, 1).reshape(nqb, H, W * bs, D)
     v = v_pool[bt_q]
+    if k_scales is not None:
+        # per-slot dequant: [nqb, W, bs, 1] broadcast over H (axis 2)
+        # and D; mirrors the kernel's `k * ks_ref[0, :, :1]`
+        k = k.astype(jnp.float32) * k_scales[bt_q][:, :, None, :, :1]
+        v = v.astype(jnp.float32) * v_scales[bt_q][:, :, None, :, :1]
+    k = jnp.moveaxis(k, 2, 1).reshape(nqb, H, W * bs, D)
     v = jnp.moveaxis(v, 2, 1).reshape(nqb, H, W * bs, D)
     qt = jnp.swapaxes(q.reshape(nqb, block_q, H, D), 1, 2)
     scores = jnp.einsum("nhqd,nhkd->nhqk", qt, k,
@@ -186,46 +240,60 @@ def _ragged_ref(q, k_pool, v_pool, block_tables, context_lens, seq_ids,
 
 def _ragged_attention_impl(q, k_pool, v_pool, block_tables,
                            context_lens, seq_ids, q_starts, q_valids,
-                           *, block_q, scale, use_pallas):
+                           *scales, block_q, scale, use_pallas):
+    ks, vs = scales if scales else (None, None)
     if use_pallas:
         from ...ops.pallas_ragged import ragged_paged_attention as _krn
         out = _krn(q[0], k_pool, v_pool, block_tables, context_lens,
                    seq_ids, q_starts, q_valids, block_q=block_q,
-                   scale=scale)
+                   scale=scale, k_scales=ks, v_scales=vs)
     else:
         out = _ragged_ref(q[0], k_pool, v_pool, block_tables,
                           context_lens, seq_ids, q_starts, q_valids,
-                          block_q, scale)
+                          block_q, scale, k_scales=ks, v_scales=vs)
     return out[None]
 
 
-def _use_pallas_ragged(head_dim, block_size, dtype, block_q):
+def _use_pallas_ragged(head_dim, block_size, dtype, block_q,
+                       q_dtype=None):
     jd = jnp.dtype(dtype)
-    if jd not in (jnp.dtype(jnp.float32), jnp.dtype(jnp.bfloat16)):
+    int8_kv = jd == jnp.dtype(jnp.int8)
+    if not int8_kv and jd not in (jnp.dtype(jnp.float32),
+                                  jnp.dtype(jnp.bfloat16)):
         return False
     if head_dim > 256 or block_size % 8 != 0:
         return False
     from ...ops.pallas_kernels import _min_rows
-    if block_q % _min_rows(jd):
+    # block_q tiles the QUERY buffer, whose dtype is the compute
+    # precision — an int8 pool does not force 32-row q blocks
+    if block_q % _min_rows(jnp.dtype(q_dtype) if q_dtype is not None
+                           else jd):
         return False
     from ...ops.pallas_gate import pallas_enabled
-    return pallas_enabled("ragged_attention")
+    return pallas_enabled("ragged_attention_int8" if int8_kv
+                          else "ragged_attention")
 
 
 def ragged_attention(q, k_pool, v_pool, block_tables, context_lens,
-                     seq_ids, q_starts, q_valids, block_q, scale=None):
+                     seq_ids, q_starts, q_valids, block_q, scale=None,
+                     k_scales=None, v_scales=None):
     """Mixed prefill+decode attention for q [1, T, H, D] over paged
     K/V, where T packs every scheduled token of a serving step into
-    block-aligned ragged segments (ops/pallas_ragged.py)."""
+    block-aligned ragged segments (ops/pallas_ragged.py).  Int8 pools
+    pass their per-slot dequant tables as ``k_scales``/``v_scales``."""
     head_dim = q.shape[-1]
     if scale is None:
         scale = 1.0 / math.sqrt(head_dim)
     kv = k_pool._value if isinstance(k_pool, Tensor) else k_pool
+    qv_ = q._value if isinstance(q, Tensor) else q
     use_pallas = _use_pallas_ragged(head_dim, kv.shape[2], kv.dtype,
-                                    int(block_q))
+                                    int(block_q), qv_.dtype)
+    args = (q, k_pool, v_pool, block_tables, context_lens,
+            seq_ids, q_starts, q_valids)
+    if k_scales is not None:
+        args += (k_scales, v_scales)
     return dispatch("ragged_paged_attention", _ragged_attention_impl,
-                    (q, k_pool, v_pool, block_tables, context_lens,
-                     seq_ids, q_starts, q_valids),
+                    args,
                     dict(block_q=int(block_q), scale=float(scale),
                          use_pallas=use_pallas),
                     differentiable=False)
@@ -334,9 +402,25 @@ class RaggedLayerCache:
     def attend(self, q, k, v, use_flash=True):
         """Scatter this step's K/V into the pool, then run ragged
         attention over every segment — prefill chunks and decode rows
-        share one kernel call.  q/k/v: [1, T, H, D] Tensors."""
+        share one kernel call.  q/k/v: [1, T, H, D] Tensors.  Int8
+        pools quantize per token at scatter time and thread the
+        per-slot scale tables into the attention call."""
         view = self._view
         k_pool, v_pool = view.cache.layer_pools(self._layer)
+        scales = view.cache.layer_scales(self._layer)
+        if scales is not None:
+            ks_t, vs_t = scales
+            new_k, new_v, new_ks, new_vs = kv_cache_scatter_quant(
+                k_pool, v_pool, ks_t, vs_t, k, v, view.slot_mapping)
+            k_pool._inplace_update(new_k._value)
+            v_pool._inplace_update(new_v._value)
+            ks_t._inplace_update(new_ks._value)
+            vs_t._inplace_update(new_vs._value)
+            return ragged_attention(q, new_k, new_v, view.block_tables,
+                                    view.context_lens, view.seq_ids,
+                                    view.q_starts, view.q_valids,
+                                    view.block_q, k_scales=new_ks,
+                                    v_scales=new_vs)
         new_k, new_v = kv_cache_scatter(k_pool, v_pool, k, v,
                                         view.slot_mapping)
         k_pool._inplace_update(new_k._value)

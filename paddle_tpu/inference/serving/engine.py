@@ -81,12 +81,18 @@ from .streaming import TokenStream
 
 __all__ = ["GenerationEngine", "serving_sample_next",
            "ragged_sample_next", "ENV_STEP_DEADLINE_MS",
-           "ENV_SHED_DEPTH"]
+           "ENV_SHED_DEPTH", "ENV_KV_DTYPE", "ENV_WEIGHT_DTYPE"]
 
 #: per-step wall-clock deadline in ms (watchdog; unset/empty disables)
 ENV_STEP_DEADLINE_MS = "PADDLE_TPU_SERVE_STEP_DEADLINE_MS"
 #: admission load-shedding bound on queue depth (unset/0 disables)
 ENV_SHED_DEPTH = "PADDLE_TPU_SERVE_SHED_DEPTH"
+#: KV pool element dtype override ("int8" quantizes the paged cache
+#: with per-slot dequant scales; unset = the model's param dtype)
+ENV_KV_DTYPE = "PADDLE_TPU_KV_DTYPE"
+#: weight dtype override ("int8" converts every Linear to weight-only
+#: int8 with the dequant-fused matmul epilogue; unset = float weights)
+ENV_WEIGHT_DTYPE = "PADDLE_TPU_WEIGHT_DTYPE"
 
 
 # ---------------------------------------------------------------------
@@ -202,12 +208,18 @@ class GenerationEngine:
                  block_size=None, num_blocks=None, max_model_len=None,
                  prefill_chunk=None, hbm_fraction=0.3,
                  prefix_cache=None, speculative=None, slo=None,
-                 step_deadline_ms=None, shed_depth=None, clock=None):
+                 step_deadline_ms=None, shed_depth=None, clock=None,
+                 kv_cache_dtype=None, weight_dtype=None):
         import paddle_tpu as paddle
         cfg = config or getattr(model, "config", None) \
             or model.gpt.config
         self.model = model
         model.eval()
+        if weight_dtype is None:
+            weight_dtype = os.environ.get(ENV_WEIGHT_DTYPE) or None
+        if weight_dtype is not None and str(weight_dtype) == "int8":
+            from ...quantization import convert_to_int8
+            convert_to_int8(model)  # no-op on already-converted layers
         num_layers = cfg.num_hidden_layers
         num_heads = cfg.num_attention_heads
         head_dim = cfg.hidden_size // num_heads
@@ -215,8 +227,10 @@ class GenerationEngine:
             max_model_len or cfg.max_position_embeddings,
             cfg.max_position_embeddings))
         param = next(iter(model.parameters()))
+        if kv_cache_dtype is None:
+            kv_cache_dtype = os.environ.get(ENV_KV_DTYPE) or param.dtype
         self.cache = PagedKVCache(
-            num_layers, num_heads, head_dim, dtype=param.dtype,
+            num_layers, num_heads, head_dim, dtype=kv_cache_dtype,
             block_size=block_size, num_blocks=num_blocks,
             max_model_len=self.max_model_len, hbm_fraction=hbm_fraction,
             prefix_cache=prefix_cache)
@@ -225,8 +239,11 @@ class GenerationEngine:
         # unified step geometry: one prefill chunk (padded to whole
         # q-blocks) + one q-block per decode row, ALL in a single
         # fixed-shape program — token_budget never changes, so the
-        # engine compiles once
-        self.block_q = ragged_q_block(self.cache._jdtype)
+        # engine compiles once.  block_q follows the COMPUTE dtype (the
+        # q buffer is never int8), so an int8 KV pool keeps the same
+        # step geometry as its bf16 baseline.
+        from ...core.dtypes import to_jax_dtype
+        self.block_q = ragged_q_block(to_jax_dtype(param.dtype))
         chunk = min(int(prefill_chunk or prefill_chunk_size()),
                     self.max_model_len)
         self.prefill_chunk = max(1, chunk)

@@ -121,14 +121,27 @@ class PagedKVCache:
         from ...core.dtypes import to_jax_dtype
         from ...core.tensor import Tensor
 
+        from ...ops.pallas_ragged import KV_SCALE_LANES
+
         self.num_layers = int(num_layers)
         self.num_heads = int(num_heads)
         self.head_dim = int(head_dim)
         self.block_size = int(block_size or kv_block_size())
         self._jdtype = jnp.dtype(to_jax_dtype(dtype))
+        #: int8 pools carry per-slot f32 dequant scale tables
+        #: ``[num_blocks, block_size, KV_SCALE_LANES]`` per layer per
+        #: side; every token is quantized independently at scatter time
+        #: (amax over its (H, D) slice), so a block filling up across
+        #: decode steps never re-scales already-written slots.
+        self.quantized = self._jdtype == jnp.dtype(jnp.int8)
+        self.scale_lanes = KV_SCALE_LANES if self.quantized else 0
+        # byte charge follows the ELEMENT dtype (int8 = 1 byte) plus the
+        # scale-table overhead, so a fixed HBM budget admits ~2x blocks
         self.bytes_per_block = (2 * self.num_layers * self.num_heads
                                 * self.block_size * self.head_dim
-                                * self._jdtype.itemsize)
+                                * self._jdtype.itemsize
+                                + 2 * self.num_layers * self.block_size
+                                * self.scale_lanes * 4)
         if num_blocks is None:
             num_blocks = self._blocks_from_budget(hbm_fraction)
         # +1: block 0 is the reserved pad block, never allocated
@@ -145,6 +158,7 @@ class PagedKVCache:
         shape = (self.num_blocks, self.num_heads, self.block_size,
                  self.head_dim)
         self._pools = []  # [(k_tensor, v_tensor)] per layer
+        self._scales = []  # [(k_scale, v_scale)] per layer (int8 only)
         for i in range(self.num_layers):
             k = Tensor(jnp.zeros(shape, self._jdtype), _internal=True,
                        stop_gradient=True)
@@ -153,6 +167,16 @@ class PagedKVCache:
                        stop_gradient=True)
             v.name = f"kv_cache.v.layer{i}"
             self._pools.append((k, v))
+            if self.quantized:
+                sshape = (self.num_blocks, self.block_size,
+                          self.scale_lanes)
+                ks = Tensor(jnp.zeros(sshape, jnp.float32),
+                            _internal=True, stop_gradient=True)
+                ks.name = f"kv_cache.k_scale.layer{i}"
+                vs = Tensor(jnp.zeros(sshape, jnp.float32),
+                            _internal=True, stop_gradient=True)
+                vs.name = f"kv_cache.v_scale.layer{i}"
+                self._scales.append((ks, vs))
 
         self._free = list(range(self.num_blocks - 1, 0, -1))  # pop() → 1
         self._tables = {}      # seq_id -> [block ids]
@@ -193,7 +217,8 @@ class PagedKVCache:
         register_resident(
             self.resident_name, self.pool_bytes,
             buffer_ids=lambda: {id(t._value)
-                                for kv in self._pools for t in kv})
+                                for kv in (self._pools + self._scales)
+                                for t in kv})
         self._registered = True
 
     def close(self):
@@ -209,8 +234,15 @@ class PagedKVCache:
         """(k_pool, v_pool) Tensors for one layer."""
         return self._pools[layer]
 
+    def layer_scales(self, layer):
+        """(k_scale, v_scale) per-slot dequant tables for one layer
+        (int8 pools only; None otherwise)."""
+        if not self.quantized:
+            return None
+        return self._scales[layer]
+
     def pool_tensors(self):
-        return [t for kv in self._pools for t in kv]
+        return [t for kv in (self._pools + self._scales) for t in kv]
 
     # -- allocator -------------------------------------------------------
     @property
@@ -258,6 +290,13 @@ class PagedKVCache:
         return need + int(headroom) <= capacity
 
     def _chain_hash(self, prev, block_tokens):
+        # the chain root is seeded with the pool dtype so a bf16 block
+        # and an int8 block holding the same tokens can never alias
+        # (their stored bytes differ) — matters when tables/hashes
+        # migrate across pools, e.g. a failover replay onto a replica
+        # configured with a different PADDLE_TPU_KV_DTYPE
+        if prev is None:
+            prev = str(self._jdtype)
         return hash((prev, tuple(int(t) for t in block_tokens)))
 
     def _prefix_hits(self, tokens, num_tokens):
@@ -418,10 +457,15 @@ class PagedKVCache:
                 del self._by_hash[h]
 
     def _copy_block(self, src, dst):
-        """Device-side block copy, all layers (the COW split)."""
+        """Device-side block copy, all layers (the COW split).  Int8
+        pools copy the per-slot scale rows alongside the data — a split
+        block with stale scales would dequantize to garbage."""
         for k, v in self._pools:
             k._inplace_update(k._value.at[dst].set(k._value[src]))
             v._inplace_update(v._value.at[dst].set(v._value[src]))
+        for ks, vs in self._scales:
+            ks._inplace_update(ks._value.at[dst].set(ks._value[src]))
+            vs._inplace_update(vs._value.at[dst].set(vs._value[src]))
 
     def append(self, seq_id, num_tokens=1):
         """Extend a sequence by ``num_tokens`` slots (decode).  Returns
@@ -537,6 +581,8 @@ class PagedKVCache:
         return {
             "num_blocks": self.num_blocks - 1,
             "block_size": self.block_size,
+            "kv_dtype": str(self._jdtype),
+            "bytes_per_block": self.bytes_per_block,
             "blocks_in_use": self.blocks_in_use,
             "free_blocks": self.free_blocks,
             "logical_blocks": self.logical_blocks,

@@ -90,8 +90,14 @@ class GPTMLP(nn.Layer):
 
     def forward(self, x):
         # fc1's bias+gelu fold into the matmul epilogue on TPU
-        return self.fc2(F.linear_act(x, self.fc1.weight, self.fc1.bias,
-                                     act="gelu_tanh"))
+        w_q = getattr(self.fc1, "weight_q", None)
+        if w_q is not None:
+            h = F.linear_act_int8(x, w_q, self.fc1.weight_scale,
+                                  self.fc1.bias, act="gelu_tanh")
+        else:
+            h = F.linear_act(x, self.fc1.weight, self.fc1.bias,
+                             act="gelu_tanh")
+        return self.fc2(h)
 
 
 class GPTBlock(nn.Layer):

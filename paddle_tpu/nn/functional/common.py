@@ -15,7 +15,8 @@ from ...core.tensor import Tensor, to_tensor
 from ...framework.random import default_generator
 
 __all__ = [
-    "linear", "linear_act", "dropout", "dropout2d", "dropout3d",
+    "linear", "linear_act", "linear_act_int8", "dropout", "dropout2d",
+    "dropout3d",
     "alpha_dropout", "pad",
     "interpolate", "upsample", "cosine_similarity", "pixel_shuffle",
     "pixel_unshuffle", "unfold", "fold", "one_hot", "embedding",
@@ -72,6 +73,38 @@ def linear_act(x, weight, bias=None, act="none", name=None):
 
     args = (x, weight) + ((bias,) if bias is not None else ())
     return dispatch("linear_act", impl, args,
+                    dict(act=act, use_pallas=use_pallas))
+
+
+def linear_act_int8(x, weight_q, weight_scale, bias, act="none", name=None):
+    """act((x @ W_int8) * scale + b): per-output-channel int8 weight with
+    the dequant fused into the matmul accumulator (``matmul_epilogue_int8``
+    gate).  The fallback applies the scale POST-dot — the same op order
+    as the kernel, so both paths agree bitwise; scaling the weight
+    pre-dot would reassociate the contraction and drift."""
+    from ...ops.pallas_fused import ACTIVATIONS
+    if act not in ACTIVATIONS:
+        raise ValueError(
+            f"unknown activation {act!r}; expected one of {ACTIVATIONS}")
+    from ...ops.pallas_gate import pallas_enabled
+    use_pallas = pallas_enabled("matmul_epilogue_int8")
+    if bias is None:
+        bias = to_tensor(np.zeros(int(weight_q.shape[-1]), np.float32))
+
+    def impl(v, w_q, s, b, *, act, use_pallas=False):
+        if use_pallas:
+            from ...ops.pallas_fused import fused_linear_act_int8
+            return fused_linear_act_int8(v, w_q, s, b, act)
+        z = jax.lax.dot_general(
+            v.astype(jnp.float32), w_q.astype(jnp.float32),
+            (((v.ndim - 1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        z = z * s.astype(jnp.float32) + b.astype(jnp.float32)
+        from ...ops.pallas_fused import _act_f32
+        return _act_f32(z, act).astype(v.dtype)
+
+    return dispatch("linear_act_int8", impl, (x, weight_q, weight_scale,
+                                              bias),
                     dict(act=act, use_pallas=use_pallas))
 
 

@@ -45,6 +45,9 @@ class Linear(Layer):
                 default_initializer=I.Constant(0.0))
 
     def forward(self, x):
+        w_q = getattr(self, "weight_q", None)
+        if w_q is not None:  # int8 weight-only (quantization.convert_to_int8)
+            return F.linear_act_int8(x, w_q, self.weight_scale, self.bias)
         return F.linear(x, self.weight, self.bias)
 
     def extra_repr(self):

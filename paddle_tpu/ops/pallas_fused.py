@@ -27,6 +27,7 @@ import functools
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax.experimental import pallas as pl
 
 from .pallas_kernels import (_STAT_LANES, _demote_f64, _interpret,
@@ -37,6 +38,7 @@ __all__ = [
     "ACTIVATIONS",
     "fused_layer_norm_residual",
     "fused_linear_act",
+    "fused_linear_act_int8",
     "ln_residual_block_plan",
     "matmul_epilogue_block_plan",
 ]
@@ -392,20 +394,185 @@ def fused_linear_act(x, w, b, act="none"):
     return out.reshape(shape[:-1] + (n,))
 
 
+# =====================================================================
+# Int8-weight matmul epilogue: act((x @ w_int8) * scale + b)
+# =====================================================================
+#
+# The weight lives in HBM as int8 with one f32 scale per OUTPUT channel.
+# Per-output-channel dequant commutes with the contraction —
+# x @ (w_q * diag(s)) == (x @ w_q) * s — so the kernel keeps the int8
+# tiles all the way into VMEM (half the weight bandwidth of bf16, a
+# quarter of f32) and applies the scale once on the f32 accumulator:
+# one multiply per OUTPUT element instead of one per weight element.
+# The XLA fallback in nn.functional must use the same post-dot op order
+# to stay bit-exact with the interpret-mode kernel.
+
+
+def _me_int8_fwd_kernel(x_ref, w_ref, s_ref, b_ref, o_ref, z_ref, *, act):
+    # tpu.matmul wants f32 operands (same convention as _me_fwd_kernel);
+    # the int8 -> f32 widening happens on the VMEM-resident tile, AFTER
+    # the (k, bn) block travelled HBM->VMEM at 1 byte/element
+    z = jax.lax.dot_general(
+        x_ref[:].astype(jnp.float32), w_ref[:].astype(jnp.float32),
+        (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)             # (bm, bn)
+    z = z * s_ref[:] + b_ref[:].astype(jnp.float32)     # dequant epilogue
+    z_ref[:] = z.astype(z_ref.dtype)
+    o_ref[:] = _act_f32(z, act).astype(o_ref.dtype)
+
+
+def _me_int8_blocks(m, k, n, x_dtype):
+    """(bm, bn, m_pad, n_pad) for the int8-weight variant: the VMEM
+    ceiling is driven by the double-buffered (K, bn) weight block at
+    1 byte/element, so bn can run wider than the float kernel's; bm
+    still follows the ACTIVATION dtype (x is not int8)."""
+    bm = min(_round_up(max(m, 1), _min_rows(x_dtype)), 128)
+    bn = 512
+    while bn > 128 and 2 * k * bn * 1 > (6 << 20):
+        bn //= 2
+    bn = min(bn, _round_up(max(n, 1), 128))
+    return bm, bn, _round_up(m, bm), _round_up(n, bn)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4,))
+def _matmul_epilogue_int8_2d(x, w_q, scale, b, act):
+    return _matmul_epilogue_int8_2d_fwd(x, w_q, scale, b, act)[0]
+
+
+@_x32
+def _matmul_epilogue_int8_2d_fwd(x, w_q, scale, b, act):
+    m, k = x.shape
+    n = w_q.shape[1]
+    bm, bn, m_pad, n_pad = _me_int8_blocks(m, k, n, x.dtype)
+    xp = _pad_dim(x, 0, m_pad)
+    wp = _pad_dim(w_q, 1, n_pad)
+    # padded channels get scale 1.0 so the bwd dscale division below
+    # never sees a synthetic zero (their columns are sliced off anyway)
+    sp = _pad_dim(scale.reshape(1, n).astype(jnp.float32), 1, n_pad, 1.0)
+    bp = _pad_dim(b.reshape(1, n), 1, n_pad)
+    with _kernel_span("matmul_epilogue_int8", "fwd"):
+        out, z = pl.pallas_call(
+            functools.partial(_me_int8_fwd_kernel, act=act),
+            grid=(m_pad // bm, n_pad // bn),
+            in_specs=[
+                pl.BlockSpec((bm, k), lambda i, j: (i, 0)),
+                pl.BlockSpec((k, bn), lambda i, j: (0, j)),
+                pl.BlockSpec((1, bn), lambda i, j: (0, j)),
+                pl.BlockSpec((1, bn), lambda i, j: (0, j)),
+            ],
+            out_specs=[
+                pl.BlockSpec((bm, bn), lambda i, j: (i, j)),
+                pl.BlockSpec((bm, bn), lambda i, j: (i, j)),
+            ],
+            out_shape=[
+                jax.ShapeDtypeStruct((m_pad, n_pad), x.dtype),
+                jax.ShapeDtypeStruct((m_pad, n_pad), x.dtype),
+            ],
+            interpret=_interpret(),
+        )(xp, wp, sp, bp)
+    return out[:m, :n], (x, w_q, scale, b, z[:m, :n])
+
+
+@_x32
+def _matmul_epilogue_int8_2d_bwd(act, res, g):
+    x, w_q, scale, b, z = res
+    m, k = x.shape
+    n = w_q.shape[1]
+    bm, bn, m_pad, n_pad = _me_int8_blocks(m, k, n, x.dtype)
+    zp = _pad_dim(_pad_dim(z, 0, m_pad), 1, n_pad)
+    gp = _pad_dim(_pad_dim(g, 0, m_pad), 1, n_pad)
+    # dz/db epilogue backward is dtype-agnostic over z/g — reuse the
+    # float kernel at the int8 plan's block sizes
+    with _kernel_span("matmul_epilogue_int8", "bwd"):
+        dz_pad, db_acc = pl.pallas_call(
+            functools.partial(_me_bwd_kernel, act=act),
+            grid=(n_pad // bn, m_pad // bm),
+            in_specs=[
+                pl.BlockSpec((bm, bn), lambda j, i: (i, j)),
+                pl.BlockSpec((bm, bn), lambda j, i: (i, j)),
+            ],
+            out_specs=[
+                pl.BlockSpec((bm, bn), lambda j, i: (i, j)),
+                pl.BlockSpec((8, bn), lambda j, i: (0, j)),
+            ],
+            out_shape=[
+                jax.ShapeDtypeStruct((m_pad, n_pad), x.dtype),
+                jax.ShapeDtypeStruct((8, n_pad), jnp.float32),
+            ],
+            interpret=_interpret(),
+        )(zp, gp)
+    dz = dz_pad[:m, :n]
+    s32 = scale.reshape(n).astype(jnp.float32)
+    # the weight is dequantized ONCE for dx; the quantized tensor
+    # itself is integer (no cotangent), but the per-channel scale is a
+    # live float leaf — its grad falls out of the saved pre-activation:
+    # z = (x @ w_q) * s + b  =>  dz/ds_j = (z_j - b_j) / s_j
+    w_deq = w_q.astype(jnp.float32) * s32[None, :]
+    dx = jax.lax.dot_general(
+        dz, w_deq, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32).astype(x.dtype)
+    dz32 = dz.astype(jnp.float32)
+    acc = (z.astype(jnp.float32) - b.reshape(1, n).astype(jnp.float32))
+    dscale = jnp.sum(dz32 * acc, axis=0) / s32
+    db = db_acc[0, :n].astype(b.dtype)
+    dw_q = np.zeros(w_q.shape, dtype=jax.dtypes.float0)
+    return dx, dw_q, dscale.astype(scale.dtype), db
+
+
+_matmul_epilogue_int8_2d.defvjp(_matmul_epilogue_int8_2d_fwd,
+                                _matmul_epilogue_int8_2d_bwd)
+
+
+def fused_linear_act_int8(x, w_q, scale, b, act="none"):
+    """act((x @ w_int8) * scale + b) with the per-output-channel dequant
+    fused into the matmul accumulator; differentiable in x, scale, b.
+
+    x: [..., K] float; w_q: [K, N] int8; scale: [N] f32 per-channel
+    dequant scales; b: [N].  The int8 weight is a frozen constant
+    (integer primal, float0 cotangent).
+    """
+    if act not in ACTIVATIONS:
+        raise ValueError(f"act must be one of {ACTIVATIONS}, got {act!r}")
+    if jnp.dtype(w_q.dtype) != jnp.dtype(jnp.int8):
+        raise ValueError(f"w_q must be int8, got {w_q.dtype}")
+    x, b = _demote_f64(x, b)
+    scale = jnp.asarray(scale, jnp.float32)
+    shape = x.shape
+    k = shape[-1]
+    n = w_q.shape[-1]
+    out = _matmul_epilogue_int8_2d(x.reshape(-1, k), w_q,
+                                   scale.reshape(n), b.reshape(n), act)
+    return out.reshape(shape[:-1] + (n,))
+
+
 def matmul_epilogue_block_plan(m, k, n, dtype=jnp.float32,
-                               direction="fwd"):
+                               direction="fwd", weight_dtype=None):
     """The exact block plan `_matmul_epilogue_2d_{fwd,bwd}` uses for
-    an (m, k) @ (k, n) problem.  Same contract as `flash_block_plan`."""
+    an (m, k) @ (k, n) problem.  Same contract as `flash_block_plan`.
+
+    ``weight_dtype=int8`` exports the `_matmul_epilogue_int8_2d` plan
+    instead: int8 (k, bn) weight blocks + an f32 (1, bn) per-channel
+    scale operand; the activation/output dtype stays ``dtype``.
+    """
     dtype = jnp.dtype(dtype)
     f32 = jnp.dtype(jnp.float32)
-    bm, bn, m_pad, n_pad = _me_blocks(m, k, n, dtype)
+    wdt = jnp.dtype(weight_dtype) if weight_dtype is not None else dtype
+    int8_w = wdt == jnp.dtype(jnp.int8)
+    if int8_w:
+        bm, bn, m_pad, n_pad = _me_int8_blocks(m, k, n, dtype)
+    else:
+        bm, bn, m_pad, n_pad = _me_blocks(m, k, n, dtype)
     out_blk = lambda name: (  # noqa: E731 - local table helper
         name, (bm, bn), (m_pad, n_pad), dtype)
     if direction == "fwd":
         grid = (m_pad // bm, n_pad // bn)
         operands = [
             ("x", (bm, k), (m_pad, k), dtype),
-            ("w", (k, bn), (k, n_pad), dtype),
+            ("w", (k, bn), (k, n_pad), wdt),
+        ]
+        if int8_w:
+            operands.append(("scale", (1, bn), (1, n_pad), f32))
+        operands += [
             ("b", (1, bn), (1, n_pad), dtype),
             out_blk("out"), out_blk("z"),
         ]
@@ -422,6 +589,7 @@ def matmul_epilogue_block_plan(m, k, n, dtype=jnp.float32,
         "grid": grid,
         "block_m": bm,
         "block_n": bn,
+        "weight_dtype": str(wdt),
         "operands": operands,
         "scratch": (),
     }

@@ -136,6 +136,19 @@ def _probe_matmul_epilogue():
     jax.block_until_ready(fn(x, w, b))
 
 
+def _probe_matmul_epilogue_int8():
+    from . import pallas_fused as pf
+    x = jnp.zeros((32, 128), jnp.bfloat16)
+    w_q = jnp.ones((128, 256), jnp.int8)
+    s = jnp.ones((256,), jnp.float32)
+    b = jnp.zeros((256,), jnp.bfloat16)
+    fn = jax.jit(jax.grad(
+        lambda x, s, b: pf.fused_linear_act_int8(
+            x, w_q, s, b, "gelu_tanh").astype(jnp.float32).sum(),
+        argnums=(0, 1, 2)))
+    jax.block_until_ready(fn(x, s, b))
+
+
 def _probe_paged_attention():
     from . import pallas_kernels as pk
     q = jnp.zeros((2, 1, 2, 64), jnp.float32)
@@ -162,13 +175,33 @@ def _probe_ragged_attention():
     jax.block_until_ready(fn(q, pool, pool))
 
 
+def _probe_ragged_attention_int8():
+    from . import pallas_ragged as pr
+    block_q = pr.ragged_q_block(jnp.float32)
+    nqb = 3                       # one 2-block prefill + one decode
+    q = jnp.zeros((nqb * block_q, 2, 64), jnp.float32)
+    pool = jnp.zeros((4, 2, 16, 64), jnp.int8)
+    scales = jnp.ones((4, 16, pr.KV_SCALE_LANES), jnp.float32)
+    bt = jnp.array([[1, 2], [3, 0]], jnp.int32)
+    cl = jnp.array([20, 5], jnp.int32)
+    sid = jnp.array([0, 0, 1], jnp.int32)
+    qs = jnp.array([4, 4 + block_q, 4], jnp.int32)
+    qv = jnp.array([block_q, block_q, 1], jnp.int32)
+    fn = jax.jit(lambda q, kp, vp, ks, vs: pr.ragged_paged_attention(
+        q, kp, vp, bt, cl, sid, qs, qv, block_q=block_q,
+        k_scales=ks, v_scales=vs))
+    jax.block_until_ready(fn(q, pool, pool, scales, scales))
+
+
 _PROBES = {
     "flash_attention": _probe_flash_attention,
     "paged_attention": _probe_paged_attention,
     "ragged_attention": _probe_ragged_attention,
+    "ragged_attention_int8": _probe_ragged_attention_int8,
     "layer_norm": _probe_layer_norm,
     "layer_norm_residual": _probe_layer_norm_residual,
     "matmul_epilogue": _probe_matmul_epilogue,
+    "matmul_epilogue_int8": _probe_matmul_epilogue_int8,
     "rms_norm": _probe_rms_norm,
     "softmax_cross_entropy": _probe_softmax_cross_entropy,
 }
@@ -193,6 +226,10 @@ def _static_diagnose(kernel):
         return list(tiling.audit_ragged_attention(
             2, 64, 16, num_q_blocks=3, num_blocks=4, table_width=2,
             dtype=jnp.float32))
+    if kernel == "ragged_attention_int8":
+        return list(tiling.audit_ragged_attention(
+            2, 64, 16, num_q_blocks=3, num_blocks=4, table_width=2,
+            dtype=jnp.float32, kv_dtype=jnp.int8))
     if kernel == "layer_norm_residual":
         diags = []
         for direction in ("fwd", "bwd"):
@@ -204,6 +241,13 @@ def _static_diagnose(kernel):
         for direction in ("fwd", "bwd"):
             diags.extend(tiling.audit_matmul_epilogue(
                 32, 128, 256, dtype=jnp.bfloat16, direction=direction))
+        return diags
+    if kernel == "matmul_epilogue_int8":
+        diags = []
+        for direction in ("fwd", "bwd"):
+            diags.extend(tiling.audit_matmul_epilogue(
+                32, 128, 256, dtype=jnp.bfloat16, direction=direction,
+                weight_dtype=jnp.int8))
         return diags
     return []
 

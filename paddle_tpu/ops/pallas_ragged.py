@@ -53,7 +53,15 @@ from .pallas_kernels import (_NEG_INF, _STAT_LANES, _demote_f64,
                              _x32)
 
 __all__ = ["ragged_paged_attention", "ragged_block_plan",
-           "ragged_q_block", "ragged_segments"]
+           "ragged_q_block", "ragged_segments", "KV_SCALE_LANES"]
+
+#: lane width of the per-slot KV dequant scale tables
+#: ``[num_blocks, block_size, KV_SCALE_LANES]`` (f32).  One lane keeps
+#: the int8 pool's scale overhead at 4 bytes per slot-layer so the
+#: capacity win stays ~2x even at small head_dim; both trailing dims of
+#: the (1, block_size, 1) scale block cover the full array, which keeps
+#: the spec legal at any lane count.
+KV_SCALE_LANES = 1
 
 
 def ragged_q_block(dtype) -> int:
@@ -104,16 +112,22 @@ def ragged_segments(query_lens, context_lens, block_q,
             np.asarray(offsets, np.int32), off)
 
 
-def _ragged_attn_kernel(bt_ref, cl_ref, sid_ref, qs_ref, qv_ref,
-                        q_ref, k_ref, v_ref, o_ref,
-                        acc_ref, m_ref, l_ref, *, block_size, block_q,
-                        scale, w_last):
+def _ragged_attn_body(bt_ref, cl_ref, sid_ref, qs_ref, qv_ref,
+                      q_ref, k_ref, v_ref, ks_ref, vs_ref, o_ref,
+                      acc_ref, m_ref, l_ref, *, block_size, block_q,
+                      scale, w_last):
     """One (q-block, head, table-slot) program over the paged pool.
 
     Scalar-prefetched ``seq_ids`` route each q-block to its sequence's
     block table; the null segment (``seq_ids == num_seqs``) reads
     ``context_len 0`` from the padded tail of ``cl_ref`` so its guard
     never fires and the emit writes zeros.
+
+    ``ks_ref``/``vs_ref`` are the int8 variant's per-slot dequant scale
+    blocks ((1, block_size, KV_SCALE_LANES) f32, walked by the SAME
+    block-table index map as k/v) or None on the float path; dequant
+    happens on the VMEM-resident tile inside the running-softmax loop —
+    the int8 bytes are all that crosses HBM.
     """
     i = pl.program_id(0)
     w = pl.program_id(2)
@@ -132,6 +146,8 @@ def _ragged_attn_kernel(bt_ref, cl_ref, sid_ref, qs_ref, qv_ref,
     def _block():
         q = q_ref[0].astype(jnp.float32)                # (bq, D)
         k = k_ref[0, 0].astype(jnp.float32)             # (bs, D)
+        if ks_ref is not None:
+            k = k * ks_ref[0, :, :1]                    # per-slot dequant
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale  # (bq, bs)
@@ -149,6 +165,8 @@ def _ragged_attn_kernel(bt_ref, cl_ref, sid_ref, qs_ref, qv_ref,
         l_ref[...] = _lanes(alpha * l_ref[:, :1]
                             + jnp.sum(p, axis=-1, keepdims=True))
         v = v_ref[0, 0].astype(jnp.float32)             # (bs, D)
+        if vs_ref is not None:
+            v = v * vs_ref[0, :, :1]                    # per-slot dequant
         acc_ref[...] = acc_ref[...] * alpha + jax.lax.dot_general(
             p, v, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
@@ -168,10 +186,26 @@ def _ragged_attn_kernel(bt_ref, cl_ref, sid_ref, qs_ref, qv_ref,
         o_ref[...] = out[None].astype(o_ref.dtype)
 
 
+def _ragged_attn_kernel(bt_ref, cl_ref, sid_ref, qs_ref, qv_ref,
+                        q_ref, k_ref, v_ref, o_ref,
+                        acc_ref, m_ref, l_ref, **kw):
+    _ragged_attn_body(bt_ref, cl_ref, sid_ref, qs_ref, qv_ref,
+                      q_ref, k_ref, v_ref, None, None, o_ref,
+                      acc_ref, m_ref, l_ref, **kw)
+
+
+def _ragged_attn_int8_kernel(bt_ref, cl_ref, sid_ref, qs_ref, qv_ref,
+                             q_ref, k_ref, v_ref, ks_ref, vs_ref, o_ref,
+                             acc_ref, m_ref, l_ref, **kw):
+    _ragged_attn_body(bt_ref, cl_ref, sid_ref, qs_ref, qv_ref,
+                      q_ref, k_ref, v_ref, ks_ref, vs_ref, o_ref,
+                      acc_ref, m_ref, l_ref, **kw)
+
+
 @_x32
 def ragged_paged_attention(q, k_pool, v_pool, block_tables, context_lens,
                            seq_ids, q_starts, q_valids, block_q=None,
-                           scale=None):
+                           scale=None, k_scales=None, v_scales=None):
     """Mixed prefill+decode attention over the paged KV pool.
 
     q: [T, H, D] flat block-aligned ragged queries (T % block_q == 0);
@@ -179,8 +213,17 @@ def ragged_paged_attention(q, k_pool, v_pool, block_tables, context_lens,
     block_tables: [S, W] int32; context_lens: [S] int32;
     seq_ids/q_starts/q_valids: [T // block_q] int32 (see module doc;
     ``seq_ids == S`` marks a null/pad q-block).  Returns [T, H, D].
+
+    Int8 pools additionally take ``k_scales``/``v_scales``
+    ``[num_blocks, block_size, KV_SCALE_LANES]`` f32 per-slot dequant
+    tables (kv_cache.py maintains them through every block lifecycle
+    edge); the kernel walks them with the block tables and dequantizes
+    in VMEM.
     """
     q, k_pool, v_pool = _demote_f64(q, k_pool, v_pool)
+    int8_kv = jnp.dtype(k_pool.dtype) == jnp.dtype(jnp.int8)
+    if int8_kv and (k_scales is None or v_scales is None):
+        raise ValueError("int8 KV pools need k_scales/v_scales tables")
     T, H, D = q.shape
     if block_q is None:
         block_q = ragged_q_block(q.dtype)
@@ -210,27 +253,37 @@ def ragged_paged_attention(q, k_pool, v_pool, block_tables, context_lens,
     qs = q_starts.astype(jnp.int32)
     qv = q_valids.astype(jnp.int32)
 
-    with _kernel_span("ragged_attention", "fwd"):
+    q_spec = pl.BlockSpec(
+        (1, block_q, D),
+        lambda i, h, w, bt, cl, sid, qs, qv: (h, i, 0))
+    pool_spec = pl.BlockSpec(
+        (1, 1, block_size, D),
+        lambda i, h, w, bt, cl, sid, qs, qv: (bt[sid[i], w], h, 0, 0))
+    in_specs = [q_spec, pool_spec, pool_spec]
+    operands = [qt, k_pool, v_pool]
+    kernel = _ragged_attn_kernel
+    name = "ragged_attention"
+    if int8_kv:
+        # the scale blocks ride the same block-table walk as k/v; both
+        # trailing dims cover the full scale array so the spec is legal
+        scale_spec = pl.BlockSpec(
+            (1, block_size, KV_SCALE_LANES),
+            lambda i, h, w, bt, cl, sid, qs, qv: (bt[sid[i], w], 0, 0))
+        in_specs += [scale_spec, scale_spec]
+        operands += [k_scales.astype(jnp.float32),
+                     v_scales.astype(jnp.float32)]
+        kernel = _ragged_attn_int8_kernel
+        name = "ragged_attention_int8"
+
+    with _kernel_span(name, "fwd"):
         out = pl.pallas_call(
             functools.partial(
-                _ragged_attn_kernel, block_size=block_size,
+                kernel, block_size=block_size,
                 block_q=block_q, scale=float(scale), w_last=W - 1),
             grid_spec=pltpu.PrefetchScalarGridSpec(
                 num_scalar_prefetch=5,
                 grid=(nqb, H, W),
-                in_specs=[
-                    pl.BlockSpec(
-                        (1, block_q, D),
-                        lambda i, h, w, bt, cl, sid, qs, qv: (h, i, 0)),
-                    pl.BlockSpec(
-                        (1, 1, block_size, D),
-                        lambda i, h, w, bt, cl, sid, qs, qv:
-                            (bt[sid[i], w], h, 0, 0)),
-                    pl.BlockSpec(
-                        (1, 1, block_size, D),
-                        lambda i, h, w, bt, cl, sid, qs, qv:
-                            (bt[sid[i], w], h, 0, 0)),
-                ],
+                in_specs=in_specs,
                 out_specs=pl.BlockSpec(
                     (1, block_q, D),
                     lambda i, h, w, bt, cl, sid, qs, qv: (h, i, 0)),
@@ -242,33 +295,47 @@ def ragged_paged_attention(q, k_pool, v_pool, block_tables, context_lens,
             ),
             out_shape=jax.ShapeDtypeStruct((H, T, D), q.dtype),
             interpret=_interpret(),
-        )(bt, cl, sid, qs, qv, qt, k_pool, v_pool)
+        )(bt, cl, sid, qs, qv, *operands)
     return jnp.swapaxes(out, 0, 1)                      # [T, H, D]
 
 
 def ragged_block_plan(num_heads, head_dim, block_size, num_q_blocks=4,
                       block_q=None, num_blocks=64, table_width=8,
-                      dtype=jnp.float32):
+                      dtype=jnp.float32, kv_dtype=None):
     """The ragged mixed-batch attention block plan (see
     `ragged_paged_attention`).  Scalar-prefetch operands (block tables,
     context lens, segment descriptors) are untiled and omitted, like
-    `paged_block_plan`."""
+    `paged_block_plan`.
+
+    ``kv_dtype=int8`` exports the int8-pool variant: int8 k/v blocks
+    plus the two (1, block_size, KV_SCALE_LANES) f32 per-slot scale
+    operands; q/out stay ``dtype`` (the compute precision).
+    """
     dtype = jnp.dtype(dtype)
     f32 = jnp.dtype(jnp.float32)
+    kvdt = jnp.dtype(kv_dtype) if kv_dtype is not None else dtype
     if block_q is None:
         block_q = ragged_q_block(dtype)
     D = head_dim
     T = num_q_blocks * block_q
     pool = (num_blocks, num_heads, block_size, D)
+    operands = [
+        ("q", (1, block_q, D), (num_heads, T, D), dtype),
+        ("k_pool", (1, 1, block_size, D), pool, kvdt),
+        ("v_pool", (1, 1, block_size, D), pool, kvdt),
+    ]
+    if kvdt == jnp.dtype(jnp.int8):
+        scales = (num_blocks, block_size, KV_SCALE_LANES)
+        operands += [
+            ("k_scales", (1, block_size, KV_SCALE_LANES), scales, f32),
+            ("v_scales", (1, block_size, KV_SCALE_LANES), scales, f32),
+        ]
+    operands.append(("out", (1, block_q, D), (num_heads, T, D), dtype))
     return {
         "grid": (num_q_blocks, num_heads, table_width),
         "block_q": block_q,
-        "operands": [
-            ("q", (1, block_q, D), (num_heads, T, D), dtype),
-            ("k_pool", (1, 1, block_size, D), pool, dtype),
-            ("v_pool", (1, 1, block_size, D), pool, dtype),
-            ("out", (1, block_q, D), (num_heads, T, D), dtype),
-        ],
+        "kv_dtype": str(kvdt),
+        "operands": operands,
         "scratch": (
             ((block_q, D), f32),
             ((block_q, _STAT_LANES), f32),

@@ -19,12 +19,14 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from ..analysis.diagnostics import Diagnostic, DiagnosticReport
 from ..core.dispatch import dispatch
 from ..core.tensor import Tensor
 from ..nn.layer.layers import Layer
 
 __all__ = ["QuantConfig", "QAT", "PTQ", "FakeQuanterWithAbsMax",
-           "AbsmaxObserver", "quant_dequant"]
+           "AbsmaxObserver", "quant_dequant", "quantize_weight_int8",
+           "convert_to_int8", "logits_cosine", "greedy_match_ratio"]
 
 
 @jax.custom_vjp
@@ -57,19 +59,125 @@ def quant_dequant(x, scale, bits=8):
 
 
 class AbsmaxObserver:
-    """Tracks running abs-max of a tensor (PTQ calibration)."""
+    """Tracks running abs-max of a tensor (PTQ calibration).
 
-    def __init__(self, quant_bits=8):
+    ``axis=None`` keeps one scalar over the whole tensor; an integer
+    axis keeps one abs-max per slice along that axis (per-channel), the
+    granularity the int8 weight path consumes."""
+
+    def __init__(self, quant_bits=8, axis=None):
         self.bits = quant_bits
-        self._absmax = 0.0
+        self.axis = axis
+        self._absmax = 0.0 if axis is None else None
 
     def observe(self, x):
-        v = float(jnp.max(jnp.abs(
-            x._value if isinstance(x, Tensor) else jnp.asarray(x))))
-        self._absmax = max(self._absmax, v)
+        v = x._value if isinstance(x, Tensor) else jnp.asarray(x)
+        if self.axis is None:
+            self._absmax = max(self._absmax,
+                               float(jnp.max(jnp.abs(v))))
+            return
+        ax = self.axis % v.ndim
+        red = tuple(i for i in range(v.ndim) if i != ax)
+        cur = np.asarray(jnp.max(jnp.abs(v), axis=red), np.float32)
+        self._absmax = cur if self._absmax is None \
+            else np.maximum(self._absmax, cur)
 
     def scale(self):
-        return max(self._absmax, 1e-8)
+        if self.axis is None:
+            return max(self._absmax, 1e-8)
+        if self._absmax is None:
+            raise ValueError("per-channel observer never observed data")
+        return np.maximum(self._absmax, 1e-8)
+
+
+# ---------------------------------------------------------------------
+# int8 weight-only execution (TPU serving path)
+# ---------------------------------------------------------------------
+Q_INT8_MAX = 127.0
+
+
+def quantize_weight_int8(w, axis=-1, report=None):
+    """Symmetric per-channel int8 weight quantization.
+
+    Returns ``(w_q, scale)`` Tensors: int8 codes and the float32
+    per-channel scale along ``axis`` such that ``w ≈ w_q * scale``
+    (scale broadcast over the other dims).  Per-output-channel scale
+    commutes with the contraction, so the matmul epilogue can apply it
+    once on the f32 accumulator.  Channels whose abs-max is zero or
+    nonfinite get scale 1.0 and a TPU404 diagnostic on ``report``.
+    """
+    v = w._value if isinstance(w, Tensor) else jnp.asarray(w)
+    v = v.astype(jnp.float32)
+    ax = axis % v.ndim
+    red = tuple(i for i in range(v.ndim) if i != ax)
+    amax = np.asarray(jnp.max(jnp.abs(v), axis=red), np.float32)
+    bad = ~np.isfinite(amax) | (amax <= 0.0)
+    if bad.any() and report is not None:
+        report.add(Diagnostic(
+            "TPU404",
+            f"{int(bad.sum())} of {amax.size} channels along axis "
+            f"{ax} have zero or nonfinite abs-max; their scale is "
+            "clamped to 1.0 and the channel dequantizes to zeros",
+            site=f"quantize_weight_int8[shape={tuple(v.shape)}]",
+            hint="check the calibration data / weight init for dead "
+                 "or overflowed channels",
+            data={"bad_channels": np.nonzero(bad)[0][:16].tolist()}))
+    scale = np.where(bad, 1.0, amax / Q_INT8_MAX).astype(np.float32)
+    bshape = [1] * v.ndim
+    bshape[ax] = -1
+    q = jnp.clip(jnp.round(v / jnp.asarray(scale).reshape(bshape)),
+                 -Q_INT8_MAX, Q_INT8_MAX).astype(jnp.int8)
+    return (Tensor(q, _internal=True, stop_gradient=True),
+            Tensor(jnp.asarray(scale), _internal=True,
+                   stop_gradient=True))
+
+
+def convert_to_int8(model, report=None):
+    """Convert every ``nn.Linear`` under ``model`` to int8 weight-only
+    execution.
+
+    The float ``weight`` parameter is dropped and replaced by two
+    persistable buffers — ``weight_q`` (int8 codes) and
+    ``weight_scale`` (float32 per-output-channel) — which round-trip
+    through ``state_dict`` like any checkpointed tensor.  The forward
+    pass then dispatches to the dequant-fused matmul epilogue
+    (``F.linear_act_int8``).  Returns a ``DiagnosticReport`` carrying
+    TPU404 findings for degenerate channels.
+    """
+    from .. import nn
+    if report is None:
+        report = DiagnosticReport(label="convert_to_int8")
+    for layer in model.sublayers(include_self=True):
+        if not isinstance(layer, nn.Linear):
+            continue
+        if "weight" not in layer._parameters:
+            continue  # already converted (or weightless)
+        w = layer._parameters["weight"]
+        w_q, scale = quantize_weight_int8(w, axis=1, report=report)
+        layer.weight = None
+        layer.register_buffer("weight_q", w_q, persistable=True)
+        layer.register_buffer("weight_scale", scale, persistable=True)
+    return report
+
+
+def logits_cosine(a, b):
+    """Cosine similarity between two logits tensors (flattened f32)."""
+    av = jnp.ravel(a._value if isinstance(a, Tensor)
+                   else jnp.asarray(a)).astype(jnp.float32)
+    bv = jnp.ravel(b._value if isinstance(b, Tensor)
+                   else jnp.asarray(b)).astype(jnp.float32)
+    denom = jnp.linalg.norm(av) * jnp.linalg.norm(bv) + 1e-12
+    return float(jnp.vdot(av, bv) / denom)
+
+
+def greedy_match_ratio(ref, hyp):
+    """Position-wise token agreement between two lists of greedy
+    sequences; length mismatches count as mismatched positions."""
+    match = total = 0
+    for a, b in zip(ref, hyp):
+        total += max(len(a), len(b))
+        match += sum(1 for x, y in zip(a, b) if x == y)
+    return match / max(total, 1)
 
 
 class FakeQuanterWithAbsMax:
@@ -111,6 +219,24 @@ class FakeQuanterWithAbsMax:
                 scale = Tensor(
                     jnp.maximum(jax.lax.stop_gradient(cur), 1e-8),
                     _internal=True, stop_gradient=True)
+        return quant_dequant(x, scale, self.bits)
+
+
+class _FixedQuanter:
+    """Frozen PTQ scale: reads its registered buffer on every call, so
+    an in-place ``set_state_dict`` load retargets the quant scale."""
+
+    def __init__(self, buf, bits=8):
+        self._buf = buf
+        self.bits = bits
+
+    @property
+    def _scale(self):
+        return self._buf._value
+
+    def __call__(self, x):
+        scale = Tensor(jnp.maximum(self._buf._value, 1e-8),
+                       _internal=True, stop_gradient=True)
         return quant_dequant(x, scale, self.bits)
 
 
@@ -254,22 +380,31 @@ class PTQ:
 
     def convert(self, model, inplace=True):
         """After calibration: replace observers with fixed-scale
-        fake-quant (so the exported graph carries the PTQ scales)."""
+        fake-quant (so the exported graph carries the PTQ scales).
+
+        Each frozen scale is registered as a persistable buffer on the
+        wrapper (``act_scale`` / ``w_scale``), so it lands in
+        ``state_dict`` and a later ``set_state_dict`` retargets the
+        quanter in place — calibration round-trips through
+        checkpoints."""
         if not inplace:
             import copy
             model = copy.deepcopy(model)
         for name, child in list(getattr(model, "_sub_layers",
                                         {}).items()):
             if isinstance(child, _QuantedWrapper):
-                for attr in ("_act_q", "_weight_q"):
+                for attr, bname in (("_act_q", "act_scale"),
+                                    ("_weight_q", "w_scale")):
                     q = getattr(child, attr)
                     obs = getattr(q, "observer", None)
                     if obs is not None:
-                        scale = obs.scale()
-                        fixed = FakeQuanterWithAbsMax()
-                        fixed._scale = jnp.asarray(scale, jnp.float32)
-                        fixed.moving_rate = 1.0  # frozen
-                        setattr(child, attr, fixed)
+                        buf = Tensor(
+                            jnp.asarray(obs.scale(), jnp.float32),
+                            _internal=True, stop_gradient=True)
+                        child.register_buffer(bname, buf,
+                                              persistable=True)
+                        setattr(child, attr,
+                                _FixedQuanter(buf, obs.bits))
             else:
                 self.convert(child)
         return model

@@ -106,6 +106,17 @@ for tag, (m, k, n) in [("bert_ffn", (768, 768, 3072)),
             x, w, b, "gelu_tanh").astype(f32).sum(), argnums=(0, 1, 2)),
         ((m, k), bf16), ((k, n), bf16), ((n,), bf16))
 
+# int8-weight matmul epilogue: dequant fused post-dot; the int8 operand
+# must hold the (32,128) minimum tile through Mosaic lowering
+for tag, (m, k, n) in [("bert_ffn", (768, 768, 3072)),
+                       ("uneven", (300, 768, 640))]:
+    ok &= aot_compile(
+        f"matmul_epilogue int8 fwd+bwd {tag}",
+        jax.grad(lambda x, w, s, b: pf.fused_linear_act_int8(
+            x, w, s, b, "gelu_tanh").astype(f32).sum(),
+            argnums=(0, 2, 3)),
+        ((m, k), bf16), ((k, n), jnp.int8), ((n,), f32), ((n,), bf16))
+
 # paged decode attention (scalar-prefetched block tables): the index
 # maps trace at lower time outside the _x32 scope, which is exactly
 # what this compile-only pipeline catches and interpret mode cannot
@@ -127,6 +138,21 @@ for tag, dt in [("f32", f32), ("bf16", bf16)]:
         ((T, H, D), dt), ((NB, H, bs, D), dt), ((NB, H, bs, D), dt),
         ((S, W), i32), ((S,), i32), ((4,), i32), ((4,), i32),
         ((4,), i32))
+
+# int8 ragged attention: quantized KV pools + per-slot f32 scale tables
+# prefetched next to the block tables and dequantized in-kernel
+for tag, dt in [("f32", f32), ("bf16", bf16)]:
+    bq = pr.ragged_q_block(dt)
+    T, H, D, bs, W, S, NB = 4 * bq, 8, 64, 16, 8, 8, 128
+    sc = ((NB, bs, pr.KV_SCALE_LANES), f32)
+    ok &= aot_compile(
+        f"ragged_attn int8kv {tag}",
+        lambda q, kp, vp, bt, cl, sid, qs, qv, ks, vs:
+            pr.ragged_paged_attention(q, kp, vp, bt, cl, sid, qs, qv,
+                                      k_scales=ks, v_scales=vs),
+        ((T, H, D), dt), ((NB, H, bs, D), jnp.int8),
+        ((NB, H, bs, D), jnp.int8), ((S, W), i32), ((S,), i32),
+        ((4,), i32), ((4,), i32), ((4,), i32), sc, sc)
 
 # softmax xent at LM-head shapes
 for tag, (rows, v) in [("bert", (768, 30522)), ("llama", (512, 32000))]:

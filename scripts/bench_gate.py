@@ -10,9 +10,11 @@ ROADMAP item 5: runs ``bench.py`` in a subprocess for a FRESH capture
 ``BENCH_LAST_GOOD.json`` rolling artifact that bench.py maintains, and
 compares every shared gated metric: higher-is-better throughput (the
 headline plus all ``*_tokens_per_sec`` / ``*_imgs_per_sec`` /
-``*_accept_rate`` entries in ``extra_metrics``) and lower-is-better
-latency (``*_p99_ttft_ms``).  Exits 1 iff any shared metric regressed
-by more than ``--threshold`` (default 5%) in its bad direction.
+``*_accept_rate`` entries in ``extra_metrics``), lower-is-better
+latency (``*_p99_ttft_ms``), and zero-tolerance quality parity
+(``*_greedy_match``: ANY drop below last-good refuses the capture).
+Exits 1 iff any shared metric regressed by more than ``--threshold``
+(default 5%) in its bad direction.
 
 The gate is HARD whenever a live fresh capture exists: a regression
 exits 1, and so does a live capture the gate cannot judge (platform
@@ -38,6 +40,9 @@ sys.path.insert(0, str(ROOT))
 GATE_SUFFIXES = ("_tokens_per_sec", "_imgs_per_sec", "_accept_rate")
 #: lower-is-better latency metrics: a RISE beyond the threshold fails
 LOW_SUFFIXES = ("_p99_ttft_ms", "_failover_recovery_ms", "_shed_rate")
+#: quality-parity metrics (int8 greedy match vs float): ZERO tolerance
+#: — ANY drop below last-good refuses the capture, threshold ignored
+QUALITY_SUFFIXES = ("_greedy_match",)
 
 
 def log(msg):
@@ -65,7 +70,8 @@ def gated_metrics(payload):
     if payload.get("metric") and payload.get("value", 0) > 0:
         out[payload["metric"]] = float(payload["value"])
     for name, v in (payload.get("extra_metrics") or {}).items():
-        if name.endswith(GATE_SUFFIXES + LOW_SUFFIXES) \
+        if name.endswith(GATE_SUFFIXES + LOW_SUFFIXES
+                         + QUALITY_SUFFIXES) \
                 and isinstance(v, (int, float)) and v > 0:
             out[name] = float(v)
     return out
@@ -103,10 +109,17 @@ def compare(last_good, fresh, threshold, only=None):
     for name in sorted(names):
         delta = new[name] / old[name] - 1.0
         verdict = "ok"
-        lower_better = name.endswith(LOW_SUFFIXES)
-        if (delta > threshold) if lower_better else (delta < -threshold):
-            verdict = "REGRESSION"
-            regressions.append(name)
+        if name.endswith(QUALITY_SUFFIXES):
+            # quality parity: any drop below last-good is a refusal
+            if new[name] < old[name]:
+                verdict = "REGRESSION"
+                regressions.append(name)
+        else:
+            lower_better = name.endswith(LOW_SUFFIXES)
+            if (delta > threshold) if lower_better \
+                    else (delta < -threshold):
+                verdict = "REGRESSION"
+                regressions.append(name)
         rows.append({"metric": name, "last_good": old[name],
                      "fresh": new[name], "delta": round(delta, 4),
                      "verdict": verdict})

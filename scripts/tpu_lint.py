@@ -143,8 +143,9 @@ def lint_gpt():
 def lint_pallas():
     """Fused-suite block plans vs the Mosaic tiling rules: flash
     attention (fwd + both backward passes), layernorm+residual and
-    matmul-epilogue fusion (fwd + bwd), paged decode attention, ragged
-    mixed prefill+decode attention."""
+    matmul-epilogue fusion (fwd + bwd, float and int8-weight), paged
+    decode attention, ragged mixed prefill+decode attention (float and
+    int8 KV)."""
     import jax.numpy as jnp
     from paddle_tpu import analysis
     from paddle_tpu.analysis.diagnostics import DiagnosticReport, record
@@ -164,6 +165,10 @@ def lint_pallas():
             r = analysis.audit_matmul_epilogue(
                 512, 768, 3072, dtype=dtype, direction=direction)
             report.extend(r.diagnostics)
+            r = analysis.audit_matmul_epilogue(
+                512, 768, 3072, dtype=dtype, direction=direction,
+                weight_dtype=jnp.int8)
+            report.extend(r.diagnostics)
     r = analysis.audit_paged_attention(num_heads=8, head_dim=64,
                                        block_size=16, num_blocks=64,
                                        dtype=jnp.bfloat16)
@@ -174,6 +179,13 @@ def lint_pallas():
                                             num_q_blocks=8,
                                             num_blocks=64,
                                             dtype=dtype)
+        report.extend(r.diagnostics)
+        r = analysis.audit_ragged_attention(num_heads=8, head_dim=64,
+                                            block_size=16,
+                                            num_q_blocks=8,
+                                            num_blocks=64,
+                                            dtype=dtype,
+                                            kv_dtype=jnp.int8)
         report.extend(r.diagnostics)
     for d in report.diagnostics:
         record(d)
