@@ -1,18 +1,24 @@
-"""LLM serving: paged KV cache with COW prefix caching, chunked-prefill
-continuous batching, the unified ragged generation engine, speculative
-decoding, SLO-aware multi-tenant scheduling, streaming delivery, and
-serving-tier fault tolerance (replica health/failover with
-deterministic replay, decode watchdog, load shedding).
+"""LLM serving: paged KV cache with COW prefix caching and HBM→host-RAM
+tiering, chunked-prefill continuous batching, the unified ragged
+generation engine, speculative decoding, SLO-aware multi-tenant
+scheduling, streaming delivery, serving-tier fault tolerance (replica
+health/failover with deterministic replay, decode watchdog, load
+shedding), and prefill/decode disaggregation.
 
 The multi-request generation layer over models/gpt.py — see
 README.md §"Serving" and §"Serving fault tolerance".  Entry point:
-``GenerationEngine`` (one replica) / ``DataParallelEngine`` (a fleet).
+``GenerationEngine`` (one replica) / ``DataParallelEngine`` (a fleet) /
+``DisaggregatedEngine`` (role-split prefill + decode engines).
 """
 from .kv_cache import (ENV_KV_BLOCK_SIZE, ENV_PREFIX_CACHE,
                        RESIDENT_NAME, PagedKVCache, kv_block_size,
                        prefix_cache_enabled)
+from .tiering import (ENV_KV_HOST_BUDGET, ENV_KV_TIERING,
+                      HandoffPayload, HostKVPool, kv_host_budget,
+                      kv_tiering_enabled)
 from .attention import (PagedCacheView, PagedLayerCache,
                         RaggedCacheView, RaggedLayerCache,
+                        kv_blocks_gather, kv_blocks_scatter,
                         kv_cache_scatter, paged_attention,
                         ragged_attention)
 from .scheduler import (ENV_MAX_BATCH, ENV_PREFILL_CHUNK,
@@ -34,12 +40,16 @@ from .engine import (ENV_SHED_DEPTH, ENV_STEP_DEADLINE_MS,
                      serving_sample_next)
 from .dp import (HEALTHY, PROBATION, UNHEALTHY, DataParallelEngine,
                  ReplicaHealth)
+from .disagg import DisaggregatedEngine
 
 __all__ = [
     "ENV_KV_BLOCK_SIZE", "ENV_PREFIX_CACHE", "RESIDENT_NAME",
     "PagedKVCache", "kv_block_size", "prefix_cache_enabled",
+    "ENV_KV_TIERING", "ENV_KV_HOST_BUDGET", "HandoffPayload",
+    "HostKVPool", "kv_tiering_enabled", "kv_host_budget",
     "PagedCacheView", "PagedLayerCache", "RaggedCacheView",
-    "RaggedLayerCache", "kv_cache_scatter", "paged_attention",
+    "RaggedLayerCache", "kv_blocks_gather", "kv_blocks_scatter",
+    "kv_cache_scatter", "paged_attention",
     "ragged_attention",
     "ENV_MAX_BATCH", "ENV_PREFILL_CHUNK", "ContinuousBatchingScheduler",
     "PrefillChunk", "Request", "max_batch_size", "prefill_chunk_size",
@@ -57,4 +67,5 @@ __all__ = [
     "GenerationEngine", "ragged_sample_next", "serving_sample_next",
     "DataParallelEngine", "ReplicaHealth",
     "HEALTHY", "PROBATION", "UNHEALTHY",
+    "DisaggregatedEngine",
 ]

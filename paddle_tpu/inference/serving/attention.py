@@ -42,9 +42,55 @@ from ...core.tensor import Tensor
 __all__ = ["kv_cache_scatter", "kv_cache_scatter_quant",
            "paged_attention", "ragged_attention",
            "PagedCacheView", "PagedLayerCache", "RaggedCacheView",
-           "RaggedLayerCache"]
+           "RaggedLayerCache", "kv_blocks_gather", "kv_blocks_scatter"]
 
 _NEG_INF = -1e30
+
+
+# ---------------------------------------------------------------------
+# whole-block DMA: pool blocks <-> host bytes (tiering / disaggregation)
+# ---------------------------------------------------------------------
+def kv_blocks_gather(cache, blocks):
+    """Dispatch device gathers of whole pool blocks across all layers
+    of a PagedKVCache: ``(k, v, k_scales, v_scales)`` lists (per layer)
+    of ``[nb, H, bs, D]`` / ``[nb, bs, lanes]`` device arrays, in
+    ``blocks`` order.  The gathers are async — the caller decides when
+    (and whether) to sync them to host, so spills/exports overlap with
+    compute.  Scale tables ride along for int8 pools (None otherwise):
+    block bytes without their dequant scales are garbage."""
+    import numpy as np
+    idx = jnp.asarray(np.asarray(blocks, np.int32))
+    k = [kp._value[idx] for kp, _ in cache._pools]
+    v = [vp._value[idx] for _, vp in cache._pools]
+    ks = [s._value[idx] for s, _ in cache._scales] or None
+    vs = [s._value[idx] for _, s in cache._scales] or None
+    return k, v, ks, vs
+
+
+def kv_blocks_scatter(cache, blocks, k_parts, v_parts, ks_parts=None,
+                      vs_parts=None):
+    """Device-put host block bytes into pool blocks (promotion /
+    import): per-layer ``[nb, H, bs, D]`` host arrays land in
+    ``blocks`` via one ``.at[idx].set`` per layer per side, through
+    ``_inplace_update`` so compiled step functions see the new
+    buffers.  Returns the updated pool values for pipeline-window
+    admission."""
+    import numpy as np
+    idx = jnp.asarray(np.asarray(blocks, np.int32))
+    puts = []
+    for i, (kp, vp) in enumerate(cache._pools):
+        kp._inplace_update(
+            kp._value.at[idx].set(jnp.asarray(k_parts[i])))
+        vp._inplace_update(
+            vp._value.at[idx].set(jnp.asarray(v_parts[i])))
+        puts.extend((kp._value, vp._value))
+    for i, (ksp, vsp) in enumerate(cache._scales):
+        ksp._inplace_update(
+            ksp._value.at[idx].set(jnp.asarray(ks_parts[i])))
+        vsp._inplace_update(
+            vsp._value.at[idx].set(jnp.asarray(vs_parts[i])))
+        puts.extend((ksp._value, vsp._value))
+    return puts
 
 
 # ---------------------------------------------------------------------

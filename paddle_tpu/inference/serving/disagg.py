@@ -1,0 +1,414 @@
+"""Prefill/decode disaggregation: role-split engines behind one front.
+
+Interleaved continuous batching (engine.py) makes every decode step pay
+for whatever prefill chunk shares it: a long prompt admission stretches
+the unified ragged step and every decode row's inter-token latency
+jitters with it.  This front splits the two phases onto **dedicated
+engines**:
+
+  prefill engines   ``role="prefill"``: run chunked prefill only (the
+                    scheduler never schedules decode rows), drain
+                    eagerly, and park each prompt-complete request —
+                    prompt K/V written, first token sampled — in
+                    ``running`` until the front extracts it
+  decode engines    ``role="decode"``: never admit raw prompts; they
+                    adopt handed-off requests via ``inject_request``
+                    and run pure decode steps, so their step time (and
+                    p99 TPOT) no longer carries prefill chunks
+
+The **handoff** is block-granular and rides the same host-RAM DMA path
+as KV tiering (tiering.py): ``extract_request`` gathers the sequence's
+blocks into a :class:`~.tiering.HandoffPayload` (per-block int8 scale
+tables ride along), frees them WITH tokens on the prefill side — so
+they stay prefix-indexed and the next shared-prompt prefill is still
+warm there — and ``inject_request`` scatters only the blocks the decode
+engine's prefix cache does not already hold.  Ownership moves with the
+payload: refcounts, COW chain hashes and scale tables arrive intact,
+so greedy AND seeded-sampling outputs are bit-identical to a colocated
+run (sampling is keyed by absolute position, which the handoff
+preserves).
+
+**Fault tolerance** mirrors dp.py: every engine carries a
+:class:`~.dp.ReplicaHealth` state machine and an injectable fault site
+(``serve.prefill_down.p<i>`` / ``serve.decode_down.d<i>``).  A prefill
+engine failure requeues its in-flight prompts (committed progress
+folds into the prompt) and replays them on surviving prefill engines;
+payloads already extracted are host-side and proceed untouched — a
+mid-handoff crash leaks zero blocks.  A decode engine failure routes
+its requests BACK through a prefill engine (they need a re-prefill),
+again bit-identically.  With no eligible target the work parks and
+:class:`~.errors.ServingUnavailable` raises, exactly like dp.py.
+
+Observability: engine work runs under ``obs.tag(shard="prefill<i>")`` /
+``"decode<i>"`` so phase_breakdown()["shards"] separates the two roles;
+``serving.handoffs`` counts completed transfers,
+``serving.handoff_wait_ms`` the queue latency between extract and
+inject, and ``serving.tpot_ms`` the per-request inter-token latency
+whose p99 (``stats()["tpot_p99_ms"]``) is the metric this topology
+exists to improve.
+"""
+from __future__ import annotations
+
+import time
+from collections import deque
+
+from ... import observability as obs
+from ...distributed.fault_tolerance.plan import fault_point
+from .dp import ReplicaHealth
+from .engine import GenerationEngine
+from .errors import ServingUnavailable
+from .streaming import TokenStream
+
+__all__ = ["DisaggregatedEngine"]
+
+
+class DisaggregatedEngine:
+    """Prefill/decode-disaggregated serving front (module doc).
+
+    ``prefill`` / ``decode`` size the two engine groups.  ``speculative``
+    (in ``engine_kwargs``) only applies to decode engines — a prefill
+    engine never decodes, so a draft model there would be dead weight.
+    When ``hbm_fraction`` is not given the single-engine default is
+    divided across ALL engines, so the combined pools claim no more HBM
+    than one colocated engine would.
+    """
+
+    def __init__(self, model, prefill=1, decode=1, hbm_fraction=None,
+                 fail_threshold=1, probation_policy=None, clock=None,
+                 **engine_kwargs):
+        self.n_prefill = int(prefill)
+        self.n_decode = int(decode)
+        if self.n_prefill < 1 or self.n_decode < 1:
+            raise ValueError("need at least one prefill and one decode "
+                             f"engine, got {prefill}/{decode}")
+        if hbm_fraction is None:
+            hbm_fraction = 0.3 / (self.n_prefill + self.n_decode)
+        self.clock = clock or time.monotonic
+        pf_kwargs = dict(engine_kwargs)
+        pf_kwargs.pop("speculative", None)
+        self.prefills = [
+            GenerationEngine(model, role="prefill",
+                             hbm_fraction=hbm_fraction,
+                             resident_name=f"kv cache blocks (prefill{i})",
+                             **pf_kwargs)
+            for i in range(self.n_prefill)
+        ]
+        self.decodes = [
+            GenerationEngine(model, role="decode",
+                             hbm_fraction=hbm_fraction,
+                             resident_name=f"kv cache blocks (decode{i})",
+                             **engine_kwargs)
+            for i in range(self.n_decode)
+        ]
+        self.phealth = [
+            ReplicaHealth(f"prefill{i}", policy=probation_policy,
+                          fail_threshold=fail_threshold,
+                          clock=self.clock)
+            for i in range(self.n_prefill)
+        ]
+        self.dhealth = [
+            ReplicaHealth(f"decode{i}", policy=probation_policy,
+                          fail_threshold=fail_threshold,
+                          clock=self.clock)
+            for i in range(self.n_decode)
+        ]
+        # handoff queue: [req, length, payload, stream, t_extract]
+        # lists (not tuples) so open_stream can attach mid-flight
+        self._handoff = deque()
+        self._owner = {}          # req_id -> ("p"|"d", idx) | ("h", None)
+        self._results = {}        # req_id -> finished Request
+        self._tpot = []           # per-request mean TPOT ms
+        self._req_counter = 0
+        self._handoffs = 0
+        self._failovers = 0
+        self._replays = 0
+
+    # -- routing ----------------------------------------------------------
+    @staticmethod
+    def _load(eng):
+        return (eng.scheduler.queue_depth + len(eng.scheduler.running)
+                + len(eng._pending))
+
+    def _route(self, engines, health, prompt, exclude=()):
+        """dp.py's affinity-with-skew-guard routing over one engine
+        group; raises ServingUnavailable when the group is down."""
+        eligible = [i for i in range(len(engines))
+                    if i not in exclude and health[i].eligible()]
+        if not eligible:
+            raise ServingUnavailable(
+                f"no healthy {health[0].name.rstrip('0123456789')} "
+                f"engine available (all {len(engines)} are unhealthy "
+                "and backing off)")
+        loads = {i: self._load(engines[i]) for i in eligible}
+        min_load = min(loads.values())
+        aff = {i: engines[i].cache.prefix_match_tokens(prompt)
+               for i in eligible}
+        best = max(eligible, key=lambda i: (aff[i], -loads[i], -i))
+        if (aff[best] > 0
+                and loads[best] - min_load <= engines[best].max_batch):
+            return best, aff[best]
+        best = min(eligible, key=lambda i: (loads[i], i))
+        return best, aff[best]
+
+    # -- public API -------------------------------------------------------
+    def add_request(self, prompt, request_id=None, **kwargs):
+        """Enqueue one prompt on the best prefill engine (prefix
+        affinity — host-tier prefixes count — then load)."""
+        if request_id is None:
+            request_id = f"dgreq{self._req_counter}"
+        self._req_counter += 1
+        prompt_list = [int(t) for t in prompt]
+        i, affinity = self._route(self.prefills, self.phealth,
+                                  prompt_list)
+        if affinity > 0:
+            obs.get_registry().counter("serving.prefix_routed").inc()
+        with obs.tag(shard=f"prefill{i}"):
+            self.prefills[i].add_request(prompt_list,
+                                         request_id=request_id,
+                                         **kwargs)
+        self._owner[request_id] = ("p", i)
+        return request_id
+
+    def has_unfinished(self):
+        return (bool(self._handoff)
+                or any(e.has_unfinished() for e in self.prefills)
+                or any(e.has_unfinished() for e in self.decodes))
+
+    def step(self):
+        """One front step: advance prefill engines, harvest and place
+        handoffs, advance decode engines.  Placement runs between the
+        two so a prompt finished THIS step starts decoding THIS step.
+        Returns the requests that finished, across all engines."""
+        finished = []
+        for i, eng in enumerate(self.prefills):
+            if not (eng.has_unfinished() and self.phealth[i].eligible()):
+                continue
+            try:
+                with obs.tag(shard=f"prefill{i}"):
+                    fault_point(f"serve.prefill_down.p{i}")
+                    finished.extend(eng.step())
+                    for req in eng.handoff_ready():
+                        payload, length, stream = eng.extract_request(req)
+                        self._handoff.append(
+                            [req, length, payload, stream, self.clock()])
+                        self._owner[req.id] = ("h", None)
+                self.phealth[i].record_success()
+            except Exception as e:
+                self._prefill_failover(i, e)
+        self._place_handoffs()
+        for j, eng in enumerate(self.decodes):
+            if not (eng.has_unfinished() and self.dhealth[j].eligible()):
+                continue
+            try:
+                with obs.tag(shard=f"decode{j}"):
+                    fault_point(f"serve.decode_down.d{j}")
+                    finished.extend(eng.step())
+                self.dhealth[j].record_success()
+            except Exception as e:
+                self._decode_failover(j, e)
+        for req in finished:
+            self._finish(req)
+        return finished
+
+    def _place_handoffs(self):
+        """Move queued payloads onto decode engines.  A payload that no
+        engine can seat right now (rows and blocks both full) stays
+        queued — its blocks live in host RAM, costing no HBM — and
+        retries next step."""
+        retry = deque()
+        while self._handoff:
+            item = self._handoff.popleft()
+            req, length, payload, stream, t0 = item
+            tokens = (list(req.prompt) + list(req.generated))[:length]
+            try:
+                j, _ = self._route(self.decodes, self.dhealth, tokens)
+            except ServingUnavailable:
+                retry.append(item)
+                break                     # group down: park everything
+            placed = False
+            order = [j] + [k for k in range(self.n_decode) if k != j]
+            for k in order:
+                if not self.dhealth[k].eligible():
+                    continue
+                with obs.tag(shard=f"decode{k}"):
+                    if self.decodes[k].inject_request(
+                            req, length, payload, stream=stream):
+                        placed = True
+                        break
+            if not placed:
+                retry.append(item)        # every engine full; next step
+                continue
+            self._owner[req.id] = ("d", k)
+            self._handoffs += 1
+            wait_ms = (self.clock() - t0) * 1e3
+            reg = obs.get_registry()
+            reg.counter("serving.handoffs").inc()
+            reg.histogram("serving.handoff_wait_ms").observe(wait_ms)
+        self._handoff.extendleft(reversed(retry))
+
+    def _finish(self, req):
+        self._results[req.id] = req
+        n = len(req.generated)
+        if (n > 1 and req.t_first_token is not None
+                and req.t_finish is not None):
+            tpot_ms = (req.t_finish - req.t_first_token) / (n - 1) * 1e3
+            self._tpot.append(tpot_ms)
+            obs.get_registry().histogram(
+                "serving.tpot_ms").observe(tpot_ms)
+
+    # -- failover ---------------------------------------------------------
+    def _harvest(self, eng):
+        """Requeue everything seated on a failed engine (committed
+        progress folds into the prompt) and return the requests to
+        replay.  Payloads already extracted are untouched: they are
+        host-side numpy, owned by the front, not the engine."""
+        for req in list(eng.scheduler.running):
+            if req.row is not None:
+                eng._rows[req.row] = None
+            if eng.proposer is not None:
+                eng.proposer.drop(req.id)
+            eng.scheduler.requeue(req, req.generated)
+        eng._pending.clear()      # replay regenerates these tokens
+        moved = list(eng.scheduler.waiting)
+        eng.scheduler.waiting.clear()
+        return moved
+
+    def _replay(self, eng, name, moved, exclude, t0, error):
+        """Resubmit harvested requests on surviving PREFILL engines
+        (a decode engine's refugees need their K/V rebuilt anyway;
+        requeue already folded generated tokens into the prompt, so
+        the replay is bit-identical and prefix-cache warm)."""
+        try:
+            for req in moved:
+                i, _ = self._route(self.prefills, self.phealth,
+                                   req.prompt, exclude=exclude)
+                self.prefills[i].scheduler.submit(req)
+                self._owner[req.id] = ("p", i)
+                st = eng._streams.pop(req.id, None)
+                if st is not None:
+                    self.prefills[i]._streams[req.id] = st
+        except ServingUnavailable:
+            for req in reversed(moved):
+                if self._owner.get(req.id, ("x",))[0] != "p":
+                    eng.scheduler.waiting.appendleft(req)
+            raise
+        recovery_ms = (self.clock() - t0) * 1e3
+        self._failovers += 1
+        self._replays += len(moved)
+        reg = obs.get_registry()
+        reg.counter("serving.failovers").inc()
+        reg.counter("serving.replays").inc(len(moved))
+        reg.histogram("serving.failover_recovery_ms").observe(recovery_ms)
+        obs.instant("serving.failover", cat="fault", replica=name,
+                    replayed=len(moved),
+                    recovery_ms=round(recovery_ms, 3),
+                    error=f"{type(error).__name__}: {error}"[:200])
+
+    def _prefill_failover(self, i, error):
+        t0 = self.clock()
+        self.phealth[i].record_failure()
+        eng = self.prefills[i]
+        moved = self._harvest(eng)
+        # requeue cleared owner rows; requests not yet extracted whose
+        # owner says ("p", i) replay elsewhere
+        self._replay(eng, f"prefill{i}", moved, exclude=(i,), t0=t0,
+                     error=error)
+
+    def _decode_failover(self, j, error):
+        t0 = self.clock()
+        self.dhealth[j].record_failure()
+        eng = self.decodes[j]
+        moved = self._harvest(eng)
+        self._replay(eng, f"decode{j}", moved, exclude=(), t0=t0,
+                     error=error)
+
+    # -- results / streams ------------------------------------------------
+    def generate(self, prompts, stream=False, **kwargs):
+        """Run a batch of prompts to completion across the topology.
+
+        ``stream=False``: one full token list per prompt, in order.
+        ``stream=True``: a generator of
+        :class:`~.streaming.StreamEvent` tuples — tokens keep flowing
+        across the prefill→decode handoff (the stream object rides the
+        payload)."""
+        if stream:
+            return self._generate_stream(prompts, **kwargs)
+        ids = [self.add_request(p, **kwargs) for p in prompts]
+        while self.has_unfinished():
+            self.step()
+        return [self.result(i) for i in ids]
+
+    def open_stream(self, request_id):
+        """Live token queue for a request, wherever it currently is —
+        prefill engine, handoff queue, or decode engine."""
+        kind, idx = self._owner[request_id]
+        if kind == "h":
+            for item in self._handoff:
+                if item[0].id == request_id:
+                    if item[3] is None:
+                        item[3] = TokenStream(request_id)
+                    return item[3]
+            raise KeyError(request_id)
+        eng = (self.prefills if kind == "p" else self.decodes)[idx]
+        return eng.open_stream(request_id)
+
+    def _generate_stream(self, prompts, **kwargs):
+        ids = [self.add_request(p, **kwargs) for p in prompts]
+        streams = [self.open_stream(i) for i in ids]
+        try:
+            while True:
+                if self.has_unfinished():
+                    self.step()
+                for st in streams:
+                    for ev in st.drain():
+                        yield ev
+                if all(st.done for st in streams):
+                    return
+        finally:
+            for i in ids:
+                for eng in self.prefills + self.decodes:
+                    eng._streams.pop(i, None)
+
+    def result(self, request_id):
+        """Full token sequence of a finished request."""
+        req = self._results[request_id]
+        return list(req.prompt) + list(req.generated)
+
+    # -- bookkeeping ------------------------------------------------------
+    def stats(self):
+        """Aggregate totals plus ``per_engine`` and ``replica_health``
+        breakdowns and the headline ``tpot_p99_ms``."""
+        per_engine = {}
+        total = {"tokens_generated": 0, "queue_depth": 0, "running": 0,
+                 "step_compiles": 0, "shed_requests": 0,
+                 "step_timeouts": 0, "alloc_fails": 0,
+                 "host_spills": 0, "host_promotes": 0}
+        groups = [("prefill", self.prefills), ("decode", self.decodes)]
+        for role, engines in groups:
+            for i, eng in enumerate(engines):
+                s = eng.stats()
+                per_engine[f"{role}{i}"] = s
+                for k in total:
+                    total[k] += int(s.get(k, 0))
+        total["prefill_engines"] = self.n_prefill
+        total["decode_engines"] = self.n_decode
+        total["handoffs"] = self._handoffs
+        total["handoff_queued"] = len(self._handoff)
+        total["failovers"] = self._failovers
+        total["replays"] = self._replays
+        if self._tpot:
+            srt = sorted(self._tpot)
+            total["tpot_p99_ms"] = srt[
+                min(len(srt) - 1, int(0.99 * len(srt)))]
+            total["tpot_mean_ms"] = sum(srt) / len(srt)
+        else:
+            total["tpot_p99_ms"] = 0.0
+            total["tpot_mean_ms"] = 0.0
+        total["replica_health"] = {
+            h.name: h.snapshot() for h in self.phealth + self.dhealth}
+        total["per_engine"] = per_engine
+        return total
+
+    def close(self):
+        for eng in self.prefills + self.decodes:
+            eng.close()

@@ -7,6 +7,11 @@ pool** and scheduler.  Decode batches on different replicas advance
 independently, so one replica draining a long prefill never stalls
 another's decode loop.
 
+For the phase-split topology — dedicated prefill replicas handing
+paged KV state to dedicated decode replicas — see
+:class:`~.disagg.DisaggregatedEngine`, which reuses this module's
+:class:`ReplicaHealth` and routing machinery per tier.
+
 **Prefix-cache-aware routing** (ISSUE 12): a request routes to the
 replica whose paged pool already holds the longest cached prefix of its
 prompt (``PagedKVCache.prefix_match_tokens`` walks the same block chain

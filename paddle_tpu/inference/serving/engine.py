@@ -209,12 +209,21 @@ class GenerationEngine:
                  prefill_chunk=None, hbm_fraction=0.3,
                  prefix_cache=None, speculative=None, slo=None,
                  step_deadline_ms=None, shed_depth=None, clock=None,
-                 kv_cache_dtype=None, weight_dtype=None):
+                 kv_cache_dtype=None, weight_dtype=None,
+                 role="colocated", kv_tiering=None, kv_host_budget=None,
+                 resident_name=None):
         import paddle_tpu as paddle
         cfg = config or getattr(model, "config", None) \
             or model.gpt.config
         self.model = model
         model.eval()
+        # disaggregated topology (disagg.py): a "prefill" engine runs
+        # chunked prefill only and hands prompt-complete requests off;
+        # a "decode" engine adopts them via inject_request.  The
+        # default "colocated" interleaves both in one step as before.
+        if role not in ("colocated", "prefill", "decode"):
+            raise ValueError(f"unknown engine role {role!r}")
+        self.role = role
         if weight_dtype is None:
             weight_dtype = os.environ.get(ENV_WEIGHT_DTYPE) or None
         if weight_dtype is not None and str(weight_dtype) == "int8":
@@ -233,7 +242,8 @@ class GenerationEngine:
             num_layers, num_heads, head_dim, dtype=kv_cache_dtype,
             block_size=block_size, num_blocks=num_blocks,
             max_model_len=self.max_model_len, hbm_fraction=hbm_fraction,
-            prefix_cache=prefix_cache)
+            prefix_cache=prefix_cache, tiering=kv_tiering,
+            host_budget=kv_host_budget, resident_name=resident_name)
         self.max_batch = int(max_batch or max_batch_size())
 
         # unified step geometry: one prefill chunk (padded to whole
@@ -257,7 +267,8 @@ class GenerationEngine:
         self.slo = slo
         self.scheduler = ContinuousBatchingScheduler(
             self.cache, self.max_batch, self.prefill_chunk,
-            victim_policy=slo, admission_policy=slo, budget_policy=slo)
+            victim_policy=slo, admission_policy=slo, budget_policy=slo,
+            prefill_only=(self.role == "prefill"))
 
         # speculative decoding (speculative.py): verify segments are
         # k+1 tokens wide and must fit one q-block
@@ -391,7 +402,11 @@ class GenerationEngine:
                 self._run_step(payload)
         elif self._pending:
             self._drain(0)       # nothing to schedule: retire in flight
-        self._drain(max(0, pipeline_depth() - 1))
+        # a prefill engine drains eagerly: its product is a handoff,
+        # and extract_request needs no token still in flight
+        lag = 0 if self.role == "prefill" \
+            else max(0, pipeline_depth() - 1)
+        self._drain(lag)
         self._collect_finished()
         reg = obs.get_registry()
         reg.gauge("serving.queue_depth").set(self.scheduler.queue_depth)
@@ -400,6 +415,74 @@ class GenerationEngine:
             obs.instant("serving.tenant.tokens", cat="decode",
                         step=self._step_idx, tenant=t, n=n)
         return list(self._step_finished)
+
+    # -- disaggregated handoff (disagg.py) -------------------------------
+    def handoff_ready(self):
+        """Requests whose prompt K/V is complete and first token is
+        sampled — a prefill engine's finished product, waiting to move
+        to a decode engine."""
+        return [r for r in self.scheduler.running
+                if not r.done and not r.prefilling and r.generated]
+
+    def extract_request(self, req):
+        """Pull a prompt-complete request out of this engine together
+        with its paged KV state as a host payload.  The request leaves
+        running and its blocks are freed WITH their tokens, so they
+        park prefix-indexed: the next request sharing this prompt
+        still prefills warm here.  Returns (payload, length,
+        stream)."""
+        if req not in self.scheduler.running:
+            raise KeyError(f"{req.id!r} is not running here")
+        if req.prefilling or not req.generated:
+            raise ValueError(f"{req.id!r} is not handoff-ready")
+        if self._pending:
+            self._drain(0)        # no token may still be in flight
+        length = self.cache.length(req.id)
+        payload = self.cache.export_sequence(req.id)
+        tokens = (list(req.prompt) + list(req.generated))[:length]
+        if req.row is not None:
+            self._rows[req.row] = None
+            req.row = None
+        self.scheduler.running.remove(req)
+        self.cache.free(req.id, tokens=tokens)
+        if self.proposer is not None:
+            self.proposer.drop(req.id)
+        stream = self._streams.pop(req.id, None)
+        obs.instant("serving.handoff_out", cat="prefill",
+                    request=req.id, blocks=payload.num_blocks)
+        return payload, length, stream
+
+    def inject_request(self, req, length, payload, stream=None):
+        """Seat a request whose prompt K/V was prefilled on ANOTHER
+        engine (disaggregated decode).  Imports the blocks through the
+        local prefix cache (already-cached blocks are skipped, not
+        copied), seats a batch row, and primes the device-side token
+        feed with the request's last sampled token — the next decode
+        step proceeds exactly as if the prefill had run here.  Returns
+        False (nothing mutated) when no row or blocks are available."""
+        if req.id in self.cache:
+            raise KeyError(f"sequence {req.id!r} already allocated")
+        if None not in self._rows:
+            return False
+        tokens = (list(req.prompt) + list(req.generated))[:length]
+        if not self.cache.import_sequence(req.id, tokens, length,
+                                          payload):
+            return False
+        row = self._rows.index(None)
+        self._rows[row] = req
+        req.row = row
+        req.num_computed = len(req.prompt)
+        req.cached_prefix = self.cache.cached_prefix_len(req.id)
+        self.scheduler.adopt(req)
+        # the colocated engine's own prefill would have left the first
+        # sampled token in this row's slot of _last_tokens; recreate it
+        self._last_tokens = self._last_tokens.at[row].set(
+            int(req.generated[-1]))
+        if stream is not None:
+            self._streams[req.id] = stream
+        obs.instant("serving.handoff_in", cat="decode",
+                    request=req.id, blocks=payload.num_blocks)
+        return True
 
     def generate(self, prompts, stream=False, **kwargs):
         """Run a batch of prompts to completion.
@@ -457,7 +540,8 @@ class GenerationEngine:
         compiles = len(self._step_fn._cache)
         if self.proposer is not None:
             compiles += self.proposer.step_compiles
-        s.update(queue_depth=self.scheduler.queue_depth,
+        s.update(role=self.role,
+                 queue_depth=self.scheduler.queue_depth,
                  running=len(self.scheduler.running),
                  tokens_generated=self._tokens_generated,
                  tokens_drafted=self._tokens_drafted,
@@ -964,6 +1048,9 @@ class GenerationEngine:
             if req.done:
                 if req.row is not None:
                     self._rows[req.row] = None
+                # same wall clock as t_first_token so per-request TPOT
+                # ((t_finish - t_first_token) / (n-1)) is consistent
+                req.t_finish = time.perf_counter()
                 self.scheduler.finish(req)
                 if self.proposer is not None:
                     self.proposer.drop(req.id)

@@ -62,15 +62,35 @@ Utilization rides the observability registry: gauges
 ``serving.kv_blocks_total`` / ``serving.kv_blocks_in_use`` /
 ``serving.kv_utilization`` / ``serving.kv_blocks_shared`` /
 ``serving.prefix_hit_rate`` plus a host-side high-water mark.
+
+**Host tiering** (tiering.py, ``PADDLE_TPU_KV_TIERING`` /
+``PADDLE_TPU_KV_HOST_BUDGET``): when an LRU eviction would delete a
+still-indexed refcount-0 block, its bytes (and int8 scale rows) are
+demoted to a bounded host-RAM ring instead and the chain-hash entry
+follows them.  The chain walk then resolves each link against BOTH
+tiers — an HBM hit is shared in place, a host hit is promoted back
+(fresh block + ``device_put``) and counts as cached tokens exactly
+like an HBM hit, so the effective prefix cache is host-RAM sized.
+A hash lives in exactly one tier at a time: indexing a block in HBM
+drops any host twin, and spilling only happens at the moment the HBM
+copy is evicted.  ``truncate`` bumps a *commit generation* and
+``commit_prefix`` re-verifies stored hashes against the actual tokens,
+so a truncated-then-regrown sequence can never re-index — or promote —
+a stale entry.  ``export_sequence`` / ``import_sequence`` reuse the
+same host representation to move a whole sequence between pools
+(the disaggregated prefill→decode handoff, serving/disagg.py).
 """
 from __future__ import annotations
 
 import os
+import time
 from collections import OrderedDict
 
 import numpy as np
 
 from ... import observability as obs
+from .tiering import (HandoffPayload, HostKVPool, _dma_span, _observe_dma,
+                      kv_host_budget, kv_tiering_enabled)
 
 __all__ = ["ENV_KV_BLOCK_SIZE", "ENV_PREFIX_CACHE", "kv_block_size",
            "prefix_cache_enabled", "PagedKVCache", "RESIDENT_NAME"]
@@ -116,7 +136,7 @@ class PagedKVCache:
     def __init__(self, num_layers, num_heads, head_dim, dtype="float32",
                  block_size=None, num_blocks=None, max_model_len=None,
                  hbm_fraction=0.3, register=True, prefix_cache=None,
-                 resident_name=None):
+                 resident_name=None, tiering=None, host_budget=None):
         import jax.numpy as jnp
         from ...core.dtypes import to_jax_dtype
         from ...core.tensor import Tensor
@@ -191,10 +211,44 @@ class PagedKVCache:
         self._lookup_tokens = 0  # prompt tokens that consulted the index
         self.cow_splits = 0    # COW block copies performed, cumulative
         self.high_water = 0    # max blocks in use, ever
+        # -- host tier (tiering.py) --------------------------------------
+        # evicted-but-indexed blocks spill into a bounded host ring; a
+        # chain hash lives in EXACTLY one tier (_by_hash xor _host_of)
+        if tiering is None:
+            tiering = kv_tiering_enabled() and kv_host_budget() is not None
+        if host_budget is None:
+            host_budget = kv_host_budget()
+        if tiering and host_budget is None:
+            # explicit tiering=True with no budget: mirror the HBM pool
+            host_budget = self.pool_bytes
+        host_slots = (int(host_budget) // self.bytes_per_block
+                      if tiering and host_budget else 0)
+        self.host = None
+        if host_slots >= 1:
+            # _jdtype is a numpy dtype (ml_dtypes covers bf16), so the
+            # host ring stores the exact on-device representation
+            self.host = HostKVPool(
+                self.num_layers, self.num_heads, self.block_size,
+                self.head_dim, self._jdtype, self.scale_lanes,
+                host_slots)
+        self._host_of = {}     # chain hash -> host ring slot
+        self._host_hash = {}   # host ring slot -> chain hash
+        self._host_lru = OrderedDict()  # slot -> None, eviction order
+        self._host_pin = set()  # slots an in-progress allocate holds
+        self._host_gen = {}    # slot -> commit generation at spill time
+        #: bumped by truncate(): the stale-guard epoch — a host entry
+        #: spilled before a truncate is verified, never blindly trusted
+        self._commit_gen = 0
+        self.host_spills = 0
+        self.host_promotes = 0
+        self.host_evictions = 0
+        self.stale_hash_drops = 0
+        self._host_hit_tokens = 0
         # a second pool in the same process (the speculative draft
         # cache) charges its own line item so HBM triage separates them
         self.resident_name = resident_name or RESIDENT_NAME
         self._registered = False
+        self._host_registered = False
         if register:
             self._register_resident()
         self._update_gauges()
@@ -220,6 +274,16 @@ class PagedKVCache:
                                 for kv in (self._pools + self._scales)
                                 for t in kv})
         self._registered = True
+        if self.host is not None:
+            # host=True: a named line item for triage, NOT charged
+            # against the device HBM preflight
+            register_resident(self.host_resident_name,
+                              self.host.nbytes, host=True)
+            self._host_registered = True
+
+    @property
+    def host_resident_name(self):
+        return f"{self.resident_name} host tier"
 
     def close(self):
         """Drop the memory-guard charge (the pool itself dies with the
@@ -228,6 +292,10 @@ class PagedKVCache:
             from ...memory.guard import unregister_resident
             unregister_resident(self.resident_name)
             self._registered = False
+        if self._host_registered:
+            from ...memory.guard import unregister_resident
+            unregister_resident(self.host_resident_name, host=True)
+            self._host_registered = False
 
     # -- pool tensors ----------------------------------------------------
     def layer_pools(self, layer):
@@ -279,12 +347,15 @@ class PagedKVCache:
         admission that consumed them could be preempted right back out
         by the very decode appends it displaced, and the retry would
         livelock."""
-        hits = self._prefix_hits(tokens, num_tokens)
-        need = self.blocks_needed(num_tokens) - len(hits)
+        chain = self._walk_chain(tokens, num_tokens)
+        hbm_hits = [ref for _, kind, ref in chain if kind == "hbm"]
+        # a HOST hit still consumes a physical block (the promotion
+        # DMAs into a fresh one) — only HBM hits reduce the need
+        need = self.blocks_needed(num_tokens) - len(hbm_hits)
         # same capacity formula as allocate(): a parked hit block is
         # reactivated, not consumed — but it must not ALSO be counted
         # as evictable free capacity
-        hits_parked = sum(1 for b in hits if b in self._cached_free)
+        hits_parked = sum(1 for b in hbm_hits if b in self._cached_free)
         capacity = (len(self._free)
                     + len(self._cached_free) - hits_parked)
         return need + int(headroom) <= capacity
@@ -299,13 +370,17 @@ class PagedKVCache:
             prev = str(self._jdtype)
         return hash((prev, tuple(int(t) for t in block_tokens)))
 
-    def _prefix_hits(self, tokens, num_tokens):
-        """Indexed blocks covering the longest cached block-aligned
-        prefix of ``tokens``, capped so at least one of ``num_tokens``
-        is still computed (the model must produce logits)."""
-        hits = []
+    def _walk_chain(self, tokens, num_tokens):
+        """``[(hash, tier, ref)]`` for the longest cached block-aligned
+        prefix of ``tokens``, resolved against BOTH tiers: ``("hbm",
+        block_id)`` entries are sharable in place, ``("host", slot)``
+        entries need promotion.  Capped so at least one of
+        ``num_tokens`` is still computed (the model must produce
+        logits).  Read-only — safe from ``can_allocate`` and the
+        affinity router."""
+        chain = []
         if not self.prefix_cache or tokens is None:
-            return hits
+            return chain
         bs = self.block_size
         h = None
         max_reuse = int(num_tokens) - 1   # leave >= 1 token to compute
@@ -314,22 +389,137 @@ class PagedKVCache:
                 break
             h = self._chain_hash(h, tokens[b * bs:(b + 1) * bs])
             blk = self._by_hash.get(h)
-            if blk is None:
+            if blk is not None:
+                chain.append((h, "hbm", blk))
+                continue
+            slot = self._host_of.get(h)
+            if slot is not None:
+                chain.append((h, "host", slot))
+                continue
+            break
+        return chain
+
+    def _prefix_hits(self, tokens, num_tokens):
+        """HBM-resident blocks covering the longest cached prefix that
+        needs NO promotion DMA (legacy view of ``_walk_chain``)."""
+        hits = []
+        for _, kind, ref in self._walk_chain(tokens, num_tokens):
+            if kind != "hbm":
                 break
-            hits.append(blk)
+            hits.append(ref)
         return hits
 
     def _take_block(self):
         """One writable block: prefer virgin free blocks, else evict
         the least-recently-used refcount-0 cached block (de-indexing
-        its hash — the prefix is gone once the block is reused)."""
+        its hash).  With tiering the evicted block's bytes are demoted
+        to the host ring first — the prefix survives, one DMA away."""
         if self._free:
             return self._free.pop()
         blk, _ = self._cached_free.popitem(last=False)
         h = self._hash_of.pop(blk, None)
         if h is not None and self._by_hash.get(h) == blk:
             del self._by_hash[h]
+            if self.host is not None:
+                self._spill(blk, h)
         return blk
+
+    # -- host tier -------------------------------------------------------
+    def _host_take_slot(self):
+        """A writable host ring slot, evicting the host-LRU entry if
+        the ring is full (pinned slots — promotions in flight for the
+        current allocate — are never victims).  None when every slot is
+        pinned."""
+        slot = self.host.take()
+        if slot is not None:
+            return slot
+        for victim in self._host_lru:
+            if victim not in self._host_pin:
+                self._drop_host(self._host_hash[victim])
+                self.host_evictions += 1
+                obs.get_registry().counter(
+                    "serving.host_evictions").inc()
+                return self.host.take()
+        return None
+
+    def _drop_host(self, h):
+        """Remove a chain hash's host entry (if any) and return its
+        ring slot to the free list.  Called whenever the hash becomes
+        canonical in HBM again — a hash lives in exactly one tier — and
+        when a stale entry is invalidated."""
+        slot = self._host_of.pop(h, None)
+        if slot is None:
+            return
+        self._host_hash.pop(slot, None)
+        self._host_lru.pop(slot, None)
+        self._host_gen.pop(slot, None)
+        self.host.give(slot)
+
+    def _spill(self, blk, h):
+        """Demote an evicted, still-indexed block's bytes to the host
+        ring.  The device gathers are dispatched first and admitted
+        into the in-flight pipeline window (bounding outstanding DMA
+        like any compute step), then landed host-side."""
+        if h in self._host_of:            # content already host-resident
+            self._host_lru.move_to_end(self._host_of[h])
+            return
+        slot = self._host_take_slot()
+        if slot is None:                  # ring exhausted by pins
+            return
+        from ...core.pipeline import get_window
+        t0 = time.perf_counter()
+        with _dma_span("spill", self.bytes_per_block, block=blk):
+            ks = [k._value[blk] for k, _ in self._pools]
+            vs = [v._value[blk] for _, v in self._pools]
+            kss = vss = None
+            if self.quantized:
+                kss = [s._value[blk] for s, _ in self._scales]
+                vss = [s._value[blk] for _, s in self._scales]
+            get_window().admit(ks + vs, label="kv:dma:spill")
+            self.host.write(
+                slot, [np.asarray(x) for x in ks],
+                [np.asarray(x) for x in vs],
+                kss and [np.asarray(x) for x in kss],
+                vss and [np.asarray(x) for x in vss])
+        _observe_dma("spill", self.bytes_per_block,
+                     time.perf_counter() - t0)
+        self._host_of[h] = slot
+        self._host_hash[slot] = h
+        self._host_gen[slot] = self._commit_gen
+        self._host_lru[slot] = None
+        self.host_spills += 1
+        obs.get_registry().counter("serving.host_spills").inc()
+
+    def _promote(self, slot, blk, h):
+        """Bring a host-resident prefix block back: ``device_put`` the
+        ring slot's bytes (+ scale rows) into a freshly taken block and
+        make the hash canonical in HBM again (dropping the host entry —
+        one tier per hash)."""
+        import jax.numpy as jnp
+        from ...core.pipeline import get_window
+        k_parts, v_parts, ks_parts, vs_parts = self.host.read(slot)
+        t0 = time.perf_counter()
+        with _dma_span("promote", self.bytes_per_block, block=blk):
+            puts = []
+            for i, (k, v) in enumerate(self._pools):
+                k._inplace_update(
+                    k._value.at[blk].set(jnp.asarray(k_parts[i])))
+                v._inplace_update(
+                    v._value.at[blk].set(jnp.asarray(v_parts[i])))
+                puts.extend((k._value, v._value))
+            for i, (ks, vs) in enumerate(self._scales):
+                ks._inplace_update(
+                    ks._value.at[blk].set(jnp.asarray(ks_parts[i])))
+                vs._inplace_update(
+                    vs._value.at[blk].set(jnp.asarray(vs_parts[i])))
+            get_window().admit(puts, label="kv:dma:promote")
+        _observe_dma("promote", self.bytes_per_block,
+                     time.perf_counter() - t0)
+        self._hash_of[blk] = h
+        self._by_hash[h] = blk
+        self._drop_host(h)
+        self.host_promotes += 1
+        obs.get_registry().counter("serving.host_promotes").inc()
 
     def _activate(self, blk):
         """Bring a hit block into a table (refcount += 1; un-park it
@@ -368,25 +558,45 @@ class PagedKVCache:
         # pool mutation, so a failed admission provably leaks nothing.
         from ...distributed.fault_tolerance.plan import fault_point
         fault_point("serve.alloc_fail")
-        hits = self._prefix_hits(tokens, num_tokens)
-        need = self.blocks_needed(num_tokens) - len(hits)
-        hits_parked = sum(1 for b in hits if b in self._cached_free)
+        chain = self._walk_chain(tokens, num_tokens)
+        hbm_hits = [ref for _, kind, ref in chain if kind == "hbm"]
+        host_slots = [ref for _, kind, ref in chain if kind == "host"]
+        # host hits avoid the RECOMPUTE but still need a physical block
+        # each (the promotion DMAs into a fresh one)
+        need = self.blocks_needed(num_tokens) - len(hbm_hits)
+        hits_parked = sum(1 for b in hbm_hits if b in self._cached_free)
         if need > len(self._free) + (len(self._cached_free)
                                      - hits_parked):
             return False
-        for blk in hits:
+        # activate ALL HBM hits before any _take_block so an eviction
+        # for a fresh/promoted block can't consume a later chain hit;
+        # pin the host slots so our own spills can't evict them either
+        for blk in hbm_hits:
             self._activate(blk)
-        table = list(hits)
-        for _ in range(need):
-            blk = self._take_block()
-            self._ref[blk] = 1
-            table.append(blk)
+        self._host_pin.update(host_slots)
+        try:
+            table = []
+            for h, kind, ref in chain:
+                if kind == "hbm":
+                    table.append(ref)
+                else:
+                    blk = self._take_block()
+                    self._promote(ref, blk, h)
+                    self._ref[blk] = 1
+                    table.append(blk)
+            for _ in range(self.blocks_needed(num_tokens) - len(table)):
+                blk = self._take_block()
+                self._ref[blk] = 1
+                table.append(blk)
+        finally:
+            self._host_pin.difference_update(host_slots)
         self._tables[seq_id] = table
         self._lengths[seq_id] = int(num_tokens)
-        cached = len(hits) * self.block_size
+        cached = len(chain) * self.block_size
         self._cached_len[seq_id] = cached
         if self.prefix_cache and tokens is not None:
             self._hit_tokens += cached
+            self._host_hit_tokens += len(host_slots) * self.block_size
             self._lookup_tokens += int(num_tokens)
         self._update_gauges()
         return True
@@ -395,13 +605,16 @@ class PagedKVCache:
         """How many leading tokens of ``tokens`` this pool could serve
         from its prefix cache RIGHT NOW, without allocating anything.
         Used by the data-parallel router to send a request (or a
-        failover replay) to the replica already holding its prefix."""
+        failover replay) to the replica already holding its prefix.
+        HOST-resident chain links count too — a replica whose prefix
+        spilled to its host ring is still the warm target, one
+        promotion DMA away instead of a full re-prefill."""
         if tokens is None:
             return 0
         # num_tokens = len+1 lifts the "leave one to compute" cap so a
         # full-prompt match counts every block.
-        hits = self._prefix_hits(tokens, len(tokens) + 1)
-        return len(hits) * self.block_size
+        chain = self._walk_chain(tokens, len(tokens) + 1)
+        return len(chain) * self.block_size
 
     def cached_prefix_len(self, seq_id):
         """Prompt tokens served from the prefix cache at allocate()
@@ -411,8 +624,15 @@ class PagedKVCache:
     def commit_prefix(self, seq_id, tokens):
         """Index every FULL block covered by ``tokens`` (the sequence's
         written prefix so far) into the prefix cache.  Called by the
-        engine after each prefill chunk lands; blocks already indexed
-        (cache hits) just extend the chain."""
+        engine after each prefill chunk lands.
+
+        The chain hash is always RECOMPUTED from ``tokens`` and
+        verified against a block's stored hash instead of trusted: a
+        sequence that truncated mid-chain and regrew with different
+        tokens would otherwise keep (and re-anchor!) its stale index
+        entry, and a host twin spilled under that hash could later
+        promote stale bytes into a fresh allocation.  A mismatch
+        de-indexes the block in BOTH tiers before re-indexing."""
         if not self.prefix_cache:
             return
         bs = self.block_size
@@ -421,14 +641,34 @@ class PagedKVCache:
         h = None
         for b in range(n):
             blk = table[b]
-            if blk in self._hash_of:
-                h = self._hash_of[blk]
-                continue
             h = self._chain_hash(h, tokens[b * bs:(b + 1) * bs])
+            stored = self._hash_of.get(blk)
+            if stored is not None:
+                if stored == h:
+                    # content verified canonical in HBM: any host twin
+                    # of this hash is redundant — drop it so a stale
+                    # copy can never outlive the live block
+                    self._drop_host(h)
+                    continue
+                # stale index entry (truncated-then-regrown sequence)
+                if self._ref.get(blk, 1) == 1:
+                    del self._hash_of[blk]
+                    if self._by_hash.get(stored) == blk:
+                        del self._by_hash[stored]
+                    self._drop_host(stored)
+                    self.stale_hash_drops += 1
+                    obs.instant("serving.stale_hash", cat="prefill",
+                                block=blk, gen=self._commit_gen)
+                else:
+                    # shared block whose canonical content differs from
+                    # OUR tokens: leave the other owners' index alone
+                    # and do not claim the hash for this block
+                    continue
             other = self._by_hash.get(h)
             if other is None:
                 self._hash_of[blk] = h
                 self._by_hash[h] = blk
+                self._drop_host(h)
             # duplicate content under another canonical block: leave
             # this one unindexed, future lookups hit the canonical one
 
@@ -455,6 +695,9 @@ class PagedKVCache:
             h = self._hash_of.pop(blk)
             if self._by_hash.get(h) == blk:
                 del self._by_hash[h]
+            # the write invalidates the content this hash names; a host
+            # twin spilled under it would be just as stale
+            self._drop_host(h)
 
     def _copy_block(self, src, dst):
         """Device-side block copy, all layers (the COW split).  Int8
@@ -506,6 +749,24 @@ class PagedKVCache:
         keep = self.blocks_needed(length)
         while len(table) > keep:
             self._release(table.pop())
+        if length < self._lengths[seq_id]:
+            # stale-guard epoch: anything spilled to the host ring
+            # before this point must be re-verified against recomputed
+            # token hashes before it can be trusted again
+            self._commit_gen += 1
+        if length % self.block_size:
+            # the new end cuts INTO a block; if that block is indexed
+            # and exclusively ours, the regrow will overwrite its tail
+            # — de-index it (both tiers) now rather than trusting the
+            # commit-time verify alone
+            idx = length // self.block_size
+            if idx < len(table):
+                blk = table[idx]
+                if self._ref.get(blk, 1) == 1 and blk in self._hash_of:
+                    h = self._hash_of.pop(blk)
+                    if self._by_hash.get(h) == blk:
+                        del self._by_hash[h]
+                    self._drop_host(h)
         self._lengths[seq_id] = length
         self._update_gauges()
 
@@ -542,6 +803,127 @@ class PagedKVCache:
         """Fraction of looked-up prompt tokens served from the cache."""
         return self._hit_tokens / max(1, self._lookup_tokens)
 
+    @property
+    def host_hit_rate(self):
+        """Fraction of looked-up prompt tokens served from the HOST
+        tier specifically (promotions; subset of prefix_hit_rate)."""
+        return self._host_hit_tokens / max(1, self._lookup_tokens)
+
+    # -- cross-pool transfer (disaggregated prefill -> decode) -----------
+    def export_sequence(self, seq_id):
+        """The sequence's paged KV state as a host-side
+        :class:`HandoffPayload` — per-layer stacked block data (+ int8
+        scale tables) in table order, read with one device gather per
+        layer per side through the same DMA accounting as the host
+        tier.  The sequence stays allocated; callers typically
+        ``free(tokens=...)`` afterwards so the blocks park
+        prefix-indexed for the NEXT request sharing the prompt."""
+        from .attention import kv_blocks_gather
+        from ...core.pipeline import get_window
+        table = self._tables[seq_id]
+        nbytes = len(table) * self.bytes_per_block
+        t0 = time.perf_counter()
+        with _dma_span("export", nbytes, blocks=len(table),
+                       seq=str(seq_id)):
+            k, v, ks, vs = kv_blocks_gather(self, table)
+            get_window().admit(k + v, label="kv:dma:export")
+            payload = HandoffPayload(
+                [np.asarray(x) for x in k],
+                [np.asarray(x) for x in v],
+                ks and [np.asarray(x) for x in ks],
+                vs and [np.asarray(x) for x in vs],
+                self.block_size, self._jdtype)
+        _observe_dma("export", nbytes, time.perf_counter() - t0)
+        return payload
+
+    def import_sequence(self, seq_id, tokens, length, payload):
+        """Adopt a sequence prefilled in ANOTHER pool: allocate blocks
+        here, device-put every block the local prefix cache doesn't
+        already hold from ``payload``, and commit the chain hashes so
+        refcounts/COW/sharing behave as if the prefill ran locally.
+        All-or-nothing — returns False (nothing mutated) when capacity
+        is short; payload geometry must match this pool."""
+        if seq_id in self._tables:
+            raise KeyError(f"sequence {seq_id!r} already allocated")
+        if (int(payload.block_size) != self.block_size
+                or payload.kv_dtype != str(self._jdtype)):
+            raise ValueError(
+                f"payload geometry {payload.kv_dtype}x"
+                f"{payload.block_size} does not match pool "
+                f"{self._jdtype}x{self.block_size}")
+        # Chaos site: fires BEFORE any pool mutation (like alloc_fail),
+        # so an injected import failure provably leaks nothing.
+        from ...distributed.fault_tolerance.plan import fault_point
+        fault_point("serve.import_fail")
+        length = int(length)
+        # num_tokens = length+1 lifts the leave-one-to-compute cap:
+        # nothing is left to compute, the payload carries every byte
+        chain = self._walk_chain(tokens, length + 1)
+        hbm_hits = [ref for _, kind, ref in chain if kind == "hbm"]
+        host_slots = [ref for _, kind, ref in chain if kind == "host"]
+        need = self.blocks_needed(length) - len(hbm_hits)
+        hits_parked = sum(1 for b in hbm_hits if b in self._cached_free)
+        if need > len(self._free) + (len(self._cached_free)
+                                     - hits_parked):
+            return False
+        for blk in hbm_hits:
+            self._activate(blk)
+        self._host_pin.update(host_slots)
+        table = []
+        try:
+            for h, kind, ref in chain:
+                if kind == "hbm":
+                    table.append(ref)
+                else:
+                    blk = self._take_block()
+                    self._promote(ref, blk, h)
+                    self._ref[blk] = 1
+                    table.append(blk)
+            fresh_start = len(table)
+            for _ in range(self.blocks_needed(length) - len(table)):
+                blk = self._take_block()
+                self._ref[blk] = 1
+                table.append(blk)
+            if fresh_start < len(table):
+                from .attention import kv_blocks_scatter
+                from ...core.pipeline import get_window
+                src = np.arange(fresh_start, len(table))
+                nbytes = len(src) * self.bytes_per_block
+                t0 = time.perf_counter()
+                with _dma_span("import", nbytes, blocks=len(src),
+                               seq=str(seq_id)):
+                    puts = kv_blocks_scatter(
+                        self, table[fresh_start:],
+                        [a[src] for a in payload.k],
+                        [a[src] for a in payload.v],
+                        payload.k_scales
+                        and [a[src] for a in payload.k_scales],
+                        payload.v_scales
+                        and [a[src] for a in payload.v_scales])
+                    get_window().admit(puts, label="kv:dma:import")
+                _observe_dma("import", nbytes,
+                             time.perf_counter() - t0)
+        except BaseException:
+            for blk in reversed(table):
+                self._release(blk)
+            raise
+        finally:
+            self._host_pin.difference_update(host_slots)
+        self._tables[seq_id] = table
+        self._lengths[seq_id] = length
+        cached = len(chain) * self.block_size
+        self._cached_len[seq_id] = cached
+        if self.prefix_cache and tokens is not None:
+            self._hit_tokens += cached
+            self._host_hit_tokens += len(host_slots) * self.block_size
+            self._lookup_tokens += length
+            self.commit_prefix(seq_id, tokens)
+        obs.instant("serving.kv_import", cat="dma", seq=str(seq_id),
+                    blocks=len(table), transferred=len(table) - cached
+                    // self.block_size)
+        self._update_gauges()
+        return True
+
     # -- device-side driving arrays --------------------------------------
     def slot_mapping(self, seq_id, start, count):
         """Flat pool slots for positions [start, start+count) — the
@@ -576,10 +958,18 @@ class PagedKVCache:
             used / max(1, self.num_blocks - 1))
         reg.gauge("serving.kv_blocks_shared").set(self.shared_blocks)
         reg.gauge("serving.prefix_hit_rate").set(self.prefix_hit_rate)
+        if self.host is not None:
+            reg.gauge("serving.host_blocks_used").set(
+                len(self._host_lru))
+            reg.gauge("serving.host_hit_rate").set(self.host_hit_rate)
 
     def stats(self):
+        # MIGRATION: block counts are split by tier — "hbm_blocks" is
+        # the device pool ("num_blocks" stays as its alias), the
+        # "host_*" family covers the spill ring
         return {
             "num_blocks": self.num_blocks - 1,
+            "hbm_blocks": self.num_blocks - 1,
             "block_size": self.block_size,
             "kv_dtype": str(self._jdtype),
             "bytes_per_block": self.bytes_per_block,
@@ -594,6 +984,15 @@ class PagedKVCache:
             "high_water": self.high_water,
             "pool_bytes": self.pool_bytes,
             "sequences": len(self._tables),
+            "host_blocks": self.host.num_slots if self.host else 0,
+            "host_blocks_used": len(self._host_lru),
+            "host_pool_bytes": self.host.nbytes if self.host else 0,
+            "host_spills": self.host_spills,
+            "host_promotes": self.host_promotes,
+            "host_evictions": self.host_evictions,
+            "host_hit_rate": self.host_hit_rate,
+            "stale_hash_drops": self.stale_hash_drops,
+            "commit_gen": self._commit_gen,
         }
 
     def __repr__(self):

@@ -133,8 +133,8 @@ class Request:
                  "top_p", "temperature", "seed", "eos_token_id",
                  "generated", "n_scheduled", "num_computed",
                  "cached_prefix", "row", "arrival", "done",
-                 "preemptions", "t_submit", "t_first_token", "tenant",
-                 "stream_offset")
+                 "preemptions", "t_submit", "t_first_token", "t_finish",
+                 "tenant", "stream_offset")
 
     def __init__(self, id, prompt, max_new_tokens=16, do_sample=False,
                  top_k=0, top_p=1.0, temperature=1.0, seed=0,
@@ -159,6 +159,7 @@ class Request:
         self.preemptions = 0
         self.t_submit = None      # wall clock at submit (TTFT start)
         self.t_first_token = None  # wall clock at first drained token
+        self.t_finish = None      # wall clock at finish (TPOT end)
         self.stream_offset = 0    # completion tokens folded into the
         # prompt by requeue(); stream indices stay absolute across
         # preemption and failover replay (exactly-once delivery)
@@ -185,13 +186,17 @@ class ContinuousBatchingScheduler:
 
     def __init__(self, cache, max_batch=None, prefill_chunk=None,
                  victim_policy=None, admission_policy=None,
-                 budget_policy=None):
+                 budget_policy=None, prefill_only=False):
         self.cache = cache
         self.max_batch = int(max_batch or max_batch_size())
         self.prefill_chunk = int(prefill_chunk or prefill_chunk_size())
         self.victim_policy = victim_policy or YoungestFirst()
         self.admission_policy = admission_policy or AdmissionPolicy()
         self.budget_policy = budget_policy or TokenBudgetPolicy()
+        #: disaggregated prefill role: never schedule decode rows —
+        #: a prompt-complete request (its first token sampled at the
+        #: end of prefill) just waits to be extracted for handoff
+        self.prefill_only = bool(prefill_only)
         self.waiting = deque()
         self.running = []
         self._arrival = 0
@@ -272,6 +277,11 @@ class ContinuousBatchingScheduler:
         decodes = [r for r in self.running
                    if not r.done and not r.prefilling
                    and r.remaining > 0]
+        if self.prefill_only:
+            # prompt-complete requests are handoff cargo, not decode
+            # rows; they sit in running (holding their blocks) until
+            # the disaggregated front extracts them
+            decodes = []
         if decodes:
             allowed = self.budget_policy.filter_decodes(list(decodes))
             if not allowed and chunk is None:
@@ -296,6 +306,19 @@ class ContinuousBatchingScheduler:
         request.cached_prefix = self.cache.cached_prefix_len(request.id)
         request.num_computed = request.cached_prefix
         self.waiting.popleft()
+        self.running.append(request)
+
+    def adopt(self, request):
+        """Seat an externally prefilled request directly into running
+        (disaggregated handoff): its blocks were imported through
+        ``PagedKVCache.import_sequence``, not allocated via
+        ``begin_prefill``, so only the queue bookkeeping happens
+        here."""
+        request.arrival = self._arrival
+        self._arrival += 1
+        if request.t_submit is None:
+            import time
+            request.t_submit = time.perf_counter()
         self.running.append(request)
 
     def finish(self, request):

@@ -26,7 +26,8 @@ from .guard import (ENV_MEMORY_GUARD, guard_enabled, guard_mode,
                     preflight_check, oom_context, is_oom_error,
                     remat_enabled, set_remat, remat_scope, last_estimate,
                     record_estimate, register_resident,
-                    unregister_resident, resident_items)
+                    unregister_resident, resident_items,
+                    host_resident_items)
 from .ladder import (GradAccumulator, split_feed, batch_size_of,
                      run_with_ladder)
 
@@ -40,5 +41,6 @@ __all__ = [
     "oom_context", "is_oom_error", "remat_enabled", "set_remat",
     "remat_scope", "last_estimate", "record_estimate",
     "register_resident", "unregister_resident", "resident_items",
+    "host_resident_items",
     "GradAccumulator", "split_feed", "batch_size_of", "run_with_ladder",
 ]

@@ -37,7 +37,8 @@ __all__ = ["ENV_MEMORY_GUARD", "guard_enabled", "guard_mode", "GuardPolicy",
            "set_guard_policy", "get_guard_policy", "preflight_check",
            "oom_context", "is_oom_error", "remat_enabled", "set_remat",
            "remat_scope", "last_estimate", "record_estimate",
-           "register_resident", "unregister_resident", "resident_items"]
+           "register_resident", "unregister_resident", "resident_items",
+           "host_resident_items"]
 
 ENV_MEMORY_GUARD = "PADDLE_TPU_MEMORY_GUARD"
 OOM_SITE = "exec.oom"
@@ -150,24 +151,36 @@ def remat_scope(on=True):
 # register here as a named line item so every preflight charges them and
 # HbmBudgetError reports e.g. "kv cache blocks" next to params/opt-state.
 _residents = {}
+#: host-RAM residents (the KV cache's spill ring is the canonical one):
+#: named line items for triage that are NOT charged against the device
+#: HBM preflight — host memory is not HBM
+_host_residents = {}
 _residents_lock = threading.Lock()
 
 
-def register_resident(name, nbytes, buffer_ids=None):
+def register_resident(name, nbytes, buffer_ids=None, host=False):
     """Charge a long-lived device allocation against every future
     preflight.  ``buffer_ids`` is an optional zero-arg callable returning
     the current ``id()`` set of the backing jax arrays — when a program's
     own arguments include those buffers (the engine's decode step takes
     the pool as donated state, already counted in argument_bytes), the
-    preflight skips the double charge but keeps the named line item."""
+    preflight skips the double charge but keeps the named line item.
+    ``host=True`` registers a host-RAM allocation instead: it appears in
+    ``host_resident_items()`` (and memory triage output) but never
+    counts against the device budget."""
     with _residents_lock:
-        _residents[name] = (int(nbytes), buffer_ids)
+        if host:
+            _host_residents[name] = int(nbytes)
+        else:
+            _residents[name] = (int(nbytes), buffer_ids)
     obs.instant("memory.resident", cat="memory", resident=name,
-                nbytes=int(nbytes))
+                nbytes=int(nbytes), host=bool(host))
 
 
-def unregister_resident(name):
+def unregister_resident(name, host=False):
     with _residents_lock:
+        if host:
+            return _host_residents.pop(name, None) is not None
         return _residents.pop(name, None) is not None
 
 
@@ -175,6 +188,12 @@ def resident_items():
     """Snapshot [(name, nbytes, buffer_ids_fn)] of registered residents."""
     with _residents_lock:
         return [(n, b, f) for n, (b, f) in _residents.items()]
+
+
+def host_resident_items():
+    """Snapshot [(name, nbytes)] of registered HOST-RAM residents."""
+    with _residents_lock:
+        return list(_host_residents.items())
 
 
 # -- estimates ----------------------------------------------------------

@@ -30,7 +30,7 @@ __all__ = ["CATEGORY_LANES", "chrome_trace", "collective_overlap_stats",
 CATEGORY_LANES = {"host": 0, "compile": 1, "dispatch": 2, "collective": 3,
                   "memory": 4, "fault": 5, "amp": 6, "h2d": 7, "d2h": 8,
                   "pipeline": 9, "prefill": 10, "decode": 11,
-                  "analysis": 12, "kernel": 13}
+                  "analysis": 12, "kernel": 13, "dma": 14}
 _EXTRA_LANE_BASE = 16
 
 
@@ -205,16 +205,20 @@ def phase_breakdown(events=None):
     out = {"compile_ms": 0.0, "dispatch_ms": 0.0, "collective_ms": 0.0,
            "h2d_ms": 0.0, "d2h_ms": 0.0, "pipeline_wait_ms": 0.0,
            "prefill_ms": 0.0, "decode_ms": 0.0, "kernel_ms": 0.0,
+           "dma_ms": 0.0,
            "collective_bytes": 0, "h2d_bytes": 0, "d2h_bytes": 0,
+           "dma_bytes": 0,
            "compile_count": 0, "dispatch_count": 0, "collective_count": 0,
            "h2d_count": 0, "d2h_count": 0, "pipeline_wait_count": 0,
-           "prefill_count": 0, "decode_count": 0, "kernel_count": 0}
+           "prefill_count": 0, "decode_count": 0, "kernel_count": 0,
+           "dma_count": 0}
     kernel_keys = []
     axis_keys = []
     shards = {}
     tenants = {}
     faults = {"failover_count": 0, "failover_recovery_ms": 0.0,
               "replays": 0, "step_timeout_count": 0, "shed_count": 0}
+    hostkv = {"host_spill_count": 0, "host_promote_count": 0}
 
     def _shard_row(label):
         return shards.setdefault(label, {
@@ -309,12 +313,23 @@ def phase_breakdown(events=None):
         elif e.cat == "pipeline":
             out["pipeline_wait_ms"] += ms
             out["pipeline_wait_count"] += 1
+        elif e.cat == "dma":
+            # the kv:dma lane: KV-tier spills/promotes and the
+            # disaggregated prefill->decode block transfers
+            out["dma_ms"] += ms
+            out["dma_count"] += 1
+            out["dma_bytes"] += int(attrs.get("bytes", 0) or 0)
+            direction = attrs.get("dir")
+            if direction == "spill":
+                hostkv["host_spill_count"] += 1
+            elif direction == "promote":
+                hostkv["host_promote_count"] += 1
         elif e.cat in ("prefill", "decode"):
             out[f"{e.cat}_ms"] += ms
             out[f"{e.cat}_count"] += 1
     for k in ("compile_ms", "dispatch_ms", "collective_ms", "h2d_ms",
               "d2h_ms", "pipeline_wait_ms", "prefill_ms", "decode_ms",
-              "kernel_ms", *kernel_keys, *axis_keys):
+              "kernel_ms", "dma_ms", *kernel_keys, *axis_keys):
         out[k] = round(out[k], 3)
     # per-axis compute/communication overlap (tile-level overlap win):
     # overlap_ratio_<axis> = fraction of that axis's collective-span
@@ -338,6 +353,10 @@ def phase_breakdown(events=None):
         faults["failover_recovery_ms"] = round(
             faults["failover_recovery_ms"], 3)
         out.update(faults)
+    # host-tier spill/promote counts ride along only when the tier
+    # actually moved blocks (same conditional pattern as faults)
+    if any(hostkv.values()):
+        out.update(hostkv)
     return out
 
 

@@ -21,7 +21,12 @@ PADDLE_TPU_OBS=1 and validates the whole story:
     engine with drafts actually accepted, within the compile budget;
   * a bursty two-tenant SLO run: a low-priority flood cannot push the
     high-priority tenant's p99 TTFT anywhere near the flood's own, and
-    the per-tenant metrics/phase breakdown come out populated.
+    the per-tenant metrics/phase breakdown come out populated;
+  * KV tiering under a deliberately tiny HBM pool: two alternating
+    shared prefixes cannot both stay device-resident, so evicted prefix
+    blocks spill to the host tier and later requests PROMOTE them back
+    (host hit rate > 0) — with outputs identical to a roomy run and
+    still within the two-compile bound.
 
 Prints tokens/sec and the KV-pool block high-water mark.  Exits 0 iff
 every scenario passes.  CPU-only, no TPU required.
@@ -285,6 +290,61 @@ def _slo_burst(args):
               f"{s['spec_accept_rate']:.0%}, violations "
               f"{slo.violations}; per-tenant tokens "
               f"{ {t: v['tokens'] for t, v in sorted(tenants.items())} }")
+    finally:
+        eng.close()
+
+
+@scenario("KV tiering: tiny HBM pool, prefix burst served from host tier")
+def _tiering(args):
+    # two 32-token system prompts alternate; the 8-block HBM pool can
+    # hold at most one prefix working set, so serving a P2 request
+    # evicts P1's parked blocks into the host ring and the next P1
+    # request promotes them back — the effective prefix cache is
+    # host-RAM sized
+    model = build_model(args.seed)
+    rng = np.random.RandomState(args.seed + 6)
+    p1 = list(rng.randint(1, VOCAB, size=32))
+    p2 = list(rng.randint(1, VOCAB, size=32))
+    prompts = []
+    for i in range(6):
+        shared = p1 if i % 2 == 0 else p2
+        prompts.append(shared + list(rng.randint(1, VOCAB, size=4)))
+    kw = dict(max_new_tokens=8)
+
+    ref_eng = GenerationEngine(model, num_blocks=256, max_batch=1,
+                               block_size=8, max_model_len=128)
+    try:
+        ref = [ref_eng.generate([p], **kw)[0] for p in prompts]
+    finally:
+        ref_eng.close()
+
+    obs.get_timeline().clear()
+    eng = GenerationEngine(model, num_blocks=8, block_size=8,
+                           max_batch=1, max_model_len=128,
+                           kv_tiering=True)
+    try:
+        s0 = eng.stats()
+        assert s0["host_blocks"] > 0, "host tier did not materialize"
+        got = [eng.generate([p], **kw)[0] for p in prompts]
+        assert got == ref, "tiering changed greedy output"
+        s = eng.stats()
+        assert s["host_spills"] > 0, "tiny pool never spilled"
+        assert s["host_promotes"] > 0, "no block came back from host"
+        assert s["host_hit_rate"] > 0, s["host_hit_rate"]
+        assert s["blocks_in_use"] == 0
+        events = obs.get_timeline().events()
+        compiles = [e for e in events
+                    if e.name.startswith("compile:jit:GenerationEngine")]
+        assert len(compiles) <= 2, (
+            f"{len(compiles)} compiles (bound 2): "
+            + ", ".join(e.name for e in compiles))
+        dma = [e for e in events if e.cat == "dma" and e.dur is not None]
+        assert dma, "no kv:dma spans recorded"
+        print(f"      {len(prompts)} requests over "
+              f"{s['hbm_blocks']} HBM / {s['host_blocks']} host blocks: "
+              f"{s['host_spills']} spills, {s['host_promotes']} "
+              f"promotes, host hit rate {s['host_hit_rate']:.0%}, "
+              f"{len(compiles)} compile(s), {len(dma)} DMA spans")
     finally:
         eng.close()
 

