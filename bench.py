@@ -1112,6 +1112,65 @@ def bench_bert_dp(on_tpu):
 
 
 # ---------------------------------------------------------------------
+# bert_elastic: the elastic-training chaos drill as a benchmark —
+# device lost mid-run on a dp=4 mesh, shrink to dp=2, restore from the
+# async snapshot, resume bit-identically.  The judged metric is the
+# recovery time (lower is better); ok/parity ride along as flags.
+
+_ELASTIC_SUB = r"""
+import os, json
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "") +
+                           " --xla_force_host_platform_device_count=8")
+import jax
+jax.config.update("jax_platforms", "cpu")
+from paddle_tpu import observability as obs
+obs.enable(True)
+from paddle_tpu.distributed.elastic_train import run_elastic_drill
+print("BERT_ELASTIC_JSON: " + json.dumps(run_elastic_drill(seed=7),
+                                         default=str))
+"""
+
+
+def bench_bert_elastic(on_tpu):
+    import jax
+    t = time.time()
+    if jax.device_count() >= 4:
+        from paddle_tpu.distributed.elastic_train import run_elastic_drill
+        rep = run_elastic_drill(seed=7)
+        rep["forced_host_mesh"] = False
+    else:
+        # the child must own its XLA_FLAGS / platform selection — the
+        # ambient env may point both at a live TPU tunnel.  The
+        # persistent compile cache must not leak in either: warm
+        # multi-device deserialization segfaults jaxlib 0.4.37 CPU
+        # (same reason tests/conftest.py keeps it off the suite).
+        env = {k: v for k, v in os.environ.items()
+               if k not in ("XLA_FLAGS", "JAX_PLATFORMS",
+                            "PADDLE_TPU_COMPILE_CACHE_DIR")}
+        p = subprocess.run(
+            [sys.executable, "-c", _ELASTIC_SUB], cwd=str(ROOT),
+            capture_output=True, text=True, timeout=1800, env=env)
+        rep = None
+        for line in p.stdout.splitlines():
+            if line.startswith("BERT_ELASTIC_JSON:"):
+                rep = json.loads(line[len("BERT_ELASTIC_JSON:"):])
+        if rep is None:
+            raise RuntimeError(
+                "bert_elastic subprocess produced no result: "
+                + (p.stderr or "")[-400:])
+        rep["forced_host_mesh"] = True
+    rep["seconds"] = round(time.time() - t, 1)
+    rec = rep.get("recovery_to_first_step_ms")
+    if rec is None and rep.get("mttr_ms"):
+        rec = rep["mttr_ms"][-1]
+    rep["recovery_ms"] = rec
+    log(f"bert_elastic: ok={rep['ok']} recovery {rec} ms "
+        f"mesh {rep['mesh_before']} -> {rep['mesh_after']} "
+        f"({rep['seconds']:.0f}s)")
+    return rep
+
+
+# ---------------------------------------------------------------------
 # bert_tp: the same BERT-mini step under tp=2 — the executor routes
 # row-parallel matmuls through the overlapped all-gather/reduce-scatter
 # ring (distributed/auto_parallel/overlap.py), so this config is the
@@ -1283,7 +1342,8 @@ def main():
                   [sys.executable, "-u", os.path.abspath(__file__)], env)
     configs = os.environ.get(
         "PADDLE_TPU_BENCH_CONFIGS",
-        "bert,lenet,resnet50,gpt,llama_dryrun,bert_dp,bert_tp"
+        "bert,lenet,resnet50,gpt,llama_dryrun,bert_dp,bert_tp,"
+        "bert_elastic"
         ).split(",")
 
     info = None
@@ -1403,6 +1463,7 @@ def main():
         "llama_dryrun": bench_llama_dryrun,
         "bert_dp": lambda: bench_bert_dp(on_tpu),
         "bert_tp": lambda: bench_bert_tp(on_tpu),
+        "bert_elastic": lambda: bench_bert_elastic(on_tpu),
     }
     errors = {}
     from collections import Counter as _Counter
@@ -1550,6 +1611,19 @@ def main():
             # subprocess case measured them in the child's timeline)
             if res.get("phases"):
                 payload["extra_metrics"]["bert_dp_phases"] = \
+                    res["phases"]
+        elif name == "bert_elastic":
+            payload["extra_metrics"]["bert_elastic_recovery_ms"] = \
+                res["recovery_ms"]
+            payload["extra_metrics"]["bert_elastic_ok"] = res["ok"]
+            payload["extra_metrics"]["bert_elastic_mesh"] = \
+                f"{res['mesh_before']} -> {res['mesh_after']}"
+            payload["extra_metrics"]["bert_elastic_replayed_steps"] = \
+                res["replayed_steps"]
+            payload["extra_metrics"]["bert_elastic_forced_host_mesh"] = \
+                res["forced_host_mesh"]
+            if res.get("phases"):
+                payload["extra_metrics"]["bert_elastic_phases"] = \
                     res["phases"]
         elif name == "bert_tp":
             payload["extra_metrics"]["bert_tp_tokens_per_sec"] = \

@@ -85,6 +85,9 @@ CODES = {
     "TPU504": ("hot-path tensor-parallel matmul whose collective cannot "
                "overlap with compute: the MXU idles for the full "
                "transfer", WARNING),
+    "TPU505": ("mesh shrink dropped a model-parallel axis to replication: "
+               "the surviving devices cannot hold the axis, so its "
+               "parameters re-materialize fully replicated", WARNING),
 }
 
 

@@ -37,6 +37,8 @@ from .store import TCPStore
 from .split_api import split
 from . import utils
 from . import fault_tolerance
+from .elastic_train import (DeviceLostError, ElasticTrainer,
+                            elastic_state_dict)
 
 spawn = None  # set by launch module
 

@@ -254,6 +254,10 @@ class MeshPlan:
         self.size = math.prod(self.axis_sizes.values())
         self._mesh = None
         self._virtual = bool(virtual)
+        # Bumped by shrink(): keeps executable-cache keys fresh across a
+        # recovery even when the shrunk topology coincides with an old one.
+        self._generation = 0
+        self.shrink_findings = []
         if not virtual:
             import jax
             devs = list(devices) if devices is not None else jax.devices()
@@ -297,7 +301,7 @@ class MeshPlan:
         ``axis_sizes``; the overlap mode via ``overlap.mode_token()``."""
         from . import overlap as _overlap
         return (tuple(self.axis_sizes.items()), self.rules_token(),
-                _overlap.mode_token())
+                _overlap.mode_token(), self._generation)
 
     def __repr__(self):
         return (f"MeshPlan({self.describe()}, rules={len(self.rules)}"
@@ -344,6 +348,62 @@ class MeshPlan:
             return list(arr.ravel())
         idx = self.axis_names.index("pp")
         return list(np.take(arr, [stage], axis=idx).ravel())
+
+    # -- elastic recovery -------------------------------------------------
+    def shrink(self, surviving_devices):
+        """Rebuild this plan over a smaller device set after a loss.
+
+        dp is the preferred shrink axis: it drops to the largest divisor
+        of the original dp size that still fits, so global-batch
+        divisibility (and therefore bit-identical resume on the shrunk
+        mesh) is preserved.  Model-parallel axes that no longer fit
+        (tp, then fsdp, then pp) fall back to replication — each drop is
+        recorded as a TPU505 finding on ``shrink_findings`` and in the
+        diagnostic log.  The new plan reuses the SAME partition rules,
+        so ``_legalize`` re-materializes specs on the smaller mesh, and
+        carries a bumped ``_generation`` so ``cache_token()`` never
+        collides with a pre-loss executable cache entry.
+        """
+        from ...analysis import diagnostics as _diag
+        if self._virtual:
+            raise RuntimeError("cannot shrink a virtual MeshPlan")
+        devs = list(surviving_devices)
+        if not devs:
+            raise ValueError("shrink() needs at least one surviving device")
+        axes = dict(self.axis_sizes)
+        findings = []
+
+        def _non_dp():
+            return math.prod(v for k, v in axes.items() if k != "dp")
+
+        for ax in ("tp", "fsdp", "pp"):
+            if _non_dp() <= len(devs):
+                break
+            if axes.get(ax, 1) > 1:
+                msg = (f"mesh shrink {self.describe()} -> {len(devs)} "
+                       f"devices: axis {ax}={axes[ax]} no longer fits; "
+                       f"its parameters fall back to replication")
+                findings.append(_diag.record(_diag.Diagnostic(
+                    "TPU505", msg, site=f"mesh.shrink.{ax}",
+                    hint="restore capacity or re-launch with a smaller "
+                         f"{ax} degree to re-shard these parameters",
+                    data={"axis": ax, "old_size": axes[ax],
+                          "surviving": len(devs)})))
+                axes[ax] = 1
+        if _non_dp() > len(devs):
+            raise ValueError(
+                f"cannot shrink {self.describe()} onto {len(devs)} "
+                f"devices: model-parallel axes need {_non_dp()}")
+        old_dp = axes.get("dp", 1)
+        cap = len(devs) // _non_dp()
+        new_dp = max(d for d in range(1, old_dp + 1)
+                     if old_dp % d == 0 and d <= cap)
+        if "dp" in axes:
+            axes["dp"] = new_dp
+        new = MeshPlan(axes, rules=self.rules, devices=devs)
+        new._generation = self._generation + 1
+        new.shrink_findings = findings
+        return new
 
     # -- spec resolution --------------------------------------------------
     def data_axes(self):

@@ -33,7 +33,18 @@ from ..fault_tolerance.atomic import (atomic_write, write_manifest,
                                       CheckpointCorruptionError)
 from ..fault_tolerance.plan import fault_point
 
-__all__ = ["save_state_dict", "load_state_dict"]
+__all__ = ["save_state_dict", "load_state_dict", "read_train_meta"]
+
+
+def read_train_meta(path):
+    """The ``"train"`` block (step / rng_key / data_cursor) a checkpoint
+    manifest was committed with, or ``None`` for older checkpoints."""
+    from ..fault_tolerance.atomic import MANIFEST_NAME
+    try:
+        with open(os.path.join(path, MANIFEST_NAME)) as f:
+            return json.load(f).get("train")
+    except (OSError, ValueError):
+        return None
 
 
 def _proc_id():
@@ -44,7 +55,12 @@ def _proc_id():
 
 
 def save_state_dict(state_dict, path, process_group=None,
-                    coordinator_rank=0, unique_id=None, async_save=False):
+                    coordinator_rank=0, unique_id=None, async_save=False,
+                    train_meta=None):
+    """``train_meta`` (optional dict, e.g. ``{"step": 12, "rng_key":
+    [...], "data_cursor": 12}``) is committed into the manifest under a
+    ``"train"`` key so a resume can restore step/RNG/data-loader
+    position from the checkpoint alone."""
     os.makedirs(path, exist_ok=True)
     rank = _proc_id()
     shards = {}
@@ -92,7 +108,8 @@ def save_state_dict(state_dict, path, process_group=None,
             json.dump(meta, f)
         # commit record, written LAST: a checkpoint without a manifest
         # is by definition incomplete
-        write_manifest(path)
+        write_manifest(path, extra={"train": dict(train_meta)}
+                       if train_meta else None)
         # FaultPlan site "checkpoint.commit": a "corrupt" event here
         # mangles a committed file — post-commit bit-rot/torn replace,
         # exactly what the checksum manifest must catch at load time
@@ -119,6 +136,11 @@ def load_state_dict(state_dict, path, process_group=None,
                 ok_fb, _ = validate_checkpoint(fallback_path)
                 fb = fallback_path if ok_fb else \
                     latest_good_checkpoint(fallback_path)
+            from ... import observability as obs
+            if obs.enabled():
+                obs.instant("ckpt.corrupt", cat="fault", path=str(path),
+                            reasons="; ".join(reasons),
+                            fallback=str(fallback_path or ""))
             if fb is None:
                 raise CheckpointCorruptionError(path, reasons)
             import warnings

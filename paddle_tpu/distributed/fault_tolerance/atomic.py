@@ -38,11 +38,28 @@ class CheckpointCorruptionError(RuntimeError):
             + "; ".join(self.reasons))
 
 
+def _fsync_dir(path):
+    """fsync a directory so a completed rename survives power loss.
+    Platforms that cannot open directories (or refuse to fsync them)
+    are a no-op — the rename itself is still atomic."""
+    try:
+        fd = os.open(path, os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
+
+
 @contextlib.contextmanager
 def atomic_write(path, mode="wb"):
     """Write to ``path`` all-or-nothing: stage into a same-directory tmp
-    file, fsync, then ``os.replace`` (atomic on POSIX).  On any error
-    the tmp file is removed and ``path`` is untouched."""
+    file, fsync, then ``os.replace`` (atomic on POSIX) and fsync the
+    parent directory so the rename itself is durable.  On any error the
+    tmp file is removed and ``path`` is untouched."""
     tmp = f"{path}.tmp.{os.getpid()}"
     f = open(tmp, mode)
     try:
@@ -51,6 +68,7 @@ def atomic_write(path, mode="wb"):
         os.fsync(f.fileno())
         f.close()
         os.replace(tmp, path)
+        _fsync_dir(os.path.dirname(os.path.abspath(path)))
     except BaseException:
         f.close()
         try:

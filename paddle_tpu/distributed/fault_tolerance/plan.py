@@ -21,6 +21,11 @@ Sites currently instrumented:
   serve.step_hang              serving step completion (watchdog target)
   serve.replica_down.<shard>   per-replica step (serving/dp.py)
   serve.alloc_fail             KV block allocation (serving/kv_cache.py)
+  kv.dma_fail                  host KV spill/promote DMA (kv_cache.py)
+  dist.device_lost.<rank>      elastic trainer health probe, per rank
+                               (distributed/elastic_train.py)
+  dist.host_preempt            whole-host preemption notice (same probe)
+  elastic.snapshot.write       async snapshot writer (elastic_train.py)
 
 Activation: ``with inject(plan): ...`` or the ``PADDLE_TPU_FAULT_PLAN``
 env var (JSON, or the compact ``site:action:k=v,...;site2:...`` form) so

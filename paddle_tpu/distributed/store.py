@@ -35,7 +35,7 @@ import threading
 import time
 
 from .fault_tolerance.plan import fault_point
-from .fault_tolerance.retry import (backoff_delays, ENV_STORE_RETRIES,
+from .fault_tolerance.retry import (ENV_STORE_RETRIES,
                                     RetryExhausted, RetryPolicy)
 
 __all__ = ["TCPStore"]
@@ -222,10 +222,11 @@ class TCPStore:
         self.port = port
         self._sock = None
         self._lock = threading.Lock()
-        # deterministic jitter (seeded by rank) decorrelates a restart
-        # herd without losing replayability
-        self._op_delays = backoff_delays(base=0.02, factor=2.0,
-                                         max_delay=0.5)
+        # per-call replay schedule for idempotent ops: a FRESH delay
+        # sequence every call (a shared generator would saturate at
+        # max_delay after the first few retries and stay there forever)
+        self._op_policy = RetryPolicy(retries=self._retries, base=0.02,
+                                      factor=2.0, max_delay=0.5)
         with self._lock:
             self._connect()
 
@@ -289,12 +290,16 @@ class TCPStore:
 
     def _call(self, op_name, fn, idempotent=False):
         """Run one wire op under the lock.  Transient socket errors
-        drop the connection; idempotent ops reconnect and replay up to
-        ``retries`` times (the store may have restarted — get/wait/query
-        replay safely; set/add/delete never do)."""
-        attempts = (self._retries + 1) if idempotent else 1
-        last = None
-        for i in range(attempts):
+        drop the connection; idempotent ops reconnect and replay through
+        ``RetryPolicy`` (the store may have restarted — get/wait/query
+        replay safely; set/add/delete never do).  A reply *timeout* is
+        never replayed: the stream is desynced, so the socket is
+        poisoned and the error surfaces immediately."""
+
+        class _ReplyTimeout(Exception):
+            pass  # not an OSError: opts out of the replay policy
+
+        def attempt():
             with self._lock:
                 try:
                     if self._sock is None:
@@ -305,18 +310,27 @@ class TCPStore:
                     # reply stream is now desynced: poison the socket so
                     # the next op reconnects cleanly
                     self._drop_sock()
-                    raise TimeoutError(
-                        f"TCPStore {op_name!r}: no reply within "
-                        f"{self._timeout}s from "
-                        f"{self._host}:{self.port}") from e
-                except (ConnectionError, OSError) as e:
-                    last = e
+                    raise _ReplyTimeout() from e
+                except (ConnectionError, OSError):
                     self._drop_sock()
-            if i + 1 < attempts:
-                time.sleep(next(self._op_delays))
-        raise ConnectionError(
-            f"TCPStore {op_name!r}: {attempts} attempt(s) failed against "
-            f"{self._host}:{self.port} (last error: {last})")
+                    raise
+
+        try:
+            if idempotent:
+                return self._op_policy.call(
+                    attempt, exceptions=(ConnectionError, OSError),
+                    what="store." + op_name)
+            return attempt()
+        except _ReplyTimeout as e:
+            raise TimeoutError(
+                f"TCPStore {op_name!r}: no reply within "
+                f"{self._timeout}s from "
+                f"{self._host}:{self.port}") from e.__cause__
+        except RetryExhausted as e:
+            raise ConnectionError(
+                f"TCPStore {op_name!r}: {self._retries + 1} attempt(s) "
+                f"failed against {self._host}:{self.port} "
+                f"(last error: {e.last})") from e.last
 
     # -- API -------------------------------------------------------------
     def set(self, key, value):

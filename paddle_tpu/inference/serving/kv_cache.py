@@ -98,6 +98,13 @@ __all__ = ["ENV_KV_BLOCK_SIZE", "ENV_PREFIX_CACHE", "kv_block_size",
 ENV_KV_BLOCK_SIZE = "PADDLE_TPU_KV_BLOCK_SIZE"
 ENV_PREFIX_CACHE = "PADDLE_TPU_PREFIX_CACHE"
 _DEFAULT_BLOCK_SIZE = 16
+
+
+def _kv_dma_policy():
+    """Retry schedule for host-tier DMA: one fast retry, then the
+    caller degrades the transfer to a cache miss (never a crash)."""
+    from ...distributed.fault_tolerance.retry import RetryPolicy
+    return RetryPolicy(retries=1, base=0.001, factor=2.0, max_delay=0.01)
 RESIDENT_NAME = "kv cache blocks"
 
 # when no budget is visible (CPU tests without PADDLE_TPU_HBM_BUDGET)
@@ -467,8 +474,14 @@ class PagedKVCache:
         if slot is None:                  # ring exhausted by pins
             return
         from ...core.pipeline import get_window
-        t0 = time.perf_counter()
-        with _dma_span("spill", self.bytes_per_block, block=blk):
+        from ...distributed.fault_tolerance.plan import fault_point
+        from ...distributed.fault_tolerance.retry import RetryExhausted
+
+        def _dma():
+            # "kv.dma_fail" fires before any host-side mutation, so a
+            # retried (or abandoned) transfer leaks nothing; a full
+            # rewrite of the slot makes the retry idempotent
+            fault_point("kv.dma_fail")
             ks = [k._value[blk] for k, _ in self._pools]
             vs = [v._value[blk] for _, v in self._pools]
             kss = vss = None
@@ -481,6 +494,22 @@ class PagedKVCache:
                 [np.asarray(x) for x in vs],
                 kss and [np.asarray(x) for x in kss],
                 vss and [np.asarray(x) for x in vss])
+
+        t0 = time.perf_counter()
+        try:
+            with _dma_span("spill", self.bytes_per_block, block=blk):
+                _kv_dma_policy().call(
+                    _dma, exceptions=(ConnectionError, OSError),
+                    what="kv:spill")
+        except RetryExhausted:
+            # degrade: the evicted block simply is not host-cached — a
+            # future request recomputes it (a miss, never a crash)
+            self.host.give(slot)
+            obs.get_registry().counter("serving.kv_dma_fail").inc()
+            if obs.enabled():
+                obs.instant("kv.dma_fail", cat="fault", dir="spill",
+                            block=blk)
+            return
         _observe_dma("spill", self.bytes_per_block,
                      time.perf_counter() - t0)
         self._host_of[h] = slot
@@ -494,12 +523,20 @@ class PagedKVCache:
         """Bring a host-resident prefix block back: ``device_put`` the
         ring slot's bytes (+ scale rows) into a freshly taken block and
         make the hash canonical in HBM again (dropping the host entry —
-        one tier per hash)."""
+        one tier per hash).  Returns False when the transfer failed
+        after retries — ``blk`` is then unindexed scratch the caller
+        recycles, and the entry degrades to a recompute."""
         import jax.numpy as jnp
         from ...core.pipeline import get_window
-        k_parts, v_parts, ks_parts, vs_parts = self.host.read(slot)
-        t0 = time.perf_counter()
-        with _dma_span("promote", self.bytes_per_block, block=blk):
+        from ...distributed.fault_tolerance.plan import fault_point
+        from ...distributed.fault_tolerance.retry import RetryExhausted
+
+        def _dma():
+            # fires before the hash is re-indexed; a retry rewrites the
+            # whole block, so partial state from a failed attempt is
+            # overwritten (or discarded with the scratch block)
+            fault_point("kv.dma_fail")
+            k_parts, v_parts, ks_parts, vs_parts = self.host.read(slot)
             puts = []
             for i, (k, v) in enumerate(self._pools):
                 k._inplace_update(
@@ -513,6 +550,19 @@ class PagedKVCache:
                 vs._inplace_update(
                     vs._value.at[blk].set(jnp.asarray(vs_parts[i])))
             get_window().admit(puts, label="kv:dma:promote")
+
+        t0 = time.perf_counter()
+        try:
+            with _dma_span("promote", self.bytes_per_block, block=blk):
+                _kv_dma_policy().call(
+                    _dma, exceptions=(ConnectionError, OSError),
+                    what="kv:promote")
+        except RetryExhausted:
+            obs.get_registry().counter("serving.kv_dma_fail").inc()
+            if obs.enabled():
+                obs.instant("kv.dma_fail", cat="fault", dir="promote",
+                            block=blk)
+            return False
         _observe_dma("promote", self.bytes_per_block,
                      time.perf_counter() - t0)
         self._hash_of[blk] = h
@@ -520,6 +570,7 @@ class PagedKVCache:
         self._drop_host(h)
         self.host_promotes += 1
         obs.get_registry().counter("serving.host_promotes").inc()
+        return True
 
     def _activate(self, blk):
         """Bring a hit block into a table (refcount += 1; un-park it
@@ -574,22 +625,46 @@ class PagedKVCache:
         for blk in hbm_hits:
             self._activate(blk)
         self._host_pin.update(host_slots)
+        failed_h = None
         try:
             table = []
             for h, kind, ref in chain:
                 if kind == "hbm":
                     table.append(ref)
-                else:
-                    blk = self._take_block()
-                    self._promote(ref, blk, h)
+                    continue
+                blk = self._take_block()
+                if self._promote(ref, blk, h):
                     self._ref[blk] = 1
                     table.append(blk)
-            for _ in range(self.blocks_needed(num_tokens) - len(table)):
-                blk = self._take_block()
-                self._ref[blk] = 1
-                table.append(blk)
+                    continue
+                # transient DMA failure after retries: unwind this
+                # attempt (promoted blocks park back in the cache —
+                # their transfer DID land) and degrade below
+                failed_h = h
+                self._free.append(blk)
+                break
+            if failed_h is None:
+                for _ in range(self.blocks_needed(num_tokens)
+                               - len(table)):
+                    blk = self._take_block()
+                    self._ref[blk] = 1
+                    table.append(blk)
+            else:
+                in_table = set(table)
+                for blk in table:
+                    self._release(blk)
+                for blk in hbm_hits:
+                    if blk not in in_table:
+                        self._release(blk)
         finally:
             self._host_pin.difference_update(host_slots)
+        if failed_h is not None:
+            # drop the suspect host entry and re-run: the chain walk now
+            # stops where the promotion failed, so the lost tail is
+            # recomputed — the engine sees a shorter cached prefix,
+            # never the failure
+            self._drop_host(failed_h)
+            return self.allocate(seq_id, num_tokens, tokens)
         self._tables[seq_id] = table
         self._lengths[seq_id] = int(num_tokens)
         cached = len(chain) * self.block_size

@@ -30,8 +30,9 @@ __all__ = ["CATEGORY_LANES", "chrome_trace", "collective_overlap_stats",
 CATEGORY_LANES = {"host": 0, "compile": 1, "dispatch": 2, "collective": 3,
                   "memory": 4, "fault": 5, "amp": 6, "h2d": 7, "d2h": 8,
                   "pipeline": 9, "prefill": 10, "decode": 11,
-                  "analysis": 12, "kernel": 13, "dma": 14}
-_EXTRA_LANE_BASE = 16
+                  "analysis": 12, "kernel": 13, "dma": 14,
+                  "recovery": 15, "ckpt": 16}
+_EXTRA_LANE_BASE = 18
 
 
 def _lane(cat, extra):
@@ -199,7 +200,13 @@ def phase_breakdown(events=None):
     Serving-fault attribution: when any ``serving.failover`` /
     ``serving.step_timeout`` / ``serving.shed`` instant fired, the
     breakdown gains ``failover_count`` / ``failover_recovery_ms`` /
-    ``replays`` / ``step_timeout_count`` / ``shed_count``."""
+    ``replays`` / ``step_timeout_count`` / ``shed_count``.
+
+    Elastic-training attribution: ``recovery``-lane spans (mesh shrink,
+    checkpoint restore) and ``ckpt``-lane spans (async snapshot capture
+    + background write) aggregate into ``recovery_ms``/``recovery_count``
+    and ``ckpt_ms``/``ckpt_count``, with ``device_lost_count`` counting
+    ``elastic.device_lost`` instants — included only when they fired."""
     if events is None:
         events = get_timeline().events()
     out = {"compile_ms": 0.0, "dispatch_ms": 0.0, "collective_ms": 0.0,
@@ -219,6 +226,8 @@ def phase_breakdown(events=None):
     faults = {"failover_count": 0, "failover_recovery_ms": 0.0,
               "replays": 0, "step_timeout_count": 0, "shed_count": 0}
     hostkv = {"host_spill_count": 0, "host_promote_count": 0}
+    elastic = {"recovery_ms": 0.0, "recovery_count": 0,
+               "ckpt_ms": 0.0, "ckpt_count": 0, "device_lost_count": 0}
 
     def _shard_row(label):
         return shards.setdefault(label, {
@@ -250,6 +259,8 @@ def phase_breakdown(events=None):
                 faults["step_timeout_count"] += 1
             elif e.name == "serving.shed":
                 faults["shed_count"] += 1
+            elif e.name == "elastic.device_lost":
+                elastic["device_lost_count"] += 1
             continue
         ms = e.dur * 1e3
         shard = attrs.get("shard")
@@ -324,6 +335,14 @@ def phase_breakdown(events=None):
                 hostkv["host_spill_count"] += 1
             elif direction == "promote":
                 hostkv["host_promote_count"] += 1
+        elif e.cat == "recovery":
+            # elastic-training lane: shrink + restore spans
+            elastic["recovery_ms"] += ms
+            elastic["recovery_count"] += 1
+        elif e.cat == "ckpt":
+            # async snapshot lane: capture + background write spans
+            elastic["ckpt_ms"] += ms
+            elastic["ckpt_count"] += 1
         elif e.cat in ("prefill", "decode"):
             out[f"{e.cat}_ms"] += ms
             out[f"{e.cat}_count"] += 1
@@ -357,6 +376,11 @@ def phase_breakdown(events=None):
     # actually moved blocks (same conditional pattern as faults)
     if any(hostkv.values()):
         out.update(hostkv)
+    # elastic-training recovery/snapshot lanes, only when they fired
+    if any(elastic.values()):
+        elastic["recovery_ms"] = round(elastic["recovery_ms"], 3)
+        elastic["ckpt_ms"] = round(elastic["ckpt_ms"], 3)
+        out.update(elastic)
     return out
 
 

@@ -41,7 +41,7 @@ GATE_SUFFIXES = ("_tokens_per_sec", "_imgs_per_sec", "_accept_rate",
                  "_hit_rate")
 #: lower-is-better latency metrics: a RISE beyond the threshold fails
 LOW_SUFFIXES = ("_p99_ttft_ms", "_p99_tpot_ms", "_failover_recovery_ms",
-                "_shed_rate")
+                "_shed_rate", "_elastic_recovery_ms")
 #: quality-parity metrics (int8 greedy match vs float): ZERO tolerance
 #: — ANY drop below last-good refuses the capture, threshold ignored
 QUALITY_SUFFIXES = ("_greedy_match",)

@@ -21,11 +21,17 @@ serving fault-tolerance story end to end:
     burst still completes;
   * **overload shedding**: a queue-depth bound turns the overflow of a
     flood into structured 429-style rejections while everything
-    admitted completes.
+    admitted completes;
+  * **device lost mid-training** (separate ``TRAIN_SCENARIOS``
+    registry, subprocess on a forced 8-device host mesh): an injected
+    ``dist.device_lost`` kill triggers mesh shrink dp 4->2, async
+    snapshot restore, and a resume bit-identical to a clean run from
+    the same checkpoint on the shrunk mesh, leaking no pipeline
+    buffers or staging bytes.
 
-``run()`` returns ``(ok, report)`` for the tier-1 gate test; the CLI
-prints a PASS/FAIL line per scenario and exits 0 iff all pass.
-CPU-only, no TPU required.
+``run()`` / ``run_training()`` return ``(ok, report)`` for the tier-1
+gate tests; the CLI runs both registries, prints a PASS/FAIL line per
+scenario and exits 0 iff all pass.  CPU-only, no TPU required.
 """
 import argparse
 import logging
@@ -218,6 +224,91 @@ def _shed(args, report):
                       "rejected": len(rejections)}
 
 
+# ---------------------------------------------------------------------
+# Training chaos: a separate registry so the serving gate
+# (tests/test_serving_faults.py) and the elastic-training gate
+# (tests/test_elastic_train.py) each pay only for their own drills.
+# ---------------------------------------------------------------------
+TRAIN_SCENARIOS = []
+
+
+def train_scenario(name):
+    def deco(fn):
+        TRAIN_SCENARIOS.append((name, fn))
+        return fn
+    return deco
+
+
+_ELASTIC_DRILL_SUB = r"""
+import os, json
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "") +
+                           " --xla_force_host_platform_device_count=8")
+import jax
+jax.config.update("jax_platforms", "cpu")
+from paddle_tpu import observability as obs
+obs.enable(True)
+from paddle_tpu.distributed.elastic_train import run_elastic_drill
+print("ELASTIC_DRILL_JSON: " + json.dumps(run_elastic_drill(seed=%SEED%),
+                                          default=str))
+"""
+
+
+@train_scenario("device lost mid-training: shrink dp 4->2, restore, "
+                "resume bit-identical to clean-from-checkpoint")
+def _elastic_device_lost(args, report):
+    import json
+    import subprocess
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("XLA_FLAGS", "JAX_PLATFORMS",
+                        "PADDLE_TPU_COMPILE_CACHE_DIR")}
+    p = subprocess.run(
+        [sys.executable, "-c",
+         _ELASTIC_DRILL_SUB.replace("%SEED%", str(args.seed))],
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        capture_output=True, text=True, timeout=900, env=env)
+    rep = None
+    for line in p.stdout.splitlines():
+        if line.startswith("ELASTIC_DRILL_JSON:"):
+            rep = json.loads(line[len("ELASTIC_DRILL_JSON:"):])
+    if rep is None:
+        raise RuntimeError("elastic drill subprocess produced no "
+                           "report: " + (p.stderr or "")[-800:])
+    phases = rep.get("phases", {})
+    assert rep["ok"], f"drill not ok: {rep}"
+    assert rep["parity"], f"resume NOT bit-identical: {rep}"
+    assert rep["mesh_after"] == "dp=2", rep["mesh_after"]
+    assert rep["restarts"] == 1 and rep["lost_steps"] >= 1, rep
+    assert rep["window_len"] == 0, "leaked in-flight pipeline buffers"
+    assert not rep["leaked_host_items"], "leaked snapshot staging bytes"
+    assert rep["mttr_ms"], "elastic.mttr_ms not populated"
+    assert phases.get("recovery_count", 0) >= 1, phases
+    assert phases.get("ckpt_count", 0) >= 1, phases
+    report["elastic_device_lost"] = {
+        "mesh": f"{rep['mesh_before']} -> {rep['mesh_after']}",
+        "resume_step": rep["resume_step"],
+        "replayed_steps": rep["replayed_steps"],
+        "lost_steps": rep["lost_steps"],
+        "mttr_ms": rep["mttr_ms"],
+        "recovery_to_first_step_ms": rep["recovery_to_first_step_ms"],
+        "recovery_ms": phases.get("recovery_ms"),
+        "ckpt_ms": phases.get("ckpt_ms")}
+
+
+def run_training(seed=7):
+    """Execute the training chaos scenarios; ``(ok, report)`` like
+    :func:`run` (the tier-1 gate in tests/test_elastic_train.py)."""
+    args = argparse.Namespace(seed=seed, requests=0)
+    report = {}
+    ok = True
+    for name, fn in TRAIN_SCENARIOS:
+        try:
+            fn(args, report)
+        except Exception:
+            ok = False
+            report[f"FAIL: {name}"] = traceback.format_exc()
+    return ok, report
+
+
 def run(seed=7, requests=6):
     """Execute every chaos scenario; returns ``(ok, report)`` where
     ``report`` maps scenario keys to recorded evidence (replay counts,
@@ -242,7 +333,7 @@ def main():
     logging.basicConfig(level=logging.WARNING)
     failures = 0
     report = {}
-    for name, fn in SCENARIOS:
+    for name, fn in SCENARIOS + TRAIN_SCENARIOS:
         args = argparse.Namespace(seed=cli.seed, requests=cli.requests)
         try:
             fn(args, report)
@@ -254,7 +345,7 @@ def main():
     for k, v in report.items():
         if not str(k).startswith("FAIL"):
             print(f"      {k}: {v}")
-    total = len(SCENARIOS)
+    total = len(SCENARIOS) + len(TRAIN_SCENARIOS)
     print(f"\nchaos smoke: {total - failures}/{total} scenarios passed "
           f"(seed={cli.seed})")
     return 1 if failures else 0

@@ -1,0 +1,312 @@
+"""Elastic preemption-tolerant training (PR 15).
+
+Covers: detection (fault sites -> DeviceLostError), mesh-shrink
+re-legalization (dp preferred, indivisible tp -> replication + TPU505),
+snapshot manifest round-trip of step/RNG/data-cursor, corrupt-manifest
+fallback (with the recorded ``ckpt.corrupt`` instant), single-device
+resume bit-parity, and the full chaos gate (device lost mid-training on
+a forced 8-device host mesh -> shrink dp 4->2 -> restore -> resume
+bit-identical to clean-from-checkpoint).
+"""
+import importlib.util
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import optimizer as popt
+from paddle_tpu import static
+from paddle_tpu import observability as obs
+from paddle_tpu.distributed.elastic_train import (DeviceLostError,
+                                                  ElasticTrainer,
+                                                  elastic_state_dict,
+                                                  list_snapshots,
+                                                  read_train_meta)
+from paddle_tpu.distributed.fault_tolerance import (FaultPlan, corrupt_file,
+                                                    inject)
+from paddle_tpu.distributed.fault_tolerance.atomic import validate_checkpoint
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+pytestmark = [pytest.mark.faults, pytest.mark.dist]
+
+
+def _tiny_trainer(tmp_path, snapshot_every=0, n_feat=4, seed=11,
+                  max_restarts=2, keep=2):
+    """A 1-device linear-regression training loop under ElasticTrainer."""
+    paddle.enable_static()
+    paddle.seed(seed)
+    main_prog, startup = static.Program(), static.Program()
+    with static.program_guard(main_prog, startup):
+        x = static.data("x", [8, n_feat], "float32")
+        y = static.data("y", [8, 1], "float32")
+        lin = paddle.nn.Linear(n_feat, 1)
+        loss = paddle.nn.functional.mse_loss(lin(x), y)
+        opt = popt.AdamW(learning_rate=1e-2,
+                         parameters=lin.parameters())
+        opt.minimize(loss)
+    exe = static.Executor()
+    exe.run(startup)
+    opt._ensure_static_state(
+        [p for p in lin.parameters() if not p.stop_gradient])
+
+    def feed(step):
+        rng = np.random.default_rng(100 + step)
+        return {"x": rng.standard_normal((8, n_feat), np.float32),
+                "y": rng.standard_normal((8, 1), np.float32)}
+
+    state = elastic_state_dict(lin, opt)
+    trainer = ElasticTrainer(exe, main_prog, feed, [loss],
+                             state_dict=state,
+                             ckpt_dir=str(tmp_path),
+                             snapshot_every=snapshot_every,
+                             keep=keep, max_restarts=max_restarts)
+    return trainer, lin, opt, state
+
+
+class TestDetection:
+    def test_device_lost_site_escalates(self, tmp_path):
+        trainer, _, _, _ = _tiny_trainer(tmp_path, max_restarts=0)
+        try:
+            fp = FaultPlan().add("dist.device_lost.0", "kill",
+                                 after=1, count=1)
+            with inject(fp):
+                # no snapshot exists and max_restarts=0: the structured
+                # error surfaces instead of the raw SimulatedWorkerDeath
+                with pytest.raises(DeviceLostError) as ei:
+                    trainer.run(4)
+            assert ei.value.lost_ranks == [0]
+            assert not ei.value.preempted
+            assert fp.history and fp.history[0][0] == "dist.device_lost.0"
+        finally:
+            paddle.disable_static()
+
+    def test_host_preempt_site(self, tmp_path):
+        trainer, _, _, _ = _tiny_trainer(tmp_path, max_restarts=0)
+        try:
+            fp = FaultPlan().add("dist.host_preempt", "drop", count=1)
+            with inject(fp):
+                with pytest.raises(DeviceLostError) as ei:
+                    trainer.run(2)
+            assert ei.value.preempted
+        finally:
+            paddle.disable_static()
+
+    def test_watchdog_escalation_maps_missing_ranks(self):
+        from paddle_tpu.distributed.fault_tolerance.watchdog import \
+            CollectiveTimeoutError
+        e = CollectiveTimeoutError("all_reduce", "dp", 1.0,
+                                   checked_in=[0, 2], missing=[1, 3])
+        err = ElasticTrainer._escalate(e)
+        assert isinstance(err, DeviceLostError)
+        assert err.lost_ranks == [1, 3] and not err.preempted
+
+
+class TestManifestRoundTrip:
+    def test_snapshot_carries_step_rng_cursor(self, tmp_path):
+        trainer, _, _, _ = _tiny_trainer(tmp_path, snapshot_every=2)
+        try:
+            trainer.run(4)
+            snaps = list_snapshots(str(tmp_path))
+            assert len(snaps) == 2
+            ok, reasons = validate_checkpoint(snaps[-1])
+            assert ok, reasons
+            train = read_train_meta(snaps[-1])
+            assert train["step"] == 4
+            assert train["data_cursor"] == 4
+            key = np.asarray(train["rng_key"], np.uint32)
+            assert key.shape and key.size >= 2
+        finally:
+            paddle.disable_static()
+
+    def test_resume_bit_parity_single_device(self, tmp_path):
+        trainer, lin, opt, state = _tiny_trainer(tmp_path,
+                                                 snapshot_every=2,
+                                                 keep=8)
+        try:
+            fp = FaultPlan().add("dist.device_lost.0", "kill",
+                                 after=3, count=1)
+            with inject(fp):
+                trainer.run(6)
+            assert trainer.restarts == 1
+            assert trainer.last_resume_step == 2
+            assert trainer.lost_steps == 1
+            assert trainer.mttr_ms
+            elastic = {n: np.asarray(t._value) for n, t in state.items()}
+            # clean reference: restore the SAME snapshot into the same
+            # tensors and replay steps 2..5 without any fault
+            resume = trainer.restore(trainer.last_resume_path)
+            assert resume == 2
+            for step in range(resume, 6):
+                trainer.exe.run(trainer.program,
+                                feed=trainer.feed_fn(step),
+                                fetch_list=trainer.fetch_list)
+            clean = {n: np.asarray(t._value) for n, t in state.items()}
+            for n in elastic:
+                assert elastic[n].tobytes() == clean[n].tobytes(), n
+        finally:
+            paddle.disable_static()
+
+
+class TestCorruptFallback:
+    def test_pick_checkpoint_skips_corrupt_newest(self, tmp_path):
+        trainer, _, _, _ = _tiny_trainer(tmp_path, snapshot_every=1)
+        try:
+            trainer.run(3)
+            snaps = list_snapshots(str(tmp_path))
+            assert len(snaps) >= 2
+            corrupt_file(os.path.join(snaps[-1], "shard_0.pkl"), seed=3)
+            assert not validate_checkpoint(snaps[-1])[0]
+            obs.enable(True)
+            try:
+                picked = trainer._pick_checkpoint()
+                events = [e for e in
+                          obs.get_timeline().events()
+                          if e.name == "ckpt.corrupt"]
+            finally:
+                obs.enable(False)
+            assert picked == snaps[-2]
+            assert events and events[-1].attrs["path"] == snaps[-1]
+        finally:
+            paddle.disable_static()
+
+    def test_load_state_dict_fallback_records_instant(self, tmp_path):
+        from paddle_tpu.distributed.checkpoint.save_load import (
+            load_state_dict, save_state_dict)
+        t = paddle.to_tensor(np.arange(6, dtype=np.float32).reshape(2, 3))
+        t.name = "w"
+        good = str(tmp_path / "g1")
+        bad = str(tmp_path / "g2")
+        save_state_dict({"w": t}, good)
+        save_state_dict({"w": t}, bad)
+        corrupt_file(os.path.join(bad, "shard_0.pkl"), seed=5)
+        dst = paddle.to_tensor(np.zeros((2, 3), np.float32))
+        dst.name = "w"
+        obs.enable(True)
+        try:
+            with pytest.warns(RuntimeWarning):
+                load_state_dict({"w": dst}, bad, fallback_path=good)
+            events = [e for e in obs.get_timeline().events()
+                      if e.name == "ckpt.corrupt"]
+        finally:
+            obs.enable(False)
+        assert events, "no ckpt.corrupt instant recorded"
+        np.testing.assert_array_equal(np.asarray(dst._value),
+                                      np.asarray(t._value))
+
+
+class TestShrinkRelegalization:
+    """MeshPlan.shrink needs a real multi-device mesh -> subprocess."""
+
+    SCRIPT = r"""
+import os
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "") +
+                           " --xla_force_host_platform_device_count=8")
+import json
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+from paddle_tpu.distributed.auto_parallel.sharding import (BERT_RULES,
+                                                           MeshPlan)
+
+out = {}
+devs8 = None
+
+p = MeshPlan("dp=4", rules=BERT_RULES())
+devs8 = list(np.asarray(p.mesh.devices).ravel())
+s = p.shrink([d for i, d in enumerate(devs8) if i != 3])
+out["dp"] = s.describe()
+out["gen"] = s._generation
+out["token_changed"] = p.cache_token() != s.cache_token()
+out["same_rules"] = s.rules_token() == p.rules_token()
+
+p2 = MeshPlan("dp=2,tp=4", rules=BERT_RULES())
+s2 = p2.shrink(devs8[:3])
+out["tp_fallback"] = s2.describe()
+out["tp_findings"] = [f.code for f in s2.shrink_findings]
+# the SAME rules re-legalize on the shrunk mesh: a tp-sharded weight
+# re-materializes replicated (size-1 tp axis dropped by _legalize)
+shape = (64, 64)
+spec_before = str(p2.spec_for("bert.encoder.0.attention.qkv.weight",
+                              shape))
+spec_after = str(s2.spec_for("bert.encoder.0.attention.qkv.weight",
+                             shape))
+out["spec_before"] = spec_before
+out["spec_after"] = spec_after
+
+p3 = MeshPlan("dp=2,fsdp=2", rules=BERT_RULES())
+s3 = p3.shrink(devs8[:2])
+out["fsdp"] = s3.describe()
+
+p4 = MeshPlan("dp=2,fsdp=2", rules=BERT_RULES())
+s4 = p4.shrink(devs8[:6])
+out["fsdp6"] = s4.describe()
+
+try:
+    MeshPlan("tp=8").shrink([])
+    out["empty_raises"] = False
+except ValueError:
+    out["empty_raises"] = True
+
+print("SHRINK_JSON: " + json.dumps(out))
+"""
+
+    @pytest.fixture(scope="class")
+    def shrink_report(self):
+        env = {k: v for k, v in os.environ.items()
+               if k not in ("XLA_FLAGS", "JAX_PLATFORMS")}
+        p = subprocess.run([sys.executable, "-c", self.SCRIPT],
+                           cwd=ROOT, capture_output=True, text=True,
+                           timeout=600, env=env)
+        for line in p.stdout.splitlines():
+            if line.startswith("SHRINK_JSON:"):
+                return json.loads(line[len("SHRINK_JSON:"):])
+        raise RuntimeError("no report: " + (p.stderr or "")[-800:])
+
+    def test_dp_shrinks_to_largest_divisor(self, shrink_report):
+        assert shrink_report["dp"] == "dp=2"
+        assert shrink_report["gen"] == 1
+        assert shrink_report["token_changed"]
+        assert shrink_report["same_rules"]
+
+    def test_indivisible_tp_falls_back_with_tpu505(self, shrink_report):
+        assert shrink_report["tp_fallback"] == "dp=2,tp=1"
+        assert shrink_report["tp_findings"] == ["TPU505"]
+        assert "tp" in shrink_report["spec_before"]
+        assert "tp" not in shrink_report["spec_after"]
+
+    def test_fsdp_survives_dp_prefers_shrink(self, shrink_report):
+        # 2 devices: dp gives way first, fsdp keeps its sharding
+        assert shrink_report["fsdp"] == "dp=1,fsdp=2"
+        # 6 devices: dp can only keep a divisor of 2 -> dp=2 (4 used)
+        assert shrink_report["fsdp6"] == "dp=2,fsdp=2"
+
+    def test_empty_survivor_set_raises(self, shrink_report):
+        assert shrink_report["empty_raises"]
+
+
+def _load_chaos_smoke():
+    spec = importlib.util.spec_from_file_location(
+        "chaos_smoke", os.path.join(ROOT, "scripts", "chaos_smoke.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+class TestChaosTrainingGate:
+    """Tier-1 gate: the full device-lost drill (subprocess, forced
+    8-device host mesh) must pass — shrink dp 4->2, restore, resume
+    bit-identical, zero leaked buffers, mttr populated."""
+
+    def test_training_scenario_passes(self):
+        smoke = _load_chaos_smoke()
+        ok, report = smoke.run_training(seed=7)
+        assert ok, json.dumps(report, indent=1, default=str)[-2000:]
+        ev = report["elastic_device_lost"]
+        assert ev["mesh"] == "dp=4 -> dp=2"
+        assert ev["replayed_steps"] >= 1
+        assert ev["mttr_ms"]

@@ -284,3 +284,74 @@ def test_serving_smoke_tiering_scenario(monkeypatch):
     spec.loader.exec_module(mod)
     args = types.SimpleNamespace(seed=7, requests=16)
     mod._tiering(args)
+
+
+def test_spill_dma_failure_degrades_to_miss():
+    """kv.dma_fail during spill: after the bounded retry the evicted
+    block is simply not host-cached — a later request is a miss, never
+    a crash — and the reserved host slot is returned."""
+    from paddle_tpu import observability as obs
+    from paddle_tpu.distributed.fault_tolerance import FaultPlan, inject
+
+    cache = _cache()
+    ta, tb, td = _tokens(1), _tokens(2), _tokens(3)
+    assert cache.allocate("a", 16, tokens=ta)
+    _write(cache, "a", seed=11)
+    cache.free("a", tokens=ta)          # 4 blocks park, indexed
+
+    obs.enable(True)
+    try:
+        c0 = obs.get_registry().counter("serving.kv_dma_fail").value
+        # 4 spill DMAs x (1 try + 1 retry) all dropped
+        fp = FaultPlan().add("kv.dma_fail", "drop", count=8)
+        with inject(fp):
+            assert cache.allocate("b", 16, tokens=tb)
+            assert cache.allocate("d", 16, tokens=td)
+        assert cache.host_spills == 0
+        assert cache.host.used_slots == 0   # reserved slots given back
+        assert obs.get_registry().counter(
+            "serving.kv_dma_fail").value - c0 == 4
+        instants = [e for e in obs.get_timeline().events()
+                    if e.name == "kv.dma_fail"]
+        assert instants and instants[-1].attrs["dir"] == "spill"
+    finally:
+        obs.disable()
+
+    cache.free("b")
+    cache.free("d")
+    # the evicted chain never made it to host: plain miss on reuse
+    assert cache.allocate("a2", 16, tokens=ta)
+    assert cache.cached_prefix_len("a2") == 0
+    assert cache.host_promotes == 0
+
+
+def test_promote_dma_failure_degrades_to_shorter_prefix():
+    """kv.dma_fail during promote: the suspect host entry is dropped and
+    the allocate re-walk transparently sees a shorter cached prefix; the
+    engine recomputes those tokens and never observes the failure."""
+    from paddle_tpu.distributed.fault_tolerance import FaultPlan, inject
+
+    cache = _cache()
+    ta, tb, td = _tokens(1), _tokens(2), _tokens(3)
+    assert cache.allocate("a", 16, tokens=ta)
+    _write(cache, "a", seed=11)
+    cache.free("a", tokens=ta)
+    assert cache.allocate("b", 16, tokens=tb)
+    assert cache.allocate("d", 16, tokens=td)
+    assert cache.host_spills == 4
+    cache.free("b")
+    cache.free("d")
+    host_used = cache.host.used_slots
+
+    # the FIRST promote DMA dies (try + retry); the chain re-walk stops
+    # at the dropped link, so the whole prefix degrades to a miss
+    fp = FaultPlan().add("kv.dma_fail", "drop", count=2)
+    with inject(fp):
+        assert cache.allocate("a2", 16, tokens=ta)
+    assert cache.cached_prefix_len("a2") == 0
+    assert cache.host_promotes == 0
+    assert cache.host.used_slots == host_used - 1  # bad entry dropped
+    # the sequence's blocks are ordinary scratch: write/free still work
+    _write(cache, "a2", seed=21)
+    cache.free("a2")
+    assert cache.allocate("e", 16, tokens=_tokens(5))

@@ -160,3 +160,52 @@ def test_elastic_store_over_tcp_store(monkeypatch):
         assert es.get("absent", "dflt") == "dflt"
     finally:
         master.close()
+
+
+def test_server_bounce_idempotent_replay_reconnects():
+    """The store server dies and comes back on the same port (rendezvous
+    master restart).  Idempotent ops (get/query) replay through the
+    per-call RetryPolicy and transparently reconnect; a non-idempotent
+    set surfaces a bounded error immediately — it may already have
+    landed, so replaying it would not be safe."""
+    srv = _PyStoreServer(0)
+    port = srv.port
+    store = TCPStore("127.0.0.1", port, is_master=False, world_size=1,
+                     timeout=5, retries=4)
+    try:
+        store.set("k0", b"v0")
+        assert store.get("k0") == b"v0"
+        srv.stop()
+        time.sleep(0.05)
+        with pytest.raises((ConnectionError, TimeoutError, OSError)):
+            store.set("k1", b"v1")
+        srv = _PyStoreServer(port)  # SO_REUSEADDR: rebind same port
+        with srv._cv:
+            srv._data["k2"] = b"v2"
+            srv._cv.notify_all()
+        # idempotent get reconnects + replays within its retry budget
+        assert store.get("k2") == b"v2"
+        assert store.query("missing") is None
+        store.set("k3", b"v3")  # non-idempotent works again post-bounce
+        assert store.get("k3") == b"v3"
+    finally:
+        store.close()
+        srv.stop()
+
+
+def test_idempotent_replay_is_bounded():
+    """With the server gone for good, an idempotent op exhausts its
+    replay budget and fails with a named ConnectionError instead of
+    looping forever."""
+    srv = _PyStoreServer(0)
+    store = TCPStore("127.0.0.1", srv.port, is_master=False,
+                     world_size=1, timeout=5, retries=2)
+    srv.stop()
+    time.sleep(0.05)
+    try:
+        t0 = time.monotonic()
+        with pytest.raises((ConnectionError, OSError), match="get"):
+            store.get("k")
+        assert time.monotonic() - t0 < 8
+    finally:
+        store.close()
