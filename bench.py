@@ -1171,6 +1171,62 @@ def bench_bert_elastic(on_tpu):
 
 
 # ---------------------------------------------------------------------
+# gpt_cluster: the multi-host serving fabric drill as a benchmark — a
+# 4-host ClusterRouter burst survives a hard host kill and a
+# preemption drain (KV shipped over the fabric transport).  Judged
+# metrics: p99 TTFT under chaos and failover recovery (both lower is
+# better), and the fraction of fabric transfer time hidden behind
+# decode (higher is better).  Runs in a subprocess on a forced
+# 8-device host mesh so MeshPlan.shrink is exercised for real.
+
+_GPT_CLUSTER_SUB = r"""
+import os, sys, json
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "") +
+                           " --xla_force_host_platform_device_count=8")
+import jax
+jax.config.update("jax_platforms", "cpu")
+import importlib.util
+spec = importlib.util.spec_from_file_location(
+    "chaos_smoke_bench", os.path.join(%ROOT%, "scripts",
+                                      "chaos_smoke.py"))
+mod = importlib.util.module_from_spec(spec)
+spec.loader.exec_module(mod)
+print("GPT_CLUSTER_JSON: " +
+      json.dumps(mod.run_cluster_drill(seed=7), default=str))
+"""
+
+
+def bench_gpt_cluster(on_tpu):
+    t = time.time()
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("XLA_FLAGS", "JAX_PLATFORMS",
+                        "PADDLE_TPU_COMPILE_CACHE_DIR")}
+    p = subprocess.run(
+        [sys.executable, "-c",
+         _GPT_CLUSTER_SUB.replace("%ROOT%", repr(str(ROOT)))],
+        cwd=str(ROOT), capture_output=True, text=True, timeout=1800,
+        env=env)
+    rep = None
+    for line in p.stdout.splitlines():
+        if line.startswith("GPT_CLUSTER_JSON:"):
+            rep = json.loads(line[len("GPT_CLUSTER_JSON:"):])
+    if rep is None:
+        raise RuntimeError("gpt_cluster subprocess produced no result: "
+                           + (p.stderr or "")[-400:])
+    rep["seconds"] = round(time.time() - t, 1)
+    # the worse (kill vs preempt) TTFT is the honest chaos headline
+    rep["p99_ttft_ms"] = max(rep["kill"]["ttft_p99_ms"],
+                             rep["preempt"]["ttft_p99_ms"])
+    rep["failover_ms"] = rep["preempt"]["cluster_failover_ms"]
+    rep["fabric_hidden_ratio"] = rep["preempt"]["fabric_hidden_ratio"]
+    log(f"gpt_cluster: ok={rep['ok']} p99 ttft "
+        f"{rep['p99_ttft_ms']:.0f} ms failover "
+        f"{rep['failover_ms']:.0f} ms hidden "
+        f"{rep['fabric_hidden_ratio']:.3f} ({rep['seconds']:.0f}s)")
+    return rep
+
+
+# ---------------------------------------------------------------------
 # bert_tp: the same BERT-mini step under tp=2 — the executor routes
 # row-parallel matmuls through the overlapped all-gather/reduce-scatter
 # ring (distributed/auto_parallel/overlap.py), so this config is the
@@ -1464,6 +1520,7 @@ def main():
         "bert_dp": lambda: bench_bert_dp(on_tpu),
         "bert_tp": lambda: bench_bert_tp(on_tpu),
         "bert_elastic": lambda: bench_bert_elastic(on_tpu),
+        "gpt_cluster": lambda: bench_gpt_cluster(on_tpu),
     }
     errors = {}
     from collections import Counter as _Counter
@@ -1625,6 +1682,18 @@ def main():
             if res.get("phases"):
                 payload["extra_metrics"]["bert_elastic_phases"] = \
                     res["phases"]
+        elif name == "gpt_cluster":
+            payload["extra_metrics"]["gpt_cluster_ok"] = res["ok"]
+            payload["extra_metrics"]["gpt_cluster_p99_ttft_ms"] = \
+                res["p99_ttft_ms"]
+            payload["extra_metrics"]["gpt_cluster_failover_ms"] = \
+                res["failover_ms"]
+            payload["extra_metrics"]["gpt_fabric_hidden_ratio"] = \
+                res["fabric_hidden_ratio"]
+            payload["extra_metrics"]["gpt_cluster_mesh"] = \
+                f"dp=8 -> {res['preempt']['mesh_after']}"
+            payload["extra_metrics"]["gpt_cluster_fabric_bytes"] = \
+                res["preempt"]["fabric_bytes"]
         elif name == "bert_tp":
             payload["extra_metrics"]["bert_tp_tokens_per_sec"] = \
                 res["tokens_per_sec"]

@@ -14,12 +14,13 @@ hint.  Entry points:
   executable caches) after steps ran;
 * ``observability.lint_summary_table()`` — render recorded findings.
 """
-from . import (diagnostics, dtype_audit, host_sync, recompile,
-               sharding_audit, tiling)
+from . import (diagnostics, dtype_audit, fabric_audit, host_sync,
+               recompile, sharding_audit, tiling)
 from .diagnostics import (CODES, ERROR, INFO, SEVERITIES, WARNING,
                           Diagnostic, DiagnosticLog, DiagnosticReport,
                           describe_code, get_log, record, reset_log)
 from .dtype_audit import audit_jaxpr, check_collective_payload, iter_eqns
+from .fabric_audit import audit_fabric_handoff, handoff_bytes_per_block
 from .host_sync import audit_host_sync, sync_budget
 from .sharding_audit import audit_sharding, check_collective_axis
 from .program import analyze_runtime, analyze_traced, lint_summary
@@ -35,13 +36,15 @@ __all__ = [
     "CODES", "ERROR", "INFO", "LANE", "SEVERITIES", "VMEM_BYTES",
     "WARNING", "Diagnostic", "DiagnosticLog", "DiagnosticReport",
     "analyze_runtime", "analyze_traced", "audit_eager_cache",
-    "audit_executor_cache", "audit_flash_attention", "audit_host_sync",
+    "audit_executor_cache", "audit_fabric_handoff",
+    "audit_flash_attention", "audit_host_sync",
     "audit_jaxpr", "audit_layer_norm_residual", "audit_matmul_epilogue",
     "audit_paged_attention", "audit_ragged_attention",
     "audit_sharding", "audit_trace_cache", "check_collective_axis",
     "audit_weak_types", "check_block_spec", "check_collective_payload",
     "check_pallas_call", "describe_code", "diagnostics", "dtype_audit",
-    "estimate_vmem_bytes", "get_log", "host_sync", "iter_eqns",
+    "estimate_vmem_bytes", "fabric_audit", "get_log",
+    "handoff_bytes_per_block", "host_sync", "iter_eqns",
     "lint_summary", "min_tile", "record", "recompile", "reset_log",
     "sync_budget", "tiling",
 ]

@@ -88,6 +88,10 @@ CODES = {
     "TPU505": ("mesh shrink dropped a model-parallel axis to replication: "
                "the surviving devices cannot hold the axis, so its "
                "parameters re-materialize fully replicated", WARNING),
+    "TPU506": ("KV handoff payload cannot hide behind the decode window: "
+               "the transfer outlasts the decode steps available before "
+               "the destination needs the blocks, so decode stalls on "
+               "the fabric", WARNING),
 }
 
 

@@ -1,0 +1,84 @@
+"""Fabric handoff analyzer: TPU506, pure arithmetic.
+
+The cross-host serving fabric (inference/serving/transport.py) is
+scheduled to hide KV handoff transfers behind the destination's decode
+steps — the same overlap discipline the tile-level collective overlap
+uses for matmul reduce-scatters.  Whether a given payload CAN hide is
+decidable before any byte moves:
+
+* a handoff ships ``num_blocks`` cross-layer block slabs of
+  ``bytes_per_block`` each, so the wire occupies the link for
+  ``transfer_ms = num_blocks * bytes_per_block / link``;
+* the destination keeps decoding its other rows while the payload is
+  in flight, but only until the handed-off request's first decode
+  step needs the blocks seated.  Under chunked prefill that window is
+  the time the source spends on one admission chunk —
+  ``chunk_size // block_size`` block-steps of decode at
+  ``decode_step_ms`` each — because the router places payloads once
+  per step and the next chunk's completion wants the previous
+  payload's seat.
+
+``transfer_ms > window_ms`` means decode stalls on the fabric:
+**TPU506**.  The fix levers are the ones in the inequality — fewer
+bytes per block (int8 KV halves it, scales included), a bigger chunk
+(wider window), or a fatter link.
+"""
+from __future__ import annotations
+
+from .diagnostics import Diagnostic, DiagnosticReport, record
+
+__all__ = ["audit_fabric_handoff", "handoff_bytes_per_block"]
+
+
+def handoff_bytes_per_block(num_layers, num_heads, block_size, head_dim,
+                            itemsize, scale_lanes=0):
+    """Wire bytes of ONE cross-layer block slab in a
+    :class:`~..inference.serving.tiering.HandoffPayload`: K and V for
+    every layer, plus the f32 per-slot scale tables int8 pools carry
+    alongside."""
+    data = 2 * num_layers * num_heads * block_size * head_dim * itemsize
+    scales = 2 * num_layers * block_size * scale_lanes * 4
+    return int(data + scales)
+
+
+def audit_fabric_handoff(num_blocks, bytes_per_block, chunk_size,
+                         block_size, *, link_gbps=2.0,
+                         decode_step_ms=2.0, site="fabric.handoff",
+                         report=None, emit=True):
+    """TPU506 check for one handoff geometry (module doc).
+
+    Pure arithmetic — no timeline, no engine: callable from the lint
+    CLI over a planned serving config as easily as from a live router.
+    Returns a :class:`DiagnosticReport`; the finding's ``data`` holds
+    both sides of the inequality so the report is actionable."""
+    report = report if report is not None else DiagnosticReport(
+        label="fabric handoff")
+    transfer_ms = (num_blocks * bytes_per_block) \
+        / (link_gbps * 1e9) * 1e3
+    window_steps = max(1, int(chunk_size) // max(1, int(block_size)))
+    window_ms = window_steps * float(decode_step_ms)
+    if transfer_ms > window_ms:
+        d = Diagnostic(
+            "TPU506",
+            f"handoff of {num_blocks} blocks "
+            f"({num_blocks * bytes_per_block} B) needs "
+            f"{transfer_ms:.3f} ms on a {link_gbps:g} GB/s link but "
+            f"the decode window at chunk size {chunk_size} is only "
+            f"{window_steps} step(s) = {window_ms:.3f} ms — decode "
+            "stalls on the fabric",
+            site=site,
+            hint="shrink bytes/block (int8 KV halves the slab, scale "
+                 "tables ride along), raise the prefill chunk size to "
+                 "widen the decode window, or provision link "
+                 "bandwidth",
+            data={"num_blocks": int(num_blocks),
+                  "bytes_per_block": int(bytes_per_block),
+                  "transfer_ms": round(transfer_ms, 3),
+                  "window_ms": round(window_ms, 3),
+                  "window_steps": window_steps,
+                  "chunk_size": int(chunk_size),
+                  "link_gbps": float(link_gbps)})
+        if emit:
+            record(d)
+        report.add(d)
+    return report

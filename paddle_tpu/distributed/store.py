@@ -34,11 +34,30 @@ import struct
 import threading
 import time
 
+from .. import observability as obs
 from .fault_tolerance.plan import fault_point
 from .fault_tolerance.retry import (ENV_STORE_RETRIES,
                                     RetryExhausted, RetryPolicy)
 
-__all__ = ["TCPStore"]
+__all__ = ["StoreTimeoutError", "TCPStore"]
+
+
+class StoreTimeoutError(TimeoutError):
+    """``TCPStore.wait`` ran out its hard deadline.
+
+    Structured: ``keys`` is the full wait set, ``pending`` the keys
+    not yet observed when the deadline hit, ``waited_s`` the wall time
+    actually spent, ``deadline_s`` the budget.  Subclasses
+    ``TimeoutError`` so pre-existing ``except TimeoutError`` callers
+    keep working."""
+
+    def __init__(self, msg, keys=(), pending=(), waited_s=0.0,
+                 deadline_s=0.0):
+        super().__init__(msg)
+        self.keys = tuple(keys)
+        self.pending = tuple(pending)
+        self.waited_s = float(waited_s)
+        self.deadline_s = float(deadline_s)
 
 
 class _PyStoreServer:
@@ -288,7 +307,7 @@ class TCPStore:
         msg += payload
         self._sock.sendall(msg)
 
-    def _call(self, op_name, fn, idempotent=False):
+    def _call(self, op_name, fn, idempotent=False, deadline=None):
         """Run one wire op under the lock.  Transient socket errors
         drop the connection; idempotent ops reconnect and replay through
         ``RetryPolicy`` (the store may have restarted — get/wait/query
@@ -319,7 +338,7 @@ class TCPStore:
             if idempotent:
                 return self._op_policy.call(
                     attempt, exceptions=(ConnectionError, OSError),
-                    what="store." + op_name)
+                    deadline=deadline, what="store." + op_name)
             return attempt()
         except _ReplyTimeout as e:
             raise TimeoutError(
@@ -369,14 +388,57 @@ class TCPStore:
             return now
         return self._call("add", fn)
 
-    def wait(self, keys):
+    def wait(self, keys, deadline=None):
+        """Block until every key exists — under a HARD deadline.
+
+        ``deadline`` (seconds; default the store timeout) bounds the
+        WHOLE wait: all keys, all reconnect retries (paced by the
+        ``RetryPolicy``, which stops scheduling attempts past the
+        deadline), and each server-side park (the socket timeout is
+        shrunk to the remaining budget, so a wedged master cannot
+        spin this past its bound).  On expiry raises
+        :class:`StoreTimeoutError` naming the pending keys and emits
+        a ``store.wait_timeout`` instant."""
         if isinstance(keys, str):
             keys = [keys]
-        for k in keys:
+        keys = list(keys)
+        budget = self._timeout if deadline is None else float(deadline)
+        t_end = time.monotonic() + budget
+
+        def _expired(err, pending):
+            waited = budget - max(0.0, t_end - time.monotonic())
+            obs.instant("store.wait_timeout", cat="fault",
+                        keys=len(keys), pending=pending[0],
+                        waited_s=round(waited, 3),
+                        deadline_s=round(budget, 3))
+            raise StoreTimeoutError(
+                f"TCPStore.wait: {len(pending)}/{len(keys)} key(s) "
+                f"still absent after {waited:.3f}s "
+                f"(deadline {budget:.3f}s); first pending: "
+                f"{pending[0]!r}", keys=keys, pending=pending,
+                waited_s=waited, deadline_s=budget) from err
+
+        for n, k in enumerate(keys):
             def fn(k=k):
-                self._req(b"W", k)
-                self._read_n(1)
-            self._call("wait", fn, idempotent=True)
+                remaining = t_end - time.monotonic()
+                if remaining <= 0:
+                    raise TimeoutError(
+                        f"wait deadline expired before {k!r}")
+                prev = self._sock.gettimeout()
+                self._sock.settimeout(
+                    min(prev, remaining) if prev else remaining)
+                try:
+                    self._req(b"W", k)
+                    self._read_n(1)
+                finally:
+                    try:
+                        self._sock.settimeout(prev)
+                    except OSError:
+                        pass
+            try:
+                self._call("wait", fn, idempotent=True, deadline=t_end)
+            except (TimeoutError, ConnectionError) as e:
+                _expired(e, keys[n:])
 
     def delete_key(self, key):
         def fn():

@@ -8,7 +8,10 @@ shedding), and prefill/decode disaggregation.
 The multi-request generation layer over models/gpt.py — see
 README.md §"Serving" and §"Serving fault tolerance".  Entry point:
 ``GenerationEngine`` (one replica) / ``DataParallelEngine`` (a fleet) /
-``DisaggregatedEngine`` (role-split prefill + decode engines).
+``DisaggregatedEngine`` (role-split prefill + decode engines) /
+``ClusterRouter`` (multi-host fabric: wire-format KV handoffs over
+``transport``, gossiped prefix routing, preemption-driven
+autoscaling — README §"Cluster serving").
 """
 from .kv_cache import (ENV_KV_BLOCK_SIZE, ENV_PREFIX_CACHE,
                        RESIDENT_NAME, PagedKVCache, kv_block_size,
@@ -41,6 +44,14 @@ from .engine import (ENV_SHED_DEPTH, ENV_STEP_DEADLINE_MS,
 from .dp import (HEALTHY, PROBATION, UNHEALTHY, DataParallelEngine,
                  ReplicaHealth)
 from .disagg import DisaggregatedEngine
+from .transport import (WIRE_MAGIC, WIRE_VERSION, Delivery,
+                        HandoffEnvelope, LoopbackTransport,
+                        PayloadIntegrityError, PayloadVersionError,
+                        StoreTransport, TransportError,
+                        TransportTimeout, deserialize_handoff,
+                        deserialize_request, serialize_handoff,
+                        serialize_request)
+from .cluster import ClusterRouter, LocalStore
 
 __all__ = [
     "ENV_KV_BLOCK_SIZE", "ENV_PREFIX_CACHE", "RESIDENT_NAME",
@@ -68,4 +79,10 @@ __all__ = [
     "DataParallelEngine", "ReplicaHealth",
     "HEALTHY", "PROBATION", "UNHEALTHY",
     "DisaggregatedEngine",
+    "WIRE_MAGIC", "WIRE_VERSION", "Delivery", "HandoffEnvelope",
+    "LoopbackTransport", "PayloadIntegrityError", "PayloadVersionError",
+    "StoreTransport", "TransportError", "TransportTimeout",
+    "deserialize_handoff", "deserialize_request", "serialize_handoff",
+    "serialize_request",
+    "ClusterRouter", "LocalStore",
 ]

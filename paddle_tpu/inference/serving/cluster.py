@@ -1,0 +1,562 @@
+"""Multi-host serving fabric: gossiped prefix routing + autoscaling.
+
+One :class:`ClusterRouter` fronts N *hosts* — each a full colocated
+:class:`~.engine.GenerationEngine` replica with its own paged KV pool
+— and extends the single-process serving stack (dp.py's affinity
+routing, disagg.py's block-granular handoffs, the PR-15 elastic
+machinery) across a simulated host boundary:
+
+**Gossiped prefix affinity.**  ``prefix_match_tokens`` needs the
+pool's chain-hash index, which on a remote host is not addressable.
+Each host therefore publishes a compact digest of its prefix index
+(:meth:`~.kv_cache.PagedKVCache.prefix_digest` — the chain hashes of
+both tiers) through the rendezvous store on a heartbeat.  The router
+hashes an incoming prompt once (``chain_hashes``) and scores every
+host by how many leading links its *gossiped* digest holds.  The
+contract: a summary older than ``staleness_s`` scores zero, and a
+digest is a ROUTING HINT ONLY — a stale or wrong hint routes to a
+host that misses its prefix cache and re-prefills, which is slower,
+never wrong.  Correctness always re-derives from the chosen host's
+actual index.  (In this in-process simulation the chain hashes come
+from Python's salted ``hash`` and are only comparable within one
+process; a real deployment would swap in a process-stable hash — the
+gossip contract is unchanged.)
+
+**Failover = replay.**  Per-host :class:`~.dp.ReplicaHealth` machines
+(the PR-12 transitions) gate stepping and routing.  When a host dies
+mid-step (``fabric.host_down.h<i>``), its waiting AND running
+requests are harvested — committed progress folds into the prompt via
+``scheduler.requeue`` — and resubmitted on survivors.  Sampling is
+keyed by ``fold_in(seed, absolute_position)``, so the replay is
+bit-identical: the cluster's output with a mid-burst host kill equals
+the no-kill run token for token, greedy or seeded.
+
+**Autoscaling = the same drain, driven by pressure.**  The autoscaler
+watches aggregate queue depth: sustained pressure activates a spare
+host (scale-up), sustained idleness drains one (scale-down).  A
+*preemption notice* (``fabric.preempt.h<i>``, the TPU-pool eviction
+signal) takes exactly the scale-down path: extract every decodable
+request's KV as a :class:`~.tiering.HandoffPayload`, ship it over the
+fabric transport (transport.py wire bytes — the prefix-cache value
+leaves WITH the host), replay the rest, and re-legalize any attached
+:class:`~..distributed.auto_parallel.sharding.MeshPlan` via
+``shrink()`` so a training-style mesh riding the same pool stays
+legal.  Every move records ``fabric.scale_event`` instants and
+``serving.cluster_failover_ms`` so ``phase_breakdown()`` surfaces
+them next to the fabric transfer lane.
+"""
+from __future__ import annotations
+
+import json
+import time
+from collections import deque
+
+from ... import observability as obs
+from ...distributed.fault_tolerance.plan import fault_point
+from .dp import ReplicaHealth
+from .engine import GenerationEngine
+from .errors import ServingUnavailable
+from .transport import LoopbackTransport, serialize_handoff
+
+__all__ = ["ClusterRouter", "LocalStore"]
+
+
+class LocalStore:
+    """Dict-backed stand-in for ``TCPStore`` (set/get/query/add/wait)
+    so the single-process cluster simulation gossips through the same
+    store API a real deployment would point at the rendezvous
+    master."""
+
+    def __init__(self):
+        self._data = {}
+
+    def set(self, key, value):
+        if isinstance(value, str):
+            value = value.encode()
+        self._data[key] = bytes(value)
+
+    def get(self, key):
+        return self._data[key]
+
+    def query(self, key):
+        return self._data.get(key)
+
+    def add(self, key, amount=1):
+        cur = int(self._data.get(key, b"0")) + int(amount)
+        self._data[key] = str(cur).encode()
+        return cur
+
+    def wait(self, keys, deadline=None):
+        if isinstance(keys, str):
+            keys = [keys]
+        missing = [k for k in keys if k not in self._data]
+        if missing:
+            raise TimeoutError(f"LocalStore.wait: absent {missing[0]!r}")
+
+
+class ClusterRouter:
+    """Multi-host serving front (module doc).
+
+    ``hosts`` replicas are active at start; ``spare_hosts`` more can
+    be activated by the autoscaler (their engines are built lazily on
+    first activation, so an unused spare costs nothing).  All engines
+    split one colocated engine's HBM budget unless ``hbm_fraction``
+    says otherwise."""
+
+    def __init__(self, model, hosts=2, spare_hosts=0, store=None,
+                 transport=None, staleness_s=2.0, heartbeat_s=0.25,
+                 autoscale=False, min_hosts=1, scale_up_depth=8,
+                 scale_down_idle_steps=64, mesh_plan=None,
+                 hbm_fraction=None, fail_threshold=1,
+                 probation_policy=None, clock=None, **engine_kwargs):
+        self.n_hosts = int(hosts) + int(spare_hosts)
+        if int(hosts) < 1:
+            raise ValueError(f"need at least one host, got {hosts}")
+        self.model = model
+        self.clock = clock or time.monotonic
+        self.store = store if store is not None else LocalStore()
+        self.transport = transport or LoopbackTransport()
+        self.staleness_s = float(staleness_s)
+        self.heartbeat_s = float(heartbeat_s)
+        self.autoscale = bool(autoscale)
+        self.min_hosts = int(min_hosts)
+        self.scale_up_depth = int(scale_up_depth)
+        self.scale_down_idle_steps = int(scale_down_idle_steps)
+        self.mesh_plan = mesh_plan
+        if hbm_fraction is None:
+            hbm_fraction = 0.3 / self.n_hosts
+        self._engine_kwargs = dict(engine_kwargs,
+                                   hbm_fraction=hbm_fraction)
+        self._engines = [None] * self.n_hosts
+        self._active = [i < int(hosts) for i in range(self.n_hosts)]
+        self.health = [
+            ReplicaHealth(f"host{i}", policy=probation_policy,
+                          fail_threshold=fail_threshold,
+                          clock=self.clock)
+            for i in range(self.n_hosts)
+        ]
+        for i in range(int(hosts)):
+            self._ensure_engine(i)
+            self.transport.connect(f"host{i}")
+        self._owner = {}       # req_id -> ("host", i) | ("fabric", i)
+        self._exports = {}     # req_id -> export sequence (dedup key)
+        self._inflight = deque()   # [delivery, target, req, stream]
+        self._results = {}
+        self._last_gossip = [0.0] * self.n_hosts
+        self._idle_steps = 0
+        self._req_counter = 0
+        self.failovers = 0
+        self.replays = 0
+        self.scale_ups = 0
+        self.scale_downs = 0
+        self.preemptions = 0
+
+    # -- hosts -----------------------------------------------------------
+    def _ensure_engine(self, i):
+        if self._engines[i] is None:
+            self._engines[i] = GenerationEngine(
+                self.model, role="colocated",
+                resident_name=f"kv cache blocks (host{i})",
+                **self._engine_kwargs)
+        return self._engines[i]
+
+    def _eligible(self, exclude=()):
+        return [i for i in range(self.n_hosts)
+                if self._active[i] and i not in exclude
+                and self.health[i].eligible()]
+
+    @staticmethod
+    def _load(eng):
+        return (eng.scheduler.queue_depth + len(eng.scheduler.running)
+                + len(eng._pending))
+
+    # -- gossip ----------------------------------------------------------
+    def _publish(self, i):
+        """One heartbeat: this host's prefix digest into the store."""
+        eng = self._engines[i]
+        dig = eng.cache.prefix_digest()
+        record = {"t": self.clock(), "commit_gen": dig["commit_gen"],
+                  "block_size": dig["block_size"],
+                  "hashes": list(dig["hashes"])}
+        self.store.set(f"fabric/prefix/host{i}",
+                       json.dumps(record).encode())
+        self._last_gossip[i] = self.clock()
+        obs.get_registry().counter("fabric.gossip_published").inc()
+
+    def _gossip_affinity(self, i, hashes):
+        """Leading-prefix token match of ``hashes`` against host i's
+        LAST PUBLISHED digest.  Stale (> staleness_s) or absent
+        summaries score 0 — a hint gone quiet stops attracting
+        traffic, it never blocks it."""
+        raw = self.store.query(f"fabric/prefix/host{i}")
+        if raw is None:
+            return 0
+        record = json.loads(raw)
+        if self.clock() - float(record["t"]) > self.staleness_s:
+            obs.get_registry().counter("fabric.gossip_stale").inc()
+            return 0
+        known = set(record["hashes"])
+        depth = 0
+        for h in hashes:
+            if h not in known:
+                break
+            depth += 1
+        return depth * int(record["block_size"])
+
+    def _route(self, tokens, exclude=()):
+        """dp.py's affinity-with-skew-guard routing, with the affinity
+        term coming from GOSSIP instead of a shared-address-space
+        index probe."""
+        eligible = self._eligible(exclude)
+        if not eligible:
+            raise ServingUnavailable(
+                f"no healthy host available (all {self.n_hosts} are "
+                "inactive or backing off)")
+        loads = {i: self._load(self._engines[i]) for i in eligible}
+        min_load = min(loads.values())
+        hashes = self._engines[eligible[0]].cache.chain_hashes(tokens)
+        aff = {i: self._gossip_affinity(i, hashes) for i in eligible}
+        best = max(eligible, key=lambda i: (aff[i], -loads[i], -i))
+        if (aff[best] > 0 and loads[best] - min_load
+                <= self._engines[best].max_batch):
+            if aff[best] > 0:
+                obs.get_registry().counter(
+                    "fabric.gossip_routed").inc()
+            return best
+        return min(eligible, key=lambda i: (loads[i], i))
+
+    # -- public API ------------------------------------------------------
+    def add_request(self, prompt, request_id=None, **kwargs):
+        if request_id is None:
+            request_id = f"clreq{self._req_counter}"
+        self._req_counter += 1
+        prompt_list = [int(t) for t in prompt]
+        i = self._route(prompt_list)
+        with obs.tag(shard=f"host{i}"):
+            self._engines[i].add_request(prompt_list,
+                                         request_id=request_id,
+                                         **kwargs)
+        self._owner[request_id] = ("host", i)
+        return request_id
+
+    def has_unfinished(self):
+        return (bool(self._inflight)
+                or any(self._active[i] and self._engines[i] is not None
+                       and self._engines[i].has_unfinished()
+                       for i in range(self.n_hosts)))
+
+    def step(self):
+        """One cluster step: autoscale check, advance every active
+        host (preemption notices and hard deaths handled per host),
+        then seat in-flight fabric payloads — AFTER the host loop, so
+        a transfer's span brackets the decode dispatches it hid
+        behind."""
+        self._autoscale_tick()
+        finished = []
+        for i in range(self.n_hosts):
+            if not (self._active[i] and self.health[i].eligible()):
+                continue
+            eng = self._engines[i]
+            try:
+                fault_point(f"fabric.preempt.h{i}")
+            except Exception as e:
+                self.preemptions += 1
+                self._scale_down(i, reason="preempt", error=e)
+                continue
+            now = self.clock()
+            if now - self._last_gossip[i] >= self.heartbeat_s:
+                self._publish(i)
+            if not eng.has_unfinished():
+                continue
+            try:
+                with obs.tag(shard=f"host{i}"):
+                    fault_point(f"fabric.host_down.h{i}")
+                    finished.extend(eng.step())
+                self.health[i].record_success()
+            except Exception as e:
+                self._host_failover(i, e)
+        self._pump_fabric()
+        for req in finished:
+            self._finish(req)
+        return finished
+
+    # -- fabric seating --------------------------------------------------
+    def _ship(self, src, req, exclude=()):
+        """Extract one decodable request's KV off host ``src`` and
+        ship it over the fabric to the routed survivor."""
+        eng = self._engines[src]
+        payload, length, stream = eng.extract_request(req)
+        tokens = (list(req.prompt) + list(req.generated))[:length]
+        target = self._route(tokens, exclude=exclude)
+        n = self._exports.get(req.id, 0) + 1
+        self._exports[req.id] = n
+        data = serialize_handoff(
+            payload, request_id=req.id,
+            commit_gen=eng.cache._commit_gen, length=length,
+            stream=stream, request=req, meta={"export": n})
+        self.transport.send(f"host{target}", data,
+                            oob={"request": req, "stream": stream})
+        for d in self.transport.recv(f"host{target}"):
+            self._inflight.append([d, target, d.oob.get("request"),
+                                   d.oob.get("stream")])
+        self._owner[req.id] = ("fabric", target)
+        return target
+
+    def _pump_fabric(self):
+        """Seat delivered payloads; a host with no free row keeps the
+        delivery queued (host-side bytes, no HBM) for the next step."""
+        retry = deque()
+        while self._inflight:
+            item = self._inflight.popleft()
+            delivery, target, req, stream = item
+            env = delivery.envelope
+            if req is None:
+                req = env.restore_request()
+            if stream is None and env.stream_state is not None:
+                stream = env.restore_stream()
+            placed = False
+            if self._active[target] and self.health[target].eligible():
+                with obs.tag(shard=f"host{target}"):
+                    placed = self._engines[target].inject_request(
+                        req, env.length, env.payload, stream=stream)
+            else:
+                # adoptive host died while the payload was in flight:
+                # replay from scratch on whoever is left
+                self._requeue_refugee(req, stream)
+                continue
+            if placed:
+                delivery.settle()
+                self._owner[req.id] = ("host", target)
+                obs.get_registry().counter("fabric.handoffs").inc()
+            else:
+                retry.append(item)
+        self._inflight.extend(retry)
+
+    def _requeue_refugee(self, req, stream):
+        """Replay a request whose KV payload cannot seat anywhere
+        (target lost mid-flight): fold committed tokens into the
+        prompt and resubmit — bit-identical by absolute position."""
+        req.prompt = list(req.prompt) + [int(t) for t in req.generated]
+        req.stream_offset += len(req.generated)
+        req.max_new_tokens -= len(req.generated)
+        req.generated = []
+        req.n_scheduled = 0
+        req.num_computed = 0
+        req.cached_prefix = 0
+        req.row = None
+        req.preemptions += 1
+        i = self._route(req.prompt)
+        self._engines[i].scheduler.submit(req)
+        if stream is not None:
+            self._engines[i]._streams[req.id] = stream
+        self._owner[req.id] = ("host", i)
+        self.replays += 1
+
+    # -- failover --------------------------------------------------------
+    def _harvest(self, eng):
+        """disagg.py's harvest: requeue running (progress folds into
+        the prompt), collect waiting; returns requests to replay."""
+        for req in list(eng.scheduler.running):
+            if req.row is not None:
+                eng._rows[req.row] = None
+            if eng.proposer is not None:
+                eng.proposer.drop(req.id)
+            eng.scheduler.requeue(req, req.generated)
+        eng._pending.clear()
+        moved = list(eng.scheduler.waiting)
+        eng.scheduler.waiting.clear()
+        return moved
+
+    def _replay(self, src, moved, exclude, t0, kind, error):
+        eng = self._engines[src]
+        try:
+            for req in moved:
+                i = self._route(req.prompt, exclude=exclude)
+                self._engines[i].scheduler.submit(req)
+                self._owner[req.id] = ("host", i)
+                st = eng._streams.pop(req.id, None)
+                if st is not None:
+                    self._engines[i]._streams[req.id] = st
+        except ServingUnavailable:
+            for req in reversed(moved):
+                if self._owner.get(req.id, ("x",))[0] != "host" \
+                        or self._owner[req.id][1] == src:
+                    eng.scheduler.waiting.appendleft(req)
+            raise
+        recovery_ms = (self.clock() - t0) * 1e3
+        self.failovers += 1
+        self.replays += len(moved)
+        reg = obs.get_registry()
+        reg.counter("serving.failovers").inc()
+        reg.counter("serving.replays").inc(len(moved))
+        reg.histogram("serving.cluster_failover_ms").observe(recovery_ms)
+        obs.instant("serving.cluster_failover", cat="fault",
+                    host=f"host{src}", kind=kind, replayed=len(moved),
+                    recovery_ms=round(recovery_ms, 3),
+                    error=f"{type(error).__name__}: {error}"[:200])
+
+    def _host_failover(self, i, error):
+        """Hard host death: its HBM (and so its KV) is GONE — nothing
+        to ship.  Harvest the scheduler state the front still owns
+        and replay on survivors; shrink any attached mesh plan."""
+        t0 = self.clock()
+        self.health[i].record_failure()
+        moved = self._harvest(self._engines[i])
+        self._shrink_mesh(i)
+        self._replay(i, moved, exclude=(i,), t0=t0, kind="host_down",
+                     error=error)
+
+    # -- autoscaler ------------------------------------------------------
+    def _autoscale_tick(self):
+        if not self.autoscale:
+            return
+        active = self._eligible()
+        if not active:
+            return
+        depth = sum(self._load(self._engines[i]) for i in active)
+        spares = [i for i in range(self.n_hosts) if not self._active[i]]
+        if spares and depth / len(active) >= self.scale_up_depth:
+            self._scale_up(spares[0])
+            self._idle_steps = 0
+        elif depth == 0 and len(active) > self.min_hosts:
+            self._idle_steps += 1
+            if self._idle_steps >= self.scale_down_idle_steps:
+                self._scale_down(active[-1], reason="idle")
+                self._idle_steps = 0
+        else:
+            self._idle_steps = 0
+
+    def _scale_event(self, kind, host, **attrs):
+        reg = obs.get_registry()
+        reg.counter("fabric.scale_events").inc()
+        obs.instant("fabric.scale_event", cat="fault", kind=kind,
+                    host=f"host{host}", **attrs)
+
+    def _scale_up(self, i):
+        """Activate a spare (lazily building its engine), announce it
+        via gossip so affinity traffic can find it."""
+        self._ensure_engine(i)
+        self.transport.connect(f"host{i}")
+        self._active[i] = True
+        self.scale_ups += 1
+        self._publish(i)
+        self._scale_event("up", i,
+                          active=sum(self._active))
+
+    def _scale_down(self, i, reason, error=None):
+        """Drain host ``i`` and deactivate it: decodable requests'
+        KV ships over the fabric (the prefix-cache value leaves with
+        them), everything else replays from its folded prompt.  A
+        preemption notice takes exactly this path — a preempted host
+        is just a scale-down the scheduler didn't choose."""
+        t0 = self.clock()
+        eng = self._engines[i]
+        self._active[i] = False
+        self.scale_downs += 1
+        shipped = 0
+        try:
+            for req in list(eng.scheduler.running):
+                if not req.done and not req.prefilling and req.generated:
+                    self._ship(i, req, exclude=(i,))
+                    shipped += 1
+            moved = self._harvest(eng)
+            self._replay(i, moved, exclude=(i,), t0=t0, kind=reason,
+                         error=error or RuntimeError(reason))
+        except ServingUnavailable:
+            self._active[i] = True    # nowhere to drain to: stay up
+            raise
+        self._shrink_mesh(i)
+        self._scale_event(reason, i, shipped=shipped,
+                          active=sum(self._active))
+
+    def _shrink_mesh(self, lost_host):
+        """Re-legalize an attached MeshPlan over the surviving hosts'
+        device share (PR-15 ``shrink()``: dp drops to the largest
+        fitting divisor, model axes fall back with TPU505 findings).
+        Best-effort: serving correctness never depends on it."""
+        plan = self.mesh_plan
+        if plan is None:
+            return None
+        try:
+            import numpy as _np
+            devs = list(_np.asarray(plan.mesh.devices).flat)
+            share = max(1, len(devs) // self.n_hosts)
+            lost = set(id(d) for d in
+                       devs[lost_host * share:(lost_host + 1) * share])
+            surviving = [d for d in devs if id(d) not in lost]
+            with obs.span("fabric:mesh_shrink", cat="recovery",
+                          host=f"host{lost_host}",
+                          survivors=len(surviving)):
+                self.mesh_plan = plan.shrink(surviving)
+            return self.mesh_plan
+        except Exception as e:
+            obs.instant("fabric.mesh_shrink_failed", cat="fault",
+                        error=f"{type(e).__name__}: {e}"[:200])
+            return None
+
+    # -- results / streams -----------------------------------------------
+    def _finish(self, req):
+        self._results[req.id] = req
+
+    def result(self, request_id):
+        req = self._results[request_id]
+        return list(req.prompt) + list(req.generated)
+
+    def open_stream(self, request_id):
+        kind, idx = self._owner[request_id]
+        if kind == "fabric":
+            for item in self._inflight:
+                if item[0].envelope.request_id == request_id:
+                    if item[3] is None:
+                        from .streaming import TokenStream
+                        item[3] = TokenStream(request_id)
+                    return item[3]
+            raise KeyError(request_id)
+        return self._engines[idx].open_stream(request_id)
+
+    def generate(self, prompts, **kwargs):
+        ids = [self.add_request(p, **kwargs) for p in prompts]
+        while self.has_unfinished():
+            self.step()
+        return [self.result(i) for i in ids]
+
+    # -- bookkeeping -----------------------------------------------------
+    def stats(self):
+        per_host = {}
+        total = {"tokens_generated": 0, "queue_depth": 0, "running": 0,
+                 "blocks_in_use": 0}
+        for i in range(self.n_hosts):
+            if self._engines[i] is None:
+                continue
+            s = self._engines[i].stats()
+            s["active"] = self._active[i]
+            per_host[f"host{i}"] = s
+            for k in ("tokens_generated", "queue_depth", "running",
+                      "blocks_in_use"):
+                total[k] += int(s.get(k, 0))
+        ttfts = sorted(
+            (r.t_first_token - r.t_submit) * 1e3
+            for r in self._results.values()
+            if r.t_first_token is not None and r.t_submit is not None)
+        total["ttft_p99_ms"] = ttfts[
+            min(len(ttfts) - 1, int(0.99 * len(ttfts)))] if ttfts \
+            else 0.0
+        total.update({
+            "hosts": self.n_hosts, "hosts_active": sum(self._active),
+            "failovers": self.failovers, "replays": self.replays,
+            "scale_ups": self.scale_ups,
+            "scale_downs": self.scale_downs,
+            "preemptions": self.preemptions,
+            "fabric_in_flight": len(self._inflight),
+            "fabric_duplicates": getattr(self.transport,
+                                         "duplicates", 0),
+            "replica_health": {h.name: h.snapshot()
+                               for h in self.health},
+            "per_host": per_host,
+        })
+        return total
+
+    def close(self):
+        for eng in self._engines:
+            if eng is not None:
+                eng.close()

@@ -26,7 +26,11 @@ engine's prefix cache does not already hold.  Ownership moves with the
 payload: refcounts, COW chain hashes and scale tables arrive intact,
 so greedy AND seeded-sampling outputs are bit-identical to a colocated
 run (sampling is keyed by absolute position, which the handoff
-preserves).
+preserves).  The payload crosses the **fabric transport**
+(transport.py) as versioned wire bytes — sha256-checked, deduped by
+(request id, commit generation) — through an in-process loopback by
+default, so in-process behavior is unchanged while the path taken is
+exactly the one a real cross-host hop takes.
 
 **Fault tolerance** mirrors dp.py: every engine carries a
 :class:`~.dp.ReplicaHealth` state machine and an injectable fault site
@@ -58,6 +62,7 @@ from .dp import ReplicaHealth
 from .engine import GenerationEngine
 from .errors import ServingUnavailable
 from .streaming import TokenStream
+from .transport import LoopbackTransport, serialize_handoff
 
 __all__ = ["DisaggregatedEngine"]
 
@@ -75,7 +80,7 @@ class DisaggregatedEngine:
 
     def __init__(self, model, prefill=1, decode=1, hbm_fraction=None,
                  fail_threshold=1, probation_policy=None, clock=None,
-                 **engine_kwargs):
+                 transport=None, **engine_kwargs):
         self.n_prefill = int(prefill)
         self.n_decode = int(decode)
         if self.n_prefill < 1 or self.n_decode < 1:
@@ -112,10 +117,19 @@ class DisaggregatedEngine:
                           clock=self.clock)
             for i in range(self.n_decode)
         ]
-        # handoff queue: [req, length, payload, stream, t_extract]
-        # lists (not tuples) so open_stream can attach mid-flight
+        # Every handoff traverses the fabric transport as wire bytes
+        # (serialize -> integrity check -> dedup) even in-process;
+        # the default loopback keeps behavior identical to the old
+        # object pass while exercising the exact cross-host path.
+        self.transport = transport or LoopbackTransport()
+        self.transport.connect("decode")
+        # handoff queue: [req, length, payload, stream, t_extract,
+        # delivery] lists (not tuples) so open_stream can attach a
+        # stream mid-flight; ``delivery`` settles the fabric span
+        # when the payload finally seats
         self._handoff = deque()
         self._owner = {}          # req_id -> ("p"|"d", idx) | ("h", None)
+        self._exports = {}        # req_id -> export sequence (dedup key)
         self._results = {}        # req_id -> finished Request
         self._tpot = []           # per-request mean TPOT ms
         self._req_counter = 0
@@ -170,7 +184,8 @@ class DisaggregatedEngine:
         return request_id
 
     def has_unfinished(self):
-        return (bool(self._handoff)
+        in_flight = getattr(self.transport, "pending", lambda _d: 0)
+        return (bool(self._handoff) or bool(in_flight("decode"))
                 or any(e.has_unfinished() for e in self.prefills)
                 or any(e.has_unfinished() for e in self.decodes))
 
@@ -189,8 +204,17 @@ class DisaggregatedEngine:
                     finished.extend(eng.step())
                     for req in eng.handoff_ready():
                         payload, length, stream = eng.extract_request(req)
-                        self._handoff.append(
-                            [req, length, payload, stream, self.clock()])
+                        n = self._exports.get(req.id, 0) + 1
+                        self._exports[req.id] = n
+                        data = serialize_handoff(
+                            payload, request_id=req.id,
+                            commit_gen=eng.cache._commit_gen,
+                            length=length, stream=stream, request=req,
+                            meta={"export": n})
+                        self.transport.send(
+                            "decode", data,
+                            oob={"request": req, "stream": stream,
+                                 "t_extract": self.clock()})
                         self._owner[req.id] = ("h", None)
                 self.phealth[i].record_success()
             except Exception as e:
@@ -210,15 +234,33 @@ class DisaggregatedEngine:
             self._finish(req)
         return finished
 
+    def _pump_transport(self):
+        """Drain delivered fabric envelopes into the local handoff
+        queue.  The payload the decode side seats is the DESERIALIZED
+        one — it round-tripped the wire format — while the live
+        ``Request``/``TokenStream`` objects ride the loopback's
+        out-of-band slot (on a real socket hop the envelope's own
+        request/stream state rebuilds them)."""
+        for d in self.transport.recv("decode"):
+            env = d.envelope
+            req = d.oob.get("request") or env.restore_request()
+            stream = d.oob.get("stream")
+            if stream is None and env.stream_state is not None:
+                stream = env.restore_stream()
+            t0 = d.oob.get("t_extract", self.clock())
+            self._handoff.append(
+                [req, env.length, env.payload, stream, t0, d])
+
     def _place_handoffs(self):
         """Move queued payloads onto decode engines.  A payload that no
         engine can seat right now (rows and blocks both full) stays
         queued — its blocks live in host RAM, costing no HBM — and
         retries next step."""
+        self._pump_transport()
         retry = deque()
         while self._handoff:
             item = self._handoff.popleft()
-            req, length, payload, stream, t0 = item
+            req, length, payload, stream, t0, delivery = item
             tokens = (list(req.prompt) + list(req.generated))[:length]
             try:
                 j, _ = self._route(self.decodes, self.dhealth, tokens)
@@ -238,6 +280,8 @@ class DisaggregatedEngine:
             if not placed:
                 retry.append(item)        # every engine full; next step
                 continue
+            if delivery is not None:
+                delivery.settle()         # transfer span: send -> seat
             self._owner[req.id] = ("d", k)
             self._handoffs += 1
             wait_ms = (self.clock() - t0) * 1e3

@@ -691,6 +691,36 @@ class PagedKVCache:
         chain = self._walk_chain(tokens, len(tokens) + 1)
         return len(chain) * self.block_size
 
+    def chain_hashes(self, tokens):
+        """The block-granular chain-hash ladder of ``tokens`` —
+        ``hashes[b]`` identifies the prefix covering blocks ``0..b``.
+        Pure arithmetic over the token ids (no index lookups), so the
+        cluster router can hash a prompt ONCE and compare it against
+        every host's gossiped digest."""
+        bs = self.block_size
+        out = []
+        h = None
+        for b in range(len(tokens) // bs):
+            h = self._chain_hash(h, tokens[b * bs:(b + 1) * bs])
+            out.append(h)
+        return out
+
+    def prefix_digest(self, max_entries=4096):
+        """Compact summary of every chain hash this pool can serve —
+        BOTH tiers (HBM-indexed and host-spilled) — for gossip.  A set
+        membership test against this digest approximates
+        ``prefix_match_tokens`` remotely; it is a routing HINT only
+        (staleness-bounded by the publisher's heartbeat), never a
+        correctness input: a wrong hint just costs a prefix-cache
+        miss on the chosen host.  ``max_entries`` bounds the gossip
+        message; when truncated, the newest-indexed entries win."""
+        hashes = list(self._by_hash.keys()) + list(self._host_of.keys())
+        if len(hashes) > max_entries:
+            hashes = hashes[-max_entries:]
+        return {"hashes": set(hashes), "blocks": len(hashes),
+                "block_size": self.block_size,
+                "commit_gen": self._commit_gen}
+
     def cached_prefix_len(self, seq_id):
         """Prompt tokens served from the prefix cache at allocate()
         time — prefill may start at this offset."""

@@ -114,6 +114,36 @@ class TokenStream:
                 "duplicates": self.duplicates, "closed": self.closed,
                 "next_index": self._next_index}
 
+    # -- migration (cross-host failover) --------------------------------
+    def export_state(self):
+        """JSON-able migration metadata.  ``next_index`` is the load-
+        bearing field: it is the exactly-once dedup high-water mark,
+        and a stream that fails over TWICE (prefill host dies, then
+        the decode host that adopted it dies) only stays exactly-once
+        if every hop carries it forward — a fresh stream would accept
+        the second replay's re-committed positions as new tokens.
+        Undelivered queued events ride along so a mid-drain migration
+        loses nothing."""
+        return {"request_id": self.request_id, "maxlen": self.maxlen,
+                "dropped": self.dropped, "duplicates": self.duplicates,
+                "closed": self.closed, "next_index": self._next_index,
+                "queued": [[ev.token, ev.index, ev.finished]
+                           for ev in self._q]}
+
+    @classmethod
+    def restore(cls, state):
+        """Rebuild a stream from :meth:`export_state` on the adopting
+        host, dedup high-water mark intact."""
+        st = cls(state["request_id"], maxlen=state["maxlen"])
+        st.dropped = int(state["dropped"])
+        st.duplicates = int(state["duplicates"])
+        st._next_index = int(state["next_index"])
+        for token, index, finished in state.get("queued", ()):
+            st._q.append(StreamEvent(state["request_id"], token,
+                                     index, finished))
+        st.closed = bool(state["closed"])
+        return st
+
     @property
     def done(self):
         """True once closed AND fully drained."""

@@ -1,0 +1,502 @@
+"""Cross-host KV handoff transport: wire format + fabric endpoints.
+
+The disaggregated front (disagg.py) and the cluster router (cluster.py)
+move a sequence's paged KV state between engines as a
+:class:`~.tiering.HandoffPayload`.  In one process that used to be a
+plain object pass; this module gives the move a **wire form** so the
+same handoff survives a socket hop to another host:
+
+  * :func:`serialize_handoff` / :func:`deserialize_handoff` — a
+    versioned, deterministic byte encoding of one handoff envelope:
+    the payload's per-layer block arrays (int8 per-slot scale tables
+    ride along), the seat length, the request's replayable fields, and
+    the token stream's migration metadata
+    (:meth:`~.streaming.TokenStream.export_state`).  The round trip is
+    bit-identical — ``np.array_equal`` on every array, byte-equal on
+    re-serialization — because routing decisions and prefix chain
+    hashes downstream depend on the bytes, not a lossy copy.
+  * Integrity and version are checked BEFORE anything is seated: a
+    sha256 digest trails the message and a 2-byte wire version leads
+    it.  Corrupt bytes raise :class:`PayloadIntegrityError`, a
+    version skew raises :class:`PayloadVersionError` — both structured
+    (offending fields on the exception), and both strictly
+    before-side-effects so a bad payload can never half-seat a row.
+    The ``fabric.corrupt_payload`` fault site lets a chaos plan mangle
+    in-flight bytes deterministically to prove exactly that.
+  * Idempotent resend: every envelope is keyed by ``(request_id,
+    commit_gen)`` — the sender's commit generation at export time —
+    and receiving endpoints remember delivered keys, so a replayed
+    send (sender retried after a lost ack) is counted and dropped,
+    never double-seated.
+  * :class:`LoopbackTransport` is the in-process fabric (tests, the
+    single-process cluster simulation): bytes still traverse the full
+    serialize → integrity-check → dedup path, and live Python objects
+    (the ``Request``, the consumer-held ``TokenStream``) ride
+    out-of-band exactly like an RDMA completion handle would.
+    :class:`StoreTransport` rides the hardened ``TCPStore`` /
+    ``RetryPolicy`` stack: control keys carry a per-destination
+    sequence counter, values carry the wire bytes, and every blocking
+    call takes a hard per-message deadline
+    (:meth:`~...distributed.store.TCPStore.wait`'s deadline form).
+
+Every delivered transfer lands a retroactive ``fabric:transfer`` span
+(``cat="fabric"``, its own timeline lane) running from send to seat,
+so ``phase_breakdown()`` can intersect transfer intervals against
+decode dispatch spans and report ``fabric_bytes`` /
+``fabric_hidden_ratio`` — the same machinery as
+``collective_overlap_stats``: a ratio near 1.0 means the fabric hid
+behind decode, near 0 means decode stalled on the wire.
+"""
+from __future__ import annotations
+
+import hashlib
+import json
+import struct
+import time
+from collections import deque
+
+import numpy as np
+
+from ... import observability as obs
+from ...distributed.fault_tolerance.plan import fault_point
+from .errors import ServingError
+from .streaming import TokenStream
+from .tiering import HandoffPayload
+
+__all__ = [
+    "WIRE_MAGIC", "WIRE_VERSION", "TransportError",
+    "PayloadIntegrityError", "PayloadVersionError", "TransportTimeout",
+    "HandoffEnvelope", "Delivery", "serialize_handoff",
+    "deserialize_handoff", "serialize_request", "deserialize_request",
+    "LoopbackTransport", "StoreTransport",
+]
+
+WIRE_MAGIC = b"PTKV"
+WIRE_VERSION = 1
+_DIGEST = hashlib.sha256
+_DIGEST_LEN = 32
+
+
+# -- errors --------------------------------------------------------------
+class TransportError(ServingError):
+    """Base for fabric transport failures."""
+
+
+class PayloadIntegrityError(TransportError):
+    """Wire bytes failed the sha256 check (or were truncated).  Raised
+    strictly before deserialization side effects; carries the expected
+    and actual digests (hex) and the byte counts."""
+
+    def __init__(self, msg, expected=None, actual=None, nbytes=None):
+        super().__init__(msg)
+        self.expected = expected
+        self.actual = actual
+        self.nbytes = nbytes
+
+
+class PayloadVersionError(TransportError):
+    """Sender and receiver disagree on the wire version (or the magic
+    is wrong — not a fabric message at all).  Carries both versions so
+    the operator knows which side to roll."""
+
+    def __init__(self, msg, ours=WIRE_VERSION, theirs=None):
+        super().__init__(msg)
+        self.ours = ours
+        self.theirs = theirs
+
+
+class TransportTimeout(TransportError):
+    """A per-message deadline expired before the fabric delivered."""
+
+
+# -- request serialization ------------------------------------------------
+# The replayable subset of Request: everything failover needs to
+# resubmit bit-identically (sampling is keyed by seed + absolute
+# position, so seed/stream_offset MUST survive the hop), nothing
+# host-local (row, wall-clock stamps) that the adopting host rebuilds.
+_REQ_FIELDS = ("id", "prompt", "max_new_tokens", "do_sample", "top_k",
+               "top_p", "temperature", "seed", "eos_token_id", "tenant",
+               "generated", "stream_offset", "preemptions")
+
+
+def serialize_request(req):
+    """JSON-able dict of one request's replayable fields."""
+    return {f: getattr(req, f) for f in _REQ_FIELDS}
+
+
+def deserialize_request(state):
+    """Rebuild a schedulable Request from :func:`serialize_request`."""
+    from .scheduler import Request
+    req = Request(state["id"], state["prompt"],
+                  max_new_tokens=state["max_new_tokens"],
+                  do_sample=state["do_sample"], top_k=state["top_k"],
+                  top_p=state["top_p"], temperature=state["temperature"],
+                  seed=state["seed"], eos_token_id=state["eos_token_id"],
+                  tenant=state["tenant"])
+    req.generated = [int(t) for t in state["generated"]]
+    req.stream_offset = int(state["stream_offset"])
+    req.preemptions = int(state["preemptions"])
+    return req
+
+
+# -- envelope -------------------------------------------------------------
+class HandoffEnvelope:
+    """One decoded fabric message: the payload plus seat metadata."""
+
+    __slots__ = ("request_id", "commit_gen", "length", "payload",
+                 "stream_state", "request_state", "meta", "wire_bytes")
+
+    def __init__(self, request_id, commit_gen, length, payload,
+                 stream_state=None, request_state=None, meta=None,
+                 wire_bytes=0):
+        self.request_id = request_id
+        self.commit_gen = int(commit_gen)
+        self.length = int(length)
+        self.payload = payload
+        self.stream_state = stream_state
+        self.request_state = request_state
+        self.meta = meta or {}
+        self.wire_bytes = int(wire_bytes)
+
+    @property
+    def key(self):
+        """Idempotency key: a RESEND of the same export (sender retry
+        after a lost ack — byte-identical message) collides and is
+        suppressed; a RE-EXPORT of the same request (failover replay
+        regenerated its state — new ``export`` sequence in ``meta``,
+        or a new commit generation after truncation) is new work and
+        seats normally."""
+        return (self.request_id, self.commit_gen,
+                self.meta.get("export", 0))
+
+    def restore_stream(self):
+        """A TokenStream carrying the serialized migration metadata
+        (None when the sender had no open stream)."""
+        if self.stream_state is None:
+            return None
+        return TokenStream.restore(self.stream_state)
+
+    def restore_request(self):
+        return deserialize_request(self.request_state) \
+            if self.request_state else None
+
+    def __repr__(self):
+        return (f"HandoffEnvelope({self.request_id!r}, "
+                f"gen={self.commit_gen}, len={self.length}, "
+                f"{self.wire_bytes} wire bytes)")
+
+
+def _array_specs(payload):
+    """Deterministic (name, array) walk: k0..kN, v0..vN, ks*, vs*."""
+    out = []
+    for side, arrays in (("k", payload.k), ("v", payload.v)):
+        for i, a in enumerate(arrays):
+            out.append((f"{side}{i}", a))
+    for side, arrays in (("ks", payload.k_scales),
+                         ("vs", payload.v_scales)):
+        for i, a in enumerate(arrays or ()):
+            out.append((f"{side}{i}", a))
+    return out
+
+
+def serialize_handoff(payload, *, request_id, commit_gen, length,
+                      stream=None, request=None, meta=None):
+    """Encode one handoff as wire bytes (module doc).  ``stream`` may
+    be a live :class:`TokenStream` (its migration metadata is
+    embedded) and ``request`` a live ``Request`` (its replayable
+    fields ride in the header)."""
+    arrays = _array_specs(payload)
+    header = {
+        "request_id": request_id,
+        "commit_gen": int(commit_gen),
+        "length": int(length),
+        "num_layers": len(payload.k),
+        "num_blocks": int(payload.num_blocks),
+        "block_size": int(payload.block_size),
+        "kv_dtype": str(payload.kv_dtype),
+        "has_scales": payload.k_scales is not None,
+        "arrays": [{"name": n, "dtype": str(a.dtype),
+                    "shape": list(a.shape)} for n, a in arrays],
+        "stream": stream.export_state() if stream is not None else None,
+        "request": serialize_request(request)
+        if request is not None else None,
+        "meta": meta or {},
+    }
+    hdr = json.dumps(header, sort_keys=True,
+                     separators=(",", ":")).encode()
+    parts = [WIRE_MAGIC, struct.pack("<H", WIRE_VERSION),
+             struct.pack("<I", len(hdr)), hdr]
+    for _, a in arrays:
+        parts.append(np.ascontiguousarray(a).tobytes())
+    body = b"".join(parts)
+    return body + _DIGEST(body).digest()
+
+
+def _check_wire(data):
+    """Integrity + version gate; returns the parsed header dict and
+    the offset of the first array byte.  Raises before ANY payload
+    state is built."""
+    if len(data) < len(WIRE_MAGIC) + 6 + _DIGEST_LEN:
+        raise PayloadIntegrityError(
+            f"fabric payload truncated: {len(data)} bytes is shorter "
+            "than the fixed wire framing", nbytes=len(data))
+    body, digest = data[:-_DIGEST_LEN], data[-_DIGEST_LEN:]
+    actual = _DIGEST(body).digest()
+    if actual != digest:
+        raise PayloadIntegrityError(
+            "fabric payload failed sha256 integrity check "
+            "(corrupt or torn on the wire)",
+            expected=digest.hex(), actual=actual.hex(),
+            nbytes=len(data))
+    if body[:4] != WIRE_MAGIC:
+        raise PayloadVersionError(
+            f"not a fabric payload (magic {body[:4]!r})", theirs=None)
+    (version,) = struct.unpack_from("<H", body, 4)
+    if version != WIRE_VERSION:
+        raise PayloadVersionError(
+            f"fabric wire version skew: peer sent v{version}, this "
+            f"host speaks v{WIRE_VERSION} — refusing the payload",
+            theirs=version)
+    (hdr_len,) = struct.unpack_from("<I", body, 6)
+    start = 10
+    try:
+        header = json.loads(body[start:start + hdr_len].decode())
+    except (UnicodeDecodeError, json.JSONDecodeError) as e:
+        raise PayloadIntegrityError(
+            f"fabric payload header undecodable: {e}",
+            nbytes=len(data)) from e
+    return header, start + hdr_len
+
+
+def deserialize_handoff(data):
+    """Decode wire bytes to a :class:`HandoffEnvelope`.  All-or-
+    nothing: integrity and version are verified first, array extents
+    are bounds-checked against the message, and only then are the
+    payload arrays materialized (as fresh writable copies)."""
+    header, off = _check_wire(data)
+    end = len(data) - _DIGEST_LEN
+    arrays = {}
+    for spec in header["arrays"]:
+        dt = np.dtype(spec["dtype"])
+        shape = tuple(int(s) for s in spec["shape"])
+        n = int(np.prod(shape, dtype=np.int64)) * dt.itemsize
+        if off + n > end:
+            raise PayloadIntegrityError(
+                f"fabric payload array {spec['name']!r} extends past "
+                "the message body", nbytes=len(data))
+        arrays[spec["name"]] = np.frombuffer(
+            data, dtype=dt, count=int(np.prod(shape, dtype=np.int64)),
+            offset=off).reshape(shape).copy()
+        off += n
+    nl = int(header["num_layers"])
+    k = [arrays[f"k{i}"] for i in range(nl)]
+    v = [arrays[f"v{i}"] for i in range(nl)]
+    if header["has_scales"]:
+        ks = [arrays[f"ks{i}"] for i in range(nl)]
+        vs = [arrays[f"vs{i}"] for i in range(nl)]
+    else:
+        ks = vs = None
+    payload = HandoffPayload(k, v, ks, vs, header["block_size"],
+                             header["kv_dtype"])
+    return HandoffEnvelope(
+        header["request_id"], header["commit_gen"], header["length"],
+        payload, stream_state=header.get("stream"),
+        request_state=header.get("request"),
+        meta=header.get("meta") or {}, wire_bytes=len(data))
+
+
+# -- fault injection ------------------------------------------------------
+def _maybe_corrupt(data):
+    """The ``fabric.corrupt_payload`` site: when an active FaultPlan
+    fires here (any action), the in-flight bytes are deterministically
+    mangled — one flipped byte mid-body — so the receiver's integrity
+    gate must catch it.  Returns (bytes, corrupted?)."""
+    try:
+        ev = fault_point("fabric.corrupt_payload")
+    except Exception:
+        ev = True      # raising actions (drop/kill/oom) also corrupt
+    if not ev:
+        return data, False
+    mangled = bytearray(data)
+    mangled[len(mangled) // 2] ^= 0xFF
+    return bytes(mangled), True
+
+
+def _reject(err, where):
+    """Count + timeline-mark one integrity/version rejection."""
+    reg = obs.get_registry()
+    reg.counter("fabric.corrupt_rejected").inc()
+    obs.instant("fabric.corrupt_payload", cat="fault", where=where,
+                error=f"{type(err).__name__}: {err}"[:200])
+
+
+# -- deliveries -----------------------------------------------------------
+class Delivery:
+    """One received envelope, pending its seat.  ``settle()`` closes
+    the transfer's timeline accounting — call it AFTER the payload is
+    injected, so the ``fabric:transfer`` span covers the true
+    in-flight window (send → seat) and ``fabric_hidden_ratio`` can
+    measure how much of it hid behind decode dispatch."""
+
+    __slots__ = ("envelope", "oob", "dest", "resends", "_t_send",
+                 "_settled")
+
+    def __init__(self, envelope, dest, t_send, oob=None, resends=0):
+        self.envelope = envelope
+        self.oob = oob or {}
+        self.dest = dest
+        self.resends = int(resends)
+        self._t_send = t_send
+        self._settled = False
+
+    def settle(self):
+        if self._settled:
+            return
+        self._settled = True
+        now = time.perf_counter()
+        dur = max(0.0, now - self._t_send)
+        tl = obs.get_timeline()
+        tl.add_span("fabric:transfer", cat="fabric",
+                    ts=self._t_send - tl.t0, dur=dur,
+                    attrs={"bytes": self.envelope.wire_bytes,
+                           "dest": self.dest,
+                           "request_id": self.envelope.request_id,
+                           "resends": self.resends})
+        reg = obs.get_registry()
+        reg.counter("fabric.bytes").inc(self.envelope.wire_bytes)
+        reg.counter("fabric.transfers").inc()
+        reg.histogram("fabric.transfer_ms").observe(dur * 1e3)
+
+
+class LoopbackTransport:
+    """In-process fabric (module doc): per-destination inboxes with
+    the full wire discipline — serialize, integrity-verify, dedup by
+    ``(request_id, commit_gen)`` — plus an out-of-band slot for live
+    objects that cannot cross a real wire (the consumer-held
+    ``TokenStream``).  ``resends`` bounds the sender-side replay loop
+    when the receiver rejects corrupt bytes."""
+
+    def __init__(self, resends=2):
+        self.resends = int(resends)
+        self._inbox = {}       # dest -> deque[Delivery]
+        self._seen = {}        # dest -> {key: t_delivered}
+        self.duplicates = 0    # resends suppressed by the dedup gate
+
+    def connect(self, dest):
+        """Idempotently materialize an endpoint inbox."""
+        self._inbox.setdefault(dest, deque())
+        self._seen.setdefault(dest, {})
+        return dest
+
+    def send(self, dest, data, oob=None, deadline=None):
+        """Deliver wire bytes to ``dest``.  Returns ``"ok"`` on first
+        delivery, ``"duplicate"`` when the key was already delivered
+        (the resend is suppressed — never double-seated).  Raises
+        :class:`PayloadIntegrityError` when every attempt arrived
+        corrupt (sender out of resend budget)."""
+        self.connect(dest)
+        last = None
+        for attempt in range(self.resends + 1):
+            wire, _ = _maybe_corrupt(data)
+            try:
+                env = deserialize_handoff(wire)
+            except (PayloadIntegrityError, PayloadVersionError) as e:
+                _reject(e, where=dest)
+                last = e
+                continue           # sender retries with fresh bytes
+            if env.key in self._seen[dest]:
+                self.duplicates += 1
+                obs.get_registry().counter(
+                    "fabric.duplicate_suppressed").inc()
+                return "duplicate"
+            self._seen[dest][env.key] = time.perf_counter()
+            self._inbox[dest].append(Delivery(
+                env, dest, time.perf_counter(), oob=oob,
+                resends=attempt))
+            return "ok"
+        raise last
+
+    def recv(self, dest):
+        """All deliveries queued for ``dest`` (possibly empty)."""
+        self.connect(dest)
+        box = self._inbox[dest]
+        out = list(box)
+        box.clear()
+        return out
+
+    def pending(self, dest):
+        return len(self._inbox.get(dest, ()))
+
+
+class StoreTransport:
+    """Fabric endpoint over the ``TCPStore`` control plane: a
+    per-destination monotone sequence key orders messages, values
+    carry the wire bytes, and reads honor a hard per-message deadline
+    through the store's deadline-aware ``wait``.  Suitable for true
+    cross-process hops — live objects do NOT ride along; receivers
+    rebuild the request and stream from the envelope itself."""
+
+    def __init__(self, store, name, prefix="fabric"):
+        self.store = store
+        self.name = name
+        self.prefix = prefix
+        self._tail = {}        # src queue -> next sequence to read
+        self._seen = {}        # key -> True (delivered)
+        self.duplicates = 0
+
+    def _head_key(self, dest):
+        return f"{self.prefix}/{dest}/head"
+
+    @staticmethod
+    def _decode_seq(raw):
+        """Counter value as an int across store backends: the real
+        ``TCPStore`` keeps ``add`` counters as 8-byte little-endian,
+        ``LocalStore`` as ASCII digits; absent means zero."""
+        if raw is None or raw == b"":
+            return 0
+        if isinstance(raw, int):
+            return raw
+        if isinstance(raw, bytes) and len(raw) == 8:
+            return struct.unpack("<q", raw)[0]
+        return int(raw)
+
+    def send(self, dest, data, deadline=None, oob=None):
+        """Publish one message to ``dest``'s queue.  ``oob`` is
+        ignored (nothing object-like crosses a process boundary)."""
+        t0 = time.perf_counter()
+        wire, _ = _maybe_corrupt(data)
+        seq = self.store.add(self._head_key(dest), 1) - 1
+        self.store.set(f"{self.prefix}/{dest}/{seq}", wire)
+        if deadline is not None and time.perf_counter() - t0 > deadline:
+            raise TransportTimeout(
+                f"fabric send to {dest!r} missed its "
+                f"{deadline:.3f}s deadline")
+        return "ok"
+
+    def recv(self, deadline=None):
+        """Drain this endpoint's queue: returns deliveries in order,
+        dedup-suppressing replayed keys and rejecting (with a counted
+        ``fabric.corrupt_payload`` mark) corrupt or version-skewed
+        messages.  ``deadline`` bounds each blocking store read."""
+        head = self._decode_seq(self.store.query(self._head_key(self.name)))
+        tail = self._tail.get(self.name, 0)
+        out = []
+        for seq in range(tail, head):
+            key = f"{self.prefix}/{self.name}/{seq}"
+            if deadline is not None:
+                self.store.wait([key], deadline=deadline)
+            wire = self.store.get(key)
+            self._tail[self.name] = seq + 1
+            try:
+                env = deserialize_handoff(wire)
+            except (PayloadIntegrityError, PayloadVersionError) as e:
+                _reject(e, where=self.name)
+                continue
+            if env.key in self._seen:
+                self.duplicates += 1
+                obs.get_registry().counter(
+                    "fabric.duplicate_suppressed").inc()
+                continue
+            self._seen[env.key] = True
+            out.append(Delivery(env, self.name, time.perf_counter()))
+        return out

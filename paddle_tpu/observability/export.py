@@ -31,7 +31,7 @@ CATEGORY_LANES = {"host": 0, "compile": 1, "dispatch": 2, "collective": 3,
                   "memory": 4, "fault": 5, "amp": 6, "h2d": 7, "d2h": 8,
                   "pipeline": 9, "prefill": 10, "decode": 11,
                   "analysis": 12, "kernel": 13, "dma": 14,
-                  "recovery": 15, "ckpt": 16}
+                  "recovery": 15, "ckpt": 16, "fabric": 17}
 _EXTRA_LANE_BASE = 18
 
 
@@ -206,7 +206,16 @@ def phase_breakdown(events=None):
     checkpoint restore) and ``ckpt``-lane spans (async snapshot capture
     + background write) aggregate into ``recovery_ms``/``recovery_count``
     and ``ckpt_ms``/``ckpt_count``, with ``device_lost_count`` counting
-    ``elastic.device_lost`` instants — included only when they fired."""
+    ``elastic.device_lost`` instants — included only when they fired.
+
+    Fabric attribution: ``fabric``-lane transfer spans (cross-host KV
+    handoffs, serving/transport.py) aggregate into ``fabric_ms`` /
+    ``fabric_count`` / ``fabric_bytes`` plus ``fabric_hidden_ratio``
+    — the fraction of transfer time covered by compute spans, i.e.
+    how well the fabric hid behind decode — with
+    ``scale_events`` / ``cluster_failover_count`` /
+    ``cluster_failover_ms`` counting the autoscaler's moves; included
+    only when transfers actually ran."""
     if events is None:
         events = get_timeline().events()
     out = {"compile_ms": 0.0, "dispatch_ms": 0.0, "collective_ms": 0.0,
@@ -228,6 +237,10 @@ def phase_breakdown(events=None):
     hostkv = {"host_spill_count": 0, "host_promote_count": 0}
     elastic = {"recovery_ms": 0.0, "recovery_count": 0,
                "ckpt_ms": 0.0, "ckpt_count": 0, "device_lost_count": 0}
+    fabric = {"fabric_ms": 0.0, "fabric_count": 0, "fabric_bytes": 0,
+              "fabric_hidden_ratio": 0.0, "scale_events": 0,
+              "cluster_failover_count": 0, "cluster_failover_ms": 0.0}
+    fabric_spans = []
 
     def _shard_row(label):
         return shards.setdefault(label, {
@@ -261,6 +274,12 @@ def phase_breakdown(events=None):
                 faults["shed_count"] += 1
             elif e.name == "elastic.device_lost":
                 elastic["device_lost_count"] += 1
+            elif e.name == "fabric.scale_event":
+                fabric["scale_events"] += 1
+            elif e.name == "serving.cluster_failover":
+                fabric["cluster_failover_count"] += 1
+                fabric["cluster_failover_ms"] += \
+                    float(attrs.get("recovery_ms", 0) or 0)
             continue
         ms = e.dur * 1e3
         shard = attrs.get("shard")
@@ -335,6 +354,14 @@ def phase_breakdown(events=None):
                 hostkv["host_spill_count"] += 1
             elif direction == "promote":
                 hostkv["host_promote_count"] += 1
+        elif e.cat == "fabric":
+            # cross-host KV handoff transfers (serving/transport.py):
+            # spans run send -> seat, so the hidden ratio below can
+            # measure how much of the wire time ran under decode
+            fabric["fabric_ms"] += ms
+            fabric["fabric_count"] += 1
+            fabric["fabric_bytes"] += int(attrs.get("bytes", 0) or 0)
+            fabric_spans.append((e.ts, e.ts + e.dur))
         elif e.cat == "recovery":
             # elastic-training lane: shrink + restore spans
             elastic["recovery_ms"] += ms
@@ -381,6 +408,32 @@ def phase_breakdown(events=None):
         elastic["recovery_ms"] = round(elastic["recovery_ms"], 3)
         elastic["ckpt_ms"] = round(elastic["ckpt_ms"], 3)
         out.update(elastic)
+    # fabric lane (cross-host KV handoffs), only when transfers ran.
+    # hidden ratio = the fraction of transfer time covered by compute
+    # dispatch spans (decode steps on the surviving/adopting hosts) —
+    # interval intersection, same machinery as collective_overlap_stats
+    if any(fabric.values()):
+        compute = sorted((e.ts, e.ts + e.dur) for e in events
+                         if e.dur is not None
+                         and e.cat in ("dispatch", "kernel", "decode"))
+        merged = []
+        for a, b in compute:
+            if merged and a <= merged[-1][1]:
+                merged[-1][1] = max(merged[-1][1], b)
+            else:
+                merged.append([a, b])
+        total = covered = 0.0
+        for a, b in fabric_spans:
+            total += b - a
+            hid = sum(max(0.0, min(b, y) - max(a, x))
+                      for x, y in merged)
+            covered += min(hid, b - a)
+        fabric["fabric_hidden_ratio"] = round(covered / total, 4) \
+            if total else 0.0
+        fabric["fabric_ms"] = round(fabric["fabric_ms"], 3)
+        fabric["cluster_failover_ms"] = round(
+            fabric["cluster_failover_ms"], 3)
+        out.update(fabric)
     return out
 
 

@@ -10,8 +10,9 @@ ROADMAP item 5: runs ``bench.py`` in a subprocess for a FRESH capture
 ``BENCH_LAST_GOOD.json`` rolling artifact that bench.py maintains, and
 compares every shared gated metric: higher-is-better throughput (the
 headline plus all ``*_tokens_per_sec`` / ``*_imgs_per_sec`` /
-``*_accept_rate`` entries in ``extra_metrics``), lower-is-better
-latency (``*_p99_ttft_ms``), and zero-tolerance quality parity
+``*_accept_rate`` / ``*_hidden_ratio`` entries in ``extra_metrics``),
+lower-is-better latency (``*_p99_ttft_ms``, ``*_failover_ms``, ...),
+and zero-tolerance quality parity
 (``*_greedy_match``: ANY drop below last-good refuses the capture).
 Exits 1 iff any shared metric regressed by more than ``--threshold``
 (default 5%) in its bad direction.
@@ -38,10 +39,12 @@ ROOT = Path(__file__).resolve().parent.parent
 sys.path.insert(0, str(ROOT))
 
 GATE_SUFFIXES = ("_tokens_per_sec", "_imgs_per_sec", "_accept_rate",
-                 "_hit_rate")
+                 "_hit_rate", "_hidden_ratio")
 #: lower-is-better latency metrics: a RISE beyond the threshold fails
+#: (note: "_failover_recovery_ms" does NOT match "_failover_ms" — the
+#: cluster drill's recovery metric gates separately from the DP one)
 LOW_SUFFIXES = ("_p99_ttft_ms", "_p99_tpot_ms", "_failover_recovery_ms",
-                "_shed_rate", "_elastic_recovery_ms")
+                "_shed_rate", "_elastic_recovery_ms", "_failover_ms")
 #: quality-parity metrics (int8 greedy match vs float): ZERO tolerance
 #: — ANY drop below last-good refuses the capture, threshold ignored
 QUALITY_SUFFIXES = ("_greedy_match",)

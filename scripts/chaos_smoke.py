@@ -22,6 +22,14 @@ serving fault-tolerance story end to end:
   * **overload shedding**: a queue-depth bound turns the overflow of a
     flood into structured 429-style rejections while everything
     admitted completes;
+  * **cluster fabric kill + preemption** (separate
+    ``CLUSTER_SCENARIOS`` registry, subprocess on a forced 8-device
+    host mesh): a 4-host :class:`ClusterRouter` burst survives a hard
+    host kill (harvest + replay, bit-identical) AND a preemption
+    notice (graceful drain: KV ships over the fabric transport and
+    the transfer hides behind decode — ``fabric_hidden_ratio > 0``),
+    with exactly-once streams, zero lost requests, zero leaked blocks
+    on surviving pools, and the attached ``dp=8`` mesh plan shrunk;
   * **device lost mid-training** (separate ``TRAIN_SCENARIOS``
     registry, subprocess on a forced 8-device host mesh): an injected
     ``dist.device_lost`` kill triggers mesh shrink dp 4->2, async
@@ -225,6 +233,209 @@ def _shed(args, report):
 
 
 # ---------------------------------------------------------------------
+# Cluster chaos: the multi-host fabric drill (ClusterRouter over 4
+# hosts).  A separate registry so the serving gate pays only for the
+# single-process drills and the cluster gate runs this one in a
+# subprocess on a forced 8-device host mesh (so mesh-plan shrink is
+# exercised with real devices, like the PR-15 elastic drill).
+# ---------------------------------------------------------------------
+CLUSTER_SCENARIOS = []
+
+
+def cluster_scenario(name):
+    def deco(fn):
+        CLUSTER_SCENARIOS.append((name, fn))
+        return fn
+    return deco
+
+
+def _check_streams(events, got, prompts):
+    """Exactly-once streaming despite at-least-once replay: contiguous
+    indices from 0, no duplicates, one terminal marker, and the
+    streamed tokens byte-equal the final completion."""
+    for k, (rid, evs) in enumerate(sorted(events.items())):
+        toks = [(e.index, e.token) for e in evs if e.index >= 0]
+        idx = [i for i, _ in toks]
+        assert idx == sorted(set(idx)), f"{rid}: duplicate stream index"
+        assert idx == list(range(len(idx))), f"{rid}: stream gap {idx}"
+        finals = [e for e in evs if e.finished]
+        assert len(finals) == 1, (
+            f"{rid}: {len(finals)} terminal events (want exactly 1)")
+        tail = got[k][len(prompts[k]):]
+        assert [t for _, t in toks] == tail, (
+            f"{rid}: streamed tokens diverge from the completion")
+
+
+def run_cluster_drill(seed=7, requests=8):
+    """Inner body of the cluster drill: a 4-host ClusterRouter under a
+    hard host kill (greedy burst) and a preemption notice (seeded
+    burst), each demanding bit-parity with a single-engine reference —
+    the cluster's outputs are schedule-independent because sampling is
+    keyed by fold_in(seed, absolute position).  Returns a JSON-able
+    report; every assertion failure surfaces as ``ok: False``."""
+    import jax
+    from paddle_tpu import observability as obs
+    from paddle_tpu.observability import phase_breakdown
+    from paddle_tpu.inference.serving import ClusterRouter
+    from paddle_tpu.distributed.auto_parallel.sharding import MeshPlan
+
+    obs.enable(True)
+    model = build_model(seed)
+    prompts = shared_prefix_prompts(seed, requests)
+    rep = {"ok": True}
+
+    def reference(**kw):
+        eng = GenerationEngine(model, num_blocks=128, max_batch=4,
+                               block_size=8, max_model_len=64)
+        try:
+            return eng.generate(prompts, max_new_tokens=8, **kw)
+        finally:
+            eng.close()
+
+    def cluster_run(plan_str, **kw):
+        devs = jax.devices()
+        mesh_plan = MeshPlan("dp=8", devices=devs) \
+            if len(devs) >= 8 else None
+        obs.get_timeline().clear()
+        cl = ClusterRouter(model, hosts=4, num_blocks=64, max_batch=4,
+                           block_size=8, max_model_len=64,
+                           mesh_plan=mesh_plan)
+        events = {}
+        try:
+            ids = [cl.add_request(p, max_new_tokens=8, **kw)
+                   for p in prompts]
+            streams = {r: cl.open_stream(r) for r in ids}
+            with inject(FaultPlan.parse(plan_str)):
+                while cl.has_unfinished():
+                    cl.step()
+                    for r, st in streams.items():
+                        events.setdefault(r, []).extend(st.drain())
+            for r, st in streams.items():
+                events[r].extend(st.drain())
+            got = [cl.result(r) for r in ids]
+            stats = cl.stats()
+            mesh_after = cl.mesh_plan.describe() if cl.mesh_plan \
+                else None
+            pb = phase_breakdown()
+        finally:
+            cl.close()
+        return got, stats, events, mesh_after, pb
+
+    # hard kill mid-burst: host0's HBM (and KV) is gone; harvest +
+    # replay on the survivors, bit-identical, zero lost requests
+    want = reference()
+    got, s, events, mesh_after, _ = cluster_run(
+        "fabric.host_down.h0:kill:after=1,count=100")
+    assert got == want, "host kill: outputs diverge from no-kill run"
+    assert s["failovers"] >= 1 and s["replays"] > 0, s
+    assert s["replica_health"]["host0"]["state"] != "healthy"
+    _check_streams(events, got, prompts)
+    survivors_in_use = sum(
+        h["blocks_in_use"] for name, h in s["per_host"].items()
+        if name != "host0")
+    assert survivors_in_use == 0, (
+        f"leaked {survivors_in_use} blocks on surviving pools")
+    rep["kill"] = {"failovers": s["failovers"], "replays": s["replays"],
+                   "hosts_active": s["hosts_active"],
+                   "ttft_p99_ms": round(s["ttft_p99_ms"], 3),
+                   "mesh_after": mesh_after}
+
+    # preemption notice mid-burst (seeded sampling): the host drains
+    # gracefully — decodable KV ships over the fabric transport, the
+    # transfer hides behind the survivors' decode steps
+    kw = {"do_sample": True, "seed": 11, "top_k": 20,
+          "temperature": 0.8}
+    want = reference(**kw)
+    got, s, events, mesh_after, pb = cluster_run(
+        "fabric.preempt.h1:kill:after=2,count=1", **kw)
+    assert got == want, "preempt: outputs diverge from no-fault run"
+    assert s["preemptions"] >= 1 and s["scale_downs"] >= 1, s
+    assert s["hosts_active"] == 3, s["hosts_active"]
+    _check_streams(events, got, prompts)
+    assert s["blocks_in_use"] == 0, (
+        f"leaked {s['blocks_in_use']} blocks after preemption drain")
+    assert pb.get("fabric_bytes", 0) > 0, (
+        "preemption drain shipped nothing over the fabric")
+    assert pb.get("fabric_hidden_ratio", 0) > 0, (
+        "fabric transfer never overlapped decode dispatch")
+    rep["preempt"] = {
+        "ttft_p99_ms": round(s["ttft_p99_ms"], 3),
+        "preemptions": s["preemptions"],
+        "scale_downs": s["scale_downs"],
+        "hosts_active": s["hosts_active"],
+        "fabric_bytes": pb["fabric_bytes"],
+        "fabric_hidden_ratio": pb["fabric_hidden_ratio"],
+        "cluster_failover_ms": pb.get("cluster_failover_ms"),
+        "mesh_after": mesh_after}
+    return rep
+
+
+_CLUSTER_DRILL_SUB = r"""
+import os, sys, json
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "") +
+                           " --xla_force_host_platform_device_count=8")
+import jax
+jax.config.update("jax_platforms", "cpu")
+sys.path.insert(0, %ROOT%)
+import importlib.util
+spec = importlib.util.spec_from_file_location(
+    "chaos_smoke_sub", os.path.join(%ROOT%, "scripts", "chaos_smoke.py"))
+mod = importlib.util.module_from_spec(spec)
+spec.loader.exec_module(mod)
+print("CLUSTER_DRILL_JSON: " +
+      json.dumps(mod.run_cluster_drill(seed=%SEED%), default=str))
+"""
+
+
+@cluster_scenario("cluster fabric: host kill + preemption drain over "
+                  "4 hosts, bit-identical, exactly-once streams")
+def _cluster_kill_preempt(args, report):
+    import json
+    import subprocess
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("XLA_FLAGS", "JAX_PLATFORMS",
+                        "PADDLE_TPU_COMPILE_CACHE_DIR")}
+    src = (_CLUSTER_DRILL_SUB
+           .replace("%ROOT%", repr(root))
+           .replace("%SEED%", str(args.seed)))
+    p = subprocess.run([sys.executable, "-c", src], cwd=root,
+                       capture_output=True, text=True, timeout=900,
+                       env=env)
+    rep = None
+    for line in p.stdout.splitlines():
+        if line.startswith("CLUSTER_DRILL_JSON:"):
+            rep = json.loads(line[len("CLUSTER_DRILL_JSON:"):])
+    if rep is None:
+        raise RuntimeError("cluster drill subprocess produced no "
+                           "report: " + (p.stderr or "")[-800:])
+    assert rep["ok"], rep
+    assert rep["kill"]["failovers"] >= 1
+    assert rep["preempt"]["fabric_hidden_ratio"] > 0
+    # the forced 8-device mesh shrank when hosts left (dp=8 -> a
+    # divisor that fits the survivors' device share)
+    assert rep["kill"]["mesh_after"] not in (None, "dp=8"), rep["kill"]
+    report["cluster"] = {**rep["kill"],
+                         **{f"preempt_{k}": v
+                            for k, v in rep["preempt"].items()}}
+
+
+def run_cluster(seed=7):
+    """Execute the cluster chaos scenarios; ``(ok, report)`` like
+    :func:`run` (the tier-1 gate in tests/test_serving_faults.py)."""
+    args = argparse.Namespace(seed=seed, requests=8)
+    report = {}
+    ok = True
+    for name, fn in CLUSTER_SCENARIOS:
+        try:
+            fn(args, report)
+        except Exception:
+            ok = False
+            report[f"FAIL: {name}"] = traceback.format_exc()
+    return ok, report
+
+
+# ---------------------------------------------------------------------
 # Training chaos: a separate registry so the serving gate
 # (tests/test_serving_faults.py) and the elastic-training gate
 # (tests/test_elastic_train.py) each pay only for their own drills.
@@ -333,7 +544,7 @@ def main():
     logging.basicConfig(level=logging.WARNING)
     failures = 0
     report = {}
-    for name, fn in SCENARIOS + TRAIN_SCENARIOS:
+    for name, fn in SCENARIOS + CLUSTER_SCENARIOS + TRAIN_SCENARIOS:
         args = argparse.Namespace(seed=cli.seed, requests=cli.requests)
         try:
             fn(args, report)
@@ -345,7 +556,8 @@ def main():
     for k, v in report.items():
         if not str(k).startswith("FAIL"):
             print(f"      {k}: {v}")
-    total = len(SCENARIOS) + len(TRAIN_SCENARIOS)
+    total = (len(SCENARIOS) + len(CLUSTER_SCENARIOS)
+             + len(TRAIN_SCENARIOS))
     print(f"\nchaos smoke: {total - failures}/{total} scenarios passed "
           f"(seed={cli.seed})")
     return 1 if failures else 0

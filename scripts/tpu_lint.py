@@ -35,7 +35,7 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(
 
 import numpy as np  # noqa: E402
 
-MODELS = ("lenet", "bert", "gpt", "pallas", "sharding")
+MODELS = ("lenet", "bert", "gpt", "pallas", "sharding", "fabric")
 
 
 def lint_lenet():
@@ -246,8 +246,44 @@ def lint_sharding():
     return report
 
 
+def lint_fabric():
+    """Cross-host KV handoff geometry vs the decode window (TPU506) —
+    pure arithmetic over the *configured* serving geometry (block size
+    and prefill chunk from the env knobs), no engine, no fabric.
+
+    Audits representative handoff payloads for a GPT-2-class decode
+    replica in both f32 and int8 KV: a single-chunk handoff (the
+    steady-state disaggregated case) must hide behind the decode
+    window; the full-prompt failover spill is checked at 4x that size
+    so a geometry that only hides the happy path still surfaces."""
+    from paddle_tpu.analysis.fabric_audit import (audit_fabric_handoff,
+                                                  handoff_bytes_per_block)
+    from paddle_tpu.analysis.diagnostics import DiagnosticReport
+    from paddle_tpu.inference.serving import (kv_block_size,
+                                              prefill_chunk_size)
+
+    block = kv_block_size()
+    chunk = prefill_chunk_size()
+    layers, heads, head_dim = 12, 12, 64
+    report = DiagnosticReport(label="fabric handoff")
+    for kv, itemsize, lanes in (("f32", 4, 0), ("int8", 1, heads)):
+        bpb = handoff_bytes_per_block(layers, heads, block, head_dim,
+                                      itemsize, scale_lanes=lanes)
+        # steady state: one admission chunk's worth of blocks in flight
+        chunk_blocks = max(1, chunk // block)
+        audit_fabric_handoff(chunk_blocks, bpb, chunk, block,
+                             site=f"gpt[{kv}] chunk handoff",
+                             report=report)
+        # failover spill: a long-lived request's whole prefix at once
+        audit_fabric_handoff(4 * chunk_blocks, bpb, chunk, block,
+                             site=f"gpt[{kv}] failover spill",
+                             report=report)
+    return report
+
+
 LINTERS = {"lenet": lint_lenet, "bert": lint_bert, "gpt": lint_gpt,
-           "pallas": lint_pallas, "sharding": lint_sharding}
+           "pallas": lint_pallas, "sharding": lint_sharding,
+           "fabric": lint_fabric}
 
 
 def run_models(names):

@@ -603,3 +603,22 @@ class TestChaosSmokeGate:
         assert report["kill_greedy"]["replays"] > 0
         assert report["kill_seeded"]["replays"] > 0
         assert report["kill_greedy"]["survivor_prefix_hit_rate"] > 0
+
+class TestClusterChaosGate:
+    """Tier-1 gate: the multi-host fabric drill (subprocess, forced
+    8-device host mesh) must pass — a 4-host burst survives a hard
+    host kill AND a preemption drain bit-identical to the no-fault
+    run, streams stay exactly-once, the preempted host's KV ships
+    over the fabric with fabric_hidden_ratio > 0, no block leaks,
+    and the attached dp=8 mesh plan shrinks."""
+
+    def test_cluster_scenario_passes(self):
+        import json
+        smoke = _load_chaos_smoke()
+        ok, report = smoke.run_cluster(seed=7)
+        assert ok, json.dumps(report, indent=1, default=str)[-2000:]
+        ev = report["cluster"]
+        assert ev["failovers"] >= 1 and ev["replays"] > 0
+        assert ev["preempt_fabric_bytes"] > 0
+        assert ev["preempt_fabric_hidden_ratio"] > 0
+        assert ev["mesh_after"] == "dp=4"

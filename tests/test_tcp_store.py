@@ -209,3 +209,38 @@ def test_idempotent_replay_is_bounded():
         assert time.monotonic() - t0 < 8
     finally:
         store.close()
+
+
+def test_wait_deadline_raises_structured_store_timeout():
+    """wait(keys, deadline=...) on an absent key gives up at the hard
+    deadline with a StructuredError naming the pending keys — and marks
+    a ``store.wait_timeout`` instant so rendezvous stalls show up on
+    the timeline instead of as silent hangs."""
+    from paddle_tpu import observability as obs
+    from paddle_tpu.distributed import StoreTimeoutError
+    srv = _PyStoreServer(0)
+    prev = obs.enable(True)
+    obs.get_timeline().clear()
+    try:
+        store = TCPStore("127.0.0.1", srv.port, timeout=30)
+        store.set("present", b"1")
+        store.wait(["present"], deadline=1.0)  # satisfied: no error
+        t0 = time.monotonic()
+        with pytest.raises(StoreTimeoutError) as ei:
+            store.wait(["present", "never"], deadline=0.4)
+        waited = time.monotonic() - t0
+        assert waited < 5  # hard deadline, not the 30s op timeout
+        assert "never" in ei.value.pending
+        assert "never" in str(ei.value)
+        assert ei.value.deadline_s == pytest.approx(0.4)
+        assert ei.value.waited_s >= 0.3
+        marks = [e for e in obs.get_timeline().events()
+                 if e.name == "store.wait_timeout"]
+        assert marks and marks[0].cat == "fault"
+        # the store survives the timeout: next op reconnects cleanly
+        assert store.get("present") == b"1"
+        store.close()
+    finally:
+        obs.get_timeline().clear()
+        obs.enable(prev)
+        srv.stop()
