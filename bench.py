@@ -1219,10 +1219,20 @@ def bench_gpt_cluster(on_tpu):
                              rep["preempt"]["ttft_p99_ms"])
     rep["failover_ms"] = rep["preempt"]["cluster_failover_ms"]
     rep["fabric_hidden_ratio"] = rep["preempt"]["fabric_hidden_ratio"]
+    # control-plane outage phase: the worse (greedy vs seeded) stall
+    # over the fault-free baseline, and how much of the outage run was
+    # spent routing on cached digests
+    outage = rep["store_outage"]
+    rep["store_outage_stall_ms"] = max(outage["stall_ms"],
+                                       outage["seeded_stall_ms"])
+    rep["degraded_ratio"] = max(outage["degraded_ratio"],
+                                outage["seeded_degraded_ratio"])
     log(f"gpt_cluster: ok={rep['ok']} p99 ttft "
         f"{rep['p99_ttft_ms']:.0f} ms failover "
         f"{rep['failover_ms']:.0f} ms hidden "
-        f"{rep['fabric_hidden_ratio']:.3f} ({rep['seconds']:.0f}s)")
+        f"{rep['fabric_hidden_ratio']:.3f} outage stall "
+        f"{rep['store_outage_stall_ms']:.0f} ms degraded "
+        f"{rep['degraded_ratio']:.3f} ({rep['seconds']:.0f}s)")
     return rep
 
 
@@ -1694,6 +1704,10 @@ def main():
                 f"dp=8 -> {res['preempt']['mesh_after']}"
             payload["extra_metrics"]["gpt_cluster_fabric_bytes"] = \
                 res["preempt"]["fabric_bytes"]
+            payload["extra_metrics"]["gpt_store_outage_stall_ms"] = \
+                res["store_outage_stall_ms"]
+            payload["extra_metrics"]["gpt_degraded_ratio"] = \
+                res["degraded_ratio"]
         elif name == "bert_tp":
             payload["extra_metrics"]["bert_tp_tokens_per_sec"] = \
                 res["tokens_per_sec"]

@@ -21,6 +21,7 @@ from .diagnostics import (CODES, ERROR, INFO, SEVERITIES, WARNING,
                           describe_code, get_log, record, reset_log)
 from .dtype_audit import audit_jaxpr, check_collective_payload, iter_eqns
 from .fabric_audit import audit_fabric_handoff, handoff_bytes_per_block
+from .fault_lint import audit_fault_sites, scan_fault_references
 from .host_sync import audit_host_sync, sync_budget
 from .sharding_audit import audit_sharding, check_collective_axis
 from .program import analyze_runtime, analyze_traced, lint_summary
@@ -37,7 +38,7 @@ __all__ = [
     "WARNING", "Diagnostic", "DiagnosticLog", "DiagnosticReport",
     "analyze_runtime", "analyze_traced", "audit_eager_cache",
     "audit_executor_cache", "audit_fabric_handoff",
-    "audit_flash_attention", "audit_host_sync",
+    "audit_fault_sites", "audit_flash_attention", "audit_host_sync",
     "audit_jaxpr", "audit_layer_norm_residual", "audit_matmul_epilogue",
     "audit_paged_attention", "audit_ragged_attention",
     "audit_sharding", "audit_trace_cache", "check_collective_axis",
@@ -46,5 +47,5 @@ __all__ = [
     "estimate_vmem_bytes", "fabric_audit", "get_log",
     "handoff_bytes_per_block", "host_sync", "iter_eqns",
     "lint_summary", "min_tile", "record", "recompile", "reset_log",
-    "sync_budget", "tiling",
+    "scan_fault_references", "sync_budget", "tiling",
 ]

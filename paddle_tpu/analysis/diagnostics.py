@@ -92,6 +92,13 @@ CODES = {
                "the transfer outlasts the decode steps available before "
                "the destination needs the blocks, so decode stalls on "
                "the fabric", WARNING),
+    # -- fault-site registry (TPU6xx) ----------------------------------
+    "TPU601": ("fault-site reference not in the FAULT_SITES registry: "
+               "chaos schedules can never reach it, and a typo'd site "
+               "silently never fires", ERROR),
+    "TPU602": ("registered fault site with no fault_point() "
+               "instrumentation anywhere in the tree: schedules list "
+               "it but injection can never trigger", WARNING),
 }
 
 

@@ -33,7 +33,8 @@ from .auto_parallel.api import (shard_tensor, shard_op, ProcessMesh, Shard,
                                 reshard, shard_layer)
 from . import checkpoint
 from .checkpoint.save_load import save_state_dict, load_state_dict
-from .store import StoreTimeoutError, TCPStore
+from .store import (LocalStore, ResilientStore, StoreEpochError,
+                    StoreLease, StoreTimeoutError, TCPStore)
 from .split_api import split
 from . import utils
 from . import fault_tolerance

@@ -14,13 +14,23 @@ stack:
                retry_call; the TCPStore client's hardening primitives.
   atomic.py    crash-safe checkpoint primitives — atomic_write,
                checksum manifests, validate/latest-good scanning.
+  chaos.py     seeded chaos-schedule explorer — enumerates the central
+               FAULT_SITES registry, generates deterministic randomized
+               fault schedules, replays each against a multi-host
+               cluster on a synthetic bursty trace and checks a global
+               invariant suite (exactly-once streams, zero leaked KV,
+               bit-parity, no stale-epoch writes, bounded recovery).
 
 See README.md §"Fault tolerance" for the env knobs.
 """
 from .plan import (FaultEvent, FaultPlan, inject, fault_point, active_plan,
                    clear_active_plan, InjectedFault, InjectedConnectionError,
                    SimulatedWorkerDeath, InjectedResourceExhausted,
-                   ENV_FAULT_PLAN, corrupt_file)
+                   ENV_FAULT_PLAN, corrupt_file, FAULT_SITES,
+                   register_fault_site, registered_fault_sites,
+                   site_registered, matching_sites)
+from .chaos import (ChaosSchedule, bursty_trace, generate_schedule,
+                    serving_site_inventory, run_schedule, explore)
 from .retry import (backoff_delays, retry_call, RetryExhausted,
                     RetryPolicy)
 from .watchdog import (CollectiveWatchdog, CollectiveTimeoutError,
@@ -42,4 +52,8 @@ __all__ = [
     "atomic_write", "file_sha256", "write_manifest", "validate_checkpoint",
     "latest_good_checkpoint", "CheckpointCorruptionError", "MANIFEST_NAME",
     "poison_gradients",
+    "FAULT_SITES", "register_fault_site", "registered_fault_sites",
+    "site_registered", "matching_sites",
+    "ChaosSchedule", "bursty_trace", "generate_schedule",
+    "serving_site_inventory", "run_schedule", "explore",
 ]

@@ -1,0 +1,443 @@
+"""Seeded chaos-schedule explorer over the central fault-site registry.
+
+Hand-written chaos drills (scripts/chaos_smoke.py) prove a handful of
+curated failure stories; this module explores the space *systematically*
+while keeping every run replayable:
+
+  bursty_trace(seed, ...)       synthetic serving trace — heavy-tailed
+                                (Pareto) arrival gaps + Zipf-shared
+                                prompt prefixes, the scaled stand-in
+                                for a millions-of-requests burst shape
+  serving_site_inventory(...)   FAULT_SITES registry patterns expanded
+                                to concrete injectable (site, actions)
+                                pairs for an N-host cluster run
+  generate_schedule(seed, ...)  seeded randomized fault schedule
+                                (site x occurrence x duration); the
+                                same seed reproduces the same schedule
+                                byte-for-byte (ChaosSchedule.to_json)
+  run_schedule(schedule, ...)   replay one schedule against a fresh
+                                >=4-replica ClusterRouter over a
+                                ResilientStore and check the global
+                                invariant suite
+  explore(...)                  N schedules end-to-end; one report
+
+The invariant suite after EVERY schedule:
+  * every request completes (zero lost, bounded steps — recovery time
+    is bounded by construction, not by luck);
+  * exactly-once stream delivery (contiguous indices, one terminal
+    event, streamed tokens == the completion tail);
+  * zero leaked KV blocks across tiers (HBM pools drained, no
+    fabric payloads stranded in flight);
+  * greedy/seeded bit-parity vs the fault-free run of the same trace
+    (sampling keyed by fold_in(seed, absolute_position) makes every
+    replay schedule-independent);
+  * no stale-epoch write accepted: when the schedule killed the store
+    master, a write carrying a pre-outage lease MUST be fenced with
+    StoreEpochError after the run.
+
+Heavy imports (serving, models, jax dispatch) stay function-local so
+``paddle_tpu.distributed.fault_tolerance`` keeps importing light.
+"""
+from __future__ import annotations
+
+import contextlib
+import json
+import random
+import time
+
+import numpy as np
+
+from ... import observability as obs
+from .plan import FaultPlan, inject, site_registered
+
+__all__ = ["ChaosSchedule", "bursty_trace", "serving_site_inventory",
+           "generate_schedule", "run_schedule", "explore"]
+
+
+# ---------------------------------------------------------------------
+# synthetic bursty trace
+# ---------------------------------------------------------------------
+def bursty_trace(seed, n_requests=8, vocab=97, prefix_pool=4,
+                 prefix_len=16, tail_max=5, zipf_a=1.5, pareto_a=1.3,
+                 max_new_tokens=6, horizon=24):
+    """Deterministic synthetic serving trace.
+
+    Arrival gaps are heavy-tailed (Pareto): most requests land in one
+    burst, a few stragglers trickle in late — the shape that makes
+    failover + replay interesting.  Prompts share prefixes drawn from
+    a small pool with Zipf popularity (rank-k probability ~ k^-a), so
+    prefix-affinity gossip routing has real structure to exploit.
+    Returns ``[{"arrival_step", "prompt", "max_new_tokens"}, ...]``.
+    """
+    rng = np.random.RandomState(seed)
+    prefixes = [[int(t) for t in rng.randint(1, vocab, size=prefix_len)]
+                for _ in range(prefix_pool)]
+    ranks = np.arange(1, prefix_pool + 1, dtype=np.float64) ** -zipf_a
+    probs = ranks / ranks.sum()
+    t = 0.0
+    out = []
+    for i in range(int(n_requests)):
+        if i:
+            t += float(rng.pareto(pareto_a))
+        p = int(rng.choice(prefix_pool, p=probs))
+        tail = [int(x) for x in
+                rng.randint(1, vocab, size=1 + int(rng.randint(tail_max)))]
+        out.append({"arrival_step": min(int(t), horizon - 1),
+                    "prompt": prefixes[p] + tail,
+                    "max_new_tokens": int(max_new_tokens)})
+    return out
+
+
+# ---------------------------------------------------------------------
+# site inventory + schedules
+# ---------------------------------------------------------------------
+#: Registry families the explorer may inject against a cluster run,
+#: with the actions that are meaningful at each site.  ``{h}`` expands
+#: per host.  Hard host removals (kill at host_down/preempt) are
+#: bounded by the generator so a schedule can never take out the whole
+#: cluster.
+_SERVING_ACTIONS = (
+    ("serve.step_fail", ("drop",)),
+    ("serve.alloc_fail", ("oom",)),
+    ("kv.dma_fail", ("drop",)),
+    ("fabric.corrupt_payload", ("drop",)),
+    ("store.get", ("drop", "delay")),
+    ("store.set", ("drop",)),
+    ("store.query", ("drop", "delay")),
+    ("store.add", ("drop",)),
+    ("store.master_down", ("kill",)),
+    ("store.partition.h{h}", ("drop",)),
+    ("fabric.host_down.h{h}", ("kill",)),
+    ("fabric.preempt.h{h}", ("kill",)),
+)
+
+_REMOVAL_PREFIXES = ("fabric.host_down.", "fabric.preempt.")
+
+
+def serving_site_inventory(hosts=4):
+    """Concrete injectable ``(site, actions)`` pairs for a ``hosts``-
+    replica cluster run, expanded from the central registry.  Every
+    entry is validated against ``FAULT_SITES`` — the explorer can
+    never schedule a typo'd site."""
+    out = []
+    for pat, actions in _SERVING_ACTIONS:
+        if "{h}" in pat:
+            out.extend((pat.format(h=h), actions)
+                       for h in range(int(hosts)))
+        else:
+            out.append((pat, actions))
+    for site, _ in out:
+        if not site_registered(site):
+            raise ValueError(
+                f"chaos inventory site {site!r} is not in the central "
+                "fault-site registry (fault_tolerance/plan.py)")
+    return out
+
+
+class ChaosSchedule:
+    """One seeded fault schedule: an ordered list of
+    ``{"site", "action", "after", "count", "delay"}`` entries plus the
+    seed that generated it.  ``to_json()`` is canonical (sorted keys,
+    no whitespace) so byte-for-byte reproducibility is testable."""
+
+    def __init__(self, seed, entries):
+        self.seed = int(seed)
+        self.entries = list(entries)
+
+    def sites(self):
+        return sorted({e["site"] for e in self.entries})
+
+    def to_json(self):
+        return json.dumps({"seed": self.seed, "entries": self.entries},
+                          sort_keys=True, separators=(",", ":"))
+
+    @classmethod
+    def from_json(cls, s):
+        d = json.loads(s)
+        return cls(d["seed"], d["entries"])
+
+    def to_plan(self):
+        plan = FaultPlan(seed=self.seed)
+        for e in self.entries:
+            kw = {"after": e["after"], "count": e["count"]}
+            if e.get("delay"):
+                kw["delay"] = e["delay"]
+            plan.add(e["site"], e["action"], **kw)
+        return plan
+
+    def __repr__(self):
+        return (f"ChaosSchedule(seed={self.seed}, "
+                f"entries={len(self.entries)}, sites={self.sites()})")
+
+
+def generate_schedule(seed, hosts=4, max_faults=4, horizon=20):
+    """Seeded randomized schedule over the cluster site inventory.
+
+    Determinism contract: driven entirely by ``random.Random(seed)``
+    over a fixed inventory — the same (seed, hosts, max_faults,
+    horizon) reproduces the same schedule byte-for-byte.  Safety
+    bounds: at most ``hosts - 2`` distinct hosts may be hard-removed
+    (host_down / preempt kills, one occurrence each) so survivors
+    always exist, and the store master dies at most once per
+    schedule."""
+    rng = random.Random(seed)
+    inv = serving_site_inventory(hosts)
+    want = rng.randint(2, max(2, int(max_faults)))
+    entries = []
+    removed_hosts = set()
+    master_downs = 0
+    attempts = 0
+    while len(entries) < want and attempts < 64:
+        attempts += 1
+        site, actions = inv[rng.randrange(len(inv))]
+        if site.startswith(_REMOVAL_PREFIXES):
+            h = site.rsplit(".", 1)[-1]
+            if len(removed_hosts) >= max(0, int(hosts) - 2) \
+                    or h in removed_hosts:
+                continue
+            removed_hosts.add(h)
+            entries.append({"site": site, "action": "kill",
+                            "after": rng.randint(1, max(1, horizon // 2)),
+                            "count": 1, "delay": 0.0})
+            continue
+        if site == "store.master_down":
+            if master_downs:
+                continue
+            master_downs += 1
+            entries.append({"site": site, "action": "kill",
+                            "after": rng.randint(0, horizon),
+                            "count": 1, "delay": 0.0})
+            continue
+        action = actions[rng.randrange(len(actions))]
+        entries.append({
+            "site": site, "action": action,
+            "after": rng.randint(0, horizon - 1),
+            "count": rng.randint(1, 3),
+            "delay": round(rng.uniform(0.01, 0.04), 3)
+            if action == "delay" else 0.0})
+    entries.sort(key=lambda e: (e["after"], e["site"], e["action"]))
+    return ChaosSchedule(seed, entries)
+
+
+# ---------------------------------------------------------------------
+# replay + invariants
+# ---------------------------------------------------------------------
+def _default_model(seed=7):
+    import paddle_tpu as paddle
+    from ...models.gpt import GPTConfig, GPTForCausalLM
+    cfg = GPTConfig(vocab_size=97, hidden_size=32, num_hidden_layers=2,
+                    num_attention_heads=4, max_position_embeddings=64)
+    paddle.seed(seed)
+    model = GPTForCausalLM(cfg)
+    model.eval()
+    return model
+
+
+def _drive(model, trace, hosts=4, store=None, plan=None, sample=None,
+           max_steps=600):
+    """Run ``trace`` through a fresh ``hosts``-replica ClusterRouter
+    (optionally under an injected fault plan) and collect outputs,
+    stream events, and final stats.  ``ServingUnavailable`` from a
+    step (every survivor mid-backoff) is absorbed — health probes
+    re-admit hosts within a bounded number of steps."""
+    from ...inference.serving import ClusterRouter
+    from ...inference.serving.errors import ServingUnavailable
+
+    sample = dict(sample or {})
+    cl = ClusterRouter(model, hosts=hosts, store=store, num_blocks=64,
+                       max_batch=4, block_size=8, max_model_len=64)
+    events = {}
+    try:
+        queue = sorted(range(len(trace)),
+                       key=lambda i: (trace[i]["arrival_step"], i))
+        ids = {}
+        streams = {}
+        step = 0
+        ctx = inject(plan) if plan is not None \
+            else contextlib.nullcontext()
+        with ctx:
+            while queue or cl.has_unfinished():
+                while queue and \
+                        trace[queue[0]]["arrival_step"] <= step:
+                    i = queue[0]
+                    t = trace[i]
+                    try:
+                        rid = cl.add_request(
+                            t["prompt"], request_id=f"chaos{i}",
+                            max_new_tokens=t["max_new_tokens"],
+                            **sample)
+                    except ServingUnavailable:
+                        break      # re-admit next step
+                    queue.pop(0)
+                    ids[i] = rid
+                    streams[rid] = cl.open_stream(rid)
+                try:
+                    cl.step()
+                except ServingUnavailable:
+                    pass
+                for rid, st in streams.items():
+                    events.setdefault(rid, []).extend(st.drain())
+                step += 1
+                if step > max_steps:
+                    raise RuntimeError(
+                        f"no progress within {max_steps} steps: "
+                        f"{len(queue)} unsubmitted, stats "
+                        f"{cl.stats()}")
+        for rid, st in streams.items():
+            events.setdefault(rid, []).extend(st.drain())
+        got = [cl.result(ids[i]) for i in range(len(trace))]
+        stats = cl.stats()
+    finally:
+        cl.close()
+    return got, stats, events, step
+
+
+def _stream_violations(events, got, trace):
+    """Exactly-once delivery check (the chaos_smoke contract): per
+    request contiguous indices from 0, no duplicates, exactly one
+    terminal event, streamed tokens == the completion tail.  Returns
+    a list of violation strings (empty == clean)."""
+    bad = []
+    for k in range(len(trace)):
+        rid = f"chaos{k}"
+        evs = events.get(rid, [])
+        toks = [(e.index, e.token) for e in evs if e.token is not None]
+        idx = [i for i, _ in toks]
+        if idx != list(range(len(idx))):
+            bad.append(f"{rid}: stream indices {idx}")
+        finals = [e for e in evs if e.finished]
+        if len(finals) != 1:
+            bad.append(f"{rid}: {len(finals)} terminal events")
+        tail = got[k][len(trace[k]["prompt"]):]
+        if [t for _, t in toks] != tail:
+            bad.append(f"{rid}: streamed tokens diverge")
+    return bad
+
+
+def run_schedule(schedule, trace, model=None, hosts=4, sample=None,
+                 reference=None, max_steps=600):
+    """Replay ``schedule`` against a fresh cluster over a fresh
+    :class:`~..store.ResilientStore` and check every global invariant.
+    ``reference`` is the fault-free ``(outputs, steps)`` for the same
+    (trace, sample); computed here when not supplied.  Returns a
+    JSON-able report with ``ok`` plus per-invariant evidence."""
+    from ..store import ResilientStore, StoreEpochError
+
+    if model is None:
+        model = _default_model()
+    if reference is None:
+        ref_got, _, ref_events, ref_steps = _drive(
+            model, trace, hosts=hosts, sample=sample,
+            max_steps=max_steps)
+        reference = (ref_got, ref_steps)
+    want, ref_steps = reference
+
+    store = ResilientStore(timeout=1.0)
+    pre_outage_lease = store.acquire_lease(owner="fenced-out-writer")
+    t0 = time.perf_counter()
+    failures = []
+    try:
+        got, stats, events, steps = _drive(
+            model, trace, hosts=hosts, store=store,
+            plan=schedule.to_plan(), sample=sample,
+            max_steps=max_steps)
+    except Exception as e:
+        return {"ok": False, "seed": schedule.seed,
+                "sites": schedule.sites(),
+                "failures": [f"run died: {type(e).__name__}: {e}"],
+                "wall_s": round(time.perf_counter() - t0, 3)}
+    wall_s = time.perf_counter() - t0
+
+    if len(got) != len(trace):
+        failures.append(f"lost requests: {len(got)}/{len(trace)}")
+    if got != want:
+        failures.append("bit-parity: outputs diverge from the "
+                        "fault-free run")
+    failures.extend(_stream_violations(events, got, trace))
+    # zero leaked KV: hard-killed hosts' pools are "gone HBM" (the
+    # drill contract) — judge the survivors' pools plus the fabric
+    killed = {e["site"].rsplit(".h", 1)[-1] for e in schedule.entries
+              if e["site"].startswith("fabric.host_down.")}
+    leaked = sum(h["blocks_in_use"]
+                 for name, h in stats["per_host"].items()
+                 if name[len("host"):] not in killed)
+    if leaked != 0:
+        failures.append(f"leaked {leaked} KV blocks on surviving "
+                        "pools")
+    if stats["fabric_in_flight"] != 0:
+        failures.append(f"{stats['fabric_in_flight']} fabric payloads "
+                        "stranded in flight")
+    # epoch fencing: if the master died, the pre-outage lease MUST be
+    # refused now — a fenced-out writer can never slip a write in
+    fence_proven = None
+    if store.promotions > 0:
+        try:
+            store.set("__chaos_fence_probe__", b"x",
+                      lease=pre_outage_lease)
+            fence_proven = False
+            failures.append("stale-epoch write was ACCEPTED after "
+                            "master promotion")
+        except StoreEpochError:
+            fence_proven = True
+    # bounded recovery: the faulted run finished within the same step
+    # budget; flag pathological blowups vs the fault-free run
+    if steps > max(4 * ref_steps, ref_steps + 64):
+        failures.append(f"recovery unbounded: {steps} steps vs "
+                        f"{ref_steps} fault-free")
+    store_stats = store.stats()
+    store.close()
+    return {"ok": not failures, "seed": schedule.seed,
+            "sites": schedule.sites(), "schedule": schedule.to_json(),
+            "failures": failures, "steps": steps,
+            "ref_steps": ref_steps, "wall_s": round(wall_s, 3),
+            "fence_proven": fence_proven,
+            "store": store_stats,
+            "degraded_ms": stats.get("degraded_ms", 0.0),
+            "degraded_events": stats.get("degraded_events", 0),
+            "failovers": stats.get("failovers", 0),
+            "replays": stats.get("replays", 0),
+            "preemptions": stats.get("preemptions", 0)}
+
+
+def explore(seeds=range(8), hosts=4, n_requests=8, trace_seed=101,
+            model=None, max_faults=4, log=None):
+    """Replay one generated schedule per seed against the shared
+    bursty trace, alternating greedy / seeded sampling so both decode
+    paths face chaos.  The two fault-free references are computed once
+    and shared across schedules.  Returns a soak report with per-
+    schedule evidence, the distinct-site coverage set, and ``ok``."""
+    if model is None:
+        model = _default_model()
+    trace = bursty_trace(trace_seed, n_requests=n_requests)
+    seeded_kw = {"do_sample": True, "seed": 11, "top_k": 20,
+                 "temperature": 0.8}
+    refs = {}
+    results = []
+    for k, seed in enumerate(seeds):
+        mode = "greedy" if k % 2 == 0 else "seeded"
+        sample = {} if mode == "greedy" else seeded_kw
+        if mode not in refs:
+            ref_got, _, _, ref_steps = _drive(model, trace,
+                                              hosts=hosts,
+                                              sample=sample)
+            refs[mode] = (ref_got, ref_steps)
+        schedule = generate_schedule(seed, hosts=hosts,
+                                     max_faults=max_faults)
+        rep = run_schedule(schedule, trace, model=model, hosts=hosts,
+                           sample=sample, reference=refs[mode])
+        rep["mode"] = mode
+        results.append(rep)
+        if log is not None:
+            log(f"schedule seed={seed} [{mode}] "
+                f"ok={rep['ok']} sites={rep['sites']} "
+                f"wall={rep.get('wall_s', 0):.1f}s")
+    covered = sorted(set().union(*[set(r["sites"]) for r in results])) \
+        if results else []
+    report = {"ok": all(r["ok"] for r in results),
+              "schedules": len(results),
+              "distinct_sites": covered,
+              "trace_seed": trace_seed, "hosts": hosts,
+              "results": results}
+    obs.instant("chaos.soak", cat="fault", schedules=len(results),
+                sites=len(covered), ok=report["ok"])
+    return report

@@ -9,23 +9,13 @@ seeded RNG — whether that call fires a fault, and records every firing
 in ``plan.history`` so two runs of the same plan produce byte-identical
 failure sequences.
 
-Sites currently instrumented:
-  store.connect / store.<op>   TCPStore client (distributed/store.py)
-  heartbeat.beat               ElasticManager (fleet/elastic/manager.py)
-  collective.<op>              watchdog-wrapped collectives (ops.py)
-  checkpoint.write             shard writes (checkpoint/save_load.py)
-  grad.poison                  optimizer pre-step hook (NaN gradients)
-  exec.oom                     executor/jit dispatch (memory/guard.py)
-  worker.step                  user training loops / smoke scripts
-  serve.step_fail              serving step dispatch (serving/engine.py)
-  serve.step_hang              serving step completion (watchdog target)
-  serve.replica_down.<shard>   per-replica step (serving/dp.py)
-  serve.alloc_fail             KV block allocation (serving/kv_cache.py)
-  kv.dma_fail                  host KV spill/promote DMA (kv_cache.py)
-  dist.device_lost.<rank>      elastic trainer health probe, per rank
-                               (distributed/elastic_train.py)
-  dist.host_preempt            whole-host preemption notice (same probe)
-  elastic.snapshot.write       async snapshot writer (elastic_train.py)
+Site names are no longer ad-hoc strings: the module-level
+``FAULT_SITES`` registry is the single source of truth for every
+instrumented site (``<name>`` segments are wildcards for parameterized
+families).  ``tpu_lint faults`` (analysis/fault_lint.py, TPU601/602)
+statically audits every ``fault_point()`` / ``FaultPlan`` / ``inject()``
+reference in the tree against it, and the chaos-schedule explorer
+(fault_tolerance/chaos.py) enumerates it.
 
 Activation: ``with inject(plan): ...`` or the ``PADDLE_TPU_FAULT_PLAN``
 env var (JSON, or the compact ``site:action:k=v,...;site2:...`` form) so
@@ -45,9 +35,104 @@ from ... import observability as obs
 __all__ = ["FaultEvent", "FaultPlan", "inject", "fault_point",
            "active_plan", "clear_active_plan", "InjectedFault",
            "InjectedConnectionError", "SimulatedWorkerDeath",
-           "InjectedResourceExhausted", "ENV_FAULT_PLAN"]
+           "InjectedResourceExhausted", "ENV_FAULT_PLAN",
+           "FAULT_SITES", "register_fault_site",
+           "registered_fault_sites", "site_registered",
+           "matching_sites"]
 
 ENV_FAULT_PLAN = "PADDLE_TPU_FAULT_PLAN"
+
+#: Central fault-site registry.  Keys are concrete site names or
+#: ``<wildcard>`` patterns (one ``<name>`` segment matches exactly one
+#: dot-separated segment); values are one-line descriptions of where the
+#: site is instrumented.  A ``fault_point(site)`` / ``FaultPlan`` event
+#: naming a site that matches nothing here can never fire — ``tpu_lint
+#: faults`` flags it as TPU601.
+FAULT_SITES = {
+    "store.connect": "TCPStore client connect (distributed/store.py)",
+    "store.<op>": "TCPStore client op: set/get/query/add/wait/"
+                  "delete_key/num_keys (distributed/store.py)",
+    "store.master_down": "ResilientStore: kill the live store master "
+                         "(standby-promotion path, distributed/store.py)",
+    "store.partition.<host>": "ClusterRouter: one host's view of the "
+                              "store partitioned away (serving/cluster.py)",
+    "heartbeat.beat": "ElasticManager heartbeat (fleet/elastic/manager.py)",
+    "collective.<op>": "watchdog-wrapped collectives "
+                       "(fault_tolerance/watchdog.py)",
+    "checkpoint.write": "checkpoint shard write (checkpoint/save_load.py)",
+    "checkpoint.commit": "checkpoint manifest commit "
+                         "(checkpoint/save_load.py)",
+    "grad.poison": "optimizer pre-step hook: NaN gradients "
+                   "(fault_tolerance/faults.py)",
+    "exec.oom": "executor/jit dispatch OOM probe (memory/guard.py)",
+    "worker.step": "user training loops / smoke scripts",
+    "serve.step_fail": "serving step dispatch (serving/engine.py)",
+    "serve.step_hang": "serving step completion stall (watchdog target)",
+    "serve.alloc_fail": "KV block allocation (serving/kv_cache.py)",
+    "serve.import_fail": "KV block import seat (serving/kv_cache.py)",
+    "serve.replica_down.<shard>": "per-replica step (serving/dp.py)",
+    "serve.prefill_down.<engine>": "disaggregated prefill tier step "
+                                   "(serving/disagg.py)",
+    "serve.decode_down.<engine>": "disaggregated decode tier step "
+                                  "(serving/disagg.py)",
+    "kv.dma_fail": "host KV spill/promote DMA (serving/kv_cache.py)",
+    "dist.device_lost.<rank>": "elastic trainer device-lost probe "
+                               "(distributed/elastic_train.py)",
+    "dist.host_preempt": "whole-host preemption notice "
+                         "(distributed/elastic_train.py)",
+    "elastic.snapshot.write": "async snapshot writer "
+                              "(distributed/elastic_train.py)",
+    "fabric.corrupt_payload": "in-flight fabric payload corruption "
+                              "(serving/transport.py)",
+    "fabric.host_down.<host>": "hard host death mid-step "
+                               "(serving/cluster.py)",
+    "fabric.preempt.<host>": "host preemption notice -> graceful drain "
+                             "(serving/cluster.py)",
+    "site.<name>": "reserved test-local namespace "
+                   "(plan-mechanics unit tests)",
+}
+
+
+def register_fault_site(name, description=""):
+    """Add a concrete site (or ``<wildcard>`` pattern) to the central
+    registry; returns the name so callers can do
+    ``SITE = register_fault_site("my.site", "...")``."""
+    FAULT_SITES[str(name)] = str(description)
+    return name
+
+
+def registered_fault_sites():
+    """A copy of the central registry: ``{site-or-pattern: description}``."""
+    return dict(FAULT_SITES)
+
+
+def _segment_matches(pat_seg, got_seg):
+    if pat_seg.startswith("<") and pat_seg.endswith(">"):
+        return True
+    if "*" in got_seg:
+        # a dynamic part discovered by static scan ("fabric.host_down.h*"
+        # from an f-string) only proves the wildcard families, never a
+        # literal segment
+        return False
+    return pat_seg == got_seg
+
+
+def matching_sites(site):
+    """All registry entries ``site`` matches.  ``site`` is a concrete
+    name, or a scan form with ``*`` standing in for dynamic parts."""
+    got = str(site).split(".")
+    out = []
+    for pat in FAULT_SITES:
+        ps = pat.split(".")
+        if len(ps) == len(got) and all(
+                _segment_matches(p, g) for p, g in zip(ps, got)):
+            out.append(pat)
+    return out
+
+
+def site_registered(site):
+    """True when ``site`` matches at least one registry entry."""
+    return bool(matching_sites(site))
 
 
 class InjectedFault(Exception):

@@ -25,6 +25,19 @@ Hardening (fault_tolerance layer):
     socket errors (a store restart mid-rendezvous is survivable);
   * ``fault_point("store.connect")`` / ``("store.<op>")`` sites let the
     FaultPlan drop or delay any of this deterministically.
+
+Control-plane resilience (PR 17):
+  * ``LocalStore`` — the in-process dict stand-in (moved here from
+    serving/cluster.py) with TCPStore-parity ``wait(keys, deadline=)``
+    semantics: it blocks, and raises the same structured
+    ``StoreTimeoutError``;
+  * ``ResilientStore`` — an outage-surviving wrapper that owns the live
+    ``_PyStoreServer`` master, promotes a standby on master death
+    (clients reconnect through the existing RetryPolicy), stamps every
+    promotion with a monotonic **store epoch**, and fences any write
+    carrying a stale-epoch ``StoreLease`` with a structured
+    ``StoreEpochError`` — split-brain protection on top of the fabric's
+    ``(request_id, commit_gen, export_seq)`` idempotency keys.
 """
 from __future__ import annotations
 
@@ -39,7 +52,8 @@ from .fault_tolerance.plan import fault_point
 from .fault_tolerance.retry import (ENV_STORE_RETRIES,
                                     RetryExhausted, RetryPolicy)
 
-__all__ = ["StoreTimeoutError", "TCPStore"]
+__all__ = ["StoreTimeoutError", "StoreEpochError", "StoreLease",
+           "LocalStore", "ResilientStore", "TCPStore"]
 
 
 class StoreTimeoutError(TimeoutError):
@@ -475,6 +489,330 @@ class TCPStore:
         if self._server is not None:
             self._server.stop()
             self._server = None
+
+    def __del__(self):  # pragma: no cover - best effort
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+class StoreEpochError(RuntimeError):
+    """A store write carried a lease stamped with a stale epoch.
+
+    Raised BEFORE the write touches the store: the lease holder was
+    fenced out by a standby promotion (its epoch predates the store's),
+    so letting the write land could double-own a request across a
+    partition.  Structured: ``lease_epoch`` / ``store_epoch`` are the
+    two epochs, ``owner`` the lease holder, ``key`` the refused key."""
+
+    def __init__(self, msg, *, lease_epoch=0, store_epoch=0, owner="",
+                 key=""):
+        super().__init__(msg)
+        self.lease_epoch = int(lease_epoch)
+        self.store_epoch = int(store_epoch)
+        self.owner = str(owner)
+        self.key = str(key)
+
+
+class StoreLease:
+    """An epoch-stamped write capability handed out by ResilientStore.
+
+    Immutable: renewing after a promotion returns a NEW lease at the
+    current epoch (``ResilientStore.renew``) — a fenced-out holder can
+    never un-fence a stale one in place."""
+
+    __slots__ = ("owner", "epoch")
+
+    def __init__(self, owner, epoch):
+        self.owner = str(owner)
+        self.epoch = int(epoch)
+
+    def __repr__(self):
+        return f"StoreLease(owner={self.owner!r}, epoch={self.epoch})"
+
+
+class LocalStore:
+    """In-process dict stand-in for :class:`TCPStore` (single-host
+    clusters, loopback-transport tests).
+
+    Parity contract (PR 17 satellite): ``wait(keys, deadline=)`` blocks
+    and raises the same structured :class:`StoreTimeoutError` (with the
+    ``store.wait_timeout`` instant) as ``TCPStore.wait`` — loopback
+    tests exercise the identical timeout path as the real fabric.
+    Counters are stored as ASCII digits, matching what gossip/transport
+    code round-trips through a real store."""
+
+    def __init__(self, timeout=5.0):
+        self._cv = threading.Condition()
+        self._data = {}
+        self._timeout = float(timeout)
+
+    def set(self, key, value):
+        if isinstance(value, str):
+            value = value.encode()
+        with self._cv:
+            self._data[key] = value
+            self._cv.notify_all()
+
+    def get(self, key):
+        """Blocking get (waits until the key exists, up to timeout)."""
+        t_end = time.monotonic() + self._timeout
+        with self._cv:
+            while key not in self._data:
+                remaining = t_end - time.monotonic()
+                if remaining <= 0:
+                    raise TimeoutError(
+                        f"LocalStore.get: no value for {key!r} within "
+                        f"{self._timeout:.3f}s")
+                self._cv.wait(min(remaining, 0.05))
+            return self._data[key]
+
+    def query(self, key):
+        """Non-blocking get: returns None when absent."""
+        with self._cv:
+            return self._data.get(key)
+
+    def add(self, key, amount=1):
+        with self._cv:
+            now = int(self._data.get(key, b"0")) + int(amount)
+            self._data[key] = str(now).encode()
+            self._cv.notify_all()
+            return now
+
+    def wait(self, keys, deadline=None):
+        """Block until every key exists — under a HARD deadline, with
+        ``TCPStore.wait``'s exact failure shape."""
+        if isinstance(keys, str):
+            keys = [keys]
+        keys = list(keys)
+        budget = self._timeout if deadline is None else float(deadline)
+        t_end = time.monotonic() + budget
+        with self._cv:
+            pending = [k for k in keys if k not in self._data]
+            while pending:
+                remaining = t_end - time.monotonic()
+                if remaining <= 0:
+                    waited = budget
+                    obs.instant("store.wait_timeout", cat="fault",
+                                keys=len(keys), pending=pending[0],
+                                waited_s=round(waited, 3),
+                                deadline_s=round(budget, 3))
+                    raise StoreTimeoutError(
+                        f"LocalStore.wait: {len(pending)}/{len(keys)} "
+                        f"key(s) still absent after {waited:.3f}s "
+                        f"(deadline {budget:.3f}s); first pending: "
+                        f"{pending[0]!r}", keys=keys, pending=pending,
+                        waited_s=waited, deadline_s=budget)
+                self._cv.wait(min(remaining, 0.05))
+                pending = [k for k in keys if k not in self._data]
+
+    def delete_key(self, key):
+        with self._cv:
+            self._data.pop(key, None)
+        return True
+
+    def num_keys(self):
+        with self._cv:
+            return len(self._data)
+
+    def close(self):
+        pass
+
+
+class ResilientStore:
+    """Outage-surviving control-plane store with epoch fencing.
+
+    Owns the live ``_PyStoreServer`` master plus the promotion policy:
+
+    * every op first probes the ``store.master_down`` fault site — a
+      chaos plan firing ANY action there kills the live master
+      in-place, exactly like a real master death;
+    * a ``ConnectionError`` from the client is only treated as a dead
+      master after a direct liveness probe of the master port fails
+      (a transiently dropped op — injected or real — must NOT cost the
+      master its data); then a standby ``_PyStoreServer`` is promoted:
+      fresh empty server (the old master's memory is LOST by design —
+      gossip digests republish on the next heartbeat, transport
+      counters rewind, see ``StoreTransport.recv``), the **epoch** is
+      bumped, and the client reconnects via the existing RetryPolicy;
+    * writes (``set``/``add``/``delete_key``) accept ``lease=`` and are
+      fenced with :class:`StoreEpochError` BEFORE touching the store
+      when the lease's epoch is stale — a partitioned writer that
+      missed a promotion can never double-own a request.
+
+    Observability: ``store.epoch`` gauge, ``store.promotions`` /
+    ``store.fenced_writes`` counters, ``store.promote_ms`` histogram,
+    ``store.promoted`` / ``store.write_fenced`` instants."""
+
+    def __init__(self, host="127.0.0.1", timeout=2.0, retries=0,
+                 auto_promote=True):
+        self._host = host
+        self._timeout = float(timeout)
+        self._retries = retries
+        self.auto_promote = bool(auto_promote)
+        self._lock = threading.RLock()
+        self._epoch = 1
+        self._lease_seq = 0
+        self.promotions = 0
+        self.fenced_writes = 0
+        self._server = _PyStoreServer(0)
+        self._client = self._new_client()
+        obs.get_registry().gauge("store.epoch").set(self._epoch)
+
+    # -- plumbing -----------------------------------------------------
+    def _new_client(self):
+        return TCPStore(self._host, self._server.port,
+                        timeout=self._timeout, retries=self._retries)
+
+    @property
+    def port(self):
+        """Port of the CURRENT master (changes across promotions)."""
+        return self._server.port
+
+    def epoch(self):
+        return self._epoch
+
+    def stats(self):
+        return {"epoch": self._epoch, "promotions": self.promotions,
+                "fenced_writes": self.fenced_writes}
+
+    # -- leases / fencing ---------------------------------------------
+    def acquire_lease(self, owner=None):
+        """A fresh :class:`StoreLease` stamped with the current epoch."""
+        with self._lock:
+            self._lease_seq += 1
+            name = owner if owner is not None \
+                else f"lease{self._lease_seq}"
+            return StoreLease(name, self._epoch)
+
+    def renew(self, lease):
+        """Re-stamp ``lease`` at the current epoch (a NEW lease).  Only
+        a holder that can still REACH the store can renew — the fenced
+        side of a partition cannot, which is the whole point."""
+        return StoreLease(lease.owner, self._epoch)
+
+    def _fence(self, lease, key):
+        if lease is None:
+            return
+        if lease.epoch != self._epoch:
+            self.fenced_writes += 1
+            obs.get_registry().counter("store.fenced_writes").inc()
+            obs.instant("store.write_fenced", cat="fault",
+                        owner=lease.owner, lease_epoch=lease.epoch,
+                        store_epoch=self._epoch)
+            raise StoreEpochError(
+                f"store write to {key!r} fenced: lease for "
+                f"{lease.owner!r} carries epoch {lease.epoch} but the "
+                f"store is at epoch {self._epoch} (a standby was "
+                f"promoted; renew the lease before writing)",
+                lease_epoch=lease.epoch, store_epoch=self._epoch,
+                owner=lease.owner, key=key)
+
+    # -- failure handling ---------------------------------------------
+    def master_down(self):
+        """Kill the live master in-place (what the ``store.master_down``
+        fault site realizes): its listener and in-memory data die."""
+        with self._lock:
+            self._server.stop()
+
+    def _master_alive(self):
+        try:
+            with socket.create_connection(
+                    (self._host, self._server.port), timeout=0.25):
+                return True
+        except OSError:
+            return False
+
+    def promote_standby(self):
+        """Promote the standby to master: fresh server, epoch+1,
+        client reconnected.  Returns the new epoch."""
+        with self._lock:
+            t0 = time.perf_counter()
+            try:
+                self._client.close()
+            except Exception:
+                pass
+            try:
+                self._server.stop()
+            except Exception:
+                pass
+            self._server = _PyStoreServer(0)
+            self._epoch += 1
+            self.promotions += 1
+            self._client = self._new_client()
+            ms = (time.perf_counter() - t0) * 1e3
+            reg = obs.get_registry()
+            reg.gauge("store.epoch").set(self._epoch)
+            reg.counter("store.promotions").inc()
+            reg.histogram("store.promote_ms").observe(ms)
+            obs.instant("store.promoted", cat="fault",
+                        epoch=self._epoch, promote_ms=round(ms, 3))
+            return self._epoch
+
+    def _call(self, fn):
+        from .fault_tolerance.plan import InjectedFault
+        try:
+            fault_point("store.master_down")
+        except InjectedFault:
+            self.master_down()
+        epoch0 = self._epoch
+        try:
+            return fn()
+        except (ConnectionError, OSError) as e:
+            if isinstance(e, TimeoutError) \
+                    and not isinstance(e, ConnectionError):
+                # a parked read running out its socket timeout is a
+                # missing KEY, not a dead master
+                raise
+            if not self.auto_promote or self._master_alive():
+                # transient op failure against a live master: surface
+                # it (callers degrade / retry); promoting here would
+                # cost the master its data for nothing
+                raise
+            with self._lock:
+                if self._epoch == epoch0:
+                    self.promote_standby()
+            return fn()
+
+    # -- the store API ------------------------------------------------
+    def set(self, key, value, lease=None):
+        self._fence(lease, key)
+        return self._call(lambda: self._client.set(key, value))
+
+    def get(self, key):
+        return self._call(lambda: self._client.get(key))
+
+    def query(self, key):
+        return self._call(lambda: self._client.query(key))
+
+    def add(self, key, amount=1, lease=None):
+        self._fence(lease, key)
+        return self._call(lambda: self._client.add(key, amount))
+
+    def wait(self, keys, deadline=None):
+        # StoreTimeoutError (NOT ConnectionError) surfaces from a dead
+        # master here — the caller's deadline semantics stay exact; the
+        # next non-wait op takes the promotion path
+        return self._call(lambda: self._client.wait(keys,
+                                                    deadline=deadline))
+
+    def delete_key(self, key, lease=None):
+        self._fence(lease, key)
+        return self._call(lambda: self._client.delete_key(key))
+
+    def num_keys(self):
+        return self._call(lambda: self._client.num_keys())
+
+    def close(self):
+        try:
+            self._client.close()
+        except Exception:
+            pass
+        try:
+            self._server.stop()
+        except Exception:
+            pass
 
     def __del__(self):  # pragma: no cover - best effort
         try:

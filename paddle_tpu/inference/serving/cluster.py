@@ -44,6 +44,23 @@ leaves WITH the host), replay the rest, and re-legalize any attached
 legal.  Every move records ``fabric.scale_event`` instants and
 ``serving.cluster_failover_ms`` so ``phase_breakdown()`` surfaces
 them next to the fabric transfer lane.
+
+**Degraded mode = routing on the last snapshot.**  Gossip is a HINT,
+so the router never needs the store to be *correct* — only to be
+*fresh*.  When the store is unreachable (a real outage, or the
+``store.partition.h<i>`` fault site simulating one host partitioned
+away), every store access degrades instead of propagating: routing
+falls back to the last gossiped digest snapshot (staleness waived —
+a stale hint costs a re-prefill, never a wrong token), publishes are
+skipped, and the autoscaler PAUSES (scale decisions need a quorum
+view the router no longer has).  The degraded window is metered
+(``cluster.degraded_ms`` histogram, ``cluster:degraded`` span in the
+``degraded`` lane of ``phase_breakdown()``).  When the store is a
+:class:`~...distributed.store.ResilientStore`, the router holds an
+epoch-stamped lease: a publish fenced with ``StoreEpochError`` after
+a standby promotion renews the lease and retries — only a writer
+that can still REACH the store can renew, so a partitioned twin
+stays fenced out.
 """
 from __future__ import annotations
 
@@ -53,45 +70,13 @@ from collections import deque
 
 from ... import observability as obs
 from ...distributed.fault_tolerance.plan import fault_point
+from ...distributed.store import LocalStore, StoreEpochError
 from .dp import ReplicaHealth
 from .engine import GenerationEngine
 from .errors import ServingUnavailable
 from .transport import LoopbackTransport, serialize_handoff
 
 __all__ = ["ClusterRouter", "LocalStore"]
-
-
-class LocalStore:
-    """Dict-backed stand-in for ``TCPStore`` (set/get/query/add/wait)
-    so the single-process cluster simulation gossips through the same
-    store API a real deployment would point at the rendezvous
-    master."""
-
-    def __init__(self):
-        self._data = {}
-
-    def set(self, key, value):
-        if isinstance(value, str):
-            value = value.encode()
-        self._data[key] = bytes(value)
-
-    def get(self, key):
-        return self._data[key]
-
-    def query(self, key):
-        return self._data.get(key)
-
-    def add(self, key, amount=1):
-        cur = int(self._data.get(key, b"0")) + int(amount)
-        self._data[key] = str(cur).encode()
-        return cur
-
-    def wait(self, keys, deadline=None):
-        if isinstance(keys, str):
-            keys = [keys]
-        missing = [k for k in keys if k not in self._data]
-        if missing:
-            raise TimeoutError(f"LocalStore.wait: absent {missing[0]!r}")
 
 
 class ClusterRouter:
@@ -150,6 +135,16 @@ class ClusterRouter:
         self.scale_ups = 0
         self.scale_downs = 0
         self.preemptions = 0
+        # degraded-mode state: last good gossip record per host, and
+        # the open outage window (None when the store is reachable)
+        self._digest_cache = {}
+        self._degraded_t0 = None       # perf_counter at entry
+        self._degraded_mono = None     # self.clock() at entry
+        self.degraded_ms = 0.0
+        self.degraded_events = 0
+        self.fenced_writes = 0
+        self._lease = self.store.acquire_lease(owner="router") \
+            if hasattr(self.store, "acquire_lease") else None
 
     # -- hosts -----------------------------------------------------------
     def _ensure_engine(self, i):
@@ -170,31 +165,109 @@ class ClusterRouter:
         return (eng.scheduler.queue_depth + len(eng.scheduler.running)
                 + len(eng._pending))
 
+    # -- degraded mode ---------------------------------------------------
+    _STORE_DOWN = (ConnectionError, OSError, TimeoutError)
+
+    def _store_call(self, i, fn):
+        """One store access on behalf of host ``i``'s view.  The
+        ``store.partition.h<i>`` fault site simulates this host being
+        partitioned from the rendezvous master; any unreachability
+        (injected or real) flips the router DEGRADED instead of
+        propagating.  Returns ``(result, reachable)``."""
+        try:
+            fault_point(f"store.partition.h{i}")
+            out = fn()
+        except self._STORE_DOWN as e:
+            self._enter_degraded(e)
+            return None, False
+        self._exit_degraded()
+        return out, True
+
+    def _enter_degraded(self, err):
+        if self._degraded_t0 is not None:
+            return
+        self._degraded_t0 = time.perf_counter()
+        self._degraded_mono = self.clock()
+        self.degraded_events += 1
+        obs.get_registry().counter("cluster.degraded_events").inc()
+        obs.instant("cluster.degraded", cat="degraded",
+                    error=f"{type(err).__name__}: {err}"[:200])
+
+    def _exit_degraded(self):
+        if self._degraded_t0 is None:
+            return
+        t0, self._degraded_t0 = self._degraded_t0, None
+        dur = max(0.0, time.perf_counter() - t0)
+        self.degraded_ms += max(
+            0.0, (self.clock() - self._degraded_mono) * 1e3)
+        self._degraded_mono = None
+        tl = obs.get_timeline()
+        tl.add_span("cluster:degraded", cat="degraded",
+                    ts=t0 - tl.t0, dur=dur)
+        obs.get_registry().histogram("cluster.degraded_ms").observe(
+            dur * 1e3)
+
+    @property
+    def degraded(self):
+        return self._degraded_t0 is not None
+
     # -- gossip ----------------------------------------------------------
     def _publish(self, i):
-        """One heartbeat: this host's prefix digest into the store."""
+        """One heartbeat: this host's prefix digest into the store.
+        Fenced writes (a standby was promoted since our lease) renew
+        and retry; an unreachable store skips the publish — the local
+        snapshot still refreshes, so degraded routing stays current
+        for this host's own view."""
         eng = self._engines[i]
         dig = eng.cache.prefix_digest()
         record = {"t": self.clock(), "commit_gen": dig["commit_gen"],
                   "block_size": dig["block_size"],
                   "hashes": list(dig["hashes"])}
-        self.store.set(f"fabric/prefix/host{i}",
-                       json.dumps(record).encode())
+        data = json.dumps(record).encode()
+        key = f"fabric/prefix/host{i}"
+
+        def write():
+            if self._lease is None:
+                self.store.set(key, data)
+                return
+            try:
+                self.store.set(key, data, lease=self._lease)
+            except StoreEpochError:
+                self.fenced_writes += 1
+                obs.get_registry().counter(
+                    "cluster.fenced_writes").inc()
+                self._lease = self.store.renew(self._lease)
+                self.store.set(key, data, lease=self._lease)
+
+        _, reachable = self._store_call(i, write)
+        self._digest_cache[i] = record
         self._last_gossip[i] = self.clock()
-        obs.get_registry().counter("fabric.gossip_published").inc()
+        if reachable:
+            obs.get_registry().counter("fabric.gossip_published").inc()
 
     def _gossip_affinity(self, i, hashes):
         """Leading-prefix token match of ``hashes`` against host i's
         LAST PUBLISHED digest.  Stale (> staleness_s) or absent
         summaries score 0 — a hint gone quiet stops attracting
-        traffic, it never blocks it."""
-        raw = self.store.query(f"fabric/prefix/host{i}")
-        if raw is None:
-            return 0
-        record = json.loads(raw)
-        if self.clock() - float(record["t"]) > self.staleness_s:
-            obs.get_registry().counter("fabric.gossip_stale").inc()
-            return 0
+        traffic, it never blocks it.  With the store unreachable the
+        staleness bound is WAIVED over the cached snapshot: hints are
+        correctness-safe, and during an outage an old hint beats
+        none."""
+        raw, reachable = self._store_call(
+            i, lambda: self.store.query(f"fabric/prefix/host{i}"))
+        if not reachable:
+            record = self._digest_cache.get(i)
+            if record is None:
+                return 0
+            obs.get_registry().counter("cluster.degraded_routes").inc()
+        else:
+            if raw is None:
+                return 0
+            record = json.loads(raw)
+            self._digest_cache[i] = record
+            if self.clock() - float(record["t"]) > self.staleness_s:
+                obs.get_registry().counter("fabric.gossip_stale").inc()
+                return 0
         known = set(record["hashes"])
         depth = 0
         for h in hashes:
@@ -410,6 +483,11 @@ class ClusterRouter:
     def _autoscale_tick(self):
         if not self.autoscale:
             return
+        if self.degraded:
+            # scale decisions gossip through the store; without it we
+            # neither add capacity nor drain — routing continues on
+            # snapshots, autoscaling resumes when the store does
+            return
         active = self._eligible()
         if not active:
             return
@@ -541,12 +619,22 @@ class ClusterRouter:
         total["ttft_p99_ms"] = ttfts[
             min(len(ttfts) - 1, int(0.99 * len(ttfts)))] if ttfts \
             else 0.0
+        degraded_ms = self.degraded_ms
+        if self._degraded_mono is not None:
+            degraded_ms += max(
+                0.0, (self.clock() - self._degraded_mono) * 1e3)
         total.update({
             "hosts": self.n_hosts, "hosts_active": sum(self._active),
             "failovers": self.failovers, "replays": self.replays,
             "scale_ups": self.scale_ups,
             "scale_downs": self.scale_downs,
             "preemptions": self.preemptions,
+            "degraded": self.degraded,
+            "degraded_ms": round(degraded_ms, 3),
+            "degraded_events": self.degraded_events,
+            "fenced_writes": self.fenced_writes,
+            "store_epoch": self.store.epoch()
+            if hasattr(self.store, "epoch") else None,
             "fabric_in_flight": len(self._inflight),
             "fabric_duplicates": getattr(self.transport,
                                          "duplicates", 0),
@@ -557,6 +645,7 @@ class ClusterRouter:
         return total
 
     def close(self):
+        self._exit_degraded()   # flush an open outage window's span
         for eng in self._engines:
             if eng is not None:
                 eng.close()

@@ -436,13 +436,20 @@ class StoreTransport:
     cross-process hops — live objects do NOT ride along; receivers
     rebuild the request and stream from the envelope itself."""
 
-    def __init__(self, store, name, prefix="fabric"):
+    def __init__(self, store, name, prefix="fabric", lease=None):
         self.store = store
         self.name = name
         self.prefix = prefix
+        self.lease = lease     # epoch-stamped StoreLease (optional):
+        #                        a fenced-out sender's publishes raise
+        #                        StoreEpochError instead of landing
         self._tail = {}        # src queue -> next sequence to read
         self._seen = {}        # key -> True (delivered)
         self.duplicates = 0
+        self.store_resets = 0
+
+    def _wkw(self):
+        return {"lease": self.lease} if self.lease is not None else {}
 
     def _head_key(self, dest):
         return f"{self.prefix}/{dest}/head"
@@ -465,8 +472,9 @@ class StoreTransport:
         ignored (nothing object-like crosses a process boundary)."""
         t0 = time.perf_counter()
         wire, _ = _maybe_corrupt(data)
-        seq = self.store.add(self._head_key(dest), 1) - 1
-        self.store.set(f"{self.prefix}/{dest}/{seq}", wire)
+        seq = self.store.add(self._head_key(dest), 1, **self._wkw()) - 1
+        self.store.set(f"{self.prefix}/{dest}/{seq}", wire,
+                       **self._wkw())
         if deadline is not None and time.perf_counter() - t0 > deadline:
             raise TransportTimeout(
                 f"fabric send to {dest!r} missed its "
@@ -480,6 +488,18 @@ class StoreTransport:
         messages.  ``deadline`` bounds each blocking store read."""
         head = self._decode_seq(self.store.query(self._head_key(self.name)))
         tail = self._tail.get(self.name, 0)
+        if head < tail:
+            # the store lost its counters (master died, a standby was
+            # promoted with empty state): senders restart sequences at
+            # 0, so rewind the tail or every post-promotion message is
+            # silently skipped.  The (request_id, commit_gen, export)
+            # dedup key still suppresses true duplicates — exactly-once
+            # seating survives the rewind.
+            self.store_resets += 1
+            obs.get_registry().counter("fabric.store_resets").inc()
+            obs.instant("fabric.store_reset", cat="fault",
+                        endpoint=self.name, head=head, tail=tail)
+            tail = self._tail[self.name] = 0
         out = []
         for seq in range(tail, head):
             key = f"{self.prefix}/{self.name}/{seq}"

@@ -215,7 +215,14 @@ def phase_breakdown(events=None):
     how well the fabric hid behind decode — with
     ``scale_events`` / ``cluster_failover_count`` /
     ``cluster_failover_ms`` counting the autoscaler's moves; included
-    only when transfers actually ran."""
+    only when transfers actually ran.
+
+    Degraded-mode attribution: ``degraded``-lane spans (the cluster
+    router routing on snapshots while the coordination store is
+    unreachable, serving/cluster.py) aggregate into ``degraded_ms`` /
+    ``degraded_count``, with ``store_promotions`` counting
+    ``store.promoted`` instants (standby store masters taking over) —
+    included only when an outage actually happened."""
     if events is None:
         events = get_timeline().events()
     out = {"compile_ms": 0.0, "dispatch_ms": 0.0, "collective_ms": 0.0,
@@ -241,6 +248,8 @@ def phase_breakdown(events=None):
               "fabric_hidden_ratio": 0.0, "scale_events": 0,
               "cluster_failover_count": 0, "cluster_failover_ms": 0.0}
     fabric_spans = []
+    degraded = {"degraded_ms": 0.0, "degraded_count": 0,
+                "store_promotions": 0}
 
     def _shard_row(label):
         return shards.setdefault(label, {
@@ -280,6 +289,8 @@ def phase_breakdown(events=None):
                 fabric["cluster_failover_count"] += 1
                 fabric["cluster_failover_ms"] += \
                     float(attrs.get("recovery_ms", 0) or 0)
+            elif e.name == "store.promoted":
+                degraded["store_promotions"] += 1
             continue
         ms = e.dur * 1e3
         shard = attrs.get("shard")
@@ -362,6 +373,11 @@ def phase_breakdown(events=None):
             fabric["fabric_count"] += 1
             fabric["fabric_bytes"] += int(attrs.get("bytes", 0) or 0)
             fabric_spans.append((e.ts, e.ts + e.dur))
+        elif e.cat == "degraded":
+            # store-outage lane: windows the cluster router spent
+            # routing on its last gossip snapshot (serving/cluster.py)
+            degraded["degraded_ms"] += ms
+            degraded["degraded_count"] += 1
         elif e.cat == "recovery":
             # elastic-training lane: shrink + restore spans
             elastic["recovery_ms"] += ms
@@ -403,6 +419,10 @@ def phase_breakdown(events=None):
     # actually moved blocks (same conditional pattern as faults)
     if any(hostkv.values()):
         out.update(hostkv)
+    # store-outage lane, only when an outage actually happened
+    if any(degraded.values()):
+        degraded["degraded_ms"] = round(degraded["degraded_ms"], 3)
+        out.update(degraded)
     # elastic-training recovery/snapshot lanes, only when they fired
     if any(elastic.values()):
         elastic["recovery_ms"] = round(elastic["recovery_ms"], 3)

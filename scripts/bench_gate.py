@@ -44,7 +44,8 @@ GATE_SUFFIXES = ("_tokens_per_sec", "_imgs_per_sec", "_accept_rate",
 #: (note: "_failover_recovery_ms" does NOT match "_failover_ms" — the
 #: cluster drill's recovery metric gates separately from the DP one)
 LOW_SUFFIXES = ("_p99_ttft_ms", "_p99_tpot_ms", "_failover_recovery_ms",
-                "_shed_rate", "_elastic_recovery_ms", "_failover_ms")
+                "_shed_rate", "_elastic_recovery_ms", "_failover_ms",
+                "_stall_ms")
 #: quality-parity metrics (int8 greedy match vs float): ZERO tolerance
 #: — ANY drop below last-good refuses the capture, threshold ignored
 QUALITY_SUFFIXES = ("_greedy_match",)

@@ -30,6 +30,12 @@ serving fault-tolerance story end to end:
     the transfer hides behind decode — ``fabric_hidden_ratio > 0``),
     with exactly-once streams, zero lost requests, zero leaked blocks
     on surviving pools, and the attached ``dp=8`` mesh plan shrunk;
+    plus a **control-plane outage** phase: the rendezvous store master
+    is killed mid-burst (with one host partitioned away from it), a
+    standby is promoted (``ResilientStore`` epoch fence), routing
+    rides its cached digests (degraded mode) and a stale pre-outage
+    lease is rejected with ``StoreEpochError`` — greedy AND seeded
+    runs stay bit-identical to fault-free;
   * **device lost mid-training** (separate ``TRAIN_SCENARIOS``
     registry, subprocess on a forced 8-device host mesh): an injected
     ``dist.device_lost`` kill triggers mesh shrink dp 4->2, async
@@ -42,9 +48,11 @@ gate tests; the CLI runs both registries, prints a PASS/FAIL line per
 scenario and exits 0 iff all pass.  CPU-only, no TPU required.
 """
 import argparse
+import contextlib
 import logging
 import os
 import sys
+import time
 import traceback
 
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
@@ -292,20 +300,22 @@ def run_cluster_drill(seed=7, requests=8):
         finally:
             eng.close()
 
-    def cluster_run(plan_str, **kw):
+    def cluster_run(plan_str, store=None, **kw):
         devs = jax.devices()
         mesh_plan = MeshPlan("dp=8", devices=devs) \
             if len(devs) >= 8 else None
         obs.get_timeline().clear()
         cl = ClusterRouter(model, hosts=4, num_blocks=64, max_batch=4,
                            block_size=8, max_model_len=64,
-                           mesh_plan=mesh_plan)
+                           mesh_plan=mesh_plan, store=store)
         events = {}
         try:
             ids = [cl.add_request(p, max_new_tokens=8, **kw)
                    for p in prompts]
             streams = {r: cl.open_stream(r) for r in ids}
-            with inject(FaultPlan.parse(plan_str)):
+            ctx = inject(FaultPlan.parse(plan_str)) if plan_str \
+                else contextlib.nullcontext()
+            with ctx:
                 while cl.has_unfinished():
                     cl.step()
                     for r, st in streams.items():
@@ -323,10 +333,11 @@ def run_cluster_drill(seed=7, requests=8):
 
     # hard kill mid-burst: host0's HBM (and KV) is gone; harvest +
     # replay on the survivors, bit-identical, zero lost requests
-    want = reference()
+    want_greedy = reference()
     got, s, events, mesh_after, _ = cluster_run(
         "fabric.host_down.h0:kill:after=1,count=100")
-    assert got == want, "host kill: outputs diverge from no-kill run"
+    assert got == want_greedy, \
+        "host kill: outputs diverge from no-kill run"
     assert s["failovers"] >= 1 and s["replays"] > 0, s
     assert s["replica_health"]["host0"]["state"] != "healthy"
     _check_streams(events, got, prompts)
@@ -345,10 +356,11 @@ def run_cluster_drill(seed=7, requests=8):
     # transfer hides behind the survivors' decode steps
     kw = {"do_sample": True, "seed": 11, "top_k": 20,
           "temperature": 0.8}
-    want = reference(**kw)
+    want_seeded = reference(**kw)
     got, s, events, mesh_after, pb = cluster_run(
         "fabric.preempt.h1:kill:after=2,count=1", **kw)
-    assert got == want, "preempt: outputs diverge from no-fault run"
+    assert got == want_seeded, \
+        "preempt: outputs diverge from no-fault run"
     assert s["preemptions"] >= 1 and s["scale_downs"] >= 1, s
     assert s["hosts_active"] == 3, s["hosts_active"]
     _check_streams(events, got, prompts)
@@ -367,6 +379,67 @@ def run_cluster_drill(seed=7, requests=8):
         "fabric_hidden_ratio": pb["fabric_hidden_ratio"],
         "cluster_failover_ms": pb.get("cluster_failover_ms"),
         "mesh_after": mesh_after}
+
+    # control-plane outage mid-burst: the rendezvous store master is
+    # killed while host3 is also partitioned away from it.  A standby
+    # is promoted (epoch bumps), routing keeps serving on cached
+    # digests (degraded mode — hints only, never answers), and a lease
+    # from the dead epoch can never write again (split-brain fence).
+    from paddle_tpu.distributed.store import (ResilientStore,
+                                              StoreEpochError)
+    outage_plan = ("store.master_down:kill:after=2,count=1;"
+                   "store.partition.h3:drop:after=0,count=6")
+
+    t0 = time.perf_counter()
+    got, s, events, _, _ = cluster_run(None)  # fault-free baseline
+    baseline_ms = (time.perf_counter() - t0) * 1e3
+    assert got == want_greedy, "baseline cluster run diverged"
+
+    outage = {}
+    for label, want, skw in (("greedy", want_greedy, {}),
+                             ("seeded", want_seeded, kw)):
+        store = ResilientStore(timeout=1.0)
+        stale = store.acquire_lease(owner="pre-outage-writer")
+        try:
+            t0 = time.perf_counter()
+            got, s, events, _, pb = cluster_run(outage_plan,
+                                                store=store, **skw)
+            outage_ms = (time.perf_counter() - t0) * 1e3
+            assert got == want, (
+                f"store outage ({label}): outputs diverge from "
+                "fault-free run")
+            _check_streams(events, got, prompts)
+            assert s["blocks_in_use"] == 0, (
+                f"leaked {s['blocks_in_use']} blocks through the "
+                "outage")
+            assert store.promotions >= 1 and store.epoch() >= 2, (
+                store.stats())
+            assert s["degraded_events"] >= 1 and s["degraded_ms"] > 0, s
+            assert "degraded_ms" in pb, (
+                "degraded lane missing from phase_breakdown()")
+            try:
+                store.set("__outage_probe__", b"x", lease=stale)
+                raise AssertionError(
+                    "stale pre-outage lease wrote past the epoch "
+                    "fence")
+            except StoreEpochError:
+                pass
+            outage[label] = {
+                "wall_ms": round(outage_ms, 1),
+                "stall_ms": round(max(0.0, outage_ms - baseline_ms), 1),
+                "degraded_ms": round(s["degraded_ms"], 1),
+                "degraded_ratio": round(
+                    min(1.0, s["degraded_ms"] / outage_ms), 4),
+                "degraded_events": s["degraded_events"],
+                "fenced_writes": s["fenced_writes"],
+                "promotions": store.promotions,
+                "epoch": store.epoch()}
+        finally:
+            store.close()
+    rep["store_outage"] = {"baseline_ms": round(baseline_ms, 1),
+                           **outage["greedy"],
+                           **{f"seeded_{k}": v
+                              for k, v in outage["seeded"].items()}}
     return rep
 
 
@@ -415,9 +488,15 @@ def _cluster_kill_preempt(args, report):
     # the forced 8-device mesh shrank when hosts left (dp=8 -> a
     # divisor that fits the survivors' device share)
     assert rep["kill"]["mesh_after"] not in (None, "dp=8"), rep["kill"]
+    # the store-outage phase promoted a standby and stayed correct
+    outage = rep["store_outage"]
+    assert outage["promotions"] >= 1 and outage["epoch"] >= 2, outage
+    assert outage["degraded_ms"] > 0, outage
     report["cluster"] = {**rep["kill"],
                          **{f"preempt_{k}": v
-                            for k, v in rep["preempt"].items()}}
+                            for k, v in rep["preempt"].items()},
+                         **{f"outage_{k}": v
+                            for k, v in outage.items()}}
 
 
 def run_cluster(seed=7):
@@ -544,8 +623,10 @@ def main():
     logging.basicConfig(level=logging.WARNING)
     failures = 0
     report = {}
+    walls = []
     for name, fn in SCENARIOS + CLUSTER_SCENARIOS + TRAIN_SCENARIOS:
         args = argparse.Namespace(seed=cli.seed, requests=cli.requests)
+        t0 = time.perf_counter()
         try:
             fn(args, report)
             print(f"PASS  {name}")
@@ -553,11 +634,16 @@ def main():
             failures += 1
             print(f"FAIL  {name}")
             traceback.print_exc()
+        walls.append((name, time.perf_counter() - t0))
     for k, v in report.items():
         if not str(k).startswith("FAIL"):
             print(f"      {k}: {v}")
     total = (len(SCENARIOS) + len(CLUSTER_SCENARIOS)
              + len(TRAIN_SCENARIOS))
+    print("\nper-scenario wall time:")
+    for name, wall in sorted(walls, key=lambda kv: -kv[1]):
+        print(f"  {wall:8.1f}s  {name}")
+    print(f"  {sum(w for _, w in walls):8.1f}s  TOTAL")
     print(f"\nchaos smoke: {total - failures}/{total} scenarios passed "
           f"(seed={cli.seed})")
     return 1 if failures else 0

@@ -15,7 +15,10 @@ Lints the bundled models without needing a TPU:
     Mosaic tiling rules (``analysis.tiling``), no kernel launch;
   * **sharding** — built-in BERT/GPT partition-rule sets audited against
     virtual ``dp=2,tp=2`` / ``fsdp=2`` meshes (TPU501 rule miss,
-    TPU502 large-replicated), no multi-device runtime needed.
+    TPU502 large-replicated), no multi-device runtime needed;
+  * **faults** — fault-site registry audit (TPU601 unregistered site
+    reference, TPU602 registered-but-uninstrumented site), pure AST
+    over the whole tree.
 
 Every finding is a structured ``Diagnostic`` (stable TPUxxx code,
 severity, site, fix hint).  Exit code is 1 iff any diagnostic at or
@@ -35,7 +38,8 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(
 
 import numpy as np  # noqa: E402
 
-MODELS = ("lenet", "bert", "gpt", "pallas", "sharding", "fabric")
+MODELS = ("lenet", "bert", "gpt", "pallas", "sharding", "fabric",
+          "faults")
 
 
 def lint_lenet():
@@ -281,9 +285,20 @@ def lint_fabric():
     return report
 
 
+def lint_faults():
+    """Fault-site registry audit (TPU601/602) — every literal site the
+    tree references through fault_point()/FaultEvent/FaultPlan.add or a
+    compact parse()/inject() spec must match a FAULT_SITES registry
+    pattern, and every registry pattern must have at least one
+    fault_point() behind it.  Pure AST over paddle_tpu/, scripts/,
+    tests/ and bench.py — no scanned module is imported."""
+    from paddle_tpu.analysis.fault_lint import audit_fault_sites
+    return audit_fault_sites()
+
+
 LINTERS = {"lenet": lint_lenet, "bert": lint_bert, "gpt": lint_gpt,
            "pallas": lint_pallas, "sharding": lint_sharding,
-           "fabric": lint_fabric}
+           "fabric": lint_fabric, "faults": lint_faults}
 
 
 def run_models(names):

@@ -1,0 +1,105 @@
+"""Chaos-schedule explorer: seeded schedule generation, deterministic
+replay, and the global invariant suite.
+
+Tier-1 runs the cheap layers — registry/inventory/trace/schedule
+determinism plus a 2-schedule smoke of the full replay harness.  The
+acceptance-grade soak (>= 8 seeded schedules over >= 10 distinct fault
+sites, greedy and seeded sampling alternating) is ``slow``:
+
+    pytest tests/test_chaos_explorer.py -m slow
+"""
+import json
+
+import pytest
+
+import paddle_tpu  # noqa: F401  (path setup)
+from paddle_tpu.distributed.fault_tolerance import (ChaosSchedule,
+                                                    bursty_trace,
+                                                    explore,
+                                                    generate_schedule,
+                                                    serving_site_inventory,
+                                                    site_registered)
+
+pytestmark = pytest.mark.faults
+
+
+class TestScheduleGeneration:
+    def test_inventory_only_lists_registered_sites(self):
+        inv = serving_site_inventory(hosts=4)
+        assert len(inv) >= 15
+        assert all(site_registered(site) for site, _ in inv)
+
+    def test_seed_to_schedule_byte_reproducible(self):
+        for seed in range(8):
+            a = generate_schedule(seed).to_json()
+            b = generate_schedule(seed).to_json()
+            assert a == b, f"seed {seed} not reproducible"
+        # distinct seeds explore distinct fault mixes
+        assert len({generate_schedule(s).to_json()
+                    for s in range(8)}) > 1
+
+    def test_schedule_json_roundtrip(self):
+        s = generate_schedule(5)
+        s2 = ChaosSchedule.from_json(s.to_json())
+        assert s2.to_json() == s.to_json()
+        assert s2.sites() == s.sites()
+        plan = s.to_plan()
+        assert len(plan.events) == len(s.entries)
+
+    def test_schedules_bound_destructive_faults(self):
+        """No schedule may remove so many hosts the cluster cannot
+        finish: at most hosts-2 distinct host removals and at most one
+        master kill."""
+        for seed in range(32):
+            s = generate_schedule(seed, hosts=4)
+            removals = {e["site"] for e in s.entries
+                        if e["site"].startswith(("fabric.host_down.",
+                                                 "fabric.preempt."))}
+            assert len(removals) <= 2, (seed, sorted(removals))
+            masters = [e for e in s.entries
+                       if e["site"] == "store.master_down"]
+            assert len(masters) <= 1, seed
+
+    def test_bursty_trace_deterministic_and_heavy_tailed(self):
+        a = bursty_trace(101)
+        b = bursty_trace(101)
+        assert a == b
+        assert bursty_trace(102) != a
+        # Zipf prefix sharing: at least two requests open identically
+        firsts = [tuple(t["prompt"][:8]) for t in a]
+        assert len(set(firsts)) < len(firsts)
+        # arrivals are bursty, not uniform: at least one shared step
+        steps = [t["arrival_step"] for t in a]
+        assert steps == sorted(steps)
+        assert len(set(steps)) < len(steps)
+
+
+class TestExplorerSmoke:
+    def test_two_schedule_smoke(self):
+        """Tier-1 gate: two seeded schedules (one greedy, one seeded
+        sampling) replay with every invariant green."""
+        out = explore(seeds=range(2), n_requests=6)
+        assert out["ok"], json.dumps(out, indent=1, default=str)
+        assert out["schedules"] == 2
+        for r in out["results"]:
+            assert r["ok"], r["failures"]
+            assert not r["failures"]
+
+
+@pytest.mark.slow
+class TestExplorerSoak:
+    def test_eight_schedule_soak_covers_ten_sites(self):
+        """Acceptance soak: >= 8 seeded schedules spanning >= 10
+        distinct fault sites, alternating greedy / seeded sampling,
+        all invariants green, and the seed -> schedule mapping byte
+        reproducible."""
+        seeds = range(8)
+        out = explore(seeds=seeds, n_requests=8)
+        assert out["ok"], json.dumps(out, indent=1, default=str)
+        assert out["schedules"] == 8
+        assert len(out["distinct_sites"]) >= 10, out["distinct_sites"]
+        for r in out["results"]:
+            assert r["ok"], (r["seed"], r["failures"])
+        # byte-for-byte reproducibility of every replayed schedule
+        for seed, r in zip(seeds, out["results"]):
+            assert generate_schedule(seed).to_json() == r["schedule"]

@@ -622,3 +622,139 @@ class TestClusterChaosGate:
         assert ev["preempt_fabric_bytes"] > 0
         assert ev["preempt_fabric_hidden_ratio"] > 0
         assert ev["mesh_after"] == "dp=4"
+        # control-plane outage phase: a standby was promoted, routing
+        # degraded onto cached digests, and the stale lease was fenced
+        assert ev["outage_promotions"] >= 1 and ev["outage_epoch"] >= 2
+        assert ev["outage_degraded_ms"] > 0
+        assert ev["outage_stall_ms"] >= 0
+
+
+# ---------------------------------------------------------------------
+# Degraded mode: the router must keep serving on its cached gossip
+# snapshot when the rendezvous store is unreachable — hints only, so
+# an outage costs re-prefills, never a wrong answer.
+# ---------------------------------------------------------------------
+class FlakyStore:
+    """LocalStore whose every op raises ConnectionError while
+    ``down`` — a deterministic stand-in for a real store outage."""
+
+    def __init__(self):
+        from paddle_tpu.distributed.store import LocalStore
+        self._inner = LocalStore()
+        self.down = False
+
+    def _gate(self):
+        if self.down:
+            raise ConnectionError("store unreachable (test outage)")
+
+    def set(self, key, value, lease=None):
+        self._gate()
+        return self._inner.set(key, value)
+
+    def get(self, key):
+        self._gate()
+        return self._inner.get(key)
+
+    def query(self, key):
+        self._gate()
+        return self._inner.query(key)
+
+    def add(self, key, amount=1, lease=None):
+        self._gate()
+        return self._inner.add(key, amount)
+
+    def wait(self, keys, deadline=None):
+        self._gate()
+        return self._inner.wait(keys, deadline=deadline)
+
+    def close(self):
+        self._inner.close()
+
+
+class TestDegradedMode:
+    def _cluster(self, model, store, clock, **kw):
+        from paddle_tpu.inference.serving import ClusterRouter
+        kw.setdefault("hosts", 2)
+        return ClusterRouter(model, store=store, clock=clock,
+                             num_blocks=64, max_batch=4, block_size=8,
+                             max_model_len=64, **kw)
+
+    def test_outage_serves_from_cached_digests(self, gpt_mini):
+        prev = obs.enable(True)
+        obs.get_timeline().clear()
+        clock = SimClock()
+        store = FlakyStore()
+        cl = self._cluster(gpt_mini, store, clock)
+        prompts = _shared_prompts(4)
+        try:
+            # healthy burst seeds the per-host digest snapshot
+            ids = [cl.add_request(p, max_new_tokens=4)
+                   for p in prompts[:2]]
+            while cl.has_unfinished():
+                clock.t += 1.0
+                cl.step()
+            assert not cl.degraded
+
+            store.down = True
+            ids += [cl.add_request(p, max_new_tokens=4)
+                    for p in prompts[2:]]
+            while cl.has_unfinished():
+                clock.t += 1.0
+                cl.step()
+            assert cl.degraded
+            s = cl.stats()
+            assert s["degraded"] and s["degraded_events"] >= 1
+            assert s["degraded_ms"] > 0
+            # every request completed through the outage
+            assert all(len(cl.result(r)) > len(p)
+                       for r, p in zip(ids, prompts))
+            routed = obs.get_registry().counter(
+                "cluster.degraded_routes").value
+            assert routed >= 1, "outage routing never used the cache"
+
+            # store comes back: the next heartbeat publish clears the
+            # window and settles it on the timeline
+            store.down = False
+            clock.t += 1.0
+            cl.step()
+            assert not cl.degraded
+            assert cl.stats()["degraded_ms"] > 0
+        finally:
+            cl.close()
+            obs.enable(prev)
+        from paddle_tpu.observability.export import phase_breakdown
+        pb = phase_breakdown()
+        assert pb.get("degraded_ms", 0) > 0
+        assert pb.get("degraded_count", 0) >= 1
+        obs.get_timeline().clear()
+
+    def test_autoscale_paused_while_degraded(self, gpt_mini):
+        clock = SimClock()
+        store = FlakyStore()
+        cl = self._cluster(gpt_mini, store, clock, hosts=1,
+                           spare_hosts=1, autoscale=True,
+                           scale_up_depth=2)
+        try:
+            store.down = True
+            ids = [cl.add_request(p, max_new_tokens=2)
+                   for p in _shared_prompts(6)]
+            clock.t += 1.0
+            cl.step()
+            assert cl.degraded
+            # queue depth is far past scale_up_depth, but membership
+            # gossips through the store: no scale-up during an outage
+            assert cl.scale_ups == 0
+
+            store.down = False
+            clock.t += 1.0
+            cl.step()     # heartbeat succeeds -> degraded clears
+            assert not cl.degraded
+            clock.t += 1.0
+            cl.step()     # autoscaler resumes with the store
+            assert cl.scale_ups >= 1
+            while cl.has_unfinished():
+                clock.t += 1.0
+                cl.step()
+            assert all(len(cl.result(r)) > 0 for r in ids)
+        finally:
+            cl.close()

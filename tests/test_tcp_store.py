@@ -244,3 +244,124 @@ def test_wait_deadline_raises_structured_store_timeout():
         obs.get_timeline().clear()
         obs.enable(prev)
         srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# LocalStore parity: the in-process store honors the same wait/deadline
+# contract as TCPStore so cluster code is backend-agnostic.
+# ---------------------------------------------------------------------------
+class TestLocalStoreParity:
+    def test_roundtrip_matches_tcp_semantics(self):
+        from paddle_tpu.distributed.store import LocalStore
+        store = LocalStore()
+        try:
+            _roundtrip(store)
+        finally:
+            store.close()
+
+    def test_wait_deadline_raises_structured_store_timeout(self):
+        from paddle_tpu import observability as obs
+        from paddle_tpu.distributed import LocalStore, StoreTimeoutError
+        prev = obs.enable(True)
+        obs.get_timeline().clear()
+        store = LocalStore()
+        try:
+            store.set("present", b"1")
+            store.wait(["present"], deadline=1.0)   # satisfied
+            t0 = time.monotonic()
+            with pytest.raises(StoreTimeoutError) as ei:
+                store.wait(["present", "never"], deadline=0.3)
+            assert time.monotonic() - t0 < 5
+            assert "never" in ei.value.pending
+            assert ei.value.deadline_s == pytest.approx(0.3)
+            assert ei.value.waited_s >= 0.2
+            marks = [e for e in obs.get_timeline().events()
+                     if e.name == "store.wait_timeout"]
+            assert marks and marks[0].cat == "fault"
+        finally:
+            store.close()
+            obs.get_timeline().clear()
+            obs.enable(prev)
+
+    def test_blocking_get_times_out(self):
+        from paddle_tpu.distributed.store import LocalStore
+        store = LocalStore(timeout=0.3)
+        try:
+            with pytest.raises(TimeoutError):
+                store.get("never")
+        finally:
+            store.close()
+
+
+# ---------------------------------------------------------------------------
+# ResilientStore: standby promotion with epoch fencing.
+# ---------------------------------------------------------------------------
+class TestResilientStore:
+    def test_promotion_and_epoch_fence(self):
+        from paddle_tpu.distributed.store import (ResilientStore,
+                                                  StoreEpochError)
+        store = ResilientStore(timeout=1.0)
+        try:
+            lease = store.acquire_lease(owner="writer")
+            store.set("k", b"v", lease=lease)
+            assert store.get("k") == b"v"
+            assert store.epoch() == 1
+
+            store.master_down()
+            # next op promotes a standby transparently
+            store.set("k2", b"v2")
+            assert store.promotions == 1 and store.epoch() == 2
+            # promoted standby starts EMPTY: gossip republishes, the
+            # fabric's head/tail rewind covers in-flight sequences
+            assert store.query("k") is None
+
+            # split-brain fence: the pre-outage lease can never write
+            with pytest.raises(StoreEpochError) as ei:
+                store.set("k3", b"x", lease=lease)
+            assert ei.value.lease_epoch == 1
+            assert ei.value.store_epoch == 2
+            assert store.fenced_writes == 1
+            assert store.query("k3") is None
+
+            # renewing re-admits the writer under the new epoch
+            lease = store.renew(lease)
+            store.set("k3", b"y", lease=lease)
+            assert store.get("k3") == b"y"
+        finally:
+            store.close()
+
+    def test_transient_op_drop_does_not_promote(self):
+        """An injected store-op failure while the master is ALIVE must
+        surface (the caller degrades), not trigger a promotion that
+        would wipe healthy state."""
+        from paddle_tpu.distributed.fault_tolerance import (FaultPlan,
+                                                            inject)
+        from paddle_tpu.distributed.store import ResilientStore
+        store = ResilientStore(timeout=1.0)
+        try:
+            store.set("k", b"v")
+            with inject(FaultPlan.parse("store.get:drop:count=1")):
+                with pytest.raises((ConnectionError, OSError)):
+                    store.get("k")
+            assert store.promotions == 0 and store.epoch() == 1
+            assert store.get("k") == b"v"   # data intact
+        finally:
+            store.close()
+
+    def test_fault_site_kills_master(self):
+        """The ``store.master_down`` site is the chaos-schedule entry
+        point: the kill lands on the Nth store op and the caller only
+        sees the epoch bump."""
+        from paddle_tpu.distributed.fault_tolerance import (FaultPlan,
+                                                            inject)
+        from paddle_tpu.distributed.store import ResilientStore
+        store = ResilientStore(timeout=1.0)
+        try:
+            with inject(FaultPlan.parse(
+                    "store.master_down:kill:after=1,count=1")):
+                store.set("a", b"1")          # op 1: clean
+                store.set("b", b"2")          # op 2: master dies here
+            assert store.promotions == 1 and store.epoch() == 2
+            assert store.get("b") == b"2"     # retried post-promotion
+        finally:
+            store.close()

@@ -1,16 +1,19 @@
 """Fabric transport wire format: bit-identical round trips, integrity
-and version gates, idempotent resend, store-backed hops, and the
-TokenStream double-failover dedup regression.
+and version gates, idempotent resend, store-backed hops, the
+TokenStream double-failover dedup regression, and control-plane loss
+under the fabric (store master death mid-hop and mid-failover).
 
-Pure host-side — no model, no JAX dispatch — so the whole file runs in
-well under a second."""
+Host-side except the final class, which drives a small ClusterRouter
+burst (tiny GPT, CPU) through a store-master kill DURING a host
+failover."""
 import numpy as np
 import pytest
 
 import paddle_tpu  # noqa: F401  (path setup)
 from paddle_tpu import observability as obs
 from paddle_tpu.distributed.fault_tolerance import FaultPlan, inject
-from paddle_tpu.distributed.store import TCPStore, _PyStoreServer
+from paddle_tpu.distributed.store import (ResilientStore, TCPStore,
+                                          _PyStoreServer)
 from paddle_tpu.inference.serving import (HandoffPayload,
                                           LoopbackTransport,
                                           PayloadIntegrityError,
@@ -276,6 +279,42 @@ class TestStoreTransport:
             store.close()
             srv.stop()
 
+    def test_master_loss_rewinds_tail_and_stays_exactly_once(self):
+        """A promoted standby starts with empty counters, so senders
+        restart sequences at 0; the receiver must rewind its tail
+        (head < tail) or every post-promotion message is silently
+        skipped — and the envelope dedup key must still suppress the
+        at-least-once replays that cross the outage."""
+        store = ResilientStore(timeout=1.0)
+        try:
+            src = StoreTransport(store, "prefill")
+            dst = StoreTransport(store, "decode")
+            p = _payload()
+            d1 = _wire(p, request_id="a", commit_gen=1,
+                       meta={"export": 1})
+            d2 = _wire(p, request_id="b", commit_gen=1,
+                       meta={"export": 1})
+            src.send("decode", d1)
+            src.send("decode", d2)
+            assert len(dst.recv(deadline=5.0)) == 2   # tail now 2
+
+            store.master_down()
+            # the sender's retry replays b into the FRESH store: its
+            # head restarts at 1, below the receiver's tail of 2
+            src.send("decode", d2)
+            assert dst.recv(deadline=5.0) == []
+            assert dst.store_resets == 1
+            assert dst.duplicates == 1     # replayed b suppressed
+            # a genuinely new message after the rewind still lands
+            d3 = _wire(p, request_id="c", commit_gen=1,
+                       meta={"export": 1})
+            src.send("decode", d3)
+            out = dst.recv(deadline=5.0)
+            assert [dl.envelope.key[0] for dl in out] == ["c"]
+            assert store.promotions == 1 and store.epoch() == 2
+        finally:
+            store.close()
+
 
 # ---------------------------------------------------------------------------
 # TokenStream double-failover regression: the dedup high-water mark
@@ -319,3 +358,35 @@ class TestStreamDoubleFailover:
         st2 = TokenStream.restore(st.export_state())
         assert [e.token for e in st2.drain()] == [7, 8]
         assert st2.stats()["next_index"] == 2
+
+
+# ---------------------------------------------------------------------------
+# Control-plane death DURING a host failover: the worst compound case —
+# host0's HBM is already gone and its requests are mid-harvest when the
+# rendezvous store master dies too.  A standby must be promoted, the
+# failover must still complete bit-identical, and the streams must stay
+# exactly-once across BOTH recoveries.
+# ---------------------------------------------------------------------------
+class TestStoreOutageDuringFailover:
+    def test_master_kill_mid_failover_bit_identical(self):
+        from paddle_tpu.distributed.fault_tolerance import chaos
+
+        trace = chaos.bursty_trace(23, n_requests=4)
+        model = chaos._default_model(seed=7)
+        want, _, _, _ = chaos._drive(model, trace)
+
+        plan = FaultPlan.parse(
+            "fabric.host_down.h0:kill:after=1,count=2;"
+            "store.master_down:kill:after=10,count=1")
+        store = ResilientStore(timeout=1.0)
+        try:
+            got, stats, events, _ = chaos._drive(
+                model, trace, store=store, plan=plan)
+            assert got == want, \
+                "outputs diverge under host kill + store outage"
+            assert chaos._stream_violations(events, got, trace) == []
+            assert stats["failovers"] >= 1, stats["failovers"]
+            assert store.promotions == 1 and store.epoch() == 2, \
+                store.stats()
+        finally:
+            store.close()
