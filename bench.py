@@ -1353,6 +1353,161 @@ def bench_bert_tp(on_tpu):
                        + (p.stderr or "")[-400:])
 
 
+def _moe_gpt_body(n_iters=4):
+    """MoE GPT-mini dropless training step under ``dp=2,ep=2`` plus a
+    dense iso-FLOPs twin (intermediate scaled by top_k, so both models
+    spend the same MLP FLOPs per token); returns the metrics dict with
+    the routing-imbalance gauge and the measured ``ep`` overlap ratio."""
+    import numpy as np
+    import jax.numpy as jnp
+    import paddle_tpu as paddle
+    import paddle_tpu.distributed as dist
+    from paddle_tpu import observability as obs
+    from paddle_tpu import optimizer
+    from paddle_tpu.distributed.auto_parallel import moe_dispatch as md
+    from paddle_tpu.distributed.auto_parallel import overlap as ovl
+    from paddle_tpu.distributed.auto_parallel.sharding import (
+        MeshPlan, annotate_params, clear_mesh_plan, rules_for,
+        set_mesh_plan)
+    from paddle_tpu.models import (GPTConfig, GPTForCausalLM,
+                                   GPTPretrainingCriterion, MoEGPTConfig,
+                                   MoEGPTForCausalLM)
+    from paddle_tpu.models.moe_gpt import (MoEGPTPretrainingCriterion,
+                                           _moe_mlp_compute)
+
+    B, S, H, E, K = 8, 64, 128, 4, 2
+    paddle.seed(0)
+    plan = MeshPlan("dp=2,ep=2", rules=rules_for("moe_gpt"))
+    set_mesh_plan(plan)
+    dist.env.set_global_mesh(plan.mesh)
+    try:
+        mode = ovl.select_mode(plan, "ep")
+        cfg = MoEGPTConfig(
+            vocab_size=256, hidden_size=H, num_hidden_layers=2,
+            num_attention_heads=2, use_flash_attention=False,
+            max_position_embeddings=S, num_experts=E, top_k=K)
+        model = MoEGPTForCausalLM(cfg)
+        annotate_params(model)
+        crit = MoEGPTPretrainingCriterion(model=model)
+        opt = optimizer.AdamW(learning_rate=1e-4,
+                              parameters=model.parameters())
+        rng = np.random.default_rng(0)
+        ids = paddle.to_tensor(
+            rng.integers(0, 256, (B, S)).astype(np.int64))
+
+        def step(m, c, o):
+            loss = c(m(ids), ids)
+            loss.backward()
+            o.step()
+            o.clear_grad()
+            return loss
+
+        t = time.time()
+        l0 = step(model, crit, opt)
+        compile_s = time.time() - t
+        log(f"moe_gpt: compile+first step {compile_s:.1f}s "
+            f"loss={float(l0.numpy()):.3f} mesh={plan.describe()} "
+            f"mode={mode}")
+        t = time.time()
+        for _ in range(n_iters):
+            lv = step(model, crit, opt)
+        dt = (time.time() - t) / n_iters
+        moe_tps = B * S / dt
+
+        # dense iso-FLOPs twin: top_k active experts/token == a dense
+        # MLP whose intermediate is top_k x the per-expert width
+        dense = GPTForCausalLM(GPTConfig(
+            vocab_size=256, hidden_size=H, num_hidden_layers=2,
+            num_attention_heads=2, use_flash_attention=False,
+            max_position_embeddings=S, intermediate_size=K * 4 * H))
+        dcrit = GPTPretrainingCriterion()
+        dopt = optimizer.AdamW(learning_rate=1e-4,
+                               parameters=dense.parameters())
+        step(dense, dcrit, dopt)
+        t = time.time()
+        for _ in range(n_iters):
+            step(dense, dcrit, dopt)
+        dense_tps = B * S / ((time.time() - t) / n_iters)
+
+        # routing-balance gauge: the layer-0 router over a seeded
+        # hidden sample (the TPU508 threshold input)
+        mlp = model.gpt.h[0].mlp
+        x = jnp.asarray(
+            rng.standard_normal((B * S, H)).astype(np.float32))
+        _, _, counts = _moe_mlp_compute(
+            x, mlp.router._value, mlp.w1._value, mlp.b1._value,
+            mlp.w2._value, mlp.b2._value, top_k=K, num_experts=E,
+            act="gelu_tanh")
+        imbalance = float(md.expert_imbalance(np.asarray(counts)))
+
+        # overlap evidence: host-driven ep dispatch ring over a grouped
+        # buffer (real collective spans -> overlap_ratio_ep)
+        obs.get_timeline().clear()
+        xd = jnp.asarray(
+            rng.standard_normal((256, H)).astype(np.float32))
+        w = jnp.asarray(rng.standard_normal((H, H)).astype(np.float32))
+        import jax
+        expert_fn = jax.jit(lambda v: v @ w)
+        for _ in range(3):
+            md.measured_ep_dispatch(xd, expert_fn, plan=plan, axis="ep",
+                                    mode=mode)
+        overlap = obs.collective_overlap_stats().get("ep", {})
+        log(f"moe_gpt: step {dt*1e3:.1f} ms {moe_tps:,.0f} tok/s "
+            f"(dense iso-FLOPs {dense_tps:,.0f}) "
+            f"imbalance={imbalance:.2f} "
+            f"overlap_ratio={overlap.get('overlap_ratio', 0.0):.2f}")
+        return {"tokens_per_sec": round(moe_tps, 1),
+                "dense_tokens_per_sec": round(dense_tps, 1),
+                "step_ms": round(dt * 1e3, 2),
+                "compile_first_s": round(compile_s, 1),
+                "loss": round(float(lv.numpy()), 4),
+                "mesh": plan.describe(),
+                "overlap_mode": mode,
+                "expert_imbalance": round(imbalance, 3),
+                "overlap_ratio_ep": overlap.get("overlap_ratio", 0.0),
+                "phases": obs.phase_breakdown()}
+    finally:
+        dist.env.set_global_mesh(None)
+        clear_mesh_plan()
+
+
+_MOE_GPT_SUB = r"""
+import os, json
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "") +
+                           " --xla_force_host_platform_device_count=8")
+import jax
+jax.config.update("jax_platforms", "cpu")
+from paddle_tpu import observability as obs
+obs.enable(True)
+import bench
+print("MOE_GPT_JSON: " + json.dumps(bench._moe_gpt_body()))
+"""
+
+
+def bench_moe_gpt(on_tpu):
+    import jax
+    if jax.device_count() >= 4:
+        res = _moe_gpt_body()
+        res["forced_host_mesh"] = False
+        return res
+    t = time.time()
+    p = subprocess.run(
+        [sys.executable, "-c", _MOE_GPT_SUB], cwd=str(ROOT),
+        capture_output=True, text=True, timeout=1800)
+    for line in p.stdout.splitlines():
+        if line.startswith("MOE_GPT_JSON:"):
+            res = json.loads(line[len("MOE_GPT_JSON:"):])
+            res["forced_host_mesh"] = True
+            res["seconds"] = round(time.time() - t, 1)
+            log(f"moe_gpt (forced host mesh): "
+                f"{res['tokens_per_sec']:,.0f} tok/s "
+                f"imbalance={res['expert_imbalance']:.2f} "
+                f"({res['seconds']:.0f}s)")
+            return res
+    raise RuntimeError("moe_gpt subprocess produced no result: "
+                       + (p.stderr or "")[-400:])
+
+
 def _bert_x32_subprocess(wait_s=900):
     """Run the BERT config under PADDLE_TPU_X32=1 in a child; parse its
     JSON line.  MUST run before the parent initializes jax — the TPU
@@ -1409,7 +1564,7 @@ def main():
     configs = os.environ.get(
         "PADDLE_TPU_BENCH_CONFIGS",
         "bert,lenet,resnet50,gpt,llama_dryrun,bert_dp,bert_tp,"
-        "bert_elastic"
+        "moe_gpt,bert_elastic"
         ).split(",")
 
     info = None
@@ -1529,6 +1684,7 @@ def main():
         "llama_dryrun": bench_llama_dryrun,
         "bert_dp": lambda: bench_bert_dp(on_tpu),
         "bert_tp": lambda: bench_bert_tp(on_tpu),
+        "moe_gpt": lambda: bench_moe_gpt(on_tpu),
         "bert_elastic": lambda: bench_bert_elastic(on_tpu),
         "gpt_cluster": lambda: bench_gpt_cluster(on_tpu),
     }
@@ -1721,6 +1877,27 @@ def main():
                 res["forced_host_mesh"]
             if res.get("phases"):
                 payload["extra_metrics"]["bert_tp_phases"] = \
+                    res["phases"]
+        elif name == "moe_gpt":
+            payload["extra_metrics"]["moe_gpt_tokens_per_sec"] = \
+                res["tokens_per_sec"]
+            payload["extra_metrics"][
+                "moe_gpt_dense_iso_tokens_per_sec"] = \
+                res["dense_tokens_per_sec"]
+            payload["extra_metrics"]["moe_gpt_step_ms"] = res["step_ms"]
+            payload["extra_metrics"]["moe_gpt_mesh"] = res["mesh"]
+            payload["extra_metrics"]["moe_gpt_overlap_mode"] = \
+                res["overlap_mode"]
+            payload["extra_metrics"]["moe_gpt_expert_imbalance"] = \
+                res["expert_imbalance"]
+            payload["extra_metrics"]["overlap_ratio_ep"] = \
+                res["overlap_ratio_ep"]
+            payload["extra_metrics"]["moe_gpt_overlap_ratio"] = \
+                res["overlap_ratio_ep"]
+            payload["extra_metrics"]["moe_gpt_forced_host_mesh"] = \
+                res["forced_host_mesh"]
+            if res.get("phases"):
+                payload["extra_metrics"]["moe_gpt_phases"] = \
                     res["phases"]
         if errors:
             payload["errors"] = errors

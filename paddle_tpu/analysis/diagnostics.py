@@ -92,6 +92,12 @@ CODES = {
                "the transfer outlasts the decode steps available before "
                "the destination needs the blocks, so decode stalls on "
                "the fabric", WARNING),
+    "TPU507": ("expert capacity below the expected peak load: tokens "
+               "past slot C of a hot expert are silently dropped by the "
+               "capacity router", WARNING),
+    "TPU508": ("expert routing imbalance: a hot expert's load is far "
+               "above the mean, so dropless grouped blocks pad (wasted "
+               "MXU cycles) and capacity routers drop", WARNING),
     # -- fault-site registry (TPU6xx) ----------------------------------
     "TPU601": ("fault-site reference not in the FAULT_SITES registry: "
                "chaos schedules can never reach it, and a typo'd site "

@@ -1,0 +1,105 @@
+"""MoE routing analyzers: TPU507 / TPU508, pure arithmetic.
+
+Two routers ship in the tree and each has one failure mode decidable
+from geometry plus a load sample, before any chip time is spent:
+
+* the CAPACITY router (``incubate/.../moe_layer.py``) drops every
+  token past slot ``C`` of its expert (``keep = loc < C``).  Whether a
+  configured ``C`` survives a given load skew is one inequality:
+  ``C >= imbalance * tokens * top_k / num_experts`` — **TPU507**
+  otherwise (quality silently degrades, no error is raised anywhere);
+* the DROPLESS router (``distributed/auto_parallel/moe_dispatch.py``)
+  never drops, but every expert's rows round up to whole
+  ``block_rows`` grouped blocks, so a hot expert converts imbalance
+  into padded blocks the grouped kernel still multiplies — **TPU508**
+  when ``max(counts) / mean(counts)`` crosses the threshold (the same
+  gauge `moe_dispatch.expert_imbalance` reports and the bench
+  publishes as ``moe_gpt_expert_imbalance``).
+
+Both are callable from the lint CLI over a planned config as easily as
+from a live run's measured counts.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .diagnostics import Diagnostic, DiagnosticReport, record
+
+__all__ = ["audit_expert_capacity", "audit_routing_balance"]
+
+
+def audit_expert_capacity(tokens, num_experts, top_k, capacity, *,
+                          imbalance=2.0, site="moe.capacity",
+                          report=None, emit=True):
+    """TPU507: does ``capacity`` hold the expected peak expert load?
+
+    ``imbalance`` is the load-skew factor to provision for (peak =
+    ``imbalance * tokens * top_k / num_experts``); 2.0 is the usual
+    early-training skew.  The incubate default ``capacity_factor=1.2``
+    therefore flags here unless the gate keeps routing balanced."""
+    report = report if report is not None else DiagnosticReport(
+        label="moe capacity")
+    mean = tokens * top_k / max(num_experts, 1)
+    peak = imbalance * mean
+    if capacity < peak:
+        dropped = int(peak - capacity) * num_experts
+        d = Diagnostic(
+            "TPU507",
+            f"capacity {capacity} per expert < expected peak load "
+            f"{peak:.0f} ({imbalance:g}x the mean {mean:.0f} of "
+            f"{tokens} tokens x top-{top_k} over {num_experts} "
+            f"experts): ~{dropped} assignments dropped per step at "
+            "that skew",
+            site=site,
+            hint="raise capacity_factor, or switch the layer to the "
+                 "dropless grouped path (models/moe_gpt.py), which "
+                 "pads instead of dropping",
+            data={"capacity": int(capacity), "peak": round(peak, 1),
+                  "mean": round(mean, 1), "tokens": int(tokens),
+                  "top_k": int(top_k), "num_experts": int(num_experts),
+                  "imbalance": float(imbalance)})
+        if emit:
+            record(d)
+        report.add(d)
+    return report
+
+
+def audit_routing_balance(counts, *, block_rows=None, threshold=2.0,
+                          site="moe.routing", report=None, emit=True):
+    """TPU508: is the measured per-expert load skewed past
+    ``threshold``?
+
+    ``counts`` is the per-expert assignment histogram (the third
+    return of `moe_dispatch.dropless_plan`, or any measured sample).
+    With ``block_rows`` the finding also quantifies the grouped-buffer
+    padding the skew costs (``padded_rows / real_rows - 1``)."""
+    report = report if report is not None else DiagnosticReport(
+        label="moe routing balance")
+    c = np.asarray(counts, dtype=np.float64)
+    total = float(c.sum())
+    mean = total / max(len(c), 1)
+    ratio = float(c.max()) / max(mean, 1.0)
+    data = {"counts": [int(v) for v in c],
+            "imbalance": round(ratio, 3),
+            "threshold": float(threshold)}
+    if block_rows:
+        padded = float(np.ceil(c / block_rows).sum() * block_rows)
+        data["padding_frac"] = round(padded / max(total, 1.0) - 1.0, 3)
+    if ratio > threshold:
+        pad = (f", {data['padding_frac']:.0%} grouped-block padding"
+               if "padding_frac" in data else "")
+        d = Diagnostic(
+            "TPU508",
+            f"hottest expert carries {ratio:.2f}x the mean load "
+            f"(threshold {threshold:g}x{pad}): dropless blocks pad, "
+            "capacity routers drop",
+            site=site,
+            hint="check the router aux loss is applied "
+                 "(MoEGPTPretrainingCriterion weights it in) and that "
+                 "its weight has not been zeroed; a dead router at "
+                 "init also shows up here",
+            data=data)
+        if emit:
+            record(d)
+        report.add(d)
+    return report

@@ -25,7 +25,7 @@ __all__ = ["LANE", "VMEM_BYTES", "min_tile", "check_block_spec",
            "check_pallas_call", "estimate_vmem_bytes",
            "audit_flash_attention", "audit_paged_attention",
            "audit_ragged_attention", "audit_layer_norm_residual",
-           "audit_matmul_epilogue"]
+           "audit_matmul_epilogue", "audit_grouped_matmul"]
 
 LANE = 128
 # per-core VMEM; Mosaic needs headroom for double buffering, so the
@@ -213,6 +213,25 @@ def audit_matmul_epilogue(m, k, n, dtype="float32", direction="fwd",
     report = check_pallas_call(
         plan["operands"], scratch=plan.get("scratch", ()), site=site)
     _flag_int8_relayout(report, plan, site=site)
+    report.plan = plan
+    return report
+
+
+def audit_grouped_matmul(tokens, k, n, num_experts, dtype="float32",
+                         direction="fwd"):
+    """Statically validate the grouped-expert matmul block plan
+    (see ``ops.pallas_grouped.grouped_matmul_block_plan``).
+
+    The scalar-prefetched ``block_group`` descriptor is untiled and
+    omitted from the plan, like the ragged kernels' block tables."""
+    from ..ops.pallas_grouped import grouped_matmul_block_plan
+    plan = grouped_matmul_block_plan(tokens, k, n, num_experts,
+                                     dtype=dtype, direction=direction)
+    site = (f"grouped_matmul.{direction}"
+            f"[{np.dtype(dtype).name} tokens={tokens} k={k} n={n} "
+            f"e={num_experts}]")
+    report = check_pallas_call(
+        plan["operands"], scratch=plan.get("scratch", ()), site=site)
     report.plan = plan
     return report
 

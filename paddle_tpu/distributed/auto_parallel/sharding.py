@@ -6,8 +6,9 @@ This is the mesh-aware core that both execution tiers (the static
 * ``match_partition_rules(rules, named_shapes)`` — fmengine-style regex
   matching of structural parameter names to ``PartitionSpec`` leaves.
   Scalar leaves are never sharded; a name matched by no rule raises.
-* ``MeshPlan`` — the plan object.  Axes (``dp``/``tp``/``fsdp``) come
-  from a spec string such as ``"dp=4,tp=2"`` (env: ``PADDLE_TPU_MESH``).
+* ``MeshPlan`` — the plan object.  Axes (``dp``/``tp``/``fsdp``/``pp``/
+  ``ep``) come from a spec string such as ``"dp=4,tp=2"``
+  (env: ``PADDLE_TPU_MESH``).
   It resolves rule hits into *legal* specs for a concrete shape (absent
   axes dropped, indivisible dims replicated), builds ``NamedSharding``s,
   and picks jit-with-NamedSharding vs ``shard_map`` per step function
@@ -46,11 +47,18 @@ MODEL_AXES = ("tp",)
 #: rules and batch specs never place tensors on it; it partitions the
 #: *program* into stages (see auto_parallel.pipeline / stage_plan).
 PIPELINE_AXES = ("pp",)
-KNOWN_AXES = DATA_AXES + MODEL_AXES + PIPELINE_AXES
+#: expert axis: MoE expert parallelism.  Stacked expert parameters
+#: shard their leading [num_experts, ...] dim over it; token dispatch
+#: crosses it with all-to-all (see distributed.moe).  Like tp it is a
+#: model axis for batch purposes — feeds are never sharded over ep.
+EXPERT_AXES = ("ep",)
+KNOWN_AXES = DATA_AXES + MODEL_AXES + PIPELINE_AXES + EXPERT_AXES
 
 __all__ = [
-    "ENV_MESH", "DATA_AXES", "MODEL_AXES", "KNOWN_AXES", "PIPELINE_AXES",
-    "BERT_RULES", "GPT_RULES", "MeshPlan", "annotate_params",
+    "ENV_MESH", "DATA_AXES", "EXPERT_AXES", "MODEL_AXES", "KNOWN_AXES",
+    "PIPELINE_AXES",
+    "BERT_RULES", "GPT_RULES", "MOE_GPT_RULES", "MeshPlan",
+    "annotate_params",
     "clear_mesh_plan", "gather_value", "gather_named", "get_mesh_plan",
     "make_shard_and_gather_fns", "match_partition_rules",
     "parse_mesh_spec", "plan_cache_token", "rules_for", "set_mesh_plan",
@@ -221,7 +229,21 @@ def GPT_RULES():
     ]
 
 
-_BUILTIN_RULES = {"bert": BERT_RULES, "gpt": GPT_RULES}
+def MOE_GPT_RULES():
+    """Partition rules for the bundled MoE GPT (``models/moe_gpt.py``):
+    the stacked expert weights [E, ...] shard their expert dim over
+    ``ep`` (dropped automatically on meshes without one); the router
+    stays replicated so every device ranks every expert; the shared
+    trunk follows ``GPT_RULES``."""
+    return [
+        (r"mlp\.router$", _P()),
+        (r"mlp\.w[12]$", _P("ep", None, None)),
+        (r"mlp\.b[12]$", _P("ep", None)),
+    ] + GPT_RULES()
+
+
+_BUILTIN_RULES = {"bert": BERT_RULES, "gpt": GPT_RULES,
+                  "moe_gpt": MOE_GPT_RULES}
 
 
 def rules_for(model):
@@ -357,7 +379,8 @@ class MeshPlan:
         of the original dp size that still fits, so global-batch
         divisibility (and therefore bit-identical resume on the shrunk
         mesh) is preserved.  Model-parallel axes that no longer fit
-        (tp, then fsdp, then pp) fall back to replication — each drop is
+        (tp, then fsdp, then pp, then ep — ep=1 keeps every expert
+        resident on every device) fall back to replication — each drop is
         recorded as a TPU505 finding on ``shrink_findings`` and in the
         diagnostic log.  The new plan reuses the SAME partition rules,
         so ``_legalize`` re-materializes specs on the smaller mesh, and
@@ -376,7 +399,7 @@ class MeshPlan:
         def _non_dp():
             return math.prod(v for k, v in axes.items() if k != "dp")
 
-        for ax in ("tp", "fsdp", "pp"):
+        for ax in ("tp", "fsdp", "pp", "ep"):
             if _non_dp() <= len(devs):
                 break
             if axes.get(ax, 1) > 1:

@@ -59,7 +59,8 @@ __all__ = ["ChaosSchedule", "bursty_trace", "serving_site_inventory",
 # ---------------------------------------------------------------------
 def bursty_trace(seed, n_requests=8, vocab=97, prefix_pool=4,
                  prefix_len=16, tail_max=5, zipf_a=1.5, pareto_a=1.3,
-                 max_new_tokens=6, horizon=24):
+                 max_new_tokens=6, horizon=24, arrival_rate=None,
+                 duration=None):
     """Deterministic synthetic serving trace.
 
     Arrival gaps are heavy-tailed (Pareto): most requests land in one
@@ -68,7 +69,22 @@ def bursty_trace(seed, n_requests=8, vocab=97, prefix_pool=4,
     a small pool with Zipf popularity (rank-k probability ~ k^-a), so
     prefix-affinity gossip routing has real structure to exploit.
     Returns ``[{"arrival_step", "prompt", "max_new_tokens"}, ...]``.
+
+    Sustained-load mode: passing BOTH ``arrival_rate`` (requests per
+    step) and ``duration`` (steps) replaces the Pareto burst with a
+    steady open-loop arrival process — ``round(rate * duration)``
+    requests at ``arrival_step = int(i / rate)`` — the soak shape for
+    capacity drills (MoE expert-load churn under constant pressure)
+    rather than failover drills.  ``n_requests`` is ignored and the
+    horizon stretches to cover ``duration``.  Prompt construction (and
+    its RNG draws) is identical in both modes; with the knob unset the
+    output is byte-for-byte the historical trace for the same seed.
     """
+    sustained = arrival_rate is not None and duration is not None
+    if sustained:
+        n_requests = max(1, int(round(float(arrival_rate)
+                                      * float(duration))))
+        horizon = max(int(horizon), int(duration))
     rng = np.random.RandomState(seed)
     prefixes = [[int(t) for t in rng.randint(1, vocab, size=prefix_len)]
                 for _ in range(prefix_pool)]
@@ -77,7 +93,9 @@ def bursty_trace(seed, n_requests=8, vocab=97, prefix_pool=4,
     t = 0.0
     out = []
     for i in range(int(n_requests)):
-        if i:
+        if sustained:
+            t = i / float(arrival_rate)
+        elif i:
             t += float(rng.pareto(pareto_a))
         p = int(rng.choice(prefix_pool, p=probs))
         tail = [int(x) for x in

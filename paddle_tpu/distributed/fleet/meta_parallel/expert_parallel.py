@@ -40,7 +40,20 @@ __all__ = ["global_scatter_local", "global_gather_local",
            "moe_ep_forward_local", "ExpertParallelEngine"]
 
 
-def global_scatter_local(dispatched, *, axis="ep", axis_size):
+def _a2a(x, *, axis, axis_size, mode):
+    """Leading-dim all-to-all: the fused collective, or (overlap mode)
+    the bit-exact per-peer ppermute ring whose hops XLA can schedule
+    under the surrounding expert compute (PR 11 ring discipline)."""
+    if mode == "overlap":
+        from ...auto_parallel.moe_dispatch import ring_all_to_all_local
+        return ring_all_to_all_local(x, axis=axis, axis_size=axis_size,
+                                     mode=mode)
+    return jax.lax.all_to_all(x, axis, split_axis=0, concat_axis=0,
+                              tiled=False)
+
+
+def global_scatter_local(dispatched, *, axis="ep", axis_size,
+                         mode="sequential"):
     """[E, C, D] token-major slots → [E_local, P*C, D] expert-major.
 
     Chunk p (experts owned by device p) is sent to device p; received
@@ -48,25 +61,26 @@ def global_scatter_local(dispatched, *, axis="ep", axis_size):
     E, C, D = dispatched.shape
     e_loc = E // axis_size
     x = dispatched.reshape(axis_size, e_loc, C, D)
-    x = jax.lax.all_to_all(x, axis, split_axis=0, concat_axis=0,
-                           tiled=False)          # dim0 now = source dev
+    x = _a2a(x, axis=axis, axis_size=axis_size,
+             mode=mode)                          # dim0 now = source dev
     x = jnp.swapaxes(x, 0, 1)                    # [E_loc, P, C, D]
     return x.reshape(e_loc, axis_size * C, D)
 
 
-def global_gather_local(expert_out, *, axis="ep", axis_size):
+def global_gather_local(expert_out, *, axis="ep", axis_size,
+                        mode="sequential"):
     """Inverse of global_scatter_local: [E_local, P*C, D] → [E, C, D]."""
     e_loc, PC, D = expert_out.shape
     C = PC // axis_size
     x = expert_out.reshape(e_loc, axis_size, C, D)
     x = jnp.swapaxes(x, 0, 1)                    # [P, E_loc, C, D]
-    x = jax.lax.all_to_all(x, axis, split_axis=0, concat_axis=0,
-                           tiled=False)
+    x = _a2a(x, axis=axis, axis_size=axis_size, mode=mode)
     return x.reshape(axis_size * e_loc, C, D)
 
 
 def moe_ep_forward_local(x, gating, expert_params, expert_apply,
-                         dispatch_fn, *, capacity, axis="ep", axis_size):
+                         dispatch_fn, *, capacity, axis="ep", axis_size,
+                         mode="sequential"):
     """MoE forward on a LOCAL token shard inside shard_map.
 
     x: [n_local, D] tokens.  gating: (probs, topk_idx, topk_val) local
@@ -81,10 +95,11 @@ def moe_ep_forward_local(x, gating, expert_params, expert_apply,
     dispatched, combine = dispatch_fn(x, probs, topk_idx, topk_val,
                                       capacity)
     slots = global_scatter_local(dispatched, axis=axis,
-                                 axis_size=axis_size)   # [E_loc, P*C, D]
+                                 axis_size=axis_size,
+                                 mode=mode)             # [E_loc, P*C, D]
     out = jax.vmap(expert_apply)(expert_params, slots)
-    gathered = global_gather_local(out, axis=axis,
-                                   axis_size=axis_size)  # [E, C, D]
+    gathered = global_gather_local(out, axis=axis, axis_size=axis_size,
+                                   mode=mode)            # [E, C, D]
     y = jnp.einsum("nec,ecd->nd", combine.astype(jnp.float32),
                    gathered.astype(jnp.float32)).astype(x.dtype)
     return y
@@ -165,13 +180,20 @@ class ExpertParallelEngine:
         # gate runs globally (aux loss must see the global distribution)
         probs, topk_idx, topk_val, aux = self._gate_fn(x_val, gate_vals)
 
+        # ep all-to-alls ride the ring-overlap machinery when the active
+        # plan's probe admits it (PADDLE_TPU_OVERLAP discipline)
+        from ...auto_parallel import overlap as _overlap
+        from ...auto_parallel import sharding as _spmd
+        a2a_mode = _overlap.select_mode(_spmd.get_mesh_plan(), axis)
+
         def device_fn(stacked, xl, pl, il, vl):
             return moe_ep_forward_local(
                 xl, (pl, il, vl),
                 list(stacked),
                 lambda pv, t: self._seg(list(pv), t),
                 lambda *a: _dispatch_combine(*a),
-                capacity=capacity, axis=axis, axis_size=axis_size)
+                capacity=capacity, axis=axis, axis_size=axis_size,
+                mode=a2a_mode)
 
         tok_spec = P(self.tok_axes)
         p_specs = tuple(P(axis, *([None] * (a.ndim - 1)))

@@ -12,6 +12,8 @@ from .bert import BertConfig, BertModel, BertForMaskedLM
 from .llama import LlamaConfig, LlamaModel, LlamaForCausalLM
 from .ernie import (ErnieConfig, ErnieModel, ErnieForMaskedLM,
                     ErnieForSequenceClassification)
+from .moe_gpt import (MoEGPTConfig, MoEGPTModel, MoEGPTForCausalLM,
+                      MoEGPTPretrainingCriterion)
 from .generation import GenerationMixin, generate
 
 __all__ = [
@@ -19,5 +21,7 @@ __all__ = [
     "BertConfig", "BertModel", "BertForMaskedLM",
     "LlamaConfig", "LlamaModel", "LlamaForCausalLM",
     "ErnieConfig", "ErnieModel", "ErnieForMaskedLM",
-    "ErnieForSequenceClassification", "GenerationMixin", "generate",
+    "ErnieForSequenceClassification",
+    "MoEGPTConfig", "MoEGPTModel", "MoEGPTForCausalLM",
+    "MoEGPTPretrainingCriterion", "GenerationMixin", "generate",
 ]

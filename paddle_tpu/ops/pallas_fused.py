@@ -30,9 +30,10 @@ import jax.numpy as jnp
 import numpy as np
 from jax.experimental import pallas as pl
 
-from .pallas_kernels import (_STAT_LANES, _demote_f64, _interpret,
-                             _kernel_span, _ln_block_rows, _ln_bwd_kernel,
-                             _min_rows, _pad_dim, _round_up, _x32)
+from .pallas_kernels import _ln_bwd_kernel
+from .pallas_tiles import (_STAT_LANES, _demote_f64, _interpret,
+                           _kernel_span, _ln_block_rows, _pad_dim,
+                           _round_up, _x32, matmul_accum_blocks)
 
 __all__ = [
     "ACTIVATIONS",
@@ -292,15 +293,9 @@ def _me_bwd_kernel(z_ref, g_ref, dz_ref, db_ref, *, act):
 
 
 def _me_blocks(m, k, n, dtype):
-    """(bm, bn, m_pad, n_pad): full-K resident rows, N split so the
-    double-buffered (K, bn) weight block stays under ~6MB of VMEM."""
-    itemsize = jnp.dtype(dtype).itemsize
-    bm = min(_round_up(max(m, 1), _min_rows(dtype)), 128)
-    bn = 512
-    while bn > 128 and 2 * k * bn * itemsize > (6 << 20):
-        bn //= 2
-    bn = min(bn, _round_up(max(n, 1), 128))
-    return bm, bn, _round_up(m, bm), _round_up(n, bn)
+    """(bm, bn, m_pad, n_pad): the shared k-blocked f32 accumulator
+    plan (`pallas_tiles.matmul_accum_blocks`) at this dtype."""
+    return matmul_accum_blocks(m, k, n, dtype)
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
@@ -426,12 +421,7 @@ def _me_int8_blocks(m, k, n, x_dtype):
     ceiling is driven by the double-buffered (K, bn) weight block at
     1 byte/element, so bn can run wider than the float kernel's; bm
     still follows the ACTIVATION dtype (x is not int8)."""
-    bm = min(_round_up(max(m, 1), _min_rows(x_dtype)), 128)
-    bn = 512
-    while bn > 128 and 2 * k * bn * 1 > (6 << 20):
-        bn //= 2
-    bn = min(bn, _round_up(max(n, 1), 128))
-    return bm, bn, _round_up(m, bm), _round_up(n, bn)
+    return matmul_accum_blocks(m, k, n, x_dtype, weight_itemsize=1)
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(4,))

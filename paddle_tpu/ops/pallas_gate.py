@@ -149,6 +149,24 @@ def _probe_matmul_epilogue_int8():
     jax.block_until_ready(fn(x, s, b))
 
 
+def _probe_grouped_matmul():
+    from . import pallas_grouped as pg
+    from . import pallas_tiles as pt
+    E, K, N, tokens = 2, 128, 256, 48
+    bm, nb, rows = pg.grouped_layout(tokens, E, jnp.bfloat16)
+    gid, _ = pt.group_segments(jnp.array([tokens - 16, 16], jnp.int32),
+                               bm, nb)
+    x = jnp.zeros((rows, K), jnp.bfloat16)
+    w = jnp.ones((E, K, N), jnp.bfloat16)
+    b = jnp.zeros((E, N), jnp.bfloat16)
+    fn = jax.jit(jax.grad(
+        lambda x, w, b: pg.grouped_linear_act(
+            x, w, b, block_group=gid,
+            act="gelu_tanh").astype(jnp.float32).sum(),
+        argnums=(0, 1, 2)))
+    jax.block_until_ready(fn(x, w, b))
+
+
 def _probe_paged_attention():
     from . import pallas_kernels as pk
     q = jnp.zeros((2, 1, 2, 64), jnp.float32)
@@ -200,6 +218,7 @@ _PROBES = {
     "ragged_attention_int8": _probe_ragged_attention_int8,
     "layer_norm": _probe_layer_norm,
     "layer_norm_residual": _probe_layer_norm_residual,
+    "grouped_matmul": _probe_grouped_matmul,
     "matmul_epilogue": _probe_matmul_epilogue,
     "matmul_epilogue_int8": _probe_matmul_epilogue_int8,
     "rms_norm": _probe_rms_norm,
@@ -235,6 +254,13 @@ def _static_diagnose(kernel):
         for direction in ("fwd", "bwd"):
             diags.extend(tiling.audit_layer_norm_residual(
                 32, 256, dtype=jnp.bfloat16, direction=direction))
+        return diags
+    if kernel == "grouped_matmul":
+        diags = []
+        for direction in ("fwd", "bwd_dw"):
+            diags.extend(tiling.audit_grouped_matmul(
+                48, 128, 256, 2, dtype=jnp.bfloat16,
+                direction=direction))
         return diags
     if kernel == "matmul_epilogue":
         diags = []

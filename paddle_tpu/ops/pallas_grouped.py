@@ -1,0 +1,355 @@
+"""Grouped-expert matmul Pallas kernel: the MoE dropless-dispatch GEMM.
+
+One kernel computes ``act(x @ w[e] + b[e])`` for every expert ``e`` in a
+single pass over a flat, block-aligned token buffer
+
+    x: [R, K]      R = num_blocks * block_rows
+
+where each expert owns a run of whole ``block_rows``-row blocks (the
+dropless router pads every expert's token count up to a block multiple,
+exactly like the serving engine's ragged q-blocks).  One scalar array
+describes the grouped layout:
+
+    block_group[i]   which expert owns block ``i``
+                     (``num_experts`` = null block: all rows padding)
+
+built by `pallas_tiles.group_segments` from the per-expert token
+counts.  The scalar-prefetched descriptor drives the weight/bias
+BlockSpec index maps — the same machinery `pallas_ragged.py` uses to
+route q-blocks through per-sequence block tables — while the matmul
+itself is matmul-epilogue's full-K f32 accumulator
+(`pallas_tiles.matmul_accum_blocks`): resident (block_rows, K) token
+rows, N split under the VMEM weight-block budget.
+
+The backward runs three pieces: ``dz = g * act'(z)`` elementwise in
+XLA (exact, saved pre-activation), ``dx`` through this same kernel
+with the transposed expert weights, and ``dw`` through a dedicated
+grouped-accumulation kernel whose output block index map follows
+``block_group`` — consecutive same-expert programs accumulate into one
+revisited (1, bk, bn) block, the sequential-grid pattern of the LN
+dgamma reduction.  ``db`` is a segment-sum in XLA.
+
+`grouped_linear_act_ref` is the bit-exact XLA composite (same
+per-block full-K f32 dots, same epilogue order) callers fall back to
+when the gate disables the kernel.  Gated through ``pallas_gate``
+("grouped_matmul" probe); `grouped_matmul_block_plan` exports the
+exact specs for `analysis.tiling.audit_grouped_matmul` / tpu_lint.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from .pallas_fused import ACTIVATIONS, _act_f32, _act_grad_f32
+from .pallas_tiles import (_demote_f64, _interpret, _kernel_span,
+                           _min_rows, _pad_dim, _round_up, _x32,
+                           group_segments, matmul_accum_blocks,
+                           num_group_blocks)
+
+__all__ = [
+    "grouped_block_rows",
+    "grouped_layout",
+    "grouped_linear_act",
+    "grouped_linear_act_ref",
+    "grouped_matmul_block_plan",
+]
+
+
+def grouped_block_rows(tokens, num_experts, dtype) -> int:
+    """Rows per grouped block: adapts to the expected per-expert load
+    (small decode batches must not pay a 128-row pad per expert) while
+    staying a legal Mosaic sublane multiple, capped at one MXU height."""
+    per = -(-max(int(tokens), 1) // max(int(num_experts), 1))
+    return min(128, _round_up(per, _min_rows(jnp.dtype(dtype))))
+
+
+def grouped_layout(tokens, num_experts, dtype):
+    """(block_rows, num_blocks, rows): the static padded grouped layout
+    for ``tokens`` dispatched rows across ``num_experts`` experts.  The
+    router and the kernel must agree on this — routing scatters into
+    ``rows`` flat rows, the kernel walks ``num_blocks`` blocks."""
+    bm = grouped_block_rows(tokens, num_experts, dtype)
+    nb = num_group_blocks(int(tokens), int(num_experts), bm)
+    return bm, nb, nb * bm
+
+
+def _gmm_fwd_kernel(gid_ref, x_ref, w_ref, b_ref, o_ref, z_ref, *, act):
+    """One (block, n-block) program: full-K f32 dot against the owning
+    expert's weight slice (gid routes the index map; the kernel body
+    never branches on it — null blocks hit the appended zero expert)."""
+    z = jax.lax.dot_general(
+        x_ref[:].astype(jnp.float32), w_ref[0].astype(jnp.float32),
+        (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)             # (bm, bn)
+    z = z + b_ref[0].astype(jnp.float32)
+    z_ref[:] = z.astype(z_ref.dtype)
+    o_ref[:] = _act_f32(z, act).astype(o_ref.dtype)
+
+
+@_x32
+def _gmm_call(xp, wp, bp, gid, act, bm, bn, direction):
+    """Dispatch the grouped matmul pallas_call.  xp: [R, K] grouped
+    rows; wp: [E+1, K, n_pad] (zero null expert appended); bp:
+    [E+1, 1, n_pad]; gid: [R // bm] int32 block descriptors."""
+    R, K = xp.shape
+    n_pad = wp.shape[2]
+    nb = R // bm
+    with _kernel_span("grouped_matmul", direction):
+        out, z = pl.pallas_call(
+            functools.partial(_gmm_fwd_kernel, act=act),
+            grid_spec=pltpu.PrefetchScalarGridSpec(
+                num_scalar_prefetch=1,
+                grid=(nb, n_pad // bn),
+                in_specs=[
+                    pl.BlockSpec((bm, K), lambda i, j, gid: (i, 0)),
+                    pl.BlockSpec((1, K, bn),
+                                 lambda i, j, gid: (gid[i], 0, j)),
+                    pl.BlockSpec((1, 1, bn),
+                                 lambda i, j, gid: (gid[i], 0, j)),
+                ],
+                out_specs=[
+                    pl.BlockSpec((bm, bn), lambda i, j, gid: (i, j)),
+                    pl.BlockSpec((bm, bn), lambda i, j, gid: (i, j)),
+                ],
+            ),
+            out_shape=[
+                jax.ShapeDtypeStruct((R, n_pad), xp.dtype),
+                jax.ShapeDtypeStruct((R, n_pad), xp.dtype),
+            ],
+            interpret=_interpret(),
+        )(gid, xp, wp, bp)
+    return out, z
+
+
+def _gmm_dw_kernel(gid_ref, x_ref, dz_ref, dw_ref):
+    """dw[e] += x_blk^T @ dz_blk: the block dim is innermost, so for a
+    fixed (k-block, n-block) the programs of one expert are consecutive
+    and the revisited (1, bk, bn) output block accumulates sequentially
+    (LN-dgamma pattern); a new expert's first visit re-initialises."""
+    m = pl.program_id(2)
+    e = gid_ref[m]
+    prev = gid_ref[jnp.maximum(m - 1, 0)]
+
+    @pl.when(jnp.logical_or(m == 0, e != prev))
+    def _init():
+        dw_ref[...] = jnp.zeros_like(dw_ref)
+
+    dw_ref[...] += jax.lax.dot_general(
+        x_ref[:].astype(jnp.float32), dz_ref[:].astype(jnp.float32),
+        (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)[None]       # (1, bk, bn)
+
+
+def _gmm_dw_blocks(k, n, dtype):
+    """(bk, bn, k_pad, n_pad) for the dw accumulation: both weight dims
+    are output dims here, split on the same VMEM-budgeted lane grid."""
+    bk = min(_round_up(max(k, 1), 128), 512)
+    _, bn, _, n_pad = matmul_accum_blocks(8, k, n, dtype)
+    return bk, bn, _round_up(k, bk), n_pad
+
+
+@_x32
+def _gmm_dw_call(xp, dzp, gid, num_experts, bm, bk, bn):
+    R, k_pad = xp.shape
+    n_pad = dzp.shape[1]
+    nb = R // bm
+    with _kernel_span("grouped_matmul", "bwd_dw"):
+        dw = pl.pallas_call(
+            _gmm_dw_kernel,
+            grid_spec=pltpu.PrefetchScalarGridSpec(
+                num_scalar_prefetch=1,
+                grid=(k_pad // bk, n_pad // bn, nb),
+                in_specs=[
+                    pl.BlockSpec((bm, bk),
+                                 lambda kb, nb_, m, gid: (m, kb)),
+                    pl.BlockSpec((bm, bn),
+                                 lambda kb, nb_, m, gid: (m, nb_)),
+                ],
+                out_specs=pl.BlockSpec(
+                    (1, bk, bn),
+                    lambda kb, nb_, m, gid: (gid[m], kb, nb_)),
+            ),
+            out_shape=jax.ShapeDtypeStruct(
+                (num_experts + 1, k_pad, n_pad), jnp.float32),
+            interpret=_interpret(),
+        )(gid, xp, dzp)
+    return dw
+
+
+def _stacked_pad(w, b, n_pad):
+    """Append the zero null expert and pad N: wp [E+1, K, n_pad],
+    bp [E+1, 1, n_pad]."""
+    E, K, N = w.shape
+    wp = _pad_dim(jnp.concatenate(
+        [w, jnp.zeros((1, K, N), w.dtype)], axis=0), 2, n_pad)
+    bp = _pad_dim(jnp.concatenate(
+        [b, jnp.zeros((1, N), b.dtype)], axis=0), 1, n_pad)[:, None, :]
+    return wp, bp
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4,))
+def _grouped_2d(x, w, b, gid, act):
+    return _grouped_2d_fwd(x, w, b, gid, act)[0]
+
+
+def _grouped_2d_fwd(x, w, b, gid, act):
+    R, K = x.shape
+    E, _, N = w.shape
+    bm = R // gid.shape[0]
+    _, bn, _, n_pad = matmul_accum_blocks(bm, K, N, x.dtype)
+    wp, bp = _stacked_pad(w, b, n_pad)
+    out, z = _gmm_call(x, wp, bp, gid, act, bm, bn, "fwd")
+    return out[:, :N], (x, w, b, gid, z[:, :N])
+
+
+def _grouped_2d_bwd(act, res, g):
+    x, w, b, gid, z = res
+    R, K = x.shape
+    E, _, N = w.shape
+    bm = R // gid.shape[0]
+    # epilogue backward: elementwise in XLA on the saved pre-activation
+    dz32 = g.astype(jnp.float32) * _act_grad_f32(z.astype(jnp.float32),
+                                                 act)
+    dz = dz32.astype(x.dtype)
+    # dx rides the SAME grouped kernel with transposed expert weights
+    # (contraction over N, output K); bias zeros, identity epilogue
+    wt = jnp.swapaxes(w, 1, 2)                          # [E, N, K]
+    _, bn2, _, k_pad = matmul_accum_blocks(bm, N, K, x.dtype)
+    wtp, btp = _stacked_pad(wt, jnp.zeros((E, K), x.dtype), k_pad)
+    dx_pad, _ = _gmm_call(dz, wtp, btp, gid, "none", bm, bn2, "bwd_dx")
+    dx = dx_pad[:, :K].astype(x.dtype)
+    # dw through the grouped-accumulation kernel
+    bk, bn, k_pad2, n_pad = _gmm_dw_blocks(K, N, x.dtype)
+    dw_full = _gmm_dw_call(_pad_dim(x, 1, k_pad2), _pad_dim(dz, 1, n_pad),
+                           gid, E, bm, bk, bn)
+    # experts that own zero blocks were never visited: their output
+    # blocks are uninitialised — mask them to exact zeros
+    blocks_per = jax.ops.segment_sum(
+        jnp.ones_like(gid), gid, num_segments=E + 1)[:E]
+    dw = jnp.where((blocks_per > 0)[:, None, None],
+                   dw_full[:E, :K, :N], 0.0).astype(w.dtype)
+    # db: per-expert row segment-sum (padding rows carry zero cotangent)
+    row_gid = jnp.repeat(gid, bm)
+    db = jax.ops.segment_sum(
+        dz32, row_gid, num_segments=E + 1)[:E].astype(b.dtype)
+    return dx, dw, db, np.zeros(gid.shape, dtype=jax.dtypes.float0)
+
+
+_grouped_2d.defvjp(_grouped_2d_fwd, _grouped_2d_bwd)
+
+
+def _check_layout(x, w, b, block_group):
+    E, K, N = w.shape
+    R = x.shape[0]
+    nb = block_group.shape[0]
+    if x.shape[1] != K:
+        raise ValueError(f"x K={x.shape[1]} vs w K={K}")
+    if R % nb:
+        raise ValueError(
+            f"{R} grouped rows not divisible by {nb} block descriptors")
+    bm = R // nb
+    if bm % _min_rows(x.dtype):
+        raise ValueError(
+            f"block_rows {bm} is not a {jnp.dtype(x.dtype).name} "
+            f"sublane multiple ({_min_rows(x.dtype)})")
+    if b is not None and tuple(b.shape) != (E, N):
+        raise ValueError(f"b shape {b.shape} != ({E}, {N})")
+
+
+def grouped_linear_act(x, w, b=None, *, block_group, act="none"):
+    """``act(x @ w[e] + b[e])`` over block-aligned grouped rows; the
+    Pallas path (interpret mode off-TPU); differentiable in x, w, b.
+
+    x: [R, K] rows in grouped layout (R = num_blocks * block_rows,
+    padding rows zero); w: [E, K, N] stacked expert weights; b: [E, N]
+    or None; block_group: [num_blocks] int32 from
+    `pallas_tiles.group_segments` (``E`` marks a null block).
+    Padding-row outputs are garbage-free but meaningless — callers
+    gather only the dispatched rows back out.
+    """
+    if act not in ACTIVATIONS:
+        raise ValueError(f"act must be one of {ACTIVATIONS}, got {act!r}")
+    x, w, b = _demote_f64(x, w, b)
+    E, K, N = w.shape
+    if b is None:
+        b = jnp.zeros((E, N), x.dtype)
+    _check_layout(x, w, b, block_group)
+    return _grouped_2d(x, w, b.astype(x.dtype),
+                       block_group.astype(jnp.int32), act)
+
+
+def grouped_linear_act_ref(x, w, b=None, *, block_group, act="none"):
+    """XLA composite of `grouped_linear_act`: the same per-block
+    full-K f32 dots (batched over blocks) and the same epilogue order —
+    the dispatch fallback when the gate is off, and the parity
+    reference for the kernel tests.  Numerically equivalent to the
+    kernel within dot reduction order (the blocks batch into one 3D
+    dot here): a few f32 ULP, never a tolerance-visible gap."""
+    if act not in ACTIVATIONS:
+        raise ValueError(f"act must be one of {ACTIVATIONS}, got {act!r}")
+    x, w, b = _demote_f64(x, w, b)
+    E, K, N = w.shape
+    if b is None:
+        b = jnp.zeros((E, N), x.dtype)
+    _check_layout(x, w, b, block_group)
+    gid = block_group.astype(jnp.int32)
+    nb = gid.shape[0]
+    bm = x.shape[0] // nb
+    wp = jnp.concatenate([w, jnp.zeros((1, K, N), w.dtype)], axis=0)
+    bp = jnp.concatenate(
+        [b.astype(x.dtype), jnp.zeros((1, N), x.dtype)], axis=0)
+    xb = x.reshape(nb, bm, K).astype(jnp.float32)
+    wg = wp[gid].astype(jnp.float32)                    # [nb, K, N]
+    z = jax.lax.dot_general(
+        xb, wg, (((2,), (1,)), ((0,), (0,))),
+        preferred_element_type=jnp.float32)
+    z = z + bp[gid][:, None, :].astype(jnp.float32)
+    return _act_f32(z, act).reshape(nb * bm, N).astype(x.dtype)
+
+
+def grouped_matmul_block_plan(tokens, k, n, num_experts,
+                              dtype=jnp.float32, direction="fwd"):
+    """The exact block plan the grouped matmul uses for ``tokens``
+    dispatched rows.  Same contract as `flash_block_plan`; the scalar-
+    prefetched ``block_group`` descriptor is untiled and omitted, like
+    `ragged_block_plan`'s tables.
+
+    ``direction`` selects ``"fwd"`` (`_gmm_call`, also the shape of the
+    dx pass with k/n swapped) or ``"bwd_dw"`` (`_gmm_dw_call`).
+    """
+    dtype = jnp.dtype(dtype)
+    f32 = jnp.dtype(jnp.float32)
+    bm, nb, rows = grouped_layout(tokens, num_experts, dtype)
+    E = num_experts
+    base = {"direction": direction, "block_rows": bm, "num_blocks": nb,
+            "scratch": ()}
+    if direction == "fwd":
+        _, bn, _, n_pad = matmul_accum_blocks(bm, k, n, dtype)
+        base["grid"] = (nb, n_pad // bn)
+        base["block_n"] = bn
+        base["operands"] = [
+            ("x", (bm, k), (rows, k), dtype),
+            ("w", (1, k, bn), (E + 1, k, n_pad), dtype),
+            ("b", (1, 1, bn), (E + 1, 1, n_pad), dtype),
+            ("out", (bm, bn), (rows, n_pad), dtype),
+            ("z", (bm, bn), (rows, n_pad), dtype),
+        ]
+    elif direction == "bwd_dw":
+        bk, bn, k_pad, n_pad = _gmm_dw_blocks(k, n, dtype)
+        base["grid"] = (k_pad // bk, n_pad // bn, nb)
+        base["block_k"] = bk
+        base["block_n"] = bn
+        base["operands"] = [
+            ("x", (bm, bk), (rows, k_pad), dtype),
+            ("dz", (bm, bn), (rows, n_pad), dtype),
+            ("dw", (1, bk, bn), (E + 1, k_pad, n_pad), f32),
+        ]
+    else:
+        raise ValueError(
+            f"direction must be fwd|bwd_dw, got {direction!r}")
+    return base

@@ -60,91 +60,15 @@ __all__ = [
     "paged_block_plan",
 ]
 
-_NEG_INF = -1e30
-_STAT_LANES = 8  # trailing lane dim for per-row stat arrays (see module doc)
-
-try:
-    from jax._src.config import enable_x64 as _enable_x64_ctx
-except ImportError:  # pragma: no cover - fallback for jax API moves
-    import contextlib
-
-    @contextlib.contextmanager
-    def _enable_x64_ctx(value):
-        old = jax.config.jax_enable_x64
-        jax.config.update("jax_enable_x64", value)
-        try:
-            yield
-        finally:
-            jax.config.update("jax_enable_x64", old)
-
-
-def _x32(fn):
-    """Trace the wrapped pallas_call builder under x32 semantics.
-
-    The framework enables jax_enable_x64 globally (paddle_tpu/__init__.py)
-    for Paddle's int64/float64 tensor semantics.  Under x64, Pallas
-    index-map literals and in-kernel weak ints trace as i64, which Mosaic
-    cannot legalize ("failed to legalize func.return (i32, i64)") and
-    whose int64 converts send Mosaic's _convert_helper into infinite
-    recursion — this was the root cause of ALL four round-2 kernel
-    failures on hardware.  Every dtype inside the kernels is explicit
-    (f32/bf16/i32), so tracing them x32 changes nothing numerically.
-    """
-    @functools.wraps(fn)
-    def wrapper(*args, **kwargs):
-        with _enable_x64_ctx(False):
-            return fn(*args, **kwargs)
-    return wrapper
-
-
-def _interpret() -> bool:
-    return jax.default_backend() != "tpu"
-
-
-def _kernel_span(name: str, direction: str):
-    """Timeline span around one pallas_call build+dispatch.
-
-    Spans land in the ``kernel`` category so `phase_breakdown()` can
-    attribute step time per kernel and direction
-    (``kernel_<name>_<direction>_ms``).  The timeline returns a no-op
-    singleton when observability is disabled, so this costs one global
-    read on the hot path.
-    """
-    from ..observability.timeline import span
-    return span(f"kernel:{name}.{direction}", cat="kernel")
-
-
-def _round_up(x: int, m: int) -> int:
-    return ((x + m - 1) // m) * m
-
-
-def _pad_dim(x, dim, target, value=0.0):
-    pad = target - x.shape[dim]
-    if pad == 0:
-        return x
-    widths = [(0, 0)] * x.ndim
-    widths[dim] = (0, pad)
-    # dtype-matched fill: a python float is a strong f64 under the
-    # framework's global x64 mode and would promote the padded array
-    return jnp.pad(x, widths, constant_values=jnp.asarray(value, x.dtype))
-
-
-def _lanes(x2d):
-    """Broadcast a (rows,) or (rows, 1) stat to the stat-lane layout."""
-    if x2d.ndim == 1:
-        x2d = x2d[:, None]
-    return jnp.broadcast_to(x2d, x2d.shape[:-1] + (_STAT_LANES,))
-
-
-def _demote_f64(*xs):
-    """TPU has no float64: demote f64 inputs to f32 (grad flows back
-    through the cast).  The global x64 mode (paddle_tpu/__init__.py)
-    makes f64 a reachable input dtype on the CPU test path."""
-    return tuple(
-        x.astype(jnp.float32) if x is not None
-        and jnp.issubdtype(x.dtype, jnp.floating)
-        and jnp.dtype(x.dtype).itemsize == 8 else x
-        for x in xs)
+# Shared tile primitives (see ops/pallas_tiles.py): tracing policy,
+# dtype-aware block picking, stat-lane layout, padding.  These names are
+# re-exported here so downstream `from .pallas_kernels import _x32, ...`
+# keeps binding the SAME objects — the refactor's bit-identity contract.
+from .pallas_tiles import (_NEG_INF, _STAT_LANES, _demote_f64,
+                           _interpret, _kernel_span, _lanes,
+                           _ln_block_rows, _min_rows, _pad_dim,
+                           _round_up, _sane_block, _x32, _xent_blocks,
+                           softmax_scratch, stat_scratch)
 
 
 # =====================================================================
@@ -424,23 +348,6 @@ def set_flash_block_sizes(block_q=None, block_k=None):
 _block_override = (None, None)
 
 
-def _min_rows(dtype) -> int:
-    """Mosaic minimum sublane rows for `dtype`: 8 for 4-byte, 16 for
-    2-byte (bf16/f16), 32 for 1-byte tiles."""
-    return {1: 32, 2: 16}.get(jnp.dtype(dtype).itemsize, 8)
-
-
-def _sane_block(b, seq, min_rows=16):
-    """Clamp any requested block to a legal tiling for `seq`/`dtype`."""
-    try:
-        b = int(b)
-    except (TypeError, ValueError):
-        return None
-    if b < min_rows or b % min_rows:
-        return None
-    return min(b, _round_up(max(seq, min_rows), min_rows))
-
-
 def _pick_block(seq: int, which: int = 0, dtype=jnp.float32) -> int:
     """Q/K block rows for `seq`: legal by construction for `dtype`
     (sublane multiple of _min_rows), covering `seq` after _round_up
@@ -656,13 +563,6 @@ def _ln_bwd_kernel(x_ref, g_ref, mu_ref, rstd_ref, do_ref,
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
 def _fused_layer_norm_2d(x, gamma, beta, eps):
     return _fused_layer_norm_2d_fwd(x, gamma, beta, eps)[0]
-
-
-def _ln_block_rows(rows, n, itemsize=4):
-    # keep a block under ~2MB of f32 VMEM working set; 16-row multiples
-    # keep bf16 blocks on whole (16, 128) tiles
-    budget = max(1, (2 << 20) // max(n * itemsize, 1))
-    return min(_round_up(rows, 16), max(16, min(512, _round_up(budget, 16))))
 
 
 @_x32
@@ -912,13 +812,6 @@ def _xent_bwd_kernel(x_ref, lbl_ref, lse_ref, g_ref, dx_ref, *, block_v):
     dx_ref[:] = dx.astype(dx_ref.dtype)
 
 
-def _xent_blocks(rows, v):
-    """(block_rows, block_v, rows_pad, v_pad) with bounded VMEM."""
-    bv = min(_round_up(v, 128), 2048)
-    br = min(_round_up(rows, 16), 256)
-    return br, bv, _round_up(rows, br), _round_up(v, bv)
-
-
 @jax.custom_vjp
 def _fused_xent_2d(logits, labels):
     return _fused_xent_2d_fwd(logits, labels)[0]
@@ -948,11 +841,7 @@ def _fused_xent_2d_fwd(logits, labels):
             jax.ShapeDtypeStruct((rows_pad, _STAT_LANES), jnp.float32),
             jax.ShapeDtypeStruct((rows_pad, _STAT_LANES), jnp.float32),
         ],
-        scratch_shapes=[
-            pltpu.VMEM((br, _STAT_LANES), jnp.float32),
-            pltpu.VMEM((br, _STAT_LANES), jnp.float32),
-            pltpu.VMEM((br, _STAT_LANES), jnp.float32),
-        ],
+        scratch_shapes=stat_scratch(br, 3),
         interpret=_interpret(),
     )(xp, lp)
     return loss[:rows, 0], (logits, labels, lse[:rows])
@@ -1112,11 +1001,7 @@ def paged_attention(q, k_pool, v_pool, block_tables, context_lens,
             ],
             out_specs=pl.BlockSpec((1, 1, 1, D),
                                    lambda b, h, w, bt, cl: (b, h, 0, 0)),
-            scratch_shapes=[
-                pltpu.VMEM((_STAT_LANES, D), jnp.float32),
-                pltpu.VMEM((_STAT_LANES, _STAT_LANES), jnp.float32),
-                pltpu.VMEM((_STAT_LANES, _STAT_LANES), jnp.float32),
-            ],
+            scratch_shapes=softmax_scratch(_STAT_LANES, D),
         ),
         out_shape=jax.ShapeDtypeStruct((B, H, 1, D), q.dtype),
         interpret=_interpret(),

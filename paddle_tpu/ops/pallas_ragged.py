@@ -48,9 +48,9 @@ import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from .pallas_kernels import (_NEG_INF, _STAT_LANES, _demote_f64,
-                             _interpret, _kernel_span, _lanes, _min_rows,
-                             _x32)
+from .pallas_tiles import (_NEG_INF, _STAT_LANES, _demote_f64,
+                           _interpret, _kernel_span, _lanes, _min_rows,
+                           _x32, softmax_scratch)
 
 __all__ = ["ragged_paged_attention", "ragged_block_plan",
            "ragged_q_block", "ragged_segments", "KV_SCALE_LANES"]
@@ -287,11 +287,7 @@ def ragged_paged_attention(q, k_pool, v_pool, block_tables, context_lens,
                 out_specs=pl.BlockSpec(
                     (1, block_q, D),
                     lambda i, h, w, bt, cl, sid, qs, qv: (h, i, 0)),
-                scratch_shapes=[
-                    pltpu.VMEM((block_q, D), jnp.float32),
-                    pltpu.VMEM((block_q, _STAT_LANES), jnp.float32),
-                    pltpu.VMEM((block_q, _STAT_LANES), jnp.float32),
-                ],
+                scratch_shapes=softmax_scratch(block_q, D),
             ),
             out_shape=jax.ShapeDtypeStruct((H, T, D), q.dtype),
             interpret=_interpret(),

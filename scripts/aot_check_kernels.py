@@ -29,15 +29,19 @@ import jax.numpy as jnp
 from jax.experimental import topologies
 
 import paddle_tpu.ops.pallas_fused as pf
+import paddle_tpu.ops.pallas_grouped as pgm
 import paddle_tpu.ops.pallas_kernels as pk
 import paddle_tpu.ops.pallas_ragged as pr
+import paddle_tpu.ops.pallas_tiles as pt
 
 # lower the non-interpret (Mosaic) path even though we trace on CPU
-# (pallas_fused/pallas_ragged bind _interpret by value at import —
-# patch all three)
+# (each kernel module binds _interpret by value at import — patch every
+# module's own global, including the shared tile layer)
 pk._interpret = lambda: False
 pf._interpret = lambda: False
 pr._interpret = lambda: False
+pgm._interpret = lambda: False
+pt._interpret = lambda: False
 
 TOPOLOGY = os.environ.get("PADDLE_TPU_AOT_TOPOLOGY", "v5e:2x2x1")
 topo = topologies.get_topology_desc(TOPOLOGY, "tpu")
@@ -116,6 +120,20 @@ for tag, (m, k, n) in [("bert_ffn", (768, 768, 3072)),
             x, w, s, b, "gelu_tanh").astype(f32).sum(),
             argnums=(0, 2, 3)),
         ((m, k), bf16), ((k, n), jnp.int8), ((n,), f32), ((n,), bf16))
+
+# grouped-expert matmul (MoE dropless dispatch): scalar-prefetched
+# block_group descriptors route whole token blocks to per-expert weight
+# slices; fwd + full backward (dx via kernel reuse, dw accumulation)
+for tag, dt in [("f32", f32), ("bf16", bf16)]:
+    E, K, N, tokens = 8, 768, 3072, 1024
+    bm, nb, rows = pgm.grouped_layout(tokens, E, dt)
+    gid = jnp.zeros((nb,), i32)
+    ok &= aot_compile(
+        f"grouped_matmul fwd+bwd {tag}",
+        jax.grad(lambda x, w, b: pgm.grouped_linear_act(
+            x, w, b, block_group=gid,
+            act="gelu_tanh").astype(f32).sum(), argnums=(0, 1, 2)),
+        ((rows, K), dt), ((E, K, N), dt), ((E, N), dt))
 
 # paged decode attention (scalar-prefetched block tables): the index
 # maps trace at lower time outside the _x32 scope, which is exactly

@@ -39,13 +39,15 @@ ROOT = Path(__file__).resolve().parent.parent
 sys.path.insert(0, str(ROOT))
 
 GATE_SUFFIXES = ("_tokens_per_sec", "_imgs_per_sec", "_accept_rate",
-                 "_hit_rate", "_hidden_ratio")
+                 "_hit_rate", "_hidden_ratio", "_overlap_ratio")
 #: lower-is-better latency metrics: a RISE beyond the threshold fails
 #: (note: "_failover_recovery_ms" does NOT match "_failover_ms" — the
-#: cluster drill's recovery metric gates separately from the DP one)
+#: cluster drill's recovery metric gates separately from the DP one;
+#: "_expert_imbalance" is the MoE routing gauge — hotter routing means
+#: padded grouped blocks, so a rise gates like a latency regression)
 LOW_SUFFIXES = ("_p99_ttft_ms", "_p99_tpot_ms", "_failover_recovery_ms",
                 "_shed_rate", "_elastic_recovery_ms", "_failover_ms",
-                "_stall_ms")
+                "_stall_ms", "_expert_imbalance")
 #: quality-parity metrics (int8 greedy match vs float): ZERO tolerance
 #: — ANY drop below last-good refuses the capture, threshold ignored
 QUALITY_SUFFIXES = ("_greedy_match",)

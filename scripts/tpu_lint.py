@@ -11,6 +11,9 @@ Lints the bundled models without needing a TPU:
   * **bert**  — static-graph MLM step (AMP bf16) through
     ``Executor.analyze_program`` (the fingerprint-cache path);
   * **gpt**   — static-graph causal-LM step (AMP bf16 + recompute);
+  * **moe**   — bundled moe_gpt routing balance at init (TPU508),
+    capacity-router headroom at the measured skew (TPU507), and the
+    grouped expert matmul's block plans vs the Mosaic tiling rules;
   * **pallas** — flash / paged attention block plans checked against the
     Mosaic tiling rules (``analysis.tiling``), no kernel launch;
   * **sharding** — built-in BERT/GPT partition-rule sets audited against
@@ -38,7 +41,7 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(
 
 import numpy as np  # noqa: E402
 
-MODELS = ("lenet", "bert", "gpt", "pallas", "sharding", "fabric",
+MODELS = ("lenet", "bert", "gpt", "moe", "pallas", "sharding", "fabric",
           "faults")
 
 
@@ -142,6 +145,66 @@ def lint_gpt():
         return feed, [loss]
 
     return _lint_static(build)
+
+
+def lint_moe():
+    """MoE subsystem lint: measured routing balance of the bundled
+    moe_gpt at init (TPU508), capacity headroom of the incubate
+    capacity router at that measured skew (TPU507), and the grouped
+    expert matmul's block plans vs the Mosaic tiling rules — all
+    CPU-only, no expert matmul is launched for the plan checks."""
+    import jax.numpy as jnp
+    import paddle_tpu as paddle
+    from paddle_tpu import analysis
+    from paddle_tpu.analysis.diagnostics import DiagnosticReport, record
+    from paddle_tpu.analysis.moe_audit import (audit_expert_capacity,
+                                               audit_routing_balance)
+    from paddle_tpu.models import MoEGPTConfig, MoEGPTForCausalLM
+    from paddle_tpu.models.moe_gpt import _moe_mlp_compute
+    from paddle_tpu.ops.pallas_grouped import grouped_block_rows
+
+    paddle.disable_static()
+    paddle.seed(0)
+    cfg = MoEGPTConfig(vocab_size=256, hidden_size=128,
+                       num_hidden_layers=2, num_attention_heads=2,
+                       use_flash_attention=False,
+                       max_position_embeddings=128,
+                       num_experts=4, top_k=2)
+    model = MoEGPTForCausalLM(cfg)
+    report = DiagnosticReport(label="moe routing + grouped plans")
+    rng = np.random.default_rng(3)
+    tokens = 512
+    x = jnp.asarray(rng.standard_normal(
+        (tokens, cfg.hidden_size)).astype(np.float32))
+    bm = grouped_block_rows(tokens * cfg.top_k, cfg.num_experts,
+                            jnp.float32)
+    worst = 1.0
+    for i, blk in enumerate(model.gpt.h):
+        mlp = blk.mlp
+        _, _, counts = _moe_mlp_compute(
+            x, mlp.router._value, mlp.w1._value, mlp.b1._value,
+            mlp.w2._value, mlp.b2._value, top_k=cfg.top_k,
+            num_experts=cfg.num_experts, act="gelu_tanh")
+        counts = np.asarray(counts)
+        worst = max(worst, counts.max() / max(counts.mean(), 1.0))
+        audit_routing_balance(counts, block_rows=bm,
+                              site=f"moe_gpt.h.{i}.mlp",
+                              report=report)
+    # the incubate capacity router at its default factor must hold the
+    # skew the bundled router actually shows at init
+    cap = max(int(1.2 * tokens * cfg.top_k / cfg.num_experts), 1)
+    audit_expert_capacity(tokens, cfg.num_experts, cfg.top_k, cap,
+                          imbalance=worst,
+                          site="incubate.moe_layer[capacity_factor=1.2]",
+                          report=report)
+    for dtype in (jnp.float32, jnp.bfloat16):
+        for direction in ("fwd", "bwd_dw"):
+            r = analysis.audit_grouped_matmul(
+                1024, 768, 3072, 8, dtype=dtype, direction=direction)
+            report.extend(r.diagnostics)
+    for d in report.diagnostics:
+        record(d)
+    return report
 
 
 def lint_pallas():
@@ -297,8 +360,9 @@ def lint_faults():
 
 
 LINTERS = {"lenet": lint_lenet, "bert": lint_bert, "gpt": lint_gpt,
-           "pallas": lint_pallas, "sharding": lint_sharding,
-           "fabric": lint_fabric, "faults": lint_faults}
+           "moe": lint_moe, "pallas": lint_pallas,
+           "sharding": lint_sharding, "fabric": lint_fabric,
+           "faults": lint_faults}
 
 
 def run_models(names):

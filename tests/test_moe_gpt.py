@@ -1,0 +1,250 @@
+"""The bundled moe_gpt model end to end: dense-twin parity (every
+expert initialised to the dense MLP makes the renormalised top-k mix a
+no-op), expert parallelism on the forced 8-device host mesh, elastic
+shrink over ep, the TPU507/TPU508 routing audits, and serving through
+the unified ragged engine across scheduler preemption."""
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+import paddle_tpu.distributed as dist
+from paddle_tpu.models import (GPTConfig, GPTForCausalLM, MoEGPTConfig,
+                               MoEGPTForCausalLM,
+                               MoEGPTPretrainingCriterion)
+
+KW = dict(vocab_size=97, hidden_size=64, num_hidden_layers=2,
+          num_attention_heads=4, intermediate_size=128,
+          max_position_embeddings=64)
+
+
+@pytest.fixture(autouse=True)
+def _no_mesh():
+    yield
+    dist.env.set_global_mesh(None)
+
+
+def _twins(seed=0, E=4, k=2):
+    """A dense GPT and an MoE GPT with identical math: shared params
+    copied by name, every expert loaded with the dense MLP weights, so
+    the renormalised top-k weights (summing to 1) reproduce the dense
+    block output."""
+    paddle.seed(seed)
+    dense = GPTForCausalLM(GPTConfig(**KW))
+    moe = MoEGPTForCausalLM(MoEGPTConfig(num_experts=E, top_k=k, **KW))
+    dp = dict(dense.named_parameters())
+    for name, p in moe.named_parameters():
+        if name in dp:
+            p._value = dp[name]._value
+    for blk_d, blk_m in zip(dense.gpt.h, moe.gpt.h):
+        blk_m.mlp.w1._value = jnp.stack([blk_d.mlp.fc1.weight._value] * E)
+        blk_m.mlp.b1._value = jnp.stack([blk_d.mlp.fc1.bias._value] * E)
+        blk_m.mlp.w2._value = jnp.stack([blk_d.mlp.fc2.weight._value] * E)
+        blk_m.mlp.b2._value = jnp.stack([blk_d.mlp.fc2.bias._value] * E)
+    return dense, moe
+
+
+def _ids(seed=0, shape=(2, 16)):
+    return paddle.to_tensor(np.random.default_rng(seed).integers(
+        0, KW["vocab_size"], shape).astype("int64"))
+
+
+class TestParity:
+    def test_dense_twin_forward_parity(self):
+        dense, moe = _twins()
+        ids = _ids()
+        ld = np.asarray(dense(ids)._value)
+        lm = np.asarray(moe(ids)._value)
+        np.testing.assert_allclose(lm, ld, rtol=1e-5, atol=1e-5)
+
+    def test_forward_deterministic(self):
+        _, moe = _twins(seed=3)
+        ids = _ids(1)
+        a = np.asarray(moe(ids)._value)
+        b = np.asarray(moe(ids)._value)
+        assert (a == b).all()
+
+    def test_criterion_backward_trains_the_router(self):
+        _, moe = _twins(seed=1)
+        ids = _ids(2)
+        crit = MoEGPTPretrainingCriterion(model=moe)
+        loss = crit(moe(ids), ids)
+        loss.backward()
+        assert np.isfinite(float(loss._value))
+        aux = moe.aux_loss()
+        assert float(aux._value if hasattr(aux, "_value") else aux) > 0
+        for p in (moe.gpt.h[0].mlp.router, moe.gpt.h[0].mlp.w1,
+                  moe.gpt.h[0].mlp.b2):
+            g = p.grad
+            g = np.asarray(g._value if hasattr(g, "_value") else g)
+            assert np.isfinite(g).all()
+            assert np.abs(g).max() > 0, "gradient did not reach the MoE"
+
+    def test_aux_weight_zero_drops_the_aux_term(self):
+        _, moe = _twins(seed=2)
+        ids = _ids(3)
+        logits = moe(ids)
+        l0 = float(MoEGPTPretrainingCriterion(model=moe,
+                                              aux_weight=0.0)(
+            logits, ids)._value)
+        l1 = float(MoEGPTPretrainingCriterion(model=moe)(
+            logits, ids)._value)
+        aux = moe.aux_loss()
+        aux = float(aux._value if hasattr(aux, "_value") else aux)
+        assert l1 == pytest.approx(
+            l0 + moe.config.router_aux_weight * aux, rel=1e-6)
+
+
+@pytest.mark.dist
+class TestExpertParallel:
+    def test_ep2_host_mesh_parity(self):
+        """dp=4,ep=2 on the forced 8-device host mesh: the expert-
+        parallel impl is selected and matches both the dense twin and
+        the meshless run."""
+        from jax.sharding import Mesh
+        from paddle_tpu.models.moe_gpt import _moe_mlp_impl
+        dense, moe = _twins()
+        ids = _ids()
+        ld = np.asarray(dense(ids)._value)
+        lm1 = np.asarray(moe(ids)._value)
+        mesh = Mesh(np.array(jax.devices()[:8]).reshape(4, 2),
+                    ("dp", "ep"))
+        dist.env.set_global_mesh(mesh)
+        assert moe.gpt.h[0].mlp._impl_for_mesh() is not _moe_mlp_impl
+        lm2 = np.asarray(moe(ids)._value)
+        np.testing.assert_allclose(lm2, ld, rtol=1e-5, atol=1e-5)
+        np.testing.assert_allclose(lm2, lm1, rtol=1e-5, atol=1e-5)
+
+    def test_ep2_grad_parity(self):
+        from jax.sharding import Mesh
+        _, moe = _twins(seed=4)
+        ids = _ids(4)
+        crit = MoEGPTPretrainingCriterion(model=moe)
+
+        def grad_w1():
+            for p in moe.parameters():
+                if hasattr(p, "clear_gradient"):
+                    p.clear_gradient()
+            crit(moe(ids), ids).backward()
+            g = moe.gpt.h[0].mlp.w1.grad
+            return np.asarray(g._value if hasattr(g, "_value") else g)
+
+        g1 = grad_w1()
+        dist.env.set_global_mesh(Mesh(
+            np.array(jax.devices()[:8]).reshape(4, 2), ("dp", "ep")))
+        g2 = grad_w1()
+        np.testing.assert_allclose(g2, g1, rtol=1e-4, atol=1e-6)
+
+    def test_mesh_plan_and_shrink_over_ep(self):
+        from paddle_tpu.distributed.auto_parallel.sharding import (
+            MeshPlan, rules_for)
+        plan = MeshPlan("dp=4,ep=2", rules=rules_for("moe_gpt"))
+        assert plan.axis_sizes["ep"] == 2
+        # losing half the mesh: ep no longer fits -> replicated experts,
+        # recorded as TPU505 on the new plan
+        new = plan.shrink(list(np.asarray(plan.mesh.devices).ravel()[:4]))
+        assert new.axis_sizes.get("ep", 1) in (1, 2)
+        if new.axis_sizes.get("ep", 1) == 1:
+            codes = [f.code for f in new.shrink_findings]
+            assert "TPU505" in codes
+        assert new.cache_token() != plan.cache_token()
+
+    def test_parse_mesh_spec_rejects_unknown_but_knows_ep(self):
+        from paddle_tpu.distributed.auto_parallel.sharding import (
+            parse_mesh_spec)
+        assert parse_mesh_spec("dp=2,ep=4") == {"dp": 2, "ep": 4}
+        with pytest.raises(ValueError, match="'ep'"):
+            parse_mesh_spec("dp=2,xp=4")
+
+
+@pytest.mark.analysis
+class TestRoutingAudits:
+    def test_tpu507_fires_on_undersized_capacity(self):
+        from paddle_tpu.analysis import audit_expert_capacity
+        # incubate default: C = 1.2 * 512 * 2 / 8 = 153 < 2x mean 128
+        rep = audit_expert_capacity(512, 8, 2, 153, imbalance=2.0,
+                                    emit=False)
+        assert [d.code for d in rep] == ["TPU507"]
+        rep = audit_expert_capacity(512, 8, 2, 256, imbalance=2.0,
+                                    emit=False)
+        assert len(rep) == 0
+
+    def test_tpu508_fires_on_hot_expert(self):
+        from paddle_tpu.analysis import audit_routing_balance
+        rep = audit_routing_balance([100, 2, 2, 24], block_rows=16,
+                                    emit=False)
+        assert [d.code for d in rep] == ["TPU508"]
+        assert rep[0].data["padding_frac"] >= 0
+        rep = audit_routing_balance([30, 34, 32, 32], block_rows=16,
+                                    emit=False)
+        assert len(rep) == 0
+
+    def test_lint_moe_model_is_clean(self):
+        import scripts.tpu_lint as tl
+        rep = tl.LINTERS["moe"]()
+        assert not [d for d in rep
+                    if d.severity == "error"], list(rep)
+
+
+@pytest.mark.serve
+class TestServing:
+    @pytest.fixture(scope="class")
+    def moe_mini(self):
+        cfg = MoEGPTConfig(vocab_size=97, hidden_size=32,
+                           num_hidden_layers=2, num_attention_heads=4,
+                           max_position_embeddings=64, num_experts=4,
+                           top_k=2)
+        paddle.seed(7)
+        model = MoEGPTForCausalLM(cfg)
+        model.eval()
+        return model
+
+    def _prompts(self, lengths, seed=0):
+        rng = np.random.RandomState(seed)
+        return [list(rng.randint(1, 97, size=n)) for n in lengths]
+
+    def _reference(self, model, prompts, n):
+        out = []
+        for p in prompts:
+            ids = paddle.to_tensor(np.asarray([p], np.int64))
+            out.append(np.asarray(
+                model.generate(ids, max_new_tokens=n).numpy())[0].tolist())
+        return out
+
+    def test_engine_greedy_parity(self, moe_mini):
+        from paddle_tpu.inference.serving import GenerationEngine
+        prompts = self._prompts((3, 7, 12))
+        ref = self._reference(moe_mini, prompts, 6)
+        eng = GenerationEngine(moe_mini, num_blocks=64, max_batch=3,
+                               max_model_len=64, prefill_chunk=16)
+        try:
+            assert eng.generate(prompts, max_new_tokens=6) == ref
+            assert eng.stats()["step_compiles"] == 1
+        finally:
+            eng.close()
+
+    def test_greedy_deterministic_across_preemption(self, moe_mini):
+        """A tiny block pool forces mid-decode preemption; per-token
+        routing is row-independent, so rescheduling must not move a
+        single token."""
+        from paddle_tpu.inference.serving import GenerationEngine
+        prompts = self._prompts((3, 7, 12))
+        ref_eng = GenerationEngine(moe_mini, num_blocks=64, max_batch=1,
+                                   max_model_len=64)
+        try:
+            ref = [ref_eng.generate([p], max_new_tokens=20)[0]
+                   for p in prompts]
+        finally:
+            ref_eng.close()
+        eng = GenerationEngine(moe_mini, num_blocks=8, block_size=4,
+                               max_batch=3, max_model_len=64)
+        try:
+            ids = [eng.add_request(p, max_new_tokens=20)
+                   for p in prompts]
+            while eng.has_unfinished():
+                eng.step()
+            assert [eng.result(i) for i in ids] == ref
+            assert sum(eng._results[i].preemptions for i in ids) > 0
+        finally:
+            eng.close()
