@@ -58,6 +58,9 @@ CODES = {
                "constant: every new value recompiles", WARNING),
     "TPU204": ("program structure mutated in place: fingerprint churn "
                "rebuilds the cached executable", WARNING),
+    "TPU205": ("lazy segment cache thrash: one op sequence keeps "
+               "fingerprinting to new segments instead of replaying a "
+               "cached executable", WARNING),
     # -- host synchronization (TPU3xx) ---------------------------------
     "TPU301": ("early fetch read: a d2h sync lands before the next step "
                "is dispatched, serializing the pipeline", WARNING),

@@ -22,15 +22,25 @@ of adding instrumentation:
 
 Weak-typed inputs (TPU201) are read straight off a traced jaxpr's
 invars.
+
+The lazy auto-trace tier adds a fourth cache: ``core.lazy``'s
+fingerprinted segment executables.  A healthy training loop replays ONE
+fingerprint forever; an op sequence that keeps compiling new
+fingerprints (TPU205) is paying a whole-segment XLA compile per step —
+the audit diffs the per-node structural keys of the colliding variants
+to NAME the node that keeps changing (a baked-in python scalar, a
+drifting input shape).
 """
 from __future__ import annotations
 
+import os
 from collections import defaultdict
 
 from .diagnostics import Diagnostic
 
 __all__ = ["audit_executor_cache", "audit_trace_cache",
-           "audit_eager_cache", "audit_weak_types"]
+           "audit_eager_cache", "audit_segment_cache",
+           "audit_weak_types"]
 
 # distinct variants of "the same" program/call tolerated before the
 # churn diagnostics fire (2 shapes may be train vs eval; 3+ is drift)
@@ -178,6 +188,95 @@ def audit_eager_cache(cache=None, per_op_threshold=16):
                 site=f"eager:{name}",
                 hint="bucket input shapes, or trace the loop with "
                      "paddle.jit.to_static"))
+    return diags
+
+
+def _diff_segment_variants(a, b, labels):
+    """Name the node whose structural key differs between two compiled
+    variants of the same op sequence; returns (op_name, kind, detail)
+    with kind in {"scalar", "shape", "structural", "leaves"}."""
+    for pos, (ka, kb) in enumerate(zip(a["keys"], b["keys"])):
+        if ka == kb:
+            continue
+        op = labels[pos] if pos < len(labels) else f"node#{pos}"
+        # dispatch node keys: (name, code, statics, attr_sig, aval_sig
+        # [, hoisted]) — statics drift = baked-in python scalar
+        if (isinstance(ka, tuple) and isinstance(kb, tuple)
+                and len(ka) == len(kb) and len(ka) >= 5):
+            if ka[2] != kb[2] or ka[3] != kb[3]:
+                changed = sorted(
+                    set(ka[2]) ^ set(kb[2])
+                    | set(ka[3]) ^ set(kb[3]),
+                    key=repr)[:4]
+                return op, "scalar", repr(changed)
+            if ka[4] != kb[4]:
+                return op, "shape", f"{ka[4]} vs {kb[4]}"
+        return op, "structural", ""
+    if a["leaf_sig"] != b["leaf_sig"]:
+        drift = [(i, x, y) for i, (x, y) in
+                 enumerate(zip(a["leaf_sig"], b["leaf_sig"]))
+                 if x != y][:3]
+        return "segment leaves", "leaves", repr(drift)
+    return "segment", "structural", ""
+
+
+def audit_segment_cache(history=None, threshold=None, only_labels=None):
+    """TPU205: segment cache thrash in the lazy eager tier.
+
+    Groups the compile history by op-name sequence; a group that
+    compiled ``threshold``+ distinct fingerprints is thrashing — steady
+    state should be a pure replay.  The per-node key diff names the
+    offending node so the hint can be actionable."""
+    if history is None:
+        from ..core.lazy import _segment_history
+        history = _segment_history
+    if threshold is None:
+        try:
+            threshold = int(os.environ.get(
+                "PADDLE_TPU_EAGER_FRAG_THRESHOLD", "16"))
+        except (TypeError, ValueError):
+            threshold = 16
+    groups = defaultdict(dict)     # labels -> {fingerprint: entry}
+    for ent in list(history):
+        labels = ent["labels"]
+        if only_labels is not None and labels != only_labels:
+            continue
+        groups[labels].setdefault(ent["fingerprint"], ent)
+    diags = []
+    for labels, by_fp in groups.items():
+        # two variants minimum to diff, even when the caller (the live
+        # watch in core.lazy) has already decided the group is over
+        if len(by_fp) < max(threshold, 2):
+            continue
+        variants = list(by_fp.values())
+        op, kind, detail = _diff_segment_variants(
+            variants[-2], variants[-1], labels)
+        site = (f"lazy:{labels[0]}..{labels[-1]}"
+                f"[{len(labels)} nodes]") if labels else "lazy:segment"
+        if kind == "scalar":
+            msg = (f"lazy segment ({len(labels)} nodes) compiled "
+                   f"{len(by_fp)} fingerprint variants; node {op!r} "
+                   f"bakes a python scalar into its key (changed "
+                   f"statics: {detail})")
+            hint = ("pass the changing scalar as a 0-d tensor "
+                    "(paddle.to_tensor(x)) so it rides as a traced "
+                    "leaf instead of a static constant")
+        elif kind in ("shape", "leaves"):
+            msg = (f"lazy segment ({len(labels)} nodes) compiled "
+                   f"{len(by_fp)} fingerprint variants; {op!r} sees "
+                   f"drifting input shapes ({detail})")
+            hint = ("pad or bucket inputs to a fixed shape set; every "
+                    "new shape pays a whole-segment XLA compile")
+        else:
+            msg = (f"lazy segment ({len(labels)} nodes) compiled "
+                   f"{len(by_fp)} fingerprint variants at node {op!r}")
+            hint = ("the op stream itself varies per iteration; keep "
+                    "value-dependent control flow out of the steady "
+                    "state or raise PADDLE_TPU_LAZY_MAX_NODES")
+        diags.append(Diagnostic(
+            "TPU205", msg, site=site, hint=hint,
+            data={"variants": len(by_fp), "nodes": len(labels),
+                  "offending_node": op, "kind": kind}))
     return diags
 
 

@@ -17,6 +17,7 @@ from __future__ import annotations
 import os
 import threading
 import time
+from collections import OrderedDict
 from typing import Any, Callable, Optional, Sequence
 
 import jax
@@ -71,9 +72,33 @@ _state = _DispatchState()
 # the plain eager path (the cache must key all behavior).
 # ---------------------------------------------------------------------
 _EAGER_JIT_MAX = 4096
-_eager_fwd_cache: dict = {}
-_eager_vjp_cache: dict = {}
+# Bounded LRUs: a long-running dynamic workload must keep caching its
+# CURRENT working set.  The old insert-stop policy froze the cache at
+# the first _EAGER_JIT_MAX signatures — every later op silently lost
+# caching forever (re-traced per call).  Hits refresh recency; inserts
+# past the cap evict the least-recently-dispatched signature and count
+# into stats/`eager.cache_evictions`.
+_eager_fwd_cache: OrderedDict = OrderedDict()
+_eager_vjp_cache: OrderedDict = OrderedDict()
+cache_evictions = {"fwd": 0, "vjp": 0}
 _bwd_apply = None
+
+
+def _cache_get(cache, key):
+    v = cache.get(key)
+    if v is not None:
+        cache.move_to_end(key)
+    return v
+
+
+def _cache_put(cache, key, val, lane):
+    cache[key] = val
+    if len(cache) > _EAGER_JIT_MAX:
+        cache.popitem(last=False)
+        cache_evictions[lane] += 1
+        if _obs_enabled():
+            from ..observability.registry import get_registry
+            get_registry().counter("eager.cache_evictions").inc()
 
 # dtype -> str(dtype) memo: numpy dtype __str__ allocates on every call
 # and _jit_key stringifies every operand's dtype on every eager dispatch
@@ -134,6 +159,13 @@ def _static_sig(v):
         return (type(v).__name__, v)
     if isinstance(v, _np.generic):
         return (type(v).__name__, v.item())
+    if isinstance(v, _np.dtype):
+        # dtype-valued attrs (cast's target dtype): without this, cast
+        # had no cache key at all — every AMP cast re-traced per call
+        # and, under the lazy tier, forced a segment flush
+        return ("dtype", v.str)
+    if isinstance(v, type) and issubclass(v, _np.generic):
+        return ("dtype", v.__name__)
     if isinstance(v, (tuple, list)):
         return tuple(_static_sig(x) for x in v)
     raise TypeError
@@ -269,8 +301,8 @@ def _dispatch(name: str, impl: Callable, args: Sequence[Any], attrs,
 
     if not record:
         if key is not None:
-            cached = _eager_fwd_cache.get(key)
-            if cached is None and len(_eager_fwd_cache) < _EAGER_JIT_MAX:
+            cached = _cache_get(_eager_fwd_cache, key)
+            if cached is None:
                 # None at tensor slots: the closure must not pin the
                 # first call's Tensors (and their autograd graphs)
                 template = [None if i in set(tensor_idx) else a
@@ -284,7 +316,7 @@ def _dispatch(name: str, impl: Callable, args: Sequence[Any], attrs,
                     return _impl(*full, **_attrs)
 
                 cached = jax.jit(pure_fwd)
-                _eager_fwd_cache[key] = cached
+                _cache_put(_eager_fwd_cache, key, cached, "fwd")
                 _note_cache_insert(name)
             if cached is not None:
                 return _wrap(cached(*arrays), name, node=None)
@@ -301,8 +333,8 @@ def _dispatch(name: str, impl: Callable, args: Sequence[Any], attrs,
         return impl(*full, **attrs)
 
     if key is not None:
-        cached = _eager_vjp_cache.get(key)
-        if cached is None and len(_eager_vjp_cache) < _EAGER_JIT_MAX:
+        cached = _cache_get(_eager_vjp_cache, key)
+        if cached is None:
             template = [None if i in set(tensor_idx) else a
                         for i, a in enumerate(args)]
 
@@ -316,7 +348,7 @@ def _dispatch(name: str, impl: Callable, args: Sequence[Any], attrs,
                 return jax.vjp(f, *arrs)
 
             cached = jax.jit(pure_pair)
-            _eager_vjp_cache[key] = cached
+            _cache_put(_eager_vjp_cache, key, cached, "vjp")
             _note_cache_insert(name)
         if cached is not None:
             outs, raw_vjp = cached(*arrays)
@@ -349,29 +381,80 @@ def _dispatch(name: str, impl: Callable, args: Sequence[Any], attrs,
 _LAZY_UNSUPPORTED = object()
 
 
+class _NoneOutputs(Exception):
+    pass
+
+
+# (name, code-sig) pairs whose python scalars must stay static: hoisting
+# them to traced leaves made abstract eval fail (shape-/value-dependent
+# scalars — axis args, output sizes).  Learned once, then permanent.
+_NO_HOIST: set = set()
+
+
 def _lazy_dispatch(name, impl, args, attrs, tensor_idx, tensors, arrays,
                    needs, record, key):
     """Record the op into the lazy segment buffer; no device dispatch.
+
+    Bare python int/float positionals (scale factors, loop counters —
+    ``x * lr_t``) are hoisted to weak-typed traced leaves so a changing
+    scalar does NOT change the node key, and a training loop whose only
+    per-step difference is a counter fingerprints to the SAME segment.
+    Ops whose scalars are load-bearing for shapes fail the hoisted
+    abstract eval once, land in _NO_HOIST, and keep them static.
+
     Returns _LAZY_UNSUPPORTED when the op cannot be abstractly
-    evaluated (host-value-dependent impls) — caller falls through to
-    the immediate path."""
+    evaluated at all (host-value-dependent impls) — caller falls
+    through to the immediate path."""
     from . import lazy as _lazy
 
+    name_, code, statics, attr_sig, aval_sig = key
     tset = set(tensor_idx)
-    template = [None if i in tset else a for i, a in enumerate(args)]
-    in_avals = [_lazy._aval_of(a) for a in arrays]
+    hoist = tuple(i for i, a in enumerate(args)
+                  if i not in tset and type(a) in (int, float))
+    if hoist and (name, code) not in _NO_HOIST:
+        try:
+            hvals = [jnp.asarray(args[i]) for i in hoist]
+            hset = set(hoist)
+            lkey = (name_, code,
+                    tuple(s for s in statics if s[0] not in hset),
+                    attr_sig,
+                    aval_sig + tuple(
+                        ((), _dtype_str(v.dtype), True) for v in hvals),
+                    True)
+            return _lazy_record(name, impl, args, attrs, tensor_idx,
+                                tensors, arrays, needs, record, lkey,
+                                hoist, hvals)
+        except Exception:
+            _NO_HOIST.add((name, code))
     try:
-        meta = _lazy.abs_eval(key, record, template, tensor_idx, attrs,
-                              impl, in_avals)
+        return _lazy_record(name, impl, args, attrs, tensor_idx,
+                            tensors, arrays, needs, record, key, (), [])
     except Exception:
         return _LAZY_UNSUPPORTED
-    if record and any(meta["none_mask"]):
-        return _LAZY_UNSUPPORTED
 
-    run = _lazy.make_fwd_run(template, tensor_idx, attrs, impl, record)
-    avals = list(meta["out_avals"]) + list(meta.get("res_avals", ()))
-    lazy_outs = _lazy.record_node(run, arrays, avals,
-                                  ("fwd", key, record))
+
+def _lazy_record(name, impl, args, attrs, tensor_idx, tensors, arrays,
+                 needs, record, lkey, hoist, hvals):
+    from . import lazy as _lazy
+
+    # ONE big-tuple hash per dispatch: the structural key is interned to
+    # an int here; the abs_eval cache, the node key and the segment
+    # fingerprint all ride on the int
+    kid = _lazy._intern_key(lkey)
+    tset = set(tensor_idx) | set(hoist)
+    template = [None if i in tset else a for i, a in enumerate(args)]
+    ext_idx = tuple(tensor_idx) + hoist
+    ext_arrays = list(arrays) + hvals
+    in_avals = [_lazy._aval_of(a) for a in ext_arrays]
+    meta = _lazy.abs_eval(kid, record, template, ext_idx, attrs,
+                          impl, in_avals, n_diff=len(tensor_idx))
+    if record and any(meta["none_mask"]):
+        raise _NoneOutputs(name)
+
+    lazy_outs = _lazy.record_node(meta["run"], ext_arrays,
+                                  meta["all_avals"],
+                                  ("fwd", kid, record),
+                                  label=name, raw_key=lkey)
     n_out = len(meta["out_avals"])
     outs = lazy_outs[:n_out]
 
@@ -384,7 +467,7 @@ def _lazy_dispatch(name, impl, args, attrs, tensor_idx, tensors, arrays,
         return _wrap(outs[0], name, node=None)
 
     res_vals = lazy_outs[n_out:]
-    vjp_fn = _lazy.make_lazy_vjp(key, res_vals, meta["treedef"],
+    vjp_fn = _lazy.make_lazy_vjp(kid, res_vals, meta["treedef"],
                                  meta["out_struct"])
     node = autograd.GradNode(
         name, vjp_fn, tensors, needs, n_out,

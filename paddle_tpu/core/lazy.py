@@ -1,4 +1,4 @@
-"""Lazy eager execution: defer op dispatch into a segment buffer.
+"""Lazy eager execution: the auto-trace tier for dygraph.
 
 Reference parity: Paddle's dygraph hides per-op latency with generated
 C++ paths and async CUDA launches (`paddle/fluid/eager/`,
@@ -17,15 +17,40 @@ How a train step executes under lazy mode:
     arrays + static structure, captured abstractly at record time), so
     ``loss.backward()``'s tape walk records backward nodes into the SAME
     buffer — forward and backward become one graph;
-  * the fused optimizer step consumes grads through ``__jax_array__``,
-    which forces the buffer: the whole forward+backward flushes as one
-    jitted, cache-keyed segment, then the optimizer's own fused
-    executable runs.  Steady state: ~2 executable launches per step
-    instead of hundreds of per-op round trips.
+  * the fused optimizer step consumes grads lazily too, so the whole
+    train step — forward, backward, parameter update — flushes as ONE
+    jitted, fingerprint-keyed segment at the first host read.  Steady
+    state: 1–2 executable launches per step instead of hundreds of
+    per-op round trips.
 
-A segment's jit cache key is the full structural wiring (per-node op
-keys + which input is which earlier output vs leaf + leaf avals), so the
-second iteration of a training loop replays a compiled executable.
+Fingerprinted reuse: a segment's structural fingerprint (interned
+per-node op keys + wiring + leaf avals incl. weak-typedness + the
+donation mask) keys a bounded LRU of AOT-compiled executables
+(`TracedFunction`-style), so the second execution of a training-loop
+body is a pure cache hit — zero retrace, zero relower.  Python scalars
+are hoisted to weak-typed traced leaves by the dispatcher
+(core/dispatch.py) so loop counters don't bake into the fingerprint.
+
+Flush triggers: host reads (``__jax_array__``/``__array__``/``force``),
+value-dependent control flow (``float()``/``bool()`` on a Tensor), and
+the op-count watermark ``PADDLE_TPU_LAZY_MAX_NODES`` (re-read at every
+``enable_lazy()``).
+
+In-place param updates donate their old buffers: when a Tensor's buffer
+is rebound to a pending LazyValue (optimizer ``p._inplace_update``),
+the replaced concrete array is noted and — if nothing outside the
+segment still references it at flush time — passed to XLA as a donated
+argument, so params/opt-state cost 1x HBM in the replayed step (gated
+on ``FLAGS_buffer_donation``; the donation mask is part of the
+fingerprint).
+
+Observability: each flush runs under a ``lazy:flush`` span
+(``cat="dispatch"``, attrs: nodes, cache_hit, fingerprint), segment
+compiles under ``compile:lazy:segment``; the metrics registry carries
+``eager.segment_cache_hit_rate`` / ``eager.segment_cache_evictions``,
+and ``phase_breakdown()`` exposes the lazy lane.  Fresh executables go
+through the memory-guard preflight before their first dispatch, so
+segments are held to the HBM budget like every other compiled program.
 
 Enablement is PROCESS-global (``enable_lazy`` / ``PADDLE_TPU_LAZY=1`` /
 ``paddle.incubate.lazy_eager()``); each thread records into its own
@@ -35,11 +60,16 @@ logging threads).
 """
 from __future__ import annotations
 
+import os
 import threading
+from collections import Counter, OrderedDict, deque
 
 import numpy as np
 import jax
 import jax.numpy as jnp
+
+from ..observability.timeline import (enabled as _obs_enabled,
+                                      span as _span)
 
 __all__ = ["LazyValue", "lazy_enabled", "enable_lazy", "lazy_guard",
            "flush", "concrete"]
@@ -48,12 +78,16 @@ __all__ = ["LazyValue", "lazy_enabled", "enable_lazy", "lazy_guard",
 class _Buffer:
     """One thread's pending segment."""
 
-    __slots__ = ("pending", "flushing", "lock")
+    __slots__ = ("pending", "flushing", "lock", "donate")
 
     def __init__(self):
         self.pending = []
         self.flushing = False
         self.lock = threading.RLock()
+        # id(old array) -> old array for buffers an _inplace_update
+        # replaced with a pending LazyValue (donation candidates); the
+        # strong ref keeps the id stable until the flush decides
+        self.donate = {}
 
 
 class _ThreadState(threading.local):
@@ -68,17 +102,32 @@ _ENABLED = False
 # sticky: once lazy has EVER been on, fallback paths must concretize
 _EVER_ENABLED = False
 
-# segment executable cache: wiring key -> jitted replay fn
-_segment_cache: dict = {}
+# segment executable LRU: fingerprint key -> compiled AOT executable.
+# Bounded like TracedFunction._cache; hits move to the back, inserts
+# past the cap evict the least-recently-replayed segment.
+_segment_cache: OrderedDict = OrderedDict()
 _SEGMENT_CACHE_MAX = 512
-# capture statistics (read by jit/sot.py reports): monotonic counters
-stats = {"flushes": 0, "cache_hits": 0, "compiles": 0, "nodes": 0}
-# per-op abstract-eval cache
+# capture statistics (read by jit/sot.py reports and bench.py):
+# monotonic counters
+stats = {"flushes": 0, "cache_hits": 0, "compiles": 0, "nodes": 0,
+         "evictions": 0, "donated": 0}
+# per-op abstract-eval cache (also memoizes each op's replay `run`
+# callable, so a steady-state dispatch allocates no new closures)
 _abseval_cache: dict = {}
 _ABSEVAL_CACHE_MAX = 8192
-# auto-flush bound: a loop that never reads values must not grow the
-# buffer without limit
-_AUTO_FLUSH_NODES = 4096
+
+
+def _max_nodes_env(default=4096):
+    try:
+        return int(os.environ.get("PADDLE_TPU_LAZY_MAX_NODES", default))
+    except (TypeError, ValueError):
+        return default
+
+
+# auto-flush watermark: a loop that never reads values must not grow
+# the buffer without limit (PADDLE_TPU_LAZY_MAX_NODES, re-read at every
+# enable_lazy so tests/jobs can retune without a restart)
+_AUTO_FLUSH_NODES = _max_nodes_env()
 
 
 def lazy_enabled():
@@ -87,12 +136,17 @@ def lazy_enabled():
 
 def enable_lazy(on=True):
     """Switch lazy eager mode process-wide.  Returns previous mode."""
-    global _ENABLED, _EVER_ENABLED
+    global _ENABLED, _EVER_ENABLED, _AUTO_FLUSH_NODES
     prev = _ENABLED
     if prev and not on:
         flush()
     _ENABLED = bool(on)
     _EVER_ENABLED = _EVER_ENABLED or _ENABLED
+    if on and "PADDLE_TPU_LAZY_MAX_NODES" in os.environ:
+        # env knob re-read on every enable so jobs/tests can retune the
+        # watermark without a process restart; a directly-assigned
+        # module value (tests) is left alone when the env is unset
+        _AUTO_FLUSH_NODES = _max_nodes_env(_AUTO_FLUSH_NODES)
     return prev
 
 
@@ -212,13 +266,16 @@ class LazyValue:
 
 
 class LazyNode:
-    __slots__ = ("run", "inputs", "outs", "key", "buffer")
+    __slots__ = ("run", "inputs", "outs", "key", "buffer", "label",
+                 "raw_key")
 
-    def __init__(self, run, inputs, avals, key, buffer):
+    def __init__(self, run, inputs, avals, key, buffer, label, raw_key):
         self.run = run                 # run(*input_vals) -> tuple
         self.inputs = list(inputs)     # LazyValue | concrete array
-        self.key = key
+        self.key = key                 # interned int (fingerprint atom)
         self.buffer = buffer
+        self.label = label             # op name, for TPU205 naming
+        self.raw_key = raw_key         # structural key, for TPU205 diff
         self.outs = [LazyValue(a, self, i) for i, a in enumerate(avals)]
 
     def buffer_flush(self):
@@ -232,19 +289,32 @@ _aval_intern: dict = {}
 
 def _aval_of(v):
     """ShapeDtypeStruct for one dispatch operand, interned by
-    (shape, dtype): the lazy recorder abstractifies every operand of
-    every recorded op, and a training loop re-sees the same handful of
-    signatures millions of times (the lenet eager-dispatch triage)."""
+    (shape, dtype, weak_type): the lazy recorder abstractifies every
+    operand of every recorded op, and a training loop re-sees the same
+    handful of signatures millions of times (the lenet eager-dispatch
+    triage).  weak_type rides along because hoisted python scalars must
+    keep python-number promotion inside the replayed program."""
     if isinstance(v, LazyValue):
-        sig = (v.aval.shape, v.aval.dtype)
+        sig = (v.aval.shape, v.aval.dtype,
+               bool(getattr(v.aval, "weak_type", False)))
     else:
-        sig = (jnp.shape(v), jnp.result_type(v))
+        sig = (jnp.shape(v), jnp.result_type(v), _weak_of(v))
     aval = _aval_intern.get(sig)
     if aval is None:
         if len(_aval_intern) >= 4096:
-            return jax.ShapeDtypeStruct(*sig)
-        aval = _aval_intern[sig] = jax.ShapeDtypeStruct(*sig)
+            return jax.ShapeDtypeStruct(sig[0], sig[1], weak_type=sig[2])
+        aval = _aval_intern[sig] = jax.ShapeDtypeStruct(
+            sig[0], sig[1], weak_type=sig[2])
     return aval
+
+
+def _weak_of(v):
+    """Is ``v`` weakly typed for promotion purposes?  jax arrays carry
+    the flag; bare python numbers ARE weak."""
+    w = getattr(v, "weak_type", None)
+    if w is not None:
+        return bool(w)
+    return isinstance(v, (bool, int, float, complex))
 
 
 _key_intern: dict = {}
@@ -263,14 +333,17 @@ def _intern_key(key):
     return i
 
 
-def record_node(run, inputs, out_avals, key):
-    """Append one node to this thread's buffer; returns its outputs."""
+def record_node(run, inputs, out_avals, key, label="op", raw_key=None):
+    """Append one node to this thread's buffer; returns its outputs.
+    ``key`` may be pre-interned (int) or a structural tuple."""
     buf = _tls.buffer
     if len(buf.pending) >= _AUTO_FLUSH_NODES:
         # flush BEFORE appending: the new node's outputs have no Tensor
         # wrapper yet, so the liveness pruning would see them as dead
         _flush_buffer(buf)
-    node = LazyNode(run, inputs, out_avals, _intern_key(key), buf)
+    kid = key if isinstance(key, int) else _intern_key(key)
+    node = LazyNode(run, inputs, out_avals, kid, buf, label,
+                    raw_key if raw_key is not None else key)
     with buf.lock:  # another thread may be force-flushing this buffer
         buf.pending.append(node)
     return node.outs
@@ -291,7 +364,24 @@ def lazy_add(a, b):
     out = jax.eval_shape(jnp.add, aa, ab)
     key = ("lazy_add", aa.shape, str(aa.dtype), ab.shape, str(ab.dtype))
     return record_node(lambda x, y: (jnp.add(x, y),), [a, b],
-                       [out], key)[0]
+                       [out], key, label="lazy_add")[0]
+
+
+def note_donation(old, new):
+    """Called by ``Tensor._inplace_update``: when a concrete buffer is
+    replaced by a pending LazyValue (optimizer in-place param update),
+    the old array becomes a donation candidate for this thread's next
+    flush.  A forced LazyValue (last step's segment output — the steady
+    state) donates its materialized array."""
+    if not (isinstance(new, LazyValue) and new._concrete is None):
+        return
+    if isinstance(old, LazyValue):
+        old = old._concrete
+        if old is None:
+            return
+    if isinstance(old, jax.Array) and not isinstance(old,
+                                                     jax.core.Tracer):
+        _tls.buffer.donate[id(old)] = old
 
 
 def concrete(v):
@@ -316,11 +406,12 @@ def flush():
 def _flush_buffer(buf):
     with buf.lock:
         pending, buf.pending = buf.pending, []
+        donate, buf.donate = buf.donate, {}
         if not pending:
             return
         buf.flushing = True
         try:
-            _flush_nodes(pending)
+            _flush_nodes(pending, donate)
         except BaseException as e:
             # every in-flight value of this segment can never
             # materialize; remember the cause so later reads point at
@@ -351,7 +442,6 @@ def _liveness_masks(pending):
     the silent-drop direction; a genuinely-referenced value misjudged
     dead would fail LOUDLY at force() ("did not materialize")."""
     import sys
-    from collections import Counter
     # generator scope: no leaked local binding to skew the refcounts
     in_seg = Counter(id(v) for n in pending for v in n.inputs
                      if isinstance(v, LazyValue))
@@ -367,7 +457,172 @@ def _liveness_masks(pending):
     return masks
 
 
-def _flush_nodes(pending):
+def _donatable_leaves(leaves, pending, donate):
+    """Leaf indices safe to donate to XLA: the leaf was noted as an
+    in-place-replaced buffer AND nothing outside this flush still
+    references it.  Refcount accounting mirrors _liveness_masks: the
+    expected count is getrefcount's own arg + the loop binding + the
+    ``donate`` map's strong ref + every ``leaves``/``node.inputs``
+    occurrence; anything beyond means a user still holds the old
+    buffer — overcounting (hidden refs) only SKIPS a donation, never
+    donates a live buffer."""
+    if not donate:
+        return ()
+    from ..framework.flags import get_flags
+    if not get_flags("FLAGS_buffer_donation")["FLAGS_buffer_donation"]:
+        return ()
+    import sys
+    inputs_ct = Counter(id(v) for n in pending for v in n.inputs
+                        if not isinstance(v, LazyValue))
+    leaves_ct = Counter(id(v) for v in leaves)
+    # a forced LazyValue input holds ONE ref to its materialized array
+    # via _concrete.  That ref is creditable only when the LazyValue
+    # itself has no references outside these input lists — a tensor
+    # still bound to it (detach() alias, user variable) could read the
+    # array after the flush, so it must block donation.
+    lv_occ = Counter(id(v) for n in pending for v in n.inputs
+                     if isinstance(v, LazyValue)
+                     and v._concrete is not None)
+    lv_credit = Counter()
+    seen = set()
+    for n in pending:
+        for v in n.inputs:
+            if not (isinstance(v, LazyValue)
+                    and v._concrete is not None):
+                continue
+            vid = id(v)
+            if vid in seen:
+                continue
+            seen.add(vid)
+            # getrefcount arg + loop binding + input-list occurrences
+            if sys.getrefcount(v) <= 2 + lv_occ[vid]:
+                lv_credit[id(v._concrete)] += 1
+    out = []
+    for i in range(len(leaves)):
+        v = leaves[i]
+        vid = id(v)
+        if vid not in donate or leaves_ct[vid] != 1:
+            # aliased-operand duplicate slots can't donate one buffer
+            # twice; keep it simple and keep them all
+            del v
+            continue
+        expected = 3 + leaves_ct[vid] + inputs_ct[vid] + lv_credit[vid]
+        if sys.getrefcount(v) <= expected:
+            out.append(i)
+        del v
+    return tuple(out)
+
+
+class _Segment:
+    """One cached AOT-compiled segment executable."""
+
+    __slots__ = ("compiled", "fingerprint", "n_donated")
+
+    def __init__(self, compiled, fingerprint, n_donated):
+        self.compiled = compiled
+        self.fingerprint = fingerprint
+        self.n_donated = n_donated
+
+
+# segment compile history for the TPU205 thrash audit: every compiled
+# fingerprint with its per-node structural keys, grouped by op-name
+# sequence so the audit can diff two variants and NAME the node that
+# keeps changing (a baked-in python scalar, a drifting shape)
+_segment_history: deque = deque(maxlen=256)
+_seg_groups: dict = {}          # label tuple -> set of fingerprints
+_SEG_GROUPS_MAX = 512
+_seg_flagged: set = set()
+
+
+def _frag_threshold():
+    try:
+        return int(os.environ.get("PADDLE_TPU_EAGER_FRAG_THRESHOLD",
+                                  "16"))
+    except (TypeError, ValueError):
+        return 16
+
+
+def _note_segment_compile(fp, pending, leaf_sig):
+    labels = tuple(n.label for n in pending)
+    _segment_history.append({
+        "fingerprint": fp,
+        "labels": labels,
+        "keys": tuple(n.raw_key for n in pending),
+        "leaf_sig": leaf_sig,
+    })
+    if len(_seg_groups) < _SEG_GROUPS_MAX or labels in _seg_groups:
+        group = _seg_groups.setdefault(labels, set())
+        group.add(fp)
+        if len(group) == _frag_threshold() \
+                and labels not in _seg_flagged:
+            # live thrash watch, same shape as dispatch._note_cache_insert
+            _seg_flagged.add(labels)
+            try:
+                from ..analysis.diagnostics import record
+                from ..analysis.recompile import audit_segment_cache
+                for d in audit_segment_cache(only_labels=labels,
+                                             threshold=1):
+                    record(d)
+            except Exception:
+                pass
+
+
+def _metrics_flush_update(hit):
+    """Registry lanes (no-ops with observability off)."""
+    from ..observability.registry import get_registry
+    reg = get_registry()
+    if hit:
+        reg.counter("eager.segment_cache_hits").inc()
+    else:
+        reg.counter("eager.segment_cache_misses").inc()
+    fl = stats["flushes"]
+    if fl:
+        reg.gauge("eager.segment_cache_hit_rate").set(
+            stats["cache_hits"] / fl)
+
+
+def _compile_segment(seg_key, pending, wiring, masks, leaves,
+                     donate_idx, kept_idx, fp):
+    runs = [n.run for n in pending]
+    wires = [w for _, w in wiring]
+    n_leaves = len(leaves)
+    d_idx, k_idx = tuple(donate_idx), tuple(kept_idx)
+
+    def replay(donated, kept):
+        leaf_vals = [None] * n_leaves
+        for i, v in zip(d_idx, donated):
+            leaf_vals[i] = v
+        for i, v in zip(k_idx, kept):
+            leaf_vals[i] = v
+        results = []
+        out = []
+        for run, slots, mask in zip(runs, wires, masks):
+            ins = [results[s[1]][s[2]] if s[0] == "n"
+                   else leaf_vals[s[1]] for s in slots]
+            res = run(*ins)
+            results.append(res)
+            out.append(tuple(
+                o for o, keep in zip(res, mask) if keep))
+        return tuple(out)
+
+    jit_kwargs = {}
+    if d_idx:
+        jit_kwargs["donate_argnums"] = (0,)
+    donated = tuple(leaves[i] for i in d_idx)
+    kept = tuple(leaves[i] for i in k_idx)
+    with _span("compile:lazy:segment", cat="compile",
+               nodes=len(pending), fingerprint=fp):
+        compiled = jax.jit(replay, **jit_kwargs) \
+            .lower(donated, kept).compile()
+    # memory-guard preflight: hold the fresh segment executable to the
+    # HBM budget (in-flight leaves + materialized outputs) before its
+    # first dispatch, exactly like TracedFunction/Executor programs
+    from ..memory.guard import preflight_check
+    preflight_check(compiled, program=f"lazy:segment#{fp}")
+    return _Segment(compiled, fp, len(d_idx))
+
+
+def _flush_nodes(pending, donate=None):
     leaves = []
     leaf_pos: dict = {}          # id(array) -> leaf index
     wiring = []
@@ -409,42 +664,55 @@ def _flush_nodes(pending):
                 slots.append(leaf_slot(v))
         wiring.append((n.key, tuple(slots)))
 
+    donate_idx = _donatable_leaves(leaves, pending, donate)
+    dset = set(donate_idx)
+    kept_idx = tuple(i for i in range(len(leaves)) if i not in dset)
     leaf_sig = tuple(
-        (jnp.shape(v), str(jnp.result_type(v))) for v in leaves)
-    seg_key = (tuple(wiring), tuple(masks), leaf_sig)
+        (jnp.shape(v), str(jnp.result_type(v)), _weak_of(v))
+        for v in leaves)
+    seg_key = (tuple(wiring), tuple(masks), leaf_sig, donate_idx)
     stats["flushes"] += 1
     stats["nodes"] += len(pending)
-    fn = _segment_cache.get(seg_key)
-    if fn is not None:
+    seg = _segment_cache.get(seg_key)
+    hit = seg is not None
+    if hit:
         stats["cache_hits"] += 1
-    if fn is None:
+        _segment_cache.move_to_end(seg_key)
+    else:
         stats["compiles"] += 1
-        runs = [n.run for n in pending]
-        wires = [w for _, w in wiring]
-
-        def replay(leaf_vals):
-            results = []
-            kept = []
-            for run, slots, mask in zip(runs, wires, masks):
-                ins = [results[s[1]][s[2]] if s[0] == "n"
-                       else leaf_vals[s[1]] for s in slots]
-                out = run(*ins)
-                results.append(out)
-                kept.append(tuple(
-                    o for o, keep in zip(out, mask) if keep))
-            return tuple(kept)
-
-        fn = jax.jit(replay)
-        if len(_segment_cache) < _SEGMENT_CACHE_MAX:
-            _segment_cache[seg_key] = fn
+        fp = _intern_key(seg_key)
+        seg = _compile_segment(seg_key, pending, wiring, masks, leaves,
+                               donate_idx, kept_idx, fp)
+        _segment_cache[seg_key] = seg
+        if len(_segment_cache) > _SEGMENT_CACHE_MAX:
+            _segment_cache.popitem(last=False)
+            stats["evictions"] += 1
+            if _obs_enabled():
+                from ..observability.registry import get_registry
+                get_registry().counter(
+                    "eager.segment_cache_evictions").inc()
+        _note_segment_compile(fp, pending, leaf_sig)
+    stats["donated"] += len(donate_idx)
+    if _obs_enabled():
+        _metrics_flush_update(hit)
+    donated = tuple(leaves[i] for i in donate_idx)
+    kept = tuple(leaves[i] for i in kept_idx)
+    del leaves
     from ..device import hbm_oom_context
-    with hbm_oom_context():  # dygraph OOMs surface here
-        out = fn(leaves)
+    with _span("lazy:flush", cat="dispatch", nodes=len(pending),
+               cache_hit=hit, fingerprint=seg.fingerprint,
+               donated=len(donated)):
+        with hbm_oom_context():  # dygraph OOMs surface here
+            out = seg.compiled(donated, kept)
     for n, vals, mask in zip(pending, out, masks):
         it = iter(vals)
         for lv, keep in zip(n.outs, mask):
             if keep:
                 lv._concrete = next(it)
+                # break the lv -> node -> sibling-outs chain: a rebound
+                # tensor must free (and donate) last step's buffers, not
+                # keep the whole flushed segment alive transitively
+                lv.node = None
         n.run = None
         n.inputs = []
         n.buffer = None
@@ -454,16 +722,27 @@ def _flush_nodes(pending):
 # dispatch integration (called from core.dispatch)
 # ---------------------------------------------------------------------
 def abs_eval(op_key, record, template, tensor_idx, attrs, impl,
-             in_avals):
+             in_avals, n_diff=None):
     """Cached per-op abstract evaluation: output avals; for recorded ops
     also the VJP residual avals + pytree structure (captured via side
-    effect during the abstract trace — the structure is static)."""
+    effect during the abstract trace — the structure is static).
+
+    The meta dict also memoizes the node's replay ``run`` callable:
+    equal op keys prove behavioral equality (same contract as the
+    per-op jit caches), so a steady-state dispatch reuses one closure
+    instead of building template/closure objects per call.
+
+    ``n_diff``: how many leading inputs are differentiable Tensor
+    operands — hoisted python-scalar leaves ride after them and stay
+    out of the VJP (their "gradient" is never consumed)."""
     cache_key = (op_key, bool(record))
     meta = _abseval_cache.get(cache_key)
     if meta is not None:
         return meta
 
     t_idx = tuple(tensor_idx)
+    if n_diff is None:
+        n_diff = len(t_idx)
     side = {}
 
     if not record:
@@ -483,20 +762,23 @@ def abs_eval(op_key, record, template, tensor_idx, attrs, impl,
                 "none_mask": side["none_mask"]}
     else:
         def probe(*ins):
+            hoisted = ins[n_diff:]
+
             def f(*xs):
                 full = list(template)
-                for i, v in zip(t_idx, xs):
+                for i, v in zip(t_idx, tuple(xs) + tuple(hoisted)):
                     full[i] = v
                 return impl(*full, **attrs)
 
-            outs, vjp = jax.vjp(f, *ins)
+            outs, vjp = jax.vjp(f, *ins[:n_diff])
             res, treedef = jax.tree_util.tree_flatten(vjp)
             side["treedef"] = treedef
             side["is_multi"] = isinstance(outs, (tuple, list))
             side["out_struct"] = jax.tree_util.tree_structure(outs)
-            side["n_out"] = (len(outs) if side["is_multi"] else 1)
-            return (tuple(outs) if side["is_multi"] else (outs,)) \
-                + tuple(res)
+            outs_t = tuple(outs) if side["is_multi"] else (outs,)
+            side["n_out"] = len(outs_t)
+            side["none_mask"] = tuple(o is None for o in outs_t)
+            return outs_t + tuple(res)
 
         all_avals = jax.eval_shape(probe, *in_avals)
         n_out = side["n_out"]
@@ -506,16 +788,23 @@ def abs_eval(op_key, record, template, tensor_idx, attrs, impl,
                 "treedef": side["treedef"],
                 "out_struct": side["out_struct"],
                 "is_multi": side["is_multi"],
-                "none_mask": (False,) * n_out}
+                "none_mask": side["none_mask"]}
+    meta["run"] = make_fwd_run(template, t_idx, attrs, impl, record,
+                               n_diff)
+    meta["all_avals"] = meta["out_avals"] + \
+        tuple(meta.get("res_avals", ()))
     if len(_abseval_cache) < _ABSEVAL_CACHE_MAX:
         _abseval_cache[cache_key] = meta
     return meta
 
 
-def make_fwd_run(template, tensor_idx, attrs, impl, record):
+def make_fwd_run(template, tensor_idx, attrs, impl, record,
+                 n_diff=None):
     """The node's replay function.  All behavior-affecting state is in
     the node key (op key), so identical keys may share compiled code."""
     t_idx = tuple(tensor_idx)
+    if n_diff is None:
+        n_diff = len(t_idx)
     if not record:
         def run(*ins):
             full = list(template)
@@ -528,13 +817,15 @@ def make_fwd_run(template, tensor_idx, attrs, impl, record):
         return run
 
     def run(*ins):
+        hoisted = ins[n_diff:]
+
         def f(*xs):
             full = list(template)
-            for i, v in zip(t_idx, xs):
+            for i, v in zip(t_idx, tuple(xs) + tuple(hoisted)):
                 full[i] = v
             return impl(*full, **attrs)
 
-        outs, vjp = jax.vjp(f, *ins)
+        outs, vjp = jax.vjp(f, *ins[:n_diff])
         res, _ = jax.tree_util.tree_flatten(vjp)
         outs_t = tuple(outs) if isinstance(outs, (tuple, list)) \
             else (outs,)
@@ -551,28 +842,31 @@ def make_lazy_vjp(op_key, res_values, treedef, out_struct):
             cts, is_leaf=lambda x: isinstance(x, LazyValue))
         n_res = len(res_values)
 
-        def bwd_run(*ins):
-            vjp = jax.tree_util.tree_unflatten(treedef, ins[:n_res])
-            ct_vals = jax.tree_util.tree_unflatten(
-                out_struct, list(ins[n_res:]))
-            return tuple(vjp(ct_vals))
-
         ct_sig = tuple((_aval_of(c).shape, str(_aval_of(c).dtype))
                        for c in flat_cts)
         key = ("bwd", op_key, ct_sig)
         meta = _abseval_cache.get(key)
         if meta is None:
+            def bwd_run(*ins):
+                vjp = jax.tree_util.tree_unflatten(treedef,
+                                                   ins[:n_res])
+                ct_vals = jax.tree_util.tree_unflatten(
+                    out_struct, list(ins[n_res:]))
+                return tuple(vjp(ct_vals))
+
             in_avals = [_aval_of(v) for v in res_values] + \
                 [_aval_of(c) for c in flat_cts]
-            meta = tuple(jax.eval_shape(bwd_run, *in_avals))
+            meta = {"avals": tuple(jax.eval_shape(bwd_run, *in_avals)),
+                    "run": bwd_run}
             if len(_abseval_cache) < _ABSEVAL_CACHE_MAX:
                 _abseval_cache[key] = meta
         if lazy_enabled():
-            return record_node(bwd_run, list(res_values) + flat_cts,
-                               list(meta), key)
+            return record_node(meta["run"],
+                               list(res_values) + flat_cts,
+                               list(meta["avals"]), key, label="bwd")
         vals = [concrete(v) for v in res_values] + \
             [concrete(c) for c in flat_cts]
-        return bwd_run(*vals)
+        return meta["run"](*vals)
 
     vjp_fn._lazy_ok = True  # may receive LazyValue cotangents
     return vjp_fn

@@ -21,6 +21,7 @@ import jax
 import jax.numpy as jnp
 
 from . import autograd
+from . import lazy as _lazy
 from .dtypes import DType, convert_dtype, to_jax_dtype, to_paddle_dtype, default_dtype
 from .place import CPUPlace, Place, TPUPlace, current_place
 
@@ -132,6 +133,11 @@ class Tensor:
         ctx = _trace_state.ctx
         if ctx is not None:
             ctx.on_write(self, self._value, self._grad_node)
+        if _lazy._ENABLED:
+            # optimizer param updates replace concrete buffers with
+            # pending LazyValues: the old buffer is a donation candidate
+            # for the flushed segment (params cost 1x HBM per step)
+            _lazy.note_donation(self._value, new_value)
         self._value = new_value
         self._grad_node = node
         self._out_index = out_index

@@ -250,6 +250,8 @@ def phase_breakdown(events=None):
     fabric_spans = []
     degraded = {"degraded_ms": 0.0, "degraded_count": 0,
                 "store_promotions": 0}
+    lazy_lane = {"lazy_ms": 0.0, "lazy_flush_count": 0,
+                 "lazy_nodes": 0, "lazy_cache_hits": 0}
 
     def _shard_row(label):
         return shards.setdefault(label, {
@@ -327,6 +329,14 @@ def phase_breakdown(events=None):
             out["d2h_bytes"] += int(attrs.get("d2h_bytes", 0) or 0)
             if attrs.get("mesh"):
                 out["mesh"] = str(attrs["mesh"])
+            if e.name == "lazy:flush":
+                # eager auto-trace lane: segment replays (core/lazy.py)
+                lazy_lane["lazy_ms"] += ms
+                lazy_lane["lazy_flush_count"] += 1
+                lazy_lane["lazy_nodes"] += int(attrs.get("nodes", 0)
+                                               or 0)
+                if attrs.get("cache_hit"):
+                    lazy_lane["lazy_cache_hits"] += 1
         elif e.cat == "collective":
             out["collective_ms"] += ms
             out["collective_count"] += 1
@@ -423,6 +433,13 @@ def phase_breakdown(events=None):
     if any(degraded.values()):
         degraded["degraded_ms"] = round(degraded["degraded_ms"], 3)
         out.update(degraded)
+    # lazy eager-capture lane, only when segments actually flushed
+    if lazy_lane["lazy_flush_count"]:
+        lazy_lane["lazy_ms"] = round(lazy_lane["lazy_ms"], 3)
+        lazy_lane["segment_cache_hit_rate"] = round(
+            lazy_lane["lazy_cache_hits"]
+            / lazy_lane["lazy_flush_count"], 4)
+        out.update(lazy_lane)
     # elastic-training recovery/snapshot lanes, only when they fired
     if any(elastic.values()):
         elastic["recovery_ms"] = round(elastic["recovery_ms"], 3)

@@ -103,8 +103,15 @@ class Optimizer:
         self._learning_rate = scheduler
 
     def _sync_lr(self):
+        lr = self._current_lr()
+        # skip the per-step h2d transfer (and, under lazy mode, a
+        # spurious leaf-signature change) while the lr is unchanged —
+        # the common case for constant-lr training
+        if lr == getattr(self, "_lr_last", None):
+            return
+        self._lr_last = lr
         self._lr_tensor._inplace_update(
-            jnp.asarray(self._current_lr(), jnp.float32))
+            jnp.asarray(lr, jnp.float32))
 
     # ---- accumulators ----
     def _acc(self, name, param, init=0.0, shape=None, dtype=None):

@@ -47,7 +47,10 @@ GATE_SUFFIXES = ("_tokens_per_sec", "_imgs_per_sec", "_accept_rate",
 #: padded grouped blocks, so a rise gates like a latency regression)
 LOW_SUFFIXES = ("_p99_ttft_ms", "_p99_tpot_ms", "_failover_recovery_ms",
                 "_shed_rate", "_elastic_recovery_ms", "_failover_ms",
-                "_stall_ms", "_expert_imbalance")
+                "_stall_ms", "_expert_imbalance",
+                # lazy-tier: more segment flushes per train step means
+                # whole-step capture regressed toward per-op dispatch
+                "_flushes_per_step")
 #: quality-parity metrics (int8 greedy match vs float): ZERO tolerance
 #: — ANY drop below last-good refuses the capture, threshold ignored
 QUALITY_SUFFIXES = ("_greedy_match",)

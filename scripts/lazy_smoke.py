@@ -1,0 +1,124 @@
+#!/usr/bin/env python
+"""lazy_smoke: gate the eager auto-trace tier's steady state.
+
+    python scripts/lazy_smoke.py [--json]
+
+Runs a LeNet train step (fwd + bwd + fused Adam) under
+``paddle.incubate.lazy_eager()``: two warmup iterations compile the
+segment, then the timeline is cleared and N steady-state iterations run
+with observability on.  The gate asserts, from the RECORDED events and
+capture stats — not from trust:
+
+  * <= 2 ``cat="dispatch"`` spans per step (whole-step capture: the
+    train step flushes as one or two executable launches, not hundreds
+    of per-op dispatches);
+  * segment cache hit rate >= 0.9 (fingerprinted reuse: steady state is
+    a pure replay);
+  * zero ``cat="compile"`` spans (no retrace after warmup).
+
+Exit code 1 on any violation: a red run here means dygraph fell off the
+auto-trace fast path.  Runs in the tier-1 suite via
+tests/test_analysis.py.
+"""
+import argparse
+import json
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+STEADY_ITERS = 10
+MAX_DISPATCH_PER_STEP = 2.0
+MIN_HIT_RATE = 0.9
+
+
+def run(emit_json=False, out=sys.stdout):
+    import numpy as np
+    import paddle_tpu as paddle
+    import paddle_tpu.nn.functional as F
+    from paddle_tpu import observability as obs
+    from paddle_tpu import optimizer
+    from paddle_tpu.core import lazy
+    from paddle_tpu.vision.models import LeNet
+
+    paddle.disable_static()
+    paddle.seed(0)
+    model = LeNet(num_classes=10)
+    opt = optimizer.Adam(learning_rate=1e-3,
+                         parameters=model.parameters())
+    rng = np.random.default_rng(0)
+    img = paddle.to_tensor(
+        rng.standard_normal((16, 1, 28, 28)).astype(np.float32))
+    label = paddle.to_tensor(
+        rng.integers(0, 10, (16,)).astype(np.int64))
+
+    def step():
+        loss = F.cross_entropy(model(img), label)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        return float(loss)  # the step's one sync point
+
+    with obs.enabled_scope():
+        with paddle.incubate.lazy_eager():
+            for _ in range(2):  # warmup: compile the segment
+                step()
+            obs.get_timeline().clear()
+            before = dict(lazy.stats)
+            for _ in range(STEADY_ITERS):
+                step()
+            delta = {k: lazy.stats[k] - before[k] for k in before}
+            phases = obs.phase_breakdown(obs.get_timeline().events())
+
+    dispatch_per_step = phases.get("dispatch_count", 0) / STEADY_ITERS
+    hit_rate = (delta["cache_hits"] / delta["flushes"]
+                if delta["flushes"] else 0.0)
+    span_hit_rate = phases.get("segment_cache_hit_rate", 0.0)
+    compiles = phases.get("compile_count", 0)
+
+    checks = {
+        "dispatch_per_step": {
+            "value": dispatch_per_step, "max": MAX_DISPATCH_PER_STEP,
+            "ok": dispatch_per_step <= MAX_DISPATCH_PER_STEP},
+        "segment_cache_hit_rate": {
+            "value": hit_rate, "min": MIN_HIT_RATE,
+            "ok": hit_rate >= MIN_HIT_RATE},
+        "span_cache_hit_rate": {
+            "value": span_hit_rate, "min": MIN_HIT_RATE,
+            "ok": span_hit_rate >= MIN_HIT_RATE},
+        "steady_state_compiles": {
+            "value": compiles, "max": 0, "ok": compiles == 0},
+    }
+    ok = all(c["ok"] for c in checks.values())
+    report = {"ok": ok, "checks": checks, "stats_delta": delta,
+              "lazy_ms": phases.get("lazy_ms", 0.0),
+              "lazy_flush_count": phases.get("lazy_flush_count", 0)}
+    if emit_json:
+        print(json.dumps(report, indent=2, default=str), file=out)
+    else:
+        for name, c in checks.items():
+            bound = (f"<= {c['max']}" if "max" in c
+                     else f">= {c['min']}")
+            status = "OK" if c["ok"] else "FAIL"
+            print(f"[lazy_smoke] {name:<24} {c['value']:<8.3f} "
+                  f"(want {bound})  {status}", file=out)
+        print(f"[lazy_smoke] {STEADY_ITERS} steps: "
+              f"{delta['flushes']} flushes, {delta['cache_hits']} "
+              f"cache hits, {delta['compiles']} compiles, "
+              f"{delta['donated']} buffers donated", file=out)
+    return ok, report
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--json", action="store_true",
+                    help="print machine-readable JSON instead of text")
+    args = ap.parse_args(argv)
+    ok, _ = run(emit_json=args.json)
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

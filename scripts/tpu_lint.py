@@ -41,8 +41,8 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(
 
 import numpy as np  # noqa: E402
 
-MODELS = ("lenet", "bert", "gpt", "moe", "pallas", "sharding", "fabric",
-          "faults")
+MODELS = ("lenet", "eager", "bert", "gpt", "moe", "pallas", "sharding",
+          "fabric", "faults")
 
 
 def lint_lenet():
@@ -72,6 +72,62 @@ def lint_lenet():
     label = paddle.to_tensor(rng.integers(0, 10, (8,)).astype(np.int64))
     traced(img, label)  # discovery trace
     return traced.analyze_program(img, label)
+
+
+def lint_eager():
+    """LeNet train steps under the lazy eager tier — asserts whole-step
+    capture (1 flush/step), fingerprint reuse (steady-state cache hit),
+    and runs the TPU205 segment-thrash audit over the compile history."""
+    import paddle_tpu as paddle
+    from paddle_tpu import optimizer
+    from paddle_tpu.core import lazy
+    from paddle_tpu.vision.models import LeNet
+    import paddle_tpu.nn.functional as F
+    from paddle_tpu.analysis.diagnostics import (Diagnostic,
+                                                 DiagnosticReport)
+    from paddle_tpu.analysis.recompile import audit_segment_cache
+
+    paddle.disable_static()
+    paddle.seed(0)
+    model = LeNet(num_classes=10)
+    opt = optimizer.Adam(learning_rate=1e-3,
+                         parameters=model.parameters())
+    rng = np.random.default_rng(0)
+    img = paddle.to_tensor(
+        rng.standard_normal((8, 1, 28, 28)).astype(np.float32))
+    label = paddle.to_tensor(rng.integers(0, 10, (8,)).astype(np.int64))
+
+    rep = DiagnosticReport(label="lint:eager")
+    deltas = []
+    with paddle.incubate.lazy_eager():
+        for _ in range(3):
+            before = dict(lazy.stats)
+            loss = F.cross_entropy(model(img), label)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            float(loss)  # the step's one sync point
+            deltas.append({k: lazy.stats[k] - before[k]
+                           for k in before})
+    steady = deltas[-1]
+    if steady["flushes"] > 2:
+        rep.add(Diagnostic(
+            "TPU205", severity="error", site="lint:eager",
+            message=f"steady-state lazy LeNet step flushed "
+                    f"{steady['flushes']} segments (expected <= 2): "
+                    "whole-step capture is broken",
+            hint="look for a host read inside the train step"))
+    if steady["cache_hits"] < steady["flushes"]:
+        rep.add(Diagnostic(
+            "TPU205", severity="error", site="lint:eager",
+            message="third lazy LeNet iteration was not a pure "
+                    f"fingerprint cache hit ({steady['cache_hits']} "
+                    f"hits / {steady['flushes']} flushes, "
+                    f"{steady['compiles']} compiles)",
+            hint="a node key or leaf signature varies per step; run "
+                 "analysis.recompile.audit_segment_cache for the node"))
+    rep.extend(audit_segment_cache())
+    return rep
 
 
 def _lint_static(build):
@@ -359,8 +415,8 @@ def lint_faults():
     return audit_fault_sites()
 
 
-LINTERS = {"lenet": lint_lenet, "bert": lint_bert, "gpt": lint_gpt,
-           "moe": lint_moe, "pallas": lint_pallas,
+LINTERS = {"lenet": lint_lenet, "eager": lint_eager, "bert": lint_bert,
+           "gpt": lint_gpt, "moe": lint_moe, "pallas": lint_pallas,
            "sharding": lint_sharding, "fabric": lint_fabric,
            "faults": lint_faults}
 

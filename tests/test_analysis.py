@@ -521,3 +521,33 @@ class TestFusionSmoke:
         from paddle_tpu.ops import pallas_gate as pg
         assert set(report) == set(pg._PROBES)
         assert all(rec["probed"] for rec in report.values())
+
+
+def _load_lazy_smoke():
+    path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "scripts", "lazy_smoke.py")
+    spec = importlib.util.spec_from_file_location("lazy_smoke_cli", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+@pytest.mark.perf
+class TestLazySmoke:
+    def test_steady_state_lazy_step_is_fused_and_cached(self, capsys):
+        from paddle_tpu.core import lazy
+        smoke = _load_lazy_smoke()
+        try:
+            ok, report = smoke.run()
+        finally:
+            lazy.enable_lazy(False)
+            lazy._tls.buffer.pending.clear()
+            lazy._tls.buffer.donate.clear()
+        capsys.readouterr()
+        assert ok, report
+        checks = report["checks"]
+        # whole-step capture: <= 2 executable launches per train step
+        assert checks["dispatch_per_step"]["value"] <= 2.0
+        # fingerprinted reuse: steady state is a pure replay
+        assert checks["segment_cache_hit_rate"]["value"] >= 0.9
+        assert checks["steady_state_compiles"]["value"] == 0

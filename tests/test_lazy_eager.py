@@ -272,6 +272,264 @@ def test_lazy_to_static_with_pending_state():
         paddle.disable_static()
 
 
+# ---------------------------------------------------------------------
+# whole-step capture + fingerprinted executable reuse
+# ---------------------------------------------------------------------
+def test_lazy_lenet_full_state_bit_parity():
+    """The whole-step segment must be BIT-identical to per-op eager:
+    losses, every parameter, and every Adam accumulator, after 3 full
+    train steps (fwd + bwd + fused update)."""
+    import contextlib
+    from paddle_tpu.vision.models import LeNet
+
+    def train(lazy_on, steps=3):
+        paddle.seed(7)
+        m = LeNet(num_classes=10)
+        opt = optimizer.Adam(learning_rate=1e-3,
+                             parameters=m.parameters())
+        rng = np.random.RandomState(1)
+        img = paddle.to_tensor(
+            rng.randn(8, 1, 28, 28).astype(np.float32))
+        lab = paddle.to_tensor(
+            rng.randint(0, 10, (8,)).astype(np.int64))
+        cm = paddle.incubate.lazy_eager() if lazy_on else \
+            contextlib.nullcontext()
+        losses = []
+        with cm:
+            for _ in range(steps):
+                loss = F.cross_entropy(m(img), lab)
+                loss.backward()
+                opt.step()
+                opt.clear_grad()
+                losses.append(float(loss))
+            params = [np.asarray(p.numpy()) for p in m.parameters()]
+            accs = [np.asarray(t.numpy())
+                    for _, d in sorted(opt._accumulators.items())
+                    for _, t in sorted(d.items())]
+        return losses, params, accs
+
+    l_ref, p_ref, a_ref = train(False)
+    l_got, p_got, a_got = train(True)
+    assert l_got == l_ref                     # exact, not allclose
+    assert len(a_got) == len(a_ref) > 0
+    for got, ref in zip(p_got + a_got, p_ref + a_ref):
+        np.testing.assert_array_equal(got, ref)
+
+
+def test_lazy_fused_bn_segment_close_parity():
+    """BatchNorm models: fusing fwd+bwd into ONE program lets XLA round
+    the BN backward reductions differently than per-op programs (pure
+    jax.jit(whole) vs split jits reproduces this with no paddle code
+    involved), so the guarantee is tight allclose, not bit-equality —
+    the same caveat to_static carries."""
+    import contextlib
+
+    def train(lazy_on, steps=3):
+        paddle.seed(3)
+        m = nn.Sequential(nn.Conv2D(3, 8, 3), nn.BatchNorm2D(8),
+                          nn.ReLU(), nn.Flatten(), nn.Linear(8 * 6 * 6, 5))
+        opt = optimizer.Momentum(learning_rate=0.01, momentum=0.9,
+                                 parameters=m.parameters())
+        rng = np.random.RandomState(0)
+        img = paddle.to_tensor(
+            rng.randn(4, 3, 8, 8).astype(np.float32))
+        lab = paddle.to_tensor(
+            rng.randint(0, 5, (4,)).astype(np.int64))
+        cm = paddle.incubate.lazy_eager() if lazy_on else \
+            contextlib.nullcontext()
+        losses = []
+        with cm:
+            for _ in range(steps):
+                loss = F.cross_entropy(m(img), lab)
+                loss.backward()
+                opt.step()
+                opt.clear_grad()
+                losses.append(float(loss))
+            stats = [np.asarray(b.numpy()) for b in m.buffers()]
+        return losses, stats
+
+    l_ref, s_ref = train(False)
+    l_got, s_got = train(True)
+    np.testing.assert_allclose(l_got, l_ref, rtol=1e-5, atol=1e-6)
+    for got, ref in zip(s_got, s_ref):        # running stats track
+        np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-6)
+
+
+def test_lazy_cross_thread_flush():
+    """A tensor recorded on one thread may be read from another
+    (checkpoint / logging threads): force() flushes the buffer that
+    OWNS the node, not the reader's thread-local buffer."""
+    import threading
+
+    with paddle.incubate.lazy_eager():
+        x = paddle.to_tensor(np.ones((4, 4), np.float32))
+        y = x * 2.0 + 1.0
+        assert isinstance(y._value, lazy.LazyValue)
+        box = {}
+
+        def reader():
+            box["val"] = np.asarray(y.numpy())
+            box["pending_here"] = len(lazy._tls.buffer.pending)
+
+        t = threading.Thread(target=reader)
+        t.start()
+        t.join()
+        np.testing.assert_allclose(box["val"], np.full((4, 4), 3.0))
+        assert box["pending_here"] == 0       # worker's own buffer
+        assert len(lazy._tls.buffer.pending) == 0, \
+            "producer's buffer was not flushed by the cross-thread read"
+
+
+def test_lazy_watermark_env_rereads(monkeypatch):
+    """PADDLE_TPU_LAZY_MAX_NODES is re-read at enable_lazy(), so jobs
+    retune the watermark without a restart; a loop that never reads
+    values flushes at the cap."""
+    old = lazy._AUTO_FLUSH_NODES
+    monkeypatch.setenv("PADDLE_TPU_LAZY_MAX_NODES", "16")
+    try:
+        with paddle.incubate.lazy_eager():
+            assert lazy._AUTO_FLUSH_NODES == 16
+            before = lazy.stats["flushes"]
+            x = paddle.to_tensor(np.float32(1.0))
+            for _ in range(40):
+                x = x + 1
+            assert len(lazy._tls.buffer.pending) <= 16
+            assert lazy.stats["flushes"] > before
+            assert float(x) == 41.0
+    finally:
+        lazy._AUTO_FLUSH_NODES = old
+
+
+def test_lazy_control_flow_flush_counts():
+    """Value-dependent control flow is a real sync point: the branch
+    condition flushes the pending segment (counted), and ops recorded
+    after it start a fresh segment."""
+    with paddle.incubate.lazy_eager():
+        before = lazy.stats["flushes"]
+        x = paddle.to_tensor(np.float32(2.0))
+        y = x * 3
+        if float(y) > 5.0:                    # forces a flush
+            z = y + 1
+        assert lazy.stats["flushes"] == before + 1
+        assert isinstance(z._value, lazy.LazyValue)
+        assert float(z) == 7.0
+
+
+def test_lazy_fingerprint_hit_and_shape_miss():
+    """Same structure + same leaf avals -> pure cache hit (no retrace);
+    a leaf SHAPE change is a different fingerprint -> compile."""
+    def step(n):
+        x = paddle.to_tensor(np.ones((n, n), np.float32))
+        return float((x * 2.0 + 1.0).sum())
+
+    with paddle.incubate.lazy_eager():
+        step(4)
+        s0 = dict(lazy.stats)
+        assert step(4) == step(4)             # two replays
+        s1 = dict(lazy.stats)
+        assert s1["cache_hits"] - s0["cache_hits"] == 2
+        assert s1["compiles"] == s0["compiles"], "replay retraced"
+        step(5)                               # shape change
+        s2 = dict(lazy.stats)
+        assert s2["compiles"] == s1["compiles"] + 1
+        assert s2["cache_hits"] == s1["cache_hits"]
+
+
+def test_lazy_scalar_hoist_no_thrash():
+    """Bare python scalars are hoisted to weak-typed traced leaves, so a
+    CHANGING scalar (lr schedules, loss scales) replays the same
+    executable instead of fingerprinting a new segment per value."""
+    x = paddle.to_tensor(np.ones((3, 3), np.float32))
+
+    def step(k):
+        # one code shape for warmup and loop: the liveness mask (which
+        # outputs materialize) is part of the fingerprint, so the
+        # warmup must hold references exactly like the replay does
+        return float((x * k).sum())
+
+    with paddle.incubate.lazy_eager():
+        step(2.0)                             # compile once
+        s0 = dict(lazy.stats)
+        for k in (3.0, 4.5, 7.25):
+            assert step(k) == 9 * k
+        s1 = dict(lazy.stats)
+        assert s1["compiles"] == s0["compiles"], \
+            "changing python scalar retraced the segment"
+        assert s1["cache_hits"] - s0["cache_hits"] == 3
+
+
+def test_eager_fwd_cache_lru_eviction(monkeypatch):
+    """The per-op jit cache evicts least-recently-USED past the cap
+    (the old insert-stop silently disabled caching for every op past
+    the first N), and evictions are counted into stats + registry."""
+    from paddle_tpu.core import dispatch
+    from paddle_tpu import observability as obs
+    from paddle_tpu.observability.registry import get_registry
+
+    saved = list(dispatch._eager_fwd_cache.items())
+    dispatch._eager_fwd_cache.clear()
+    monkeypatch.setattr(dispatch, "_EAGER_JIT_MAX", 4)
+    ev0 = dispatch.cache_evictions["fwd"]
+    try:
+        with obs.enabled_scope():
+            reg0 = get_registry().counter("eager.cache_evictions").value
+            with paddle.no_grad():
+                for n in range(1, 7):         # 6 distinct signatures
+                    t = paddle.to_tensor(np.ones((n,), np.float32))
+                    (t + 1.0).numpy()
+                assert len(dispatch._eager_fwd_cache) <= 4
+                assert dispatch.cache_evictions["fwd"] >= ev0 + 2
+                # LRU not FIFO: touching an old entry keeps it alive
+                keys = list(dispatch._eager_fwd_cache)
+                t = paddle.to_tensor(np.ones((3,), np.float32))
+                (t + 1.0).numpy()             # hit -> moves to back
+                assert list(dispatch._eager_fwd_cache)[-1] in keys
+            reg1 = get_registry().counter("eager.cache_evictions").value
+            assert reg1 > reg0
+    finally:
+        dispatch._eager_fwd_cache.clear()
+        dispatch._eager_fwd_cache.update(saved)
+
+
+@pytest.mark.serve
+def test_lazy_traced_model_serves_through_engine():
+    """A model whose params were mutated under lazy mode (pending
+    LazyValues in the weights) serves through GenerationEngine
+    unchanged: the engine's trace forces pending state cleanly."""
+    from paddle_tpu.inference.serving import GenerationEngine
+    from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLM
+
+    cfg = GPTConfig(vocab_size=97, hidden_size=32, num_hidden_layers=2,
+                    num_attention_heads=4, max_position_embeddings=64)
+    paddle.seed(7)
+    model = GPTForCausalLM(cfg)
+    model.eval()
+    rng = np.random.RandomState(0)
+    prompts = [list(rng.randint(1, 97, size=n)) for n in (3, 7, 5)]
+
+    with paddle.incubate.lazy_eager():
+        # identity-rescale every param lazily (the optimizer's in-place
+        # rebind path): weights now hold pending LazyValues when the
+        # engine first traces the model
+        for p in model.parameters():
+            p._inplace_update((p * 1.0)._value)
+        assert any(isinstance(p._value, lazy.LazyValue)
+                   for p in model.parameters())
+        ref = []
+        for p in prompts:
+            ids = paddle.to_tensor(np.asarray([p], np.int64))
+            ref.append(np.asarray(
+                model.generate(ids, max_new_tokens=6).numpy())[0]
+                .tolist())
+        eng = GenerationEngine(model, num_blocks=64, max_batch=3,
+                               max_model_len=64, prefill_chunk=16)
+        try:
+            got = eng.generate(prompts, max_new_tokens=6)
+        finally:
+            eng.close()
+    assert got == ref
+
+
 def test_lazy_prunes_dead_intermediates():
     """Intermediates with no external reference at flush time must NOT
     be materialized as program outputs (buffer-reuse/DCE inside the
