@@ -15,13 +15,15 @@ hint.  Entry points:
 * ``observability.lint_summary_table()`` — render recorded findings.
 """
 from . import (diagnostics, dtype_audit, fabric_audit, host_sync,
-               moe_audit, recompile, sharding_audit, tiling)
+               lora_audit, moe_audit, recompile, sharding_audit, tiling)
 from .diagnostics import (CODES, ERROR, INFO, SEVERITIES, WARNING,
                           Diagnostic, DiagnosticLog, DiagnosticReport,
                           describe_code, get_log, record, reset_log)
 from .dtype_audit import audit_jaxpr, check_collective_payload, iter_eqns
 from .fabric_audit import audit_fabric_handoff, handoff_bytes_per_block
 from .fault_lint import audit_fault_sites, scan_fault_references
+from .lora_audit import (audit_adapter_working_set, audit_lora_rank,
+                         simulate_adapter_store)
 from .moe_audit import audit_expert_capacity, audit_routing_balance
 from .host_sync import audit_host_sync, sync_budget
 from .sharding_audit import audit_sharding, check_collective_axis
@@ -30,19 +32,22 @@ from .recompile import (audit_eager_cache, audit_executor_cache,
                         audit_trace_cache, audit_weak_types)
 from .tiling import (LANE, VMEM_BYTES, audit_flash_attention,
                      audit_grouped_matmul, audit_layer_norm_residual,
-                     audit_matmul_epilogue, audit_paged_attention,
+                     audit_lora_sgmv, audit_matmul_epilogue,
+                     audit_paged_attention,
                      audit_ragged_attention, check_block_spec,
                      check_pallas_call, estimate_vmem_bytes, min_tile)
 
 __all__ = [
     "CODES", "ERROR", "INFO", "LANE", "SEVERITIES", "VMEM_BYTES",
     "WARNING", "Diagnostic", "DiagnosticLog", "DiagnosticReport",
-    "analyze_runtime", "analyze_traced", "audit_eager_cache",
+    "analyze_runtime", "analyze_traced", "audit_adapter_working_set",
+    "audit_eager_cache",
     "audit_executor_cache", "audit_expert_capacity",
     "audit_fabric_handoff",
     "audit_fault_sites", "audit_flash_attention",
     "audit_grouped_matmul", "audit_host_sync",
-    "audit_jaxpr", "audit_layer_norm_residual", "audit_matmul_epilogue",
+    "audit_jaxpr", "audit_layer_norm_residual", "audit_lora_rank",
+    "audit_lora_sgmv", "audit_matmul_epilogue",
     "audit_paged_attention", "audit_ragged_attention",
     "audit_routing_balance",
     "audit_sharding", "audit_trace_cache", "check_collective_axis",
@@ -50,6 +55,8 @@ __all__ = [
     "check_pallas_call", "describe_code", "diagnostics", "dtype_audit",
     "estimate_vmem_bytes", "fabric_audit", "get_log",
     "handoff_bytes_per_block", "host_sync", "iter_eqns",
-    "lint_summary", "min_tile", "moe_audit", "record", "recompile",
-    "reset_log", "scan_fault_references", "sync_budget", "tiling",
+    "lint_summary", "lora_audit", "min_tile", "moe_audit", "record",
+    "recompile",
+    "reset_log", "scan_fault_references", "simulate_adapter_store",
+    "sync_budget", "tiling",
 ]

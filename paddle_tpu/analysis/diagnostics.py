@@ -101,6 +101,12 @@ CODES = {
     "TPU508": ("expert routing imbalance: a hot expert's load is far "
                "above the mean, so dropless grouped blocks pad (wasted "
                "MXU cycles) and capacity routers drop", WARNING),
+    "TPU509": ("adapter-store thrash: the live adapter working set "
+               "exceeds the HBM slot pool, so the store keeps spilling "
+               "and re-promoting adapters on the decode path", WARNING),
+    "TPU510": ("LoRA rank below the dtype's minimum sublane tile: the "
+               "packed stacks zero-pad every adapter to the tile floor "
+               "and the SGMV dots multiply the padding", WARNING),
     # -- fault-site registry (TPU6xx) ----------------------------------
     "TPU601": ("fault-site reference not in the FAULT_SITES registry: "
                "chaos schedules can never reach it, and a typo'd site "

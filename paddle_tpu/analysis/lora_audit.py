@@ -1,0 +1,138 @@
+"""Multi-LoRA serving analyzers: TPU509 / TPU510, pure arithmetic.
+
+The paged adapter store (``inference/serving/lora.py``) and the
+segmented SGMV epilogue (``ops/pallas_grouped.py``) each have one
+failure mode decidable before any chip time is spent:
+
+* the STORE holds ``num_slots`` adapters in HBM and spills the rest to
+  host RAM; a tenant mix whose *working set* exceeds the pool turns
+  every admission into a spill + promote DMA on the decode path —
+  **TPU509**.  The audit replays a request trace through the store's
+  exact LRU policy, so a planned trace answers the question a live
+  ``serving.lora_hit_rate`` gauge answers after the fact;
+* the KERNEL packs every adapter at ``lora_rank_pad(rank, dtype)``
+  (the Mosaic sublane floor: 8 rows f32, 16 bf16, 32 int8), so a rank
+  below the floor zero-pads each stack and the low-rank dots multiply
+  the padding — **TPU510** quantifies the wasted fraction (a rank-4
+  bf16 adapter does 75% dead work; bump the rank or keep f32 stacks).
+
+Both are callable from the lint CLI over a planned config as easily as
+from a live trace.
+"""
+from __future__ import annotations
+
+from collections import OrderedDict
+
+import numpy as np
+
+from .diagnostics import Diagnostic, DiagnosticReport, record
+
+__all__ = ["audit_adapter_working_set", "audit_lora_rank",
+           "simulate_adapter_store"]
+
+
+def simulate_adapter_store(trace, num_slots):
+    """Replay ``trace`` (request adapter ids, ``None`` = base model)
+    through the store's LRU policy: hit = adapter already resident,
+    miss = a promote, spill = an eviction the promote forced.  Returns
+    ``(hits, misses, spills)``.  Matches `LoRAAdapterStore` exactly for
+    serial traces (the common planning case: refcounts don't pin)."""
+    lru = OrderedDict()
+    hits = misses = spills = 0
+    for name in trace:
+        if name is None:
+            continue
+        if name in lru:
+            lru.move_to_end(name)
+            hits += 1
+            continue
+        misses += 1
+        if len(lru) >= max(int(num_slots), 1):
+            lru.popitem(last=False)
+            spills += 1
+        lru[name] = True
+    return hits, misses, spills
+
+
+def audit_adapter_working_set(trace, num_slots, *, bytes_per_slot=None,
+                              threshold=0.5, site="lora.store",
+                              report=None, emit=True):
+    """TPU509: does the HBM slot pool hold this tenant mix's working
+    set?
+
+    ``trace`` is a sequence of per-request adapter names (``None``
+    rows are base-model traffic and don't touch the store) — a planned
+    tenant mix, or the replay of a live one.  Flags when the simulated
+    LRU hit rate lands below ``threshold`` AND the distinct-adapter
+    count actually exceeds the pool (a cold-start miss per adapter is
+    not thrash).  With ``bytes_per_slot`` the finding also quantifies
+    the promote traffic per 1k requests."""
+    report = report if report is not None else DiagnosticReport(
+        label="lora adapter working set")
+    names = [t for t in trace if t is not None]
+    distinct = len(set(names))
+    hits, misses, spills = simulate_adapter_store(trace, num_slots)
+    total = hits + misses
+    rate = hits / total if total else 1.0
+    data = {"num_slots": int(num_slots), "distinct": distinct,
+            "requests": total, "hit_rate": round(rate, 3),
+            "spills": spills, "threshold": float(threshold)}
+    if bytes_per_slot and total:
+        data["promote_mb_per_1k"] = round(
+            misses * float(bytes_per_slot) / total * 1000 / 2**20, 1)
+    if distinct > int(num_slots) and rate < threshold:
+        traffic = (f", ~{data['promote_mb_per_1k']} MB promoted per 1k "
+                   "requests" if "promote_mb_per_1k" in data else "")
+        d = Diagnostic(
+            "TPU509",
+            f"{distinct} distinct adapters over {num_slots} HBM slots: "
+            f"simulated LRU hit rate {rate:.0%} (threshold "
+            f"{threshold:.0%}), {spills} spills over {total} "
+            f"adapter-carrying requests{traffic}",
+            site=site,
+            hint="raise PADDLE_TPU_LORA_STORE_BUDGET (or enable_lora("
+                 "num_slots=...)) toward the working set, or shard hot "
+                 "tenants across replicas so each store sees a subset",
+            data=data)
+        if emit:
+            record(d)
+        report.add(d)
+    return report
+
+
+def audit_lora_rank(rank, dtype="float32", *, site="lora.rank",
+                    report=None, emit=True):
+    """TPU510: does ``rank`` reach the dtype's minimum sublane tile?
+
+    The packed stacks always tile at ``lora_rank_pad(rank, dtype)``
+    rows; a rank below that floor is stored — and multiplied — as
+    zeros.  Quantifies ``1 - rank / r_pad`` (the dead fraction of both
+    SGMV dots and of every adapter's HBM slot)."""
+    import jax.numpy as jnp
+
+    from ..ops.pallas_grouped import lora_rank_pad
+    from ..ops.pallas_tiles import _min_rows
+
+    report = report if report is not None else DiagnosticReport(
+        label="lora rank tiling")
+    jdtype = jnp.dtype(dtype)
+    floor = _min_rows(jdtype)
+    r_pad = lora_rank_pad(rank, jdtype)
+    if int(rank) < floor:
+        waste = 1.0 - int(rank) / r_pad
+        d = Diagnostic(
+            "TPU510",
+            f"rank {rank} below the {jdtype.name} sublane floor "
+            f"{floor}: stacks pad to r={r_pad}, {waste:.0%} of the "
+            "SGMV rank dimension (and of every HBM slot) is zeros",
+            site=site,
+            hint=f"raise the rank to {floor} (free capacity — the "
+                 "padding is already paid for), or keep the stacks in "
+                 "float32 where the floor is 8",
+            data={"rank": int(rank), "r_pad": int(r_pad),
+                  "floor": int(floor), "dtype": jdtype.name,
+                  "waste_frac": round(waste, 3)})
+        if emit:
+            record(d)
+        report.add(d)
+    return report

@@ -25,7 +25,8 @@ __all__ = ["LANE", "VMEM_BYTES", "min_tile", "check_block_spec",
            "check_pallas_call", "estimate_vmem_bytes",
            "audit_flash_attention", "audit_paged_attention",
            "audit_ragged_attention", "audit_layer_norm_residual",
-           "audit_matmul_epilogue", "audit_grouped_matmul"]
+           "audit_matmul_epilogue", "audit_grouped_matmul",
+           "audit_lora_sgmv"]
 
 LANE = 128
 # per-core VMEM; Mosaic needs headroom for double buffering, so the
@@ -230,6 +231,26 @@ def audit_grouped_matmul(tokens, k, n, num_experts, dtype="float32",
     site = (f"grouped_matmul.{direction}"
             f"[{np.dtype(dtype).name} tokens={tokens} k={k} n={n} "
             f"e={num_experts}]")
+    report = check_pallas_call(
+        plan["operands"], scratch=plan.get("scratch", ()), site=site)
+    report.plan = plan
+    return report
+
+
+def audit_lora_sgmv(tokens, k, n, rank, num_adapters, dtype="float32",
+                    direction="fwd", block_rows=None):
+    """Statically validate the segmented LoRA SGMV epilogue block plan
+    (see ``ops.pallas_grouped.lora_epilogue_block_plan``).
+
+    The scalar-prefetched ``block_adapter`` descriptor is untiled and
+    omitted from the plan, like the grouped kernel's ``block_group``."""
+    from ..ops.pallas_grouped import lora_epilogue_block_plan
+    plan = lora_epilogue_block_plan(tokens, k, n, rank, num_adapters,
+                                    dtype=dtype, direction=direction,
+                                    block_rows=block_rows)
+    site = (f"lora_sgmv.{direction}"
+            f"[{np.dtype(dtype).name} tokens={tokens} k={k} n={n} "
+            f"r={rank} adapters={num_adapters}]")
     report = check_pallas_call(
         plan["operands"], scratch=plan.get("scratch", ()), site=site)
     report.plan = plan

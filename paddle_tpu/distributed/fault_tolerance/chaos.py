@@ -60,7 +60,8 @@ __all__ = ["ChaosSchedule", "bursty_trace", "serving_site_inventory",
 def bursty_trace(seed, n_requests=8, vocab=97, prefix_pool=4,
                  prefix_len=16, tail_max=5, zipf_a=1.5, pareto_a=1.3,
                  max_new_tokens=6, horizon=24, arrival_rate=None,
-                 duration=None):
+                 duration=None, adapter_pool=0, adapter_zipf=1.3,
+                 adapter_none_frac=0.25):
     """Deterministic synthetic serving trace.
 
     Arrival gaps are heavy-tailed (Pareto): most requests land in one
@@ -79,6 +80,15 @@ def bursty_trace(seed, n_requests=8, vocab=97, prefix_pool=4,
     horizon stretches to cover ``duration``.  Prompt construction (and
     its RNG draws) is identical in both modes; with the knob unset the
     output is byte-for-byte the historical trace for the same seed.
+
+    Tenant mode: ``adapter_pool > 0`` tags each request with an
+    ``"adapter"`` key — Zipf-popular ids ``"t0".."t{pool-1}"`` (rank-k
+    probability ~ k^-adapter_zipf), with ``adapter_none_frac`` of the
+    traffic left as base-model ``None`` rows — the mix the multi-LoRA
+    store drills against.  The tags ride a SEPARATE RNG stream, so
+    turning the pool on (or resizing it) never shifts the arrival /
+    prompt draws, and with the knob at its 0 default the dicts are
+    byte-for-byte the historical trace: no extra draws, no new key.
     """
     sustained = arrival_rate is not None and duration is not None
     if sustained:
@@ -90,6 +100,13 @@ def bursty_trace(seed, n_requests=8, vocab=97, prefix_pool=4,
                 for _ in range(prefix_pool)]
     ranks = np.arange(1, prefix_pool + 1, dtype=np.float64) ** -zipf_a
     probs = ranks / ranks.sum()
+    a_rng = a_probs = None
+    if adapter_pool:
+        # separate stream: tenant tags never perturb the prompt draws
+        a_rng = np.random.RandomState([int(seed), 0xADA])
+        a_ranks = np.arange(1, int(adapter_pool) + 1,
+                            dtype=np.float64) ** -float(adapter_zipf)
+        a_probs = a_ranks / a_ranks.sum()
     t = 0.0
     out = []
     for i in range(int(n_requests)):
@@ -100,9 +117,14 @@ def bursty_trace(seed, n_requests=8, vocab=97, prefix_pool=4,
         p = int(rng.choice(prefix_pool, p=probs))
         tail = [int(x) for x in
                 rng.randint(1, vocab, size=1 + int(rng.randint(tail_max)))]
-        out.append({"arrival_step": min(int(t), horizon - 1),
-                    "prompt": prefixes[p] + tail,
-                    "max_new_tokens": int(max_new_tokens)})
+        req = {"arrival_step": min(int(t), horizon - 1),
+               "prompt": prefixes[p] + tail,
+               "max_new_tokens": int(max_new_tokens)}
+        if adapter_pool:
+            base = a_rng.random_sample() < float(adapter_none_frac)
+            aid = int(a_rng.choice(int(adapter_pool), p=a_probs))
+            req["adapter"] = None if base else f"t{aid}"
+        out.append(req)
     return out
 
 

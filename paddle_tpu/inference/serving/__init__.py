@@ -34,6 +34,11 @@ from .speculative import (ENV_SPEC_DRAFT, ENV_SPEC_K,
                           NgramProposer, SpeculativeConfig, spec_draft,
                           spec_k)
 from .slo import SLOPolicy, TenantSpec
+from .lora import (ENV_LORA_STORE_BUDGET, AdapterStoreFull,
+                   LoRAAdapterStore, SegmentAdapterState,
+                   attach_lora_sites, convert_to_lora, load_lora_state_dict,
+                   lora_state_dict, lora_store_budget, merge_lora,
+                   unmerge_lora)
 from .streaming import (ENV_STREAM_QUEUE, StreamEvent, TokenStream,
                         stream_queue_depth)
 from .errors import (RequestRejected, ServingError, ServingStepTimeout,
@@ -70,6 +75,10 @@ __all__ = [
     "NgramProposer", "DraftModelProposer", "DraftWorker", "spec_k",
     "spec_draft",
     "SLOPolicy", "TenantSpec",
+    "ENV_LORA_STORE_BUDGET", "AdapterStoreFull", "LoRAAdapterStore",
+    "SegmentAdapterState", "attach_lora_sites", "convert_to_lora",
+    "load_lora_state_dict", "lora_state_dict", "lora_store_budget",
+    "merge_lora", "unmerge_lora",
     "ENV_STREAM_QUEUE", "StreamEvent", "TokenStream",
     "stream_queue_depth",
     "RequestRejected", "ServingError", "ServingStepTimeout",

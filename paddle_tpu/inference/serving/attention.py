@@ -445,6 +445,11 @@ class RaggedLayerCache:
         self._view = view
         self._layer = layer
 
+    @property
+    def lora(self):
+        """The multi-LoRA segment state (serving.lora), or None."""
+        return self._view.lora
+
     def attend(self, q, k, v, use_flash=True):
         """Scatter this step's K/V into the pool, then run ragged
         attention over every segment — prefill chunks and decode rows
@@ -505,8 +510,14 @@ class RaggedCacheView:
         self.q_valids = None       # [T // block_q] int32
         self.last_index = None     # [S, C] int32 flat sampling indices
         self.sample_pos = None     # [S, C] int64 absolute sampling pos
+        self.lora = None           # SegmentAdapterState when multi-LoRA on
         self._layers = [RaggedLayerCache(self, i)
                         for i in range(cache.num_layers)]
+
+    def set_lora(self, state):
+        """Attach the multi-LoRA segment state (serving.lora); model
+        layers reach it through their layer cache as ``cache.lora``."""
+        self.lora = state
 
     def __getitem__(self, layer):
         return self._layers[layer]

@@ -276,7 +276,7 @@ class ClusterRouter:
             depth += 1
         return depth * int(record["block_size"])
 
-    def _route(self, tokens, exclude=()):
+    def _route(self, tokens, exclude=(), adapter=None):
         """dp.py's affinity-with-skew-guard routing, with the affinity
         term coming from GOSSIP instead of a shared-address-space
         index probe."""
@@ -287,7 +287,8 @@ class ClusterRouter:
                 "inactive or backing off)")
         loads = {i: self._load(self._engines[i]) for i in eligible}
         min_load = min(loads.values())
-        hashes = self._engines[eligible[0]].cache.chain_hashes(tokens)
+        hashes = self._engines[eligible[0]].cache.chain_hashes(
+            tokens, adapter=adapter)
         aff = {i: self._gossip_affinity(i, hashes) for i in eligible}
         best = max(eligible, key=lambda i: (aff[i], -loads[i], -i))
         if (aff[best] > 0 and loads[best] - min_load
@@ -304,7 +305,7 @@ class ClusterRouter:
             request_id = f"clreq{self._req_counter}"
         self._req_counter += 1
         prompt_list = [int(t) for t in prompt]
-        i = self._route(prompt_list)
+        i = self._route(prompt_list, adapter=kwargs.get("adapter"))
         with obs.tag(shard=f"host{i}"):
             self._engines[i].add_request(prompt_list,
                                          request_id=request_id,
@@ -418,7 +419,7 @@ class ClusterRouter:
         req.cached_prefix = 0
         req.row = None
         req.preemptions += 1
-        i = self._route(req.prompt)
+        i = self._route(req.prompt, adapter=req.adapter)
         self._engines[i].scheduler.submit(req)
         if stream is not None:
             self._engines[i]._streams[req.id] = stream
@@ -432,6 +433,7 @@ class ClusterRouter:
         for req in list(eng.scheduler.running):
             if req.row is not None:
                 eng._rows[req.row] = None
+            eng._lora_release(req)
             if eng.proposer is not None:
                 eng.proposer.drop(req.id)
             eng.scheduler.requeue(req, req.generated)
@@ -444,7 +446,8 @@ class ClusterRouter:
         eng = self._engines[src]
         try:
             for req in moved:
-                i = self._route(req.prompt, exclude=exclude)
+                i = self._route(req.prompt, exclude=exclude,
+                                adapter=req.adapter)
                 self._engines[i].scheduler.submit(req)
                 self._owner[req.id] = ("host", i)
                 st = eng._streams.pop(req.id, None)

@@ -143,7 +143,7 @@ class DisaggregatedEngine:
         return (eng.scheduler.queue_depth + len(eng.scheduler.running)
                 + len(eng._pending))
 
-    def _route(self, engines, health, prompt, exclude=()):
+    def _route(self, engines, health, prompt, exclude=(), adapter=None):
         """dp.py's affinity-with-skew-guard routing over one engine
         group; raises ServingUnavailable when the group is down."""
         eligible = [i for i in range(len(engines))
@@ -155,7 +155,8 @@ class DisaggregatedEngine:
                 "and backing off)")
         loads = {i: self._load(engines[i]) for i in eligible}
         min_load = min(loads.values())
-        aff = {i: engines[i].cache.prefix_match_tokens(prompt)
+        aff = {i: engines[i].cache.prefix_match_tokens(
+                   prompt, adapter=adapter)
                for i in eligible}
         best = max(eligible, key=lambda i: (aff[i], -loads[i], -i))
         if (aff[best] > 0
@@ -173,7 +174,8 @@ class DisaggregatedEngine:
         self._req_counter += 1
         prompt_list = [int(t) for t in prompt]
         i, affinity = self._route(self.prefills, self.phealth,
-                                  prompt_list)
+                                  prompt_list,
+                                  adapter=kwargs.get("adapter"))
         if affinity > 0:
             obs.get_registry().counter("serving.prefix_routed").inc()
         with obs.tag(shard=f"prefill{i}"):
@@ -263,7 +265,8 @@ class DisaggregatedEngine:
             req, length, payload, stream, t0, delivery = item
             tokens = (list(req.prompt) + list(req.generated))[:length]
             try:
-                j, _ = self._route(self.decodes, self.dhealth, tokens)
+                j, _ = self._route(self.decodes, self.dhealth, tokens,
+                                   adapter=req.adapter)
             except ServingUnavailable:
                 retry.append(item)
                 break                     # group down: park everything
@@ -309,6 +312,7 @@ class DisaggregatedEngine:
         for req in list(eng.scheduler.running):
             if req.row is not None:
                 eng._rows[req.row] = None
+            eng._lora_release(req)
             if eng.proposer is not None:
                 eng.proposer.drop(req.id)
             eng.scheduler.requeue(req, req.generated)
@@ -325,7 +329,8 @@ class DisaggregatedEngine:
         try:
             for req in moved:
                 i, _ = self._route(self.prefills, self.phealth,
-                                   req.prompt, exclude=exclude)
+                                   req.prompt, exclude=exclude,
+                                   adapter=req.adapter)
                 self.prefills[i].scheduler.submit(req)
                 self._owner[req.id] = ("p", i)
                 st = eng._streams.pop(req.id, None)

@@ -185,7 +185,7 @@ class DataParallelEngine:
         return (eng.scheduler.queue_depth + len(eng.scheduler.running)
                 + len(eng._pending))
 
-    def _route(self, prompt, exclude=()):
+    def _route(self, prompt, exclude=(), adapter=None):
         """Pick the replica for ``prompt``: longest cached prefix wins
         (warm KV makes its prefill nearly free), with a least-loaded
         fallback and a skew guard — affinity may cost at most one extra
@@ -198,7 +198,8 @@ class DataParallelEngine:
                 f"{self.dp} are unhealthy and backing off)")
         loads = {i: self._load(i) for i in eligible}
         min_load = min(loads.values())
-        aff = {i: self.engines[i].cache.prefix_match_tokens(prompt)
+        aff = {i: self.engines[i].cache.prefix_match_tokens(
+                   prompt, adapter=adapter)
                for i in eligible}
         best = max(eligible, key=lambda i: (aff[i], -loads[i], -i))
         if (aff[best] > 0
@@ -218,7 +219,8 @@ class DataParallelEngine:
             request_id = f"dpreq{self._req_counter}"
         self._req_counter += 1
         prompt_list = [int(t) for t in prompt]
-        shard, affinity = self._route(prompt_list)
+        shard, affinity = self._route(prompt_list,
+                                      adapter=kwargs.get("adapter"))
         if affinity > 0:
             obs.get_registry().counter("serving.prefix_routed").inc()
         with obs.tag(shard=f"dp{shard}"):
@@ -270,6 +272,7 @@ class DataParallelEngine:
         for req in list(eng.scheduler.running):
             if req.row is not None:
                 eng._rows[req.row] = None
+            eng._lora_release(req)
             if eng.proposer is not None:
                 eng.proposer.drop(req.id)
             eng.scheduler.requeue(req, req.generated)
@@ -280,7 +283,8 @@ class DataParallelEngine:
         try:
             for req in moved:
                 target, affinity = self._route(req.prompt,
-                                               exclude=(replica,))
+                                               exclude=(replica,),
+                                               adapter=req.adapter)
                 tgt = self.engines[target]
                 tgt.scheduler.submit(req)     # keeps t_submit: honest TTFT
                 self._owner[req.id] = target

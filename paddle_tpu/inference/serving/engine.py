@@ -298,6 +298,12 @@ class GenerationEngine:
             shed_depth = int(v) if v else 0
         self.shed_depth = int(shed_depth or 0)
 
+        # multi-LoRA tenancy (lora.py): enable_lora() builds the paged
+        # adapter store and the per-q-block segment descriptor BEFORE
+        # the first trace; requests then carry an adapter id
+        self._lora = None
+        self._lora_held = {}      # req.id -> adapter pinned for it
+
         self._rows = [None] * self.max_batch
         self._last_tokens = jnp.zeros((self.max_batch,), jnp.int64)
         self._pending = []        # [(rows_reqs, device_tokens)]
@@ -325,11 +331,87 @@ class GenerationEngine:
                 logits, view.last_index, seeds, view.sample_pos,
                 do_sample, top_k, top_p, temperature)
 
+    # -- multi-LoRA tenancy ---------------------------------------------
+    def enable_lora(self, rank=8, alpha=None, targets=None,
+                    num_slots=None, budget=None):
+        """Build the paged adapter store over this engine's model and
+        stage the all-null segment descriptor.  MUST run before the
+        first step (the ONE compiled program reads the descriptor and
+        the store's device stacks as staged state — enabling later
+        would mean a second program).  Without an explicit size, the
+        ``PADDLE_TPU_LORA_STORE_BUDGET`` env sizes the store, falling
+        back to ``max_batch`` slots — enough that every running row
+        can pin a distinct adapter, so admission never starves.
+        Returns the store."""
+        from .lora import (LoRAAdapterStore, SegmentAdapterState,
+                           attach_lora_sites, lora_store_budget)
+        if self._lora is not None:
+            return self._lora.store
+        if len(self._step_fn._cache):
+            raise RuntimeError(
+                "enable_lora() must run before the first step: the "
+                "compiled step program is already traced without the "
+                "adapter epilogue")
+        sites = attach_lora_sites(self.model, targets=targets)
+        param = next(iter(self.model.parameters()))
+        if num_slots is None and budget is None \
+                and lora_store_budget() is None:
+            num_slots = self.max_batch
+        store = LoRAAdapterStore(
+            sites, rank, dtype=param.dtype, alpha=alpha,
+            num_slots=num_slots, budget=budget)
+        self._lora = SegmentAdapterState(store, self.block_q)
+        self._lora.stage(np.full(self.num_q_blocks, store.null_slot,
+                                 np.int32))
+        self._view.set_lora(self._lora)
+        return store
+
+    def register_adapter(self, name, weights, alpha=None, rank=None):
+        """Land one adapter in the store's host tier (see
+        ``LoRAAdapterStore.register_adapter``); requires
+        ``enable_lora()`` first."""
+        if self._lora is None:
+            raise RuntimeError("enable_lora() first")
+        return self._lora.store.register_adapter(name, weights,
+                                                 alpha=alpha, rank=rank)
+
+    def _lora_acquire(self, req):
+        """Pin the request's adapter into a device slot (idempotent —
+        a requeued request re-admits without double-counting)."""
+        if self._lora is None or req.adapter is None:
+            return
+        if req.id in self._lora_held:
+            return
+        self._lora.store.acquire(req.adapter)
+        self._lora_held[req.id] = req.adapter
+
+    def _lora_release(self, req):
+        """Drop the request's pin; the slot parks LRU-evictable."""
+        if self._lora is None:
+            return
+        name = self._lora_held.pop(req.id, None)
+        if name is not None:
+            self._lora.store.release(name)
+
     # -- public API -----------------------------------------------------
     def add_request(self, prompt, max_new_tokens=16, do_sample=False,
                     top_k=0, top_p=1.0, temperature=1.0, seed=0,
-                    eos_token_id=None, request_id=None, tenant=None):
-        """Enqueue one prompt; returns the request id."""
+                    eos_token_id=None, request_id=None, tenant=None,
+                    adapter=None):
+        """Enqueue one prompt; returns the request id.  ``adapter``
+        selects a registered LoRA adapter (None = base model); a
+        tenant-tagged request with no explicit adapter inherits its
+        ``TenantSpec.adapter``."""
+        if adapter is None and tenant is not None and self.slo is not None:
+            spec = self.slo.tenants.get(tenant)
+            if spec is not None:
+                adapter = spec.adapter
+        if adapter is not None:
+            if self._lora is None:
+                raise ValueError(
+                    f"adapter={adapter!r} requires enable_lora()")
+            if not self._lora.store.has_adapter(adapter):
+                raise KeyError(f"adapter {adapter!r} is not registered")
         prompt = [int(t) for t in np.asarray(prompt).reshape(-1)]
         if not prompt:
             raise ValueError("empty prompt")
@@ -357,7 +439,8 @@ class GenerationEngine:
         req = Request(request_id, prompt, max_new_tokens=max_new_tokens,
                       do_sample=do_sample, top_k=top_k, top_p=top_p,
                       temperature=temperature, seed=seed,
-                      eos_token_id=eos_token_id, tenant=tenant)
+                      eos_token_id=eos_token_id, tenant=tenant,
+                      adapter=adapter)
         self.scheduler.submit(req)
         obs.get_registry().gauge("serving.queue_depth").set(
             self.scheduler.queue_depth)
@@ -445,6 +528,7 @@ class GenerationEngine:
             req.row = None
         self.scheduler.running.remove(req)
         self.cache.free(req.id, tokens=tokens)
+        self._lora_release(req)
         if self.proposer is not None:
             self.proposer.drop(req.id)
         stream = self._streams.pop(req.id, None)
@@ -464,10 +548,17 @@ class GenerationEngine:
             raise KeyError(f"sequence {req.id!r} already allocated")
         if None not in self._rows:
             return False
+        if req.adapter is not None:
+            if self._lora is None \
+                    or not self._lora.store.has_adapter(req.adapter):
+                raise KeyError(
+                    f"adapter {req.adapter!r} is not registered here")
         tokens = (list(req.prompt) + list(req.generated))[:length]
         if not self.cache.import_sequence(req.id, tokens, length,
-                                          payload):
+                                          payload,
+                                          adapter=req.adapter):
             return False
+        self._lora_acquire(req)
         row = self._rows.index(None)
         self._rows[row] = req
         req.row = row
@@ -555,16 +646,24 @@ class GenerationEngine:
                  step_aborts=self._step_aborts,
                  shed_requests=self._shed_requests,
                  alloc_fails=self._alloc_fails)
+        if self._lora is not None:
+            ls = self._lora.store.stats()
+            s.update(lora=ls, adapter_hit_rate=ls["hit_rate"])
         return s
 
     def close(self):
         if self.proposer is not None:
             self.proposer.close()
+        if self._lora is not None:
+            self._lora.store.close()
         self.cache.close()
 
     # -- admission ------------------------------------------------------
     def _admit(self, req):
         """Allocate the prompt (prefix-aware) and seat the request."""
+        # pin the adapter FIRST: an AdapterStoreFull leaves the
+        # scheduler and the KV pool untouched
+        self._lora_acquire(req)
         self.scheduler.begin_prefill(req)
         row = self._rows.index(None)
         self._rows[row] = req
@@ -638,6 +737,7 @@ class GenerationEngine:
                     generated=len(victim.generated))
         if victim.row is not None:
             self._rows[victim.row] = None
+        self._lora_release(victim)
         if self.proposer is not None:
             self.proposer.drop(victim.id)
         self.scheduler.requeue(victim, victim.generated)
@@ -666,6 +766,7 @@ class GenerationEngine:
                 self.cache.truncate(req.id, appended[req.id])
             if req.row is not None:
                 self._rows[req.row] = None
+            self._lora_release(req)
             if self.proposer is not None:
                 self.proposer.drop(req.id)
             self.scheduler.requeue(req, req.generated)
@@ -721,6 +822,10 @@ class GenerationEngine:
         ctx = np.zeros(S, np.int32)
         last_index = np.zeros(S, np.int32)
         sample_pos = np.zeros(S, np.int64)
+        lora_slots = None        # q-block -> adapter device slot
+        if self._lora is not None:
+            lora_slots = np.full(NQB, self._lora.store.null_slot,
+                                 np.int32)
 
         flat = 0
         rows_reqs = []           # rows that sample a token this step
@@ -732,6 +837,8 @@ class GenerationEngine:
             seq_ids[seg] = r
             q_starts[seg] = length - 1
             q_valids[seg] = 1
+            if lora_slots is not None and req.adapter is not None:
+                lora_slots[seg] = self._lora.store.slot_of(req.adapter)
             slots[flat] = self.cache.slot_mapping(
                 req.id, length - 1, 1)[0]
             positions[0, flat] = length - 1
@@ -754,6 +861,9 @@ class GenerationEngine:
                 seq_ids[flat // BQ + j] = r
                 q_starts[flat // BQ + j] = start + j * BQ
                 q_valids[flat // BQ + j] = min(BQ, n - j * BQ)
+            if lora_slots is not None and req.adapter is not None:
+                lora_slots[flat // BQ:flat // BQ + nseg] = \
+                    self._lora.store.slot_of(req.adapter)
             tables[r] = self.cache.block_table(req.id)
             ctx[r] = start + n
             if start + n == len(req.prompt):
@@ -766,6 +876,8 @@ class GenerationEngine:
         self._view.set_inputs(slots, tables, ctx, positions, seq_ids,
                               q_starts, q_valids, last_index,
                               sample_pos)
+        if lora_slots is not None:
+            self._lora.stage(lora_slots)
         args = self._control_tensors(
             [self._rows[r] for r in range(S)], S)
         ids_dev = jnp.asarray(ids)
@@ -869,6 +981,10 @@ class GenerationEngine:
         ctx = np.zeros(S, np.int32)
         last_index = np.zeros((S, C), np.int32)
         sample_pos = np.zeros((S, C), np.int64)
+        lora_slots = None        # q-block -> adapter device slot
+        if self._lora is not None:
+            lora_slots = np.full(NQB, self._lora.store.null_slot,
+                                 np.int32)
 
         flat = 0
         spec_rows = []           # (req, base, drafts)
@@ -881,6 +997,8 @@ class GenerationEngine:
             seq_ids[seg] = r
             q_starts[seg] = base
             q_valids[seg] = w
+            if lora_slots is not None and req.adapter is not None:
+                lora_slots[seg] = self._lora.store.slot_of(req.adapter)
             # feed = last committed token + the draft continuation
             ids[0, flat] = req.generated[-1]
             if d:
@@ -909,6 +1027,9 @@ class GenerationEngine:
                 seq_ids[flat // BQ + j] = r
                 q_starts[flat // BQ + j] = start + j * BQ
                 q_valids[flat // BQ + j] = min(BQ, n - j * BQ)
+            if lora_slots is not None and req.adapter is not None:
+                lora_slots[flat // BQ:flat // BQ + nseg] = \
+                    self._lora.store.slot_of(req.adapter)
             tables[r] = self.cache.block_table(req.id)
             ctx[r] = start + n
             if start + n == len(req.prompt):
@@ -921,6 +1042,8 @@ class GenerationEngine:
         self._view.set_inputs(slots, tables, ctx, positions, seq_ids,
                               q_starts, q_valids, last_index,
                               sample_pos)
+        if lora_slots is not None:
+            self._lora.stage(lora_slots)
         args = self._control_tensors(
             [self._rows[r] for r in range(S)], S)
         ids_t = self._tensor(ids)
@@ -1048,6 +1171,7 @@ class GenerationEngine:
             if req.done:
                 if req.row is not None:
                     self._rows[req.row] = None
+                self._lora_release(req)
                 # same wall clock as t_first_token so per-request TPOT
                 # ((t_finish - t_first_token) / (n-1)) is consistent
                 req.t_finish = time.perf_counter()

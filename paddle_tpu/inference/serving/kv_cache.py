@@ -208,6 +208,7 @@ class PagedKVCache:
         self._free = list(range(self.num_blocks - 1, 0, -1))  # pop() → 1
         self._tables = {}      # seq_id -> [block ids]
         self._lengths = {}     # seq_id -> tokens stored
+        self._seq_adapter = {} # seq_id -> LoRA adapter id (None = base)
         # prefix-cache state
         self._ref = {}         # block -> refcount (blocks in any table)
         self._hash_of = {}     # block -> chain hash (full prefix blocks)
@@ -346,7 +347,8 @@ class PagedKVCache:
     def blocks_needed(self, num_tokens):
         return -(-int(num_tokens) // self.block_size)
 
-    def can_allocate(self, num_tokens, tokens=None, headroom=0):
+    def can_allocate(self, num_tokens, tokens=None, headroom=0,
+                     adapter=None):
         """Admission check; with ``tokens`` prefix-cache hits count as
         already available (a hit parked in the LRU is reactivated, not
         consumed from the free capacity).  ``headroom`` blocks are held
@@ -354,7 +356,7 @@ class PagedKVCache:
         admission that consumed them could be preempted right back out
         by the very decode appends it displaced, and the retry would
         livelock."""
-        chain = self._walk_chain(tokens, num_tokens)
+        chain = self._walk_chain(tokens, num_tokens, adapter=adapter)
         hbm_hits = [ref for _, kind, ref in chain if kind == "hbm"]
         # a HOST hit still consumes a physical block (the promotion
         # DMAs into a fresh one) — only HBM hits reduce the need
@@ -367,17 +369,21 @@ class PagedKVCache:
                     + len(self._cached_free) - hits_parked)
         return need + int(headroom) <= capacity
 
-    def _chain_hash(self, prev, block_tokens):
+    def _chain_hash(self, prev, block_tokens, adapter=None):
         # the chain root is seeded with the pool dtype so a bf16 block
         # and an int8 block holding the same tokens can never alias
         # (their stored bytes differ) — matters when tables/hashes
         # migrate across pools, e.g. a failover replay onto a replica
-        # configured with a different PADDLE_TPU_KV_DTYPE
+        # configured with a different PADDLE_TPU_KV_DTYPE.  The LoRA
+        # adapter id seeds the root the same way: an adapter changes
+        # the K/V bytes every layer writes, so two tenants prefilling
+        # the same prompt must never alias cache entries
         if prev is None:
-            prev = str(self._jdtype)
+            prev = (str(self._jdtype),
+                    None if adapter is None else str(adapter))
         return hash((prev, tuple(int(t) for t in block_tokens)))
 
-    def _walk_chain(self, tokens, num_tokens):
+    def _walk_chain(self, tokens, num_tokens, adapter=None):
         """``[(hash, tier, ref)]`` for the longest cached block-aligned
         prefix of ``tokens``, resolved against BOTH tiers: ``("hbm",
         block_id)`` entries are sharable in place, ``("host", slot)``
@@ -394,7 +400,8 @@ class PagedKVCache:
         for b in range(min(len(tokens), int(num_tokens)) // bs):
             if (b + 1) * bs > max_reuse:
                 break
-            h = self._chain_hash(h, tokens[b * bs:(b + 1) * bs])
+            h = self._chain_hash(h, tokens[b * bs:(b + 1) * bs],
+                                 adapter=adapter)
             blk = self._by_hash.get(h)
             if blk is not None:
                 chain.append((h, "hbm", blk))
@@ -406,11 +413,12 @@ class PagedKVCache:
             break
         return chain
 
-    def _prefix_hits(self, tokens, num_tokens):
+    def _prefix_hits(self, tokens, num_tokens, adapter=None):
         """HBM-resident blocks covering the longest cached prefix that
         needs NO promotion DMA (legacy view of ``_walk_chain``)."""
         hits = []
-        for _, kind, ref in self._walk_chain(tokens, num_tokens):
+        for _, kind, ref in self._walk_chain(tokens, num_tokens,
+                                             adapter=adapter):
             if kind != "hbm":
                 break
             hits.append(ref)
@@ -596,20 +604,23 @@ class PagedKVCache:
         else:
             self._free.append(blk)
 
-    def allocate(self, seq_id, num_tokens, tokens=None):
+    def allocate(self, seq_id, num_tokens, tokens=None, adapter=None):
         """Reserve blocks for a sequence's first ``num_tokens`` tokens
         (prefill).  With ``tokens`` (the prompt) the prefix index is
         consulted and every leading cached block is SHARED instead of
         reserved fresh — ``cached_prefix_len()`` reports how many
-        tokens the caller may skip.  Raises KeyError on duplicate ids,
-        returns False when the pool cannot hold it."""
+        tokens the caller may skip.  ``adapter`` keys the chain hashes
+        (and is remembered for the sequence's later commits), so
+        tenants only ever share cache with themselves.  Raises KeyError
+        on duplicate ids, returns False when the pool cannot hold
+        it."""
         if seq_id in self._tables:
             raise KeyError(f"sequence {seq_id!r} already allocated")
         # Chaos site: an injected allocation failure fires BEFORE any
         # pool mutation, so a failed admission provably leaks nothing.
         from ...distributed.fault_tolerance.plan import fault_point
         fault_point("serve.alloc_fail")
-        chain = self._walk_chain(tokens, num_tokens)
+        chain = self._walk_chain(tokens, num_tokens, adapter=adapter)
         hbm_hits = [ref for _, kind, ref in chain if kind == "hbm"]
         host_slots = [ref for _, kind, ref in chain if kind == "host"]
         # host hits avoid the RECOMPUTE but still need a physical block
@@ -664,9 +675,12 @@ class PagedKVCache:
             # recomputed — the engine sees a shorter cached prefix,
             # never the failure
             self._drop_host(failed_h)
-            return self.allocate(seq_id, num_tokens, tokens)
+            return self.allocate(seq_id, num_tokens, tokens,
+                                 adapter=adapter)
         self._tables[seq_id] = table
         self._lengths[seq_id] = int(num_tokens)
+        if adapter is not None:
+            self._seq_adapter[seq_id] = adapter
         cached = len(chain) * self.block_size
         self._cached_len[seq_id] = cached
         if self.prefix_cache and tokens is not None:
@@ -676,7 +690,7 @@ class PagedKVCache:
         self._update_gauges()
         return True
 
-    def prefix_match_tokens(self, tokens):
+    def prefix_match_tokens(self, tokens, adapter=None):
         """How many leading tokens of ``tokens`` this pool could serve
         from its prefix cache RIGHT NOW, without allocating anything.
         Used by the data-parallel router to send a request (or a
@@ -688,10 +702,11 @@ class PagedKVCache:
             return 0
         # num_tokens = len+1 lifts the "leave one to compute" cap so a
         # full-prompt match counts every block.
-        chain = self._walk_chain(tokens, len(tokens) + 1)
+        chain = self._walk_chain(tokens, len(tokens) + 1,
+                                 adapter=adapter)
         return len(chain) * self.block_size
 
-    def chain_hashes(self, tokens):
+    def chain_hashes(self, tokens, adapter=None):
         """The block-granular chain-hash ladder of ``tokens`` —
         ``hashes[b]`` identifies the prefix covering blocks ``0..b``.
         Pure arithmetic over the token ids (no index lookups), so the
@@ -701,7 +716,8 @@ class PagedKVCache:
         out = []
         h = None
         for b in range(len(tokens) // bs):
-            h = self._chain_hash(h, tokens[b * bs:(b + 1) * bs])
+            h = self._chain_hash(h, tokens[b * bs:(b + 1) * bs],
+                                 adapter=adapter)
             out.append(h)
         return out
 
@@ -742,11 +758,13 @@ class PagedKVCache:
             return
         bs = self.block_size
         table = self._tables[seq_id]
+        adapter = self._seq_adapter.get(seq_id)
         n = min(int(len(tokens)), self._lengths[seq_id]) // bs
         h = None
         for b in range(n):
             blk = table[b]
-            h = self._chain_hash(h, tokens[b * bs:(b + 1) * bs])
+            h = self._chain_hash(h, tokens[b * bs:(b + 1) * bs],
+                                 adapter=adapter)
             stored = self._hash_of.get(blk)
             if stored is not None:
                 if stored == h:
@@ -892,6 +910,7 @@ class PagedKVCache:
         blocks = self._tables.pop(seq_id)
         self._lengths.pop(seq_id, None)
         self._cached_len.pop(seq_id, None)
+        self._seq_adapter.pop(seq_id, None)
         for blk in reversed(blocks):
             self._release(blk)
         self._update_gauges()
@@ -941,7 +960,8 @@ class PagedKVCache:
         _observe_dma("export", nbytes, time.perf_counter() - t0)
         return payload
 
-    def import_sequence(self, seq_id, tokens, length, payload):
+    def import_sequence(self, seq_id, tokens, length, payload,
+                        adapter=None):
         """Adopt a sequence prefilled in ANOTHER pool: allocate blocks
         here, device-put every block the local prefix cache doesn't
         already hold from ``payload``, and commit the chain hashes so
@@ -963,7 +983,7 @@ class PagedKVCache:
         length = int(length)
         # num_tokens = length+1 lifts the leave-one-to-compute cap:
         # nothing is left to compute, the payload carries every byte
-        chain = self._walk_chain(tokens, length + 1)
+        chain = self._walk_chain(tokens, length + 1, adapter=adapter)
         hbm_hits = [ref for _, kind, ref in chain if kind == "hbm"]
         host_slots = [ref for _, kind, ref in chain if kind == "host"]
         need = self.blocks_needed(length) - len(hbm_hits)
@@ -1016,6 +1036,8 @@ class PagedKVCache:
             self._host_pin.difference_update(host_slots)
         self._tables[seq_id] = table
         self._lengths[seq_id] = length
+        if adapter is not None:
+            self._seq_adapter[seq_id] = adapter
         cached = len(chain) * self.block_size
         self._cached_len[seq_id] = cached
         if self.prefix_cache and tokens is not None:

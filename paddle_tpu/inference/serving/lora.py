@@ -1,0 +1,561 @@
+"""Multi-LoRA tenancy: the paged adapter store + serving-side plumbing.
+
+One base model, hundreds of tenants, each with a cheap LoRA fine-tune
+— the production shape of the ROADMAP's "millions of users" — served
+through the SAME unified ragged step.  Three pieces live here:
+
+* :func:`convert_to_lora` / :func:`merge_lora` / :func:`unmerge_lora`
+  — the checkpoint retarget path.  A converted ``nn.Linear`` grows
+  trainable ``lora_A``/``lora_B`` parameters (base weight frozen) and
+  routes its forward through the segmented SGMV epilogue
+  (``ops.pallas_grouped.lora_segment_epilogue``), whose custom-vjp
+  backward makes per-tenant fine-tuning run through the same kernel
+  serving uses.  The adapter round-trips through ``state_dict`` like
+  any checkpointed tensor; :func:`lora_state_dict` extracts the packed
+  per-site form :meth:`LoRAAdapterStore.register_adapter` consumes.
+
+* :class:`LoRAAdapterStore` — the paged adapter store.  Packed A/B
+  stacks for every converted site live in fixed device arrays of
+  ``num_slots`` adapter slots (the ``HostKVPool`` pattern from the KV
+  tier applied to adapter weights): host RAM holds every registered
+  adapter's packed bytes (the spill tier and source of truth), HBM
+  holds the refcounted hot set.  ``acquire`` promotes on demand,
+  evicting the LRU refcount-0 slot when full; ``release`` parks a slot
+  reclaimable without dropping its bytes, so the next acquire is a
+  hit.  Promotion rewrites one slot of each site's stack IN PLACE
+  (``tensor._value`` swap — the same staging contract as the KV cache
+  views), so the ONE compiled step program sees adapter loads and
+  evictions without recompiling.  The store registers with the memory
+  guard as a named resident (device stacks) plus a host line item, and
+  publishes hit/miss/spill counters and residency gauges.
+
+* :class:`SegmentAdapterState` — the view-side handle the engine
+  stages each step: the per-q-block adapter descriptor (``[NQB]``
+  int32 of device slot ids, ``store.null_slot`` for adapter-less rows)
+  plus the dispatch helper model layers call.  Null rows ride the
+  epilogue's appended zero expert, so their output is exactly the base
+  model's.
+
+Knobs: ``PADDLE_TPU_LORA_STORE_BUDGET`` (device bytes for the hot
+stacks, "64M"/"1G" form) sizes ``num_slots`` when not given
+explicitly; ``adapter=`` on ``GenerationEngine.add_request`` (or
+``TenantSpec.adapter`` for SLO-managed tenants) selects the adapter
+per request.
+"""
+from __future__ import annotations
+
+import os
+import time
+
+import numpy as np
+import jax.numpy as jnp
+
+from ... import observability as obs
+from ...core.tensor import Tensor
+from ...ops.pallas_grouped import lora_rank_pad
+from .tiering import _parse_bytes
+
+__all__ = ["ENV_LORA_STORE_BUDGET", "DEFAULT_LORA_TARGETS",
+           "lora_store_budget", "AdapterStoreFull", "attach_lora_sites",
+           "convert_to_lora", "merge_lora", "unmerge_lora",
+           "lora_state_dict", "load_lora_state_dict",
+           "LoRAAdapterStore", "SegmentAdapterState"]
+
+ENV_LORA_STORE_BUDGET = "PADDLE_TPU_LORA_STORE_BUDGET"
+RESIDENT_NAME = "lora adapter store"
+
+#: the linears a GPT-family block exposes; attention qkv/out plus both
+#: MLP projections — the classic LoRA target set
+DEFAULT_LORA_TARGETS = ("qkv_proj", "out_proj", "fc1", "fc2")
+
+
+def lora_store_budget():
+    """Device-byte budget for the hot adapter stacks
+    (PADDLE_TPU_LORA_STORE_BUDGET, bytes or 64M/1G form; None =
+    unset)."""
+    return _parse_bytes(os.environ.get(ENV_LORA_STORE_BUDGET, ""))
+
+
+class AdapterStoreFull(RuntimeError):
+    """Every device slot is pinned by an in-flight request: the mixed
+    batch references more distinct adapters than the store holds.
+    Raise ``num_slots`` (or the byte budget), or admit fewer distinct
+    tenants at once."""
+
+
+# -- site discovery -------------------------------------------------------
+
+def attach_lora_sites(model, targets=None):
+    """Walk ``model`` and mark every target ``nn.Linear`` with its
+    structured name as ``lora_site`` (the key adapters and the store
+    agree on).  Returns ``[(site, in_features, out_features)]`` in
+    walk order — the site list a :class:`LoRAAdapterStore` is built
+    from.  Idempotent; int8-converted layers (no float ``weight``) are
+    skipped."""
+    from ... import nn
+    targets = tuple(targets or DEFAULT_LORA_TARGETS)
+    sites = []
+    for name, layer in model.named_sublayers():
+        if not isinstance(layer, nn.Linear):
+            continue
+        if name.rsplit(".", 1)[-1] not in targets:
+            continue
+        w = getattr(layer, "weight", None)
+        if w is None:
+            continue
+        layer.lora_site = name
+        k, n = (int(s) for s in w.shape)
+        sites.append((name, k, n))
+    return sites
+
+
+# -- the checkpoint retarget path ----------------------------------------
+
+def convert_to_lora(model, rank=8, alpha=None, targets=None):
+    """Convert every target ``nn.Linear`` under ``model`` to LoRA
+    fine-tuning: freeze the base ``weight``/``bias`` and add trainable
+    ``lora_A`` ([in, r], normal init) / ``lora_B`` ([r, out], zeros —
+    the delta starts at exactly 0) parameters.  The layer forward then
+    routes the delta through the segmented SGMV epilogue (single-
+    adapter segment), so fine-tuning exercises the same kernel — and
+    the same custom-vjp backward — that multi-tenant serving runs.
+    Both new parameters round-trip through ``state_dict``.  Returns
+    the ``[(site, k, n)]`` list of converted sites."""
+    from ... import nn
+    from ...nn import initializer as I
+    from ...nn.layer.layers import create_parameter
+    alpha = float(alpha if alpha is not None else rank)
+    sites = attach_lora_sites(model, targets=targets)
+    by_name = dict(model.named_sublayers())
+    for site, k, n in sites:
+        layer = by_name[site]
+        if getattr(layer, "lora_A", None) is not None:
+            continue  # already converted
+        a = create_parameter([k, int(rank)], dtype=layer.weight.dtype,
+                             default_initializer=I.Normal(0.0, 0.02))
+        b = create_parameter([int(rank), n], dtype=layer.weight.dtype,
+                             default_initializer=I.Constant(0.0))
+        layer.add_parameter("lora_A", a)
+        layer.add_parameter("lora_B", b)
+        layer.weight.stop_gradient = True
+        if layer.bias is not None:
+            layer.bias.stop_gradient = True
+        layer.lora_rank = int(rank)
+        layer.lora_alpha = alpha
+        layer.lora_scaling = alpha / float(rank)
+        layer.lora_merged = False
+    return sites
+
+
+def _lora_layers(model):
+    from ... import nn
+    for name, layer in model.named_sublayers():
+        if isinstance(layer, nn.Layer) \
+                and getattr(layer, "lora_A", None) is not None:
+            yield name, layer
+
+
+def _delta(layer):
+    """The merged-weight delta ``A @ B * (alpha/r)`` in f32, cast to
+    the weight dtype.  Merge and unmerge compute it identically, so
+    ``merge -> unmerge`` restores the float add/sub pair exactly."""
+    a = layer.lora_A._value.astype(jnp.float32)
+    b = layer.lora_B._value.astype(jnp.float32)
+    return (a @ b * layer.lora_scaling).astype(layer.weight._value.dtype)
+
+
+def merge_lora(model):
+    """Fold every adapter delta into its base weight (dense serving of
+    ONE adapter with zero per-step overhead); the LoRA branch then
+    short-circuits.  Idempotent."""
+    for _, layer in _lora_layers(model):
+        if layer.lora_merged:
+            continue
+        layer.weight._inplace_update(layer.weight._value + _delta(layer))
+        layer.lora_merged = True
+    return model
+
+
+def unmerge_lora(model):
+    """Subtract the folded delta back out, re-enabling the live LoRA
+    branch (and further fine-tuning).  Idempotent."""
+    for _, layer in _lora_layers(model):
+        if not layer.lora_merged:
+            continue
+        layer.weight._inplace_update(layer.weight._value - _delta(layer))
+        layer.lora_merged = False
+    return model
+
+
+def lora_state_dict(model):
+    """Extract the adapter alone: ``{site: {"A", "B", "rank",
+    "alpha"}}`` with numpy arrays — the packed per-site form
+    :meth:`LoRAAdapterStore.register_adapter` consumes directly, and
+    the portable half of a per-tenant checkpoint."""
+    out = {}
+    for name, layer in _lora_layers(model):
+        out[name] = {"A": np.asarray(layer.lora_A._value),
+                     "B": np.asarray(layer.lora_B._value),
+                     "rank": int(layer.lora_rank),
+                     "alpha": float(layer.lora_alpha)}
+    return out
+
+
+def load_lora_state_dict(model, state):
+    """Retarget a converted model's adapter in place (the hot-swap
+    path: same site set, new bytes — no retrace, no reallocation)."""
+    for name, layer in _lora_layers(model):
+        if name not in state:
+            continue
+        entry = state[name]
+        layer.lora_A._inplace_update(jnp.asarray(
+            entry["A"], layer.lora_A._value.dtype))
+        layer.lora_B._inplace_update(jnp.asarray(
+            entry["B"], layer.lora_B._value.dtype))
+    return model
+
+
+# -- the paged adapter store ---------------------------------------------
+
+class LoRAAdapterStore:
+    """HBM slot pool for packed per-site A/B adapter stacks.
+
+    Layout per site ``(k, n)``: ``A_stack [num_slots, k, r_pad]`` and
+    ``B_stack [num_slots, r_pad, n]`` where ``r_pad`` rounds the store
+    rank up to the dtype's sublane minimum.  The ``alpha/r`` scale is
+    folded into the packed B at registration, so the kernel never sees
+    a scale operand and a merged base weight (``W + A @ B_packed``)
+    uses byte-identical factors.  Slot ``num_slots`` is the epilogue
+    op's implicit appended zero expert — :attr:`null_slot` — and holds
+    no storage.
+
+    Residency: ``acquire`` pins (refcount++), ``release`` unpins; a
+    refcount-0 slot parks in LRU order and is the eviction candidate
+    when a miss needs a slot.  Eviction is a pure bookkeeping spill —
+    host RAM always holds every registered adapter's packed bytes, so
+    a later promote re-lands bit-identical weights."""
+
+    def __init__(self, sites, rank, dtype="float32", alpha=None,
+                 num_slots=None, budget=None, register=True,
+                 resident_name=None):
+        from collections import OrderedDict
+        from ...core.dtypes import to_jax_dtype
+        if not sites:
+            raise ValueError("no LoRA sites (attach_lora_sites found "
+                             "no target linears)")
+        self._site_order = [str(name) for name, _, _ in sites]
+        self.sites = {str(name): (int(k), int(n))
+                      for name, k, n in sites}
+        self.rank = int(rank)
+        self.alpha = float(alpha if alpha is not None else rank)
+        self.scaling = self.alpha / float(self.rank)
+        self._jdtype = jnp.dtype(to_jax_dtype(dtype))
+        self.r_pad = lora_rank_pad(self.rank, self._jdtype)
+        per_slot = sum(k * self.r_pad + self.r_pad * n
+                       for k, n in self.sites.values())
+        self.bytes_per_slot = per_slot * self._jdtype.itemsize
+        if num_slots is None:
+            if budget is None:
+                budget = lora_store_budget()
+            if budget:
+                num_slots = max(1, int(budget) // self.bytes_per_slot)
+            else:
+                num_slots = 8
+        self.num_slots = int(num_slots)
+        self._stacks = {}
+        for name in self._site_order:
+            k, n = self.sites[name]
+            a = Tensor(jnp.zeros((self.num_slots, k, self.r_pad),
+                                 self._jdtype),
+                       _internal=True, stop_gradient=True)
+            a.name = f"lora.store.{name}.a"
+            b = Tensor(jnp.zeros((self.num_slots, self.r_pad, n),
+                                 self._jdtype),
+                       _internal=True, stop_gradient=True)
+            b.name = f"lora.store.{name}.b"
+            self._stacks[name] = (a, b)
+        self._host = {}              # name -> {site: (A_np, B_np)}
+        self._slot_names = [None] * self.num_slots
+        self._refs = [0] * self.num_slots
+        self._resident = {}          # name -> slot
+        self._lru = OrderedDict()    # refcount-0 resident, LRU first
+        self._hits = self._misses = self._spills = 0
+        self.resident_name = resident_name or RESIDENT_NAME
+        self._registered = False
+        self._host_registered = False
+        if register:
+            self._register_resident()
+        self._update_gauges()
+
+    # -- memory guard ----------------------------------------------------
+    @property
+    def device_bytes(self):
+        return self.num_slots * self.bytes_per_slot
+
+    @property
+    def host_bytes(self):
+        return len(self._host) * self.bytes_per_slot
+
+    @property
+    def host_resident_name(self):
+        return f"{self.resident_name} host tier"
+
+    def _register_resident(self):
+        from ...memory.guard import register_resident
+        register_resident(
+            self.resident_name, self.device_bytes,
+            buffer_ids=lambda: {id(t._value)
+                                for ab in self._stacks.values()
+                                for t in ab})
+        self._registered = True
+
+    def _register_host(self):
+        if not self._registered:
+            return
+        from ...memory.guard import register_resident
+        register_resident(self.host_resident_name, self.host_bytes,
+                          host=True)
+        self._host_registered = True
+
+    def close(self):
+        from ...memory.guard import unregister_resident
+        if self._registered:
+            unregister_resident(self.resident_name)
+            self._registered = False
+        if self._host_registered:
+            unregister_resident(self.host_resident_name, host=True)
+            self._host_registered = False
+
+    # -- registration (the host tier) ------------------------------------
+    def _pack(self, site, a, b, scaling):
+        """Pad [k, r] / [r, n] to the store rank and fold the scale
+        into B (f32 multiply, then cast — deterministic bytes)."""
+        k, n = self.sites[site]
+        a = np.asarray(a)
+        b = np.asarray(b)
+        r = a.shape[1]
+        if a.shape != (k, r) or b.shape != (r, n):
+            raise ValueError(
+                f"adapter weights for site {site!r} have shapes "
+                f"{a.shape}/{b.shape}; expected ({k}, r)/(r, {n})")
+        if r > self.r_pad:
+            raise ValueError(
+                f"adapter rank {r} exceeds store rank capacity "
+                f"{self.r_pad} (store rank {self.rank})")
+        ap = np.zeros((k, self.r_pad), self._jdtype)
+        bp = np.zeros((self.r_pad, n), self._jdtype)
+        ap[:, :r] = a.astype(self._jdtype)
+        bp[:r] = (b.astype(np.float32) * float(scaling)).astype(
+            self._jdtype)
+        return ap, bp
+
+    def register_adapter(self, name, weights, alpha=None, rank=None):
+        """Land one adapter's packed bytes in the host tier.
+        ``weights`` is either :func:`lora_state_dict` output or a
+        plain ``{site: (A, B)}`` mapping; sites the adapter does not
+        touch pack as zeros (delta-free).  Registration never touches
+        the device — the first ``acquire`` promotes."""
+        name = str(name)
+        if name in self._host:
+            raise KeyError(f"adapter {name!r} already registered")
+        packed = {}
+        for site in self._site_order:
+            entry = weights.get(site)
+            if entry is None:
+                k, n = self.sites[site]
+                packed[site] = (np.zeros((k, self.r_pad), self._jdtype),
+                                np.zeros((self.r_pad, n), self._jdtype))
+                continue
+            if isinstance(entry, dict):
+                a, b = entry["A"], entry["B"]
+                sc = float(entry.get("alpha", self.alpha)) \
+                    / float(entry.get("rank", self.rank))
+            else:
+                a, b = entry
+                sc = (float(alpha) / float(rank or self.rank)
+                      if alpha is not None else self.scaling)
+            packed[site] = self._pack(site, a, b, sc)
+        self._host[name] = packed
+        self._register_host()
+        self._update_gauges()
+        return name
+
+    def drop_adapter(self, name):
+        """Forget an adapter entirely (both tiers).  Refuses while any
+        in-flight request still pins it."""
+        slot = self._resident.get(name)
+        if slot is not None:
+            if self._refs[slot]:
+                raise RuntimeError(
+                    f"adapter {name!r} is pinned by {self._refs[slot]} "
+                    "in-flight request(s)")
+            self._evict(name)
+        del self._host[name]
+        self._register_host()
+        self._update_gauges()
+
+    def has_adapter(self, name):
+        return name in self._host
+
+    def adapters(self):
+        return list(self._host)
+
+    # -- residency -------------------------------------------------------
+    @property
+    def null_slot(self):
+        """The descriptor value for adapter-less rows: the epilogue
+        op's appended zero expert (== ``num_slots``)."""
+        return self.num_slots
+
+    def pair(self, site):
+        """(A_stack, B_stack) Tensors for one site."""
+        return self._stacks[site]
+
+    def slot_of(self, name):
+        """Device slot of a RESIDENT adapter (KeyError otherwise)."""
+        return self._resident[name]
+
+    def acquire(self, name):
+        """Pin ``name`` into a device slot (promoting if spilled) and
+        return the slot id.  Raises :class:`AdapterStoreFull` when
+        every slot is pinned by other in-flight requests."""
+        if name not in self._host:
+            raise KeyError(f"adapter {name!r} is not registered")
+        slot = self._resident.get(name)
+        if slot is not None:
+            self._hits += 1
+            obs.get_registry().counter("serving.lora_hits").inc()
+            self._lru.pop(name, None)
+            self._refs[slot] += 1
+            self._update_gauges()
+            return slot
+        self._misses += 1
+        obs.get_registry().counter("serving.lora_misses").inc()
+        slot = self._promote(name)
+        self._refs[slot] = 1
+        self._update_gauges()
+        return slot
+
+    def release(self, name):
+        """Unpin one reference; a refcount-0 slot parks LRU-evictable
+        but keeps its bytes, so a re-acquire is a hit."""
+        slot = self._resident.get(name)
+        if slot is None:
+            return
+        self._refs[slot] = max(0, self._refs[slot] - 1)
+        if self._refs[slot] == 0:
+            self._lru[name] = None
+            self._lru.move_to_end(name)
+        self._update_gauges()
+
+    def _free_slot(self):
+        for s, owner in enumerate(self._slot_names):
+            if owner is None:
+                return s
+        if not self._lru:
+            raise AdapterStoreFull(
+                f"all {self.num_slots} adapter slots are pinned by "
+                "in-flight requests")
+        victim, _ = self._lru.popitem(last=False)
+        self._spills += 1
+        obs.get_registry().counter("serving.lora_spills").inc()
+        obs.instant("serving.lora_spill", cat="memory", adapter=victim,
+                    slot=self._resident[victim])
+        return self._evict(victim)
+
+    def _evict(self, name):
+        slot = self._resident.pop(name)
+        self._slot_names[slot] = None
+        self._refs[slot] = 0
+        self._lru.pop(name, None)
+        return slot
+
+    def _promote(self, name):
+        slot = self._free_slot()
+        packed = self._host[name]
+        t0 = time.perf_counter()
+        with obs.span("lora:promote", cat="dma", adapter=name,
+                      slot=slot, bytes=self.bytes_per_slot):
+            for site in self._site_order:
+                a_t, b_t = self._stacks[site]
+                a_np, b_np = packed[site]
+                a_t._value = a_t._value.at[slot].set(jnp.asarray(a_np))
+                b_t._value = b_t._value.at[slot].set(jnp.asarray(b_np))
+        obs.get_registry().histogram("serving.lora_promote_ms").observe(
+            (time.perf_counter() - t0) * 1e3)
+        self._slot_names[slot] = name
+        self._resident[name] = slot
+        return slot
+
+    # -- telemetry -------------------------------------------------------
+    def _update_gauges(self):
+        reg = obs.get_registry()
+        reg.gauge("serving.lora_resident").set(len(self._resident))
+        reg.gauge("serving.lora_registered").set(len(self._host))
+        looked = self._hits + self._misses
+        if looked:
+            reg.gauge("serving.lora_hit_rate").set(self._hits / looked)
+
+    def stats(self):
+        looked = self._hits + self._misses
+        return {"hits": self._hits, "misses": self._misses,
+                "spills": self._spills,
+                "hit_rate": self._hits / looked if looked else 0.0,
+                "resident": len(self._resident),
+                "registered": len(self._host),
+                "num_slots": self.num_slots,
+                "device_bytes": self.device_bytes,
+                "host_bytes": self.host_bytes}
+
+    def __repr__(self):
+        return (f"LoRAAdapterStore(slots={len(self._resident)}/"
+                f"{self.num_slots}, registered={len(self._host)}, "
+                f"rank={self.rank}, sites={len(self.sites)})")
+
+
+# -- the view-side handle -------------------------------------------------
+
+class SegmentAdapterState:
+    """What the ragged cache view carries when multi-LoRA is on: the
+    staged per-q-block adapter descriptor plus the store.  Model
+    layers reach it through their layer cache (``cache.lora``) and
+    call :meth:`apply` after the base matmul."""
+
+    def __init__(self, store, block_q):
+        self.store = store
+        self.block_q = int(block_q)
+        self.block_adapter = None   # [NQB] int32 device slot ids
+
+    def stage(self, slots):
+        """Swap this step's descriptor values (same contract as the
+        cache views' ``_stage``: constant shape, one executable)."""
+        val = jnp.asarray(slots, jnp.int32)
+        if self.block_adapter is None:
+            t = Tensor(val, _internal=True, stop_gradient=True)
+            t.name = "lora.block_adapter"
+            self.block_adapter = t
+        else:
+            self.block_adapter._value = val
+
+    def active(self, layer):
+        site = getattr(layer, "lora_site", None)
+        return site is not None and site in self.store.sites
+
+    def apply(self, z, x, layer, act="none"):
+        """Route ``z = layer(x)`` (pre-activation) through the
+        segmented epilogue: ``act(z + (x @ A[slot]) @ B[slot])`` per
+        q-block.  A layer without a store site passes through (act
+        must be "none" then — callers fuse the activation only where
+        a site exists)."""
+        site = getattr(layer, "lora_site", None)
+        if site is None or site not in self.store.sites:
+            if act != "none":
+                raise ValueError(
+                    f"layer has no adapter site but act={act!r} was "
+                    "deferred to the epilogue")
+            return z
+        a_t, b_t = self.store.pair(site)
+        from ...nn import functional as F
+        return F.lora_segment_act(z, x, a_t, b_t,
+                                  block_adapter=self.block_adapter,
+                                  act=act)

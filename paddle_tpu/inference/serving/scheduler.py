@@ -134,11 +134,11 @@ class Request:
                  "generated", "n_scheduled", "num_computed",
                  "cached_prefix", "row", "arrival", "done",
                  "preemptions", "t_submit", "t_first_token", "t_finish",
-                 "tenant", "stream_offset")
+                 "tenant", "adapter", "stream_offset")
 
     def __init__(self, id, prompt, max_new_tokens=16, do_sample=False,
                  top_k=0, top_p=1.0, temperature=1.0, seed=0,
-                 eos_token_id=None, tenant=None):
+                 eos_token_id=None, tenant=None, adapter=None):
         self.id = id
         self.prompt = list(prompt)
         self.max_new_tokens = int(max_new_tokens)
@@ -149,6 +149,7 @@ class Request:
         self.seed = int(seed)
         self.eos_token_id = eos_token_id
         self.tenant = tenant      # SLO tenant name (None = untagged)
+        self.adapter = adapter    # LoRA adapter id (None = base model)
         self.generated = []       # host-read tokens, in order
         self.n_scheduled = 0      # tokens sampled on device (>= drained)
         self.num_computed = 0     # prompt tokens whose K/V are in cache
@@ -258,7 +259,7 @@ class ContinuousBatchingScheduler:
             headroom = sum(1 for r in self.running if not r.done)
             if req is not None and self.cache.can_allocate(
                     len(req.prompt) + 1, tokens=req.prompt,
-                    headroom=headroom):
+                    headroom=headroom, adapter=req.adapter):
                 return ("admit", req)
             if req is not None and not self.running:
                 need = self.cache.blocks_needed(len(req.prompt) + 1)
@@ -300,7 +301,8 @@ class ContinuousBatchingScheduler:
         prefill starts at the first uncached block."""
         assert self.waiting and self.waiting[0] is request
         if not self.cache.allocate(request.id, len(request.prompt),
-                                   tokens=request.prompt):
+                                   tokens=request.prompt,
+                                   adapter=request.adapter):
             raise RuntimeError(
                 f"allocation for {request.id!r} raced the free list")
         request.cached_prefix = self.cache.cached_prefix_len(request.id)

@@ -55,13 +55,16 @@ class TenantSpec:
     ``burst``: bucket capacity (default 2s worth of refill).
     ``ttft_target_ms`` / ``tpot_target_ms``: deadline targets; both
     optional (None = no deadline pressure, no violation accounting).
+    ``adapter``: the tenant's default LoRA adapter id — requests tagged
+    with this tenant and no explicit ``adapter=`` serve through it
+    (must be registered with the engine's adapter store).
     """
 
     __slots__ = ("name", "priority", "tokens_per_s", "burst",
-                 "ttft_target_ms", "tpot_target_ms")
+                 "ttft_target_ms", "tpot_target_ms", "adapter")
 
     def __init__(self, name, priority=0, tokens_per_s=None, burst=None,
-                 ttft_target_ms=None, tpot_target_ms=None):
+                 ttft_target_ms=None, tpot_target_ms=None, adapter=None):
         self.name = str(name)
         self.priority = int(priority)
         self.tokens_per_s = (None if tokens_per_s is None
@@ -73,6 +76,7 @@ class TenantSpec:
                                else float(ttft_target_ms))
         self.tpot_target_ms = (None if tpot_target_ms is None
                                else float(tpot_target_ms))
+        self.adapter = adapter
 
     def __repr__(self):
         return (f"TenantSpec({self.name!r}, prio={self.priority}, "

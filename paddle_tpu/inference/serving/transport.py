@@ -116,7 +116,7 @@ class TransportTimeout(TransportError):
 # host-local (row, wall-clock stamps) that the adopting host rebuilds.
 _REQ_FIELDS = ("id", "prompt", "max_new_tokens", "do_sample", "top_k",
                "top_p", "temperature", "seed", "eos_token_id", "tenant",
-               "generated", "stream_offset", "preemptions")
+               "adapter", "generated", "stream_offset", "preemptions")
 
 
 def serialize_request(req):
@@ -132,7 +132,8 @@ def deserialize_request(state):
                   do_sample=state["do_sample"], top_k=state["top_k"],
                   top_p=state["top_p"], temperature=state["temperature"],
                   seed=state["seed"], eos_token_id=state["eos_token_id"],
-                  tenant=state["tenant"])
+                  tenant=state["tenant"],
+                  adapter=state.get("adapter"))
     req.generated = [int(t) for t in state["generated"]]
     req.stream_offset = int(state["stream_offset"])
     req.preemptions = int(state["preemptions"])

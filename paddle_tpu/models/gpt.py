@@ -54,15 +54,23 @@ class GPTAttention(nn.Layer):
     def forward(self, x, cache=None, use_cache=False):
         b, s, h = x.shape
         qkv = self.qkv_proj(x)
+        # multi-LoRA serving (inference/serving/lora): per-q-block
+        # adapter deltas ride the segmented SGMV epilogue after each
+        # projection; rows without an adapter hit the zero segment
+        lora = getattr(cache, "lora", None) if cache is not None else None
+        if lora is not None and lora.active(self.qkv_proj):
+            qkv = lora.apply(qkv, x, self.qkv_proj)
         qkv = paddle.reshape(qkv, [b, s, 3, self.num_heads, self.head_dim])
         q, k, v = paddle.unbind(qkv, axis=2)     # each [b, s, nh, hd]
         if cache is not None and hasattr(cache, "attend"):
             # paged serving cache (inference/serving): the layer view
             # scatters K/V into the block pool and attends through the
             # block tables; dense semantics below stay untouched
-            out = cache.attend(q, k, v, use_flash=self.use_flash)
-            out = paddle.reshape(out, [b, s, h])
-            out = self.out_proj(out)
+            attn = cache.attend(q, k, v, use_flash=self.use_flash)
+            attn = paddle.reshape(attn, [b, s, h])
+            out = self.out_proj(attn)
+            if lora is not None and lora.active(self.out_proj):
+                out = lora.apply(out, attn, self.out_proj)
             if use_cache:
                 return out, cache
             return out
@@ -88,16 +96,24 @@ class GPTMLP(nn.Layer):
         self.fc1 = nn.Linear(cfg.hidden_size, cfg.intermediate_size)
         self.fc2 = nn.Linear(cfg.intermediate_size, cfg.hidden_size)
 
-    def forward(self, x):
+    def forward(self, x, lora=None):
         # fc1's bias+gelu fold into the matmul epilogue on TPU
         w_q = getattr(self.fc1, "weight_q", None)
         if w_q is not None:
             h = F.linear_act_int8(x, w_q, self.fc1.weight_scale,
                                   self.fc1.bias, act="gelu_tanh")
+        elif lora is not None and lora.active(self.fc1):
+            # the activation defers past the LoRA delta — the SGMV
+            # epilogue computes act(z + delta) in one fused pass
+            z = F.linear(x, self.fc1.weight, self.fc1.bias)
+            h = lora.apply(z, x, self.fc1, act="gelu_tanh")
         else:
             h = F.linear_act(x, self.fc1.weight, self.fc1.bias,
                              act="gelu_tanh")
-        return self.fc2(h)
+        y = self.fc2(h)
+        if lora is not None and lora.active(self.fc2):
+            y = lora.apply(y, h, self.fc2)
+        return y
 
 
 class GPTBlock(nn.Layer):
@@ -112,13 +128,14 @@ class GPTBlock(nn.Layer):
         self.dropout = nn.Dropout(cfg.hidden_dropout_prob)
 
     def forward(self, x, cache=None, use_cache=False):
+        lora = getattr(cache, "lora", None) if cache is not None else None
         if use_cache:
             a, new_cache = self.attn(self.ln_1(x), cache, True)
             x = x + self.dropout(a)
-            x = x + self.dropout(self.mlp(self.ln_2(x)))
+            x = x + self.dropout(self.mlp(self.ln_2(x), lora=lora))
             return x, new_cache
         x = x + self.dropout(self.attn(self.ln_1(x), cache))
-        x = x + self.dropout(self.mlp(self.ln_2(x)))
+        x = x + self.dropout(self.mlp(self.ln_2(x), lora=lora))
         return x
 
 

@@ -15,7 +15,8 @@ from ...core.tensor import Tensor, to_tensor
 from ...framework.random import default_generator
 
 __all__ = [
-    "linear", "linear_act", "linear_act_int8", "dropout", "dropout2d",
+    "linear", "linear_act", "linear_act_int8", "lora_segment_act",
+    "dropout", "dropout2d",
     "dropout3d",
     "alpha_dropout", "pad",
     "interpolate", "upsample", "cosine_similarity", "pixel_shuffle",
@@ -73,6 +74,56 @@ def linear_act(x, weight, bias=None, act="none", name=None):
 
     args = (x, weight) + ((bias,) if bias is not None else ())
     return dispatch("linear_act", impl, args,
+                    dict(act=act, use_pallas=use_pallas))
+
+
+def lora_segment_act(z, x, lora_a, lora_b, block_adapter=None, act="none",
+                     name=None):
+    """act(z + (x @ A[a]) @ B[a]) — the segmented LoRA SGMV epilogue
+    (``lora_sgmv`` gate).  ``z`` is the base-matmul pre-activation for
+    ``x``; ``lora_a``/``lora_b`` are either one adapter's factors
+    ([in, r]/[r, out] — fine-tuning's single-segment case) or stacked
+    per-adapter factors ([L, in, r]/[L, r, out]) routed per row block
+    by ``block_adapter`` ([num_blocks] int32; the block height is
+    ``rows // num_blocks``; id L selects the appended zero adapter, so
+    those rows get exactly ``act(z)``).  Any scale (alpha/r) must be
+    pre-folded into ``lora_b``."""
+    from ...ops.pallas_fused import ACTIVATIONS
+    if act not in ACTIVATIONS:
+        raise ValueError(
+            f"unknown activation {act!r}; expected one of {ACTIVATIONS}")
+    from ...ops.pallas_gate import pallas_enabled
+    use_pallas = pallas_enabled("lora_sgmv")
+
+    def impl(z, v, a, b, *aid, act, use_pallas=False):
+        from ...ops.pallas_grouped import (lora_segment_epilogue,
+                                           lora_segment_epilogue_ref)
+        from ...ops.pallas_tiles import _min_rows
+        if a.ndim == 2:
+            a, b = a[None], b[None]
+        z2 = z.reshape(-1, z.shape[-1])
+        v2 = v.reshape(-1, v.shape[-1])
+        rows = z2.shape[0]
+        fn = lora_segment_epilogue if use_pallas \
+            else lora_segment_epilogue_ref
+        if aid:
+            out = fn(z2, v2, a, b, block_adapter=aid[0], act=act)
+        else:
+            # single-adapter: every block is segment 0; pad the row
+            # count to a legal block height (pad rows see x=0, so the
+            # delta there is 0, and they are sliced back off)
+            bm = _min_rows(z2.dtype)
+            pad = (-rows) % bm
+            if pad:
+                z2 = jnp.pad(z2, ((0, pad), (0, 0)))
+                v2 = jnp.pad(v2, ((0, pad), (0, 0)))
+            blk = jnp.zeros(((rows + pad) // bm,), jnp.int32)
+            out = fn(z2, v2, a, b, block_adapter=blk, act=act)[:rows]
+        return out.reshape(z.shape)
+
+    args = (z, x, lora_a, lora_b) + (
+        (block_adapter,) if block_adapter is not None else ())
+    return dispatch("lora_segment_act", impl, args,
                     dict(act=act, use_pallas=use_pallas))
 
 

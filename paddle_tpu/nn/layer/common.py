@@ -48,7 +48,14 @@ class Linear(Layer):
         w_q = getattr(self, "weight_q", None)
         if w_q is not None:  # int8 weight-only (quantization.convert_to_int8)
             return F.linear_act_int8(x, w_q, self.weight_scale, self.bias)
-        return F.linear(x, self.weight, self.bias)
+        y = F.linear(x, self.weight, self.bias)
+        if getattr(self, "lora_A", None) is not None \
+                and not getattr(self, "lora_merged", False):
+            # LoRA fine-tuning (serving.lora.convert_to_lora): the
+            # delta rides the segmented SGMV epilogue as one segment
+            y = F.lora_segment_act(
+                y, x, self.lora_A, self.lora_B * self.lora_scaling)
+        return y
 
     def extra_repr(self):
         return f"in={self._in_features}, out={self._out_features}"

@@ -167,6 +167,24 @@ def _probe_grouped_matmul():
     jax.block_until_ready(fn(x, w, b))
 
 
+def _probe_lora_sgmv():
+    from . import pallas_grouped as pg
+    L, K, N, r = 2, 128, 256, 8
+    bm = 16                       # bf16 sublane multiple
+    nb = 3
+    aid = jnp.array([0, L, 1], jnp.int32)   # middle block null
+    z = jnp.zeros((nb * bm, N), jnp.bfloat16)
+    x = jnp.zeros((nb * bm, K), jnp.bfloat16)
+    a = jnp.ones((L, K, pg.lora_rank_pad(r, jnp.bfloat16)), jnp.bfloat16)
+    b = jnp.ones((L, a.shape[2], N), jnp.bfloat16)
+    fn = jax.jit(jax.grad(
+        lambda z, x, a, b: pg.lora_segment_epilogue(
+            z, x, a, b, block_adapter=aid,
+            act="gelu_tanh").astype(jnp.float32).sum(),
+        argnums=(0, 1, 2, 3)))
+    jax.block_until_ready(fn(z, x, a, b))
+
+
 def _probe_paged_attention():
     from . import pallas_kernels as pk
     q = jnp.zeros((2, 1, 2, 64), jnp.float32)
@@ -219,6 +237,7 @@ _PROBES = {
     "layer_norm": _probe_layer_norm,
     "layer_norm_residual": _probe_layer_norm_residual,
     "grouped_matmul": _probe_grouped_matmul,
+    "lora_sgmv": _probe_lora_sgmv,
     "matmul_epilogue": _probe_matmul_epilogue,
     "matmul_epilogue_int8": _probe_matmul_epilogue_int8,
     "rms_norm": _probe_rms_norm,
@@ -260,6 +279,13 @@ def _static_diagnose(kernel):
         for direction in ("fwd", "bwd_dw"):
             diags.extend(tiling.audit_grouped_matmul(
                 48, 128, 256, 2, dtype=jnp.bfloat16,
+                direction=direction))
+        return diags
+    if kernel == "lora_sgmv":
+        diags = []
+        for direction in ("fwd", "bwd_dw"):
+            diags.extend(tiling.audit_lora_sgmv(
+                48, 128, 256, 8, 2, dtype=jnp.bfloat16,
                 direction=direction))
         return diags
     if kernel == "matmul_epilogue":

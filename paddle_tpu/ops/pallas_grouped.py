@@ -57,6 +57,10 @@ __all__ = [
     "grouped_linear_act",
     "grouped_linear_act_ref",
     "grouped_matmul_block_plan",
+    "lora_epilogue_block_plan",
+    "lora_rank_pad",
+    "lora_segment_epilogue",
+    "lora_segment_epilogue_ref",
 ]
 
 
@@ -310,6 +314,308 @@ def grouped_linear_act_ref(x, w, b=None, *, block_group, act="none"):
         preferred_element_type=jnp.float32)
     z = z + bp[gid][:, None, :].astype(jnp.float32)
     return _act_f32(z, act).reshape(nb * bm, N).astype(x.dtype)
+
+
+# =====================================================================
+# Segmented LoRA SGMV epilogue: act(z + (x @ A[a]) @ B[a])
+# =====================================================================
+#
+# The multi-LoRA serving epilogue (inference/serving/lora.py): after the
+# base matmul produced the pre-activation ``z = x @ W + b``, each
+# block-aligned row block adds its OWN adapter's low-rank update before
+# the activation fires.  The per-block ``block_adapter`` descriptor is
+# the same scalar-prefetched routing machinery as ``block_group`` above
+# — in the engine it is literally the ragged step's per-q-block array,
+# so one compiled program serves a batch where every row may carry a
+# different adapter.  Null rows (``block_adapter == L``) ride an
+# appended zero adapter: their output is ``act(z + 0.0)``, bitwise the
+# plain fused epilogue.  The ``alpha / r`` scale is folded into the
+# packed B stack at load time (lora.py), so merge/unmerge and this
+# kernel share one scaled-B representation.
+#
+# Backward (custom_vjp, so per-tenant fine-tuning trains THROUGH the
+# serving kernel): ``ds = g * act'(s)`` elementwise in XLA on the saved
+# pre-activation sum; ``dz = ds`` (the base path's cotangent);
+# ``dx = (ds @ B[a]^T) @ A[a]^T`` rides `_gmm_call` twice with the
+# transposed stacks; ``dA = x^T @ (ds @ B[a]^T)`` and
+# ``dB = (x @ A[a])^T @ ds`` ride the `_gmm_dw_call` grouped
+# accumulator.  Adapters owning zero blocks are masked to exact zeros,
+# the same uninitialised-block discipline as the grouped dw.
+
+
+def lora_rank_pad(rank, dtype) -> int:
+    """Packed adapter rank: ``rank`` rounded up to the dtype's minimum
+    sublane count, so the B-stack's (r, bn) blocks tile legally and the
+    A-stack's trailing dim lands lane-aligned after Mosaic's internal
+    padding.  The store packs every adapter at this width (zero-filled
+    tail rank columns contribute exact zeros to the update)."""
+    return _round_up(max(int(rank), 1), _min_rows(jnp.dtype(dtype)))
+
+
+def _lora_fwd_kernel(aid_ref, z_ref, x_ref, a_ref, b_ref, o_ref, s_ref,
+                     *, act):
+    """One (block, n-block) program: both low-rank dots in f32 against
+    the owning adapter's slices (aid routes the index maps; the body
+    never branches — null blocks hit the appended zero adapter)."""
+    t = jax.lax.dot_general(
+        x_ref[:].astype(jnp.float32), a_ref[0].astype(jnp.float32),
+        (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)             # (bm, r)
+    d = jax.lax.dot_general(
+        t, b_ref[0].astype(jnp.float32),
+        (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)             # (bm, bn)
+    s = z_ref[:].astype(jnp.float32) + d
+    s_ref[:] = s.astype(s_ref.dtype)
+    o_ref[:] = _act_f32(s, act).astype(o_ref.dtype)
+
+
+@_x32
+def _lora_call(zp, xp, ap, bp, aid, act, bm, bn, direction):
+    """Dispatch the SGMV epilogue pallas_call.  zp: [R, n_pad] base
+    pre-activation; xp: [R, K] block-aligned rows; ap: [L+1, K, r]
+    (zero null adapter appended); bp: [L+1, r, n_pad]; aid: [R // bm]
+    int32 block descriptors."""
+    R, K = xp.shape
+    n_pad = bp.shape[2]
+    r = ap.shape[2]
+    nb = R // bm
+    with _kernel_span("lora_sgmv", direction):
+        out, s = pl.pallas_call(
+            functools.partial(_lora_fwd_kernel, act=act),
+            grid_spec=pltpu.PrefetchScalarGridSpec(
+                num_scalar_prefetch=1,
+                grid=(nb, n_pad // bn),
+                in_specs=[
+                    pl.BlockSpec((bm, bn), lambda i, j, aid: (i, j)),
+                    pl.BlockSpec((bm, K), lambda i, j, aid: (i, 0)),
+                    pl.BlockSpec((1, K, r),
+                                 lambda i, j, aid: (aid[i], 0, 0)),
+                    pl.BlockSpec((1, r, bn),
+                                 lambda i, j, aid: (aid[i], 0, j)),
+                ],
+                out_specs=[
+                    pl.BlockSpec((bm, bn), lambda i, j, aid: (i, j)),
+                    pl.BlockSpec((bm, bn), lambda i, j, aid: (i, j)),
+                ],
+            ),
+            out_shape=[
+                jax.ShapeDtypeStruct((R, n_pad), xp.dtype),
+                jax.ShapeDtypeStruct((R, n_pad), xp.dtype),
+            ],
+            interpret=_interpret(),
+        )(aid, zp, xp, ap, bp)
+    return out, s
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5,))
+def _lora_2d(z, x, a, b, aid, act):
+    return _lora_2d_fwd(z, x, a, b, aid, act)[0]
+
+
+def _lora_2d_fwd(z, x, a, b, aid, act):
+    R, K = x.shape
+    L, _, r = a.shape
+    N = b.shape[2]
+    bm = R // aid.shape[0]
+    _, bn, _, n_pad = matmul_accum_blocks(bm, K, N, x.dtype)
+    ap = jnp.concatenate([a, jnp.zeros((1, K, r), a.dtype)], axis=0)
+    bp = _pad_dim(jnp.concatenate(
+        [b, jnp.zeros((1, r, N), b.dtype)], axis=0), 2, n_pad)
+    zp = _pad_dim(z, 1, n_pad)
+    out, s = _lora_call(zp, x, ap, bp, aid, act, bm, bn, "fwd")
+    return out[:, :N], (z, x, a, b, aid, s[:, :N])
+
+
+def _lora_2d_bwd(act, res, g):
+    z, x, a, b, aid, s = res
+    R, K = x.shape
+    L, _, r = a.shape
+    N = b.shape[2]
+    bm = R // aid.shape[0]
+    # epilogue backward: elementwise in XLA on the saved pre-activation
+    ds32 = g.astype(jnp.float32) * _act_grad_f32(s.astype(jnp.float32),
+                                                 act)
+    dz = ds32.astype(z.dtype)         # the base path's cotangent
+    ds = ds32.astype(x.dtype)
+    # u = ds @ B[a]^T through the grouped kernel (contraction over N)
+    bt = jnp.swapaxes(b, 1, 2)                          # [L, N, r]
+    _, bn_u, _, r_pad = matmul_accum_blocks(bm, N, r, x.dtype)
+    btp, btb = _stacked_pad(bt, jnp.zeros((L, r), x.dtype), r_pad)
+    u_pad, _ = _gmm_call(ds, btp, btb, aid, "none", bm, bn_u, "bwd_dx")
+    u = u_pad[:, :r].astype(x.dtype)
+    # dx = u @ A[a]^T
+    at = jnp.swapaxes(a, 1, 2)                          # [L, r, K]
+    _, bn_x, _, k_pad = matmul_accum_blocks(bm, r, K, x.dtype)
+    atp, atb = _stacked_pad(at, jnp.zeros((L, K), x.dtype), k_pad)
+    dx_pad, _ = _gmm_call(u, atp, atb, aid, "none", bm, bn_x, "bwd_dx")
+    dx = dx_pad[:, :K].astype(x.dtype)
+    # t = x @ A[a] recomputed (cheaper than a third fwd output)
+    _, bn_t, _, r_pad2 = matmul_accum_blocks(bm, K, r, x.dtype)
+    ap2, ab2 = _stacked_pad(a, jnp.zeros((L, r), x.dtype), r_pad2)
+    t_pad, _ = _gmm_call(x, ap2, ab2, aid, "none", bm, bn_t, "fwd")
+    t = t_pad[:, :r].astype(x.dtype)
+    # dA[l] = x^T @ u and dB[l] = t^T @ ds through the grouped dw
+    # accumulator.  The accumulator's revisited-block init trick needs
+    # each adapter's blocks CONSECUTIVE — the MoE router guarantees
+    # that, but serving q-blocks arrive in request order — so the
+    # blocks are stable-sorted by adapter id first (a pure function of
+    # the descriptor: the permutation replays bit-identically).
+    # Adapters owning zero blocks were never visited — mask their
+    # uninitialised output blocks to exact zeros.
+    nbk = aid.shape[0]
+    order = jnp.argsort(aid, stable=True)
+    sgid = aid[order]
+
+    def _by_adapter(v):
+        return v.reshape(nbk, bm, v.shape[1])[order].reshape(v.shape)
+
+    bk_a, bn_a, k_pad2, ra_pad = _gmm_dw_blocks(K, r, x.dtype)
+    da_full = _gmm_dw_call(_by_adapter(_pad_dim(x, 1, k_pad2)),
+                           _by_adapter(_pad_dim(u, 1, ra_pad)),
+                           sgid, L, bm, bk_a, bn_a)
+    bk_b, bn_b, rb_pad, nb_pad = _gmm_dw_blocks(r, N, x.dtype)
+    db_full = _gmm_dw_call(_by_adapter(_pad_dim(t, 1, rb_pad)),
+                           _by_adapter(_pad_dim(ds, 1, nb_pad)),
+                           sgid, L, bm, bk_b, bn_b)
+    blocks_per = jax.ops.segment_sum(
+        jnp.ones_like(aid), aid, num_segments=L + 1)[:L]
+    live = (blocks_per > 0)[:, None, None]
+    da = jnp.where(live, da_full[:L, :K, :r], 0.0).astype(a.dtype)
+    db = jnp.where(live, db_full[:L, :r, :N], 0.0).astype(b.dtype)
+    return dz, dx, da, db, np.zeros(aid.shape, dtype=jax.dtypes.float0)
+
+
+_lora_2d.defvjp(_lora_2d_fwd, _lora_2d_bwd)
+
+
+def _check_lora_layout(z, x, a, b, block_adapter):
+    L, K, r = a.shape
+    R = x.shape[0]
+    nb = block_adapter.shape[0]
+    if x.shape[1] != K:
+        raise ValueError(f"x K={x.shape[1]} vs a_stack K={K}")
+    if tuple(b.shape[:2]) != (L, r):
+        raise ValueError(
+            f"b_stack leading dims {tuple(b.shape[:2])} != ({L}, {r})")
+    if tuple(z.shape) != (R, b.shape[2]):
+        raise ValueError(
+            f"z shape {tuple(z.shape)} != ({R}, {b.shape[2]})")
+    if R % nb:
+        raise ValueError(
+            f"{R} rows not divisible by {nb} block descriptors")
+    bm = R // nb
+    if bm % _min_rows(x.dtype):
+        raise ValueError(
+            f"block_rows {bm} is not a {jnp.dtype(x.dtype).name} "
+            f"sublane multiple ({_min_rows(x.dtype)})")
+
+
+def lora_segment_epilogue(z, x, a_stack, b_stack, *, block_adapter,
+                          act="none"):
+    """``act(z + (x @ A[a]) @ B[a])`` over block-aligned rows; the
+    Pallas path (interpret mode off-TPU); differentiable in z, x and
+    both adapter stacks.
+
+    z: [R, N] base pre-activation (``x @ W + b``); x: [R, K] rows in
+    q-block/grouped layout; a_stack: [L, K, r] packed adapter A
+    weights; b_stack: [L, r, N] packed B weights WITH the ``alpha/r``
+    scale folded in; block_adapter: [R // block_rows] int32 per-block
+    adapter ids (``L`` marks a null block — zero update, so those rows
+    emit ``act(z)`` bitwise).
+    """
+    if act not in ACTIVATIONS:
+        raise ValueError(f"act must be one of {ACTIVATIONS}, got {act!r}")
+    z, x, a_stack, b_stack = _demote_f64(z, x, a_stack, b_stack)
+    _check_lora_layout(z, x, a_stack, b_stack, block_adapter)
+    return _lora_2d(z, x, a_stack, b_stack,
+                    block_adapter.astype(jnp.int32), act)
+
+
+def lora_segment_epilogue_ref(z, x, a_stack, b_stack, *, block_adapter,
+                              act="none"):
+    """XLA composite of `lora_segment_epilogue`: the same per-block
+    full-K f32 dots (batched over blocks) in the same order — low-rank
+    contraction, expansion, add, activation — so it is the dispatch
+    fallback when the gate is off and the parity reference for the
+    kernel tests.  Numerically equivalent to the kernel within dot
+    reduction order."""
+    if act not in ACTIVATIONS:
+        raise ValueError(f"act must be one of {ACTIVATIONS}, got {act!r}")
+    z, x, a_stack, b_stack = _demote_f64(z, x, a_stack, b_stack)
+    _check_lora_layout(z, x, a_stack, b_stack, block_adapter)
+    L, K, r = a_stack.shape
+    N = b_stack.shape[2]
+    aid = block_adapter.astype(jnp.int32)
+    nb = aid.shape[0]
+    bm = x.shape[0] // nb
+    ap = jnp.concatenate(
+        [a_stack, jnp.zeros((1, K, r), a_stack.dtype)], axis=0)
+    bp = jnp.concatenate(
+        [b_stack, jnp.zeros((1, r, N), b_stack.dtype)], axis=0)
+    xb = x.reshape(nb, bm, K).astype(jnp.float32)
+    ag = ap[aid].astype(jnp.float32)                    # [nb, K, r]
+    t = jax.lax.dot_general(
+        xb, ag, (((2,), (1,)), ((0,), (0,))),
+        preferred_element_type=jnp.float32)             # [nb, bm, r]
+    bg = bp[aid].astype(jnp.float32)                    # [nb, r, N]
+    d = jax.lax.dot_general(
+        t, bg, (((2,), (1,)), ((0,), (0,))),
+        preferred_element_type=jnp.float32)
+    s = z.reshape(nb, bm, N).astype(jnp.float32) + d
+    return _act_f32(s, act).reshape(nb * bm, N).astype(x.dtype)
+
+
+def lora_epilogue_block_plan(tokens, k, n, rank, num_adapters,
+                             dtype=jnp.float32, direction="fwd",
+                             block_rows=None):
+    """The exact block plan the SGMV epilogue uses for ``tokens`` rows.
+    Same contract as `grouped_matmul_block_plan`; the scalar-prefetched
+    ``block_adapter`` descriptor is untiled and omitted.
+
+    ``block_rows`` pins the serving engine's ragged q-block height;
+    default is the grouped fine-tuning layout.  ``direction`` selects
+    ``"fwd"`` (`_lora_call`; also the shape of the two dx passes with
+    dims permuted) or ``"bwd_dw"`` (the dA grouped accumulation).
+    """
+    dtype = jnp.dtype(dtype)
+    f32 = jnp.dtype(jnp.float32)
+    if block_rows:
+        bm = int(block_rows)
+        nb = -(-int(tokens) // bm)
+    else:
+        bm, nb, _ = grouped_layout(tokens, num_adapters, dtype)
+    rows = nb * bm
+    r = lora_rank_pad(rank, dtype)
+    L = num_adapters
+    base = {"direction": direction, "block_rows": bm, "num_blocks": nb,
+            "rank": r, "scratch": ()}
+    if direction == "fwd":
+        _, bn, _, n_pad = matmul_accum_blocks(bm, k, n, dtype)
+        base["grid"] = (nb, n_pad // bn)
+        base["block_n"] = bn
+        base["operands"] = [
+            ("z", (bm, bn), (rows, n_pad), dtype),
+            ("x", (bm, k), (rows, k), dtype),
+            ("a", (1, k, r), (L + 1, k, r), dtype),
+            ("b", (1, r, bn), (L + 1, r, n_pad), dtype),
+            ("out", (bm, bn), (rows, n_pad), dtype),
+            ("s", (bm, bn), (rows, n_pad), dtype),
+        ]
+    elif direction == "bwd_dw":
+        bk, bn, k_pad, r_pad = _gmm_dw_blocks(k, r, dtype)
+        base["grid"] = (k_pad // bk, r_pad // bn, nb)
+        base["block_k"] = bk
+        base["block_n"] = bn
+        base["operands"] = [
+            ("x", (bm, bk), (rows, k_pad), dtype),
+            ("u", (bm, bn), (rows, r_pad), dtype),
+            ("da", (1, bk, bn), (L + 1, k_pad, r_pad), f32),
+        ]
+    else:
+        raise ValueError(
+            f"direction must be fwd|bwd_dw, got {direction!r}")
+    return base
 
 
 def grouped_matmul_block_plan(tokens, k, n, num_experts,

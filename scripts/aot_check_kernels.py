@@ -135,6 +135,23 @@ for tag, dt in [("f32", f32), ("bf16", bf16)]:
             act="gelu_tanh").astype(f32).sum(), argnums=(0, 1, 2)),
         ((rows, K), dt), ((E, K, N), dt), ((E, N), dt))
 
+# segmented LoRA SGMV epilogue (multi-adapter serving): scalar-
+# prefetched block_adapter descriptors route per-q-block low-rank
+# updates onto the base pre-activation; fwd + full backward (dz/dx via
+# kernel reuse, dA/dB grouped accumulation over the block sort)
+for tag, dt in [("f32", f32), ("bf16", bf16)]:
+    L, K, N, tokens, rank = 64, 768, 3072, 1024, 16
+    bm, nb, rows = pgm.grouped_layout(tokens, L, dt)
+    r = pgm.lora_rank_pad(rank, dt)
+    aid = jnp.zeros((nb,), i32)
+    ok &= aot_compile(
+        f"lora_sgmv fwd+bwd {tag}",
+        jax.grad(lambda z, x, a, b: pgm.lora_segment_epilogue(
+            z, x, a, b, block_adapter=aid,
+            act="gelu_tanh").astype(f32).sum(), argnums=(0, 1, 2, 3)),
+        ((rows, N), dt), ((rows, K), dt), ((L, K, r), dt),
+        ((L, r, N), dt))
+
 # paged decode attention (scalar-prefetched block tables): the index
 # maps trace at lower time outside the _x32 scope, which is exactly
 # what this compile-only pipeline catches and interpret mode cannot

@@ -14,6 +14,9 @@ Lints the bundled models without needing a TPU:
   * **moe**   — bundled moe_gpt routing balance at init (TPU508),
     capacity-router headroom at the measured skew (TPU507), and the
     grouped expert matmul's block plans vs the Mosaic tiling rules;
+  * **lora**  — multi-LoRA serving: adapter-store working set replayed
+    through the LRU policy (TPU509), rank vs the dtype sublane floor
+    (TPU510), and the segmented SGMV epilogue's fwd/bwd block plans;
   * **pallas** — flash / paged attention block plans checked against the
     Mosaic tiling rules (``analysis.tiling``), no kernel launch;
   * **sharding** — built-in BERT/GPT partition-rule sets audited against
@@ -41,8 +44,8 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(
 
 import numpy as np  # noqa: E402
 
-MODELS = ("lenet", "eager", "bert", "gpt", "moe", "pallas", "sharding",
-          "fabric", "faults")
+MODELS = ("lenet", "eager", "bert", "gpt", "moe", "lora", "pallas",
+          "sharding", "fabric", "faults")
 
 
 def lint_lenet():
@@ -263,6 +266,42 @@ def lint_moe():
     return report
 
 
+def lint_lora():
+    """Multi-LoRA serving lint: the planned tenant mix replayed through
+    the adapter store's LRU policy (TPU509), the configured rank vs the
+    stack dtype's sublane floor (TPU510), and the segmented SGMV
+    epilogue's block plans vs the Mosaic tiling rules — all CPU-only,
+    no kernel launch and no model build."""
+    import jax.numpy as jnp
+    from paddle_tpu import analysis
+    from paddle_tpu.analysis.diagnostics import DiagnosticReport, record
+    from paddle_tpu.analysis.lora_audit import (audit_adapter_working_set,
+                                                audit_lora_rank)
+
+    report = DiagnosticReport(label="lora store + sgmv plans")
+    # the bench's serving shape: Zipf tenant mix over a pool sized to
+    # the default (num_slots = max_batch); must not thrash
+    rng = np.random.default_rng(0)
+    trace = [f"t{min(int(z), 63)}" for z in rng.zipf(1.3, 512)]
+    audit_adapter_working_set(trace, 16, site="bench.gpt_multilora",
+                              report=report)
+    for dtype in (jnp.float32, jnp.bfloat16):
+        audit_lora_rank(16, dtype, site=f"lora.rank[{jnp.dtype(dtype).name}]",
+                        report=report)
+        for direction in ("fwd", "bwd_dw"):
+            r = analysis.audit_lora_sgmv(
+                1024, 768, 3072, 16, 64, dtype=dtype, direction=direction)
+            report.extend(r.diagnostics)
+        # the serving epilogue rides the engine's ragged q-block height
+        r = analysis.audit_lora_sgmv(
+            256, 768, 768, 16, 64, dtype=dtype,
+            block_rows=16 if jnp.dtype(dtype).itemsize == 2 else 8)
+        report.extend(r.diagnostics)
+    for d in report.diagnostics:
+        record(d)
+    return report
+
+
 def lint_pallas():
     """Fused-suite block plans vs the Mosaic tiling rules: flash
     attention (fwd + both backward passes), layernorm+residual and
@@ -416,7 +455,8 @@ def lint_faults():
 
 
 LINTERS = {"lenet": lint_lenet, "eager": lint_eager, "bert": lint_bert,
-           "gpt": lint_gpt, "moe": lint_moe, "pallas": lint_pallas,
+           "gpt": lint_gpt, "moe": lint_moe, "lora": lint_lora,
+           "pallas": lint_pallas,
            "sharding": lint_sharding, "fabric": lint_fabric,
            "faults": lint_faults}
 

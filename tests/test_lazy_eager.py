@@ -549,3 +549,97 @@ def test_lazy_prunes_dead_intermediates():
         # `held` was externally referenced -> materialized by the flush
         assert held._value._concrete is not None or \
             np.asarray(held.numpy()).sum() == 80.0
+
+
+# ---------------------------------------------------------------------
+# RNN / dynamic-model sweep: recurrent python loops are the lazy
+# tier's stress case — every timestep records ops into the segment, so
+# whole-step capture must still flush once per sync point, replay one
+# cached fingerprint at steady state, and leave the TPU205 segment
+# audit clean (fixed shapes => no thrash).
+# ---------------------------------------------------------------------
+def _rnn_model(kind):
+    class Net(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            if kind == "simple":
+                self.rnn = nn.SimpleRNN(8, 16)
+            elif kind == "lstm":
+                self.rnn = nn.LSTM(8, 16)
+            elif kind == "gru":
+                self.rnn = nn.GRU(8, 16)
+            else:
+                self.rnn = nn.GRU(8, 16, direction="bidirect")
+            self.head = nn.Linear(32 if kind == "bigru" else 16, 4)
+
+        def forward(self, x):
+            y, _ = self.rnn(x)
+            return self.head(paddle.mean(y, axis=1))
+    return Net()
+
+
+@pytest.mark.parametrize("kind", ["simple", "lstm", "gru", "bigru"])
+def test_lazy_rnn_sweep_flush_counts_and_clean_audit(kind):
+    from paddle_tpu import analysis
+    from paddle_tpu.core.lazy import _segment_history
+
+    paddle.seed(11)
+    m = _rnn_model(kind)
+    opt = optimizer.Adam(learning_rate=1e-3, parameters=m.parameters())
+    rng = np.random.RandomState(5)
+    mark = len(_segment_history)
+    steps, flushes, hits0 = 4, [], lazy.stats["cache_hits"]
+    with paddle.incubate.lazy_eager():
+        for i in range(steps):
+            x = paddle.to_tensor(rng.randn(2, 6, 8).astype(np.float32))
+            y = paddle.to_tensor(rng.randint(0, 4, (2,)).astype(np.int64))
+            before = lazy.stats["flushes"]
+            loss = F.cross_entropy(m(x), y)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            float(loss)                           # the step's one sync
+            flushes.append(lazy.stats["flushes"] - before)
+    # whole-step capture: exactly one flush per training step
+    assert flushes == [1] * steps, flushes
+    # steady state replays the cached executable, not a recompile
+    assert lazy.stats["cache_hits"] - hits0 >= steps - 1
+    # fixed shapes + static op stream => the TPU205 audit stays clean
+    fresh = list(_segment_history)[mark:]
+    diags = analysis.recompile.audit_segment_cache(history=fresh, threshold=2)
+    assert diags == [], [d.message for d in diags]
+
+
+@pytest.mark.parametrize("kind", ["lstm", "gru"])
+def test_lazy_rnn_parity_against_immediate(kind):
+    """Same recurrent step, lazy vs immediate eager: exact same impls
+    in the same order, so losses agree to float tolerance."""
+    def data(i):
+        rng = np.random.RandomState(i)
+        return (paddle.to_tensor(rng.randn(2, 6, 8).astype(np.float32)),
+                paddle.to_tensor(rng.randint(0, 4, (2,)).astype(np.int64)))
+
+    ref = _train(lambda: _rnn_model(kind), data, False)
+    got = _train(lambda: _rnn_model(kind), data, True)
+    np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-7)
+
+
+def test_lazy_rnn_shape_drift_flags_tpu205():
+    """The negative control: a recurrent loop fed a NEW sequence length
+    every step recompiles the whole segment each time — exactly what
+    the TPU205 audit exists to name."""
+    from paddle_tpu import analysis
+    from paddle_tpu.core.lazy import _segment_history
+
+    paddle.seed(12)
+    m = _rnn_model("gru")
+    rng = np.random.RandomState(9)
+    mark = len(_segment_history)
+    with paddle.incubate.lazy_eager():
+        for t in (4, 5, 6):                      # drifting seq length
+            x = paddle.to_tensor(rng.randn(2, t, 8).astype(np.float32))
+            float(paddle.mean(m(x)))
+    fresh = list(_segment_history)[mark:]
+    diags = analysis.recompile.audit_segment_cache(history=fresh, threshold=2)
+    assert any(d.code == "TPU205" for d in diags), \
+        "shape drift across steps must flag TPU205"

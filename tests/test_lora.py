@@ -1,0 +1,506 @@
+"""Multi-LoRA tenancy: the paged adapter store, the segmented SGMV
+epilogue, adapter-aware prefix caching, and serving-tier integration.
+
+The acceptance bar is exactness, not "close": per-row adapter outputs
+must match each adapter's MERGED model greedily (f32), null-adapter
+rows must match the base engine token-for-token, spill/promote
+round-trips must be bit-identical, and an adapter-carrying request
+killed mid-decode must replay bit-identically on a survivor — the
+same replay invariants the serving fault suite leans on, extended to
+the tenant dimension.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.distributed.fault_tolerance import FaultPlan, inject
+from paddle_tpu.inference.serving import (AdapterStoreFull,
+                                          DataParallelEngine,
+                                          GenerationEngine,
+                                          LoRAAdapterStore, PagedKVCache,
+                                          SLOPolicy, TenantSpec)
+from paddle_tpu.inference.serving import lora as L
+from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLM
+
+VOCAB = 97
+
+
+@pytest.fixture(autouse=True)
+def _serving_env(monkeypatch):
+    for var in ("PADDLE_TPU_HBM_BUDGET", "PADDLE_TPU_MEMORY_GUARD",
+                "PADDLE_TPU_KV_BLOCK_SIZE", "PADDLE_TPU_MAX_BATCH",
+                "PADDLE_TPU_PREFIX_CACHE", "PADDLE_TPU_PREFILL_CHUNK",
+                "PADDLE_TPU_LORA_STORE_BUDGET"):
+        monkeypatch.delenv(var, raising=False)
+    yield
+
+
+def _cfg():
+    return GPTConfig(vocab_size=VOCAB, hidden_size=32,
+                     num_hidden_layers=2, num_attention_heads=4,
+                     max_position_embeddings=64,
+                     use_flash_attention=False)
+
+
+def _model(seed=7):
+    paddle.seed(seed)
+    m = GPTForCausalLM(_cfg())
+    m.eval()
+    return m
+
+
+@pytest.fixture(scope="module")
+def base_state():
+    return _model().state_dict()
+
+
+def _fresh(base_state):
+    m = _model()
+    m.set_state_dict(base_state)
+    return m
+
+
+def _adapter_sd(sites, seed, rank=4, scale=0.05):
+    rng = np.random.default_rng(seed)
+    return {name: {"A": (rng.standard_normal((k, rank)) * scale
+                         ).astype(np.float32),
+                   "B": (rng.standard_normal((rank, n)) * scale
+                         ).astype(np.float32),
+                   "rank": rank, "alpha": float(rank)}
+            for name, k, n in sites}
+
+
+def _prompts(n, seed=0):
+    rng = np.random.default_rng(seed)
+    return [list(rng.integers(1, VOCAB, size=int(rng.integers(5, 14))))
+            for _ in range(n)]
+
+
+def _engine(model, **kw):
+    kw.setdefault("max_batch", 4)
+    kw.setdefault("num_blocks", 128)
+    kw.setdefault("block_size", 8)
+    kw.setdefault("max_model_len", 64)
+    return GenerationEngine(model, **kw)
+
+
+# ---------------------------------------------------------------------
+# convert / merge / state-dict round-trip
+# ---------------------------------------------------------------------
+class TestConvertMerge:
+    def test_convert_zero_init_is_identity(self, base_state):
+        m = _fresh(base_state)
+        x = paddle.to_tensor(
+            np.arange(8, dtype=np.int64).reshape(1, 8) % VOCAB)
+        want = m(x).numpy()
+        L.convert_to_lora(m, rank=4)
+        got = m(x).numpy()
+        # B initializes to zero => the delta is exactly zero
+        np.testing.assert_array_equal(got, want)
+        for site, _, _ in L.attach_lora_sites(m):
+            layer = dict(m.named_sublayers())[site]
+            assert layer.weight.stop_gradient
+            assert not layer.lora_A.stop_gradient
+            assert not layer.lora_B.stop_gradient
+
+    def test_merge_unmerge_roundtrip(self, base_state):
+        m = _fresh(base_state)
+        sites = L.attach_lora_sites(m)
+        L.convert_to_lora(m, rank=4)
+        L.load_lora_state_dict(m, _adapter_sd(sites, 1))
+        before = {site: dict(m.named_sublayers())[site].weight.numpy()
+                  for site, _, _ in sites}
+        L.merge_lora(m)
+        L.merge_lora(m)      # idempotent
+        after = {site: dict(m.named_sublayers())[site].weight.numpy()
+                 for site, _, _ in sites}
+        assert any(not np.array_equal(before[s], after[s])
+                   for s in before)
+        L.unmerge_lora(m)
+        L.unmerge_lora(m)    # idempotent
+        for site, _, _ in sites:
+            got = dict(m.named_sublayers())[site].weight.numpy()
+            np.testing.assert_allclose(got, before[site],
+                                       rtol=1e-6, atol=1e-6)
+
+    def test_state_dict_roundtrip(self, base_state):
+        m = _fresh(base_state)
+        sites = L.attach_lora_sites(m)
+        L.convert_to_lora(m, rank=4)
+        sd = _adapter_sd(sites, 2)
+        L.load_lora_state_dict(m, sd)
+        out = L.lora_state_dict(m)
+        for site, _, _ in sites:
+            np.testing.assert_array_equal(out[site]["A"], sd[site]["A"])
+            np.testing.assert_array_equal(out[site]["B"], sd[site]["B"])
+
+
+# ---------------------------------------------------------------------
+# the paged adapter store
+# ---------------------------------------------------------------------
+class TestAdapterStore:
+    SITES = [("blk.fc1", 32, 64), ("blk.fc2", 64, 32)]
+
+    def _store(self, **kw):
+        kw.setdefault("num_slots", 2)
+        kw.setdefault("register", False)
+        return LoRAAdapterStore(self.SITES, rank=4, **kw)
+
+    def _weights(self, seed):
+        rng = np.random.default_rng(seed)
+        return {name: (rng.standard_normal((k, 4)).astype(np.float32),
+                       rng.standard_normal((4, n)).astype(np.float32))
+                for name, k, n in self.SITES}
+
+    def test_spill_promote_bit_identical(self):
+        st = self._store()
+        for i in range(3):
+            st.register_adapter(f"t{i}", self._weights(i))
+        st.acquire("t0")
+        packed0 = {s: (np.asarray(st.pair(s)[0]._value[st.slot_of("t0")]),
+                       np.asarray(st.pair(s)[1]._value[st.slot_of("t0")]))
+                   for s, _, _ in self.SITES}
+        st.release("t0")
+        st.acquire("t1")
+        st.acquire("t2")     # evicts t0 (LRU, refcount 0)
+        assert st.stats()["spills"] >= 1
+        st.release("t1")
+        st.release("t2")
+        st.acquire("t0")     # promote back from host
+        for s, _, _ in self.SITES:
+            a = np.asarray(st.pair(s)[0]._value[st.slot_of("t0")])
+            b = np.asarray(st.pair(s)[1]._value[st.slot_of("t0")])
+            np.testing.assert_array_equal(a, packed0[s][0])
+            np.testing.assert_array_equal(b, packed0[s][1])
+        st.close()
+
+    def test_full_pool_raises_when_pinned(self):
+        st = self._store()
+        for i in range(3):
+            st.register_adapter(f"t{i}", self._weights(i))
+        st.acquire("t0")
+        st.acquire("t1")
+        with pytest.raises(AdapterStoreFull):
+            st.acquire("t2")
+        st.release("t0")
+        st.acquire("t2")     # now the LRU slot is evictable
+        st.close()
+
+    def test_drop_refuses_pinned(self):
+        st = self._store()
+        st.register_adapter("t0", self._weights(0))
+        st.acquire("t0")
+        with pytest.raises(RuntimeError):
+            st.drop_adapter("t0")
+        st.release("t0")
+        st.drop_adapter("t0")
+        assert not st.has_adapter("t0")
+        st.close()
+
+    def test_scale_folded_into_b(self):
+        st = self._store()
+        w = self._weights(5)
+        st.register_adapter("x2", w, alpha=8.0)   # alpha/r = 2.0
+        st.acquire("x2")
+        s, _, n = self.SITES[0]
+        b = np.asarray(st.pair(s)[1]._value[st.slot_of("x2")])
+        np.testing.assert_allclose(b[:4], w[s][1] * 2.0, rtol=1e-6)
+        st.close()
+
+
+# ---------------------------------------------------------------------
+# TPU509 / TPU510 analyzers
+# ---------------------------------------------------------------------
+class TestLoraAudits:
+    def test_lru_simulation_counts(self):
+        from paddle_tpu.analysis import simulate_adapter_store
+        hits, misses, spills = simulate_adapter_store(
+            ["a", "b", "a", None, "c", "a", "b"], 2)
+        # a,b miss; a hits; c miss evicting b; a hits; b misses again
+        assert (hits, misses, spills) == (2, 4, 2)
+
+    def test_tpu509_fires_on_thrash(self):
+        from paddle_tpu.analysis import audit_adapter_working_set
+        trace = [f"t{i % 8}" for i in range(64)]   # round-robin over 8
+        rep = audit_adapter_working_set(trace, 2, bytes_per_slot=1 << 20,
+                                        emit=False)
+        assert [d.code for d in rep] == ["TPU509"]
+        assert rep.diagnostics[0].data["hit_rate"] == 0.0
+
+    def test_tpu509_clean_when_pool_holds(self):
+        from paddle_tpu.analysis import audit_adapter_working_set
+        trace = [f"t{i % 4}" for i in range(64)]
+        rep = audit_adapter_working_set(trace, 8, emit=False)
+        assert list(rep) == []
+
+    def test_tpu510_rank_below_tile(self):
+        from paddle_tpu.analysis import audit_lora_rank
+        rep = audit_lora_rank(4, "bfloat16", emit=False)
+        assert [d.code for d in rep] == ["TPU510"]
+        assert rep.diagnostics[0].data["r_pad"] == 16
+        assert list(audit_lora_rank(8, "float32", emit=False)) == []
+
+    @pytest.mark.parametrize("dtype", ["float32", "bfloat16"])
+    @pytest.mark.parametrize("direction", ["fwd", "bwd_dw"])
+    def test_sgmv_block_plans_legal(self, dtype, direction):
+        from paddle_tpu.analysis import audit_lora_sgmv
+        rep = audit_lora_sgmv(512, 256, 1024, 16, 64, dtype=dtype,
+                              direction=direction)
+        assert list(rep) == [], rep.render()
+
+
+# ---------------------------------------------------------------------
+# adapter-aware prefix caching
+# ---------------------------------------------------------------------
+class TestPrefixAdapterKeying:
+    def _cache(self):
+        return PagedKVCache(num_blocks=64, block_size=4, num_layers=1,
+                            num_heads=1, head_dim=8, register=False)
+
+    def test_adapters_do_not_share_prefixes(self):
+        c = self._cache()
+        toks = list(range(1, 17))
+        c.allocate("a", len(toks), tokens=toks, adapter="t0")
+        c.commit_prefix("a", toks)
+        # same tokens, same adapter -> full block hits
+        assert c.prefix_match_tokens(toks, adapter="t0") == 16
+        # same tokens, other adapter / base model -> cold
+        assert c.prefix_match_tokens(toks, adapter="t1") == 0
+        assert c.prefix_match_tokens(toks) == 0
+        # chain hashes diverge at the root, not just at depth
+        assert (c.chain_hashes(toks, adapter="t0")
+                != c.chain_hashes(toks, adapter="t1"))
+
+    def test_adapter_survives_free_requeue(self):
+        c = self._cache()
+        toks = list(range(1, 13))
+        c.allocate("a", len(toks), tokens=toks, adapter="t0")
+        c.commit_prefix("a", toks)
+        c.free("a")
+        # the committed prefix remains keyed under its adapter
+        assert c.prefix_match_tokens(toks, adapter="t0") == 12
+        assert c.prefix_match_tokens(toks, adapter="t1") == 0
+
+
+# ---------------------------------------------------------------------
+# serving integration
+# ---------------------------------------------------------------------
+class TestEngineMultiLora:
+    def _serve(self, eng, reqs):
+        ids = [eng.add_request(p, max_new_tokens=8, adapter=a)
+               for p, a in reqs]
+        while eng.has_unfinished():
+            eng.step()
+        return [eng.result(i) for i in ids]
+
+    def test_mixed_adapters_one_program_and_merged_parity(
+            self, base_state):
+        m = _fresh(base_state)
+        sites = L.attach_lora_sites(m)
+        adapters = {f"t{i}": _adapter_sd(sites, 10 + i)
+                    for i in range(3)}
+        prompts = _prompts(6, seed=3)
+        assign = ["t0", "t1", None, "t2", "t0", None]
+        eng = _engine(m)
+        try:
+            eng.enable_lora(rank=4)
+            for name, sd in adapters.items():
+                eng.register_adapter(name, sd)
+            outs = self._serve(eng, list(zip(prompts, assign)))
+            # 64 tenants, ONE unified step program
+            assert eng.stats()["step_compiles"] <= 3
+            assert eng.stats()["adapter_hit_rate"] >= 0.0
+        finally:
+            eng.close()
+        # per-row parity against each adapter's MERGED model, greedy f32
+        for name in [None, "t0", "t1", "t2"]:
+            idxs = [i for i, a in enumerate(assign) if a == name]
+            ref_m = _fresh(base_state)
+            if name is not None:
+                L.convert_to_lora(ref_m, rank=4)
+                L.load_lora_state_dict(ref_m, adapters[name])
+                L.merge_lora(ref_m)
+            ref = _engine(ref_m)
+            try:
+                want = ref.generate([prompts[i] for i in idxs],
+                                    max_new_tokens=8)
+            finally:
+                ref.close()
+            for j, i in enumerate(idxs):
+                assert outs[i] == want[j], (name, i)
+
+    def test_null_rows_match_base_engine_exactly(self, base_state):
+        prompts = _prompts(5, seed=9)
+        base = _engine(_fresh(base_state))
+        try:
+            want = base.generate(prompts, max_new_tokens=8)
+        finally:
+            base.close()
+        m = _fresh(base_state)
+        sites = L.attach_lora_sites(m)
+        eng = _engine(m)
+        try:
+            eng.enable_lora(rank=4)
+            eng.register_adapter("t0", _adapter_sd(sites, 20))
+            # adapter traffic interleaved with base rows: the null rows
+            # ride the appended zero expert and must not move at all
+            reqs = [(p, "t0" if i == 2 else None)
+                    for i, p in enumerate(prompts)]
+            outs = self._serve(eng, reqs)
+        finally:
+            eng.close()
+        for i in range(len(prompts)):
+            if i != 2:
+                assert outs[i] == want[i], i
+
+    def test_spill_promote_under_decode_exact(self, base_state):
+        """A slot pool smaller than the tenant set forces spill/promote
+        between bursts; outputs must match the uncontended run."""
+        m = _fresh(base_state)
+        sites = L.attach_lora_sites(m)
+        adapters = {f"t{i}": _adapter_sd(sites, 30 + i)
+                    for i in range(4)}
+        prompts = _prompts(4, seed=11)
+
+        def run(num_slots):
+            eng = _engine(_fresh(base_state), max_batch=2)
+            try:
+                eng.enable_lora(rank=4, num_slots=num_slots)
+                for name, sd in adapters.items():
+                    eng.register_adapter(name, sd)
+                out = []
+                for burst in range(2):
+                    reqs = [(p, f"t{i}")
+                            for i, p in enumerate(prompts)]
+                    out.extend(self._serve(eng, reqs))
+                return out, eng.stats()["lora"]
+            finally:
+                eng.close()
+
+        want, _ = run(num_slots=4)
+        got, ls = run(num_slots=2)
+        assert ls["spills"] > 0
+        assert got == want
+
+    def test_tenant_default_adapter_via_slo(self, base_state):
+        m = _fresh(base_state)
+        sites = L.attach_lora_sites(m)
+        slo = SLOPolicy(tenants=[TenantSpec("acme", adapter="t0")])
+        eng = _engine(m, slo=slo)
+        try:
+            eng.enable_lora(rank=4)
+            eng.register_adapter("t0", _adapter_sd(sites, 40))
+            p = _prompts(1, seed=13)[0]
+            rid = eng.add_request(p, max_new_tokens=6, tenant="acme")
+            while eng.has_unfinished():
+                eng.step()
+            got = eng.result(rid)
+        finally:
+            eng.close()
+        ref_m = _fresh(base_state)
+        L.convert_to_lora(ref_m, rank=4)
+        L.load_lora_state_dict(ref_m, _adapter_sd(sites, 40))
+        L.merge_lora(ref_m)
+        ref = _engine(ref_m)
+        try:
+            want = ref.generate([p], max_new_tokens=6)[0]
+        finally:
+            ref.close()
+        assert got == want
+
+    def test_unregistered_adapter_rejected(self, base_state):
+        m = _fresh(base_state)
+        eng = _engine(m)
+        try:
+            with pytest.raises(ValueError):
+                eng.add_request([1, 2, 3], adapter="nope")
+            eng.enable_lora(rank=4)
+            with pytest.raises(KeyError):
+                eng.add_request([1, 2, 3], adapter="nope")
+        finally:
+            eng.close()
+
+    def test_sixty_four_adapters_one_program(self, base_state):
+        """The tentpole acceptance shape: a 64-adapter Zipf trace
+        through one engine, asserting program-count stability."""
+        from paddle_tpu.distributed.fault_tolerance.chaos import (
+            bursty_trace)
+        m = _fresh(base_state)
+        sites = L.attach_lora_sites(m)
+        eng = _engine(m)
+        try:
+            eng.enable_lora(rank=4, num_slots=8)
+            for i in range(64):
+                eng.register_adapter(f"t{i}", _adapter_sd(sites, 100 + i))
+            trace = bursty_trace(5, n_requests=16, vocab=VOCAB,
+                                 prefix_len=8, tail_max=6,
+                                 max_new_tokens=4, adapter_pool=64)
+            ids = [eng.add_request(r["prompt"],
+                                   max_new_tokens=r["max_new_tokens"],
+                                   adapter=r["adapter"]) for r in trace]
+            while eng.has_unfinished():
+                eng.step()
+            outs = [eng.result(i) for i in ids]
+            assert all(len(o) > len(r["prompt"])
+                       for o, r in zip(outs, trace))
+            assert eng.stats()["step_compiles"] <= 3
+            assert eng.stats()["lora"]["registered"] == 64
+        finally:
+            eng.close()
+
+
+# ---------------------------------------------------------------------
+# failover replay with adapter-carrying requests
+# ---------------------------------------------------------------------
+class TestLoraFailover:
+    def _dp(self, base_state, adapters):
+        dp = DataParallelEngine(_fresh(base_state), dp=2, max_batch=4,
+                                num_blocks=128, block_size=8,
+                                max_model_len=64)
+        # the adapter must be registered on EVERY replica: failover
+        # re-admits the request on a survivor, whose store resolves
+        # the id locally
+        for e in dp.engines:
+            e.enable_lora(rank=4)
+            for name, sd in adapters.items():
+                e.register_adapter(name, sd)
+        return dp
+
+    def test_replica_kill_replays_bit_identical(self, base_state):
+        sites = L.attach_lora_sites(_fresh(base_state))
+        adapters = {f"t{i}": _adapter_sd(sites, 50 + i)
+                    for i in range(2)}
+        prompts = _prompts(6, seed=17)
+        assign = ["t0", "t1", None, "t0", "t1", None]
+
+        def run(plan=None):
+            dp = self._dp(base_state, adapters)
+            try:
+                ctx = inject(plan) if plan is not None else None
+                if ctx:
+                    ctx.__enter__()
+                try:
+                    ids = [dp.add_request(p, max_new_tokens=8, adapter=a)
+                           for p, a in zip(prompts, assign)]
+                    while dp.has_unfinished():
+                        dp.step()
+                finally:
+                    if ctx:
+                        ctx.__exit__(None, None, None)
+                return ([dp.result(i) for i in ids], dp.stats())
+            finally:
+                dp.close()
+
+        want, _ = run()
+        got, s = run(FaultPlan.parse(
+            "serve.replica_down.dp0:kill:after=2,count=1"))
+        assert s["failovers"] == 1
+        assert got == want
+
+    def test_transport_preserves_adapter(self):
+        from paddle_tpu.inference.serving.scheduler import Request
+        from paddle_tpu.inference.serving.transport import (
+            deserialize_request, serialize_request)
+        req = Request("r1", [1, 2, 3], max_new_tokens=4, adapter="t7")
+        out = deserialize_request(serialize_request(req))
+        assert out.adapter == "t7"
